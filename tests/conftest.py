@@ -1,0 +1,34 @@
+"""Test bootstrap.
+
+Mirrors the reference's test strategy (SURVEY.md §4): everything runs against
+an in-process stand-in for the distributed tier. Here that means JAX's CPU
+backend with 8 virtual devices, so collective/sharding tests exercise the
+real multi-chip code paths without TPU hardware. Must run before jax is
+imported anywhere.
+"""
+
+import os
+
+# Force CPU for tests even when the session env points at real TPU hardware.
+# The axon sitecustomize pins JAX_PLATFORMS=axon at interpreter start, so the
+# env var alone is not enough — jax.config.update after import wins.
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

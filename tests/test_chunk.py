@@ -1,0 +1,139 @@
+"""Columnar core tests (Dictionary / Column / Chunk)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tidb_tpu.chunk import Chunk, Column, Dictionary
+from tidb_tpu.types import (
+    INT64,
+    FLOAT64,
+    STRING,
+    decimal_type,
+    decimal_to_scaled,
+    scaled_to_decimal_str,
+)
+
+
+class TestDictionary:
+    def test_sorted_codes_preserve_order(self):
+        d, codes, valid = Dictionary.encode(["pear", "apple", None, "banana", "apple"])
+        assert d.values == ["apple", "banana", "pear"]
+        assert codes.tolist() == [2, 0, 0, 1, 0]
+        assert valid.tolist() == [True, True, False, True, True]
+        # order preservation: code comparison == lexicographic comparison
+        assert d.code_of("apple") < d.code_of("banana") < d.code_of("pear")
+
+    def test_range_bounds(self):
+        d = Dictionary(["a", "c", "e"])
+        assert d.lower_bound("c") == 1   # col < 'c'  <=>  code < 1
+        assert d.upper_bound("c") == 2   # col <= 'c' <=>  code < 2
+        assert d.lower_bound("b") == 1
+        assert d.code_of("zzz") == -1
+
+    def test_match_table_like(self):
+        d = Dictionary(["apple pie", "banana", "apple tart"])
+        # values are sorted: [apple pie, apple tart, banana]
+        lut = d.match_table(lambda s: s.startswith("apple"))
+        assert lut.tolist() == [True, True, False]
+
+    def test_translate(self):
+        a = Dictionary(["x", "y", "z"])
+        b = Dictionary(["w", "y", "z"])
+        t = a.translate_to(b)
+        assert t.tolist() == [-1, 1, 2]
+
+
+class TestColumn:
+    def test_from_numpy_pads(self):
+        c = Column.from_numpy(np.array([1, 2, 3]), INT64, capacity=8)
+        assert c.capacity == 8
+        data, valid = c.to_numpy()
+        assert data[:3].tolist() == [1, 2, 3]
+        assert valid.tolist() == [True] * 3 + [False] * 5
+        assert data.dtype == np.int64
+
+    def test_pytree_roundtrip_keeps_type(self):
+        c = Column.from_numpy(np.array([1.5, 2.5]), FLOAT64)
+        leaves, treedef = jax.tree_util.tree_flatten(c)
+        c2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert c2.type_ == FLOAT64
+
+    def test_jit_over_column(self):
+        c = Column.from_numpy(np.array([1, 2, 3, 4]), INT64)
+
+        @jax.jit
+        def double(col):
+            return col.with_data(col.data * 2)
+
+        out = double(c)
+        assert np.asarray(out.data).tolist() == [2, 4, 6, 8]
+
+    def test_gather_masks_invalid(self):
+        c = Column.from_numpy(np.array([10, 20, 30]), INT64)
+        idx = jnp.array([2, 0, 99])
+        iv = jnp.array([True, True, False])
+        g = c.gather(idx, iv)
+        data, valid = g.to_numpy()
+        assert data[0] == 30 and data[1] == 10
+        assert valid.tolist() == [True, True, False]
+
+
+class TestChunk:
+    def _chunk(self):
+        return Chunk.from_numpy(
+            {"a": np.array([1, 2, 3, 4]), "b": np.array([1.0, 4.0, 9.0, 16.0])},
+            {"a": INT64, "b": FLOAT64},
+            capacity=8,
+        )
+
+    def test_num_rows_and_sel(self):
+        ch = self._chunk()
+        assert int(ch.num_rows()) == 4
+        ch2 = ch.filter(ch.col("a").data > 2)
+        assert int(ch2.num_rows()) == 2
+
+    def test_jit_fragment_over_chunk(self):
+        ch = self._chunk()
+
+        @jax.jit
+        def frag(c):
+            c = c.filter(c.col("a").data % 2 == 0)
+            return c.extend({"c": c.col("b").with_data(c.col("b").data + 1.0)})
+
+        out = frag(ch)
+        rows = out.to_pylist()
+        assert rows == [(2, 4.0, 5.0), (4, 16.0, 17.0)]
+
+    def test_to_pylist_decodes_strings_and_decimals(self):
+        d, codes, valid = Dictionary.encode(["hi", None, "yo"])
+        dec = decimal_type(10, 2)
+        ch = Chunk.from_numpy(
+            {"s": codes, "d": np.array([decimal_to_scaled("1.25", 2), 0, -50])},
+            {"s": STRING, "d": dec},
+            valids={"s": valid},
+        )
+        rows = ch.to_pylist(dicts={"s": d})
+        assert rows == [("hi", "1.25"), (None, "0.00"), ("yo", "-0.50")]
+
+    def test_scaled_decimal_roundtrip(self):
+        assert scaled_to_decimal_str(decimal_to_scaled("123.456", 3), 3) == "123.456"
+        assert scaled_to_decimal_str(decimal_to_scaled("-0.07", 2), 2) == "-0.07"
+
+
+class TestMultiDevice:
+    def test_eight_devices_present(self, devices8):
+        assert len(devices8) == 8
+
+    def test_chunk_shards_over_mesh(self, devices8):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(devices8), ("data",))
+        ch = Chunk.from_numpy(
+            {"a": np.arange(64)}, {"a": INT64}, capacity=64
+        )
+        sharding = NamedSharding(mesh, P("data"))
+        put = jax.device_put(ch, jax.tree_util.tree_map(lambda _: sharding, ch))
+        total = jax.jit(lambda c: jnp.sum(jnp.where(c.sel, c.col("a").data, 0)))(put)
+        assert int(total) == sum(range(64))

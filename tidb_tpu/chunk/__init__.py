@@ -1,0 +1,23 @@
+"""Columnar batch format — the `util/chunk` equivalent, redesigned for TPU.
+
+The reference's chunk (util/chunk.Chunk: Arrow-like columns with null bitmap,
+offsets, raw data) is pointer-rich and variable-length. On TPU everything
+must be fixed-shape dense arrays, so:
+
+  * a `Column` is (data[capacity], valid[capacity]) jnp arrays
+  * a `Chunk` is named columns + one `sel[capacity]` bool mask of live rows
+    (selection is a mask, never compaction — filters just AND the mask)
+  * strings live as int32 codes into a per-column *sorted* `Dictionary`
+    (host-side); sortedness makes code comparisons == lexicographic ones
+  * capacity is a static (trace-time) constant; the same compiled kernel is
+    reused for every chunk of a table
+
+Both Column and Chunk are registered pytrees so they can flow through jit,
+shard_map, and scan untouched.
+"""
+
+from tidb_tpu.chunk.dictionary import Dictionary
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.chunk.chunk import Chunk, DEFAULT_CAPACITY
+
+__all__ = ["Dictionary", "Column", "Chunk", "DEFAULT_CAPACITY"]

@@ -1,0 +1,162 @@
+"""Chunk: a fixed-capacity columnar batch with a selection mask.
+
+Reference counterpart: util/chunk.Chunk (a ~1024-row batch pulled through
+executor.Next). TPU redesign decisions:
+
+  * capacity is static; the row count is carried as the `sel` bool mask
+    (a filter is `sel &= predicate` — no compaction, no dynamic shapes)
+  * columns are a dict name -> Column; order is preserved (python dicts)
+  * Chunk is a pytree (sel + columns are leaves; names/types are aux), so a
+    whole query fragment can be jitted over Chunk -> Chunk
+
+Host materialization (`to_pylist`) compacts by `sel` on the host — the only
+place dynamic row counts exist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.chunk.column import Column
+
+__all__ = ["Chunk", "DEFAULT_CAPACITY"]
+
+# Default device batch: large enough to keep the MXU/VPU busy and amortize
+# dispatch, small enough to double-buffer in HBM. (The reference uses 1024-row
+# chunks tuned for CPU cache; TPU wants orders of magnitude more per dispatch.)
+DEFAULT_CAPACITY = 1 << 20
+
+
+@dataclass
+class Chunk:
+    columns: Dict[str, Column]
+    sel: jax.Array  # [capacity] bool — live-row mask
+
+    @property
+    def capacity(self) -> int:
+        return self.sel.shape[-1]
+
+    @property
+    def names(self) -> list:
+        return list(self.columns.keys())
+
+    def col(self, name: str) -> Column:
+        return self.columns[name]
+
+    def num_rows(self) -> jax.Array:
+        """Live row count (device scalar)."""
+        return jnp.sum(self.sel.astype(jnp.int64))
+
+    # -- functional updates ------------------------------------------------
+
+    def with_sel(self, sel: jax.Array) -> "Chunk":
+        return Chunk(self.columns, sel)
+
+    def filter(self, mask: jax.Array) -> "Chunk":
+        """AND a predicate into the selection mask (SelectionExec)."""
+        return Chunk(self.columns, self.sel & mask)
+
+    def project(self, cols: Dict[str, Column]) -> "Chunk":
+        return Chunk(dict(cols), self.sel)
+
+    def extend(self, cols: Dict[str, Column]) -> "Chunk":
+        merged = dict(self.columns)
+        merged.update(cols)
+        return Chunk(merged, self.sel)
+
+    def select(self, names: Iterable[str]) -> "Chunk":
+        return Chunk({n: self.columns[n] for n in names}, self.sel)
+
+    def rename(self, mapping: Dict[str, str]) -> "Chunk":
+        return Chunk(
+            {mapping.get(n, n): c for n, c in self.columns.items()}, self.sel
+        )
+
+    def gather(self, idx: jax.Array, idx_valid: Optional[jax.Array] = None) -> "Chunk":
+        """Row gather across all columns; new sel comes from idx validity."""
+        cols = {n: c.gather(idx, idx_valid) for n, c in self.columns.items()}
+        sel = jnp.take(self.sel, idx, mode="clip")
+        if idx_valid is not None:
+            sel = sel & idx_valid
+        return Chunk(cols, sel)
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def from_numpy(
+        arrays: Dict[str, np.ndarray],
+        types: Dict[str, "SQLType"],
+        valids: Optional[Dict[str, np.ndarray]] = None,
+        capacity: Optional[int] = None,
+    ) -> "Chunk":
+        if not arrays:
+            raise ValueError("empty chunk")
+        lengths = {name: len(a) for name, a in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"column length mismatch: {lengths}")
+        n = next(iter(lengths.values()))
+        cap = n if capacity is None else capacity
+        cols = {
+            name: Column.from_numpy(
+                arr, types[name],
+                valid=(valids or {}).get(name),
+                capacity=cap,
+            )
+            for name, arr in arrays.items()
+        }
+        sel = np.zeros(cap, dtype=np.bool_)
+        sel[:n] = True
+        return Chunk(cols, jnp.asarray(sel))
+
+    @staticmethod
+    def empty_like(other: "Chunk") -> "Chunk":
+        return Chunk(other.columns, jnp.zeros_like(other.sel))
+
+    # -- host materialization ---------------------------------------------
+
+    def to_pylist(
+        self,
+        dicts: Optional[Dict[str, "Dictionary"]] = None,
+        names: Optional[list] = None,
+    ) -> list:
+        """Compact live rows to host as a list of tuples, decoding string
+        codes through `dicts` (name -> Dictionary) when provided.
+
+        `names` fixes the output column order. It matters: jax sorts dict
+        keys when flattening pytrees, so a Chunk that went through jit has
+        its columns in sorted-name order, not SELECT order — result-set
+        materialization must pass the plan's output order explicitly.
+        """
+        from tidb_tpu.types import TypeKind, scaled_to_decimal_str
+
+        sel = np.asarray(self.sel)
+        live = np.nonzero(sel)[0]
+        out_cols = []
+        ordered = (
+            [(n, self.columns[n]) for n in names]
+            if names is not None
+            else list(self.columns.items())
+        )
+        for name, col in ordered:
+            data, valid = col.to_numpy()
+            data, valid = data[live], valid[live]
+            kind = col.type_.kind
+            if kind == TypeKind.STRING and dicts and name in dicts:
+                vals = dicts[name].decode(data, valid)
+            elif kind == TypeKind.DECIMAL:
+                vals = [
+                    scaled_to_decimal_str(int(d), col.type_.scale) if v else None
+                    for d, v in zip(data, valid)
+                ]
+            else:
+                vals = [d.item() if v else None for d, v in zip(data, valid)]
+            out_cols.append(vals)
+        return list(zip(*out_cols)) if out_cols else []
+
+
+jax.tree_util.register_dataclass(Chunk, data_fields=["columns", "sel"], meta_fields=[])

@@ -1,0 +1,86 @@
+"""Column: a fixed-capacity device vector with a validity mask.
+
+The reference's util/chunk.Column is [null bitmap | offsets | data bytes];
+here a column is two dense arrays — `data` (the fixed-width device repr per
+tidb_tpu.types) and `valid` (True where the value is non-NULL). There are no
+offsets: variable-length data (strings) was dictionary-encoded at ingest.
+
+Column is a pytree whose static (aux) part is the SQLType, so jitted kernels
+specialize on type but not on contents.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.types import SQLType
+
+__all__ = ["Column"]
+
+
+@dataclass
+class Column:
+    data: jax.Array   # [capacity] device repr (see tidb_tpu.types)
+    valid: jax.Array  # [capacity] bool, True = non-NULL
+    type_: SQLType    # static metadata (pytree aux)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[-1]
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def from_numpy(
+        data: np.ndarray,
+        type_: SQLType,
+        valid: Optional[np.ndarray] = None,
+        capacity: Optional[int] = None,
+    ) -> "Column":
+        """Pad host data up to `capacity` (defaults to len(data)) and move it
+        to device. Padding rows get valid=False and zero data."""
+        data = np.asarray(data)
+        n = len(data)
+        cap = n if capacity is None else capacity
+        if cap < n:
+            raise ValueError(f"capacity {cap} < data length {n}")
+        dt = type_.np_dtype
+        buf = np.zeros(cap, dtype=dt)
+        buf[:n] = data.astype(dt, copy=False)
+        v = np.zeros(cap, dtype=np.bool_)
+        v[:n] = True if valid is None else np.asarray(valid)[:n]
+        return Column(jnp.asarray(buf), jnp.asarray(v), type_)
+
+    @staticmethod
+    def full(capacity: int, value, type_: SQLType) -> "Column":
+        """A constant column (literal broadcast)."""
+        data = jnp.full((capacity,), 0 if value is None else value, dtype=type_.np_dtype)
+        valid = jnp.full((capacity,), value is not None, dtype=jnp.bool_)
+        return Column(data, valid, type_)
+
+    # -- basic ops ---------------------------------------------------------
+
+    def with_data(self, data: jax.Array, type_: Optional[SQLType] = None) -> "Column":
+        return Column(data, self.valid, type_ or self.type_)
+
+    def gather(self, idx: jax.Array, idx_valid: Optional[jax.Array] = None) -> "Column":
+        """Row gather; out-of-range idx are clipped, callers mask them out
+        via idx_valid."""
+        data = jnp.take(self.data, idx, mode="clip")
+        valid = jnp.take(self.valid, idx, mode="clip")
+        if idx_valid is not None:
+            valid = valid & idx_valid
+        return Column(data, valid, self.type_)
+
+    def to_numpy(self) -> tuple[np.ndarray, np.ndarray]:
+        return np.asarray(self.data), np.asarray(self.valid)
+
+
+jax.tree_util.register_dataclass(
+    Column, data_fields=["data", "valid"], meta_fields=["type_"]
+)

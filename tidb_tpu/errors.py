@@ -1,0 +1,64 @@
+"""Error hierarchy for tidb_tpu.
+
+Mirrors the error classes a MySQL-compatible engine needs at the surface
+(parse / plan / execution / schema errors) without the full MySQL errno
+catalogue; codes follow MySQL numbering where one exists.
+"""
+
+
+class TiDBTPUError(Exception):
+    """Base class for all framework errors."""
+
+    code = 1105  # ER_UNKNOWN_ERROR
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class ParseError(TiDBTPUError):
+    code = 1064  # ER_PARSE_ERROR
+
+
+class PlanError(TiDBTPUError):
+    code = 1105
+
+
+class ExecutionError(TiDBTPUError):
+    code = 1105
+
+
+class UnsupportedError(TiDBTPUError):
+    """Feature understood by the grammar but not yet implemented."""
+
+    code = 1235  # ER_NOT_SUPPORTED_YET
+
+
+class SchemaError(TiDBTPUError):
+    code = 1146  # ER_NO_SUCH_TABLE
+
+
+class DuplicateTableError(SchemaError):
+    code = 1050  # ER_TABLE_EXISTS_ERROR
+
+
+class UnknownColumnError(PlanError):
+    code = 1054  # ER_BAD_FIELD_ERROR
+
+
+class AmbiguousColumnError(PlanError):
+    code = 1052  # ER_NON_UNIQ_ERROR
+
+
+class TypeError_(TiDBTPUError):
+    code = 1366  # ER_TRUNCATED_WRONG_VALUE_FOR_FIELD
+
+
+class OOMError(ExecutionError):
+    """Memory tracker budget exceeded (ref: util/memory OOM actions)."""
+
+    code = 1105
+
+
+class PrivilegeError(TiDBTPUError):
+    code = 1142  # ER_TABLEACCESS_DENIED_ERROR

@@ -1,0 +1,271 @@
+"""SQL type system (the `types/` + `util/codec` role of the reference).
+
+Every SQL type maps onto a fixed-width device representation so that all
+columns are dense jnp arrays with static shapes:
+
+  SQL type            device repr            notes
+  ------------------  ---------------------  ----------------------------------
+  BIGINT/INT/...      int64                  all integer widths widen to int64
+  DOUBLE/FLOAT        float64                float32 opt-in per column
+  DECIMAL(p,s)        int64 scaled by 10^s   p<=18; sums widen on host
+  CHAR/VARCHAR/TEXT   int32 dict code        per-column *sorted* dictionary, so
+                                             code order == lexicographic order
+  DATE                int32 days since epoch
+  DATETIME/TIMESTAMP  int64 microseconds since epoch
+  BOOLEAN             bool_
+  NULL                carried in validity mask, never in data
+
+The host-side scalar view of a value is a `Datum` (Python object), used by
+the parser/planner for literals and by result sets; the device never sees
+Datums.
+"""
+
+from __future__ import annotations
+
+import datetime
+import enum
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "TypeKind",
+    "SQLType",
+    "Datum",
+    "INT64",
+    "FLOAT64",
+    "BOOL",
+    "DATE",
+    "DATETIME",
+    "STRING",
+    "NULLTYPE",
+    "decimal_type",
+    "EPOCH",
+    "date_to_days",
+    "days_to_date",
+    "datetime_to_micros",
+    "micros_to_datetime",
+    "decimal_to_scaled",
+    "scaled_to_decimal_str",
+    "common_type",
+    "parse_type_name",
+]
+
+
+class TypeKind(enum.Enum):
+    INT = "int"
+    FLOAT = "float"
+    DECIMAL = "decimal"
+    STRING = "string"
+    DATE = "date"
+    DATETIME = "datetime"
+    BOOL = "bool"
+    NULL = "null"
+
+
+@dataclass(frozen=True)
+class SQLType:
+    """Static (trace-time) type descriptor for a column or expression."""
+
+    kind: TypeKind
+    # decimal precision/scale; scale is the power-of-ten fixed-point shift
+    precision: int = 0
+    scale: int = 0
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return {
+            TypeKind.INT: np.dtype(np.int64),
+            TypeKind.FLOAT: np.dtype(np.float64),
+            TypeKind.DECIMAL: np.dtype(np.int64),
+            TypeKind.STRING: np.dtype(np.int32),
+            TypeKind.DATE: np.dtype(np.int32),
+            TypeKind.DATETIME: np.dtype(np.int64),
+            TypeKind.BOOL: np.dtype(np.bool_),
+            TypeKind.NULL: np.dtype(np.bool_),
+        }[self.kind]
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in (TypeKind.INT, TypeKind.FLOAT, TypeKind.DECIMAL, TypeKind.BOOL)
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind == TypeKind.STRING
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.kind in (TypeKind.DATE, TypeKind.DATETIME)
+
+    def __str__(self) -> str:
+        if self.kind == TypeKind.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        return self.kind.value
+
+
+INT64 = SQLType(TypeKind.INT)
+FLOAT64 = SQLType(TypeKind.FLOAT)
+BOOL = SQLType(TypeKind.BOOL)
+DATE = SQLType(TypeKind.DATE)
+DATETIME = SQLType(TypeKind.DATETIME)
+STRING = SQLType(TypeKind.STRING)
+NULLTYPE = SQLType(TypeKind.NULL)
+
+
+def decimal_type(precision: int, scale: int) -> SQLType:
+    if precision > 18:
+        # int64 holds 18 full decimal digits; larger precisions would need a
+        # two-limb representation (future work), reject loudly for now.
+        raise ValueError(f"decimal precision {precision} > 18 unsupported")
+    return SQLType(TypeKind.DECIMAL, precision=precision, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# host-side scalar conversions
+# ---------------------------------------------------------------------------
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def date_to_days(d: datetime.date) -> int:
+    return (d - EPOCH).days
+
+
+def days_to_date(days: int) -> datetime.date:
+    return EPOCH + datetime.timedelta(days=int(days))
+
+
+def datetime_to_micros(dt: datetime.datetime) -> int:
+    # integer arithmetic: float seconds lose microsecond exactness and int()
+    # truncates toward zero for pre-epoch values
+    epoch = (
+        datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+        if dt.tzinfo
+        else datetime.datetime(1970, 1, 1)
+    )
+    return (dt - epoch) // datetime.timedelta(microseconds=1)
+
+
+def micros_to_datetime(us: int) -> datetime.datetime:
+    return datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(us))
+
+
+def decimal_to_scaled(value, scale: int) -> int:
+    """Parse a decimal literal (str/float/int/Decimal) to scaled int64."""
+    import decimal as _dec
+
+    d = _dec.Decimal(str(value))
+    q = d.scaleb(scale).to_integral_value(rounding=_dec.ROUND_HALF_UP)
+    return int(q)
+
+
+def scaled_to_decimal_str(scaled: int, scale: int) -> str:
+    if scale == 0:
+        return str(int(scaled))
+    sign = "-" if scaled < 0 else ""
+    mag = abs(int(scaled))
+    intpart, frac = divmod(mag, 10**scale)
+    return f"{sign}{intpart}.{frac:0{scale}d}"
+
+
+# ---------------------------------------------------------------------------
+# type inference helpers
+# ---------------------------------------------------------------------------
+
+
+def common_type(a: SQLType, b: SQLType) -> SQLType:
+    """Result type of a binary arithmetic/comparison over (a, b).
+
+    Follows MySQL's widening order: int < decimal < float; temporal types
+    compare among themselves; strings compare as dictionary codes.
+    """
+    if a.kind == TypeKind.NULL:
+        return b
+    if b.kind == TypeKind.NULL:
+        return a
+    if a.kind == b.kind:
+        if a.kind == TypeKind.DECIMAL:
+            scale = max(a.scale, b.scale)
+            prec = min(18, max(a.precision - a.scale, b.precision - b.scale) + scale + 1)
+            return decimal_type(prec, scale)
+        return a
+    order = {
+        TypeKind.BOOL: 0,
+        TypeKind.INT: 1,
+        TypeKind.DECIMAL: 2,
+        TypeKind.FLOAT: 3,
+    }
+    if a.kind in order and b.kind in order:
+        hi = a if order[a.kind] >= order[b.kind] else b
+        if hi.kind == TypeKind.DECIMAL:
+            return decimal_type(min(18, hi.precision + 1), hi.scale)
+        return SQLType(hi.kind)
+    if a.is_temporal and b.is_temporal:
+        return DATETIME if TypeKind.DATETIME in (a.kind, b.kind) else DATE
+    # string vs temporal / numeric: compare as strings is wrong for TPU codes;
+    # widen to float for numeric-vs-string like MySQL does.
+    if a.kind == TypeKind.STRING and b.is_numeric:
+        return FLOAT64
+    if b.kind == TypeKind.STRING and a.is_numeric:
+        return FLOAT64
+    if a.kind == TypeKind.STRING and b.is_temporal:
+        return b
+    if b.kind == TypeKind.STRING and a.is_temporal:
+        return a
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+_TYPE_NAMES = {
+    "tinyint": INT64,
+    "smallint": INT64,
+    "mediumint": INT64,
+    "int": INT64,
+    "integer": INT64,
+    "bigint": INT64,
+    "float": FLOAT64,
+    "double": FLOAT64,
+    "real": FLOAT64,
+    "char": STRING,
+    "varchar": STRING,
+    "text": STRING,
+    "tinytext": STRING,
+    "mediumtext": STRING,
+    "longtext": STRING,
+    "string": STRING,
+    "date": DATE,
+    "datetime": DATETIME,
+    "timestamp": DATETIME,
+    "bool": BOOL,
+    "boolean": BOOL,
+}
+
+
+def parse_type_name(name: str, args: tuple = ()) -> SQLType:
+    """Map a SQL column type name (+ optional length/scale args) to SQLType."""
+    low = name.lower()
+    if low in ("decimal", "numeric"):
+        prec = int(args[0]) if args else 10
+        scale = int(args[1]) if len(args) > 1 else 0
+        return decimal_type(prec, scale)
+    if low in _TYPE_NAMES:
+        return _TYPE_NAMES[low]
+    raise ValueError(f"unknown type name {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# Datum: host-side boxed scalar (parser literals, result rows)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Datum:
+    """A typed host scalar. `value` is the *logical* Python value (Decimal
+    values are python ints already scaled per `type_.scale`)."""
+
+    type_: SQLType
+    value: Any  # None means SQL NULL
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
