@@ -1,0 +1,211 @@
+"""Expression compiler tests — null propagation, decimals, dates, logic."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.expression import (
+    Call,
+    Case,
+    Cast,
+    ColumnRef,
+    InList,
+    Literal,
+    Lookup,
+    compile_expr,
+    compile_predicate,
+)
+from tidb_tpu.expression.dates import civil_from_days, days_from_civil
+from tidb_tpu.types import BOOL, DATE, FLOAT64, INT64, STRING, decimal_type, date_to_days
+import datetime
+
+
+def chunk_ab():
+    return Chunk.from_numpy(
+        {"a": np.array([1, 2, 3, 4]), "b": np.array([10, 0, 30, 40])},
+        {"a": INT64, "b": INT64},
+        valids={"b": np.array([True, True, False, True])},
+    )
+
+
+def col(name, t=INT64):
+    return ColumnRef(type_=t, name=name)
+
+
+def lit(v, t=INT64):
+    return Literal(type_=t, value=v)
+
+
+class TestArithmetic:
+    def test_add_null_propagates(self):
+        e = Call(type_=INT64, op="add", args=(col("a"), col("b")))
+        out = compile_expr(e)(chunk_ab())
+        data, valid = out.to_numpy()
+        assert data[0] == 11 and data[1] == 2 and data[3] == 44
+        assert valid.tolist() == [True, True, False, True]
+
+    def test_div_by_zero_is_null(self):
+        e = Call(type_=FLOAT64, op="div", args=(col("a"), col("b")))
+        data, valid = compile_expr(e)(chunk_ab()).to_numpy()
+        assert valid.tolist() == [True, False, False, True]
+        assert data[0] == pytest.approx(0.1)
+
+    def test_mod_sign_follows_dividend(self):
+        ch = Chunk.from_numpy(
+            {"a": np.array([7, -7, 7, -7]), "b": np.array([3, 3, -3, -3])},
+            {"a": INT64, "b": INT64},
+        )
+        e = Call(type_=INT64, op="mod", args=(col("a"), col("b")))
+        data, valid = compile_expr(e)(ch).to_numpy()
+        assert data.tolist() == [1, -1, 1, -1]  # MySQL/C semantics
+
+    def test_decimal_mul_scales_add(self):
+        d2 = decimal_type(15, 2)
+        d4 = decimal_type(18, 4)
+        ch = Chunk.from_numpy(
+            {"p": np.array([12550]), "q": np.array([95])},  # 125.50, 0.95
+            {"p": d2, "q": d2},
+        )
+        e = Call(type_=d4, op="mul", args=(col("p", d2), col("q", d2)))
+        data, _ = compile_expr(e)(ch).to_numpy()
+        assert data[0] == 1192250  # 119.2250 at scale 4
+
+    def test_decimal_add_aligns_scales(self):
+        d2, d4 = decimal_type(15, 2), decimal_type(15, 4)
+        ch = Chunk.from_numpy(
+            {"x": np.array([150]), "y": np.array([12345])},  # 1.50, 1.2345
+            {"x": d2, "y": d4},
+        )
+        e = Call(type_=d4, op="add", args=(col("x", d2), col("y", d4)))
+        data, _ = compile_expr(e)(ch).to_numpy()
+        assert data[0] == 27345  # 2.7345
+
+
+class TestLogic:
+    def test_kleene_and_or(self):
+        # a: [T, F, NULL];  b: [NULL, NULL, NULL]
+        ch = Chunk.from_numpy(
+            {"a": np.array([True, False, False]), "b": np.array([False] * 3)},
+            {"a": BOOL, "b": BOOL},
+            valids={"a": np.array([True, True, False]), "b": np.array([False] * 3)},
+        )
+        and_ = Call(type_=BOOL, op="and", args=(col("a", BOOL), col("b", BOOL)))
+        d, v = compile_expr(and_)(ch).to_numpy()
+        # T AND NULL = NULL; F AND NULL = F; NULL AND NULL = NULL
+        assert v.tolist() == [False, True, False]
+        assert bool(d[1]) is False
+        or_ = Call(type_=BOOL, op="or", args=(col("a", BOOL), col("b", BOOL)))
+        d, v = compile_expr(or_)(ch).to_numpy()
+        # T OR NULL = T; F OR NULL = NULL; NULL OR NULL = NULL
+        assert v.tolist() == [True, False, False]
+        assert bool(d[0]) is True
+
+    def test_predicate_excludes_null(self):
+        e = Call(type_=BOOL, op="gt", args=(col("b"), lit(5)))
+        mask = compile_predicate(e)(chunk_ab())
+        assert np.asarray(mask).tolist() == [True, False, False, True]
+
+    def test_in_list(self):
+        e = InList(type_=BOOL, arg=col("a"), values=(2, 4))
+        mask = compile_predicate(e)(chunk_ab())
+        assert np.asarray(mask).tolist() == [False, True, False, True]
+
+    def test_is_null(self):
+        e = Call(type_=BOOL, op="is_null", args=(col("b"),))
+        mask = compile_predicate(e)(chunk_ab())
+        assert np.asarray(mask).tolist() == [False, False, True, False]
+
+
+class TestCaseCastLookup:
+    def test_case_when(self):
+        # CASE WHEN a >= 3 THEN 100 WHEN a >= 2 THEN 50 ELSE 0 END
+        e = Case(
+            type_=INT64,
+            whens=(
+                (Call(type_=BOOL, op="ge", args=(col("a"), lit(3))), lit(100)),
+                (Call(type_=BOOL, op="ge", args=(col("a"), lit(2))), lit(50)),
+            ),
+            else_=lit(0),
+        )
+        data, valid = compile_expr(e)(chunk_ab()).to_numpy()
+        assert data.tolist() == [0, 50, 100, 100]
+        assert valid.all()
+
+    def test_case_no_else_yields_null(self):
+        e = Case(
+            type_=INT64,
+            whens=(((Call(type_=BOOL, op="gt", args=(col("a"), lit(3)))), lit(1)),),
+        )
+        data, valid = compile_expr(e)(chunk_ab()).to_numpy()
+        assert valid.tolist() == [False, False, False, True]
+
+    def test_cast_decimal_to_float(self):
+        d2 = decimal_type(15, 2)
+        ch = Chunk.from_numpy({"x": np.array([12345])}, {"x": d2})
+        e = Cast(type_=FLOAT64, arg=col("x", d2))
+        data, _ = compile_expr(e)(ch).to_numpy()
+        assert data[0] == pytest.approx(123.45)
+
+    def test_lookup_like(self):
+        # strings: codes into dict [apple, banana, cherry]; LIKE 'b%' -> LUT
+        ch = Chunk.from_numpy(
+            {"s": np.array([0, 1, 2, 1], dtype=np.int32)}, {"s": STRING}
+        )
+        lut = np.array([False, True, False])
+        e = Lookup.build(col("s", STRING), lut, BOOL)
+        mask = compile_predicate(e)(ch)
+        assert np.asarray(mask).tolist() == [False, True, False, True]
+
+    def test_lookup_absent_code_invalid(self):
+        ch = Chunk.from_numpy(
+            {"s": np.array([-1, 1], dtype=np.int32)}, {"s": STRING}
+        )
+        e = Lookup.build(col("s", STRING), np.array([10, 20, 30]), INT64)
+        data, valid = compile_expr(e)(ch).to_numpy()
+        assert valid.tolist() == [False, True]
+        assert data[1] == 20
+
+
+class TestDates:
+    def test_civil_roundtrip(self):
+        some_days = np.array(
+            [date_to_days(d) for d in [
+                datetime.date(1970, 1, 1),
+                datetime.date(1998, 12, 1),
+                datetime.date(2000, 2, 29),
+                datetime.date(1969, 7, 20),
+                datetime.date(2026, 7, 29),
+            ]]
+        )
+        y, m, d = civil_from_days(jnp.asarray(some_days))
+        assert y.tolist() == [1970, 1998, 2000, 1969, 2026]
+        assert m.tolist() == [1, 12, 2, 7, 7]
+        assert d.tolist() == [1, 1, 29, 20, 29]
+        back = days_from_civil(y, m, d)
+        assert back.tolist() == some_days.tolist()
+
+    def test_year_extract_under_jit(self):
+        days = np.array([date_to_days(datetime.date(1995, 3, 15))])
+        ch = Chunk.from_numpy({"d": days}, {"d": DATE})
+        e = Call(type_=INT64, op="year", args=(col("d", DATE),))
+        out = jax.jit(compile_expr(e))(ch)
+        data, _ = out.to_numpy()
+        assert data[0] == 1995
+
+
+class TestNullFuncs:
+    def test_coalesce(self):
+        e = Call(type_=INT64, op="coalesce", args=(col("b"), col("a")))
+        data, valid = compile_expr(e)(chunk_ab()).to_numpy()
+        assert data.tolist() == [10, 0, 3, 40]
+        assert valid.all()
+
+    def test_ifnull_and_nullif(self):
+        e = Call(type_=INT64, op="ifnull", args=(col("b"), lit(-1)))
+        data, _ = compile_expr(e)(chunk_ab()).to_numpy()
+        assert data.tolist() == [10, 0, -1, 40]
+        e2 = Call(type_=INT64, op="nullif", args=(col("a"), lit(2)))
+        _, valid = compile_expr(e2)(chunk_ab()).to_numpy()
+        assert valid.tolist() == [True, False, True, True]

@@ -1,0 +1,214 @@
+"""Parser tests — TPC-H-class SELECTs, DML, DDL, edge cases."""
+
+import pytest
+
+from tidb_tpu.errors import ParseError
+from tidb_tpu.parser import parse, parse_one
+from tidb_tpu.parser.ast import (
+    CreateTableStmt, DeleteStmt, EBetween, EBinary, ECase, EExists, EFunc,
+    EIn, EIsNull, ELike, EName, ENum, EStr, ESubquery, ExplainStmt,
+    InsertStmt, Join, SelectStmt, SetStmt, ShowStmt, SubqueryTable,
+    TableName, UnionStmt, UpdateStmt, DropTableStmt,
+)
+
+TPCH_Q1 = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+TPCH_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.06 - 0.01 and 0.06 + 0.01
+  and l_quantity < 24
+"""
+
+TPCH_Q18 = """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (
+        select l_orderkey
+        from lineitem
+        group by l_orderkey
+        having sum(l_quantity) > 300)
+  and c_custkey = o_custkey
+  and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate
+limit 100
+"""
+
+
+class TestSelect:
+    def test_q1_shape(self):
+        s = parse_one(TPCH_Q1)
+        assert isinstance(s, SelectStmt)
+        assert len(s.items) == 10
+        assert s.items[2].alias == "sum_qty"
+        assert isinstance(s.from_, TableName) and s.from_.name == "lineitem"
+        assert len(s.group_by) == 2 and len(s.order_by) == 2
+        # where: l_shipdate <= date '1998-12-01' - interval '90' day
+        assert isinstance(s.where, EBinary) and s.where.op == "<="
+
+    def test_q6_between(self):
+        s = parse_one(TPCH_Q6)
+        # where is AND chain; find the BETWEEN
+        found = []
+        def walk(e):
+            if isinstance(e, EBetween):
+                found.append(e)
+            if isinstance(e, EBinary):
+                walk(e.left); walk(e.right)
+        walk(s.where)
+        assert len(found) == 1
+        assert isinstance(found[0].low, EBinary)
+
+    def test_q18_in_subquery(self):
+        s = parse_one(TPCH_Q18)
+        assert s.limit == 100
+        assert isinstance(s.from_, Join)  # comma joins folded left-deep
+        def find_in(e):
+            if isinstance(e, EIn):
+                return e
+            if isinstance(e, EBinary):
+                return find_in(e.left) or find_in(e.right)
+            return None
+        e_in = find_in(s.where)
+        assert e_in is not None and e_in.subquery is not None
+        assert e_in.subquery.having is not None
+
+    def test_joins_explicit(self):
+        s = parse_one(
+            "select * from a join b on a.x = b.x left join c using (y)"
+        )
+        j = s.from_
+        assert isinstance(j, Join) and j.kind == "left" and j.using == ["y"]
+        assert isinstance(j.left, Join) and j.left.kind == "inner"
+
+    def test_derived_table(self):
+        s = parse_one("select t.n from (select count(*) n from x) as t")
+        assert isinstance(s.from_, SubqueryTable) and s.from_.alias == "t"
+
+    def test_union_order_limit(self):
+        s = parse_one("select a from t union all select b from u order by 1 limit 5")
+        assert isinstance(s, UnionStmt) and s.all and s.limit == 5
+
+    def test_distinct_case_like(self):
+        s = parse_one(
+            "select distinct case when a like 'x%' then 1 else 0 end from t"
+        )
+        assert s.distinct
+        c = s.items[0].expr
+        assert isinstance(c, ECase) and isinstance(c.whens[0][0], ELike)
+
+    def test_exists_scalar_subquery(self):
+        s = parse_one(
+            "select (select max(x) from u) m from t where exists (select 1 from v)"
+        )
+        assert isinstance(s.items[0].expr, ESubquery)
+        assert isinstance(s.where, EExists)
+
+    def test_cte(self):
+        s = parse_one("with w as (select 1 x) select * from w")
+        assert len(s.ctes) == 1 and s.ctes[0].name == "w"
+
+    def test_operator_precedence(self):
+        s = parse_one("select 1 + 2 * 3 = 7 and not false")
+        e = s.items[0].expr
+        assert isinstance(e, EBinary) and e.op == "and"
+        cmp = e.left
+        assert cmp.op == "="
+        add = cmp.left
+        assert add.op == "+" and add.right.op == "*"
+
+    def test_is_null_not_in(self):
+        s = parse_one("select * from t where a is not null and b not in (1,2)")
+        e = s.where
+        assert isinstance(e.left, EIsNull) and e.left.negated
+        assert isinstance(e.right, EIn) and e.right.negated
+
+
+class TestDML:
+    def test_insert_values(self):
+        s = parse_one("insert into t (a, b) values (1, 'x'), (2, 'y')")
+        assert isinstance(s, InsertStmt) and s.columns == ["a", "b"]
+        assert len(s.rows) == 2
+
+    def test_insert_select(self):
+        s = parse_one("insert into t select * from u where a > 1")
+        assert s.select is not None
+
+    def test_update(self):
+        s = parse_one("update t set a = a + 1, b = 2 where c = 3")
+        assert isinstance(s, UpdateStmt) and len(s.sets) == 2
+
+    def test_delete(self):
+        s = parse_one("delete from t where a < 0")
+        assert isinstance(s, DeleteStmt)
+
+
+class TestDDL:
+    def test_create_table(self):
+        s = parse_one(
+            """create table if not exists lineitem (
+                l_orderkey bigint not null,
+                l_quantity decimal(15,2) not null,
+                l_returnflag char(1),
+                l_shipdate date,
+                primary key (l_orderkey),
+                key idx_ship (l_shipdate)
+            ) engine=innodb charset=utf8mb4"""
+        )
+        assert isinstance(s, CreateTableStmt) and s.if_not_exists
+        assert [c.name for c in s.columns] == [
+            "l_orderkey", "l_quantity", "l_returnflag", "l_shipdate"
+        ]
+        assert s.columns[1].type_args == (15, 2)
+        assert s.primary_key == ["l_orderkey"]
+        assert s.indexes == [("idx_ship", ["l_shipdate"])]
+
+    def test_drop_show_set_explain(self):
+        assert isinstance(parse_one("drop table if exists t, u"), DropTableStmt)
+        assert isinstance(parse_one("show tables"), ShowStmt)
+        st = parse_one("set @@session.tidb_enable_tpu_exec = 1, global x = 'y'")
+        assert isinstance(st, SetStmt) and len(st.assignments) == 2
+        assert st.assignments[0][:2] == ("session", "tidb_enable_tpu_exec")
+        ex = parse_one("explain analyze select 1")
+        assert isinstance(ex, ExplainStmt) and ex.analyze
+
+
+class TestLexEdge:
+    def test_comments_and_quotes(self):
+        s = parse_one(
+            "select `weird col`, 'it''s' -- trailing\n from t /* block */ where a = 1"
+        )
+        assert s.items[0].expr.name == "weird col"
+        assert s.items[1].expr.value == "it's"
+
+    def test_multi_statements(self):
+        stmts = parse("select 1; select 2;")
+        assert len(stmts) == 2
+
+    def test_parse_error_has_position(self):
+        with pytest.raises(ParseError) as e:
+            parse_one("select from where")
+        assert "line 1" in str(e.value)
+
+    def test_keyword_funcs(self):
+        s = parse_one("select if(a > 0, 1, 2), left(b, 3) from t")
+        assert isinstance(s.items[0].expr, EFunc)
+        assert s.items[1].expr.name == "left"

@@ -1,0 +1,49 @@
+"""Expression evaluation (ref: expression/ — Expression, ScalarFunction,
+VecEvalInt/Real/... and VectorizedFilter).
+
+The reference hand-writes vectorized Go loops per (function, type) pair —
+100k+ lines, much generated. On TPU all of that collapses: a scalar
+expression tree compiles to a composition of jnp ops over whole columns,
+and XLA fuses the lot into the surrounding kernel. The vectorized-eval
+framework is therefore ~three small modules:
+
+  expr.py      -- the typed expression IR the planner produces
+  compiler.py  -- IR -> pure (Chunk -> Column) function, null-aware
+  dates.py     -- civil calendar decomposition in integer jnp ops
+
+Null semantics: every compiled node yields (data, valid); strict functions
+AND validity, AND/OR implement Kleene three-valued logic, and a WHERE mask
+is `data & valid` (NULL rows never match).
+
+String semantics: by the time IR reaches the compiler, the planner has
+rewritten string predicates into integer-code operations (sorted-dict
+ranges, equality on codes, LUT gathers for LIKE/functions) — the compiler
+never sees a raw string.
+"""
+
+from tidb_tpu.expression.expr import (
+    Expr,
+    ColumnRef,
+    Literal,
+    Call,
+    Case,
+    Cast,
+    Lookup,
+    InList,
+    AggRef,
+)
+from tidb_tpu.expression.compiler import compile_expr, compile_predicate
+
+__all__ = [
+    "Expr",
+    "ColumnRef",
+    "Literal",
+    "Call",
+    "Case",
+    "Cast",
+    "Lookup",
+    "InList",
+    "AggRef",
+    "compile_expr",
+    "compile_predicate",
+]

@@ -1,0 +1,50 @@
+"""Calendar decomposition as branch-free integer jnp ops.
+
+Days-since-epoch -> (year, month, day) using the civil-from-days algorithm
+(era/400-year-cycle arithmetic), fully vectorized — this is how YEAR()/
+MONTH()/DAY()/EXTRACT run on device without any host round-trip.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["civil_from_days", "days_from_civil", "year_of", "month_of", "day_of"]
+
+
+def civil_from_days(z):
+    """z: int array of days since 1970-01-01 -> (y, m, d) int arrays."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097  # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def days_from_civil(y, m, d):
+    """(y, m, d) int arrays -> days since 1970-01-01."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def year_of(days):
+    return civil_from_days(days)[0]
+
+
+def month_of(days):
+    return civil_from_days(days)[1]
+
+
+def day_of(days):
+    return civil_from_days(days)[2]

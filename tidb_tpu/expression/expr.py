@@ -1,0 +1,132 @@
+"""Typed expression IR.
+
+Produced by the planner's expression builder (name resolution + type
+inference + string-predicate rewriting already done); consumed by
+expression.compiler. Everything here is static/trace-time data — literals
+hold *device representations* (scaled ints for decimals, day counts for
+dates); raw python strings never appear (the builder rewrites them to
+dictionary codes or LUTs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.types import BOOL, SQLType
+
+__all__ = [
+    "Expr",
+    "ColumnRef",
+    "Literal",
+    "Call",
+    "Case",
+    "Cast",
+    "Lookup",
+    "InList",
+    "AggRef",
+    "walk",
+]
+
+
+@dataclass(frozen=True)
+class Expr:
+    """Base node. `type_` is the SQL result type of the node."""
+
+    type_: SQLType
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    """Reference to a chunk column by its resolved unique name."""
+
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    """Host scalar constant in device representation; value=None is NULL."""
+
+    value: Any = None
+
+
+@dataclass(frozen=True)
+class Call(Expr):
+    """Scalar function application; `op` is a key in compiler.FUNCS."""
+
+    op: str = ""
+    args: Tuple[Expr, ...] = ()
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    """CASE WHEN c1 THEN r1 ... ELSE e END (searched form)."""
+
+    whens: Tuple[Tuple[Expr, Expr], ...] = ()
+    else_: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    arg: Expr = None
+    # target type is `type_`; for decimals the scale shift is derived from
+    # arg.type_.scale vs type_.scale
+
+
+@dataclass(frozen=True)
+class Lookup(Expr):
+    """Gather `arg`'s int codes through a host-built lookup table.
+
+    The planner lowers dictionary-dependent string operations (LIKE, LENGTH,
+    UPPER comparisons, cross-dictionary translation) to this: O(|dict|) host
+    work builds `table`, the device does one gather. table_valid marks
+    entries that map to NULL/absent.
+    """
+
+    arg: Expr = None
+    table: Tuple[float, ...] = ()  # stored as tuple for hashability
+    table_valid: Optional[Tuple[bool, ...]] = None
+
+    @staticmethod
+    def build(arg: Expr, table: np.ndarray, type_: SQLType, table_valid=None) -> "Lookup":
+        return Lookup(
+            type_=type_,
+            arg=arg,
+            table=tuple(table.tolist()),
+            table_valid=tuple(table_valid.tolist()) if table_valid is not None else None,
+        )
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    """arg IN (v1, v2, ...) over literal device-repr values."""
+
+    arg: Expr = None
+    values: Tuple[Any, ...] = ()
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class AggRef(Expr):
+    """Reference to a computed aggregate output column (post-agg exprs like
+    HAVING sum(x) > 1 or SELECT sum(a)/sum(b) refer to agg slots by name)."""
+
+    name: str = ""
+
+
+def walk(e: Expr):
+    """Yield every node in the tree (pre-order)."""
+    yield e
+    if isinstance(e, Call):
+        for a in e.args:
+            yield from walk(a)
+    elif isinstance(e, Case):
+        for c, r in e.whens:
+            yield from walk(c)
+            yield from walk(r)
+        if e.else_ is not None:
+            yield from walk(e.else_)
+    elif isinstance(e, (Cast, Lookup, InList)):
+        yield from walk(e.arg)

@@ -1,0 +1,16 @@
+"""MySQL-dialect SQL front-end (ref: parser/ — yacc-generated in the
+reference; hand-written lexer + recursive-descent/Pratt here, which keeps
+the grammar we actually execute auditable and dependency-free).
+
+    parse(sql)      -> list of statement AST nodes
+    parse_one(sql)  -> exactly one statement
+
+The AST is untyped (names unresolved); the planner binds names against the
+catalog and lowers expressions to the typed IR in tidb_tpu.expression.
+"""
+
+from tidb_tpu.parser.ast import *  # noqa: F401,F403
+from tidb_tpu.parser.lexer import Lexer, Token
+from tidb_tpu.parser.parser import Parser, parse, parse_one
+
+__all__ = ["Lexer", "Token", "Parser", "parse", "parse_one"]

@@ -1,0 +1,334 @@
+"""Untyped SQL AST (ref: the reference's `ast` package under parser/).
+
+Dataclasses only — no behavior. Names are unresolved strings; the planner
+binds them. Expression nodes are deliberately close to MySQL's grammar
+shapes (IN with either a value list or a subquery, BETWEEN, IS NULL, ...)
+so the planner owns all semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+__all__ = [
+    # expressions
+    "EName", "ENum", "EStr", "ENull", "EBool", "EStar", "EParam",
+    "EBinary", "EUnary", "EFunc", "ECase", "ECast", "EIn", "EBetween",
+    "ELike", "EExists", "ESubquery", "EInterval", "EIsNull", "EVar",
+    # query structure
+    "SelectItem", "TableName", "SubqueryTable", "Join", "OrderItem",
+    "SelectStmt", "UnionStmt", "CTE",
+    # statements
+    "InsertStmt", "UpdateStmt", "DeleteStmt", "ColumnDef", "CreateTableStmt",
+    "DropTableStmt", "CreateIndexStmt", "DropIndexStmt", "AlterTableStmt",
+    "ExplainStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
+    "RollbackStmt", "UseStmt", "TruncateStmt", "AnalyzeStmt",
+    "CreateDatabaseStmt", "DropDatabaseStmt",
+]
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EName:
+    name: str
+    qualifier: Optional[str] = None  # table or alias
+
+    def __str__(self):
+        return f"{self.qualifier}.{self.name}" if self.qualifier else self.name
+
+
+@dataclass
+class ENum:
+    text: str  # literal text; planner decides int/decimal/float
+
+@dataclass
+class EStr:
+    value: str
+
+@dataclass
+class ENull:
+    pass
+
+@dataclass
+class EBool:
+    value: bool
+
+@dataclass
+class EStar:
+    qualifier: Optional[str] = None  # t.* or bare *
+
+@dataclass
+class EParam:
+    index: int
+
+@dataclass
+class EVar:
+    name: str       # @@sysvar or @uservar (text includes @ prefix)
+    scope: str = ""  # "global"/"session"/"" from @@global.x syntax
+
+
+@dataclass
+class EBinary:
+    op: str  # +,-,*,/,div,mod,=,<>,<,<=,>,>=,<=>,and,or,xor
+    left: "Expr"
+    right: "Expr"
+
+@dataclass
+class EUnary:
+    op: str  # -, +, not, ~
+    arg: "Expr"
+
+@dataclass
+class EFunc:
+    name: str  # lowercased
+    args: List["Expr"] = field(default_factory=list)
+    distinct: bool = False  # COUNT(DISTINCT x)
+
+@dataclass
+class ECase:
+    operand: Optional["Expr"]  # CASE x WHEN ... (simple) vs CASE WHEN (searched)
+    whens: List[Tuple["Expr", "Expr"]] = field(default_factory=list)
+    else_: Optional["Expr"] = None
+
+@dataclass
+class ECast:
+    arg: "Expr"
+    type_name: str
+    type_args: Tuple[int, ...] = ()
+
+@dataclass
+class EIn:
+    arg: "Expr"
+    values: Optional[List["Expr"]] = None       # IN (1,2,3)
+    subquery: Optional["SelectStmt"] = None     # IN (SELECT ...)
+    negated: bool = False
+
+@dataclass
+class EBetween:
+    arg: "Expr"
+    low: "Expr"
+    high: "Expr"
+    negated: bool = False
+
+@dataclass
+class ELike:
+    arg: "Expr"
+    pattern: "Expr"
+    negated: bool = False
+    escape: Optional[str] = None
+
+@dataclass
+class EExists:
+    subquery: "SelectStmt"
+    negated: bool = False
+
+@dataclass
+class ESubquery:
+    """Scalar subquery in expression position."""
+    select: "SelectStmt"
+
+@dataclass
+class EInterval:
+    value: "Expr"
+    unit: str  # day, month, year, ...
+
+@dataclass
+class EIsNull:
+    arg: "Expr"
+    negated: bool = False
+
+
+Expr = Union[
+    EName, ENum, EStr, ENull, EBool, EStar, EParam, EVar, EBinary, EUnary,
+    EFunc, ECase, ECast, EIn, EBetween, ELike, EExists, ESubquery,
+    EInterval, EIsNull,
+]
+
+
+# ---------------------------------------------------------------------------
+# query structure
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+@dataclass
+class TableName:
+    name: str
+    schema: Optional[str] = None
+    alias: Optional[str] = None
+
+@dataclass
+class SubqueryTable:
+    select: Union["SelectStmt", "UnionStmt"]
+    alias: str
+
+@dataclass
+class Join:
+    kind: str  # inner, left, right, cross, semi (planner-only)
+    left: "TableSource"
+    right: "TableSource"
+    on: Optional[Expr] = None
+    using: Optional[List[str]] = None
+
+TableSource = Union[TableName, SubqueryTable, Join]
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    desc: bool = False
+
+@dataclass
+class CTE:
+    name: str
+    columns: Optional[List[str]]
+    select: Union["SelectStmt", "UnionStmt"]
+
+@dataclass
+class SelectStmt:
+    items: List[SelectItem] = field(default_factory=list)
+    from_: Optional[TableSource] = None
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+    ctes: List[CTE] = field(default_factory=list)
+
+@dataclass
+class UnionStmt:
+    left: Union["SelectStmt", "UnionStmt"]
+    right: Union["SelectStmt", "UnionStmt"]
+    all: bool = False
+    op: str = "union"  # union | except | intersect
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# statements
+# ---------------------------------------------------------------------------
+
+@dataclass
+class InsertStmt:
+    table: TableName
+    columns: Optional[List[str]] = None
+    rows: Optional[List[List[Expr]]] = None
+    select: Optional[Union[SelectStmt, UnionStmt]] = None
+    replace: bool = False
+
+@dataclass
+class UpdateStmt:
+    table: TableName
+    sets: List[Tuple[EName, Expr]] = field(default_factory=list)
+    where: Optional[Expr] = None
+
+@dataclass
+class DeleteStmt:
+    table: TableName
+    where: Optional[Expr] = None
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    type_args: Tuple[int, ...] = ()
+    not_null: bool = False
+    primary_key: bool = False
+    unique: bool = False
+    default: Optional[Expr] = None
+    auto_increment: bool = False
+
+@dataclass
+class CreateTableStmt:
+    table: TableName
+    columns: List[ColumnDef] = field(default_factory=list)
+    primary_key: Optional[List[str]] = None
+    unique_keys: List[Tuple[str, List[str]]] = field(default_factory=list)
+    indexes: List[Tuple[str, List[str]]] = field(default_factory=list)
+    if_not_exists: bool = False
+
+@dataclass
+class DropTableStmt:
+    tables: List[TableName] = field(default_factory=list)
+    if_exists: bool = False
+
+@dataclass
+class CreateIndexStmt:
+    name: str
+    table: TableName = None
+    columns: List[str] = field(default_factory=list)
+    unique: bool = False
+
+@dataclass
+class DropIndexStmt:
+    name: str
+    table: TableName = None
+
+@dataclass
+class AlterTableStmt:
+    table: TableName
+    action: str = ""          # add_column | drop_column | rename | add_index
+    column: Optional[ColumnDef] = None
+    old_name: Optional[str] = None
+    new_name: Optional[str] = None
+    index: Optional[Tuple[str, List[str]]] = None
+
+@dataclass
+class ExplainStmt:
+    stmt: object
+    analyze: bool = False
+
+@dataclass
+class SetStmt:
+    assignments: List[Tuple[str, str, Expr]] = field(default_factory=list)
+    # (scope 'global'|'session'|'user', name, value)
+
+@dataclass
+class ShowStmt:
+    kind: str  # databases | tables | columns | variables | status | create_table
+    target: Optional[str] = None
+    like: Optional[str] = None
+
+@dataclass
+class BeginStmt:
+    pass
+
+@dataclass
+class CommitStmt:
+    pass
+
+@dataclass
+class RollbackStmt:
+    pass
+
+@dataclass
+class UseStmt:
+    db: str
+
+@dataclass
+class TruncateStmt:
+    table: TableName = None
+
+@dataclass
+class AnalyzeStmt:
+    tables: List[TableName] = field(default_factory=list)
+
+@dataclass
+class CreateDatabaseStmt:
+    name: str
+    if_not_exists: bool = False
+
+@dataclass
+class DropDatabaseStmt:
+    name: str
+    if_exists: bool = False

@@ -1,0 +1,192 @@
+"""SQL lexer: text -> token stream.
+
+Handles MySQL-isms the benchmarks need: backtick identifiers, both quote
+styles for strings with '' escaping, `--`/`#` line and C block comments,
+and multi-char operators (<=, >=, <>, !=, <=>, ||, &&, :=).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from tidb_tpu.errors import ParseError
+
+__all__ = ["Token", "Lexer", "KEYWORDS"]
+
+# Reserved words recognized by the grammar. Non-reserved words (function
+# names etc.) lex as IDENT and are resolved contextually.
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "xor", "in", "between", "like",
+    "is", "null", "true", "false", "distinct", "all", "union", "except",
+    "intersect", "join", "inner", "left", "right", "full", "outer", "cross",
+    "on", "using", "exists", "case", "when", "then", "else", "end", "cast",
+    "insert", "into", "values", "update", "set", "delete", "create", "table",
+    "drop", "if", "primary", "key", "unique", "index", "default", "replace",
+    "explain", "analyze", "describe", "desc", "asc", "show", "databases",
+    "tables", "columns", "begin", "start", "transaction", "commit",
+    "rollback", "use", "truncate", "interval", "date", "time", "timestamp",
+    "with", "recursive", "global", "session", "database", "schema",
+    "constraint", "foreign", "references", "comment", "engine", "charset",
+    "character", "collate", "auto_increment", "unsigned", "zerofill",
+    "variables", "status", "grant", "revoke", "flush", "privileges",
+    "alter", "add", "modify", "change", "rename", "to",
+}
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # IDENT, QIDENT, NUM, STR, OP, KW, EOF, PARAM
+    text: str  # raw text (keywords lowercased)
+    pos: int   # byte offset, for error messages
+
+    def __repr__(self):
+        return f"{self.kind}:{self.text}"
+
+
+_TWO_CHAR = {"<=", ">=", "<>", "!=", "||", "&&", ":=", "->"}
+_THREE_CHAR = {"<=>"}
+_SINGLE = set("+-*/%(),.;=<>!@&|^~?")
+
+
+class Lexer:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.n = len(sql)
+        self.i = 0
+
+    def error(self, msg: str) -> ParseError:
+        line = self.sql.count("\n", 0, self.i) + 1
+        return ParseError(f"lex error at line {line} (offset {self.i}): {msg}")
+
+    def tokens(self) -> List[Token]:
+        out: List[Token] = []
+        while True:
+            t = self._next()
+            out.append(t)
+            if t.kind == "EOF":
+                return out
+
+    def _skip_ws(self):
+        s, n = self.sql, self.n
+        while self.i < n:
+            c = s[self.i]
+            if c in " \t\r\n":
+                self.i += 1
+            elif c == "#" or s.startswith("--", self.i):
+                j = s.find("\n", self.i)
+                self.i = n if j < 0 else j + 1
+            elif s.startswith("/*", self.i):
+                j = s.find("*/", self.i + 2)
+                if j < 0:
+                    raise self.error("unterminated block comment")
+                self.i = j + 2
+            else:
+                return
+
+    def _next(self) -> Token:
+        self._skip_ws()
+        s, n = self.sql, self.n
+        if self.i >= n:
+            return Token("EOF", "", self.i)
+        start = self.i
+        c = s[start]
+
+        # numbers: 123, 1.5, .5, 1e-3, 0x1F
+        if c.isdigit() or (c == "." and start + 1 < n and s[start + 1].isdigit()):
+            i = start
+            if s.startswith("0x", i) or s.startswith("0X", i):
+                i += 2
+                while i < n and (s[i].isdigit() or s[i].lower() in "abcdef"):
+                    i += 1
+                self.i = i
+                return Token("NUM", s[start:i], start)
+            seen_dot = seen_e = False
+            while i < n:
+                ch = s[i]
+                if ch.isdigit():
+                    i += 1
+                elif ch == "." and not seen_dot and not seen_e:
+                    seen_dot = True
+                    i += 1
+                elif ch in "eE" and not seen_e and i > start:
+                    seen_e = True
+                    i += 1
+                    if i < n and s[i] in "+-":
+                        i += 1
+                else:
+                    break
+            self.i = i
+            return Token("NUM", s[start:i], start)
+
+        # strings '...' or "..." with doubled-quote and backslash escapes
+        if c in "'\"":
+            q = c
+            i = start + 1
+            buf = []
+            while i < n:
+                ch = s[i]
+                if ch == "\\" and i + 1 < n:
+                    esc = s[i + 1]
+                    buf.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0"}.get(esc, esc))
+                    i += 2
+                elif ch == q:
+                    if i + 1 < n and s[i + 1] == q:  # '' escape
+                        buf.append(q)
+                        i += 2
+                    else:
+                        self.i = i + 1
+                        return Token("STR", "".join(buf), start)
+                else:
+                    buf.append(ch)
+                    i += 1
+            raise self.error("unterminated string")
+
+        # backtick identifier
+        if c == "`":
+            j = s.find("`", start + 1)
+            if j < 0:
+                raise self.error("unterminated identifier")
+            self.i = j + 1
+            return Token("QIDENT", s[start + 1 : j], start)
+
+        # identifiers / keywords (incl. @@sysvar and @uservar)
+        if c.isalpha() or c == "_" or c == "@":
+            i = start
+            if c == "@":
+                i += 1
+                if i < n and s[i] == "@":
+                    i += 1
+            while i < n and (s[i].isalnum() or s[i] in "_$."):
+                # '.' stays out of ident: qualified names are parsed as
+                # IDENT '.' IDENT so 'a.b' isn't one token — except @@x.y
+                if s[i] == "." and not s[start] == "@":
+                    break
+                i += 1
+            text = s[start:i]
+            self.i = i
+            low = text.lower()
+            if low in KEYWORDS and c != "@":
+                return Token("KW", low, start)
+            return Token("IDENT", text, start)
+
+        # parameter placeholder
+        if c == "?":
+            self.i = start + 1
+            return Token("PARAM", "?", start)
+
+        # operators
+        for trio in _THREE_CHAR:
+            if s.startswith(trio, start):
+                self.i = start + 3
+                return Token("OP", trio, start)
+        for duo in _TWO_CHAR:
+            if s.startswith(duo, start):
+                self.i = start + 2
+                return Token("OP", duo, start)
+        if c in _SINGLE:
+            self.i = start + 1
+            return Token("OP", c, start)
+
+        raise self.error(f"unexpected character {c!r}")

@@ -1,0 +1,932 @@
+"""Recursive-descent statement parser + Pratt expression parser.
+
+Grammar shape follows MySQL's, with precedence levels matching the MySQL
+manual (OR < XOR < AND < NOT < comparison/IN/BETWEEN/LIKE/IS < | < & <
+shifts < +- < */DIV/MOD < ^ < unary). Only the productions the engine
+executes are implemented; everything else raises ParseError with position.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+from tidb_tpu.errors import ParseError
+from tidb_tpu.parser.ast import *  # noqa: F403
+from tidb_tpu.parser.lexer import Lexer, Token
+
+__all__ = ["Parser", "parse", "parse_one"]
+
+
+def parse(sql: str) -> list:
+    return Parser(sql).parse_statements()
+
+
+def parse_one(sql: str):
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected exactly one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = Lexer(sql).tokens()
+        self.pos = 0
+        self.param_count = 0
+
+    # -- token helpers -----------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        i = min(self.pos + ahead, len(self.toks) - 1)
+        return self.toks[i]
+
+    def next(self) -> Token:
+        t = self.toks[self.pos]
+        if t.kind != "EOF":
+            self.pos += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "KW" and t.text in kws
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "OP" and t.text in ops
+
+    def accept_kw(self, *kws: str) -> Optional[Token]:
+        if self.at_kw(*kws):
+            return self.next()
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[Token]:
+        if self.at_op(*ops):
+            return self.next()
+        return None
+
+    def expect_kw(self, kw: str) -> Token:
+        if not self.at_kw(kw):
+            raise self.error(f"expected {kw.upper()}")
+        return self.next()
+
+    def expect_op(self, op: str) -> Token:
+        if not self.at_op(op):
+            raise self.error(f"expected {op!r}")
+        return self.next()
+
+    def expect_ident(self) -> str:
+        t = self.peek()
+        if t.kind in ("IDENT", "QIDENT"):
+            self.next()
+            return t.text
+        # non-reserved-ish keywords usable as identifiers in practice
+        if t.kind == "KW" and t.text in _IDENTISH_KW:
+            self.next()
+            return t.text
+        raise self.error("expected identifier")
+
+    def error(self, msg: str) -> ParseError:
+        t = self.peek()
+        line = self.sql.count("\n", 0, t.pos) + 1
+        return ParseError(f"{msg} at line {line} near {t.text or '<eof>'!r}")
+
+    # -- statements --------------------------------------------------------
+
+    def parse_statements(self) -> list:
+        out = []
+        while self.peek().kind != "EOF":
+            if self.accept_op(";"):
+                continue
+            out.append(self.parse_statement())
+            if not self.accept_op(";") and self.peek().kind != "EOF":
+                raise self.error("expected ';' or end of input")
+        return out
+
+    def parse_statement(self):
+        t = self.peek()
+        if t.kind != "KW":
+            raise self.error("expected statement keyword")
+        kw = t.text
+        if kw in ("select", "with") or self.at_op("("):
+            return self.parse_select_or_union()
+        handler = {
+            "insert": self.parse_insert,
+            "replace": self.parse_insert,
+            "update": self.parse_update,
+            "delete": self.parse_delete,
+            "create": self.parse_create,
+            "drop": self.parse_drop,
+            "alter": self.parse_alter,
+            "explain": self.parse_explain,
+            "describe": self.parse_explain,
+            "desc": self.parse_explain,
+            "set": self.parse_set,
+            "show": self.parse_show,
+            "begin": lambda: (self.next(), BeginStmt())[1],
+            "start": self.parse_start_txn,
+            "commit": lambda: (self.next(), CommitStmt())[1],
+            "rollback": lambda: (self.next(), RollbackStmt())[1],
+            "use": self.parse_use,
+            "truncate": self.parse_truncate,
+            "analyze": self.parse_analyze,
+        }.get(kw)
+        if handler is None:
+            raise self.error(f"unsupported statement {kw.upper()}")
+        return handler()
+
+    # -- SELECT ------------------------------------------------------------
+
+    def parse_select_or_union(self):
+        ctes: List[CTE] = []
+        if self.accept_kw("with"):
+            self.accept_kw("recursive")  # accepted, not yet executed
+            while True:
+                name = self.expect_ident()
+                cols = None
+                if self.accept_op("("):
+                    cols = [self.expect_ident()]
+                    while self.accept_op(","):
+                        cols.append(self.expect_ident())
+                    self.expect_op(")")
+                self.expect_kw("as")
+                self.expect_op("(")
+                sel = self.parse_select_or_union()
+                self.expect_op(")")
+                ctes.append(CTE(name, cols, sel))
+                if not self.accept_op(","):
+                    break
+
+        node = self.parse_select_core()
+        while self.at_kw("union", "except", "intersect"):
+            op = self.next().text
+            all_ = bool(self.accept_kw("all"))
+            if not all_:
+                self.accept_kw("distinct")
+            right = self.parse_select_core()
+            node = UnionStmt(node, right, all=all_, op=op)
+            # an unparenthesized trailing ORDER BY/LIMIT was consumed by the
+            # right SELECT but binds to the whole union (MySQL semantics)
+            if isinstance(right, SelectStmt) and not self.at_kw("union", "except", "intersect"):
+                node.order_by, right.order_by = right.order_by, []
+                node.limit, node.offset = right.limit, right.offset
+                right.limit = right.offset = None
+        if ctes:
+            if isinstance(node, SelectStmt):
+                node.ctes = ctes
+            else:
+                # hang CTEs off the leftmost select of the union
+                left = node
+                while isinstance(left, UnionStmt):
+                    left = left.left
+                left.ctes = ctes
+        return node
+
+    def parse_select_core(self) -> Union[SelectStmt, "UnionStmt"]:
+        if self.accept_op("("):
+            sel = self.parse_select_or_union()
+            self.expect_op(")")
+            return sel
+        self.expect_kw("select")
+        stmt = SelectStmt()
+        if self.accept_kw("distinct"):
+            stmt.distinct = True
+        else:
+            self.accept_kw("all")
+        stmt.items = [self.parse_select_item()]
+        while self.accept_op(","):
+            stmt.items.append(self.parse_select_item())
+        if self.accept_kw("from"):
+            stmt.from_ = self.parse_table_sources()
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            stmt.group_by = [self.parse_expr()]
+            while self.accept_op(","):
+                stmt.group_by.append(self.parse_expr())
+        if self.accept_kw("having"):
+            stmt.having = self.parse_expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = self.parse_order_items()
+        if self.accept_kw("limit"):
+            stmt.limit, stmt.offset = self.parse_limit_clause()
+        return stmt
+
+    def parse_select_item(self) -> SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return SelectItem(EStar())
+        # t.* qualified star
+        t = self.peek()
+        if (
+            t.kind in ("IDENT", "QIDENT")
+            and self.peek(1).kind == "OP"
+            and self.peek(1).text == "."
+            and self.peek(2).kind == "OP"
+            and self.peek(2).text == "*"
+        ):
+            self.next(); self.next(); self.next()
+            return SelectItem(EStar(qualifier=t.text))
+        expr = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident_or_string()
+        else:
+            nt = self.peek()
+            if nt.kind in ("IDENT", "QIDENT") or (nt.kind == "KW" and nt.text in _IDENTISH_KW):
+                alias = self.expect_ident()
+        return SelectItem(expr, alias)
+
+    def expect_ident_or_string(self) -> str:
+        if self.peek().kind == "STR":
+            return self.next().text
+        return self.expect_ident()
+
+    def parse_order_items(self) -> List[OrderItem]:
+        items = [self.parse_order_item()]
+        while self.accept_op(","):
+            items.append(self.parse_order_item())
+        return items
+
+    def parse_order_item(self) -> OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("desc"):
+            desc = True
+        else:
+            self.accept_kw("asc")
+        return OrderItem(e, desc)
+
+    def parse_limit_clause(self):
+        a = int(self.next().text)
+        offset = None
+        if self.accept_op(","):  # LIMIT offset, count
+            b = int(self.next().text)
+            return b, a
+        if self.accept_kw("offset"):
+            offset = int(self.next().text)
+        return a, offset
+
+    # -- FROM / joins --------------------------------------------------------
+
+    def parse_table_sources(self) -> TableSource:
+        left = self.parse_joined_table()
+        while self.accept_op(","):  # comma join == cross join
+            right = self.parse_joined_table()
+            left = Join("cross", left, right)
+        return left
+
+    def parse_joined_table(self) -> TableSource:
+        left = self.parse_table_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.parse_table_primary()
+                left = Join("cross", left, right)
+                continue
+            kind = None
+            if self.accept_kw("inner"):
+                kind = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                kind = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                kind = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                kind = "full"
+            if kind is None:
+                if not self.at_kw("join"):
+                    return left
+                kind = "inner"
+            self.expect_kw("join")
+            right = self.parse_table_primary()
+            on = None
+            using = None
+            if self.accept_kw("on"):
+                on = self.parse_expr()
+            elif self.accept_kw("using"):
+                self.expect_op("(")
+                using = [self.expect_ident()]
+                while self.accept_op(","):
+                    using.append(self.expect_ident())
+                self.expect_op(")")
+            left = Join(kind, left, right, on=on, using=using)
+
+    def parse_table_primary(self) -> TableSource:
+        if self.accept_op("("):
+            if self.at_kw("select", "with") or self.at_op("("):
+                sel = self.parse_select_or_union()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.expect_ident()
+                return SubqueryTable(sel, alias)
+            src = self.parse_table_sources()
+            self.expect_op(")")
+            return src
+        name = self.expect_ident()
+        schema = None
+        if self.accept_op("."):
+            schema, name = name, self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        else:
+            nt = self.peek()
+            if nt.kind in ("IDENT", "QIDENT"):
+                alias = self.next().text
+        return TableName(name, schema=schema, alias=alias)
+
+    # -- DML -----------------------------------------------------------------
+
+    def parse_insert(self) -> InsertStmt:
+        replace = self.peek().text == "replace"
+        self.next()  # insert/replace
+        self.accept_kw("into")
+        table = self._table_name()
+        columns = None
+        if self.at_op("(") and not self._paren_starts_select():
+            self.expect_op("(")
+            columns = [self.expect_ident()]
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        if self.accept_kw("values"):
+            rows = [self._value_row()]
+            while self.accept_op(","):
+                rows.append(self._value_row())
+            return InsertStmt(table, columns, rows=rows, replace=replace)
+        sel = self.parse_select_or_union()
+        return InsertStmt(table, columns, select=sel, replace=replace)
+
+    def _paren_starts_select(self) -> bool:
+        t1 = self.peek(1)
+        return t1.kind == "KW" and t1.text in ("select", "with")
+
+    def _value_row(self) -> List:
+        self.expect_op("(")
+        row = [self.parse_expr()]
+        while self.accept_op(","):
+            row.append(self.parse_expr())
+        self.expect_op(")")
+        return row
+
+    def _table_name(self) -> TableName:
+        name = self.expect_ident()
+        schema = None
+        if self.accept_op("."):
+            schema, name = name, self.expect_ident()
+        return TableName(name, schema=schema)
+
+    def parse_update(self) -> UpdateStmt:
+        self.expect_kw("update")
+        table = self._table_name()
+        self.expect_kw("set")
+        sets = []
+        while True:
+            name = self.expect_ident()
+            qual = None
+            if self.accept_op("."):
+                qual, name = name, self.expect_ident()
+            self.expect_op("=")
+            sets.append((EName(name, qual), self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return UpdateStmt(table, sets, where)
+
+    def parse_delete(self) -> DeleteStmt:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self._table_name()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return DeleteStmt(table, where)
+
+    # -- DDL -----------------------------------------------------------------
+
+    def parse_create(self):
+        self.expect_kw("create")
+        if self.accept_kw("database") or self.accept_kw("schema"):
+            ine = self._if_not_exists()
+            return CreateDatabaseStmt(self.expect_ident(), ine)
+        unique = bool(self.accept_kw("unique"))
+        if self.accept_kw("index"):
+            name = self.expect_ident()
+            self.expect_kw("on")
+            table = self._table_name()
+            self.expect_op("(")
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+            return CreateIndexStmt(name, table, cols, unique)
+        self.expect_kw("table")
+        ine = self._if_not_exists()
+        table = self._table_name()
+        stmt = CreateTableStmt(table, if_not_exists=ine)
+        self.expect_op("(")
+        while True:
+            if self.accept_kw("primary"):
+                self.expect_kw("key")
+                stmt.primary_key = self._paren_name_list()
+            elif self.accept_kw("unique"):
+                self.accept_kw("key") or self.accept_kw("index")
+                kname = ""
+                if self.peek().kind in ("IDENT", "QIDENT"):
+                    kname = self.expect_ident()
+                stmt.unique_keys.append((kname, self._paren_name_list()))
+            elif self.accept_kw("key") or self.accept_kw("index"):
+                kname = ""
+                if self.peek().kind in ("IDENT", "QIDENT"):
+                    kname = self.expect_ident()
+                stmt.indexes.append((kname, self._paren_name_list()))
+            elif self.accept_kw("constraint"):
+                # named constraint: swallow FOREIGN KEY / etc. for parse-compat
+                if self.peek().kind in ("IDENT", "QIDENT"):
+                    self.expect_ident()
+                if self.accept_kw("primary"):
+                    self.expect_kw("key")
+                    stmt.primary_key = self._paren_name_list()
+                elif self.accept_kw("unique"):
+                    stmt.unique_keys.append(("", self._paren_name_list()))
+                elif self.accept_kw("foreign"):
+                    self.expect_kw("key")
+                    self._paren_name_list()
+                    self.expect_kw("references")
+                    self._table_name()
+                    self._paren_name_list()
+            elif self.accept_kw("foreign"):
+                self.expect_kw("key")
+                self._paren_name_list()
+                self.expect_kw("references")
+                self._table_name()
+                self._paren_name_list()
+            else:
+                stmt.columns.append(self.parse_column_def())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        # table options: ENGINE=..., CHARSET=..., COMMENT '...'
+        while self.peek().kind == "KW" and self.peek().text in ("engine", "charset", "character", "comment", "collate"):
+            self.next()
+            self.accept_kw("set")
+            self.accept_op("=")
+            self.next()
+        return stmt
+
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            # EXISTS lexes as KW
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def _paren_name_list(self) -> List[str]:
+        self.expect_op("(")
+        out = [self.expect_ident()]
+        while self.accept_op(","):
+            out.append(self.expect_ident())
+        self.expect_op(")")
+        return out
+
+    def parse_column_def(self) -> ColumnDef:
+        name = self.expect_ident()
+        t = self.peek()
+        if t.kind not in ("IDENT", "KW"):
+            raise self.error("expected column type")
+        type_name = self.next().text.lower()
+        args = ()
+        if self.accept_op("("):
+            a = [int(self.next().text)]
+            while self.accept_op(","):
+                a.append(int(self.next().text))
+            self.expect_op(")")
+            args = tuple(a)
+        self.accept_kw("unsigned")
+        self.accept_kw("zerofill")
+        if self.accept_kw("character"):
+            self.expect_kw("set")
+            self.next()
+        if self.accept_kw("collate"):
+            self.next()
+        col = ColumnDef(name, type_name, args)
+        while True:
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                col.not_null = True
+            elif self.accept_kw("null"):
+                pass
+            elif self.accept_kw("primary"):
+                self.expect_kw("key")
+                col.primary_key = True
+            elif self.accept_kw("unique"):
+                self.accept_kw("key")
+                col.unique = True
+            elif self.accept_kw("default"):
+                col.default = self.parse_primary()
+            elif self.accept_kw("auto_increment"):
+                col.auto_increment = True
+            elif self.accept_kw("comment"):
+                self.next()
+            else:
+                return col
+
+    def parse_drop(self):
+        self.expect_kw("drop")
+        if self.accept_kw("database") or self.accept_kw("schema"):
+            ie = self._if_exists()
+            return DropDatabaseStmt(self.expect_ident(), ie)
+        if self.accept_kw("index"):
+            name = self.expect_ident()
+            self.expect_kw("on")
+            return DropIndexStmt(name, self._table_name())
+        self.expect_kw("table")
+        ie = self._if_exists()
+        tables = [self._table_name()]
+        while self.accept_op(","):
+            tables.append(self._table_name())
+        return DropTableStmt(tables, ie)
+
+    def _if_exists(self) -> bool:
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def parse_alter(self) -> AlterTableStmt:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self._table_name()
+        if self.accept_kw("add"):
+            if self.accept_kw("index") or self.accept_kw("key"):
+                name = ""
+                if self.peek().kind in ("IDENT", "QIDENT"):
+                    name = self.expect_ident()
+                return AlterTableStmt(table, "add_index", index=(name, self._paren_name_list()))
+            self.accept_kw("column")
+            return AlterTableStmt(table, "add_column", column=self.parse_column_def())
+        if self.accept_kw("drop"):
+            self.accept_kw("column")
+            return AlterTableStmt(table, "drop_column", old_name=self.expect_ident())
+        if self.accept_kw("rename"):
+            self.accept_kw("to")
+            return AlterTableStmt(table, "rename", new_name=self.expect_ident())
+        raise self.error("unsupported ALTER TABLE action")
+
+    # -- misc statements -----------------------------------------------------
+
+    def parse_explain(self) -> ExplainStmt:
+        self.next()  # explain/describe/desc
+        analyze = bool(self.accept_kw("analyze"))
+        return ExplainStmt(self.parse_statement(), analyze)
+
+    def parse_set(self) -> SetStmt:
+        self.expect_kw("set")
+        assignments = []
+        while True:
+            scope = "session"
+            if self.accept_kw("global"):
+                scope = "global"
+            elif self.accept_kw("session"):
+                scope = "session"
+            t = self.peek()
+            if t.kind == "IDENT" and t.text.startswith("@@"):
+                self.next()
+                name = t.text[2:]
+                for pre in ("global.", "session."):
+                    if name.startswith(pre):
+                        scope = pre[:-1]
+                        name = name[len(pre):]
+            elif t.kind == "IDENT" and t.text.startswith("@"):
+                self.next()
+                scope, name = "user", t.text[1:]
+            else:
+                name = self.expect_ident()
+            self.accept_op("=") or self.accept_op(":=")
+            value = self.parse_expr()
+            assignments.append((scope, name, value))
+            if not self.accept_op(","):
+                break
+        return SetStmt(assignments)
+
+    def parse_show(self) -> ShowStmt:
+        self.expect_kw("show")
+        if self.accept_kw("databases"):
+            return ShowStmt("databases")
+        if self.accept_kw("tables"):
+            like = self.next().text if self.accept_kw("like") else None
+            return ShowStmt("tables", like=like)
+        if self.accept_kw("columns"):
+            self.expect_kw("from")
+            return ShowStmt("columns", target=self.expect_ident())
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            return ShowStmt("create_table", target=self.expect_ident())
+        if self.accept_kw("global") or self.accept_kw("session"):
+            pass
+        if self.accept_kw("variables"):
+            like = self.next().text if self.accept_kw("like") else None
+            return ShowStmt("variables", like=like)
+        if self.accept_kw("status"):
+            return ShowStmt("status")
+        raise self.error("unsupported SHOW")
+
+    def parse_start_txn(self) -> BeginStmt:
+        self.expect_kw("start")
+        self.expect_kw("transaction")
+        return BeginStmt()
+
+    def parse_use(self) -> UseStmt:
+        self.expect_kw("use")
+        return UseStmt(self.expect_ident())
+
+    def parse_truncate(self) -> TruncateStmt:
+        self.expect_kw("truncate")
+        self.accept_kw("table")
+        return TruncateStmt(self._table_name())
+
+    def parse_analyze(self) -> AnalyzeStmt:
+        self.expect_kw("analyze")
+        self.expect_kw("table")
+        tables = [self._table_name()]
+        while self.accept_op(","):
+            tables.append(self._table_name())
+        return AnalyzeStmt(tables)
+
+    # -- expressions (Pratt) -------------------------------------------------
+
+    def parse_expr(self):
+        return self.parse_or()
+
+    def parse_or(self):
+        left = self.parse_xor()
+        while self.at_kw("or") or self.at_op("||"):
+            self.next()
+            left = EBinary("or", left, self.parse_xor())
+        return left
+
+    def parse_xor(self):
+        left = self.parse_and()
+        while self.at_kw("xor"):
+            self.next()
+            left = EBinary("xor", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.at_kw("and") or self.at_op("&&"):
+            self.next()
+            left = EBinary("and", left, self.parse_not())
+        return left
+
+    def parse_not(self):
+        if self.accept_kw("not"):
+            return EUnary("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self):
+        left = self.parse_bitor()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">=", "<=>"):
+                op = self.next().text
+                op = {"!=": "<>"}.get(op, op)
+                right = self.parse_bitor()
+                left = EBinary(op, left, right)
+                continue
+            negated = False
+            save = self.pos
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    sub = self.parse_select_or_union()
+                    self.expect_op(")")
+                    left = EIn(left, subquery=sub, negated=negated)
+                else:
+                    vals = [self.parse_expr()]
+                    while self.accept_op(","):
+                        vals.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = EIn(left, values=vals, negated=negated)
+                continue
+            if self.accept_kw("between"):
+                low = self.parse_bitor()
+                self.expect_kw("and")
+                high = self.parse_bitor()
+                left = EBetween(left, low, high, negated=negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self.parse_bitor()
+                escape = None
+                t = self.peek()
+                if t.kind == "IDENT" and t.text.lower() == "escape":
+                    self.next()
+                    escape = self.next().text
+                left = ELike(left, pattern, negated=negated, escape=escape)
+                continue
+            if negated:
+                self.pos = save
+                break
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                if self.accept_kw("null"):
+                    left = EIsNull(left, negated=neg)
+                elif self.accept_kw("true"):
+                    e = EBinary("<=>", left, EBool(True))
+                    left = EUnary("not", e) if neg else e
+                elif self.accept_kw("false"):
+                    e = EBinary("<=>", left, EBool(False))
+                    left = EUnary("not", e) if neg else e
+                else:
+                    raise self.error("expected NULL/TRUE/FALSE after IS")
+                continue
+            break
+        return left
+
+    def parse_bitor(self):
+        left = self.parse_bitand()
+        while self.at_op("|"):
+            self.next()
+            left = EBinary("|", left, self.parse_bitand())
+        return left
+
+    def parse_bitand(self):
+        left = self.parse_additive()
+        while self.at_op("&"):
+            self.next()
+            left = EBinary("&", left, self.parse_additive())
+        return left
+
+    def parse_additive(self):
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().text
+            left = EBinary(op, left, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self):
+        left = self.parse_unary()
+        while True:
+            if self.at_op("*", "/", "%"):
+                op = self.next().text
+                left = EBinary({"%": "mod"}.get(op, op), left, self.parse_unary())
+            elif self.peek().kind == "IDENT" and self.peek().text.lower() in ("div", "mod"):
+                op = self.next().text.lower()
+                left = EBinary(op, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self):
+        if self.at_op("-"):
+            self.next()
+            return EUnary("-", self.parse_unary())
+        if self.at_op("+"):
+            self.next()
+            return self.parse_unary()
+        if self.at_op("~"):
+            self.next()
+            return EUnary("~", self.parse_unary())
+        if self.at_op("!"):
+            self.next()
+            return EUnary("not", self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self):
+        t = self.peek()
+
+        if t.kind == "NUM":
+            self.next()
+            return ENum(t.text)
+        if t.kind == "STR":
+            self.next()
+            return EStr(t.text)
+        if t.kind == "PARAM":
+            self.next()
+            idx = self.param_count
+            self.param_count += 1
+            return EParam(idx)
+
+        if t.kind == "KW":
+            if self.accept_kw("null"):
+                return ENull()
+            if self.accept_kw("true"):
+                return EBool(True)
+            if self.accept_kw("false"):
+                return EBool(False)
+            if self.accept_kw("case"):
+                return self.parse_case()
+            if self.accept_kw("cast"):
+                self.expect_op("(")
+                arg = self.parse_expr()
+                self.expect_kw("as")
+                tt = self.next()
+                ty = tt.text.lower()
+                targs = ()
+                if self.accept_op("("):
+                    a = [int(self.next().text)]
+                    while self.accept_op(","):
+                        a.append(int(self.next().text))
+                    self.expect_op(")")
+                    targs = tuple(a)
+                self.expect_op(")")
+                return ECast(arg, ty, targs)
+            if self.accept_kw("exists"):
+                self.expect_op("(")
+                sub = self.parse_select_or_union()
+                self.expect_op(")")
+                return EExists(sub)
+            if self.accept_kw("not"):
+                return EUnary("not", self.parse_not())
+            if self.accept_kw("interval"):
+                val = self.parse_expr()
+                unit = self.next().text.lower()
+                return EInterval(val, unit)
+            if self.at_kw("date", "time", "timestamp") and self.peek(1).kind == "STR":
+                kw = self.next().text
+                s = self.next().text
+                return EFunc(kw, [EStr(s)])
+            if t.text in _IDENTISH_KW:
+                # keyword usable as function/identifier (e.g. LEFT(x,1))
+                return self.parse_name_or_call()
+            raise self.error(f"unexpected keyword {t.text.upper()} in expression")
+
+        if t.kind in ("IDENT", "QIDENT"):
+            if t.text.startswith("@@"):
+                self.next()
+                name = t.text[2:]
+                scope = ""
+                for pre in ("global.", "session."):
+                    if name.startswith(pre):
+                        scope, name = pre[:-1], name[len(pre):]
+                return EVar(name, scope)
+            if t.text.startswith("@"):
+                self.next()
+                return EVar(t.text, "user")
+            return self.parse_name_or_call()
+
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                sub = self.parse_select_or_union()
+                self.expect_op(")")
+                return ESubquery(sub)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+
+        if self.at_op("*"):
+            self.next()
+            return EStar()
+
+        raise self.error("unexpected token in expression")
+
+    def parse_name_or_call(self):
+        name = self.expect_ident()
+        if self.accept_op("("):
+            fname = name.lower()
+            distinct = bool(self.accept_kw("distinct"))
+            args: List = []
+            if not self.at_op(")"):
+                if self.at_op("*"):
+                    self.next()
+                    args.append(EStar())
+                else:
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+            self.expect_op(")")
+            return EFunc(fname, args, distinct=distinct)
+        if self.accept_op("."):
+            t = self.peek()
+            if self.at_op("*"):
+                self.next()
+                return EStar(qualifier=name)
+            col = self.expect_ident()
+            return EName(col, qualifier=name)
+        return EName(name)
+
+    def parse_case(self) -> ECase:
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            whens.append((cond, self.parse_expr()))
+        else_ = None
+        if self.accept_kw("else"):
+            else_ = self.parse_expr()
+        self.expect_kw("end")
+        return ECase(operand, whens, else_)
+
+
+# keywords that may appear where identifiers/functions are expected
+_IDENTISH_KW = {
+    "date", "time", "timestamp", "left", "right", "if", "replace", "values",
+    "database", "schema", "comment", "status", "key", "engine",
+}
