@@ -132,7 +132,12 @@ class Chunk:
         its columns in sorted-name order, not SELECT order — result-set
         materialization must pass the plan's output order explicitly.
         """
-        from tidb_tpu.types import TypeKind, scaled_to_decimal_str
+        from tidb_tpu.types import (
+            TypeKind,
+            days_to_date,
+            micros_to_datetime,
+            scaled_to_decimal_str,
+        )
 
         sel = np.asarray(self.sel)
         live = np.nonzero(sel)[0]
@@ -151,6 +156,16 @@ class Chunk:
             elif kind == TypeKind.DECIMAL:
                 vals = [
                     scaled_to_decimal_str(int(d), col.type_.scale) if v else None
+                    for d, v in zip(data, valid)
+                ]
+            elif kind == TypeKind.DATE:
+                vals = [
+                    days_to_date(int(d)).isoformat() if v else None
+                    for d, v in zip(data, valid)
+                ]
+            elif kind == TypeKind.DATETIME:
+                vals = [
+                    micros_to_datetime(int(d)).isoformat(sep=" ") if v else None
                     for d, v in zip(data, valid)
                 ]
             else:

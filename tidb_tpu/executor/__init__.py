@@ -1,0 +1,23 @@
+"""Executor (ref: executor/ — the Open/Next/Close Volcano operators).
+
+The reference pulls 1024-row chunks through per-operator Next() calls with
+goroutine pipelines inside the heavy operators. The TPU redesign keeps the
+pull protocol at the Python level (operator scheduling, memory control)
+but fuses all map-style work between pipeline breakers into single jitted
+device fragments:
+
+  scan.py      -- TableScanExec: partition streaming + fused filter/project
+                  fragment (the coprocessor analogue)
+  aggregate.py -- HashAggExec: packed-code segment strategy on device, or
+                  generic host groupby fallback
+  join.py      -- HashJoinExec: device sort+searchsorted build/probe with
+                  static-capacity windowed expansion
+  sort.py      -- SortExec / TopNExec / LimitExec / UnionExec (root, host)
+  builder.py   -- physical plan -> executor tree (ref: executorBuilder)
+  base.py      -- Executor protocol, ExecContext, ResultSet, RuntimeStats
+"""
+
+from tidb_tpu.executor.base import ExecContext, Executor, ResultSet, run_plan
+from tidb_tpu.executor.builder import build_executor
+
+__all__ = ["ExecContext", "Executor", "ResultSet", "build_executor", "run_plan"]

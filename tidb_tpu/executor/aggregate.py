@@ -1,0 +1,354 @@
+"""HashAggExec (ref: executor/aggregate.go — partial/final worker
+pipeline).
+
+Two strategies, chosen by the planner:
+
+  segment  -- every group key has a small known domain (dictionary codes,
+              bools). Keys pack into one dense code; aggregation is
+              jnp scatter-adds into [G]-shaped accumulators per chunk, on
+              device, inside one jitted update. NULL gets its own slot per
+              key (domain+1) so SQL NULL-group semantics hold. This is the
+              partial-agg kernel that psum-merges across chips in the
+              distributed path.
+
+  generic  -- arbitrary keys (wide ints, floats, many distinct). Chunks
+              compact to host and a vectorized numpy groupby finalizes.
+              This is the root-task fallback, like reference root HashAgg
+              over coprocessor partials.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.errors import ExecutionError, UnsupportedError
+from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.expression.compiler import eval_expr
+from tidb_tpu.planner.logical import AggSpec
+from tidb_tpu.types import FLOAT64, SQLType, TypeKind
+
+__all__ = ["HashAggExec"]
+
+
+def _min_identity(dtype):
+    if np.issubdtype(dtype, np.floating):
+        return np.inf
+    return np.iinfo(dtype).max
+
+
+def _max_identity(dtype):
+    if np.issubdtype(dtype, np.floating):
+        return -np.inf
+    return np.iinfo(dtype).min
+
+
+class HashAggExec(Executor):
+    def __init__(self, schema, child, group_exprs, group_uids, aggs: List[AggSpec],
+                 strategy: str, segment_sizes: Optional[List[int]] = None):
+        super().__init__(schema, [child])
+        self.group_exprs = group_exprs
+        self.group_uids = group_uids
+        self.aggs = aggs
+        self.strategy = strategy
+        self.segment_sizes = segment_sizes
+        self._out: List[Chunk] = []
+        self._emitted = False
+
+    # ------------------------------------------------------------------
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self.ctx = ctx
+        self._out = []
+        self._emitted = False
+        if self.strategy == "segment":
+            self._run_segment()
+        else:
+            self._run_generic()
+
+    def next(self) -> Optional[Chunk]:
+        if self._out:
+            return self._out.pop(0)
+        return None
+
+    # ------------------------------------------------------------------
+    # segment strategy (device)
+    # ------------------------------------------------------------------
+
+    def _run_segment(self):
+        sizes = self.segment_sizes or []
+        domains = [s + 1 for s in sizes]  # +1 slot for NULL keys
+        G = 1
+        for d in domains:
+            G *= d
+        G = max(G, 1)
+        aggs = self.aggs
+
+        def init_state():
+            st = {"occ": jnp.zeros(G, dtype=jnp.int64)}
+            for a in aggs:
+                if a.func in ("sum", "avg"):
+                    dt = jnp.float64 if a.arg.type_.kind == TypeKind.FLOAT else jnp.int64
+                    st[f"{a.uid}.sum"] = jnp.zeros(G, dtype=dt)
+                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+                elif a.func == "count":
+                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+                elif a.func == "min":
+                    dt = a.arg.type_.np_dtype
+                    st[f"{a.uid}.min"] = jnp.full(G, _min_identity(dt), dtype=dt)
+                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+                elif a.func == "max":
+                    dt = a.arg.type_.np_dtype
+                    st[f"{a.uid}.max"] = jnp.full(G, _max_identity(dt), dtype=dt)
+                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+            return st
+
+        group_exprs = self.group_exprs
+
+        def update(state, chunk: Chunk):
+            packed = jnp.zeros(chunk.capacity, dtype=jnp.int64)
+            stride = 1
+            for g, dom in zip(group_exprs, domains):
+                data, valid = eval_expr(g, chunk)
+                idx = jnp.where(valid, jnp.clip(data.astype(jnp.int64), 0, dom - 2), dom - 1)
+                packed = packed + idx * stride
+                stride *= dom
+            sel = chunk.sel
+            seli = sel.astype(jnp.int64)
+            out = dict(state)
+            out["occ"] = state["occ"].at[packed].add(seli)
+            for a in aggs:
+                if a.arg is not None:
+                    d, v = eval_expr(a.arg, chunk)
+                    ok = sel & v
+                if a.func in ("sum", "avg"):
+                    acc = state[f"{a.uid}.sum"]
+                    contrib = jnp.where(ok, d, 0).astype(acc.dtype)
+                    out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
+                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+                elif a.func == "count":
+                    if a.arg is None:
+                        out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(seli)
+                    else:
+                        out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+                elif a.func == "min":
+                    acc = state[f"{a.uid}.min"]
+                    contrib = jnp.where(ok, d, _min_identity(np.dtype(acc.dtype))).astype(acc.dtype)
+                    out[f"{a.uid}.min"] = acc.at[packed].min(contrib)
+                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+                elif a.func == "max":
+                    acc = state[f"{a.uid}.max"]
+                    contrib = jnp.where(ok, d, _max_identity(np.dtype(acc.dtype))).astype(acc.dtype)
+                    out[f"{a.uid}.max"] = acc.at[packed].max(contrib)
+                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+            return out
+
+        update = jax.jit(update, donate_argnums=0)
+        state = init_state()
+        for chunk in self.children[0].chunks():
+            state = update(state, chunk)
+
+        # finalize host-side: unpack occupied groups
+        host = {k: np.asarray(v) for k, v in state.items()}
+        if group_exprs:
+            occupied = np.nonzero(host["occ"] > 0)[0]
+        else:
+            occupied = np.array([0], dtype=np.int64)  # global agg: 1 row always
+        self._emit_groups_from_packed(occupied, domains, host)
+
+    def _emit_groups_from_packed(self, occupied, domains, host):
+        n = len(occupied)
+        cap = max(self.ctx.chunk_capacity, 1)
+        group_cols = {}
+        rem = occupied.copy()
+        for (uid, dom) in zip(self.group_uids, domains):
+            idx = rem % dom
+            rem = rem // dom
+            valid = idx != (dom - 1)
+            group_cols[uid] = (idx, valid)
+        out_arrays: Dict[str, tuple] = {}
+        for c, (uid) in zip(self.schema[: len(self.group_uids)], self.group_uids):
+            idx, valid = group_cols[uid]
+            out_arrays[uid] = (idx.astype(c.type_.np_dtype), valid)
+        for a in self.aggs:
+            out_arrays[a.uid] = self._finalize_agg_host(a, host, occupied)
+        self._chunks_from_host(out_arrays, n, cap)
+
+    def _finalize_agg_host(self, a: AggSpec, host, occupied):
+        cnt = host.get(f"{a.uid}.cnt")
+        cnt = cnt[occupied] if cnt is not None else None
+        if a.func == "count":
+            return cnt.astype(np.int64), np.ones(len(occupied), dtype=np.bool_)
+        if a.func in ("sum",):
+            s = host[f"{a.uid}.sum"][occupied]
+            return s.astype(a.type_.np_dtype), cnt > 0
+        if a.func == "avg":
+            s = host[f"{a.uid}.sum"][occupied].astype(np.float64)
+            if a.arg.type_.kind == TypeKind.DECIMAL:
+                s = s / (10 ** a.arg.type_.scale)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                avg = np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0)
+            return avg, cnt > 0
+        if a.func == "min":
+            return host[f"{a.uid}.min"][occupied].astype(a.type_.np_dtype), cnt > 0
+        if a.func == "max":
+            return host[f"{a.uid}.max"][occupied].astype(a.type_.np_dtype), cnt > 0
+        raise ExecutionError(f"unknown aggregate {a.func}")
+
+    def _chunks_from_host(self, out_arrays: Dict[str, tuple], n: int, cap: int):
+        for start in range(0, max(n, 1), cap):
+            end = min(start + cap, n)
+            if n == 0 and self.group_exprs:
+                break
+            cols = {}
+            for c in self.schema:
+                data, valid = out_arrays[c.uid]
+                cols[c.uid] = Column.from_numpy(
+                    data[start:end], c.type_, valid=valid[start:end], capacity=cap
+                )
+            m = end - start
+            sel = np.zeros(cap, dtype=np.bool_)
+            sel[:m] = True
+            self._out.append(Chunk(cols, jnp.asarray(sel)))
+            if n == 0:
+                break
+
+    # ------------------------------------------------------------------
+    # generic strategy (host groupby)
+    # ------------------------------------------------------------------
+
+    def _run_generic(self):
+        import jax.numpy as jnp
+
+        key_parts: List[List[np.ndarray]] = [[] for _ in self.group_exprs]
+        key_valid: List[List[np.ndarray]] = [[] for _ in self.group_exprs]
+        agg_parts: List[List[np.ndarray]] = [[] for _ in self.aggs]
+        agg_valid: List[List[np.ndarray]] = [[] for _ in self.aggs]
+        total = 0
+
+        group_exprs, aggs = self.group_exprs, self.aggs
+
+        def eval_all(chunk):
+            outs = []
+            for g in group_exprs:
+                outs.append(eval_expr(g, chunk))
+            for a in aggs:
+                if a.arg is not None:
+                    outs.append(eval_expr(a.arg, chunk))
+            return outs, chunk.sel
+
+        eval_all = jax.jit(eval_all)
+
+        for chunk in self.children[0].chunks():
+            outs, sel = eval_all(chunk)
+            sel = np.asarray(sel)
+            live = np.nonzero(sel)[0]
+            total += len(live)
+            i = 0
+            for k in range(len(group_exprs)):
+                d, v = outs[i]; i += 1
+                key_parts[k].append(np.asarray(d)[live])
+                key_valid[k].append(np.asarray(v)[live])
+            for j, a in enumerate(aggs):
+                if a.arg is not None:
+                    d, v = outs[i]; i += 1
+                    agg_parts[j].append(np.asarray(d)[live])
+                    agg_valid[j].append(np.asarray(v)[live])
+                else:
+                    agg_parts[j].append(np.ones(len(live), dtype=np.bool_))
+                    agg_valid[j].append(np.ones(len(live), dtype=np.bool_))
+
+        cap = self.ctx.chunk_capacity
+        if total == 0:
+            if self.group_exprs:
+                self._out = []  # grouped agg over empty input -> no rows
+                return
+            # global aggregate over empty input: one row
+            out_arrays = {}
+            for c, a in zip(self.schema, self.aggs):
+                if a.func == "count":
+                    out_arrays[a.uid] = (np.zeros(1, dtype=np.int64), np.ones(1, dtype=np.bool_))
+                else:
+                    out_arrays[a.uid] = (np.zeros(1, dtype=a.type_.np_dtype), np.zeros(1, dtype=np.bool_))
+            self._chunks_from_host(out_arrays, 1, cap)
+            return
+
+        keys = [np.concatenate(p) for p in key_parts]
+        kvalids = [np.concatenate(p) for p in key_valid]
+        avals = [np.concatenate(p) for p in agg_parts]
+        avalids = [np.concatenate(p) for p in agg_valid]
+
+        if keys:
+            mat = np.stack(
+                [self._to_int64_bits(k, kv) for k, kv in zip(keys, kvalids)]
+                + [kv.astype(np.int64) for kv in kvalids],
+                axis=1,
+            )
+            uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
+            ngroups = len(uniq)
+            first_idx = np.zeros(ngroups, dtype=np.int64)
+            # representative row per group for key values
+            first_idx[inverse[::-1]] = np.arange(total - 1, -1, -1)
+        else:
+            ngroups = 1
+            inverse = np.zeros(total, dtype=np.int64)
+            first_idx = np.zeros(1, dtype=np.int64)
+
+        out_arrays: Dict[str, tuple] = {}
+        for uid, k, kv, c in zip(self.group_uids, keys, kvalids, self.schema):
+            out_arrays[uid] = (k[first_idx].astype(c.type_.np_dtype), kv[first_idx])
+
+        for a, vals, valids in zip(self.aggs, avals, avalids):
+            out_arrays[a.uid] = self._generic_agg(a, vals, valids, inverse, ngroups)
+
+        self._chunks_from_host(out_arrays, ngroups, cap)
+
+    @staticmethod
+    def _to_int64_bits(arr: np.ndarray, valid: np.ndarray) -> np.ndarray:
+        a = np.where(valid, arr, 0)
+        if np.issubdtype(a.dtype, np.floating):
+            return a.astype(np.float64).view(np.int64)
+        return a.astype(np.int64)
+
+    def _generic_agg(self, a: AggSpec, vals, valids, inverse, ngroups):
+        ok = valids.astype(np.bool_)
+        if a.distinct:
+            if a.func not in ("count", "sum", "avg", "min", "max"):
+                raise UnsupportedError(f"DISTINCT {a.func}")
+            bits = self._to_int64_bits(vals, ok)
+            trip = np.stack([inverse[ok], bits[ok]], axis=1)
+            uniq = np.unique(trip, axis=0)
+            inverse = uniq[:, 0]
+            vals = uniq[:, 1].astype(vals.dtype) if not np.issubdtype(vals.dtype, np.floating) else uniq[:, 1].view(np.float64)
+            ok = np.ones(len(vals), dtype=np.bool_)
+
+        cnt = np.zeros(ngroups, dtype=np.int64)
+        np.add.at(cnt, inverse[ok], 1)
+        if a.func == "count":
+            return cnt, np.ones(ngroups, dtype=np.bool_)
+        if a.func in ("sum", "avg"):
+            dt = np.float64 if a.arg.type_.kind == TypeKind.FLOAT or a.func == "avg" else np.int64
+            s = np.zeros(ngroups, dtype=np.int64 if a.arg.type_.kind != TypeKind.FLOAT else np.float64)
+            np.add.at(s, inverse[ok], vals[ok])
+            if a.func == "sum":
+                return s.astype(a.type_.np_dtype), cnt > 0
+            s = s.astype(np.float64)
+            if a.arg.type_.kind == TypeKind.DECIMAL:
+                s = s / (10 ** a.arg.type_.scale)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0), cnt > 0
+        if a.func == "min":
+            m = np.full(ngroups, _min_identity(vals.dtype), dtype=vals.dtype)
+            np.minimum.at(m, inverse[ok], vals[ok])
+            return m.astype(a.type_.np_dtype), cnt > 0
+        if a.func == "max":
+            m = np.full(ngroups, _max_identity(vals.dtype), dtype=vals.dtype)
+            np.maximum.at(m, inverse[ok], vals[ok])
+            return m.astype(a.type_.np_dtype), cnt > 0
+        raise ExecutionError(f"unknown aggregate {a.func}")

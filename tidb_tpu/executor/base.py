@@ -1,0 +1,87 @@
+"""Executor protocol and execution context."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.planner.binder import PlanCol
+
+__all__ = ["ExecContext", "Executor", "ResultSet", "RuntimeStats", "run_plan"]
+
+
+@dataclass
+class RuntimeStats:
+    """Per-operator stats surfaced by EXPLAIN ANALYZE
+    (ref: util/execdetails RuntimeStats)."""
+
+    rows: int = 0
+    chunks: int = 0
+    open_wall: float = 0.0
+    next_wall: float = 0.0
+
+
+@dataclass
+class ExecContext:
+    chunk_capacity: int = 1 << 16
+    collect_stats: bool = False
+    # memory budget for host-side state (bytes); OOM action raises
+    mem_budget: Optional[int] = None
+
+
+class Executor:
+    """Open/Next/Close — the same operator boundary as the reference's
+    executor.Executor, pulling device Chunks instead of CPU chunks."""
+
+    schema: List[PlanCol]
+
+    def __init__(self, schema: List[PlanCol], children: List["Executor"]):
+        self.schema = schema
+        self.children = children
+        self.stats = RuntimeStats()
+
+    def open(self, ctx: ExecContext) -> None:
+        for c in self.children:
+            c.open(ctx)
+
+    def next(self) -> Optional[Chunk]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        for c in self.children:
+            c.close()
+
+    def chunks(self) -> Iterator[Chunk]:
+        while True:
+            ch = self.next()
+            if ch is None:
+                return
+            yield ch
+
+
+@dataclass
+class ResultSet:
+    names: List[str]
+    rows: List[tuple]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+def run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None) -> ResultSet:
+    """Drive an executor tree to completion and materialize host rows."""
+    root.open(ctx)
+    try:
+        visible = root.schema if n_visible is None else root.schema[:n_visible]
+        uids = [c.uid for c in visible]
+        dicts = {c.uid: c.dict_ for c in visible if c.dict_ is not None}
+        rows: List[tuple] = []
+        for ch in root.chunks():
+            rows.extend(ch.to_pylist(dicts=dicts, names=uids))
+        return ResultSet(names=[c.name for c in visible], rows=rows)
+    finally:
+        root.close()

@@ -1,0 +1,107 @@
+"""executorBuilder (ref: executor/builder.go): physical plan -> executors.
+
+The builder performs pipeline fusion: chains of Selection/Projection above
+a TableFullScan collapse into the scan's jitted fragment (stages), so a
+scan+filter+project runs as ONE device dispatch per chunk — the shape the
+coprocessor gives the reference for free.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tidb_tpu.errors import PlanError
+from tidb_tpu.executor.aggregate import HashAggExec
+from tidb_tpu.executor.base import Executor
+from tidb_tpu.executor.join import HashJoinExec
+from tidb_tpu.executor.scan import ProjectionExec, SelectionExec, TableScanExec
+from tidb_tpu.executor.sort import LimitExec, SortExec, TopNExec, UnionExec
+from tidb_tpu.planner.physical import (
+    PHashAgg,
+    PHashJoin,
+    PLimit,
+    PProjection,
+    PScan,
+    PSelection,
+    PSort,
+    PTopN,
+    PUnion,
+    PhysicalPlan,
+)
+
+__all__ = ["build_executor"]
+
+
+def build_executor(plan: PhysicalPlan) -> Executor:
+    # pipeline fusion: Selection/Projection chains over a scan
+    stages, base = [], plan
+    while True:
+        if isinstance(base, PSelection):
+            stages.append(("filter", base.cond))
+            base = base.child
+        elif isinstance(base, PProjection):
+            stages.append(("project", list(zip([c.uid for c in base.schema], base.exprs))))
+            base = base.child
+        else:
+            break
+    if isinstance(base, PScan):
+        scan_stages = []
+        if base.pushed_cond is not None:
+            scan_stages.append(("filter", base.pushed_cond))
+        scan_stages.extend(reversed(stages))
+        return TableScanExec(
+            schema=base.schema,
+            table=base.table,
+            stages=scan_stages,
+            out_schema=plan.schema,
+        )
+
+    if isinstance(plan, PSelection):
+        return SelectionExec(plan.schema, build_executor(plan.child), plan.cond)
+    if isinstance(plan, PProjection):
+        return ProjectionExec(plan.schema, build_executor(plan.child), plan.exprs)
+    if isinstance(plan, PScan):
+        scan_stages = []
+        if plan.pushed_cond is not None:
+            scan_stages.append(("filter", plan.pushed_cond))
+        return TableScanExec(schema=plan.schema, table=plan.table, stages=scan_stages)
+    if isinstance(plan, PHashAgg):
+        return HashAggExec(
+            plan.schema,
+            build_executor(plan.child),
+            plan.group_exprs,
+            plan.group_uids,
+            plan.aggs,
+            plan.strategy,
+            segment_sizes=getattr(plan, "segment_sizes", None),
+        )
+    if isinstance(plan, PHashJoin):
+        probe_idx = 1 - plan.build_side
+        probe_plan = plan.children[probe_idx]
+        build_plan = plan.children[plan.build_side]
+        probe_keys = plan.eq_left if probe_idx == 0 else plan.eq_right
+        build_keys = plan.eq_right if plan.build_side == 1 else plan.eq_left
+        build_payload_schema = (
+            [] if plan.kind in ("semi", "anti") else list(build_plan.schema)
+        )
+        return HashJoinExec(
+            plan.schema,
+            build_executor(probe_plan),
+            build_executor(build_plan),
+            plan.kind,
+            probe_keys,
+            build_keys,
+            other_cond=plan.other_cond,
+            probe_schema=list(probe_plan.schema),
+            build_schema=build_payload_schema,
+        )
+    if isinstance(plan, PSort):
+        return SortExec(plan.schema, build_executor(plan.child), plan.items)
+    if isinstance(plan, PTopN):
+        return TopNExec(plan.schema, build_executor(plan.child), plan.items, plan.count, plan.offset)
+    if isinstance(plan, PLimit):
+        return LimitExec(plan.schema, build_executor(plan.child), plan.count, plan.offset)
+    if isinstance(plan, PUnion):
+        return UnionExec(plan.schema, [build_executor(c) for c in plan.children])
+
+    raise PlanError(f"no executor for {type(plan).__name__}")

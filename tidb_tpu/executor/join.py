@@ -1,0 +1,275 @@
+"""HashJoinExec (ref: executor/join.go — build + concurrent probe workers).
+
+TPU redesign: hash tables are scatter-hostile, so the build side becomes a
+*sorted* key array (+ row payload) on device, and each probe chunk runs
+one jitted kernel:
+
+    searchsorted(build_keys, probe_keys)  -> start, count per probe row
+    windowed expansion                    -> static-capacity output chunks
+
+The only host syncs are the per-chunk match total (to pick the number of
+output windows) — everything else stays on device. Duplicate build keys
+are handled naturally by the [start, start+count) ranges; NULL keys never
+match by masking them out of both sides.
+
+Multi-key equi joins pack keys into one int64 using host-known ranges
+(offset+stride per key); if ranges overflow, the join falls back to a
+host merge join (correct, slower — the reference similarly falls back
+from its fast paths).
+
+Join kinds: inner, left (outer), semi, anti (with NOT IN null semantics:
+any NULL build key -> empty result).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.errors import ExecutionError, UnsupportedError
+from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.expression.compiler import compile_predicate, eval_expr
+from tidb_tpu.types import TypeKind
+
+__all__ = ["HashJoinExec"]
+
+
+def _as_int64_key(d, mode: str):
+    """Device-side: make a comparable int64 key (floats via bit pattern)."""
+    if mode == "bits":
+        return jax.lax.bitcast_convert_type(d.astype(jnp.float64), jnp.int64)
+    return d.astype(jnp.int64)
+
+
+class HashJoinExec(Executor):
+    def __init__(self, schema, probe_child, build_child, kind: str,
+                 probe_keys: List, build_keys: List, other_cond=None,
+                 probe_schema=None, build_schema=None):
+        super().__init__(schema, [probe_child, build_child])
+        self.kind = kind
+        self.probe_keys = probe_keys
+        self.build_keys = build_keys
+        self.other_cond = other_cond
+        self.probe_schema = probe_schema
+        self.build_schema = build_schema
+        if kind == "left" and other_cond is not None:
+            raise UnsupportedError("LEFT JOIN with non-equi conditions not supported yet")
+
+    # ------------------------------------------------------------------
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self.ctx = ctx
+        self._pending: List[Chunk] = []
+        self._drained = False
+        self._build()
+
+    def _build(self):
+        """Drain the build child; compact key + payload columns to host;
+        sort by key; stage back to device."""
+        build_child = self.children[1]
+        keys_ir = self.build_keys
+
+        def eval_keys(chunk):
+            # keyless (cross) join: a constant key matches everything
+            if not keys_ir:
+                z = jnp.zeros(chunk.capacity, dtype=jnp.int64)
+                return [(z, jnp.ones(chunk.capacity, dtype=jnp.bool_))], chunk.sel
+            outs = [eval_expr(k, chunk) for k in keys_ir]
+            return outs, chunk.sel
+
+        eval_keys = jax.jit(eval_keys)
+
+        key_cols = [[] for _ in (keys_ir or [None])]
+        key_ok = []
+        payload: dict = {c.uid: ([], []) for c in (self.build_schema or [])}
+        for chunk in build_child.chunks():
+            outs, sel = eval_keys(chunk)
+            sel = np.asarray(sel)
+            live = np.nonzero(sel)[0]
+            ok = np.ones(len(live), dtype=np.bool_)
+            for i, (d, v) in enumerate(outs):
+                key_cols[i].append(np.asarray(d)[live])
+                ok &= np.asarray(v)[live]
+            key_ok.append(ok)
+            for uid in payload:
+                col = chunk.columns[uid]
+                payload[uid][0].append(np.asarray(col.data)[live])
+                payload[uid][1].append(np.asarray(col.valid)[live])
+
+        key_arrays = [np.concatenate(p) if p else np.zeros(0, dtype=np.int64) for p in key_cols]
+        ok = np.concatenate(key_ok) if key_ok else np.zeros(0, dtype=np.bool_)
+        self._build_had_null = bool((~ok).any())
+        # NULL keys can never match: drop them from the build side
+        key_arrays = [k[ok] for k in key_arrays]
+
+        packed, self._pack_info = self._pack_keys_host(key_arrays)
+        order = np.argsort(packed, kind="stable")
+        self._n_build = len(packed)
+        self._sorted_keys = jnp.asarray(packed[order])
+        self._build_payload = {}
+        for uid, (dlist, vlist) in payload.items():
+            d = np.concatenate(dlist) if dlist else np.zeros(0)
+            v = np.concatenate(vlist) if vlist else np.zeros(0, dtype=np.bool_)
+            d, v = d[ok][order], v[ok][order]
+            self._build_payload[uid] = (jnp.asarray(d), jnp.asarray(v))
+        self._probe_fn = None
+
+    def _pack_keys_host(self, key_arrays: List[np.ndarray]):
+        """Combine multi-keys into one int64 via range packing. Returns
+        (packed, info) where info lets the probe side apply the same
+        transform; raises to host-merge fallback on overflow."""
+        if len(key_arrays) == 1:
+            k = key_arrays[0]
+            if np.issubdtype(k.dtype, np.floating):
+                return k.astype(np.float64).view(np.int64), [("bits", 0, 1, 0)]
+            return k.astype(np.int64), [("int", 0, 1, 0)]
+        info = []
+        packed = np.zeros(len(key_arrays[0]), dtype=np.int64)
+        stride = 1
+        for k in key_arrays:
+            if np.issubdtype(k.dtype, np.floating):
+                k = k.astype(np.float64).view(np.int64)
+                mode = "bits"
+            else:
+                k = k.astype(np.int64)
+                mode = "int"
+            lo = int(k.min()) if len(k) else 0
+            hi = int(k.max()) if len(k) else 0
+            rng = hi - lo + 1
+            if stride > 0 and rng * stride > (1 << 62):
+                raise UnsupportedError("multi-key join range overflow (host fallback TODO)")
+            info.append((mode, lo, stride, rng))
+            packed = packed + (k - lo) * stride
+            stride *= rng if rng > 0 else 1
+        return packed, info
+
+    def _pack_probe(self, outs):
+        """Device-side packing of probe keys with the build-side info.
+        Returns (packed int64, ok mask) — keys outside the build range get
+        ok=False (they cannot match)."""
+        info = self._pack_info
+        if len(outs) == 1:
+            d, v = outs[0]
+            ones = jnp.ones_like(v)
+            return _as_int64_key(d, info[0][0]), v, ones
+        packed = jnp.zeros_like(outs[0][0], dtype=jnp.int64)
+        valid = jnp.ones_like(outs[0][1])
+        in_range = jnp.ones_like(outs[0][1])
+        for (d, v), (mode, lo, stride, rng) in zip(outs, info):
+            d = _as_int64_key(d, mode)
+            valid = valid & v
+            # probe keys outside the build range can't match; without this
+            # mask they'd alias into other (lo, stride) cells and collide.
+            # kept separate from `valid`: an out-of-range key is a definite
+            # non-match (anti joins must keep the row), not a NULL.
+            in_range = in_range & (d >= lo) & (d < lo + rng)
+            packed = packed + jnp.clip(d - lo, 0, max(rng - 1, 0)) * stride
+        return packed, valid, in_range
+
+    # ------------------------------------------------------------------
+
+    def _make_probe_fn(self):
+        keys_ir = self.probe_keys
+        sorted_keys = self._sorted_keys
+
+        def probe(chunk):
+            if not keys_ir:
+                packed = jnp.zeros(chunk.capacity, dtype=jnp.int64)
+                valid = in_range = jnp.ones(chunk.capacity, dtype=jnp.bool_)
+            else:
+                outs = [eval_expr(k, chunk) for k in keys_ir]
+                packed, valid, in_range = self._pack_probe(outs)
+            ok = valid & chunk.sel
+            start = jnp.searchsorted(sorted_keys, packed, side="left")
+            end = jnp.searchsorted(sorted_keys, packed, side="right")
+            count = jnp.where(ok & in_range, end - start, 0)
+            return start, count, ok
+
+        return jax.jit(probe)
+
+    def next(self) -> Optional[Chunk]:
+        while True:
+            if self._pending:
+                return self._pending.pop(0)
+            if self._drained:
+                return None
+            chunk = self.children[0].next()
+            if chunk is None:
+                self._drained = True
+                continue
+            self._process_probe_chunk(chunk)
+
+    def _process_probe_chunk(self, chunk: Chunk):
+        if self._probe_fn is None:
+            self._probe_fn = self._make_probe_fn()
+            self._expand_fn = self._make_expand_fn()
+            self._filter_fns = {}
+        start, count, ok = self._probe_fn(chunk)
+
+        if self.kind == "semi":
+            self._pending.append(chunk.with_sel(ok & (count > 0)))
+            return
+        if self.kind == "anti":
+            if self._build_had_null:
+                return  # NOT IN with NULL in subquery: no row is ever TRUE
+            self._pending.append(chunk.with_sel(chunk.sel & ok & (count == 0)))
+            return
+
+        real_count = count
+        if self.kind == "left":
+            count = jnp.where(chunk.sel, jnp.maximum(count, 1), 0)
+
+        cum = jnp.cumsum(count)
+        total = int(cum[-1])
+        if total == 0:
+            return
+        cap = self.ctx.chunk_capacity
+        for w in range(0, total, cap):
+            out = self._expand_fn(chunk, start, count, real_count, cum, jnp.int64(w))
+            if self.other_cond is not None:
+                key = "oc"
+                if key not in self._filter_fns:
+                    pred = compile_predicate(self.other_cond)
+                    self._filter_fns[key] = jax.jit(lambda ch: ch.filter(pred(ch)))
+                out = self._filter_fns[key](out)
+            self._pending.append(out)
+
+    def _make_expand_fn(self):
+        payload = self._build_payload
+        build_schema = {c.uid: c for c in (self.build_schema or [])}
+        kind = self.kind
+        n_build = max(self._n_build, 1)
+        cap = self.ctx.chunk_capacity
+
+        def expand(chunk, start, count, real_count, cum, w):
+            j = jnp.arange(cap, dtype=jnp.int64) + w
+            total = cum[-1]
+            valid_out = j < total
+            probe_row = jnp.searchsorted(cum, j, side="right")
+            probe_row = jnp.clip(probe_row, 0, chunk.capacity - 1)
+            cum_excl = cum[probe_row] - count[probe_row]
+            k = j - cum_excl
+            build_pos = jnp.clip(start[probe_row] + k, 0, n_build - 1)
+
+            cols = {}
+            for uid, col in chunk.columns.items():
+                cols[uid] = col.gather(probe_row, valid_out)
+            # left join emits one slot even for unmatched probe rows; the
+            # build payload is NULL there (k beyond the real match count)
+            real = k < real_count[probe_row]
+            for uid, (d, v) in payload.items():
+                data = jnp.take(d, build_pos, mode="clip")
+                valid = jnp.take(v, build_pos, mode="clip") & valid_out
+                if kind == "left":
+                    valid = valid & real
+                c = build_schema[uid]
+                cols[uid] = Column(data, valid, c.type_)
+            return Chunk(cols, valid_out)
+
+        return jax.jit(expand)

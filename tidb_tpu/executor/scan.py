@@ -1,0 +1,141 @@
+"""TableScanExec: stream table partitions to device through a fused
+filter/project fragment.
+
+This is the distsql/coprocessor boundary of the reference collapsed onto
+host->HBM staging: each partition slice becomes a fixed-capacity Chunk,
+and one jitted fragment (pushed filter + any stacked Selection/Projection
+ops) runs per chunk. The same compiled fragment is reused for every chunk
+of the table — shapes are static by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.expression.compiler import compile_expr, compile_predicate
+from tidb_tpu.planner.binder import PlanCol
+
+__all__ = ["TableScanExec", "make_pipeline_fn", "SelectionExec", "ProjectionExec"]
+
+
+def make_pipeline_fn(stages: List) -> Callable:
+    """Compose stages into one Chunk->Chunk function to be jitted.
+
+    Each stage is ("filter", ir) or ("project", [(uid, ir)], keep_input:bool).
+    """
+    compiled = []
+    for kind, payload in stages:
+        if kind == "filter":
+            compiled.append(("filter", compile_predicate(payload)))
+        else:
+            exprs = [(uid, compile_expr(ir)) for uid, ir in payload]
+            compiled.append(("project", exprs))
+
+    def run(chunk: Chunk) -> Chunk:
+        for kind, fn in compiled:
+            if kind == "filter":
+                chunk = chunk.filter(fn(chunk))
+            else:
+                chunk = chunk.project({uid: f(chunk) for uid, f in fn})
+        return chunk
+
+    return run
+
+
+class TableScanExec(Executor):
+    def __init__(self, schema: List[PlanCol], table, stages: List, out_schema: Optional[List[PlanCol]] = None):
+        super().__init__(out_schema or schema, [])
+        self.scan_schema = schema  # storage columns staged (pre-pipeline)
+        self.table = table
+        self.stages = stages
+        self._fn = None
+        self._slices = []
+        self._i = 0
+
+    def open(self, ctx: ExecContext) -> None:
+        self.ctx = ctx
+        cap = ctx.chunk_capacity
+        self._fn = jax.jit(make_pipeline_fn(self.stages)) if self.stages else None
+        self._slices = []
+        if self.table is not None:
+            n = self.table.n
+            for s in range(0, max(n, 1), cap):
+                self._slices.append((s, min(s + cap, n)))
+            if n == 0:
+                self._slices = []
+        else:
+            # dual table: one empty-schema row (SELECT without FROM)
+            self._slices = [None]
+        self._i = 0
+
+    def next(self) -> Optional[Chunk]:
+        import jax.numpy as jnp
+
+        while self._i < len(self._slices):
+            sl = self._slices[self._i]
+            self._i += 1
+            cap = self.ctx.chunk_capacity
+            if sl is None:
+                sel = np.zeros(cap, dtype=np.bool_)
+                sel[0] = True
+                chunk = Chunk({}, jnp.asarray(sel))
+            else:
+                start, end = sl
+                n = end - start
+                cols = {}
+                for c in self.scan_schema:
+                    data, valid = self.table.column_slice(c.name, start, end)
+                    cols[c.uid] = Column.from_numpy(data, c.type_, valid=valid, capacity=cap)
+                live = np.zeros(cap, dtype=np.bool_)
+                live[:n] = self.table.live_mask(start, end)
+                chunk = Chunk(cols, jnp.asarray(live))
+            if self._fn is not None:
+                chunk = self._fn(chunk)
+            self.stats.chunks += 1
+            return chunk
+        return None
+
+
+class SelectionExec(Executor):
+    """Standalone filter for positions where fusion into a scan fragment
+    wasn't possible (e.g. above an aggregate for HAVING)."""
+
+    def __init__(self, schema, child: Executor, cond):
+        super().__init__(schema, [child])
+        self.cond = cond
+        self._fn = None
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        pred = compile_predicate(self.cond)
+        self._fn = jax.jit(lambda ch: ch.filter(pred(ch)))
+
+    def next(self) -> Optional[Chunk]:
+        ch = self.children[0].next()
+        if ch is None:
+            return None
+        return self._fn(ch)
+
+
+class ProjectionExec(Executor):
+    def __init__(self, schema, child: Executor, exprs):
+        super().__init__(schema, [child])
+        self.exprs = exprs
+        self._fn = None
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        pairs = [(c.uid, compile_expr(e)) for c, e in zip(self.schema, self.exprs)]
+        self._fn = jax.jit(lambda ch: ch.project({uid: f(ch) for uid, f in pairs}))
+
+    def next(self) -> Optional[Chunk]:
+        ch = self.children[0].next()
+        if ch is None:
+            return None
+        return self._fn(ch)

@@ -1,0 +1,188 @@
+"""Root-task operators: Sort / TopN / Limit / Union
+(ref: executor/sort.go, topn, limit; these sit at the plan root over small
+results, so they run host-side — the reference similarly runs root
+executors on the SQL node while coprocessors do the heavy scans).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.expression.compiler import compile_expr
+from tidb_tpu.types import TypeKind
+
+__all__ = ["SortExec", "TopNExec", "LimitExec", "UnionExec"]
+
+
+class _Materializing(Executor):
+    """Shared: drain child to host-compacted column arrays."""
+
+    def _drain_to_host(self, sort_items: List[Tuple[object, bool]]):
+        child = self.children[0]
+        uids = [c.uid for c in self.schema]
+        key_fns = [compile_expr(e) for e, _ in sort_items]
+
+        def eval_chunk(ch):
+            keys = [f(ch) for f in key_fns]
+            return keys, ch
+
+        eval_chunk = jax.jit(eval_chunk)
+
+        cols = {uid: ([], []) for uid in uids}
+        keys: List[Tuple[List, List]] = [([], []) for _ in sort_items]
+        for ch in child.chunks():
+            kcols, ch = eval_chunk(ch)
+            sel = np.asarray(ch.sel)
+            live = np.nonzero(sel)[0]
+            for uid in uids:
+                col = ch.columns[uid]
+                cols[uid][0].append(np.asarray(col.data)[live])
+                cols[uid][1].append(np.asarray(col.valid)[live])
+            for i, kc in enumerate(kcols):
+                keys[i][0].append(np.asarray(kc.data)[live])
+                keys[i][1].append(np.asarray(kc.valid)[live])
+
+        host_cols = {}
+        n = 0
+        for uid in uids:
+            d = np.concatenate(cols[uid][0]) if cols[uid][0] else np.zeros(0)
+            v = np.concatenate(cols[uid][1]) if cols[uid][1] else np.zeros(0, dtype=np.bool_)
+            host_cols[uid] = (d, v)
+            n = len(d)
+        host_keys = [
+            (np.concatenate(k[0]) if k[0] else np.zeros(0),
+             np.concatenate(k[1]) if k[1] else np.zeros(0, dtype=np.bool_))
+            for k in keys
+        ]
+        return host_cols, host_keys, n
+
+    def _emit(self, host_cols, order: Optional[np.ndarray], n: int):
+        cap = self.ctx.chunk_capacity
+        self._chunks = []
+        idx = order if order is not None else np.arange(n)
+        for s in range(0, len(idx), cap):
+            part = idx[s : s + cap]
+            cols = {}
+            for c in self.schema:
+                d, v = host_cols[c.uid]
+                cols[c.uid] = Column.from_numpy(d[part], c.type_, valid=v[part], capacity=cap)
+            sel = np.zeros(cap, dtype=np.bool_)
+            sel[: len(part)] = True
+            self._chunks.append(Chunk(cols, jnp.asarray(sel)))
+
+    def next(self) -> Optional[Chunk]:
+        if self._chunks:
+            return self._chunks.pop(0)
+        return None
+
+
+def _sort_order(host_keys, items) -> np.ndarray:
+    """np.lexsort with MySQL NULL ordering (NULLs first ASC, last DESC)."""
+    lex = []
+    for (data, valid), (_, desc) in zip(host_keys, items):
+        d = data
+        if np.issubdtype(d.dtype, np.bool_):
+            d = d.astype(np.int64)
+        if desc:
+            d = -d.astype(np.float64) if np.issubdtype(d.dtype, np.floating) else -d.astype(np.int64)
+            nullrank = (~valid).astype(np.int64)  # nulls last on desc
+        else:
+            d = d.astype(np.float64) if np.issubdtype(d.dtype, np.floating) else d.astype(np.int64)
+            nullrank = valid.astype(np.int64)  # nulls (0) first on asc
+        d = np.where(valid, d, 0)
+        # within one sort key, null-rank dominates the value
+        lex.append(nullrank)
+        lex.append(d)
+    # np.lexsort: last key is primary; our items[0] is primary
+    return np.lexsort(lex[::-1]) if lex else np.arange(len(host_keys[0][0]) if host_keys else 0)
+
+
+class SortExec(_Materializing):
+    def __init__(self, schema, child, items):
+        super().__init__(schema, [child])
+        self.items = items
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self.ctx = ctx
+        host_cols, host_keys, n = self._drain_to_host(self.items)
+        order = _sort_order(host_keys, self.items) if self.items else None
+        self._emit(host_cols, order, n)
+
+
+class TopNExec(_Materializing):
+    def __init__(self, schema, child, items, count: int, offset: int):
+        super().__init__(schema, [child])
+        self.items = items
+        self.count = count
+        self.offset = offset
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self.ctx = ctx
+        host_cols, host_keys, n = self._drain_to_host(self.items)
+        order = _sort_order(host_keys, self.items)
+        order = order[self.offset : self.offset + self.count]
+        self._emit(host_cols, order, n)
+
+
+class LimitExec(Executor):
+    def __init__(self, schema, child, count: int, offset: int):
+        super().__init__(schema, [child])
+        self.count = count
+        self.offset = offset
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self.ctx = ctx
+        self._skipped = 0
+        self._taken = 0
+
+    def next(self) -> Optional[Chunk]:
+        import jax.numpy as jnp
+
+        while self._taken < self.count:
+            ch = self.children[0].next()
+            if ch is None:
+                return None
+            sel = np.asarray(ch.sel)
+            live = np.nonzero(sel)[0]
+            m = len(live)
+            if m == 0:
+                continue
+            drop = min(self._skipped_remaining(), m)
+            take = min(self.count - self._taken, m - drop)
+            self._skipped += drop
+            self._taken += take
+            if take <= 0:
+                continue
+            keep = np.zeros_like(sel)
+            keep[live[drop : drop + take]] = True
+            return ch.with_sel(ch.sel & jnp.asarray(keep))
+        return None
+
+    def _skipped_remaining(self) -> int:
+        return max(0, self.offset - self._skipped)
+
+
+class UnionExec(Executor):
+    """UNION ALL: chain child streams (children project onto shared uids)."""
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self._i = 0
+
+    def next(self) -> Optional[Chunk]:
+        while self._i < len(self.children):
+            ch = self.children[self._i].next()
+            if ch is not None:
+                return ch
+            self._i += 1
+        return None

@@ -229,6 +229,37 @@ def _cmp(op):
     return fn
 
 
+def _nseq(e: Call, chunk) -> Pair:
+    """Null-safe equal <=> : NULL<=>NULL is TRUE, never returns NULL."""
+    a, b = e.args
+    (da, va), (db, vb) = eval_expr(a, chunk), eval_expr(b, chunk)
+    if a.type_.kind == TypeKind.DECIMAL or b.type_.kind == TypeKind.DECIMAL:
+        s = max(a.type_.scale, b.type_.scale)
+        da = _rescale(da, a.type_.scale, s) if a.type_.kind == TypeKind.DECIMAL else da * 10**s
+        db = _rescale(db, b.type_.scale, s) if b.type_.kind == TypeKind.DECIMAL else db * 10**s
+    both_null = ~va & ~vb
+    eq = va & vb & (da == db)
+    return both_null | eq, jnp.ones_like(va)
+
+
+def _truncate(e: Call, chunk) -> Pair:
+    """TRUNCATE(x, d): toward zero, unlike ROUND."""
+    a = e.args[0]
+    nd = 0
+    if len(e.args) > 1:
+        lit = e.args[1]
+        if not isinstance(lit, Literal):
+            raise PlanError("TRUNCATE digits must be a constant")
+        nd = int(lit.value)
+    d, v = eval_expr(a, chunk)
+    if a.type_.kind == TypeKind.DECIMAL:
+        f = 10 ** max(a.type_.scale - nd, 0)
+        out = jax.lax.div(d, jnp.int64(f)) * f if f > 1 else d
+        return _rescale(out, a.type_.scale, e.type_.scale), v
+    f = 10.0**nd
+    return jnp.trunc(d.astype(jnp.float64) * f) / f, v
+
+
 def _and(e: Call, chunk) -> Pair:
     a, b = e.args
     (da, va), (db, vb) = eval_expr(a, chunk), eval_expr(b, chunk)
@@ -281,10 +312,16 @@ def _intdiv(e: Call, chunk) -> Pair:
 def _mod(e: Call, chunk) -> Pair:
     a, b = e.args
     (da, va), (db, vb) = eval_expr(a, chunk), eval_expr(b, chunk)
+    # align operands on the result representation (decimal scale / float)
+    da = _to_kind(da, a.type_, e.type_)
+    db = _to_kind(db, b.type_, e.type_)
     zero = db == 0
     safe = jnp.where(zero, 1, db)
     # MySQL MOD takes the sign of the dividend (C semantics), not python's
-    r = da - jax.lax.div(da, safe) * safe if da.dtype != jnp.float64 else da - jnp.trunc(da / safe) * safe
+    if e.type_.kind == TypeKind.FLOAT:
+        r = da - jnp.trunc(da / safe) * safe
+    else:
+        r = da - jax.lax.div(da, safe) * safe
     return r, va & vb & ~zero
 
 
@@ -389,8 +426,10 @@ FUNCS = {
     "and": _and,
     "or": _or,
     "not": _not,
+    "nseq": _nseq,
     "is_null": _is_null,
     "is_not_null": _is_not_null,
+    "truncate": _truncate,
     "coalesce": _coalesce,
     "if": _if,
     "ifnull": _ifnull,

@@ -104,11 +104,13 @@ class Parser:
         return out
 
     def parse_statement(self):
+        if self.at_op("("):  # parenthesized SELECT statement
+            return self.parse_select_or_union()
         t = self.peek()
         if t.kind != "KW":
             raise self.error("expected statement keyword")
         kw = t.text
-        if kw in ("select", "with") or self.at_op("("):
+        if kw in ("select", "with"):
             return self.parse_select_or_union()
         handler = {
             "insert": self.parse_insert,
@@ -166,8 +168,13 @@ class Parser:
             right = self.parse_select_core()
             node = UnionStmt(node, right, all=all_, op=op)
             # an unparenthesized trailing ORDER BY/LIMIT was consumed by the
-            # right SELECT but binds to the whole union (MySQL semantics)
-            if isinstance(right, SelectStmt) and not self.at_kw("union", "except", "intersect"):
+            # right SELECT but binds to the whole union (MySQL semantics);
+            # a parenthesized operand keeps its own ORDER BY/LIMIT
+            if (
+                isinstance(right, SelectStmt)
+                and not getattr(right, "_parenthesized", False)
+                and not self.at_kw("union", "except", "intersect")
+            ):
                 node.order_by, right.order_by = right.order_by, []
                 node.limit, node.offset = right.limit, right.offset
                 right.limit = right.offset = None
@@ -186,6 +193,7 @@ class Parser:
         if self.accept_op("("):
             sel = self.parse_select_or_union()
             self.expect_op(")")
+            sel._parenthesized = True
             return sel
         self.expect_kw("select")
         stmt = SelectStmt()
@@ -928,5 +936,5 @@ class Parser:
 # keywords that may appear where identifiers/functions are expected
 _IDENTISH_KW = {
     "date", "time", "timestamp", "left", "right", "if", "replace", "values",
-    "database", "schema", "comment", "status", "key", "engine",
+    "database", "schema", "comment", "status", "key", "engine", "truncate",
 }

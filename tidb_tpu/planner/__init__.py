@@ -1,0 +1,23 @@
+"""Planner (ref: planner/core — PlanBuilder, logicalOptimize,
+physicalOptimize).
+
+    binder.py    -- name resolution + AST->typed-IR lowering, including the
+                    string-predicate rewrite onto dictionary codes
+    logical.py   -- logical plan nodes + build from parsed statements
+    rules.py     -- rule-based logical optimization (constant folding,
+                    predicate pushdown, column pruning, subquery-to-join)
+    physical.py  -- physical operators + lowering + EXPLAIN text
+    optimizer.py -- the Optimize() entry: AST -> optimized physical plan
+
+The reference runs a cost-based search over storage paths; this engine has
+one storage tier (host columnar -> device), so physical choice reduces to
+algorithm selection (agg strategy, join order/build side) driven by simple
+stats — the cascades-style search can arrive later without changing the
+plan interfaces.
+"""
+
+from tidb_tpu.planner.binder import Binder, PlanCol, Scope
+from tidb_tpu.planner.optimizer import plan_statement
+from tidb_tpu.planner.physical import explain_text
+
+__all__ = ["Binder", "PlanCol", "Scope", "plan_statement", "explain_text"]

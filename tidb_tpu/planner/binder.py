@@ -1,0 +1,782 @@
+"""Name resolution and AST -> typed-IR lowering.
+
+This is where SQL semantics meet the TPU data layout:
+
+  * every column gets a plan-unique uid; chunks key columns by uid, so
+    operators never collide on names
+  * string predicates are rewritten onto sorted-dictionary codes at bind
+    time (equality -> code compare, ranges -> code bounds, LIKE -> host
+    LUT + device gather, cross-dictionary compares -> union-dict
+    translation) — the device never sees a string
+  * temporal literals and INTERVAL arithmetic over literals fold to day
+    counts host-side
+  * decimal types carry scales; binding computes result scales (mul adds
+    scales, div leaves fixed point for float)
+
+ref: planner/core expression rewriting + expression/ type inference.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk.dictionary import Dictionary
+from tidb_tpu.errors import (
+    AmbiguousColumnError,
+    PlanError,
+    UnknownColumnError,
+    UnsupportedError,
+)
+from tidb_tpu.expression.expr import (
+    Call,
+    Case,
+    Cast,
+    ColumnRef,
+    Expr,
+    InList,
+    Literal,
+    Lookup,
+)
+from tidb_tpu.parser import ast as A
+from tidb_tpu.types import (
+    BOOL,
+    DATE,
+    DATETIME,
+    FLOAT64,
+    INT64,
+    NULLTYPE,
+    STRING,
+    SQLType,
+    TypeKind,
+    common_type,
+    date_to_days,
+    datetime_to_micros,
+    decimal_to_scaled,
+    decimal_type,
+)
+
+__all__ = ["PlanCol", "Scope", "Binder", "AGG_FUNCS", "ast_key"]
+
+AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
+
+
+@dataclass
+class PlanCol:
+    uid: str
+    name: str                      # display / alias name
+    type_: SQLType
+    qualifier: Optional[str] = None  # table alias for qualified resolution
+    dict_: Optional[Dictionary] = None  # for STRING columns
+
+    def ref(self) -> ColumnRef:
+        return ColumnRef(type_=self.type_, name=self.uid)
+
+
+class Scope:
+    """Visible columns during binding; supports qualified/unqualified lookup."""
+
+    def __init__(self, cols: List[PlanCol], parent: Optional["Scope"] = None):
+        self.cols = cols
+        self.parent = parent
+
+    def resolve(self, name: str, qualifier: Optional[str]) -> PlanCol:
+        # exact-uid references come from the planner's own agg/group
+        # substitution (uids contain '#', so they never collide with SQL names)
+        if "#" in name:
+            for c in self.cols:
+                if c.uid == name:
+                    return c
+        matches = [
+            c
+            for c in self.cols
+            if c.name.lower() == name.lower()
+            and (qualifier is None or (c.qualifier or "").lower() == qualifier.lower())
+        ]
+        if len(matches) > 1:
+            # identical uid through different paths is fine
+            if len({m.uid for m in matches}) > 1:
+                raise AmbiguousColumnError(f"ambiguous column {name!r}")
+        if matches:
+            return matches[0]
+        if self.parent is not None:
+            # correlated reference — recognized so we can error clearly
+            found = self.parent.try_resolve(name, qualifier)
+            if found:
+                raise UnsupportedError(
+                    f"correlated subquery reference {qualifier + '.' if qualifier else ''}{name} not supported yet"
+                )
+        raise UnknownColumnError(f"unknown column {qualifier + '.' if qualifier else ''}{name}")
+
+    def try_resolve(self, name: str, qualifier: Optional[str]) -> Optional[PlanCol]:
+        try:
+            return self.resolve(name, qualifier)
+        except UnknownColumnError:
+            return None
+        except UnsupportedError:
+            return None
+
+
+def ast_key(e) -> str:
+    """Stable structural key for AST dedup (same agg/group expr -> one slot)."""
+    if isinstance(e, list):
+        return "[" + ",".join(ast_key(x) for x in e) + "]"
+    if isinstance(e, tuple):
+        return "(" + ",".join(ast_key(x) for x in e) + ")"
+    if hasattr(e, "__dataclass_fields__"):
+        parts = [type(e).__name__]
+        for f in e.__dataclass_fields__:
+            parts.append(f + "=" + ast_key(getattr(e, f)))
+        return "{" + ";".join(parts) + "}"
+    return repr(e)
+
+
+class Binder:
+    def __init__(self):
+        self._uid = 0
+
+    def new_uid(self, base: str) -> str:
+        self._uid += 1
+        return f"{base}#{self._uid}"
+
+    # ------------------------------------------------------------------
+    # literals
+    # ------------------------------------------------------------------
+
+    def bind_literal(self, e) -> Expr:
+        if isinstance(e, A.ENum):
+            t = e.text
+            if re.search(r"[eE]", t):
+                return Literal(type_=FLOAT64, value=float(t))
+            if "." in t:
+                scale = len(t.split(".", 1)[1])
+                if scale > 12:
+                    # decimal compares rescale both sides; huge literal scales
+                    # would overflow int64 — treat as float like MySQL double
+                    return Literal(type_=FLOAT64, value=float(t))
+                return Literal(
+                    type_=decimal_type(18, scale), value=decimal_to_scaled(t, scale)
+                )
+            if t.lower().startswith("0x"):
+                return Literal(type_=INT64, value=int(t, 16))
+            return Literal(type_=INT64, value=int(t))
+        if isinstance(e, A.EStr):
+            # bare string literal: kept as python str until context decides
+            # (string compare -> code; numeric context -> parsed number)
+            return Literal(type_=STRING, value=e.value)
+        if isinstance(e, A.ENull):
+            return Literal(type_=NULLTYPE, value=None)
+        if isinstance(e, A.EBool):
+            return Literal(type_=BOOL, value=e.value)
+        raise PlanError(f"not a literal: {e}")
+
+    @staticmethod
+    def parse_date_literal(s: str) -> int:
+        return date_to_days(datetime.date.fromisoformat(s.strip()))
+
+    @staticmethod
+    def parse_datetime_literal(s: str) -> int:
+        s = s.strip()
+        try:
+            return datetime_to_micros(datetime.datetime.fromisoformat(s))
+        except ValueError:
+            return datetime_to_micros(
+                datetime.datetime.combine(datetime.date.fromisoformat(s), datetime.time())
+            )
+
+    # ------------------------------------------------------------------
+    # main expression binding
+    # ------------------------------------------------------------------
+
+    def bind_expr(self, e, scope: Scope) -> Expr:
+        if isinstance(e, (A.ENum, A.EStr, A.ENull, A.EBool)):
+            return self.bind_literal(e)
+
+        if isinstance(e, A.EName):
+            pc = scope.resolve(e.name, e.qualifier)
+            return self.attach_dict(pc.ref(), pc.dict_)
+
+        if isinstance(e, A.EUnary):
+            return self.bind_unary(e, scope)
+
+        if isinstance(e, A.EBinary):
+            return self.bind_binary(e.op, e.left, e.right, scope)
+
+        if isinstance(e, A.EIsNull):
+            arg = self.bind_expr(e.arg, scope)
+            op = "is_not_null" if e.negated else "is_null"
+            return Call(type_=BOOL, op=op, args=(arg,))
+
+        if isinstance(e, A.EBetween):
+            lo = A.EBinary(">=", e.arg, e.low)
+            hi = A.EBinary("<=", e.arg, e.high)
+            both = A.EBinary("and", lo, hi)
+            return self.bind_expr(
+                A.EUnary("not", both) if e.negated else both, scope
+            )
+
+        if isinstance(e, A.EIn):
+            if e.subquery is not None:
+                raise UnsupportedError(
+                    "IN (SELECT ...) outside a WHERE conjunct is not supported yet"
+                )
+            return self.bind_in_values(e, scope)
+
+        if isinstance(e, A.ELike):
+            return self.bind_like(e, scope)
+
+        if isinstance(e, A.ECase):
+            return self.bind_case(e, scope)
+
+        if isinstance(e, A.ECast):
+            from tidb_tpu.types import parse_type_name
+
+            arg = self.bind_expr(e.arg, scope)
+            to = parse_type_name(e.type_name, e.type_args)
+            if to.kind == TypeKind.STRING:
+                raise UnsupportedError("CAST to string not supported yet")
+            arg = self.coerce_untyped_literal(arg, to)
+            return Cast(type_=to, arg=arg)
+
+        if isinstance(e, A.EFunc):
+            return self.bind_func(e, scope)
+
+        if isinstance(e, A.EInterval):
+            raise PlanError("INTERVAL only valid next to +/- on a date")
+
+        if isinstance(e, (A.EExists, A.ESubquery)):
+            raise UnsupportedError(
+                "subquery in this position not supported yet (use WHERE conjuncts)"
+            )
+
+        if isinstance(e, A.EVar):
+            raise UnsupportedError("variable reference must be bound by session layer")
+
+        if isinstance(e, A.EStar):
+            raise PlanError("* not valid in this context")
+
+        raise PlanError(f"cannot bind expression {type(e).__name__}")
+
+    # ------------------------------------------------------------------
+
+    def bind_unary(self, e: A.EUnary, scope: Scope) -> Expr:
+        if e.op == "not":
+            arg = self.bind_expr(e.arg, scope)
+            return Call(type_=BOOL, op="not", args=(self.to_bool(arg),))
+        if e.op == "-":
+            arg = self.bind_expr(e.arg, scope)
+            if isinstance(arg, Literal) and arg.value is not None:
+                return Literal(type_=arg.type_, value=-arg.value)
+            return Call(type_=arg.type_, op="neg", args=(arg,))
+        if e.op == "~":
+            raise UnsupportedError("bitwise ~ not supported yet")
+        raise PlanError(f"unknown unary op {e.op}")
+
+    def to_bool(self, arg: Expr) -> Expr:
+        if arg.type_.kind == TypeKind.BOOL or arg.type_.kind == TypeKind.NULL:
+            return arg
+        return Call(type_=BOOL, op="ne", args=(arg, Literal(type_=arg.type_, value=0)))
+
+    # ------------------------------------------------------------------
+
+    _CMP = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+
+    def bind_binary(self, op: str, left_ast, right_ast, scope: Scope) -> Expr:
+        # date +/- INTERVAL
+        if op in ("+", "-") and isinstance(right_ast, A.EInterval):
+            return self.bind_interval_arith(op, left_ast, right_ast, scope)
+        if op == "+" and isinstance(left_ast, A.EInterval):
+            return self.bind_interval_arith(op, right_ast, left_ast, scope)
+
+        if op in ("and", "or", "xor"):
+            l = self.to_bool(self.bind_expr(left_ast, scope))
+            r = self.to_bool(self.bind_expr(right_ast, scope))
+            if op == "xor":
+                return Call(type_=BOOL, op="ne", args=(l, r))
+            return Call(type_=BOOL, op=op, args=(l, r))
+
+        l = self.bind_expr(left_ast, scope)
+        r = self.bind_expr(right_ast, scope)
+
+        if op in self._CMP or op == "<=>":
+            return self.bind_comparison(op, l, r)
+
+        if op in ("+", "-", "*", "/", "div", "mod", "%"):
+            return self.bind_arith(op, l, r)
+
+        if op in ("|", "&"):
+            raise UnsupportedError(f"bitwise {op} not supported yet")
+        raise PlanError(f"unknown binary op {op}")
+
+    def bind_interval_arith(self, op: str, date_ast, interval: A.EInterval, scope: Scope) -> Expr:
+        base = self.bind_expr(date_ast, scope)
+        base = self.coerce_untyped_literal(base, DATE)
+        iv = self.bind_expr(interval.value, scope)
+        if not isinstance(iv, Literal):
+            raise UnsupportedError("non-constant INTERVAL")
+        amount = int(iv.value) if iv.type_.kind != TypeKind.STRING else int(str(iv.value))
+        if op == "-":
+            amount = -amount
+        unit = interval.unit
+        if base.type_.kind == TypeKind.DATE:
+            if isinstance(base, Literal):
+                d = datetime.date.fromordinal(
+                    datetime.date(1970, 1, 1).toordinal() + int(base.value)
+                )
+                return Literal(type_=DATE, value=date_to_days(_add_interval(d, amount, unit)))
+            if unit == "day":
+                return Call(type_=DATE, op="add", args=(base, Literal(type_=DATE, value=amount)))
+            if unit == "week":
+                return Call(type_=DATE, op="add", args=(base, Literal(type_=DATE, value=amount * 7)))
+            raise UnsupportedError(f"INTERVAL {unit} on non-constant date")
+        raise UnsupportedError("INTERVAL on datetime expressions not supported yet")
+
+    # -- comparisons ----------------------------------------------------
+
+    def bind_comparison(self, op: str, l: Expr, r: Expr) -> Expr:
+        lk, rk = l.type_.kind, r.type_.kind
+
+        # untyped string literal meets typed column: coerce literal
+        if lk == TypeKind.STRING and isinstance(l, Literal) and rk != TypeKind.STRING:
+            l = self.coerce_untyped_literal(l, r.type_)
+            lk = l.type_.kind
+        if rk == TypeKind.STRING and isinstance(r, Literal) and lk != TypeKind.STRING:
+            r = self.coerce_untyped_literal(r, l.type_)
+            rk = r.type_.kind
+
+        if lk == TypeKind.STRING or rk == TypeKind.STRING:
+            return self.bind_string_comparison(op, l, r)
+
+        ir_op = {"<=>": "nseq"}.get(op) or self._CMP[op]
+        return Call(type_=BOOL, op=ir_op, args=(l, r))
+
+    def coerce_untyped_literal(self, e: Expr, target: SQLType) -> Expr:
+        """A string Literal meeting a typed context parses into that type."""
+        if not (isinstance(e, Literal) and e.type_.kind == TypeKind.STRING):
+            return e
+        s = str(e.value)
+        k = target.kind
+        if k == TypeKind.DATE:
+            return Literal(type_=DATE, value=self.parse_date_literal(s))
+        if k == TypeKind.DATETIME:
+            return Literal(type_=DATETIME, value=self.parse_datetime_literal(s))
+        if k == TypeKind.DECIMAL:
+            return Literal(type_=target, value=decimal_to_scaled(s, target.scale))
+        if k == TypeKind.INT:
+            return Literal(type_=INT64, value=int(float(s)))
+        if k == TypeKind.FLOAT:
+            return Literal(type_=FLOAT64, value=float(s))
+        if k == TypeKind.BOOL:
+            return Literal(type_=BOOL, value=bool(float(s)))
+        return e
+
+    def _dict_of(self, e: Expr) -> Optional[Dictionary]:
+        return getattr(e, "_dict", None)
+
+    def attach_dict(self, e: Expr, d: Optional[Dictionary]) -> Expr:
+        if d is not None:
+            object.__setattr__(e, "_dict", d)
+        return e
+
+    def bind_string_comparison(self, op: str, l: Expr, r: Expr) -> Expr:
+        ld, rd = self._dict_of(l), self._dict_of(r)
+
+        # literal vs column: host-side code lookup
+        if isinstance(r, Literal) and r.type_.kind == TypeKind.STRING and ld is not None:
+            return self._string_col_vs_literal(op, l, ld, str(r.value))
+        if isinstance(l, Literal) and l.type_.kind == TypeKind.STRING and rd is not None:
+            flipped = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+            base = self._CMP.get(op, "nseq" if op == "<=>" else None)
+            base = flipped.get(base, base)
+            return self._string_col_vs_literal_op(base, r, rd, str(l.value))
+
+        # column vs column
+        if ld is not None and rd is not None:
+            ir_op = {"<=>": "nseq"}.get(op) or self._CMP[op]
+            if ld == rd:
+                return Call(type_=BOOL, op=ir_op, args=(l, r))
+            union = Dictionary.union(ld, rd)
+            lt = Lookup.build(l, ld.translate_to(union).astype(np.int32), STRING)
+            rt = Lookup.build(r, rd.translate_to(union).astype(np.int32), STRING)
+            return Call(type_=BOOL, op=ir_op, args=(lt, rt))
+
+        # literal vs literal
+        if isinstance(l, Literal) and isinstance(r, Literal):
+            a, b = str(l.value), str(r.value)
+            res = {
+                "=": a == b, "<>": a != b, "<": a < b, "<=": a <= b,
+                ">": a > b, ">=": a >= b, "<=>": a == b,
+            }[op]
+            return Literal(type_=BOOL, value=res)
+
+        raise UnsupportedError("string comparison without dictionary context")
+
+    def _string_col_vs_literal(self, op: str, col: Expr, d: Dictionary, s: str) -> Expr:
+        return self._string_col_vs_literal_op(
+            {"<=>": "nseq"}.get(op) or self._CMP[op], col, d, s
+        )
+
+    def _string_col_vs_literal_op(self, ir_op: str, col: Expr, d: Dictionary, s: str) -> Expr:
+        i32 = STRING  # codes are int32; compare as ints
+        if ir_op in ("eq", "nseq"):
+            code = d.code_of(s)
+            if code < 0:
+                if ir_op == "nseq":
+                    return Literal(type_=BOOL, value=False)
+                # col = 'absent': FALSE for non-null, NULL for null
+                return Call(type_=BOOL, op="ne", args=(col, col))
+            return Call(type_=BOOL, op=ir_op, args=(col, Literal(type_=i32, value=code)))
+        if ir_op == "ne":
+            code = d.code_of(s)
+            if code < 0:
+                return Call(type_=BOOL, op="eq", args=(col, col))  # TRUE/NULL
+            return Call(type_=BOOL, op="ne", args=(col, Literal(type_=i32, value=code)))
+        if ir_op == "lt":
+            return Call(type_=BOOL, op="lt", args=(col, Literal(type_=i32, value=d.lower_bound(s))))
+        if ir_op == "le":
+            return Call(type_=BOOL, op="lt", args=(col, Literal(type_=i32, value=d.upper_bound(s))))
+        if ir_op == "ge":
+            return Call(type_=BOOL, op="ge", args=(col, Literal(type_=i32, value=d.lower_bound(s))))
+        if ir_op == "gt":
+            return Call(type_=BOOL, op="ge", args=(col, Literal(type_=i32, value=d.upper_bound(s))))
+        raise PlanError(f"bad string op {ir_op}")
+
+    # -- arithmetic -----------------------------------------------------
+
+    def bind_arith(self, op: str, l: Expr, r: Expr) -> Expr:
+        # untyped string literals in numeric context parse as numbers
+        if isinstance(l, Literal) and l.type_.kind == TypeKind.STRING:
+            l = self.coerce_untyped_literal(l, FLOAT64)
+        if isinstance(r, Literal) and r.type_.kind == TypeKind.STRING:
+            r = self.coerce_untyped_literal(r, FLOAT64)
+
+        lt, rt = l.type_, r.type_
+
+        # date arithmetic: date - date -> int days; date + int -> date
+        if lt.kind == TypeKind.DATE and rt.kind == TypeKind.DATE:
+            if op != "-":
+                raise PlanError("only subtraction is defined between dates")
+            return Call(type_=INT64, op="sub", args=(l, r))
+        if lt.kind == TypeKind.DATE and rt.kind == TypeKind.INT:
+            return Call(type_=DATE, op={"+": "add", "-": "sub"}[op], args=(l, r))
+
+        if op == "/":
+            return Call(type_=FLOAT64, op="div", args=(l, r))
+        if op == "div":
+            t = INT64 if lt.kind != TypeKind.FLOAT and rt.kind != TypeKind.FLOAT else FLOAT64
+            return Call(type_=t, op="intdiv", args=(l, r))
+        if op in ("mod", "%"):
+            return Call(type_=common_type(lt, rt), op="mod", args=(l, r))
+
+        ir = {"+": "add", "-": "sub", "*": "mul"}[op]
+        if ir == "mul" and TypeKind.DECIMAL in (lt.kind, rt.kind) and TypeKind.FLOAT not in (lt.kind, rt.kind):
+            s = (lt.scale if lt.kind == TypeKind.DECIMAL else 0) + (
+                rt.scale if rt.kind == TypeKind.DECIMAL else 0
+            )
+            if s > 12:
+                return Call(type_=FLOAT64, op="mul", args=(l, r))
+            return Call(type_=decimal_type(18, s), op="mul", args=(l, r))
+        return Call(type_=common_type(lt, rt), op=ir, args=(l, r))
+
+    # -- IN / LIKE ------------------------------------------------------
+
+    def bind_in_values(self, e: A.EIn, scope: Scope) -> Expr:
+        arg = self.bind_expr(e.arg, scope)
+        d = self._dict_of(arg)
+        vals = []
+        has_null = False
+        for v_ast in e.values:
+            v = self.bind_expr(v_ast, scope)
+            if not isinstance(v, Literal):
+                raise UnsupportedError("non-constant IN list")
+            if v.value is None:
+                has_null = True
+                continue
+            if arg.type_.kind == TypeKind.STRING:
+                if d is None:
+                    raise UnsupportedError("IN on string without dictionary")
+                code = d.code_of(str(v.value))
+                if code >= 0:
+                    vals.append(code)
+            else:
+                v = self.coerce_untyped_literal(v, arg.type_)
+                val = v.value
+                if arg.type_.kind == TypeKind.DECIMAL and v.type_.kind == TypeKind.DECIMAL and v.type_.scale != arg.type_.scale:
+                    val = decimal_to_scaled(
+                        str(val / 10**v.type_.scale), arg.type_.scale
+                    )
+                vals.append(val)
+        base = InList(type_=BOOL, arg=arg, values=tuple(vals), negated=e.negated)
+        if has_null:
+            # x IN (..., NULL) is never FALSE (TRUE or NULL); x NOT IN with a
+            # NULL member is never TRUE — Kleene OR/AND with NULL encodes both.
+            null_lit = Literal(type_=BOOL, value=None)
+            op = "and" if e.negated else "or"
+            return Call(type_=BOOL, op=op, args=(base, null_lit))
+        return base
+
+    def bind_like(self, e: A.ELike, scope: Scope) -> Expr:
+        arg = self.bind_expr(e.arg, scope)
+        d = self._dict_of(arg)
+        pat = self.bind_expr(e.pattern, scope)
+        if not isinstance(pat, Literal):
+            raise UnsupportedError("non-constant LIKE pattern")
+        if d is None:
+            raise UnsupportedError("LIKE on non-string or dictionary-less value")
+        rx = _like_to_regex(str(pat.value), e.escape)
+        lut = d.match_table(lambda s: rx.fullmatch(s) is not None)
+        if e.negated:
+            lut = ~lut
+        return Lookup.build(arg, lut, BOOL)
+
+    # -- CASE -----------------------------------------------------------
+
+    def bind_case(self, e: A.ECase, scope: Scope) -> Expr:
+        whens = []
+        for cond_ast, res_ast in e.whens:
+            if e.operand is not None:
+                cond = self.bind_binary("=", e.operand, cond_ast, scope)
+            else:
+                cond = self.to_bool(self.bind_expr(cond_ast, scope))
+            whens.append((cond, self.bind_expr(res_ast, scope)))
+        else_ = self.bind_expr(e.else_, scope) if e.else_ is not None else None
+        # result type: common type over branches
+        branch_types = [r.type_ for _, r in whens] + ([else_.type_] if else_ else [])
+        rt = branch_types[0]
+        for bt in branch_types[1:]:
+            rt = common_type(rt, bt)
+        if rt.kind == TypeKind.STRING:
+            return self._string_case(whens, else_, rt)
+        out = Case(type_=rt, whens=tuple(whens), else_=else_)
+        return out
+
+    def _string_case(self, whens, else_, rt) -> Expr:
+        """String-valued CASE: unify branch dictionaries/literals into one
+        result Dictionary and rewrite branches to codes in it."""
+        branches = [r for _, r in whens] + ([else_] if else_ is not None else [])
+        values: list = []
+        dicts: list = []
+        for b in branches:
+            d = self._dict_of(b)
+            if d is not None:
+                dicts.append(d)
+            elif isinstance(b, Literal):
+                if b.value is not None:
+                    values.append(str(b.value))
+            else:
+                raise UnsupportedError("string CASE branch without dictionary")
+        union = Dictionary(values)
+        for d in dicts:
+            union = Dictionary.union(union, d)
+
+        def rewrite(b: Expr) -> Expr:
+            d = self._dict_of(b)
+            if d is not None:
+                if d == union:
+                    return b
+                return Lookup.build(b, d.translate_to(union).astype(np.int32), STRING)
+            assert isinstance(b, Literal)
+            if b.value is None:
+                return Literal(type_=STRING, value=None)
+            return Literal(type_=STRING, value=union.code_of(str(b.value)))
+
+        new_whens = tuple((c, rewrite(r)) for c, r in whens)
+        new_else = rewrite(else_) if else_ is not None else None
+        out = Case(type_=rt, whens=new_whens, else_=new_else)
+        return self.attach_dict(out, union)
+
+    # -- scalar functions ----------------------------------------------
+
+    def bind_func(self, e: A.EFunc, scope: Scope) -> Expr:
+        name = e.name
+        if name in AGG_FUNCS:
+            raise PlanError(
+                f"aggregate function {name.upper()} not allowed in this context"
+            )
+
+        if name in ("date",) and len(e.args) == 1 and isinstance(e.args[0], A.EStr):
+            return Literal(type_=DATE, value=self.parse_date_literal(e.args[0].value))
+        if name in ("timestamp", "datetime") and len(e.args) == 1 and isinstance(e.args[0], A.EStr):
+            return Literal(
+                type_=DATETIME, value=self.parse_datetime_literal(e.args[0].value)
+            )
+
+        args = [self.bind_expr(a, scope) for a in e.args]
+
+        if name in ("if",):
+            if len(args) != 3:
+                raise PlanError("IF takes 3 arguments")
+            rt = common_type(args[1].type_, args[2].type_)
+            return Call(type_=rt, op="if", args=(self.to_bool(args[0]), args[1], args[2]))
+        if name == "ifnull":
+            rt = common_type(args[0].type_, args[1].type_)
+            return Call(type_=rt, op="ifnull", args=tuple(args))
+        if name == "nullif":
+            return Call(type_=args[0].type_, op="nullif", args=tuple(args))
+        if name == "coalesce":
+            rt = args[0].type_
+            for a in args[1:]:
+                rt = common_type(rt, a.type_)
+            return Call(type_=rt, op="coalesce", args=tuple(args))
+
+        if name in ("year", "month", "day", "dayofmonth"):
+            op = {"dayofmonth": "day"}.get(name, name)
+            a = self.coerce_untyped_literal(args[0], DATE)
+            if not a.type_.is_temporal:
+                raise PlanError(f"{name.upper()} needs a date/datetime argument")
+            if isinstance(a, Literal):
+                days = int(a.value)
+                if a.type_.kind == TypeKind.DATETIME:
+                    days = days // 86_400_000_000  # micros -> days
+                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+                return Literal(type_=INT64, value={"year": d.year, "month": d.month, "day": d.day}[op])
+            return Call(type_=INT64, op=op, args=(a,))
+        if name in ("datediff",):
+            a = self.coerce_untyped_literal(args[0], DATE)
+            b = self.coerce_untyped_literal(args[1], DATE)
+            return Call(type_=INT64, op="sub", args=(a, b))
+        if name in ("date_add", "adddate", "date_sub", "subdate"):
+            raise UnsupportedError(f"{name} — use +/- INTERVAL syntax")
+
+        if name in ("abs",):
+            return Call(type_=args[0].type_, op="abs", args=tuple(args))
+        if name in ("ceil", "ceiling", "floor"):
+            op = {"ceiling": "ceil"}.get(name, name)
+            return Call(type_=FLOAT64, op=op, args=tuple(args))
+        if name in ("sqrt", "exp", "ln", "log2", "log10", "sin", "cos"):
+            return Call(type_=FLOAT64, op=name, args=tuple(args))
+        if name in ("log",):
+            return Call(type_=FLOAT64, op="ln", args=tuple(args))
+        if name in ("power", "pow"):
+            return Call(type_=FLOAT64, op="pow", args=tuple(args))
+        if name in ("round", "truncate"):
+            rt = args[0].type_
+            if rt.kind == TypeKind.DECIMAL:
+                nd = int(args[1].value) if len(args) > 1 else 0
+                rt = decimal_type(rt.precision, max(0, min(rt.scale, nd)))
+            op = "truncate" if name == "truncate" else "round"
+            return Call(type_=rt if rt.kind != TypeKind.INT else INT64, op=op, args=tuple(args))
+        if name in ("mod",):
+            return Call(
+                type_=common_type(args[0].type_, args[1].type_), op="mod", args=tuple(args)
+            )
+        if name in ("greatest", "least"):
+            raise UnsupportedError(f"{name} not supported yet")
+
+        # string functions via dictionary LUTs
+        if name in _STRING_VALUE_FUNCS:
+            return self.bind_string_func(name, e, args)
+
+        raise UnsupportedError(f"function {name.upper()} not supported yet")
+
+    def bind_string_func(self, name: str, e: A.EFunc, args: List[Expr]) -> Expr:
+        arg = args[0]
+        d = self._dict_of(arg)
+        if d is None:
+            if isinstance(arg, Literal) and arg.type_.kind == TypeKind.STRING:
+                # fold over the literal host-side
+                val = _apply_string_func(name, str(arg.value), e, args)
+                t = INT64 if name in ("length", "char_length", "character_length") else STRING
+                return Literal(type_=t, value=val)
+            raise UnsupportedError(f"{name} on dictionary-less string")
+        if name in ("length", "char_length", "character_length"):
+            lut = d.apply_table(len, np.int64)
+            return Lookup.build(arg, lut, INT64)
+        # string->string: build the target dictionary
+        mapped = [_apply_string_func(name, s, e, args) for s in d.values]
+        nd = Dictionary(mapped)
+        table = np.array([nd.code_of(m) for m in mapped], dtype=np.int32)
+        out = Lookup.build(arg, table, STRING)
+        return self.attach_dict(out, nd)
+
+
+_STRING_VALUE_FUNCS = {
+    "length", "char_length", "character_length", "upper", "ucase", "lower",
+    "lcase", "trim", "ltrim", "rtrim", "substring", "substr", "left",
+    "right", "reverse", "concat", "replace",
+}
+
+
+def _apply_string_func(name: str, s: str, e: A.EFunc, args: List[Expr]) -> str:
+    if name in ("length", "char_length", "character_length"):
+        return len(s)
+    if name in ("upper", "ucase"):
+        return s.upper()
+    if name in ("lower", "lcase"):
+        return s.lower()
+    if name == "trim":
+        return s.strip()
+    if name == "ltrim":
+        return s.lstrip()
+    if name == "rtrim":
+        return s.rstrip()
+    if name == "reverse":
+        return s[::-1]
+    if name in ("substring", "substr"):
+        if len(args) < 2 or not all(isinstance(a, Literal) for a in args[1:]):
+            raise UnsupportedError("SUBSTRING needs constant positions")
+        start = int(args[1].value)
+        start = start - 1 if start > 0 else len(s) + start
+        if len(args) > 2:
+            return s[start : start + int(args[2].value)]
+        return s[start:]
+    if name == "left":
+        return s[: int(args[1].value)]
+    if name == "right":
+        return s[-int(args[1].value):] if int(args[1].value) else ""
+    if name == "concat":
+        parts = [s]
+        for a in args[1:]:
+            if not (isinstance(a, Literal) and a.type_.kind == TypeKind.STRING):
+                raise UnsupportedError("CONCAT of two columns not supported yet")
+            parts.append(str(a.value))
+        return "".join(parts)
+    if name == "replace":
+        if not all(isinstance(a, Literal) for a in args[1:]):
+            raise UnsupportedError("REPLACE needs constant arguments")
+        return s.replace(str(args[1].value), str(args[2].value))
+    raise UnsupportedError(f"string function {name}")
+
+
+def _add_interval(d: datetime.date, amount: int, unit: str) -> datetime.date:
+    if unit == "day":
+        return d + datetime.timedelta(days=amount)
+    if unit == "week":
+        return d + datetime.timedelta(weeks=amount)
+    if unit == "month":
+        m = d.month - 1 + amount
+        y = d.year + m // 12
+        m = m % 12 + 1
+        import calendar
+
+        return datetime.date(y, m, min(d.day, calendar.monthrange(y, m)[1]))
+    if unit == "year":
+        import calendar
+
+        y = d.year + amount
+        return datetime.date(y, d.month, min(d.day, calendar.monthrange(y, d.month)[1]))
+    raise UnsupportedError(f"INTERVAL unit {unit}")
+
+
+def _like_to_regex(pattern: str, escape: Optional[str]) -> "re.Pattern":
+    esc = escape or "\\"
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("".join(out), re.DOTALL)

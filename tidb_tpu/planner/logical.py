@@ -1,0 +1,756 @@
+"""Logical plan nodes and the AST -> logical-plan builder
+(ref: planner/core PlanBuilder + logical operators).
+
+Subquery strategy (round 1): uncorrelated IN-subqueries in WHERE conjuncts
+become semi/anti joins (the decorrelation the reference's planner does);
+uncorrelated EXISTS and scalar subqueries are evaluated eagerly through a
+session-provided callback and folded to constants (the reference likewise
+evaluates "max-one-row" subqueries at optimize time). Correlated
+subqueries raise UnsupportedError.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tidb_tpu.errors import PlanError, SchemaError, UnsupportedError
+from tidb_tpu.expression.expr import Call, ColumnRef, Expr, Literal, Lookup, walk
+from tidb_tpu.chunk.dictionary import Dictionary
+from tidb_tpu.parser import ast as A
+from tidb_tpu.planner.binder import AGG_FUNCS, Binder, PlanCol, Scope, ast_key
+from tidb_tpu.types import (
+    BOOL,
+    FLOAT64,
+    INT64,
+    STRING,
+    SQLType,
+    TypeKind,
+    common_type,
+    decimal_type,
+)
+
+__all__ = [
+    "LogicalPlan", "LScan", "LSelection", "LProjection", "LAggregate",
+    "AggSpec", "LJoin", "LSort", "LLimit", "LUnion", "build_select",
+    "BuildContext", "expr_display",
+]
+
+
+# ---------------------------------------------------------------------------
+# nodes
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LogicalPlan:
+    schema: List[PlanCol] = field(default_factory=list)
+    children: List["LogicalPlan"] = field(default_factory=list)
+
+    @property
+    def child(self) -> "LogicalPlan":
+        return self.children[0]
+
+
+@dataclass
+class LScan(LogicalPlan):
+    db: str = ""
+    table_name: str = ""
+    table: object = None  # storage.Table
+    # predicate pushed into the scan fragment (the coprocessor analogue)
+    pushed_cond: Optional[Expr] = None
+
+
+@dataclass
+class LSelection(LogicalPlan):
+    cond: Expr = None
+
+
+@dataclass
+class LProjection(LogicalPlan):
+    exprs: List[Expr] = field(default_factory=list)
+    n_visible: Optional[int] = None  # hidden ORDER BY helper columns follow
+
+
+@dataclass
+class AggSpec:
+    uid: str
+    func: str            # sum | count | avg | min | max
+    arg: Optional[Expr]  # None for COUNT(*)
+    distinct: bool = False
+    type_: SQLType = INT64
+
+
+@dataclass
+class LAggregate(LogicalPlan):
+    group_exprs: List[Expr] = field(default_factory=list)  # over child schema
+    group_uids: List[str] = field(default_factory=list)
+    aggs: List[AggSpec] = field(default_factory=list)
+
+
+@dataclass
+class LJoin(LogicalPlan):
+    kind: str = "inner"  # inner | left | semi | anti | cross
+    # equi conditions as (left_expr, right_expr) over the resp. child schemas
+    eq_conds: List[Tuple[Expr, Expr]] = field(default_factory=list)
+    other_cond: Optional[Expr] = None
+
+
+@dataclass
+class LSort(LogicalPlan):
+    items: List[Tuple[Expr, bool]] = field(default_factory=list)  # (expr, desc)
+
+
+@dataclass
+class LLimit(LogicalPlan):
+    count: int = 0
+    offset: int = 0
+
+
+@dataclass
+class LUnion(LogicalPlan):
+    all: bool = False
+
+
+# ---------------------------------------------------------------------------
+# display helper (EXPLAIN / auto column names)
+# ---------------------------------------------------------------------------
+
+def expr_display(e) -> str:
+    """Reconstruct readable SQL-ish text from an AST expression."""
+    if isinstance(e, A.EName):
+        return f"{e.qualifier}.{e.name}" if e.qualifier else e.name
+    if isinstance(e, A.ENum):
+        return e.text
+    if isinstance(e, A.EStr):
+        return f"'{e.value}'"
+    if isinstance(e, A.ENull):
+        return "NULL"
+    if isinstance(e, A.EBool):
+        return "TRUE" if e.value else "FALSE"
+    if isinstance(e, A.EStar):
+        return f"{e.qualifier}.*" if e.qualifier else "*"
+    if isinstance(e, A.EBinary):
+        return f"{expr_display(e.left)} {e.op} {expr_display(e.right)}"
+    if isinstance(e, A.EUnary):
+        return f"{e.op} {expr_display(e.arg)}"
+    if isinstance(e, A.EFunc):
+        inner = ", ".join(expr_display(a) for a in e.args)
+        if e.distinct:
+            inner = "distinct " + inner
+        return f"{e.name}({inner})"
+    if isinstance(e, A.ECase):
+        return "case ... end"
+    if isinstance(e, A.ECast):
+        return f"cast({expr_display(e.arg)} as {e.type_name})"
+    if isinstance(e, A.EIn):
+        return f"{expr_display(e.arg)} in (...)"
+    if isinstance(e, A.EBetween):
+        return f"{expr_display(e.arg)} between ..."
+    if isinstance(e, A.ELike):
+        return f"{expr_display(e.arg)} like {expr_display(e.pattern)}"
+    if isinstance(e, A.EIsNull):
+        return f"{expr_display(e.arg)} is {'not ' if e.negated else ''}null"
+    if isinstance(e, (A.EExists,)):
+        return "exists(...)"
+    if isinstance(e, (A.ESubquery,)):
+        return "(subquery)"
+    if isinstance(e, A.EInterval):
+        return f"interval {expr_display(e.value)} {e.unit}"
+    return type(e).__name__
+
+
+# ---------------------------------------------------------------------------
+# build context
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BuildContext:
+    catalog: object
+    db: str = "test"
+    binder: Binder = field(default_factory=Binder)
+    # session-provided: execute a logical plan, return list of row tuples of
+    # python values in device repr (used for scalar/EXISTS subqueries)
+    execute_subplan: Optional[Callable] = None
+    ctes: Dict[str, object] = field(default_factory=dict)  # name -> AST select
+
+
+def _conjuncts(e) -> List:
+    if isinstance(e, A.EBinary) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _and_ir(parts: List[Expr]) -> Optional[Expr]:
+    out = None
+    for p in parts:
+        out = p if out is None else Call(type_=BOOL, op="and", args=(out, p))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# FROM clause
+# ---------------------------------------------------------------------------
+
+def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalPlan, Scope]:
+    if src is None:
+        # SELECT without FROM: one-row dual table
+        return LScan(schema=[], db=ctx.db, table_name="", table=None), Scope([], outer)
+
+    if isinstance(src, A.TableName):
+        alias = src.alias or src.name
+        if src.name in ctx.ctes and src.schema is None:
+            sub = build_select(ctx.ctes[src.name], ctx, outer)
+            cols = [
+                dataclasses.replace(c, qualifier=alias) for c in sub.schema
+            ]
+            sub = _realias(sub, cols)
+            return sub, Scope(cols, outer)
+        db = src.schema or ctx.db
+        table = ctx.catalog.table(db, src.name)
+        cols = [
+            PlanCol(
+                uid=ctx.binder.new_uid(f"{src.name}.{c.name}"),
+                name=c.name,
+                type_=c.type_,
+                qualifier=alias,
+                dict_=table.dicts.get(c.name),
+            )
+            for c in table.schema.columns
+        ]
+        return (
+            LScan(schema=cols, db=db, table_name=src.name, table=table),
+            Scope(cols, outer),
+        )
+
+    if isinstance(src, A.SubqueryTable):
+        sub = build_select(src.select, ctx, outer)
+        cols = [dataclasses.replace(c, qualifier=src.alias) for c in sub.schema]
+        sub = _realias(sub, cols)
+        return sub, Scope(cols, outer)
+
+    if isinstance(src, A.Join):
+        if src.kind == "full":
+            raise UnsupportedError("FULL OUTER JOIN not supported yet")
+        left, lscope = build_from(src.left, ctx, outer)
+        right, rscope = build_from(src.right, ctx, outer)
+        if src.kind == "right":
+            left, right = right, left
+            lscope, rscope = rscope, lscope
+            kind = "left"
+        else:
+            kind = src.kind
+        combined = Scope(lscope.cols + rscope.cols, outer)
+        eq, other = [], []
+        cond_asts = []
+        if src.on is not None:
+            cond_asts = _conjuncts(src.on)
+        elif src.using:
+            for name in src.using:
+                cond_asts.append(
+                    A.EBinary("=", A.EName(name, _qual_of(lscope, name)),
+                              A.EName(name, _qual_of(rscope, name)))
+                )
+        left_uids = {c.uid for c in lscope.cols}
+        right_uids = {c.uid for c in rscope.cols}
+        for cast_ in cond_asts:
+            bound = ctx.binder.bind_expr(cast_, combined)
+            side = _classify_eq(bound, left_uids, right_uids)
+            if side == "lr":
+                eq.append((bound.args[0], bound.args[1]))
+            elif side == "rl":
+                eq.append((bound.args[1], bound.args[0]))
+            else:
+                other.append(bound)
+        join = LJoin(
+            schema=lscope.cols + rscope.cols,
+            children=[left, right],
+            kind=kind,
+            eq_conds=eq,
+            other_cond=_and_ir(other),
+        )
+        if kind == "left":
+            # right-side columns become nullable — semantics only, repr same
+            pass
+        return join, combined
+
+    raise PlanError(f"unknown FROM source {type(src).__name__}")
+
+
+def _qual_of(scope: Scope, name: str) -> Optional[str]:
+    c = scope.try_resolve(name, None)
+    return c.qualifier if c else None
+
+
+def _classify_eq(bound: Expr, left_uids, right_uids) -> Optional[str]:
+    if not (isinstance(bound, Call) and bound.op == "eq"):
+        return None
+    a, b = bound.args
+    ua = {n.name for n in walk(a) if isinstance(n, ColumnRef)}
+    ub = {n.name for n in walk(b) if isinstance(n, ColumnRef)}
+    if ua and ub:
+        if ua <= left_uids and ub <= right_uids:
+            return "lr"
+        if ua <= right_uids and ub <= left_uids:
+            return "rl"
+    return None
+
+
+def _realias(plan: LogicalPlan, cols: List[PlanCol]) -> LogicalPlan:
+    """Wrap a subplan so its schema carries new qualifiers (same uids)."""
+    plan.schema = cols
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# aggregate extraction
+# ---------------------------------------------------------------------------
+
+def _collect_agg_calls(e, out: Dict[str, A.EFunc]):
+    if isinstance(e, A.EFunc) and e.name in AGG_FUNCS:
+        out.setdefault(ast_key(e), e)
+        return  # no nested aggregates
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, list):
+            for x in v:
+                if hasattr(x, "__dataclass_fields__"):
+                    _collect_agg_calls(x, out)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if hasattr(y, "__dataclass_fields__"):
+                            _collect_agg_calls(y, out)
+        elif hasattr(v, "__dataclass_fields__") and not isinstance(v, (A.SelectStmt, A.UnionStmt)):
+            _collect_agg_calls(v, out)
+
+
+def _substitute(e, mapping: Dict[str, str]):
+    """Replace AST subtrees (by structural key) with EName(uid) references."""
+    k = ast_key(e)
+    if k in mapping:
+        return A.EName(mapping[k])
+    if not hasattr(e, "__dataclass_fields__"):
+        return e
+    if isinstance(e, (A.SelectStmt, A.UnionStmt)):
+        return e
+    kwargs = {}
+    for f in e.__dataclass_fields__:
+        v = getattr(e, f)
+        if isinstance(v, list):
+            kwargs[f] = [
+                tuple(_substitute(y, mapping) for y in x) if isinstance(x, tuple)
+                else _substitute(x, mapping) if hasattr(x, "__dataclass_fields__")
+                else x
+                for x in v
+            ]
+        elif hasattr(v, "__dataclass_fields__") and not isinstance(v, (A.SelectStmt, A.UnionStmt)):
+            kwargs[f] = _substitute(v, mapping)
+        else:
+            kwargs[f] = v
+    return type(e)(**kwargs)
+
+
+def _agg_result_type(func: str, arg: Optional[Expr]) -> SQLType:
+    if func == "count":
+        return INT64
+    if func == "avg":
+        return FLOAT64
+    if func in ("min", "max"):
+        return arg.type_
+    # sum
+    k = arg.type_.kind
+    if k == TypeKind.DECIMAL:
+        return decimal_type(18, arg.type_.scale)
+    if k == TypeKind.FLOAT:
+        return FLOAT64
+    return INT64
+
+
+# ---------------------------------------------------------------------------
+# SELECT builder
+# ---------------------------------------------------------------------------
+
+def build_select(stmt, ctx: BuildContext, outer: Optional[Scope] = None) -> LogicalPlan:
+    if isinstance(stmt, A.UnionStmt):
+        return _build_union(stmt, ctx, outer)
+    assert isinstance(stmt, A.SelectStmt)
+
+    # CTEs visible in this select (inlined on reference)
+    old_ctes = dict(ctx.ctes)
+    for cte in stmt.ctes:
+        if cte.columns:
+            raise UnsupportedError("CTE column lists not supported yet")
+        ctx.ctes[cte.name] = cte.select
+    try:
+        return _build_select_core(stmt, ctx, outer)
+    finally:
+        ctx.ctes = old_ctes
+
+
+def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalPlan:
+    binder = ctx.binder
+    plan, scope = build_from(stmt.from_, ctx, outer)
+
+    # ---- WHERE: subquery conjuncts become joins/gates ----
+    if stmt.where is not None:
+        plain = []
+        for conj in _conjuncts(stmt.where):
+            conj = _fold_subqueries(conj, ctx, scope)
+            if isinstance(conj, A.EIn) and conj.subquery is not None:
+                plan, scope = _in_subquery_to_join(conj, plan, scope, ctx)
+            elif isinstance(conj, A.EExists):
+                val = _exists_value(conj, ctx, scope)
+                plain.append(A.EBool(val))
+            else:
+                plain.append(conj)
+        if plain:
+            cond = _and_ir([binder.bind_expr(c, scope) for c in plain])
+            plan = LSelection(schema=plan.schema, children=[plan], cond=cond)
+
+    # ---- aggregate detection ----
+    agg_calls: Dict[str, A.EFunc] = {}
+    for item in stmt.items:
+        _collect_agg_calls(item.expr, agg_calls)
+    if stmt.having is not None:
+        _collect_agg_calls(stmt.having, agg_calls)
+    for oi in stmt.order_by:
+        _collect_agg_calls(oi.expr, agg_calls)
+
+    has_agg = bool(agg_calls) or bool(stmt.group_by)
+    alias_map = {
+        item.alias.lower(): item.expr for item in stmt.items if item.alias
+    }
+
+    post_scope = scope
+    if has_agg:
+        plan, post_scope, mapping = _build_aggregate(stmt, plan, scope, ctx, agg_calls, alias_map)
+    else:
+        mapping = {}
+
+    # ---- HAVING ----
+    if stmt.having is not None:
+        if not has_agg:
+            raise PlanError("HAVING without aggregation")
+        h_ast = _substitute(stmt.having, mapping)
+        cond = binder.bind_expr(h_ast, post_scope)
+        plan = LSelection(schema=plan.schema, children=[plan], cond=cond)
+
+    # ---- SELECT items ----
+    items: List[Tuple[str, object]] = []  # (display name, ast)
+    for item in stmt.items:
+        if isinstance(item.expr, A.EStar):
+            src_scope = scope if not has_agg else None
+            if src_scope is None:
+                raise PlanError("SELECT * with GROUP BY requires explicit columns")
+            for c in src_scope.cols:
+                if item.expr.qualifier and (c.qualifier or "").lower() != item.expr.qualifier.lower():
+                    continue
+                items.append((c.name, A.EName(c.name, c.qualifier)))
+            if not items:
+                raise PlanError("* expanded to nothing")
+        else:
+            name = item.alias or expr_display(item.expr)
+            items.append((name, _substitute(item.expr, mapping) if has_agg else item.expr))
+
+    proj_exprs: List[Expr] = []
+    proj_cols: List[PlanCol] = []
+    for name, ast_e in items:
+        bound = binder.bind_expr(ast_e, post_scope)
+        uid = binder.new_uid(name)
+        proj_exprs.append(bound)
+        proj_cols.append(
+            PlanCol(uid=uid, name=name, type_=bound.type_, qualifier=None,
+                    dict_=getattr(bound, "_dict", None))
+        )
+    n_visible = len(proj_cols)
+
+    # ---- ORDER BY (may add hidden projection columns) ----
+    sort_items: List[Tuple[Expr, bool]] = []
+    if stmt.order_by:
+        by_alias = {c.name.lower(): i for i, c in enumerate(proj_cols)}
+        for oi in stmt.order_by:
+            target_idx = None
+            if isinstance(oi.expr, A.ENum) and "." not in oi.expr.text:
+                pos = int(oi.expr.text)
+                if not 1 <= pos <= n_visible:
+                    raise PlanError(f"ORDER BY position {pos} out of range")
+                target_idx = pos - 1
+            elif isinstance(oi.expr, A.EName) and oi.expr.qualifier is None and oi.expr.name.lower() in by_alias:
+                target_idx = by_alias[oi.expr.name.lower()]
+            if target_idx is not None:
+                pc = proj_cols[target_idx]
+                sort_items.append((ColumnRef(type_=pc.type_, name=pc.uid), oi.desc))
+                continue
+            ast_e = _substitute(oi.expr, mapping) if has_agg else oi.expr
+            bound = binder.bind_expr(ast_e, post_scope)
+            uid = binder.new_uid("sort")
+            proj_exprs.append(bound)
+            proj_cols.append(PlanCol(uid=uid, name=uid, type_=bound.type_,
+                                     dict_=getattr(bound, "_dict", None)))
+            sort_items.append((ColumnRef(type_=bound.type_, name=uid), oi.desc))
+
+    plan = LProjection(
+        schema=proj_cols, children=[plan], exprs=proj_exprs, n_visible=n_visible
+    )
+
+    # ---- DISTINCT ----
+    if stmt.distinct:
+        if len(proj_cols) != n_visible:
+            raise UnsupportedError("DISTINCT with ORDER BY on hidden columns")
+        plan = LAggregate(
+            schema=list(proj_cols),
+            children=[plan],
+            group_exprs=[c.ref() for c in proj_cols],
+            group_uids=[c.uid for c in proj_cols],
+            aggs=[],
+        )
+
+    if sort_items:
+        plan = LSort(schema=plan.schema, children=[plan], items=sort_items)
+
+    if stmt.limit is not None:
+        plan = LLimit(
+            schema=plan.schema, children=[plan],
+            count=stmt.limit, offset=stmt.offset or 0,
+        )
+    return plan
+
+
+def _build_aggregate(stmt, plan, scope, ctx, agg_calls, alias_map):
+    binder = ctx.binder
+    mapping: Dict[str, str] = {}
+    group_exprs: List[Expr] = []
+    group_uids: List[str] = []
+    group_cols: List[PlanCol] = []
+
+    for g_ast in stmt.group_by:
+        # ordinal / alias resolution
+        if isinstance(g_ast, A.ENum) and "." not in g_ast.text:
+            pos = int(g_ast.text)
+            if not 1 <= pos <= len(stmt.items):
+                raise PlanError(f"GROUP BY position {pos} out of range")
+            g_ast = stmt.items[pos - 1].expr
+        elif (
+            isinstance(g_ast, A.EName)
+            and g_ast.qualifier is None
+            and g_ast.name.lower() in alias_map
+            and scope.try_resolve(g_ast.name, None) is None
+        ):
+            g_ast = alias_map[g_ast.name.lower()]
+        bound = binder.bind_expr(g_ast, scope)
+        uid = binder.new_uid("group")
+        mapping[ast_key(g_ast)] = uid
+        group_exprs.append(bound)
+        group_uids.append(uid)
+        name = expr_display(g_ast)
+        if isinstance(g_ast, A.EName):
+            name = g_ast.name
+        group_cols.append(
+            PlanCol(uid=uid, name=name, type_=bound.type_,
+                    dict_=getattr(bound, "_dict", None))
+        )
+
+    aggs: List[AggSpec] = []
+    agg_cols: List[PlanCol] = []
+    for key, call in agg_calls.items():
+        if key in mapping:
+            continue
+        func = call.name
+        if func == "count" and (not call.args or isinstance(call.args[0], A.EStar)):
+            arg = None
+        else:
+            if len(call.args) != 1:
+                raise UnsupportedError(f"{func.upper()} with {len(call.args)} args")
+            arg = binder.bind_expr(call.args[0], scope)
+        t = _agg_result_type(func, arg)
+        uid = binder.new_uid(func)
+        mapping[key] = uid
+        aggs.append(AggSpec(uid=uid, func=func, arg=arg, distinct=call.distinct, type_=t))
+        agg_cols.append(
+            PlanCol(uid=uid, name=expr_display(call), type_=t,
+                    dict_=(getattr(arg, "_dict", None) if func in ("min", "max") and arg is not None else None))
+        )
+
+    node = LAggregate(
+        schema=group_cols + agg_cols,
+        children=[plan],
+        group_exprs=group_exprs,
+        group_uids=group_uids,
+        aggs=aggs,
+    )
+    return node, Scope(node.schema, None), mapping
+
+
+# ---------------------------------------------------------------------------
+# subqueries
+# ---------------------------------------------------------------------------
+
+def _fold_subqueries(conj, ctx: BuildContext, scope: Scope):
+    """Replace uncorrelated scalar subqueries (ESubquery) inside an AST
+    conjunct with literal AST nodes by executing them now."""
+    if isinstance(conj, A.ESubquery):
+        rows = _run_subplan(conj.select, ctx, scope)
+        if len(rows) > 1:
+            raise PlanError("scalar subquery returned more than one row")
+        if not rows or rows[0][0] is None:
+            return A.ENull()
+        v = rows[0][0]
+        if isinstance(v, str):
+            return A.EStr(v)
+        if isinstance(v, float):
+            return A.ENum(f"{v:.17e}")  # exponent form binds as FLOAT64
+        return A.ENum(repr(v))
+    if not hasattr(conj, "__dataclass_fields__") or isinstance(conj, (A.SelectStmt, A.UnionStmt)):
+        return conj
+    kwargs = {}
+    for f in conj.__dataclass_fields__:
+        v = getattr(conj, f)
+        if hasattr(v, "__dataclass_fields__") and not isinstance(v, (A.SelectStmt, A.UnionStmt)):
+            kwargs[f] = _fold_subqueries(v, ctx, scope)
+        elif isinstance(v, list):
+            kwargs[f] = [
+                _fold_subqueries(x, ctx, scope) if hasattr(x, "__dataclass_fields__") and not isinstance(x, (A.SelectStmt, A.UnionStmt)) else x
+                for x in v
+            ]
+        else:
+            kwargs[f] = v
+    return type(conj)(**kwargs)
+
+
+def _run_subplan(select_ast, ctx: BuildContext, scope: Scope) -> list:
+    if ctx.execute_subplan is None:
+        raise UnsupportedError("subquery execution not wired (no session)")
+    sub = build_select(select_ast, ctx, scope)  # scope as parent: correlation detection
+    return ctx.execute_subplan(sub)
+
+
+def _exists_value(conj: A.EExists, ctx: BuildContext, scope: Scope) -> bool:
+    limited = dataclasses.replace(conj.subquery) if isinstance(conj.subquery, A.SelectStmt) else conj.subquery
+    if isinstance(limited, A.SelectStmt) and limited.limit is None:
+        limited.limit = 1
+    rows = _run_subplan(limited, ctx, scope)
+    val = bool(rows)
+    return (not val) if conj.negated else val
+
+
+def _in_subquery_to_join(conj: A.EIn, plan, scope, ctx: BuildContext):
+    sub = build_select(conj.subquery, ctx, scope)
+    if len(sub.schema) != 1:
+        raise PlanError("IN subquery must return exactly one column")
+    outer_expr = ctx.binder.bind_expr(conj.arg, scope)
+    inner_col = sub.schema[0]
+    inner_expr: Expr = inner_col.ref()
+
+    # align string dictionaries across the two sides
+    od = getattr(outer_expr, "_dict", None)
+    idd = inner_col.dict_
+    if od is not None or idd is not None:
+        if od is None or idd is None:
+            raise UnsupportedError("IN subquery mixing string and non-string")
+        if od != idd:
+            import numpy as np
+
+            union = Dictionary.union(od, idd)
+            outer_expr = Lookup.build(outer_expr, od.translate_to(union).astype(np.int32), STRING)
+            inner_expr = Lookup.build(inner_expr, idd.translate_to(union).astype(np.int32), STRING)
+
+    kind = "anti" if conj.negated else "semi"
+    join = LJoin(
+        schema=list(plan.schema),  # semi/anti joins keep the outer schema
+        children=[plan, sub],
+        kind=kind,
+        eq_conds=[(outer_expr, inner_expr)],
+    )
+    return join, Scope(join.schema, scope.parent)
+
+
+# ---------------------------------------------------------------------------
+# UNION
+# ---------------------------------------------------------------------------
+
+def _build_union(stmt: A.UnionStmt, ctx: BuildContext, outer) -> LogicalPlan:
+    if stmt.op != "union":
+        raise UnsupportedError(f"{stmt.op.upper()} not supported yet")
+    sides: List[LogicalPlan] = []
+
+    def flatten(s):
+        if isinstance(s, A.UnionStmt) and s.op == "union" and s.all == stmt.all and not s.order_by and s.limit is None:
+            flatten(s.left)
+            flatten(s.right)
+        else:
+            sides.append(build_select(s, ctx, outer))
+
+    flatten(stmt.left)
+    flatten(stmt.right)
+
+    arity = len(sides[0].schema)
+    for s in sides:
+        if len(s.schema) != arity:
+            raise PlanError("UNION arity mismatch")
+
+    # result types + dictionaries per position
+    out_cols: List[PlanCol] = []
+    for i in range(arity):
+        t = sides[0].schema[i].type_
+        for s in sides[1:]:
+            t = common_type(t, s.schema[i].type_)
+        d = None
+        if t.kind == TypeKind.STRING:
+            d = sides[0].schema[i].dict_ or Dictionary([])
+            for s in sides[1:]:
+                d = Dictionary.union(d, s.schema[i].dict_ or Dictionary([]))
+        out_cols.append(
+            PlanCol(uid=ctx.binder.new_uid(f"union.{sides[0].schema[i].name}"),
+                    name=sides[0].schema[i].name, type_=t, dict_=d)
+        )
+
+    # coerce each side through a projection
+    import numpy as np
+    from tidb_tpu.expression.expr import Cast
+
+    coerced = []
+    for s in sides:
+        exprs = []
+        for i, oc in enumerate(out_cols):
+            src = s.schema[i]
+            e: Expr = src.ref()
+            if oc.type_.kind == TypeKind.STRING:
+                sd = src.dict_ or Dictionary([])
+                if sd != oc.dict_:
+                    e = Lookup.build(e, sd.translate_to(oc.dict_).astype(np.int32), STRING)
+            elif src.type_ != oc.type_:
+                e = Cast(type_=oc.type_, arg=e)
+            exprs.append(e)
+        cols = [dataclasses.replace(c) for c in out_cols]
+        coerced.append(LProjection(schema=cols, children=[s], exprs=exprs))
+        # all sides project onto the SAME uids so union is pure concat
+        for c, oc in zip(cols, out_cols):
+            c.uid = oc.uid
+
+    node = LUnion(schema=out_cols, children=coerced, all=stmt.all)
+    if not stmt.all:
+        node = LAggregate(
+            schema=list(out_cols),
+            children=[node],
+            group_exprs=[c.ref() for c in out_cols],
+            group_uids=[c.uid for c in out_cols],
+            aggs=[],
+        )
+
+    plan = node
+    if stmt.order_by:
+        by_alias = {c.name.lower(): c for c in out_cols}
+        items = []
+        for oi in stmt.order_by:
+            if isinstance(oi.expr, A.ENum):
+                pos = int(oi.expr.text)
+                c = out_cols[pos - 1]
+            elif isinstance(oi.expr, A.EName) and oi.expr.name.lower() in by_alias:
+                c = by_alias[oi.expr.name.lower()]
+            else:
+                raise UnsupportedError("UNION ORDER BY must use output columns")
+            items.append((c.ref(), oi.desc))
+        plan = LSort(schema=plan.schema, children=[plan], items=items)
+    if stmt.limit is not None:
+        plan = LLimit(schema=plan.schema, children=[plan], count=stmt.limit, offset=stmt.offset or 0)
+    return plan

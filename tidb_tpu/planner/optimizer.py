@@ -1,0 +1,30 @@
+"""Optimize() entry point (ref: planner.Optimize -> logical rules -> cost
+based physical search; here rules + deterministic lowering)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from tidb_tpu.parser import ast as A
+from tidb_tpu.planner.binder import Binder
+from tidb_tpu.planner.logical import BuildContext, build_select
+from tidb_tpu.planner.physical import PhysicalPlan, lower
+from tidb_tpu.planner.rules import optimize_logical
+
+__all__ = ["plan_statement"]
+
+
+def plan_statement(
+    stmt,
+    catalog,
+    db: str = "test",
+    execute_subplan: Optional[Callable] = None,
+) -> PhysicalPlan:
+    """SELECT/UNION AST -> optimized physical plan."""
+    assert isinstance(stmt, (A.SelectStmt, A.UnionStmt)), type(stmt)
+    ctx = BuildContext(
+        catalog=catalog, db=db, binder=Binder(), execute_subplan=execute_subplan
+    )
+    logical = build_select(stmt, ctx)
+    logical = optimize_logical(logical)
+    return lower(logical)

@@ -1,0 +1,299 @@
+"""Physical plan (ref: planner/core Physical* operators + EXPLAIN).
+
+Lowering is algorithm selection: aggregation picks a device strategy
+(packed-code segment-sum vs generic), joins pick a build side from row
+estimates, Sort+Limit fuses to TopN. Every node is annotated with `task`:
+"device" operators run inside jitted fragments on TPU; "root" operators
+run host-side on materialized (small) results — mirroring the reference's
+coprocessor-vs-root split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from tidb_tpu.planner.binder import PlanCol
+from tidb_tpu.planner.logical import (
+    AggSpec,
+    LAggregate,
+    LJoin,
+    LLimit,
+    LProjection,
+    LScan,
+    LSelection,
+    LSort,
+    LUnion,
+    LogicalPlan,
+)
+
+__all__ = [
+    "PhysicalPlan", "PScan", "PSelection", "PProjection", "PHashAgg",
+    "PHashJoin", "PSort", "PTopN", "PLimit", "PUnion", "lower", "explain_text",
+]
+
+
+@dataclass
+class PhysicalPlan:
+    schema: List[PlanCol] = field(default_factory=list)
+    children: List["PhysicalPlan"] = field(default_factory=list)
+    est_rows: float = 0.0
+    task: str = "device"
+
+    @property
+    def child(self) -> "PhysicalPlan":
+        return self.children[0]
+
+    def op_name(self) -> str:
+        return type(self).__name__[1:]
+
+    def op_info(self) -> str:
+        return ""
+
+
+@dataclass
+class PScan(PhysicalPlan):
+    db: str = ""
+    table_name: str = ""
+    table: object = None
+    pushed_cond: object = None
+
+    def op_name(self):
+        return "TableFullScan"
+
+    def op_info(self):
+        info = f"table:{self.table_name}"
+        if self.pushed_cond is not None:
+            info += ", pushed_filter"
+        return info
+
+
+@dataclass
+class PSelection(PhysicalPlan):
+    cond: object = None
+
+
+@dataclass
+class PProjection(PhysicalPlan):
+    exprs: List = field(default_factory=list)
+    n_visible: Optional[int] = None
+
+
+@dataclass
+class PHashAgg(PhysicalPlan):
+    group_exprs: List = field(default_factory=list)
+    group_uids: List[str] = field(default_factory=list)
+    aggs: List[AggSpec] = field(default_factory=list)
+    strategy: str = "generic"  # "segment" (packed small key space) | "generic"
+
+    def op_name(self):
+        return "HashAgg"
+
+    def op_info(self):
+        funcs = ", ".join(
+            f"{a.func}({'distinct ' if a.distinct else ''}{'*' if a.arg is None else '...'})"
+            for a in self.aggs
+        )
+        return f"group:{len(self.group_exprs)} [{funcs}] strategy:{self.strategy}"
+
+
+@dataclass
+class PHashJoin(PhysicalPlan):
+    kind: str = "inner"
+    eq_left: List = field(default_factory=list)   # exprs over probe child
+    eq_right: List = field(default_factory=list)  # exprs over build child
+    other_cond: object = None
+    build_side: int = 1  # child index used as build side
+
+    def op_name(self):
+        return "HashJoin"
+
+    def op_info(self):
+        return f"{self.kind} join, build:child[{self.build_side}], keys:{len(self.eq_left)}"
+
+
+@dataclass
+class PSort(PhysicalPlan):
+    items: List[Tuple[object, bool]] = field(default_factory=list)
+    task: str = "root"
+
+
+@dataclass
+class PTopN(PhysicalPlan):
+    items: List[Tuple[object, bool]] = field(default_factory=list)
+    count: int = 0
+    offset: int = 0
+    task: str = "root"
+
+    def op_info(self):
+        return f"limit:{self.count} offset:{self.offset}"
+
+
+@dataclass
+class PLimit(PhysicalPlan):
+    count: int = 0
+    offset: int = 0
+    task: str = "root"
+
+
+@dataclass
+class PUnion(PhysicalPlan):
+    all: bool = True
+
+
+# ---------------------------------------------------------------------------
+# row estimation (ref: statistics feeding the cost model; here: live row
+# counts + fixed selectivities — ANALYZE histograms can refine later)
+# ---------------------------------------------------------------------------
+
+_SEL_FILTER = 0.25
+
+
+def _estimate(plan: LogicalPlan) -> float:
+    if isinstance(plan, LScan):
+        n = float(plan.table.live_rows) if plan.table is not None else 1.0
+        if plan.pushed_cond is not None:
+            n *= _SEL_FILTER
+        return max(n, 1.0)
+    if isinstance(plan, LSelection):
+        return max(_estimate(plan.child) * _SEL_FILTER, 1.0)
+    if isinstance(plan, LAggregate):
+        n = _estimate(plan.child)
+        return max(min(n, n ** 0.75), 1.0) if plan.group_exprs else 1.0
+    if isinstance(plan, LJoin):
+        l = _estimate(plan.children[0])
+        r = _estimate(plan.children[1])
+        if plan.kind in ("semi", "anti"):
+            return max(l * 0.5, 1.0)
+        if plan.eq_conds:
+            return max(l, r)
+        return l * r
+    if isinstance(plan, LUnion):
+        return sum(_estimate(c) for c in plan.children)
+    if isinstance(plan, LLimit):
+        return float(plan.count)
+    if plan.children:
+        return _estimate(plan.children[0])
+    return 1.0
+
+
+# packed-code segment aggregation applies when every group key is a dict
+# code or bool with known small cardinality; bound on the packed domain:
+SEGMENT_DOMAIN_LIMIT = 1 << 22  # 4M accumulator slots
+
+
+def _segment_domain(agg: LAggregate) -> Optional[List[int]]:
+    """If all group keys have small known domains, return their sizes."""
+    from tidb_tpu.expression.expr import ColumnRef, Lookup
+    from tidb_tpu.types import TypeKind
+
+    sizes = []
+    child_cols = {c.uid: c for c in agg.child.schema}
+    for g in agg.group_exprs:
+        d = getattr(g, "_dict", None)
+        if d is None and isinstance(g, ColumnRef):
+            c = child_cols.get(g.name)
+            d = c.dict_ if c else None
+        if d is not None:
+            sizes.append(max(len(d), 1))
+        elif g.type_.kind == TypeKind.BOOL:
+            sizes.append(2)
+        else:
+            return None
+    prod = 1
+    for s in sizes:
+        prod *= s
+    if prod == 0 or prod > SEGMENT_DOMAIN_LIMIT:
+        return None
+    return sizes
+
+
+# ---------------------------------------------------------------------------
+# lowering
+# ---------------------------------------------------------------------------
+
+def lower(plan: LogicalPlan) -> PhysicalPlan:
+    est = _estimate(plan)
+
+    if isinstance(plan, LScan):
+        return PScan(
+            schema=plan.schema, est_rows=est, db=plan.db,
+            table_name=plan.table_name, table=plan.table,
+            pushed_cond=plan.pushed_cond,
+        )
+    if isinstance(plan, LSelection):
+        return PSelection(
+            schema=plan.schema, children=[lower(plan.child)], est_rows=est,
+            cond=plan.cond,
+        )
+    if isinstance(plan, LProjection):
+        return PProjection(
+            schema=plan.schema, children=[lower(plan.child)], est_rows=est,
+            exprs=plan.exprs, n_visible=plan.n_visible,
+        )
+    if isinstance(plan, LAggregate):
+        sizes = _segment_domain(plan)
+        has_distinct = any(a.distinct for a in plan.aggs)
+        strategy = "segment" if sizes is not None and not has_distinct else "generic"
+        node = PHashAgg(
+            schema=plan.schema, children=[lower(plan.child)], est_rows=est,
+            group_exprs=plan.group_exprs, group_uids=plan.group_uids,
+            aggs=plan.aggs, strategy=strategy,
+        )
+        if sizes is not None:
+            node.segment_sizes = sizes
+        return node
+    if isinstance(plan, LJoin):
+        l = lower(plan.children[0])
+        r = lower(plan.children[1])
+        eq_l = [lc for lc, _ in plan.eq_conds]
+        eq_r = [rc for _, rc in plan.eq_conds]
+        build = 1
+        if plan.kind == "inner" and l.est_rows < r.est_rows:
+            # probe the bigger side; semi/anti/left must build the inner side
+            build = 0
+        return PHashJoin(
+            schema=plan.schema, children=[l, r], est_rows=est, kind=plan.kind,
+            eq_left=eq_l, eq_right=eq_r, other_cond=plan.other_cond,
+            build_side=build,
+        )
+    if isinstance(plan, LSort):
+        return PSort(schema=plan.schema, children=[lower(plan.child)], est_rows=est, items=plan.items)
+    if isinstance(plan, LLimit):
+        c = lower(plan.child)
+        if isinstance(c, PSort):
+            return PTopN(
+                schema=plan.schema, children=c.children, est_rows=min(est, float(plan.count)),
+                items=c.items, count=plan.count, offset=plan.offset,
+            )
+        return PLimit(schema=plan.schema, children=[c], est_rows=min(est, float(plan.count)), count=plan.count, offset=plan.offset)
+    if isinstance(plan, LUnion):
+        return PUnion(schema=plan.schema, children=[lower(c) for c in plan.children], est_rows=est, all=plan.all)
+
+    raise NotImplementedError(f"lower: {type(plan).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN
+# ---------------------------------------------------------------------------
+
+def explain_text(plan: PhysicalPlan) -> str:
+    """TiDB-style EXPLAIN table: id, estRows, task, operator info."""
+    rows: List[Tuple[str, str, str, str]] = []
+
+    def visit(p: PhysicalPlan, depth: int, last: bool):
+        indent = ""
+        if depth:
+            indent = "  " * (depth - 1) + ("└─" if last else "├─")
+        rows.append((indent + p.op_name(), f"{p.est_rows:.2f}", p.task, p.op_info()))
+        for i, c in enumerate(p.children):
+            visit(c, depth + 1, i == len(p.children) - 1)
+
+    visit(plan, 0, True)
+    w0 = max(len(r[0]) for r in rows) + 2
+    w1 = max(len(r[1]) for r in rows) + 2
+    w2 = max(len(r[2]) for r in rows) + 2
+    lines = [f"{'id':<{w0}}{'estRows':<{w1}}{'task':<{w2}}operator info"]
+    for r in rows:
+        lines.append(f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:<{w2}}{r[3]}")
+    return "\n".join(lines)

@@ -1,0 +1,372 @@
+"""Rule-based logical optimization (ref: planner/core logicalOptimize's
+rule list: constant folding, predicate pushdown, column pruning, ...).
+
+Rules here are functions LogicalPlan -> LogicalPlan, applied in a fixed
+order. The set matters for the TPU backend: pushing predicates into the
+scan means the filter mask is computed inside the same jitted fragment
+that stages the columns (the coprocessor-pushdown analogue), and pruning
+decides which columns get staged to HBM at all.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+import numpy as np
+
+from tidb_tpu.expression.compiler import eval_expr
+from tidb_tpu.expression.expr import (
+    AggRef,
+    Call,
+    Case,
+    Cast,
+    ColumnRef,
+    Expr,
+    InList,
+    Literal,
+    Lookup,
+    walk,
+)
+from tidb_tpu.planner.logical import (
+    AggSpec,
+    LAggregate,
+    LJoin,
+    LLimit,
+    LProjection,
+    LScan,
+    LSelection,
+    LSort,
+    LUnion,
+    LogicalPlan,
+)
+from tidb_tpu.types import BOOL, TypeKind
+
+__all__ = ["optimize_logical", "fold_constants"]
+
+
+# ---------------------------------------------------------------------------
+# constant folding
+# ---------------------------------------------------------------------------
+
+def fold_constants(e: Expr) -> Expr:
+    """Bottom-up folding of all-literal subtrees via the real compiler on a
+    1-row chunk — semantics identical to runtime by construction."""
+    if isinstance(e, Call):
+        args = tuple(fold_constants(a) for a in e.args)
+        e = Call(type_=e.type_, op=e.op, args=args)
+        # Kleene shortcuts with literal TRUE/FALSE
+        if e.op == "and":
+            lits = [a for a in args if isinstance(a, Literal)]
+            if any(a.value is False for a in lits):
+                return Literal(type_=BOOL, value=False)
+            non = [a for a in args if not (isinstance(a, Literal) and a.value is True)]
+            if not non:
+                return Literal(type_=BOOL, value=True)
+            if len(non) == 1 and not any(isinstance(a, Literal) and a.value is None for a in args):
+                return non[0]
+        if e.op == "or":
+            lits = [a for a in args if isinstance(a, Literal)]
+            if any(a.value is True for a in lits):
+                return Literal(type_=BOOL, value=True)
+            non = [a for a in args if not (isinstance(a, Literal) and a.value is False)]
+            if not non:
+                return Literal(type_=BOOL, value=False)
+            if len(non) == 1 and not any(isinstance(a, Literal) and a.value is None for a in args):
+                return non[0]
+        if all(isinstance(a, Literal) for a in args):
+            return _eval_const(e)
+        return e
+    if isinstance(e, Cast):
+        arg = fold_constants(e.arg)
+        e = Cast(type_=e.type_, arg=arg)
+        if isinstance(arg, Literal):
+            return _eval_const(e)
+        return e
+    if isinstance(e, Case):
+        whens = tuple((fold_constants(c), fold_constants(r)) for c, r in e.whens)
+        else_ = fold_constants(e.else_) if e.else_ is not None else None
+        return Case(type_=e.type_, whens=whens, else_=else_)
+    if isinstance(e, Lookup):
+        return Lookup(type_=e.type_, arg=fold_constants(e.arg), table=e.table,
+                      table_valid=e.table_valid)
+    if isinstance(e, InList):
+        return InList(type_=e.type_, arg=fold_constants(e.arg), values=e.values,
+                      negated=e.negated)
+    return e
+
+
+def _eval_const(e: Expr) -> Literal:
+    from tidb_tpu.chunk.chunk import Chunk
+    import jax.numpy as jnp
+
+    dummy = Chunk({}, jnp.ones(1, dtype=jnp.bool_))
+    data, valid = eval_expr(e, dummy)
+    if not bool(np.asarray(valid)[0]):
+        return Literal(type_=e.type_, value=None)
+    v = np.asarray(data)[0]
+    if e.type_.kind == TypeKind.BOOL:
+        return Literal(type_=e.type_, value=bool(v))
+    if e.type_.kind == TypeKind.FLOAT:
+        return Literal(type_=e.type_, value=float(v))
+    return Literal(type_=e.type_, value=int(v))
+
+
+def _rule_fold(plan: LogicalPlan) -> LogicalPlan:
+    for i, c in enumerate(plan.children):
+        plan.children[i] = _rule_fold(c)
+    if isinstance(plan, LSelection):
+        plan.cond = fold_constants(plan.cond)
+        if isinstance(plan.cond, Literal) and plan.cond.value is True:
+            return plan.child
+    elif isinstance(plan, LProjection):
+        plan.exprs = [fold_constants(x) for x in plan.exprs]
+    elif isinstance(plan, LAggregate):
+        plan.group_exprs = [fold_constants(x) for x in plan.group_exprs]
+        for a in plan.aggs:
+            if a.arg is not None:
+                a.arg = fold_constants(a.arg)
+    elif isinstance(plan, LJoin):
+        plan.eq_conds = [(fold_constants(l), fold_constants(r)) for l, r in plan.eq_conds]
+        if plan.other_cond is not None:
+            plan.other_cond = fold_constants(plan.other_cond)
+    elif isinstance(plan, LSort):
+        plan.items = [(fold_constants(x), d) for x, d in plan.items]
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown
+# ---------------------------------------------------------------------------
+
+def _conj_split(e: Expr) -> List[Expr]:
+    if isinstance(e, Call) and e.op == "and":
+        return _conj_split(e.args[0]) + _conj_split(e.args[1])
+    return [e]
+
+
+def _conj_join(parts: List[Expr]) -> Optional[Expr]:
+    out = None
+    for p in parts:
+        out = p if out is None else Call(type_=BOOL, op="and", args=(out, p))
+    return out
+
+
+def _refs(e: Expr) -> Set[str]:
+    return {n.name for n in walk(e) if isinstance(n, (ColumnRef, AggRef))}
+
+
+def _subst_proj(e: Expr, mapping) -> Expr:
+    """Rewrite uids through a projection (uid -> defining expr)."""
+    if isinstance(e, ColumnRef):
+        return mapping.get(e.name, e)
+    if isinstance(e, Call):
+        return Call(type_=e.type_, op=e.op, args=tuple(_subst_proj(a, mapping) for a in e.args))
+    if isinstance(e, Cast):
+        return Cast(type_=e.type_, arg=_subst_proj(e.arg, mapping))
+    if isinstance(e, Lookup):
+        return Lookup(type_=e.type_, arg=_subst_proj(e.arg, mapping), table=e.table, table_valid=e.table_valid)
+    if isinstance(e, InList):
+        return InList(type_=e.type_, arg=_subst_proj(e.arg, mapping), values=e.values, negated=e.negated)
+    if isinstance(e, Case):
+        return Case(
+            type_=e.type_,
+            whens=tuple((_subst_proj(c, mapping), _subst_proj(r, mapping)) for c, r in e.whens),
+            else_=_subst_proj(e.else_, mapping) if e.else_ is not None else None,
+        )
+    return e
+
+
+def _push_cond(plan: LogicalPlan, conds: List[Expr]) -> LogicalPlan:
+    """Push conjuncts as far down as they can go; returns new plan."""
+    if not conds:
+        return _rule_pushdown(plan)
+
+    if isinstance(plan, LScan) and plan.table is not None:
+        plan.pushed_cond = _conj_join(
+            ([plan.pushed_cond] if plan.pushed_cond is not None else []) + conds
+        )
+        return plan
+
+    if isinstance(plan, LSelection):
+        return _push_cond(plan.child, conds + _conj_split(plan.cond))
+
+    if isinstance(plan, LProjection):
+        mapping = {c.uid: x for c, x in zip(plan.schema, plan.exprs)}
+        # only push through simple (non-volatile) projections
+        rewritten = [_subst_proj(c, mapping) for c in conds]
+        plan.children[0] = _push_cond(plan.child, rewritten)
+        return plan
+
+    if isinstance(plan, LJoin):
+        left_uids = {c.uid for c in plan.children[0].schema}
+        right_uids = {c.uid for c in plan.children[1].schema}
+        lconds, rconds, keep = [], [], []
+        for c in conds:
+            r = _refs(c)
+            if r <= left_uids:
+                lconds.append(c)
+            elif r <= right_uids and plan.kind == "inner":
+                rconds.append(c)
+            elif r <= right_uids and plan.kind in ("semi", "anti"):
+                rconds.append(c)
+            elif plan.kind in ("inner", "cross"):
+                # equi conjunct across the two sides becomes a join key
+                # (this is what turns comma joins into hash joins)
+                if isinstance(c, Call) and c.op == "eq":
+                    a, b = c.args
+                    ra, rb = _refs(a), _refs(b)
+                    if ra <= left_uids and rb <= right_uids:
+                        plan.eq_conds.append((a, b))
+                        plan.kind = "inner"
+                        continue
+                    if ra <= right_uids and rb <= left_uids:
+                        plan.eq_conds.append((b, a))
+                        plan.kind = "inner"
+                        continue
+                keep.append(c)
+            else:
+                keep.append(c)
+        plan.children[0] = _push_cond(plan.children[0], lconds)
+        plan.children[1] = _push_cond(plan.children[1], rconds)
+        plan.children[0] = _rule_pushdown(plan.children[0]) if not lconds else plan.children[0]
+        if keep:
+            return LSelection(schema=plan.schema, children=[plan], cond=_conj_join(keep))
+        return plan
+
+    if isinstance(plan, LAggregate):
+        # conds referencing only group uids could push below; round 1: stop
+        plan.children[0] = _rule_pushdown(plan.child)
+        return LSelection(schema=plan.schema, children=[plan], cond=_conj_join(conds))
+
+    # default: stop here
+    plan.children = [_rule_pushdown(c) for c in plan.children]
+    return LSelection(schema=plan.schema, children=[plan], cond=_conj_join(conds))
+
+
+def _rule_pushdown(plan: LogicalPlan) -> LogicalPlan:
+    if isinstance(plan, LSelection):
+        child = plan.child
+        return _push_cond(child, _conj_split(plan.cond))
+    plan.children = [_rule_pushdown(c) for c in plan.children]
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+# ---------------------------------------------------------------------------
+
+def _rule_prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
+    """required=None means 'all visible outputs required' (root)."""
+    if isinstance(plan, LScan):
+        if required is not None and plan.table is not None:
+            need = set(required)
+            if plan.pushed_cond is not None:
+                need |= _refs(plan.pushed_cond)
+            plan.schema = [c for c in plan.schema if c.uid in need]
+        return plan
+
+    if isinstance(plan, LSelection):
+        child_req = None
+        if required is not None:
+            child_req = set(required) | _refs(plan.cond)
+        plan.children[0] = _rule_prune(plan.child, child_req)
+        if required is not None:
+            plan.schema = [c for c in plan.schema if c.uid in required or c.uid in {s.uid for s in plan.child.schema}]
+        plan.schema = list(plan.child.schema)
+        return plan
+
+    if isinstance(plan, LProjection):
+        if required is not None:
+            keep = [
+                (c, x)
+                for c, x in zip(plan.schema, plan.exprs)
+                if c.uid in required
+            ]
+            # keep at least one column so COUNT(*) style plans have a stream
+            if not keep:
+                keep = [(plan.schema[0], plan.exprs[0])]
+            plan.schema = [c for c, _ in keep]
+            plan.exprs = [x for _, x in keep]
+            plan.n_visible = len(plan.schema)
+        child_req = set()
+        for x in plan.exprs:
+            child_req |= _refs(x)
+        plan.children[0] = _rule_prune(plan.child, child_req)
+        return plan
+
+    if isinstance(plan, LAggregate):
+        if required is not None:
+            keep_aggs = [a for a in plan.aggs if a.uid in required]
+            plan.aggs = keep_aggs
+            plan.schema = [
+                c for c in plan.schema
+                if c.uid in required or c.uid in plan.group_uids
+            ]
+        child_req = set()
+        for g in plan.group_exprs:
+            child_req |= _refs(g)
+        for a in plan.aggs:
+            if a.arg is not None:
+                child_req |= _refs(a.arg)
+        plan.children[0] = _rule_prune(plan.child, child_req or None)
+        return plan
+
+    if isinstance(plan, LJoin):
+        child_req_l, child_req_r = set(), set()
+        if required is not None:
+            left_uids = {c.uid for c in plan.children[0].schema}
+            right_uids = {c.uid for c in plan.children[1].schema}
+            for uid in required:
+                if uid in left_uids:
+                    child_req_l.add(uid)
+                elif uid in right_uids:
+                    child_req_r.add(uid)
+        for l, r in plan.eq_conds:
+            child_req_l |= _refs(l)
+            child_req_r |= _refs(r)
+        if plan.other_cond is not None:
+            lu = {c.uid for c in plan.children[0].schema}
+            for uid in _refs(plan.other_cond):
+                (child_req_l if uid in lu else child_req_r).add(uid)
+        plan.children[0] = _rule_prune(plan.children[0], child_req_l or None)
+        plan.children[1] = _rule_prune(plan.children[1], child_req_r or None)
+        if plan.kind in ("semi", "anti"):
+            plan.schema = list(plan.children[0].schema)
+        else:
+            plan.schema = list(plan.children[0].schema) + list(plan.children[1].schema)
+        if required is not None:
+            plan.schema = [c for c in plan.schema if c.uid in required or c.uid in child_req_l | child_req_r]
+        return plan
+
+    if isinstance(plan, (LSort,)):
+        child_req = None
+        if required is not None:
+            child_req = set(required)
+            for x, _ in plan.items:
+                child_req |= _refs(x)
+        plan.children[0] = _rule_prune(plan.child, child_req)
+        plan.schema = list(plan.child.schema)
+        return plan
+
+    if isinstance(plan, (LLimit,)):
+        plan.children[0] = _rule_prune(plan.child, required)
+        plan.schema = list(plan.child.schema)
+        return plan
+
+    if isinstance(plan, LUnion):
+        # all sides share output uids; prune positionally
+        plan.children = [_rule_prune(c, set(required) if required is not None else None) for c in plan.children]
+        plan.schema = list(plan.children[0].schema)
+        return plan
+
+    plan.children = [_rule_prune(c, None) for c in plan.children]
+    return plan
+
+
+# ---------------------------------------------------------------------------
+
+def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
+    plan = _rule_fold(plan)
+    plan = _rule_pushdown(plan)
+    plan = _rule_prune(plan, None)
+    return plan

@@ -1,0 +1,11 @@
+"""Session layer (ref: session/ — Execute's parse->compile->run loop).
+
+Round-1 scope: statement dispatch for SELECT/DML/DDL/EXPLAIN/SET/SHOW over
+an in-process catalog, with the subquery-execution callback the planner
+needs. Sysvars, domain, privileges and the full variable system widen in
+session/sysvars.py.
+"""
+
+from tidb_tpu.session.session import Session
+
+__all__ = ["Session"]

@@ -1,0 +1,399 @@
+"""Session: the SQL entry point."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from tidb_tpu.errors import ExecutionError, PlanError, SchemaError, UnsupportedError
+from tidb_tpu.executor import ExecContext, ResultSet, build_executor, run_plan
+from tidb_tpu.executor.base import Executor
+from tidb_tpu.parser import ast as A
+from tidb_tpu.parser import parse
+from tidb_tpu.planner.logical import BuildContext, build_select
+from tidb_tpu.planner.optimizer import plan_statement
+from tidb_tpu.planner.physical import PProjection, explain_text, lower
+from tidb_tpu.planner.rules import optimize_logical
+from tidb_tpu.storage.catalog import Catalog
+from tidb_tpu.storage.table import ColumnInfo, TableSchema
+from tidb_tpu.types import parse_type_name
+
+__all__ = ["Session"]
+
+
+class Session:
+    def __init__(self, catalog: Optional[Catalog] = None, db: str = "test",
+                 chunk_capacity: int = 1 << 16):
+        self.catalog = catalog or Catalog()
+        self.db = db
+        self.chunk_capacity = chunk_capacity
+        self.vars: dict = {}
+        self.user_vars: dict = {}
+
+    # ------------------------------------------------------------------
+
+    def execute(self, sql: str) -> Optional[ResultSet]:
+        """Execute one or more statements; returns the last result set."""
+        result = None
+        for stmt in parse(sql):
+            result = self._execute_stmt(stmt)
+        return result
+
+    def query(self, sql: str) -> List[tuple]:
+        rs = self.execute(sql)
+        if rs is None:
+            return []
+        return rs.rows
+
+    # ------------------------------------------------------------------
+
+    def _exec_ctx(self) -> ExecContext:
+        return ExecContext(chunk_capacity=self.chunk_capacity)
+
+    def _execute_subplan(self, logical) -> List[tuple]:
+        """Planner callback: run a bound logical subplan to completion."""
+        logical = optimize_logical(logical)
+        phys = lower(logical)
+        root = build_executor(phys)
+        n_vis = phys.n_visible if isinstance(phys, PProjection) else None
+        rs = run_plan(root, self._exec_ctx(), n_visible=n_vis)
+        return rs.rows
+
+    def _plan_select(self, stmt):
+        return plan_statement(
+            stmt, self.catalog, db=self.db, execute_subplan=self._execute_subplan
+        )
+
+    def _run_select(self, stmt) -> ResultSet:
+        phys = self._plan_select(stmt)
+        root = build_executor(phys)
+        n_vis = phys.n_visible if isinstance(phys, PProjection) else None
+        if n_vis is None and hasattr(phys, "children") and phys.children:
+            # Sort/Limit on top of the projection keep hidden sort columns
+            c = phys
+            while c.children and not isinstance(c, PProjection):
+                c = c.children[0]
+            if isinstance(c, PProjection) and c.n_visible is not None and c.n_visible < len(phys.schema):
+                n_vis = c.n_visible
+        return run_plan(root, self._exec_ctx(), n_visible=n_vis)
+
+    # ------------------------------------------------------------------
+
+    def _execute_stmt(self, stmt) -> Optional[ResultSet]:
+        if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
+            return self._run_select(stmt)
+        if isinstance(stmt, A.InsertStmt):
+            return self._run_insert(stmt)
+        if isinstance(stmt, A.UpdateStmt):
+            return self._run_update(stmt)
+        if isinstance(stmt, A.DeleteStmt):
+            return self._run_delete(stmt)
+        if isinstance(stmt, A.CreateTableStmt):
+            return self._run_create_table(stmt)
+        if isinstance(stmt, A.DropTableStmt):
+            for t in stmt.tables:
+                self.catalog.drop_table(t.schema or self.db, t.name, stmt.if_exists)
+            return None
+        if isinstance(stmt, A.CreateDatabaseStmt):
+            self.catalog.create_database(stmt.name, stmt.if_not_exists)
+            return None
+        if isinstance(stmt, A.DropDatabaseStmt):
+            self.catalog.drop_database(stmt.name, stmt.if_exists)
+            return None
+        if isinstance(stmt, A.TruncateStmt):
+            self.catalog.table(stmt.table.schema or self.db, stmt.table.name).truncate()
+            return None
+        if isinstance(stmt, A.UseStmt):
+            self.catalog.database(stmt.db)  # raises if missing
+            self.db = stmt.db
+            return None
+        if isinstance(stmt, A.ExplainStmt):
+            return self._run_explain(stmt)
+        if isinstance(stmt, A.SetStmt):
+            for scope, name, value in stmt.assignments:
+                from tidb_tpu.planner.binder import Binder
+
+                lit = Binder().bind_literal(value) if not isinstance(value, A.EName) else None
+                v = lit.value if lit is not None else value.name
+                if scope == "user":
+                    self.user_vars[name] = v
+                else:
+                    self.vars[name.lower()] = v
+            return None
+        if isinstance(stmt, A.ShowStmt):
+            return self._run_show(stmt)
+        if isinstance(stmt, (A.BeginStmt, A.CommitStmt, A.RollbackStmt)):
+            # autocommit single-node round 1: txn statements are accepted
+            return None
+        if isinstance(stmt, A.AnalyzeStmt):
+            return None  # stats are live row counts for now
+        if isinstance(stmt, (A.CreateIndexStmt, A.DropIndexStmt)):
+            return None  # indexes: accepted, scans are columnar
+        if isinstance(stmt, A.AlterTableStmt):
+            raise UnsupportedError("ALTER TABLE execution not supported yet")
+        raise UnsupportedError(f"statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------
+
+    def _run_create_table(self, stmt: A.CreateTableStmt):
+        cols = []
+        pk = list(stmt.primary_key) if stmt.primary_key else None
+        for c in stmt.columns:
+            t = parse_type_name(c.type_name, c.type_args)
+            default = None
+            if c.default is not None:
+                from tidb_tpu.planner.binder import Binder
+
+                default = Binder().bind_literal(c.default).value
+            if c.primary_key:
+                pk = [c.name]
+            cols.append(
+                ColumnInfo(
+                    c.name, t,
+                    not_null=c.not_null or c.primary_key,
+                    default=default,
+                    auto_increment=c.auto_increment,
+                )
+            )
+        schema = TableSchema(stmt.table.name, cols, primary_key=pk)
+        self.catalog.create_table(stmt.table.schema or self.db, schema, stmt.if_not_exists)
+        return None
+
+    def _run_insert(self, stmt: A.InsertStmt):
+        table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
+        if stmt.select is not None:
+            rs = self._run_select(stmt.select)
+            rows = [list(r) for r in rs.rows]
+            table.insert_rows(rows, columns=stmt.columns)
+            return None
+        from tidb_tpu.planner.binder import Binder
+        from tidb_tpu.planner.logical import BuildContext
+        from tidb_tpu.planner.rules import fold_constants
+
+        binder = Binder()
+        rows = []
+        names = stmt.columns or table.schema.names()
+        for r_ast in stmt.rows:
+            if len(r_ast) != len(names):
+                raise ExecutionError(
+                    f"column count mismatch: {len(r_ast)} values for {len(names)} columns"
+                )
+            row = []
+            for cell, cname in zip(r_ast, names):
+                col = table.schema.col(cname)
+                bound = self._bind_const(binder, cell, col)
+                row.append(bound)
+            rows.append(row)
+        table.insert_rows(rows, columns=stmt.columns)
+        return None
+
+    def _bind_const(self, binder, cell_ast, col: ColumnInfo):
+        """Evaluate a constant INSERT/UPDATE value to a python value in the
+        table's logical form."""
+        from tidb_tpu.planner.binder import Scope
+        from tidb_tpu.planner.rules import fold_constants
+        from tidb_tpu.types import TypeKind, days_to_date, micros_to_datetime
+
+        bound = binder.bind_expr(cell_ast, Scope([], None))
+        bound = binder.coerce_untyped_literal(bound, col.type_)
+        bound = fold_constants(bound)
+        from tidb_tpu.expression.expr import Literal
+
+        if not isinstance(bound, Literal):
+            raise UnsupportedError("non-constant INSERT value")
+        if bound.value is None:
+            return None
+        k = col.type_.kind
+        v = bound.value
+        if k == TypeKind.DATE:
+            if bound.type_.kind == TypeKind.DATE:
+                return days_to_date(v)
+            return v
+        if k == TypeKind.DATETIME:
+            if bound.type_.kind == TypeKind.DATETIME:
+                return micros_to_datetime(v)
+            return v
+        if k == TypeKind.DECIMAL:
+            if bound.type_.kind == TypeKind.DECIMAL:
+                return v / (10 ** bound.type_.scale)
+            return v
+        if k == TypeKind.STRING:
+            return str(v)
+        return v
+
+    def _rows_matching(self, table, where, table_name: str) -> np.ndarray:
+        """Row ids (physical) matching a WHERE clause — shared by
+        UPDATE/DELETE. Runs a scan plan over the table with a hidden row id."""
+        sel = A.SelectStmt(
+            items=[A.SelectItem(A.EFunc("__row_id__", []))],
+            from_=A.TableName(table_name),
+            where=where,
+        )
+        # plan manually: scan + filter, materialize row ids
+        from tidb_tpu.planner.binder import Binder, PlanCol, Scope
+        from tidb_tpu.planner.logical import BuildContext, build_select
+        from tidb_tpu.types import INT64
+
+        # simpler: evaluate the predicate via a SELECT of the pk/rowid using
+        # a dedicated scan executor
+        from tidb_tpu.executor.scan import TableScanExec
+        from tidb_tpu.expression.compiler import compile_predicate
+
+        binder = Binder()
+        cols = [
+            PlanCol(
+                uid=binder.new_uid(f"{table_name}.{c.name}"),
+                name=c.name, type_=c.type_, qualifier=table_name,
+                dict_=table.dicts.get(c.name),
+            )
+            for c in table.schema.columns
+        ]
+        scope = Scope(cols, None)
+        stages = []
+        if where is not None:
+            cond = binder.bind_expr(where, scope)
+            from tidb_tpu.planner.rules import fold_constants
+
+            stages.append(("filter", fold_constants(cond)))
+        scan = TableScanExec(schema=cols, table=table, stages=stages)
+        ctx = self._exec_ctx()
+        scan.open(ctx)
+        ids = []
+        base = 0
+        try:
+            while True:
+                ch = scan.next()
+                if ch is None:
+                    break
+                live = np.nonzero(np.asarray(ch.sel))[0]
+                ids.append(live + base)
+                base += ctx.chunk_capacity
+        finally:
+            scan.close()
+        return np.concatenate(ids) if ids else np.zeros(0, dtype=np.int64)
+
+    def _run_update(self, stmt: A.UpdateStmt):
+        table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
+        ids = self._rows_matching(table, stmt.where, stmt.table.name)
+        if len(ids) == 0:
+            return None
+        from tidb_tpu.planner.binder import Binder
+
+        binder = Binder()
+        updates = {}
+        for name_ast, val_ast in stmt.sets:
+            col = table.schema.col(name_ast.name)
+            has_refs = _ast_has_name(val_ast)
+            if not has_refs:
+                v = self._bind_const(binder, val_ast, col)
+                updates[col.name] = [v] * len(ids)
+            else:
+                # expression over current row values: evaluate via scan
+                vals = self._eval_update_expr(table, stmt.table.name, val_ast, ids, col)
+                updates[col.name] = vals
+        table.update_rows(ids, updates)
+        return None
+
+    def _eval_update_expr(self, table, table_name, val_ast, ids, col: ColumnInfo):
+        from tidb_tpu.executor.scan import TableScanExec
+        from tidb_tpu.planner.binder import Binder, PlanCol, Scope
+        from tidb_tpu.types import TypeKind, days_to_date, micros_to_datetime
+
+        binder = Binder()
+        cols = [
+            PlanCol(
+                uid=binder.new_uid(f"{table_name}.{c.name}"),
+                name=c.name, type_=c.type_, qualifier=table_name,
+                dict_=table.dicts.get(c.name),
+            )
+            for c in table.schema.columns
+        ]
+        scope = Scope(cols, None)
+        bound = binder.bind_expr(val_ast, scope)
+        out_uid = "__upd__"
+        scan = TableScanExec(
+            schema=cols, table=table,
+            stages=[("project", [(out_uid, bound)])],
+        )
+        ctx = self._exec_ctx()
+        scan.open(ctx)
+        datas, valids = [], []
+        try:
+            while True:
+                ch = scan.next()
+                if ch is None:
+                    break
+                c = ch.columns[out_uid]
+                datas.append(np.asarray(c.data))
+                valids.append(np.asarray(c.valid))
+        finally:
+            scan.close()
+        data = np.concatenate(datas)[ids]
+        valid = np.concatenate(valids)[ids]
+        k = col.type_.kind
+        out = []
+        for d, v in zip(data, valid):
+            if not v:
+                out.append(None)
+            elif k == TypeKind.DATE:
+                out.append(days_to_date(int(d)))
+            elif k == TypeKind.DATETIME:
+                out.append(micros_to_datetime(int(d)))
+            elif k == TypeKind.DECIMAL:
+                src_scale = bound.type_.scale if bound.type_.kind == TypeKind.DECIMAL else 0
+                out.append(int(d) / (10 ** src_scale) if src_scale else int(d))
+            elif k == TypeKind.STRING:
+                raise UnsupportedError("UPDATE of string columns from expressions not supported yet")
+            else:
+                out.append(d.item())
+        return out
+
+    def _run_delete(self, stmt: A.DeleteStmt):
+        table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
+        ids = self._rows_matching(table, stmt.where, stmt.table.name)
+        table.delete_rows(ids)
+        return None
+
+    # ------------------------------------------------------------------
+
+    def _run_explain(self, stmt: A.ExplainStmt):
+        target = stmt.stmt
+        if not isinstance(target, (A.SelectStmt, A.UnionStmt)):
+            raise UnsupportedError("EXPLAIN only supports SELECT")
+        phys = self._plan_select(target)
+        text = explain_text(phys)
+        return ResultSet(names=["EXPLAIN"], rows=[(line,) for line in text.split("\n")])
+
+    def _run_show(self, stmt: A.ShowStmt):
+        if stmt.kind == "databases":
+            return ResultSet(names=["Database"], rows=[(n,) for n in sorted(self.catalog.databases)])
+        if stmt.kind == "tables":
+            return ResultSet(names=[f"Tables_in_{self.db}"], rows=[(n,) for n in self.catalog.tables(self.db)])
+        if stmt.kind == "columns":
+            t = self.catalog.table(self.db, stmt.target)
+            rows = [
+                (c.name, str(c.type_), "NO" if c.not_null else "YES")
+                for c in t.schema.columns
+            ]
+            return ResultSet(names=["Field", "Type", "Null"], rows=rows)
+        if stmt.kind == "variables":
+            return ResultSet(names=["Variable_name", "Value"],
+                             rows=sorted((k, str(v)) for k, v in self.vars.items()))
+        raise UnsupportedError(f"SHOW {stmt.kind}")
+
+
+def _ast_has_name(e) -> bool:
+    if isinstance(e, A.EName):
+        return True
+    if not hasattr(e, "__dataclass_fields__"):
+        return False
+    for f in e.__dataclass_fields__:
+        v = getattr(e, f)
+        if isinstance(v, list):
+            if any(_ast_has_name(x) for x in v if hasattr(x, "__dataclass_fields__")):
+                return True
+        elif hasattr(v, "__dataclass_fields__") and _ast_has_name(v):
+            return True
+    return False

@@ -1,0 +1,20 @@
+"""Host-side storage layer (ref: kv/, store/mockstore, table/, meta/).
+
+The reference keeps durable data in TiKV (reached over gRPC) and stands it
+in with an in-process mock for tests. Here the storage tier is host
+columnar partitions feeding the device:
+
+  table.py    -- TableSchema + Table: append-only columnar segments with a
+                 tombstone mask (delete) and in-place update; per-string-
+                 column sorted dictionaries; partition slicing for chips
+  catalog.py  -- databases -> tables; DDL entry points; schema versioning
+
+A C++ native engine (native/) can back Table's column buffers; the numpy
+implementation is the reference semantics and the test stand-in (the
+mockstore role).
+"""
+
+from tidb_tpu.storage.table import ColumnInfo, Table, TableSchema
+from tidb_tpu.storage.catalog import Catalog, Database
+
+__all__ = ["ColumnInfo", "Table", "TableSchema", "Catalog", "Database"]

@@ -1,0 +1,99 @@
+"""Catalog: databases -> tables (ref: infoschema/ + meta/ + ddl DDL entry).
+
+In-memory, schema-versioned. DDL here is synchronous (the reference's
+online multi-phase schema change exists because many stateless SQL nodes
+share storage; a single-process engine can flip schema atomically — the
+schema_version counter preserves the observable contract that sessions can
+detect schema changes)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tidb_tpu.errors import DuplicateTableError, SchemaError
+from tidb_tpu.storage.table import ColumnInfo, Table, TableSchema
+
+__all__ = ["Database", "Catalog"]
+
+
+@dataclass
+class Database:
+    name: str
+    tables: Dict[str, Table] = field(default_factory=dict)
+
+
+class Catalog:
+    def __init__(self):
+        self.databases: Dict[str, Database] = {"test": Database("test")}
+        self.schema_version = 0
+
+    # -- databases ---------------------------------------------------------
+
+    def create_database(self, name: str, if_not_exists: bool = False):
+        if name in self.databases:
+            if if_not_exists:
+                return
+            raise DuplicateTableError(f"database {name!r} exists")
+        self.databases[name] = Database(name)
+        self.schema_version += 1
+
+    def drop_database(self, name: str, if_exists: bool = False):
+        if name not in self.databases:
+            if if_exists:
+                return
+            raise SchemaError(f"no database {name!r}")
+        del self.databases[name]
+        self.schema_version += 1
+
+    def database(self, name: str) -> Database:
+        db = self.databases.get(name)
+        if db is None:
+            raise SchemaError(f"no database {name!r}")
+        return db
+
+    # -- tables ------------------------------------------------------------
+
+    def create_table(self, db: str, schema: TableSchema, if_not_exists: bool = False) -> Table:
+        d = self.database(db)
+        if schema.name in d.tables:
+            if if_not_exists:
+                return d.tables[schema.name]
+            raise DuplicateTableError(f"table {schema.name!r} exists")
+        t = Table(schema)
+        d.tables[schema.name] = t
+        self.schema_version += 1
+        return t
+
+    def drop_table(self, db: str, name: str, if_exists: bool = False):
+        d = self.database(db)
+        if name not in d.tables:
+            if if_exists:
+                return
+            raise SchemaError(f"no table {db}.{name}")
+        del d.tables[name]
+        self.schema_version += 1
+
+    def table(self, db: str, name: str) -> Table:
+        d = self.database(db)
+        t = d.tables.get(name)
+        if t is None:
+            raise SchemaError(f"no table {db}.{name}")
+        return t
+
+    def has_table(self, db: str, name: str) -> bool:
+        return name in self.databases.get(db, Database(db)).tables
+
+    def tables(self, db: str) -> List[str]:
+        return sorted(self.database(db).tables.keys())
+
+    def rename_table(self, db: str, old: str, new: str):
+        d = self.database(db)
+        if old not in d.tables:
+            raise SchemaError(f"no table {db}.{old}")
+        if new in d.tables:
+            raise DuplicateTableError(f"table {new!r} exists")
+        t = d.tables.pop(old)
+        t.schema.name = new
+        d.tables[new] = t
+        self.schema_version += 1

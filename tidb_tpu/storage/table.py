@@ -1,0 +1,296 @@
+"""Host columnar tables.
+
+Layout decisions (device-first):
+  * column-major numpy buffers in the device representation already
+    (scaled ints, day counts, dict codes) so staging to HBM is a straight
+    jnp.asarray of a slice — no row pivots on the hot path
+  * appends grow buffers geometrically; deletes set a tombstone bit;
+    updates write in place (single-writer host model, like the reference's
+    single leaseholder per region)
+  * each string column owns a sorted Dictionary; appends that introduce new
+    strings re-encode the column (dictionaries grow rarely in analytics
+    workloads; re-encode is vectorized)
+  * `version` bumps on every mutation — executors snapshot (version,
+    row_count) so EXPLAIN ANALYZE and the scheduler can detect staleness
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tidb_tpu.chunk.dictionary import Dictionary
+from tidb_tpu.errors import ExecutionError, SchemaError, TypeError_
+from tidb_tpu.types import (
+    SQLType,
+    TypeKind,
+    date_to_days,
+    datetime_to_micros,
+    decimal_to_scaled,
+)
+
+__all__ = ["ColumnInfo", "TableSchema", "Table"]
+
+
+@dataclass
+class ColumnInfo:
+    name: str
+    type_: SQLType
+    not_null: bool = False
+    default: object = None
+    auto_increment: bool = False
+
+
+@dataclass
+class TableSchema:
+    name: str
+    columns: List[ColumnInfo]
+    primary_key: Optional[List[str]] = None
+
+    def col(self, name: str) -> ColumnInfo:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise SchemaError(f"no column {name!r} in table {self.name!r}")
+
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+
+_GROW = 1.5
+_MIN_CAP = 1024
+
+
+class Table:
+    """Append-friendly columnar store for one table."""
+
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self.n = 0  # logical rows incl. tombstoned
+        self.version = 0
+        self._auto_inc = 1
+        cap = _MIN_CAP
+        self._cap = cap
+        self.data: Dict[str, np.ndarray] = {}
+        self.valid: Dict[str, np.ndarray] = {}
+        self.dicts: Dict[str, Dictionary] = {}
+        for c in schema.columns:
+            self.data[c.name] = np.zeros(cap, dtype=c.type_.np_dtype)
+            self.valid[c.name] = np.zeros(cap, dtype=np.bool_)
+            if c.type_.kind == TypeKind.STRING:
+                self.dicts[c.name] = Dictionary([])
+        self.tombstone = np.zeros(cap, dtype=np.bool_)
+
+    # -- row count ---------------------------------------------------------
+
+    @property
+    def live_rows(self) -> int:
+        return int(self.n - self.tombstone[: self.n].sum())
+
+    def _ensure(self, extra: int):
+        need = self.n + extra
+        if need <= self._cap:
+            return
+        cap = max(int(self._cap * _GROW), need, _MIN_CAP)
+        for name in self.data:
+            self.data[name] = np.resize(self.data[name], cap)
+            self.data[name][self.n:] = 0
+            self.valid[name] = np.resize(self.valid[name], cap)
+            self.valid[name][self.n:] = False
+        self.tombstone = np.resize(self.tombstone, cap)
+        self.tombstone[self.n:] = False
+        self._cap = cap
+
+    # -- ingestion ---------------------------------------------------------
+
+    def to_device_value(self, col: ColumnInfo, v):
+        """Host python value -> device representation scalar."""
+        import datetime
+
+        if v is None:
+            return None
+        k = col.type_.kind
+        try:
+            if k == TypeKind.INT:
+                return int(v)
+            if k == TypeKind.FLOAT:
+                return float(v)
+            if k == TypeKind.BOOL:
+                return bool(v)
+            if k == TypeKind.DECIMAL:
+                return decimal_to_scaled(v, col.type_.scale)
+            if k == TypeKind.DATE:
+                if isinstance(v, str):
+                    v = datetime.date.fromisoformat(v)
+                return date_to_days(v)
+            if k == TypeKind.DATETIME:
+                if isinstance(v, str):
+                    v = datetime.datetime.fromisoformat(v)
+                return datetime_to_micros(v)
+            if k == TypeKind.STRING:
+                return str(v)  # encoded in bulk by insert_rows
+        except (ValueError, TypeError) as e:
+            raise TypeError_(f"bad value {v!r} for column {col.name}: {e}")
+        raise TypeError_(f"unsupported type {col.type_}")
+
+    def insert_rows(self, rows: Sequence[Sequence], columns: Optional[List[str]] = None) -> int:
+        """Insert python rows (already in logical form; strings as str,
+        dates as date/str, decimals as str/float). Returns rows inserted."""
+        names = columns or self.schema.names()
+        cols = [self.schema.col(n) for n in names]
+        m = len(rows)
+        if m == 0:
+            return 0
+        self._ensure(m)
+        start, end = self.n, self.n + m
+        provided = set(names)
+        # columns not provided get default/NULL/auto-inc
+        for c in self.schema.columns:
+            if c.name in provided:
+                continue
+            if c.auto_increment:
+                vals = np.arange(self._auto_inc, self._auto_inc + m, dtype=np.int64)
+                self._auto_inc += m
+                self.data[c.name][start:end] = vals
+                self.valid[c.name][start:end] = True
+            elif c.default is not None:
+                dv = self.to_device_value(c, c.default)
+                if c.type_.kind == TypeKind.STRING:
+                    self._append_strings(c.name, [dv] * m, start, end)
+                else:
+                    self.data[c.name][start:end] = dv
+                    self.valid[c.name][start:end] = True
+            elif c.not_null:
+                raise ExecutionError(f"column {c.name!r} has no default and is NOT NULL")
+            # else: stays NULL
+        for j, (name, c) in enumerate(zip(names, cols)):
+            vals = [self.to_device_value(c, r[j]) for r in rows]
+            if any(v is None for v in vals) and c.not_null:
+                raise ExecutionError(f"NULL in NOT NULL column {c.name!r}")
+            if c.type_.kind == TypeKind.STRING:
+                self._append_strings(name, vals, start, end)
+            else:
+                arr = self.data[name]
+                vd = self.valid[name]
+                for i, v in enumerate(vals):
+                    if v is None:
+                        vd[start + i] = False
+                    else:
+                        arr[start + i] = v
+                        vd[start + i] = True
+        self.n = end
+        self.version += 1
+        return m
+
+    def insert_columns(self, arrays: Dict[str, np.ndarray], valids: Optional[Dict[str, np.ndarray]] = None, strings: Optional[Dict[str, list]] = None):
+        """Bulk columnar ingest (datagen / LOAD). `arrays` hold device reprs
+        for non-string columns; `strings` holds raw python strings per
+        string column."""
+        sizes = [len(a) for a in arrays.values()] + [len(s) for s in (strings or {}).values()]
+        if not sizes:
+            return 0
+        m = sizes[0]
+        if any(s != m for s in sizes):
+            raise ExecutionError(f"bulk insert length mismatch: {sizes}")
+        self._ensure(m)
+        start, end = self.n, self.n + m
+        for c in self.schema.columns:
+            name = c.name
+            if strings and name in strings:
+                self._append_strings(name, strings[name], start, end)
+            elif name in arrays:
+                self.data[name][start:end] = arrays[name].astype(c.type_.np_dtype, copy=False)
+                if valids and name in valids:
+                    self.valid[name][start:end] = valids[name]
+                else:
+                    self.valid[name][start:end] = True
+            elif c.not_null:
+                raise ExecutionError(f"bulk insert missing NOT NULL column {name!r}")
+        self.n = end
+        self.version += 1
+        return m
+
+    def _append_strings(self, name: str, vals: list, start: int, end: int):
+        d = self.dicts[name]
+        new = {v for v in vals if v is not None and v not in d}
+        if new:
+            # dictionary grows: build union dict and re-encode existing codes
+            nd = Dictionary(list(d.values) + list(new))
+            if self.n > 0 and len(d) > 0:
+                trans = d.translate_to(nd)
+                self.data[name][: self.n] = trans[self.data[name][: self.n]]
+            self.dicts[name] = nd
+            d = nd
+        codes, valid = d.encode_with(vals)
+        self.data[name][start:end] = codes
+        self.valid[name][start:end] = valid
+
+    # -- mutation ----------------------------------------------------------
+
+    def delete_rows(self, row_ids: np.ndarray) -> int:
+        """Tombstone rows by physical id; returns count newly deleted."""
+        ids = np.asarray(row_ids, dtype=np.int64)
+        ids = ids[(ids >= 0) & (ids < self.n)]
+        fresh = ~self.tombstone[ids]
+        self.tombstone[ids] = True
+        self.version += 1
+        return int(fresh.sum())
+
+    def update_rows(self, row_ids: np.ndarray, updates: Dict[str, list]) -> int:
+        ids = np.asarray(row_ids, dtype=np.int64)
+        for name, vals in updates.items():
+            c = self.schema.col(name)
+            if c.type_.kind == TypeKind.STRING:
+                # route through append-style encoding (may grow dict)
+                d = self.dicts[name]
+                new = {v for v in vals if v is not None and v not in d}
+                if new:
+                    nd = Dictionary(list(d.values) + list(new))
+                    trans = d.translate_to(nd)
+                    self.data[name][: self.n] = trans[self.data[name][: self.n]]
+                    self.dicts[name] = nd
+                    d = nd
+                codes, valid = d.encode_with(vals)
+                self.data[name][ids] = codes
+                self.valid[name][ids] = valid
+            else:
+                for i, v in zip(ids, vals):
+                    if v is None:
+                        self.valid[name][i] = False
+                    else:
+                        self.data[name][i] = self.to_device_value(c, v)
+                        self.valid[name][i] = True
+        self.version += 1
+        return len(ids)
+
+    def truncate(self):
+        self.n = 0
+        self.version += 1
+        self.tombstone[:] = False
+        for c in self.schema.columns:
+            # valid[] must clear: insert paths that omit a column rely on
+            # stale slots reading as NULL
+            self.valid[c.name][:] = False
+            self.data[c.name][:] = 0
+            if c.type_.kind == TypeKind.STRING:
+                self.dicts[c.name] = Dictionary([])
+
+    # -- reads -------------------------------------------------------------
+
+    def column_slice(self, name: str, start: int, end: int):
+        """(data, valid) physical slice incl. tombstoned rows — executor
+        masks them via live_mask."""
+        return self.data[name][start:end], self.valid[name][start:end]
+
+    def live_mask(self, start: int, end: int) -> np.ndarray:
+        return ~self.tombstone[start:end]
+
+    def partition_bounds(self, num_partitions: int) -> List[tuple]:
+        """Split [0, n) into near-equal contiguous partitions (the region/
+        shard analogue for the scan scheduler)."""
+        if num_partitions <= 0:
+            raise ValueError("num_partitions must be positive")
+        edges = np.linspace(0, self.n, num_partitions + 1, dtype=np.int64)
+        return [(int(edges[i]), int(edges[i + 1])) for i in range(num_partitions)]

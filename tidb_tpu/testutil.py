@@ -1,0 +1,130 @@
+"""Test utilities (ref: util/testkit — MustQuery-style helpers).
+
+The reference tests boot a real session over mockstore and compare SQL
+results; here the oracle is stdlib sqlite3: `mirror_to_sqlite` copies any
+catalog table into an in-memory sqlite database so the same SQL (modulo
+dialect) can be cross-checked row for row.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Iterable, Optional
+
+import numpy as np
+
+from tidb_tpu.storage.catalog import Catalog
+from tidb_tpu.types import TypeKind, days_to_date, micros_to_datetime
+
+__all__ = ["mirror_to_sqlite", "rows_equal", "normalize_row"]
+
+
+def mirror_to_sqlite(catalog: Catalog, db: str = "test", tables: Optional[Iterable[str]] = None) -> sqlite3.Connection:
+    """Copy catalog tables into a fresh in-memory sqlite DB.
+
+    Decimals become REAL (compare with tolerance), dates ISO strings (so
+    date literals compare lexically, matching sqlite conventions)."""
+    conn = sqlite3.connect(":memory:")
+    for name in tables or catalog.tables(db):
+        t = catalog.table(db, name)
+        cols = t.schema.columns
+        decls = ", ".join(f"{c.name} {_sqlite_type(c.type_.kind)}" for c in cols)
+        conn.execute(f"CREATE TABLE {name} ({decls})")
+        n = t.n
+        if n == 0:
+            continue
+        pycols = []
+        for c in cols:
+            data, valid = t.data[c.name][:n], t.valid[c.name][:n]
+            pycols.append(_to_python(c.type_, data, valid, t.dicts.get(c.name)))
+        live = ~t.tombstone[:n]
+        rows = [tuple(col[i] for col in pycols) for i in range(n) if live[i]]
+        ph = ", ".join("?" * len(cols))
+        conn.executemany(f"INSERT INTO {name} VALUES ({ph})", rows)
+    conn.commit()
+    return conn
+
+
+def _sqlite_type(kind: TypeKind) -> str:
+    return {
+        TypeKind.INT: "INTEGER",
+        TypeKind.BOOL: "INTEGER",
+        TypeKind.FLOAT: "REAL",
+        TypeKind.DECIMAL: "REAL",
+        TypeKind.STRING: "TEXT",
+        TypeKind.DATE: "TEXT",
+        TypeKind.DATETIME: "TEXT",
+    }[kind]
+
+
+def _to_python(type_, data: np.ndarray, valid: np.ndarray, dictionary) -> list:
+    k = type_.kind
+    if k == TypeKind.STRING:
+        return dictionary.decode(data, valid)
+    out = []
+    for v, ok in zip(data, valid):
+        if not ok:
+            out.append(None)
+        elif k == TypeKind.DECIMAL:
+            out.append(int(v) / (10**type_.scale))
+        elif k == TypeKind.DATE:
+            out.append(days_to_date(int(v)).isoformat())
+        elif k == TypeKind.DATETIME:
+            out.append(micros_to_datetime(int(v)).isoformat(sep=" "))
+        elif k == TypeKind.FLOAT:
+            out.append(float(v))
+        else:
+            out.append(int(v))
+    return out
+
+
+def normalize_row(row: tuple) -> tuple:
+    """Canonicalize a result row for comparison: decimal strings -> float,
+    everything else unchanged."""
+    out = []
+    for v in row:
+        if isinstance(v, str):
+            try:
+                out.append(float(v)) if _is_numeric_str(v) else out.append(v)
+                continue
+            except ValueError:
+                pass
+        out.append(v)
+    return tuple(out)
+
+
+def _is_numeric_str(s: str) -> bool:
+    if not s:
+        return False
+    body = s[1:] if s[0] in "+-" else s
+    return body.replace(".", "", 1).isdigit()
+
+
+def rows_equal(got: list, want: list, ordered: bool = False, rel_tol: float = 1e-6) -> tuple:
+    """Compare result sets; returns (ok, message). Numeric values compare
+    with relative tolerance (decimals mirrored as REAL in sqlite)."""
+    g = [normalize_row(r) for r in got]
+    w = [normalize_row(r) for r in want]
+    if not ordered:
+        g = sorted(g, key=_sort_key)
+        w = sorted(w, key=_sort_key)
+    if len(g) != len(w):
+        return False, f"row count {len(g)} != {len(w)}\n got: {g[:5]}\nwant: {w[:5]}"
+    for i, (rg, rw) in enumerate(zip(g, w)):
+        if len(rg) != len(rw):
+            return False, f"row {i}: width {len(rg)} != {len(rw)}"
+        for j, (a, b) in enumerate(zip(rg, rw)):
+            if a is None or b is None:
+                if a is not b:
+                    return False, f"row {i} col {j}: {a!r} != {b!r}"
+                continue
+            if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+                if abs(a - b) > rel_tol * max(1.0, abs(a), abs(b)):
+                    return False, f"row {i} col {j}: {a!r} != {b!r}"
+            elif a != b:
+                return False, f"row {i} col {j}: {a!r} != {b!r}"
+    return True, "ok"
+
+
+def _sort_key(row: tuple):
+    return tuple((v is None, str(type(v).__name__), v if not isinstance(v, (int, float)) else float(v)) for v in row)
