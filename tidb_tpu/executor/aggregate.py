@@ -33,7 +33,7 @@ from tidb_tpu.expression.compiler import eval_expr
 from tidb_tpu.planner.logical import AggSpec
 from tidb_tpu.types import FLOAT64, SQLType, TypeKind
 
-__all__ = ["HashAggExec"]
+__all__ = ["HashAggExec", "make_segment_kernel", "MERGE_OPS"]
 
 
 def _min_identity(dtype):
@@ -46,6 +46,94 @@ def _max_identity(dtype):
     if np.issubdtype(dtype, np.floating):
         return -np.inf
     return np.iinfo(dtype).min
+
+
+# How each piece of segment-agg state merges across partial aggregators.
+# Key suffix -> collective: the distributed path (parallel/distsql.py) maps
+# these onto lax.psum / lax.pmin / lax.pmax over the shard mesh axis —
+# exactly the partial/final split of the reference's HashAggExec pipeline.
+MERGE_OPS = {"occ": "sum", ".sum": "sum", ".cnt": "sum", ".min": "min", ".max": "max"}
+
+
+def merge_op_for(key: str) -> str:
+    if key == "occ":
+        return "sum"
+    for suffix, op in MERGE_OPS.items():
+        if key.endswith(suffix):
+            return op
+    raise ExecutionError(f"no merge op for state key {key!r}")
+
+
+def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
+    """Build (init_state, update, G) for segment-strategy aggregation.
+
+    `update(state, chunk) -> state` is a pure function over [G]-shaped
+    accumulators — usable per-chunk on one chip (HashAggExec) or per-shard
+    under shard_map with a collective merge (the partial-agg kernel of the
+    distributed path; see merge_op_for)."""
+    G = 1
+    for d in domains:
+        G *= d
+    G = max(G, 1)
+
+    def init_state():
+        st = {"occ": jnp.zeros(G, dtype=jnp.int64)}
+        for a in aggs:
+            if a.func in ("sum", "avg"):
+                dt = jnp.float64 if a.arg.type_.kind == TypeKind.FLOAT else jnp.int64
+                st[f"{a.uid}.sum"] = jnp.zeros(G, dtype=dt)
+                st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+            elif a.func == "count":
+                st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+            elif a.func == "min":
+                dt = a.arg.type_.np_dtype
+                st[f"{a.uid}.min"] = jnp.full(G, _min_identity(dt), dtype=dt)
+                st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+            elif a.func == "max":
+                dt = a.arg.type_.np_dtype
+                st[f"{a.uid}.max"] = jnp.full(G, _max_identity(dt), dtype=dt)
+                st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
+        return st
+
+    def update(state, chunk: Chunk):
+        packed = jnp.zeros(chunk.capacity, dtype=jnp.int64)
+        stride = 1
+        for g, dom in zip(group_exprs, domains):
+            data, valid = eval_expr(g, chunk)
+            idx = jnp.where(valid, jnp.clip(data.astype(jnp.int64), 0, dom - 2), dom - 1)
+            packed = packed + idx * stride
+            stride *= dom
+        sel = chunk.sel
+        seli = sel.astype(jnp.int64)
+        out = dict(state)
+        out["occ"] = state["occ"].at[packed].add(seli)
+        for a in aggs:
+            if a.arg is not None:
+                d, v = eval_expr(a.arg, chunk)
+                ok = sel & v
+            if a.func in ("sum", "avg"):
+                acc = state[f"{a.uid}.sum"]
+                contrib = jnp.where(ok, d, 0).astype(acc.dtype)
+                out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
+                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+            elif a.func == "count":
+                if a.arg is None:
+                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(seli)
+                else:
+                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+            elif a.func == "min":
+                acc = state[f"{a.uid}.min"]
+                contrib = jnp.where(ok, d, _min_identity(np.dtype(acc.dtype))).astype(acc.dtype)
+                out[f"{a.uid}.min"] = acc.at[packed].min(contrib)
+                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+            elif a.func == "max":
+                acc = state[f"{a.uid}.max"]
+                contrib = jnp.where(ok, d, _max_identity(np.dtype(acc.dtype))).astype(acc.dtype)
+                out[f"{a.uid}.max"] = acc.at[packed].max(contrib)
+                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+        return out
+
+    return init_state, update, G
 
 
 class HashAggExec(Executor):
@@ -84,79 +172,21 @@ class HashAggExec(Executor):
     def _run_segment(self):
         sizes = self.segment_sizes or []
         domains = [s + 1 for s in sizes]  # +1 slot for NULL keys
-        G = 1
-        for d in domains:
-            G *= d
-        G = max(G, 1)
-        aggs = self.aggs
-
-        def init_state():
-            st = {"occ": jnp.zeros(G, dtype=jnp.int64)}
-            for a in aggs:
-                if a.func in ("sum", "avg"):
-                    dt = jnp.float64 if a.arg.type_.kind == TypeKind.FLOAT else jnp.int64
-                    st[f"{a.uid}.sum"] = jnp.zeros(G, dtype=dt)
-                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
-                elif a.func == "count":
-                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
-                elif a.func == "min":
-                    dt = a.arg.type_.np_dtype
-                    st[f"{a.uid}.min"] = jnp.full(G, _min_identity(dt), dtype=dt)
-                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
-                elif a.func == "max":
-                    dt = a.arg.type_.np_dtype
-                    st[f"{a.uid}.max"] = jnp.full(G, _max_identity(dt), dtype=dt)
-                    st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
-            return st
-
+        init_state, update, _ = make_segment_kernel(self.group_exprs, self.aggs, domains)
         group_exprs = self.group_exprs
-
-        def update(state, chunk: Chunk):
-            packed = jnp.zeros(chunk.capacity, dtype=jnp.int64)
-            stride = 1
-            for g, dom in zip(group_exprs, domains):
-                data, valid = eval_expr(g, chunk)
-                idx = jnp.where(valid, jnp.clip(data.astype(jnp.int64), 0, dom - 2), dom - 1)
-                packed = packed + idx * stride
-                stride *= dom
-            sel = chunk.sel
-            seli = sel.astype(jnp.int64)
-            out = dict(state)
-            out["occ"] = state["occ"].at[packed].add(seli)
-            for a in aggs:
-                if a.arg is not None:
-                    d, v = eval_expr(a.arg, chunk)
-                    ok = sel & v
-                if a.func in ("sum", "avg"):
-                    acc = state[f"{a.uid}.sum"]
-                    contrib = jnp.where(ok, d, 0).astype(acc.dtype)
-                    out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
-                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
-                elif a.func == "count":
-                    if a.arg is None:
-                        out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(seli)
-                    else:
-                        out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
-                elif a.func == "min":
-                    acc = state[f"{a.uid}.min"]
-                    contrib = jnp.where(ok, d, _min_identity(np.dtype(acc.dtype))).astype(acc.dtype)
-                    out[f"{a.uid}.min"] = acc.at[packed].min(contrib)
-                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
-                elif a.func == "max":
-                    acc = state[f"{a.uid}.max"]
-                    contrib = jnp.where(ok, d, _max_identity(np.dtype(acc.dtype))).astype(acc.dtype)
-                    out[f"{a.uid}.max"] = acc.at[packed].max(contrib)
-                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
-            return out
 
         update = jax.jit(update, donate_argnums=0)
         state = init_state()
         for chunk in self.children[0].chunks():
             state = update(state, chunk)
+        self._finalize_segment_state(state, domains)
 
-        # finalize host-side: unpack occupied groups
+    def _finalize_segment_state(self, state, domains):
+        """Host finalize of [G]-shaped accumulators: unpack occupied groups.
+        Shared with the distributed executors (parallel/executor.py), which
+        produce the same state via collective merge."""
         host = {k: np.asarray(v) for k, v in state.items()}
-        if group_exprs:
+        if self.group_exprs:
             occupied = np.nonzero(host["occ"] > 0)[0]
         else:
             occupied = np.array([0], dtype=np.int64)  # global agg: 1 row always

@@ -29,30 +29,46 @@ from tidb_tpu.planner.physical import (
     PhysicalPlan,
 )
 
-__all__ = ["build_executor"]
+__all__ = ["build_executor", "peel_stages", "scan_stages_for"]
+
+
+def peel_stages(plan: PhysicalPlan):
+    """Strip the fusible Selection/Projection chain off `plan`.
+
+    Returns (stages, base): stages in execution order, base the first
+    non-fusible node. Shared by the single-chip fusion below and the
+    distributed fragment matcher (parallel/executor.py) so both tiers
+    always fuse the same plan shapes."""
+    rev, base = [], plan
+    while True:
+        if isinstance(base, PSelection):
+            rev.append(("filter", base.cond))
+            base = base.child
+        elif isinstance(base, PProjection):
+            rev.append(("project", list(zip([c.uid for c in base.schema], base.exprs))))
+            base = base.child
+        else:
+            break
+    return list(reversed(rev)), base
+
+
+def scan_stages_for(scan: PScan, stages) -> list:
+    """Prepend the scan's pushed filter to a fused stage list."""
+    out = []
+    if scan.pushed_cond is not None:
+        out.append(("filter", scan.pushed_cond))
+    out.extend(stages)
+    return out
 
 
 def build_executor(plan: PhysicalPlan) -> Executor:
     # pipeline fusion: Selection/Projection chains over a scan
-    stages, base = [], plan
-    while True:
-        if isinstance(base, PSelection):
-            stages.append(("filter", base.cond))
-            base = base.child
-        elif isinstance(base, PProjection):
-            stages.append(("project", list(zip([c.uid for c in base.schema], base.exprs))))
-            base = base.child
-        else:
-            break
+    stages, base = peel_stages(plan)
     if isinstance(base, PScan):
-        scan_stages = []
-        if base.pushed_cond is not None:
-            scan_stages.append(("filter", base.pushed_cond))
-        scan_stages.extend(reversed(stages))
         return TableScanExec(
             schema=base.schema,
             table=base.table,
-            stages=scan_stages,
+            stages=scan_stages_for(base, stages),
             out_schema=plan.schema,
         )
 

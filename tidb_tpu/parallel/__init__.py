@@ -1,0 +1,36 @@
+"""Multi-chip execution: the distsql/coprocessor tier rebuilt on jax.sharding.
+
+Reference counterparts (SURVEY.md §2 parallelism inventory):
+  * distsql/ + store/copr/  -> sharded partitions + shard_map scan fragments
+  * HashAggExec partial/final worker pipeline -> per-shard partial segment agg
+    merged with lax.psum/pmin/pmax over the mesh axis
+  * HashJoinExec build/probe workers + MPP exchange -> hash repartition via
+    lax.all_to_all, local sort-probe join per shard
+  * gRPC/region-cache routing -> NamedSharding placement on a Mesh; ICI
+    carries every exchange, DCN modeled as an outer mesh axis
+"""
+
+from tidb_tpu.parallel.mesh import make_mesh, shard_axis, dcn_axis
+from tidb_tpu.parallel.partition import ShardedTable, shard_table
+from tidb_tpu.parallel.distsql import (
+    dist_agg_fragment,
+    dist_join_agg_fragment,
+    make_agg_fragment,
+    make_join_agg_fragment,
+    merge_state,
+    repartition_by_key,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_axis",
+    "dcn_axis",
+    "ShardedTable",
+    "shard_table",
+    "dist_agg_fragment",
+    "make_agg_fragment",
+    "make_join_agg_fragment",
+    "dist_join_agg_fragment",
+    "merge_state",
+    "repartition_by_key",
+]

@@ -1,0 +1,251 @@
+"""Distributed plan fragments: scan/agg/join over the mesh.
+
+This is the coprocessor pushdown tier (ref: distsql.Select fan-out +
+mocktikv coprocessor + MPP exchange) rebuilt as XLA collectives:
+
+  * scan+filter+partial-agg fragments run per shard under jax.shard_map;
+    partial [G]-shaped agg states merge with psum/pmin/pmax over the mesh
+    (merge ops declared next to the kernel in executor/aggregate.py)
+  * join repartitioning is a fixed-capacity bucket exchange over
+    lax.all_to_all — rows hash to a destination shard, take a slot in a
+    [P, cap] send buffer (cap = growth * R / P), and overflow is counted
+    and surfaced rather than silently dropped (static shapes: capacity
+    overflow is the TPU analogue of the reference's spill trigger)
+  * local join per shard is sort + searchsorted probe (TPU-friendly; no
+    pointer-chasing hash table). Build side must be unique-key (PK-FK
+    joins — the reference's common HashJoinExec shape); many-many joins
+    stay on the host executor.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.executor.aggregate import make_segment_kernel, merge_op_for
+from tidb_tpu.executor.scan import make_pipeline_fn
+from tidb_tpu.expression.compiler import eval_expr
+from tidb_tpu.parallel.mesh import dcn_axis, shard_axis
+from tidb_tpu.parallel.partition import ShardedTable
+
+__all__ = [
+    "merge_state",
+    "make_agg_fragment",
+    "make_join_agg_fragment",
+    "dist_agg_fragment",
+    "dist_join_agg_fragment",
+    "repartition_by_key",
+]
+
+_HASH_MULT = np.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as int64
+
+_AXES = (dcn_axis, shard_axis)
+_SPEC = P(_AXES, None)
+
+
+def merge_state(state: Dict[str, jax.Array], axes=_AXES) -> Dict[str, jax.Array]:
+    """Merge per-shard partial agg states across mesh axes (final-agg step)."""
+    out = {}
+    for k, v in state.items():
+        op = merge_op_for(k)
+        if op == "sum":
+            out[k] = jax.lax.psum(v, axes)
+        elif op == "min":
+            out[k] = jax.lax.pmin(v, axes)
+        elif op == "max":
+            out[k] = jax.lax.pmax(v, axes)
+        else:
+            raise ValueError(f"unknown merge op {op}")
+    return out
+
+
+def _shard_chunk(st: ShardedTable, data, valid, sel, uid_map) -> Chunk:
+    cols = {}
+    for name in data:
+        uid = uid_map.get(name, name) if uid_map else name
+        cols[uid] = Column(data=data[name][0], valid=valid[name][0],
+                           type_=st.types[name])
+    return Chunk(cols, sel[0])
+
+
+def make_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
+                      domains: List[int], uid_map: Optional[Dict[str, str]] = None):
+    """Compile scan->filter->partial-agg->merge over the mesh.
+
+    Returns a jitted fn(data, valid, sel) -> merged [G]-state dict
+    (replicated; fetched once). Cache the returned fn — jit keys on
+    function identity, so rebuilding it means recompiling."""
+    pipeline = make_pipeline_fn(stages) if stages else (lambda c: c)
+    init_state, update, _ = make_segment_kernel(group_exprs, aggs, domains)
+
+    def per_shard(data, valid, sel):
+        chunk = pipeline(_shard_chunk(st, data, valid, sel, uid_map))
+        return merge_state(update(init_state(), chunk))
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=st.mesh,
+        in_specs=(_SPEC, _SPEC, _SPEC), out_specs=P(),
+    ))
+
+
+def dist_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
+                      domains: List[int], uid_map: Optional[Dict[str, str]] = None):
+    """Compile + run (convenience; see make_agg_fragment for the cached path)."""
+    fn = make_agg_fragment(st, stages, group_exprs, aggs, domains, uid_map)
+    return fn(st.data, st.valid, st.sel)
+
+
+# ---------------------------------------------------------------------------
+# repartition exchange
+# ---------------------------------------------------------------------------
+
+
+def _hash_dest(key: jax.Array, n_parts: int) -> jax.Array:
+    h = key * _HASH_MULT
+    return ((h % n_parts) + n_parts) % n_parts
+
+
+def repartition_by_key(arrays: Dict[str, jax.Array], sel: jax.Array,
+                       key: jax.Array, key_valid: jax.Array, n_parts: int,
+                       growth: float = 2.0,
+                       axes=_AXES) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array, jax.Array]:
+    """Exchange rows so equal keys land on the same shard (call in shard_map).
+
+    arrays: name -> [R]; returns (arrays', sel', key', overflow_count) with
+    [n_parts * cap] shapes where cap = ceil(growth * R / n_parts).
+    NULL keys never join, so such rows are dropped here (sel'=False).
+    """
+    R = sel.shape[0]
+    cap = int(np.ceil(growth * R / n_parts))
+    live = sel & key_valid
+    dest = jnp.where(live, _hash_dest(key, n_parts), n_parts)  # P = drop lane
+
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    seg_start = jnp.searchsorted(sorted_dest, jnp.arange(n_parts + 1, dtype=sorted_dest.dtype))
+    pos = jnp.arange(R) - seg_start[jnp.clip(sorted_dest, 0, n_parts)]
+    in_cap = (pos < cap) & (sorted_dest < n_parts)
+    overflow = jnp.sum((pos >= cap) & (sorted_dest < n_parts))
+
+    # scatter row `order[i]` into send slot [sorted_dest[i], pos[i]];
+    # dead/overflow rows land in a trash lane (row n_parts) that is sliced
+    # off before the exchange — slot (0,0) must never see collisions
+    slot_d = jnp.where(in_cap, sorted_dest, n_parts)
+    slot_p = jnp.where(in_cap, pos, 0)
+
+    def scatter(a):
+        buf = jnp.zeros((n_parts + 1, cap), dtype=a.dtype)
+        return buf.at[slot_d, slot_p].set(a[order])[:n_parts]
+
+    sent_sel = (jnp.zeros((n_parts + 1, cap), dtype=jnp.bool_)
+                .at[slot_d, slot_p].set(True))[:n_parts]
+    sent_key = scatter(key)
+    sent = {name: scatter(a) for name, a in arrays.items()}
+
+    recv_sel = jax.lax.all_to_all(sent_sel, axes, 0, 0).reshape(-1)
+    recv_key = jax.lax.all_to_all(sent_key, axes, 0, 0).reshape(-1)
+    recv = {name: jax.lax.all_to_all(a, axes, 0, 0).reshape(-1)
+            for name, a in sent.items()}
+    return recv, recv_sel, recv_key, overflow
+
+
+def _local_join(build_key, build_sel, probe_key, probe_sel):
+    """Sort build keys, searchsorted-probe. Returns (build_idx, hit).
+
+    Validity is a secondary sort key (valid rows first among equal keys),
+    not an in-band sentinel — a legitimate INT64_MAX key still joins."""
+    n = build_key.shape[0]
+    invalid = (~build_sel).astype(jnp.int32)
+    skeys, sinv, order = jax.lax.sort(
+        (build_key, invalid, jnp.arange(n)), num_keys=2)
+    pos = jnp.clip(jnp.searchsorted(skeys, probe_key), 0, n - 1)
+    hit = (skeys[pos] == probe_key) & (sinv[pos] == 0) & probe_sel
+    return order[pos], hit
+
+
+def make_join_agg_fragment(
+    probe: ShardedTable, build: ShardedTable,
+    probe_stages: List, build_stages: List,
+    probe_key_ir, build_key_ir,
+    probe_uids: Dict[str, str], build_uids: Dict[str, str],
+    post_stages: List, group_exprs, aggs, domains: List[int],
+    growth: float = 2.0,
+):
+    """Compile hash-repartition join + partial agg, all on device.
+
+    Pipeline per shard: scan probe/build -> pushed filters -> eval join
+    keys -> all_to_all exchange both sides -> local unique-build-key join
+    -> post-join filter/project -> partial segment agg -> collective merge.
+
+    Returns a jitted fn(p_data, p_valid, p_sel, b_data, b_valid, b_sel)
+    -> (state, overflow) — state is the merged [G] dict; overflow is the
+    total row count dropped by exchange capacity (must be 0; caller
+    re-runs with higher growth otherwise).
+    """
+    p_pipe = make_pipeline_fn(probe_stages) if probe_stages else (lambda c: c)
+    b_pipe = make_pipeline_fn(build_stages) if build_stages else (lambda c: c)
+    post_pipe = make_pipeline_fn(post_stages) if post_stages else (lambda c: c)
+    init_state, update, _ = make_segment_kernel(group_exprs, aggs, domains)
+    mesh = probe.mesh
+    n_parts = probe.n_parts
+
+    def per_shard(p_data, p_valid, p_sel, b_data, b_valid, b_sel):
+        pch = p_pipe(_shard_chunk(probe, p_data, p_valid, p_sel, probe_uids))
+        bch = b_pipe(_shard_chunk(build, b_data, b_valid, b_sel, build_uids))
+
+        pk, pkv = eval_expr(probe_key_ir, pch)
+        bk, bkv = eval_expr(build_key_ir, bch)
+        pk = pk.astype(jnp.int64)
+        bk = bk.astype(jnp.int64)
+
+        def flat(ch: Chunk):
+            arrs = {}
+            for uid, col in ch.columns.items():
+                arrs[uid + ".d"] = col.data
+                arrs[uid + ".v"] = col.valid
+            return arrs
+
+        def unflat(arrs, ref: Chunk, sel):
+            cols = {}
+            for uid, col in ref.columns.items():
+                cols[uid] = Column(data=arrs[uid + ".d"], valid=arrs[uid + ".v"],
+                                   type_=col.type_)
+            return Chunk(cols, sel)
+
+        pr, pr_sel, pr_key, p_ovf = repartition_by_key(
+            flat(pch), pch.sel, pk, pkv, n_parts, growth)
+        br, br_sel, br_key, b_ovf = repartition_by_key(
+            flat(bch), bch.sel, bk, bkv, n_parts, growth)
+
+        bidx, hit = _local_join(br_key, br_sel, pr_key, pr_sel)
+        joined_cols = dict(pr)
+        for uid, col in bch.columns.items():
+            joined_cols[uid + ".d"] = br[uid + ".d"][bidx]
+            joined_cols[uid + ".v"] = br[uid + ".v"][bidx] & hit
+        ref_cols = dict(pch.columns)
+        ref_cols.update(bch.columns)
+        ref = Chunk(ref_cols, pch.sel)  # types template only
+        joined = unflat(joined_cols, ref, hit)
+
+        joined = post_pipe(joined)
+        state = merge_state(update(init_state(), joined))
+        ovf = jax.lax.psum(p_ovf + b_ovf, _AXES)
+        return state, ovf
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(_SPEC,) * 6, out_specs=(P(), P()),
+    ))
+
+
+def dist_join_agg_fragment(probe: ShardedTable, build: ShardedTable, *args, **kwargs):
+    """Compile + run (convenience; see make_join_agg_fragment)."""
+    fn = make_join_agg_fragment(probe, build, *args, **kwargs)
+    return fn(probe.data, probe.valid, probe.sel,
+              build.data, build.valid, build.sel)

@@ -1,0 +1,218 @@
+"""Distributed executors: plug mesh fragments into the Volcano tree.
+
+build_dist_executor mirrors executor/builder.py but intercepts plan
+shapes that can run as one collective fragment across the mesh:
+
+  * HashAgg(segment) over fused Selection/Projection stages on one scan
+    -> dist_agg_fragment (scan+filter+partial agg per shard, psum merge)
+  * HashAgg(segment) over Join(scan-side, scan-side) with int equi-keys
+    -> dist_join_agg_fragment (all_to_all repartition + local join)
+
+Anything else falls back to the single-chip executors — exactly how the
+reference falls back from coprocessor pushdown to root-task execution
+when a subtree isn't pushable (ref: planner "cop task" vs "root task").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+from tidb_tpu.errors import ExecutionError
+from tidb_tpu.executor.aggregate import HashAggExec
+from tidb_tpu.executor.builder import build_executor, peel_stages, scan_stages_for
+from tidb_tpu.executor.base import Executor
+from tidb_tpu.executor.scan import ProjectionExec, SelectionExec
+from tidb_tpu.executor.sort import LimitExec, SortExec, TopNExec
+from tidb_tpu.parallel.distsql import make_agg_fragment, make_join_agg_fragment
+from tidb_tpu.parallel.partition import ShardedTable, shard_table
+from tidb_tpu.planner.physical import (
+    PHashAgg,
+    PHashJoin,
+    PLimit,
+    PProjection,
+    PScan,
+    PSelection,
+    PSort,
+    PTopN,
+    PhysicalPlan,
+)
+
+__all__ = ["ShardCache", "build_dist_executor", "DistAggExec", "DistJoinAggExec"]
+
+
+class ShardCache:
+    """(table identity, version) -> ShardedTable. The region-cache analogue:
+    invalidated by table mutation (version bump), not by epoch.
+
+    The entry pins the host table object so a recycled id() can never alias
+    a different table. Also caches compiled collective fragments (keyed by
+    plan signature) — shard_map closures recompile per jit identity, and a
+    repeated query must not pay XLA compilation twice."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._cache: Dict[int, Tuple[object, int, ShardedTable]] = {}
+        self.fragments: Dict[str, object] = {}
+
+    def get(self, table) -> ShardedTable:
+        hit = self._cache.get(id(table))
+        if hit is not None:
+            held, version, st = hit
+            if held is table and version == table.version:
+                return st
+        st = shard_table(table, self.mesh)
+        self._cache[id(table)] = (table, table.version, st)
+        return st
+
+
+def _collapse_to_scan(plan: PhysicalPlan):
+    """Fuse Selection/Projection chain onto a single scan; return
+    (scan, stages) or None if the subtree isn't a pushable pipeline."""
+    stages, base = peel_stages(plan)
+    if not isinstance(base, PScan) or base.table is None:
+        return None
+    return base, scan_stages_for(base, stages)
+
+
+def _uid_map(scan: PScan) -> Dict[str, str]:
+    return {c.name: c.uid for c in scan.schema}
+
+
+class DistAggExec(HashAggExec):
+    """Segment agg whose input is a sharded scan fragment on the mesh."""
+
+    def __init__(self, plan: PHashAgg, scan: PScan, stages, cache: ShardCache):
+        super().__init__(plan.schema, None, plan.group_exprs, plan.group_uids,
+                         plan.aggs, "segment",
+                         segment_sizes=getattr(plan, "segment_sizes", None))
+        self.children = []
+        self._scan = scan
+        self._stages = stages
+        self._cache = cache
+
+    def _run_segment(self):
+        sizes = self.segment_sizes or []
+        domains = [s + 1 for s in sizes]
+        st = self._cache.get(self._scan.table)
+        key = ("agg", repr((self._stages, self.group_exprs, self.aggs, domains)),
+               st.n_parts, st.rows_per_part, id(self._scan.table))
+        fn = self._cache.fragments.get(key)
+        if fn is None:
+            fn = make_agg_fragment(st, self._stages, self.group_exprs,
+                                   self.aggs, domains, uid_map=_uid_map(self._scan))
+            self._cache.fragments[key] = fn
+        state = fn(st.data, st.valid, st.sel)
+        self._finalize_segment_state(state, domains)
+
+
+class DistJoinAggExec(HashAggExec):
+    """Segment agg over a repartition join of two sharded scans."""
+
+    def __init__(self, plan: PHashAgg, join: PHashJoin,
+                 probe_scan, probe_stages, build_scan, build_stages,
+                 post_stages, cache: ShardCache):
+        super().__init__(plan.schema, None, plan.group_exprs, plan.group_uids,
+                         plan.aggs, "segment",
+                         segment_sizes=getattr(plan, "segment_sizes", None))
+        self.children = []
+        self._join = join
+        self._probe_scan, self._probe_stages = probe_scan, probe_stages
+        self._build_scan, self._build_stages = build_scan, build_stages
+        self._post_stages = post_stages
+        self._cache = cache
+
+    def _run_segment(self):
+        sizes = self.segment_sizes or []
+        domains = [s + 1 for s in sizes]
+        join = self._join
+        probe_idx = 1 - join.build_side
+        probe_keys = join.eq_left if probe_idx == 0 else join.eq_right
+        build_keys = join.eq_right if join.build_side == 1 else join.eq_left
+        probe_st = self._cache.get(self._probe_scan.table)
+        build_st = self._cache.get(self._build_scan.table)
+        sig = repr((self._probe_stages, self._build_stages, probe_keys[0],
+                    build_keys[0], self._post_stages, self.group_exprs,
+                    self.aggs, domains))
+        growth = 2.0
+        for _ in range(4):
+            key = ("joinagg", sig, growth, probe_st.n_parts,
+                   probe_st.rows_per_part, build_st.rows_per_part,
+                   id(self._probe_scan.table), id(self._build_scan.table))
+            fn = self._cache.fragments.get(key)
+            if fn is None:
+                fn = make_join_agg_fragment(
+                    probe_st, build_st,
+                    self._probe_stages, self._build_stages,
+                    probe_keys[0], build_keys[0],
+                    _uid_map(self._probe_scan), _uid_map(self._build_scan),
+                    self._post_stages, self.group_exprs, self.aggs, domains,
+                    growth=growth,
+                )
+                self._cache.fragments[key] = fn
+            state, ovf = fn(probe_st.data, probe_st.valid, probe_st.sel,
+                            build_st.data, build_st.valid, build_st.sel)
+            if int(ovf) == 0:
+                break
+            growth *= 2  # skewed exchange: retry with bigger buckets
+        else:
+            raise ExecutionError("join exchange overflow persisted at growth=16x")
+        self._finalize_segment_state(state, domains)
+
+
+def _try_dist_agg(plan: PHashAgg, cache: ShardCache) -> Optional[Executor]:
+    if plan.strategy != "segment":
+        return None
+    scan_frag = _collapse_to_scan(plan.child)
+    if scan_frag is not None:
+        scan, stages = scan_frag
+        return DistAggExec(plan, scan, stages, cache)
+    # join underneath?
+    post_stages, node = peel_stages(plan.child)
+    if not isinstance(node, PHashJoin) or node.kind != "inner":
+        return None
+    if len(node.eq_left) != 1 or node.other_cond is not None:
+        return None
+    probe_idx = 1 - node.build_side
+    probe_frag = _collapse_to_scan(node.children[probe_idx])
+    build_frag = _collapse_to_scan(node.children[node.build_side])
+    if probe_frag is None or build_frag is None:
+        return None
+    # unique-build-key requirement: trust the planner only when the build
+    # key is the build table's primary key
+    build_scan = build_frag[0]
+    build_keys = node.eq_right if node.build_side == 1 else node.eq_left
+    from tidb_tpu.expression.expr import ColumnRef
+
+    pk = getattr(build_scan.table.schema, "primary_key", None)
+    key_ir = build_keys[0]
+    key_col = key_ir.name if isinstance(key_ir, ColumnRef) else None
+    pk_uids = []
+    if pk:
+        by_name = {c.name: c.uid for c in build_scan.schema}
+        pk_uids = [by_name.get(n) for n in pk]
+    if not (len(pk_uids) == 1 and key_col == pk_uids[0]):
+        return None
+    return DistJoinAggExec(plan, node, probe_frag[0], probe_frag[1],
+                           build_frag[0], build_frag[1], post_stages, cache)
+
+
+def build_dist_executor(plan: PhysicalPlan, cache: ShardCache) -> Executor:
+    """Build an executor tree, running distributable fragments on the mesh."""
+    if isinstance(plan, PHashAgg):
+        ex = _try_dist_agg(plan, cache)
+        if ex is not None:
+            return ex
+        return build_executor(plan)
+    if isinstance(plan, PProjection):
+        return ProjectionExec(plan.schema, build_dist_executor(plan.child, cache), plan.exprs)
+    if isinstance(plan, PSelection):
+        return SelectionExec(plan.schema, build_dist_executor(plan.child, cache), plan.cond)
+    if isinstance(plan, PSort):
+        return SortExec(plan.schema, build_dist_executor(plan.child, cache), plan.items)
+    if isinstance(plan, PTopN):
+        return TopNExec(plan.schema, build_dist_executor(plan.child, cache), plan.items,
+                        plan.count, plan.offset)
+    if isinstance(plan, PLimit):
+        return LimitExec(plan.schema, build_dist_executor(plan.child, cache), plan.count, plan.offset)
+    return build_executor(plan)

@@ -25,12 +25,25 @@ __all__ = ["Session"]
 
 class Session:
     def __init__(self, catalog: Optional[Catalog] = None, db: str = "test",
-                 chunk_capacity: int = 1 << 16):
+                 chunk_capacity: int = 1 << 16, mesh=None):
         self.catalog = catalog or Catalog()
         self.db = db
         self.chunk_capacity = chunk_capacity
         self.vars: dict = {}
         self.user_vars: dict = {}
+        self.mesh = mesh
+        self._shard_cache = None
+        if mesh is not None:
+            from tidb_tpu.parallel.executor import ShardCache
+
+            self._shard_cache = ShardCache(mesh)
+
+    def _build_root(self, phys):
+        if self._shard_cache is not None:
+            from tidb_tpu.parallel.executor import build_dist_executor
+
+            return build_dist_executor(phys, self._shard_cache)
+        return build_executor(phys)
 
     # ------------------------------------------------------------------
 
@@ -68,7 +81,7 @@ class Session:
 
     def _run_select(self, stmt) -> ResultSet:
         phys = self._plan_select(stmt)
-        root = build_executor(phys)
+        root = self._build_root(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
         if n_vis is None and hasattr(phys, "children") and phys.children:
             # Sort/Limit on top of the projection keep hidden sort columns
