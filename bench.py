@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Benchmark driver: TPC-H Q1 end-to-end throughput on the current JAX
+backend (the BASELINE.json "TPC-H rows/sec/chip" metric, Q1 config).
+
+Prints ONE json line:
+  {"metric": "tpch_q1_rows_per_sec", "value": N, "unit": "rows/sec",
+   "vs_baseline": R}
+
+vs_baseline is measured against an in-process CPU SQL executor (stdlib
+sqlite3) running the identical query over the identical data — the
+stand-in for the reference's CPU vectorized executor, which is
+unavailable in this environment (BASELINE.json ships "published": {};
+see BASELINE.md). The north-star target is >=5x the CPU executor.
+
+Env knobs: BENCH_SF (default 1.0), BENCH_REPS (default 3),
+BENCH_CHUNK (default 2^20 rows), BENCH_ORACLE=0 to skip the sqlite
+baseline (vs_baseline reported as 0.0).
+"""
+
+import json
+import os
+import sys
+import time
+
+SF = float(os.environ.get("BENCH_SF", "1.0"))
+REPS = int(os.environ.get("BENCH_REPS", "3"))
+CAP = int(os.environ.get("BENCH_CHUNK", str(1 << 20)))
+ORACLE = os.environ.get("BENCH_ORACLE", "1") != "0"
+
+Q1 = """select l_returnflag, l_linestatus,
+               sum(l_quantity) as sum_qty,
+               sum(l_extendedprice) as sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+               avg(l_quantity) as avg_qty,
+               avg(l_extendedprice) as avg_price,
+               avg(l_discount) as avg_disc,
+               count(*) as count_order
+        from lineitem
+        where l_shipdate <= date '1998-12-01' - interval '90' day
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus"""
+
+Q1_SQLITE = Q1.replace("date '1998-12-01' - interval '90' day", "'1998-09-02'")
+
+
+def main():
+    import tidb_tpu  # noqa: F401  (jax x64 config)
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.tpch import load_tpch
+
+    t0 = time.perf_counter()
+    s = Session(chunk_capacity=CAP)
+    counts = load_tpch(s.catalog, sf=SF)
+    rows = counts["lineitem"]
+    gen_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    warm = s.query(Q1)  # compile + warmup
+    warm_s = time.perf_counter() - t0
+    assert len(warm) >= 1
+
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        got = s.query(Q1)
+        best = min(best, time.perf_counter() - t0)
+    rps = rows / best
+
+    vs = 0.0
+    cpu_s = None
+    if ORACLE:
+        from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+
+        t0 = time.perf_counter()
+        conn = mirror_to_sqlite(s.catalog, tables=["lineitem"])
+        mirror_s = time.perf_counter() - t0
+        cpu_s = float("inf")
+        for _ in range(max(1, REPS - 1)):
+            t0 = time.perf_counter()
+            want = conn.execute(Q1_SQLITE).fetchall()
+            cpu_s = min(cpu_s, time.perf_counter() - t0)
+        ok, msg = rows_equal(got, want, ordered=True)
+        if not ok:
+            print(f"RESULT MISMATCH vs sqlite oracle: {msg}", file=sys.stderr)
+            sys.exit(1)
+        vs = cpu_s / best
+        print(
+            f"# sf={SF} rows={rows} gen={gen_s:.1f}s warmup={warm_s:.2f}s "
+            f"best={best * 1e3:.1f}ms sqlite_mirror={mirror_s:.1f}s "
+            f"sqlite_best={cpu_s * 1e3:.1f}ms",
+            file=sys.stderr,
+        )
+
+    print(json.dumps({
+        "metric": "tpch_q1_rows_per_sec",
+        "value": round(rps, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

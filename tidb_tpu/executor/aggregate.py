@@ -21,7 +21,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -32,8 +31,9 @@ from tidb_tpu.executor.base import ExecContext, Executor
 from tidb_tpu.expression.compiler import eval_expr
 from tidb_tpu.planner.logical import AggSpec
 from tidb_tpu.types import FLOAT64, SQLType, TypeKind
+from tidb_tpu.utils.jitcache import cached_jit
 
-__all__ = ["HashAggExec", "make_segment_kernel", "MERGE_OPS"]
+__all__ = ["HashAggExec", "make_segment_kernel", "MERGE_OPS", "merge_op_for"]
 
 
 def _min_identity(dtype):
@@ -52,7 +52,7 @@ def _max_identity(dtype):
 # Key suffix -> collective: the distributed path (parallel/distsql.py) maps
 # these onto lax.psum / lax.pmin / lax.pmax over the shard mesh axis —
 # exactly the partial/final split of the reference's HashAggExec pipeline.
-MERGE_OPS = {"occ": "sum", ".sum": "sum", ".cnt": "sum", ".min": "min", ".max": "max"}
+MERGE_OPS = {".sum": "sum", ".cnt": "sum", ".min": "min", ".max": "max"}
 
 
 def merge_op_for(key: str) -> str:
@@ -173,9 +173,11 @@ class HashAggExec(Executor):
         sizes = self.segment_sizes or []
         domains = [s + 1 for s in sizes]  # +1 slot for NULL keys
         init_state, update, _ = make_segment_kernel(self.group_exprs, self.aggs, domains)
-        group_exprs = self.group_exprs
 
-        update = jax.jit(update, donate_argnums=0)
+        update = cached_jit(
+            "segagg", repr((self.group_exprs, self.aggs, domains)),
+            lambda: update, donate_argnums=0,
+        )
         state = init_state()
         for chunk in self.children[0].chunks():
             state = update(state, chunk)
@@ -273,7 +275,9 @@ class HashAggExec(Executor):
                     outs.append(eval_expr(a.arg, chunk))
             return outs, chunk.sel
 
-        eval_all = jax.jit(eval_all)
+        eval_all = cached_jit(
+            "genagg", repr((group_exprs, [a.arg for a in aggs])), lambda: eval_all
+        )
 
         for chunk in self.children[0].chunks():
             outs, sel = eval_all(chunk)

@@ -33,6 +33,7 @@ from tidb_tpu.chunk.chunk import Chunk
 from tidb_tpu.chunk.column import Column
 from tidb_tpu.errors import ExecutionError, UnsupportedError
 from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
 from tidb_tpu.types import TypeKind
 
@@ -83,7 +84,7 @@ class HashJoinExec(Executor):
             outs = [eval_expr(k, chunk) for k in keys_ir]
             return outs, chunk.sel
 
-        eval_keys = jax.jit(eval_keys)
+        eval_keys = cached_jit("joinkeys", repr(keys_ir), lambda: eval_keys)
 
         key_cols = [[] for _ in (keys_ir or [None])]
         key_ok = []

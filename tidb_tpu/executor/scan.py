@@ -12,7 +12,6 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional
 
-import jax
 import numpy as np
 
 from tidb_tpu.chunk.chunk import Chunk
@@ -20,6 +19,7 @@ from tidb_tpu.chunk.column import Column
 from tidb_tpu.executor.base import ExecContext, Executor
 from tidb_tpu.expression.compiler import compile_expr, compile_predicate
 from tidb_tpu.planner.binder import PlanCol
+from tidb_tpu.utils.jitcache import cached_jit
 
 __all__ = ["TableScanExec", "make_pipeline_fn", "SelectionExec", "ProjectionExec"]
 
@@ -61,7 +61,11 @@ class TableScanExec(Executor):
     def open(self, ctx: ExecContext) -> None:
         self.ctx = ctx
         cap = ctx.chunk_capacity
-        self._fn = jax.jit(make_pipeline_fn(self.stages)) if self.stages else None
+        self._fn = (
+            cached_jit("pipeline", repr(self.stages), lambda: make_pipeline_fn(self.stages))
+            if self.stages
+            else None
+        )
         self._slices = []
         if self.table is not None:
             n = self.table.n
@@ -113,8 +117,11 @@ class SelectionExec(Executor):
 
     def open(self, ctx: ExecContext) -> None:
         super().open(ctx)
-        pred = compile_predicate(self.cond)
-        self._fn = jax.jit(lambda ch: ch.filter(pred(ch)))
+        def build():
+            pred = compile_predicate(self.cond)
+            return lambda ch: ch.filter(pred(ch))
+
+        self._fn = cached_jit("filter", repr(self.cond), build)
 
     def next(self) -> Optional[Chunk]:
         ch = self.children[0].next()
@@ -131,8 +138,13 @@ class ProjectionExec(Executor):
 
     def open(self, ctx: ExecContext) -> None:
         super().open(ctx)
-        pairs = [(c.uid, compile_expr(e)) for c, e in zip(self.schema, self.exprs)]
-        self._fn = jax.jit(lambda ch: ch.project({uid: f(ch) for uid, f in pairs}))
+        uids = [c.uid for c in self.schema]
+
+        def build():
+            pairs = [(uid, compile_expr(e)) for uid, e in zip(uids, self.exprs)]
+            return lambda ch: ch.project({uid: f(ch) for uid, f in pairs})
+
+        self._fn = cached_jit("project", repr(list(zip(uids, self.exprs))), build)
 
     def next(self) -> Optional[Chunk]:
         ch = self.children[0].next()

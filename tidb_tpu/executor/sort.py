@@ -8,13 +8,13 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tidb_tpu.chunk.chunk import Chunk
 from tidb_tpu.chunk.column import Column
 from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_expr
 from tidb_tpu.types import TypeKind
 
@@ -33,7 +33,7 @@ class _Materializing(Executor):
             keys = [f(ch) for f in key_fns]
             return keys, ch
 
-        eval_chunk = jax.jit(eval_chunk)
+        eval_chunk = cached_jit("sortkeys", repr(sort_items), lambda: eval_chunk)
 
         cols = {uid: ([], []) for uid in uids}
         keys: List[Tuple[List, List]] = [([], []) for _ in sort_items]

@@ -65,12 +65,12 @@ def merge_state(state: Dict[str, jax.Array], axes=_AXES) -> Dict[str, jax.Array]
     return out
 
 
-def _shard_chunk(st: ShardedTable, data, valid, sel, uid_map) -> Chunk:
+def _shard_chunk(types: Dict, data, valid, sel, uid_map) -> Chunk:
     cols = {}
     for name in data:
         uid = uid_map.get(name, name) if uid_map else name
         cols[uid] = Column(data=data[name][0], valid=valid[name][0],
-                           type_=st.types[name])
+                           type_=types[name])
     return Chunk(cols, sel[0])
 
 
@@ -80,16 +80,20 @@ def make_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
 
     Returns a jitted fn(data, valid, sel) -> merged [G]-state dict
     (replicated; fetched once). Cache the returned fn — jit keys on
-    function identity, so rebuilding it means recompiling."""
+    function identity, so rebuilding it means recompiling. The closure
+    deliberately captures only st's metadata (types/mesh), never the
+    ShardedTable itself, so a cached fragment cannot pin retired [P,R]
+    device arrays."""
     pipeline = make_pipeline_fn(stages) if stages else (lambda c: c)
     init_state, update, _ = make_segment_kernel(group_exprs, aggs, domains)
+    types, mesh = dict(st.types), st.mesh
 
     def per_shard(data, valid, sel):
-        chunk = pipeline(_shard_chunk(st, data, valid, sel, uid_map))
+        chunk = pipeline(_shard_chunk(types, data, valid, sel, uid_map))
         return merge_state(update(init_state(), chunk))
 
     return jax.jit(jax.shard_map(
-        per_shard, mesh=st.mesh,
+        per_shard, mesh=mesh,
         in_specs=(_SPEC, _SPEC, _SPEC), out_specs=P(),
     ))
 
@@ -194,10 +198,12 @@ def make_join_agg_fragment(
     init_state, update, _ = make_segment_kernel(group_exprs, aggs, domains)
     mesh = probe.mesh
     n_parts = probe.n_parts
+    # capture metadata only — never the ShardedTables (see make_agg_fragment)
+    probe_types, build_types = dict(probe.types), dict(build.types)
 
     def per_shard(p_data, p_valid, p_sel, b_data, b_valid, b_sel):
-        pch = p_pipe(_shard_chunk(probe, p_data, p_valid, p_sel, probe_uids))
-        bch = b_pipe(_shard_chunk(build, b_data, b_valid, b_sel, build_uids))
+        pch = p_pipe(_shard_chunk(probe_types, p_data, p_valid, p_sel, probe_uids))
+        bch = b_pipe(_shard_chunk(build_types, b_data, b_valid, b_sel, build_uids))
 
         pk, pkv = eval_expr(probe_key_ir, pch)
         bk, bkv = eval_expr(build_key_ir, bch)

@@ -15,7 +15,10 @@ when a subtree isn't pushable (ref: planner "cop task" vs "root task").
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, Optional, Tuple
+
+from tidb_tpu.utils.lru import get_or_build, touch
 
 
 from tidb_tpu.errors import ExecutionError
@@ -46,24 +49,50 @@ class ShardCache:
     invalidated by table mutation (version bump), not by epoch.
 
     The entry pins the host table object so a recycled id() can never alias
-    a different table. Also caches compiled collective fragments (keyed by
-    plan signature) — shard_map closures recompile per jit identity, and a
-    repeated query must not pay XLA compilation twice."""
+    a different table; a small LRU bounds how many dead tables' [P,R]
+    device copies can stay resident after drops/replacements. Also caches
+    compiled collective fragments (keyed by plan signature) — shard_map
+    closures recompile per jit identity, and a repeated query must not pay
+    XLA compilation twice — and the proven exchange growth per join
+    signature so skewed joins don't re-run known-overflowing fragments."""
+
+    MAX_TABLES = 16
+    MAX_FRAGMENTS = 128
 
     def __init__(self, mesh):
         self.mesh = mesh
-        self._cache: Dict[int, Tuple[object, int, ShardedTable]] = {}
-        self.fragments: Dict[str, object] = {}
+        self._cache: "OrderedDict[int, Tuple[object, int, ShardedTable]]" = OrderedDict()
+        self.fragments: "OrderedDict[object, object]" = OrderedDict()
+        # bounded with fragments' LRU discipline: one entry per join
+        # signature+data version, pruned opportunistically
+        self.growth: "OrderedDict[object, float]" = OrderedDict()
 
     def get(self, table) -> ShardedTable:
         hit = self._cache.get(id(table))
         if hit is not None:
             held, version, st = hit
             if held is table and version == table.version:
+                self._cache.move_to_end(id(table))
                 return st
         st = shard_table(table, self.mesh)
         self._cache[id(table)] = (table, table.version, st)
+        self._cache.move_to_end(id(table))
+        while len(self._cache) > self.MAX_TABLES:
+            self._cache.popitem(last=False)
         return st
+
+    def get_fragment(self, key, build):
+        return get_or_build(self.fragments, key, build, self.MAX_FRAGMENTS)
+
+    def get_growth(self, gkey) -> float:
+        g = self.growth.get(gkey)
+        if g is None:
+            return 2.0
+        self.growth.move_to_end(gkey)
+        return g
+
+    def put_growth(self, gkey, growth: float) -> None:
+        touch(self.growth, gkey, growth, self.MAX_FRAGMENTS)
 
 
 def _collapse_to_scan(plan: PhysicalPlan):
@@ -96,12 +125,12 @@ class DistAggExec(HashAggExec):
         domains = [s + 1 for s in sizes]
         st = self._cache.get(self._scan.table)
         key = ("agg", repr((self._stages, self.group_exprs, self.aggs, domains)),
-               st.n_parts, st.rows_per_part, id(self._scan.table))
-        fn = self._cache.fragments.get(key)
-        if fn is None:
-            fn = make_agg_fragment(st, self._stages, self.group_exprs,
-                                   self.aggs, domains, uid_map=_uid_map(self._scan))
-            self._cache.fragments[key] = fn
+               st.n_parts, st.rows_per_part, st.serial)
+        fn = self._cache.get_fragment(
+            key,
+            lambda: make_agg_fragment(st, self._stages, self.group_exprs,
+                                      self.aggs, domains, uid_map=_uid_map(self._scan)),
+        )
         state = fn(st.data, st.valid, st.sel)
         self._finalize_segment_state(state, domains)
 
@@ -134,25 +163,30 @@ class DistJoinAggExec(HashAggExec):
         sig = repr((self._probe_stages, self._build_stages, probe_keys[0],
                     build_keys[0], self._post_stages, self.group_exprs,
                     self.aggs, domains))
-        growth = 2.0
-        for _ in range(4):
+        # start from the growth that last worked for this signature on this
+        # data version so a skewed join doesn't replay its known-overflowing
+        # fragments; keyed on serials so it resets when the data changes
+        gkey = (sig, probe_st.serial, build_st.serial)
+        growth = self._cache.get_growth(gkey)
+        while growth <= 16.0:
             key = ("joinagg", sig, growth, probe_st.n_parts,
                    probe_st.rows_per_part, build_st.rows_per_part,
-                   id(self._probe_scan.table), id(self._build_scan.table))
-            fn = self._cache.fragments.get(key)
-            if fn is None:
-                fn = make_join_agg_fragment(
+                   probe_st.serial, build_st.serial)
+            fn = self._cache.get_fragment(
+                key,
+                lambda: make_join_agg_fragment(
                     probe_st, build_st,
                     self._probe_stages, self._build_stages,
                     probe_keys[0], build_keys[0],
                     _uid_map(self._probe_scan), _uid_map(self._build_scan),
                     self._post_stages, self.group_exprs, self.aggs, domains,
                     growth=growth,
-                )
-                self._cache.fragments[key] = fn
+                ),
+            )
             state, ovf = fn(probe_st.data, probe_st.valid, probe_st.sel,
                             build_st.data, build_st.valid, build_st.sel)
             if int(ovf) == 0:
+                self._cache.put_growth(gkey, growth)
                 break
             growth *= 2  # skewed exchange: retry with bigger buckets
         else:
@@ -204,9 +238,15 @@ def build_dist_executor(plan: PhysicalPlan, cache: ShardCache) -> Executor:
         if ex is not None:
             return ex
         return build_executor(plan)
-    if isinstance(plan, PProjection):
-        return ProjectionExec(plan.schema, build_dist_executor(plan.child, cache), plan.exprs)
-    if isinstance(plan, PSelection):
+    if isinstance(plan, (PProjection, PSelection)):
+        # a fusible chain over a plain scan has no collective fragment —
+        # hand the whole thing to the single-chip builder so it fuses into
+        # one scan pipeline instead of per-node executors
+        _, base = peel_stages(plan)
+        if isinstance(base, PScan):
+            return build_executor(plan)
+        if isinstance(plan, PProjection):
+            return ProjectionExec(plan.schema, build_dist_executor(plan.child, cache), plan.exprs)
         return SelectionExec(plan.schema, build_dist_executor(plan.child, cache), plan.cond)
     if isinstance(plan, PSort):
         return SortExec(plan.schema, build_dist_executor(plan.child, cache), plan.items)

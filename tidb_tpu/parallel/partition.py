@@ -12,7 +12,8 @@ rows are born columnar and the "region boundary" is a static row range.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import itertools
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import jax
@@ -38,6 +39,9 @@ class ShardedTable:
     sel: jax.Array                  # [P, R] bool: live rows
     types: Dict[str, SQLType]
     dicts: Dict[str, object]        # string dictionaries (host-side)
+    # process-unique, never-recycled id: cache keys built from it can never
+    # alias a different sharding the way id()-based keys can after GC
+    serial: int = field(default_factory=itertools.count().__next__)
 
 
 

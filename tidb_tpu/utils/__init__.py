@@ -1,0 +1,2 @@
+"""Cross-cutting utilities (ref: util/ — memory tracking, execdetails,
+plan cache)."""

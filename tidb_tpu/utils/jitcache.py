@@ -1,0 +1,49 @@
+"""Compiled-fragment cache (the plan-cache/prepared-statement analogue,
+ref: planner plan cache reusing compiled plans across executions).
+
+jax.jit keys on Python function identity, and the executors build fresh
+closures per open() — without this cache every execution of the same
+query would re-trace and re-compile its device fragments. Keys are reprs
+of the compiled IR: binder uids are deterministic per statement (a fresh
+Binder numbers from zero for every plan), so the same SQL text always
+produces the same key, while any difference in baked constants (e.g.
+dictionary codes for string literals) changes it.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Tuple
+
+import jax
+
+from tidb_tpu.utils.lru import get_or_build
+
+__all__ = ["cached_jit", "clear", "size"]
+
+# LRU-bounded: keys bake in value-level constants (dictionary codes for
+# string literals), so mutating workloads mint new keys over time — old
+# executables must age out rather than accumulate for the process lifetime.
+MAX_ENTRIES = 512
+
+_CACHE: "OrderedDict[Tuple[str, str], Callable]" = OrderedDict()
+
+
+def cached_jit(ns: str, key: str, build: Callable[[], Callable], **jit_kwargs) -> Callable:
+    """Return a jitted fn for (ns, key), building it on first use.
+
+    `build` returns the raw python function; it is only called on a miss.
+    The jitted fn itself remains shape-polymorphic (jax retraces per
+    shape under the same identity), so one entry serves all chunk sizes.
+    """
+    return get_or_build(
+        _CACHE, (ns, key), lambda: jax.jit(build(), **jit_kwargs), MAX_ENTRIES
+    )
+
+
+def clear() -> None:
+    _CACHE.clear()
+
+
+def size() -> int:
+    return len(_CACHE)

@@ -1,0 +1,31 @@
+"""Tiny bounded-LRU get-or-build over an OrderedDict — shared by the
+jit-fragment cache, the shard cache, and the exchange-growth memo so the
+recency/eviction discipline lives in exactly one place."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, TypeVar
+
+__all__ = ["get_or_build", "touch"]
+
+V = TypeVar("V")
+
+
+def get_or_build(od: "OrderedDict", key, build: Callable[[], V], max_entries: int) -> V:
+    v = od.get(key)
+    if v is None and key not in od:
+        v = build()
+        od[key] = v
+    od.move_to_end(key)
+    while len(od) > max_entries:
+        od.popitem(last=False)
+    return od[key]
+
+
+def touch(od: "OrderedDict", key, value, max_entries: int) -> None:
+    """Insert/overwrite `key` as most-recently-used and trim."""
+    od[key] = value
+    od.move_to_end(key)
+    while len(od) > max_entries:
+        od.popitem(last=False)
