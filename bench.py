@@ -46,11 +46,15 @@ Q1_SQLITE = Q1.replace("date '1998-12-01' - interval '90' day", "'1998-09-02'")
 
 def main():
     import tidb_tpu  # noqa: F401  (jax x64 config)
+    from tidb_tpu.parallel import make_mesh
     from tidb_tpu.session import Session
     from tidb_tpu.storage.tpch import load_tpch
 
     t0 = time.perf_counter()
-    s = Session(chunk_capacity=CAP)
+    # mesh session even on one chip: tables stay device-resident in the
+    # shard cache and each query is one collective fragment dispatch
+    mesh = make_mesh()
+    s = Session(chunk_capacity=CAP, mesh=mesh)
     counts = load_tpch(s.catalog, sf=SF)
     rows = counts["lineitem"]
     gen_s = time.perf_counter() - t0

@@ -42,8 +42,11 @@ class Column:
         valid: Optional[np.ndarray] = None,
         capacity: Optional[int] = None,
     ) -> "Column":
-        """Pad host data up to `capacity` (defaults to len(data)) and move it
-        to device. Padding rows get valid=False and zero data."""
+        """Pad host data up to `capacity` (defaults to len(data)). Padding
+        rows get valid=False and zero data. The arrays stay host-resident:
+        device transfer happens lazily when the column crosses a jit
+        boundary, so small root-task results (post-agg groups, sorted
+        output) never round-trip through HBM at all."""
         data = np.asarray(data)
         n = len(data)
         cap = n if capacity is None else capacity
@@ -54,7 +57,7 @@ class Column:
         buf[:n] = data.astype(dt, copy=False)
         v = np.zeros(cap, dtype=np.bool_)
         v[:n] = True if valid is None else np.asarray(valid)[:n]
-        return Column(jnp.asarray(buf), jnp.asarray(v), type_)
+        return Column(buf, v, type_)
 
     @staticmethod
     def full(capacity: int, value, type_: SQLType) -> "Column":

@@ -187,7 +187,11 @@ class HashAggExec(Executor):
         """Host finalize of [G]-shaped accumulators: unpack occupied groups.
         Shared with the distributed executors (parallel/executor.py), which
         produce the same state via collective merge."""
-        host = {k: np.asarray(v) for k, v in state.items()}
+        # one batched fetch: on a remote/tunneled device, per-key np.asarray
+        # would pay a round trip per state array
+        import jax
+
+        host = jax.device_get(state)
         if self.group_exprs:
             occupied = np.nonzero(host["occ"] > 0)[0]
         else:
@@ -247,7 +251,7 @@ class HashAggExec(Executor):
             m = end - start
             sel = np.zeros(cap, dtype=np.bool_)
             sel[:m] = True
-            self._out.append(Chunk(cols, jnp.asarray(sel)))
+            self._out.append(Chunk(cols, sel))
             if n == 0:
                 break
 

@@ -79,8 +79,6 @@ class TableScanExec(Executor):
         self._i = 0
 
     def next(self) -> Optional[Chunk]:
-        import jax.numpy as jnp
-
         while self._i < len(self._slices):
             sl = self._slices[self._i]
             self._i += 1
@@ -88,7 +86,7 @@ class TableScanExec(Executor):
             if sl is None:
                 sel = np.zeros(cap, dtype=np.bool_)
                 sel[0] = True
-                chunk = Chunk({}, jnp.asarray(sel))
+                chunk = Chunk({}, sel)
             else:
                 start, end = sl
                 n = end - start
@@ -98,7 +96,7 @@ class TableScanExec(Executor):
                     cols[c.uid] = Column.from_numpy(data, c.type_, valid=valid, capacity=cap)
                 live = np.zeros(cap, dtype=np.bool_)
                 live[:n] = self.table.live_mask(start, end)
-                chunk = Chunk(cols, jnp.asarray(live))
+                chunk = Chunk(cols, live)
             if self._fn is not None:
                 chunk = self._fn(chunk)
             self.stats.chunks += 1

@@ -75,7 +75,7 @@ class _Materializing(Executor):
                 cols[c.uid] = Column.from_numpy(d[part], c.type_, valid=v[part], capacity=cap)
             sel = np.zeros(cap, dtype=np.bool_)
             sel[: len(part)] = True
-            self._chunks.append(Chunk(cols, jnp.asarray(sel)))
+            self._chunks.append(Chunk(cols, sel))
 
     def next(self) -> Optional[Chunk]:
         if self._chunks:

@@ -95,6 +95,13 @@ class ShardCache:
         touch(self.growth, gkey, growth, self.MAX_FRAGMENTS)
 
 
+def _types_sig(st: ShardedTable) -> str:
+    """Schema signature of a sharding: the compiled fragments close over
+    st.types (column name -> SQLType), so the cache key must distinguish
+    shardings by it — but nothing else."""
+    return repr(sorted((n, t) for n, t in st.types.items()))
+
+
 def _collapse_to_scan(plan: PhysicalPlan):
     """Fuse Selection/Projection chain onto a single scan; return
     (scan, stages) or None if the subtree isn't a pushable pipeline."""
@@ -124,8 +131,11 @@ class DistAggExec(HashAggExec):
         sizes = self.segment_sizes or []
         domains = [s + 1 for s in sizes]
         st = self._cache.get(self._scan.table)
+        # keyed on schema signature, NOT data identity: the compiled fragment
+        # is a pure function of plan + shapes + column types (arrays are
+        # arguments), so version bumps with unchanged schema reuse it
         key = ("agg", repr((self._stages, self.group_exprs, self.aggs, domains)),
-               st.n_parts, st.rows_per_part, st.serial)
+               st.n_parts, st.rows_per_part, _types_sig(st))
         fn = self._cache.get_fragment(
             key,
             lambda: make_agg_fragment(st, self._stages, self.group_exprs,
@@ -171,7 +181,7 @@ class DistJoinAggExec(HashAggExec):
         while growth <= 16.0:
             key = ("joinagg", sig, growth, probe_st.n_parts,
                    probe_st.rows_per_part, build_st.rows_per_part,
-                   probe_st.serial, build_st.serial)
+                   _types_sig(probe_st), _types_sig(build_st))
             fn = self._cache.get_fragment(
                 key,
                 lambda: make_join_agg_fragment(
