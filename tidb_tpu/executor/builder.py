@@ -97,9 +97,18 @@ def build_executor(plan: PhysicalPlan) -> Executor:
         build_plan = plan.children[plan.build_side]
         probe_keys = plan.eq_left if probe_idx == 0 else plan.eq_right
         build_keys = plan.eq_right if plan.build_side == 1 else plan.eq_left
-        build_payload_schema = (
-            [] if plan.kind in ("semi", "anti") else list(build_plan.schema)
-        )
+        # semi/anti joins need no build payload — unless an other_cond must
+        # evaluate build columns during the probe, and then only those
+        if plan.kind in ("semi", "anti"):
+            if plan.other_cond is None:
+                build_payload_schema = []
+            else:
+                from tidb_tpu.expression.expr import ColumnRef, walk
+
+                refs = {n.name for n in walk(plan.other_cond) if isinstance(n, ColumnRef)}
+                build_payload_schema = [c for c in build_plan.schema if c.uid in refs]
+        else:
+            build_payload_schema = list(build_plan.schema)
         return HashJoinExec(
             plan.schema,
             build_executor(probe_plan),
@@ -110,6 +119,7 @@ def build_executor(plan: PhysicalPlan) -> Executor:
             other_cond=plan.other_cond,
             probe_schema=list(probe_plan.schema),
             build_schema=build_payload_schema,
+            exists_sem=plan.exists_sem,
         )
     if isinstance(plan, PSort):
         return SortExec(plan.schema, build_executor(plan.child), plan.items)

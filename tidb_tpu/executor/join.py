@@ -35,7 +35,7 @@ from tidb_tpu.errors import ExecutionError, UnsupportedError
 from tidb_tpu.executor.base import ExecContext, Executor
 from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
-from tidb_tpu.types import TypeKind
+from tidb_tpu.types import INT64, TypeKind
 
 __all__ = ["HashJoinExec"]
 
@@ -50,7 +50,7 @@ def _as_int64_key(d, mode: str):
 class HashJoinExec(Executor):
     def __init__(self, schema, probe_child, build_child, kind: str,
                  probe_keys: List, build_keys: List, other_cond=None,
-                 probe_schema=None, build_schema=None):
+                 probe_schema=None, build_schema=None, exists_sem: bool = False):
         super().__init__(schema, [probe_child, build_child])
         self.kind = kind
         self.probe_keys = probe_keys
@@ -58,8 +58,7 @@ class HashJoinExec(Executor):
         self.other_cond = other_cond
         self.probe_schema = probe_schema
         self.build_schema = build_schema
-        if kind == "left" and other_cond is not None:
-            raise UnsupportedError("LEFT JOIN with non-equi conditions not supported yet")
+        self.exists_sem = exists_sem
 
     # ------------------------------------------------------------------
 
@@ -213,33 +212,83 @@ class HashJoinExec(Executor):
             self._filter_fns = {}
         start, count, ok = self._probe_fn(chunk)
 
-        if self.kind == "semi":
-            self._pending.append(chunk.with_sel(ok & (count > 0)))
-            return
-        if self.kind == "anti":
-            if self._build_had_null:
+        if self.kind in ("semi", "anti"):
+            if self.other_cond is None:
+                matched = count > 0
+            else:
+                matched = self._qualified_matches(chunk, start, count)
+            if self.kind == "semi":
+                self._pending.append(chunk.with_sel(ok & matched))
+                return
+            if self._build_had_null and not self.exists_sem:
                 return  # NOT IN with NULL in subquery: no row is ever TRUE
-            self._pending.append(chunk.with_sel(chunk.sel & ok & (count == 0)))
+            if self.exists_sem:
+                # NOT EXISTS: a NULL probe key never matches -> row kept
+                keep = chunk.sel & ~(ok & matched)
+            else:
+                keep = chunk.sel & ok & ~matched
+            self._pending.append(chunk.with_sel(keep))
             return
 
         real_count = count
-        if self.kind == "left":
+        left_other = self.kind == "left" and self.other_cond is not None
+        if self.kind == "left" and not left_other:
             count = jnp.where(chunk.sel, jnp.maximum(count, 1), 0)
 
         cum = jnp.cumsum(count)
         total = int(cum[-1])
-        if total == 0:
-            return
         cap = self.ctx.chunk_capacity
+        matched = np.zeros(chunk.capacity, dtype=np.bool_) if left_other else None
         for w in range(0, total, cap):
             out = self._expand_fn(chunk, start, count, real_count, cum, jnp.int64(w))
             if self.other_cond is not None:
-                key = "oc"
-                if key not in self._filter_fns:
-                    pred = compile_predicate(self.other_cond)
-                    self._filter_fns[key] = jax.jit(lambda ch: ch.filter(pred(ch)))
-                out = self._filter_fns[key](out)
+                out = self._other_filter(out)
+                if left_other:
+                    sel = np.asarray(out.sel)
+                    rows = np.asarray(out.columns["__probe_row__"].data)[sel]
+                    matched[rows] = True
             self._pending.append(out)
+        if left_other:
+            # probe rows whose every match failed other_cond (or that had
+            # none) emit one NULL-payload row each, per LEFT JOIN semantics
+            unmatched = chunk.sel & jnp.asarray(~matched)
+            if bool(np.asarray(unmatched).any()):
+                self._pending.append(self._null_build_chunk(chunk, unmatched))
+
+    def _qualified_matches(self, chunk: Chunk, start, count):
+        """[capacity] bool: probe rows with at least one build match passing
+        other_cond — via windowed expansion (semi/anti joins carrying extra
+        conditions, e.g. decorrelated EXISTS with non-equi predicates)."""
+        cum = jnp.cumsum(count)
+        total = int(cum[-1])
+        matched = np.zeros(chunk.capacity, dtype=np.bool_)
+        cap = self.ctx.chunk_capacity
+        for w in range(0, total, cap):
+            out = self._expand_fn(chunk, start, count, count, cum, jnp.int64(w))
+            out = self._other_filter(out)
+            sel = np.asarray(out.sel)
+            rows = np.asarray(out.columns["__probe_row__"].data)[sel]
+            matched[rows] = True
+        return jnp.asarray(matched)
+
+    def _other_filter(self, out: Chunk) -> Chunk:
+        if "oc" not in self._filter_fns:
+            pred = compile_predicate(self.other_cond)
+            self._filter_fns["oc"] = jax.jit(lambda ch: ch.filter(pred(ch)))
+        return self._filter_fns["oc"](out)
+
+    def _null_build_chunk(self, chunk: Chunk, sel) -> Chunk:
+        """Probe columns pass through; build payload is all-NULL."""
+        build_schema = {c.uid: c for c in (self.build_schema or [])}
+        cols = dict(chunk.columns)
+        for uid in self._build_payload:
+            c = build_schema[uid]
+            cols[uid] = Column(
+                np.zeros(chunk.capacity, dtype=c.type_.np_dtype),
+                np.zeros(chunk.capacity, dtype=np.bool_),
+                c.type_,
+            )
+        return Chunk(cols, sel)
 
     def _make_expand_fn(self):
         payload = self._build_payload
@@ -247,6 +296,9 @@ class HashJoinExec(Executor):
         kind = self.kind
         n_build = max(self._n_build, 1)
         cap = self.ctx.chunk_capacity
+        # only the other_cond match-tracking reads the origin-row column;
+        # don't make the hot inner-join path carry it
+        with_probe_row = self.other_cond is not None
 
         def expand(chunk, start, count, real_count, cum, w):
             j = jnp.arange(cap, dtype=jnp.int64) + w
@@ -261,6 +313,8 @@ class HashJoinExec(Executor):
             cols = {}
             for uid, col in chunk.columns.items():
                 cols[uid] = col.gather(probe_row, valid_out)
+            if with_probe_row:
+                cols["__probe_row__"] = Column(probe_row, valid_out, INT64)
             # left join emits one slot even for unmatched probe rows; the
             # build payload is NULL there (k beyond the real match count)
             real = k < real_count[probe_row]

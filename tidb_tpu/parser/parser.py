@@ -848,6 +848,14 @@ class Parser:
                 sub = self.parse_select_or_union()
                 self.expect_op(")")
                 return EExists(sub)
+            if self.accept_kw("extract"):
+                # EXTRACT(unit FROM expr) -> unit(expr)
+                self.expect_op("(")
+                unit = self.next().text.lower()
+                self.expect_kw("from")
+                arg = self.parse_expr()
+                self.expect_op(")")
+                return EFunc(unit, [arg])
             if self.accept_kw("not"):
                 return EUnary("not", self.parse_not())
             if self.accept_kw("interval"):

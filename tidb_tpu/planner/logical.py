@@ -94,6 +94,9 @@ class LJoin(LogicalPlan):
     # equi conditions as (left_expr, right_expr) over the resp. child schemas
     eq_conds: List[Tuple[Expr, Expr]] = field(default_factory=list)
     other_cond: Optional[Expr] = None
+    # anti joins from NOT EXISTS keep NULL-key probe rows (no match ->
+    # EXISTS is false -> NOT EXISTS true), unlike NOT IN's NULL semantics
+    exists_sem: bool = False
 
 
 @dataclass
@@ -394,15 +397,26 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
     # ---- WHERE: subquery conjuncts become joins/gates ----
     if stmt.where is not None:
         plain = []
-        for conj in _conjuncts(stmt.where):
-            conj = _fold_subqueries(conj, ctx, scope)
+        conjuncts = [x for c in _conjuncts(stmt.where) for x in _factor_or(c)]
+        for conj in conjuncts:
+            conj = _normalize_not(conj)
             if isinstance(conj, A.EIn) and conj.subquery is not None:
+                # scalar subqueries inside the IN's left-hand side fold first
+                conj = dataclasses.replace(conj, arg=_fold_subqueries(conj.arg, ctx, scope))
                 plan, scope = _in_subquery_to_join(conj, plan, scope, ctx)
-            elif isinstance(conj, A.EExists):
-                val = _exists_value(conj, ctx, scope)
-                plain.append(A.EBool(val))
-            else:
-                plain.append(conj)
+                continue
+            if isinstance(conj, A.EExists):
+                join = _exists_to_join(conj, plan, scope, ctx)
+                if join is not None:
+                    plan = join
+                else:
+                    plain.append(A.EBool(_exists_value(conj, ctx, scope)))
+                continue
+            hit = _try_scalar_corr(conj, plan, scope, ctx)
+            if hit is not None:
+                conj, plan, scope = hit
+            conj = _fold_subqueries(conj, ctx, scope)
+            plain.append(conj)
         if plain:
             cond = _and_ir([binder.bind_expr(c, scope) for c in plain])
             plan = LSelection(schema=plan.schema, children=[plan], cond=cond)
@@ -431,7 +445,9 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
     if stmt.having is not None:
         if not has_agg:
             raise PlanError("HAVING without aggregation")
-        h_ast = _substitute(stmt.having, mapping)
+        # uncorrelated scalar subqueries in HAVING fold to constants now
+        h_ast = _fold_subqueries(stmt.having, ctx, scope)
+        h_ast = _substitute(h_ast, mapping)
         cond = binder.bind_expr(h_ast, post_scope)
         plan = LSelection(schema=plan.schema, children=[plan], cond=cond)
 
@@ -579,6 +595,290 @@ def _build_aggregate(stmt, plan, scope, ctx, agg_calls, alias_map):
         aggs=aggs,
     )
     return node, Scope(node.schema, None), mapping
+
+
+# ---------------------------------------------------------------------------
+# WHERE-clause rewrites
+# ---------------------------------------------------------------------------
+
+def _disjuncts(e) -> List:
+    if isinstance(e, A.EBinary) and e.op == "or":
+        return _disjuncts(e.left) + _disjuncts(e.right)
+    return [e]
+
+
+def _and_ast(parts: List) -> Optional[object]:
+    out = None
+    for p in parts:
+        out = p if out is None else A.EBinary("and", out, p)
+    return out
+
+
+def _factor_or(conj) -> List:
+    """(a AND b) OR (a AND c) -> [a, b OR c]: conjuncts common to every OR
+    branch factor out, so join keys hidden under OR (TPC-H Q19's shape)
+    become extractable equi-join conditions instead of forcing a cross
+    join (ref: planner/core expression_rewriter's extractFiltersFromDNF)."""
+    if not (isinstance(conj, A.EBinary) and conj.op == "or"):
+        return [conj]
+    branches = _disjuncts(conj)
+    keyed = [{ast_key(c): c for c in _conjuncts(b)} for b in branches]
+    common_keys = set(keyed[0])
+    for k in keyed[1:]:
+        common_keys &= set(k)
+    if not common_keys:
+        return [conj]
+    common = [keyed[0][k] for k in sorted(common_keys)]
+    residuals = []
+    for k in keyed:
+        rest = [c for key, c in k.items() if key not in common_keys]
+        if not rest:
+            return common  # one branch is exactly the common part: OR is true
+        residuals.append(_and_ast(rest))
+    out = None
+    for r in residuals:
+        out = r if out is None else A.EBinary("or", out, r)
+    return common + [out]
+
+
+def _normalize_not(conj):
+    """Push NOT into EXISTS/IN so the join rewrites below see them."""
+    while isinstance(conj, A.EUnary) and conj.op == "not":
+        arg = conj.arg
+        if isinstance(arg, A.EExists):
+            conj = dataclasses.replace(arg, negated=not arg.negated)
+        elif isinstance(arg, A.EIn):
+            conj = dataclasses.replace(arg, negated=not arg.negated)
+        elif isinstance(arg, A.EUnary) and arg.op == "not":
+            conj = arg.arg
+        else:
+            return conj
+    return conj
+
+
+def _ast_names(e, out: List):
+    """Collect EName nodes, not descending into nested selects."""
+    if isinstance(e, A.EName):
+        out.append(e)
+        return
+    if not hasattr(e, "__dataclass_fields__") or isinstance(e, (A.SelectStmt, A.UnionStmt)):
+        return
+    for f in e.__dataclass_fields__:
+        v = getattr(e, f)
+        if isinstance(v, list):
+            for x in v:
+                if isinstance(x, tuple):
+                    for y in x:
+                        _ast_names(y, out)
+                else:
+                    _ast_names(x, out)
+        elif isinstance(v, tuple):
+            for y in v:
+                _ast_names(y, out)
+        else:
+            _ast_names(v, out)
+
+
+def _has_subquery(e) -> bool:
+    if isinstance(e, (A.ESubquery, A.EExists, A.SelectStmt, A.UnionStmt)):
+        return True
+    if isinstance(e, A.EIn) and e.subquery is not None:
+        return True
+    if not hasattr(e, "__dataclass_fields__"):
+        return False
+    for f in e.__dataclass_fields__:
+        v = getattr(e, f)
+        if isinstance(v, list):
+            for x in v:
+                if isinstance(x, tuple):
+                    if any(_has_subquery(y) for y in x):
+                        return True
+                elif _has_subquery(x):
+                    return True
+        elif isinstance(v, tuple):
+            if any(_has_subquery(y) for y in v):
+                return True
+        elif _has_subquery(v):
+            return True
+    return False
+
+
+def _expr_side(e, inner_scope: Scope, outer_scope: Scope) -> str:
+    """Which scope an expression's column refs live in: 'inner', 'outer',
+    'const' (no refs), 'mixed', or 'unknown'. Inner shadows outer, matching
+    SQL name resolution."""
+    names: List = []
+    _ast_names(e, names)
+    if not names:
+        return "const"
+    sides = set()
+    for n in names:
+        if inner_scope.try_resolve(n.name, n.qualifier) is not None:
+            sides.add("inner")
+        elif outer_scope.try_resolve(n.name, n.qualifier) is not None:
+            sides.add("outer")
+        else:
+            return "unknown"
+    return sides.pop() if len(sides) == 1 else "mixed"
+
+
+def _align_dicts(outer_expr: Expr, inner_expr: Expr, inner_dict) -> Tuple[Expr, Expr]:
+    """Translate both sides of a cross-plan string equality onto a union
+    dictionary so codes compare correctly."""
+    od = getattr(outer_expr, "_dict", None)
+    idd = inner_dict if inner_dict is not None else getattr(inner_expr, "_dict", None)
+    if od is None and idd is None:
+        return outer_expr, inner_expr
+    if od is None or idd is None:
+        raise UnsupportedError("subquery join mixing string and non-string")
+    if od != idd:
+        import numpy as np
+
+        union = Dictionary.union(od, idd)
+        outer_expr = Lookup.build(outer_expr, od.translate_to(union).astype(np.int32), STRING)
+        inner_expr = Lookup.build(inner_expr, idd.translate_to(union).astype(np.int32), STRING)
+    return outer_expr, inner_expr
+
+
+def _split_correlation(sub: A.SelectStmt, ctx: BuildContext, outer_scope: Scope):
+    """Build the subquery's FROM and classify its WHERE conjuncts against
+    (inner, outer) scopes. Returns None if any conjunct defeats the
+    decorrelation (nested subquery, unknown name, non-equality mix), else
+    (inner_plan, inner_scope, local, corr_eq, corr_other) where corr_eq is
+    [(outer_ast, inner_ast)] equalities and corr_other the remaining
+    outer-referencing conjuncts."""
+    inner_plan, inner_scope = build_from(sub.from_, ctx, None)
+    local, corr_eq, corr_other = [], [], []
+    for c in (_conjuncts(sub.where) if sub.where is not None else []):
+        if _has_subquery(c):
+            return None
+        side = _expr_side(c, inner_scope, outer_scope)
+        if side in ("inner", "const"):
+            local.append(c)
+        elif side == "unknown":
+            return None
+        elif side == "mixed" and isinstance(c, A.EBinary) and c.op == "=":
+            ls = _expr_side(c.left, inner_scope, outer_scope)
+            rs = _expr_side(c.right, inner_scope, outer_scope)
+            if {ls, rs} == {"inner", "outer"}:
+                oa, ia = (c.right, c.left) if ls == "inner" else (c.left, c.right)
+                corr_eq.append((oa, ia))
+            else:
+                corr_other.append(c)
+        else:  # outer-only or non-equality mixed
+            corr_other.append(c)
+    return inner_plan, inner_scope, local, corr_eq, corr_other
+
+
+def _exists_to_join(conj: A.EExists, plan, scope: Scope, ctx: BuildContext):
+    """Correlated [NOT] EXISTS -> semi/anti join on the correlation
+    equalities (the decorrelation the reference's planner performs); other
+    correlated conjuncts ride along as the join's other_cond. Returns None
+    for uncorrelated subqueries (eager evaluation handles those)."""
+    sub = conj.subquery
+    if not isinstance(sub, A.SelectStmt) or sub.from_ is None:
+        return None
+    if sub.group_by or sub.having is not None or sub.limit is not None:
+        return None
+    split = _split_correlation(sub, ctx, scope)
+    if split is None:
+        return None
+    inner_plan, inner_scope, local, corr_eq, corr_other = split
+    if not corr_eq and not corr_other:
+        return None  # uncorrelated
+    if not corr_eq:
+        raise UnsupportedError("correlated EXISTS without an equality correlation")
+    binder = ctx.binder
+    if local:
+        cond = _and_ir([binder.bind_expr(c, inner_scope) for c in local])
+        inner_plan = LSelection(schema=inner_plan.schema, children=[inner_plan], cond=cond)
+    eq = []
+    for oa, ia in corr_eq:
+        oe = binder.bind_expr(oa, scope)
+        ie = binder.bind_expr(ia, inner_scope)
+        inner_dict = getattr(ie, "_dict", None)
+        oe, ie = _align_dicts(oe, ie, inner_dict)
+        eq.append((oe, ie))
+    other = None
+    if corr_other:
+        combined = Scope(list(scope.cols) + list(inner_scope.cols), scope.parent)
+        other = _and_ir([binder.bind_expr(c, combined) for c in corr_other])
+    return LJoin(
+        schema=list(plan.schema),
+        children=[plan, inner_plan],
+        kind="anti" if conj.negated else "semi",
+        eq_conds=eq,
+        other_cond=other,
+        exists_sem=True,
+    )
+
+
+_CMP_OPS = {"=", "<>", "!=", "<", "<=", ">", ">="}
+
+
+def _try_scalar_corr(conj, plan, scope: Scope, ctx: BuildContext):
+    """Rewrite `expr cmp (correlated scalar aggregate subquery)` as an inner
+    join against the subquery re-grouped by its correlation keys, with the
+    comparison referencing the joined aggregate column (classic scalar-agg
+    decorrelation; ref: planner/core decorrelate rule). Returns
+    (new_conj_ast, plan, scope) or None."""
+    if not (isinstance(conj, A.EBinary) and conj.op in _CMP_OPS):
+        return None
+    if isinstance(conj.right, A.ESubquery) and not isinstance(conj.left, A.ESubquery):
+        sub_node, other_side, sub_on_right = conj.right, conj.left, True
+    elif isinstance(conj.left, A.ESubquery) and not isinstance(conj.right, A.ESubquery):
+        sub_node, other_side, sub_on_right = conj.left, conj.right, False
+    else:
+        return None
+    sel = sub_node.select
+    if not isinstance(sel, A.SelectStmt) or sel.from_ is None:
+        return None
+    if sel.group_by or sel.having is not None or len(sel.items) != 1:
+        return None
+    agg_calls: Dict[str, A.EFunc] = {}
+    _collect_agg_calls(sel.items[0].expr, agg_calls)
+    if not agg_calls:
+        return None  # not guaranteed single-row; only agg subqueries rewrite
+    if any(c.name == "count" for c in agg_calls.values()):
+        # COUNT over an empty group is 0, not NULL — the inner-join rewrite
+        # below would drop zero-match outer rows instead of comparing 0
+        return None
+    split = _split_correlation(sel, ctx, scope)
+    if split is None:
+        return None
+    _, _, local, corr_eq, corr_other = split
+    if not corr_eq or corr_other:
+        return None
+    # regroup the subquery by its correlation keys and join on them
+    new_sel = A.SelectStmt(
+        items=[A.SelectItem(ia) for _, ia in corr_eq] + [sel.items[0]],
+        from_=sel.from_,
+        where=_and_ast(local),
+        group_by=[ia for _, ia in corr_eq],
+    )
+    sub_plan = build_select(new_sel, ctx, None)
+    value_col = sub_plan.schema[len(corr_eq)]
+    binder = ctx.binder
+    eq = []
+    for i, (oa, _ia) in enumerate(corr_eq):
+        oe = binder.bind_expr(oa, scope)
+        ic = sub_plan.schema[i]
+        ie = ic.ref()
+        oe, ie = _align_dicts(oe, ie, ic.dict_)
+        eq.append((oe, ie))
+    join = LJoin(
+        schema=list(plan.schema) + [value_col],
+        children=[plan, sub_plan],
+        kind="inner",
+        eq_conds=eq,
+    )
+    # rows with no group simply drop out of the inner join — identical to
+    # the NULL-comparison semantics of the original scalar subquery for
+    # the agg functions this rewrite accepts (empty agg -> NULL)
+    vref = A.EName(value_col.uid)
+    new_conj = A.EBinary(conj.op, other_side, vref) if sub_on_right else A.EBinary(conj.op, vref, other_side)
+    new_scope = Scope(list(scope.cols) + [value_col], scope.parent)
+    return new_conj, join, new_scope
 
 
 # ---------------------------------------------------------------------------

@@ -104,6 +104,7 @@ class PHashJoin(PhysicalPlan):
     eq_right: List = field(default_factory=list)  # exprs over build child
     other_cond: object = None
     build_side: int = 1  # child index used as build side
+    exists_sem: bool = False  # see LJoin.exists_sem
 
     def op_name(self):
         return "HashJoin"
@@ -255,7 +256,7 @@ def lower(plan: LogicalPlan) -> PhysicalPlan:
         return PHashJoin(
             schema=plan.schema, children=[l, r], est_rows=est, kind=plan.kind,
             eq_left=eq_l, eq_right=eq_r, other_cond=plan.other_cond,
-            build_side=build,
+            build_side=build, exists_sem=plan.exists_sem,
         )
     if isinstance(plan, LSort):
         return PSort(schema=plan.schema, children=[lower(plan.child)], est_rows=est, items=plan.items)
