@@ -1,0 +1,85 @@
+"""Session-layer features: sysvars (ref: sessionctx/variable), EXPLAIN
+ANALYZE runtime stats (ref: util/execdetails), variable references."""
+
+import pytest
+
+from tidb_tpu.errors import ExecutionError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create table t (a bigint, b varchar(10))")
+    s.execute("insert into t values (1,'x'), (2,'y'), (3,'x'), (null,'z')")
+    return s
+
+
+class TestSysVars:
+    def test_defaults_and_set(self, sess):
+        assert sess.sysvars.get("tidb_enable_tpu_exec") is True
+        sess.execute("set tidb_enable_tpu_exec = OFF")
+        assert sess.sysvars.get("tidb_enable_tpu_exec") is False
+        sess.execute("set @@tidb_enable_tpu_exec = 1")
+        assert sess.sysvars.get("tidb_enable_tpu_exec") is True
+
+    def test_global_scope_shared_via_catalog(self, sess):
+        sess.execute("set global tidb_mem_quota_query = 2097152")
+        other = Session(catalog=sess.catalog)
+        assert other.sysvars.get("tidb_mem_quota_query") == 2097152
+        # session override wins locally only
+        other.execute("set tidb_mem_quota_query = 4194304")
+        assert other.sysvars.get("tidb_mem_quota_query") == 4194304
+        assert sess.sysvars.get("tidb_mem_quota_query") == 2097152
+
+    def test_chunk_capacity_var(self):
+        s = Session()
+        s.execute("set tidb_max_chunk_size = 2048")
+        assert s.chunk_capacity == 2048
+        # explicit constructor override beats the var
+        s2 = Session(chunk_capacity=128)
+        s2.execute("set tidb_max_chunk_size = 2048")
+        assert s2.chunk_capacity == 128
+
+    def test_int_clamped_to_range(self, sess):
+        sess.execute("set tidb_max_chunk_size = 1")
+        assert sess.sysvars.get("tidb_max_chunk_size") == 1 << 10
+
+    def test_unknown_var_rejected(self, sess):
+        with pytest.raises(ExecutionError):
+            sess.execute("set no_such_variable = 1")
+
+    def test_select_sysvar_and_uservar(self, sess):
+        assert sess.query("select @@tidb_enable_tpu_exec") == [(1,)]
+        sess.execute("set @u = 7")
+        assert sess.query("select @u * 6") == [(42,)]
+        assert sess.query("select @undefined is null") == [(True,)]
+
+    def test_show_variables(self, sess):
+        rows = dict(sess.query("show variables"))
+        assert rows["tidb_enable_tpu_exec"] == "ON"
+        assert "version" in rows
+
+    def test_string_literal_output(self, sess):
+        assert sess.query("select 'lit', a from t where a = 1") == [("lit", 1)]
+
+
+class TestExplainAnalyze:
+    def test_plain_explain(self, sess):
+        rows = sess.query("explain select a from t where a > 1")
+        text = "\n".join(r[0] for r in rows)
+        assert "TableFullScan" in text and "estRows" in text
+
+    def test_analyze_runs_and_reports(self, sess):
+        rows = sess.query(
+            "explain analyze select b, count(*) from t group by b order by b")
+        text = "\n".join(r[0] for r in rows)
+        assert "actRows" in text
+        assert "HashAgg" in text
+        assert "loops:" in text
+
+    def test_analyze_rowcounts(self, sess):
+        rows = sess.query("explain analyze select a from t where a > 1")
+        scan_line = next(r[0] for r in rows if "TableScan" in r[0])
+        # 2 rows pass the fused filter (NULL excluded)
+        assert " 2 " in scan_line

@@ -247,6 +247,11 @@ class HashJoinExec(Executor):
                     sel = np.asarray(out.sel)
                     rows = np.asarray(out.columns["__probe_row__"].data)[sel]
                     matched[rows] = True
+                # bookkeeping column stays internal to the match tracking
+                out = Chunk(
+                    {u: c for u, c in out.columns.items() if u != "__probe_row__"},
+                    out.sel,
+                )
             self._pending.append(out)
         if left_other:
             # probe rows whose every match failed other_cond (or that had

@@ -382,6 +382,15 @@ class Binder:
             object.__setattr__(e, "_dict", d)
         return e
 
+    def codify_output_literal(self, e: Expr) -> Expr:
+        """A bare string literal reaching output position becomes code 0 of
+        a one-entry dictionary (strings only exist as dict codes on device)."""
+        import dataclasses as _dc
+
+        if isinstance(e, Literal) and e.type_.kind == TypeKind.STRING and isinstance(e.value, str):
+            return self.attach_dict(_dc.replace(e, value=0), Dictionary([e.value]))
+        return e
+
     def bind_string_comparison(self, op: str, l: Expr, r: Expr) -> Expr:
         ld, rd = self._dict_of(l), self._dict_of(r)
 

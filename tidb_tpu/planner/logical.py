@@ -407,7 +407,9 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
                 continue
             if isinstance(conj, A.EExists):
                 join = _exists_to_join(conj, plan, scope, ctx)
-                if join is not None:
+                if join == "const":
+                    plain.append(A.EBool(not conj.negated))
+                elif join is not None:
                     plan = join
                 else:
                     plain.append(A.EBool(_exists_value(conj, ctx, scope)))
@@ -471,7 +473,7 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
     proj_exprs: List[Expr] = []
     proj_cols: List[PlanCol] = []
     for name, ast_e in items:
-        bound = binder.bind_expr(ast_e, post_scope)
+        bound = binder.codify_output_literal(binder.bind_expr(ast_e, post_scope))
         uid = binder.new_uid(name)
         proj_exprs.append(bound)
         proj_cols.append(
@@ -780,6 +782,14 @@ def _exists_to_join(conj: A.EExists, plan, scope: Scope, ctx: BuildContext):
         return None
     if sub.group_by or sub.having is not None or sub.limit is not None:
         return None
+    agg_calls: Dict[str, A.EFunc] = {}
+    for it in sub.items:
+        if not isinstance(it.expr, A.EStar):
+            _collect_agg_calls(it.expr, agg_calls)
+    if agg_calls:
+        # an ungrouped aggregate select always yields exactly one row, so
+        # EXISTS over it is constant TRUE whatever the correlation matches
+        return "const"
     split = _split_correlation(sub, ctx, scope)
     if split is None:
         return None

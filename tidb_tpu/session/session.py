@@ -16,20 +16,21 @@ from tidb_tpu.planner.logical import BuildContext, build_select
 from tidb_tpu.planner.optimizer import plan_statement
 from tidb_tpu.planner.physical import PProjection, explain_text, lower
 from tidb_tpu.planner.rules import optimize_logical
+from tidb_tpu.session.sysvars import SysVarStore
 from tidb_tpu.storage.catalog import Catalog
 from tidb_tpu.storage.table import ColumnInfo, TableSchema
-from tidb_tpu.types import parse_type_name
+from tidb_tpu.types import TypeKind, parse_type_name
 
 __all__ = ["Session"]
 
 
 class Session:
     def __init__(self, catalog: Optional[Catalog] = None, db: str = "test",
-                 chunk_capacity: int = 1 << 16, mesh=None):
+                 chunk_capacity: Optional[int] = None, mesh=None):
         self.catalog = catalog or Catalog()
         self.db = db
-        self.chunk_capacity = chunk_capacity
-        self.vars: dict = {}
+        self._chunk_capacity = chunk_capacity  # explicit override; else sysvar
+        self.sysvars = SysVarStore(self.catalog.global_vars)
         self.user_vars: dict = {}
         self.mesh = mesh
         self._shard_cache = None
@@ -38,8 +39,14 @@ class Session:
 
             self._shard_cache = ShardCache(mesh)
 
+    @property
+    def chunk_capacity(self) -> int:
+        if self._chunk_capacity is not None:
+            return self._chunk_capacity
+        return int(self.sysvars.get("tidb_max_chunk_size"))
+
     def _build_root(self, phys):
-        if self._shard_cache is not None:
+        if self._shard_cache is not None and self.sysvars.get("tidb_enable_tpu_exec"):
             from tidb_tpu.parallel.executor import build_dist_executor
 
             return build_dist_executor(phys, self._shard_cache)
@@ -94,7 +101,50 @@ class Session:
 
     # ------------------------------------------------------------------
 
+    def _sub_vars(self, e):
+        """Replace @@sysvar / @uservar references with their current values
+        (ref: sessionctx/variable resolution during expression rewriting)."""
+        if isinstance(e, A.EVar):
+            if e.scope == "user":
+                v = self.user_vars.get(e.name.lstrip("@"))
+            elif e.scope == "global":
+                from tidb_tpu.session.sysvars import SYSVARS
+
+                n = e.name.lower()
+                var = SYSVARS.get(n)
+                if var is None:
+                    raise ExecutionError(f"unknown system variable {n!r}")
+                v = self.catalog.global_vars.get(n, var.default)
+            else:
+                v = self.sysvars.get(e.name)
+            if v is None:
+                return A.ENull()
+            if isinstance(v, bool):
+                return A.ENum("1" if v else "0")
+            if isinstance(v, (int, float)):
+                return A.ENum(repr(v))
+            return A.EStr(str(v))
+        if not hasattr(e, "__dataclass_fields__"):
+            return e
+        kwargs = {}
+        for f in e.__dataclass_fields__:
+            v = getattr(e, f)
+            if isinstance(v, list):
+                kwargs[f] = [
+                    tuple(self._sub_vars(y) if hasattr(y, "__dataclass_fields__") else y for y in x)
+                    if isinstance(x, tuple)
+                    else self._sub_vars(x) if hasattr(x, "__dataclass_fields__") else x
+                    for x in v
+                ]
+            elif hasattr(v, "__dataclass_fields__"):
+                kwargs[f] = self._sub_vars(v)
+            else:
+                kwargs[f] = v
+        return type(e)(**kwargs)
+
     def _execute_stmt(self, stmt) -> Optional[ResultSet]:
+        if not isinstance(stmt, A.SetStmt) and _ast_contains(stmt, A.EVar):
+            stmt = self._sub_vars(stmt)
         if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
             return self._run_select(stmt)
         if isinstance(stmt, A.InsertStmt):
@@ -130,10 +180,12 @@ class Session:
 
                 lit = Binder().bind_literal(value) if not isinstance(value, A.EName) else None
                 v = lit.value if lit is not None else value.name
+                if lit is not None and lit.type_.kind == TypeKind.DECIMAL:
+                    v = v / (10 ** lit.type_.scale)
                 if scope == "user":
-                    self.user_vars[name] = v
+                    self.user_vars[name.lstrip("@")] = v
                 else:
-                    self.vars[name.lower()] = v
+                    self.sysvars.set(name, v, scope or "session")
             return None
         if isinstance(stmt, A.ShowStmt):
             return self._run_show(stmt)
@@ -376,14 +428,35 @@ class Session:
         if not isinstance(target, (A.SelectStmt, A.UnionStmt)):
             raise UnsupportedError("EXPLAIN only supports SELECT")
         phys = self._plan_select(target)
+        if stmt.analyze:
+            from tidb_tpu.utils.execdetails import analyze_text, instrument
+
+            root = self._build_root(phys)
+            instrument(root)
+            run_plan(root, self._exec_ctx())  # execute; rows discarded
+            text = analyze_text(root)
+            return ResultSet(names=["EXPLAIN ANALYZE"],
+                             rows=[(line,) for line in text.split("\n")])
         text = explain_text(phys)
         return ResultSet(names=["EXPLAIN"], rows=[(line,) for line in text.split("\n")])
 
+    @staticmethod
+    def _like_filter(rows, like: Optional[str], col: int = 0):
+        if like is None:
+            return rows
+        import re
+
+        pat = re.escape(like).replace("%", ".*").replace("_", ".")
+        rx = re.compile(f"^{pat}$", re.IGNORECASE)
+        return [r for r in rows if rx.match(str(r[col]))]
+
     def _run_show(self, stmt: A.ShowStmt):
         if stmt.kind == "databases":
-            return ResultSet(names=["Database"], rows=[(n,) for n in sorted(self.catalog.databases)])
+            rows = [(n,) for n in sorted(self.catalog.databases)]
+            return ResultSet(names=["Database"], rows=self._like_filter(rows, stmt.like))
         if stmt.kind == "tables":
-            return ResultSet(names=[f"Tables_in_{self.db}"], rows=[(n,) for n in self.catalog.tables(self.db)])
+            rows = [(n,) for n in self.catalog.tables(self.db)]
+            return ResultSet(names=[f"Tables_in_{self.db}"], rows=self._like_filter(rows, stmt.like))
         if stmt.kind == "columns":
             t = self.catalog.table(self.db, stmt.target)
             rows = [
@@ -392,21 +465,34 @@ class Session:
             ]
             return ResultSet(names=["Field", "Type", "Null"], rows=rows)
         if stmt.kind == "variables":
+            from tidb_tpu.session.sysvars import display
+
+            rows = sorted((k, display(v)) for k, v in self.sysvars.all_effective().items())
             return ResultSet(names=["Variable_name", "Value"],
-                             rows=sorted((k, str(v)) for k, v in self.vars.items()))
+                             rows=self._like_filter(rows, stmt.like))
         raise UnsupportedError(f"SHOW {stmt.kind}")
 
 
-def _ast_has_name(e) -> bool:
-    if isinstance(e, A.EName):
+def _ast_contains(e, cls) -> bool:
+    """Whether any node of type `cls` occurs in an AST (tuples in lists
+    included — e.g. SET assignments, CASE whens)."""
+    if isinstance(e, cls):
         return True
     if not hasattr(e, "__dataclass_fields__"):
         return False
     for f in e.__dataclass_fields__:
         v = getattr(e, f)
         if isinstance(v, list):
-            if any(_ast_has_name(x) for x in v if hasattr(x, "__dataclass_fields__")):
-                return True
-        elif hasattr(v, "__dataclass_fields__") and _ast_has_name(v):
+            for x in v:
+                if isinstance(x, tuple):
+                    if any(_ast_contains(y, cls) for y in x if hasattr(y, "__dataclass_fields__")):
+                        return True
+                elif hasattr(x, "__dataclass_fields__") and _ast_contains(x, cls):
+                    return True
+        elif hasattr(v, "__dataclass_fields__") and _ast_contains(v, cls):
             return True
     return False
+
+
+def _ast_has_name(e) -> bool:
+    return _ast_contains(e, A.EName)

@@ -1,0 +1,131 @@
+"""System variables (ref: sessionctx/variable — the two-tier GLOBAL /
+SESSION variable system, incl. the `tidb_enable_tpu_exec`-style switch the
+north star registers for toggling the device executor).
+
+Globals live on the Catalog (the cluster-state analogue of
+mysql.global_variables); sessions overlay them. New sessions snapshot
+nothing — reads fall through session -> global -> default, like the
+reference's cached global + session copy."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from tidb_tpu.errors import ExecutionError
+
+__all__ = ["SysVar", "SYSVARS", "SysVarStore", "canonical"]
+
+GLOBAL, SESSION, BOTH = "global", "session", "both"
+
+
+@dataclass(frozen=True)
+class SysVar:
+    name: str
+    default: object
+    scope: str = BOTH
+    kind: str = "str"  # bool | int | str
+    min_: Optional[int] = None
+    max_: Optional[int] = None
+
+
+SYSVARS: Dict[str, SysVar] = {}
+
+
+def _reg(*vs: SysVar) -> None:
+    for v in vs:
+        SYSVARS[v.name] = v
+
+
+_reg(
+    # the north-star switch: route eligible fragments to the device mesh
+    SysVar("tidb_enable_tpu_exec", True, BOTH, "bool"),
+    # fixed device batch capacity (ref: tidb_max_chunk_size)
+    SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
+    # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)
+    SysVar("tidb_mem_quota_query", 1 << 31, BOTH, "int", min_=1 << 20, max_=1 << 45),
+    SysVar("autocommit", True, BOTH, "bool"),
+    SysVar("sql_mode", "STRICT_TRANS_TABLES", BOTH, "str"),
+    SysVar("version", "8.0.11-tidb-tpu-0.1.0", GLOBAL, "str"),
+    SysVar("version_comment", "tidb_tpu: TPU-native SQL execution engine", GLOBAL, "str"),
+    SysVar("time_zone", "SYSTEM", BOTH, "str"),
+    SysVar("max_execution_time", 0, BOTH, "int", min_=0, max_=1 << 31),
+    SysVar("tx_isolation", "REPEATABLE-READ", BOTH, "str"),
+    SysVar("transaction_isolation", "REPEATABLE-READ", BOTH, "str"),
+    SysVar("character_set_client", "utf8mb4", BOTH, "str"),
+    SysVar("character_set_results", "utf8mb4", BOTH, "str"),
+    SysVar("character_set_connection", "utf8mb4", BOTH, "str"),
+    SysVar("collation_connection", "utf8mb4_bin", BOTH, "str"),
+)
+
+_TRUTHY = {"1", "on", "true", "yes"}
+_FALSY = {"0", "off", "false", "no"}
+
+
+def canonical(var: SysVar, value) -> object:
+    """Validate + canonicalize a SET value per the variable's kind."""
+    if var.kind == "bool":
+        s = str(value).strip().lower()
+        if s in _TRUTHY:
+            return True
+        if s in _FALSY:
+            return False
+        raise ExecutionError(f"invalid boolean value {value!r} for {var.name}")
+    if var.kind == "int":
+        try:
+            n = int(value)
+        except (TypeError, ValueError):
+            raise ExecutionError(f"invalid integer value {value!r} for {var.name}")
+        if var.min_ is not None and n < var.min_:
+            n = var.min_
+        if var.max_ is not None and n > var.max_:
+            n = var.max_
+        return n
+    return str(value)
+
+
+def display(value) -> str:
+    if isinstance(value, bool):
+        return "ON" if value else "OFF"
+    return str(value)
+
+
+class SysVarStore:
+    """Session-side view: overlay dict over the catalog's global dict."""
+
+    def __init__(self, globals_: Dict[str, object]):
+        self._globals = globals_
+        self._session: Dict[str, object] = {}
+
+    def get(self, name: str):
+        name = name.lower()
+        if name in self._session:
+            return self._session[name]
+        if name in self._globals:
+            return self._globals[name]
+        var = SYSVARS.get(name)
+        if var is None:
+            raise ExecutionError(f"unknown system variable {name!r}")
+        return var.default
+
+    def set(self, name: str, value, scope: str = SESSION) -> None:
+        name = name.lower()
+        var = SYSVARS.get(name)
+        if var is None:
+            raise ExecutionError(f"unknown system variable {name!r}")
+        value = canonical(var, value)
+        if scope == GLOBAL:
+            if var.scope == SESSION:
+                raise ExecutionError(f"{name} is a SESSION-only variable")
+            self._globals[name] = value
+        else:
+            if var.scope == GLOBAL:
+                raise ExecutionError(
+                    f"{name} is a GLOBAL variable; use SET GLOBAL")
+            self._session[name] = value
+
+    def all_effective(self) -> Dict[str, object]:
+        out = {name: v.default for name, v in SYSVARS.items()}
+        out.update(self._globals)
+        out.update(self._session)
+        return out

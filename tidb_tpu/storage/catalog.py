@@ -27,6 +27,8 @@ class Catalog:
     def __init__(self):
         self.databases: Dict[str, Database] = {"test": Database("test")}
         self.schema_version = 0
+        # cluster-wide GLOBAL sysvars (ref: mysql.global_variables)
+        self.global_vars: Dict[str, object] = {}
 
     # -- databases ---------------------------------------------------------
 
