@@ -64,6 +64,15 @@ def merge_op_for(key: str) -> str:
     raise ExecutionError(f"no merge op for state key {key!r}")
 
 
+def _partial_nbytes(p: dict) -> int:
+    return int(
+        p["mat"].nbytes
+        + sum(a.nbytes for a in p["keys"])
+        + sum(a.nbytes for a in p["kvalids"])
+        + sum(a.nbytes for st in p["states"] for a in st.values())
+    )
+
+
 def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
     """Build (init_state, update, G) for segment-strategy aggregation.
 
@@ -147,6 +156,7 @@ class HashAggExec(Executor):
         self.segment_sizes = segment_sizes
         self._out: List[Chunk] = []
         self._emitted = False
+        self._runs = None
 
     # ------------------------------------------------------------------
 
@@ -260,13 +270,7 @@ class HashAggExec(Executor):
     # ------------------------------------------------------------------
 
     def _run_generic(self):
-        import jax.numpy as jnp
-
-        key_parts: List[List[np.ndarray]] = [[] for _ in self.group_exprs]
-        key_valid: List[List[np.ndarray]] = [[] for _ in self.group_exprs]
-        agg_parts: List[List[np.ndarray]] = [[] for _ in self.aggs]
-        agg_valid: List[List[np.ndarray]] = [[] for _ in self.aggs]
-        total = 0
+        from tidb_tpu.utils.memory import SpillableRuns
 
         group_exprs, aggs = self.group_exprs, self.aggs
 
@@ -283,27 +287,33 @@ class HashAggExec(Executor):
             "genagg", repr((group_exprs, [a.arg for a in aggs])), lambda: eval_all
         )
 
+        runs = SpillableRuns(self.ctx.mem_tracker.child("hashagg"), "hashagg")
+        self._runs = runs
+        total = 0
         for chunk in self.children[0].chunks():
             outs, sel = eval_all(chunk)
             sel = np.asarray(sel)
             live = np.nonzero(sel)[0]
             total += len(live)
+            named = {}
             i = 0
             for k in range(len(group_exprs)):
                 d, v = outs[i]; i += 1
-                key_parts[k].append(np.asarray(d)[live])
-                key_valid[k].append(np.asarray(v)[live])
+                named[f"k{k}.d"] = np.asarray(d)[live]
+                named[f"k{k}.v"] = np.asarray(v)[live]
             for j, a in enumerate(aggs):
                 if a.arg is not None:
                     d, v = outs[i]; i += 1
-                    agg_parts[j].append(np.asarray(d)[live])
-                    agg_valid[j].append(np.asarray(v)[live])
+                    named[f"a{j}.d"] = np.asarray(d)[live]
+                    named[f"a{j}.v"] = np.asarray(v)[live]
                 else:
-                    agg_parts[j].append(np.ones(len(live), dtype=np.bool_))
-                    agg_valid[j].append(np.ones(len(live), dtype=np.bool_))
+                    named[f"a{j}.d"] = np.ones(len(live), dtype=np.bool_)
+                    named[f"a{j}.v"] = np.ones(len(live), dtype=np.bool_)
+            runs.append(named)
 
         cap = self.ctx.chunk_capacity
         if total == 0:
+            runs.close()
             if self.group_exprs:
                 self._out = []  # grouped agg over empty input -> no rows
                 return
@@ -317,10 +327,46 @@ class HashAggExec(Executor):
             self._chunks_from_host(out_arrays, 1, cap)
             return
 
-        keys = [np.concatenate(p) for p in key_parts]
-        kvalids = [np.concatenate(p) for p in key_valid]
-        avals = [np.concatenate(p) for p in agg_parts]
-        avalids = [np.concatenate(p) for p in agg_valid]
+        run_list = runs.all_runs()
+        has_distinct = any(a.distinct for a in aggs)
+        if len(run_list) > 1 and not has_distinct:
+            # spilled: per-run partial groupby states, merged like the
+            # reference's partial/final HashAgg worker split — memory peaks
+            # at the partial group tables (bounded by distinct keys per
+            # run), which are tracked so a near-unique key space surfaces
+            # as OOM instead of silently exceeding the budget
+            tracker = self.ctx.mem_tracker.child("hashagg.final")
+            tracked = 0
+            try:
+                merged = None
+                for loader, _rows in run_list:
+                    p = self._partial_states(loader)
+                    b_p = _partial_nbytes(p)
+                    tracker.consume(b_p)
+                    tracked += b_p
+                    if merged is not None:
+                        merged = self._merge_partials([merged, p])
+                        b_m = _partial_nbytes(merged)
+                        tracker.consume(b_m)
+                        tracker.release(tracked)  # old merged + p are dead
+                        tracked = b_m
+                    else:
+                        merged = p
+                self._emit_merged(merged, cap)
+            finally:
+                tracker.release(tracked)
+            runs.close()
+            return
+
+        # resident (or DISTINCT, which needs raw values): whole-input path
+        def cat(name):
+            arrays = [np.asarray(l(name)) for l, _ in run_list]
+            return arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
+
+        keys = [cat(f"k{k}.d") for k in range(len(group_exprs))]
+        kvalids = [cat(f"k{k}.v") for k in range(len(group_exprs))]
+        avals = [cat(f"a{j}.d") for j in range(len(aggs))]
+        avalids = [cat(f"a{j}.v") for j in range(len(aggs))]
 
         if keys:
             mat = np.stack(
@@ -346,6 +392,135 @@ class HashAggExec(Executor):
             out_arrays[a.uid] = self._generic_agg(a, vals, valids, inverse, ngroups)
 
         self._chunks_from_host(out_arrays, ngroups, cap)
+        # output chunks own copies of everything — free the runs (and their
+        # budget charge) now rather than at query close
+        runs.close()
+
+    def _partial_states(self, loader):
+        """Groupby one run into (group key table, mergeable agg states)."""
+        nk = len(self.group_exprs)
+        keys = [np.asarray(loader(f"k{k}.d")) for k in range(nk)]
+        kvalids = [np.asarray(loader(f"k{k}.v")) for k in range(nk)]
+        n = len(keys[0]) if keys else len(np.asarray(loader("a0.d")))
+        if keys:
+            mat = np.stack(
+                [self._to_int64_bits(k, kv) for k, kv in zip(keys, kvalids)]
+                + [kv.astype(np.int64) for kv in kvalids],
+                axis=1,
+            )
+            uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
+            g = len(uniq)
+            first_idx = np.zeros(g, dtype=np.int64)
+            first_idx[inverse[::-1]] = np.arange(n - 1, -1, -1)
+        else:
+            uniq = np.zeros((1, 0), dtype=np.int64)
+            inverse = np.zeros(n, dtype=np.int64)
+            g = 1
+            first_idx = np.zeros(1, dtype=np.int64)
+        states = []
+        for j, a in enumerate(self.aggs):
+            vals = np.asarray(loader(f"a{j}.d"))
+            ok = np.asarray(loader(f"a{j}.v")).astype(np.bool_)
+            cnt = np.zeros(g, dtype=np.int64)
+            np.add.at(cnt, inverse[ok], 1)
+            st = {"cnt": cnt}
+            if a.func in ("sum", "avg"):
+                dt = np.float64 if a.arg.type_.kind == TypeKind.FLOAT else np.int64
+                s = np.zeros(g, dtype=dt)
+                np.add.at(s, inverse[ok], vals[ok])
+                st["sum"] = s
+            elif a.func == "min":
+                m = np.full(g, _min_identity(vals.dtype), dtype=vals.dtype)
+                np.minimum.at(m, inverse[ok], vals[ok])
+                st["min"] = m
+            elif a.func == "max":
+                m = np.full(g, _max_identity(vals.dtype), dtype=vals.dtype)
+                np.maximum.at(m, inverse[ok], vals[ok])
+                st["max"] = m
+            states.append(st)
+        return {
+            "mat": uniq,
+            "keys": [k[first_idx] for k in keys],
+            "kvalids": [kv[first_idx] for kv in kvalids],
+            "states": states,
+        }
+
+    def _merge_partials(self, partials):
+        """Merge partial group tables into one (final-agg merge step)."""
+        mats = np.concatenate([p["mat"] for p in partials], axis=0)
+        ntotal = len(mats)
+        if mats.shape[1]:
+            uniq, inverse = np.unique(mats, axis=0, return_inverse=True)
+            ngroups = len(uniq)
+        else:
+            uniq = np.zeros((1, 0), dtype=np.int64)
+            ngroups = 1
+            inverse = np.zeros(ntotal, dtype=np.int64)
+        first_idx = np.zeros(ngroups, dtype=np.int64)
+        first_idx[inverse[::-1]] = np.arange(ntotal - 1, -1, -1)
+
+        nk = len(self.group_exprs)
+        keys, kvalids = [], []
+        for ki in range(nk):
+            kcat = np.concatenate([p["keys"][ki] for p in partials])
+            vcat = np.concatenate([p["kvalids"][ki] for p in partials])
+            keys.append(kcat[first_idx])
+            kvalids.append(vcat[first_idx])
+
+        states = []
+        for j, a in enumerate(self.aggs):
+            cnt = np.zeros(ngroups, dtype=np.int64)
+            np.add.at(cnt, inverse, np.concatenate([p["states"][j]["cnt"] for p in partials]))
+            st = {"cnt": cnt}
+            if a.func in ("sum", "avg"):
+                parts = np.concatenate([p["states"][j]["sum"] for p in partials])
+                s = np.zeros(ngroups, dtype=parts.dtype)
+                np.add.at(s, inverse, parts)
+                st["sum"] = s
+            elif a.func in ("min", "max"):
+                op, ident = (
+                    (np.minimum, _min_identity) if a.func == "min" else (np.maximum, _max_identity)
+                )
+                parts = np.concatenate([p["states"][j][a.func] for p in partials])
+                m = np.full(ngroups, ident(parts.dtype), dtype=parts.dtype)
+                op.at(m, inverse, parts)
+                st[a.func] = m
+            states.append(st)
+        return {"mat": uniq, "keys": keys, "kvalids": kvalids, "states": states}
+
+    def _emit_merged(self, merged, cap):
+        """Finalize a merged partial table into output chunks."""
+        ngroups = len(merged["mat"]) if merged["mat"].shape[1] else 1
+        out_arrays: Dict[str, tuple] = {}
+        nk = len(self.group_exprs)
+        for ki, (uid, c) in enumerate(zip(self.group_uids, self.schema[:nk])):
+            out_arrays[uid] = (
+                merged["keys"][ki].astype(c.type_.np_dtype),
+                merged["kvalids"][ki],
+            )
+        for j, a in enumerate(self.aggs):
+            st = merged["states"][j]
+            cnt = st["cnt"]
+            if a.func == "count":
+                out_arrays[a.uid] = (cnt, np.ones(ngroups, dtype=np.bool_))
+            elif a.func == "sum":
+                out_arrays[a.uid] = (st["sum"].astype(a.type_.np_dtype), cnt > 0)
+            elif a.func == "avg":
+                sf = st["sum"].astype(np.float64)
+                if a.arg.type_.kind == TypeKind.DECIMAL:
+                    sf = sf / (10 ** a.arg.type_.scale)
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    avg = np.where(cnt > 0, sf / np.maximum(cnt, 1), 0.0)
+                out_arrays[a.uid] = (avg, cnt > 0)
+            else:
+                out_arrays[a.uid] = (st[a.func].astype(a.type_.np_dtype), cnt > 0)
+        self._chunks_from_host(out_arrays, ngroups, cap)
+
+    def close(self) -> None:
+        if getattr(self, "_runs", None) is not None:
+            self._runs.close()
+            self._runs = None
+        super().close()
 
     @staticmethod
     def _to_int64_bits(arr: np.ndarray, valid: np.ndarray) -> np.ndarray:

@@ -29,8 +29,15 @@ class RuntimeStats:
 class ExecContext:
     chunk_capacity: int = 1 << 16
     collect_stats: bool = False
-    # memory budget for host-side state (bytes); OOM action raises
-    mem_budget: Optional[int] = None
+    # host-side memory accounting root (budget + spill/OOM actions live
+    # here; ref: the per-query memory.Tracker in sessionctx)
+    mem_tracker: "object" = None
+
+    def __post_init__(self):
+        if self.mem_tracker is None:
+            from tidb_tpu.utils.memory import MemTracker
+
+            self.mem_tracker = MemTracker("query")
 
 
 class Executor:
@@ -74,8 +81,10 @@ class ResultSet:
 
 def run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None) -> ResultSet:
     """Drive an executor tree to completion and materialize host rows."""
-    root.open(ctx)
+    opened = False
     try:
+        root.open(ctx)  # inside try: open() can raise after acquiring
+        opened = True   # spill files / device buffers that close() frees
         visible = root.schema if n_visible is None else root.schema[:n_visible]
         uids = [c.uid for c in visible]
         dicts = {c.uid: c.dict_ for c in visible if c.dict_ is not None}
@@ -84,4 +93,8 @@ def run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None) 
             rows.extend(ch.to_pylist(dicts=dicts, names=uids))
         return ResultSet(names=[c.name for c in visible], rows=rows)
     finally:
-        root.close()
+        try:
+            root.close()
+        except Exception:
+            if opened:
+                raise

@@ -113,12 +113,25 @@ class HashJoinExec(Executor):
         self._n_build = len(packed)
         self._sorted_keys = jnp.asarray(packed[order])
         self._build_payload = {}
+        nbytes = packed.nbytes
         for uid, (dlist, vlist) in payload.items():
             d = np.concatenate(dlist) if dlist else np.zeros(0)
             v = np.concatenate(vlist) if vlist else np.zeros(0, dtype=np.bool_)
             d, v = d[ok][order], v[ok][order]
+            nbytes += d.nbytes + v.nbytes
             self._build_payload[uid] = (jnp.asarray(d), jnp.asarray(v))
+        # account the materialized build side against the query budget
+        # (ref: HashJoinExec's build RowContainer under the memory tracker)
+        self._mem_tracker = self.ctx.mem_tracker.child("hashjoin.build")
+        self._build_bytes = int(nbytes)
+        self._mem_tracker.consume(self._build_bytes)
         self._probe_fn = None
+
+    def close(self) -> None:
+        if getattr(self, "_build_bytes", 0):
+            self._mem_tracker.release(self._build_bytes)
+            self._build_bytes = 0
+        super().close()
 
     def _pack_keys_host(self, key_arrays: List[np.ndarray]):
         """Combine multi-keys into one int64 via range packing. Returns

@@ -22,9 +22,14 @@ __all__ = ["SortExec", "TopNExec", "LimitExec", "UnionExec"]
 
 
 class _Materializing(Executor):
-    """Shared: drain child to host-compacted column arrays."""
+    """Shared: drain child to host-compacted runs (spillable under the
+    query memory budget — the RowContainer + SpillDiskAction shape)."""
 
-    def _drain_to_host(self, sort_items: List[Tuple[object, bool]]):
+    _runs = None
+
+    def _drain_to_runs(self, sort_items: List[Tuple[object, bool]]):
+        from tidb_tpu.utils.memory import SpillableRuns
+
         child = self.children[0]
         uids = [c.uid for c in self.schema]
         key_fns = [compile_expr(e) for e, _ in sort_items]
@@ -35,47 +40,85 @@ class _Materializing(Executor):
 
         eval_chunk = cached_jit("sortkeys", repr(sort_items), lambda: eval_chunk)
 
-        cols = {uid: ([], []) for uid in uids}
-        keys: List[Tuple[List, List]] = [([], []) for _ in sort_items]
+        runs = SpillableRuns(self.ctx.mem_tracker.child("sort"), "sort")
+        self._runs = runs
         for ch in child.chunks():
             kcols, ch = eval_chunk(ch)
             sel = np.asarray(ch.sel)
             live = np.nonzero(sel)[0]
+            named = {}
             for uid in uids:
                 col = ch.columns[uid]
-                cols[uid][0].append(np.asarray(col.data)[live])
-                cols[uid][1].append(np.asarray(col.valid)[live])
+                named[f"c.{uid}.d"] = np.asarray(col.data)[live]
+                named[f"c.{uid}.v"] = np.asarray(col.valid)[live]
             for i, kc in enumerate(kcols):
-                keys[i][0].append(np.asarray(kc.data)[live])
-                keys[i][1].append(np.asarray(kc.valid)[live])
+                named[f"k.{i}.d"] = np.asarray(kc.data)[live]
+                named[f"k.{i}.v"] = np.asarray(kc.valid)[live]
+            runs.append(named)
+        return runs
 
-        host_cols = {}
-        n = 0
-        for uid in uids:
-            d = np.concatenate(cols[uid][0]) if cols[uid][0] else np.zeros(0)
-            v = np.concatenate(cols[uid][1]) if cols[uid][1] else np.zeros(0, dtype=np.bool_)
-            host_cols[uid] = (d, v)
-            n = len(d)
-        host_keys = [
-            (np.concatenate(k[0]) if k[0] else np.zeros(0),
-             np.concatenate(k[1]) if k[1] else np.zeros(0, dtype=np.bool_))
-            for k in keys
-        ]
-        return host_cols, host_keys, n
+    def _global_keys(self, runs, n_keys: int):
+        """Concatenate sort keys across runs (keys stay in host memory;
+        only the payload gather is mmap-backed)."""
+        host_keys = []
+        for i in range(n_keys):
+            ds, vs = [], []
+            for loader, _rows in runs.all_runs():
+                ds.append(np.asarray(loader(f"k.{i}.d")))
+                vs.append(np.asarray(loader(f"k.{i}.v")))
+            host_keys.append(
+                (ds[0] if len(ds) == 1 else np.concatenate(ds) if ds else np.zeros(0),
+                 vs[0] if len(vs) == 1 else np.concatenate(vs) if vs else np.zeros(0, dtype=np.bool_))
+            )
+        return host_keys
 
-    def _emit(self, host_cols, order: Optional[np.ndarray], n: int):
+    def _emit(self, runs, order: Optional[np.ndarray], n: int):
+        """Emit output chunks by gathering `order` rows from the runs."""
         cap = self.ctx.chunk_capacity
         self._chunks = []
         idx = order if order is not None else np.arange(n)
+        run_list = runs.all_runs()
+        bases = np.cumsum([0] + [rows for _, rows in run_list])
+        handles = {}
+
+        def col_of(ri, name):
+            key = (ri, name)
+            if key not in handles:
+                handles[key] = run_list[ri][0](name)
+            return handles[key]
+
         for s in range(0, len(idx), cap):
             part = idx[s : s + cap]
             cols = {}
             for c in self.schema:
-                d, v = host_cols[c.uid]
-                cols[c.uid] = Column.from_numpy(d[part], c.type_, valid=v[part], capacity=cap)
+                d_out = v_out = None
+                for ri in range(len(run_list)):
+                    m = (part >= bases[ri]) & (part < bases[ri + 1])
+                    if not m.any():
+                        continue
+                    local = part[m] - bases[ri]
+                    d = col_of(ri, f"c.{c.uid}.d")
+                    if d_out is None:
+                        d_out = np.empty(len(part), dtype=d.dtype)
+                        v_out = np.empty(len(part), dtype=np.bool_)
+                    d_out[m] = d[local]
+                    v_out[m] = col_of(ri, f"c.{c.uid}.v")[local]
+                if d_out is None:
+                    d_out = np.zeros(len(part), dtype=c.type_.np_dtype)
+                    v_out = np.zeros(len(part), dtype=np.bool_)
+                cols[c.uid] = Column.from_numpy(d_out, c.type_, valid=v_out, capacity=cap)
             sel = np.zeros(cap, dtype=np.bool_)
             sel[: len(part)] = True
             self._chunks.append(Chunk(cols, sel))
+
+    def _close_runs(self) -> None:
+        if self._runs is not None:
+            self._runs.close()
+            self._runs = None
+
+    def close(self) -> None:
+        self._close_runs()
+        super().close()
 
     def next(self) -> Optional[Chunk]:
         if self._chunks:
@@ -112,9 +155,14 @@ class SortExec(_Materializing):
     def open(self, ctx: ExecContext) -> None:
         super().open(ctx)
         self.ctx = ctx
-        host_cols, host_keys, n = self._drain_to_host(self.items)
-        order = _sort_order(host_keys, self.items) if self.items else None
-        self._emit(host_cols, order, n)
+        runs = self._drain_to_runs(self.items)
+        n = sum(rows for _, rows in runs.all_runs())
+        order = None
+        if self.items:
+            host_keys = self._global_keys(runs, len(self.items))
+            order = _sort_order(host_keys, self.items)
+        self._emit(runs, order, n)
+        self._close_runs()  # output chunks own copies; free the charge now
 
 
 class TopNExec(_Materializing):
@@ -127,10 +175,13 @@ class TopNExec(_Materializing):
     def open(self, ctx: ExecContext) -> None:
         super().open(ctx)
         self.ctx = ctx
-        host_cols, host_keys, n = self._drain_to_host(self.items)
+        runs = self._drain_to_runs(self.items)
+        n = sum(rows for _, rows in runs.all_runs())
+        host_keys = self._global_keys(runs, len(self.items))
         order = _sort_order(host_keys, self.items)
         order = order[self.offset : self.offset + self.count]
-        self._emit(host_cols, order, n)
+        self._emit(runs, order, n)
+        self._close_runs()
 
 
 class LimitExec(Executor):

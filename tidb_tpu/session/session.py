@@ -70,7 +70,16 @@ class Session:
     # ------------------------------------------------------------------
 
     def _exec_ctx(self) -> ExecContext:
-        return ExecContext(chunk_capacity=self.chunk_capacity)
+        from tidb_tpu.utils.memory import MemTracker
+
+        return ExecContext(
+            chunk_capacity=self.chunk_capacity,
+            mem_tracker=MemTracker(
+                "query",
+                budget=int(self.sysvars.get("tidb_mem_quota_query")),
+                spill_enabled=bool(self.sysvars.get("tidb_enable_tmp_storage_on_oom")),
+            ),
+        )
 
     def _execute_subplan(self, logical) -> List[tuple]:
         """Planner callback: run a bound logical subplan to completion."""

@@ -44,6 +44,8 @@ _reg(
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)
     SysVar("tidb_mem_quota_query", 1 << 31, BOTH, "int", min_=1 << 20, max_=1 << 45),
+    # spill host operator state to disk instead of cancelling on OOM
+    SysVar("tidb_enable_tmp_storage_on_oom", True, BOTH, "bool"),
     SysVar("autocommit", True, BOTH, "bool"),
     SysVar("sql_mode", "STRICT_TRANS_TABLES", BOTH, "str"),
     SysVar("version", "8.0.11-tidb-tpu-0.1.0", GLOBAL, "str"),

@@ -1,0 +1,206 @@
+"""Memory tracking + spill (ref: util/memory.Tracker tree with OOM
+actions, and util/chunk.RowContainer's spill-to-disk).
+
+A MemTracker forms a tree (query root -> operator trackers). consume()
+propagates to the root, where the budget lives. On exceeding the budget
+the tracker first asks its registered spillables to shed host memory
+(largest consumer first — the reference's SpillDiskAction); if nothing
+can spill, it cancels the query (the reference's PanicOnExceed/Cancel
+action).
+
+Only *host-side* state is tracked: device HBM is governed by the static
+chunk capacity and XLA; host accumulation (sort runs, join build, agg
+state) is what can grow without bound with cardinality.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, List, Optional
+
+from tidb_tpu.errors import ExecutionError
+
+__all__ = ["MemTracker", "QueryOOMError", "SpillFile", "SpillableRuns"]
+
+
+class QueryOOMError(ExecutionError):
+    pass
+
+
+class MemTracker:
+    def __init__(self, label: str = "query", budget: Optional[int] = None,
+                 parent: Optional["MemTracker"] = None, spill_enabled: bool = True):
+        self.label = label
+        self.budget = budget
+        self.parent = parent
+        self.spill_enabled = spill_enabled
+        self.consumed = 0
+        self.max_consumed = 0
+        self._spillables: List[object] = []  # objects with spill() -> int
+
+    def child(self, label: str) -> "MemTracker":
+        return MemTracker(label, parent=self)
+
+    def register_spillable(self, obj) -> None:
+        self._spillables.append(obj)
+
+    def unregister_spillable(self, obj) -> None:
+        if obj in self._spillables:
+            self._spillables.remove(obj)
+
+    def consume(self, nbytes: int) -> None:
+        node = self
+        while node is not None:
+            node.consumed += nbytes
+            node.max_consumed = max(node.max_consumed, node.consumed)
+            if node.budget is not None and node.consumed > node.budget:
+                node._on_exceed()
+            node = node.parent
+
+    def release(self, nbytes: int) -> None:
+        node = self
+        while node is not None:
+            node.consumed -= nbytes
+            node = node.parent
+
+    # ------------------------------------------------------------------
+
+    def _on_exceed(self) -> None:
+        # shed the largest spillable first until we're back under budget;
+        # spillables register on the budget-holding (root) tracker
+        while self.budget is not None and self.consumed > self.budget:
+            candidates = [s for s in self._spillables if s.spillable_bytes() > 0]
+            if not candidates:
+                raise QueryOOMError(
+                    f"Out Of Memory Quota! [budget={self.budget} consumed={self.consumed}]"
+                )
+            biggest = max(candidates, key=lambda s: s.spillable_bytes())
+            freed = biggest.spill()
+            if freed <= 0:
+                raise QueryOOMError(
+                    f"Out Of Memory Quota! [budget={self.budget} consumed={self.consumed}]"
+                )
+
+
+class SpillFile:
+    """A spilled batch of named numpy arrays, one .npy per array so reads
+    can be mmap-backed (row gathers touch only the needed pages)."""
+
+    def __init__(self, arrays: dict, spill_dir: Optional[str] = None):
+        import numpy as np
+
+        self.dir = tempfile.mkdtemp(prefix="tidb_tpu_spill_", dir=spill_dir)
+        self.names = list(arrays)
+        self.nbytes = 0
+        self.rows = 0
+        for name, a in arrays.items():
+            np.save(os.path.join(self.dir, f"{name}.npy"), a)
+            self.nbytes += a.nbytes
+            self.rows = len(a)
+
+    def load(self, name: str):
+        import numpy as np
+
+        return np.load(os.path.join(self.dir, f"{name}.npy"), mmap_mode="r")
+
+    def close(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class SpillableRuns:
+    """Chunk-wise accumulator of named numpy arrays that can shed its
+    buffer to disk under memory pressure (the RowContainer analogue).
+
+    Arrays in one append() call must share a row count. Registered on the
+    budget-holding tracker; consume() may re-enter via spill()."""
+
+    def __init__(self, tracker: MemTracker, label: str = "runs"):
+        self.tracker = tracker
+        root = tracker
+        while root.parent is not None:
+            root = root.parent
+        self._root = root
+        if root.spill_enabled:
+            root.register_spillable(self)
+        self.buf: dict = {}
+        self.buf_bytes = 0
+        self.files: List[SpillFile] = []
+        self.closed = False
+        self._frozen: Optional[dict] = None
+
+    def append(self, named: dict) -> None:
+        for k, a in named.items():
+            self.buf.setdefault(k, []).append(a)
+        b = int(sum(a.nbytes for a in named.values()))
+        self.buf_bytes += b
+        self.tracker.consume(b)  # may call back into self.spill()
+
+    def spillable_bytes(self) -> int:
+        return self.buf_bytes
+
+    def spill(self) -> int:
+        if self.buf_bytes == 0:
+            return 0
+        import numpy as np
+
+        if self._frozen is not None:
+            # appends may have landed after a reader froze the buffer —
+            # spill both, or rows would silently vanish
+            arrays = {
+                k: (np.concatenate([self._frozen[k]] + self.buf[k])
+                    if self.buf.get(k) else self._frozen[k])
+                for k in self._frozen
+            }
+        else:
+            arrays = {k: np.concatenate(v) for k, v in self.buf.items()}
+        if not arrays:
+            return 0
+        self.files.append(SpillFile(arrays))
+        freed = self.buf_bytes
+        self.buf = {}
+        self._frozen = None
+        self.buf_bytes = 0
+        self.tracker.release(freed)
+        return freed
+
+    @property
+    def spilled(self) -> bool:
+        return bool(self.files)
+
+    def freeze(self) -> None:
+        """Collapse the chunk-list buffer into single arrays (call once,
+        after the last append; repeated all_runs() calls then share them)."""
+        import numpy as np
+
+        if self._frozen is None and any(self.buf.values()):
+            self._frozen = {k: np.concatenate(v) for k, v in self.buf.items()}
+            self.buf = {}
+
+    def in_memory(self) -> dict:
+        self.freeze()
+        return self._frozen or {}
+
+    def all_runs(self):
+        """[(loader, rows)] across spilled files + the resident buffer.
+        loader(name) returns that run's array (mmap-backed for files)."""
+        runs = [(f.load, f.rows) for f in self.files]
+        mem = self.in_memory()
+        if mem:
+            rows = len(next(iter(mem.values())))
+            runs.append((lambda name, _m=mem: _m[name], rows))
+        return runs
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        for f in self.files:
+            f.close()
+        self.files = []
+        self.tracker.release(self.buf_bytes)
+        self.buf = {}
+        self.buf_bytes = 0
+        self._root.unregister_spillable(self)
