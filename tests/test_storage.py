@@ -49,10 +49,17 @@ class TestTable:
         t.insert_rows([[1, "a", "1.00"], [2, "b", "2.00"], [3, "c", "3.00"]])
         assert t.delete_rows(np.array([1])) == 1
         assert t.live_rows == 2
+        # MVCC: update appends a new row version; the old one goes dead
         t.update_rows(np.array([2]), {"balance": ["9.99"], "name": ["cc"]})
-        data, _ = t.column_slice("balance", 2, 3)
+        assert t.live_rows == 2
+        assert not t.live_mask(2, 3)[0]  # old version invisible
+        assert t.live_mask(3, 4)[0]      # new version visible
+        data, _ = t.column_slice("balance", 3, 4)
         assert data[0] == 999
-        assert t.dicts["name"].decode(*t.column_slice("name", 2, 3)) == ["cc"]
+        assert t.dicts["name"].decode(*t.column_slice("name", 3, 4)) == ["cc"]
+        # unchanged column carried into the new version
+        ids, _ = t.column_slice("id", 3, 4)
+        assert ids[0] == 3
 
     def test_not_null_violation(self):
         t = Table(people_schema())

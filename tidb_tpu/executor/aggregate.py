@@ -358,15 +358,29 @@ class HashAggExec(Executor):
             runs.close()
             return
 
-        # resident (or DISTINCT, which needs raw values): whole-input path
-        def cat(name):
-            arrays = [np.asarray(l(name)) for l, _ in run_list]
-            return arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
+        # resident (or DISTINCT, which needs raw values): whole-input path.
+        # Spilled runs rematerialize here — charge the budget so quota
+        # violations surface as OOM instead of silent host growth.
+        fallback_tracker = self.ctx.mem_tracker.child("hashagg.distinct")
+        fallback_bytes = 0
 
-        keys = [cat(f"k{k}.d") for k in range(len(group_exprs))]
-        kvalids = [cat(f"k{k}.v") for k in range(len(group_exprs))]
-        avals = [cat(f"a{j}.d") for j in range(len(aggs))]
-        avalids = [cat(f"a{j}.v") for j in range(len(aggs))]
+        def cat(name):
+            nonlocal fallback_bytes
+            arrays = [np.asarray(l(name)) for l, _ in run_list]
+            out = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
+            if runs.spilled:
+                fallback_tracker.consume(out.nbytes)
+                fallback_bytes += out.nbytes
+            return out
+
+        try:
+            keys = [cat(f"k{k}.d") for k in range(len(group_exprs))]
+            kvalids = [cat(f"k{k}.v") for k in range(len(group_exprs))]
+            avals = [cat(f"a{j}.d") for j in range(len(aggs))]
+            avalids = [cat(f"a{j}.v") for j in range(len(aggs))]
+        except BaseException:
+            fallback_tracker.release(fallback_bytes)
+            raise
 
         if keys:
             mat = np.stack(
@@ -394,6 +408,7 @@ class HashAggExec(Executor):
         self._chunks_from_host(out_arrays, ngroups, cap)
         # output chunks own copies of everything — free the runs (and their
         # budget charge) now rather than at query close
+        fallback_tracker.release(fallback_bytes)
         runs.close()
 
     def _partial_states(self, loader):

@@ -29,6 +29,10 @@ class RuntimeStats:
 class ExecContext:
     chunk_capacity: int = 1 << 16
     collect_stats: bool = False
+    # MVCC snapshot: None reads committed-latest; a txn's reads carry its
+    # start ts and marker so it sees its own provisional writes
+    read_ts: Optional[int] = None
+    txn_marker: int = 0
     # host-side memory accounting root (budget + spill/OOM actions live
     # here; ref: the per-query memory.Tracker in sessionctx)
     mem_tracker: "object" = None

@@ -95,7 +95,9 @@ class TableScanExec(Executor):
                     data, valid = self.table.column_slice(c.name, start, end)
                     cols[c.uid] = Column.from_numpy(data, c.type_, valid=valid, capacity=cap)
                 live = np.zeros(cap, dtype=np.bool_)
-                live[:n] = self.table.live_mask(start, end)
+                live[:n] = self.table.live_mask(
+                    start, end, read_ts=self.ctx.read_ts, marker=self.ctx.txn_marker
+                )
                 chunk = Chunk(cols, live)
             if self._fn is not None:
                 chunk = self._fn(chunk)

@@ -21,7 +21,28 @@ from tidb_tpu.storage.catalog import Catalog
 from tidb_tpu.storage.table import ColumnInfo, TableSchema
 from tidb_tpu.types import TypeKind, parse_type_name
 
-__all__ = ["Session"]
+__all__ = ["Session", "TxnState"]
+
+
+@dataclasses.dataclass
+class TxnState:
+    """An open transaction (ref: session txn lifecycle over the Percolator
+    model — here the marker doubles as the provisional ts and row lock)."""
+
+    marker: int
+    read_ts: int
+    # id(table) -> (table, TableTxnLog): commit/rollback touch only the
+    # logged rows, not whole version arrays
+    logs: dict = dataclasses.field(default_factory=dict)
+
+    def log_for(self, table):
+        from tidb_tpu.storage.table import TableTxnLog
+
+        entry = self.logs.get(id(table))
+        if entry is None:
+            entry = (table, TableTxnLog())
+            self.logs[id(table)] = entry
+        return entry[1]
 
 
 class Session:
@@ -32,6 +53,7 @@ class Session:
         self._chunk_capacity = chunk_capacity  # explicit override; else sysvar
         self.sysvars = SysVarStore(self.catalog.global_vars)
         self.user_vars: dict = {}
+        self.txn: Optional[TxnState] = None
         self.mesh = mesh
         self._shard_cache = None
         if mesh is not None:
@@ -45,7 +67,63 @@ class Session:
             return self._chunk_capacity
         return int(self.sysvars.get("tidb_max_chunk_size"))
 
+    # -- transactions ------------------------------------------------------
+
+    def _begin(self) -> None:
+        from tidb_tpu.storage.table import TXN_TS_BASE
+
+        if self.txn is not None:
+            self._commit()  # MySQL: BEGIN implicitly commits the open txn
+        self.txn = TxnState(
+            marker=TXN_TS_BASE + self.catalog.next_txn_id(),
+            read_ts=self.catalog.current_ts,
+        )
+
+    def _ensure_txn(self):
+        """(txn, implicit): implicit txns commit at statement end."""
+        if self.txn is not None:
+            return self.txn, False
+        self._begin()
+        if not self.sysvars.get("autocommit"):
+            return self.txn, False
+        return self.txn, True
+
+    def _commit(self) -> None:
+        txn, self.txn = self.txn, None
+        if txn is None:
+            return
+        commit_ts = self.catalog.next_ts()
+        for t, log in txn.logs.values():
+            t.txn_commit(txn.marker, commit_ts, log)
+
+    def _rollback(self) -> None:
+        txn, self.txn = self.txn, None
+        if txn is None:
+            return
+        for t, log in txn.logs.values():
+            t.txn_rollback(txn.marker, log)
+
+    def _run_dml(self, fn):
+        """Run a write inside the session txn; implicit txns commit (or
+        roll back on error) at statement end."""
+        txn, implicit = self._ensure_txn()
+        try:
+            fn(txn)
+        except Exception:
+            if implicit:
+                self._rollback()
+            raise
+        if implicit:
+            self._commit()
+        return None
+
+    # -- execution ---------------------------------------------------------
+
     def _build_root(self, phys):
+        if self.txn is not None:
+            # snapshot reads need per-row visibility masks; the sharded
+            # device tables hold committed-latest — use the local executors
+            return build_executor(phys)
         if self._shard_cache is not None and self.sysvars.get("tidb_enable_tpu_exec"):
             from tidb_tpu.parallel.executor import build_dist_executor
 
@@ -79,6 +157,8 @@ class Session:
                 budget=int(self.sysvars.get("tidb_mem_quota_query")),
                 spill_enabled=bool(self.sysvars.get("tidb_enable_tmp_storage_on_oom")),
             ),
+            read_ts=self.txn.read_ts if self.txn is not None else None,
+            txn_marker=self.txn.marker if self.txn is not None else 0,
         )
 
     def _execute_subplan(self, logical) -> List[tuple]:
@@ -96,6 +176,8 @@ class Session:
         )
 
     def _run_select(self, stmt) -> ResultSet:
+        if self.txn is None and not self.sysvars.get("autocommit"):
+            self._begin()  # consistent-snapshot reads without autocommit
         phys = self._plan_select(stmt)
         root = self._build_root(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
@@ -162,6 +244,10 @@ class Session:
             return self._run_update(stmt)
         if isinstance(stmt, A.DeleteStmt):
             return self._run_delete(stmt)
+        if isinstance(stmt, (A.CreateTableStmt, A.DropTableStmt, A.CreateDatabaseStmt,
+                             A.DropDatabaseStmt, A.TruncateStmt, A.CreateIndexStmt,
+                             A.DropIndexStmt, A.AlterTableStmt)):
+            self._commit()  # DDL implicitly commits the open txn (MySQL)
         if isinstance(stmt, A.CreateTableStmt):
             return self._run_create_table(stmt)
         if isinstance(stmt, A.DropTableStmt):
@@ -198,8 +284,14 @@ class Session:
             return None
         if isinstance(stmt, A.ShowStmt):
             return self._run_show(stmt)
-        if isinstance(stmt, (A.BeginStmt, A.CommitStmt, A.RollbackStmt)):
-            # autocommit single-node round 1: txn statements are accepted
+        if isinstance(stmt, A.BeginStmt):
+            self._begin()
+            return None
+        if isinstance(stmt, A.CommitStmt):
+            self._commit()
+            return None
+        if isinstance(stmt, A.RollbackStmt):
+            self._rollback()
             return None
         if isinstance(stmt, A.AnalyzeStmt):
             return None  # stats are live row counts for now
@@ -238,13 +330,14 @@ class Session:
     def _run_insert(self, stmt: A.InsertStmt):
         table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
         if stmt.select is not None:
-            rs = self._run_select(stmt.select)
-            rows = [list(r) for r in rs.rows]
-            table.insert_rows(rows, columns=stmt.columns)
-            return None
+            def do(txn):
+                rs = self._run_select(stmt.select)
+                rows = [list(r) for r in rs.rows]
+                table.insert_rows(rows, columns=stmt.columns, begin_ts=txn.marker,
+                                  log=txn.log_for(table))
+
+            return self._run_dml(do)
         from tidb_tpu.planner.binder import Binder
-        from tidb_tpu.planner.logical import BuildContext
-        from tidb_tpu.planner.rules import fold_constants
 
         binder = Binder()
         rows = []
@@ -260,8 +353,12 @@ class Session:
                 bound = self._bind_const(binder, cell, col)
                 row.append(bound)
             rows.append(row)
-        table.insert_rows(rows, columns=stmt.columns)
-        return None
+
+        def do(txn):
+            table.insert_rows(rows, columns=stmt.columns, begin_ts=txn.marker,
+                              log=txn.log_for(table))
+
+        return self._run_dml(do)
 
     def _bind_const(self, binder, cell_ast, col: ColumnInfo):
         """Evaluate a constant INSERT/UPDATE value to a python value in the
@@ -350,25 +447,30 @@ class Session:
 
     def _run_update(self, stmt: A.UpdateStmt):
         table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
-        ids = self._rows_matching(table, stmt.where, stmt.table.name)
-        if len(ids) == 0:
-            return None
-        from tidb_tpu.planner.binder import Binder
 
-        binder = Binder()
-        updates = {}
-        for name_ast, val_ast in stmt.sets:
-            col = table.schema.col(name_ast.name)
-            has_refs = _ast_has_name(val_ast)
-            if not has_refs:
-                v = self._bind_const(binder, val_ast, col)
-                updates[col.name] = [v] * len(ids)
-            else:
-                # expression over current row values: evaluate via scan
-                vals = self._eval_update_expr(table, stmt.table.name, val_ast, ids, col)
-                updates[col.name] = vals
-        table.update_rows(ids, updates)
-        return None
+        def do(txn):
+            ids = self._rows_matching(table, stmt.where, stmt.table.name)
+            if len(ids) == 0:
+                return
+            from tidb_tpu.planner.binder import Binder
+
+            binder = Binder()
+            updates = {}
+            for name_ast, val_ast in stmt.sets:
+                col = table.schema.col(name_ast.name)
+                has_refs = _ast_has_name(val_ast)
+                if not has_refs:
+                    v = self._bind_const(binder, val_ast, col)
+                    updates[col.name] = [v] * len(ids)
+                else:
+                    # expression over current row values: evaluate via scan
+                    vals = self._eval_update_expr(table, stmt.table.name, val_ast, ids, col)
+                    updates[col.name] = vals
+            table.update_rows(ids, updates, begin_ts=txn.marker,
+                              end_ts=txn.marker, marker=txn.marker,
+                              log=txn.log_for(table))
+
+        return self._run_dml(do)
 
     def _eval_update_expr(self, table, table_name, val_ast, ids, col: ColumnInfo):
         from tidb_tpu.executor.scan import TableScanExec
@@ -426,9 +528,13 @@ class Session:
 
     def _run_delete(self, stmt: A.DeleteStmt):
         table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
-        ids = self._rows_matching(table, stmt.where, stmt.table.name)
-        table.delete_rows(ids)
-        return None
+
+        def do(txn):
+            ids = self._rows_matching(table, stmt.where, stmt.table.name)
+            table.delete_rows(ids, end_ts=txn.marker, marker=txn.marker,
+                              log=txn.log_for(table))
+
+        return self._run_dml(do)
 
     # ------------------------------------------------------------------
 

@@ -29,6 +29,22 @@ class Catalog:
         self.schema_version = 0
         # cluster-wide GLOBAL sysvars (ref: mysql.global_variables)
         self.global_vars: Dict[str, object] = {}
+        # timestamp oracle + txn id allocator (ref: PD TSO; monotonically
+        # increasing, shared by every table in this catalog)
+        self._ts = 0
+        self._txn_id = 0
+
+    def next_ts(self) -> int:
+        self._ts += 1
+        return self._ts
+
+    @property
+    def current_ts(self) -> int:
+        return self._ts
+
+    def next_txn_id(self) -> int:
+        self._txn_id += 1
+        return self._txn_id
 
     # -- databases ---------------------------------------------------------
 
@@ -63,6 +79,7 @@ class Catalog:
                 return d.tables[schema.name]
             raise DuplicateTableError(f"table {schema.name!r} exists")
         t = Table(schema)
+        t.ts_source = self.next_ts
         d.tables[schema.name] = t
         self.schema_version += 1
         return t

@@ -31,7 +31,17 @@ from tidb_tpu.types import (
     decimal_to_scaled,
 )
 
-__all__ = ["ColumnInfo", "TableSchema", "Table"]
+__all__ = ["ColumnInfo", "TableSchema", "Table", "TableTxnLog"]
+
+
+@dataclass
+class TableTxnLog:
+    """Rows one transaction touched in one table, so commit/rollback cost
+    O(rows written) not O(table) (ref: the txn's memdb buffer keying the
+    2PC mutations)."""
+
+    ranges: List[tuple] = field(default_factory=list)  # appended [start,end)
+    ended: List[np.ndarray] = field(default_factory=list)  # end_ts-stamped ids
 
 
 @dataclass
@@ -62,15 +72,24 @@ class TableSchema:
 _GROW = 1.5
 _MIN_CAP = 1024
 
+# MVCC timestamps: committed rows carry ts < TXN_TS_BASE; an open
+# transaction stamps its provisional writes with marker = TXN_TS_BASE +
+# txn_id (greater than every possible read_ts, so invisible to others —
+# and, sitting in end_ts, an effective row lock). MAX_TS = "not deleted".
+TXN_TS_BASE = 1 << 60
+MAX_TS = 1 << 62
+
 
 class Table:
     """Append-friendly columnar store for one table."""
 
     def __init__(self, schema: TableSchema):
         self.schema = schema
-        self.n = 0  # logical rows incl. tombstoned
+        self.n = 0  # physical rows incl. dead versions
         self.version = 0
         self._auto_inc = 1
+        self._local_ts = 0  # fallback TSO for catalog-less tables
+        self.ts_source = None  # catalog-provided TSO (set by create_table)
         cap = _MIN_CAP
         self._cap = cap
         self.data: Dict[str, np.ndarray] = {}
@@ -81,13 +100,26 @@ class Table:
             self.valid[c.name] = np.zeros(cap, dtype=np.bool_)
             if c.type_.kind == TypeKind.STRING:
                 self.dicts[c.name] = Dictionary([])
-        self.tombstone = np.zeros(cap, dtype=np.bool_)
+        # MVCC visibility range per physical row (see TXN_TS_BASE above)
+        self.begin_ts = np.zeros(cap, dtype=np.int64)
+        self.end_ts = np.full(cap, MAX_TS, dtype=np.int64)
+
+    def _next_ts(self) -> int:
+        if self.ts_source is not None:
+            return self.ts_source()
+        self._local_ts += 1
+        return self._local_ts
 
     # -- row count ---------------------------------------------------------
 
     @property
     def live_rows(self) -> int:
-        return int(self.n - self.tombstone[: self.n].sum())
+        """Committed-latest row count (provisional writes excluded)."""
+        if self.n == 0:
+            return 0
+        b = self.begin_ts[: self.n]
+        e = self.end_ts[: self.n]
+        return int(((b < TXN_TS_BASE) & (e >= TXN_TS_BASE)).sum())
 
     def _ensure(self, extra: int):
         need = self.n + extra
@@ -99,8 +131,10 @@ class Table:
             self.data[name][self.n:] = 0
             self.valid[name] = np.resize(self.valid[name], cap)
             self.valid[name][self.n:] = False
-        self.tombstone = np.resize(self.tombstone, cap)
-        self.tombstone[self.n:] = False
+        self.begin_ts = np.resize(self.begin_ts, cap)
+        self.begin_ts[self.n:] = 0
+        self.end_ts = np.resize(self.end_ts, cap)
+        self.end_ts[self.n:] = MAX_TS
         self._cap = cap
 
     # -- ingestion ---------------------------------------------------------
@@ -135,9 +169,13 @@ class Table:
             raise TypeError_(f"bad value {v!r} for column {col.name}: {e}")
         raise TypeError_(f"unsupported type {col.type_}")
 
-    def insert_rows(self, rows: Sequence[Sequence], columns: Optional[List[str]] = None) -> int:
+    def insert_rows(self, rows: Sequence[Sequence], columns: Optional[List[str]] = None,
+                    begin_ts: Optional[int] = None,
+                    log: Optional["TableTxnLog"] = None) -> int:
         """Insert python rows (already in logical form; strings as str,
-        dates as date/str, decimals as str/float). Returns rows inserted."""
+        dates as date/str, decimals as str/float). Returns rows inserted.
+        begin_ts: commit timestamp, or a txn marker for provisional writes;
+        None commits immediately at the next TSO tick."""
         names = columns or self.schema.names()
         cols = [self.schema.col(n) for n in names]
         m = len(rows)
@@ -180,7 +218,11 @@ class Table:
                     else:
                         arr[start + i] = v
                         vd[start + i] = True
+        self.begin_ts[start:end] = self._next_ts() if begin_ts is None else begin_ts
+        self.end_ts[start:end] = MAX_TS
         self.n = end
+        if log is not None:
+            log.ranges.append((start, end))
         self.version += 1
         return m
 
@@ -208,6 +250,8 @@ class Table:
                     self.valid[name][start:end] = True
             elif c.not_null:
                 raise ExecutionError(f"bulk insert missing NOT NULL column {name!r}")
+        self.begin_ts[start:end] = 0  # bulk loads are committed "at origin"
+        self.end_ts[start:end] = MAX_TS
         self.n = end
         self.version += 1
         return m
@@ -229,46 +273,135 @@ class Table:
 
     # -- mutation ----------------------------------------------------------
 
-    def delete_rows(self, row_ids: np.ndarray) -> int:
-        """Tombstone rows by physical id; returns count newly deleted."""
-        ids = np.asarray(row_ids, dtype=np.int64)
-        ids = ids[(ids >= 0) & (ids < self.n)]
-        fresh = ~self.tombstone[ids]
-        self.tombstone[ids] = True
-        self.version += 1
-        return int(fresh.sum())
+    def _writable_mask(self, ids: np.ndarray, marker: int) -> np.ndarray:
+        """Mask over `ids` this write may stamp: rows already ended by
+        another txn's marker (lock conflict) or by a commit (optimistic
+        conflict) raise; rows already ended by OUR marker are skipped."""
+        in_bounds = (ids >= 0) & (ids < self.n)
+        cur = np.where(in_bounds, self.end_ts[np.clip(ids, 0, max(self.n - 1, 0))], MAX_TS)
+        ours = cur == marker if marker else np.zeros(len(ids), dtype=np.bool_)
+        blocked = (cur != MAX_TS) & ~ours & in_bounds
+        if blocked.any():
+            raise ExecutionError(
+                "write conflict: row modified by another transaction "
+                f"(table {self.schema.name!r})"
+            )
+        return in_bounds & ~ours
 
-    def update_rows(self, row_ids: np.ndarray, updates: Dict[str, list]) -> int:
+    def delete_rows(self, row_ids: np.ndarray, end_ts: Optional[int] = None,
+                    marker: int = 0, log: Optional["TableTxnLog"] = None) -> int:
+        """End rows' visibility at end_ts (a commit ts, or a txn marker for
+        provisional deletes). Returns count newly deleted."""
         ids = np.asarray(row_ids, dtype=np.int64)
+        ids = ids[self._writable_mask(ids, marker)]
+        self.end_ts[ids] = self._next_ts() if end_ts is None else end_ts
+        if log is not None:
+            log.ended.append(ids)
+        self.version += 1
+        return len(ids)
+
+    def update_rows(self, row_ids: np.ndarray, updates: Dict[str, list],
+                    begin_ts: Optional[int] = None, end_ts: Optional[int] = None,
+                    marker: int = 0, log: Optional["TableTxnLog"] = None) -> int:
+        """MVCC update: end the old row versions and append new versions
+        carrying the updated values (ref: TiDB writes a new MVCC version
+        per update; here the version chain is physical-row append)."""
+        ids = np.asarray(row_ids, dtype=np.int64)
+        keep = self._writable_mask(ids, marker)
+        ids = ids[keep]
+        m = len(ids)
+        if m == 0:
+            return 0
+        # convert values BEFORE mutating any state: a bad value must leave
+        # the table untouched, or an explicit txn could commit half a row
+        converted: Dict[str, list] = {}
         for name, vals in updates.items():
             c = self.schema.col(name)
+            vals = [v for v, k in zip(vals, keep) if k]
             if c.type_.kind == TypeKind.STRING:
-                # route through append-style encoding (may grow dict)
-                d = self.dicts[name]
-                new = {v for v in vals if v is not None and v not in d}
-                if new:
-                    nd = Dictionary(list(d.values) + list(new))
-                    trans = d.translate_to(nd)
-                    self.data[name][: self.n] = trans[self.data[name][: self.n]]
-                    self.dicts[name] = nd
-                    d = nd
-                codes, valid = d.encode_with(vals)
-                self.data[name][ids] = codes
-                self.valid[name][ids] = valid
+                converted[name] = [None if v is None else str(v) for v in vals]
             else:
-                for i, v in zip(ids, vals):
+                converted[name] = [
+                    None if v is None else self.to_device_value(c, v) for v in vals
+                ]
+
+        if begin_ts is None and end_ts is None:
+            begin_ts = end_ts = self._next_ts()
+        self.end_ts[ids] = end_ts
+
+        self._ensure(m)
+        start, end = self.n, self.n + m
+        for name in self.data:
+            self.data[name][start:end] = self.data[name][ids]
+            self.valid[name][start:end] = self.valid[name][ids]
+        self.begin_ts[start:end] = begin_ts
+        self.end_ts[start:end] = MAX_TS
+        self.n = end
+        if log is not None:
+            log.ended.append(ids)
+            log.ranges.append((start, end))
+
+        # overwrite the updated columns in the new versions
+        for name, vals in converted.items():
+            c = self.schema.col(name)
+            if c.type_.kind == TypeKind.STRING:
+                self._append_strings(name, vals, start, end)
+            else:
+                for i, v in zip(range(start, end), vals):
                     if v is None:
                         self.valid[name][i] = False
                     else:
-                        self.data[name][i] = self.to_device_value(c, v)
+                        self.data[name][i] = v
                         self.valid[name][i] = True
         self.version += 1
-        return len(ids)
+        return m
+
+    def txn_commit(self, marker: int, commit_ts: int,
+                   log: Optional["TableTxnLog"] = None) -> None:
+        """Rewrite this txn's markers to the commit timestamp. With a log,
+        only the logged rows are touched (O(rows written)); without one,
+        the full version arrays are scanned."""
+        if log is not None:
+            for s, e in log.ranges:
+                b = self.begin_ts[s:e]
+                b[b == marker] = commit_ts
+            for ids in log.ended:
+                e_ = self.end_ts[ids]
+                self.end_ts[ids] = np.where(e_ == marker, commit_ts, e_)
+        else:
+            b = self.begin_ts[: self.n]
+            e = self.end_ts[: self.n]
+            b[b == marker] = commit_ts
+            e[e == marker] = commit_ts
+        self.version += 1
+
+    def txn_rollback(self, marker: int, log: Optional["TableTxnLog"] = None) -> None:
+        """Discard provisional writes; restore provisional deletes."""
+        if log is not None:
+            # restore deletes first; then kill inserted versions (a row both
+            # inserted and deleted by this txn must end up dead)
+            for ids in log.ended:
+                e_ = self.end_ts[ids]
+                self.end_ts[ids] = np.where(e_ == marker, MAX_TS, e_)
+            for s, e in log.ranges:
+                b = self.begin_ts[s:e]
+                dead = b == marker
+                self.end_ts[s:e][dead] = 0
+                b[dead] = 0
+        else:
+            b = self.begin_ts[: self.n]
+            e = self.end_ts[: self.n]
+            dead = b == marker
+            e[dead] = 0
+            b[dead] = 0
+            e[e == marker] = MAX_TS
+        self.version += 1
 
     def truncate(self):
         self.n = 0
         self.version += 1
-        self.tombstone[:] = False
+        self.begin_ts[:] = 0
+        self.end_ts[:] = MAX_TS
         for c in self.schema.columns:
             # valid[] must clear: insert paths that omit a column rely on
             # stale slots reading as NULL
@@ -280,12 +413,22 @@ class Table:
     # -- reads -------------------------------------------------------------
 
     def column_slice(self, name: str, start: int, end: int):
-        """(data, valid) physical slice incl. tombstoned rows — executor
+        """(data, valid) physical slice incl. dead row versions — executor
         masks them via live_mask."""
         return self.data[name][start:end], self.valid[name][start:end]
 
-    def live_mask(self, start: int, end: int) -> np.ndarray:
-        return ~self.tombstone[start:end]
+    def live_mask(self, start: int, end: int, read_ts: Optional[int] = None,
+                  marker: int = 0) -> np.ndarray:
+        """Row visibility. read_ts=None reads committed-latest; a snapshot
+        read at read_ts additionally sees its own txn's marker writes."""
+        b = self.begin_ts[start:end]
+        e = self.end_ts[start:end]
+        if read_ts is None:
+            return (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
+        vis = (b <= read_ts) & (e > read_ts)
+        if marker:
+            vis = ((b <= read_ts) | (b == marker)) & (e > read_ts) & (e != marker)
+        return vis
 
     def partition_bounds(self, num_partitions: int) -> List[tuple]:
         """Split [0, n) into near-equal contiguous partitions (the region/

@@ -37,7 +37,7 @@ def mirror_to_sqlite(catalog: Catalog, db: str = "test", tables: Optional[Iterab
         for c in cols:
             data, valid = t.data[c.name][:n], t.valid[c.name][:n]
             pycols.append(_to_python(c.type_, data, valid, t.dicts.get(c.name)))
-        live = ~t.tombstone[:n]
+        live = t.live_mask(0, n)
         rows = [tuple(col[i] for col in pycols) for i in range(n) if live[i]]
         ph = ", ".join("?" * len(cols))
         conn.executemany(f"INSERT INTO {name} VALUES ({ph})", rows)
