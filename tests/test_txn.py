@@ -108,13 +108,22 @@ class TestBasics:
 
     def test_implicit_rollback_on_error(self, cat):
         s = sess(cat)
-        with pytest.raises(ExecutionError):
-            # NULL into NOT NULL-free table is fine; force conflict instead
-            s1 = sess(cat)
-            s1.execute("begin")
-            s1.execute("update acc set bal = 1 where id = 3")
+        s1 = sess(cat)
+        s1.execute("begin")
+        s1.execute("update acc set bal = 1 where id = 3")
+        with pytest.raises(ExecutionError, match="write conflict"):
             s.execute("update acc set bal = 2 where id = 3")
         s1.execute("rollback")
         # the failed autocommit statement left nothing behind
         assert s.query("select bal from acc where id = 3") == [(300,)]
         assert s.txn is None
+
+    def test_set_autocommit_on_commits(self, cat):
+        s = sess(cat)
+        s.execute("set autocommit = 0")
+        s.execute("update acc set bal = 7 where id = 1")
+        other = sess(cat)
+        assert other.query("select bal from acc where id = 1") == [(100,)]
+        s.execute("set autocommit = 1")  # MySQL: commits the open txn
+        assert s.txn is None
+        assert other.query("select bal from acc where id = 1") == [(7,)]

@@ -374,13 +374,18 @@ class HashAggExec(Executor):
             return out
 
         try:
-            keys = [cat(f"k{k}.d") for k in range(len(group_exprs))]
-            kvalids = [cat(f"k{k}.v") for k in range(len(group_exprs))]
-            avals = [cat(f"a{j}.d") for j in range(len(aggs))]
-            avalids = [cat(f"a{j}.v") for j in range(len(aggs))]
-        except BaseException:
+            self._run_generic_resident(run_list, cat, cap)
+        finally:
             fallback_tracker.release(fallback_bytes)
-            raise
+            runs.close()
+
+    def _run_generic_resident(self, run_list, cat, cap):
+        group_exprs, aggs = self.group_exprs, self.aggs
+        total = sum(rows for _, rows in run_list)
+        keys = [cat(f"k{k}.d") for k in range(len(group_exprs))]
+        kvalids = [cat(f"k{k}.v") for k in range(len(group_exprs))]
+        avals = [cat(f"a{j}.d") for j in range(len(aggs))]
+        avalids = [cat(f"a{j}.v") for j in range(len(aggs))]
 
         if keys:
             mat = np.stack(
@@ -406,10 +411,6 @@ class HashAggExec(Executor):
             out_arrays[a.uid] = self._generic_agg(a, vals, valids, inverse, ngroups)
 
         self._chunks_from_host(out_arrays, ngroups, cap)
-        # output chunks own copies of everything — free the runs (and their
-        # budget charge) now rather than at query close
-        fallback_tracker.release(fallback_bytes)
-        runs.close()
 
     def _partial_states(self, loader):
         """Groupby one run into (group key table, mergeable agg states)."""

@@ -78,6 +78,9 @@ class Executor:
 class ResultSet:
     names: List[str]
     rows: List[tuple]
+    # column type kinds (tidb_tpu.types.TypeKind) for wire-protocol column
+    # metadata; None for synthetic result sets (SHOW/EXPLAIN)
+    types: Optional[list] = None
 
     def __len__(self):
         return len(self.rows)
@@ -95,7 +98,11 @@ def run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None) 
         rows: List[tuple] = []
         for ch in root.chunks():
             rows.extend(ch.to_pylist(dicts=dicts, names=uids))
-        return ResultSet(names=[c.name for c in visible], rows=rows)
+        return ResultSet(
+            names=[c.name for c in visible],
+            rows=rows,
+            types=[c.type_.kind for c in visible],
+        )
     finally:
         try:
             root.close()

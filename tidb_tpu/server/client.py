@@ -1,0 +1,162 @@
+"""Minimal MySQL text-protocol client.
+
+Speaks the standard protocol (handshake v10 + COM_QUERY + text result
+sets), so it works against this package's Server or any MySQL-compatible
+server. Used by the test suite (no third-party MySQL driver ships in the
+environment) and as a tiny CLI: python -m tidb_tpu.server.client.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+from tidb_tpu.server import protocol as P
+
+__all__ = ["Client", "ServerError"]
+
+
+class ServerError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"({code}) {message}")
+        self.code = code
+        self.message = message
+
+
+class Client:
+    def __init__(self, host: str = "127.0.0.1", port: int = 4000,
+                 user: str = "root", db: Optional[str] = None, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        _seq, payload = P.read_packet(self.sock)
+        if payload and payload[0] == 0xFF:
+            raise self._err(payload)
+        caps = P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION | P.CLIENT_PLUGIN_AUTH
+        if db:
+            caps |= P.CLIENT_CONNECT_WITH_DB
+        resp = (
+            struct.pack("<I", caps)
+            + struct.pack("<I", 1 << 24)
+            + bytes([0x21])
+            + b"\x00" * 23
+            + user.encode() + b"\x00"
+            + bytes([0])  # empty auth response
+            + ((db.encode() + b"\x00") if db else b"")
+            + b"mysql_native_password\x00"
+        )
+        P.write_packet(self.sock, 1, resp)
+        _seq, payload = P.read_packet(self.sock)
+        if payload and payload[0] == 0xFF:
+            raise self._err(payload)
+
+    # ------------------------------------------------------------------
+
+    def query(self, sql: str) -> Tuple[List[str], List[tuple]]:
+        """Run one statement; returns (column names, rows). Non-queries
+        return ([], [])."""
+        P.write_packet(self.sock, 0, b"\x03" + sql.encode("utf-8"))
+        _seq, payload = P.read_packet(self.sock)
+        if not payload:
+            raise ConnectionError("empty response")
+        if payload[0] == 0xFF:
+            raise self._err(payload)
+        if payload[0] == 0x00:
+            return [], []
+        ncols, _ = P.read_lenc_int(payload, 0)
+        names = []
+        for _ in range(ncols):
+            _seq, col = P.read_packet(self.sock)
+            names.append(self._column_name(col))
+        _seq, eof = P.read_packet(self.sock)  # EOF after column defs
+        rows = []
+        while True:
+            _seq, pkt = P.read_packet(self.sock)
+            if pkt and pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt and pkt[0] == 0xFF:
+                raise self._err(pkt)
+            rows.append(self._parse_row(pkt, ncols))
+        return names, rows
+
+    def execute(self, sql: str) -> None:
+        self.query(sql)
+
+    def ping(self) -> bool:
+        P.write_packet(self.sock, 0, b"\x0e")
+        _seq, payload = P.read_packet(self.sock)
+        return bool(payload) and payload[0] == 0x00
+
+    def close(self) -> None:
+        try:
+            P.write_packet(self.sock, 0, b"\x01")
+        except OSError:
+            pass
+        self.sock.close()
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _err(payload: bytes) -> ServerError:
+        code = struct.unpack_from("<H", payload, 1)[0]
+        msg = payload[3:].decode("utf-8", "replace")
+        if msg.startswith("#"):
+            msg = msg[6:]
+        return ServerError(code, msg)
+
+    @staticmethod
+    def _column_name(payload: bytes) -> str:
+        pos = 0
+        out = []
+        for _ in range(5):  # catalog, schema, table, org_table, name
+            n, pos = P.read_lenc_int(payload, pos)
+            out.append(payload[pos:pos + n])
+            pos += n
+        return out[4].decode()
+
+    @staticmethod
+    def _parse_row(payload: bytes, ncols: int) -> tuple:
+        pos = 0
+        vals = []
+        for _ in range(ncols):
+            if payload[pos] == 0xFB:
+                vals.append(None)
+                pos += 1
+            else:
+                n, pos = P.read_lenc_int(payload, pos)
+                vals.append(payload[pos:pos + n].decode("utf-8"))
+                pos += n
+        return tuple(vals)
+
+
+def _main():  # pragma: no cover - interactive CLI
+    import sys
+
+    host, port = "127.0.0.1", 4000
+    if len(sys.argv) > 1:
+        host, _, p = sys.argv[1].partition(":")
+        port = int(p or 4000)
+    c = Client(host, port)
+    print(f"connected to {host}:{port}; enter SQL, empty line to quit")
+    while True:
+        try:
+            sql = input("sql> ").strip()
+        except EOFError:
+            break
+        if not sql:
+            break
+        try:
+            names, rows = c.query(sql)
+        except ServerError as e:
+            print("ERROR:", e)
+            continue
+        if names:
+            print("\t".join(names))
+            for r in rows:
+                print("\t".join("NULL" if v is None else str(v) for v in r))
+        else:
+            print("OK")
+    c.close()
+
+
+if __name__ == "__main__":
+    _main()

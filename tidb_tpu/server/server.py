@@ -1,0 +1,167 @@
+"""Threaded MySQL-protocol server over Session (ref: server/server.go
+Server.Run + clientConn.Run: accept, handshake, command dispatch loop).
+
+One Session per connection, all sharing one Catalog — the same shape as
+the reference's one-process-many-connections SQL node. The executor tier
+underneath (single-chip or mesh) is whatever the Session was built with.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import traceback
+from typing import Optional
+
+from tidb_tpu.errors import TiDBTPUError as TidbError
+from tidb_tpu.server import protocol as P
+from tidb_tpu.session import Session
+from tidb_tpu.storage.catalog import Catalog
+
+__all__ = ["Server"]
+
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+
+
+class Server:
+    def __init__(self, catalog: Optional[Catalog] = None, host: str = "127.0.0.1",
+                 port: int = 4000, mesh=None):
+        self.catalog = catalog or Catalog()
+        self.host = host
+        self.port = port
+        self.mesh = mesh
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_id = 0
+        self._running = False
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]  # resolves port 0
+        self._sock.listen(16)
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            self._accept_thread.join()
+        except KeyboardInterrupt:
+            self.stop()
+
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            self._conn_id += 1
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn, self._conn_id), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket, conn_id: int) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sess = Session(catalog=self.catalog, mesh=self.mesh)
+            salt = os.urandom(20).replace(b"\x00", b"\x01")
+            version = str(sess.sysvars.get("version"))
+            P.write_packet(conn, 0, P.handshake_v10(conn_id, version, salt))
+            _seq, payload = P.read_packet(conn)
+            hello = P.parse_handshake_response(payload)
+            if hello["db"]:
+                try:
+                    sess.execute(f"use {hello['db']}")
+                except TidbError:
+                    pass
+            # auth: accept everyone (no privilege tier yet)
+            P.write_packet(conn, 2, P.ok_packet())
+            self._command_loop(conn, sess)
+        except (ConnectionError, OSError):
+            pass
+        except Exception:
+            traceback.print_exc()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _command_loop(self, conn: socket.socket, sess: Session) -> None:
+        while True:
+            _seq, payload = P.read_packet(conn)
+            if not payload:
+                return
+            cmd, body = payload[0], payload[1:]
+            if cmd == COM_QUIT:
+                return
+            if cmd == COM_PING:
+                P.write_packet(conn, 1, P.ok_packet())
+                continue
+            if cmd == COM_INIT_DB:
+                self._run_sql(conn, sess, f"use {body.decode()}")
+                continue
+            if cmd == COM_QUERY:
+                self._run_sql(conn, sess, body.decode("utf-8"))
+                continue
+            if cmd == COM_FIELD_LIST:
+                P.write_packet(conn, 1, P.eof_packet())
+                continue
+            P.write_packet(conn, 1, P.err_packet(1047, f"unknown command {cmd:#x}"))
+
+    @staticmethod
+    def _status(sess: Session) -> int:
+        status = 0
+        if sess.sysvars.get("autocommit"):
+            status |= P.SERVER_STATUS_AUTOCOMMIT
+        if sess.txn is not None:
+            status |= P.SERVER_STATUS_IN_TRANS
+        return status
+
+    def _run_sql(self, conn: socket.socket, sess: Session, sql: str) -> None:
+        try:
+            # the storage layer is single-writer: statements across
+            # connections serialize on the catalog lock
+            with self.catalog.lock:
+                rs = sess.execute(sql)
+        except TidbError as e:
+            P.write_packet(conn, 1, P.err_packet(1105, str(e)))
+            return
+        except Exception as e:  # engine bug — surface, don't kill the conn
+            traceback.print_exc()
+            P.write_packet(conn, 1, P.err_packet(1105, f"internal error: {e}"))
+            return
+        status = self._status(sess)
+        if rs is None:
+            P.write_packet(conn, 1, P.ok_packet(status=status))
+            return
+        types = rs.types or [None] * len(rs.names)
+        seq = P.write_packet(conn, 1, P.lenc_int(len(rs.names)))
+        for name, kind in zip(rs.names, types):
+            seq = P.write_packet(conn, seq, P.column_def41(name, P.mysql_type_of(kind)))
+        seq = P.write_packet(conn, seq, P.eof_packet(status=status))
+        for row in rs.rows:
+            seq = P.write_packet(conn, seq, P.text_row(list(row)))
+        P.write_packet(conn, seq, P.eof_packet(status=status))

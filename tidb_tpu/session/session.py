@@ -281,6 +281,10 @@ class Session:
                     self.user_vars[name.lstrip("@")] = v
                 else:
                     self.sysvars.set(name, v, scope or "session")
+                    # MySQL: enabling autocommit commits the open txn
+                    if (name.lower() == "autocommit" and scope != "global"
+                            and self.sysvars.get("autocommit")):
+                        self._commit()
             return None
         if isinstance(stmt, A.ShowStmt):
             return self._run_show(stmt)

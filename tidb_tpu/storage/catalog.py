@@ -25,6 +25,12 @@ class Database:
 
 class Catalog:
     def __init__(self):
+        import threading
+
+        # statement-granularity lock for multi-threaded front-ends (the wire
+        # server): the host storage layer is single-writer by design, like
+        # the reference's per-region leaseholder
+        self.lock = threading.RLock()
         self.databases: Dict[str, Database] = {"test": Database("test")}
         self.schema_version = 0
         # cluster-wide GLOBAL sysvars (ref: mysql.global_variables)
