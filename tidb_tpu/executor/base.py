@@ -87,7 +87,19 @@ class ResultSet:
 
 
 def run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None) -> ResultSet:
-    """Drive an executor tree to completion and materialize host rows."""
+    """Drive an executor tree to completion and materialize host rows.
+
+    Runs under host_eager(): the tree's glue ops (finalize, sort of a
+    few groups, result decode) stay on the host CPU backend; only the
+    compiled mesh fragments — whose inputs are committed device arrays —
+    execute on the accelerator. Keeps device round-trips per query O(1)."""
+    from tidb_tpu.utils.device import host_eager
+
+    with host_eager():
+        return _run_plan(root, ctx, n_visible)
+
+
+def _run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None) -> ResultSet:
     opened = False
     try:
         root.open(ctx)  # inside try: open() can raise after acquiring

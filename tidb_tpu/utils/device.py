@@ -1,0 +1,53 @@
+"""Device-placement policy for the execution runtime.
+
+The TPU-first execution contract (ref: SURVEY.md §7 hard part 5 —
+host<->device staging costs): all hot-loop compute runs inside a small
+number of *compiled* fragments dispatched to the accelerator mesh, and
+everything outside those fragments (operator glue, final ORDER BY over a
+handful of groups, result decode) runs on the host. On real hardware a
+device round-trip costs ~100-500ms of latency when the chip is reached
+over a network tunnel, and even locally each eager op dispatch +
+transfer is pure overhead — a query must cost O(1) device round-trips,
+not O(ops).
+
+`host_eager()` pins jax's *default* device to the CPU backend for the
+duration of the executor tree walk. Compiled mesh fragments are
+unaffected: their inputs are committed, sharded device arrays, and
+explicit shardings/meshes always win over the default-device hint. Only
+uncommitted eager ops (numpy inputs) land on CPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+__all__ = ["host_eager", "host_cpu_device"]
+
+_cpu_device: Optional[object] = None
+_probed = False
+
+
+def host_cpu_device():
+    """The host CPU backend device, or None when the default backend is
+    already CPU (tests pin jax_platforms=cpu; no second backend exists)."""
+    global _cpu_device, _probed
+    if not _probed:
+        _probed = True
+        try:
+            if jax.default_backend() != "cpu":
+                _cpu_device = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            _cpu_device = None
+    return _cpu_device
+
+
+def host_eager():
+    """Context manager: eager ops go to host CPU; compiled mesh
+    fragments keep their explicit placement."""
+    dev = host_cpu_device()
+    if dev is None:
+        return contextlib.nullcontext()
+    return jax.default_device(dev)
