@@ -273,6 +273,10 @@ class HashAggExec(Executor):
         from tidb_tpu.utils.memory import SpillableRuns
 
         group_exprs, aggs = self.group_exprs, self.aggs
+        if (group_exprs and self.ctx.device_agg
+                and not any(a.distinct for a in aggs)):
+            self._run_generic_device()
+            return
 
         def eval_all(chunk):
             outs = []
@@ -378,6 +382,56 @@ class HashAggExec(Executor):
         finally:
             fallback_tracker.release(fallback_bytes)
             runs.close()
+
+    def _run_generic_device(self):
+        """Sort-based grouping on device (agg_device.py): per-chunk
+        partial group tables, pairwise device merges, one batched fetch,
+        host finalize through the shared partial-state path."""
+        import jax
+
+        from tidb_tpu.executor.agg_device import (
+            GroupTableStack,
+            make_partial_kernel,
+            table_to_host_partial,
+        )
+
+        sig = repr((self.group_exprs, self.aggs))
+        partial_fn = cached_jit(
+            "aggpart", sig, lambda: make_partial_kernel(self.group_exprs, self.aggs)
+        )
+        stack = GroupTableStack(len(self.group_exprs), self.aggs, sig)
+        for chunk in self.children[0].chunks():
+            stack.push(partial_fn(chunk))
+
+        tables = stack.tables()
+        cap = self.ctx.chunk_capacity
+        if not tables:
+            self._out = []  # grouped agg over empty input -> no rows
+            return
+        host_tables = jax.device_get(tables)  # ONE round trip
+        # account the durable (ngroups-sliced) partial tables with the
+        # same incremental discipline as the host spill-merge path; the
+        # padded slot arrays are transients
+        tracker = self.ctx.mem_tracker.child("hashagg.device")
+        tracked = 0
+        try:
+            merged = None
+            for t in host_tables:
+                p = table_to_host_partial(t, len(self.group_exprs), self.aggs)
+                b_p = _partial_nbytes(p)
+                tracker.consume(b_p)
+                tracked += b_p
+                if merged is None:
+                    merged = p
+                else:
+                    merged = self._merge_partials([merged, p])
+                    b_m = _partial_nbytes(merged)
+                    tracker.consume(b_m)
+                    tracker.release(tracked)  # old merged + p are dead
+                    tracked = b_m
+            self._emit_merged(merged, cap)
+        finally:
+            tracker.release(tracked)
 
     def _run_generic_resident(self, run_list, cat, cap):
         group_exprs, aggs = self.group_exprs, self.aggs

@@ -36,6 +36,10 @@ class ExecContext:
     # host-side memory accounting root (budget + spill/OOM actions live
     # here; ref: the per-query memory.Tracker in sessionctx)
     mem_tracker: "object" = None
+    # generic (high-cardinality) aggregation via the jitted sort-based
+    # grouping kernels; off falls back to the numpy oracle path
+    # (tidb_enable_tpu_exec sysvar)
+    device_agg: bool = True
 
     def __post_init__(self):
         if self.mem_tracker is None:

@@ -159,6 +159,7 @@ class Session:
             ),
             read_ts=self.txn.read_ts if self.txn is not None else None,
             txn_marker=self.txn.marker if self.txn is not None else 0,
+            device_agg=bool(self.sysvars.get("tidb_enable_tpu_exec")),
         )
 
     def _execute_subplan(self, logical) -> List[tuple]:
