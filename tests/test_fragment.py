@@ -1,0 +1,165 @@
+"""General distributed fragments (parallel/fragment.py) vs the sqlite
+oracle on the 8-virtual-device mesh.
+
+Covers what round 1's dist tier could not run distributed: many-many
+joins, multi-key joins, multi-way join trees, left/semi/anti kinds,
+other_cond filters, generic (high-cardinality) aggregation, and
+broadcast build sides — asserting the fragment path is actually used
+(no silent single-chip fallback) for each shape."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.parallel import make_mesh
+from tidb_tpu.parallel.executor import DistFragmentExec, build_dist_executor
+from tidb_tpu.parser import parse
+from tidb_tpu.session import Session
+from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+
+
+@pytest.fixture(scope="module")
+def sess(devices8):
+    mesh = make_mesh(n_shards=4, n_dcn=2, devices=devices8)
+    s = Session(chunk_capacity=2048, mesh=mesh)
+    rng = np.random.default_rng(11)
+    s.execute("CREATE TABLE fact (fk bigint, fk2 bigint, grp bigint, val bigint, tag varchar(8))")
+    s.execute("CREATE TABLE dim (dk bigint, dk2 bigint, dgrp bigint, weight bigint)")
+    s.execute("CREATE TABLE dim2 (ek bigint, cat bigint)")
+    n, nd, ne = 4000, 600, 40
+    rows = []
+    for i in range(n):
+        fk = "NULL" if i % 53 == 0 else str(rng.integers(1, nd + 1))
+        rows.append(
+            f"({fk}, {rng.integers(0, 4)}, {rng.integers(0, 900)}, "
+            f"{rng.integers(-100, 100)}, 't{rng.integers(0, 3)}')")
+    for start in range(0, n, 500):
+        s.execute("INSERT INTO fact VALUES " + ", ".join(rows[start:start + 500]))
+    rows = []
+    for i in range(1, nd + 1):
+        # duplicate dk values -> many-many joins against fact
+        rows.append(f"({(i % 300) + 1}, {i % 4}, {i % 25}, {rng.integers(1, 10)})")
+    s.execute("INSERT INTO dim VALUES " + ", ".join(rows))
+    rows = [f"({i}, {i % 7})" for i in range(1, ne + 1)]
+    s.execute("INSERT INTO dim2 VALUES " + ", ".join(rows))
+    return s
+
+
+@pytest.fixture(scope="module")
+def oracle(sess):
+    return mirror_to_sqlite(sess.catalog)
+
+
+def check(sess, oracle, sql, expect_fragment=True):
+    if expect_fragment:
+        root = build_dist_executor(sess._plan_select(parse(sql)[0]), sess._shard_cache)
+        names, stack = set(), [root]
+        while stack:
+            e = stack.pop()
+            names.add(type(e).__name__)
+            stack.extend(e.children)
+        assert "DistFragmentExec" in names, f"fragment not used: {sorted(names)}"
+    got = sess.query(sql)
+    want = oracle.execute(sql).fetchall()
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_many_many_join_generic_agg(sess, oracle):
+    check(sess, oracle, """
+        select grp, count(*), sum(val * weight) from fact
+        join dim on fk = dk group by grp order by grp""")
+
+
+def test_multi_key_join(sess, oracle):
+    check(sess, oracle, """
+        select dgrp, count(*), sum(val) from fact
+        join dim on fk = dk and fk2 = dk2 group by dgrp order by dgrp""")
+
+
+def test_three_way_join(sess, oracle):
+    check(sess, oracle, """
+        select cat, count(*), sum(val * weight) from fact
+        join dim on fk = dk
+        join dim2 on dgrp = ek
+        group by cat order by cat""")
+
+
+def test_left_join(sess, oracle):
+    check(sess, oracle, """
+        select grp, count(weight), count(*) from fact
+        left join dim on fk = dk and dk2 = 1
+        group by grp order by grp""")
+
+
+def test_join_other_cond(sess, oracle):
+    check(sess, oracle, """
+        select dgrp, count(*) from fact join dim on fk = dk and val > weight
+        group by dgrp order by dgrp""")
+
+
+def test_semi_join(sess, oracle):
+    # IN decorrelates to a semi join with a broadcast agg build side
+    check(sess, oracle, """
+        select grp, count(*) from fact
+        where fk in (select dk from dim where weight > 5)
+        group by grp order by grp""")
+
+
+def test_anti_join_not_in_null(sess, oracle):
+    # NOT IN against a subquery that contains no NULLs
+    check(sess, oracle, """
+        select count(*) from fact
+        where fk2 not in (select cat from dim2 where cat < 3)""",
+        expect_fragment=False)  # global agg is segment G=1 over anti join
+    # ... and with possible NULL keys on the probe side
+    check(sess, oracle, """
+        select grp, count(*) from fact
+        where fk not in (select dk from dim where dk > 250)
+        group by grp order by grp""")
+
+
+def test_segment_agg_over_join_tree(sess, oracle):
+    check(sess, oracle, """
+        select tag, count(*), sum(weight) from fact
+        join dim on fk = dk group by tag order by tag""")
+
+
+def test_high_cardinality_dist_agg(sess, oracle):
+    check(sess, oracle, """
+        select grp, fk2, count(*), sum(val), min(val), max(val), avg(val)
+        from fact group by grp, fk2 order by grp, fk2""")
+
+
+def test_growth_retry_on_skew(sess, oracle):
+    # every fact row joins every dim row with dk=1 (heavy duplication)
+    # forcing expansion-capacity retries
+    check(sess, oracle, """
+        select count(*), sum(weight) from fact join dim on fk2 = dk2
+        where dk2 = 1""", expect_fragment=False)
+
+
+def test_derived_table_probe_not_inflated(sess, oracle):
+    # regression: a subquery on the PROBE side of a join must not enter
+    # the fragment as a replicated broadcast — that counted every probe
+    # row once per shard (8x inflation on this mesh)
+    sql = """select count(*) from
+             (select fk f, count(*) c from fact group by fk) d
+             join dim on d.f = dk"""
+    check(sess, oracle, sql, expect_fragment=False)
+    sql = """select dgrp, count(*) from
+             (select fk f, sum(val) v from fact group by fk) d
+             left join dim on d.f = dk group by dgrp order by dgrp"""
+    check(sess, oracle, sql, expect_fragment=False)
+
+
+def test_update_invalidates_fragment_results(sess, oracle):
+    sql = """select grp, count(*), sum(val * weight) from fact
+             join dim on fk = dk group by grp order by grp"""
+    before = sess.query(sql)
+    sess.execute("INSERT INTO fact VALUES (1, 1, 1, 42, 'tX')")
+    after = sess.query(sql)
+    assert before != after
+    oracle.execute("INSERT INTO fact VALUES (1, 1, 1, 42, 'tX')")
+    want = oracle.execute(sql).fetchall()
+    ok, msg = rows_equal(after, want, ordered=True)
+    assert ok, msg

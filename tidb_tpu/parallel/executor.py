@@ -18,6 +18,8 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
+import numpy as np
+
 from tidb_tpu.utils.lru import get_or_build, touch
 
 
@@ -28,6 +30,8 @@ from tidb_tpu.executor.base import Executor
 from tidb_tpu.executor.scan import ProjectionExec, SelectionExec
 from tidb_tpu.executor.sort import LimitExec, SortExec, TopNExec
 from tidb_tpu.parallel.distsql import make_agg_fragment, make_join_agg_fragment
+from tidb_tpu.parallel.fragment import BROADCAST_LIMIT, compile_fragment
+from tidb_tpu.parallel.mesh import dcn_axis, shard_axis
 from tidb_tpu.parallel.partition import ShardedTable, shard_table
 from tidb_tpu.planner.physical import (
     PHashAgg,
@@ -204,6 +208,178 @@ class DistJoinAggExec(HashAggExec):
         self._finalize_segment_state(state, domains)
 
 
+class DistFragmentExec(HashAggExec):
+    """Agg root over a general compiled fragment (parallel/fragment.py):
+    join trees, broadcast build sides, segment or generic aggregation —
+    one shard_map dispatch per execution, with per-knob capacity retry."""
+
+    MAX_GROWTH = {"exch": 64.0, "expand": 2048.0}
+
+    def __init__(self, plan: PHashAgg, prog, cache: ShardCache):
+        super().__init__(plan.schema, None, plan.group_exprs, plan.group_uids,
+                         plan.aggs, plan.strategy,
+                         segment_sizes=getattr(plan, "segment_sizes", None))
+        self.children = []
+        self._plan = plan
+        self._prog = prog
+        self._cache = cache
+        self._delegate = None
+
+    def _run_segment(self):
+        self._run_fragment()
+
+    def _run_generic(self):
+        self._run_fragment()
+
+    def next(self):
+        if self._delegate is not None:
+            return self._delegate.next()
+        return super().next()
+
+    def close(self):
+        if self._delegate is not None:
+            self._delegate.close()
+            self._delegate = None
+        super().close()
+
+    def _fall_back_single_chip(self):
+        """Pathological skew blew every capacity retry: run the plan on
+        the single-chip executors instead of failing the query (the
+        reference's root-task fallback)."""
+        root = build_executor(self._plan)
+        root.open(self.ctx)
+        self._delegate = root
+
+    # ------------------------------------------------------------------
+
+    def _materialize_broadcast(self, bc):
+        """Run a non-scan subtree on this chip and return replicated
+        (data, valid, sel) arrays — the broadcast exchange input."""
+        from tidb_tpu.executor.builder import build_executor
+
+        root = build_executor(bc.plan)
+        datas = {c.uid: [] for c in bc.schema}
+        valids = {c.uid: [] for c in bc.schema}
+        n = 0
+        try:
+            root.open(self.ctx)
+            for ch in root.chunks():
+                sel = np.asarray(ch.sel)
+                live = np.nonzero(sel)[0]
+                n += len(live)
+                for c in bc.schema:
+                    col = ch.columns[c.uid]
+                    datas[c.uid].append(np.asarray(col.data)[live])
+                    valids[c.uid].append(np.asarray(col.valid)[live])
+        finally:
+            root.close()
+        # pad to pow2 so repeated executions reuse compiled shapes
+        cap = 1
+        while cap < max(n, 1):
+            cap *= 2
+        data, valid = {}, {}
+        for c in bc.schema:
+            d = (np.concatenate(datas[c.uid]) if datas[c.uid]
+                 else np.zeros(0, dtype=c.type_.np_dtype))
+            v = (np.concatenate(valids[c.uid]) if valids[c.uid]
+                 else np.zeros(0, dtype=np.bool_))
+            db = np.zeros(cap, dtype=d.dtype)
+            vb = np.zeros(cap, dtype=np.bool_)
+            db[:n], vb[:n] = d, v
+            data[c.uid], valid[c.uid] = db, vb
+        sel = np.zeros(cap, dtype=np.bool_)
+        sel[:n] = True
+        return data, valid, sel, n
+
+    def _run_fragment(self):
+        import jax
+
+        prog = self._prog
+        args, sts = [], []
+        for src in prog.sources:
+            st = self._cache.get(src.scan.table)
+            args += [st.data, st.valid, st.sel]
+            sts.append(st)
+        bcast_shapes = []
+        for bc in prog.broadcasts:
+            data, valid, sel, n = self._materialize_broadcast(bc)
+            if n > BROADCAST_LIMIT:
+                raise ExecutionError(
+                    f"broadcast side too large ({n} rows); "
+                    "disable tidb_enable_tpu_exec for this query")
+            args += [data, valid, sel]
+            bcast_shapes.append(len(sel))
+
+        gkey = (prog.sig,) + tuple(st.serial for st in sts)
+        growths = self._cache.growth.get(gkey) or prog.growth_defaults
+        shapes_sig = (tuple((st.n_parts, st.rows_per_part) for st in sts),
+                      tuple(bcast_shapes))
+        types_sig = tuple(_types_sig(st) for st in sts)
+        while True:
+            key = ("frag", prog.sig, growths, shapes_sig, types_sig)
+            fn = self._cache.get_fragment(
+                key, lambda: prog.build_fn(growths))
+            out, ovf = fn(*args)
+            ovf = np.asarray(ovf)
+            if not (ovf > 0).any():
+                break
+            # grow only the blown capacities; re-runs with proven growths
+            # start from the cached vector next time. "exch" knobs double;
+            # "expand" knobs jump to the reported required factor in one
+            # recompile (skewed joins can demand 100x+ at once)
+            new = []
+            for g, o, kind in zip(growths, ovf, prog.growth_kinds):
+                if o <= 0:
+                    new.append(g)
+                elif kind == "expand":
+                    factor = int(o) + 1
+                    mult = 1
+                    while mult < factor:
+                        mult *= 2
+                    new.append(g * max(mult, 2))
+                else:
+                    new.append(g * 2)
+            growths = tuple(new)
+            if any(g > self.MAX_GROWTH[k]
+                   for g, k in zip(growths, prog.growth_kinds)):
+                self._fall_back_single_chip()
+                return
+        touch(self._cache.growth, gkey, growths, ShardCache.MAX_FRAGMENTS)
+
+        if prog.out_kind == "segment":
+            self._finalize_segment_state(out, prog.domains)
+        else:
+            self._finalize_generic_tables(out)
+
+    def _finalize_generic_tables(self, out):
+        """Fetch the sharded per-part group tables (one device_get),
+        convert and merge through the shared host partial-state path."""
+        import jax
+
+        from tidb_tpu.executor.agg_device import table_to_host_partial
+
+        host = jax.device_get(out)
+        n_per = np.asarray(host["n"]).reshape(-1)
+        n_parts = len(n_per)
+        nk = len(self.group_exprs)
+        partials = []
+        for p in range(n_parts):
+            if n_per[p] == 0:
+                continue
+            t = {"n": n_per[p]}
+            for name, arr in host.items():
+                if name == "n":
+                    continue
+                S = len(arr) // n_parts
+                t[name] = arr[p * S:(p + 1) * S]
+            partials.append(table_to_host_partial(t, nk, self.aggs))
+        if not partials:
+            self._out = []  # no groups anywhere
+            return
+        merged = partials[0] if len(partials) == 1 else self._merge_partials(partials)
+        self._emit_merged(merged, self.ctx.chunk_capacity)
+
+
 def _try_dist_agg(plan: PHashAgg, cache: ShardCache) -> Optional[Executor]:
     if plan.strategy != "segment":
         return None
@@ -244,9 +420,22 @@ def _try_dist_agg(plan: PHashAgg, cache: ShardCache) -> Optional[Executor]:
 def build_dist_executor(plan: PhysicalPlan, cache: ShardCache) -> Executor:
     """Build an executor tree, running distributable fragments on the mesh."""
     if isinstance(plan, PHashAgg):
-        ex = _try_dist_agg(plan, cache)
+        ex = _try_dist_agg(plan, cache)  # proven fast paths first
         if ex is not None:
             return ex
+        prog = compile_fragment(
+            plan, cache.mesh,
+            cache.mesh.shape[dcn_axis] * cache.mesh.shape[shard_axis])
+        if prog is not None:
+            return DistFragmentExec(plan, prog, cache)
+        if _collapse_to_scan(plan.child) is None:
+            # the agg itself isn't distributable (agg-over-agg, DISTINCT,
+            # ...) but its subtree may contain fragmentable aggs/joins —
+            # run the root agg on the host over a distributed child
+            return HashAggExec(
+                plan.schema, build_dist_executor(plan.child, cache),
+                plan.group_exprs, plan.group_uids, plan.aggs, plan.strategy,
+                segment_sizes=getattr(plan, "segment_sizes", None))
         return build_executor(plan)
     if isinstance(plan, (PProjection, PSelection)):
         # a fusible chain over a plain scan has no collective fragment —

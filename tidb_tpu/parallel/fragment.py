@@ -1,0 +1,509 @@
+"""General distributed fragments: an agg-rooted plan subtree compiled
+into ONE shard_map program over the mesh.
+
+This generalizes distsql.py's two fixed shapes (ref: the MPP exchange +
+coprocessor tiers, SURVEY.md §2 parallelism table) to:
+
+  * join trees of any depth/width — each equi-join hash-repartitions
+    both sides over lax.all_to_all and joins locally by sorted-key
+    ranges with duplicate expansion (many-many joins), all inside the
+    same per-shard program
+  * all join kinds: inner, left (NULL-padded unmatched probe rows),
+    semi, anti (incl. NOT IN null semantics via a psum'd build-NULL
+    count), with other_cond filters and multi-key equi joins (routed by
+    a combined key hash, verified by exact per-key equality)
+  * build sides that aren't scans (subquery results, small dimension
+    pipelines) materialize on the host and enter the fragment as
+    REPLICATED broadcast inputs — the broadcast-join exchange — which
+    also skips repartitioning the probe side entirely
+  * both aggregation strategies at the root: segment (dense [G] states,
+    psum/pmin/pmax merge) and generic (per-shard sort-based partial
+    tables from executor/agg_device.py, hash-repartitioned by group key
+    and locally merged — the two-phase MPP shuffle agg), so
+    high-cardinality GROUP BY runs on the mesh too
+
+Every fixed-capacity buffer (exchange buckets, join expansion slots)
+counts its overflow instead of dropping rows; the driving executor
+doubles the blown growth factor and re-runs — the static-shape analogue
+of the reference's spill/split retry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.executor.agg_device import (
+    _bits64,
+    _sort_reduce,
+    _state_layout,
+    make_partial_kernel,
+)
+from tidb_tpu.executor.aggregate import make_segment_kernel
+from tidb_tpu.executor.builder import peel_stages, scan_stages_for
+from tidb_tpu.executor.scan import make_pipeline_fn
+from tidb_tpu.expression.compiler import compile_predicate, eval_expr
+from tidb_tpu.parallel.distsql import merge_state, repartition_by_key
+from tidb_tpu.parallel.mesh import dcn_axis, shard_axis
+from tidb_tpu.planner.physical import PHashAgg, PHashJoin, PScan
+from tidb_tpu.types import TypeKind
+
+__all__ = ["compile_fragment", "FragmentProgram"]
+
+_AXES = (dcn_axis, shard_axis)
+_SPEC = P(_AXES, None)
+
+# rows above this don't broadcast — the subtree is too big to replicate
+BROADCAST_LIMIT = 1 << 21
+
+
+# group/join key identity bits: same rule as the local sort-reduce
+# (NULL -> 0 + validity flag; floats by bit pattern) so exchange routing
+# and local grouping can never disagree
+_key_bits = _bits64
+
+
+def _mix_hash(bits: List[jax.Array]) -> jax.Array:
+    """Combine per-key bit patterns into one routing/sort hash."""
+    if len(bits) == 1:
+        return bits[0]  # exact value: collision-free fast path
+    h = jnp.zeros_like(bits[0])
+    for b in bits:
+        h = (h ^ b) * np.int64(-7046029254386353131) + np.int64(0x165667B19E3779F9)
+    return h
+
+
+@dataclass
+class _Source:
+    """A sharded scan input (3 fragment args: data, valid, sel)."""
+    scan: PScan
+    stages: list
+
+
+@dataclass
+class _Broadcast:
+    """A host-materialized subtree entering replicated (2 args + sel)."""
+    plan: object  # physical subtree to materialize
+    schema: list
+
+
+@dataclass
+class FragmentProgram:
+    """Compiled description of a distributable agg subtree."""
+    agg: PHashAgg
+    sources: List[_Source]
+    broadcasts: List[_Broadcast]
+    n_growth: int                      # number of growth knobs
+    sig: str
+    build_fn: Callable                 # (growths tuple) -> per-shard program
+    out_kind: str                      # "segment" | "generic"
+    domains: List[int] = field(default_factory=list)
+    growth_defaults: Tuple[float, ...] = ()
+    growth_kinds: Tuple[str, ...] = ()
+
+
+class _Unsupported(Exception):
+    pass
+
+
+class _Compiler:
+    def __init__(self, n_parts: int):
+        self.n_parts = n_parts
+        self.sources: List[_Source] = []
+        self.broadcasts: List[_Broadcast] = []
+        self.n_growth = 0
+        # default capacity factor per knob, in assignment order: exchanges
+        # start at 2x (skew headroom), join expansion at 1x (covers <=1
+        # match per probe row — the PK-FK common case). "exch" knobs
+        # report an overflow row count (executor doubles); "expand" knobs
+        # report required-factor-minus-one (executor jumps in one step —
+        # a skewed many-many join can demand 100x+ slots at once)
+        self.growth_defaults: List[float] = []
+        self.growth_kinds: List[str] = []
+        self.sig: List[str] = []
+
+    def _add_growth(self, default: float, kind: str) -> int:
+        idx = self.n_growth
+        self.n_growth += 1
+        self.growth_defaults.append(default)
+        self.growth_kinds.append(kind)
+        return idx
+
+    # -- producers ---------------------------------------------------------
+
+    def producer(self, plan) -> Callable:
+        """Compile a subtree into emit(env, growths) -> (Chunk, [ovf])."""
+        stages, base = peel_stages(plan)
+        if isinstance(base, PScan) and base.table is not None:
+            return self._scan_producer(base, scan_stages_for(base, stages))
+        if isinstance(base, PHashJoin):
+            join_emit = self._join_producer(base)
+            if stages:
+                pipe = make_pipeline_fn(stages)
+
+                def emit(env, growths, _j=join_emit, _p=pipe):
+                    ch, ovf = _j(env, growths)
+                    return _p(ch), ovf
+
+                self.sig.append(f"stages{stages!r}")
+                return emit
+            return join_emit
+        # anything else (agg subtree, union, limit...) becomes a broadcast
+        return self._broadcast_producer(plan)
+
+    def _scan_producer(self, scan: PScan, stages) -> Callable:
+        idx = len(self.sources)
+        self.sources.append(_Source(scan, stages))
+        uid_of = {c.name: c.uid for c in scan.schema}
+        type_of = {c.name: c.type_ for c in scan.schema}
+        pipe = make_pipeline_fn(stages) if stages else (lambda c: c)
+        self.sig.append(f"scan{idx}:{scan.table_name}:{stages!r}")
+
+        def emit(env, growths):
+            data, valid, sel = env["scan"][idx]
+            # the sharding carries every table column; take only the
+            # (pruned) scan schema
+            cols = {
+                uid_of[name]: Column(data=data[name][0], valid=valid[name][0],
+                                     type_=type_of[name])
+                for name in uid_of
+            }
+            return pipe(Chunk(cols, sel[0])), []
+
+        return emit
+
+    def _broadcast_producer(self, plan) -> Callable:
+        idx = len(self.broadcasts)
+        self.broadcasts.append(_Broadcast(plan, list(plan.schema)))
+        types = {c.uid: c.type_ for c in plan.schema}
+        self.sig.append(f"bcast{idx}:{[(c.uid, c.type_) for c in plan.schema]!r}")
+
+        def emit(env, growths):
+            data, valid, sel = env["bcast"][idx]
+            cols = {uid: Column(data=data[uid], valid=valid[uid], type_=types[uid])
+                    for uid in data}
+            return Chunk(cols, sel), []
+
+        return emit
+
+    # -- joins -------------------------------------------------------------
+
+    def _join_producer(self, join: PHashJoin) -> Callable:
+        if not join.eq_left:
+            raise _Unsupported("keyless (cross) join")
+        if join.kind not in ("inner", "left", "semi", "anti"):
+            raise _Unsupported(f"join kind {join.kind}")
+
+        probe_idx = 1 - join.build_side
+        probe_plan = join.children[probe_idx]
+        build_plan = join.children[join.build_side]
+        probe_keys = join.eq_left if probe_idx == 0 else join.eq_right
+        build_keys = join.eq_right if join.build_side == 1 else join.eq_left
+
+        # decide build mode BEFORE compiling children: a broadcast build
+        # skips both exchanges
+        def _is_bcast(plan) -> bool:
+            _, base = peel_stages(plan)
+            return not (isinstance(base, PScan) and base.table is not None
+                        ) and not isinstance(base, PHashJoin)
+
+        build_is_bcast = _is_bcast(build_plan)
+        if _is_bcast(probe_plan):
+            # a replicated probe side would be joined (and aggregated)
+            # once PER SHARD, inflating every result by n_parts
+            raise _Unsupported("broadcast probe side")
+
+        probe_emit = self.producer(probe_plan)
+        build_emit = self.producer(build_plan)
+
+        exchange = not build_is_bcast
+        g_exch = self._add_growth(2.0, "exch") if exchange else None
+        g_expand = self._add_growth(1.0, "expand")
+
+        kind = join.kind
+        exists_sem = join.exists_sem
+        other_cond = join.other_cond
+        other_pred = compile_predicate(other_cond) if other_cond is not None else None
+        n_parts = self.n_parts
+        nk = len(probe_keys)
+        need_verify = nk > 1
+        self.sig.append(
+            f"join:{kind}:{exists_sem}:{probe_keys!r}:{build_keys!r}:{other_cond!r}"
+            f":exch{exchange}"
+        )
+        # probe columns survive the join; build columns only feed inner/left
+        # output and other_cond evaluation
+        build_cols_out = kind in ("inner", "left")
+
+        def emit(env, growths):
+            pch, p_ovf = probe_emit(env, growths)
+            bch, b_ovf = build_emit(env, growths)
+            ovfs = list(p_ovf) + list(b_ovf)
+
+            p_outs = [eval_expr(k, pch) for k in probe_keys]
+            b_outs = [eval_expr(k, bch) for k in build_keys]
+            p_bits = [_key_bits(d, v) for d, v in p_outs]
+            b_bits = [_key_bits(d, v) for d, v in b_outs]
+            p_kvalid = p_outs[0][1]
+            b_kvalid = b_outs[0][1]
+            for _, v in p_outs[1:]:
+                p_kvalid = p_kvalid & v
+            for _, v in b_outs[1:]:
+                b_kvalid = b_kvalid & v
+            p_hash = _mix_hash(p_bits)
+            b_hash = _mix_hash(b_bits)
+
+            # NOT IN null semantics: any live build row with a NULL key
+            # empties the anti result — counted across the whole mesh
+            b_null = None
+            if kind == "anti" and not exists_sem:
+                b_null = jax.lax.psum(
+                    jnp.sum((bch.sel & ~b_kvalid).astype(jnp.int64)), _AXES)
+
+            def flat(ch: Chunk, bits, kvalid):
+                arrs = {}
+                for uid, col in ch.columns.items():
+                    arrs[uid + ".d"] = col.data
+                    arrs[uid + ".v"] = col.valid
+                for i, b in enumerate(bits):
+                    arrs[f"__kb{i}"] = b
+                arrs["__kv"] = kvalid
+                return arrs
+
+            def unflat(arrs, ref: Chunk, sel):
+                cols = {
+                    uid: Column(data=arrs[uid + ".d"], valid=arrs[uid + ".v"],
+                                type_=col.type_)
+                    for uid, col in ref.columns.items()
+                }
+                bits = [arrs[f"__kb{i}"] for i in range(nk)]
+                return Chunk(cols, sel), bits, arrs["__kv"]
+
+            if exchange:
+                growth = growths[g_exch]
+                pr, pr_sel, pr_hash, povf = repartition_by_key(
+                    flat(pch, p_bits, p_kvalid), pch.sel, p_hash,
+                    jnp.ones_like(p_kvalid), n_parts, growth)
+                br, br_sel, br_hash, bovf = repartition_by_key(
+                    flat(bch, b_bits, b_kvalid), bch.sel, b_hash,
+                    jnp.ones_like(b_kvalid), n_parts, growth)
+                ovfs.append(jax.lax.psum(povf + bovf, _AXES))
+                pch2, p_bits2, p_kvalid2 = unflat(pr, pch, pr_sel)
+                bch2, b_bits2, b_kvalid2 = unflat(br, bch, br_sel)
+                p_hash2, b_hash2 = pr_hash, br_hash
+            else:
+                pch2, p_bits2, p_kvalid2, p_hash2 = pch, p_bits, p_kvalid, p_hash
+                bch2, b_bits2, b_kvalid2, b_hash2 = bch, b_bits, b_kvalid, b_hash
+
+            Rp = pch2.capacity
+            Rb = bch2.capacity
+
+            # local sorted-range join on the hash; validity is a secondary
+            # sort key so valid rows prefix each equal-hash run
+            b_live = bch2.sel & b_kvalid2
+            inval = (~b_live).astype(jnp.int32)
+            sh, sinv, order = jax.lax.sort(
+                (b_hash2, inval, jnp.arange(Rb)), num_keys=2)
+            cvi = jnp.concatenate([
+                jnp.zeros(1, dtype=jnp.int64),
+                jnp.cumsum((sinv == 0).astype(jnp.int64)),
+            ])
+            lo = jnp.searchsorted(sh, p_hash2, side="left")
+            hi = jnp.searchsorted(sh, p_hash2, side="right")
+            p_ok = pch2.sel & p_kvalid2
+            cnt = jnp.where(p_ok, cvi[hi] - cvi[lo], 0)
+
+            cum = jnp.cumsum(cnt)
+            total = cum[-1]
+            growth_j = growths[g_expand]
+            capJ = int(np.ceil(growth_j * Rp))
+            # required-factor-minus-one, maxed over shards (0 = fits)
+            factor = (total + capJ - 1) // capJ
+            ovfs.append(jax.lax.pmax(jnp.maximum(factor - 1, 0), _AXES))
+
+            j = jnp.arange(capJ, dtype=jnp.int64)
+            valid_out = j < total
+            p_row = jnp.clip(jnp.searchsorted(cum, j, side="right"), 0, Rp - 1)
+            k = j - (cum[p_row] - cnt[p_row])
+            b_sorted_pos = jnp.clip(lo[p_row] + k, 0, Rb - 1)
+            b_row = order[b_sorted_pos]
+
+            sel_out = valid_out
+            if need_verify:  # hash routing can collide; verify exact keys
+                for pb, bb, in zip(p_bits2, b_bits2):
+                    sel_out = sel_out & (pb[p_row] == bb[b_row])
+                sel_out = sel_out & p_kvalid2[p_row] & b_kvalid2[b_row]
+
+            cols = {}
+            for uid, col in pch2.columns.items():
+                cols[uid] = col.gather(p_row, valid_out)
+            for uid, col in bch2.columns.items():
+                bc = col.gather(b_row, valid_out)
+                cols[uid] = Column(bc.data, bc.valid & sel_out, col.type_)
+            joined = Chunk(cols, sel_out & pch2.sel[p_row])
+
+            if other_pred is not None:
+                joined = joined.filter(other_pred(joined))
+
+            if kind == "inner":
+                return joined, ovfs
+
+            # per-probe-row match flags (post-cond): scatter-or by p_row
+            m = jnp.zeros(Rp, dtype=jnp.int32).at[p_row].add(
+                joined.sel.astype(jnp.int32)) > 0
+            if kind == "semi":
+                return pch2.with_sel(p_ok & m), ovfs
+            if kind == "anti":
+                if exists_sem:
+                    keep = pch2.sel & ~(p_kvalid2 & m)
+                else:
+                    keep = pch2.sel & p_kvalid2 & ~m & (b_null == 0)
+                return pch2.with_sel(keep), ovfs
+
+            # left join: expanded matches + one NULL-build row for each
+            # unmatched live probe row, concatenated into one chunk
+            pad_sel = pch2.sel & ~m
+            out_cols = {}
+            for uid, col in pch2.columns.items():
+                jc = joined.columns[uid]
+                out_cols[uid] = Column(
+                    jnp.concatenate([jc.data, col.data]),
+                    jnp.concatenate([jc.valid, col.valid]),
+                    col.type_,
+                )
+            for uid, col in bch2.columns.items():
+                jc = joined.columns[uid]
+                out_cols[uid] = Column(
+                    jnp.concatenate([jc.data, jnp.zeros(Rp, dtype=col.data.dtype)]),
+                    jnp.concatenate([jc.valid, jnp.zeros(Rp, dtype=jnp.bool_)]),
+                    col.type_,
+                )
+            sel_cat = jnp.concatenate([joined.sel, pad_sel])
+            return Chunk(out_cols, sel_cat), ovfs
+
+        return emit
+
+    # -- aggregation root --------------------------------------------------
+
+    def compile_agg(self, agg: PHashAgg) -> Tuple[Callable, str, List[int]]:
+        # the agg child must peel to a real sharded scan or a join tree;
+        # anything else would make the whole input a replicated broadcast
+        _, base = peel_stages(agg.child)
+        if not (isinstance(base, PHashJoin)
+                or (isinstance(base, PScan) and base.table is not None)):
+            raise _Unsupported("agg over non-scan/join subtree")
+        child_emit = self.producer(agg.child)
+
+        if any(a.distinct for a in agg.aggs):
+            raise _Unsupported("DISTINCT aggregates")
+
+        if agg.strategy == "segment":
+            sizes = agg.segment_sizes or []
+            domains = [s + 1 for s in sizes]
+            init_state, update, _ = make_segment_kernel(
+                agg.group_exprs, agg.aggs, domains)
+            self.sig.append(f"segagg:{agg.group_exprs!r}:{agg.aggs!r}:{domains!r}")
+
+            def emit(env, growths):
+                chunk, ovfs = child_emit(env, growths)
+                state = merge_state(update(init_state(), chunk))
+                return state, ovfs
+
+            return emit, "segment", domains
+
+        if not agg.group_exprs:
+            raise _Unsupported("generic global agg")  # planner uses segment
+        partial = make_partial_kernel(agg.group_exprs, agg.aggs)
+        layout = _state_layout(agg.aggs)
+        nk = len(agg.group_exprs)
+        g_agg = self._add_growth(2.0, "exch")
+        n_parts = self.n_parts
+        self.sig.append(f"genagg:{agg.group_exprs!r}:{agg.aggs!r}")
+
+        def emit(env, growths):
+            chunk, ovfs = child_emit(env, growths)
+            table = partial(chunk)  # local dedup before the exchange
+            S = table["k0.d"].shape[0]
+            live = jnp.arange(S) < table["n"]
+            kd = [table[f"k{i}.d"] for i in range(nk)]
+            kv = [table[f"k{i}.v"] for i in range(nk)]
+            khash = _mix_hash([_key_bits(d, v) for d, v in zip(kd, kv)])
+
+            arrays = {}
+            for i in range(nk):
+                arrays[f"k{i}.d"] = kd[i]
+                arrays[f"k{i}.v"] = kv[i]
+            for name, _ in layout:
+                arrays[name] = table[name]
+            recv, recv_sel, _, ovf = repartition_by_key(
+                arrays, live, khash, jnp.ones_like(live), n_parts,
+                growths[g_agg])
+            ovfs.append(jax.lax.psum(ovf, _AXES))
+
+            rkd = [recv[f"k{i}.d"] for i in range(nk)]
+            rkv = [recv[f"k{i}.v"] for i in range(nk)]
+            rbits = [_key_bits(d, v) for d, v in zip(rkd, rkv)]
+            payload = [recv[name] for name, _ in layout]
+            ops = [op for _, op in layout]
+            n, fk, fkv, red = _sort_reduce(rbits, rkv, rkd, recv_sel, payload, ops)
+            out = {"n": n[None]}
+            for i in range(nk):
+                out[f"k{i}.d"] = fk[i]
+                out[f"k{i}.v"] = fkv[i]
+            for (name, _), arr in zip(layout, red):
+                out[name] = arr
+            return out, ovfs
+
+        return emit, "generic", []
+
+
+def compile_fragment(agg: PHashAgg, mesh, n_parts: int) -> Optional[FragmentProgram]:
+    """Try to compile an agg-rooted subtree; None if not distributable."""
+    c = _Compiler(n_parts)
+    try:
+        emit, out_kind, domains = c.compile_agg(agg)
+    except _Unsupported:
+        return None
+    if not c.sources:
+        return None  # nothing sharded: run single-chip
+
+    n_src = len(c.sources)
+    n_bc = len(c.broadcasts)
+
+    def build_fn(growths: Tuple[float, ...]):
+        def frag(*args):
+            env = {"scan": [], "bcast": []}
+            i = 0
+            for _ in range(n_src):
+                env["scan"].append((args[i], args[i + 1], args[i + 2]))
+                i += 3
+            for _ in range(n_bc):
+                env["bcast"].append((args[i], args[i + 1], args[i + 2]))
+                i += 3
+            out, ovfs = emit(env, growths)
+            # per-knob overflow vector: the executor re-runs with only the
+            # blown capacities doubled
+            ovf = (jnp.stack([o.astype(jnp.int64) for o in ovfs])
+                   if ovfs else jnp.zeros((0,), dtype=jnp.int64))
+            return out, ovf
+
+        out_spec = P() if out_kind == "segment" else P(_AXES)
+        in_specs = tuple([_SPEC, _SPEC, _SPEC] * n_src + [P(), P(), P()] * n_bc)
+        return jax.jit(jax.shard_map(
+            frag, mesh=mesh, in_specs=in_specs, out_specs=(out_spec, P()),
+        ))
+
+    return FragmentProgram(
+        agg=agg, sources=c.sources, broadcasts=c.broadcasts,
+        n_growth=c.n_growth, sig="|".join(c.sig), build_fn=build_fn,
+        out_kind=out_kind, domains=domains,
+        growth_defaults=tuple(c.growth_defaults),
+        growth_kinds=tuple(c.growth_kinds),
+    )
