@@ -150,24 +150,108 @@ class PUnion(PhysicalPlan):
 _SEL_FILTER = 0.25
 
 
-def _estimate(plan: LogicalPlan) -> float:
+def resolve_scan_col(plan: LogicalPlan, uid: str):
+    """Trace a column uid to its defining base-table column (through
+    pass-through projections). Returns (table, column_name) or None."""
+    from tidb_tpu.expression.expr import ColumnRef
+
     if isinstance(plan, LScan):
-        n = float(plan.table.live_rows) if plan.table is not None else 1.0
+        for c in plan.schema:
+            if c.uid == uid:
+                return (plan.table, c.name) if plan.table is not None else None
+        return None
+    if isinstance(plan, LProjection):
+        for c, e in zip(plan.schema, plan.exprs):
+            if c.uid == uid:
+                if isinstance(e, ColumnRef):
+                    return resolve_scan_col(plan.child, e.name)
+                return None
+    for ch in plan.children:
+        r = resolve_scan_col(ch, uid)
+        if r is not None:
+            return r
+    return None
+
+
+def _eq_ndv(child: LogicalPlan, expr, child_rows: float) -> Optional[float]:
+    """NDV of a join-key expression over `child`, clamped by the child's
+    estimated rows (filters reduce distinct counts)."""
+    from tidb_tpu.expression.expr import ColumnRef
+
+    from tidb_tpu.statistics import column_ndv
+
+    if not isinstance(expr, ColumnRef):
+        return None
+    r = resolve_scan_col(child, expr.name)
+    if r is None:
+        return None
+    ndv = column_ndv(r[0], r[1])
+    if ndv is None:
+        return None
+    return max(min(ndv, child_rows), 1.0)
+
+
+def eq_join_rows(left: LogicalPlan, right: LogicalPlan, eq_conds,
+                 l: float, r: float, kind: str = "inner") -> float:
+    """|L join R| = |L|*|R| / prod over keys of max(ndv_l, ndv_r); falls
+    back to max(|L|,|R|) when no key has stats. A LEFT join emits every
+    left row at least once, so its estimate floors at |L|. Shared by the
+    cost display (_estimate) and the join reorderer (rules._greedy_order)."""
+    sel = None
+    for le, re_ in eq_conds:
+        nl = _eq_ndv(left, le, l)
+        nr = _eq_ndv(right, re_, r)
+        if nl is None and nr is None:
+            continue
+        d = max(nl or 1.0, nr or 1.0)
+        sel = (sel if sel is not None else 1.0) / d
+    out = max(l, r) if sel is None else max(l * r * sel, 1.0)
+    if kind == "left":
+        out = max(out, l)
+    return out
+
+
+def _estimate(plan: LogicalPlan) -> float:
+    from tidb_tpu.statistics import scan_selectivity, table_stats
+
+    if isinstance(plan, LScan):
+        if plan.table is None:
+            return 1.0
+        s = table_stats(plan.table)
+        n = float(s.n_rows) if s is not None else float(plan.table.live_rows)
         if plan.pushed_cond is not None:
-            n *= _SEL_FILTER
+            if s is not None:
+                uid_to_col = {c.uid: c.name for c in plan.schema}
+                n *= scan_selectivity(plan.table, plan.pushed_cond, uid_to_col)
+            else:
+                n *= _SEL_FILTER
         return max(n, 1.0)
     if isinstance(plan, LSelection):
         return max(_estimate(plan.child) * _SEL_FILTER, 1.0)
     if isinstance(plan, LAggregate):
         n = _estimate(plan.child)
-        return max(min(n, n ** 0.75), 1.0) if plan.group_exprs else 1.0
+        if not plan.group_exprs:
+            return 1.0
+        # with stats: groups bounded by the product of key NDVs
+        prod = 1.0
+        known = True
+        for g in plan.group_exprs:
+            ndv = _eq_ndv(plan.child, g, n)
+            if ndv is None:
+                known = False
+                break
+            prod = min(prod * ndv, 1e18)
+        if known:
+            return max(min(n, prod), 1.0)
+        return max(min(n, n ** 0.75), 1.0)
     if isinstance(plan, LJoin):
         l = _estimate(plan.children[0])
         r = _estimate(plan.children[1])
         if plan.kind in ("semi", "anti"):
             return max(l * 0.5, 1.0)
         if plan.eq_conds:
-            return max(l, r)
+            return eq_join_rows(plan.children[0], plan.children[1],
+                                plan.eq_conds, l, r, plan.kind)
         return l * r
     if isinstance(plan, LUnion):
         return sum(_estimate(c) for c in plan.children)

@@ -364,9 +364,102 @@ def _rule_prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
 
 
 # ---------------------------------------------------------------------------
+# join reordering (ref: planner/core's join-reorder rule — greedy over
+# statistics-driven cardinality estimates; FROM-order joins are a 10-100x
+# perf cliff at scale, and a cross join blocks the distributed tier)
+# ---------------------------------------------------------------------------
+
+
+def _flatten_inner(plan: LogicalPlan, leaves, eqs, others):
+    """Collect the maximal contiguous inner/cross-join tree."""
+    if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
+        _flatten_inner(plan.children[0], leaves, eqs, others)
+        _flatten_inner(plan.children[1], leaves, eqs, others)
+        eqs.extend(plan.eq_conds)
+        if plan.other_cond is not None:
+            others.append(plan.other_cond)
+    else:
+        leaves.append(plan)
+
+
+def _greedy_order(leaves, eqs, others) -> LogicalPlan:
+    from tidb_tpu.planner.physical import _estimate, eq_join_rows
+
+    n = len(leaves)
+    uidsets = [{c.uid for c in l.schema} for l in leaves]
+
+    def owner(refs: Set[str]) -> Optional[int]:
+        for i, s in enumerate(uidsets):
+            if refs and refs <= s:
+                return i
+        return None
+
+    edges = []  # (leaf_i, leaf_j, expr_i, expr_j)
+    leftover = list(others)
+    for a, b in eqs:
+        ia, ib = owner(_refs(a)), owner(_refs(b))
+        if ia is None or ib is None or ia == ib:
+            leftover.append(Call(type_=BOOL, op="eq", args=(a, b)))
+        else:
+            edges.append((ia, ib, a, b))
+
+    est = [_estimate(l) for l in leaves]
+    start = min(range(n), key=lambda i: est[i])
+    cur_set = {start}
+    tree, cur_rows = leaves[start], est[start]
+    remaining = set(range(n)) - cur_set
+
+    while remaining:
+        def conn_edges(c):
+            out = []
+            for ia, ib, a, b in edges:
+                if ia in cur_set and ib == c:
+                    out.append((a, b))
+                elif ib in cur_set and ia == c:
+                    out.append((b, a))
+            return out
+
+        def join_rows(c, conds):
+            if not conds:
+                return cur_rows * est[c]  # forced cross join
+            return eq_join_rows(tree, leaves[c], conds, cur_rows, est[c])
+
+        cands = [(c, conn_edges(c)) for c in remaining]
+        connected = [(c, e) for c, e in cands if e]
+        pool = connected or cands  # avoid cross joins whenever possible
+        best, conds = min(pool, key=lambda ce: join_rows(*ce))
+        cur_rows = join_rows(best, conds)
+        tree = LJoin(
+            schema=list(tree.schema) + list(leaves[best].schema),
+            children=[tree, leaves[best]],
+            kind="inner", eq_conds=conds,
+        )
+        cur_set.add(best)
+        remaining.discard(best)
+
+    if leftover:
+        sel = LSelection(schema=list(tree.schema), children=[tree],
+                         cond=_conj_join(leftover))
+        return _rule_pushdown(sel)  # re-extract eq keys / push filters
+    return tree
+
+
+def _rule_reorder(plan: LogicalPlan) -> LogicalPlan:
+    if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
+        leaves, eqs, others = [], [], []
+        _flatten_inner(plan, leaves, eqs, others)
+        if len(leaves) > 2:
+            leaves = [_rule_reorder(l) for l in leaves]
+            return _greedy_order(leaves, eqs, others)
+    plan.children = [_rule_reorder(c) for c in plan.children]
+    return plan
+
+
+# ---------------------------------------------------------------------------
 
 def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
     plan = _rule_fold(plan)
     plan = _rule_pushdown(plan)
+    plan = _rule_reorder(plan)
     plan = _rule_prune(plan, None)
     return plan

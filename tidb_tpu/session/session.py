@@ -299,7 +299,11 @@ class Session:
             self._rollback()
             return None
         if isinstance(stmt, A.AnalyzeStmt):
-            return None  # stats are live row counts for now
+            from tidb_tpu.statistics import analyze_table
+
+            for tn in stmt.tables:
+                analyze_table(self.catalog.table(tn.schema or self.db, tn.name))
+            return None
         if isinstance(stmt, (A.CreateIndexStmt, A.DropIndexStmt)):
             return None  # indexes: accepted, scans are columnar
         if isinstance(stmt, A.AlterTableStmt):

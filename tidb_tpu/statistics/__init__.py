@@ -1,0 +1,191 @@
+"""Table/column statistics feeding the cost model.
+
+Ref counterpart: statistics/ (histograms, NDV, auto-analyze feeding
+planner/core's cost-based search). Here ANALYZE TABLE collects, per
+column: NDV, null count, min/max, and an equi-depth histogram over the
+live rows; the planner consumes them for scan selectivity and join
+cardinality (planner/physical.py, planner/rules.py join reordering).
+
+Stats are version-stamped: a table mutation bumps table.version, and
+estimates silently degrade to the no-stats heuristics until the next
+ANALYZE — the same freshness model as the reference's stale-stats
+behavior, without its feedback loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from tidb_tpu.types import TypeKind
+
+__all__ = ["ColumnStats", "TableStats", "analyze_table", "table_stats",
+           "scan_selectivity", "column_ndv", "HIST_BUCKETS"]
+
+HIST_BUCKETS = 64
+
+
+@dataclass
+class ColumnStats:
+    ndv: int
+    null_count: int
+    min: Optional[float] = None
+    max: Optional[float] = None
+    # equi-depth histogram: `bounds` are the sorted values at the bucket
+    # quantiles (len <= HIST_BUCKETS+1); each bucket holds ~equal rows
+    bounds: Optional[np.ndarray] = None
+
+
+@dataclass
+class TableStats:
+    n_rows: int
+    version: int
+    cols: Dict[str, ColumnStats] = field(default_factory=dict)
+
+
+def analyze_table(table) -> TableStats:
+    """Collect stats over the live rows of a host table."""
+    n = table.n
+    live = np.asarray(table.live_mask(0, n)) if n else np.zeros(0, dtype=bool)
+    n_live = int(live.sum())
+    stats = TableStats(n_rows=n_live, version=table.version)
+    for c in table.schema.columns:
+        data, valid = table.column_slice(c.name, 0, n)
+        data, valid = np.asarray(data)[live], np.asarray(valid)[live]
+        vals = data[valid]
+        null_count = n_live - len(vals)
+        if len(vals) == 0:
+            stats.cols[c.name] = ColumnStats(ndv=0, null_count=null_count)
+            continue
+        sv = np.sort(vals.astype(np.float64, copy=False))
+        ndv = int(1 + np.count_nonzero(np.diff(sv)))
+        idx = np.linspace(0, len(sv) - 1, min(HIST_BUCKETS + 1, len(sv))).astype(np.int64)
+        stats.cols[c.name] = ColumnStats(
+            ndv=ndv, null_count=null_count,
+            min=float(sv[0]), max=float(sv[-1]),
+            bounds=sv[idx],
+        )
+    table.stats = stats
+    return stats
+
+
+def table_stats(table) -> Optional[TableStats]:
+    """Current stats if fresh (collected at this table version)."""
+    s = getattr(table, "stats", None)
+    if s is not None and s.version == table.version:
+        return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# estimation
+# ---------------------------------------------------------------------------
+
+
+def column_ndv(table, col_name: str) -> Optional[float]:
+    s = table_stats(table)
+    if s is None or col_name not in s.cols:
+        return None
+    return max(float(s.cols[col_name].ndv), 1.0)
+
+
+def _range_fraction(cs: ColumnStats, lo: float, hi: float) -> float:
+    """Fraction of non-null rows with lo <= value <= hi (equi-depth
+    interpolation)."""
+    b = cs.bounds
+    if b is None or len(b) < 2 or cs.min is None:
+        return 0.33
+    if hi < cs.min or lo > cs.max:
+        return 0.0
+    # position of a value in row-fraction space: bucket index + linear
+    # interpolation inside the bucket
+    nb = len(b) - 1
+
+    def frac(x: float, side: str) -> float:
+        i = int(np.searchsorted(b, x, side="left" if side == "lo" else "right"))
+        if i <= 0:
+            return 0.0
+        if i > nb:
+            return 1.0
+        lo_b, hi_b = b[i - 1], b[min(i, nb)]
+        inner = 0.0 if hi_b <= lo_b else (x - lo_b) / (hi_b - lo_b)
+        return ((i - 1) + min(max(inner, 0.0), 1.0)) / nb
+
+    f = frac(hi, "hi") - frac(lo, "lo")
+    return min(max(f, 0.0), 1.0)
+
+
+def _conjuncts(cond):
+    from tidb_tpu.expression.expr import Call
+
+    if isinstance(cond, Call) and cond.op == "and":
+        for a in cond.args:
+            yield from _conjuncts(a)
+    else:
+        yield cond
+
+
+_CMP = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+def _pred_selectivity(stats: TableStats, pred, uid_to_col: Dict[str, str]) -> float:
+    from tidb_tpu.expression.expr import Call, ColumnRef, InList, Literal
+
+    if isinstance(pred, Call) and pred.op in _CMP and len(pred.args) == 2:
+        a, b = pred.args
+        if isinstance(b, ColumnRef) and isinstance(a, Literal):
+            a, b = b, a
+            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+            op = flip.get(pred.op, pred.op)
+        else:
+            op = pred.op
+        if isinstance(a, ColumnRef) and isinstance(b, Literal) and b.value is not None:
+            col = uid_to_col.get(a.name)
+            cs = stats.cols.get(col) if col else None
+            if cs is None:
+                return {"eq": 0.1, "ne": 0.9}.get(op, 0.33)
+            nn = max(stats.n_rows - cs.null_count, 1)
+            v = float(b.value)
+            if op == "eq":
+                return min(1.0 / max(cs.ndv, 1), 1.0) * (nn / max(stats.n_rows, 1))
+            if op == "ne":
+                return (1.0 - 1.0 / max(cs.ndv, 1)) * (nn / max(stats.n_rows, 1))
+            if op in ("lt", "le"):
+                f = _range_fraction(cs, -np.inf, v)
+            else:
+                f = _range_fraction(cs, v, np.inf)
+            return f * (nn / max(stats.n_rows, 1))
+    if isinstance(pred, InList) and isinstance(pred.arg, ColumnRef):
+        col = uid_to_col.get(pred.arg.name)
+        cs = stats.cols.get(col) if col else None
+        if cs is not None:
+            f = min(len(pred.values) / max(cs.ndv, 1), 1.0)
+            return 1.0 - f if pred.negated else f
+    if isinstance(pred, Call) and pred.op == "or":
+        s = 0.0
+        for a in pred.args:
+            s = s + _pred_selectivity(stats, a, uid_to_col) * (1 - s)
+        return min(s, 1.0)
+    if isinstance(pred, Call) and pred.op == "is_null":
+        arg = pred.args[0]
+        if isinstance(arg, ColumnRef):
+            col = uid_to_col.get(arg.name)
+            cs = stats.cols.get(col) if col else None
+            if cs is not None:
+                return cs.null_count / max(stats.n_rows, 1)
+    return 0.33
+
+
+def scan_selectivity(table, cond, uid_to_col: Dict[str, str]) -> float:
+    """Estimated fraction of rows passing `cond` (compiled IR over scan
+    uids); falls back to fixed heuristics without fresh stats."""
+    stats = table_stats(table)
+    if stats is None or stats.n_rows == 0:
+        n = sum(1 for _ in _conjuncts(cond))
+        return 0.25 ** min(n, 2)
+    sel = 1.0
+    for pred in _conjuncts(cond):
+        sel *= _pred_selectivity(stats, pred, uid_to_col)
+    return min(max(sel, 1.0 / max(stats.n_rows, 1)), 1.0)
