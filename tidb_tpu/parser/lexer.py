@@ -31,7 +31,7 @@ KEYWORDS = {
     "constraint", "foreign", "references", "comment", "engine", "charset",
     "character", "collate", "auto_increment", "unsigned", "zerofill",
     "variables", "status", "grant", "revoke", "flush", "privileges",
-    "alter", "add", "modify", "change", "rename", "to", "extract",
+    "alter", "add", "modify", "change", "rename", "to", "extract", "column",
 }
 
 

@@ -583,6 +583,9 @@ class Parser:
         if self.accept_kw("rename"):
             self.accept_kw("to")
             return AlterTableStmt(table, "rename", new_name=self.expect_ident())
+        if self.accept_kw("modify"):
+            self.accept_kw("column")
+            return AlterTableStmt(table, "modify_column", column=self.parse_column_def())
         raise self.error("unsupported ALTER TABLE action")
 
     # -- misc statements -----------------------------------------------------

@@ -70,14 +70,10 @@ class Session:
     # -- transactions ------------------------------------------------------
 
     def _begin(self) -> None:
-        from tidb_tpu.storage.table import TXN_TS_BASE
-
         if self.txn is not None:
             self._commit()  # MySQL: BEGIN implicitly commits the open txn
-        self.txn = TxnState(
-            marker=TXN_TS_BASE + self.catalog.next_txn_id(),
-            read_ts=self.catalog.current_ts,
-        )
+        marker, read_ts = self.catalog.begin_txn()  # registers for GC safepoint
+        self.txn = TxnState(marker=marker, read_ts=read_ts)
 
     def _ensure_txn(self):
         """(txn, implicit): implicit txns commit at statement end."""
@@ -95,6 +91,9 @@ class Session:
         commit_ts = self.catalog.next_ts()
         for t, log in txn.logs.values():
             t.txn_commit(txn.marker, commit_ts, log)
+        self.catalog.end_txn(txn.marker)
+        if txn.logs and self.sysvars.get("tidb_gc_enable"):
+            self.catalog.auto_gc([t for t, _ in txn.logs.values()])
 
     def _rollback(self) -> None:
         txn, self.txn = self.txn, None
@@ -102,6 +101,9 @@ class Session:
             return
         for t, log in txn.logs.values():
             t.txn_rollback(txn.marker, log)
+        self.catalog.end_txn(txn.marker)
+        if txn.logs and self.sysvars.get("tidb_gc_enable"):
+            self.catalog.auto_gc([t for t, _ in txn.logs.values()])
 
     def _run_dml(self, fn):
         """Run a write inside the session txn; implicit txns commit (or
@@ -304,34 +306,66 @@ class Session:
             for tn in stmt.tables:
                 analyze_table(self.catalog.table(tn.schema or self.db, tn.name))
             return None
-        if isinstance(stmt, (A.CreateIndexStmt, A.DropIndexStmt)):
-            return None  # indexes: accepted, scans are columnar
+        if isinstance(stmt, A.CreateIndexStmt):
+            t = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
+            t.create_index(stmt.name, stmt.columns, unique=stmt.unique)
+            return None
+        if isinstance(stmt, A.DropIndexStmt):
+            t = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
+            t.drop_index(stmt.name)
+            return None
         if isinstance(stmt, A.AlterTableStmt):
-            raise UnsupportedError("ALTER TABLE execution not supported yet")
+            return self._run_alter_table(stmt)
         raise UnsupportedError(f"statement {type(stmt).__name__}")
 
     # ------------------------------------------------------------------
+
+    def _column_info(self, c: A.ColumnDef) -> ColumnInfo:
+        t = parse_type_name(c.type_name, c.type_args)
+        default = None
+        if c.default is not None:
+            from tidb_tpu.planner.binder import Binder
+
+            lit = Binder().bind_literal(c.default)
+            default = lit.value
+            if default is not None and lit.type_.kind == TypeKind.DECIMAL:
+                import decimal as _dec
+
+                # literals carry scaled-int decimals; defaults are stored
+                # in logical form (DEFAULT 1.5 is 1.5, not 15), exactly
+                default = _dec.Decimal(default).scaleb(-lit.type_.scale)
+        return ColumnInfo(
+            c.name, t,
+            not_null=c.not_null or c.primary_key,
+            default=default,
+            auto_increment=c.auto_increment,
+        )
+
+    def _run_alter_table(self, stmt: A.AlterTableStmt):
+        db = stmt.table.schema or self.db
+        t = self.catalog.table(db, stmt.table.name)
+        if stmt.action == "add_column":
+            t.add_column(self._column_info(stmt.column))
+        elif stmt.action == "drop_column":
+            t.drop_column(stmt.old_name)
+        elif stmt.action == "modify_column":
+            t.modify_column(self._column_info(stmt.column))
+        elif stmt.action == "rename":
+            self.catalog.rename_table(db, stmt.table.name, stmt.new_name)
+        elif stmt.action == "add_index":
+            name, columns = stmt.index
+            t.create_index(name or f"idx_{'_'.join(columns)}", columns)
+        else:
+            raise UnsupportedError(f"ALTER TABLE {stmt.action}")
+        return None
 
     def _run_create_table(self, stmt: A.CreateTableStmt):
         cols = []
         pk = list(stmt.primary_key) if stmt.primary_key else None
         for c in stmt.columns:
-            t = parse_type_name(c.type_name, c.type_args)
-            default = None
-            if c.default is not None:
-                from tidb_tpu.planner.binder import Binder
-
-                default = Binder().bind_literal(c.default).value
             if c.primary_key:
                 pk = [c.name]
-            cols.append(
-                ColumnInfo(
-                    c.name, t,
-                    not_null=c.not_null or c.primary_key,
-                    default=default,
-                    auto_increment=c.auto_increment,
-                )
-            )
+            cols.append(self._column_info(c))
         schema = TableSchema(stmt.table.name, cols, primary_key=pk)
         self.catalog.create_table(stmt.table.schema or self.db, schema, stmt.if_not_exists)
         return None
@@ -397,8 +431,19 @@ class Session:
             return v
         if k == TypeKind.DECIMAL:
             if bound.type_.kind == TypeKind.DECIMAL:
-                return v / (10 ** bound.type_.scale)
+                import decimal as _dec
+
+                # exact descale: float division corrupts 16+-digit decimals
+                return _dec.Decimal(v).scaleb(-bound.type_.scale)
             return v
+        if bound.type_.kind == TypeKind.DECIMAL:
+            # decimal literal into a non-decimal column: leave the
+            # scaled-int representation (1.5 is Literal(15, scale=1))
+            if k == TypeKind.STRING:
+                from tidb_tpu.types import scaled_to_decimal_str
+
+                return scaled_to_decimal_str(v, bound.type_.scale)
+            return v / (10 ** bound.type_.scale)
         if k == TypeKind.STRING:
             return str(v)
         return v

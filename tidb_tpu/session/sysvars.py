@@ -40,6 +40,7 @@ def _reg(*vs: SysVar) -> None:
 _reg(
     # the north-star switch: route eligible fragments to the device mesh
     SysVar("tidb_enable_tpu_exec", True, BOTH, "bool"),
+    SysVar("tidb_gc_enable", True, BOTH, "bool"),
     # fixed device batch capacity (ref: tidb_max_chunk_size)
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)

@@ -39,6 +39,8 @@ class Catalog:
         # increasing, shared by every table in this catalog)
         self._ts = 0
         self._txn_id = 0
+        # open transactions: marker -> read_ts (drives the GC safepoint)
+        self._open_txns: Dict[int, int] = {}
 
     def next_ts(self) -> int:
         self._ts += 1
@@ -51,6 +53,67 @@ class Catalog:
     def next_txn_id(self) -> int:
         self._txn_id += 1
         return self._txn_id
+
+    # -- transactions / GC safepoint ---------------------------------------
+    # (ref: PD's TSO + GC safepoint advance: the safepoint is the oldest
+    # snapshot any open txn can read; versions ended at/below it are dead)
+
+    def begin_txn(self) -> tuple:
+        """Allocate (marker, read_ts) and register the txn as open."""
+        from tidb_tpu.storage.table import TXN_TS_BASE
+
+        marker = TXN_TS_BASE + self.next_txn_id()
+        read_ts = self.current_ts
+        self._open_txns[marker] = read_ts
+        return marker, read_ts
+
+    def end_txn(self, marker: int) -> None:
+        self._open_txns.pop(marker, None)
+
+    def safepoint(self) -> int:
+        """Oldest snapshot any open txn can read. NOTE: today's GC
+        drivers refuse to run with open txns at all (their write logs
+        hold physical row positions — see Table.gc), so when GC actually
+        runs this equals current_ts; the min() is the contract for a
+        future log-remapping GC that can run under open snapshots."""
+        return min(self._open_txns.values(), default=self._ts)
+
+    def gc(self) -> Dict[str, int]:
+        """Reclaim dead MVCC versions in every table. Conservative: a
+        no-op while any txn is open (open write logs hold physical row
+        positions; see Table.gc contract). Returns table -> reclaimed."""
+        if self._open_txns:
+            return {}
+        sp = self.safepoint()
+        out: Dict[str, int] = {}
+        for db in self.databases.values():
+            for name, t in db.tables.items():
+                r = t.gc(sp)
+                if r:
+                    out[f"{db.name}.{name}"] = r
+        return out
+
+    def auto_gc(self, tables=None, min_dead: int = 4096,
+                ratio: float = 0.3) -> Dict[str, int]:
+        """Opportunistic GC after DML: compact tables whose dead-version
+        count crossed the threshold (the auto-GC worker analogue).
+        `tables` limits the scan to the tables a txn touched — the
+        threshold check costs an O(n) liveness pass per table, which
+        must not be paid for every table on every commit."""
+        if self._open_txns:
+            return {}
+        sp = self.safepoint()
+        if tables is None:
+            tables = [t for db in self.databases.values()
+                      for t in db.tables.values()]
+        out: Dict[str, int] = {}
+        for t in tables:
+            dead = t.n - t.live_rows
+            if dead >= min_dead and dead >= ratio * t.n:
+                r = t.gc(sp)
+                if r:
+                    out[t.schema.name] = r
+        return out
 
     # -- databases ---------------------------------------------------------
 

@@ -54,6 +54,18 @@ class ColumnInfo:
 
 
 @dataclass
+class IndexInfo:
+    """Secondary index metadata. Unique indexes are ENFORCED on every
+    write (ref: the reference's index KV records + unique-key checks);
+    the columnar engine scans by mask, so the index's query-side role is
+    the constraint, plus a lazily built sorted lookup for point DML."""
+
+    name: str
+    columns: List[str]
+    unique: bool = False
+
+
+@dataclass
 class TableSchema:
     name: str
     columns: List[ColumnInfo]
@@ -103,6 +115,7 @@ class Table:
         # MVCC visibility range per physical row (see TXN_TS_BASE above)
         self.begin_ts = np.zeros(cap, dtype=np.int64)
         self.end_ts = np.full(cap, MAX_TS, dtype=np.int64)
+        self.indexes: Dict[str, IndexInfo] = {}
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -218,6 +231,8 @@ class Table:
                     else:
                         arr[start + i] = v
                         vd[start + i] = True
+        self._enforce_unique_new(start, end)  # before n advances: a
+        # violation leaves the table untouched
         self.begin_ts[start:end] = self._next_ts() if begin_ts is None else begin_ts
         self.end_ts[start:end] = MAX_TS
         self.n = end
@@ -250,6 +265,7 @@ class Table:
                     self.valid[name][start:end] = True
             elif c.not_null:
                 raise ExecutionError(f"bulk insert missing NOT NULL column {name!r}")
+        self._enforce_unique_new(start, end)
         self.begin_ts[start:end] = 0  # bulk loads are committed "at origin"
         self.end_ts[start:end] = MAX_TS
         self.n = end
@@ -327,20 +343,15 @@ class Table:
 
         if begin_ts is None and end_ts is None:
             begin_ts = end_ts = self._next_ts()
-        self.end_ts[ids] = end_ts
 
+        # write the new versions into buffer slots FIRST (n not advanced,
+        # old versions not ended): a unique violation must leave the
+        # table untouched
         self._ensure(m)
         start, end = self.n, self.n + m
         for name in self.data:
             self.data[name][start:end] = self.data[name][ids]
             self.valid[name][start:end] = self.valid[name][ids]
-        self.begin_ts[start:end] = begin_ts
-        self.end_ts[start:end] = MAX_TS
-        self.n = end
-        if log is not None:
-            log.ended.append(ids)
-            log.ranges.append((start, end))
-
         # overwrite the updated columns in the new versions
         for name, vals in converted.items():
             c = self.schema.col(name)
@@ -353,6 +364,22 @@ class Table:
                     else:
                         self.data[name][i] = v
                         self.valid[name][i] = True
+        if any(ix.unique for ix in self.indexes.values()):
+            # the replaced versions don't count as present for uniqueness
+            saved = self.end_ts[ids].copy()
+            self.end_ts[ids] = 0
+            try:
+                self._enforce_unique_new(start, end)
+            finally:
+                self.end_ts[ids] = saved
+
+        self.end_ts[ids] = end_ts
+        self.begin_ts[start:end] = begin_ts
+        self.end_ts[start:end] = MAX_TS
+        self.n = end
+        if log is not None:
+            log.ended.append(ids)
+            log.ranges.append((start, end))
         self.version += 1
         return m
 
@@ -396,6 +423,214 @@ class Table:
             b[dead] = 0
             e[e == marker] = MAX_TS
         self.version += 1
+
+    # -- DDL ---------------------------------------------------------------
+    # (ref: ddl/ online schema change; single-process => synchronous, but
+    # the backfill-over-existing-rows step is the same job)
+
+    def add_column(self, col: ColumnInfo) -> None:
+        if any(c.name == col.name for c in self.schema.columns):
+            raise SchemaError(f"duplicate column {col.name!r}")
+        if col.not_null and col.default is None and self.live_rows > 0:
+            raise ExecutionError(
+                f"cannot add NOT NULL column {col.name!r} without DEFAULT "
+                "to a non-empty table")
+        self.schema.columns.append(col)
+        self.data[col.name] = np.zeros(self._cap, dtype=col.type_.np_dtype)
+        self.valid[col.name] = np.zeros(self._cap, dtype=np.bool_)
+        if col.type_.kind == TypeKind.STRING:
+            self.dicts[col.name] = Dictionary([])
+        if col.default is not None:
+            # backfill existing rows with the default
+            dv = self.to_device_value(col, col.default)
+            if col.type_.kind == TypeKind.STRING:
+                self._append_strings(col.name, [dv] * self.n, 0, self.n)
+            else:
+                self.data[col.name][: self.n] = dv
+                self.valid[col.name][: self.n] = True
+        self.version += 1
+
+    def drop_column(self, name: str) -> None:
+        col = self.schema.col(name)  # raises if absent
+        if self.schema.primary_key and name in self.schema.primary_key:
+            raise ExecutionError(f"cannot drop primary-key column {name!r}")
+        for idx in self.indexes.values():
+            if name in idx.columns:
+                raise ExecutionError(
+                    f"cannot drop column {name!r}: used by index {idx.name!r}")
+        self.schema.columns.remove(col)
+        del self.data[name]
+        del self.valid[name]
+        self.dicts.pop(name, None)
+        self.version += 1
+
+    def modify_column(self, col: ColumnInfo) -> None:
+        """Change a column's type, converting existing values. Numeric
+        widenings and integer-domain decimal scale shifts only; anything
+        lossy (non-integral, indivisible scale-down, out-of-domain BOOL)
+        raises rather than corrupting. Validity checks look only at
+        valid slots (stale bytes under NULLs / dead versions are never
+        read, but must not convert the statement into an error)."""
+        old = self.schema.col(col.name)
+        ok_kinds = {TypeKind.INT, TypeKind.FLOAT, TypeKind.DECIMAL, TypeKind.BOOL}
+        ok, nk = old.type_.kind, col.type_.kind
+        n = self.n
+        valid = self.valid[col.name][:n]
+        # zero stale bytes under NULL/dead slots: they are never read,
+        # but they must not overflow or NaN-poison the bulk conversion
+        data = np.where(valid, self.data[col.name][:n],
+                        np.zeros((), dtype=self.data[col.name].dtype))
+
+        def lossy(msg):
+            raise ExecutionError(f"MODIFY {col.name}: {msg}")
+
+        if ok == nk and not (ok == TypeKind.DECIMAL
+                             and old.type_.scale != col.type_.scale):
+            conv = data
+        elif ok not in ok_kinds or nk not in ok_kinds:
+            lossy(f"cannot convert {ok.name} to {nk.name}")
+        elif nk == TypeKind.BOOL:
+            if ((data[valid] != 0) & (data[valid] != 1)).any():
+                lossy("values outside 0/1 cannot become BOOL")
+            conv = data.astype(np.bool_)
+        elif {ok, nk} <= {TypeKind.INT, TypeKind.DECIMAL, TypeKind.BOOL}:
+            # pure integer-domain scale shift: no float round trip, so
+            # 18-digit decimals survive exactly
+            shift = ((col.type_.scale if nk == TypeKind.DECIMAL else 0)
+                     - (old.type_.scale if ok == TypeKind.DECIMAL else 0))
+            src = data.astype(np.int64)
+            if shift >= 0:
+                conv = src * (10 ** shift)
+            else:
+                div = 10 ** (-shift)
+                if (src[valid] % div != 0).any():
+                    lossy(f"scale reduction loses digits (divide by {div})")
+                conv = src // div
+        elif nk == TypeKind.FLOAT:
+            conv = data.astype(np.float64)
+            if ok == TypeKind.DECIMAL:
+                conv = conv / (10 ** old.type_.scale)
+        elif ok == TypeKind.FLOAT and nk == TypeKind.DECIMAL:
+            conv = np.round(data * 10 ** col.type_.scale)
+            back = conv[valid] / (10 ** col.type_.scale)
+            if not np.allclose(back, data[valid], rtol=0, atol=0.5 * 10 ** -col.type_.scale):
+                lossy(f"values do not fit DECIMAL scale {col.type_.scale}")
+            conv = conv.astype(np.int64)
+        else:  # FLOAT -> INT
+            if not np.allclose(data[valid], np.round(data[valid])):
+                lossy("non-integral values")
+            conv = np.round(data).astype(np.int64)
+
+        if col.not_null and n and (
+                ~valid[self.live_mask(0, n)]).any():
+            lossy("NULLs present, NOT NULL requested")
+        buf = np.zeros(self._cap, dtype=col.type_.np_dtype)
+        buf[:n] = conv
+        self.data[col.name] = buf
+        old.type_ = col.type_
+        old.not_null = col.not_null
+        if col.default is not None:
+            old.default = col.default
+        self.version += 1
+
+    # -- indexes -----------------------------------------------------------
+
+    def create_index(self, name: str, columns: List[str], unique: bool = False) -> None:
+        for c in columns:
+            self.schema.col(c)  # raises if absent
+        if name in self.indexes:
+            raise SchemaError(f"duplicate index {name!r}")
+        idx = IndexInfo(name=name, columns=list(columns), unique=unique)
+        if unique:
+            self._check_unique(idx)  # validate existing data before adding
+        self.indexes[name] = idx
+        self.version += 1
+
+    def drop_index(self, name: str) -> None:
+        if name not in self.indexes:
+            raise SchemaError(f"no index {name!r}")
+        del self.indexes[name]
+        self.version += 1
+
+    def _present_mask(self) -> np.ndarray:
+        """Rows that exist for constraint purposes: every version not yet
+        ended by a commit (includes provisional writes and rows under a
+        txn's delete marker — conservative, like InnoDB's locked checks)."""
+        return self.end_ts[: self.n] >= TXN_TS_BASE
+
+    def _check_unique(self, idx: IndexInfo, extra: Optional[tuple] = None) -> None:
+        """Raise if the index's key columns contain duplicates among
+        present rows (rows with any NULL key are exempt, MySQL-style).
+        `extra`=(start, end) adds not-yet-committed buffer slots."""
+        mask = self._present_mask()
+        sel = np.nonzero(mask)[0]
+        if extra is not None:
+            sel = np.concatenate([sel, np.arange(extra[0], extra[1])])
+        if len(sel) < 2:
+            return
+        cols, ok = [], np.ones(len(sel), dtype=np.bool_)
+        for cname in idx.columns:
+            d = self.data[cname][sel]
+            v = self.valid[cname][sel]
+            ok &= v
+            if np.issubdtype(d.dtype, np.floating):
+                d = d.astype(np.float64).view(np.int64)
+            cols.append(d.astype(np.int64))
+        mat = np.stack(cols, axis=1)[ok]
+        if len(mat) < 2:
+            return
+        _, counts = np.unique(mat, axis=0, return_counts=True)
+        if (counts > 1).any():
+            raise ExecutionError(
+                f"duplicate entry for unique index {idx.name!r} "
+                f"on {self.schema.name!r}")
+
+    def _enforce_unique_new(self, start: int, end: int) -> None:
+        """Validate unique indexes counting buffer slots [start, end) as
+        present; called BEFORE self.n advances so a violation leaves the
+        table untouched."""
+        for idx in self.indexes.values():
+            if idx.unique:
+                self._check_unique(idx, extra=(start, end))
+
+    def gc(self, safepoint: int) -> int:
+        """Reclaim row versions invisible to every current and future
+        reader (ref: the TiKV GC worker below the safepoint): versions
+        whose end_ts committed at or before the safepoint, including
+        rollback-dead rows (begin=end=0). Rows ended by an open txn's
+        marker (>= TXN_TS_BASE) are never garbage. Compacts the column
+        buffers in place and shrinks them when mostly empty.
+
+        Caller contract: no open transaction may hold physical row ids
+        into this table (txn write logs use positions) — the catalog's
+        GC driver only runs with zero open transactions."""
+        n = self.n
+        if n == 0:
+            return 0
+        e = self.end_ts[:n]
+        garbage = (e <= safepoint) & (e < TXN_TS_BASE)
+        k = int(garbage.sum())
+        if k == 0:
+            return 0
+        keep = ~garbage
+        m = n - k
+        for name in self.data:
+            self.data[name][:m] = self.data[name][:n][keep]
+            self.valid[name][:m] = self.valid[name][:n][keep]
+        self.begin_ts[:m] = self.begin_ts[:n][keep]
+        self.end_ts[:m] = self.end_ts[:n][keep]
+        self.n = m
+        # release buffer memory when the table shrank far below capacity
+        want = max(_MIN_CAP, int(m * _GROW))
+        if self._cap > 4 * want:
+            for name in self.data:
+                self.data[name] = np.resize(self.data[name], want)
+                self.valid[name] = np.resize(self.valid[name], want)
+            self.begin_ts = np.resize(self.begin_ts, want)
+            self.end_ts = np.resize(self.end_ts, want)
+            self._cap = want
+        self.version += 1
+        return k
 
     def truncate(self):
         self.n = 0
