@@ -121,6 +121,67 @@ class TestReviewRegressions:
             s.execute("ALTER TABLE t MODIFY b boolean")
 
 
+class TestReviewRegressions2:
+    def test_gc_tail_reads_null(self):
+        s = Session()
+        s.execute("CREATE TABLE t (a bigint, b bigint)")
+        s.execute("INSERT INTO t VALUES " +
+                  ", ".join(f"({i}, 777)" for i in range(5000)))
+        s.execute("DELETE FROM t WHERE a >= 1")  # auto_gc compacts
+        s.execute("INSERT INTO t (a) VALUES (100)")
+        assert s.query("select b from t where a = 100") == [(None,)]
+
+    def test_rejected_insert_leaves_no_residue(self):
+        s = Session()
+        s.execute("CREATE TABLE t (a bigint, b bigint)")
+        s.execute("CREATE UNIQUE INDEX u ON t (a)")
+        s.execute("INSERT INTO t VALUES (1, 5)")
+        with pytest.raises(ExecutionError):
+            s.execute("INSERT INTO t VALUES (1, 999)")
+        s.execute("INSERT INTO t (a) VALUES (2)")
+        assert s.query("select b from t where a = 2") == [(None,)]
+
+    def test_modify_scale_up_overflow_refused(self):
+        s = Session()
+        s.execute("CREATE TABLE t (x decimal(18,0))")
+        s.execute("INSERT INTO t VALUES ('900719925474099300')")
+        with pytest.raises(ExecutionError):
+            s.execute("ALTER TABLE t MODIFY x decimal(18,4)")
+        assert s.query("select x from t") == [("900719925474099300",)]
+
+    def test_modify_bigint_to_double_precision_refused(self):
+        s = Session()
+        s.execute("CREATE TABLE t (x bigint)")
+        s.execute("INSERT INTO t VALUES (9007199254740993)")
+        with pytest.raises(ExecutionError):
+            s.execute("ALTER TABLE t MODIFY x double")
+
+    def test_modify_merging_unique_keys_refused(self):
+        s = Session()
+        s.execute("CREATE TABLE t (x double)")
+        s.execute("CREATE UNIQUE INDEX u ON t (x)")
+        s.execute("INSERT INTO t VALUES (1.232), (1.228)")
+        with pytest.raises(ExecutionError):
+            s.execute("ALTER TABLE t MODIFY x decimal(10,2)")  # both -> 1.23
+        # table untouched and still writable
+        s.execute("INSERT INTO t VALUES (9.99)")
+        assert s.query("select count(*) from t") == [(3,)]
+
+    def test_many_single_row_inserts_with_unique_index(self):
+        import time
+
+        s = Session()
+        s.execute("CREATE TABLE t (a bigint)")
+        s.execute("CREATE UNIQUE INDEX u ON t (a)")
+        t0 = time.perf_counter()
+        for i in range(300):
+            s.execute(f"INSERT INTO t VALUES ({i})")
+        assert time.perf_counter() - t0 < 5.0
+        assert s.query("select count(*) from t") == [(300,)]
+        with pytest.raises(ExecutionError):
+            s.execute("INSERT INTO t VALUES (250)")
+
+
 class TestIndexes:
     def test_unique_index_enforced_on_insert(self, s):
         s.execute("CREATE UNIQUE INDEX uk ON t (v)")

@@ -105,6 +105,8 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
         return st
 
     def update(state, chunk: Chunk):
+        from tidb_tpu.ops import segment_count
+
         packed = jnp.zeros(chunk.capacity, dtype=jnp.int64)
         stride = 1
         for g, dom in zip(group_exprs, domains):
@@ -113,9 +115,11 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
             packed = packed + idx * stride
             stride *= dom
         sel = chunk.sel
-        seli = sel.astype(jnp.int64)
         out = dict(state)
-        out["occ"] = state["occ"].at[packed].add(seli)
+        # count-shaped accumulators route through the Pallas one-hot
+        # kernel on TPU (ops/segment_sum.py; the XLA int64 scatter is
+        # 10x+ slower there) — elementwise add merges it into the state
+        out["occ"] = state["occ"] + segment_count(sel, packed, G)
         for a in aggs:
             if a.arg is not None:
                 d, v = eval_expr(a.arg, chunk)
@@ -124,22 +128,20 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
                 acc = state[f"{a.uid}.sum"]
                 contrib = jnp.where(ok, d, 0).astype(acc.dtype)
                 out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
-                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(ok, packed, G)
             elif a.func == "count":
-                if a.arg is None:
-                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(seli)
-                else:
-                    out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+                cm = sel if a.arg is None else ok
+                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(cm, packed, G)
             elif a.func == "min":
                 acc = state[f"{a.uid}.min"]
                 contrib = jnp.where(ok, d, _min_identity(np.dtype(acc.dtype))).astype(acc.dtype)
                 out[f"{a.uid}.min"] = acc.at[packed].min(contrib)
-                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(ok, packed, G)
             elif a.func == "max":
                 acc = state[f"{a.uid}.max"]
                 contrib = jnp.where(ok, d, _max_identity(np.dtype(acc.dtype))).astype(acc.dtype)
                 out[f"{a.uid}.max"] = acc.at[packed].max(contrib)
-                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"].at[packed].add(ok.astype(jnp.int64))
+                out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(ok, packed, G)
         return out
 
     return init_state, update, G

@@ -1,0 +1,20 @@
+"""Device kernels (Pallas) for the hot relational primitives.
+
+The compute path is jax/XLA; this package holds the hand-written TPU
+kernels for the few primitives XLA lowers poorly — today the segment
+aggregation scatter-add (ref: SURVEY.md §7.4's "Pallas hash-table /
+segment kernel as the optimized path"). Every kernel has an XLA
+reference implementation; `pallas_enabled()` gates dispatch (TPU
+backend only, overridable for benchmarks), and ops/SEGSUM_BENCH.json
+records the microbenchmark that justifies the default.
+"""
+
+from tidb_tpu.ops.segment_sum import (
+    pallas_enabled,
+    segment_count,
+    segment_sum_f32,
+    set_pallas_enabled,
+)
+
+__all__ = ["segment_count", "segment_sum_f32", "pallas_enabled",
+           "set_pallas_enabled"]

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Microbenchmark: Pallas one-hot segment kernels vs XLA scatter-add.
+
+Run on the target accelerator; writes ops/SEGSUM_BENCH.json next to
+this file. The dispatch defaults in segment_sum.py are justified by
+this artifact (re-run on new hardware/jax versions).
+
+    python -m tidb_tpu.ops.bench_segsum
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import tidb_tpu  # noqa: F401 (x64 config)
+    import jax
+    import jax.numpy as jnp
+
+    from tidb_tpu.ops.segment_sum import (
+        segment_count,
+        segment_sum_f32,
+        xla_segment_sum,
+    )
+
+    rng = np.random.default_rng(0)
+    R = 1 << 20
+    vals = jnp.asarray(rng.standard_normal(R).astype(np.float32))
+    mask = jnp.asarray(rng.random(R) < 0.7)
+
+    def bench(fn, *args, reps=20):
+        jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    results = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "rows": R,
+        "configs": [],
+    }
+    for g in (16, 256, 2048):
+        seg = jnp.asarray(rng.integers(0, g, R).astype(np.int32))
+        want = np.zeros(g, np.float64)
+        np.add.at(want, np.asarray(seg), np.asarray(vals, np.float64))
+        got = np.asarray(segment_sum_f32(vals, seg, g))
+        err = float(np.abs(got - want).max() / max(np.abs(want).max(), 1.0))
+        wc = np.zeros(g, np.int64)
+        np.add.at(wc, np.asarray(seg)[np.asarray(mask)], 1)
+        exact = bool((np.asarray(segment_count(mask, seg, g)) == wc).all())
+        t_ps = bench(lambda v, s, g=g: segment_sum_f32(v, s, g), vals, seg)
+        t_xs = bench(jax.jit(lambda v, s, g=g: xla_segment_sum(v, s, g)), vals, seg)
+        t_pc = bench(lambda m, s, g=g: segment_count(m, s, g), mask, seg)
+        t_xc = bench(jax.jit(
+            lambda m, s, g=g: xla_segment_sum(m.astype(jnp.int64), s, g)), mask, seg)
+        results["configs"].append({
+            "G": g, "sum_rel_err": err, "count_exact": exact,
+            "sum_pallas_ms": round(t_ps * 1e3, 3),
+            "sum_xla_ms": round(t_xs * 1e3, 3),
+            "sum_speedup": round(t_xs / t_ps, 2),
+            "count_pallas_ms": round(t_pc * 1e3, 3),
+            "count_xla_i64_ms": round(t_xc * 1e3, 3),
+            "count_speedup": round(t_xc / t_pc, 2),
+        })
+        print(results["configs"][-1])
+
+    path = os.path.join(os.path.dirname(__file__), "SEGSUM_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Segment aggregation as tiled one-hot MXU matmuls (Pallas).
+
+XLA lowers `acc.at[seg].add(vals)` to a serialized scatter on TPU; the
+MXU-native formulation is a one-hot matmul per tile:
+
+    onehot[t, g] = (seg[t] == g)          # [TILE, G] built from iota
+    partial[g]   = vals[1, TILE] @ onehot # one MXU pass
+    out[g]      += partial                # accumulated across the grid
+
+Exactness: f32 matmul accumulation is integer-exact below 2^24, so
+  * segment_count is EXACT for any chunk up to 2^24 rows (per-tile
+    partial <= TILE, total <= R) — counts dispatch to Pallas on TPU;
+  * segment_sum_f32 matches XLA f32 summation to reordering — used for
+    FLOAT aggregates where SQL float semantics already permit it;
+  * int64/decimal sums stay on the XLA path (exactness first).
+
+Group count G is padded to the 128-lane boundary; segment ids >= G are
+the caller's NULL/overflow slots and pad lanes simply accumulate zeros
+that are sliced off.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["segment_count", "segment_sum_f32", "pallas_enabled",
+           "set_pallas_enabled", "xla_segment_sum"]
+
+_TILE = 1024
+_MAX_PALLAS_G = 8192  # above this the [TILE, G] one-hot exceeds VMEM budget
+
+_enabled: bool | None = None  # None = auto (TPU backend only)
+
+
+def set_pallas_enabled(v: bool | None) -> None:
+    global _enabled
+    _enabled = v
+
+
+def pallas_enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+def xla_segment_sum(vals: jax.Array, seg: jax.Array, G: int) -> jax.Array:
+    """Reference path: XLA scatter-add."""
+    return jnp.zeros(G, dtype=vals.dtype).at[seg].add(vals)
+
+
+_SUB = 8  # sublanes per tile row; tile is [_SUB, 128] = _TILE elements
+_LANES = 128
+
+
+def _pad_tile(x: jax.Array, fill) -> jax.Array:
+    """[R] -> [n_tiles, 8, 128] (Mosaic's (8, 128) f32 tiling)."""
+    R = x.shape[0]
+    Rp = ((R + _TILE - 1) // _TILE) * _TILE
+    if Rp != R:
+        x = jnp.concatenate([x, jnp.full(Rp - R, fill, dtype=x.dtype)])
+    return x.reshape(Rp // _TILE, _SUB, _LANES)
+
+
+@functools.partial(jax.jit, static_argnames=("G", "Gp"))
+def _pallas_segsum_f32(vals: jax.Array, seg: jax.Array, G: int, Gp: int) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from jax._src.config import enable_x64
+
+    vals2 = _pad_tile(vals.astype(jnp.float32), 0.0)
+    seg2 = _pad_tile(seg.astype(jnp.int32), Gp)  # pad rows land off-range
+    n_tiles = vals2.shape[0]
+
+    def kernel(vals_ref, seg_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        s = seg_ref[0]  # [8, 128] int32
+        v = vals_ref[0]  # [8, 128] f32
+        # one-hot over a new trailing group axis, contracted on the VPU;
+        # [8, 128, Gp] stays well inside VMEM for the segment-agg G range
+        gid = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANES, Gp), 2)
+        onehot = (s[:, :, None] == gid).astype(jnp.float32)
+        part = jnp.sum(v[:, :, None] * onehot, axis=(0, 1))  # [Gp]
+        out_ref[:] = out_ref[:] + part[None, :]
+
+    # trace the kernel with x64 OFF: the engine enables x64 globally
+    # (decimals are scaled int64), but Mosaic can't legalize the i64
+    # constants that leak into index maps / grid bookkeeping
+    with enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((1, Gp), jnp.float32),
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((1, _SUB, _LANES), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, _SUB, _LANES), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, Gp), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            # off-TPU (tests force-enable) the interpreter runs the same
+            # kernel logic, so CPU CI covers the Pallas path too
+            interpret=jax.default_backend() != "tpu",
+        )(vals2, seg2)
+    return out[0, :G]
+
+
+def _gp(G: int) -> int:
+    return max(((G + 127) // 128) * 128, 128)
+
+
+def segment_sum_f32(vals: jax.Array, seg: jax.Array, G: int) -> jax.Array:
+    """Float32 segment sum; Pallas on TPU, XLA elsewhere."""
+    if not pallas_enabled() or G > _MAX_PALLAS_G:
+        return xla_segment_sum(vals.astype(jnp.float32), seg, G)
+    return _pallas_segsum_f32(vals, seg, G, _gp(G))
+
+
+def segment_count(mask: jax.Array, seg: jax.Array, G: int) -> jax.Array:
+    """Count mask-true rows per segment, EXACT (counts < 2^24), int64.
+
+    The hottest accumulator shape in segment aggregation: occ + one cnt
+    per aggregate function all reduce a boolean through this. 10-13x
+    faster than the XLA int64 scatter on TPU v5e (ops/SEGSUM_BENCH.json)."""
+    if (not pallas_enabled() or G > _MAX_PALLAS_G
+            or mask.shape[0] >= (1 << 24)):  # f32 exactness bound
+        return xla_segment_sum(mask.astype(jnp.int64), seg, G)
+    f = _pallas_segsum_f32(mask.astype(jnp.float32), seg, G, _gp(G))
+    return f.astype(jnp.int64)

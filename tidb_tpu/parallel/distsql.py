@@ -94,7 +94,7 @@ def make_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(_SPEC, _SPEC, _SPEC), out_specs=P(),
+        in_specs=(_SPEC, _SPEC, _SPEC), out_specs=P(), check_vma=False,
     ))
 
 
@@ -246,7 +246,7 @@ def make_join_agg_fragment(
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(_SPEC,) * 6, out_specs=(P(), P()),
+        in_specs=(_SPEC,) * 6, out_specs=(P(), P()), check_vma=False,
     ))
 
 

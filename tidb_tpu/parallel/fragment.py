@@ -498,6 +498,9 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int) -> Optional[FragmentProg
         in_specs = tuple([_SPEC, _SPEC, _SPEC] * n_src + [P(), P(), P()] * n_bc)
         return jax.jit(jax.shard_map(
             frag, mesh=mesh, in_specs=in_specs, out_specs=(out_spec, P()),
+            # pallas_call outputs carry no vma metadata; the fragment's
+            # out_specs are the authority here
+            check_vma=False,
         ))
 
     return FragmentProgram(

@@ -116,6 +116,10 @@ class Table:
         self.begin_ts = np.zeros(cap, dtype=np.int64)
         self.end_ts = np.full(cap, MAX_TS, dtype=np.int64)
         self.indexes: Dict[str, IndexInfo] = {}
+        # per-unique-index sorted key cache: name -> (version, keys);
+        # fresh only across pure inserts, rebuilt lazily otherwise
+        self._uniq_cache: Dict[str, tuple] = {}
+        self._uniq_pending: Dict[str, np.ndarray] = {}
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -239,6 +243,7 @@ class Table:
         if log is not None:
             log.ranges.append((start, end))
         self.version += 1
+        self._uniq_commit()
         return m
 
     def insert_columns(self, arrays: Dict[str, np.ndarray], valids: Optional[Dict[str, np.ndarray]] = None, strings: Optional[Dict[str, list]] = None):
@@ -270,6 +275,7 @@ class Table:
         self.end_ts[start:end] = MAX_TS
         self.n = end
         self.version += 1
+        self._uniq_commit()
         return m
 
     def _append_strings(self, name: str, vals: list, start: int, end: int):
@@ -365,11 +371,20 @@ class Table:
                         self.data[name][i] = v
                         self.valid[name][i] = True
         if any(ix.unique for ix in self.indexes.values()):
-            # the replaced versions don't count as present for uniqueness
+            # the replaced versions don't count as present for uniqueness;
+            # full-scan check (the incremental cache can't express the
+            # simultaneous remove+add of an update). Rejected slots clear
+            # their valid bits so stale values never resurrect.
             saved = self.end_ts[ids].copy()
             self.end_ts[ids] = 0
             try:
-                self._enforce_unique_new(start, end)
+                for ix in self.indexes.values():
+                    if ix.unique:
+                        self._check_unique(ix, extra=(start, end))
+            except ExecutionError:
+                for name in self.valid:
+                    self.valid[name][start:end] = False
+                raise
             finally:
                 self.end_ts[ids] = saved
 
@@ -467,15 +482,19 @@ class Table:
     def modify_column(self, col: ColumnInfo) -> None:
         """Change a column's type, converting existing values. Numeric
         widenings and integer-domain decimal scale shifts only; anything
-        lossy (non-integral, indivisible scale-down, out-of-domain BOOL)
-        raises rather than corrupting. Validity checks look only at
-        valid slots (stale bytes under NULLs / dead versions are never
-        read, but must not convert the statement into an error)."""
+        lossy (non-integral, indivisible scale-down, out-of-domain BOOL,
+        int64 overflow, precision loss above 2^53 into FLOAT) raises
+        rather than corrupting. Lossy-value checks look only at valid
+        slots of PRESENT versions — stale bytes under NULLs and dead
+        (ended) versions are never read by current/future readers and
+        must not turn the statement into an error."""
         old = self.schema.col(col.name)
         ok_kinds = {TypeKind.INT, TypeKind.FLOAT, TypeKind.DECIMAL, TypeKind.BOOL}
         ok, nk = old.type_.kind, col.type_.kind
         n = self.n
         valid = self.valid[col.name][:n]
+        # lossiness is judged on present (not-ended) valid values only
+        chk = valid & self._present_mask()
         # zero stale bytes under NULL/dead slots: they are never read,
         # but they must not overflow or NaN-poison the bulk conversion
         data = np.where(valid, self.data[col.name][:n],
@@ -490,7 +509,7 @@ class Table:
         elif ok not in ok_kinds or nk not in ok_kinds:
             lossy(f"cannot convert {ok.name} to {nk.name}")
         elif nk == TypeKind.BOOL:
-            if ((data[valid] != 0) & (data[valid] != 1)).any():
+            if ((data[chk] != 0) & (data[chk] != 1)).any():
                 lossy("values outside 0/1 cannot become BOOL")
             conv = data.astype(np.bool_)
         elif {ok, nk} <= {TypeKind.INT, TypeKind.DECIMAL, TypeKind.BOOL}:
@@ -498,26 +517,33 @@ class Table:
             # 18-digit decimals survive exactly
             shift = ((col.type_.scale if nk == TypeKind.DECIMAL else 0)
                      - (old.type_.scale if ok == TypeKind.DECIMAL else 0))
-            src = data.astype(np.int64)
+            src = np.where(chk, data.astype(np.int64), 0)
             if shift >= 0:
-                conv = src * (10 ** shift)
+                mul = 10 ** shift
+                if len(src) and np.abs(src).max() > (2 ** 63 - 1) // mul:
+                    lossy(f"scale-up by {mul} overflows int64")
+                conv = src * mul
             else:
                 div = 10 ** (-shift)
-                if (src[valid] % div != 0).any():
+                if (src[chk] % div != 0).any():
                     lossy(f"scale reduction loses digits (divide by {div})")
                 conv = src // div
         elif nk == TypeKind.FLOAT:
-            conv = data.astype(np.float64)
+            src = np.where(chk, data, np.zeros((), dtype=data.dtype))
+            if np.issubdtype(src.dtype, np.integer) and len(src) and (
+                    np.abs(src).max() > (1 << 53)):
+                lossy("magnitudes above 2^53 lose precision in FLOAT")
+            conv = src.astype(np.float64)
             if ok == TypeKind.DECIMAL:
                 conv = conv / (10 ** old.type_.scale)
         elif ok == TypeKind.FLOAT and nk == TypeKind.DECIMAL:
             conv = np.round(data * 10 ** col.type_.scale)
-            back = conv[valid] / (10 ** col.type_.scale)
-            if not np.allclose(back, data[valid], rtol=0, atol=0.5 * 10 ** -col.type_.scale):
+            back = conv[chk] / (10 ** col.type_.scale)
+            if not np.allclose(back, data[chk], rtol=0, atol=0.5 * 10 ** -col.type_.scale):
                 lossy(f"values do not fit DECIMAL scale {col.type_.scale}")
             conv = conv.astype(np.int64)
         else:  # FLOAT -> INT
-            if not np.allclose(data[valid], np.round(data[valid])):
+            if not np.allclose(data[chk], np.round(data[chk])):
                 lossy("non-integral values")
             conv = np.round(data).astype(np.int64)
 
@@ -526,7 +552,18 @@ class Table:
             lossy("NULLs present, NOT NULL requested")
         buf = np.zeros(self._cap, dtype=col.type_.np_dtype)
         buf[:n] = conv
+        saved = self.data[col.name]
         self.data[col.name] = buf
+        # a narrowing conversion (e.g. float -> decimal rounding) can
+        # merge previously distinct unique keys: re-validate, and restore
+        # the old column on violation so the table stays consistent
+        try:
+            for idx in self.indexes.values():
+                if idx.unique and col.name in idx.columns:
+                    self._check_unique(idx)
+        except ExecutionError:
+            self.data[col.name] = saved
+            raise
         old.type_ = col.type_
         old.not_null = col.not_null
         if col.default is not None:
@@ -558,6 +595,64 @@ class Table:
         txn's delete marker — conservative, like InnoDB's locked checks)."""
         return self.end_ts[: self.n] >= TXN_TS_BASE
 
+    def _uniq_keys_at(self, idx: IndexInfo, sel: np.ndarray) -> np.ndarray:
+        """Index-key rows at positions `sel` as a sortable structured
+        array (lexicographic field order = column order); rows with any
+        NULL key column are dropped (MySQL: NULLs never conflict)."""
+        ok = np.ones(len(sel), dtype=np.bool_)
+        cols = []
+        for cname in idx.columns:
+            d = self.data[cname][sel]
+            v = self.valid[cname][sel]
+            ok &= v
+            if np.issubdtype(d.dtype, np.floating):
+                d = d.astype(np.float64).view(np.int64)
+            cols.append(d.astype(np.int64))
+        mat = np.stack(cols, axis=1)[ok] if cols else np.zeros((0, 0), np.int64)
+        dt = np.dtype([(f"k{i}", np.int64) for i in range(len(idx.columns))])
+        return np.ascontiguousarray(mat).view(dt).reshape(-1)
+
+    def _uniq_sorted(self, idx: IndexInfo) -> np.ndarray:
+        """Sorted key set of present rows, cached per table version.
+        Kept incrementally fresh across pure-insert workloads (the
+        bulk-load path), so per-insert cost is O(m log n + n) memcpy
+        instead of a full O(n log n) re-sort."""
+        hit = self._uniq_cache.get(idx.name)
+        if hit is not None and hit[0] == self.version:
+            return hit[1]
+        sel = np.nonzero(self._present_mask())[0]
+        keys = np.sort(self._uniq_keys_at(idx, sel))
+        self._uniq_cache[idx.name] = (self.version, keys)
+        return keys
+
+    def _check_unique_batch(self, idx: IndexInfo, start: int, end: int) -> None:
+        """Insert-path uniqueness: buffer rows [start, end) vs the sorted
+        key cache. Stages the merged key set in _uniq_pending; the caller
+        commits it after the version bump."""
+        cache = self._uniq_sorted(idx)
+        batch = np.sort(self._uniq_keys_at(idx, np.arange(start, end)))
+        if len(batch) == 0:
+            return
+        if len(batch) > 1 and (batch[1:] == batch[:-1]).any():
+            raise ExecutionError(
+                f"duplicate entry for unique index {idx.name!r} "
+                f"on {self.schema.name!r}")
+        pos = np.searchsorted(cache, batch)
+        if len(cache):
+            hit = (pos < len(cache)) & (
+                cache[np.minimum(pos, len(cache) - 1)] == batch)
+            if hit.any():
+                raise ExecutionError(
+                    f"duplicate entry for unique index {idx.name!r} "
+                    f"on {self.schema.name!r}")
+        self._uniq_pending[idx.name] = np.insert(cache, pos, batch)
+
+    def _uniq_commit(self) -> None:
+        """Adopt staged key sets at the (just bumped) current version."""
+        for name, keys in self._uniq_pending.items():
+            self._uniq_cache[name] = (self.version, keys)
+        self._uniq_pending.clear()
+
     def _check_unique(self, idx: IndexInfo, extra: Optional[tuple] = None) -> None:
         """Raise if the index's key columns contain duplicates among
         present rows (rows with any NULL key are exempt, MySQL-style).
@@ -588,10 +683,18 @@ class Table:
     def _enforce_unique_new(self, start: int, end: int) -> None:
         """Validate unique indexes counting buffer slots [start, end) as
         present; called BEFORE self.n advances so a violation leaves the
-        table untouched."""
-        for idx in self.indexes.values():
-            if idx.unique:
-                self._check_unique(idx, extra=(start, end))
+        table untouched. On rejection the written slots' valid bits are
+        cleared — later inserts that omit a column must read them as
+        NULL, not as the rejected row's values."""
+        try:
+            for idx in self.indexes.values():
+                if idx.unique:
+                    self._check_unique_batch(idx, start, end)
+        except ExecutionError:
+            self._uniq_pending.clear()
+            for name in self.valid:
+                self.valid[name][start:end] = False
+            raise
 
     def gc(self, safepoint: int) -> int:
         """Reclaim row versions invisible to every current and future
@@ -617,6 +720,9 @@ class Table:
         for name in self.data:
             self.data[name][:m] = self.data[name][:n][keep]
             self.valid[name][:m] = self.valid[name][:n][keep]
+            # vacated tail must read as NULL: insert paths that omit a
+            # column rely on slots >= n having valid=False
+            self.valid[name][m:n] = False
         self.begin_ts[:m] = self.begin_ts[:n][keep]
         self.end_ts[:m] = self.end_ts[:n][keep]
         self.n = m
