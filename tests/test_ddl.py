@@ -167,6 +167,43 @@ class TestReviewRegressions2:
         s.execute("INSERT INTO t VALUES (9.99)")
         assert s.query("select count(*) from t") == [(3,)]
 
+    def test_unique_string_index_dictionary_growth(self):
+        # regression: dictionary growth re-encodes existing codes; the
+        # unique-key cache must not compare stale codes (false dup on
+        # inserting 'a' after 'b' when 'a' sorts first)
+        s = Session()
+        s.execute("CREATE TABLE t (v varchar(10))")
+        s.execute("CREATE UNIQUE INDEX u ON t (v)")
+        s.execute("INSERT INTO t VALUES ('b')")
+        s.execute("INSERT INTO t VALUES ('a')")  # must not be a false dup
+        with pytest.raises(ExecutionError):
+            s.execute("INSERT INTO t VALUES ('a')")  # real dup still caught
+        s.execute("BEGIN")
+        s.execute("INSERT INTO t VALUES ('d')")
+        s.execute("INSERT INTO t VALUES ('c')")
+        s.execute("COMMIT")
+        assert s.query("select count(*) from t") == [(4,)]
+
+    def test_create_user_if_not_exists_preserves_password(self):
+        from tidb_tpu.storage.catalog import Catalog
+
+        cat = Catalog()
+        cat.create_user("alice", "secret")
+        before = cat.users["alice"]
+        cat.create_user("alice", "", if_not_exists=True)
+        assert cat.users["alice"] == before
+
+    def test_uniq_cache_survives_autocommit_inserts(self):
+        s = Session()
+        s.execute("CREATE TABLE t (a bigint)")
+        s.execute("CREATE UNIQUE INDEX u ON t (a)")
+        s.execute("INSERT INTO t VALUES (1)")
+        t = s.catalog.table("test", "t")
+        s.execute("INSERT INTO t VALUES (2)")
+        v, keys = t._uniq_cache["u"]
+        assert v == t.version, "cache must stay fresh across autocommit commits"
+        assert len(keys) == 2
+
     def test_many_single_row_inserts_with_unique_index(self):
         import time
 

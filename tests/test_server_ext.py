@@ -1,0 +1,196 @@
+"""Server completeness: auth, prepared statements (binary protocol),
+INFORMATION_SCHEMA, CLI boot — round-1 gaps (VERDICT items 7 and the
+tidb-server main binary row)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tidb_tpu.server.client import Client, ServerError
+from tidb_tpu.server.server import Server
+from tidb_tpu.session import Session
+from tidb_tpu.storage.catalog import Catalog
+
+
+@pytest.fixture(scope="module")
+def server():
+    cat = Catalog()
+    cat.create_user("alice", "secret")
+    s = Session(catalog=cat)
+    s.execute("CREATE TABLE t (id bigint, name varchar(20), f double)")
+    s.execute("INSERT INTO t VALUES (1, 'a', 1.5), (2, 'b', NULL), (3, NULL, 2.5)")
+    s.execute("CREATE INDEX it ON t (id)")
+    srv = Server(catalog=cat, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class TestAuth:
+    def test_root_empty_password(self, server):
+        c = Client(port=server.port)
+        assert c.ping()
+        c.close()
+
+    def test_password_auth(self, server):
+        c = Client(port=server.port, user="alice", password="secret")
+        assert c.query("select 1 + 1")[1] == [("2",)]
+        c.close()
+
+    def test_wrong_password_rejected(self, server):
+        with pytest.raises(ServerError) as e:
+            Client(port=server.port, user="alice", password="wrong")
+        assert e.value.code == 1045
+
+    def test_unknown_user_rejected(self, server):
+        with pytest.raises(ServerError):
+            Client(port=server.port, user="nobody", password="x")
+
+    def test_create_drop_user_sql(self, server):
+        c = Client(port=server.port)
+        c.execute("CREATE USER 'bob' IDENTIFIED BY 'pw'")
+        c2 = Client(port=server.port, user="bob", password="pw")
+        assert c2.ping()
+        c2.close()
+        c.execute("DROP USER 'bob'")
+        with pytest.raises(ServerError):
+            Client(port=server.port, user="bob", password="pw")
+        c.close()
+
+
+class TestPreparedStatements:
+    def test_select_with_params(self, server):
+        c = Client(port=server.port)
+        sid, n = c.prepare("select id, name, f from t where id > ? order by id")
+        assert n == 1
+        names, rows = c.execute_prepared(sid, (1,))
+        assert names == ["id", "name", "f"]
+        assert rows == [(2, "b", None), (3, None, 2.5)]
+        # re-execute with different param
+        _, rows = c.execute_prepared(sid, (2,))
+        assert rows == [(3, None, 2.5)]
+        c.close_prepared(sid)
+        c.close()
+
+    def test_string_and_float_params(self, server):
+        c = Client(port=server.port)
+        sid, n = c.prepare("select id from t where name = ? or f = ?")
+        assert n == 2
+        _, rows = c.execute_prepared(sid, ("a", 2.5))
+        assert sorted(rows) == [(1,), (3,)]
+        c.close()
+
+    def test_insert_param_and_null(self, server):
+        c = Client(port=server.port)
+        c.execute("CREATE TABLE p (a bigint, b varchar(10))")
+        sid, _ = c.prepare("insert into p values (?, ?)")
+        assert c.execute_prepared(sid, (10, "x")) == ([], [])
+        assert c.execute_prepared(sid, (11, None)) == ([], [])
+        _, rows = c.query("select a, b from p order by a")
+        assert rows == [("10", "x"), ("11", None)]
+        c.execute("DROP TABLE p")
+        c.close()
+
+    def test_reexecute_without_rebinding_types(self, server):
+        # standard clients send param types only on the FIRST execute;
+        # craft a second execute with new_params_bound_flag=0
+        import struct
+
+        from tidb_tpu.server import protocol as P
+
+        c = Client(port=server.port)
+        sid, _ = c.prepare("select id from t where id > ? order by id")
+        _, rows = c.execute_prepared(sid, (1,))
+        assert rows == [(2,), (3,)]
+        body = (struct.pack("<I", sid) + b"\x00" + struct.pack("<I", 1)
+                + b"\x00"            # null bitmap
+                + b"\x00"            # new_params_bound_flag = 0
+                + struct.pack("<q", 2))  # value with cached LONGLONG type
+        P.write_packet(c.sock, 0, b"\x17" + body)
+        _, rows = c._read_binary_resultset()
+        assert rows == [(3,)]
+        c.close()
+
+    def test_unknown_stmt_id(self, server):
+        c = Client(port=server.port)
+        with pytest.raises(ServerError):
+            c.execute_prepared(99999, ())
+        c.close()
+
+
+class TestInformationSchema:
+    def test_tables(self, server):
+        s = Session(catalog=server.catalog)
+        rows = s.query(
+            "select table_name, table_rows from information_schema.tables "
+            "where table_schema = 'test' order by table_name")
+        assert ("t", 3) in rows
+
+    def test_columns(self, server):
+        s = Session(catalog=server.catalog)
+        rows = s.query(
+            "select column_name, data_type, ordinal_position "
+            "from information_schema.columns where table_name = 't' "
+            "order by ordinal_position")
+        assert rows[0][0] == "id" and rows[1][0] == "name"
+
+    def test_statistics(self, server):
+        s = Session(catalog=server.catalog)
+        rows = s.query(
+            "select index_name, column_name from information_schema.statistics "
+            "where table_name = 't'")
+        assert ("it", "id") in rows
+
+    def test_schemata(self, server):
+        s = Session(catalog=server.catalog)
+        rows = s.query("select schema_name from information_schema.schemata")
+        assert ("test",) in rows and ("information_schema",) in rows
+
+    def test_over_wire(self, server):
+        c = Client(port=server.port)
+        names, rows = c.query(
+            "select table_name from information_schema.tables "
+            "where table_schema = 'test'")
+        assert ("t",) in rows
+        c.close()
+
+
+class TestSessionPrepared:
+    def test_session_api(self):
+        s = Session()
+        s.execute("CREATE TABLE q (a bigint)")
+        s.execute("INSERT INTO q VALUES (1), (2), (3)")
+        sid, n = s.prepare("select a from q where a >= ? order by a")
+        assert n == 1
+        assert s.execute_prepared(sid, [2]).rows == [(2,), (3,)]
+        assert s.execute_prepared(sid, [3]).rows == [(3,)]
+        s.close_prepared(sid)
+
+
+def test_cli_boot():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tidb_tpu", "--port", "0", "--mesh", "none"],
+        stderr=subprocess.PIPE, text=True, cwd="/root/repo",
+        env={**__import__("os").environ,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        port = None
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            line = proc.stderr.readline()
+            if "listening on" in line:
+                port = int(line.rsplit(":", 1)[1])
+                break
+        assert port, "server did not report a listening port"
+        c = Client(port=port)
+        c.execute("CREATE TABLE x (a bigint)")
+        c.execute("INSERT INTO x VALUES (42)")
+        assert c.query("select a from x")[1] == [("42",)]
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

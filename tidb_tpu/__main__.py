@@ -1,0 +1,82 @@
+"""tidb-server equivalent: boot the MySQL-protocol server from the CLI.
+
+    python -m tidb_tpu [--host H] [--port P] [--config file.toml]
+                       [--mesh {auto,none}] [--load-tpch SF]
+                       [--root-password PW]
+
+Ref: tidb-server/main.go (flag parsing -> config merge -> bootstrap ->
+Server.Run). Config file keys mirror the flags; explicit flags win.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="tidb_tpu", description=__doc__)
+    ap.add_argument("--host", default=None, help="listen address (default 127.0.0.1)")
+    ap.add_argument("--port", type=int, default=None, help="listen port (default 4000)")
+    ap.add_argument("--config", default=None, help="TOML config file")
+    ap.add_argument("--mesh", choices=["auto", "none"], default=None,
+                    help="auto: shard tables over all visible devices")
+    ap.add_argument("--load-tpch", type=float, default=None, metavar="SF",
+                    help="preload TPC-H tables at scale factor SF")
+    ap.add_argument("--root-password", default=None,
+                    help="set the root account password at boot")
+    return ap.parse_args(argv)
+
+
+def load_config(path):
+    import tomllib
+
+    with open(path, "rb") as f:
+        return tomllib.load(f)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    cfg = load_config(args.config) if args.config else {}
+    host = args.host or cfg.get("host", "127.0.0.1")
+    port = args.port if args.port is not None else int(cfg.get("port", 4000))
+    mesh_mode = args.mesh or cfg.get("mesh", "auto")
+    sf = args.load_tpch if args.load_tpch is not None else cfg.get("load_tpch")
+    root_pw = (args.root_password if args.root_password is not None
+               else cfg.get("root_password"))
+
+    import tidb_tpu  # noqa: F401  (x64 config before jax backend init)
+    from tidb_tpu.server.server import Server
+    from tidb_tpu.storage.catalog import Catalog
+
+    mesh = None
+    if mesh_mode == "auto":
+        try:
+            from tidb_tpu.parallel import make_mesh
+
+            mesh = make_mesh()
+        except Exception as e:  # noqa: BLE001 — boot headless without a mesh
+            print(f"# mesh unavailable ({e}); single-chip execution", file=sys.stderr)
+
+    catalog = Catalog()
+    if root_pw:
+        catalog.set_password("root", root_pw)
+    if sf:
+        from tidb_tpu.storage.tpch import load_tpch
+
+        counts = load_tpch(catalog, sf=float(sf))
+        print(f"# loaded TPC-H sf={sf}: {counts}", file=sys.stderr)
+
+    server = Server(catalog=catalog, host=host, port=port, mesh=mesh)
+    server.start()
+    print(f"# tidb_tpu server listening on {server.host}:{server.port}",
+          file=sys.stderr)
+    try:
+        server._accept_thread.join()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@ __all__ = [
     "ExplainStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
     "RollbackStmt", "UseStmt", "TruncateStmt", "AnalyzeStmt",
     "CreateDatabaseStmt", "DropDatabaseStmt",
+    "CreateUserStmt", "DropUserStmt",
 ]
 
 
@@ -322,6 +323,17 @@ class TruncateStmt:
 @dataclass
 class AnalyzeStmt:
     tables: List[TableName] = field(default_factory=list)
+
+@dataclass
+class CreateUserStmt:
+    user: str
+    password: str = ""
+    if_not_exists: bool = False
+
+@dataclass
+class DropUserStmt:
+    user: str
+    if_exists: bool = False
 
 @dataclass
 class CreateDatabaseStmt:

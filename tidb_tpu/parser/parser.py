@@ -420,6 +420,14 @@ class Parser:
         if self.accept_kw("database") or self.accept_kw("schema"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.expect_ident(), ine)
+        if self.accept_kw("user"):
+            ine = self._if_not_exists()
+            user = self._user_name()
+            password = ""
+            if self.accept_kw("identified"):
+                self.expect_kw("by")
+                password = self.next().text
+            return CreateUserStmt(user, password, ine)
         unique = bool(self.accept_kw("unique"))
         if self.accept_kw("index"):
             name = self.expect_ident()
@@ -543,11 +551,22 @@ class Parser:
             else:
                 return col
 
+    def _user_name(self) -> str:
+        """'user'[@'host'] — host accepted and ignored (single node)."""
+        t = self.next()
+        user = t.text
+        if self.accept_op("@"):
+            self.next()  # host part
+        return user
+
     def parse_drop(self):
         self.expect_kw("drop")
         if self.accept_kw("database") or self.accept_kw("schema"):
             ie = self._if_exists()
             return DropDatabaseStmt(self.expect_ident(), ie)
+        if self.accept_kw("user"):
+            ie = self._if_exists()
+            return DropUserStmt(self._user_name(), ie)
         if self.accept_kw("index"):
             name = self.expect_ident()
             self.expect_kw("on")
@@ -948,4 +967,6 @@ class Parser:
 _IDENTISH_KW = {
     "date", "time", "timestamp", "left", "right", "if", "replace", "values",
     "database", "schema", "comment", "status", "key", "engine", "truncate",
+    # table/column positions (INFORMATION_SCHEMA names, user accounts)
+    "tables", "columns", "column", "user", "variables",
 }

@@ -254,3 +254,133 @@ def text_row(values: List) -> bytes:
                 r = r.encode()
             out += lenc_str(r)
     return out
+
+
+# ---------------------------------------------------------------------------
+# binary protocol (COM_STMT_* — ref: server/conn_stmt.go)
+# ---------------------------------------------------------------------------
+
+def stmt_prepare_ok(stmt_id: int, num_columns: int, num_params: int) -> bytes:
+    return (b"\x00" + struct.pack("<I", stmt_id)
+            + struct.pack("<H", num_columns) + struct.pack("<H", num_params)
+            + b"\x00" + struct.pack("<H", 0))
+
+
+def binary_kind(kind: Optional[TypeKind]) -> int:
+    """Column type declared in binary result sets. DATE/DATETIME values
+    are already rendered to ISO strings by result materialization, so
+    they are declared (and sent) as strings."""
+    return {
+        TypeKind.INT: MYSQL_TYPE_LONGLONG,
+        TypeKind.BOOL: MYSQL_TYPE_TINY,
+        TypeKind.FLOAT: MYSQL_TYPE_DOUBLE,
+        TypeKind.DECIMAL: MYSQL_TYPE_NEWDECIMAL,
+    }.get(kind, MYSQL_TYPE_VAR_STRING)
+
+
+def binary_row(values: List, kinds: List[Optional[TypeKind]]) -> bytes:
+    """One binary-protocol resultset row: 0x00 header, NULL bitmap
+    (offset 2), then values encoded per their declared binary type."""
+    n = len(values)
+    bitmap = bytearray((n + 7 + 2) // 8)
+    body = b""
+    for i, (v, kind) in enumerate(zip(values, kinds)):
+        if v is None:
+            pos = i + 2
+            bitmap[pos // 8] |= 1 << (pos % 8)
+            continue
+        bt = binary_kind(kind)
+        if bt == MYSQL_TYPE_LONGLONG:
+            body += struct.pack("<q", int(v))
+        elif bt == MYSQL_TYPE_TINY:
+            body += struct.pack("<b", 1 if v else 0)
+        elif bt == MYSQL_TYPE_DOUBLE:
+            body += struct.pack("<d", float(v))
+        else:
+            r = render_value(v) or b""
+            body += lenc_str(r)
+    return b"\x00" + bytes(bitmap) + body
+
+
+def parse_stmt_execute(body: bytes, n_params: int,
+                       known_types: Optional[list] = None) -> Tuple[int, list, list]:
+    """COM_STMT_EXECUTE payload (after the command byte) -> (stmt_id,
+    bound parameter values, param types). Standard clients send the type
+    block only on the FIRST execute (new_params_bound_flag=1); later
+    executions reuse `known_types` cached by the connection."""
+    stmt_id = struct.unpack_from("<I", body, 0)[0]
+    pos = 4 + 1 + 4  # stmt_id, flags, iteration count
+    params: list = []
+    if n_params == 0:
+        return stmt_id, params, []
+    nb = (n_params + 7) // 8
+    null_bitmap = body[pos:pos + nb]
+    pos += nb
+    new_bound = body[pos]
+    pos += 1
+    if new_bound:
+        types = []
+        for _ in range(n_params):
+            t, flags = body[pos], body[pos + 1]
+            types.append((t, bool(flags & 0x80)))
+            pos += 2
+    elif known_types is not None:
+        types = known_types
+    else:
+        raise ValueError("re-execution without parameter types bound")
+    for i, (t, unsigned) in enumerate(types):
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            params.append(None)
+            continue
+        v, pos = _read_binary_value(body, pos, t, unsigned)
+        params.append(v)
+    return stmt_id, params, types
+
+
+def _read_binary_value(buf: bytes, pos: int, mysql_type: int, unsigned: bool):
+    import datetime
+
+    t = mysql_type
+    if t == 0x01:  # TINY
+        v = buf[pos] if unsigned else struct.unpack_from("<b", buf, pos)[0]
+        return v, pos + 1
+    if t == 0x02:  # SHORT
+        fmt = "<H" if unsigned else "<h"
+        return struct.unpack_from(fmt, buf, pos)[0], pos + 2
+    if t in (0x03, 0x09):  # LONG / INT24
+        fmt = "<I" if unsigned else "<i"
+        return struct.unpack_from(fmt, buf, pos)[0], pos + 4
+    if t == 0x08:  # LONGLONG
+        fmt = "<Q" if unsigned else "<q"
+        return struct.unpack_from(fmt, buf, pos)[0], pos + 8
+    if t == 0x04:  # FLOAT
+        return struct.unpack_from("<f", buf, pos)[0], pos + 4
+    if t == 0x05:  # DOUBLE
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if t == 0x06:  # NULL
+        return None, pos
+    if t in (0x0A, 0x0C, 0x07):  # DATE / DATETIME / TIMESTAMP
+        length = buf[pos]
+        pos += 1
+        if length == 0:
+            return datetime.date(1970, 1, 1) if t == 0x0A else datetime.datetime(1970, 1, 1), pos
+        y, mo, d = struct.unpack_from("<HBB", buf, pos)
+        if t == 0x0A and length == 4:
+            return datetime.date(y, mo, d), pos + length
+        h = mi = s = us = 0
+        if length >= 7:
+            h, mi, s = buf[pos + 4], buf[pos + 5], buf[pos + 6]
+        if length >= 11:
+            us = struct.unpack_from("<I", buf, pos + 7)[0]
+        if t == 0x0A:
+            return datetime.date(y, mo, d), pos + length
+        return datetime.datetime(y, mo, d, h, mi, s, us), pos + length
+    # strings / decimals / blobs: length-encoded
+    n, pos = read_lenc_int(buf, pos)
+    raw = buf[pos:pos + n]
+    if t == 0xF6:  # NEWDECIMAL arrives as text
+        return raw.decode(), pos + n
+    try:
+        return raw.decode("utf-8"), pos + n
+    except UnicodeDecodeError:
+        return raw, pos + n

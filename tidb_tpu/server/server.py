@@ -26,6 +26,10 @@ COM_INIT_DB = 0x02
 COM_QUERY = 0x03
 COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
 
 
 class Server:
@@ -91,12 +95,16 @@ class Server:
             P.write_packet(conn, 0, P.handshake_v10(conn_id, version, salt))
             _seq, payload = P.read_packet(conn)
             hello = P.parse_handshake_response(payload)
+            # mysql_native_password scramble against the catalog's users
+            if not self.catalog.verify_user(hello["user"], hello["auth"], salt):
+                P.write_packet(conn, 2, P.err_packet(
+                    1045, f"Access denied for user '{hello['user']}'", "28000"))
+                return
             if hello["db"]:
                 try:
                     sess.execute(f"use {hello['db']}")
                 except TidbError:
                     pass
-            # auth: accept everyone (no privilege tier yet)
             P.write_packet(conn, 2, P.ok_packet())
             self._command_loop(conn, sess)
         except (ConnectionError, OSError):
@@ -129,7 +137,71 @@ class Server:
             if cmd == COM_FIELD_LIST:
                 P.write_packet(conn, 1, P.eof_packet())
                 continue
+            if cmd == COM_STMT_PREPARE:
+                self._stmt_prepare(conn, sess, body.decode("utf-8"))
+                continue
+            if cmd == COM_STMT_EXECUTE:
+                self._stmt_execute(conn, sess, body)
+                continue
+            if cmd == COM_STMT_CLOSE:
+                if len(body) >= 4:
+                    sess.close_prepared(int.from_bytes(body[:4], "little"))
+                continue  # no response, per protocol
+            if cmd == COM_STMT_RESET:
+                P.write_packet(conn, 1, P.ok_packet())
+                continue
             P.write_packet(conn, 1, P.err_packet(1047, f"unknown command {cmd:#x}"))
+
+    def _stmt_prepare(self, conn, sess: Session, sql: str) -> None:
+        try:
+            stmt_id, n_params = sess.prepare(sql)
+        except TidbError as e:
+            P.write_packet(conn, 1, P.err_packet(1105, str(e)))
+            return
+        # num_columns=0: clients read the actual column defs from the
+        # execute response's result-set header
+        seq = P.write_packet(conn, 1, P.stmt_prepare_ok(stmt_id, 0, n_params))
+        for i in range(n_params):
+            seq = P.write_packet(conn, seq, P.column_def41(f"?{i}", P.MYSQL_TYPE_VAR_STRING))
+        if n_params:
+            P.write_packet(conn, seq, P.eof_packet())
+
+    def _stmt_execute(self, conn, sess: Session, body: bytes) -> None:
+        try:
+            stmt_id = int.from_bytes(body[:4], "little")
+            ent = sess._prepared.get(stmt_id)
+            if ent is None:
+                P.write_packet(conn, 1, P.err_packet(1243, f"unknown statement {stmt_id}"))
+                return
+            _, n_params = ent
+            # param types arrive only on the first execute; cache them
+            # per statement for re-executions (per protocol)
+            if not hasattr(sess, "_stmt_types"):
+                sess._stmt_types = {}
+            stmt_id, params, types = P.parse_stmt_execute(
+                body, n_params, sess._stmt_types.get(stmt_id))
+            sess._stmt_types[stmt_id] = types
+            with self.catalog.lock:
+                rs = sess.execute_prepared(stmt_id, params)
+        except TidbError as e:
+            P.write_packet(conn, 1, P.err_packet(1105, str(e)))
+            return
+        except Exception as e:  # engine bug — surface, don't kill the conn
+            traceback.print_exc()
+            P.write_packet(conn, 1, P.err_packet(1105, f"internal error: {e}"))
+            return
+        status = self._status(sess)
+        if rs is None:
+            P.write_packet(conn, 1, P.ok_packet(status=status))
+            return
+        types = rs.types or [None] * len(rs.names)
+        seq = P.write_packet(conn, 1, P.lenc_int(len(rs.names)))
+        for name, kind in zip(rs.names, types):
+            seq = P.write_packet(conn, seq, P.column_def41(name, P.binary_kind(kind)))
+        seq = P.write_packet(conn, seq, P.eof_packet(status=status))
+        for row in rs.rows:
+            seq = P.write_packet(conn, seq, P.binary_row(list(row), types))
+        P.write_packet(conn, seq, P.eof_packet(status=status))
 
     @staticmethod
     def _status(sess: Session) -> int:

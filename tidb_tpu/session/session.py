@@ -53,6 +53,8 @@ class Session:
         self._chunk_capacity = chunk_capacity  # explicit override; else sysvar
         self.sysvars = SysVarStore(self.catalog.global_vars)
         self.user_vars: dict = {}
+        self._prepared: dict = {}  # stmt_id -> (ast, n_params)
+        self._stmt_id = 0
         self.txn: Optional[TxnState] = None
         self.mesh = mesh
         self._shard_cache = None
@@ -316,7 +318,42 @@ class Session:
             return None
         if isinstance(stmt, A.AlterTableStmt):
             return self._run_alter_table(stmt)
+        if isinstance(stmt, A.CreateUserStmt):
+            self.catalog.create_user(stmt.user, stmt.password, stmt.if_not_exists)
+            return None
+        if isinstance(stmt, A.DropUserStmt):
+            self.catalog.drop_user(stmt.user, stmt.if_exists)
+            return None
         raise UnsupportedError(f"statement {type(stmt).__name__}")
+
+    # -- prepared statements (ref: server/conn_stmt.go + planner plan
+    # cache; the binary protocol's COM_STMT_* commands drive these) -------
+
+    def prepare(self, sql: str) -> tuple:
+        """Parse once, count placeholders. Returns (stmt_id, n_params)."""
+        stmts = parse(sql)
+        if len(stmts) != 1:
+            raise UnsupportedError("PREPARE requires exactly one statement")
+        stmt = stmts[0]
+        n_params = _count_params(stmt)
+        self._stmt_id += 1
+        self._prepared[self._stmt_id] = (stmt, n_params)
+        return self._stmt_id, n_params
+
+    def execute_prepared(self, stmt_id: int, params: list) -> Optional[ResultSet]:
+        ent = self._prepared.get(stmt_id)
+        if ent is None:
+            raise ExecutionError(f"unknown prepared statement {stmt_id}")
+        stmt, n_params = ent
+        if len(params) != n_params:
+            raise ExecutionError(
+                f"prepared statement takes {n_params} params, got {len(params)}")
+        if n_params:
+            stmt = _sub_params(stmt, params)
+        return self._execute_stmt(stmt)
+
+    def close_prepared(self, stmt_id: int) -> None:
+        self._prepared.pop(stmt_id, None)
 
     # ------------------------------------------------------------------
 
@@ -665,3 +702,66 @@ def _ast_contains(e, cls) -> bool:
 
 def _ast_has_name(e) -> bool:
     return _ast_contains(e, A.EName)
+
+
+def _ast_transform(e, fn):
+    """Rebuild an AST applying fn to every dataclass node (pre-order);
+    fn returning a new node stops recursion into it. Containers (lists,
+    tuples, nested lists — e.g. InsertStmt.rows) recurse structurally."""
+    def walk(v):
+        if hasattr(v, "__dataclass_fields__"):
+            return _ast_transform(v, fn)
+        if isinstance(v, list):
+            return [walk(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(walk(x) for x in v)
+        return v
+
+    r = fn(e)
+    if r is not e:
+        return r
+    if not hasattr(e, "__dataclass_fields__"):
+        return e
+    return type(e)(**{f: walk(getattr(e, f)) for f in e.__dataclass_fields__})
+
+
+def _count_params(stmt) -> int:
+    n = 0
+    stack = [stmt]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, A.EParam):
+            n = max(n, e.index + 1)
+        elif isinstance(e, (list, tuple)):
+            stack.extend(e)
+        elif hasattr(e, "__dataclass_fields__"):
+            stack.extend(getattr(e, f) for f in e.__dataclass_fields__)
+    return n
+
+
+def _param_literal(v):
+    """Bound parameter value -> literal AST node (typed contexts coerce
+    strings the same way quoted literals coerce)."""
+    import datetime
+
+    if v is None:
+        return A.ENull()
+    if isinstance(v, bool):
+        return A.ENum("1" if v else "0")
+    if isinstance(v, int):
+        return A.ENum(str(v))
+    if isinstance(v, float):
+        return A.ENum(repr(v))
+    if isinstance(v, bytes):
+        return A.EStr(v.decode("utf-8", "replace"))
+    if isinstance(v, datetime.datetime):
+        return A.EStr(v.isoformat(sep=" "))
+    if isinstance(v, datetime.date):
+        return A.EStr(v.isoformat())
+    return A.EStr(str(v))
+
+
+def _sub_params(stmt, params):
+    return _ast_transform(
+        stmt, lambda e: _param_literal(params[e.index]) if isinstance(e, A.EParam) else e
+    )

@@ -41,6 +41,10 @@ class Catalog:
         self._txn_id = 0
         # open transactions: marker -> read_ts (drives the GC safepoint)
         self._open_txns: Dict[int, int] = {}
+        # user accounts: name -> mysql_native_password stage-2 hash
+        # (SHA1(SHA1(password)), like mysql.user.authentication_string);
+        # "" means empty password. Ref: privilege/'s MySQLPrivilege.
+        self.users: Dict[str, bytes] = {"root": b""}
 
     def next_ts(self) -> int:
         self._ts += 1
@@ -134,6 +138,8 @@ class Catalog:
         self.schema_version += 1
 
     def database(self, name: str) -> Database:
+        if name.lower() == "information_schema":
+            return self._info_schema_db()
         db = self.databases.get(name)
         if db is None:
             raise SchemaError(f"no database {name!r}")
@@ -163,6 +169,11 @@ class Catalog:
         self.schema_version += 1
 
     def table(self, db: str, name: str) -> Table:
+        if db.lower() == "information_schema":
+            t = self._info_schema_table(name.lower())
+            if t is None:
+                raise SchemaError(f"no table {db}.{name}")
+            return t
         d = self.database(db)
         t = d.tables.get(name)
         if t is None:
@@ -170,6 +181,8 @@ class Catalog:
         return t
 
     def has_table(self, db: str, name: str) -> bool:
+        if db.lower() == "information_schema":
+            return name.lower() in _INFO_TABLES
         return name in self.databases.get(db, Database(db)).tables
 
     def tables(self, db: str) -> List[str]:
@@ -185,3 +198,132 @@ class Catalog:
         t.schema.name = new
         d.tables[new] = t
         self.schema_version += 1
+
+    # -- users (ref: privilege/ — authentication only; grants are a
+    # later tier) ----------------------------------------------------------
+
+    @staticmethod
+    def native_hash(password: str) -> bytes:
+        """mysql_native_password stage-2 hash (what the server stores)."""
+        import hashlib
+
+        if not password:
+            return b""
+        return hashlib.sha1(hashlib.sha1(password.encode()).digest()).digest()
+
+    def create_user(self, user: str, password: str = "",
+                    if_not_exists: bool = False) -> None:
+        if user in self.users:
+            if if_not_exists:
+                return  # MySQL: existing account (and password) untouched
+            raise DuplicateTableError(f"user {user!r} exists")
+        self.users[user] = self.native_hash(password)
+
+    def drop_user(self, user: str, if_exists: bool = False) -> None:
+        if user not in self.users:
+            if if_exists:
+                return
+            raise SchemaError(f"no user {user!r}")
+        del self.users[user]
+
+    def set_password(self, user: str, password: str) -> None:
+        if user not in self.users:
+            raise SchemaError(f"no user {user!r}")
+        self.users[user] = self.native_hash(password)
+
+    def verify_user(self, user: str, token: bytes, salt: bytes) -> bool:
+        """Check a mysql_native_password scramble:
+        token = SHA1(password) XOR SHA1(salt + SHA1(SHA1(password)))."""
+        import hashlib
+
+        stage2 = self.users.get(user)
+        if stage2 is None:
+            return False
+        if stage2 == b"":
+            return token in (b"", b"\x00" * 20)
+        if len(token) != 20:
+            return False
+        mix = hashlib.sha1(salt + stage2).digest()
+        stage1 = bytes(a ^ b for a, b in zip(token, mix))
+        return hashlib.sha1(stage1).digest() == stage2
+
+    # -- INFORMATION_SCHEMA (ref: infoschema/'s virtual memtables) ----------
+    # Read-only views over catalog metadata, materialized per access so
+    # they always reflect the current schema version.
+
+    def _info_schema_db(self) -> Database:
+        d = Database("information_schema")
+        for name in _INFO_TABLES:
+            d.tables[name] = self._info_schema_table(name)
+        return d
+
+    def _info_schema_table(self, name: str):
+        from tidb_tpu.types import INT64, STRING
+
+        def make(cols, rows):
+            schema = TableSchema(
+                name, [ColumnInfo(c, t, not_null=False) for c, t in cols])
+            t = Table(schema)
+            if rows:
+                t.insert_rows(rows, begin_ts=0)
+            return t
+
+        if name == "schemata":
+            return make(
+                [("catalog_name", STRING), ("schema_name", STRING)],
+                [("def", n) for n in sorted(self.databases)]
+                + [("def", "information_schema")],
+            )
+        if name == "tables":
+            rows = []
+            for dbn in sorted(self.databases):
+                for tn in sorted(self.databases[dbn].tables):
+                    t = self.databases[dbn].tables[tn]
+                    rows.append(("def", dbn, tn, "BASE TABLE", t.live_rows))
+            return make(
+                [("table_catalog", STRING), ("table_schema", STRING),
+                 ("table_name", STRING), ("table_type", STRING),
+                 ("table_rows", INT64)],
+                rows,
+            )
+        if name == "columns":
+            rows = []
+            for dbn in sorted(self.databases):
+                for tn in sorted(self.databases[dbn].tables):
+                    t = self.databases[dbn].tables[tn]
+                    pk = set(t.schema.primary_key or [])
+                    for i, c in enumerate(t.schema.columns):
+                        rows.append((
+                            dbn, tn, c.name, i + 1,
+                            c.type_.kind.name.lower(),
+                            "NO" if c.not_null else "YES",
+                            "PRI" if c.name in pk else "",
+                        ))
+            return make(
+                [("table_schema", STRING), ("table_name", STRING),
+                 ("column_name", STRING), ("ordinal_position", INT64),
+                 ("data_type", STRING), ("is_nullable", STRING),
+                 ("column_key", STRING)],
+                rows,
+            )
+        if name == "statistics":
+            rows = []
+            for dbn in sorted(self.databases):
+                for tn in sorted(self.databases[dbn].tables):
+                    t = self.databases[dbn].tables[tn]
+                    for idx in t.indexes.values():
+                        for i, cname in enumerate(idx.columns):
+                            rows.append((
+                                dbn, tn, 0 if idx.unique else 1,
+                                idx.name, i + 1, cname,
+                            ))
+            return make(
+                [("table_schema", STRING), ("table_name", STRING),
+                 ("non_unique", INT64), ("index_name", STRING),
+                 ("seq_in_index", INT64), ("column_name", STRING)],
+                rows,
+            )
+        return None
+
+
+_INFO_TABLES = ("schemata", "tables", "columns", "statistics")

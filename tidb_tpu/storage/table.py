@@ -289,6 +289,11 @@ class Table:
                 self.data[name][: self.n] = trans[self.data[name][: self.n]]
             self.dicts[name] = nd
             d = nd
+            # re-encoding is a physical change: cached structures keyed on
+            # version (unique-key sets, shardings) must see it NOW — a
+            # unique check later in this same statement would otherwise
+            # compare old-code cache entries against new-code rows
+            self.version += 1
         codes, valid = d.encode_with(vals)
         self.data[name][start:end] = codes
         self.valid[name][start:end] = valid
@@ -403,6 +408,7 @@ class Table:
         """Rewrite this txn's markers to the commit timestamp. With a log,
         only the logged rows are touched (O(rows written)); without one,
         the full version arrays are scanned."""
+        vbefore = self.version
         if log is not None:
             for s, e in log.ranges:
                 b = self.begin_ts[s:e]
@@ -416,6 +422,14 @@ class Table:
             b[b == marker] = commit_ts
             e[e == marker] = commit_ts
         self.version += 1
+        if log is not None and not log.ended:
+            # a pure-insert commit doesn't change the present key set:
+            # carry fresh unique caches forward so autocommit insert
+            # workloads keep the O(m log n) merge path instead of
+            # re-sorting the table every statement
+            for name, (v, keys) in list(self._uniq_cache.items()):
+                if v == vbefore:
+                    self._uniq_cache[name] = (self.version, keys)
 
     def txn_rollback(self, marker: int, log: Optional["TableTxnLog"] = None) -> None:
         """Discard provisional writes; restore provisional deletes."""
