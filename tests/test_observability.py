@@ -1,0 +1,90 @@
+"""Observability tier: metrics, slow-query log, TRACE spans, status
+port — the round-1 'zero observability besides EXPLAIN ANALYZE' gap."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tidb_tpu.server.server import Server
+from tidb_tpu.session import Session
+from tidb_tpu.storage.catalog import Catalog
+from tidb_tpu.utils import metrics as M
+
+
+def test_counter_and_histogram():
+    reg = M.Registry()
+    c = M.Counter("c_total", "help", registry=reg)
+    c.inc(type="select")
+    c.inc(type="select")
+    c.inc(type="insert")
+    assert c.value(type="select") == 2
+    h = M.Histogram("h_seconds", "help", buckets=(0.1, 1.0), registry=reg)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = M.render_prometheus(reg)
+    assert 'c_total{type="select"} 2' in text
+    assert 'h_seconds_bucket{le="0.1"} 1' in text
+    assert 'h_seconds_bucket{le="+Inf"} 3' in text
+    assert "h_seconds_count 3" in text
+
+
+def test_query_metrics_collected():
+    s = Session()
+    before_ok = M.QUERY_TOTAL.value(type="select", status="ok")
+    before_err = M.QUERY_TOTAL.value(type="select", status="error")
+    s.query("select 1")
+    with pytest.raises(Exception):
+        s.query("select * from missing_table_xyz")
+    assert M.QUERY_TOTAL.value(type="select", status="ok") == before_ok + 1
+    assert M.QUERY_TOTAL.value(type="select", status="error") == before_err + 1
+    assert M.QUERY_DURATION.count(type="select") > 0
+
+
+def test_txn_metrics():
+    s = Session()
+    s.execute("CREATE TABLE t (a bigint)")
+    before = M.TXN_TOTAL.value(outcome="commit")
+    s.execute("INSERT INTO t VALUES (1)")
+    assert M.TXN_TOTAL.value(outcome="commit") == before + 1
+
+
+def test_slow_query_log():
+    s = Session()
+    s.execute("SET tidb_slow_log_threshold = 0")  # everything is slow
+    s.execute("CREATE TABLE t (a bigint)")
+    s.query("select count(*) from t")
+    rows = s.query("select db, query from information_schema.slow_query")
+    assert any("count(*)" in q for _, q in rows)
+    s.execute("SET tidb_slow_log_threshold = 300000")
+
+
+def test_trace_spans():
+    s = Session()
+    s.execute("CREATE TABLE t (a bigint, b bigint)")
+    s.execute("INSERT INTO t VALUES (1, 2), (3, 4)")
+    rs = s.execute("TRACE select a, sum(b) from t group by a order by a")
+    assert rs.names == ["span", "start_ms", "duration_ms"]
+    spans = [r[0] for r in rs.rows]
+    assert "session.plan" in spans and "session.execute" in spans
+    assert any("executor." in sp for sp in spans)
+
+
+def test_status_port():
+    cat = Catalog()
+    s = Session(catalog=cat)
+    s.execute("CREATE TABLE st (a bigint)")
+    s.execute("INSERT INTO st VALUES (1), (2)")
+    srv = Server(catalog=cat, port=0, status_port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.status_port}"
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status["status"] == "ok" and "version" in status
+        metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "tidb_tpu_query_total" in metrics
+        schema = json.loads(urllib.request.urlopen(base + "/schema").read())
+        assert schema["test"]["st"] == 2
+    finally:
+        srv.stop()

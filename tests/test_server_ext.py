@@ -171,7 +171,8 @@ class TestSessionPrepared:
 
 def test_cli_boot():
     proc = subprocess.Popen(
-        [sys.executable, "-m", "tidb_tpu", "--port", "0", "--mesh", "none"],
+        [sys.executable, "-m", "tidb_tpu", "--port", "0", "--mesh", "none",
+         "--status-port", "0", "--device", "cpu"],
         stderr=subprocess.PIPE, text=True, cwd="/root/repo",
         env={**__import__("os").environ,
              "XLA_FLAGS": "--xla_force_host_platform_device_count=1",

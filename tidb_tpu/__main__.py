@@ -18,6 +18,8 @@ def parse_args(argv):
     ap = argparse.ArgumentParser(prog="tidb_tpu", description=__doc__)
     ap.add_argument("--host", default=None, help="listen address (default 127.0.0.1)")
     ap.add_argument("--port", type=int, default=None, help="listen port (default 4000)")
+    ap.add_argument("--status-port", type=int, default=None,
+                    help="HTTP status/metrics port (default 10080; -1 disables)")
     ap.add_argument("--config", default=None, help="TOML config file")
     ap.add_argument("--mesh", choices=["auto", "none"], default=None,
                     help="auto: shard tables over all visible devices")
@@ -25,6 +27,10 @@ def parse_args(argv):
                     help="preload TPC-H tables at scale factor SF")
     ap.add_argument("--root-password", default=None,
                     help="set the root account password at boot")
+    ap.add_argument("--device", choices=["default", "cpu"], default=None,
+                    help="force the jax platform (cpu bypasses a broken/"
+                         "absent accelerator; the env pin alone is not "
+                         "enough when a sitecustomize overrides it)")
     return ap.parse_args(argv)
 
 
@@ -40,12 +46,23 @@ def main(argv=None) -> int:
     cfg = load_config(args.config) if args.config else {}
     host = args.host or cfg.get("host", "127.0.0.1")
     port = args.port if args.port is not None else int(cfg.get("port", 4000))
+    status_port = (args.status_port if args.status_port is not None
+                   else int(cfg.get("status_port", 10080)))
+    if status_port < 0:
+        status_port = None
     mesh_mode = args.mesh or cfg.get("mesh", "auto")
     sf = args.load_tpch if args.load_tpch is not None else cfg.get("load_tpch")
     root_pw = (args.root_password if args.root_password is not None
                else cfg.get("root_password"))
 
     import tidb_tpu  # noqa: F401  (x64 config before jax backend init)
+
+    device = args.device or cfg.get("device", "default")
+    if device != "default":
+        import jax
+
+        jax.config.update("jax_platforms", device)
+
     from tidb_tpu.server.server import Server
     from tidb_tpu.storage.catalog import Catalog
 
@@ -67,8 +84,12 @@ def main(argv=None) -> int:
         counts = load_tpch(catalog, sf=float(sf))
         print(f"# loaded TPC-H sf={sf}: {counts}", file=sys.stderr)
 
-    server = Server(catalog=catalog, host=host, port=port, mesh=mesh)
+    server = Server(catalog=catalog, host=host, port=port, mesh=mesh,
+                    status_port=status_port)
     server.start()
+    if server.status_port is not None:
+        print(f"# status port http://{server.host}:{server.status_port}"
+              "/metrics /status /schema", file=sys.stderr)
     print(f"# tidb_tpu server listening on {server.host}:{server.port}",
           file=sys.stderr)
     try:

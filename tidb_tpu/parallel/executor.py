@@ -146,6 +146,9 @@ class DistAggExec(HashAggExec):
                                       self.aggs, domains, uid_map=_uid_map(self._scan)),
         )
         state = fn(st.data, st.valid, st.sel)
+        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
+
+        FRAGMENT_DISPATCH.inc(kind="scan_agg")
         self._finalize_segment_state(state, domains)
 
 
@@ -230,6 +233,8 @@ class DistFragmentExec(HashAggExec):
 
     def _run_generic(self):
         self._run_fragment()
+
+
 
     def next(self):
         if self._delegate is not None:
@@ -345,6 +350,9 @@ class DistFragmentExec(HashAggExec):
                 self._fall_back_single_chip()
                 return
         touch(self._cache.growth, gkey, growths, ShardCache.MAX_FRAGMENTS)
+        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
+
+        FRAGMENT_DISPATCH.inc(kind=f"general_{prog.out_kind}")
 
         if prog.out_kind == "segment":
             self._finalize_segment_state(out, prog.domains)

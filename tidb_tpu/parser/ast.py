@@ -22,7 +22,7 @@ __all__ = [
     # statements
     "InsertStmt", "UpdateStmt", "DeleteStmt", "ColumnDef", "CreateTableStmt",
     "DropTableStmt", "CreateIndexStmt", "DropIndexStmt", "AlterTableStmt",
-    "ExplainStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
+    "ExplainStmt", "TraceStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
     "RollbackStmt", "UseStmt", "TruncateStmt", "AnalyzeStmt",
     "CreateDatabaseStmt", "DropDatabaseStmt",
     "CreateUserStmt", "DropUserStmt",
@@ -283,6 +283,10 @@ class AlterTableStmt:
     old_name: Optional[str] = None
     new_name: Optional[str] = None
     index: Optional[Tuple[str, List[str]]] = None
+
+@dataclass
+class TraceStmt:
+    stmt: object
 
 @dataclass
 class ExplainStmt:

@@ -32,7 +32,7 @@ KEYWORDS = {
     "character", "collate", "auto_increment", "unsigned", "zerofill",
     "variables", "status", "grant", "revoke", "flush", "privileges",
     "alter", "add", "modify", "change", "rename", "to", "extract", "column",
-    "user", "identified",
+    "user", "identified", "trace",
 }
 
 

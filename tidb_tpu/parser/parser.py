@@ -132,6 +132,7 @@ class Parser:
             "use": self.parse_use,
             "truncate": self.parse_truncate,
             "analyze": self.parse_analyze,
+            "trace": lambda: (self.next(), TraceStmt(self.parse_statement()))[1],
         }.get(kw)
         if handler is None:
             raise self.error(f"unsupported statement {kw.upper()}")
@@ -968,5 +969,5 @@ _IDENTISH_KW = {
     "date", "time", "timestamp", "left", "right", "if", "replace", "values",
     "database", "schema", "comment", "status", "key", "engine", "truncate",
     # table/column positions (INFORMATION_SCHEMA names, user accounts)
-    "tables", "columns", "column", "user", "variables",
+    "tables", "columns", "column", "user", "variables", "trace",
 }

@@ -34,11 +34,13 @@ COM_STMT_RESET = 0x1A
 
 class Server:
     def __init__(self, catalog: Optional[Catalog] = None, host: str = "127.0.0.1",
-                 port: int = 4000, mesh=None):
+                 port: int = 4000, mesh=None, status_port: Optional[int] = None):
         self.catalog = catalog or Catalog()
         self.host = host
         self.port = port
         self.mesh = mesh
+        self.status_port = status_port  # None disables the HTTP status tier
+        self._status_server = None
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_id = 0
@@ -47,17 +49,41 @@ class Server:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        # Initialize the jax backend NOW, in the caller's (main) thread:
+        # lazy init from a connection handler thread can wedge inside the
+        # TPU plugin (observed with the tunneled axon backend), hanging
+        # every query. A failed init is fine — queries fall back per
+        # host_eager()'s probing.
+        try:
+            import jax
+
+            jax.default_backend()
+            jax.local_devices(backend="cpu")
+        except Exception:  # noqa: BLE001
+            pass
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))
         self.port = self._sock.getsockname()[1]  # resolves port 0
         self._sock.listen(16)
         self._running = True
+        if self.status_port is not None:
+            from tidb_tpu.server.status import StatusServer
+            from tidb_tpu.session.sysvars import SYSVARS
+
+            self._status_server = StatusServer(
+                self.catalog, host=self.host, port=self.status_port,
+                version=str(SYSVARS["version"].default))
+            self._status_server.start()
+            self.status_port = self._status_server.port
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
 
     def stop(self) -> None:
         self._running = False
+        if self._status_server is not None:
+            self._status_server.stop()
+            self._status_server = None
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -87,6 +113,9 @@ class Server:
             t.start()
 
     def _serve_conn(self, conn: socket.socket, conn_id: int) -> None:
+        from tidb_tpu.utils.metrics import CONN_GAUGE
+
+        CONN_GAUGE.inc()
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sess = Session(catalog=self.catalog, mesh=self.mesh)
@@ -112,6 +141,7 @@ class Server:
         except Exception:
             traceback.print_exc()
         finally:
+            CONN_GAUGE.dec()
             try:
                 conn.close()
             except OSError:
@@ -173,7 +203,7 @@ class Server:
             if ent is None:
                 P.write_packet(conn, 1, P.err_packet(1243, f"unknown statement {stmt_id}"))
                 return
-            _, n_params = ent
+            _, n_params, _sql = ent
             # param types arrive only on the first execute; cache them
             # per statement for re-executions (per protocol)
             if not hasattr(sess, "_stmt_types"):

@@ -1,0 +1,84 @@
+"""HTTP status port (ref: the tidb-server status port: /metrics for
+Prometheus, /status for liveness/version, plus schema introspection).
+
+Endpoints:
+    /metrics  - Prometheus text exposition of tidb_tpu_* collectors
+    /status   - JSON: version, connections, schema version, uptime
+    /schema   - JSON: databases -> tables -> row counts
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+__all__ = ["StatusServer"]
+
+
+class StatusServer:
+    def __init__(self, catalog, host: str = "127.0.0.1", port: int = 10080,
+                 version: str = ""):
+        self.catalog = catalog
+        self.version = version
+        self.started = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    if self.path == "/metrics":
+                        from tidb_tpu.utils.metrics import render_prometheus
+
+                        body = render_prometheus().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path == "/status":
+                        from tidb_tpu.utils.metrics import CONN_GAUGE
+
+                        body = json.dumps({
+                            "version": outer.version,
+                            "status": "ok",
+                            "connections": CONN_GAUGE.value(),
+                            "schema_version": outer.catalog.schema_version,
+                            "uptime_s": round(time.time() - outer.started, 1),
+                        }).encode()
+                        ctype = "application/json"
+                    elif self.path == "/schema":
+                        # snapshot under the catalog lock: concurrent DDL
+                        # mutates these dicts
+                        with outer.catalog.lock:
+                            snap = {
+                                dbn: {tn: t.live_rows
+                                      for tn, t in db.tables.items()}
+                                for dbn, db in outer.catalog.databases.items()
+                            }
+                        body = json.dumps(snap).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

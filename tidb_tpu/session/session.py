@@ -94,6 +94,9 @@ class Session:
         for t, log in txn.logs.values():
             t.txn_commit(txn.marker, commit_ts, log)
         self.catalog.end_txn(txn.marker)
+        from tidb_tpu.utils.metrics import TXN_TOTAL
+
+        TXN_TOTAL.inc(outcome="commit")
         if txn.logs and self.sysvars.get("tidb_gc_enable"):
             self.catalog.auto_gc([t for t, _ in txn.logs.values()])
 
@@ -104,6 +107,9 @@ class Session:
         for t, log in txn.logs.values():
             t.txn_rollback(txn.marker, log)
         self.catalog.end_txn(txn.marker)
+        from tidb_tpu.utils.metrics import TXN_TOTAL
+
+        TXN_TOTAL.inc(outcome="rollback")
         if txn.logs and self.sysvars.get("tidb_gc_enable"):
             self.catalog.auto_gc([t for t, _ in txn.logs.values()])
 
@@ -140,7 +146,40 @@ class Session:
         """Execute one or more statements; returns the last result set."""
         result = None
         for stmt in parse(sql):
-            result = self._execute_stmt(stmt)
+            result = self._execute_timed(stmt, sql)
+        return result
+
+    def _execute_timed(self, stmt, sql: str) -> Optional[ResultSet]:
+        """Metrics + slow-query log + optional jax.profiler around one
+        statement (ref: the server-layer duration histograms and the
+        slow-query log with per-phase durations)."""
+        import contextlib
+        import time as _time
+
+        from tidb_tpu.utils import metrics as M
+
+        stype = type(stmt).__name__.removesuffix("Stmt").lower()
+        prof_dir = str(self.sysvars.get("tidb_profile_dir"))
+        ctx = contextlib.nullcontext()
+        if prof_dir:
+            import jax
+
+            ctx = jax.profiler.trace(prof_dir)
+        t0 = _time.perf_counter()
+        try:
+            with ctx:
+                result = self._execute_stmt(stmt)
+        except Exception:
+            M.QUERY_TOTAL.inc(type=stype, status="error")
+            raise
+        dur = _time.perf_counter() - t0
+        M.QUERY_TOTAL.inc(type=stype, status="ok")
+        M.QUERY_DURATION.observe(dur, type=stype)
+        # threshold in ms; 0 logs every statement (long_query_time=0)
+        threshold = int(self.sysvars.get("tidb_slow_log_threshold"))
+        if dur * 1e3 >= threshold:
+            M.SLOW_QUERY_TOTAL.inc()
+            self.catalog.log_slow_query(self.db, sql, dur)
         return result
 
     def query(self, sql: str) -> List[tuple]:
@@ -274,6 +313,8 @@ class Session:
             return None
         if isinstance(stmt, A.ExplainStmt):
             return self._run_explain(stmt)
+        if isinstance(stmt, A.TraceStmt):
+            return self._run_trace(stmt)
         if isinstance(stmt, A.SetStmt):
             for scope, name, value in stmt.assignments:
                 from tidb_tpu.planner.binder import Binder
@@ -337,20 +378,22 @@ class Session:
         stmt = stmts[0]
         n_params = _count_params(stmt)
         self._stmt_id += 1
-        self._prepared[self._stmt_id] = (stmt, n_params)
+        self._prepared[self._stmt_id] = (stmt, n_params, sql)
         return self._stmt_id, n_params
 
     def execute_prepared(self, stmt_id: int, params: list) -> Optional[ResultSet]:
         ent = self._prepared.get(stmt_id)
         if ent is None:
             raise ExecutionError(f"unknown prepared statement {stmt_id}")
-        stmt, n_params = ent
+        stmt, n_params, sql = ent
         if len(params) != n_params:
             raise ExecutionError(
                 f"prepared statement takes {n_params} params, got {len(params)}")
         if n_params:
             stmt = _sub_params(stmt, params)
-        return self._execute_stmt(stmt)
+        # through the timed path: prepared executions must hit the same
+        # metrics / slow-query log / profiler hooks as text queries
+        return self._execute_timed(stmt, sql)
 
     def close_prepared(self, stmt_id: int) -> None:
         self._prepared.pop(stmt_id, None)
@@ -645,6 +688,51 @@ class Session:
                              rows=[(line,) for line in text.split("\n")])
         text = explain_text(phys)
         return ResultSet(names=["EXPLAIN"], rows=[(line,) for line in text.split("\n")])
+
+    def _run_trace(self, stmt: A.TraceStmt):
+        """TRACE <select>: phase + per-operator span tree with timings
+        (ref: util/tracing + the TRACE statement's span rendering)."""
+        import time as _time
+
+        target = stmt.stmt
+        if not isinstance(target, (A.SelectStmt, A.UnionStmt)):
+            raise UnsupportedError("TRACE only supports SELECT")
+        from tidb_tpu.utils.execdetails import instrument
+
+        if self.txn is None and not self.sysvars.get("autocommit"):
+            self._begin()  # same consistent-snapshot rule as _run_select
+        t_start = _time.perf_counter()
+        phys = self._plan_select(target)
+        t_plan = _time.perf_counter()
+        root = self._build_root(phys)
+        instrument(root)
+        t_build = _time.perf_counter()
+        run_plan(root, self._exec_ctx())
+        t_exec = _time.perf_counter()
+
+        def ms(a, b):
+            return round((b - a) * 1e3, 3)
+
+        rows = [
+            ("session.plan", 0.0, ms(t_start, t_plan)),
+            ("session.build_executor", ms(t_start, t_plan), ms(t_plan, t_build)),
+            ("session.execute", ms(t_start, t_build), ms(t_build, t_exec)),
+        ]
+
+        def visit(e, depth):
+            # operator spans have no meaningful absolute start (they
+            # interleave); start_ms is NULL, duration = open + next time
+            name = "  " * depth + "executor." + type(e).__name__
+            rows.append((
+                name,
+                None,
+                round((e.stats.open_wall + e.stats.next_wall) * 1e3, 3),
+            ))
+            for c in e.children:
+                visit(c, depth + 1)
+
+        visit(root, 1)
+        return ResultSet(names=["span", "start_ms", "duration_ms"], rows=rows)
 
     @staticmethod
     def _like_filter(rows, like: Optional[str], col: int = 0):

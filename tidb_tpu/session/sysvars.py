@@ -41,6 +41,10 @@ _reg(
     # the north-star switch: route eligible fragments to the device mesh
     SysVar("tidb_enable_tpu_exec", True, BOTH, "bool"),
     SysVar("tidb_gc_enable", True, BOTH, "bool"),
+    # statements slower than this (ms) go to the slow-query log
+    SysVar("tidb_slow_log_threshold", 300, BOTH, "int", min_=0, max_=1 << 31),
+    # non-empty: wrap query execution in jax.profiler.trace(dir)
+    SysVar("tidb_profile_dir", "", BOTH, "str"),
     # fixed device batch capacity (ref: tidb_max_chunk_size)
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)

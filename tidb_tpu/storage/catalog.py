@@ -45,6 +45,12 @@ class Catalog:
         # (SHA1(SHA1(password)), like mysql.user.authentication_string);
         # "" means empty password. Ref: privilege/'s MySQLPrivilege.
         self.users: Dict[str, bytes] = {"root": b""}
+        # recent slow statements, surfaced via
+        # information_schema.slow_query (ref: the slow-query log +
+        # INFORMATION_SCHEMA.SLOW_QUERY)
+        from collections import deque
+
+        self.slow_queries = deque(maxlen=128)
 
     def next_ts(self) -> int:
         self._ts += 1
@@ -82,6 +88,17 @@ class Catalog:
         future log-remapping GC that can run under open snapshots."""
         return min(self._open_txns.values(), default=self._ts)
 
+    def log_slow_query(self, db: str, sql: str, duration_s: float) -> None:
+        import logging
+        import time
+
+        self.slow_queries.append((
+            time.strftime("%Y-%m-%d %H:%M:%S"), db, round(duration_s, 4),
+            sql.strip()[:2048],
+        ))
+        logging.getLogger("tidb_tpu.slowlog").warning(
+            "slow query (%.3fs) db=%s: %s", duration_s, db, sql.strip()[:512])
+
     def gc(self) -> Dict[str, int]:
         """Reclaim dead MVCC versions in every table. Conservative: a
         no-op while any txn is open (open write logs hold physical row
@@ -95,6 +112,10 @@ class Catalog:
                 r = t.gc(sp)
                 if r:
                     out[f"{db.name}.{name}"] = r
+        if out:
+            from tidb_tpu.utils.metrics import GC_RECLAIMED
+
+            GC_RECLAIMED.inc(sum(out.values()))
         return out
 
     def auto_gc(self, tables=None, min_dead: int = 4096,
@@ -117,6 +138,10 @@ class Catalog:
                 r = t.gc(sp)
                 if r:
                     out[t.schema.name] = r
+        if out:
+            from tidb_tpu.utils.metrics import GC_RECLAIMED
+
+            GC_RECLAIMED.inc(sum(out.values()))
         return out
 
     # -- databases ---------------------------------------------------------
@@ -258,7 +283,7 @@ class Catalog:
         return d
 
     def _info_schema_table(self, name: str):
-        from tidb_tpu.types import INT64, STRING
+        from tidb_tpu.types import FLOAT64, INT64, STRING
 
         def make(cols, rows):
             schema = TableSchema(
@@ -306,6 +331,12 @@ class Catalog:
                  ("column_key", STRING)],
                 rows,
             )
+        if name == "slow_query":
+            return make(
+                [("time", STRING), ("db", STRING), ("query_time", FLOAT64),
+                 ("query", STRING)],
+                list(self.slow_queries),
+            )
         if name == "statistics":
             rows = []
             for dbn in sorted(self.databases):
@@ -326,4 +357,4 @@ class Catalog:
         return None
 
 
-_INFO_TABLES = ("schemata", "tables", "columns", "statistics")
+_INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query")

@@ -1,0 +1,153 @@
+"""Metrics registry (ref: metrics/ — Prometheus collectors per layer,
+served on the HTTP status port).
+
+Counters and histograms with optional labels, exposed in the Prometheus
+text format by server/status.py. A process-global REGISTRY mirrors the
+reference's package-level collectors; everything is thread-safe under
+one lock (metric updates are far off the hot device path)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Histogram", "Gauge", "REGISTRY", "Registry",
+           "render_prometheus"]
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0)
+
+
+class Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.metrics: "List[object]" = []
+
+    def register(self, m) -> None:
+        with self.lock:
+            self.metrics.append(m)
+
+
+REGISTRY = Registry()
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "",
+                 registry: Optional[Registry] = None):
+        self.name = name
+        self.help = help_
+        self.lock = threading.Lock()
+        (registry or REGISTRY).register(self)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_, registry)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self.lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self.lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def samples(self):
+        with self.lock:  # snapshot: writers may insert new label keys
+            items = sorted(self._values.items())
+        for key, v in items:
+            yield dict(key), v
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        with self.lock:
+            self._values[tuple(sorted(labels.items()))] = v
+
+    def dec(self, n: float = 1.0, **labels) -> None:
+        self.inc(-n, **labels)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_="", buckets=_DEFAULT_BUCKETS, registry=None):
+        super().__init__(name, help_, registry)
+        self.buckets = tuple(buckets)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self.lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            i = 0
+            while i < len(self.buckets) and v > self.buckets[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + v
+
+    def count(self, **labels) -> int:
+        with self.lock:
+            return sum(self._counts.get(tuple(sorted(labels.items())), []))
+
+    def samples(self):
+        with self.lock:  # snapshot under the lock (see Counter.samples)
+            items = [(k, list(self._counts[k]), self._sums.get(k, 0.0))
+                     for k in sorted(self._counts)]
+        for key, counts, total in items:
+            yield dict(key), counts, total
+
+
+def _fmt_labels(labels: Dict, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels.items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(registry: Optional[Registry] = None) -> str:
+    """Prometheus text exposition of every registered metric."""
+    reg = registry or REGISTRY
+    out = []
+    with reg.lock:
+        metrics = list(reg.metrics)
+    for m in metrics:
+        out.append(f"# HELP {m.name} {m.help}")
+        out.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            for labels, counts, total in m.samples():
+                acc = 0
+                for b, c in zip(m.buckets, counts):
+                    acc += c
+                    out.append(f"{m.name}_bucket{_fmt_labels(labels, f'le=\"{b}\"')} {acc}")
+                acc += counts[-1]
+                out.append(f"{m.name}_bucket{_fmt_labels(labels, 'le=\"+Inf\"')} {acc}")
+                out.append(f"{m.name}_sum{_fmt_labels(labels)} {total}")
+                out.append(f"{m.name}_count{_fmt_labels(labels)} {acc}")
+        else:
+            for labels, v in m.samples():
+                out.append(f"{m.name}{_fmt_labels(labels)} {v}")
+    return "\n".join(out) + "\n"
+
+
+# -- engine collectors (ref: metrics/*.go one file per layer) ---------------
+
+QUERY_TOTAL = Counter("tidb_tpu_query_total", "Statements executed, by type/status")
+QUERY_DURATION = Histogram("tidb_tpu_query_duration_seconds",
+                           "Statement wall time, by type")
+SLOW_QUERY_TOTAL = Counter("tidb_tpu_slow_query_total",
+                           "Statements exceeding tidb_slow_log_threshold")
+TXN_TOTAL = Counter("tidb_tpu_txn_total", "Transaction outcomes")
+GC_RECLAIMED = Counter("tidb_tpu_gc_reclaimed_rows_total",
+                       "MVCC versions reclaimed by GC")
+CONN_GAUGE = Gauge("tidb_tpu_connections", "Open server connections")
+FRAGMENT_DISPATCH = Counter("tidb_tpu_fragment_dispatch_total",
+                            "Distributed fragment executions, by kind")
