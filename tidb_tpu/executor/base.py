@@ -40,6 +40,9 @@ class ExecContext:
     # grouping kernels; off falls back to the numpy oracle path
     # (tidb_enable_tpu_exec sysvar)
     device_agg: bool = True
+    # tables above this stream through staged batches on the dist scan
+    # path instead of full device residency (tidb_device_cache_bytes)
+    device_cache_bytes: int = 8 << 30
 
     def __post_init__(self):
         if self.mem_tracker is None:

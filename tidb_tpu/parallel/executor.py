@@ -18,6 +18,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from tidb_tpu.utils.lru import get_or_build, touch
@@ -85,6 +86,11 @@ class ShardCache:
             self._cache.popitem(last=False)
         return st
 
+    def evict(self, table) -> None:
+        """Drop a table's resident sharding (e.g. it grew past the
+        device-cache budget and the streaming path takes over)."""
+        self._cache.pop(id(table), None)
+
     def get_fragment(self, key, build):
         return get_or_build(self.fragments, key, build, self.MAX_FRAGMENTS)
 
@@ -131,10 +137,24 @@ class DistAggExec(HashAggExec):
         self._stages = stages
         self._cache = cache
 
+    # per-shard staging batch for the >HBM streaming path (rows; the
+    # batch buffer is P * this many rows of the scanned columns)
+    STREAM_ROWS_PER_PART = 1 << 20
+
     def _run_segment(self):
+        from tidb_tpu.parallel.partition import table_bytes
+
         sizes = self.segment_sizes or []
         domains = [s + 1 for s in sizes]
-        st = self._cache.get(self._scan.table)
+        table = self._scan.table
+        scan_cols = [c.name for c in self._scan.schema]
+        # gate on the FULL table size: the resident path shards every
+        # column; streaming then stages only the scanned columns
+        if table_bytes(table) > self.ctx.device_cache_bytes:
+            self._cache.evict(table)  # drop any stale resident sharding
+            self._run_segment_streaming(domains, scan_cols)
+            return
+        st = self._cache.get(table)
         # keyed on schema signature, NOT data identity: the compiled fragment
         # is a pure function of plan + shapes + column types (arrays are
         # arguments), so version bumps with unchanged schema reuse it
@@ -149,6 +169,52 @@ class DistAggExec(HashAggExec):
         from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
 
         FRAGMENT_DISPATCH.inc(kind="scan_agg")
+        self._finalize_segment_state(state, domains)
+
+    def _run_segment_streaming(self, domains, scan_cols):
+        """>HBM tables: stream fixed [P, R] staging batches through the
+        (once-compiled) partial-agg fragment, combining the replicated
+        [G] states on device; one fetch at the end. jax's async dispatch
+        overlaps batch k's compute with batch k+1's host staging (the
+        IndexLookUp double-pipeline analogue)."""
+        from tidb_tpu.executor.aggregate import merge_op_for
+        from tidb_tpu.parallel.partition import stream_batches
+        from tidb_tpu.utils.jitcache import cached_jit
+        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
+
+        table = self._scan.table
+        mesh = self._cache.mesh
+        sig = repr((self._stages, self.group_exprs, self.aggs, domains))
+
+        def combine(s1, s2):
+            out = {}
+            for k, v in s1.items():
+                op = merge_op_for(k)
+                if op == "sum":
+                    out[k] = v + s2[k]
+                elif op == "min":
+                    out[k] = jnp.minimum(v, s2[k])
+                else:
+                    out[k] = jnp.maximum(v, s2[k])
+            return out
+
+        combine = cached_jit("aggcombine", sig, lambda: combine)
+        state = None
+        fn = None
+        for st in stream_batches(table, mesh, scan_cols,
+                                 self.STREAM_ROWS_PER_PART):
+            if fn is None:
+                key = ("agg", sig, st.n_parts, st.rows_per_part,
+                       _types_sig(st), "stream")
+                fn = self._cache.get_fragment(
+                    key,
+                    lambda st=st: make_agg_fragment(
+                        st, self._stages, self.group_exprs, self.aggs,
+                        domains, uid_map=_uid_map(self._scan)),
+                )
+            part = fn(st.data, st.valid, st.sel)
+            state = part if state is None else combine(state, part)
+            FRAGMENT_DISPATCH.inc(kind="scan_agg_stream")
         self._finalize_segment_state(state, domains)
 
 

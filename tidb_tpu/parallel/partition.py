@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tidb_tpu.parallel.mesh import dcn_axis, shard_axis
 from tidb_tpu.types import SQLType
 
-__all__ = ["ShardedTable", "shard_table"]
+__all__ = ["ShardedTable", "shard_table", "stream_batches", "table_bytes"]
 
 
 @dataclass
@@ -45,11 +45,43 @@ class ShardedTable:
 
 
 
-def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
-                rows_per_part: Optional[int] = None) -> ShardedTable:
-    """Partition a host Table across the mesh's (dcn x shard) grid."""
-    n_parts = mesh.shape[dcn_axis] * mesh.shape[shard_axis]
+def table_bytes(table, columns: Optional[List[str]] = None) -> int:
+    """Device bytes a full sharding of `table` would occupy (data +
+    validity for the chosen columns)."""
+    names = columns or [c.name for c in table.schema.columns]
     n = table.n
+    total = 0
+    for name in names:
+        total += n * (table.data[name].dtype.itemsize + 1)  # + valid byte
+    return total + n  # + sel mask
+
+
+def stream_batches(table, mesh: Mesh, columns: Optional[List[str]],
+                   rows_per_part: int):
+    """Yield fixed-shape ShardedTable batches covering the whole table.
+
+    The >HBM path (ref: SURVEY.md hard part 6 + the IndexLookUp double
+    pipeline): batch b stages rows [b*P*R, (b+1)*P*R) as one [P, R]
+    sharding. Every batch has identical shapes/types, so the compiled
+    fragment is reused across batches, and jax's async dispatch overlaps
+    batch k's compute with batch k+1's host->device staging."""
+    n_parts = mesh.shape[dcn_axis] * mesh.shape[shard_axis]
+    rows_per_batch = n_parts * rows_per_part
+    n = table.n
+    for start in range(0, max(n, 1), rows_per_batch):
+        yield shard_table(table, mesh, columns=columns,
+                          rows_per_part=rows_per_part,
+                          row_range=(start, min(start + rows_per_batch, n)))
+
+
+def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
+                rows_per_part: Optional[int] = None,
+                row_range: Optional[tuple] = None) -> ShardedTable:
+    """Partition a host Table (or a row range of it) across the mesh's
+    (dcn x shard) grid."""
+    n_parts = mesh.shape[dcn_axis] * mesh.shape[shard_axis]
+    lo, hi = row_range if row_range is not None else (0, table.n)
+    n = hi - lo
     R = rows_per_part or max((n + n_parts - 1) // n_parts, 1)
     if R * n_parts < n:
         raise ValueError(f"rows_per_part {R} too small for {n} rows / {n_parts} parts")
@@ -65,7 +97,7 @@ def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
     host_cols = {}
     for name in names:
         info = table.schema.col(name)
-        d, v = table.column_slice(name, 0, n)
+        d, v = table.column_slice(name, lo, hi)
         buf = np.zeros((n_parts, R), dtype=d.dtype)
         vbuf = np.zeros((n_parts, R), dtype=np.bool_)
         host_cols[name] = (buf, vbuf, d, v)
@@ -74,7 +106,7 @@ def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
         if dc is not None:
             dicts[name] = dc
 
-    row_live = table.live_mask(0, n)
+    row_live = table.live_mask(lo, hi)
     for p in range(n_parts):
         s, e = p * R, min((p + 1) * R, n)
         if s >= n:

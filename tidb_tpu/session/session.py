@@ -203,6 +203,7 @@ class Session:
             read_ts=self.txn.read_ts if self.txn is not None else None,
             txn_marker=self.txn.marker if self.txn is not None else 0,
             device_agg=bool(self.sysvars.get("tidb_enable_tpu_exec")),
+            device_cache_bytes=int(self.sysvars.get("tidb_device_cache_bytes")),
         )
 
     def _execute_subplan(self, logical) -> List[tuple]:

@@ -45,6 +45,10 @@ _reg(
     SysVar("tidb_slow_log_threshold", 300, BOTH, "int", min_=0, max_=1 << 31),
     # non-empty: wrap query execution in jax.profiler.trace(dir)
     SysVar("tidb_profile_dir", "", BOTH, "str"),
+    # tables above this size stream through fixed [P,R] staging batches
+    # instead of residing wholly in device memory (the >HBM path)
+    SysVar("tidb_device_cache_bytes", 8 << 30, BOTH, "int",
+           min_=1 << 20, max_=1 << 45),
     # fixed device batch capacity (ref: tidb_max_chunk_size)
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)
