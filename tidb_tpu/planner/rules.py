@@ -229,6 +229,12 @@ def _push_cond(plan: LogicalPlan, conds: List[Expr]) -> LogicalPlan:
         plan.children[0] = _push_cond(plan.children[0], lconds)
         plan.children[1] = _push_cond(plan.children[1], rconds)
         plan.children[0] = _rule_pushdown(plan.children[0]) if not lconds else plan.children[0]
+        if keep and plan.kind in ("inner", "cross"):
+            # cross-side non-equi conjuncts: for an inner join a post-join
+            # filter and a WHERE above are identical — fuse into the join
+            plan.other_cond = _conj_join(
+                ([plan.other_cond] if plan.other_cond is not None else []) + keep)
+            keep = []
         if keep:
             return LSelection(schema=plan.schema, children=[plan], cond=_conj_join(keep))
         return plan
@@ -262,7 +268,10 @@ def _rule_prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
             need = set(required)
             if plan.pushed_cond is not None:
                 need |= _refs(plan.pushed_cond)
-            plan.schema = [c for c in plan.schema if c.uid in need]
+            keep = [c for c in plan.schema if c.uid in need]
+            if not keep and plan.schema:
+                keep = [plan.schema[0]]  # COUNT(*): one column for liveness
+            plan.schema = keep
         return plan
 
     if isinstance(plan, LSelection):
@@ -308,19 +317,33 @@ def _rule_prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
         for a in plan.aggs:
             if a.arg is not None:
                 child_req |= _refs(a.arg)
-        plan.children[0] = _rule_prune(plan.child, child_req or None)
+        # an EMPTY set is meaningful ("only structural needs below" —
+        # COUNT(*) over a join must still prune to the join keys);
+        # widening it to None would disable pruning entirely
+        plan.children[0] = _rule_prune(plan.child, child_req)
         return plan
 
     if isinstance(plan, LJoin):
+        if required is None:
+            # 'everything required' propagates as-is: a Selection above
+            # this join may reference ANY child column — pruning down to
+            # the eq keys here dropped columns the parent still reads
+            plan.children[0] = _rule_prune(plan.children[0], None)
+            plan.children[1] = _rule_prune(plan.children[1], None)
+            if plan.kind in ("semi", "anti"):
+                plan.schema = list(plan.children[0].schema)
+            else:
+                plan.schema = (list(plan.children[0].schema)
+                               + list(plan.children[1].schema))
+            return plan
         child_req_l, child_req_r = set(), set()
-        if required is not None:
-            left_uids = {c.uid for c in plan.children[0].schema}
-            right_uids = {c.uid for c in plan.children[1].schema}
-            for uid in required:
-                if uid in left_uids:
-                    child_req_l.add(uid)
-                elif uid in right_uids:
-                    child_req_r.add(uid)
+        left_uids = {c.uid for c in plan.children[0].schema}
+        right_uids = {c.uid for c in plan.children[1].schema}
+        for uid in required:
+            if uid in left_uids:
+                child_req_l.add(uid)
+            elif uid in right_uids:
+                child_req_r.add(uid)
         for l, r in plan.eq_conds:
             child_req_l |= _refs(l)
             child_req_r |= _refs(r)

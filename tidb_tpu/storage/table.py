@@ -278,6 +278,46 @@ class Table:
         self._uniq_commit()
         return m
 
+    def ingest_encoded(self, arrays: Dict[str, np.ndarray],
+                       pools: Dict[str, list]) -> int:
+        """Bulk ingest with PRE-ENCODED dictionary codes (the native
+        data-loader path): string columns arrive as int codes indexing
+        their sorted unique `pools` entry; no Python string objects are
+        materialized for the rows. Table must be empty."""
+        if self.n:
+            raise ExecutionError("encoded ingest requires an empty table")
+        sizes = {len(a) for a in arrays.values()}
+        if len(sizes) != 1:
+            raise ExecutionError(f"encoded ingest length mismatch: {sizes}")
+        m = sizes.pop()
+        self._ensure(m)
+        for c in self.schema.columns:
+            name = c.name
+            if name in pools:
+                pool = pools[name]
+                if sorted(set(pool)) != list(pool):
+                    raise ExecutionError(
+                        f"pool for {name!r} must be sorted and unique")
+                codes = arrays.get(name)
+                if codes is not None and len(codes) and (
+                        codes.min() < 0 or codes.max() >= len(pool)):
+                    raise ExecutionError(
+                        f"codes for {name!r} outside [0, {len(pool)})")
+                self.dicts[name] = Dictionary(pool)
+            if name in arrays:
+                self.data[name][:m] = arrays[name].astype(
+                    c.type_.np_dtype, copy=False)
+                self.valid[name][:m] = True
+            elif c.not_null:
+                raise ExecutionError(f"encoded ingest missing NOT NULL {name!r}")
+        self._enforce_unique_new(0, m)
+        self.begin_ts[:m] = 0
+        self.end_ts[:m] = MAX_TS
+        self.n = m
+        self.version += 1
+        self._uniq_commit()
+        return m
+
     def _append_strings(self, name: str, vals: list, start: int, end: int):
         d = self.dicts[name]
         new = {v for v in vals if v is not None and v not in d}

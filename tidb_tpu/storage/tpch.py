@@ -14,7 +14,7 @@ already in device representation for bulk ingest.
 from __future__ import annotations
 
 import datetime
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -152,9 +152,70 @@ def _pool_pick(rng, pool, n):
     return [pool[i] for i in rng.integers(0, len(pool), n)]
 
 
-def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 7) -> Dict[str, int]:
+def _load_orders_lineitem_native(make_table, counts, sf, seed,
+                                 npart, nsupp, ncust) -> bool:
+    """Fill orders+lineitem via the C++ generator; False if unavailable."""
+    from tidb_tpu.storage.native_gen import native_orders_lineitem
+
+    nclerk = max(1, int(1000 * sf))
+    out = native_orders_lineitem(sf, seed, npart, nsupp, ncust, nclerk)
+    if out is None:
+        return False
+    o, l = out
+
+    t = make_table("lineitem")
+    counts["lineitem"] = t.ingest_encoded(
+        {
+            "l_orderkey": l["l_orderkey"], "l_partkey": l["l_partkey"],
+            "l_suppkey": l["l_suppkey"], "l_linenumber": l["l_linenumber"],
+            "l_quantity": l["l_quantity"],
+            "l_extendedprice": l["l_extendedprice"],
+            "l_discount": l["l_discount"], "l_tax": l["l_tax"],
+            "l_returnflag": l["l_returnflag_code"],
+            "l_linestatus": l["l_linestatus_code"],
+            "l_shipdate": l["l_shipdate"], "l_commitdate": l["l_commitdate"],
+            "l_receiptdate": l["l_receiptdate"],
+            "l_shipinstruct": l["l_instruct_code"],
+            "l_shipmode": l["l_shipmode_code"],
+            "l_comment": l["l_comment_code"],
+        },
+        pools={
+            "l_returnflag": ["A", "N", "R"],
+            "l_linestatus": ["F", "O"],
+            "l_shipinstruct": sorted(_INSTRUCT),
+            "l_shipmode": sorted(_SHIPMODES),
+            "l_comment": sorted(_COMMENT_POOL),
+        },
+    )
+    t = make_table("orders")
+    counts["orders"] = t.ingest_encoded(
+        {
+            "o_orderkey": o["o_orderkey"], "o_custkey": o["o_custkey"],
+            "o_totalprice": o["o_totalprice"], "o_orderdate": o["o_orderdate"],
+            "o_shippriority": o["o_shippriority"],
+            "o_orderstatus": o["o_status_code"],
+            "o_orderpriority": o["o_priority_code"],
+            "o_clerk": o["o_clerk_code"], "o_comment": o["o_comment_code"],
+        },
+        pools={
+            "o_orderstatus": ["F", "O", "P"],
+            "o_orderpriority": sorted(_PRIORITIES),
+            "o_clerk": [f"Clerk#{k + 1:09d}" for k in range(nclerk)],
+            "o_comment": sorted(_COMMENT_POOL),
+        },
+    )
+    return True
+
+
+def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 7,
+              native: Optional[bool] = None) -> Dict[str, int]:
     """Generate and ingest all eight TPC-H tables at scale factor `sf`.
-    Returns table -> row count."""
+    Returns table -> row count.
+
+    `native` selects the C++ generator (native/tpch_gen.cpp) for the two
+    big tables — orders and lineitem fill as int64 columns + dictionary
+    codes with no per-row Python objects. None = auto (native when the
+    library builds/loads); False forces the numpy oracle generator."""
     rng = np.random.default_rng(seed)
     counts = {}
 
@@ -257,6 +318,14 @@ def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 
     )
 
     # orders + lineitem ------------------------------------------------------
+    if native is not False:
+        done = _load_orders_lineitem_native(
+            make_table, counts, sf, seed, npart, ns, nc)
+        if done:
+            return counts
+        if native is True:
+            raise RuntimeError("native TPC-H generator unavailable")
+
     no = max(1, int(1_500_000 * sf))
     okeys = np.arange(1, no + 1)
     odate = rng.integers(_START, _END - 151, no)
