@@ -1,0 +1,173 @@
+"""Two-phase commit + failpoint injection (ref: twoPhaseCommitter and
+pingcap/failpoint — VERDICT missing item 5 and aux subsystem 30).
+
+The crash tests arm a failpoint inside the commit, catch the simulated
+crash, and then assert ATOMICITY across "restart" (resolve_locks):
+before the commit point nothing is visible; after it, everything is —
+no matter which secondary the crash interrupted."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import ExecutionError
+from tidb_tpu.session import Session
+from tidb_tpu.storage.catalog import Catalog
+from tidb_tpu.utils.failpoint import FailpointError, failpoint
+
+
+def _two_table_txn(cat):
+    s = Session(catalog=cat)
+    s.execute("CREATE TABLE a (x bigint)")
+    s.execute("CREATE TABLE b (y bigint)")
+    s.execute("INSERT INTO a VALUES (0)")
+    s.execute("INSERT INTO b VALUES (0)")
+    s.execute("BEGIN")
+    s.execute("INSERT INTO a VALUES (1)")
+    s.execute("INSERT INTO b VALUES (2)")
+    s.execute("DELETE FROM b WHERE y = 0")
+    return s
+
+
+def test_crash_before_commit_point_rolls_back():
+    cat = Catalog()
+    s = _two_table_txn(cat)
+    with failpoint("2pc.before_commit_point"):
+        with pytest.raises(FailpointError):
+            s.execute("COMMIT")
+    s.txn = None  # the session's view of the txn died with the "crash"
+    cat.resolve_locks()
+    r = Session(catalog=cat)
+    assert r.query("select count(*) from a") == [(1,)]  # only the seed row
+    assert sorted(r.query("select y from b")) == [(0,)]
+
+
+def test_crash_after_commit_point_commits_everything():
+    cat = Catalog()
+    s = _two_table_txn(cat)
+    # die before ANY secondary applies: the decision alone must win
+    with failpoint("2pc.before_secondary"):
+        with pytest.raises(FailpointError):
+            s.execute("COMMIT")
+    s.txn = None
+    assert cat.resolve_locks() == 1
+    r = Session(catalog=cat)
+    assert sorted(r.query("select x from a")) == [(0,), (1,)]
+    assert sorted(r.query("select y from b")) == [(2,)]  # delete applied
+
+
+def test_crash_between_secondaries_commits_everything():
+    cat = Catalog()
+    s = _two_table_txn(cat)
+    # first secondary applies, then crash: restart must finish the rest
+    from tidb_tpu.utils import failpoint as fp
+
+    state = {"n": 0}
+
+    def second_call_only():
+        state["n"] += 1
+        if state["n"] == 2:
+            raise FailpointError("crash between secondaries")
+
+    fp.enable("2pc.before_secondary", action=second_call_only)
+    try:
+        with pytest.raises(FailpointError):
+            s.execute("COMMIT")
+    finally:
+        fp.disable("2pc.before_secondary")
+    s.txn = None
+    cat.resolve_locks()
+    r = Session(catalog=cat)
+    assert sorted(r.query("select x from a")) == [(0,), (1,)]
+    assert sorted(r.query("select y from b")) == [(2,)]
+
+
+def test_undecided_commit_failure_releases_locks():
+    # regression: a commit failing BEFORE the commit point must abort —
+    # otherwise its row locks leak forever (no status record for
+    # resolve_locks) and the marker pins the GC safepoint
+    cat = Catalog()
+    s = Session(catalog=cat)
+    s.execute("CREATE TABLE t (id bigint, v bigint)")
+    s.execute("INSERT INTO t VALUES (1, 10)")
+    s.execute("BEGIN")
+    s.execute("UPDATE t SET v = 20 WHERE id = 1")
+    with failpoint("2pc.before_commit_point"):
+        with pytest.raises(FailpointError):
+            s.execute("COMMIT")
+    assert not cat._open_txns, "marker must not pin the safepoint"
+    s2 = Session(catalog=cat)
+    s2.execute("UPDATE t SET v = 30 WHERE id = 1")  # no leaked lock
+    assert s2.query("select v from t") == [(30,)]
+
+
+def test_resolve_is_idempotent_and_clean_when_nothing_pending():
+    cat = Catalog()
+    s = Session(catalog=cat)
+    s.execute("CREATE TABLE t (x bigint)")
+    s.execute("INSERT INTO t VALUES (1)")
+    assert cat.resolve_locks() == 0
+    assert cat.resolve_locks() == 0
+    assert s.query("select x from t") == [(1,)]
+
+
+def test_conflict_with_crashed_txn_resolves_and_retries():
+    cat = Catalog()
+    s1 = Session(catalog=cat)
+    s1.execute("CREATE TABLE t (id bigint, v bigint)")
+    s1.execute("INSERT INTO t VALUES (1, 10)")
+    s1.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 20 WHERE id = 1")
+    # crash after the commit DECISION but before secondaries
+    with failpoint("2pc.before_secondary"):
+        with pytest.raises(FailpointError):
+            s1.execute("COMMIT")
+    s1.txn = None
+    # another session writes the same row: hits the stale marker, the
+    # Backoffer path resolves the decided txn and retries
+    s2 = Session(catalog=cat)
+    s2.execute("UPDATE t SET v = 30 WHERE id = 1")
+    assert s2.query("select v from t") == [(30,)]
+
+
+def test_concurrent_conflicting_updates_one_wins():
+    cat = Catalog()
+    s0 = Session(catalog=cat)
+    s0.execute("CREATE TABLE t (id bigint, v bigint)")
+    s0.execute("INSERT INTO t VALUES (1, 0)")
+
+    s1, s2 = Session(catalog=cat), Session(catalog=cat)
+    s1.execute("BEGIN")
+    s2.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 1 WHERE id = 1")  # takes the lock
+    with pytest.raises(ExecutionError, match="write conflict"):
+        s2.execute("UPDATE t SET v = 2 WHERE id = 1")
+    s1.execute("COMMIT")
+    s2.execute("ROLLBACK")
+    assert s0.query("select v from t") == [(1,)]
+
+
+def test_threaded_increments_serialize():
+    cat = Catalog()
+    s0 = Session(catalog=cat)
+    s0.execute("CREATE TABLE c (n bigint)")
+    s0.execute("INSERT INTO c VALUES (0)")
+    errors = []
+
+    def worker():
+        s = Session(catalog=cat)
+        for _ in range(10):
+            try:
+                with cat.lock:  # statement-granularity, like the server
+                    s.execute("UPDATE c SET n = n + 1")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert s0.query("select n from c") == [(40,)]

@@ -28,6 +28,11 @@ class ExecutionError(TiDBTPUError):
     code = 1105
 
 
+class WriteConflictError(ExecutionError):
+    """A write hit another transaction's lock or a newer commit (ref:
+    kv.ErrWriteConflict — drives the resolve-lock/backoff retry)."""
+
+
 class UnsupportedError(TiDBTPUError):
     """Feature understood by the grammar but not yet implemented."""
 

@@ -7,7 +7,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from tidb_tpu.errors import ExecutionError, PlanError, SchemaError, UnsupportedError
+from tidb_tpu.errors import (ExecutionError, PlanError, SchemaError,
+                             UnsupportedError, WriteConflictError)
 from tidb_tpu.executor import ExecContext, ResultSet, build_executor, run_plan
 from tidb_tpu.executor.base import Executor
 from tidb_tpu.parser import ast as A
@@ -90,10 +91,21 @@ class Session:
         txn, self.txn = self.txn, None
         if txn is None:
             return
-        commit_ts = self.catalog.next_ts()
-        for t, log in txn.logs.values():
-            t.txn_commit(txn.marker, commit_ts, log)
-        self.catalog.end_txn(txn.marker)
+        from tidb_tpu.storage.txn2pc import TwoPhaseCommitter
+
+        committer = TwoPhaseCommitter(
+            self.catalog, txn.marker, list(txn.logs.values()))
+        try:
+            committer.execute()
+        except Exception:
+            # UNDECIDED failure (prewrite error / crash before the commit
+            # point): abort so the row locks can't leak — without a status
+            # record resolve_locks could never clean them up. A DECIDED
+            # txn (status recorded) is committed; leave its residue for
+            # resolve_locks, never roll it back.
+            if self.catalog.txn_status(txn.marker) is None:
+                committer.rollback()
+            raise
         from tidb_tpu.utils.metrics import TXN_TOTAL
 
         TXN_TOTAL.inc(outcome="commit")
@@ -104,9 +116,10 @@ class Session:
         txn, self.txn = self.txn, None
         if txn is None:
             return
-        for t, log in txn.logs.values():
-            t.txn_rollback(txn.marker, log)
-        self.catalog.end_txn(txn.marker)
+        from tidb_tpu.storage.txn2pc import TwoPhaseCommitter
+
+        TwoPhaseCommitter(
+            self.catalog, txn.marker, list(txn.logs.values())).rollback()
         from tidb_tpu.utils.metrics import TXN_TOTAL
 
         TXN_TOTAL.inc(outcome="rollback")
@@ -115,10 +128,18 @@ class Session:
 
     def _run_dml(self, fn):
         """Run a write inside the session txn; implicit txns commit (or
-        roll back on error) at statement end."""
+        roll back on error) at statement end. A write conflict against a
+        marker whose txn already DECIDED (crashed mid-2PC) resolves the
+        stale locks and retries once — the Backoffer/resolve-lock flow."""
         txn, implicit = self._ensure_txn()
         try:
-            fn(txn)
+            try:
+                fn(txn)
+            except WriteConflictError:
+                if self.catalog.resolve_locks():
+                    fn(txn)  # stale locks cleared; one retry
+                else:
+                    raise
         except Exception:
             if implicit:
                 self._rollback()

@@ -41,6 +41,9 @@ class Catalog:
         self._txn_id = 0
         # open transactions: marker -> read_ts (drives the GC safepoint)
         self._open_txns: Dict[int, int] = {}
+        # 2PC status records: marker -> ("committed", ts) | ("aborted", 0)
+        # present only between commit/abort point and secondary completion
+        self._txn_status: Dict[int, tuple] = {}
         # user accounts: name -> mysql_native_password stage-2 hash
         # (SHA1(SHA1(password)), like mysql.user.authentication_string);
         # "" means empty password. Ref: privilege/'s MySQLPrivilege.
@@ -79,6 +82,46 @@ class Catalog:
 
     def end_txn(self, marker: int) -> None:
         self._open_txns.pop(marker, None)
+
+    # -- 2PC status records (the Percolator primary; ref: txn status in
+    # TiKV consulted by lock resolution) ------------------------------------
+
+    def commit_point(self, marker: int) -> int:
+        """THE atomic commit: after this status write the txn is
+        committed regardless of crashes. Returns the commit ts."""
+        ts = self.next_ts()
+        self._txn_status[marker] = ("committed", ts)
+        return ts
+
+    def abort_point(self, marker: int) -> None:
+        self._txn_status[marker] = ("aborted", 0)
+
+    def finish_txn(self, marker: int) -> None:
+        """All secondaries applied: drop the status record + the open
+        registration."""
+        self._txn_status.pop(marker, None)
+        self.end_txn(marker)
+
+    def txn_status(self, marker: int):
+        return self._txn_status.get(marker)
+
+    def resolve_locks(self) -> int:
+        """Finish crashed commits/aborts (the resolve-lock flow): any
+        marker with a recorded decision but unapplied table residue gets
+        its markers rewritten (commit) or erased (rollback) via the
+        logless full-scan paths, which are idempotent. Returns resolved
+        txn count."""
+        n = 0
+        for marker, (st, ts) in list(self._txn_status.items()):
+            for db in self.databases.values():
+                for t in db.tables.values():
+                    if st == "committed":
+                        t.txn_commit(marker, ts)
+                    else:
+                        t.txn_rollback(marker)
+            self.finish_txn(marker)
+            n += 1
+        return n
 
     def safepoint(self) -> int:
         """Oldest snapshot any open txn can read. NOTE: today's GC

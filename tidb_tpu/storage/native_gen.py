@@ -35,11 +35,17 @@ def load_native() -> Optional[ctypes.CDLL]:
             raise FileNotFoundError(_SRC)
         if (not os.path.exists(_LIB)
                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-            subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-                 "-o", _LIB, _SRC],
-                check=True, capture_output=True, timeout=120,
-            )
+            try:  # one build recipe: the Makefile (honors CXX/CXXFLAGS)
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "libtpchgen.so"],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except (FileNotFoundError, subprocess.CalledProcessError):
+                subprocess.run(  # make absent: the Makefile's default recipe
+                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
+                     "-o", _LIB, _SRC],
+                    check=True, capture_output=True, timeout=120,
+                )
         lib = ctypes.CDLL(_LIB)
         i64p = ctypes.POINTER(ctypes.c_int64)
         lib.tpch_sizes.argtypes = [ctypes.c_double, ctypes.c_uint64, i64p, i64p]

@@ -349,7 +349,9 @@ class Table:
         ours = cur == marker if marker else np.zeros(len(ids), dtype=np.bool_)
         blocked = (cur != MAX_TS) & ~ours & in_bounds
         if blocked.any():
-            raise ExecutionError(
+            from tidb_tpu.errors import WriteConflictError
+
+            raise WriteConflictError(
                 "write conflict: row modified by another transaction "
                 f"(table {self.schema.name!r})"
             )

@@ -1,0 +1,70 @@
+"""Failpoint injection (ref: pingcap/failpoint — `failpoint.Inject`
+annotations compiled into the reference, letting tests trigger commit
+failures, retry paths, and OOM actions).
+
+Call sites sprinkle `inject("name")` at interesting boundaries (2PC
+phases, exchange staging, spill). Tests arm them:
+
+    with failpoint("commit.before_secondaries", CrashError):
+        ...
+
+Disabled failpoints cost one dict lookup."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Dict, Optional
+
+__all__ = ["inject", "enable", "disable", "failpoint", "FailpointError"]
+
+
+class FailpointError(RuntimeError):
+    """Default injected failure (stands in for a crash/network fault)."""
+
+
+_active: Dict[str, Callable[[], None]] = {}
+_lock = threading.Lock()
+
+
+def inject(name: str) -> None:
+    """Trigger point — no-op unless a test armed `name`."""
+    hook = _active.get(name)
+    if hook is not None:
+        hook()
+
+
+def enable(name: str, action: Optional[Callable[[], None]] = None,
+           exc: Optional[type] = None, times: Optional[int] = None) -> None:
+    """Arm a failpoint: run `action`, or raise `exc` (default
+    FailpointError). `times` limits how many triggers fire."""
+    state = {"left": times}
+
+    def hook():
+        if state["left"] is not None:
+            if state["left"] <= 0:
+                return
+            state["left"] -= 1
+        if action is not None:
+            action()
+        else:
+            raise (exc or FailpointError)(f"failpoint {name!r}")
+
+    with _lock:
+        _active[name] = hook
+
+
+def disable(name: str) -> None:
+    with _lock:
+        _active.pop(name, None)
+
+
+@contextlib.contextmanager
+def failpoint(name: str, exc: Optional[type] = None,
+              action: Optional[Callable[[], None]] = None,
+              times: Optional[int] = None):
+    enable(name, action=action, exc=exc, times=times)
+    try:
+        yield
+    finally:
+        disable(name)
