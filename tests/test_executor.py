@@ -372,3 +372,79 @@ class TestMeta:
             s.query("select * from nosuchtable")
         with pytest.raises(ParseError):
             s.query("select from where")
+
+
+class TestHashModeJoin:
+    """Multi-key joins whose range product overflows int64 packing fall
+    back to hash-packed keys with exact device verification
+    (executor/join.py _pack_keys_host hash mode)."""
+
+    @pytest.fixture(scope="class")
+    def wide_session(self):
+        s = Session(chunk_capacity=512)
+        s.execute("create table a (k1 bigint, k2 bigint, va bigint)")
+        s.execute("create table b (k1 bigint, k2 bigint, vb bigint)")
+        rng = np.random.default_rng(7)
+        base = 1 << 33  # per-key range ~2^34 -> product >> 2^62
+        arows = [(int(rng.integers(-base, base)), int(rng.integers(-base, base)), i)
+                 for i in range(300)]
+        brows = []
+        for i in range(300):
+            if i % 2 == 0:
+                k1, k2, _ = arows[rng.integers(0, 300)]
+            else:
+                k1, k2 = int(rng.integers(-base, base)), int(rng.integers(-base, base))
+            brows.append((k1, k2, 1000 + i))
+        for t, rows in (("a", arows), ("b", brows)):
+            vals = ", ".join(f"({r[0]}, {r[1]}, {r[2]})" for r in rows)
+            s.execute(f"insert into {t} values {vals}")
+        oracle = mirror_to_sqlite(s.catalog, tables=["a", "b"])
+        return s, oracle
+
+    def test_inner(self, wide_session):
+        check(wide_session,
+              "select a.va, b.vb from a join b on a.k1 = b.k1 and a.k2 = b.k2")
+
+    def test_left(self, wide_session):
+        check(wide_session,
+              "select a.va, b.vb from a left join b on a.k1 = b.k1 and a.k2 = b.k2")
+
+    def test_left_with_cond(self, wide_session):
+        check(wide_session,
+              "select a.va, b.vb from a left join b on a.k1 = b.k1 "
+              "and a.k2 = b.k2 and b.vb > 1100")
+
+    def test_semi(self, wide_session):
+        check(wide_session,
+              "select count(*) from a where exists "
+              "(select 1 from b where b.k1 = a.k1 and b.k2 = a.k2)")
+
+    def test_anti(self, wide_session):
+        check(wide_session,
+              "select count(*) from a where not exists "
+              "(select 1 from b where b.k1 = a.k1 and b.k2 = a.k2)")
+
+    def test_inner_with_where(self, wide_session):
+        check(wide_session,
+              "select a.va from a join b on a.k1 = b.k1 and a.k2 = b.k2 "
+              "where b.vb % 2 = 0")
+
+
+class TestUpdateStringExpr:
+    def test_update_string_from_column(self):
+        s = Session(chunk_capacity=256)
+        s.execute("create table u (id bigint primary key, name varchar(20), "
+                  "alt varchar(20), n bigint)")
+        s.execute("insert into u values (1,'aa','xx',5),(2,'bb','yy',6),(3,null,'zz',7)")
+        s.execute("update u set name = alt where id >= 2")
+        assert s.query("select id, name from u order by id") == \
+            [(1, "aa"), (2, "yy"), (3, "zz")]
+
+    def test_update_string_from_case(self):
+        s = Session(chunk_capacity=256)
+        s.execute("create table u2 (id bigint primary key, name varchar(20), "
+                  "alt varchar(20), n bigint)")
+        s.execute("insert into u2 values (1,'aa','xx',5),(2,'bb','yy',6),(3,null,'zz',7)")
+        s.execute("update u2 set name = case when n > 5 then alt else name end")
+        assert s.query("select id, name from u2 order by id") == \
+            [(1, "aa"), (2, "yy"), (3, "zz")]

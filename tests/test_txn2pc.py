@@ -13,6 +13,7 @@ import pytest
 
 from tidb_tpu.errors import ExecutionError
 from tidb_tpu.session import Session
+from tidb_tpu.utils import failpoint as fp
 from tidb_tpu.storage.catalog import Catalog
 from tidb_tpu.utils.failpoint import FailpointError, failpoint
 
@@ -171,3 +172,48 @@ def test_threaded_increments_serialize():
         t.join()
     assert not errors
     assert s0.query("select n from c") == [(40,)]
+
+
+def test_reader_resolves_crashed_decided_commit():
+    """A txn that crashed AFTER the commit point must become visible to
+    the next reader — the reader-side resolve-lock flow (no write ever
+    needs to touch the rows). Covers both text and prepared execution
+    (the check lives in _execute_timed)."""
+    cat = Catalog()
+    s = Session(catalog=cat)
+    s.execute("CREATE TABLE rr (id bigint primary key, v bigint)")
+    s.execute("INSERT INTO rr VALUES (1, 10), (2, 20)")
+    fp.enable("2pc.after_commit_point")
+    try:
+        with pytest.raises(fp.FailpointError):
+            s.execute("UPDATE rr SET v = 99 WHERE id = 1")
+    finally:
+        fp.disable("2pc.after_commit_point")
+    assert cat.has_stale_txns()
+    # a pure read on another session resolves the residue and sees the
+    # committed value
+    s2 = Session(catalog=cat)
+    assert s2.query("select v from rr where id = 1") == [(99,)]
+    assert not cat.has_stale_txns()
+
+
+def test_resolve_skips_untouched_table_versions():
+    """resolve_locks full-scans every table, but tables with no residue
+    must keep their version (cache invalidation costs; review finding)."""
+    cat = Catalog()
+    s = Session(catalog=cat)
+    s.execute("CREATE TABLE wa (id bigint primary key, v bigint)")
+    s.execute("CREATE TABLE wb (id bigint primary key, v bigint)")
+    s.execute("INSERT INTO wa VALUES (1, 1)")
+    s.execute("INSERT INTO wb VALUES (1, 1)")
+    tb = cat.table("test", "wb")
+    v_before = tb.version
+    fp.enable("2pc.after_commit_point")
+    try:
+        with pytest.raises(fp.FailpointError):
+            s.execute("UPDATE wa SET v = 2 WHERE id = 1")
+    finally:
+        fp.disable("2pc.after_commit_point")
+    cat.resolve_locks()
+    assert tb.version == v_before  # wb untouched by the crashed txn
+    assert s.query("select v from wa") == [(2,)]

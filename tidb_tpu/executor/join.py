@@ -13,9 +13,12 @@ are handled naturally by the [start, start+count) ranges; NULL keys never
 match by masking them out of both sides.
 
 Multi-key equi joins pack keys into one int64 using host-known ranges
-(offset+stride per key); if ranges overflow, the join falls back to a
-host merge join (correct, slower — the reference similarly falls back
-from its fast paths).
+(offset+stride per key); if ranges overflow int64, packing switches to
+a 64-bit mixing hash of the composite key with exact on-device
+verification — expanded candidate rows are filtered by real key
+equality, so hash collisions only cost extra candidates, never wrong
+results (the reference similarly falls back from its perfect-hash fast
+path to a generic one).
 
 Join kinds: inner, left (outer), semi, anti (with NOT IN null semantics:
 any NULL build key -> empty result).
@@ -31,7 +34,6 @@ import numpy as np
 
 from tidb_tpu.chunk.chunk import Chunk
 from tidb_tpu.chunk.column import Column
-from tidb_tpu.errors import ExecutionError, UnsupportedError
 from tidb_tpu.executor.base import ExecContext, Executor
 from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
@@ -45,6 +47,38 @@ def _as_int64_key(d, mode: str):
     if mode == "bits":
         return jax.lax.bitcast_convert_type(d.astype(jnp.float64), jnp.int64)
     return d.astype(jnp.int64)
+
+
+# splitmix64-style mixing constants (used identically on host numpy and
+# device jnp; only same-function-both-sides matters, not canonicality)
+_MIX_C1 = np.uint64(0x9E3779B97F4A7C15)
+_MIX_C2 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_C3 = np.uint64(0x94D049BB133111EB)
+
+
+def _hash_combine_host(key_arrays_i64):
+    """uint64 mixing hash of composite int64 keys -> int64 (numpy)."""
+    with np.errstate(over="ignore"):
+        h = np.zeros(len(key_arrays_i64[0]), dtype=np.uint64)
+        for k in key_arrays_i64:
+            z = k.view(np.uint64) + _MIX_C1
+            z = (z ^ (z >> np.uint64(30))) * _MIX_C2
+            z = (z ^ (z >> np.uint64(27))) * _MIX_C3
+            z = z ^ (z >> np.uint64(31))
+            h = h * _MIX_C1 ^ z
+    return h.view(np.int64)
+
+
+def _hash_combine_device(keys_i64):
+    """Same mixing hash on device (jnp uint64, logical shifts)."""
+    h = jnp.zeros_like(keys_i64[0], dtype=jnp.uint64)
+    for k in keys_i64:
+        z = jax.lax.bitcast_convert_type(k, jnp.uint64) + jnp.uint64(_MIX_C1)
+        z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(_MIX_C2)
+        z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(_MIX_C3)
+        z = z ^ (z >> jnp.uint64(31))
+        h = h * jnp.uint64(_MIX_C1) ^ z
+    return jax.lax.bitcast_convert_type(h, jnp.int64)
 
 
 class HashJoinExec(Executor):
@@ -112,6 +146,12 @@ class HashJoinExec(Executor):
         order = np.argsort(packed, kind="stable")
         self._n_build = len(packed)
         self._sorted_keys = jnp.asarray(packed[order])
+        if self._hash_mode:
+            # raw per-column key values, build-sorted, for exact
+            # verification of hash-expanded candidate rows on device
+            self._build_keyvals_sorted = [
+                jnp.asarray(k[order]) for k in self._build_keyvals
+            ]
         self._build_payload = {}
         nbytes = packed.nbytes
         for uid, (dlist, vlist) in payload.items():
@@ -136,30 +176,37 @@ class HashJoinExec(Executor):
     def _pack_keys_host(self, key_arrays: List[np.ndarray]):
         """Combine multi-keys into one int64 via range packing. Returns
         (packed, info) where info lets the probe side apply the same
-        transform; raises to host-merge fallback on overflow."""
+        transform. If the range product overflows int64, switch to a
+        64-bit mixing hash with exact device-side verification (see
+        module docstring) — sets self._hash_mode."""
+        self._hash_mode = False
         if len(key_arrays) == 1:
             k = key_arrays[0]
             if np.issubdtype(k.dtype, np.floating):
                 return k.astype(np.float64).view(np.int64), [("bits", 0, 1, 0)]
             return k.astype(np.int64), [("int", 0, 1, 0)]
+        conv, modes = [], []
+        for k in key_arrays:
+            if np.issubdtype(k.dtype, np.floating):
+                conv.append(k.astype(np.float64).view(np.int64))
+                modes.append("bits")
+            else:
+                conv.append(k.astype(np.int64))
+                modes.append("int")
         info = []
         packed = np.zeros(len(key_arrays[0]), dtype=np.int64)
         stride = 1
-        for k in key_arrays:
-            if np.issubdtype(k.dtype, np.floating):
-                k = k.astype(np.float64).view(np.int64)
-                mode = "bits"
-            else:
-                k = k.astype(np.int64)
-                mode = "int"
+        for k, mode in zip(conv, modes):
             lo = int(k.min()) if len(k) else 0
             hi = int(k.max()) if len(k) else 0
             rng = hi - lo + 1
-            if stride > 0 and rng * stride > (1 << 62):
-                raise UnsupportedError("multi-key join range overflow (host fallback TODO)")
+            if rng <= 0 or rng * stride > (1 << 62):
+                self._hash_mode = True
+                self._build_keyvals = conv
+                return _hash_combine_host(conv), [("hash", modes)]
             info.append((mode, lo, stride, rng))
             packed = packed + (k - lo) * stride
-            stride *= rng if rng > 0 else 1
+            stride *= rng
         return packed, info
 
     def _pack_probe(self, outs):
@@ -171,6 +218,16 @@ class HashJoinExec(Executor):
             d, v = outs[0]
             ones = jnp.ones_like(v)
             return _as_int64_key(d, info[0][0]), v, ones
+        if info[0][0] == "hash":
+            modes = info[0][1]
+            valid = jnp.ones_like(outs[0][1])
+            keys = []
+            for (d, v), mode in zip(outs, modes):
+                keys.append(_as_int64_key(d, mode))
+                valid = valid & v
+            # all hashes are "in range"; false candidates are removed by
+            # the exact verification filter after expansion
+            return _hash_combine_device(keys), valid, jnp.ones_like(valid)
         packed = jnp.zeros_like(outs[0][0], dtype=jnp.int64)
         valid = jnp.ones_like(outs[0][1])
         in_range = jnp.ones_like(outs[0][1])
@@ -224,9 +281,12 @@ class HashJoinExec(Executor):
             self._expand_fn = self._make_expand_fn()
             self._filter_fns = {}
         start, count, ok = self._probe_fn(chunk)
+        # hash-packed keys need exact re-verification of every candidate
+        # row, so they take the same filtered paths as other_cond
+        has_filter = self.other_cond is not None or self._hash_mode
 
         if self.kind in ("semi", "anti"):
-            if self.other_cond is None:
+            if not has_filter:
                 matched = count > 0
             else:
                 matched = self._qualified_matches(chunk, start, count)
@@ -244,7 +304,7 @@ class HashJoinExec(Executor):
             return
 
         real_count = count
-        left_other = self.kind == "left" and self.other_cond is not None
+        left_other = self.kind == "left" and has_filter
         if self.kind == "left" and not left_other:
             count = jnp.where(chunk.sel, jnp.maximum(count, 1), 0)
 
@@ -254,15 +314,16 @@ class HashJoinExec(Executor):
         matched = np.zeros(chunk.capacity, dtype=np.bool_) if left_other else None
         for w in range(0, total, cap):
             out = self._expand_fn(chunk, start, count, real_count, cum, jnp.int64(w))
-            if self.other_cond is not None:
-                out = self._other_filter(out)
+            if has_filter:
+                out = self._match_filter(out)
                 if left_other:
                     sel = np.asarray(out.sel)
                     rows = np.asarray(out.columns["__probe_row__"].data)[sel]
                     matched[rows] = True
-                # bookkeeping column stays internal to the match tracking
+                # bookkeeping columns stay internal to the match tracking
                 out = Chunk(
-                    {u: c for u, c in out.columns.items() if u != "__probe_row__"},
+                    {u: c for u, c in out.columns.items()
+                     if u not in ("__probe_row__", "__build_pos__")},
                     out.sel,
                 )
             self._pending.append(out)
@@ -283,17 +344,35 @@ class HashJoinExec(Executor):
         cap = self.ctx.chunk_capacity
         for w in range(0, total, cap):
             out = self._expand_fn(chunk, start, count, count, cum, jnp.int64(w))
-            out = self._other_filter(out)
+            out = self._match_filter(out)
             sel = np.asarray(out.sel)
             rows = np.asarray(out.columns["__probe_row__"].data)[sel]
             matched[rows] = True
         return jnp.asarray(matched)
 
-    def _other_filter(self, out: Chunk) -> Chunk:
-        if "oc" not in self._filter_fns:
-            pred = compile_predicate(self.other_cond)
-            self._filter_fns["oc"] = jax.jit(lambda ch: ch.filter(pred(ch)))
-        return self._filter_fns["oc"](out)
+    def _match_filter(self, out: Chunk) -> Chunk:
+        """Filter expanded candidate rows: exact key equality when the
+        keys were hash-packed, then other_cond if present."""
+        if "mf" not in self._filter_fns:
+            other = compile_predicate(self.other_cond) if self.other_cond is not None else None
+            hash_mode = self._hash_mode
+            probe_keys = self.probe_keys
+            modes = self._pack_info[0][1] if hash_mode else ()
+            keyvals = getattr(self, "_build_keyvals_sorted", ())
+
+            def fn(ch):
+                keep = ch.sel
+                if hash_mode:
+                    pos = ch.columns["__build_pos__"].data
+                    for k_ir, mode, bv in zip(probe_keys, modes, keyvals):
+                        pv = _as_int64_key(eval_expr(k_ir, ch)[0], mode)
+                        keep = keep & (jnp.take(bv, pos, mode="clip") == pv)
+                if other is not None:
+                    keep = keep & other(ch)
+                return ch.with_sel(keep)
+
+            self._filter_fns["mf"] = jax.jit(fn)
+        return self._filter_fns["mf"](out)
 
     def _null_build_chunk(self, chunk: Chunk, sel) -> Chunk:
         """Probe columns pass through; build payload is all-NULL."""
@@ -314,9 +393,10 @@ class HashJoinExec(Executor):
         kind = self.kind
         n_build = max(self._n_build, 1)
         cap = self.ctx.chunk_capacity
-        # only the other_cond match-tracking reads the origin-row column;
-        # don't make the hot inner-join path carry it
-        with_probe_row = self.other_cond is not None
+        # only the match-filter path reads the bookkeeping columns;
+        # don't make the hot inner-join path carry them
+        with_probe_row = self.other_cond is not None or self._hash_mode
+        with_build_pos = self._hash_mode
 
         def expand(chunk, start, count, real_count, cum, w):
             j = jnp.arange(cap, dtype=jnp.int64) + w
@@ -333,6 +413,8 @@ class HashJoinExec(Executor):
                 cols[uid] = col.gather(probe_row, valid_out)
             if with_probe_row:
                 cols["__probe_row__"] = Column(probe_row, valid_out, INT64)
+            if with_build_pos:
+                cols["__build_pos__"] = Column(build_pos, valid_out, INT64)
             # left join emits one slot even for unmatched probe rows; the
             # build payload is NULL there (k beyond the real match count)
             real = k < real_count[probe_row]

@@ -21,6 +21,7 @@ that are sliced off.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -28,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["segment_count", "segment_sum_f32", "pallas_enabled",
-           "set_pallas_enabled", "xla_segment_sum"]
+           "set_pallas_enabled", "xla_segment_sum", "force_platform"]
 
 _TILE = 1024
 _MAX_PALLAS_G = 8192  # above this the [TILE, G] one-hot exceeds VMEM budget
@@ -41,13 +42,44 @@ def set_pallas_enabled(v: bool | None) -> None:
     _enabled = v
 
 
+_forced_platform: str | None = None
+
+
+@contextlib.contextmanager
+def force_platform(p: str):
+    """Pin the Pallas target platform for the duration of a call. Mesh
+    fragments are traced while the executor glue has jax.default_device
+    pinned to host CPU (utils/device.py host_eager), yet they execute on
+    the mesh's devices — the fragment runner wraps each dispatch in
+    force_platform(mesh_platform) so kernels pick the right mode."""
+    global _forced_platform
+    prev, _forced_platform = _forced_platform, p
+    try:
+        yield
+    finally:
+        _forced_platform = prev
+
+
+def _target_platform() -> str:
+    """Platform the *current* computation lands on: an explicit
+    force_platform() wins (mesh fragments), then the pinned default
+    device (host-eager glue), then the default backend. The backend name
+    alone is wrong in both pinned cases."""
+    if _forced_platform is not None:
+        return _forced_platform
+    d = jax.config.jax_default_device
+    if d is not None:
+        return d.platform
+    try:
+        return jax.default_backend()
+    except RuntimeError:  # pragma: no cover
+        return "cpu"
+
+
 def pallas_enabled() -> bool:
     if _enabled is not None:
         return _enabled
-    try:
-        return jax.default_backend() == "tpu"
-    except RuntimeError:  # pragma: no cover
-        return False
+    return _target_platform() == "tpu"
 
 
 def xla_segment_sum(vals: jax.Array, seg: jax.Array, G: int) -> jax.Array:
@@ -113,7 +145,7 @@ def _pallas_segsum_f32(vals: jax.Array, seg: jax.Array, G: int, Gp: int) -> jax.
                                    memory_space=pltpu.VMEM),
             # off-TPU (tests force-enable) the interpreter runs the same
             # kernel logic, so CPU CI covers the Pallas path too
-            interpret=jax.default_backend() != "tpu",
+            interpret=_target_platform() != "tpu",
         )(vals2, seg2)
     return out[0, :G]
 

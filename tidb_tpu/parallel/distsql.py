@@ -49,6 +49,20 @@ _AXES = (dcn_axis, shard_axis)
 _SPEC = P(_AXES, None)
 
 
+def pmax_compat(v: jax.Array, axes=_AXES) -> jax.Array:
+    """lax.pmax via all_gather + local max. The TPU backend here (axon
+    TpuAotCompiler) lowers only Sum all-reduces — pmax/pmin fail to
+    compile — while AllGather/AllToAll/CollectivePermute all work. The
+    merged states are small ([G] or scalars), so the extra gather bytes
+    are noise."""
+    return jnp.max(jax.lax.all_gather(v, axes), axis=0)
+
+
+def pmin_compat(v: jax.Array, axes=_AXES) -> jax.Array:
+    """See pmax_compat."""
+    return jnp.min(jax.lax.all_gather(v, axes), axis=0)
+
+
 def merge_state(state: Dict[str, jax.Array], axes=_AXES) -> Dict[str, jax.Array]:
     """Merge per-shard partial agg states across mesh axes (final-agg step)."""
     out = {}
@@ -57,9 +71,9 @@ def merge_state(state: Dict[str, jax.Array], axes=_AXES) -> Dict[str, jax.Array]
         if op == "sum":
             out[k] = jax.lax.psum(v, axes)
         elif op == "min":
-            out[k] = jax.lax.pmin(v, axes)
+            out[k] = pmin_compat(v, axes)
         elif op == "max":
-            out[k] = jax.lax.pmax(v, axes)
+            out[k] = pmax_compat(v, axes)
         else:
             raise ValueError(f"unknown merge op {op}")
     return out

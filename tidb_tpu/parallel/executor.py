@@ -92,7 +92,19 @@ class ShardCache:
         self._cache.pop(id(table), None)
 
     def get_fragment(self, key, build):
-        return get_or_build(self.fragments, key, build, self.MAX_FRAGMENTS)
+        fn = get_or_build(self.fragments, key, build, self.MAX_FRAGMENTS)
+        # fragments trace lazily on first call, under the glue's
+        # host-CPU default-device pin — pin the Pallas target to the
+        # mesh's real platform for every dispatch (ops.force_platform)
+        from tidb_tpu.ops import force_platform
+
+        platform = self.mesh.devices.flat[0].platform
+
+        def dispatch(*args):
+            with force_platform(platform):
+                return fn(*args)
+
+        return dispatch
 
     def get_growth(self, gkey) -> float:
         g = self.growth.get(gkey)

@@ -50,7 +50,7 @@ from tidb_tpu.executor.aggregate import make_segment_kernel
 from tidb_tpu.executor.builder import peel_stages, scan_stages_for
 from tidb_tpu.executor.scan import make_pipeline_fn
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
-from tidb_tpu.parallel.distsql import merge_state, repartition_by_key
+from tidb_tpu.parallel.distsql import merge_state, pmax_compat, repartition_by_key
 from tidb_tpu.parallel.mesh import dcn_axis, shard_axis
 from tidb_tpu.planner.physical import PHashAgg, PHashJoin, PScan
 from tidb_tpu.types import TypeKind
@@ -326,7 +326,7 @@ class _Compiler:
             capJ = int(np.ceil(growth_j * Rp))
             # required-factor-minus-one, maxed over shards (0 = fits)
             factor = (total + capJ - 1) // capJ
-            ovfs.append(jax.lax.pmax(jnp.maximum(factor - 1, 0), _AXES))
+            ovfs.append(pmax_compat(jnp.maximum(factor - 1, 0), _AXES))
 
             j = jnp.arange(capJ, dtype=jnp.int64)
             valid_out = j < total

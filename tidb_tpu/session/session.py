@@ -179,6 +179,15 @@ class Session:
 
         from tidb_tpu.utils import metrics as M
 
+        # a DECIDED txn whose commit crashed mid-secondaries leaves rows
+        # invisible behind marker timestamps; readers resolve such locks
+        # at the statement boundary (the reference's reader-side
+        # resolve-lock flow) — commits run under the catalog statement
+        # lock, so a pending status here always means a crashed txn.
+        # Lives here (not execute()) so prepared-statement execution
+        # gets the same guarantee.
+        if self.catalog.has_stale_txns():
+            self.catalog.resolve_locks()
         stype = type(stmt).__name__.removesuffix("Stmt").lower()
         prof_dir = str(self.sysvars.get("tidb_profile_dir"))
         ctx = contextlib.nullcontext()
@@ -665,6 +674,14 @@ class Session:
         data = np.concatenate(datas)[ids]
         valid = np.concatenate(valids)[ids]
         k = col.type_.kind
+        if k == TypeKind.STRING:
+            # string exprs evaluate to dictionary codes; decode host-side
+            # (update_rows re-encodes into the column's own dictionary)
+            d = getattr(bound, "_dict", None)
+            if d is None:
+                raise UnsupportedError(
+                    "UPDATE string expression without a dictionary context")
+            return d.decode(data, valid)
         out = []
         for d, v in zip(data, valid):
             if not v:
@@ -676,8 +693,6 @@ class Session:
             elif k == TypeKind.DECIMAL:
                 src_scale = bound.type_.scale if bound.type_.kind == TypeKind.DECIMAL else 0
                 out.append(int(d) / (10 ** src_scale) if src_scale else int(d))
-            elif k == TypeKind.STRING:
-                raise UnsupportedError("UPDATE of string columns from expressions not supported yet")
             else:
                 out.append(d.item())
         return out

@@ -105,6 +105,11 @@ class Catalog:
     def txn_status(self, marker: int):
         return self._txn_status.get(marker)
 
+    def has_stale_txns(self) -> bool:
+        """Any decided txn with possibly-unapplied residue? (O(1) —
+        status records are dropped in finish_txn on the success path.)"""
+        return bool(self._txn_status)
+
     def resolve_locks(self) -> int:
         """Finish crashed commits/aborts (the resolve-lock flow): any
         marker with a recorded decision but unapplied table residue gets

@@ -461,8 +461,12 @@ class Table:
         else:
             b = self.begin_ts[: self.n]
             e = self.end_ts[: self.n]
-            b[b == marker] = commit_ts
-            e[e == marker] = commit_ts
+            bm = b == marker
+            em = e == marker
+            if not bm.any() and not em.any():
+                return  # no residue here: don't invalidate caches
+            b[bm] = commit_ts
+            e[em] = commit_ts
         self.version += 1
         if log is not None and not log.ended:
             # a pure-insert commit doesn't change the present key set:
@@ -490,9 +494,14 @@ class Table:
             b = self.begin_ts[: self.n]
             e = self.end_ts[: self.n]
             dead = b == marker
+            # rows both inserted and deleted by this txn must end dead:
+            # only restore provisional deletes of rows we didn't insert
+            em = (e == marker) & ~dead
+            if not dead.any() and not em.any():
+                return  # no residue here: don't invalidate caches
             e[dead] = 0
             b[dead] = 0
-            e[e == marker] = MAX_TS
+            e[em] = MAX_TS
         self.version += 1
 
     # -- DDL ---------------------------------------------------------------

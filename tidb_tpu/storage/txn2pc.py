@@ -54,7 +54,9 @@ class TwoPhaseCommitter:
             if len(ids) == 0:
                 continue
             e_ = table.end_ts[np.asarray(ids)]
-            theirs = (e_ != self.marker) & (e_ < (1 << 62))  # not ours, not open
+            from tidb_tpu.storage.table import MAX_TS
+
+            theirs = (e_ != self.marker) & (e_ < MAX_TS)  # not ours, not open
             if theirs.any():
                 raise ExecutionError(
                     f"prewrite conflict on {table.schema.name!r}: "
