@@ -1,0 +1,153 @@
+"""Scalar function library breadth (ref: expression/ — the reference's
+builtin_* families; VERDICT row 8 "function library is TPC-H-sized").
+
+MySQL-semantics expectations are hard-coded (sqlite lacks most of these
+functions); string functions run through the dictionary-LUT design, date
+arithmetic through the device civil-calendar ops."""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(chunk_capacity=256)
+    s.execute(
+        "create table t (id bigint primary key, a bigint, b bigint, f double,"
+        " d date, dt datetime, s1 varchar(10), s2 varchar(10))"
+    )
+    s.execute(
+        "insert into t values"
+        " (1, 12, 10, 2.5, '2024-03-15', '2024-03-15 13:45:30', 'ab', 'xy'),"
+        " (2, -7, 3, -1.5, '2023-12-31', '2023-12-31 23:59:59', 'cd', 'zw'),"
+        " (3, null, 5, null, null, null, null, 'q')"
+    )
+    return s
+
+
+def q(s, sql):
+    rows = s.query(sql)
+    return [tuple(str(x) if hasattr(x, "isoformat") else x for x in r) for r in rows]
+
+
+class TestBitwise:
+    def test_ops(self, sess):
+        assert q(sess, "select a & b, a | b, a ^ b, a << 1, a >> 1, ~a"
+                       " from t where id = 1") == [(8, 14, 6, 24, 6, -13)]
+
+    def test_null_propagates(self, sess):
+        assert q(sess, "select a & b from t where id = 3") == [(None,)]
+
+    def test_precedence(self, sess):
+        # ^ binds tighter than *: 2 * 3 ^ 1 = 2 * (3 ^ 1) = 4
+        assert q(sess, "select 2 * 3 ^ 1 from t where id = 1") == [(4,)]
+
+
+class TestGreatestLeast:
+    def test_basic(self, sess):
+        assert q(sess, "select greatest(a, b, 11), least(a, b, 11)"
+                       " from t where id = 1") == [(12, 10)]
+
+    def test_strict_null(self, sess):
+        assert q(sess, "select greatest(a, b) from t where id = 3") == [(None,)]
+
+    def test_mixed_float(self, sess):
+        assert q(sess, "select greatest(a, f) from t where id = 1") == [(12.0,)]
+
+
+class TestTemporal:
+    def test_extracts(self, sess):
+        assert q(sess, "select quarter(d), dayofweek(d), weekday(d), dayofyear(d)"
+                       " from t where id = 1") == [(1, 6, 4, 75)]
+
+    def test_time_parts(self, sess):
+        assert q(sess, "select hour(dt), minute(dt), second(dt)"
+                       " from t where id = 1") == [(13, 45, 30)]
+
+    def test_extract_syntax(self, sess):
+        assert q(sess, "select extract(quarter from d), extract(hour from dt)"
+                       " from t where id = 1") == [(1, 13)]
+
+    def test_date_add_family(self, sess):
+        assert q(sess, "select date_add(d, interval 1 month),"
+                       " date_sub(d, interval 2 day) from t where id = 1") == \
+            [("2024-04-15", "2024-03-13")]
+
+    def test_month_clamp(self, sess):
+        # adding a month to Jan 31 clamps to the leap-year Feb 29
+        assert q(sess, "select date_add(date '2024-01-31', interval 1 month)") == \
+            [("2024-02-29",)]
+
+    def test_column_month_year(self, sess):
+        assert q(sess, "select d + interval 3 month, d + interval 1 year"
+                       " from t where id = 2") == [("2024-03-31", "2024-12-31")]
+
+    def test_datetime_intervals(self, sess):
+        assert q(sess, "select dt + interval 2 hour, dt + interval 1 month"
+                       " from t where id = 2") == \
+            [("2024-01-01 01:59:59", "2024-01-31 23:59:59")]
+
+    def test_adddate_days_shorthand(self, sess):
+        assert q(sess, "select adddate(d, 10) from t where id = 1") == \
+            [("2024-03-25",)]
+
+
+class TestStringFuncs:
+    def test_concat_columns(self, sess):
+        assert q(sess, "select concat(s1, '-', s2) from t order by id") == \
+            [("ab-xy",), ("cd-zw",), (None,)]
+
+    def test_concat_literal_first(self, sess):
+        assert q(sess, "select concat('pre', s2, s1) from t where id = 2") == \
+            [("prezwcd",)]
+
+    def test_concat_in_predicate(self, sess):
+        assert q(sess, "select id from t where concat(s1, s2) = 'cdzw'") == [(2,)]
+
+    def test_pad_repeat(self, sess):
+        assert q(sess, "select lpad(s1, 5, '*'), rpad(s1, 4, '.'), repeat(s1, 2)"
+                       " from t where id = 1") == [("***ab", "ab..", "abab")]
+
+    def test_ascii_instr_locate(self, sess):
+        assert q(sess, "select ascii(s1), instr(s2, 'y'), locate('d', s1)"
+                       " from t where id = 1 or id = 2 order by id") == \
+            [(97, 2, 0), (99, 0, 2)]
+
+    def test_cast_string_identity(self, sess):
+        assert q(sess, "select cast(s1 as char) from t where id = 1") == [("ab",)]
+        assert q(sess, "select cast(123 as char), cast(date '2024-01-02' as char)") == \
+            [("123", "2024-01-02")]
+
+
+class TestMath:
+    def test_sign(self, sess):
+        assert q(sess, "select sign(a), sign(f) from t where id = 2") == [(-1, -1)]
+
+    def test_trig(self, sess):
+        assert q(sess, "select round(degrees(pi()), 3), round(atan2(1, 1), 4)"
+                       " from t where id = 1") == [(180.0, 0.7854)]
+
+
+class TestReviewRegressions:
+    """Fixes from review: bitwise coercion, 3-arg LOCATE, string
+    GREATEST/LEAST via union dictionaries, DATETIME CAST to CHAR."""
+
+    def test_bitwise_decimal_rounds(self, sess):
+        s2 = Session(chunk_capacity=64)
+        s2.execute("create table bd (p decimal(10,2), f double)")
+        s2.execute("insert into bd values (1.00, 3.6)")
+        assert s2.query("select p & 1, f & 7 from bd") == [(1, 4)]
+
+    def test_locate_with_position(self, sess):
+        assert q(sess, "select locate('a', 'banana', 3)") == [(4,)]
+        assert q(sess, "select instr('banana', 'a', 3)") == [(4,)]
+
+    def test_greatest_strings_union_dicts(self, sess):
+        assert q(sess, "select greatest(s1, s2), least(s1, s2)"
+                       " from t where id = 1") == [("xy", "ab")]
+        assert q(sess, "select greatest(s1, 'zz') from t where id = 2") == [("zz",)]
+
+    def test_cast_datetime_literal(self, sess):
+        assert q(sess, "select cast(timestamp '1999-01-01 12:00:00' as char)") == \
+            [("1999-01-01 12:00:00",)]

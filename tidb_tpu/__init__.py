@@ -26,10 +26,23 @@ Design rules (TPU-first):
   * no data-dependent Python control flow under jit
 """
 
+import os
+
 import jax
 
 # 64-bit types are required for decimal (scaled int64) and SUM accumulators.
 # Must run before any jnp array is created anywhere in the package.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: fragment compiles on the tunneled TPU
+# backend here run through a remote AOT helper at ~60s+ per program, so
+# re-compiling known shapes across processes (tests, bench, server
+# restarts) is the single largest latency source. Degrades gracefully if
+# the backend can't serialize executables.
+_cache_dir = os.environ.get("TIDB_TPU_COMPILE_CACHE",
+                            os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+if _cache_dir != "0":
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 __version__ = "0.1.0"

@@ -385,10 +385,98 @@ def _temporal_extract(which):
         d, v = eval_expr(a, chunk)
         days = _days(d, a.type_)
         y, m, dd = dates.civil_from_days(days)
-        out = {"year": y, "month": m, "day": dd}[which]
+        if which == "quarter":
+            out = (m - 1) // 3 + 1
+        elif which == "dayofweek":
+            # 1970-01-01 was a Thursday; MySQL: 1=Sunday .. 7=Saturday
+            out = (days + 4) % 7 + 1
+        elif which == "weekday":
+            # MySQL WEEKDAY(): 0=Monday .. 6=Sunday
+            out = (days + 3) % 7
+        elif which == "dayofyear":
+            out = days - dates.days_from_civil(y, jnp.ones_like(m), jnp.ones_like(dd)) + 1
+        else:
+            out = {"year": y, "month": m, "day": dd}[which]
         return out.astype(jnp.int64), v
 
     return fn
+
+
+def _time_extract(which):
+    """HOUR/MINUTE/SECOND/MICROSECOND over DATETIME micros (0 for DATE)."""
+
+    def fn(e: Call, chunk) -> Pair:
+        a = e.args[0]
+        d, v = eval_expr(a, chunk)
+        if a.type_.kind != TypeKind.DATETIME:
+            return jnp.zeros_like(d, dtype=jnp.int64), v
+        micros = d.astype(jnp.int64)
+        div, mod_ = {
+            "hour": (3_600_000_000, 24),
+            "minute": (60_000_000, 60),
+            "second": (1_000_000, 60),
+            "microsecond": (1, 1_000_000),
+        }[which]
+        out = jnp.floor_divide(micros, div) % mod_
+        return out, v
+
+    return fn
+
+
+def _add_months(e: Call, chunk) -> Pair:
+    """date/datetime + N months with end-of-month clamping (the device
+    path for +/- INTERVAL MONTH/QUARTER/YEAR on column dates)."""
+    a, n_lit = e.args
+    d, v = eval_expr(a, chunk)
+    n = jnp.int64(int(n_lit.value))
+    if a.type_.kind == TypeKind.DATETIME:
+        micros = d.astype(jnp.int64)
+        days = jnp.floor_divide(micros, 86_400_000_000)
+        tod = micros - days * 86_400_000_000
+    else:
+        days = d.astype(jnp.int64)
+        tod = None
+    y, m, dd = dates.civil_from_days(days)
+    total = y * 12 + (m - 1) + n
+    ny = jnp.floor_divide(total, 12)
+    nm = total - ny * 12 + 1
+    month_start = dates.days_from_civil(ny, nm, jnp.ones_like(dd))
+    next_start = dates.days_from_civil(
+        jnp.where(nm == 12, ny + 1, ny), jnp.where(nm == 12, 1, nm + 1), jnp.ones_like(dd))
+    dd = jnp.minimum(dd, next_start - month_start)
+    out_days = month_start + dd - 1
+    if tod is not None:
+        return out_days * 86_400_000_000 + tod, v
+    return out_days, v
+
+
+def _nary_extreme(pick):
+    """GREATEST/LEAST: strict (NULL if any arg NULL), over the common
+    type the binder computed for the Call."""
+
+    def fn(e: Call, chunk) -> Pair:
+        rt = e.type_
+        acc_d = acc_v = None
+        for a in e.args:
+            d, v = eval_expr(a, chunk)
+            if rt.kind == TypeKind.DECIMAL and a.type_.kind == TypeKind.DECIMAL:
+                d = _rescale(d, a.type_.scale, rt.scale)
+            elif rt.kind == TypeKind.DECIMAL:
+                d = d.astype(jnp.int64) * 10**rt.scale
+            elif rt.kind == TypeKind.FLOAT:
+                d = _to_kind(d, a.type_, rt)
+            if acc_d is None:
+                acc_d, acc_v = d, v
+            else:
+                acc_d, acc_v = pick(acc_d, d), acc_v & v
+        return acc_d, acc_v
+
+    return fn
+
+
+def _sign(e: Call, chunk) -> Pair:
+    d, v = eval_expr(e.args[0], chunk)
+    return jnp.sign(d).astype(jnp.int64), v
 
 
 def _round(e: Call, chunk) -> Pair:
@@ -449,4 +537,29 @@ FUNCS = {
     "year": _temporal_extract("year"),
     "month": _temporal_extract("month"),
     "day": _temporal_extract("day"),
+    "quarter": _temporal_extract("quarter"),
+    "dayofweek": _temporal_extract("dayofweek"),
+    "weekday": _temporal_extract("weekday"),
+    "dayofyear": _temporal_extract("dayofyear"),
+    "hour": _time_extract("hour"),
+    "minute": _time_extract("minute"),
+    "second": _time_extract("second"),
+    "microsecond": _time_extract("microsecond"),
+    "add_months": _add_months,
+    "greatest": _nary_extreme(jnp.maximum),
+    "least": _nary_extreme(jnp.minimum),
+    "sign": _sign,
+    "tan": _strict1(jnp.tan, cast_float=True),
+    "atan": _strict1(jnp.arctan, cast_float=True),
+    "asin": _strict1(jnp.arcsin, cast_float=True),
+    "acos": _strict1(jnp.arccos, cast_float=True),
+    "atan2": _strict2(jnp.arctan2),
+    "radians": _strict1(jnp.radians, cast_float=True),
+    "degrees": _strict1(jnp.degrees, cast_float=True),
+    "bitand": _strict2(jnp.bitwise_and),
+    "bitor": _strict2(jnp.bitwise_or),
+    "bitxor": _strict2(jnp.bitwise_xor),
+    "shl": _strict2(jnp.left_shift),
+    "shr": _strict2(jnp.right_shift),
+    "bitnot": _strict1(jnp.bitwise_not),
 }

@@ -46,7 +46,7 @@ class Token:
         return f"{self.kind}:{self.text}"
 
 
-_TWO_CHAR = {"<=", ">=", "<>", "!=", "||", "&&", ":=", "->"}
+_TWO_CHAR = {"<=", ">=", "<>", "!=", "||", "&&", ":=", "->", "<<", ">>"}
 _THREE_CHAR = {"<=>"}
 _SINGLE = set("+-*/%(),.;=<>!@&|^~?")
 

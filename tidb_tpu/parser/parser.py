@@ -787,10 +787,17 @@ class Parser:
         return left
 
     def parse_bitand(self):
-        left = self.parse_additive()
+        left = self.parse_shift()
         while self.at_op("&"):
             self.next()
-            left = EBinary("&", left, self.parse_additive())
+            left = EBinary("&", left, self.parse_shift())
+        return left
+
+    def parse_shift(self):
+        left = self.parse_additive()
+        while self.at_op("<<", ">>"):
+            op = self.next().text
+            left = EBinary(op, left, self.parse_additive())
         return left
 
     def parse_additive(self):
@@ -801,16 +808,24 @@ class Parser:
         return left
 
     def parse_multiplicative(self):
-        left = self.parse_unary()
+        left = self.parse_bitxor()
         while True:
             if self.at_op("*", "/", "%"):
                 op = self.next().text
-                left = EBinary({"%": "mod"}.get(op, op), left, self.parse_unary())
+                left = EBinary({"%": "mod"}.get(op, op), left, self.parse_bitxor())
             elif self.peek().kind == "IDENT" and self.peek().text.lower() in ("div", "mod"):
                 op = self.next().text.lower()
-                left = EBinary(op, left, self.parse_unary())
+                left = EBinary(op, left, self.parse_bitxor())
             else:
                 return left
+
+    def parse_bitxor(self):
+        # MySQL: ^ binds tighter than * /
+        left = self.parse_unary()
+        while self.at_op("^"):
+            self.next()
+            left = EBinary("^", left, self.parse_unary())
+        return left
 
     def parse_unary(self):
         if self.at_op("-"):

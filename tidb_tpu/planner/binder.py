@@ -238,7 +238,28 @@ class Binder:
             arg = self.bind_expr(e.arg, scope)
             to = parse_type_name(e.type_name, e.type_args)
             if to.kind == TypeKind.STRING:
-                raise UnsupportedError("CAST to string not supported yet")
+                if arg.type_.kind == TypeKind.STRING:
+                    return arg  # dict codes pass through unchanged
+                if isinstance(arg, Literal) and arg.value is not None:
+                    k = arg.type_.kind
+                    if k == TypeKind.DATE:
+                        days = int(arg.value)
+                        v = str(datetime.date(1970, 1, 1) + datetime.timedelta(days=days))
+                    elif k == TypeKind.DATETIME:
+                        micros = int(arg.value)
+                        v = str(datetime.datetime(1970, 1, 1)
+                                + datetime.timedelta(microseconds=micros))
+                    elif k == TypeKind.DECIMAL:
+                        sc = arg.type_.scale
+                        v = f"{int(arg.value) / 10**sc:.{sc}f}" if sc else str(int(arg.value))
+                    elif k == TypeKind.INT:
+                        v = str(int(arg.value))
+                    else:
+                        v = str(arg.value)
+                    return Literal(type_=STRING, value=v)
+                raise UnsupportedError(
+                    "CAST of a non-string column to CHAR (unbounded value "
+                    "set has no plan-time dictionary)")
             arg = self.coerce_untyped_literal(arg, to)
             return Cast(type_=to, arg=arg)
 
@@ -273,7 +294,8 @@ class Binder:
                 return Literal(type_=arg.type_, value=-arg.value)
             return Call(type_=arg.type_, op="neg", args=(arg,))
         if e.op == "~":
-            raise UnsupportedError("bitwise ~ not supported yet")
+            arg = self.bind_expr(e.arg, scope)
+            return Call(type_=INT64, op="bitnot", args=(self._to_int64(arg, "~"),))
         raise PlanError(f"unknown unary op {e.op}")
 
     def to_bool(self, arg: Expr) -> Expr:
@@ -308,9 +330,22 @@ class Binder:
         if op in ("+", "-", "*", "/", "div", "mod", "%"):
             return self.bind_arith(op, l, r)
 
-        if op in ("|", "&"):
-            raise UnsupportedError(f"bitwise {op} not supported yet")
+        if op in ("|", "&", "^", "<<", ">>"):
+            bop = {"|": "bitor", "&": "bitand", "^": "bitxor",
+                   "<<": "shl", ">>": "shr"}[op]
+            return Call(type_=INT64, op=bop,
+                        args=(self._to_int64(l, op), self._to_int64(r, op)))
         raise PlanError(f"unknown binary op {op}")
+
+    def _to_int64(self, e: Expr, op: str) -> Expr:
+        """Bitwise operands: MySQL converts to BIGINT by rounding."""
+        k = e.type_.kind
+        if k in (TypeKind.INT, TypeKind.BOOL):
+            return e
+        if k in (TypeKind.DECIMAL, TypeKind.FLOAT):
+            # Cast's kind conversion rounds half-away-from-zero (MySQL)
+            return Cast(type_=INT64, arg=e)
+        raise PlanError(f"bitwise {op} needs numeric operands")
 
     def bind_interval_arith(self, op: str, date_ast, interval: A.EInterval, scope: Scope) -> Expr:
         base = self.bind_expr(date_ast, scope)
@@ -322,6 +357,7 @@ class Binder:
         if op == "-":
             amount = -amount
         unit = interval.unit
+        months = {"month": 1, "quarter": 3, "year": 12}
         if base.type_.kind == TypeKind.DATE:
             if isinstance(base, Literal):
                 d = datetime.date.fromordinal(
@@ -332,8 +368,22 @@ class Binder:
                 return Call(type_=DATE, op="add", args=(base, Literal(type_=DATE, value=amount)))
             if unit == "week":
                 return Call(type_=DATE, op="add", args=(base, Literal(type_=DATE, value=amount * 7)))
+            if unit in months:
+                return Call(type_=DATE, op="add_months",
+                            args=(base, Literal(type_=INT64, value=amount * months[unit])))
             raise UnsupportedError(f"INTERVAL {unit} on non-constant date")
-        raise UnsupportedError("INTERVAL on datetime expressions not supported yet")
+        if base.type_.kind == TypeKind.DATETIME:
+            micros = {"day": 86_400_000_000, "week": 7 * 86_400_000_000,
+                      "hour": 3_600_000_000, "minute": 60_000_000,
+                      "second": 1_000_000, "microsecond": 1}
+            if unit in micros:
+                return Call(type_=DATETIME, op="add",
+                            args=(base, Literal(type_=DATETIME, value=amount * micros[unit])))
+            if unit in months:
+                return Call(type_=DATETIME, op="add_months",
+                            args=(base, Literal(type_=INT64, value=amount * months[unit])))
+            raise UnsupportedError(f"INTERVAL {unit} on datetime expressions")
+        raise UnsupportedError("INTERVAL arithmetic needs a date/datetime operand")
 
     # -- comparisons ----------------------------------------------------
 
@@ -607,6 +657,13 @@ class Binder:
                 f"aggregate function {name.upper()} not allowed in this context"
             )
 
+        if name in ("date_add", "adddate", "date_sub", "subdate") and len(e.args) == 2:
+            iv = e.args[1]
+            if not isinstance(iv, A.EInterval):
+                iv = A.EInterval(iv, "day")  # ADDDATE(d, n) = n days
+            op = "-" if name in ("date_sub", "subdate") else "+"
+            return self.bind_interval_arith(op, e.args[0], iv, scope)
+
         if name in ("date",) and len(e.args) == 1 and isinstance(e.args[0], A.EStr):
             return Literal(type_=DATE, value=self.parse_date_literal(e.args[0].value))
         if name in ("timestamp", "datetime") and len(e.args) == 1 and isinstance(e.args[0], A.EStr):
@@ -632,7 +689,8 @@ class Binder:
                 rt = common_type(rt, a.type_)
             return Call(type_=rt, op="coalesce", args=tuple(args))
 
-        if name in ("year", "month", "day", "dayofmonth"):
+        if name in ("year", "month", "day", "dayofmonth", "quarter",
+                    "dayofweek", "weekday", "dayofyear"):
             op = {"dayofmonth": "day"}.get(name, name)
             a = self.coerce_untyped_literal(args[0], DATE)
             if not a.type_.is_temporal:
@@ -642,14 +700,34 @@ class Binder:
                 if a.type_.kind == TypeKind.DATETIME:
                     days = days // 86_400_000_000  # micros -> days
                 d = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
-                return Literal(type_=INT64, value={"year": d.year, "month": d.month, "day": d.day}[op])
+                iso = d.isoweekday()  # 1=Mon .. 7=Sun
+                val = {
+                    "year": d.year, "month": d.month, "day": d.day,
+                    "quarter": (d.month - 1) // 3 + 1,
+                    "dayofweek": iso % 7 + 1,  # MySQL: 1=Sun .. 7=Sat
+                    "weekday": iso - 1,        # MySQL: 0=Mon .. 6=Sun
+                    "dayofyear": d.timetuple().tm_yday,
+                }[op]
+                return Literal(type_=INT64, value=val)
             return Call(type_=INT64, op=op, args=(a,))
+        if name in ("hour", "minute", "second", "microsecond"):
+            a = self.coerce_untyped_literal(args[0], DATETIME)
+            if not a.type_.is_temporal:
+                raise PlanError(f"{name.upper()} needs a date/datetime argument")
+            if isinstance(a, Literal):
+                micros = int(a.value) if a.type_.kind == TypeKind.DATETIME else 0
+                val = {
+                    "hour": micros // 3_600_000_000 % 24,
+                    "minute": micros // 60_000_000 % 60,
+                    "second": micros // 1_000_000 % 60,
+                    "microsecond": micros % 1_000_000,
+                }[name]
+                return Literal(type_=INT64, value=val)
+            return Call(type_=INT64, op=name, args=(a,))
         if name in ("datediff",):
             a = self.coerce_untyped_literal(args[0], DATE)
             b = self.coerce_untyped_literal(args[1], DATE)
             return Call(type_=INT64, op="sub", args=(a, b))
-        if name in ("date_add", "adddate", "date_sub", "subdate"):
-            raise UnsupportedError(f"{name} — use +/- INTERVAL syntax")
 
         if name in ("abs",):
             return Call(type_=args[0].type_, op="abs", args=tuple(args))
@@ -674,7 +752,26 @@ class Binder:
                 type_=common_type(args[0].type_, args[1].type_), op="mod", args=tuple(args)
             )
         if name in ("greatest", "least"):
-            raise UnsupportedError(f"{name} not supported yet")
+            if len(args) < 2:
+                raise PlanError(f"{name.upper()} needs at least 2 arguments")
+            if any(a.type_.kind == TypeKind.STRING for a in args):
+                return self._bind_extreme_strings(name, args)
+            rt = args[0].type_
+            for a in args[1:]:
+                rt = common_type(rt, a.type_)
+            return Call(type_=rt, op=name, args=tuple(args))
+        if name == "pi" and not args:
+            return Literal(type_=FLOAT64, value=3.141592653589793)
+        if name in ("atan2",) and len(args) == 2:
+            return Call(type_=FLOAT64, op="atan2", args=tuple(args))
+        if name in ("sign",):
+            return Call(type_=INT64, op="sign", args=tuple(args))
+        if name in ("tan", "atan", "asin", "acos", "radians", "degrees"):
+            return Call(type_=FLOAT64, op=name, args=tuple(args))
+
+        if name == "locate" and len(args) >= 2:
+            # LOCATE(substr, str[, pos]) = INSTR(str, substr[, pos])
+            return self.bind_string_func("instr", e, [args[1], args[0]] + args[2:])
 
         # string functions via dictionary LUTs
         if name in _STRING_VALUE_FUNCS:
@@ -683,17 +780,30 @@ class Binder:
         raise UnsupportedError(f"function {name.upper()} not supported yet")
 
     def bind_string_func(self, name: str, e: A.EFunc, args: List[Expr]) -> Expr:
+        if name == "concat":
+            return self._bind_concat(args)
         arg = args[0]
         d = self._dict_of(arg)
         if d is None:
             if isinstance(arg, Literal) and arg.type_.kind == TypeKind.STRING:
                 # fold over the literal host-side
                 val = _apply_string_func(name, str(arg.value), e, args)
-                t = INT64 if name in ("length", "char_length", "character_length") else STRING
+                t = INT64 if name in ("length", "char_length",
+                                      "character_length", "ascii", "instr") else STRING
                 return Literal(type_=t, value=val)
             raise UnsupportedError(f"{name} on dictionary-less string")
         if name in ("length", "char_length", "character_length"):
             lut = d.apply_table(len, np.int64)
+            return Lookup.build(arg, lut, INT64)
+        if name == "ascii":
+            lut = d.apply_table(lambda s: ord(s[0]) if s else 0, np.int64)
+            return Lookup.build(arg, lut, INT64)
+        if name == "instr":
+            if len(args) < 2 or not all(isinstance(a, Literal) for a in args[1:]):
+                raise UnsupportedError("INSTR needs constant arguments")
+            sub = str(args[1].value)
+            start = max(int(args[2].value) - 1, 0) if len(args) > 2 else 0
+            lut = d.apply_table(lambda s: s.find(sub, start) + 1, np.int64)
             return Lookup.build(arg, lut, INT64)
         # string->string: build the target dictionary
         mapped = [_apply_string_func(name, s, e, args) for s in d.values]
@@ -702,11 +812,96 @@ class Binder:
         out = Lookup.build(arg, table, STRING)
         return self.attach_dict(out, nd)
 
+    def _bind_extreme_strings(self, name: str, args: List[Expr]) -> Expr:
+        """GREATEST/LEAST over strings: translate every operand into one
+        union dictionary (codes are sorted-order-preserving, so max/min
+        over union codes is lexicographic max/min)."""
+        union = None
+        for a in args:
+            if isinstance(a, Literal) and a.type_.kind == TypeKind.STRING:
+                d = Dictionary([str(a.value)])
+            else:
+                d = self._dict_of(a)
+                if d is None or a.type_.kind != TypeKind.STRING:
+                    raise UnsupportedError(
+                        f"{name.upper()} mixes strings with non-strings")
+            union = d if union is None else Dictionary.union(union, d)
+        out_args = []
+        for a in args:
+            if isinstance(a, Literal):
+                out_args.append(Literal(type_=STRING, value=union.code_of(str(a.value))))
+            else:
+                d = self._dict_of(a)
+                if d == union:
+                    out_args.append(a)
+                else:
+                    out_args.append(Lookup.build(
+                        a, d.translate_to(union).astype(np.int32), STRING))
+        out = Call(type_=STRING, op=name, args=tuple(out_args))
+        return self.attach_dict(out, union)
+
+    def _bind_concat(self, args: List[Expr]) -> Expr:
+        """CONCAT over any mix of dict-encoded string columns and
+        constants: pack the per-column codes into one dense index
+        (row-major over the dictionary sizes) and gather through a
+        host-built product table. Strict NULL semantics fall out of the
+        packing arithmetic. Bounded by the product of dictionary sizes —
+        the same plan-time-LUT design as LIKE."""
+        import itertools
+
+        parts = []  # ("lit", str) | ("col", (expr, dict))
+        dims = []
+        for a in args:
+            if isinstance(a, Literal):
+                if a.type_.kind == TypeKind.STRING:
+                    parts.append(("lit", str(a.value)))
+                elif a.type_.kind == TypeKind.INT:
+                    parts.append(("lit", str(int(a.value))))
+                else:
+                    raise UnsupportedError("CONCAT of non-string/int constant")
+            else:
+                d = self._dict_of(a)
+                if d is None or a.type_.kind != TypeKind.STRING:
+                    raise UnsupportedError("CONCAT argument without dictionary context")
+                parts.append(("col", (a, d)))
+                dims.append(len(d.values))
+        if not dims:
+            return Literal(type_=STRING, value="".join(v for _, v in parts))
+        total = 1
+        for s in dims:
+            total *= s
+        if total > (1 << 16):
+            raise UnsupportedError(
+                f"CONCAT dictionary product too large ({total} > 65536)")
+        acc = None
+        for kind, v in parts:
+            if kind != "col":
+                continue
+            aexpr, d = v
+            if acc is None:
+                acc = aexpr
+            else:
+                acc = Call(type_=INT64, op="add", args=(
+                    Call(type_=INT64, op="mul",
+                         args=(acc, Literal(type_=INT64, value=len(d.values)))),
+                    aexpr))
+        col_dicts = [v[1] for kind, v in parts if kind == "col"]
+        mapped = []
+        for combo in itertools.product(*[dd.values for dd in col_dicts]):
+            it = iter(combo)
+            mapped.append("".join(v if kind == "lit" else next(it)
+                                  for kind, v in parts))
+        nd = Dictionary(mapped)
+        table = np.array([nd.code_of(m) for m in mapped], dtype=np.int32)
+        out = Lookup.build(acc, table, STRING)
+        return self.attach_dict(out, nd)
+
 
 _STRING_VALUE_FUNCS = {
     "length", "char_length", "character_length", "upper", "ucase", "lower",
     "lcase", "trim", "ltrim", "rtrim", "substring", "substr", "left",
-    "right", "reverse", "concat", "replace",
+    "right", "reverse", "concat", "replace", "lpad", "rpad", "repeat",
+    "ascii", "instr",
 }
 
 
@@ -748,6 +943,26 @@ def _apply_string_func(name: str, s: str, e: A.EFunc, args: List[Expr]) -> str:
         if not all(isinstance(a, Literal) for a in args[1:]):
             raise UnsupportedError("REPLACE needs constant arguments")
         return s.replace(str(args[1].value), str(args[2].value))
+    if name in ("lpad", "rpad"):
+        if not all(isinstance(a, Literal) for a in args[1:]):
+            raise UnsupportedError(f"{name.upper()} needs constant arguments")
+        n = int(args[1].value)
+        pad = str(args[2].value) if len(args) > 2 else " "
+        if len(s) >= n:
+            return s[:n]
+        fill = (pad * n)[: n - len(s)] if pad else ""
+        return fill + s if name == "lpad" else s + fill
+    if name == "repeat":
+        if not isinstance(args[1], Literal):
+            raise UnsupportedError("REPEAT needs a constant count")
+        return s * max(int(args[1].value), 0)
+    if name == "ascii":
+        return ord(s[0]) if s else 0
+    if name == "instr":
+        if len(args) < 2 or not all(isinstance(a, Literal) for a in args[1:]):
+            raise UnsupportedError("INSTR needs constant arguments")
+        start = max(int(args[2].value) - 1, 0) if len(args) > 2 else 0
+        return s.find(str(args[1].value), start) + 1
     raise UnsupportedError(f"string function {name}")
 
 
