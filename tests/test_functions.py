@@ -151,3 +151,11 @@ class TestReviewRegressions:
     def test_cast_datetime_literal(self, sess):
         assert q(sess, "select cast(timestamp '1999-01-01 12:00:00' as char)") == \
             [("1999-01-01 12:00:00",)]
+
+    def test_locate_nonpositive_pos(self, sess):
+        assert q(sess, "select locate('a', 'banana', 0), locate('a', s2, 0)"
+                       " from t where id = 1") == [(0, 0)]
+
+    def test_cast_char_n_truncates(self, sess):
+        assert q(sess, "select cast(s1 as char(1)), cast('abcdef' as char(3))"
+                       " from t where id = 1") == [("a", "abc")]

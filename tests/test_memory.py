@@ -61,8 +61,8 @@ class TestSpillCorrectness:
         orig = s._exec_ctx
         trackers = []
 
-        def tiny_ctx():
-            ctx = orig()
+        def tiny_ctx(**kwargs):
+            ctx = orig(**kwargs)
             ctx.mem_tracker.budget = budget
             trackers.append(ctx.mem_tracker)
             return ctx
@@ -103,8 +103,8 @@ class TestSpillCorrectness:
         s.execute("set tidb_enable_tmp_storage_on_oom = OFF")
         orig = s._exec_ctx
 
-        def tiny_ctx():
-            ctx = orig()
+        def tiny_ctx(**kwargs):
+            ctx = orig(**kwargs)
             ctx.mem_tracker.budget = 1024
             return ctx
 

@@ -1,0 +1,123 @@
+"""Plugin extension points (ref: plugin/ — audit/auth hook enums,
+INSTALL PLUGIN loading, and the alternate-executor-backend hook)."""
+
+import sys
+import textwrap
+
+import pytest
+
+from tidb_tpu.errors import ExecutionError
+from tidb_tpu.plugin import Plugin, PluginRegistry
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def plugin_module(tmp_path, monkeypatch):
+    """A real importable plugin module registering all three kinds."""
+    mod = tmp_path / "demo_plugin.py"
+    mod.write_text(textwrap.dedent("""
+        from tidb_tpu.plugin import Plugin
+
+        EVENTS = []
+
+        def _begin(session, sql, stype):
+            EVENTS.append(("begin", stype, sql))
+
+        def _end(session, sql, stype, dur, error):
+            EVENTS.append(("end", stype, error is None))
+
+        def _auth(user, token, salt):
+            if user == "plugin_user":
+                return token == b"sesame"
+            return None  # not my user
+
+        def _build(phys, session):
+            from tidb_tpu.executor.builder import build_executor
+            EVENTS.append(("build", type(phys).__name__))
+            return build_executor(phys)
+
+        def plugin_init(reg):
+            reg.register(Plugin(name="demo_audit", kind="audit",
+                                on_statement_begin=_begin,
+                                on_statement_end=_end))
+            reg.register(Plugin(name="demo_auth", kind="auth",
+                                authenticate=_auth))
+            reg.register(Plugin(name="demo_exec", kind="executor",
+                                build=_build))
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    yield "demo_plugin"
+    sys.modules.pop("demo_plugin", None)
+
+
+class TestPluginRegistry:
+    def test_install_show_uninstall(self, plugin_module):
+        s = Session(chunk_capacity=64)
+        s.execute(f"install plugin demo_audit soname '{plugin_module}'")
+        rows = s.query("show plugins")
+        names = {r[0] for r in rows}
+        # the module registered three plugins in one init
+        assert {"demo_audit", "demo_auth", "demo_exec"} <= names
+        assert ("demo_audit", "ACTIVE", "AUDIT", plugin_module, "1.0") in rows
+        s.execute("uninstall plugin demo_auth")
+        assert "demo_auth" not in {r[0] for r in s.query("show plugins")}
+
+    def test_install_name_mismatch_rolls_back(self, plugin_module):
+        s = Session(chunk_capacity=64)
+        with pytest.raises(ExecutionError):
+            s.execute(f"install plugin nosuch soname '{plugin_module}'")
+        assert s.query("show plugins") == []
+
+    def test_audit_hooks_fire(self, plugin_module):
+        s = Session(chunk_capacity=64)
+        s.execute(f"install plugin demo_audit soname '{plugin_module}'")
+        import demo_plugin
+
+        demo_plugin.EVENTS.clear()
+        s.execute("create table pa (x bigint)")
+        s.execute("insert into pa values (1)")
+        s.query("select * from pa")
+        kinds = [(e[0], e[1]) for e in demo_plugin.EVENTS]
+        assert ("begin", "createtable") in kinds
+        assert ("begin", "insert") in kinds
+        assert ("begin", "select") in kinds
+        assert ("end", "select") in kinds
+        # errors are reported to the end hook too
+        demo_plugin.EVENTS.clear()
+        with pytest.raises(Exception):
+            s.query("select * from no_such_table")
+        assert any(e[0] == "end" and e[2] is False for e in demo_plugin.EVENTS)
+
+    def test_auth_plugin(self, plugin_module):
+        s = Session(chunk_capacity=64)
+        s.execute(f"install plugin demo_auth soname '{plugin_module}'")
+        reg = s.catalog.plugins
+        assert reg.authenticate("plugin_user", b"sesame", b"") is True
+        assert reg.authenticate("plugin_user", b"wrong", b"") is False
+        # unknown users fall through to the builtin path
+        assert reg.authenticate("root", b"", b"") is None
+
+    def test_executor_plugin_takes_over(self, plugin_module):
+        s = Session(chunk_capacity=64)
+        s.execute(f"install plugin demo_exec soname '{plugin_module}'")
+        s.execute("create table pe (x bigint)")
+        s.execute("insert into pe values (7), (8)")
+        s.execute("set tidb_executor_plugin = 'demo_exec'")
+        import demo_plugin
+
+        demo_plugin.EVENTS.clear()
+        assert s.query("select sum(x) from pe") == [(15,)]
+        assert any(e[0] == "build" for e in demo_plugin.EVENTS)
+        # switch back off: builder no longer consulted
+        s.execute("set tidb_executor_plugin = ''")
+        demo_plugin.EVENTS.clear()
+        s.query("select sum(x) from pe")
+        assert not any(e[0] == "build" for e in demo_plugin.EVENTS)
+
+    def test_duplicate_register_rejected(self):
+        reg = PluginRegistry()
+        reg.register(Plugin(name="a", kind="audit"))
+        with pytest.raises(ExecutionError):
+            reg.register(Plugin(name="a", kind="audit"))
+        with pytest.raises(ExecutionError):
+            reg.register(Plugin(name="b", kind="bogus"))

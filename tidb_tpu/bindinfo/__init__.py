@@ -1,0 +1,77 @@
+"""SQL plan bindings (ref: bindinfo/ — BindHandle: normalized-SQL ->
+hinted statement, session- and global-scoped).
+
+A binding maps the *normalized* form of a statement (literals
+parameterized, whitespace collapsed, hints stripped) to a replacement
+statement carrying optimizer hints. At plan time the session looks up
+the incoming SELECT's normalized text and, on a hit, plans the bound
+statement instead — the reference's mechanism for pinning plans without
+editing application SQL."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from tidb_tpu.parser.lexer import Lexer
+
+__all__ = ["normalize_sql", "Binding", "BindHandle"]
+
+
+def normalize_sql(sql: str) -> str:
+    """Token-level normalization: numeric/string literals -> '?', hints
+    dropped, keywords lowercased (the lexer already lowercases them),
+    single-space joined. Mirrors the reference's parameterized digest."""
+    out = []
+    for t in Lexer(sql).tokens():
+        if t.kind == "EOF":
+            break
+        if t.kind == "HINT":
+            continue
+        if t.kind in ("NUM", "STR"):
+            out.append("?")
+        elif t.kind == "OP" and t.text == ";":
+            continue
+        else:
+            out.append(t.text)
+    return " ".join(out)
+
+
+@dataclass
+class Binding:
+    original_sql: str
+    bind_sql: str
+    scope: str  # global | session
+    status: str = "enabled"
+    stmt: object = None  # parsed bind_sql, cached at create() time
+
+
+class BindHandle:
+    """One scope's bindings (the catalog holds the global handle, each
+    session its own)."""
+
+    def __init__(self, scope: str):
+        self.scope = scope
+        self._by_norm: Dict[str, Binding] = {}
+
+    def create(self, target_sql: str, using_sql: str) -> None:
+        from tidb_tpu.parser import parse
+
+        norm = normalize_sql(target_sql)
+        stmts = parse(using_sql)
+        stmt = stmts[0] if len(stmts) == 1 else None
+        self._by_norm[norm] = Binding(target_sql, using_sql, self.scope, stmt=stmt)
+
+    def drop(self, target_sql: str) -> bool:
+        return self._by_norm.pop(normalize_sql(target_sql), None) is not None
+
+    def match(self, norm: str) -> Optional[Binding]:
+        b = self._by_norm.get(norm)
+        return b if b is not None and b.status == "enabled" else None
+
+    def rows(self) -> List[tuple]:
+        return [(b.original_sql, b.bind_sql, b.scope, b.status)
+                for b in self._by_norm.values()]
+
+    def __len__(self):
+        return len(self._by_norm)

@@ -26,6 +26,8 @@ __all__ = [
     "RollbackStmt", "UseStmt", "TruncateStmt", "AnalyzeStmt",
     "CreateDatabaseStmt", "DropDatabaseStmt",
     "CreateUserStmt", "DropUserStmt",
+    "InstallPluginStmt", "UninstallPluginStmt",
+    "CreateBindingStmt", "DropBindingStmt",
 ]
 
 
@@ -203,6 +205,8 @@ class SelectStmt:
     offset: Optional[int] = None
     distinct: bool = False
     ctes: List[CTE] = field(default_factory=list)
+    hints: List[Tuple[str, List[str]]] = field(default_factory=list)
+    # (HINT_NAME_lower, [args]) from /*+ ... */ after SELECT
 
 @dataclass
 class UnionStmt:
@@ -303,6 +307,30 @@ class ShowStmt:
     kind: str  # databases | tables | columns | variables | status | create_table
     target: Optional[str] = None
     like: Optional[str] = None
+
+@dataclass
+class CreateBindingStmt:
+    scope: str       # global | session
+    target_sql: str  # the statement pattern to match (normalized)
+    using_sql: str   # the hinted statement to plan instead
+
+
+@dataclass
+class DropBindingStmt:
+    scope: str
+    target_sql: str
+
+
+@dataclass
+class InstallPluginStmt:
+    name: str
+    module: str  # SONAME: python module path
+
+
+@dataclass
+class UninstallPluginStmt:
+    name: str
+
 
 @dataclass
 class BeginStmt:

@@ -32,7 +32,8 @@ KEYWORDS = {
     "character", "collate", "auto_increment", "unsigned", "zerofill",
     "variables", "status", "grant", "revoke", "flush", "privileges",
     "alter", "add", "modify", "change", "rename", "to", "extract", "column",
-    "user", "identified", "trace",
+    "user", "identified", "trace", "install", "uninstall", "plugin",
+    "soname", "plugins", "binding", "bindings", "for",
 }
 
 
@@ -79,6 +80,8 @@ class Lexer:
                 j = s.find("\n", self.i)
                 self.i = n if j < 0 else j + 1
             elif s.startswith("/*", self.i):
+                if s.startswith("/*+", self.i):
+                    return  # optimizer hint: lexed as a HINT token
                 j = s.find("*/", self.i + 2)
                 if j < 0:
                     raise self.error("unterminated block comment")
@@ -93,6 +96,14 @@ class Lexer:
             return Token("EOF", "", self.i)
         start = self.i
         c = s[start]
+
+        # optimizer hint comment /*+ ... */ -> one HINT token (inner text)
+        if s.startswith("/*+", start):
+            j = s.find("*/", start + 3)
+            if j < 0:
+                raise self.error("unterminated hint comment")
+            self.i = j + 2
+            return Token("HINT", s[start + 3 : j].strip(), start)
 
         # numbers: 123, 1.5, .5, 1e-3, 0x1F
         if c.isdigit() or (c == "." and start + 1 < n and s[start + 1].isdigit()):

@@ -31,7 +31,15 @@ def parse_one(sql: str):
 class Parser:
     def __init__(self, sql: str):
         self.sql = sql
-        self.toks = Lexer(sql).tokens()
+        toks = Lexer(sql).tokens()
+        # hints are only meaningful right after SELECT; elsewhere they
+        # behave like the comments they are (TiDB likewise ignores
+        # DML-position hints it doesn't implement)
+        self.toks = [
+            t for i, t in enumerate(toks)
+            if t.kind != "HINT"
+            or (i > 0 and toks[i - 1].kind == "KW" and toks[i - 1].text == "select")
+        ]
         self.pos = 0
         self.param_count = 0
 
@@ -98,7 +106,14 @@ class Parser:
         while self.peek().kind != "EOF":
             if self.accept_op(";"):
                 continue
-            out.append(self.parse_statement())
+            start = self.peek().pos
+            stmt = self.parse_statement()
+            # statement source text (plan bindings normalize + match it)
+            try:
+                stmt._source = self.sql[start : self.peek().pos].strip()
+            except AttributeError:  # frozen/slotted nodes don't need it
+                pass
+            out.append(stmt)
             if not self.accept_op(";") and self.peek().kind != "EOF":
                 raise self.error("expected ';' or end of input")
         return out
@@ -133,6 +148,8 @@ class Parser:
             "truncate": self.parse_truncate,
             "analyze": self.parse_analyze,
             "trace": lambda: (self.next(), TraceStmt(self.parse_statement()))[1],
+            "install": self.parse_install,
+            "uninstall": self.parse_uninstall,
         }.get(kw)
         if handler is None:
             raise self.error(f"unsupported statement {kw.upper()}")
@@ -198,6 +215,8 @@ class Parser:
             return sel
         self.expect_kw("select")
         stmt = SelectStmt()
+        if self.peek().kind == "HINT":
+            stmt.hints = self._parse_hints(self.next().text)
         if self.accept_kw("distinct"):
             stmt.distinct = True
         else:
@@ -418,6 +437,19 @@ class Parser:
 
     def parse_create(self):
         self.expect_kw("create")
+        scope = "session"
+        if self.at_kw("global", "session") and self.peek(1).text == "binding":
+            scope = self.next().text
+        if self.accept_kw("binding"):
+            self.expect_kw("for")
+            t_start = self.peek().pos
+            self.parse_statement()  # validated, matched by normalized text
+            t_sql = self.sql[t_start : self.peek().pos].strip()
+            self.expect_kw("using")
+            u_start = self.peek().pos
+            self.parse_statement()
+            u_sql = self.sql[u_start : self.peek().pos].strip()
+            return CreateBindingStmt(scope, t_sql, u_sql)
         if self.accept_kw("database") or self.accept_kw("schema"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.expect_ident(), ine)
@@ -562,6 +594,15 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        scope = "session"
+        if self.at_kw("global", "session") and self.peek(1).text == "binding":
+            scope = self.next().text
+        if self.accept_kw("binding"):
+            self.expect_kw("for")
+            start = self.peek().pos
+            self.parse_statement()
+            sql = self.sql[start : self.peek().pos].strip()
+            return DropBindingStmt(scope, sql)
         if self.accept_kw("database") or self.accept_kw("schema"):
             ie = self._if_exists()
             return DropDatabaseStmt(self.expect_ident(), ie)
@@ -613,7 +654,13 @@ class Parser:
     def parse_explain(self) -> ExplainStmt:
         self.next()  # explain/describe/desc
         analyze = bool(self.accept_kw("analyze"))
-        return ExplainStmt(self.parse_statement(), analyze)
+        start = self.peek().pos
+        inner = self.parse_statement()
+        try:
+            inner._source = self.sql[start : self.peek().pos].strip()
+        except AttributeError:
+            pass
+        return ExplainStmt(inner, analyze)
 
     def parse_set(self) -> SetStmt:
         self.expect_kw("set")
@@ -664,7 +711,36 @@ class Parser:
             return ShowStmt("variables", like=like)
         if self.accept_kw("status"):
             return ShowStmt("status")
+        if self.accept_kw("plugins"):
+            return ShowStmt("plugins")
+        if self.accept_kw("bindings"):
+            return ShowStmt("bindings")
         raise self.error("unsupported SHOW")
+
+    def _parse_hints(self, text: str):
+        """'LEADING(a, b) MEMORY_QUOTA(1048576)' -> [(name, [args])]."""
+        import re as _re
+
+        out = []
+        for m in _re.finditer(r"(\w+)\s*\(([^)]*)\)", text):
+            args = [a.strip().strip("`") for a in m.group(2).split(",") if a.strip()]
+            out.append((m.group(1).lower(), args))
+        return out
+
+    def parse_install(self) -> InstallPluginStmt:
+        self.expect_kw("install")
+        self.expect_kw("plugin")
+        name = self.expect_ident()
+        self.expect_kw("soname")
+        module = self.next()
+        if module.kind != "STR":
+            raise self.error("SONAME needs a quoted module name")
+        return InstallPluginStmt(name, module.text)
+
+    def parse_uninstall(self) -> UninstallPluginStmt:
+        self.expect_kw("uninstall")
+        self.expect_kw("plugin")
+        return UninstallPluginStmt(self.expect_ident())
 
     def parse_start_txn(self) -> BeginStmt:
         self.expect_kw("start")
@@ -985,4 +1061,7 @@ _IDENTISH_KW = {
     "database", "schema", "comment", "status", "key", "engine", "truncate",
     # table/column positions (INFORMATION_SCHEMA names, user accounts)
     "tables", "columns", "column", "user", "variables", "trace",
+    # non-reserved in MySQL: usable as identifiers
+    "binding", "bindings", "plugin", "plugins", "soname",
+    "install", "uninstall",
 }

@@ -238,8 +238,14 @@ class Binder:
             arg = self.bind_expr(e.arg, scope)
             to = parse_type_name(e.type_name, e.type_args)
             if to.kind == TypeKind.STRING:
+                n = e.type_args[0] if e.type_args else None
                 if arg.type_.kind == TypeKind.STRING:
-                    return arg  # dict codes pass through unchanged
+                    if n is None:
+                        return arg  # dict codes pass through unchanged
+                    # CHAR(n) truncates: same dictionary-LUT path as LEFT
+                    return self.bind_string_func(
+                        "left", A.EFunc("left", []),
+                        [arg, Literal(type_=INT64, value=int(n))])
                 if isinstance(arg, Literal) and arg.value is not None:
                     k = arg.type_.kind
                     if k == TypeKind.DATE:
@@ -256,7 +262,7 @@ class Binder:
                         v = str(int(arg.value))
                     else:
                         v = str(arg.value)
-                    return Literal(type_=STRING, value=v)
+                    return Literal(type_=STRING, value=v if n is None else v[: int(n)])
                 raise UnsupportedError(
                     "CAST of a non-string column to CHAR (unbounded value "
                     "set has no plan-time dictionary)")
@@ -802,7 +808,9 @@ class Binder:
             if len(args) < 2 or not all(isinstance(a, Literal) for a in args[1:]):
                 raise UnsupportedError("INSTR needs constant arguments")
             sub = str(args[1].value)
-            start = max(int(args[2].value) - 1, 0) if len(args) > 2 else 0
+            if len(args) > 2 and int(args[2].value) < 1:
+                return Literal(type_=INT64, value=0)  # MySQL: pos <= 0 -> 0
+            start = int(args[2].value) - 1 if len(args) > 2 else 0
             lut = d.apply_table(lambda s: s.find(sub, start) + 1, np.int64)
             return Lookup.build(arg, lut, INT64)
         # string->string: build the target dictionary
@@ -961,7 +969,9 @@ def _apply_string_func(name: str, s: str, e: A.EFunc, args: List[Expr]) -> str:
     if name == "instr":
         if len(args) < 2 or not all(isinstance(a, Literal) for a in args[1:]):
             raise UnsupportedError("INSTR needs constant arguments")
-        start = max(int(args[2].value) - 1, 0) if len(args) > 2 else 0
+        if len(args) > 2 and int(args[2].value) < 1:
+            return 0  # MySQL: pos <= 0 -> 0
+        start = int(args[2].value) - 1 if len(args) > 2 else 0
         return s.find(str(args[1].value), start) + 1
     raise UnsupportedError(f"string function {name}")
 

@@ -230,6 +230,8 @@ def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalP
         sub = build_select(src.select, ctx, outer)
         cols = [dataclasses.replace(c, qualifier=src.alias) for c in sub.schema]
         sub = _realias(sub, cols)
+        # query-block boundary: outer optimizer hints (LEADING) stop here
+        sub._block_boundary = True
         return sub, Scope(cols, outer)
 
     if isinstance(src, A.Join):

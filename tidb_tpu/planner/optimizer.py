@@ -26,5 +26,5 @@ def plan_statement(
         catalog=catalog, db=db, binder=Binder(), execute_subplan=execute_subplan
     )
     logical = build_select(stmt, ctx)
-    logical = optimize_logical(logical)
+    logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or ())
     return lower(logical)

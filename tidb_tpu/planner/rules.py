@@ -405,10 +405,10 @@ def _flatten_inner(plan: LogicalPlan, leaves, eqs, others):
         leaves.append(plan)
 
 
-def _greedy_order(leaves, eqs, others) -> LogicalPlan:
-    from tidb_tpu.planner.physical import _estimate, eq_join_rows
-
-    n = len(leaves)
+def _classify_edges(leaves, eqs, others):
+    """Split equi-conds into cross-leaf join edges vs leftovers that
+    must re-apply as a post-join filter. Shared by the greedy and
+    LEADING-forced orderers."""
     uidsets = [{c.uid for c in l.schema} for l in leaves]
 
     def owner(refs: Set[str]) -> Optional[int]:
@@ -425,6 +425,14 @@ def _greedy_order(leaves, eqs, others) -> LogicalPlan:
             leftover.append(Call(type_=BOOL, op="eq", args=(a, b)))
         else:
             edges.append((ia, ib, a, b))
+    return edges, leftover
+
+
+def _greedy_order(leaves, eqs, others) -> LogicalPlan:
+    from tidb_tpu.planner.physical import _estimate, eq_join_rows
+
+    n = len(leaves)
+    edges, leftover = _classify_edges(leaves, eqs, others)
 
     est = [_estimate(l) for l in leaves]
     start = min(range(n), key=lambda i: est[i])
@@ -467,22 +475,84 @@ def _greedy_order(leaves, eqs, others) -> LogicalPlan:
     return tree
 
 
-def _rule_reorder(plan: LogicalPlan) -> LogicalPlan:
+def _leaf_name(leaf: LogicalPlan) -> Optional[str]:
+    """Dominant table alias of a join leaf (for LEADING hint matching)."""
+    for c in leaf.schema:
+        if c.qualifier:
+            return c.qualifier.lower()
+    return None
+
+
+def _forced_order(leaves, eqs, others, leading) -> LogicalPlan:
+    """LEADING(a, b, ...) hint: join in exactly the given order (a
+    prefix — unmentioned leaves follow in source order), using whatever
+    equi-edges connect at each step. Mirrors the reference's
+    leading-hint override of the join-reorder rule. Callers check the
+    hint matches at least one leaf (_match_leading) first."""
+    matched = _match_leading(leaves, leading)
+    seq = matched + [i for i in range(len(leaves)) if i not in matched]
+
+    edges, leftover = _classify_edges(leaves, eqs, others)
+
+    cur_set = {seq[0]}
+    tree = leaves[seq[0]]
+    for c in seq[1:]:
+        conds = []
+        for ia, ib, a, b in edges:
+            if ia in cur_set and ib == c:
+                conds.append((a, b))
+            elif ib in cur_set and ia == c:
+                conds.append((b, a))
+        tree = LJoin(
+            schema=list(tree.schema) + list(leaves[c].schema),
+            children=[tree, leaves[c]],
+            kind="inner", eq_conds=conds,
+        )
+        cur_set.add(c)
+    if leftover:
+        sel = LSelection(schema=list(tree.schema), children=[tree],
+                         cond=_conj_join(leftover))
+        return _rule_pushdown(sel)
+    return tree
+
+
+def _match_leading(leaves, leading):
+    """Leaf indices the LEADING names resolve to, in hint order."""
+    by_name = {}
+    for i, l in enumerate(leaves):
+        nm = _leaf_name(l)
+        if nm is not None and nm not in by_name:
+            by_name[nm] = i
+    # dict.fromkeys: a repeated alias in the hint must not join a leaf twice
+    return list(dict.fromkeys(
+        by_name[n.lower()] for n in leading if n.lower() in by_name))
+
+
+def _rule_reorder(plan: LogicalPlan, leading=None) -> LogicalPlan:
+    if getattr(plan, "_block_boundary", False):
+        leading = None  # hints don't cross into derived query blocks
     if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
         leaves, eqs, others = [], [], []
         _flatten_inner(plan, leaves, eqs, others)
+        # the hint applies to ITS query block — the topmost join group
+        # here — not to derived tables / subquery joins below. A hint
+        # matching no leaf (typo'd alias) is ignored entirely.
+        if leading and len(leaves) >= 2 and _match_leading(leaves, leading):
+            leaves = [_rule_reorder(l) for l in leaves]
+            return _forced_order(leaves, eqs, others, leading)
         if len(leaves) > 2:
             leaves = [_rule_reorder(l) for l in leaves]
             return _greedy_order(leaves, eqs, others)
-    plan.children = [_rule_reorder(c) for c in plan.children]
+    plan.children = [_rule_reorder(c, leading) for c in plan.children]
     return plan
 
 
 # ---------------------------------------------------------------------------
 
-def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
+def optimize_logical(plan: LogicalPlan, hints=()) -> LogicalPlan:
     plan = _rule_fold(plan)
     plan = _rule_pushdown(plan)
-    plan = _rule_reorder(plan)
+    leading = next((args for name, args in hints if name == "leading"), None)
+    plan = _rule_reorder(plan, leading)
     plan = _rule_prune(plan, None)
     return plan

@@ -124,8 +124,13 @@ class Server:
             P.write_packet(conn, 0, P.handshake_v10(conn_id, version, salt))
             _seq, payload = P.read_packet(conn)
             hello = P.parse_handshake_response(payload)
-            # mysql_native_password scramble against the catalog's users
-            if not self.catalog.verify_user(hello["user"], hello["auth"], salt):
+            # auth plugins first (ref: plugin/ authentication hook);
+            # builtin mysql_native_password scramble otherwise
+            verdict = self.catalog.plugins.authenticate(
+                hello["user"], hello["auth"], salt)
+            if verdict is None:
+                verdict = self.catalog.verify_user(hello["user"], hello["auth"], salt)
+            if not verdict:
                 P.write_packet(conn, 2, P.err_packet(
                     1045, f"Access denied for user '{hello['user']}'", "28000"))
                 return

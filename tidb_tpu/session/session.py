@@ -54,6 +54,9 @@ class Session:
         self._chunk_capacity = chunk_capacity  # explicit override; else sysvar
         self.sysvars = SysVarStore(self.catalog.global_vars)
         self.user_vars: dict = {}
+        from tidb_tpu.bindinfo import BindHandle
+
+        self._bindings = BindHandle("session")
         self._prepared: dict = {}  # stmt_id -> (ast, n_params)
         self._stmt_id = 0
         self.txn: Optional[TxnState] = None
@@ -151,6 +154,14 @@ class Session:
     # -- execution ---------------------------------------------------------
 
     def _build_root(self, phys):
+        # an installed executor plugin named by tidb_executor_plugin
+        # takes over executor construction (the plugin/ extension point
+        # the north star describes for alternate backends)
+        plug_name = str(self.sysvars.get("tidb_executor_plugin"))
+        if plug_name:
+            build = self.catalog.plugins.executor_builder(plug_name)
+            if build is not None:
+                return build(phys, self)
         if self.txn is not None:
             # snapshot reads need per-row visibility masks; the sharded
             # device tables hold committed-latest — use the local executors
@@ -189,6 +200,7 @@ class Session:
         if self.catalog.has_stale_txns():
             self.catalog.resolve_locks()
         stype = type(stmt).__name__.removesuffix("Stmt").lower()
+        self.catalog.plugins.statement_begin(self, sql, stype)
         prof_dir = str(self.sysvars.get("tidb_profile_dir"))
         ctx = contextlib.nullcontext()
         if prof_dir:
@@ -199,10 +211,13 @@ class Session:
         try:
             with ctx:
                 result = self._execute_stmt(stmt)
-        except Exception:
+        except Exception as exc:
             M.QUERY_TOTAL.inc(type=stype, status="error")
+            self.catalog.plugins.statement_end(
+                self, sql, stype, _time.perf_counter() - t0, exc)
             raise
         dur = _time.perf_counter() - t0
+        self.catalog.plugins.statement_end(self, sql, stype, dur, None)
         M.QUERY_TOTAL.inc(type=stype, status="ok")
         M.QUERY_DURATION.observe(dur, type=stype)
         # threshold in ms; 0 logs every statement (long_query_time=0)
@@ -220,14 +235,20 @@ class Session:
 
     # ------------------------------------------------------------------
 
-    def _exec_ctx(self) -> ExecContext:
+    def _exec_ctx(self, hints=()) -> ExecContext:
         from tidb_tpu.utils.memory import MemTracker
 
+        quota = int(self.sysvars.get("tidb_mem_quota_query"))
+        for hname, hargs in hints or ():
+            if hname == "memory_quota" and hargs:
+                q = _parse_quota(hargs[0])  # MEMORY_QUOTA(bytes | N MB | N GB)
+                if q is not None:
+                    quota = q  # unparseable hints are ignored, like TiDB warns
         return ExecContext(
             chunk_capacity=self.chunk_capacity,
             mem_tracker=MemTracker(
                 "query",
-                budget=int(self.sysvars.get("tidb_mem_quota_query")),
+                budget=quota,
                 spill_enabled=bool(self.sysvars.get("tidb_enable_tmp_storage_on_oom")),
             ),
             read_ts=self.txn.read_ts if self.txn is not None else None,
@@ -250,6 +271,33 @@ class Session:
             stmt, self.catalog, db=self.db, execute_subplan=self._execute_subplan
         )
 
+    def _apply_binding(self, stmt):
+        """Plan-binding lookup (ref: bindinfo BindHandle): on a match of
+        the statement's normalized source, plan the bound (hinted)
+        statement instead. Session bindings shadow global ones."""
+        if not len(self._bindings) and not len(self.catalog.bind_handle):
+            return stmt
+        source = getattr(stmt, "_source", None)
+        if not source:
+            return stmt
+        from tidb_tpu.bindinfo import normalize_sql
+
+        norm = normalize_sql(source)
+        b = self._bindings.match(norm) or self.catalog.bind_handle.match(norm)
+        if b is None:
+            return stmt
+        # inject the binding's HINTS into the user's statement — never
+        # the bound statement itself, whose literals are the ones that
+        # happened to be in CREATE BINDING, not the user's. Copy instead
+        # of mutating: cached prepared-statement ASTs must not keep the
+        # hints after the binding is dropped.
+        if (isinstance(stmt, A.SelectStmt) and isinstance(b.stmt, A.SelectStmt)
+                and b.stmt.hints):
+            import dataclasses as _dc
+
+            return _dc.replace(stmt, hints=list(b.stmt.hints))
+        return stmt
+
     def _run_select(self, stmt) -> ResultSet:
         if self.txn is None and not self.sysvars.get("autocommit"):
             self._begin()  # consistent-snapshot reads without autocommit
@@ -263,7 +311,8 @@ class Session:
                 c = c.children[0]
             if isinstance(c, PProjection) and c.n_visible is not None and c.n_visible < len(phys.schema):
                 n_vis = c.n_visible
-        return run_plan(root, self._exec_ctx(), n_visible=n_vis)
+        return run_plan(root, self._exec_ctx(hints=getattr(stmt, "hints", ())),
+                        n_visible=n_vis)
 
     # ------------------------------------------------------------------
 
@@ -312,7 +361,23 @@ class Session:
         if not isinstance(stmt, A.SetStmt) and _ast_contains(stmt, A.EVar):
             stmt = self._sub_vars(stmt)
         if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
-            return self._run_select(stmt)
+            return self._run_select(self._apply_binding(stmt))
+        if isinstance(stmt, A.CreateBindingStmt):
+            from tidb_tpu.bindinfo import normalize_sql
+
+            if normalize_sql(stmt.target_sql) != normalize_sql(stmt.using_sql):
+                raise PlanError(
+                    "binding statements differ after normalization")
+            handle = (self.catalog.bind_handle if stmt.scope == "global"
+                      else self._bindings)
+            handle.create(stmt.target_sql, stmt.using_sql)
+            return None
+        if isinstance(stmt, A.DropBindingStmt):
+            handle = (self.catalog.bind_handle if stmt.scope == "global"
+                      else self._bindings)
+            if not handle.drop(stmt.target_sql):
+                raise ExecutionError("no such binding")
+            return None
         if isinstance(stmt, A.InsertStmt):
             return self._run_insert(stmt)
         if isinstance(stmt, A.UpdateStmt):
@@ -365,6 +430,12 @@ class Session:
             return None
         if isinstance(stmt, A.ShowStmt):
             return self._run_show(stmt)
+        if isinstance(stmt, A.InstallPluginStmt):
+            self.catalog.plugins.load_module(stmt.name, stmt.module)
+            return None
+        if isinstance(stmt, A.UninstallPluginStmt):
+            self.catalog.plugins.uninstall(stmt.name)
+            return None
         if isinstance(stmt, A.BeginStmt):
             self._begin()
             return None
@@ -713,6 +784,7 @@ class Session:
         target = stmt.stmt
         if not isinstance(target, (A.SelectStmt, A.UnionStmt)):
             raise UnsupportedError("EXPLAIN only supports SELECT")
+        target = self._apply_binding(target)  # EXPLAIN shows the bound plan
         phys = self._plan_select(target)
         if stmt.analyze:
             from tidb_tpu.utils.execdetails import analyze_text, instrument
@@ -795,6 +867,14 @@ class Session:
                 for c in t.schema.columns
             ]
             return ResultSet(names=["Field", "Type", "Null"], rows=rows)
+        if stmt.kind == "bindings":
+            rows = self._bindings.rows() + self.catalog.bind_handle.rows()
+            return ResultSet(
+                names=["Original_sql", "Bind_sql", "Scope", "Status"], rows=rows)
+        if stmt.kind == "plugins":
+            return ResultSet(
+                names=["Name", "Status", "Type", "Library", "Version"],
+                rows=self.catalog.plugins.rows())
         if stmt.kind == "variables":
             from tidb_tpu.session.sysvars import display
 
@@ -823,6 +903,19 @@ def _ast_contains(e, cls) -> bool:
         elif hasattr(v, "__dataclass_fields__") and _ast_contains(v, cls):
             return True
     return False
+
+
+def _parse_quota(arg: str):
+    """MEMORY_QUOTA hint argument: plain bytes, or 'N MB' / 'N GB'
+    (TiDB's documented unit forms). None = unparseable, ignore."""
+    parts = str(arg).strip().split()
+    try:
+        n = int(parts[0])
+    except (ValueError, IndexError):
+        return None
+    unit = parts[1].upper() if len(parts) > 1 else ""
+    mult = {"": 1, "KB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30}.get(unit)
+    return n * mult if mult is not None else None
 
 
 def _ast_has_name(e) -> bool:

@@ -40,6 +40,9 @@ def _reg(*vs: SysVar) -> None:
 _reg(
     # the north-star switch: route eligible fragments to the device mesh
     SysVar("tidb_enable_tpu_exec", True, BOTH, "bool"),
+    # non-empty: name of an installed executor plugin that builds the
+    # operator tree instead of the built-in builders (ref: plugin/)
+    SysVar("tidb_executor_plugin", "", BOTH, "str"),
     SysVar("tidb_gc_enable", True, BOTH, "bool"),
     # statements slower than this (ms) go to the slow-query log
     SysVar("tidb_slow_log_threshold", 300, BOTH, "int", min_=0, max_=1 << 31),

@@ -32,6 +32,14 @@ class Catalog:
         # the reference's per-region leaseholder
         self.lock = threading.RLock()
         self.databases: Dict[str, Database] = {"test": Database("test")}
+        # extension points (ref: plugin/ — per-process plugin list)
+        from tidb_tpu.plugin import PluginRegistry
+
+        self.plugins = PluginRegistry()
+        # global plan bindings (ref: bindinfo — mysql.bind_info)
+        from tidb_tpu.bindinfo import BindHandle
+
+        self.bind_handle = BindHandle("global")
         self.schema_version = 0
         # cluster-wide GLOBAL sysvars (ref: mysql.global_variables)
         self.global_vars: Dict[str, object] = {}
