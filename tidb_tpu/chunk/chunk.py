@@ -135,7 +135,9 @@ class Chunk:
         from tidb_tpu.types import (
             TypeKind,
             days_to_date,
+            mask_to_set_str,
             micros_to_datetime,
+            micros_to_time_str,
             scaled_to_decimal_str,
         )
 
@@ -151,8 +153,19 @@ class Chunk:
             data, valid = col.to_numpy()
             data, valid = data[live], valid[live]
             kind = col.type_.kind
-            if kind == TypeKind.STRING and dicts and name in dicts:
+            if kind in (TypeKind.STRING, TypeKind.JSON) and dicts and name in dicts:
                 vals = dicts[name].decode(data, valid)
+            elif kind == TypeKind.TIME:
+                vals = [micros_to_time_str(int(d)) if v else None
+                        for d, v in zip(data, valid)]
+            elif kind == TypeKind.ENUM:
+                members = col.type_.members
+                vals = [members[int(d) - 1] if v else None
+                        for d, v in zip(data, valid)]
+            elif kind == TypeKind.SET:
+                members = col.type_.members
+                vals = [mask_to_set_str(int(d), members) if v else None
+                        for d, v in zip(data, valid)]
             elif kind == TypeKind.DECIMAL:
                 vals = [
                     scaled_to_decimal_str(int(d), col.type_.scale) if v else None

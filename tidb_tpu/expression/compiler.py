@@ -408,6 +408,17 @@ def _time_extract(which):
     def fn(e: Call, chunk) -> Pair:
         a = e.args[0]
         d, v = eval_expr(a, chunk)
+        if a.type_.kind == TypeKind.TIME:
+            # durations: HOUR('-120:30:00') = 120 (magnitude, unbounded)
+            mag = jnp.abs(d.astype(jnp.int64))
+            div, mod_ = {
+                "hour": (3_600_000_000, None),
+                "minute": (60_000_000, 60),
+                "second": (1_000_000, 60),
+                "microsecond": (1, 1_000_000),
+            }[which]
+            out = jnp.floor_divide(mag, div)
+            return (out if mod_ is None else out % mod_), v
         if a.type_.kind != TypeKind.DATETIME:
             return jnp.zeros_like(d, dtype=jnp.int64), v
         micros = d.astype(jnp.int64)

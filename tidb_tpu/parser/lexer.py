@@ -48,7 +48,7 @@ class Token:
 
 
 _TWO_CHAR = {"<=", ">=", "<>", "!=", "||", "&&", ":=", "->", "<<", ">>"}
-_THREE_CHAR = {"<=>"}
+_THREE_CHAR = {"<=>", "->>"}
 _SINGLE = set("+-*/%(),.;=<>!@&|^~?")
 
 

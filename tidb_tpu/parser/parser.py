@@ -550,9 +550,15 @@ class Parser:
         type_name = self.next().text.lower()
         args = ()
         if self.accept_op("("):
-            a = [int(self.next().text)]
+            def type_arg():
+                t = self.next()
+                # ENUM/SET member lists are quoted strings; numeric
+                # lengths everywhere else
+                return t.text if t.kind == "STR" else int(t.text)
+
+            a = [type_arg()]
             while self.accept_op(","):
-                a.append(int(self.next().text))
+                a.append(type_arg())
             self.expect_op(")")
             args = tuple(a)
         self.accept_kw("unsigned")
@@ -916,7 +922,20 @@ class Parser:
         if self.at_op("!"):
             self.next()
             return EUnary("not", self.parse_unary())
-        return self.parse_primary()
+        return self.parse_postfix()
+
+    def parse_postfix(self):
+        left = self.parse_primary()
+        # MySQL JSON path operators: col->'$.a' / col->>'$.a'
+        while self.at_op("->", "->>"):
+            op = self.next().text
+            t = self.next()
+            if t.kind != "STR":
+                raise self.error("JSON path must be a quoted string")
+            left = EFunc("json_extract", [left, EStr(t.text)])
+            if op == "->>":
+                left = EFunc("json_unquote", [left])
+        return left
 
     def parse_primary(self):
         t = self.peek()

@@ -618,6 +618,26 @@ class Session:
                 # exact descale: float division corrupts 16+-digit decimals
                 return _dec.Decimal(v).scaleb(-bound.type_.scale)
             return v
+        if k == TypeKind.TIME:
+            if bound.type_.kind == TypeKind.TIME:
+                import datetime as _dt
+
+                return _dt.timedelta(microseconds=v)  # coerced micros
+            return v
+        if k == TypeKind.ENUM:
+            if bound.type_.kind == TypeKind.ENUM:
+                if v == 0:  # coercion's no-match sentinel: invalid on insert
+                    raise ExecutionError(
+                        f"invalid ENUM value for column {col.name!r}")
+                return int(v)  # 1-based index
+            return v
+        if k == TypeKind.SET:
+            if bound.type_.kind == TypeKind.SET:
+                if v < 0:
+                    raise ExecutionError(
+                        f"invalid SET value for column {col.name!r}")
+                return int(v)  # bitmask
+            return v
         if bound.type_.kind == TypeKind.DECIMAL:
             # decimal literal into a non-decimal column: leave the
             # scaled-int representation (1.5 is Literal(15, scale=1))

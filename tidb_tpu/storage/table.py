@@ -110,7 +110,7 @@ class Table:
         for c in schema.columns:
             self.data[c.name] = np.zeros(cap, dtype=c.type_.np_dtype)
             self.valid[c.name] = np.zeros(cap, dtype=np.bool_)
-            if c.type_.kind == TypeKind.STRING:
+            if c.type_.is_dict_encoded:
                 self.dicts[c.name] = Dictionary([])
         # MVCC visibility range per physical row (see TXN_TS_BASE above)
         self.begin_ts = np.zeros(cap, dtype=np.int64)
@@ -180,7 +180,25 @@ class Table:
                 if isinstance(v, str):
                     v = datetime.datetime.fromisoformat(v)
                 return datetime_to_micros(v)
-            if k == TypeKind.STRING:
+            if k == TypeKind.TIME:
+                from tidb_tpu.types import time_to_micros
+
+                return time_to_micros(v)
+            if k == TypeKind.ENUM:
+                members = col.type_.members
+                if isinstance(v, int):  # 1-based index form
+                    if not 1 <= v <= len(members):
+                        raise ValueError(f"ENUM index {v} out of range")
+                    return v
+                try:
+                    return members.index(str(v)) + 1
+                except ValueError:
+                    raise ValueError(f"unknown ENUM member {v!r}")
+            if k == TypeKind.SET:
+                from tidb_tpu.types import set_to_mask
+
+                return set_to_mask(v, list(col.type_.members))
+            if k in (TypeKind.STRING, TypeKind.JSON):
                 return str(v)  # encoded in bulk by insert_rows
         except (ValueError, TypeError) as e:
             raise TypeError_(f"bad value {v!r} for column {col.name}: {e}")
@@ -212,7 +230,7 @@ class Table:
                 self.valid[c.name][start:end] = True
             elif c.default is not None:
                 dv = self.to_device_value(c, c.default)
-                if c.type_.kind == TypeKind.STRING:
+                if c.type_.is_dict_encoded:
                     self._append_strings(c.name, [dv] * m, start, end)
                 else:
                     self.data[c.name][start:end] = dv
@@ -224,7 +242,7 @@ class Table:
             vals = [self.to_device_value(c, r[j]) for r in rows]
             if any(v is None for v in vals) and c.not_null:
                 raise ExecutionError(f"NULL in NOT NULL column {c.name!r}")
-            if c.type_.kind == TypeKind.STRING:
+            if c.type_.is_dict_encoded:
                 self._append_strings(name, vals, start, end)
             else:
                 arr = self.data[name]
@@ -387,7 +405,7 @@ class Table:
         for name, vals in updates.items():
             c = self.schema.col(name)
             vals = [v for v, k in zip(vals, keep) if k]
-            if c.type_.kind == TypeKind.STRING:
+            if c.type_.is_dict_encoded:
                 converted[name] = [None if v is None else str(v) for v in vals]
             else:
                 converted[name] = [
@@ -408,7 +426,7 @@ class Table:
         # overwrite the updated columns in the new versions
         for name, vals in converted.items():
             c = self.schema.col(name)
-            if c.type_.kind == TypeKind.STRING:
+            if c.type_.is_dict_encoded:
                 self._append_strings(name, vals, start, end)
             else:
                 for i, v in zip(range(start, end), vals):
@@ -518,12 +536,12 @@ class Table:
         self.schema.columns.append(col)
         self.data[col.name] = np.zeros(self._cap, dtype=col.type_.np_dtype)
         self.valid[col.name] = np.zeros(self._cap, dtype=np.bool_)
-        if col.type_.kind == TypeKind.STRING:
+        if col.type_.is_dict_encoded:
             self.dicts[col.name] = Dictionary([])
         if col.default is not None:
             # backfill existing rows with the default
             dv = self.to_device_value(col, col.default)
-            if col.type_.kind == TypeKind.STRING:
+            if col.type_.is_dict_encoded:
                 self._append_strings(col.name, [dv] * self.n, 0, self.n)
             else:
                 self.data[col.name][: self.n] = dv
@@ -813,7 +831,7 @@ class Table:
             # stale slots reading as NULL
             self.valid[c.name][:] = False
             self.data[c.name][:] = 0
-            if c.type_.kind == TypeKind.STRING:
+            if c.type_.is_dict_encoded:
                 self.dicts[c.name] = Dictionary([])
 
     # -- reads -------------------------------------------------------------

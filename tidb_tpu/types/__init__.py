@@ -50,6 +50,14 @@ __all__ = [
     "scaled_to_decimal_str",
     "common_type",
     "parse_type_name",
+    "TIME",
+    "JSONTYPE",
+    "enum_type",
+    "set_type",
+    "time_to_micros",
+    "micros_to_time_str",
+    "set_to_mask",
+    "mask_to_set_str",
 ]
 
 
@@ -60,6 +68,10 @@ class TypeKind(enum.Enum):
     STRING = "string"
     DATE = "date"
     DATETIME = "datetime"
+    TIME = "time"      # int64 signed microseconds (MySQL TIME is a duration)
+    ENUM = "enum"      # int32 1-based member index (definition order == sort order)
+    SET = "set"        # int64 member bitmask
+    JSON = "json"      # int32 dictionary code over document texts (like STRING)
     BOOL = "bool"
     NULL = "null"
 
@@ -72,6 +84,8 @@ class SQLType:
     # decimal precision/scale; scale is the power-of-ten fixed-point shift
     precision: int = 0
     scale: int = 0
+    # ENUM/SET member list, in definition order (tuple: hashable)
+    members: tuple = ()
 
     @property
     def np_dtype(self) -> np.dtype:
@@ -82,6 +96,10 @@ class SQLType:
             TypeKind.STRING: np.dtype(np.int32),
             TypeKind.DATE: np.dtype(np.int32),
             TypeKind.DATETIME: np.dtype(np.int64),
+            TypeKind.TIME: np.dtype(np.int64),
+            TypeKind.ENUM: np.dtype(np.int32),
+            TypeKind.SET: np.dtype(np.int64),
+            TypeKind.JSON: np.dtype(np.int32),
             TypeKind.BOOL: np.dtype(np.bool_),
             TypeKind.NULL: np.dtype(np.bool_),
         }[self.kind]
@@ -95,12 +113,20 @@ class SQLType:
         return self.kind == TypeKind.STRING
 
     @property
+    def is_dict_encoded(self) -> bool:
+        """Stored as codes into a per-column host dictionary."""
+        return self.kind in (TypeKind.STRING, TypeKind.JSON)
+
+    @property
     def is_temporal(self) -> bool:
         return self.kind in (TypeKind.DATE, TypeKind.DATETIME)
 
     def __str__(self) -> str:
         if self.kind == TypeKind.DECIMAL:
             return f"decimal({self.precision},{self.scale})"
+        if self.kind in (TypeKind.ENUM, TypeKind.SET):
+            inner = ",".join(f"'{m}'" for m in self.members)
+            return f"{self.kind.value}({inner})"
         return self.kind.value
 
 
@@ -109,8 +135,23 @@ FLOAT64 = SQLType(TypeKind.FLOAT)
 BOOL = SQLType(TypeKind.BOOL)
 DATE = SQLType(TypeKind.DATE)
 DATETIME = SQLType(TypeKind.DATETIME)
+TIME = SQLType(TypeKind.TIME)
 STRING = SQLType(TypeKind.STRING)
+JSONTYPE = SQLType(TypeKind.JSON)
 NULLTYPE = SQLType(TypeKind.NULL)
+
+
+def enum_type(members) -> SQLType:
+    return SQLType(TypeKind.ENUM, members=tuple(members))
+
+
+def set_type(members) -> SQLType:
+    members = tuple(members)
+    if len(members) > 63:
+        # bit 63 of the int64 mask is the sign bit; uint64 storage would
+        # buy one more member at the cost of special-casing everywhere
+        raise ValueError("SET supports at most 63 members")
+    return SQLType(TypeKind.SET, members=members)
 
 
 def decimal_type(precision: int, scale: int) -> SQLType:
@@ -149,6 +190,68 @@ def datetime_to_micros(dt: datetime.datetime) -> int:
 
 def micros_to_datetime(us: int) -> datetime.datetime:
     return datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(us))
+
+
+_TIME_MAX = (838 * 3600 + 59 * 60 + 59) * 1_000_000  # MySQL TIME range
+
+
+def time_to_micros(v) -> int:
+    """'[-]HH:MM:SS[.ffffff]' / '[-]HHMMSS' / timedelta -> signed micros."""
+    if isinstance(v, datetime.timedelta):
+        return v // datetime.timedelta(microseconds=1)
+    if isinstance(v, datetime.time):
+        return ((v.hour * 60 + v.minute) * 60 + v.second) * 1_000_000 + v.microsecond
+    s = str(v).strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    frac = 0
+    if "." in s:
+        s, f = s.split(".", 1)
+        frac = int((f + "000000")[:6])
+    if ":" in s:
+        parts = [int(p) for p in s.split(":")]
+        if len(parts) == 2:
+            parts.append(0)  # MySQL: 'HH:MM' means HH:MM:00
+        h, m, sec = parts
+    else:  # HHMMSS integer form
+        n = int(s)
+        h, m, sec = n // 10000, n // 100 % 100, n % 100
+    us = ((h * 60 + m) * 60 + sec) * 1_000_000 + frac
+    if us > _TIME_MAX:
+        raise ValueError(f"TIME value out of range: {v!r}")
+    return -us if neg else us
+
+
+def micros_to_time_str(us: int) -> str:
+    us = int(us)
+    sign = "-" if us < 0 else ""
+    mag = abs(us)
+    frac = mag % 1_000_000
+    sec = mag // 1_000_000
+    h, m, s = sec // 3600, sec // 60 % 60, sec % 60
+    base = f"{sign}{h:02d}:{m:02d}:{s:02d}"
+    return f"{base}.{frac:06d}".rstrip("0").rstrip(".") if frac else base
+
+
+def set_to_mask(v, members) -> int:
+    """'a,b' / iterable / int mask -> bitmask over definition order."""
+    if isinstance(v, int):
+        if not 0 <= v < (1 << len(members)):
+            raise ValueError(f"SET mask {v} out of range")
+        return v
+    items = [p for p in str(v).split(",") if p] if isinstance(v, str) else list(v)
+    mask = 0
+    for it in items:
+        try:
+            mask |= 1 << members.index(it)
+        except ValueError:
+            raise ValueError(f"unknown SET member {it!r}")
+    return mask
+
+
+def mask_to_set_str(mask: int, members) -> str:
+    return ",".join(m for i, m in enumerate(members) if int(mask) >> i & 1)
 
 
 def decimal_to_scaled(value, scale: int) -> int:
@@ -236,18 +339,27 @@ _TYPE_NAMES = {
     "date": DATE,
     "datetime": DATETIME,
     "timestamp": DATETIME,
+    "time": TIME,
+    "year": INT64,
+    "bit": INT64,
+    "json": JSONTYPE,
     "bool": BOOL,
     "boolean": BOOL,
 }
 
 
 def parse_type_name(name: str, args: tuple = ()) -> SQLType:
-    """Map a SQL column type name (+ optional length/scale args) to SQLType."""
+    """Map a SQL column type name (+ optional length/scale/member args)
+    to SQLType."""
     low = name.lower()
     if low in ("decimal", "numeric"):
         prec = int(args[0]) if args else 10
         scale = int(args[1]) if len(args) > 1 else 0
         return decimal_type(prec, scale)
+    if low == "enum":
+        return enum_type(str(a) for a in args)
+    if low == "set":
+        return set_type([str(a) for a in args])
     if low in _TYPE_NAMES:
         return _TYPE_NAMES[low]
     raise ValueError(f"unknown type name {name!r}")
