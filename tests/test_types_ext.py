@@ -150,3 +150,19 @@ class TestReviewRegressions:
         assert sess.query(
             "select json_extract(doc, '$.name', '$.nested.k') from e where id = 2") \
             == [('["y", 7]',)]
+
+    def test_hour_of_time_string_literal(self, sess):
+        assert sess.query("select hour('10:30:00'), minute('10:30:00')") == [(10, 30)]
+
+    def test_bad_time_literal_is_sql_error(self, sess):
+        from tidb_tpu.errors import TiDBTPUError
+        with pytest.raises(TiDBTPUError):
+            sess.query("select id from e where t = 'garbage'")
+        with pytest.raises(TiDBTPUError):
+            sess.query("select id from e where t > '900:00:00'")
+
+    def test_minutes_seconds_validated(self, sess):
+        with pytest.raises(Exception):
+            sess.query("select time '9999'")
+        with pytest.raises(Exception):
+            sess.execute("insert into e (id, t) values (9, '0:99:00')")

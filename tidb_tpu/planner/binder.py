@@ -433,7 +433,10 @@ class Binder:
         if k == TypeKind.TIME:
             from tidb_tpu.types import time_to_micros
 
-            return Literal(type_=TIME, value=time_to_micros(s))
+            try:
+                return Literal(type_=TIME, value=time_to_micros(s))
+            except ValueError as ex:
+                raise PlanError(f"bad TIME literal {s!r}: {ex}")
         if k == TypeKind.ENUM:
             # unknown member compares equal to nothing: index 0 is unused
             idx = target.members.index(s) + 1 if s in target.members else 0
@@ -738,7 +741,13 @@ class Binder:
                 return Literal(type_=INT64, value=val)
             return Call(type_=INT64, op=op, args=(a,))
         if name in ("hour", "minute", "second", "microsecond"):
-            a = self.coerce_untyped_literal(args[0], DATETIME)
+            a = args[0]
+            if isinstance(a, Literal) and a.type_.kind == TypeKind.STRING:
+                # '10:30:00' is a TIME; date dashes mean a datetime
+                target = DATETIME if "-" in str(a.value).lstrip("-") else TIME
+                a = self.coerce_untyped_literal(a, target)
+            else:
+                a = self.coerce_untyped_literal(a, DATETIME)
             if not a.type_.is_temporal and a.type_.kind != TypeKind.TIME:
                 raise PlanError(f"{name.upper()} needs a date/time argument")
             if isinstance(a, Literal) and a.type_.kind == TypeKind.TIME:

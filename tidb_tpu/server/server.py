@@ -76,11 +76,20 @@ class Server:
                 version=str(SYSVARS["version"].default))
             self._status_server.start()
             self.status_port = self._status_server.port
+        # each server instance runs a DDL worker; the elected owner
+        # executes queued DDL for every instance (ref: owner/ + ddl/)
+        from tidb_tpu.owner import DDLWorker
+
+        self._ddl_worker = DDLWorker(self.catalog, f"server-{id(self):x}")
+        self._ddl_worker.start()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
 
     def stop(self) -> None:
         self._running = False
+        if getattr(self, "_ddl_worker", None) is not None:
+            self._ddl_worker.stop()
+            self._ddl_worker = None
         if self._status_server is not None:
             self._status_server.stop()
             self._status_server = None

@@ -388,6 +388,26 @@ class Session:
                              A.DropDatabaseStmt, A.TruncateStmt, A.CreateIndexStmt,
                              A.DropIndexStmt, A.AlterTableStmt)):
             self._commit()  # DDL implicitly commits the open txn (MySQL)
+            # multi-instance deployments run DDL through the elected
+            # owner's worker (ref: ddl job queue + owner election);
+            # inline otherwise (embedded / the worker's own session)
+            if self.catalog.ddl_workers and not getattr(self, "_ddl_direct", False):
+                source = getattr(stmt, "_source", None)
+                if source:
+                    job = self.catalog.submit_ddl(source, self.db)
+                    deadline = 60
+                    while not job.done.wait(timeout=1):
+                        deadline -= 1
+                        # all workers gone while we waited: fail fast
+                        # instead of sitting out the whole timeout
+                        # holding the statement lock
+                        if not self.catalog.ddl_workers:
+                            self.catalog.drain_ddl_jobs("DDL owner shut down")
+                        if deadline <= 0:
+                            job.fail(ExecutionError("DDL job timed out"))
+                    if job.error is not None:
+                        raise job.error
+                    return None
         if isinstance(stmt, A.CreateTableStmt):
             return self._run_create_table(stmt)
         if isinstance(stmt, A.DropTableStmt):
@@ -620,9 +640,11 @@ class Session:
             return v
         if k == TypeKind.TIME:
             if bound.type_.kind == TypeKind.TIME:
+                # timedelta is TIME's logical form (as date is DATE's);
+                # to_device_value reads a bare int as HHMMSS, not micros
                 import datetime as _dt
 
-                return _dt.timedelta(microseconds=v)  # coerced micros
+                return _dt.timedelta(microseconds=v)
             return v
         if k == TypeKind.ENUM:
             if bound.type_.kind == TypeKind.ENUM:

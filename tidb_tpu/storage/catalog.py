@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from tidb_tpu.errors import DuplicateTableError, SchemaError
+from tidb_tpu.errors import DuplicateTableError, ExecutionError, SchemaError
 from tidb_tpu.storage.table import ColumnInfo, Table, TableSchema
 
 __all__ = ["Database", "Catalog"]
@@ -40,6 +40,15 @@ class Catalog:
         from tidb_tpu.bindinfo import BindHandle
 
         self.bind_handle = BindHandle("global")
+        # DDL owner election + job queue (ref: owner/ + ddl/ job rows);
+        # workers register per server instance — empty means inline DDL
+        from tidb_tpu.owner import Election
+
+        self.ddl_owner = Election()
+        self.ddl_workers: Dict[str, object] = {}
+        self._ddl_jobs: list = []
+        self._ddl_job_id = 0
+        self._ddl_qlock = threading.Lock()
         self.schema_version = 0
         # cluster-wide GLOBAL sysvars (ref: mysql.global_variables)
         self.global_vars: Dict[str, object] = {}
@@ -62,6 +71,50 @@ class Catalog:
         from collections import deque
 
         self.slow_queries = deque(maxlen=128)
+
+    def submit_ddl(self, sql: str, db: str):
+        """Enqueue a DDL job for the elected owner's worker."""
+        from tidb_tpu.owner import DDLJob
+
+        with self._ddl_qlock:
+            self._ddl_job_id += 1
+            job = DDLJob(self._ddl_job_id, sql, db)
+            self._ddl_jobs.append(job)
+        return job
+
+    def next_ddl_job(self, worker_id: str = ""):
+        with self._ddl_qlock:
+            for j in self._ddl_jobs:
+                if j.state == "queued":
+                    j.state = "running"  # claimed atomically: a lease
+                    # change between campaign() and here must not let
+                    # two workers run the same job
+                    j.claimed_by = worker_id
+                    return j
+            # opportunistic pruning of finished history
+            self._ddl_jobs = [j for j in self._ddl_jobs if not j.done.is_set()]
+        return None
+
+    def reclaim_ddl_jobs(self) -> int:
+        """Requeue jobs claimed by a worker that is gone (owner died
+        mid-execution; the new owner picks them up)."""
+        n = 0
+        with self._ddl_qlock:
+            for j in self._ddl_jobs:
+                if (j.state == "running" and j.claimed_by
+                        and j.claimed_by not in self.ddl_workers):
+                    j.state = "queued"
+                    j.claimed_by = None
+                    n += 1
+        return n
+
+    def drain_ddl_jobs(self, reason: str) -> None:
+        """Fail every unfinished job (no workers remain to run them)."""
+        with self._ddl_qlock:
+            for j in self._ddl_jobs:
+                if not j.done.is_set():
+                    j.fail(ExecutionError(reason))
+            self._ddl_jobs = []
 
     def next_ts(self) -> int:
         self._ts += 1

@@ -217,6 +217,8 @@ def time_to_micros(v) -> int:
     else:  # HHMMSS integer form
         n = int(s)
         h, m, sec = n // 10000, n // 100 % 100, n % 100
+    if m >= 60 or sec >= 60:
+        raise ValueError(f"bad TIME value: {v!r}")
     us = ((h * 60 + m) * 60 + sec) * 1_000_000 + frac
     if us > _TIME_MAX:
         raise ValueError(f"TIME value out of range: {v!r}")
