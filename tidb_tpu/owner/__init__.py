@@ -106,10 +106,13 @@ class DDLWorker:
 
     def stop(self) -> None:
         self._stop.set()
-        self.catalog.ddl_workers.pop(self.worker_id, None)
-        self.catalog.ddl_owner.resign(self.worker_id)
+        # join BEFORE deregistering: once this worker leaves the
+        # registry, reclaim_ddl_jobs may requeue a job it still holds —
+        # two workers would then run the same DDL concurrently
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self.catalog.ddl_workers.pop(self.worker_id, None)
+        self.catalog.ddl_owner.resign(self.worker_id)
         # last worker out fails everything still pending — a submitter
         # waiting on job.done (holding the statement lock) must not sit
         # out its full timeout for a DDL no one will ever run

@@ -1,0 +1,139 @@
+"""Memo-based join-order search (ref: planner/cascades — the memo/
+group/group-expression machinery, applied here to the rule set that
+matters most at this engine's scale: join commutativity/associativity).
+
+The cascades engine's core is a memo of *groups* of logically equivalent
+expressions, explored by transformation rules and costed bottom-up. For
+inner-join trees every equivalent expression is characterized by the set
+of base leaves it joins, so the memo groups are keyed by leaf subsets
+(a bitmask) and exploration enumerates every connected split of each
+group — exhaustive join ordering, guaranteed no worse than the greedy
+orderer under the same cost model. Enabled per session via
+tidb_enable_cascades_planner (the reference's sysvar of the same name);
+falls back to greedy beyond MAX_LEAVES (memo size is exponential).
+
+Cost model: shared with the greedy orderer (statistics-driven row
+estimates; cost = sum of intermediate result cardinalities)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from tidb_tpu.planner.logical import LJoin, LogicalPlan
+
+__all__ = ["memo_join_search", "MAX_LEAVES"]
+
+MAX_LEAVES = 10  # 2^10 groups tops; greedy handles wider joins
+
+
+@dataclass
+class GroupExpr:
+    """One explored expression of a group: a join of two child groups
+    (or a leaf)."""
+
+    plan: LogicalPlan
+    cost: float
+    rows: float
+
+
+class Memo:
+    """Groups keyed by the bitmask of base leaves they cover; each group
+    keeps only its winner (pruned memo — dominated expressions are
+    discarded immediately, which is safe because cost is monotone in
+    child cost for this rule set)."""
+
+    def __init__(self):
+        self.groups: Dict[int, GroupExpr] = {}
+
+    def offer(self, mask: int, expr: GroupExpr) -> None:
+        cur = self.groups.get(mask)
+        if cur is None or expr.cost < cur.cost:
+            self.groups[mask] = expr
+
+    def best(self, mask: int) -> Optional[GroupExpr]:
+        return self.groups.get(mask)
+
+
+def _splits(mask: int):
+    """All (s1, s2) partitions of mask into two non-empty halves,
+    each pair once (s1 contains mask's lowest set bit)."""
+    lowest = mask & -mask
+    sub = (mask - 1) & mask
+    while sub:
+        if sub & lowest:
+            yield sub, mask ^ sub
+        sub = (sub - 1) & mask
+
+def memo_join_search(leaves: List[LogicalPlan], eqs, others,
+                     classify_edges, conj_join, pushdown_rule):
+    """Exhaustive join-order search over the memo. Returns the best
+    plan, or None when the search doesn't apply (too many leaves).
+
+    classify_edges/conj_join/pushdown_rule are the shared helpers from
+    rules.py (passed in to avoid a circular import)."""
+    from tidb_tpu.planner.logical import LSelection
+    from tidb_tpu.planner.physical import _estimate, eq_join_rows
+
+    n = len(leaves)
+    if n < 2 or n > MAX_LEAVES:
+        return None
+    edges, leftover = classify_edges(leaves, eqs, others)
+
+    memo = Memo()
+    for i, leaf in enumerate(leaves):
+        memo.offer(1 << i, GroupExpr(leaf, 0.0, float(_estimate(leaf))))
+
+    full = (1 << n) - 1
+    # bottom-up by subset size; Python ints as masks
+    by_size: List[List[int]] = [[] for _ in range(n + 1)]
+    for mask in range(1, full + 1):
+        by_size[mask.bit_count()].append(mask)
+
+    for size in range(2, n + 1):
+        for mask in by_size[size]:
+            connected_found = False
+            for pass_cross in (False, True):
+                if pass_cross and connected_found:
+                    break  # cross joins only when no connected split exists
+                for s1, s2 in _splits(mask):
+                    g1, g2 = memo.best(s1), memo.best(s2)
+                    if g1 is None or g2 is None:
+                        continue
+                    conds = []
+                    for ia, ib, a, b in edges:
+                        if (mask >> ia & 1) and (mask >> ib & 1):
+                            if (s1 >> ia & 1) and (s2 >> ib & 1):
+                                conds.append((a, b))
+                            elif (s1 >> ib & 1) and (s2 >> ia & 1):
+                                conds.append((b, a))
+                    if not pass_cross and not conds:
+                        continue
+                    if conds:
+                        connected_found = True
+                        rows = float(eq_join_rows(
+                            g1.plan, g2.plan, conds, g1.rows, g2.rows))
+                    else:
+                        rows = g1.rows * g2.rows
+                    cost = g1.cost + g2.cost + rows
+                    cur = memo.best(mask)
+                    if cur is not None and cost >= cur.cost:
+                        continue
+                    # build-side choice is lower()'s job (it compares
+                    # post-pushdown estimates and sets build_side)
+                    plan = LJoin(
+                        schema=list(g1.plan.schema) + list(g2.plan.schema),
+                        children=[g1.plan, g2.plan],
+                        kind="inner", eq_conds=conds,
+                    )
+                    memo.offer(mask, GroupExpr(plan, cost, rows))
+
+    win = memo.best(full)
+    if win is None:  # disconnected graph with no cross pass hit (unreachable)
+        return None
+    tree = win.plan
+    if leftover:
+        sel = LSelection(schema=list(tree.schema), children=[tree],
+                         cond=conj_join(leftover))
+        return pushdown_rule(sel)
+    return tree

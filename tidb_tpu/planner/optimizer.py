@@ -19,6 +19,7 @@ def plan_statement(
     catalog,
     db: str = "test",
     execute_subplan: Optional[Callable] = None,
+    cascades: bool = False,
 ) -> PhysicalPlan:
     """SELECT/UNION AST -> optimized physical plan."""
     assert isinstance(stmt, (A.SelectStmt, A.UnionStmt)), type(stmt)
@@ -26,5 +27,6 @@ def plan_statement(
         catalog=catalog, db=db, binder=Binder(), execute_subplan=execute_subplan
     )
     logical = build_select(stmt, ctx)
-    logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or ())
+    logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or (),
+                               cascades=cascades)
     return lower(logical)

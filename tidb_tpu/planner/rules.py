@@ -528,7 +528,7 @@ def _match_leading(leaves, leading):
         by_name[n.lower()] for n in leading if n.lower() in by_name))
 
 
-def _rule_reorder(plan: LogicalPlan, leading=None) -> LogicalPlan:
+def _rule_reorder(plan: LogicalPlan, leading=None, cascades=False) -> LogicalPlan:
     if getattr(plan, "_block_boundary", False):
         leading = None  # hints don't cross into derived query blocks
     if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
@@ -538,21 +538,30 @@ def _rule_reorder(plan: LogicalPlan, leading=None) -> LogicalPlan:
         # here — not to derived tables / subquery joins below. A hint
         # matching no leaf (typo'd alias) is ignored entirely.
         if leading and len(leaves) >= 2 and _match_leading(leaves, leading):
-            leaves = [_rule_reorder(l) for l in leaves]
+            # the hint pins THIS block's order; subtrees keep the
+            # session's planner mode
+            leaves = [_rule_reorder(l, cascades=cascades) for l in leaves]
             return _forced_order(leaves, eqs, others, leading)
         if len(leaves) > 2:
-            leaves = [_rule_reorder(l) for l in leaves]
+            leaves = [_rule_reorder(l, cascades=cascades) for l in leaves]
+            if cascades:
+                from tidb_tpu.planner.cascades import memo_join_search
+
+                best = memo_join_search(leaves, eqs, others, _classify_edges,
+                                        _conj_join, _rule_pushdown)
+                if best is not None:
+                    return best
             return _greedy_order(leaves, eqs, others)
-    plan.children = [_rule_reorder(c, leading) for c in plan.children]
+    plan.children = [_rule_reorder(c, leading, cascades) for c in plan.children]
     return plan
 
 
 # ---------------------------------------------------------------------------
 
-def optimize_logical(plan: LogicalPlan, hints=()) -> LogicalPlan:
+def optimize_logical(plan: LogicalPlan, hints=(), cascades=False) -> LogicalPlan:
     plan = _rule_fold(plan)
     plan = _rule_pushdown(plan)
     leading = next((args for name, args in hints if name == "leading"), None)
-    plan = _rule_reorder(plan, leading)
+    plan = _rule_reorder(plan, leading, cascades)
     plan = _rule_prune(plan, None)
     return plan

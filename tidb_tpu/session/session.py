@@ -268,7 +268,8 @@ class Session:
 
     def _plan_select(self, stmt):
         return plan_statement(
-            stmt, self.catalog, db=self.db, execute_subplan=self._execute_subplan
+            stmt, self.catalog, db=self.db, execute_subplan=self._execute_subplan,
+            cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
         )
 
     def _apply_binding(self, stmt):
@@ -395,16 +396,15 @@ class Session:
                 source = getattr(stmt, "_source", None)
                 if source:
                     job = self.catalog.submit_ddl(source, self.db)
-                    deadline = 60
+                    # no arbitrary deadline: abandoning a RUNNING job
+                    # would release the statement lock while its worker
+                    # still mutates the catalog (unserialized). We only
+                    # fail fast when no worker remains to ever run it —
+                    # a genuinely stuck DDL behaves like stuck inline
+                    # DDL, which also holds the lock.
                     while not job.done.wait(timeout=1):
-                        deadline -= 1
-                        # all workers gone while we waited: fail fast
-                        # instead of sitting out the whole timeout
-                        # holding the statement lock
                         if not self.catalog.ddl_workers:
                             self.catalog.drain_ddl_jobs("DDL owner shut down")
-                        if deadline <= 0:
-                            job.fail(ExecutionError("DDL job timed out"))
                     if job.error is not None:
                         raise job.error
                     return None

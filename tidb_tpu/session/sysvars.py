@@ -43,6 +43,9 @@ _reg(
     # non-empty: name of an installed executor plugin that builds the
     # operator tree instead of the built-in builders (ref: plugin/)
     SysVar("tidb_executor_plugin", "", BOTH, "str"),
+    # memo-based exhaustive join-order search (ref: planner/cascades
+    # and the sysvar of the same name); greedy ordering otherwise
+    SysVar("tidb_enable_cascades_planner", False, BOTH, "bool"),
     SysVar("tidb_gc_enable", True, BOTH, "bool"),
     # statements slower than this (ms) go to the slow-query log
     SysVar("tidb_slow_log_threshold", 300, BOTH, "int", min_=0, max_=1 << 31),
