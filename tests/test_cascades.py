@@ -33,6 +33,38 @@ where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
 group by o_orderkey order by rev desc limit 10"""
 
 
+
+
+def modeled_cost(session, sql, cascades):
+    """Sum of modeled intermediate join cardinalities for the optimized
+    logical plan of `sql` (shared by the cost-dominance tests)."""
+    from tidb_tpu.parser import parse
+    from tidb_tpu.planner.binder import Binder
+    from tidb_tpu.planner.logical import BuildContext, LJoin, build_select
+    from tidb_tpu.planner.physical import _estimate, eq_join_rows
+    from tidb_tpu.planner.rules import optimize_logical
+
+    total = 0.0
+
+    def walk(p):
+        nonlocal total
+        for ch in getattr(p, "children", []):
+            walk(ch)
+        if isinstance(p, LJoin) and p.kind in ("inner", "cross"):
+            l, r = p.children
+            if p.eq_conds:
+                total += float(eq_join_rows(
+                    l, r, p.eq_conds, _estimate(l), _estimate(r)))
+            else:
+                total += float(_estimate(l)) * float(_estimate(r))
+
+    ctx = BuildContext(catalog=session.catalog, db="test", binder=Binder(),
+                       execute_subplan=session._execute_subplan)
+    logical = build_select(parse(sql)[0], ctx)
+    walk(optimize_logical(logical, cascades=cascades))
+    return total
+
+
 class TestCascades:
     def _both(self, tpch, sql):
         s, oracle = tpch
@@ -58,39 +90,11 @@ class TestCascades:
     def test_memo_cost_never_worse_than_greedy(self, tpch):
         """The memo search is exhaustive under the shared cost model, so
         its chosen plan's modeled cost must be <= greedy's."""
-        from tidb_tpu.parser import parse
-        from tidb_tpu.planner.binder import Binder
-        from tidb_tpu.planner.logical import BuildContext, LJoin, build_select
-        from tidb_tpu.planner.physical import _estimate, eq_join_rows
-        from tidb_tpu.planner.rules import optimize_logical
-
         s, _ = tpch
+        greedy = modeled_cost(s, Q5ISH, cascades=False)
+        memo = modeled_cost(s, Q5ISH, cascades=True)
+        assert memo <= greedy * 1.0001
 
-        def modeled_cost(plan):
-            """Sum of modeled intermediate join cardinalities."""
-            total = 0.0
-
-            def walk(p):
-                nonlocal total
-                for c in getattr(p, "children", []):
-                    walk(c)
-                if isinstance(p, LJoin) and p.kind == "inner":
-                    l, r = p.children
-                    total += float(eq_join_rows(
-                        l, r, p.eq_conds, _estimate(l), _estimate(r)))
-
-            walk(plan)
-            return total
-
-        stmt = parse(Q5ISH)[0]
-        costs = {}
-        for cascades in (False, True):
-            ctx = BuildContext(catalog=s.catalog, db="test", binder=Binder(),
-                               execute_subplan=s._execute_subplan)
-            logical = build_select(stmt, ctx)
-            logical = optimize_logical(logical, cascades=cascades)
-            costs[cascades] = modeled_cost(logical)
-        assert costs[True] <= costs[False] * 1.0001
 
     def test_memo_beats_greedy_on_adversarial_shape(self):
         """A shape where greedy's cheapest-first seeding is a trap: the
@@ -100,12 +104,6 @@ class TestCascades:
         Shape: greedy seeds at the smallest table `a`, whose only edge
         is a huge fanout into `b` (cost 1000 + 1000); the memo search
         reduces the selective `b-c` edge first (300 + 1000)."""
-        from tidb_tpu.parser import parse
-        from tidb_tpu.planner.binder import Binder
-        from tidb_tpu.planner.logical import BuildContext, LJoin, build_select
-        from tidb_tpu.planner.physical import _estimate, eq_join_rows
-        from tidb_tpu.planner.rules import optimize_logical
-
         s = Session(chunk_capacity=1024)
         s.execute("create table a (k bigint)")
         s.execute("create table b (k bigint, m bigint)")
@@ -118,28 +116,7 @@ class TestCascades:
         s.execute("analyze table a, b, c")
         sql = ("select count(*) from a, b, c"
                " where a.k = b.k and b.m = c.m")
-
-        def modeled_cost(cascades):
-            total = 0.0
-
-            def walk(p):
-                nonlocal total
-                for ch in getattr(p, "children", []):
-                    walk(ch)
-                if isinstance(p, LJoin) and p.kind == "inner":
-                    l, r = p.children
-                    total += float(eq_join_rows(
-                        l, r, p.eq_conds, _estimate(l), _estimate(r)))
-
-            ctx = BuildContext(catalog=s.catalog, db="test", binder=Binder(),
-                               execute_subplan=s._execute_subplan)
-            logical = build_select(parse(sql)[0], ctx)
-            walk(optimize_logical(logical, cascades=cascades))
-            return total
-
-        greedy_cost, memo_cost = modeled_cost(False), modeled_cost(True)
-        assert memo_cost < greedy_cost, (memo_cost, greedy_cost)
-
+        assert modeled_cost(s, sql, True) < modeled_cost(s, sql, False)
         want = None
         for flag in ("1", "0"):
             s.execute(f"set tidb_enable_cascades_planner = {flag}")
@@ -147,3 +124,25 @@ class TestCascades:
             if want is None:
                 want = got
             assert got == want
+
+    def test_disconnected_graph_crosses_late(self):
+        """Cross splits must be enumerated even when connected splits
+        exist: with only an a-b edge, the best plan joins a-b first and
+        crosses c LAST — a connected-only gate would force an early
+        cartesian product and lose to greedy."""
+        s = Session(chunk_capacity=1024)
+        s.execute("create table a (k bigint)")
+        s.execute("create table b (k bigint)")
+        s.execute("create table c (z bigint)")
+        s.execute("insert into a values (1)")
+        s.execute("insert into b values " + ", ".join(f"({i})" for i in range(200)))
+        s.execute("insert into c values " + ", ".join(f"({i})" for i in range(200)))
+        s.execute("analyze table a, b, c")
+        sql = "select count(*) from a, b, c where a.k = b.k"
+        greedy = modeled_cost(s, sql, False)
+        memo = modeled_cost(s, sql, True)
+        assert memo <= greedy * 1.0001, (memo, greedy)
+        s.execute("set tidb_enable_cascades_planner = 1")
+        n_memo = s.query(sql)
+        s.execute("set tidb_enable_cascades_planner = 0")
+        assert n_memo == s.query(sql) == [(200,)]

@@ -1,0 +1,135 @@
+"""Multi-host DCN tier: coprocessor fan-out over host RPC (ref:
+distsql's per-region gRPC fan-out; VERDICT row 33 "no host-RPC/DCN
+tier"). Two REAL worker subprocesses, each owning a row-range partition;
+the coordinator fans out partial aggregates and merges."""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tidb_tpu.parallel.dcn import Cluster, partial_rewrite
+from tidb_tpu.session import Session
+
+DDL = ("create table m (k bigint, grp varchar(8), v bigint, f double,"
+       " p decimal(10,2), d date)")
+
+GROUPS = ["aa", "bb", "cc", None]
+
+
+def _rows(lo, hi, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(lo, hi):
+        g = GROUPS[rng.integers(0, 4)]
+        v = int(rng.integers(-50, 50)) if rng.random() > 0.1 else None
+        f = float(rng.normal()) if rng.random() > 0.1 else None
+        p = f"{rng.integers(0, 9999) / 100:.2f}"
+        d = f"199{rng.integers(0, 9)}-0{rng.integers(1, 9)}-1{rng.integers(0, 9)}"
+        out.append((i, g, v, f, p, d))
+    return out
+
+
+def _values(rows):
+    return ", ".join(
+        "(" + ", ".join(
+            "null" if x is None else (f"'{x}'" if isinstance(x, str) else str(x))
+            for x in r) + ")"
+        for r in rows)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs, ports = [], []
+    for _ in range(2):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.parallel.dcn", "--device", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=os.path.dirname(os.path.dirname(__file__)))
+        line = p.stdout.readline()
+        m = re.search(r"DCN_WORKER_PORT=(\d+)", line)
+        assert m, f"worker failed to start: {line!r}"
+        procs.append(p)
+        ports.append(int(m.group(1)))
+    cl = Cluster([("127.0.0.1", port) for port in ports])
+    cl.broadcast_exec(DDL)
+    # row-range partitions, loaded through each worker's SQL surface
+    cl._call(0, {"cmd": "exec", "sql": f"insert into m values {_values(_rows(0, 400, 1))}"})
+    cl._call(1, {"cmd": "exec", "sql": f"insert into m values {_values(_rows(400, 700, 2))}"})
+    yield cl
+    cl.shutdown()
+    for p in procs:
+        p.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    s = Session(chunk_capacity=1024)
+    s.execute(DDL)
+    s.execute(f"insert into m values {_values(_rows(0, 400, 1))}")
+    s.execute(f"insert into m values {_values(_rows(400, 700, 2))}")
+    return s
+
+
+QUERIES = [
+    # Q1-shape: filter + multi-agg group by
+    ("select grp, count(*) as n, sum(v) as sv, avg(v) as av, min(f) as mf,"
+     " max(f) as xf from m where k < 600 group by grp order by grp"),
+    # global aggregate, no groups
+    ("select count(*) as n, sum(p) as sp, avg(f) as af from m"),
+    # Q6-shape: selective filter, single sum
+    ("select sum(v) as rev from m where d >= '1995-01-01' and v > 0"),
+    # count(col) vs count(*) NULL semantics
+    ("select grp, count(v) as cv, count(*) as ca from m group by grp order by grp"),
+    # expression inside the aggregate
+    ("select grp, sum(v * 2 + 1) as s2 from m where v is not null"
+     " group by grp order by grp"),
+]
+
+
+class TestDcn:
+    @pytest.mark.parametrize("sql", QUERIES)
+    def test_distributed_matches_single_node(self, cluster, oracle, sql):
+        got = cluster.query(sql)
+        want = oracle.query(sql)
+        def norm(rows):
+            out = []
+            for r in rows:
+                out.append(tuple(
+                    round(x, 6) if isinstance(x, float) else x for x in r))
+            return out
+        assert norm(got) == norm(want), f"{sql}\n{got}\nvs\n{want}"
+
+    def test_limit_and_order(self, cluster, oracle):
+        sql = ("select grp, sum(v) as sv from m where grp is not null"
+               " group by grp order by sv desc limit 2")
+        assert cluster.query(sql) == oracle.query(sql)
+
+    def test_worker_error_propagates(self, cluster):
+        from tidb_tpu.errors import ExecutionError
+
+        with pytest.raises(ExecutionError):
+            cluster.query("select sum(nosuch) as s from m")
+
+    def test_unsupported_shapes_rejected(self, cluster):
+        from tidb_tpu.errors import UnsupportedError
+
+        with pytest.raises(UnsupportedError):
+            partial_rewrite("select a.v from m a join m b on a.k = b.k")
+        with pytest.raises(UnsupportedError):
+            partial_rewrite("select count(distinct grp) from m")
+
+
+class TestPartialRewrite:
+    def test_shape(self):
+        p, f, names = partial_rewrite(
+            "select grp, avg(v) as a, count(*) as c from m"
+            " where v > 0 group by grp order by grp")
+        assert "sum(" in p and "count(" in p and "where" in p
+        assert "__dcn_partial__" in f and "group by" in f
+        assert names == ["grp", "a", "c"]

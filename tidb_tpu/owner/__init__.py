@@ -111,6 +111,12 @@ class DDLWorker:
         # two workers would then run the same DDL concurrently
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # a long DDL is still executing: the worker must stay
+                # registered (its claimed job must not be reclaimed or
+                # drained mid-run). It exits its loop when the job ends;
+                # the caller may stop() again then.
+                return
         self.catalog.ddl_workers.pop(self.worker_id, None)
         self.catalog.ddl_owner.resign(self.worker_id)
         # last worker out fails everything still pending — a submitter

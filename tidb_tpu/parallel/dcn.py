@@ -1,0 +1,400 @@
+"""Multi-host tier: coprocessor fan-out over host RPC (ref: distsql's
+per-region gRPC fan-out to TiKV coprocessors; SURVEY.md §7.6 "DCN tier +
+host RPC after single-slice works").
+
+Architecture (the reference's shape, re-mapped):
+
+    coordinator (this process)          workers (one process per host)
+    ───────────────────────────        ─────────────────────────────────
+    parse + plan the query              own a row-range PARTITION of
+    rewrite agg -> partial form         each table (region analogue)
+    fan out partial SQL over RPC   ->   run scan+filter+partial-agg on
+    merge partial states by group       their local backend (their own
+    key via a final agg (MPP final      chip/mesh — the ICI tier works
+    stage on the coordinator)      <-   below this one unchanged)
+
+Partial/final split: COUNT->SUM of counts, SUM->SUM, MIN/MAX->MIN/MAX,
+AVG->SUM(sum)/SUM(count). Group keys travel as decoded host values, so
+workers' independent string dictionaries never need reconciling — the
+same reason the reference's coprocessor returns datums, not its
+storage-internal encodings.
+
+Transport: length-prefixed pickles over TCP. Like the reference's
+intra-cluster gRPC, this is a CLUSTER-INTERNAL protocol: workers
+execute SQL for the coordinator by design, so it must only ever listen
+inside the cluster's trust boundary (loopback/private network).
+
+Failure handling mirrors the reference's region-error model: a worker
+RPC failure fails the query with a diagnosable error (retry/replica
+logic would slot in at Cluster._call)."""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tidb_tpu.errors import ExecutionError, UnsupportedError
+from tidb_tpu.parser import ast as A
+from tidb_tpu.parser import parse
+from tidb_tpu.parser.printer import expr_to_sql
+
+__all__ = ["Worker", "Cluster", "partial_rewrite"]
+
+_LEN = struct.Struct(">I")
+
+
+def _send(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv(sock: socket.socket):
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+
+class Worker:
+    """One host's coprocessor service: a Session over its partition."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from tidb_tpu.session import Session
+
+        self.session = Session()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(4)
+        self._running = False
+
+    def serve_forever(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = _recv(conn)
+                try:
+                    _send(conn, {"ok": True, "result": self._handle(msg)})
+                except Exception as e:  # noqa: BLE001 — error travels back
+                    _send(conn, {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}"})
+                if msg.get("cmd") == "shutdown":
+                    self._running = False
+                    try:
+                        # close() alone doesn't wake a thread blocked in
+                        # accept() on Linux; shutdown() does
+                        self._sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self._sock.close()
+                    return
+        except (ConnectionError, OSError):
+            pass
+
+    def _handle(self, msg: Dict):
+        cmd = msg["cmd"]
+        if cmd == "ping":
+            return "pong"
+        if cmd == "exec":
+            rs = self.session.execute(msg["sql"])
+            return rs.rows if rs is not None else None
+        if cmd == "load_columns":
+            table = self.session.catalog.table("test", msg["table"])
+            return table.insert_columns(
+                msg.get("arrays") or {}, msg.get("valids"),
+                strings=msg.get("strings"))
+        if cmd == "partial":
+            rs = self.session.execute(msg["sql"])
+            return rs.rows
+        if cmd == "shutdown":
+            return "bye"
+        raise ExecutionError(f"unknown dcn command {cmd!r}")
+
+
+def worker_main(argv=None) -> None:  # pragma: no cover - subprocess entry
+    """python -m tidb_tpu.parallel.dcn [--port N]; prints the bound port."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--device", default=None,
+                    help="force a jax platform (e.g. cpu) before serving")
+    args = ap.parse_args(argv)
+    if args.device:
+        import jax
+
+        jax.config.update("jax_platforms", args.device)
+    w = Worker(args.host, args.port)
+    print(f"DCN_WORKER_PORT={w.port}", flush=True)
+    sys.stdout.flush()
+    w.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    worker_main()
+
+
+# ---------------------------------------------------------------------------
+# partial/final rewrite
+# ---------------------------------------------------------------------------
+
+_DIST_AGGS = {"count", "sum", "min", "max", "avg"}
+
+
+def partial_rewrite(sql: str) -> Tuple[str, str, List[str]]:
+    """One single-table aggregate SELECT -> (partial_sql, final_sql,
+    out_names). partial_sql runs on every worker; its result rows are
+    unioned into the staging table __dcn_partial__ on the coordinator,
+    where final_sql computes the merge (incl. HAVING-free ORDER BY /
+    LIMIT from the original)."""
+    stmts = parse(sql)
+    if len(stmts) != 1 or not isinstance(stmts[0], A.SelectStmt):
+        raise UnsupportedError("dcn tier handles a single SELECT")
+    st = stmts[0]
+    if not isinstance(st.from_, A.TableName) or st.having is not None \
+            or st.distinct or st.ctes:
+        raise UnsupportedError(
+            "dcn tier pushes single-table aggregates (the coprocessor "
+            "shape); joins execute above it")
+
+    group_sqls = [expr_to_sql(g) for g in st.group_by]
+    part_items: List[str] = []
+    final_items: List[str] = []
+    out_names: List[str] = []
+    gcol: Dict[str, str] = {}
+    for i, g in enumerate(group_sqls):
+        gname = f"g{i}"
+        part_items.append(f"{g} as {gname}")
+        gcol[g] = gname
+
+    for i, item in enumerate(st.items):
+        e = item.expr
+        alias = item.alias or (
+            e.name if isinstance(e, A.EName) else f"col{i}")
+        out_names.append(alias)
+        esql = expr_to_sql(e)
+        if esql in gcol:  # a group-by column in output position
+            final_items.append(f"{gcol[esql]} as `{alias}`")
+            continue
+        if not (isinstance(e, A.EFunc) and e.name in _DIST_AGGS):
+            raise UnsupportedError(
+                f"dcn output must be group columns or plain aggregates, got {esql}")
+        if e.distinct:
+            raise UnsupportedError("dcn tier: DISTINCT aggregates")
+        argsql = expr_to_sql(e.args[0]) if e.args else "*"
+        if e.name == "count":
+            part_items.append(f"count({argsql}) as p{i}")
+            final_items.append(f"sum(p{i}) as `{alias}`")
+        elif e.name in ("sum", "min", "max"):
+            part_items.append(f"{e.name}({argsql}) as p{i}")
+            final_items.append(f"{e.name}(p{i}) as `{alias}`")
+        else:  # avg = sum of sums / sum of counts
+            part_items.append(f"sum({argsql}) as p{i}s")
+            part_items.append(f"count({argsql}) as p{i}c")
+            final_items.append(f"sum(p{i}s) / sum(p{i}c) as `{alias}`")
+
+    tname = st.from_.name
+    where = f" where {expr_to_sql(st.where)}" if st.where is not None else ""
+    groupby = f" group by {', '.join(group_sqls)}" if group_sqls else ""
+    partial_sql = (f"select {', '.join(part_items)} from `{tname}`"
+                   f"{where}{groupby}")
+
+    fgroup = f" group by {', '.join(gcol.values())}" if gcol else ""
+    order = ""
+    if st.order_by:
+        terms = []
+        for o in st.order_by:
+            osql = expr_to_sql(o.expr)
+            if isinstance(o.expr, A.EName) and o.expr.qualifier is None \
+                    and o.expr.name in out_names:
+                ref = f"`{o.expr.name}`"
+            elif osql in gcol:
+                ref = gcol[osql]
+            else:
+                raise UnsupportedError(
+                    "dcn ORDER BY must reference output aliases or group columns")
+            terms.append(ref + (" desc" if o.desc else ""))
+        order = " order by " + ", ".join(terms)
+    limit = f" limit {st.limit}" if st.limit is not None else ""
+    offset = f" offset {st.offset}" if st.offset is not None else ""
+    final_sql = (f"select {', '.join(final_items)} from `__dcn_partial__`"
+                 f"{fgroup}{order}{limit}{offset}")
+    return partial_sql, final_sql, out_names
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+
+
+class Cluster:
+    """Coordinator-side handle on the worker fleet."""
+
+    def __init__(self, endpoints: List[Tuple[str, int]]):
+        self._socks: List[socket.socket] = []
+        for host, port in endpoints:
+            s = socket.create_connection((host, port), timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+        from tidb_tpu.session import Session
+
+        self._merge_session = Session()
+
+    def __len__(self):
+        return len(self._socks)
+
+    def _call(self, i: int, msg: Dict):
+        sock = self._socks[i]
+        _send(sock, msg)
+        resp = _recv(sock)
+        if not resp["ok"]:
+            raise ExecutionError(f"dcn worker {i}: {resp['error']}")
+        return resp["result"]
+
+    def _call_all(self, msgs: List[Dict]) -> List:
+        """One message per worker, dispatched concurrently."""
+        results: List = [None] * len(self._socks)
+        errors: List = []
+
+        def run(i):
+            try:
+                results[i] = self._call(i, msgs[i])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(self._socks))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return results
+
+    def broadcast_exec(self, sql: str) -> None:
+        self._call_all([{"cmd": "exec", "sql": sql}] * len(self._socks))
+
+    def load_partition(self, worker: int, table: str, arrays=None,
+                       valids=None, strings=None) -> int:
+        return self._call(worker, {
+            "cmd": "load_columns", "table": table, "arrays": arrays,
+            "valids": valids, "strings": strings,
+        })
+
+    def query(self, sql: str, schema_sql: Optional[str] = None) -> List[tuple]:
+        """Distributed aggregate: partial on every worker, final merge
+        here. schema_sql overrides the staging table DDL; by default
+        column types are inferred from the partial rows."""
+        partial_sql, final_sql, _names = partial_rewrite(sql)
+        worker_rows = self._call_all(
+            [{"cmd": "partial", "sql": partial_sql}] * len(self._socks))
+        all_rows = [r for rows in worker_rows for r in rows]
+        s = self._merge_session
+        s.execute("drop table if exists __dcn_partial__")
+        if schema_sql is not None:
+            s.execute(schema_sql)
+        else:
+            s.execute(self._infer_staging_ddl(partial_sql, all_rows))
+        if all_rows:
+            # batched inserts through the coordinator's own SQL surface
+            for start in range(0, len(all_rows), 512):
+                chunk = all_rows[start : start + 512]
+                vals = ", ".join(
+                    "(" + ", ".join(_sql_literal(v) for v in r) + ")"
+                    for r in chunk)
+                s.execute(f"insert into __dcn_partial__ values {vals}")
+        return s.query(final_sql)
+
+    def _infer_staging_ddl(self, partial_sql: str, rows: List[tuple]) -> str:
+        # column names from the partial SELECT's aliases
+        items = parse(partial_sql)[0].items
+        names = [it.alias for it in items]
+        cols = []
+        for j, name in enumerate(names):
+            cols.append(f"`{name}` {_infer_type(r[j] for r in rows)}")
+        return "create table __dcn_partial__ (" + ", ".join(cols) + ")"
+
+    def shutdown(self) -> None:
+        for i in range(len(self._socks)):
+            try:
+                self._call(i, {"cmd": "shutdown"})
+            except Exception:  # noqa: BLE001
+                pass
+        self.close()
+
+    def close(self) -> None:
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks = []
+
+
+def _infer_type(values) -> str:
+    import datetime
+    import re
+
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return "boolean"
+        if isinstance(v, int):
+            return "bigint"
+        if isinstance(v, float):
+            return "double"
+        if isinstance(v, datetime.datetime):
+            return "datetime"
+        if isinstance(v, datetime.date):
+            return "date"
+        if isinstance(v, str):
+            m = re.fullmatch(r"-?\d+\.(\d+)", v)
+            if m:  # decimal partials arrive as exact strings
+                return f"decimal(18,{len(m.group(1))})"
+            return "varchar(64)"
+    return "bigint"
+
+
+def _sql_literal(v) -> str:
+    import datetime
+
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return "'" + str(v) + "'"
+    return "'" + str(v).replace("'", "''") + "'"

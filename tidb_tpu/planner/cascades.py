@@ -92,41 +92,42 @@ def memo_join_search(leaves: List[LogicalPlan], eqs, others,
 
     for size in range(2, n + 1):
         for mask in by_size[size]:
-            connected_found = False
-            for pass_cross in (False, True):
-                if pass_cross and connected_found:
-                    break  # cross joins only when no connected split exists
-                for s1, s2 in _splits(mask):
-                    g1, g2 = memo.best(s1), memo.best(s2)
-                    if g1 is None or g2 is None:
-                        continue
-                    conds = []
-                    for ia, ib, a, b in edges:
-                        if (mask >> ia & 1) and (mask >> ib & 1):
-                            if (s1 >> ia & 1) and (s2 >> ib & 1):
-                                conds.append((a, b))
-                            elif (s1 >> ib & 1) and (s2 >> ia & 1):
-                                conds.append((b, a))
-                    if not pass_cross and not conds:
-                        continue
-                    if conds:
-                        connected_found = True
-                        rows = float(eq_join_rows(
-                            g1.plan, g2.plan, conds, g1.rows, g2.rows))
-                    else:
-                        rows = g1.rows * g2.rows
-                    cost = g1.cost + g2.cost + rows
-                    cur = memo.best(mask)
-                    if cur is not None and cost >= cur.cost:
-                        continue
-                    # build-side choice is lower()'s job (it compares
-                    # post-pushdown estimates and sets build_side)
-                    plan = LJoin(
-                        schema=list(g1.plan.schema) + list(g2.plan.schema),
-                        children=[g1.plan, g2.plan],
-                        kind="inner", eq_conds=conds,
-                    )
-                    memo.offer(mask, GroupExpr(plan, cost, rows))
+            # EVERY split is enumerated, cross splits included: the best
+            # plan for a disconnected graph may require crossing late
+            # ((a JOIN b) x c beats (a x c) JOIN b when ab is tiny), and
+            # the cost model already penalizes cartesian blowups — a
+            # "connected splits only" gate would wrongly prune them
+            for s1, s2 in _splits(mask):
+                g1, g2 = memo.best(s1), memo.best(s2)
+                if g1 is None or g2 is None:
+                    continue
+                conds = []
+                for ia, ib, a, b in edges:
+                    if (mask >> ia & 1) and (mask >> ib & 1):
+                        if (s1 >> ia & 1) and (s2 >> ib & 1):
+                            conds.append((a, b))
+                        elif (s1 >> ib & 1) and (s2 >> ia & 1):
+                            conds.append((b, a))
+                if conds:
+                    rows = float(eq_join_rows(
+                        g1.plan, g2.plan, conds, g1.rows, g2.rows))
+                else:
+                    rows = g1.rows * g2.rows
+                cost = g1.cost + g2.cost + rows
+                cur = memo.best(mask)
+                if cur is not None and cost >= cur.cost:
+                    continue
+                # build-side choice is lower()'s job (it compares
+                # post-pushdown estimates and sets build_side)
+                # kind stays "inner" even with no conds — the lowering
+                # treats empty eq_conds as the cross join, matching the
+                # greedy orderer's convention
+                plan = LJoin(
+                    schema=list(g1.plan.schema) + list(g2.plan.schema),
+                    children=[g1.plan, g2.plan],
+                    kind="inner", eq_conds=conds,
+                )
+                memo.offer(mask, GroupExpr(plan, cost, rows))
 
     win = memo.best(full)
     if win is None:  # disconnected graph with no cross pass hit (unreachable)

@@ -259,7 +259,9 @@ class Session:
 
     def _execute_subplan(self, logical) -> List[tuple]:
         """Planner callback: run a bound logical subplan to completion."""
-        logical = optimize_logical(logical)
+        logical = optimize_logical(
+            logical,
+            cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")))
         phys = lower(logical)
         root = build_executor(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
