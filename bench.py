@@ -21,9 +21,13 @@ back to the CPU backend instead of wedging the bench (round-1 failure
 mode). Any per-metric failure is recorded in `extra` instead of killing
 the artifact; a top-level failure still prints a diagnosable JSON line.
 
+`extra` also carries the SSB Q3.2 (4-way star join) and TPC-DS Q95
+(semi-join) BASELINE configs.
+
 Env knobs: BENCH_SF (default 1.0), BENCH_SF_Q18 (default min(SF, 0.2) —
 Q18's group-by cardinality is ~#orders; see extra.q18_sf for the value
-used), BENCH_REPS (default 3), BENCH_CHUNK (default 2^20 rows),
+used), BENCH_SF_SSB (default min(SF, 0.1)), BENCH_SF_DS (default
+min(SF, 0.5)), BENCH_REPS (default 3), BENCH_CHUNK (default 2^20 rows),
 BENCH_ORACLE=0 to skip sqlite baselines, BENCH_PROBE_TIMEOUT (default
 120s), BENCH_PLATFORM to force a platform and skip the probe.
 """
@@ -41,6 +45,8 @@ CAP = int(os.environ.get("BENCH_CHUNK", str(1 << 20)))
 ORACLE = os.environ.get("BENCH_ORACLE", "1") != "0"
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 SF_Q18 = float(os.environ.get("BENCH_SF_Q18", str(min(SF, 0.2))))
+SF_SSB = float(os.environ.get("BENCH_SF_SSB", str(min(SF, 0.1))))
+SF_DS = float(os.environ.get("BENCH_SF_DS", str(min(SF, 0.5))))
 
 
 def log(msg):
@@ -75,7 +81,8 @@ def pick_platform():
     return "cpu", last
 
 
-def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS):
+def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
+                ordered=True):
     """Run engine_sql reps times; cross-check once vs sqlite. Returns
     (rows_per_sec, vs_sqlite, best_s, check)."""
     from tidb_tpu.testutil import rows_equal
@@ -95,7 +102,7 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS):
             t0 = time.perf_counter()
             want = sqlite_conn.execute(sqlite_sql).fetchall()
             cpu_s = min(cpu_s, time.perf_counter() - t0)
-        ok, msg = rows_equal(got, want, ordered=True)
+        ok, msg = rows_equal(got, want, ordered=ordered)
         check = "ok" if ok else f"MISMATCH: {msg}"
         vs = cpu_s / best
     log(f"#   warm={warm:.2f}s best={best * 1e3:.1f}ms"
@@ -206,6 +213,52 @@ def main():
             extra["q18_check"] = check
     except Exception as e:  # noqa: BLE001
         extra["q18_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # SSB Q3.2: 4-way star join (BASELINE flagship config) -------------------
+    try:
+        log(f"# ssb q3.2 at sf={SF_SSB}")
+        from tidb_tpu.storage.ssb import SSB_QUERIES, load_ssb
+
+        s_ssb = Session(chunk_capacity=CAP, mesh=mesh)
+        c_ssb = load_ssb(s_ssb.catalog, sf=SF_SSB)
+        conn_ssb = None
+        if ORACLE:
+            from tidb_tpu.testutil import mirror_to_sqlite
+
+            conn_ssb = mirror_to_sqlite(s_ssb.catalog)
+        sql = SSB_QUERIES["q3.2"]
+        # unordered: q3.2's ORDER BY doesn't break revenue ties
+        rps, vs, best, check = bench_query(
+            s_ssb, sql, conn_ssb, sql, c_ssb["lineorder"], ordered=False)
+        extra["ssb_q32_rows_per_sec"] = round(rps, 1)
+        extra["ssb_q32_vs_sqlite"] = round(vs, 3)
+        extra["ssb_sf"] = SF_SSB
+        if "MISMATCH" in check:
+            extra["ssb_q32_check"] = check
+    except Exception as e:  # noqa: BLE001
+        extra["ssb_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # TPC-DS Q95: semi-join / MPP exchange config ----------------------------
+    try:
+        log(f"# tpcds q95 at sf={SF_DS}")
+        from tidb_tpu.storage.tpcds import Q95, Q95_SQLITE, load_tpcds_q95
+
+        s_ds = Session(chunk_capacity=CAP, mesh=mesh)
+        c_ds = load_tpcds_q95(s_ds.catalog, sf=SF_DS)
+        conn_ds = None
+        if ORACLE:
+            from tidb_tpu.testutil import mirror_to_sqlite
+
+            conn_ds = mirror_to_sqlite(s_ds.catalog)
+        rps, vs, best, check = bench_query(
+            s_ds, Q95, conn_ds, Q95_SQLITE, c_ds["web_sales"])
+        extra["tpcds_q95_rows_per_sec"] = round(rps, 1)
+        extra["tpcds_q95_vs_sqlite"] = round(vs, 3)
+        extra["tpcds_sf"] = SF_DS
+        if "MISMATCH" in check:
+            extra["tpcds_q95_check"] = check
+    except Exception as e:  # noqa: BLE001
+        extra["tpcds_error"] = f"{type(e).__name__}: {e}"[:300]
 
     print(json.dumps({
         "metric": "tpch_q1_rows_per_sec",

@@ -1,0 +1,72 @@
+"""SSB (all 13 queries) + TPC-DS Q95 vs the sqlite oracle — the
+BASELINE.md eval configs beyond TPC-H ("SSB Q3.x: 4-way star join",
+"TPC-DS Q95: semi-join/correlated subquery")."""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage.ssb import SSB_QUERIES, load_ssb
+from tidb_tpu.storage.tpcds import Q95, Q95_SQLITE, load_tpcds_q95
+from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+
+
+@pytest.fixture(scope="module")
+def ssb():
+    s = Session(chunk_capacity=8192)
+    load_ssb(s.catalog, sf=0.002)
+    oracle = mirror_to_sqlite(s.catalog)
+    return s, oracle
+
+
+@pytest.fixture(scope="module")
+def tpcds():
+    s = Session(chunk_capacity=8192)
+    load_tpcds_q95(s.catalog, sf=0.2)
+    oracle = mirror_to_sqlite(s.catalog)
+    return s, oracle
+
+
+class TestSSB:
+    @pytest.mark.parametrize("name", sorted(SSB_QUERIES))
+    def test_query(self, ssb, name):
+        s, oracle = ssb
+        sql = SSB_QUERIES[name]
+        got = s.query(sql)
+        want = oracle.execute(sql).fetchall()
+        # unordered compare: q2/q3 ORDER BYs (e.g. d_year, revenue desc)
+        # don't fully determine row order, so ordered=True would flake on
+        # revenue ties; the ordering itself is asserted separately below
+        ok, msg = rows_equal(got, want, ordered=False)
+        assert ok, f"{name}: {msg}"
+
+    def test_q3_order_keys_respected(self, ssb):
+        s, _ = ssb
+        rows = s.query(SSB_QUERIES["q3.1"])
+        years = [r[2] for r in rows]
+        assert years == sorted(years)
+        for y in set(years):  # revenue desc within each year
+            revs = [float(r[3]) for r in rows if r[2] == y]  # decimals as str
+            assert revs == sorted(revs, reverse=True)
+
+    def test_flights_nonempty(self, ssb):
+        """The generator must populate every flight's selective slices
+        (empty results would make the oracle checks vacuous) — incl. the
+        city-specific q3.3/q3.4 ones."""
+        s, _ = ssb
+        assert s.query(SSB_QUERIES["q1.1"])[0][0] is not None
+        for name in ("q3.1", "q3.3", "q3.4", "q4.1"):
+            assert len(s.query(SSB_QUERIES[name])) > 0, name
+
+
+class TestTPCDSQ95:
+    def test_q95(self, tpcds):
+        s, oracle = tpcds
+        got = s.query(Q95)
+        want = oracle.execute(Q95_SQLITE).fetchall()
+        ok, msg = rows_equal(got, want, ordered=True)
+        assert ok, msg
+
+    def test_q95_nonempty(self, tpcds):
+        s, _ = tpcds
+        n = s.query(Q95)
+        assert n and n[0][0] and n[0][0] > 0, n

@@ -38,11 +38,15 @@ jax.config.update("jax_enable_x64", True)
 # backend here run through a remote AOT helper at ~60s+ per program, so
 # re-compiling known shapes across processes (tests, bench, server
 # restarts) is the single largest latency source. Degrades gracefully if
-# the backend can't serialize executables.
+# the backend can't serialize executables. The 10s threshold keeps fast
+# CPU compiles out of the cache: XLA:CPU AOT artifacts embed the compile
+# process's host-feature flags, and processes with/without the TPU
+# plugin loaded detect different CPU features — sharing those entries
+# risks SIGILL on load.
 _cache_dir = os.environ.get("TIDB_TPU_COMPILE_CACHE",
                             os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 if _cache_dir != "0":
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
 
 __version__ = "0.1.0"
