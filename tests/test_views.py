@@ -1,0 +1,115 @@
+"""Views (ref: the view half of ddl/ + planner/core's view expansion):
+stored SELECTs expanded at plan time like derived tables."""
+
+import pytest
+
+from tidb_tpu.errors import DuplicateTableError, PlanError, SchemaError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(chunk_capacity=256)
+    s.execute("create table t (a bigint, g varchar(4))")
+    s.execute("insert into t values (1,'x'),(2,'x'),(3,'y')")
+    return s
+
+
+class TestViews:
+    def test_basic_and_filter(self, sess):
+        sess.execute("create view v as select g, sum(a) as total from t group by g")
+        assert sess.query("select * from v order by g") == [("x", 3), ("y", 3)]
+        assert sess.query("select total from v where g = 'y'") == [(3,)]
+
+    def test_explicit_columns(self, sess):
+        sess.execute("create view v (grp, tot) as select g, sum(a) from t group by g")
+        assert sess.query("select grp, tot from v order by grp") == [("x", 3), ("y", 3)]
+
+    def test_or_replace(self, sess):
+        sess.execute("create view v as select a from t")
+        sess.execute("create or replace view v as select count(*) as n from t")
+        assert sess.query("select n from v") == [(3,)]
+
+    def test_view_over_view_and_join(self, sess):
+        sess.execute("create view v1 as select g, sum(a) as tot from t group by g")
+        sess.execute("create view v2 as select g, tot from v1 where tot > 2")
+        assert sess.query(
+            "select v2.g, t.a from v2 join t on t.g = v2.g order by v2.g, a") == \
+            [("x", 1), ("x", 2), ("y", 3)]
+
+    def test_show_tables_lists_views(self, sess):
+        sess.execute("create view v as select a from t")
+        assert ("v",) in sess.execute("show tables").rows
+        sess.execute("drop view v")
+        assert ("v",) not in sess.execute("show tables").rows
+
+    def test_duplicate_and_missing(self, sess):
+        sess.execute("create view v as select a from t")
+        with pytest.raises(DuplicateTableError):
+            sess.execute("create view v as select a from t")
+        with pytest.raises(DuplicateTableError):
+            sess.execute("create view t as select 1")  # clashes with table
+        with pytest.raises(SchemaError):
+            sess.execute("drop view nosuch")
+        sess.execute("drop view if exists nosuch")  # no error
+
+    def test_column_count_mismatch(self, sess):
+        sess.execute("create view v (one) as select a, g from t")
+        with pytest.raises(PlanError):  # detected at expansion time
+            sess.query("select * from v")
+
+    def test_self_reference_depth_limited(self, sess):
+        # a view created, then redefined to reference itself: expansion
+        # must stop with an error, not recurse forever
+        sess.execute("create view v as select a from t")
+        sess.catalog.database("test").views["v"] = (
+            None, sess.catalog.database("test").views["v"][1], "select * from v")
+        from tidb_tpu.parser import parse
+
+        sess.catalog.database("test").views["v"] = (
+            None, parse("select a from v")[0], "select a from v")
+        with pytest.raises(PlanError):
+            sess.query("select * from v")
+
+    def test_view_updates_reflect_base_table(self, sess):
+        sess.execute("create view v as select count(*) as n from t")
+        assert sess.query("select n from v") == [(3,)]
+        sess.execute("insert into t values (4, 'z')")
+        assert sess.query("select n from v") == [(4,)]
+
+    def test_view_resolves_in_defining_db(self, sess):
+        sess.execute("create database other")
+        sess.execute("create table other.src (x bigint)")
+        sess.execute("insert into other.src values (7)")
+        sess.execute("use other")
+        sess.execute("create view vv as select x from src")
+        sess.execute("use test")
+        # unqualified 'src' inside the view must resolve in `other`
+        assert sess.query("select x from other.vv") == [(7,)]
+
+    def test_caller_cte_does_not_shadow_view_tables(self, sess):
+        sess.execute("create view v as select sum(a) as s from t")
+        assert sess.query("with t as (select 99 as a) select s from v") == [(6,)]
+
+    def test_view_name_blocks_create_table(self, sess):
+        sess.execute("create view v as select a from t")
+        with pytest.raises(DuplicateTableError):
+            sess.execute("create table v (x bigint)")
+
+    def test_view_as_identifier_still_works(self, sess):
+        sess.execute("create table audit_t (view bigint)")
+        sess.execute("insert into audit_t values (5)")
+        assert sess.query("select view from audit_t") == [(5,)]
+
+    def test_information_schema_lists_views(self, sess):
+        sess.execute("create view v as select a from t")
+        rows = sess.query("select table_name, table_type from information_schema.tables"
+                          " where table_name = 'v'")
+        assert rows == [("v", "VIEW")]
+
+    def test_multi_drop_atomic(self, sess):
+        sess.execute("create view v1 as select a from t")
+        with pytest.raises(SchemaError):
+            sess.execute("drop view v1, nosuch")
+        # v1 must survive the failed multi-drop
+        assert ("v1",) in sess.execute("show tables").rows

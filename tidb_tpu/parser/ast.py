@@ -28,6 +28,7 @@ __all__ = [
     "CreateUserStmt", "DropUserStmt",
     "InstallPluginStmt", "UninstallPluginStmt",
     "CreateBindingStmt", "DropBindingStmt",
+    "CreateViewStmt", "DropViewStmt",
 ]
 
 
@@ -307,6 +308,22 @@ class ShowStmt:
     kind: str  # databases | tables | columns | variables | status | create_table
     target: Optional[str] = None
     like: Optional[str] = None
+
+@dataclass
+class CreateViewStmt:
+    name: str
+    columns: Optional[List[str]]
+    select: Union["SelectStmt", "UnionStmt"]
+    select_sql: str
+    or_replace: bool = False
+    schema: Optional[str] = None
+
+
+@dataclass
+class DropViewStmt:
+    names: List["TableName"]
+    if_exists: bool = False
+
 
 @dataclass
 class CreateBindingStmt:

@@ -450,6 +450,15 @@ class Parser:
             self.parse_statement()
             u_sql = self.sql[u_start : self.peek().pos].strip()
             return CreateBindingStmt(scope, t_sql, u_sql)
+        or_replace = False
+        if self.at_kw("or") and self.peek(1).text == "replace":
+            self.next()
+            self.next()
+            or_replace = True
+            self.expect_kw("view")
+            return self._create_view_tail(or_replace)
+        if self.accept_kw("view"):
+            return self._create_view_tail(False)
         if self.accept_kw("database") or self.accept_kw("schema"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.expect_ident(), ine)
@@ -598,6 +607,23 @@ class Parser:
             self.next()  # host part
         return user
 
+    def _create_view_tail(self, or_replace: bool) -> CreateViewStmt:
+        schema = None
+        name = self.expect_ident()
+        if self.accept_op("."):
+            schema, name = name, self.expect_ident()
+        cols = None
+        if self.accept_op("("):
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+        self.expect_kw("as")
+        start = self.peek().pos
+        sel = self.parse_select_or_union()
+        sql = self.sql[start : self.peek().pos].strip()
+        return CreateViewStmt(name, cols, sel, sql, or_replace, schema)
+
     def parse_drop(self):
         self.expect_kw("drop")
         scope = "session"
@@ -609,6 +635,12 @@ class Parser:
             self.parse_statement()
             sql = self.sql[start : self.peek().pos].strip()
             return DropBindingStmt(scope, sql)
+        if self.accept_kw("view"):
+            ie = self._if_exists()
+            names = [self._table_name()]
+            while self.accept_op(","):
+                names.append(self._table_name())
+            return DropViewStmt(names, ie)
         if self.accept_kw("database") or self.accept_kw("schema"):
             ie = self._if_exists()
             return DropDatabaseStmt(self.expect_ident(), ie)
@@ -1082,5 +1114,5 @@ _IDENTISH_KW = {
     "tables", "columns", "column", "user", "variables", "trace",
     # non-reserved in MySQL: usable as identifiers
     "binding", "bindings", "plugin", "plugins", "soname",
-    "install", "uninstall",
+    "install", "uninstall", "view",
 }

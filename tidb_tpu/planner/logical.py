@@ -210,6 +210,36 @@ def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalP
             sub = _realias(sub, cols)
             return sub, Scope(cols, outer)
         db = src.schema or ctx.db
+        view = ctx.catalog.view(db, src.name)
+        if view is not None:
+            # a view is a stored SELECT expanded like a derived table
+            # (ref: the view expansion in planner/core's PlanBuilder)
+            vcols, vstmt, _sql = view
+            depth = getattr(ctx, "_view_depth", 0)
+            if depth > 16:
+                raise PlanError(f"view nesting too deep at {src.name!r}")
+            # the body resolves in the view's DEFINING database with a
+            # clean name space: no caller CTEs (they must not shadow the
+            # view's tables) and no outer correlation
+            ctx._view_depth = depth + 1
+            saved_db, saved_ctes = ctx.db, ctx.ctes
+            ctx.db, ctx.ctes = db, {}
+            try:
+                sub = build_select(vstmt, ctx, None)
+            finally:
+                ctx._view_depth = depth
+                ctx.db, ctx.ctes = saved_db, saved_ctes
+            cols = [dataclasses.replace(c, qualifier=alias) for c in sub.schema]
+            if vcols is not None:
+                if len(vcols) != len(cols):
+                    raise PlanError(
+                        f"view {src.name!r} has {len(vcols)} columns, "
+                        f"SELECT yields {len(cols)}")
+                cols = [dataclasses.replace(c, name=n)
+                        for c, n in zip(cols, vcols)]
+            sub = _realias(sub, cols)
+            sub._block_boundary = True
+            return sub, Scope(cols, outer)
         table = ctx.catalog.table(db, src.name)
         cols = [
             PlanCol(

@@ -452,6 +452,22 @@ class Session:
             return None
         if isinstance(stmt, A.ShowStmt):
             return self._run_show(stmt)
+        if isinstance(stmt, A.CreateViewStmt):
+            self._commit()  # DDL semantics
+            self.catalog.create_view(
+                stmt.schema or self.db, stmt.name, stmt.columns,
+                stmt.select, stmt.select_sql, stmt.or_replace)
+            return None
+        if isinstance(stmt, A.DropViewStmt):
+            self._commit()
+            # MySQL 8: all-or-nothing — validate every name first
+            if not stmt.if_exists:
+                for t in stmt.names:
+                    if self.catalog.view(t.schema or self.db, t.name) is None:
+                        raise SchemaError(f"no view {t.schema or self.db}.{t.name}")
+            for t in stmt.names:
+                self.catalog.drop_view(t.schema or self.db, t.name, if_exists=True)
+            return None
         if isinstance(stmt, A.InstallPluginStmt):
             self.catalog.plugins.load_module(stmt.name, stmt.module)
             return None
@@ -902,7 +918,9 @@ class Session:
             rows = [(n,) for n in sorted(self.catalog.databases)]
             return ResultSet(names=["Database"], rows=self._like_filter(rows, stmt.like))
         if stmt.kind == "tables":
-            rows = [(n,) for n in self.catalog.tables(self.db)]
+            names = set(self.catalog.tables(self.db))
+            names.update(self.catalog.database(self.db).views)
+            rows = [(n,) for n in sorted(names)]  # MySQL lists views too
             return ResultSet(names=[f"Tables_in_{self.db}"], rows=self._like_filter(rows, stmt.like))
         if stmt.kind == "columns":
             t = self.catalog.table(self.db, stmt.target)

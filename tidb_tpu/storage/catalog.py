@@ -21,6 +21,8 @@ __all__ = ["Database", "Catalog"]
 class Database:
     name: str
     tables: Dict[str, Table] = field(default_factory=dict)
+    # views: name -> (explicit column names or None, SELECT ast, sql text)
+    views: Dict[str, tuple] = field(default_factory=dict)
 
 
 class Catalog:
@@ -287,6 +289,8 @@ class Catalog:
             if if_not_exists:
                 return d.tables[schema.name]
             raise DuplicateTableError(f"table {schema.name!r} exists")
+        if schema.name in d.views:
+            raise DuplicateTableError(f"view {schema.name!r} exists")
         t = Table(schema)
         t.ts_source = self.next_ts
         d.tables[schema.name] = t
@@ -322,12 +326,39 @@ class Catalog:
     def tables(self, db: str) -> List[str]:
         return sorted(self.database(db).tables.keys())
 
+    # -- views (ref: the view half of ddl/ + infoschema; a view is a
+    # stored SELECT expanded at plan time like a derived table) ---------
+
+    def create_view(self, db: str, name: str, columns, stmt, sql: str,
+                    or_replace: bool = False) -> None:
+        d = self.database(db)
+        if name in d.tables:
+            raise DuplicateTableError(f"table {name!r} exists")
+        if name in d.views and not or_replace:
+            raise DuplicateTableError(f"view {name!r} exists")
+        d.views[name] = (tuple(columns) if columns else None, stmt, sql)
+        self.schema_version += 1
+
+    def drop_view(self, db: str, name: str, if_exists: bool = False) -> None:
+        d = self.database(db)
+        if name not in d.views:
+            if if_exists:
+                return
+            raise SchemaError(f"no view {db}.{name}")
+        del d.views[name]
+        self.schema_version += 1
+
+    def view(self, db: str, name: str):
+        return self.databases.get(db, Database(db)).views.get(name)
+
     def rename_table(self, db: str, old: str, new: str):
         d = self.database(db)
         if old not in d.tables:
             raise SchemaError(f"no table {db}.{old}")
         if new in d.tables:
             raise DuplicateTableError(f"table {new!r} exists")
+        if new in d.views:
+            raise DuplicateTableError(f"view {new!r} exists")
         t = d.tables.pop(old)
         t.schema.name = new
         d.tables[new] = t
@@ -414,6 +445,8 @@ class Catalog:
                 for tn in sorted(self.databases[dbn].tables):
                     t = self.databases[dbn].tables[tn]
                     rows.append(("def", dbn, tn, "BASE TABLE", t.live_rows))
+                for vn in sorted(self.databases[dbn].views):
+                    rows.append(("def", dbn, vn, "VIEW", 0))
             return make(
                 [("table_catalog", STRING), ("table_schema", STRING),
                  ("table_name", STRING), ("table_type", STRING),

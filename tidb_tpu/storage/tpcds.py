@@ -1,8 +1,9 @@
 """TPC-DS subset for the Q95 eval config (BASELINE.md: "TPC-DS Q95
 SF100 — semi-join / correlated subquery, MPP exchange").
 
-Q95 counts web orders shipped from more than one warehouse and not
-returned, within a date window and shipping state. It needs four base
+Q95 counts web orders shipped from more than one warehouse AND
+returned (both IN-subqueries must hold), within a date window and
+shipping state. It needs four base
 tables (web_sales, web_returns, date_dim, customer_address, web_site)
 and exercises exactly the shapes the config names: a self-join
 duplicate-detection CTE, two IN-subquery semi-joins over it, and
