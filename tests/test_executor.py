@@ -448,3 +448,33 @@ class TestUpdateStringExpr:
         s.execute("update u2 set name = case when n > 5 then alt else name end")
         assert s.query("select id, name from u2 order by id") == \
             [(1, "aa"), (2, "yy"), (3, "zz")]
+
+
+class TestFullOuterJoin:
+    """FULL JOIN = (left join) UNION ALL (anti right w/ NULL left
+    payload) — planner rewrite, sqlite >= 3.39 as oracle."""
+
+    @pytest.fixture(scope="class")
+    def fj(self):
+        s = Session(chunk_capacity=256)
+        s.execute("create table l (k bigint, lv varchar(4))")
+        s.execute("create table r (k bigint, rv varchar(4))")
+        s.execute("insert into l values (1,'a'),(2,'b'),(3,'c'),(null,'n')")
+        s.execute("insert into r values (2,'x'),(3,'y'),(4,'z'),(null,'m')")
+        oracle = mirror_to_sqlite(s.catalog, tables=["l", "r"])
+        return s, oracle
+
+    def test_basic(self, fj):
+        check(fj, "select l.k, lv, r.k, rv from l full join r on l.k = r.k")
+
+    def test_with_other_cond(self, fj):
+        check(fj, "select lv, rv from l full outer join r"
+                  " on l.k = r.k and rv <> 'x'")
+
+    def test_aggregate_over_full(self, fj):
+        check(fj, "select count(*), count(l.k), count(r.k)"
+                  " from l full join r on l.k = r.k")
+
+    def test_where_after_full(self, fj):
+        check(fj, "select lv, rv from l full join r on l.k = r.k"
+                  " where rv is null")

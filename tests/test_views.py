@@ -59,13 +59,11 @@ class TestViews:
             sess.query("select * from v")
 
     def test_self_reference_depth_limited(self, sess):
-        # a view created, then redefined to reference itself: expansion
-        # must stop with an error, not recurse forever
-        sess.execute("create view v as select a from t")
-        sess.catalog.database("test").views["v"] = (
-            None, sess.catalog.database("test").views["v"][1], "select * from v")
+        # a view redefined (behind the parser's back) to reference
+        # itself: expansion must stop with an error, not recurse forever
         from tidb_tpu.parser import parse
 
+        sess.execute("create view v as select a from t")
         sess.catalog.database("test").views["v"] = (
             None, parse("select a from v")[0], "select a from v")
         with pytest.raises(PlanError):
@@ -113,3 +111,15 @@ class TestViews:
             sess.execute("drop view v1, nosuch")
         # v1 must survive the failed multi-drop
         assert ("v1",) in sess.execute("show tables").rows
+
+    def test_show_create_view(self, sess):
+        sess.execute("create view v (one) as select a from t")
+        rows = sess.execute("show create view v").rows
+        assert rows[0][0] == "v"
+        assert "CREATE VIEW `v` (one) AS select a from t" == rows[0][1]
+
+    def test_create_table_if_not_exists_over_view(self, sess):
+        sess.execute("create view v as select a from t")
+        # MySQL: satisfied by the existing object, nothing created
+        sess.execute("create table if not exists v (x bigint)")
+        assert sess.query("select count(*) from v") == [(3,)]  # still the view

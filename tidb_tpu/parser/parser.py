@@ -740,6 +740,8 @@ class Parser:
             self.expect_kw("from")
             return ShowStmt("columns", target=self.expect_ident())
         if self.accept_kw("create"):
+            if self.accept_kw("view"):
+                return ShowStmt("create_view", target=self.expect_ident())
             self.expect_kw("table")
             return ShowStmt("create_table", target=self.expect_ident())
         if self.accept_kw("global") or self.accept_kw("session"):

@@ -266,7 +266,7 @@ def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalP
 
     if isinstance(src, A.Join):
         if src.kind == "full":
-            raise UnsupportedError("FULL OUTER JOIN not supported yet")
+            return _build_full_join(src, ctx, outer)
         left, lscope = build_from(src.left, ctx, outer)
         right, rscope = build_from(src.right, ctx, outer)
         if src.kind == "right":
@@ -1009,6 +1009,54 @@ def _in_subquery_to_join(conj: A.EIn, plan, scope, ctx: BuildContext):
 # ---------------------------------------------------------------------------
 # UNION
 # ---------------------------------------------------------------------------
+
+def _build_full_join(src: A.Join, ctx: BuildContext, outer):
+    """L FULL JOIN R = (L LEFT JOIN R) UNION ALL (rows of R with no
+    qualified L match, left payload all-NULL) — the same rewrite the
+    reference's planner applies; there is no native full-join operator.
+    Both branches rebuild their sources (fresh uid spaces); branch B
+    projects onto branch A's uids so the union is pure concatenation."""
+    left_join = A.Join("left", src.left, src.right, src.on, src.using)
+    plan_a, scope_a = build_from(left_join, ctx, outer)
+    acols = scope_a.cols
+
+    # branch B: anti join with probe = right side
+    left2, lscope2 = build_from(src.left, ctx, outer)
+    right2, rscope2 = build_from(src.right, ctx, outer)
+    combined2 = Scope(lscope2.cols + rscope2.cols, outer)
+    cond_asts = _conjuncts(src.on) if src.on is not None else []
+    if src.using:
+        for name in src.using:
+            cond_asts.append(
+                A.EBinary("=", A.EName(name, _qual_of(lscope2, name)),
+                          A.EName(name, _qual_of(rscope2, name))))
+    left_uids = {c.uid for c in lscope2.cols}
+    right_uids = {c.uid for c in rscope2.cols}
+    eq, other = [], []
+    for cast_ in cond_asts:
+        bound = ctx.binder.bind_expr(cast_, combined2)
+        side = _classify_eq(bound, left_uids, right_uids)
+        if side == "lr":
+            eq.append((bound.args[1], bound.args[0]))  # probe=right first
+        elif side == "rl":
+            eq.append((bound.args[0], bound.args[1]))
+        else:
+            other.append(bound)
+    anti = LJoin(
+        schema=list(rscope2.cols), children=[right2, left2], kind="anti",
+        eq_conds=eq, other_cond=_and_ir(other),
+        exists_sem=True,  # an unmatched NULL right key still appears
+    )
+    n_left = len(acols) - len(rscope2.cols)
+    exprs_b: List[Expr] = [
+        Literal(type_=c.type_, value=None) for c in acols[:n_left]
+    ] + [c.ref() for c in rscope2.cols]
+    bcols = [dataclasses.replace(c) for c in acols]
+    proj_b = LProjection(schema=bcols, children=[anti], exprs=exprs_b)
+
+    union = LUnion(schema=list(acols), children=[plan_a, proj_b], all=True)
+    return union, Scope(acols, outer)
+
 
 def _build_union(stmt: A.UnionStmt, ctx: BuildContext, outer) -> LogicalPlan:
     if stmt.op != "union":

@@ -929,6 +929,16 @@ class Session:
                 for c in t.schema.columns
             ]
             return ResultSet(names=["Field", "Type", "Null"], rows=rows)
+        if stmt.kind == "create_view":
+            v = self.catalog.view(self.db, stmt.target)
+            if v is None:
+                raise SchemaError(f"no view {self.db}.{stmt.target}")
+            vcols, _ast, sql = v
+            collist = f" ({', '.join(vcols)})" if vcols else ""
+            return ResultSet(
+                names=["View", "Create View"],
+                rows=[(stmt.target,
+                       f"CREATE VIEW `{stmt.target}`{collist} AS {sql}")])
         if stmt.kind == "bindings":
             rows = self._bindings.rows() + self.catalog.bind_handle.rows()
             return ResultSet(

@@ -290,6 +290,10 @@ class Catalog:
                 return d.tables[schema.name]
             raise DuplicateTableError(f"table {schema.name!r} exists")
         if schema.name in d.views:
+            if if_not_exists:
+                # MySQL: IF NOT EXISTS is satisfied by any object in the
+                # shared table/view namespace — warning, nothing created
+                return None
             raise DuplicateTableError(f"view {schema.name!r} exists")
         t = Table(schema)
         t.ts_source = self.next_ts
@@ -349,7 +353,8 @@ class Catalog:
         self.schema_version += 1
 
     def view(self, db: str, name: str):
-        return self.databases.get(db, Database(db)).views.get(name)
+        d = self.databases.get(db)
+        return d.views.get(name) if d is not None else None
 
     def rename_table(self, db: str, old: str, new: str):
         d = self.database(db)
