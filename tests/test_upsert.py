@@ -1,0 +1,90 @@
+"""REPLACE INTO + INSERT ... ON DUPLICATE KEY UPDATE, and the enforced
+primary key they depend on (ref: executor's InsertExec dup-key paths;
+the PRIMARY unique index is checked on every write)."""
+
+import pytest
+
+from tidb_tpu.errors import ExecutionError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(chunk_capacity=64)
+    s.execute("create table t (id bigint primary key, v bigint, s varchar(8))")
+    s.execute("insert into t values (1, 10, 'a'), (2, 20, 'b')")
+    return s
+
+
+class TestPrimaryKeyEnforced:
+    def test_duplicate_rejected(self, sess):
+        with pytest.raises(ExecutionError):
+            sess.execute("insert into t values (1, 1, 'x')")
+        # rejection leaves the table untouched
+        assert sess.query("select count(*) from t") == [(2,)]
+
+    def test_duplicate_within_batch_rejected(self, sess):
+        with pytest.raises(ExecutionError):
+            sess.execute("insert into t values (5, 1, 'x'), (5, 2, 'y')")
+
+
+class TestReplace:
+    def test_delete_then_insert(self, sess):
+        sess.execute("replace into t values (1, 99, 'z'), (3, 30, 'c')")
+        assert sess.query("select * from t order by id") == \
+            [(1, 99, "z"), (2, 20, "b"), (3, 30, "c")]
+
+    def test_replace_under_txn_rollback(self, sess):
+        sess.execute("begin")
+        sess.execute("replace into t values (1, 99, 'z')")
+        sess.execute("rollback")
+        assert sess.query("select v from t where id = 1") == [(10,)]
+
+
+class TestOnDuplicateKeyUpdate:
+    def test_constant(self, sess):
+        sess.execute("insert into t values (2, 5, 'q')"
+                     " on duplicate key update v = 7")
+        assert sess.query("select * from t where id = 2") == [(2, 7, "b")]
+
+    def test_values_ref_and_expr(self, sess):
+        sess.execute("insert into t values (2, 100, 'w') on duplicate key"
+                     " update v = v + values(v), s = values(s)")
+        assert sess.query("select * from t where id = 2") == [(2, 120, "w")]
+
+    def test_fresh_row_inserts(self, sess):
+        sess.execute("insert into t values (4, 40, 'd')"
+                     " on duplicate key update v = 0")
+        assert sess.query("select * from t where id = 4") == [(4, 40, "d")]
+
+    def test_mixed_batch(self, sess):
+        sess.execute("insert into t values (1, 1, 'x'), (9, 90, 'n')"
+                     " on duplicate key update v = values(v)")
+        assert sess.query("select v from t where id = 1") == [(1,)]
+        assert sess.query("select v from t where id = 9") == [(90,)]
+
+
+class TestReviewRegressions:
+    def test_replace_last_row_wins_within_batch(self, sess):
+        sess.execute("replace into t values (7, 1, 'x'), (7, 2, 'y')")
+        assert sess.query("select v, s from t where id = 7") == [(2, "y")]
+
+    def test_replace_from_select(self, sess):
+        sess.execute("create table src (id bigint primary key, v bigint, s varchar(8))")
+        sess.execute("insert into src values (1, 111, 'zz'), (8, 80, 'h')")
+        sess.execute("replace into t select * from src")
+        assert sess.query("select v from t where id = 1") == [(111,)]
+        assert sess.query("select v from t where id = 8") == [(80,)]
+
+    def test_on_dup_via_defaulted_unique_column(self, sess):
+        sess.execute("create table t5 (a bigint, b bigint default 5)")
+        sess.execute("create unique index ub on t5 (b)")
+        sess.execute("insert into t5 values (1, 5)")
+        # omitted b takes default 5 -> conflicts -> update, not insert
+        sess.execute("insert into t5 (a) values (2) on duplicate key update a = 99")
+        assert sess.query("select a, b from t5") == [(99, 5)]
+
+    def test_duplicate_as_identifier(self, sess):
+        sess.execute("create table dcol (duplicate bigint)")
+        sess.execute("insert into dcol values (3)")
+        assert sess.query("select duplicate from dcol") == [(3,)]

@@ -231,6 +231,9 @@ class InsertStmt:
     rows: Optional[List[List[Expr]]] = None
     select: Optional[Union[SelectStmt, UnionStmt]] = None
     replace: bool = False
+    # ON DUPLICATE KEY UPDATE assignments: (EName, value expr); the
+    # value may use VALUES(col) to reference the would-be-inserted row
+    on_dup: Optional[List[Tuple["EName", "Expr"]]] = None
 
 @dataclass
 class UpdateStmt:

@@ -33,7 +33,7 @@ KEYWORDS = {
     "variables", "status", "grant", "revoke", "flush", "privileges",
     "alter", "add", "modify", "change", "rename", "to", "extract", "column",
     "user", "identified", "trace", "install", "uninstall", "plugin",
-    "soname", "plugins", "binding", "bindings", "for", "view",
+    "soname", "plugins", "binding", "bindings", "for", "view", "duplicate",
 }
 
 

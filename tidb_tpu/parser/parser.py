@@ -386,9 +386,26 @@ class Parser:
             rows = [self._value_row()]
             while self.accept_op(","):
                 rows.append(self._value_row())
-            return InsertStmt(table, columns, rows=rows, replace=replace)
+            on_dup = self._on_duplicate()
+            return InsertStmt(table, columns, rows=rows, replace=replace,
+                              on_dup=on_dup)
         sel = self.parse_select_or_union()
         return InsertStmt(table, columns, select=sel, replace=replace)
+
+    def _on_duplicate(self):
+        if not self.accept_kw("on"):
+            return None
+        self.expect_kw("duplicate")
+        self.expect_kw("key")
+        self.expect_kw("update")
+        sets = []
+        while True:
+            name = EName(self.expect_ident())
+            self.expect_op("=")
+            sets.append((name, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        return sets
 
     def _paren_starts_select(self) -> bool:
         t1 = self.peek(1)
@@ -1116,5 +1133,5 @@ _IDENTISH_KW = {
     "tables", "columns", "column", "user", "variables", "trace",
     # non-reserved in MySQL: usable as identifiers
     "binding", "bindings", "plugin", "plugins", "soname",
-    "install", "uninstall", "view",
+    "install", "uninstall", "view", "duplicate",
 }

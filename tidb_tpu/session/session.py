@@ -596,8 +596,12 @@ class Session:
             def do(txn):
                 rs = self._run_select(stmt.select)
                 rows = [list(r) for r in rs.rows]
-                table.insert_rows(rows, columns=stmt.columns, begin_ts=txn.marker,
-                                  log=txn.log_for(table))
+                if stmt.replace:
+                    self._replace_rows(table, rows, stmt.columns, txn)
+                else:
+                    table.insert_rows(rows, columns=stmt.columns,
+                                      begin_ts=txn.marker,
+                                      log=txn.log_for(table))
 
             return self._run_dml(do)
         from tidb_tpu.planner.binder import Binder
@@ -617,11 +621,105 @@ class Session:
                 row.append(bound)
             rows.append(row)
 
+        tname = stmt.table.name
+
+        if stmt.replace:
+            def do(txn):
+                self._replace_rows(table, rows, stmt.columns, txn)
+
+            return self._run_dml(do)
+
+        if stmt.on_dup:
+            def do(txn):
+                self._upsert_rows(table, tname, rows, stmt.rows,
+                                  stmt.columns, stmt.on_dup, txn)
+
+            return self._run_dml(do)
+
         def do(txn):
             table.insert_rows(rows, columns=stmt.columns, begin_ts=txn.marker,
                               log=txn.log_for(table))
 
         return self._run_dml(do)
+
+    # -- upsert machinery (ref: InsertExec's dup-key flows) ------------
+
+    @staticmethod
+    def _conflict_maps(table, marker):
+        """One conflict map per enforced unique index (O(n) pass each);
+        maintained incrementally across the statement's own mutations."""
+        return {idx.name: (idx, table.conflict_map(idx, marker))
+                for idx in table.indexes.values() if idx.unique}
+
+    def _replace_rows(self, table, rows, columns, txn) -> None:
+        """REPLACE: per row, delete every row any unique key collides
+        with (earlier rows of the same statement included — last row
+        wins), then insert."""
+        names = columns or table.schema.names()
+        maps = self._conflict_maps(table, txn.marker)
+        log = txn.log_for(table)
+        for row in rows:
+            vals = table.row_value_map(names, row)
+            dead = []
+            for idx, m in maps.values():
+                key = table.encode_index_key(idx, vals)
+                if key is not None and key in m:
+                    rid = m.pop(key)
+                    if rid not in dead:
+                        dead.append(rid)
+            if dead:
+                table.delete_rows(np.array(dead, dtype=np.int64),
+                                  end_ts=txn.marker, marker=txn.marker, log=log)
+            table.insert_rows([row], columns=columns, begin_ts=txn.marker,
+                              log=log)
+            new_id = table.n - 1
+            for idx, m in maps.values():
+                key = table.encode_index_key(idx, vals)
+                if key is not None:
+                    m[key] = new_id
+
+    def _upsert_rows(self, table, tname, rows, row_asts, columns,
+                     assignments, txn) -> None:
+        """INSERT ... ON DUPLICATE KEY UPDATE: conflicting rows are
+        updated (VALUES(col) refers to the would-be-inserted value),
+        fresh rows insert."""
+        from tidb_tpu.planner.binder import Binder
+
+        binder = Binder()
+        names = columns or table.schema.names()
+        maps = self._conflict_maps(table, txn.marker)
+        log = txn.log_for(table)
+        for row, r_ast in zip(rows, row_asts):
+            vals = table.row_value_map(names, row)
+            hit = None
+            for idx, m in maps.values():
+                key = table.encode_index_key(idx, vals)
+                if key is not None and key in m:
+                    hit = m[key]
+                    break
+            if hit is None:
+                table.insert_rows([row], columns=columns,
+                                  begin_ts=txn.marker, log=log)
+                new_id = table.n - 1
+                for idx, m in maps.values():
+                    key = table.encode_index_key(idx, vals)
+                    if key is not None:
+                        m[key] = new_id
+                continue
+            ids = np.array([hit], dtype=np.int64)
+            cellmap = dict(zip(names, r_ast))
+            updates = {}
+            for name_ast, val_ast in assignments:
+                col = table.schema.col(name_ast.name)
+                val_ast2 = _sub_values_refs(val_ast, cellmap)
+                if not _ast_has_name(val_ast2):
+                    v = self._bind_const(binder, val_ast2, col)
+                    updates[col.name] = [v]
+                else:
+                    updates[col.name] = self._eval_update_expr(
+                        table, tname, val_ast2, ids, col)
+            table.update_rows(ids, updates, begin_ts=txn.marker,
+                              end_ts=txn.marker, marker=txn.marker, log=log)
 
     def _bind_const(self, binder, cell_ast, col: ColumnInfo):
         """Evaluate a constant INSERT/UPDATE value to a python value in the
@@ -975,6 +1073,20 @@ def _ast_contains(e, cls) -> bool:
         elif hasattr(v, "__dataclass_fields__") and _ast_contains(v, cls):
             return True
     return False
+
+
+def _sub_values_refs(e, cellmap):
+    """ON DUPLICATE KEY UPDATE: VALUES(col) -> that row's insert value."""
+    def fn(x):
+        if (isinstance(x, A.EFunc) and x.name == "values"
+                and len(x.args) == 1 and isinstance(x.args[0], A.EName)):
+            n = x.args[0].name
+            if n not in cellmap:
+                raise PlanError(f"VALUES({n}) refers to a column not inserted")
+            return cellmap[n]
+        return x
+
+    return _ast_transform(e, fn)
 
 
 def _parse_quota(arg: str):

@@ -116,6 +116,11 @@ class Table:
         self.begin_ts = np.zeros(cap, dtype=np.int64)
         self.end_ts = np.full(cap, MAX_TS, dtype=np.int64)
         self.indexes: Dict[str, IndexInfo] = {}
+        if schema.primary_key:
+            # the primary key IS a unique index and is ENFORCED like one
+            # (ref: the clustered index / unique-key checks on write)
+            self.indexes["PRIMARY"] = IndexInfo(
+                "PRIMARY", list(schema.primary_key), unique=True)
         # per-unique-index sorted key cache: name -> (version, keys);
         # fresh only across pure inserts, rebuilt lazily otherwise
         self._uniq_cache: Dict[str, tuple] = {}
@@ -253,8 +258,14 @@ class Table:
                     else:
                         arr[start + i] = v
                         vd[start + i] = True
-        self._enforce_unique_new(start, end)  # before n advances: a
-        # violation leaves the table untouched
+        # marker exclusion (rows this txn deleted don't conflict) costs an
+        # O(n) end_ts scan — only pay it when the txn actually deleted
+        # something in this table (REPLACE / upsert flows)
+        in_txn = begin_ts is not None and begin_ts >= TXN_TS_BASE
+        txn_deleted = log is not None and bool(log.ended)
+        self._enforce_unique_new(
+            start, end, marker=begin_ts if in_txn and txn_deleted else None)
+        # before n advances: a violation leaves the table untouched
         self.begin_ts[start:end] = self._next_ts() if begin_ts is None else begin_ts
         self.end_ts[start:end] = MAX_TS
         self.n = end
@@ -708,11 +719,25 @@ class Table:
         self._uniq_cache[idx.name] = (self.version, keys)
         return keys
 
-    def _check_unique_batch(self, idx: IndexInfo, start: int, end: int) -> None:
+    def _check_unique_batch(self, idx: IndexInfo, start: int, end: int,
+                            marker: Optional[int] = None) -> None:
         """Insert-path uniqueness: buffer rows [start, end) vs the sorted
         key cache. Stages the merged key set in _uniq_pending; the caller
         commits it after the version bump."""
         cache = self._uniq_sorted(idx)
+        if marker is not None:
+            # keys of rows this txn deleted are free for re-insertion;
+            # a rollback resurrects them but also bumps the version,
+            # which rebuilds the cache
+            dead = np.nonzero(self.end_ts[: self.n] == marker)[0]
+            if len(dead):
+                dk = np.sort(self._uniq_keys_at(idx, dead))
+                pos = np.searchsorted(cache, dk)
+                ok = (pos < len(cache))
+                if ok.any():
+                    hitpos = pos[ok]
+                    match = cache[hitpos] == dk[ok]
+                    cache = np.delete(cache, np.unique(hitpos[match]))
         batch = np.sort(self._uniq_keys_at(idx, np.arange(start, end)))
         if len(batch) == 0:
             return
@@ -763,21 +788,80 @@ class Table:
                 f"duplicate entry for unique index {idx.name!r} "
                 f"on {self.schema.name!r}")
 
-    def _enforce_unique_new(self, start: int, end: int) -> None:
+    def _enforce_unique_new(self, start: int, end: int,
+                            marker: Optional[int] = None) -> None:
         """Validate unique indexes counting buffer slots [start, end) as
         present; called BEFORE self.n advances so a violation leaves the
         table untouched. On rejection the written slots' valid bits are
         cleared — later inserts that omit a column must read them as
-        NULL, not as the rejected row's values."""
+        NULL, not as the rejected row's values. `marker`: rows this txn
+        provisionally deleted don't conflict (REPLACE's delete+insert)."""
         try:
             for idx in self.indexes.values():
                 if idx.unique:
-                    self._check_unique_batch(idx, start, end)
+                    self._check_unique_batch(idx, start, end, marker)
         except ExecutionError:
             self._uniq_pending.clear()
             for name in self.valid:
                 self.valid[name][start:end] = False
             raise
+
+    # -- conflict lookup for REPLACE / ON DUPLICATE KEY UPDATE ----------
+
+    def encode_index_key(self, idx: IndexInfo, value_map: Dict[str, object]):
+        """Logical column values -> the index's comparable int key tuple,
+        or None when the key can't conflict (a NULL component, or a
+        string not present in the column dictionary)."""
+        out = []
+        for cname in idx.columns:
+            v = value_map.get(cname)
+            if v is None:
+                return None  # NULL never conflicts (MySQL)
+            col = self.schema.col(cname)
+            dv = self.to_device_value(col, v)
+            if col.type_.is_dict_encoded:
+                code = self.dicts[cname].code_of(str(dv))
+                if code < 0:
+                    return None  # new string: cannot equal any stored key
+                out.append(int(code))
+            elif col.type_.kind == TypeKind.FLOAT:
+                out.append(int(np.float64(dv).view(np.int64)))
+            else:
+                out.append(int(np.int64(dv)))
+        return tuple(out)
+
+    def conflict_map(self, idx: IndexInfo, marker: Optional[int] = None) -> dict:
+        """key tuple -> physical row id over rows present for constraint
+        purposes (minus rows this txn provisionally deleted). One O(n)
+        pass; callers keep it fresh across their own statement's
+        mutations instead of rescanning per VALUES row."""
+        mask = self._present_mask()
+        if marker is not None:
+            mask = mask & (self.end_ts[: self.n] != marker)
+        sel = np.nonzero(mask)[0]
+        ok = np.ones(len(sel), dtype=np.bool_)
+        cols = []
+        for cname in idx.columns:
+            d = self.data[cname][sel]
+            ok &= self.valid[cname][sel]
+            if np.issubdtype(d.dtype, np.floating):
+                d = d.astype(np.float64).view(np.int64)
+            cols.append(d.astype(np.int64))
+        if not cols:
+            return {}
+        mat = np.stack(cols, axis=1)[ok]
+        ids = sel[ok]
+        return {tuple(k): int(i) for k, i in zip(mat.tolist(), ids.tolist())}
+
+    def row_value_map(self, names, row) -> Dict[str, object]:
+        """Column name -> logical value for one INSERT row, with schema
+        defaults filled in for omitted columns (so unique indexes over
+        default-valued columns still detect conflicts)."""
+        out = dict(zip(names, row))
+        for c in self.schema.columns:
+            if c.name not in out and c.default is not None and not c.auto_increment:
+                out[c.name] = c.default
+        return out
 
     def gc(self, safepoint: int) -> int:
         """Reclaim row versions invisible to every current and future
