@@ -88,3 +88,64 @@ class TestReviewRegressions:
         sess.execute("create table dcol (duplicate bigint)")
         sess.execute("insert into dcol values (3)")
         assert sess.query("select duplicate from dcol") == [(3,)]
+
+
+class TestReviewRegressions2:
+    """Second review round: intra-statement re-conflicts, other-txn
+    locks, SELECT-sourced ODKU, VALUES() over defaults."""
+
+    def test_odku_same_key_twice_last_wins(self, sess):
+        sess.execute("insert into t values (1, 5, 'x'), (1, 6, 'y')"
+                     " on duplicate key update v = values(v)")
+        assert sess.query("select v from t where id = 1") == [(6,)]
+
+    def test_odku_update_moves_unique_key(self, sess):
+        sess.execute("create table mv (a bigint, b bigint)")
+        sess.execute("create unique index ub on mv (b)")
+        sess.execute("insert into mv values (1, 10)")
+        # first dup moves b 10 -> 20; second dup must then MISS key 10
+        # (fresh insert) and a third must HIT key 20
+        sess.execute("insert into mv values (2, 10), (3, 10), (4, 20)"
+                     " on duplicate key update b = values(b) + 10, a = values(a)")
+        rows = sess.query("select a, b from mv order by b")
+        # row1: (1,10)->dup a=2,b=20; row2 (3,10): no conflict -> insert;
+        # row3 (4,20): hits the moved row -> a=4, b=30
+        assert rows == [(3, 10), (4, 30)]
+
+    def test_replace_blocked_by_other_txn_insert(self):
+        from tidb_tpu.storage.catalog import Catalog
+
+        cat = Catalog()
+        a = Session(catalog=cat)
+        b = Session(catalog=cat)
+        a.execute("create table rt (id bigint primary key, v bigint)")
+        a.execute("begin")
+        a.execute("insert into rt values (5, 1)")
+        with pytest.raises(ExecutionError):
+            b.execute("replace into rt values (5, 2)")  # A's lock holds
+        a.execute("commit")
+        b.execute("replace into rt values (5, 2)")  # now fine
+        assert b.query("select v from rt where id = 5") == [(2,)]
+
+    def test_insert_select_on_duplicate(self, sess):
+        sess.execute("create table s2 (id bigint primary key, v bigint, s varchar(8))")
+        sess.execute("insert into s2 values (1, 111, 'q'), (8, 80, 'h')")
+        sess.execute("insert into t select * from s2"
+                     " on duplicate key update v = values(v)")
+        assert sess.query("select v from t where id = 1") == [(111,)]
+        assert sess.query("select v from t where id = 8") == [(80,)]
+
+    def test_values_of_defaulted_column(self, sess):
+        sess.execute("create table dv (a bigint, b bigint default 5)")
+        sess.execute("create unique index ub on dv (b)")
+        sess.execute("insert into dv values (1, 5)")
+        sess.execute("insert into dv (a) values (2)"
+                     " on duplicate key update a = 99, b = values(b)")
+        assert sess.query("select a, b from dv") == [(99, 5)]
+
+    def test_replace_odku_rejected(self, sess):
+        from tidb_tpu.errors import ParseError
+
+        with pytest.raises(ParseError):
+            sess.execute("replace into t values (1, 5, 'x')"
+                         " on duplicate key update v = 1")

@@ -26,6 +26,7 @@ from tidb_tpu.planner.physical import (
     PSort,
     PTopN,
     PUnion,
+    PWindow,
     PhysicalPlan,
 )
 
@@ -123,6 +124,12 @@ def build_executor(plan: PhysicalPlan) -> Executor:
         )
     if isinstance(plan, PSort):
         return SortExec(plan.schema, build_executor(plan.child), plan.items)
+    if isinstance(plan, PWindow):
+        from tidb_tpu.executor.window import WindowExec
+
+        return WindowExec(plan.schema, build_executor(plan.child), plan.func,
+                          plan.args, plan.partition_by, plan.order_by,
+                          plan.out_uid, plan.out_type)
     if isinstance(plan, PTopN):
         return TopNExec(plan.schema, build_executor(plan.child), plan.items, plan.count, plan.offset)
     if isinstance(plan, PLimit):

@@ -1,0 +1,215 @@
+"""WindowExec (ref: executor/window.go — the window-function executor).
+
+Runs as a root-task operator over materialized rows, like Sort (the
+reference likewise evaluates windows on the SQL node, not in
+coprocessors). One pass: lexsort by (partition keys, order keys),
+compute the function over partition segments with numpy, scatter the
+values back to the original row order, and re-emit the child's chunks
+with the output column attached.
+
+Frame semantics (MySQL defaults):
+  * no ORDER BY  -> the whole partition is the frame
+  * with ORDER BY -> RANGE UNBOUNDED PRECEDING .. CURRENT ROW: peers
+    (rows tying on the order keys) share the frame result
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.errors import UnsupportedError
+from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.executor.sort import _Materializing, _sort_order
+from tidb_tpu.types import TypeKind
+
+__all__ = ["WindowExec"]
+
+
+class WindowExec(_Materializing):
+    def __init__(self, schema, child, func: str, args, partition_by,
+                 order_by, out_uid: str, out_type):
+        super().__init__(schema, [child])
+        self.func = func
+        self.args = args
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.out_uid = out_uid
+        self.out_type = out_type
+
+    def open(self, ctx: ExecContext) -> None:
+        Executor.open(self, ctx)
+        self.ctx = ctx
+        # drain with (partition keys, order keys, arg) evaluated per chunk
+        key_items = ([(e, False) for e in self.partition_by]
+                     + list(self.order_by)
+                     + [(a, False) for a in self.args])
+        child_schema = self.schema[:-1]  # the out column isn't in the child
+        saved = self.schema
+        self.schema = child_schema
+        try:
+            runs = self._drain_to_runs(key_items)
+            host_keys = self._global_keys(runs, len(key_items))
+            n = len(host_keys[0][0]) if key_items else sum(
+                r for _, r in runs.all_runs())
+            np_part = len(self.partition_by)
+            np_ord = len(self.order_by)
+            descale = 1.0
+            if (self.func == "avg" and self.args
+                    and self.args[0].type_.kind == TypeKind.DECIMAL):
+                descale = float(10 ** self.args[0].type_.scale)
+            vals, valid = _compute_window(
+                self.func, host_keys[:np_part],
+                host_keys[np_part : np_part + np_ord],
+                list(self.order_by),
+                host_keys[np_part + np_ord :],
+                n, self.out_type, avg_descale=descale)
+            self._emit(runs, None, n)  # original row order
+        finally:
+            self.schema = saved
+            self._close_runs()
+        # attach the output column, sliced chunk-by-chunk
+        cap = self.ctx.chunk_capacity
+        out_col = self.schema[-1]
+        patched = []
+        off = 0
+        for ch in self._chunks:
+            m = int(np.asarray(ch.sel).sum())
+            d = np.zeros(cap, dtype=out_col.type_.np_dtype)
+            v = np.zeros(cap, dtype=np.bool_)
+            d[:m] = vals[off : off + m]
+            v[:m] = valid[off : off + m]
+            cols = dict(ch.columns)
+            cols[self.out_uid] = Column(d, v, out_col.type_)
+            patched.append(Chunk(cols, ch.sel))
+            off += m
+        self._chunks = patched
+
+
+def _compute_window(func, part_keys, order_keys, order_items, arg_keys,
+                    n: int, out_type, avg_descale: float = 1.0):
+    """Returns (values[n], valid[n]) in ORIGINAL row order."""
+    if n == 0:
+        return (np.zeros(0, dtype=out_type.np_dtype),
+                np.zeros(0, dtype=np.bool_))
+    # global order: partitions ascending, then the window's ORDER BY
+    items = [(None, False)] * len(part_keys) + [(None, d) for _, d in order_items]
+    perm = _sort_order(part_keys + order_keys, items) if items else np.arange(n)
+
+    def g(keys):  # gather (data, valid) pairs into sorted order
+        return [(d[perm], v[perm]) for d, v in keys]
+
+    sp, so = g(part_keys), g(order_keys)
+
+    def _neq(d, v):
+        """sorted-adjacent inequality; NULLs equal each other."""
+        both_valid = v[1:] & v[:-1]
+        both_null = ~v[1:] & ~v[:-1]
+        return ~((both_valid & (d[1:] == d[:-1])) | both_null)
+
+    # partition starts in sorted order
+    new_part = np.zeros(n, dtype=np.bool_)
+    new_part[0] = True
+    for d, v in sp:
+        new_part[1:] |= _neq(d, v)
+    pid = np.cumsum(new_part) - 1  # partition id per sorted row
+    starts = np.nonzero(new_part)[0]
+    part_start = starts[pid]  # first sorted index of each row's partition
+
+    # tie groups (same partition + same ORDER BY keys)
+    new_tie = new_part.copy()
+    for d, v in so:
+        new_tie[1:] |= _neq(d, v)
+    tid = np.cumsum(new_tie) - 1
+    tstarts = np.nonzero(new_tie)[0]
+    tie_start = tstarts[tid]
+    # last sorted index of each tie group
+    tlast = np.empty(len(tstarts), dtype=np.int64)
+    tlast[:-1] = tstarts[1:] - 1
+    tlast[-1] = n - 1
+    tie_last = tlast[tid]
+
+    idx = np.arange(n)
+    out_valid = np.ones(n, dtype=np.bool_)
+
+    if func == "row_number":
+        svals = idx - part_start + 1
+    elif func == "rank":
+        svals = tie_start - part_start + 1
+    elif func == "dense_rank":
+        # tie index within the partition
+        svals = tid - tid[part_start] + 1
+    else:
+        has_arg = bool(arg_keys)
+        if has_arg:
+            ad, av = arg_keys[0][0][perm], arg_keys[0][1][perm]
+        else:  # COUNT(*)
+            ad = np.ones(n, dtype=np.int64)
+            av = np.ones(n, dtype=np.bool_)
+        framed = bool(order_items)  # running frame vs whole partition
+        if func in ("count", "sum", "avg"):
+            fd = ad.astype(np.float64) if func == "avg" else ad.astype(
+                np.int64 if not np.issubdtype(ad.dtype, np.floating) else np.float64)
+            ones = av.astype(np.int64)
+            contrib = np.where(av, fd, 0)
+            if framed:
+                cs = np.cumsum(contrib)
+                cn = np.cumsum(ones)
+                base_s = cs[part_start] - contrib[part_start]
+                base_n = cn[part_start] - ones[part_start]
+                run_s = cs - base_s
+                run_n = cn - base_n
+                # RANGE frame: peers share the tie group's last value
+                run_s = run_s[tie_last]
+                run_n = run_n[tie_last]
+            else:
+                tot_s = np.add.reduceat(contrib, starts)
+                tot_n = np.add.reduceat(ones, starts)
+                run_s = tot_s[pid]
+                run_n = tot_n[pid]
+            if func == "count":
+                svals = run_n
+            elif func == "sum":
+                svals = run_s
+                out_valid = run_n > 0  # SUM of no rows is NULL
+            else:
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    svals = np.where(run_n > 0,
+                                     run_s / np.maximum(run_n, 1) / avg_descale,
+                                     0.0)
+                out_valid = run_n > 0
+        elif func in ("min", "max"):
+            red = np.minimum if func == "min" else np.maximum
+            big = (np.inf if np.issubdtype(ad.dtype, np.floating)
+                   else np.iinfo(np.int64).max)
+            ident = big if func == "min" else -big
+            cd = np.where(av, ad, ident)
+            ones = av.astype(np.int64)
+            if framed:
+                # partition-segmented running min/max (O(P) python loop
+                # over partitions; acceptable for a root operator)
+                run = np.empty_like(cd)
+                for s, e in zip(starts, list(starts[1:]) + [n]):
+                    run[s:e] = red.accumulate(cd[s:e])
+                cn = np.cumsum(ones)
+                run_n = cn - (cn[part_start] - ones[part_start])
+                run = run[tie_last]
+                run_n = run_n[tie_last]
+            else:
+                tot = red.reduceat(cd, starts)
+                run = tot[pid]
+                run_n = np.add.reduceat(ones, starts)[pid]
+            svals = run
+            out_valid = run_n > 0
+        else:
+            raise UnsupportedError(f"window function {func}")
+
+    # scatter back to original row order
+    inv = np.empty(n, dtype=np.int64)
+    inv[perm] = np.arange(n)
+    vals_sorted = np.asarray(svals)
+    out = vals_sorted[inv].astype(out_type.np_dtype)
+    return out, out_valid[inv]

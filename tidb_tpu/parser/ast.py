@@ -15,7 +15,7 @@ __all__ = [
     # expressions
     "EName", "ENum", "EStr", "ENull", "EBool", "EStar", "EParam",
     "EBinary", "EUnary", "EFunc", "ECase", "ECast", "EIn", "EBetween",
-    "ELike", "EExists", "ESubquery", "EInterval", "EIsNull", "EVar",
+    "ELike", "EExists", "ESubquery", "EInterval", "EIsNull", "EVar", "EWindow",
     # query structure
     "SelectItem", "TableName", "SubqueryTable", "Join", "OrderItem",
     "SelectStmt", "UnionStmt", "CTE",
@@ -146,10 +146,22 @@ class EIsNull:
     negated: bool = False
 
 
+@dataclass
+class EWindow:
+    """func(args) OVER (PARTITION BY ... ORDER BY ...). Default frame
+    semantics (RANGE UNBOUNDED PRECEDING .. CURRENT ROW when ordered,
+    whole partition otherwise)."""
+
+    func: str  # row_number | rank | dense_rank | count | sum | avg | min | max
+    args: List["Expr"] = field(default_factory=list)
+    partition_by: List["Expr"] = field(default_factory=list)
+    order_by: List["OrderItem"] = field(default_factory=list)
+
+
 Expr = Union[
     EName, ENum, EStr, ENull, EBool, EStar, EParam, EVar, EBinary, EUnary,
     EFunc, ECase, ECast, EIn, EBetween, ELike, EExists, ESubquery,
-    EInterval, EIsNull,
+    EInterval, EIsNull, EWindow,
 ]
 
 

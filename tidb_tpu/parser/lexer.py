@@ -34,6 +34,7 @@ KEYWORDS = {
     "alter", "add", "modify", "change", "rename", "to", "extract", "column",
     "user", "identified", "trace", "install", "uninstall", "plugin",
     "soname", "plugins", "binding", "bindings", "for", "view", "duplicate",
+    "over", "partition",
 }
 
 

@@ -387,10 +387,16 @@ class Parser:
             while self.accept_op(","):
                 rows.append(self._value_row())
             on_dup = self._on_duplicate()
+            if replace and on_dup:
+                raise self.error("REPLACE cannot have ON DUPLICATE KEY UPDATE")
             return InsertStmt(table, columns, rows=rows, replace=replace,
                               on_dup=on_dup)
         sel = self.parse_select_or_union()
-        return InsertStmt(table, columns, select=sel, replace=replace)
+        on_dup = self._on_duplicate()
+        if replace and on_dup:
+            raise self.error("REPLACE cannot have ON DUPLICATE KEY UPDATE")
+        return InsertStmt(table, columns, select=sel, replace=replace,
+                          on_dup=on_dup)
 
     def _on_duplicate(self):
         if not self.accept_kw("on"):
@@ -774,6 +780,32 @@ class Parser:
             return ShowStmt("bindings")
         raise self.error("unsupported SHOW")
 
+    def _parse_over(self, fname: str, args, distinct: bool) -> EWindow:
+        self.expect_kw("over")
+        self.expect_op("(")
+        if distinct:
+            raise self.error("DISTINCT in window functions")
+        part, order = [], []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            part.append(self.parse_expr())
+            while self.accept_op(","):
+                part.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                order.append(OrderItem(e, desc))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        return EWindow(fname, args, part, order)
+
     def _parse_hints(self, text: str):
         """'LEADING(a, b) MEMORY_QUOTA(1048576)' -> [(name, [args])]."""
         import re as _re
@@ -1099,6 +1131,8 @@ class Parser:
                     while self.accept_op(","):
                         args.append(self.parse_expr())
             self.expect_op(")")
+            if self.at_kw("over"):
+                return self._parse_over(fname, args, distinct)
             return EFunc(fname, args, distinct=distinct)
         if self.accept_op("."):
             t = self.peek()

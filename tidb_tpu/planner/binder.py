@@ -288,6 +288,10 @@ class Binder:
         if isinstance(e, A.EStar):
             raise PlanError("* not valid in this context")
 
+        if isinstance(e, A.EWindow):
+            raise PlanError(
+                "window functions are only allowed in SELECT items / ORDER BY")
+
         raise PlanError(f"cannot bind expression {type(e).__name__}")
 
     # ------------------------------------------------------------------

@@ -33,8 +33,8 @@ from tidb_tpu.types import (
 
 __all__ = [
     "LogicalPlan", "LScan", "LSelection", "LProjection", "LAggregate",
-    "AggSpec", "LJoin", "LSort", "LLimit", "LUnion", "build_select",
-    "BuildContext", "expr_display",
+    "AggSpec", "LJoin", "LSort", "LLimit", "LUnion", "LWindow",
+    "build_select", "BuildContext", "expr_display",
 ]
 
 
@@ -97,6 +97,20 @@ class LJoin(LogicalPlan):
     # anti joins from NOT EXISTS keep NULL-key probe rows (no match ->
     # EXISTS is false -> NOT EXISTS true), unlike NOT IN's NULL semantics
     exists_sem: bool = False
+
+
+@dataclass
+class LWindow(LogicalPlan):
+    """One window function: child schema + one output column (out_uid).
+    Default frames: whole partition without ORDER BY; RANGE UNBOUNDED
+    PRECEDING .. CURRENT ROW (peers included) with it."""
+
+    func: str = "row_number"
+    args: List[Expr] = field(default_factory=list)
+    partition_by: List[Expr] = field(default_factory=list)
+    order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
+    out_uid: str = ""
+    out_type: SQLType = INT64
 
 
 @dataclass
@@ -385,6 +399,61 @@ def _substitute(e, mapping: Dict[str, str]):
     return type(e)(**kwargs)
 
 
+_WINDOW_FUNCS = {"row_number", "rank", "dense_rank",
+                 "count", "sum", "avg", "min", "max"}
+
+
+def _collect_window_calls(e, out: Dict[str, A.EWindow]) -> None:
+    if isinstance(e, A.EWindow):
+        if e.func not in _WINDOW_FUNCS:
+            raise UnsupportedError(f"window function {e.func.upper()}")
+        out.setdefault(ast_key(e), e)
+        return  # no windows nested inside windows
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, list):
+            for x in v:
+                if hasattr(x, "__dataclass_fields__"):
+                    _collect_window_calls(x, out)
+        elif hasattr(v, "__dataclass_fields__") and not isinstance(v, (A.SelectStmt, A.UnionStmt)):
+            _collect_window_calls(v, out)
+
+
+def _plan_window(w: A.EWindow, plan: LogicalPlan, scope: Scope,
+                 ctx: BuildContext):
+    """Stack one LWindow node; returns (plan, widened scope, out uid)."""
+    binder = ctx.binder
+    part = [binder.bind_expr(e, scope) for e in w.partition_by]
+    order = [(binder.bind_expr(oi.expr, scope), oi.desc) for oi in w.order_by]
+    if w.func in ("row_number", "rank", "dense_rank"):
+        if w.args:
+            raise PlanError(f"{w.func.upper()} takes no arguments")
+        args: List[Expr] = []
+        out_type = INT64
+        d = None
+    else:
+        if w.func == "count" and (not w.args or isinstance(w.args[0], A.EStar)):
+            args = []
+            out_type = INT64
+            d = None
+        else:
+            if len(w.args) != 1:
+                raise PlanError(f"window {w.func.upper()} takes one argument")
+            arg = binder.bind_expr(w.args[0], scope)
+            args = [arg]
+            out_type = (INT64 if w.func == "count"
+                        else _agg_result_type(w.func, arg))
+            d = binder._dict_of(arg) if w.func in ("min", "max") else None
+    uid = binder.new_uid(f"win.{w.func}")
+    col = PlanCol(uid=uid, name=uid, type_=out_type, dict_=d)
+    node = LWindow(
+        schema=list(plan.schema) + [col], children=[plan],
+        func=w.func, args=args, partition_by=part, order_by=order,
+        out_uid=uid, out_type=out_type,
+    )
+    return node, Scope(list(scope.cols) + [col], scope.parent), uid
+
+
 def _agg_result_type(func: str, arg: Optional[Expr]) -> SQLType:
     if func == "count":
         return INT64
@@ -485,6 +554,20 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
         cond = binder.bind_expr(h_ast, post_scope)
         plan = LSelection(schema=plan.schema, children=[plan], cond=cond)
 
+    # ---- window functions (evaluate after grouping + HAVING) ----
+    win_calls: Dict[str, A.EWindow] = {}
+    for item in stmt.items:
+        _collect_window_calls(item.expr, win_calls)
+    for oi in stmt.order_by:
+        _collect_window_calls(oi.expr, win_calls)
+    if win_calls:
+        for key, w in win_calls.items():
+            w2 = _substitute(w, mapping) if mapping else w
+            plan, post_scope, uid = _plan_window(w2, plan, post_scope, ctx)
+            mapping[key] = uid
+
+    subst = bool(mapping)
+
     # ---- SELECT items ----
     items: List[Tuple[str, object]] = []  # (display name, ast)
     for item in stmt.items:
@@ -500,7 +583,7 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
                 raise PlanError("* expanded to nothing")
         else:
             name = item.alias or expr_display(item.expr)
-            items.append((name, _substitute(item.expr, mapping) if has_agg else item.expr))
+            items.append((name, _substitute(item.expr, mapping) if subst else item.expr))
 
     proj_exprs: List[Expr] = []
     proj_cols: List[PlanCol] = []
@@ -531,7 +614,7 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
                 pc = proj_cols[target_idx]
                 sort_items.append((ColumnRef(type_=pc.type_, name=pc.uid), oi.desc))
                 continue
-            ast_e = _substitute(oi.expr, mapping) if has_agg else oi.expr
+            ast_e = _substitute(oi.expr, mapping) if subst else oi.expr
             bound = binder.bind_expr(ast_e, post_scope)
             uid = binder.new_uid("sort")
             proj_exprs.append(bound)

@@ -24,12 +24,13 @@ from tidb_tpu.planner.logical import (
     LSelection,
     LSort,
     LUnion,
+    LWindow,
     LogicalPlan,
 )
 
 __all__ = [
     "PhysicalPlan", "PScan", "PSelection", "PProjection", "PHashAgg",
-    "PHashJoin", "PSort", "PTopN", "PLimit", "PUnion", "lower", "explain_text",
+    "PHashJoin", "PSort", "PTopN", "PLimit", "PUnion", "PWindow", "lower", "explain_text",
 ]
 
 
@@ -117,6 +118,21 @@ class PHashJoin(PhysicalPlan):
 class PSort(PhysicalPlan):
     items: List[Tuple[object, bool]] = field(default_factory=list)
     task: str = "root"
+
+
+@dataclass
+class PWindow(PhysicalPlan):
+    func: str = "row_number"
+    args: List[object] = field(default_factory=list)
+    partition_by: List[object] = field(default_factory=list)
+    order_by: List[Tuple[object, bool]] = field(default_factory=list)
+    out_uid: str = ""
+    out_type: object = None
+    task: str = "root"
+
+    def op_info(self):
+        return (f"{self.func} over(partition:{len(self.partition_by)} "
+                f"order:{len(self.order_by)})")
 
 
 @dataclass
@@ -344,6 +360,11 @@ def lower(plan: LogicalPlan) -> PhysicalPlan:
         )
     if isinstance(plan, LSort):
         return PSort(schema=plan.schema, children=[lower(plan.child)], est_rows=est, items=plan.items)
+    if isinstance(plan, LWindow):
+        return PWindow(
+            schema=plan.schema, children=[lower(plan.child)], est_rows=est,
+            func=plan.func, args=plan.args, partition_by=plan.partition_by,
+            order_by=plan.order_by, out_uid=plan.out_uid, out_type=plan.out_type)
     if isinstance(plan, LLimit):
         c = lower(plan.child)
         if isinstance(c, PSort):

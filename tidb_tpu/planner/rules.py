@@ -36,6 +36,7 @@ from tidb_tpu.planner.logical import (
     LScan,
     LSelection,
     LSort,
+    LWindow,
     LUnion,
     LogicalPlan,
 )
@@ -369,6 +370,21 @@ def _rule_prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
                 child_req |= _refs(x)
         plan.children[0] = _rule_prune(plan.child, child_req)
         plan.schema = list(plan.child.schema)
+        return plan
+
+    if isinstance(plan, LWindow):
+        child_req = None
+        if required is not None:
+            child_req = set(required) - {plan.out_uid}
+            for x in plan.args:
+                child_req |= _refs(x)
+            for x in plan.partition_by:
+                child_req |= _refs(x)
+            for x, _ in plan.order_by:
+                child_req |= _refs(x)
+        plan.children[0] = _rule_prune(plan.child, child_req)
+        out_col = plan.schema[-1]
+        plan.schema = list(plan.child.schema) + [out_col]
         return plan
 
     if isinstance(plan, (LLimit,)):

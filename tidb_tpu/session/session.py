@@ -598,6 +598,10 @@ class Session:
                 rows = [list(r) for r in rs.rows]
                 if stmt.replace:
                     self._replace_rows(table, rows, stmt.columns, txn)
+                elif stmt.on_dup:
+                    row_asts = [[_value_to_ast(v) for v in r] for r in rows]
+                    self._upsert_rows(table, stmt.table.name, rows, row_asts,
+                                      stmt.columns, stmt.on_dup, txn)
                 else:
                     table.insert_rows(rows, columns=stmt.columns,
                                       begin_ts=txn.marker,
@@ -652,31 +656,41 @@ class Session:
                 for idx in table.indexes.values() if idx.unique}
 
     def _replace_rows(self, table, rows, columns, txn) -> None:
-        """REPLACE: per row, delete every row any unique key collides
-        with (earlier rows of the same statement included — last row
-        wins), then insert."""
+        """REPLACE: delete every live row any unique key collides with;
+        a later VALUES row colliding with an earlier one of the same
+        statement supersedes it (last row wins). One delete + one
+        insert call per statement."""
         names = columns or table.schema.names()
         maps = self._conflict_maps(table, txn.marker)
         log = txn.log_for(table)
+        pending: list = []
+        dead: list = []
         for row in rows:
             vals = table.row_value_map(names, row)
-            dead = []
-            for idx, m in maps.values():
-                key = table.encode_index_key(idx, vals)
-                if key is not None and key in m:
-                    rid = m.pop(key)
-                    if rid not in dead:
-                        dead.append(rid)
-            if dead:
-                table.delete_rows(np.array(dead, dtype=np.int64),
-                                  end_ts=txn.marker, marker=txn.marker, log=log)
-            table.insert_rows([row], columns=columns, begin_ts=txn.marker,
-                              log=log)
-            new_id = table.n - 1
-            for idx, m in maps.values():
-                key = table.encode_index_key(idx, vals)
+            keys = [(idx, m, table.encode_index_key(idx, vals))
+                    for idx, m in maps.values()]
+            for _idx, m, key in keys:
+                if key is None:
+                    continue
+                hit = m.pop(key, None)
+                if hit is None:
+                    continue
+                if isinstance(hit, tuple):  # pending row of this statement
+                    pending[hit[1]] = None
+                elif hit not in dead:
+                    dead.append(hit)
+            pi = len(pending)
+            pending.append(list(row))
+            for _idx, m, key in keys:
                 if key is not None:
-                    m[key] = new_id
+                    m[key] = ("p", pi)
+        if dead:
+            table.delete_rows(np.array(dead, dtype=np.int64),
+                              end_ts=txn.marker, marker=txn.marker, log=log)
+        live = [r for r in pending if r is not None]
+        if live:
+            table.insert_rows(live, columns=columns, begin_ts=txn.marker,
+                              log=log)
 
     def _upsert_rows(self, table, tname, rows, row_asts, columns,
                      assignments, txn) -> None:
@@ -708,6 +722,12 @@ class Session:
                 continue
             ids = np.array([hit], dtype=np.int64)
             cellmap = dict(zip(names, r_ast))
+            # VALUES(col) over an omitted column yields its default
+            # (consistent with row_value_map's conflict detection)
+            for c in table.schema.columns:
+                if c.name not in cellmap and c.default is not None \
+                        and not c.auto_increment:
+                    cellmap[c.name] = _value_to_ast(c.default)
             updates = {}
             for name_ast, val_ast in assignments:
                 col = table.schema.col(name_ast.name)
@@ -720,6 +740,18 @@ class Session:
                         table, tname, val_ast2, ids, col)
             table.update_rows(ids, updates, begin_ts=txn.marker,
                               end_ts=txn.marker, marker=txn.marker, log=log)
+            # the update superseded `hit` with a new version: refresh
+            # EVERY index's mapping (assignments may change key columns;
+            # a later VALUES row hitting the stale id would silently
+            # no-op against the dead version)
+            new_id = table.n - 1
+            for idx, m in maps.values():
+                old_key = table.index_key_at(idx, hit)
+                if old_key is not None and m.get(old_key) == hit:
+                    del m[old_key]
+                nk = table.index_key_at(idx, new_id)
+                if nk is not None:
+                    m[nk] = new_id
 
     def _bind_const(self, binder, cell_ast, col: ColumnInfo):
         """Evaluate a constant INSERT/UPDATE value to a python value in the
@@ -1073,6 +1105,21 @@ def _ast_contains(e, cls) -> bool:
         elif hasattr(v, "__dataclass_fields__") and _ast_contains(v, cls):
             return True
     return False
+
+
+def _value_to_ast(v):
+    """Python logical value -> literal AST (SELECT-sourced upserts,
+    VALUES() over defaulted columns)."""
+    import datetime
+    import decimal
+
+    if v is None:
+        return A.ENull()
+    if isinstance(v, bool):
+        return A.EBool(v)
+    if isinstance(v, (int, float, decimal.Decimal)):
+        return A.ENum(str(v))
+    return A.EStr(str(v))
 
 
 def _sub_values_refs(e, cellmap):

@@ -125,6 +125,9 @@ class Table:
         # fresh only across pure inserts, rebuilt lazily otherwise
         self._uniq_cache: Dict[str, tuple] = {}
         self._uniq_pending: Dict[str, np.ndarray] = {}
+        # rows provisionally ended per open txn marker (REPLACE/upsert
+        # re-insert freedom + O(dead) instead of O(n) scans)
+        self._txn_dead: Dict[int, list] = {}
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -374,9 +377,14 @@ class Table:
         another txn's marker (lock conflict) or by a commit (optimistic
         conflict) raise; rows already ended by OUR marker are skipped."""
         in_bounds = (ids >= 0) & (ids < self.n)
-        cur = np.where(in_bounds, self.end_ts[np.clip(ids, 0, max(self.n - 1, 0))], MAX_TS)
+        clipped = np.clip(ids, 0, max(self.n - 1, 0))
+        cur = np.where(in_bounds, self.end_ts[clipped], MAX_TS)
         ours = cur == marker if marker else np.zeros(len(ids), dtype=np.bool_)
         blocked = (cur != MAX_TS) & ~ours & in_bounds
+        # another txn's UNCOMMITTED insert is a lock too: its end_ts is
+        # still MAX_TS, but its begin_ts marker makes it untouchable
+        bts = np.where(in_bounds, self.begin_ts[clipped], 0)
+        blocked |= (bts >= TXN_TS_BASE) & (bts != marker) & in_bounds
         if blocked.any():
             from tidb_tpu.errors import WriteConflictError
 
@@ -393,6 +401,8 @@ class Table:
         ids = np.asarray(row_ids, dtype=np.int64)
         ids = ids[self._writable_mask(ids, marker)]
         self.end_ts[ids] = self._next_ts() if end_ts is None else end_ts
+        if end_ts is not None and end_ts >= TXN_TS_BASE and len(ids):
+            self._txn_dead.setdefault(end_ts, []).extend(ids.tolist())
         if log is not None:
             log.ended.append(ids)
         self.version += 1
@@ -456,7 +466,7 @@ class Table:
             try:
                 for ix in self.indexes.values():
                     if ix.unique:
-                        self._check_unique(ix, extra=(start, end))
+                        self._check_unique(ix, extra=(start, end), marker=end_ts if end_ts >= TXN_TS_BASE else None)
             except ExecutionError:
                 for name in self.valid:
                     self.valid[name][start:end] = False
@@ -465,6 +475,8 @@ class Table:
                 self.end_ts[ids] = saved
 
         self.end_ts[ids] = end_ts
+        if end_ts >= TXN_TS_BASE and m:
+            self._txn_dead.setdefault(end_ts, []).extend(ids.tolist())
         self.begin_ts[start:end] = begin_ts
         self.end_ts[start:end] = MAX_TS
         self.n = end
@@ -479,6 +491,7 @@ class Table:
         """Rewrite this txn's markers to the commit timestamp. With a log,
         only the logged rows are touched (O(rows written)); without one,
         the full version arrays are scanned."""
+        self._txn_dead.pop(marker, None)
         vbefore = self.version
         if log is not None:
             for s, e in log.ranges:
@@ -508,6 +521,7 @@ class Table:
 
     def txn_rollback(self, marker: int, log: Optional["TableTxnLog"] = None) -> None:
         """Discard provisional writes; restore provisional deletes."""
+        self._txn_dead.pop(marker, None)
         if log is not None:
             # restore deletes first; then kill inserted versions (a row both
             # inserted and deleted by this txn must end up dead)
@@ -689,10 +703,11 @@ class Table:
         txn's delete marker — conservative, like InnoDB's locked checks)."""
         return self.end_ts[: self.n] >= TXN_TS_BASE
 
-    def _uniq_keys_at(self, idx: IndexInfo, sel: np.ndarray) -> np.ndarray:
-        """Index-key rows at positions `sel` as a sortable structured
-        array (lexicographic field order = column order); rows with any
-        NULL key column are dropped (MySQL: NULLs never conflict)."""
+    def _uniq_key_rows(self, idx: IndexInfo, sel: np.ndarray):
+        """(int64 key matrix, surviving row ids) at positions `sel`;
+        rows with any NULL key column are dropped (MySQL: NULLs never
+        conflict). The single source of index-key encoding — the unique
+        checks, conflict maps, and point lookups all go through it."""
         ok = np.ones(len(sel), dtype=np.bool_)
         cols = []
         for cname in idx.columns:
@@ -703,8 +718,20 @@ class Table:
                 d = d.astype(np.float64).view(np.int64)
             cols.append(d.astype(np.int64))
         mat = np.stack(cols, axis=1)[ok] if cols else np.zeros((0, 0), np.int64)
+        return mat, sel[ok]
+
+    def _uniq_keys_at(self, idx: IndexInfo, sel: np.ndarray) -> np.ndarray:
+        """Key rows at `sel` as a sortable structured array."""
+        mat, _ids = self._uniq_key_rows(idx, sel)
         dt = np.dtype([(f"k{i}", np.int64) for i in range(len(idx.columns))])
         return np.ascontiguousarray(mat).view(dt).reshape(-1)
+
+    def index_key_at(self, idx: IndexInfo, rid: int):
+        """One physical row's key tuple for `idx`, or None (NULL key)."""
+        mat, ids = self._uniq_key_rows(idx, np.array([rid], dtype=np.int64))
+        if len(ids) == 0:
+            return None
+        return tuple(mat[0].tolist())
 
     def _uniq_sorted(self, idx: IndexInfo) -> np.ndarray:
         """Sorted key set of present rows, cached per table version.
@@ -728,8 +755,9 @@ class Table:
         if marker is not None:
             # keys of rows this txn deleted are free for re-insertion;
             # a rollback resurrects them but also bumps the version,
-            # which rebuilds the cache
-            dead = np.nonzero(self.end_ts[: self.n] == marker)[0]
+            # which rebuilds the cache. O(dead) via the per-marker
+            # registry, not an O(n) end_ts scan per insert.
+            dead = np.asarray(self._txn_dead.get(marker, []), dtype=np.int64)
             if len(dead):
                 dk = np.sort(self._uniq_keys_at(idx, dead))
                 pos = np.searchsorted(cache, dk)
@@ -761,11 +789,15 @@ class Table:
             self._uniq_cache[name] = (self.version, keys)
         self._uniq_pending.clear()
 
-    def _check_unique(self, idx: IndexInfo, extra: Optional[tuple] = None) -> None:
+    def _check_unique(self, idx: IndexInfo, extra: Optional[tuple] = None,
+                      marker: Optional[int] = None) -> None:
         """Raise if the index's key columns contain duplicates among
         present rows (rows with any NULL key are exempt, MySQL-style).
-        `extra`=(start, end) adds not-yet-committed buffer slots."""
+        `extra`=(start, end) adds not-yet-committed buffer slots;
+        `marker` exempts versions this txn already superseded."""
         mask = self._present_mask()
+        if marker is not None:
+            mask = mask & (self.end_ts[: self.n] != marker)
         sel = np.nonzero(mask)[0]
         if extra is not None:
             sel = np.concatenate([sel, np.arange(extra[0], extra[1])])
@@ -832,25 +864,21 @@ class Table:
 
     def conflict_map(self, idx: IndexInfo, marker: Optional[int] = None) -> dict:
         """key tuple -> physical row id over rows present for constraint
-        purposes (minus rows this txn provisionally deleted). One O(n)
-        pass; callers keep it fresh across their own statement's
-        mutations instead of rescanning per VALUES row."""
+        purposes, minus rows this txn provisionally deleted AND minus
+        other open txns' provisional inserts (those are locked rows a
+        REPLACE/upsert must not touch — colliding with one surfaces as
+        a unique-violation/write-conflict instead of silent clobbering).
+        One O(n) pass; callers keep it fresh across their own
+        statement's mutations instead of rescanning per VALUES row."""
         mask = self._present_mask()
         if marker is not None:
             mask = mask & (self.end_ts[: self.n] != marker)
+            b = self.begin_ts[: self.n]
+            mask = mask & ~((b >= TXN_TS_BASE) & (b != marker))
         sel = np.nonzero(mask)[0]
-        ok = np.ones(len(sel), dtype=np.bool_)
-        cols = []
-        for cname in idx.columns:
-            d = self.data[cname][sel]
-            ok &= self.valid[cname][sel]
-            if np.issubdtype(d.dtype, np.floating):
-                d = d.astype(np.float64).view(np.int64)
-            cols.append(d.astype(np.int64))
-        if not cols:
+        mat, ids = self._uniq_key_rows(idx, sel)
+        if mat.size == 0 and len(ids) == 0:
             return {}
-        mat = np.stack(cols, axis=1)[ok]
-        ids = sel[ok]
         return {tuple(k): int(i) for k, i in zip(mat.tolist(), ids.tolist())}
 
     def row_value_map(self, names, row) -> Dict[str, object]:
