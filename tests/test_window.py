@@ -56,6 +56,15 @@ QUERIES = [
     # window value consumed by an expression and ORDER BY
     "select id, row_number() over (partition by g order by id) * 10 as rn"
     " from w order by rn, id limit 20",
+    # positional functions
+    "select id, lag(v) over (partition by g order by id) from w",
+    "select id, lag(v, 2) over (partition by g order by id) from w",
+    "select id, lag(v, 1, -1) over (partition by g order by id) from w",
+    "select id, lead(v) over (partition by g order by id) from w",
+    "select id, first_value(v) over (partition by g order by id) from w",
+    "select id, last_value(v) over (partition by g order by id) from w",
+    "select id, ntile(4) over (partition by g order by id) from w",
+    "select id, lag(g) over (order by id) from w",  # dict-coded strings
 ]
 
 
@@ -88,3 +97,38 @@ class TestWindow:
         s, _ = sess
         assert s.query("select id, sum(v) over (partition by g) from w"
                        " where id < 0") == []
+
+
+class TestPositionalDefaults:
+    """Review fixes: defaults in the column's device representation,
+    param validation."""
+
+    def test_string_default_in_dictionary(self, sess):
+        s, _ = sess
+        rows = s.query("select id, lag(g, 1, 'a') over (order by id)"
+                       " from w order by id limit 1")
+        assert rows == [(0, "a")]  # first row takes the default
+
+    def test_string_default_not_in_dictionary_rejected(self, sess):
+        s, _ = sess
+        from tidb_tpu.errors import UnsupportedError
+
+        with pytest.raises(UnsupportedError):
+            s.query("select lag(g, 1, 'zzz') over (order by id) from w")
+
+    def test_decimal_default_scaled(self, sess):
+        s, _ = sess
+        rows = s.query("select lag(p, 1, 9) over (order by id)"
+                       " from w order by id limit 1")
+        assert str(rows[0][0]) == "9.00"
+
+    def test_null_and_negative_params_rejected(self, sess):
+        s, _ = sess
+        with pytest.raises(PlanError):
+            s.query("select lag(v, null) over (order by id) from w")
+        with pytest.raises(PlanError):
+            s.query("select ntile(null) over (order by id) from w")
+        with pytest.raises(PlanError):
+            s.query("select lag(v, -1) over (order by id) from w")
+        with pytest.raises(PlanError):
+            s.query("select first_value(v, 99) over (order by id) from w")

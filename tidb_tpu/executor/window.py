@@ -31,7 +31,7 @@ __all__ = ["WindowExec"]
 
 class WindowExec(_Materializing):
     def __init__(self, schema, child, func: str, args, partition_by,
-                 order_by, out_uid: str, out_type):
+                 order_by, out_uid: str, out_type, params: tuple = ()):
         super().__init__(schema, [child])
         self.func = func
         self.args = args
@@ -39,6 +39,7 @@ class WindowExec(_Materializing):
         self.order_by = order_by
         self.out_uid = out_uid
         self.out_type = out_type
+        self.params = params
 
     def open(self, ctx: ExecContext) -> None:
         Executor.open(self, ctx)
@@ -66,7 +67,8 @@ class WindowExec(_Materializing):
                 host_keys[np_part : np_part + np_ord],
                 list(self.order_by),
                 host_keys[np_part + np_ord :],
-                n, self.out_type, avg_descale=descale)
+                n, self.out_type, avg_descale=descale,
+                params=self.params)
             self._emit(runs, None, n)  # original row order
         finally:
             self.schema = saved
@@ -90,7 +92,8 @@ class WindowExec(_Materializing):
 
 
 def _compute_window(func, part_keys, order_keys, order_items, arg_keys,
-                    n: int, out_type, avg_descale: float = 1.0):
+                    n: int, out_type, avg_descale: float = 1.0,
+                    params: tuple = ()):
     """Returns (values[n], valid[n]) in ORIGINAL row order."""
     if n == 0:
         return (np.zeros(0, dtype=out_type.np_dtype),
@@ -135,7 +138,49 @@ def _compute_window(func, part_keys, order_keys, order_items, arg_keys,
     idx = np.arange(n)
     out_valid = np.ones(n, dtype=np.bool_)
 
-    if func == "row_number":
+    # partition last index (for LEAD bounds / unordered LAST_VALUE)
+    pends = np.empty(len(starts), dtype=np.int64)
+    pends[:-1] = starts[1:] - 1
+    pends[-1] = n - 1
+    part_end = pends[pid]
+
+    if func in ("lead", "lag", "first_value", "last_value", "ntile"):
+        if func == "ntile":
+            nb = int(params[0])
+            size = part_end - part_start + 1
+            k = idx - part_start
+            base = size // nb
+            rem = size % nb
+            thresh = rem * (base + 1)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                svals = np.where(
+                    k < thresh,
+                    k // np.maximum(base + 1, 1) + 1,
+                    rem + (k - thresh) // np.maximum(base, 1) + 1)
+        else:
+            ad, av = arg_keys[0][0][perm], arg_keys[0][1][perm]
+            if func == "first_value":
+                src_i = part_start
+                inwin = np.ones(n, dtype=np.bool_)
+            elif func == "last_value":
+                # default frame: up to the current tie group (ordered),
+                # whole partition otherwise
+                src_i = tie_last if order_items else part_end
+                inwin = np.ones(n, dtype=np.bool_)
+            else:
+                off = int(params[0])
+                src_i = idx - off if func == "lag" else idx + off
+                inwin = (src_i >= part_start) & (src_i <= part_end)
+                src_i = np.clip(src_i, 0, n - 1)
+            svals = ad[src_i]
+            out_valid = av[src_i] & inwin
+            if func in ("lead", "lag") and len(params) > 1:
+                _off, dval, dnull = params
+                if not dnull:
+                    dv = out_type.np_dtype.type(dval)
+                    svals = np.where(inwin, svals, dv)
+                    out_valid = np.where(inwin, out_valid, True)
+    elif func == "row_number":
         svals = idx - part_start + 1
     elif func == "rank":
         svals = tie_start - part_start + 1

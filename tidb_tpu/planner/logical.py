@@ -111,6 +111,9 @@ class LWindow(LogicalPlan):
     order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
     out_uid: str = ""
     out_type: SQLType = INT64
+    # positional params: LEAD/LAG -> (offset, default_value_or_None,
+    # default_is_null); NTILE -> (n,)
+    params: tuple = ()
 
 
 @dataclass
@@ -400,7 +403,8 @@ def _substitute(e, mapping: Dict[str, str]):
 
 
 _WINDOW_FUNCS = {"row_number", "rank", "dense_rank",
-                 "count", "sum", "avg", "min", "max"}
+                 "count", "sum", "avg", "min", "max",
+                 "lead", "lag", "first_value", "last_value", "ntile"}
 
 
 def _collect_window_calls(e, out: Dict[str, A.EWindow]) -> None:
@@ -419,12 +423,96 @@ def _collect_window_calls(e, out: Dict[str, A.EWindow]) -> None:
             _collect_window_calls(v, out)
 
 
+def _window_default_repr(binder, d0: Literal, arg: Expr, fname: str):
+    """LEAD/LAG default literal -> the argument column's DEVICE
+    representation (dict code for strings, scaled int for decimals),
+    since the executor substitutes it directly into the value array.
+    Returns (value, is_null)."""
+    if d0.value is None:
+        return None, True
+    t = arg.type_
+    k = t.kind
+    if k in (TypeKind.STRING, TypeKind.JSON):
+        d = binder._dict_of(arg)
+        if d is None:
+            raise UnsupportedError(
+                f"{fname.upper()} string default without dictionary context")
+        s = str(d0.value) if d0.type_.kind == TypeKind.STRING else str(int(d0.value))
+        code = d.code_of(s)
+        if code < 0:
+            raise UnsupportedError(
+                f"{fname.upper()} default {s!r} not in the column dictionary")
+        return int(code), False
+    if k == TypeKind.DECIMAL:
+        if d0.type_.kind == TypeKind.DECIMAL:
+            return int(d0.value) * 10 ** (t.scale - d0.type_.scale) \
+                if t.scale >= d0.type_.scale else \
+                int(round(int(d0.value) / 10 ** (d0.type_.scale - t.scale))), False
+        if d0.type_.kind == TypeKind.INT:
+            return int(d0.value) * 10 ** t.scale, False
+        if d0.type_.kind == TypeKind.FLOAT:
+            return int(round(float(d0.value) * 10 ** t.scale)), False
+    if k == TypeKind.FLOAT:
+        v = d0.value
+        if d0.type_.kind == TypeKind.DECIMAL:
+            v = int(v) / 10 ** d0.type_.scale
+        return float(v), False
+    return d0.value, False
+
+
 def _plan_window(w: A.EWindow, plan: LogicalPlan, scope: Scope,
                  ctx: BuildContext):
     """Stack one LWindow node; returns (plan, widened scope, out uid)."""
     binder = ctx.binder
     part = [binder.bind_expr(e, scope) for e in w.partition_by]
     order = [(binder.bind_expr(oi.expr, scope), oi.desc) for oi in w.order_by]
+    params: tuple = ()
+    if w.func in ("lead", "lag", "first_value", "last_value"):
+        if not w.args:
+            raise PlanError(f"{w.func.upper()} needs an argument")
+        if w.func in ("first_value", "last_value") and len(w.args) != 1:
+            raise PlanError(f"{w.func.upper()} takes exactly one argument")
+        arg = binder.bind_expr(w.args[0], scope)
+        if w.func in ("lead", "lag"):
+            off = 1
+            if len(w.args) > 1:
+                o = binder.bind_expr(w.args[1], scope)
+                if not isinstance(o, Literal) or o.value is None \
+                        or int(o.value) < 0:
+                    raise PlanError(
+                        f"{w.func.upper()} offset must be a nonnegative constant")
+                off = int(o.value)
+            dval, dnull = None, True
+            if len(w.args) > 2:
+                d0 = binder.coerce_untyped_literal(
+                    binder.bind_expr(w.args[2], scope), arg.type_)
+                if not isinstance(d0, Literal):
+                    raise PlanError(f"{w.func.upper()} default must be constant")
+                dval, dnull = _window_default_repr(binder, d0, arg, w.func)
+            params = (off, dval, dnull)
+        node_args = [arg]
+        uid = binder.new_uid(f"win.{w.func}")
+        col = PlanCol(uid=uid, name=uid, type_=arg.type_,
+                      dict_=binder._dict_of(arg))
+        node = LWindow(schema=list(plan.schema) + [col], children=[plan],
+                       func=w.func, args=node_args, partition_by=part,
+                       order_by=order, out_uid=uid, out_type=arg.type_,
+                       params=params)
+        return node, Scope(list(scope.cols) + [col], scope.parent), uid
+    if w.func == "ntile":
+        if len(w.args) != 1:
+            raise PlanError("NTILE takes one constant argument")
+        nlit = binder.bind_expr(w.args[0], scope)
+        if not isinstance(nlit, Literal) or nlit.value is None \
+                or int(nlit.value) < 1:
+            raise PlanError("NTILE argument must be a positive constant")
+        uid = binder.new_uid("win.ntile")
+        col = PlanCol(uid=uid, name=uid, type_=INT64)
+        node = LWindow(schema=list(plan.schema) + [col], children=[plan],
+                       func="ntile", args=[], partition_by=part,
+                       order_by=order, out_uid=uid, out_type=INT64,
+                       params=(int(nlit.value),))
+        return node, Scope(list(scope.cols) + [col], scope.parent), uid
     if w.func in ("row_number", "rank", "dense_rank"):
         if w.args:
             raise PlanError(f"{w.func.upper()} takes no arguments")

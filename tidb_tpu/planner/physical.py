@@ -128,6 +128,7 @@ class PWindow(PhysicalPlan):
     order_by: List[Tuple[object, bool]] = field(default_factory=list)
     out_uid: str = ""
     out_type: object = None
+    params: tuple = ()
     task: str = "root"
 
     def op_info(self):
@@ -364,7 +365,8 @@ def lower(plan: LogicalPlan) -> PhysicalPlan:
         return PWindow(
             schema=plan.schema, children=[lower(plan.child)], est_rows=est,
             func=plan.func, args=plan.args, partition_by=plan.partition_by,
-            order_by=plan.order_by, out_uid=plan.out_uid, out_type=plan.out_type)
+            order_by=plan.order_by, out_uid=plan.out_uid, out_type=plan.out_type,
+            params=plan.params)
     if isinstance(plan, LLimit):
         c = lower(plan.child)
         if isinstance(c, PSort):
