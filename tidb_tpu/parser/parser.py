@@ -177,25 +177,15 @@ class Parser:
                 if not self.accept_op(","):
                     break
 
-        node = self.parse_select_core()
-        while self.at_kw("union", "except", "intersect"):
+        node = self._parse_intersect_chain()
+        while self.at_kw("union", "except"):
             op = self.next().text
             all_ = bool(self.accept_kw("all"))
             if not all_:
                 self.accept_kw("distinct")
-            right = self.parse_select_core()
+            right = self._parse_intersect_chain()
             node = UnionStmt(node, right, all=all_, op=op)
-            # an unparenthesized trailing ORDER BY/LIMIT was consumed by the
-            # right SELECT but binds to the whole union (MySQL semantics);
-            # a parenthesized operand keeps its own ORDER BY/LIMIT
-            if (
-                isinstance(right, SelectStmt)
-                and not getattr(right, "_parenthesized", False)
-                and not self.at_kw("union", "except", "intersect")
-            ):
-                node.order_by, right.order_by = right.order_by, []
-                node.limit, node.offset = right.limit, right.offset
-                right.limit = right.offset = None
+            self._hoist_set_tail(node, right)
         if ctes:
             if isinstance(node, SelectStmt):
                 node.ctes = ctes
@@ -206,6 +196,33 @@ class Parser:
                     left = left.left
                 left.ctes = ctes
         return node
+
+    def _parse_intersect_chain(self):
+        """INTERSECT binds tighter than UNION/EXCEPT (SQL standard and
+        MySQL 8)."""
+        node = self.parse_select_core()
+        while self.at_kw("intersect"):
+            self.next()
+            all_ = bool(self.accept_kw("all"))
+            if not all_:
+                self.accept_kw("distinct")
+            right = self.parse_select_core()
+            node = UnionStmt(node, right, all=all_, op="intersect")
+            self._hoist_set_tail(node, right)
+        return node
+
+    def _hoist_set_tail(self, node: UnionStmt, right) -> None:
+        """An unparenthesized trailing ORDER BY/LIMIT was consumed by
+        the rightmost operand but binds to the whole compound statement
+        (MySQL semantics); a parenthesized operand keeps its own.
+        `right` may itself be a set-op chain whose tail was hoisted."""
+        if getattr(right, "_parenthesized", False):
+            return
+        if self.at_kw("union", "except", "intersect"):
+            return
+        node.order_by, right.order_by = right.order_by, []
+        node.limit, node.offset = right.limit, right.offset
+        right.limit = right.offset = None
 
     def parse_select_core(self) -> Union[SelectStmt, "UnionStmt"]:
         if self.accept_op("("):

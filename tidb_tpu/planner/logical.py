@@ -1230,12 +1230,16 @@ def _build_full_join(src: A.Join, ctx: BuildContext, outer):
 
 
 def _build_union(stmt: A.UnionStmt, ctx: BuildContext, outer) -> LogicalPlan:
-    if stmt.op != "union":
+    if stmt.op not in ("union", "except", "intersect"):
         raise UnsupportedError(f"{stmt.op.upper()} not supported yet")
+    if stmt.op in ("except", "intersect") and stmt.all:
+        raise UnsupportedError(f"{stmt.op.upper()} ALL not supported yet")
     sides: List[LogicalPlan] = []
 
     def flatten(s):
-        if isinstance(s, A.UnionStmt) and s.op == "union" and s.all == stmt.all and not s.order_by and s.limit is None:
+        if (stmt.op == "union" and isinstance(s, A.UnionStmt)
+                and s.op == "union" and s.all == stmt.all
+                and not s.order_by and s.limit is None):
             flatten(s.left)
             flatten(s.right)
         else:
@@ -1288,15 +1292,56 @@ def _build_union(stmt: A.UnionStmt, ctx: BuildContext, outer) -> LogicalPlan:
         for c, oc in zip(cols, out_cols):
             c.uid = oc.uid
 
-    node = LUnion(schema=out_cols, children=coerced, all=stmt.all)
-    if not stmt.all:
+    if stmt.op in ("except", "intersect"):
+        # set semantics via a marked union: tag each side, group by all
+        # columns, keep groups by side counts (NULLs group together, so
+        # NULL rows compare equal — exactly set-operation semantics)
+        binder = ctx.binder
+        # one side-tag column: per group, sl = SUM(tag) counts left-side
+        # rows and COUNT(*) - sl counts right-side rows
+        l_uid = binder.new_uid("__settag")
+        lcol = PlanCol(uid=l_uid, name=l_uid, type_=INT64)
+        for i, proj in enumerate(coerced):
+            proj.exprs = list(proj.exprs) + [
+                Literal(type_=INT64, value=1 if i == 0 else 0)]
+            proj.schema = list(proj.schema) + [dataclasses.replace(lcol)]
+        ext_cols = out_cols + [lcol]
+        node = LUnion(schema=ext_cols, children=coerced, all=True)
+        sl_uid, cnt_uid = binder.new_uid("sum.__settag"), binder.new_uid("cnt")
+        agg_schema = list(out_cols) + [
+            PlanCol(uid=sl_uid, name=sl_uid, type_=INT64),
+            PlanCol(uid=cnt_uid, name=cnt_uid, type_=INT64),
+        ]
         node = LAggregate(
-            schema=list(out_cols),
-            children=[node],
+            schema=agg_schema, children=[node],
             group_exprs=[c.ref() for c in out_cols],
             group_uids=[c.uid for c in out_cols],
-            aggs=[],
+            aggs=[AggSpec(uid=sl_uid, func="sum", arg=lcol.ref(), type_=INT64),
+                  AggSpec(uid=cnt_uid, func="count", arg=None, type_=INT64)],
         )
+        sl = ColumnRef(type_=INT64, name=sl_uid)
+        cnt = ColumnRef(type_=INT64, name=cnt_uid)
+        zero = Literal(type_=INT64, value=0)
+        left_present = Call(type_=BOOL, op="gt", args=(sl, zero))
+        sr = Call(type_=INT64, op="sub", args=(cnt, sl))
+        right_test = Call(type_=BOOL,
+                          op="eq" if stmt.op == "except" else "gt",
+                          args=(sr, zero))
+        cond = Call(type_=BOOL, op="and", args=(left_present, right_test))
+        node = LSelection(schema=list(agg_schema), children=[node], cond=cond)
+        node = LProjection(schema=list(out_cols), children=[node],
+                           exprs=[c.ref() for c in out_cols],
+                           n_visible=len(out_cols))
+    else:
+        node = LUnion(schema=out_cols, children=coerced, all=stmt.all)
+        if not stmt.all:
+            node = LAggregate(
+                schema=list(out_cols),
+                children=[node],
+                group_exprs=[c.ref() for c in out_cols],
+                group_uids=[c.uid for c in out_cols],
+                aggs=[],
+            )
 
     plan = node
     if stmt.order_by:
