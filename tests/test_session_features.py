@@ -83,3 +83,28 @@ class TestExplainAnalyze:
         scan_line = next(r[0] for r in rows if "TableScan" in r[0])
         # 2 rows pass the fused filter (NULL excluded)
         assert " 2 " in scan_line
+
+
+class TestShowShortcuts:
+    """DESCRIBE <table> = SHOW COLUMNS; SHOW INDEX/INDEXES/KEYS FROM."""
+
+    def test_describe_table(self, sess):
+        assert sess.execute("describe t").rows == sess.execute(
+            "show columns from t").rows
+        assert sess.execute("desc t").rows[0][0] == "a"
+
+    def test_show_index(self, sess):
+        sess.execute("create table si (x bigint primary key, y bigint)")
+        sess.execute("create index iy on si (y)")
+        rows = sess.execute("show index from si").rows
+        assert ("si", 0, "PRIMARY", 1, "x") in rows
+        assert ("si", 1, "iy", 1, "y") in rows
+        assert sess.execute("show keys from si").rows == rows
+
+    def test_explain_statement_keywords_still_explain(self):
+        from tidb_tpu.parser import ast as A, parse
+
+        s1 = parse("explain replace into t values (1)")[0]
+        assert isinstance(s1, A.ExplainStmt) and isinstance(s1.stmt, A.InsertStmt)
+        s2 = parse("explain truncate t")[0]
+        assert isinstance(s2, A.ExplainStmt)

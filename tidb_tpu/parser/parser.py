@@ -729,8 +729,16 @@ class Parser:
 
     # -- misc statements -----------------------------------------------------
 
-    def parse_explain(self) -> ExplainStmt:
+    def parse_explain(self):
         self.next()  # explain/describe/desc
+        t = self.peek()
+        # DESCRIBE <table> is SHOW COLUMNS (MySQL shorthand) — but a
+        # statement keyword (EXPLAIN REPLACE ..., EXPLAIN TRUNCATE ...)
+        # still explains that statement
+        if t.kind in ("IDENT", "QIDENT") or (
+                t.kind == "KW" and t.text in _IDENTISH_KW
+                and t.text not in _STMT_KWS):
+            return ShowStmt("columns", target=self.expect_ident())
         analyze = bool(self.accept_kw("analyze"))
         start = self.peek().pos
         inner = self.parse_statement()
@@ -793,6 +801,12 @@ class Parser:
             return ShowStmt("status")
         if self.accept_kw("plugins"):
             return ShowStmt("plugins")
+        if self.accept_kw("index") or (
+                self.peek().kind == "IDENT"
+                and self.peek().text.lower() in ("indexes", "keys")
+                and self.next()):
+            self.expect_kw("from")
+            return ShowStmt("index", target=self.expect_ident())
         if self.accept_kw("bindings"):
             return ShowStmt("bindings")
         raise self.error("unsupported SHOW")
@@ -1177,6 +1191,14 @@ class Parser:
 
 
 # keywords that may appear where identifiers/functions are expected
+# keywords that start a parsable statement: EXPLAIN <stmt> keeps its
+# meaning for these even though some double as identifiers
+_STMT_KWS = {
+    "select", "with", "insert", "replace", "update", "delete", "create",
+    "drop", "alter", "set", "show", "begin", "start", "commit", "rollback",
+    "use", "truncate", "analyze", "trace", "install", "uninstall",
+}
+
 _IDENTISH_KW = {
     "date", "time", "timestamp", "left", "right", "if", "replace", "values",
     "database", "schema", "comment", "status", "key", "engine", "truncate",

@@ -1059,6 +1059,17 @@ class Session:
                 for c in t.schema.columns
             ]
             return ResultSet(names=["Field", "Type", "Null"], rows=rows)
+        if stmt.kind == "index":
+            t = self.catalog.table(self.db, stmt.target)
+            rows = []
+            for idx in t.indexes.values():
+                for seq, col in enumerate(idx.columns, 1):
+                    rows.append((stmt.target, 0 if idx.unique else 1,
+                                 idx.name, seq, col))
+            return ResultSet(
+                names=["Table", "Non_unique", "Key_name", "Seq_in_index",
+                       "Column_name"],
+                rows=rows)
         if stmt.kind == "create_view":
             v = self.catalog.view(self.db, stmt.target)
             if v is None:
