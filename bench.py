@@ -29,7 +29,7 @@ Q18's group-by cardinality is ~#orders; see extra.q18_sf for the value
 used), BENCH_SF_SSB (default min(SF, 0.1)), BENCH_SF_DS (default
 min(SF, 0.5)), BENCH_REPS (default 3), BENCH_CHUNK (default 2^20 rows),
 BENCH_ORACLE=0 to skip sqlite baselines, BENCH_PROBE_TIMEOUT (default
-120s), BENCH_PLATFORM to force a platform and skip the probe.
+300s), BENCH_PLATFORM to force a platform and skip the probe.
 """
 
 import json
@@ -43,7 +43,7 @@ SF = float(os.environ.get("BENCH_SF", "1.0"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
 CAP = int(os.environ.get("BENCH_CHUNK", str(1 << 20)))
 ORACLE = os.environ.get("BENCH_ORACLE", "1") != "0"
-PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
 SF_Q18 = float(os.environ.get("BENCH_SF_Q18", str(min(SF, 0.2))))
 SF_SSB = float(os.environ.get("BENCH_SF_SSB", str(min(SF, 0.1))))
 SF_DS = float(os.environ.get("BENCH_SF_DS", str(min(SF, 0.5))))
@@ -75,8 +75,14 @@ def pick_platform():
                 return "default", r.stdout.strip().splitlines()[-1]
             last = (r.stderr or r.stdout)[-1500:]
         except subprocess.TimeoutExpired:
+            # the timeout KILLED the child, possibly mid-claim — a
+            # pattern observed to wedge the chip relay for hours. Never
+            # kill a second claimer: fall back to CPU immediately.
             last = f"backend probe timed out after {PROBE_TIMEOUT}s"
-        log(f"# backend probe attempt {attempt + 1} failed: {last.splitlines()[-1] if last else '?'}")
+            log(f"# backend probe timed out; no retry (wedge risk)")
+            break
+        log(f"# backend probe attempt {attempt + 1} failed: "
+            f"{last.splitlines()[-1] if last else '?'}")
         time.sleep(3)
     return "cpu", last
 
