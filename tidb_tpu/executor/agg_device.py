@@ -61,35 +61,61 @@ def _bits64(data: jax.Array, valid: jax.Array) -> jax.Array:
     return jnp.where(valid, b, 0)
 
 
+def _group_hash(kbits: List[jax.Array], kvalids: List[jax.Array]) -> jax.Array:
+    """One i64 ordering hash over all key components (validity folded in
+    so a NULL key and a live 0 key land in different runs)."""
+    h = jnp.zeros_like(kbits[0])
+    for b, v in zip(kbits, kvalids):
+        hb = b * np.int64(2) + v.astype(jnp.int64)
+        h = (h ^ hb) * np.int64(-7046029254386353131) + np.int64(0x165667B19E3779F9)
+    return h
+
+
 def _sort_reduce(kbits: List[jax.Array], kvalids: List[jax.Array],
                  kdatas: List[jax.Array], live: jax.Array,
                  payload: List[jax.Array], reduce_ops: List[str]):
     """Shared core: sort rows by (dead, key identity), find segment
     boundaries, reduce payload arrays into dense per-group slots.
 
+    Only (dead, order-key, iota) go through the sorting network; key
+    values and payloads are gathered by the resulting permutation —
+    lax.sort carries every operand through its whole comparison network,
+    so this is ~(2+nk*3+npayload)/4 less data movement than sorting the
+    carried arrays directly. Single-key inputs order by the exact key
+    bits; multi-key inputs order by a mixed 64-bit hash with exact-key
+    boundary detection, so a hash collision can only SPLIT a group into
+    two partial slots (never merge two groups) — consumers dedup by
+    exact key at finalize (host _merge_partials), keeping results exact.
+
     Returns (ngroups, rep_kdatas, rep_kvalids, reduced_payloads) — all
     slot arrays with groups dense in [0, ngroups)."""
     R = live.shape[0]
     dead = (~live).astype(jnp.int32)
-    sort_keys: List[jax.Array] = [dead]
-    for b, v in zip(kbits, kvalids):
-        sort_keys += [b, v.astype(jnp.int32)]
-    nsk = len(sort_keys)
-    carried = kdatas + [v for v in kvalids] + payload + [live]
-    out = jax.lax.sort(tuple(sort_keys) + tuple(carried), num_keys=nsk)
-    s_keys = out[:nsk]
-    nk = len(kbits)
-    s_kdatas = list(out[nsk:nsk + nk])
-    s_kvalids = list(out[nsk + nk:nsk + 2 * nk])
-    s_payload = list(out[nsk + 2 * nk:-1])
-    s_live = out[-1]
+    iota = jnp.arange(R, dtype=jnp.int32)
+    if len(kbits) == 1:
+        # exact: equal bits tie-break on validity (NULL run != live-0 run)
+        out = jax.lax.sort(
+            (dead, kbits[0], kvalids[0].astype(jnp.int32), iota), num_keys=3)
+    else:
+        out = jax.lax.sort(
+            (dead, _group_hash(kbits, kvalids), iota), num_keys=2)
+    perm = out[-1]
+
+    def take(a):
+        return jnp.take(a, perm, axis=0)
+
+    s_kbits = [take(b) for b in kbits]
+    s_kdatas = [take(d) for d in kdatas]
+    s_kvalids = [take(v) for v in kvalids]
+    s_payload = [take(p) for p in payload]
+    s_live = take(live)
 
     # live rows are a prefix (dead sorts last); a new segment starts at
-    # row 0 or where any key component differs from the previous row
+    # row 0 or where any exact key component differs from the previous row
     idx = jnp.arange(R)
     diff = jnp.zeros(R, dtype=jnp.bool_)
-    for op in s_keys[1:]:  # key components only (dead is constant 0 in prefix)
-        diff = diff | (op != jnp.roll(op, 1))
+    for b, v in zip(s_kbits, s_kvalids):
+        diff = diff | (b != jnp.roll(b, 1)) | (v != jnp.roll(v, 1))
     newseg = s_live & ((idx == 0) | diff)
     seg = jnp.clip(jnp.cumsum(newseg.astype(jnp.int64)) - 1, 0, R - 1)
     ngroups = jnp.sum(newseg.astype(jnp.int64))

@@ -431,6 +431,14 @@ class HashAggExec(Executor):
                     tracker.consume(b_m)
                     tracker.release(tracked)  # old merged + p are dead
                     tracked = b_m
+            if len(host_tables) == 1 and len(self.group_exprs) > 1:
+                # multi-key device tables order by a mixed hash; a
+                # collision can split a group — exact-dedup on host
+                merged = self._merge_partials([merged])
+                b_m = _partial_nbytes(merged)
+                tracker.consume(b_m)
+                tracker.release(tracked)
+                tracked = b_m
             self._emit_merged(merged, cap)
         finally:
             tracker.release(tracked)

@@ -294,7 +294,13 @@ class DistFragmentExec(HashAggExec):
     join trees, broadcast build sides, segment or generic aggregation —
     one shard_map dispatch per execution, with per-knob capacity retry."""
 
-    MAX_GROWTH = {"exch": 64.0, "expand": 2048.0}
+    # "compact" knobs have no ceiling: their cap is min'd against the
+    # static capacity inside the fragment, so growth converges to a no-op
+    # in O(log) retries even from a wildly wrong estimate. "expand" jumps
+    # to the exact reported factor (never speculative), and a compacted
+    # probe side legitimately inflates the factor — the ceiling only
+    # guards against compiling absurd buffers for pathological skew.
+    MAX_GROWTH = {"exch": 64.0, "expand": 65536.0, "compact": float("inf")}
 
     def __init__(self, plan: PHashAgg, prog, cache: ShardCache):
         super().__init__(plan.schema, None, plan.group_exprs, plan.group_uids,
@@ -336,11 +342,12 @@ class DistFragmentExec(HashAggExec):
     # ------------------------------------------------------------------
 
     def _materialize_broadcast(self, bc):
-        """Run a non-scan subtree on this chip and return replicated
-        (data, valid, sel) arrays — the broadcast exchange input."""
-        from tidb_tpu.executor.builder import build_executor
-
-        root = build_executor(bc.plan)
+        """Run a non-scan subtree and return replicated (data, valid, sel)
+        arrays — the broadcast exchange input. The subtree itself runs
+        through the distributed builder, so an agg-rooted build side (a
+        HAVING subquery, say) executes as a mesh fragment instead of a
+        single-chip pass over the whole table."""
+        root = build_dist_executor(bc.plan, self._cache)
         datas = {c.uid: [] for c in bc.schema}
         valids = {c.uid: [] for c in bc.schema}
         n = 0
@@ -414,7 +421,7 @@ class DistFragmentExec(HashAggExec):
             for g, o, kind in zip(growths, ovf, prog.growth_kinds):
                 if o <= 0:
                     new.append(g)
-                elif kind == "expand":
+                elif kind in ("expand", "compact"):
                     factor = int(o) + 1
                     mult = 1
                     while mult < factor:
@@ -462,7 +469,10 @@ class DistFragmentExec(HashAggExec):
         if not partials:
             self._out = []  # no groups anywhere
             return
-        merged = partials[0] if len(partials) == 1 else self._merge_partials(partials)
+        # multi-key tables order by a mixed hash; a collision can split a
+        # group across slots, so always exact-dedup through the merge
+        merged = (partials[0] if len(partials) == 1 and nk <= 1
+                  else self._merge_partials(partials))
         self._emit_merged(merged, self.ctx.chunk_capacity)
 
 

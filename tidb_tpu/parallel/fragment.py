@@ -70,6 +70,42 @@ BROADCAST_LIMIT = 1 << 21
 _key_bits = _bits64
 
 
+def _compact(arrays: Dict[str, jax.Array], sel: jax.Array, cap: int):
+    """Scatter live rows to the prefix of [cap] buffers (linear — no sort).
+
+    Static capacities cascade: every stage inherits the worst case of the
+    stage before, while selective joins/filters collapse the LIVE count.
+    Sorts and exchanges pay for capacity, so compacting to an
+    estimate-sized buffer (with the usual overflow-retry knob) is the
+    static-shape analogue of a dynamic repartition. Returns
+    (arrays', sel', required_factor_minus_one)."""
+    pos = jnp.cumsum(sel.astype(jnp.int64)) - 1
+    total = jnp.sum(sel.astype(jnp.int64))
+    tgt = jnp.where(sel & (pos < cap), pos, cap)  # dead rows -> drop lane
+    out = {}
+    for name, a in arrays.items():
+        buf = jnp.zeros((cap + 1,) + a.shape[1:], dtype=a.dtype)
+        out[name] = buf.at[tgt].set(a, mode="drop")[:cap]
+    nsel = jnp.arange(cap) < jnp.minimum(total, cap)
+    factor = (total + cap - 1) // cap
+    return out, nsel, jnp.maximum(factor - 1, 0)
+
+
+def _compact_chunk(chunk: Chunk, cap: int):
+    """Compact a Chunk's live rows into a capacity-`cap` Chunk."""
+    arrays = {}
+    for uid, col in chunk.columns.items():
+        arrays[uid + ".d"] = col.data
+        arrays[uid + ".v"] = col.valid
+    out, nsel, ovf = _compact(arrays, chunk.sel, cap)
+    cols = {
+        uid: Column(data=out[uid + ".d"], valid=out[uid + ".v"],
+                    type_=col.type_)
+        for uid, col in chunk.columns.items()
+    }
+    return Chunk(cols, nsel), ovf
+
+
 def _mix_hash(bits: List[jax.Array]) -> jax.Array:
     """Combine per-key bit patterns into one routing/sort hash."""
     if len(bits) == 1:
@@ -135,6 +171,17 @@ class _Compiler:
         self.growth_defaults.append(default)
         self.growth_kinds.append(kind)
         return idx
+
+    def _compact_knob(self, est_rows: float) -> Tuple[int, int]:
+        """Estimate-sized compaction target: a "compact" knob plus its
+        base capacity (~2x the per-shard cardinality estimate, floor 64).
+        The base is part of the fragment signature — a stats change that
+        moves an estimate must not hit a cached fragment compiled with
+        the old capacities."""
+        base = max(64, int(np.ceil(2.0 * max(est_rows, 1.0) / self.n_parts)))
+        idx = self._add_growth(1.0, "compact")
+        self.sig.append(f"cap{idx}:{base}")
+        return idx, base
 
     # -- producers ---------------------------------------------------------
 
@@ -226,6 +273,13 @@ class _Compiler:
         exchange = not build_is_bcast
         g_exch = self._add_growth(2.0, "exch") if exchange else None
         g_expand = self._add_growth(1.0, "expand")
+        # estimate-sized compaction targets (overflow-retried): selective
+        # filters/joins collapse live counts, and every sort/exchange
+        # downstream pays for capacity — so shrink to ~2x the planner's
+        # cardinality estimate wherever that is below the static capacity
+        g_pcomp, p_base = self._compact_knob(probe_plan.est_rows)
+        g_bcomp, b_base = self._compact_knob(build_plan.est_rows)
+        g_ocomp, o_base = self._compact_knob(join.est_rows)
 
         kind = join.kind
         exists_sem = join.exists_sem
@@ -246,6 +300,15 @@ class _Compiler:
             pch, p_ovf = probe_emit(env, growths)
             bch, b_ovf = build_emit(env, growths)
             ovfs = list(p_ovf) + list(b_ovf)
+
+            capP = int(np.ceil(growths[g_pcomp] * p_base))
+            if capP < pch.capacity:
+                pch, o = _compact_chunk(pch, capP)
+                ovfs.append((g_pcomp, pmax_compat(o, _AXES)))
+            capB = int(np.ceil(growths[g_bcomp] * b_base))
+            if capB < bch.capacity:
+                bch, o = _compact_chunk(bch, capB)
+                ovfs.append((g_bcomp, pmax_compat(o, _AXES)))
 
             p_outs = [eval_expr(k, pch) for k in probe_keys]
             b_outs = [eval_expr(k, bch) for k in build_keys]
@@ -294,7 +357,7 @@ class _Compiler:
                 br, br_sel, br_hash, bovf = repartition_by_key(
                     flat(bch, b_bits, b_kvalid), bch.sel, b_hash,
                     jnp.ones_like(b_kvalid), n_parts, growth)
-                ovfs.append(jax.lax.psum(povf + bovf, _AXES))
+                ovfs.append((g_exch, jax.lax.psum(povf + bovf, _AXES)))
                 pch2, p_bits2, p_kvalid2 = unflat(pr, pch, pr_sel)
                 bch2, b_bits2, b_kvalid2 = unflat(br, bch, br_sel)
                 p_hash2, b_hash2 = pr_hash, br_hash
@@ -326,7 +389,7 @@ class _Compiler:
             capJ = int(np.ceil(growth_j * Rp))
             # required-factor-minus-one, maxed over shards (0 = fits)
             factor = (total + capJ - 1) // capJ
-            ovfs.append(pmax_compat(jnp.maximum(factor - 1, 0), _AXES))
+            ovfs.append((g_expand, pmax_compat(jnp.maximum(factor - 1, 0), _AXES)))
 
             j = jnp.arange(capJ, dtype=jnp.int64)
             valid_out = j < total
@@ -353,40 +416,45 @@ class _Compiler:
                 joined = joined.filter(other_pred(joined))
 
             if kind == "inner":
-                return joined, ovfs
-
-            # per-probe-row match flags (post-cond): scatter-or by p_row
-            m = jnp.zeros(Rp, dtype=jnp.int32).at[p_row].add(
-                joined.sel.astype(jnp.int32)) > 0
-            if kind == "semi":
-                return pch2.with_sel(p_ok & m), ovfs
-            if kind == "anti":
-                if exists_sem:
-                    keep = pch2.sel & ~(p_kvalid2 & m)
+                result = joined
+            else:
+                # per-probe-row match flags (post-cond): scatter-or by p_row
+                m = jnp.zeros(Rp, dtype=jnp.int32).at[p_row].add(
+                    joined.sel.astype(jnp.int32)) > 0
+                if kind == "semi":
+                    result = pch2.with_sel(p_ok & m)
+                elif kind == "anti":
+                    if exists_sem:
+                        keep = pch2.sel & ~(p_kvalid2 & m)
+                    else:
+                        keep = pch2.sel & p_kvalid2 & ~m & (b_null == 0)
+                    result = pch2.with_sel(keep)
                 else:
-                    keep = pch2.sel & p_kvalid2 & ~m & (b_null == 0)
-                return pch2.with_sel(keep), ovfs
+                    # left join: expanded matches + one NULL-build row for
+                    # each unmatched live probe row, concatenated
+                    pad_sel = pch2.sel & ~m
+                    out_cols = {}
+                    for uid, col in pch2.columns.items():
+                        jc = joined.columns[uid]
+                        out_cols[uid] = Column(
+                            jnp.concatenate([jc.data, col.data]),
+                            jnp.concatenate([jc.valid, col.valid]),
+                            col.type_,
+                        )
+                    for uid, col in bch2.columns.items():
+                        jc = joined.columns[uid]
+                        out_cols[uid] = Column(
+                            jnp.concatenate([jc.data, jnp.zeros(Rp, dtype=col.data.dtype)]),
+                            jnp.concatenate([jc.valid, jnp.zeros(Rp, dtype=jnp.bool_)]),
+                            col.type_,
+                        )
+                    result = Chunk(out_cols, jnp.concatenate([joined.sel, pad_sel]))
 
-            # left join: expanded matches + one NULL-build row for each
-            # unmatched live probe row, concatenated into one chunk
-            pad_sel = pch2.sel & ~m
-            out_cols = {}
-            for uid, col in pch2.columns.items():
-                jc = joined.columns[uid]
-                out_cols[uid] = Column(
-                    jnp.concatenate([jc.data, col.data]),
-                    jnp.concatenate([jc.valid, col.valid]),
-                    col.type_,
-                )
-            for uid, col in bch2.columns.items():
-                jc = joined.columns[uid]
-                out_cols[uid] = Column(
-                    jnp.concatenate([jc.data, jnp.zeros(Rp, dtype=col.data.dtype)]),
-                    jnp.concatenate([jc.valid, jnp.zeros(Rp, dtype=jnp.bool_)]),
-                    col.type_,
-                )
-            sel_cat = jnp.concatenate([joined.sel, pad_sel])
-            return Chunk(out_cols, sel_cat), ovfs
+            capO = int(np.ceil(growths[g_ocomp] * o_base))
+            if capO < result.capacity:
+                result, o = _compact_chunk(result, capO)
+                ovfs.append((g_ocomp, pmax_compat(o, _AXES)))
+            return result, ovfs
 
         return emit
 
@@ -425,12 +493,29 @@ class _Compiler:
         nk = len(agg.group_exprs)
         g_agg = self._add_growth(2.0, "exch")
         n_parts = self.n_parts
+        # estimate-sized shrink targets (see _compact): the partial sort
+        # pays for input capacity and the exchange pays for table slots
+        g_in, in_base = self._compact_knob(agg.child.est_rows)
+        g_tab, tab_base = self._compact_knob(agg.est_rows)
         self.sig.append(f"genagg:{agg.group_exprs!r}:{agg.aggs!r}")
 
         def emit(env, growths):
             chunk, ovfs = child_emit(env, growths)
+            capI = int(np.ceil(growths[g_in] * in_base))
+            if capI < chunk.capacity:
+                chunk, o = _compact_chunk(chunk, capI)
+                ovfs.append((g_in, pmax_compat(o, _AXES)))
             table = partial(chunk)  # local dedup before the exchange
             S = table["k0.d"].shape[0]
+            capT = int(np.ceil(growths[g_tab] * tab_base))
+            if capT < S:
+                # groups are dense in [0, n): slicing the slot arrays is
+                # free and shrinks everything the exchange must carry
+                factor = (table["n"] + capT - 1) // capT
+                ovfs.append((g_tab, pmax_compat(jnp.maximum(factor - 1, 0), _AXES)))
+                table = {k: (v if k == "n" else v[:capT])
+                         for k, v in table.items()}
+                S = capT
             live = jnp.arange(S) < table["n"]
             kd = [table[f"k{i}.d"] for i in range(nk)]
             kv = [table[f"k{i}.v"] for i in range(nk)]
@@ -445,7 +530,7 @@ class _Compiler:
             recv, recv_sel, _, ovf = repartition_by_key(
                 arrays, live, khash, jnp.ones_like(live), n_parts,
                 growths[g_agg])
-            ovfs.append(jax.lax.psum(ovf, _AXES))
+            ovfs.append((g_agg, jax.lax.psum(ovf, _AXES)))
 
             rkd = [recv[f"k{i}.d"] for i in range(nk)]
             rkv = [recv[f"k{i}.v"] for i in range(nk)]
@@ -476,6 +561,7 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int) -> Optional[FragmentProg
 
     n_src = len(c.sources)
     n_bc = len(c.broadcasts)
+    n_knobs = c.n_growth
 
     def build_fn(growths: Tuple[float, ...]):
         def frag(*args):
@@ -487,11 +573,15 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int) -> Optional[FragmentProg
             for _ in range(n_bc):
                 env["bcast"].append((args[i], args[i + 1], args[i + 2]))
                 i += 3
-            out, ovfs = emit(env, growths)
-            # per-knob overflow vector: the executor re-runs with only the
-            # blown capacities doubled
-            ovf = (jnp.stack([o.astype(jnp.int64) for o in ovfs])
-                   if ovfs else jnp.zeros((0,), dtype=jnp.int64))
+            out, reports = emit(env, growths)
+            # per-knob overflow vector, slot-indexed by knob id so the
+            # executor always grows exactly the blown capacity (emission
+            # order differs from knob-assignment order)
+            slots = [jnp.zeros((), dtype=jnp.int64)] * n_knobs
+            for idx, v in reports:
+                slots[idx] = slots[idx] + v.astype(jnp.int64)
+            ovf = (jnp.stack(slots) if slots
+                   else jnp.zeros((0,), dtype=jnp.int64))
             return out, ovf
 
         out_spec = P() if out_kind == "segment" else P(_AXES)
