@@ -33,6 +33,12 @@ class WriteConflictError(ExecutionError):
     kv.ErrWriteConflict — drives the resolve-lock/backoff retry)."""
 
 
+class PrivilegeError(TiDBTPUError):
+    """Authorization failure (ref: privilege/ RequestVerification)."""
+
+    code = 1142  # ER_TABLEACCESS_DENIED_ERROR
+
+
 class UnsupportedError(TiDBTPUError):
     """Feature understood by the grammar but not yet implemented."""
 
@@ -65,5 +71,3 @@ class OOMError(ExecutionError):
     code = 1105
 
 
-class PrivilegeError(TiDBTPUError):
-    code = 1142  # ER_TABLEACCESS_DENIED_ERROR

@@ -25,7 +25,7 @@ __all__ = [
     "ExplainStmt", "TraceStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
     "RollbackStmt", "UseStmt", "TruncateStmt", "AnalyzeStmt",
     "CreateDatabaseStmt", "DropDatabaseStmt",
-    "CreateUserStmt", "DropUserStmt",
+    "CreateUserStmt", "DropUserStmt", "GrantStmt", "RevokeStmt",
     "InstallPluginStmt", "UninstallPluginStmt",
     "CreateBindingStmt", "DropBindingStmt",
     "CreateViewStmt", "DropViewStmt",
@@ -398,6 +398,20 @@ class CreateUserStmt:
 class DropUserStmt:
     user: str
     if_exists: bool = False
+
+@dataclass
+class GrantStmt:
+    privs: List[str]        # lowercase names; ["all"] for ALL PRIVILEGES
+    db: str                 # "*" = global
+    table: str              # "*" = whole schema
+    user: str
+
+@dataclass
+class RevokeStmt:
+    privs: List[str]
+    db: str
+    table: str
+    user: str
 
 @dataclass
 class CreateDatabaseStmt:

@@ -30,7 +30,7 @@ KEYWORDS = {
     "with", "recursive", "global", "session", "database", "schema",
     "constraint", "foreign", "references", "comment", "engine", "charset",
     "character", "collate", "auto_increment", "unsigned", "zerofill",
-    "variables", "status", "grant", "revoke", "flush", "privileges",
+    "variables", "status", "grant", "grants", "revoke", "flush", "privileges",
     "alter", "add", "modify", "change", "rename", "to", "extract", "column",
     "user", "identified", "trace", "install", "uninstall", "plugin",
     "soname", "plugins", "binding", "bindings", "for", "view", "duplicate",

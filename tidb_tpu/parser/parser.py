@@ -148,6 +148,8 @@ class Parser:
             "truncate": self.parse_truncate,
             "analyze": self.parse_analyze,
             "trace": lambda: (self.next(), TraceStmt(self.parse_statement()))[1],
+            "grant": self.parse_grant,
+            "revoke": self.parse_revoke,
             "install": self.parse_install,
             "uninstall": self.parse_uninstall,
         }.get(kw)
@@ -809,7 +811,58 @@ class Parser:
             return ShowStmt("index", target=self.expect_ident())
         if self.accept_kw("bindings"):
             return ShowStmt("bindings")
+        if self.accept_kw("grants"):
+            user = None
+            if self.accept_kw("for"):
+                user = self._user_name()
+            return ShowStmt("grants", target=user)
         raise self.error("unsupported SHOW")
+
+    def _parse_priv_list(self):
+        """SELECT, INSERT ... | ALL [PRIVILEGES] — lowercase names."""
+        from tidb_tpu.privilege import PRIV_KINDS
+
+        if self.accept_kw("all"):
+            self.accept_kw("privileges")
+            return ["all"]
+        privs = []
+        while True:
+            name = self.next().text.lower()
+            if name not in PRIV_KINDS:
+                raise self.error(f"unknown privilege {name!r}")
+            privs.append(name)
+            if not self.accept_op(","):
+                return privs
+
+    def _parse_priv_object(self):
+        """*.* | db.* | db.table | table (current db resolved later)."""
+        if self.accept_op("*"):
+            if self.accept_op("."):
+                self.expect_op("*")
+                return "*", "*"
+            return None, "*"  # MySQL: bare * = current database
+        first = self.expect_ident()
+        if self.accept_op("."):
+            if self.accept_op("*"):
+                return first, "*"
+            return first, self.expect_ident()
+        return None, first  # db = session default, filled by the executor
+
+    def parse_grant(self):
+        self.expect_kw("grant")
+        privs = self._parse_priv_list()
+        self.expect_kw("on")
+        db, table = self._parse_priv_object()
+        self.expect_kw("to")
+        return GrantStmt(privs, db, table, self._user_name())
+
+    def parse_revoke(self):
+        self.expect_kw("revoke")
+        privs = self._parse_priv_list()
+        self.expect_kw("on")
+        db, table = self._parse_priv_object()
+        self.expect_kw("from")
+        return RevokeStmt(privs, db, table, self._user_name())
 
     def _parse_over(self, fname: str, args, distinct: bool) -> EWindow:
         self.expect_kw("over")

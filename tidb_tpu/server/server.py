@@ -143,6 +143,7 @@ class Server:
                 P.write_packet(conn, 2, P.err_packet(
                     1045, f"Access denied for user '{hello['user']}'", "28000"))
                 return
+            sess.user = hello["user"]
             if hello["db"]:
                 try:
                     sess.execute(f"use {hello['db']}")
@@ -200,7 +201,7 @@ class Server:
         try:
             stmt_id, n_params = sess.prepare(sql)
         except TidbError as e:
-            P.write_packet(conn, 1, P.err_packet(1105, str(e)))
+            P.write_packet(conn, 1, P.err_packet(getattr(e, "code", 1105), str(e)))
             return
         # num_columns=0: clients read the actual column defs from the
         # execute response's result-set header
@@ -228,7 +229,7 @@ class Server:
             with self.catalog.lock:
                 rs = sess.execute_prepared(stmt_id, params)
         except TidbError as e:
-            P.write_packet(conn, 1, P.err_packet(1105, str(e)))
+            P.write_packet(conn, 1, P.err_packet(getattr(e, "code", 1105), str(e)))
             return
         except Exception as e:  # engine bug — surface, don't kill the conn
             traceback.print_exc()
@@ -263,7 +264,7 @@ class Server:
             with self.catalog.lock:
                 rs = sess.execute(sql)
         except TidbError as e:
-            P.write_packet(conn, 1, P.err_packet(1105, str(e)))
+            P.write_packet(conn, 1, P.err_packet(getattr(e, "code", 1105), str(e)))
             return
         except Exception as e:  # engine bug — surface, don't kill the conn
             traceback.print_exc()

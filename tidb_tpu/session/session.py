@@ -54,6 +54,10 @@ class Session:
         self._chunk_capacity = chunk_capacity  # explicit override; else sysvar
         self.sysvars = SysVarStore(self.catalog.global_vars)
         self.user_vars: dict = {}
+        # authenticated account for privilege checks (ref: privilege/
+        # RequestVerification); in-process sessions default to the
+        # bootstrap superuser, the wire server sets this after handshake
+        self.user = "root"
         from tidb_tpu.bindinfo import BindHandle
 
         self._bindings = BindHandle("session")
@@ -305,6 +309,7 @@ class Session:
         if self.txn is None and not self.sysvars.get("autocommit"):
             self._begin()  # consistent-snapshot reads without autocommit
         phys = self._plan_select(stmt)
+        self._check_plan_privs(phys)
         root = self._build_root(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
         if n_vis is None and hasattr(phys, "children") and phys.children:
@@ -360,6 +365,26 @@ class Session:
                 kwargs[f] = v
         return type(e)(**kwargs)
 
+    def _priv(self, priv: str, db: str = "*", table: str = "*") -> None:
+        self.catalog.privileges.require(self.user, priv, db, table)
+
+    def _priv_table(self, priv: str, tn) -> None:
+        self._priv(priv, tn.schema or self.db, tn.name)
+
+    def _check_plan_privs(self, phys) -> None:
+        """SELECT privilege on every base table the plan scans (views
+        are expanded at bind time, so their underlying tables are what
+        gets checked)."""
+        from tidb_tpu.planner.physical import PScan
+
+        stack = [phys]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, PScan) and node.table is not None:
+                self._priv("select", getattr(node, "db", None) or self.db,
+                           node.table_name)
+            stack.extend(getattr(node, "children", ()))
+
     def _execute_stmt(self, stmt) -> Optional[ResultSet]:
         if not isinstance(stmt, A.SetStmt) and _ast_contains(stmt, A.EVar):
             stmt = self._sub_vars(stmt)
@@ -382,14 +407,18 @@ class Session:
                 raise ExecutionError("no such binding")
             return None
         if isinstance(stmt, A.InsertStmt):
+            self._priv_table("insert", stmt.table)
             return self._run_insert(stmt)
         if isinstance(stmt, A.UpdateStmt):
+            self._priv_table("update", stmt.table)
             return self._run_update(stmt)
         if isinstance(stmt, A.DeleteStmt):
+            self._priv_table("delete", stmt.table)
             return self._run_delete(stmt)
         if isinstance(stmt, (A.CreateTableStmt, A.DropTableStmt, A.CreateDatabaseStmt,
                              A.DropDatabaseStmt, A.TruncateStmt, A.CreateIndexStmt,
                              A.DropIndexStmt, A.AlterTableStmt)):
+            self._check_ddl_privs(stmt)
             self._commit()  # DDL implicitly commits the open txn (MySQL)
             # multi-instance deployments run DDL through the elected
             # owner's worker (ref: ddl job queue + owner election);
@@ -444,6 +473,8 @@ class Session:
                 if scope == "user":
                     self.user_vars[name.lstrip("@")] = v
                 else:
+                    if scope == "global":
+                        self._priv("super")  # ref: SUPER for global sysvars
                     self.sysvars.set(name, v, scope or "session")
                     # MySQL: enabling autocommit commits the open txn
                     if (name.lower() == "autocommit" and scope != "global"
@@ -469,9 +500,11 @@ class Session:
                 self.catalog.drop_view(t.schema or self.db, t.name, if_exists=True)
             return None
         if isinstance(stmt, A.InstallPluginStmt):
+            self._priv("super")  # SQL-reachable module import is admin-only
             self.catalog.plugins.load_module(stmt.name, stmt.module)
             return None
         if isinstance(stmt, A.UninstallPluginStmt):
+            self._priv("super")
             self.catalog.plugins.uninstall(stmt.name)
             return None
         if isinstance(stmt, A.BeginStmt):
@@ -500,12 +533,45 @@ class Session:
         if isinstance(stmt, A.AlterTableStmt):
             return self._run_alter_table(stmt)
         if isinstance(stmt, A.CreateUserStmt):
+            self._priv("super")
             self.catalog.create_user(stmt.user, stmt.password, stmt.if_not_exists)
             return None
         if isinstance(stmt, A.DropUserStmt):
+            self._priv("super")
             self.catalog.drop_user(stmt.user, stmt.if_exists)
+            self.catalog.privileges.drop_user(stmt.user)
+            return None
+        if isinstance(stmt, A.GrantStmt):
+            self._priv("super")
+            if stmt.user not in self.catalog.users:
+                raise ExecutionError(f"no user {stmt.user!r}")
+            db = stmt.db if stmt.db is not None else self.db
+            self.catalog.privileges.grant(stmt.user, stmt.privs, db, stmt.table)
+            return None
+        if isinstance(stmt, A.RevokeStmt):
+            self._priv("super")
+            db = stmt.db if stmt.db is not None else self.db
+            self.catalog.privileges.revoke(stmt.user, stmt.privs, db, stmt.table)
             return None
         raise UnsupportedError(f"statement {type(stmt).__name__}")
+
+    _DDL_PRIV = {
+        A.CreateTableStmt: "create", A.CreateDatabaseStmt: "create",
+        A.CreateIndexStmt: "index", A.DropIndexStmt: "index",
+        A.DropTableStmt: "drop", A.DropDatabaseStmt: "drop",
+        A.TruncateStmt: "drop", A.AlterTableStmt: "alter",
+    }
+
+    def _check_ddl_privs(self, stmt) -> None:
+        priv = self._DDL_PRIV[type(stmt)]
+        if isinstance(stmt, A.DropTableStmt):
+            for tn in stmt.tables:
+                self._priv_table(priv, tn)
+            return
+        if isinstance(stmt, (A.CreateDatabaseStmt, A.DropDatabaseStmt)):
+            self._priv(priv, stmt.name)
+            return
+        self._priv_table(priv, stmt.table)
 
     # -- prepared statements (ref: server/conn_stmt.go + planner plan
     # cache; the binary protocol's COM_STMT_* commands drive these) -------
@@ -1044,6 +1110,14 @@ class Session:
         return [r for r in rows if rx.match(str(r[col]))]
 
     def _run_show(self, stmt: A.ShowStmt):
+        if stmt.kind == "grants":
+            user = stmt.target or self.user
+            if user != self.user:
+                self._priv("super")
+            if user not in self.catalog.users:
+                raise ExecutionError(f"no user {user!r}")
+            rows = [(g,) for g in self.catalog.privileges.grants_for(user)]
+            return ResultSet(names=[f"Grants for {user}"], rows=rows)
         if stmt.kind == "databases":
             rows = [(n,) for n in sorted(self.catalog.databases)]
             return ResultSet(names=["Database"], rows=self._like_filter(rows, stmt.like))

@@ -67,6 +67,9 @@ class Catalog:
         # (SHA1(SHA1(password)), like mysql.user.authentication_string);
         # "" means empty password. Ref: privilege/'s MySQLPrivilege.
         self.users: Dict[str, bytes] = {"root": b""}
+        from tidb_tpu.privilege import Privileges
+
+        self.privileges = Privileges()
         # recent slow statements, surfaced via
         # information_schema.slow_query (ref: the slow-query log +
         # INFORMATION_SCHEMA.SLOW_QUERY)
