@@ -41,6 +41,10 @@ QUERIES = [
 @pytest.fixture(scope="module")
 def sessions():
     dev = Session(chunk_capacity=512)  # many chunks -> several merge levels
+    # the auto engine heuristic routes generic agg to the host numpy
+    # path on a bare CPU backend; these tests exist to exercise the
+    # device kernels, so pin them on
+    dev.execute("SET tidb_device_engine_mode = 'force'")
     _fill(dev)
     host = Session(chunk_capacity=512)
     host.execute("SET tidb_enable_tpu_exec = 0")
@@ -86,3 +90,13 @@ def test_distinct_falls_back(sessions):
     sql = "select k2, count(distinct v) from g group by k2 order by k2"
     ok, msg = rows_equal(dev.query(sql), host.query(sql), ordered=True)
     assert ok, msg
+
+
+def test_distinct_global_count_empty_input():
+    s = Session()
+    s.execute("create table e (d bigint, a bigint)")
+    r = s.query("select count(distinct d), count(*), count(a), sum(a) from e")
+    assert r == [(0, 0, 0, None)], r
+    s.execute("insert into e values (1, 10), (1, 20), (NULL, 30)")
+    r = s.query("select count(distinct d), count(*), count(a), sum(a) from e")
+    assert r == [(1, 3, 3, 60)], r

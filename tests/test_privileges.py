@@ -198,3 +198,45 @@ def test_super_gates_global_set_and_plugins(sess):
     with pytest.raises(PrivilegeError):
         alice.execute("install plugin p soname 'os'")
     alice.execute("set autocommit = 1")  # session scope needs no SUPER
+
+
+def test_subquery_tables_are_checked(sess):
+    sess.execute("create table secret (x bigint)")
+    sess.execute("insert into secret values (42)")
+    sess.execute("grant select on t to alice")
+    alice = as_user(sess, "alice")
+    with pytest.raises(PrivilegeError):
+        alice.query("select a from t where a = (select max(x) from secret)")
+    with pytest.raises(PrivilegeError):
+        alice.query("select a from t where exists (select 1 from secret)")
+
+
+def test_view_ddl_requires_privs(sess):
+    sess.execute("create view vv as select a from t")
+    alice = as_user(sess, "alice")
+    with pytest.raises(PrivilegeError):
+        alice.execute("drop view vv")
+    with pytest.raises(PrivilegeError):
+        alice.execute("create view v2 as select 1")
+
+
+def test_information_schema_world_readable(sess):
+    alice = as_user(sess, "alice")
+    rows = alice.query(
+        "select table_name from information_schema.tables "
+        "where table_schema = 'test'")
+    assert ("t",) in rows
+
+
+def test_revoke_unknown_user_errors(sess):
+    from tidb_tpu.errors import ExecutionError
+    with pytest.raises(ExecutionError):
+        sess.execute("revoke all on *.* from nosuchuser")
+
+
+def test_engine_mode_sysvar_validation(sess):
+    from tidb_tpu.errors import ExecutionError
+    sess.execute("set tidb_device_engine_mode = 'FORCE'")  # case-folded
+    assert sess.query("select @@tidb_device_engine_mode") == [("force",)]
+    with pytest.raises(ExecutionError):
+        sess.execute("set tidb_device_engine_mode = 'fore'")

@@ -513,9 +513,20 @@ def _try_dist_agg(plan: PHashAgg, cache: ShardCache) -> Optional[Executor]:
                            build_frag[0], build_frag[1], post_stages, cache)
 
 
-def build_dist_executor(plan: PhysicalPlan, cache: ShardCache) -> Executor:
-    """Build an executor tree, running distributable fragments on the mesh."""
+def build_dist_executor(plan: PhysicalPlan, cache: ShardCache,
+                        full: bool = True) -> Executor:
+    """Build an executor tree, running distributable fragments on the mesh.
+
+    full=False (the degenerate single-CPU backend) distributes only
+    segment scan-agg fragments — joins and generic aggregation run on
+    the vectorized host engine, which beats XLA:CPU's sorts there."""
     if isinstance(plan, PHashAgg):
+        if not full:
+            if plan.strategy == "segment":
+                frag = _collapse_to_scan(plan.child)
+                if frag is not None:
+                    return DistAggExec(plan, frag[0], frag[1], cache)
+            return build_executor(plan)
         ex = _try_dist_agg(plan, cache)  # proven fast paths first
         if ex is not None:
             return ex
@@ -529,7 +540,7 @@ def build_dist_executor(plan: PhysicalPlan, cache: ShardCache) -> Executor:
             # ...) but its subtree may contain fragmentable aggs/joins —
             # run the root agg on the host over a distributed child
             return HashAggExec(
-                plan.schema, build_dist_executor(plan.child, cache),
+                plan.schema, build_dist_executor(plan.child, cache, full),
                 plan.group_exprs, plan.group_uids, plan.aggs, plan.strategy,
                 segment_sizes=getattr(plan, "segment_sizes", None))
         return build_executor(plan)
@@ -541,13 +552,13 @@ def build_dist_executor(plan: PhysicalPlan, cache: ShardCache) -> Executor:
         if isinstance(base, PScan):
             return build_executor(plan)
         if isinstance(plan, PProjection):
-            return ProjectionExec(plan.schema, build_dist_executor(plan.child, cache), plan.exprs)
-        return SelectionExec(plan.schema, build_dist_executor(plan.child, cache), plan.cond)
+            return ProjectionExec(plan.schema, build_dist_executor(plan.child, cache, full), plan.exprs)
+        return SelectionExec(plan.schema, build_dist_executor(plan.child, cache, full), plan.cond)
     if isinstance(plan, PSort):
-        return SortExec(plan.schema, build_dist_executor(plan.child, cache), plan.items)
+        return SortExec(plan.schema, build_dist_executor(plan.child, cache, full), plan.items)
     if isinstance(plan, PTopN):
-        return TopNExec(plan.schema, build_dist_executor(plan.child, cache), plan.items,
+        return TopNExec(plan.schema, build_dist_executor(plan.child, cache, full), plan.items,
                         plan.count, plan.offset)
     if isinstance(plan, PLimit):
-        return LimitExec(plan.schema, build_dist_executor(plan.child, cache), plan.count, plan.offset)
+        return LimitExec(plan.schema, build_dist_executor(plan.child, cache, full), plan.count, plan.offset)
     return build_executor(plan)

@@ -574,7 +574,86 @@ def _rule_reorder(plan: LogicalPlan, leading=None, cascades=False) -> LogicalPla
 
 # ---------------------------------------------------------------------------
 
+def _rule_distinct_two_phase(plan: LogicalPlan) -> LogicalPlan:
+    """Rewrite DISTINCT aggregates into two stacked aggregations (ref:
+    the reference planner's distinct-agg-to-two-phase transform):
+
+        Agg[G; f(DISTINCT d), sum(x), ...]
+          -> Agg[G; count(d)/sum(d), sum(sx), ...]      (outer, small)
+               Agg[G + d; sum(x) AS sx, ...]            (inner)
+
+    The inner agg has no DISTINCT, so it is distributable as a mesh
+    fragment; the outer agg reduces one row per (G, d) group. Applies
+    when every DISTINCT agg shares one argument and the remaining aggs
+    are sum/count/min/max (each re-aggregates losslessly from the
+    inner's per-group value). NULL semantics hold: the NULL-d group's
+    key column is NULL, which outer count()/sum() skip."""
+    from tidb_tpu.planner.binder import PlanCol
+
+    plan.children = [_rule_distinct_two_phase(c) for c in plan.children]
+    if not isinstance(plan, LAggregate) or not any(a.distinct for a in plan.aggs):
+        return plan
+    d_args = [a.arg for a in plan.aggs if a.distinct]
+    if any(a is None for a in d_args) or len({repr(a) for a in d_args}) != 1:
+        return plan
+    if any(a.func not in ("count", "sum", "avg")
+           for a in plan.aggs if a.distinct):
+        return plan
+    if any(a.func not in ("sum", "count", "min", "max")
+           for a in plan.aggs if not a.distinct):
+        return plan
+    if not plan.group_uids and any(
+            a.func == "count" and not a.distinct for a in plan.aggs):
+        # a global COUNT re-aggregates as sum(inner counts), which is
+        # NULL over an empty inner — SQL requires 0; keep the direct path
+        return plan
+    d_arg = d_args[0]
+
+    child = plan.children[0]
+    group_cols = list(plan.schema[:len(plan.group_uids)])
+    # uids derive from the original agg uids: re-planning the same query
+    # must produce identical fragment signatures or every execution pays
+    # a fresh XLA compile (fragment/growth caches key on the plan repr)
+    d_uid = "d2p_" + next(a.uid for a in plan.aggs if a.distinct)
+    d_col = PlanCol(uid=d_uid, name="d2p", type_=d_arg.type_,
+                    dict_=getattr(d_arg, "_dict", None))
+
+    inner_aggs, inner_cols, outer_aggs = [], [], []
+    outer_func = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+    for a in plan.aggs:
+        if a.distinct:
+            # d is unique per outer group in the inner output
+            f = "count" if a.func == "count" else a.func
+            outer_aggs.append(AggSpec(
+                uid=a.uid, func=f,
+                arg=ColumnRef(type_=d_arg.type_, name=d_uid), type_=a.type_))
+        else:
+            iuid = "d2p_" + a.uid
+            inner_aggs.append(AggSpec(uid=iuid, func=a.func, arg=a.arg,
+                                      type_=a.type_))
+            inner_cols.append(PlanCol(uid=iuid, name="d2p", type_=a.type_))
+            outer_aggs.append(AggSpec(
+                uid=a.uid, func=outer_func[a.func],
+                arg=ColumnRef(type_=a.type_, name=iuid), type_=a.type_))
+
+    inner = LAggregate(
+        schema=group_cols + [d_col] + inner_cols,
+        children=[child],
+        group_exprs=list(plan.group_exprs) + [d_arg],
+        group_uids=list(plan.group_uids) + [d_uid],
+        aggs=inner_aggs,
+    )
+    return LAggregate(
+        schema=plan.schema,
+        children=[inner],
+        group_exprs=[c.ref() for c in group_cols],
+        group_uids=list(plan.group_uids),
+        aggs=outer_aggs,
+    )
+
+
 def optimize_logical(plan: LogicalPlan, hints=(), cascades=False) -> LogicalPlan:
+    plan = _rule_distinct_two_phase(plan)
     plan = _rule_fold(plan)
     plan = _rule_pushdown(plan)
     leading = next((args for name, args in hints if name == "leading"), None)

@@ -173,8 +173,26 @@ class Session:
         if self._shard_cache is not None and self.sysvars.get("tidb_enable_tpu_exec"):
             from tidb_tpu.parallel.executor import build_dist_executor
 
-            return build_dist_executor(phys, self._shard_cache)
+            return build_dist_executor(phys, self._shard_cache,
+                                       full=self._device_engine_auto())
         return build_executor(phys)
+
+    def _device_engine_auto(self) -> bool:
+        """Cost-based engine routing (ref: the planner's cop-task vs
+        root-task choice): device fragments pay off on accelerators and
+        on real (multi-device) meshes; a single-CPU backend runs joins
+        and generic aggregation faster on the numpy host engine."""
+        mode = str(self.sysvars.get("tidb_device_engine_mode"))
+        if mode == "force":
+            return True
+        if mode == "off":
+            return False
+        if self.mesh is not None:
+            devs = self.mesh.devices.flat
+            return devs[0].platform != "cpu" or len(devs) > 1
+        import jax
+
+        return jax.default_backend() != "cpu"
 
     # ------------------------------------------------------------------
 
@@ -257,7 +275,8 @@ class Session:
             ),
             read_ts=self.txn.read_ts if self.txn is not None else None,
             txn_marker=self.txn.marker if self.txn is not None else 0,
-            device_agg=bool(self.sysvars.get("tidb_enable_tpu_exec")),
+            device_agg=bool(self.sysvars.get("tidb_enable_tpu_exec"))
+            and self._device_engine_auto(),
             device_cache_bytes=int(self.sysvars.get("tidb_device_cache_bytes")),
         )
 
@@ -267,6 +286,10 @@ class Session:
             logical,
             cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")))
         phys = lower(logical)
+        # plan-time subqueries execute before the statement-level check
+        # and fold into literals, so they must be checked here or a
+        # scalar subquery leaks unprivileged tables
+        self._check_plan_privs(phys)
         root = build_executor(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
         rs = run_plan(root, self._exec_ctx(), n_visible=n_vis)
@@ -381,8 +404,9 @@ class Session:
         while stack:
             node = stack.pop()
             if isinstance(node, PScan) and node.table is not None:
-                self._priv("select", getattr(node, "db", None) or self.db,
-                           node.table_name)
+                db = getattr(node, "db", None) or self.db
+                if db.lower() != "information_schema":  # world-readable
+                    self._priv("select", db, node.table_name)
             stack.extend(getattr(node, "children", ()))
 
     def _execute_stmt(self, stmt) -> Optional[ResultSet]:
@@ -484,12 +508,15 @@ class Session:
         if isinstance(stmt, A.ShowStmt):
             return self._run_show(stmt)
         if isinstance(stmt, A.CreateViewStmt):
+            self._priv("create", stmt.schema or self.db)
             self._commit()  # DDL semantics
             self.catalog.create_view(
                 stmt.schema or self.db, stmt.name, stmt.columns,
                 stmt.select, stmt.select_sql, stmt.or_replace)
             return None
         if isinstance(stmt, A.DropViewStmt):
+            for t in stmt.names:
+                self._priv("drop", t.schema or self.db, t.name)
             self._commit()
             # MySQL 8: all-or-nothing — validate every name first
             if not stmt.if_exists:
@@ -550,6 +577,8 @@ class Session:
             return None
         if isinstance(stmt, A.RevokeStmt):
             self._priv("super")
+            if stmt.user not in self.catalog.users:
+                raise ExecutionError(f"no user {stmt.user!r}")
             db = stmt.db if stmt.db is not None else self.db
             self.catalog.privileges.revoke(stmt.user, stmt.privs, db, stmt.table)
             return None

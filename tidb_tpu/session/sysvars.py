@@ -24,9 +24,10 @@ class SysVar:
     name: str
     default: object
     scope: str = BOTH
-    kind: str = "str"  # bool | int | str
+    kind: str = "str"  # bool | int | str | enum
     min_: Optional[int] = None
     max_: Optional[int] = None
+    enum_values: Optional[tuple] = None  # kind == "enum": allowed (lowercase)
 
 
 SYSVARS: Dict[str, SysVar] = {}
@@ -40,6 +41,13 @@ def _reg(*vs: SysVar) -> None:
 _reg(
     # the north-star switch: route eligible fragments to the device mesh
     SysVar("tidb_enable_tpu_exec", True, BOTH, "bool"),
+    # auto: full device fragments on accelerators and multi-device
+    # meshes; on a degenerate single-CPU backend, joins and generic
+    # aggregation route to the vectorized host engine instead (XLA:CPU
+    # sorts lose to numpy's by 5-10x and a 1-device mesh has no
+    # parallelism to win back). force/off override the heuristic.
+    SysVar("tidb_device_engine_mode", "auto", BOTH, "enum",
+           enum_values=("auto", "force", "off")),
     # non-empty: name of an installed executor plugin that builds the
     # operator tree instead of the built-in builders (ref: plugin/)
     SysVar("tidb_executor_plugin", "", BOTH, "str"),
@@ -98,6 +106,13 @@ def canonical(var: SysVar, value) -> object:
         if var.max_ is not None and n > var.max_:
             n = var.max_
         return n
+    if var.kind == "enum":
+        s = str(value).strip().lower()
+        if s not in (var.enum_values or ()):
+            raise ExecutionError(
+                f"invalid value {value!r} for {var.name} "
+                f"(allowed: {', '.join(var.enum_values or ())})")
+        return s
     return str(value)
 
 
