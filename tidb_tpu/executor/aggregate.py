@@ -64,6 +64,26 @@ def merge_op_for(key: str) -> str:
     raise ExecutionError(f"no merge op for state key {key!r}")
 
 
+def _lexsort_groups(cols: List[np.ndarray]):
+    """Group rows by exact multi-column keys via one lexsort — several
+    times faster than np.unique(axis=0)'s void-dtype row comparisons.
+    Returns (ngroups, first_idx, inverse): representative original row
+    per group (first in sort order) and each row's dense group id."""
+    n = len(cols[0])
+    if n == 0:
+        return 0, np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    order = np.lexsort(cols[::-1])  # last key primary per np convention
+    newseg = np.zeros(n, dtype=np.bool_)
+    newseg[0] = True
+    for c in cols:
+        sc = c[order]
+        newseg[1:] |= sc[1:] != sc[:-1]
+    inverse = np.empty(n, dtype=np.int64)
+    inverse[order] = np.cumsum(newseg) - 1
+    first_idx = order[newseg]
+    return int(newseg.sum()), first_idx, inverse
+
+
 def _partial_nbytes(p: dict) -> int:
     return int(
         p["mat"].nbytes
@@ -452,16 +472,9 @@ class HashAggExec(Executor):
         avalids = [cat(f"a{j}.v") for j in range(len(aggs))]
 
         if keys:
-            mat = np.stack(
-                [self._to_int64_bits(k, kv) for k, kv in zip(keys, kvalids)]
-                + [kv.astype(np.int64) for kv in kvalids],
-                axis=1,
-            )
-            uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
-            ngroups = len(uniq)
-            first_idx = np.zeros(ngroups, dtype=np.int64)
-            # representative row per group for key values
-            first_idx[inverse[::-1]] = np.arange(total - 1, -1, -1)
+            cols = ([self._to_int64_bits(k, kv) for k, kv in zip(keys, kvalids)]
+                    + [kv.astype(np.int64) for kv in kvalids])
+            ngroups, first_idx, inverse = _lexsort_groups(cols)
         else:
             ngroups = 1
             inverse = np.zeros(total, dtype=np.int64)
@@ -483,15 +496,10 @@ class HashAggExec(Executor):
         kvalids = [np.asarray(loader(f"k{k}.v")) for k in range(nk)]
         n = len(keys[0]) if keys else len(np.asarray(loader("a0.d")))
         if keys:
-            mat = np.stack(
-                [self._to_int64_bits(k, kv) for k, kv in zip(keys, kvalids)]
-                + [kv.astype(np.int64) for kv in kvalids],
-                axis=1,
-            )
-            uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
-            g = len(uniq)
-            first_idx = np.zeros(g, dtype=np.int64)
-            first_idx[inverse[::-1]] = np.arange(n - 1, -1, -1)
+            cols = ([self._to_int64_bits(k, kv) for k, kv in zip(keys, kvalids)]
+                    + [kv.astype(np.int64) for kv in kvalids])
+            g, first_idx, inverse = _lexsort_groups(cols)
+            uniq = np.stack([c[first_idx] for c in cols], axis=1)
         else:
             uniq = np.zeros((1, 0), dtype=np.int64)
             inverse = np.zeros(n, dtype=np.int64)
@@ -530,14 +538,14 @@ class HashAggExec(Executor):
         mats = np.concatenate([p["mat"] for p in partials], axis=0)
         ntotal = len(mats)
         if mats.shape[1]:
-            uniq, inverse = np.unique(mats, axis=0, return_inverse=True)
-            ngroups = len(uniq)
+            ngroups, first_idx, inverse = _lexsort_groups(
+                [mats[:, j] for j in range(mats.shape[1])])
+            uniq = mats[first_idx]
         else:
             uniq = np.zeros((1, 0), dtype=np.int64)
             ngroups = 1
             inverse = np.zeros(ntotal, dtype=np.int64)
-        first_idx = np.zeros(ngroups, dtype=np.int64)
-        first_idx[inverse[::-1]] = np.arange(ntotal - 1, -1, -1)
+            first_idx = np.zeros(1, dtype=np.int64)
 
         nk = len(self.group_exprs)
         keys, kvalids = [], []

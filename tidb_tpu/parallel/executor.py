@@ -124,6 +124,24 @@ def _types_sig(st: ShardedTable) -> str:
     return repr(sorted((n, t) for n, t in st.types.items()))
 
 
+# single-CPU-backend routing threshold: fragments whose largest base
+# table is below this run on the device path even without an accelerator
+# (XLA fusion amortizes); above it, sort-bound joins/generic aggs go to
+# the numpy host engine, which wins 2-3x there
+SMALL_FRAGMENT_ROWS = 200_000
+
+
+def _max_scan_rows(plan: PhysicalPlan) -> int:
+    best = 0
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, PScan) and node.table is not None:
+            best = max(best, node.table.n)
+        stack.extend(getattr(node, "children", ()))
+    return best
+
+
 def _collapse_to_scan(plan: PhysicalPlan):
     """Fuse Selection/Projection chain onto a single scan; return
     (scan, stages) or None if the subtree isn't a pushable pipeline."""
@@ -521,7 +539,11 @@ def build_dist_executor(plan: PhysicalPlan, cache: ShardCache,
     segment scan-agg fragments — joins and generic aggregation run on
     the vectorized host engine, which beats XLA:CPU's sorts there."""
     if isinstance(plan, PHashAgg):
-        if not full:
+        if not full and _max_scan_rows(plan) > SMALL_FRAGMENT_ROWS:
+            # big inputs on a single-CPU backend: keep segment scan-aggs
+            # on device (linear scatter-adds win), run joins and generic
+            # aggregation on the host engine. Small inputs stay on the
+            # device path either way — compiled fusion amortizes.
             if plan.strategy == "segment":
                 frag = _collapse_to_scan(plan.child)
                 if frag is not None:
