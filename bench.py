@@ -69,32 +69,55 @@ def pick_platform():
         return forced, f"forced via BENCH_PLATFORM={forced}"
     import tempfile
 
-    marker = tempfile.mktemp(prefix="bench_probe_")
+    fd, marker = tempfile.mkstemp(prefix="bench_probe_")
+    os.close(fd)
+    os.unlink(marker)  # the child re-creates it on success
+    errpath = marker + ".err"
     code = (
         "import jax, json\n"
         "d = jax.devices()\n"
         "open(%r, 'w').write(json.dumps([len(d), d[0].platform]))\n" % marker
     )
-    child = subprocess.Popen(
-        [sys.executable, "-c", code],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        start_new_session=True,  # survives us; nobody ever kills it
-    )
+    with open(errpath, "w") as errf:
+        child = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL, stderr=errf,
+            start_new_session=True,  # survives us; nobody ever kills it
+        )
+
+    def err_tail():
+        try:
+            with open(errpath) as f:
+                return f.read()[-1500:]
+        except OSError:
+            return ""
+
     deadline = time.time() + PROBE_TIMEOUT
-    while time.time() < deadline:
-        if os.path.exists(marker):
-            try:
-                n, plat = json.load(open(marker))
-                os.unlink(marker)
-                return "default", f"OK {n} {plat}"
-            except Exception:  # noqa: BLE001  (partial write: retry)
-                pass
-        if child.poll() is not None and not os.path.exists(marker):
-            return "cpu", f"backend probe exited rc={child.returncode}"
-        time.sleep(1)
-    log("# backend probe still claiming at timeout; leaving it to finish "
-        "(never kill a mid-claim client) and benching on CPU")
-    return "cpu", f"backend probe timed out after {PROBE_TIMEOUT}s (not killed)"
+    try:
+        while time.time() < deadline:
+            if os.path.exists(marker):
+                try:
+                    n, plat = json.load(open(marker))
+                    os.unlink(marker)
+                    return "default", f"OK {n} {plat}"
+                except Exception:  # noqa: BLE001  (partial write: retry)
+                    pass
+            if child.poll() is not None and not os.path.exists(marker):
+                return ("cpu", f"backend probe exited rc={child.returncode}: "
+                        f"{err_tail()}")
+            time.sleep(1)
+        log("# backend probe still claiming at timeout; leaving it to finish "
+            "(never kill a mid-claim client) and benching on CPU")
+        return "cpu", f"backend probe timed out after {PROBE_TIMEOUT}s (not killed)"
+    finally:
+        # the abandoned child may still create the marker later; leave
+        # only bounded residue (single .err file reused next run is fine)
+        if child.poll() is not None:
+            for pth in (marker, errpath):
+                try:
+                    os.unlink(pth)
+                except OSError:
+                    pass
 
 
 def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,

@@ -1,0 +1,118 @@
+"""Index-backed point access (ref: executor/point_get.go PointGetExecutor;
+SURVEY.md:91 IndexLookUp index->row path). A WHERE pk = ? against a large
+table must be O(log n) host work, visible in EXPLAIN as PointGet."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture
+def sess():
+    s = Session()
+    s.execute("create table p (id bigint primary key, v bigint, s varchar(8))")
+    s.execute("insert into p values " + ",".join(
+        f"({i}, {i * 10}, 'x{i % 7}')" for i in range(1, 2001)))
+    return s
+
+
+def test_explain_shows_pointget(sess):
+    rows = [r[0] for r in sess.query(
+        "explain select v from p where id = 77")]
+    assert any("PointGet" in r for r in rows), rows
+    assert any("index:PRIMARY" in r for r in rows), rows
+
+
+def test_point_and_residual_and_miss(sess):
+    assert sess.query("select v from p where id = 77") == [(770,)]
+    assert sess.query("select v from p where id = 77 and v > 1000") == []
+    assert sess.query("select v from p where id = -1") == []
+    # multi-conjunct residual on strings still applies
+    assert sess.query("select s from p where id = 8 and s = 'x1'") == [("x1",)]
+    assert sess.query("select s from p where id = 8 and s = 'x2'") == []
+
+
+def test_point_sees_txn_snapshot(sess):
+    sess.execute("begin")
+    sess.execute("update p set v = -5 where id = 10")
+    assert sess.query("select v from p where id = 10") == [(-5,)]
+    sess.execute("rollback")
+    assert sess.query("select v from p where id = 10") == [(100,)]
+    # committed update is visible and stale versions are not
+    sess.execute("update p set v = 123 where id = 10")
+    assert sess.query("select v from p where id = 10") == [(123,)]
+
+
+def test_point_after_delete(sess):
+    sess.execute("delete from p where id = 500")
+    assert sess.query("select v from p where id = 500") == []
+
+
+def test_secondary_unique_index(sess):
+    sess.execute("create unique index uv on p (v)")
+    rows = [r[0] for r in sess.query("explain select id from p where v = 770")]
+    assert any("PointGet" in r and "index:uv" in r for r in rows), rows
+    assert sess.query("select id from p where v = 770") == [(77,)]
+
+
+def test_non_unique_or_partial_keys_stay_scans(sess):
+    # inequality -> no point get
+    rows = [r[0] for r in sess.query("explain select v from p where id > 5")]
+    assert not any("PointGet" in r for r in rows)
+    # equality on a non-indexed column -> no point get
+    rows = [r[0] for r in sess.query("explain select id from p where v = 770")]
+    assert not any("PointGet" in r for r in rows)
+
+
+def test_index_lookup_is_log_n(sess):
+    """The lookup itself must not scan: cache build is one-time, probes
+    touch O(log n) keys."""
+    t = sess.catalog.table("test", "p")
+    rows = t.index_lookup("PRIMARY", (1234,))
+    assert len(rows) == 1
+    got = int(np.asarray(t.data["v"][rows])[0])
+    assert got == 12340
+    assert len(t.index_lookup("PRIMARY", (999999,))) == 0
+
+
+def test_decimal_pk_not_pointget_but_correct(sess):
+    """DECIMAL keys store rescaled encodings; the planner must NOT probe
+    them with raw literals (review finding) — and results stay right."""
+    sess.execute("create table dp (price decimal(10,2) primary key, v bigint)")
+    sess.execute("insert into dp values (5.00, 1), (6.50, 2)")
+    rows = [r[0] for r in sess.query("explain select v from dp where price = 5")]
+    assert not any("PointGet" in r for r in rows), rows
+    assert sess.query("select v from dp where price = 5") == [(1,)]
+    assert sess.query("select v from dp where price = 6.50") == [(2,)]
+
+
+def test_insert_then_point_reuses_cache(sess):
+    t = sess.catalog.table("test", "p")
+    assert sess.query("select v from p where id = 1999") == [(19990,)]
+    v0 = t._lookup_cache["PRIMARY"][0]
+    sess.execute("insert into p values (5001, 50010, 'n')")
+    assert sess.query("select v from p where id = 5001") == [(50010,)]
+    assert sess.query("select v from p where id = 1999") == [(19990,)]
+    # cache merged forward, not rebuilt (version advanced with it)
+    assert t._lookup_cache["PRIMARY"][0] == t.version
+    assert len(t._lookup_cache["PRIMARY"][1]) == 2001
+
+
+def test_pointget_joined_with_big_table_still_distributes():
+    import jax
+    from tidb_tpu.parallel import make_mesh
+    from tidb_tpu.parallel.executor import _all_scans_pointy
+    s = Session()
+    s.execute("create table big (k bigint, x bigint)")
+    s.execute("insert into big values " + ",".join(f"({i%50},{i})" for i in range(5000)))
+    s.execute("create table dim (k bigint primary key, name bigint)")
+    s.execute("insert into dim values (7, 70)")
+    from tidb_tpu.planner.optimizer import plan_statement
+    from tidb_tpu.parser import parse
+    stmt = parse("select sum(big.x) from big join dim on big.k = dim.k where dim.k = 7")[0]
+    phys = plan_statement(stmt, s.catalog, db="test")
+    assert not _all_scans_pointy(phys)  # big table present -> stays eligible for mesh
+    r = s.query("select sum(big.x) from big join dim on big.k = dim.k where dim.k = 7")
+    want = sum(i for i in range(5000) if i % 50 == 7)
+    assert r == [(want,)], r

@@ -21,6 +21,7 @@ from tidb_tpu.planner.physical import (
     PHashJoin,
     PLimit,
     PProjection,
+    PPointGet,
     PScan,
     PSelection,
     PSort,
@@ -65,6 +66,17 @@ def scan_stages_for(scan: PScan, stages) -> list:
 def build_executor(plan: PhysicalPlan) -> Executor:
     # pipeline fusion: Selection/Projection chains over a scan
     stages, base = peel_stages(plan)
+    if isinstance(base, PPointGet):
+        from tidb_tpu.executor.scan import PointGetExec
+
+        return PointGetExec(
+            schema=base.schema,
+            table=base.table,
+            stages=scan_stages_for(base, stages),
+            index_name=base.index_name,
+            key_values=base.key_values,
+            out_schema=plan.schema,
+        )
     if isinstance(base, PScan):
         return TableScanExec(
             schema=base.schema,

@@ -21,7 +21,7 @@ from tidb_tpu.expression.compiler import compile_expr, compile_predicate
 from tidb_tpu.planner.binder import PlanCol
 from tidb_tpu.utils.jitcache import cached_jit
 
-__all__ = ["TableScanExec", "make_pipeline_fn", "SelectionExec", "ProjectionExec"]
+__all__ = ["TableScanExec", "PointGetExec", "make_pipeline_fn", "SelectionExec", "ProjectionExec"]
 
 
 def make_pipeline_fn(stages: List) -> Callable:
@@ -104,6 +104,55 @@ class TableScanExec(Executor):
             self.stats.chunks += 1
             return chunk
         return None
+
+
+class PointGetExec(TableScanExec):
+    """O(log n) unique-index point lookup feeding one small chunk (ref:
+    executor/point_get.go PointGetExecutor). The full pushed filter
+    still runs over the fetched rows, so residual conjuncts compose,
+    and MVCC visibility is applied by index_lookup itself."""
+
+    def __init__(self, schema, table, stages, index_name, key_values,
+                 out_schema=None):
+        super().__init__(schema, table, stages, out_schema)
+        self.index_name = index_name
+        self.key_values = key_values
+
+    def open(self, ctx: ExecContext) -> None:
+        # deliberately NOT TableScanExec.open(): that would mint a
+        # literal-keyed jitted pipeline per ad-hoc point query (a fresh
+        # XLA compile each time) and churn the bounded jit LRU. The
+        # handful of fetched rows evaluate eagerly instead.
+        Executor.open(self, ctx)
+        self.ctx = ctx
+        self._fn = make_pipeline_fn(self.stages) if self.stages else None
+        rows = self.table.index_lookup(
+            self.index_name, self.key_values,
+            read_ts=ctx.read_ts, marker=ctx.txn_marker)
+        self._rows = rows
+        self._slices = [("point", None)]  # one emission
+        self._i = 0
+
+    def next(self) -> Optional[Chunk]:
+        if self._i >= len(self._slices):
+            return None
+        self._i += 1
+        rows = self._rows
+        cap = 8
+        while cap < len(rows):
+            cap *= 2
+        cols = {}
+        for c in self.scan_schema:
+            d = self.table.data[c.name][rows]
+            v = self.table.valid[c.name][rows]
+            cols[c.uid] = Column.from_numpy(d, c.type_, valid=v, capacity=cap)
+        sel = np.zeros(cap, dtype=np.bool_)
+        sel[: len(rows)] = True
+        chunk = Chunk(cols, sel)
+        if self._fn is not None:
+            chunk = self._fn(chunk)
+        self.stats.chunks += 1
+        return chunk
 
 
 class SelectionExec(Executor):

@@ -531,6 +531,26 @@ def _try_dist_agg(plan: PHashAgg, cache: ShardCache) -> Optional[Executor]:
                            build_frag[0], build_frag[1], post_stages, cache)
 
 
+def _all_scans_pointy(plan: PhysicalPlan) -> bool:
+    """True when every base-table access is a point get (or tiny): the
+    whole plan touches a handful of rows, so the O(log n) host path wins.
+    A point-get LEAF inside a big join must NOT drag the rest of the
+    plan off the mesh — the fragment treats it as a filtered scan."""
+    from tidb_tpu.planner.physical import PPointGet
+
+    found = False
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, PPointGet):
+            found = True
+        elif isinstance(node, PScan) and node.table is not None:
+            if node.table.n > 4096:
+                return False
+        stack.extend(getattr(node, "children", ()))
+    return found
+
+
 def build_dist_executor(plan: PhysicalPlan, cache: ShardCache,
                         full: bool = True) -> Executor:
     """Build an executor tree, running distributable fragments on the mesh.
@@ -538,6 +558,10 @@ def build_dist_executor(plan: PhysicalPlan, cache: ShardCache,
     full=False (the degenerate single-CPU backend) distributes only
     segment scan-agg fragments — joins and generic aggregation run on
     the vectorized host engine, which beats XLA:CPU's sorts there."""
+    if _all_scans_pointy(plan):
+        # the whole plan touches a handful of rows; the O(log n) host
+        # path beats staging tables onto the mesh
+        return build_executor(plan)
     if isinstance(plan, PHashAgg):
         if not full and _max_scan_rows(plan) > SMALL_FRAGMENT_ROWS:
             # big inputs on a single-CPU backend: keep segment scan-aggs

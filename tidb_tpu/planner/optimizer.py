@@ -8,7 +8,7 @@ from typing import Callable, Optional
 from tidb_tpu.parser import ast as A
 from tidb_tpu.planner.binder import Binder
 from tidb_tpu.planner.logical import BuildContext, build_select
-from tidb_tpu.planner.physical import PhysicalPlan, lower
+from tidb_tpu.planner.physical import PhysicalPlan, inject_point_get, lower
 from tidb_tpu.planner.rules import optimize_logical
 
 __all__ = ["plan_statement"]
@@ -29,4 +29,4 @@ def plan_statement(
     logical = build_select(stmt, ctx)
     logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or (),
                                cascades=cascades)
-    return lower(logical)
+    return inject_point_get(lower(logical))

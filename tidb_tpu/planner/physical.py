@@ -70,6 +70,89 @@ class PScan(PhysicalPlan):
 
 
 @dataclass
+class PPointGet(PScan):
+    """Unique-index point access (ref: planner/core point_get_plan.go →
+    PointGetExecutor; SURVEY.md:91 IndexLookUp's index→row path). The
+    full pushed_cond is retained, so every execution path — including
+    ones that treat this as a plain scan — stays correct; the point
+    executor is the O(log n) fast path."""
+
+    index_name: str = ""
+    key_values: Tuple = ()
+
+    def op_name(self):
+        return "PointGet"
+
+    def op_info(self):
+        return (f"table:{self.table_name}, index:{self.index_name}, "
+                f"key:{tuple(self.key_values)!r}")
+
+
+def inject_point_get(plan: PhysicalPlan) -> PhysicalPlan:
+    """Replace full scans with PPointGet where the pushed filter pins a
+    unique index with integer-typed equality literals."""
+    from tidb_tpu.expression.expr import Call, ColumnRef, Literal
+    from tidb_tpu.types import TypeKind
+    import numpy as np
+
+    def eq_literals(cond, uid_to_col):
+        eqs = {}
+
+        def visit(e):
+            if isinstance(e, Call) and e.op == "and":
+                for a in e.args:
+                    visit(a)
+                return
+            if isinstance(e, Call) and e.op == "eq" and len(e.args) == 2:
+                a, b = e.args
+                if isinstance(a, Literal):
+                    a, b = b, a
+                if (isinstance(a, ColumnRef) and isinstance(b, Literal)
+                        and b.value is not None):
+                    col = uid_to_col.get(a.name)
+                    if col is not None and col.name not in eqs:
+                        eqs[col.name] = (col, b)
+
+        visit(cond)
+        return eqs
+
+    def rewrite(node):
+        node.children = [rewrite(c) for c in node.children]
+        if (type(node) is PScan and node.table is not None
+                and node.pushed_cond is not None):
+            uid_to_col = {c.uid: c for c in node.schema}
+            eqs = eq_literals(node.pushed_cond, uid_to_col)
+            for idx in getattr(node.table, "indexes", {}).values():
+                if not idx.unique or not idx.columns:
+                    continue
+                vals = []
+                for cname in idx.columns:
+                    hit = eqs.get(cname)
+                    if hit is None:
+                        break
+                    col, lit = hit
+                    # plain INT columns compared to INT literals only:
+                    # other int64-backed kinds (DECIMAL scale, DATE epoch
+                    # days, ...) store RESCALED encodings that a raw
+                    # literal does not match — the compiler rescales at
+                    # eval time, but the index key probe would miss
+                    if (col.type_.kind != TypeKind.INT
+                            or lit.type_.kind != TypeKind.INT
+                            or not isinstance(lit.value, (int, np.integer))):
+                        break
+                    vals.append(int(lit.value))
+                else:
+                    return PPointGet(
+                        schema=node.schema, est_rows=1.0, db=node.db,
+                        table_name=node.table_name, table=node.table,
+                        pushed_cond=node.pushed_cond,
+                        index_name=idx.name, key_values=tuple(vals))
+        return node
+
+    return rewrite(plan)
+
+
+@dataclass
 class PSelection(PhysicalPlan):
     cond: object = None
 

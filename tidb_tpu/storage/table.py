@@ -125,6 +125,8 @@ class Table:
         # fresh only across pure inserts, rebuilt lazily otherwise
         self._uniq_cache: Dict[str, tuple] = {}
         self._uniq_pending: Dict[str, np.ndarray] = {}
+        # point-lookup cache: index name -> (version, sorted keys, rows)
+        self._lookup_cache: Dict[str, tuple] = {}
         # rows provisionally ended per open txn marker (REPLACE/upsert
         # re-insert freedom + O(dead) instead of O(n) scans)
         self._txn_dead: Dict[int, list] = {}
@@ -518,6 +520,30 @@ class Table:
             for name, (v, keys) in list(self._uniq_cache.items()):
                 if v == vbefore:
                     self._uniq_cache[name] = (self.version, keys)
+            # same for the point-lookup cache, but the new rows must be
+            # MERGED in (they are new physical positions): O(m log n + n)
+            # memcpy instead of a full re-sort on the next probe
+            if self._lookup_cache:
+                new_ids = (np.concatenate([np.arange(s, e) for s, e in log.ranges])
+                           if log.ranges else np.zeros(0, dtype=np.int64))
+                for name, hit in list(self._lookup_cache.items()):
+                    v, skeys, srows = hit
+                    if v != vbefore:
+                        continue
+                    idx = self.indexes.get(name)
+                    if idx is None:
+                        del self._lookup_cache[name]
+                        continue
+                    mat, ids = self._uniq_key_rows(idx, new_ids)
+                    add = np.ascontiguousarray(mat).view(skeys.dtype).reshape(-1)
+                    order = np.argsort(add, kind="stable")
+                    add, ids = add[order], ids[order]
+                    pos = np.searchsorted(skeys, add)
+                    self._lookup_cache[name] = (
+                        self.version,
+                        np.insert(skeys, pos, add),
+                        np.insert(srows, pos, ids),
+                    )
 
     def txn_rollback(self, marker: int, log: Optional["TableTxnLog"] = None) -> None:
         """Discard provisional writes; restore provisional deletes."""
@@ -732,6 +758,43 @@ class Table:
         if len(ids) == 0:
             return None
         return tuple(mat[0].tolist())
+
+    def index_lookup(self, idx_name: str, key_vals, read_ts=None,
+                     marker: int = 0) -> np.ndarray:
+        """Visible physical row positions whose index key equals
+        `key_vals` — O(log n) against a sorted (key, row) cache per
+        index+version instead of a full scan (ref: the reference's
+        PointGetExecutor reading the index KV record, SURVEY.md:91).
+        MVCC versions share a key; visibility filters them here."""
+        idx = self.indexes[idx_name]
+        hit = self._lookup_cache.get(idx_name)
+        if hit is None or hit[0] != self.version:
+            all_rows = np.arange(self.n, dtype=np.int64)
+            mat, ids = self._uniq_key_rows(idx, all_rows)
+            dt = np.dtype([(f"k{i}", np.int64) for i in range(len(idx.columns))])
+            keys = np.ascontiguousarray(mat).view(dt).reshape(-1)
+            order = np.argsort(keys, kind="stable")
+            hit = (self.version, keys[order], ids[order])
+            self._lookup_cache[idx_name] = hit
+        _, skeys, srows = hit
+        probe = np.zeros(1, dtype=skeys.dtype)
+        for i, v in enumerate(key_vals):
+            probe[f"k{i}"] = np.int64(v)
+        lo = np.searchsorted(skeys, probe[0], side="left")
+        hi = np.searchsorted(skeys, probe[0], side="right")
+        cand = srows[lo:hi]
+        if len(cand) == 0:
+            return cand
+        b = self.begin_ts[cand]
+        e = self.end_ts[cand]
+        if read_ts is None:
+            keep = (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
+        else:
+            keep = (b <= read_ts) & (e > read_ts)
+            if marker:
+                keep = (((b <= read_ts) | (b == marker))
+                        & (e > read_ts) & (e != marker))
+        return cand[keep]
 
     def _uniq_sorted(self, idx: IndexInfo) -> np.ndarray:
         """Sorted key set of present rows, cached per table version.
