@@ -133,3 +133,184 @@ class TestPartialRewrite:
         assert "sum(" in p and "count(" in p and "where" in p
         assert "__dcn_partial__" in f and "group by" in f
         assert names == ["grp", "a", "c"]
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        import datetime
+        import decimal
+
+        from tidb_tpu.parallel.dcn import _dumps, _loads
+
+        obj = {
+            "cmd": "load_columns", "n": None, "t": True, "f": False,
+            "i": 12345678901234567890, "neg": -7, "d": 3.5,
+            "s": "héllo", "b": b"\x00\x01", "lst": [1, "x", None],
+            "tup": (1, 2), "date": datetime.date(1995, 3, 1),
+            "dt": datetime.datetime(2001, 2, 3, 4, 5, 6),
+            "dec": decimal.Decimal("10.25"),
+            "arr": np.arange(5, dtype=np.int64),
+            "farr": np.linspace(0, 1, 4).astype(np.float32),
+        }
+        got = _loads(_dumps(obj))
+        for k in obj:
+            if isinstance(obj[k], np.ndarray):
+                np.testing.assert_array_equal(got[k], obj[k])
+            else:
+                assert got[k] == obj[k], k
+
+    def test_rejects_arbitrary_objects(self):
+        from tidb_tpu.errors import ExecutionError
+        from tidb_tpu.parallel.dcn import _dumps, _loads
+
+        class Evil:
+            pass
+
+        with pytest.raises(ExecutionError):
+            _dumps({"x": Evil()})
+        with pytest.raises(ExecutionError):
+            _loads(b"Z")  # unknown tag
+        # object dtypes (the pickle-smuggling vector) are refused
+        with pytest.raises(ExecutionError):
+            _dumps({"x": np.array([object()], dtype=object)})
+
+
+class TestAuth:
+    def test_secret_handshake(self):
+        import threading as th
+
+        from tidb_tpu.errors import ExecutionError
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        w = Worker(secret="sesame")
+        t = th.Thread(target=w.serve_forever, daemon=True)
+        t.start()
+        try:
+            # right secret works end to end
+            cl = Cluster([("127.0.0.1", w.port)], secret="sesame")
+            assert cl._call(0, {"cmd": "ping"}) == "pong"
+            cl.close()
+            # no secret -> refused client-side before any message
+            with pytest.raises(ExecutionError):
+                Cluster([("127.0.0.1", w.port)])
+            # wrong secret -> server drops the connection
+            with pytest.raises((ConnectionError, OSError, ExecutionError)):
+                bad = Cluster([("127.0.0.1", w.port)], secret="wrong")
+                bad._call(0, {"cmd": "ping"})
+        finally:
+            try:
+                ok = Cluster([("127.0.0.1", w.port)], secret="sesame")
+                ok.shutdown()
+            except Exception:
+                pass
+
+    def test_nonloopback_requires_secret(self):
+        from tidb_tpu.errors import ExecutionError
+        from tidb_tpu.parallel.dcn import Worker
+
+        with pytest.raises(ExecutionError):
+            Worker(host="0.0.0.0")
+
+
+class TestTopNPushdown:
+    def test_topn_partial_shape(self):
+        p, f, names = partial_rewrite(
+            "select k, v from m where v > 0 order by v desc limit 3 offset 1")
+        # each worker returns its local top (limit+offset)
+        assert "limit 4" in p and "order by `v` desc" in p, p
+        assert "limit 3" in f and "offset 1" in f, f
+        assert names == ["k", "v"]
+
+    def test_topn_end_to_end(self, cluster, oracle):
+        sql = ("select k, v from m where v is not null"
+               " order by v desc, k limit 5")
+        assert cluster.query(sql) == oracle.query(sql)
+
+    def test_plain_scan_gather(self, cluster, oracle):
+        sql = "select k from m where k < 5 order by k"
+        assert cluster.query(sql) == oracle.query(sql)
+
+
+class TestReplicaFailover:
+    def test_partial_retries_on_replica(self):
+        """Kill the primary's worker; its partition re-runs on the
+        replica from the mirrored `m__part0` table."""
+        import threading as th
+
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        workers = [Worker() for _ in range(2)]
+        for w in workers:
+            th.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                     replicas={0: 1, 1: 0})
+        try:
+            cl.broadcast_exec("create table r (k bigint, v bigint)")
+            cl.load_partition(0, "r",
+                              arrays={"k": np.arange(0, 10, dtype=np.int64),
+                                      "v": np.full(10, 1, dtype=np.int64)},
+                              db="test")
+            cl.load_partition(1, "r",
+                              arrays={"k": np.arange(10, 30, dtype=np.int64),
+                                      "v": np.full(20, 2, dtype=np.int64)},
+                              db="test")
+            sql = "select count(*) as n, sum(v) as s from r"
+            assert cl.query(sql) == [(30, 50)]
+            # hard-kill worker 0's server socket mid-cluster
+            workers[0]._running = False
+            workers[0]._sock.close()
+            cl._socks[0].close()  # simulate the broken link surfacing
+            assert cl.query(sql) == [(30, 50)]  # replica answered for part 0
+        finally:
+            try:
+                cl.shutdown()
+            except Exception:
+                pass
+
+
+class TestReviewRegressions:
+    def test_agg_inside_expression_not_topn(self):
+        """sum(v)+1 nests the aggregate in EBinary; it must NOT be
+        mis-classified as a plain scan-gather, which would return one
+        local sum per worker (review finding). The aggregate-shaped
+        path rejects the composite output instead."""
+        from tidb_tpu.errors import UnsupportedError
+
+        with pytest.raises(UnsupportedError, match="group columns or plain"):
+            partial_rewrite("select sum(v) + 1 as s from m")
+
+    def test_downgrade_refused(self):
+        import threading as th
+
+        from tidb_tpu.errors import ExecutionError
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        w = Worker()  # no secret
+        th.Thread(target=w.serve_forever, daemon=True).start()
+        try:
+            with pytest.raises(ExecutionError):
+                Cluster([("127.0.0.1", w.port)], secret="sesame")
+        finally:
+            Cluster([("127.0.0.1", w.port)]).shutdown()
+
+    def test_malformed_frame_marks_socket_dead(self):
+        import threading as th
+
+        from tidb_tpu.parallel.dcn import Cluster, Worker, _LEN
+
+        w = Worker()
+        th.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port)])
+        try:
+            # desync the stream with a raw garbage frame
+            cl._socks[0].sendall(_LEN.pack(3) + b"Zxx")
+            with pytest.raises((ConnectionError, Exception)):
+                cl._call(0, {"cmd": "ping"})
+            assert cl._socks[0] is None  # marked dead, not reused
+        finally:
+            cl.close()
+            w._running = False
+            try:
+                w._sock.close()
+            except OSError:
+                pass

@@ -159,3 +159,14 @@ class TestReviewRegressions:
     def test_cast_char_n_truncates(self, sess):
         assert q(sess, "select cast(s1 as char(1)), cast('abcdef' as char(3))"
                        " from t where id = 1") == [("a", "abc")]
+
+
+def test_bitwise_unsigned_semantics(sess):
+    """MySQL bit ops are BIGINT UNSIGNED: ~0 is 2^64-1, >> shifts in
+    zeros, and shift counts >= 64 yield 0 (review finding)."""
+    s = sess
+    # jnp uint64 -> python int via the i64 bitcast; compare bit patterns
+    assert s.query("select -1 >> 1") == [(0x7FFFFFFFFFFFFFFF,)]
+    assert s.query("select 1 << 64") == [(0,)]
+    assert s.query("select 123 >> 64") == [(0,)]
+    assert s.query("select (1 << 63) >> 63") == [(1,)]

@@ -121,3 +121,19 @@ class TestPluginRegistry:
             reg.register(Plugin(name="a", kind="audit"))
         with pytest.raises(ExecutionError):
             reg.register(Plugin(name="b", kind="bogus"))
+
+
+def test_module_allowlist():
+    """INSTALL PLUGIN imports are restricted to configured prefixes on
+    servers (review: SQL-reachable importlib of arbitrary paths)."""
+    import pytest
+
+    from tidb_tpu.errors import ExecutionError
+    from tidb_tpu.session import Session
+
+    s = Session()
+    s.catalog.plugins.allowed_prefixes = ("tidb_tpu.testplugins",)
+    with pytest.raises(ExecutionError):
+        s.execute("install plugin evil soname 'os'")
+    with pytest.raises(ExecutionError):
+        s.execute("install plugin evil soname 'tidb_tpu_fake.x'")

@@ -27,6 +27,10 @@ def parse_args(argv):
                     help="preload TPC-H tables at scale factor SF")
     ap.add_argument("--root-password", default=None,
                     help="set the root account password at boot")
+    ap.add_argument("--plugin-modules", default=None,
+                    help="comma-separated module path prefixes INSTALL "
+                         "PLUGIN may import (default: none — SQL plugin "
+                         "loading disabled on the server)")
     ap.add_argument("--device", choices=["default", "cpu"], default=None,
                     help="force the jax platform (cpu bypasses a broken/"
                          "absent accelerator; the env pin alone is not "
@@ -54,6 +58,8 @@ def main(argv=None) -> int:
     sf = args.load_tpch if args.load_tpch is not None else cfg.get("load_tpch")
     root_pw = (args.root_password if args.root_password is not None
                else cfg.get("root_password"))
+    plugin_mods = (args.plugin_modules if args.plugin_modules is not None
+                   else cfg.get("plugin_modules", ""))
 
     import tidb_tpu  # noqa: F401  (x64 config before jax backend init)
 
@@ -76,6 +82,9 @@ def main(argv=None) -> int:
             print(f"# mesh unavailable ({e}); single-chip execution", file=sys.stderr)
 
     catalog = Catalog()
+    # SQL-reachable plugin imports are allowlisted on the wire server
+    catalog.plugins.allowed_prefixes = tuple(
+        p.strip() for p in str(plugin_mods).split(",") if p.strip())
     if root_pw:
         catalog.set_password("root", root_pw)
     if sf:

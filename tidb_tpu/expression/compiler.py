@@ -485,6 +485,29 @@ def _nary_extreme(pick):
     return fn
 
 
+def _ushift(op):
+    """MySQL shifts are on BIGINT UNSIGNED: logical (zero-fill) via the
+    uint64 bit pattern, and counts >= 64 are defined to give 0 (XLA
+    leaves oversize shifts undefined)."""
+    def f(a, b):
+        ua = jax.lax.bitcast_convert_type(a.astype(jnp.int64), jnp.uint64)
+        bi = b.astype(jnp.int64)
+        # the count is BIGINT UNSIGNED too: a negative count wraps to
+        # >= 2^63, which is >= 64 -> zero
+        cnt = jnp.where(bi < 0, jnp.int64(64), jnp.clip(bi, 0, 64))
+        out = op(ua, jnp.minimum(cnt, 63).astype(jnp.uint64))
+        out = jnp.where(cnt >= 64, jnp.uint64(0), out)
+        return jax.lax.bitcast_convert_type(out, jnp.int64)
+
+    return f
+
+
+def _ubitnot(a):
+    return jax.lax.bitcast_convert_type(
+        ~jax.lax.bitcast_convert_type(a.astype(jnp.int64), jnp.uint64),
+        jnp.int64)
+
+
 def _sign(e: Call, chunk) -> Pair:
     d, v = eval_expr(e.args[0], chunk)
     return jnp.sign(d).astype(jnp.int64), v
@@ -567,10 +590,13 @@ FUNCS = {
     "atan2": _strict2(jnp.arctan2),
     "radians": _strict1(jnp.radians, cast_float=True),
     "degrees": _strict1(jnp.degrees, cast_float=True),
+    # MySQL bit ops are BIGINT UNSIGNED: ~ and >> operate on the uint64
+    # bit pattern (logical shift, not arithmetic), and shift counts >= 64
+    # are defined to produce 0 (XLA leaves them undefined)
     "bitand": _strict2(jnp.bitwise_and),
     "bitor": _strict2(jnp.bitwise_or),
     "bitxor": _strict2(jnp.bitwise_xor),
-    "shl": _strict2(jnp.left_shift),
-    "shr": _strict2(jnp.right_shift),
-    "bitnot": _strict1(jnp.bitwise_not),
+    "shl": _strict2(_ushift(jnp.left_shift)),
+    "shr": _strict2(_ushift(jnp.right_shift)),
+    "bitnot": _strict1(_ubitnot),
 }

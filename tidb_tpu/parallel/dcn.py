@@ -14,27 +14,36 @@ Architecture (the reference's shape, re-mapped):
     stage on the coordinator)      <-   below this one unchanged)
 
 Partial/final split: COUNT->SUM of counts, SUM->SUM, MIN/MAX->MIN/MAX,
-AVG->SUM(sum)/SUM(count). Group keys travel as decoded host values, so
-workers' independent string dictionaries never need reconciling — the
-same reason the reference's coprocessor returns datums, not its
-storage-internal encodings.
+AVG->SUM(sum)/SUM(count). Plain SELECT ... ORDER BY ... LIMIT pushes the
+TopN into every worker (local top-n) and merges on the coordinator — the
+reference's coprocessor TopN pushdown. Group keys travel as decoded host
+values, so workers' independent string dictionaries never reconcile.
 
-Transport: length-prefixed pickles over TCP. Like the reference's
-intra-cluster gRPC, this is a CLUSTER-INTERNAL protocol: workers
-execute SQL for the coordinator by design, so it must only ever listen
-inside the cluster's trust boundary (loopback/private network).
+Transport: length-prefixed messages in a RESTRICTED codec (scalars,
+strings, bytes, date/time/decimal, lists/dicts, allowlisted numpy
+arrays — never arbitrary objects), so a hostile peer cannot execute
+code by serialization alone. An optional shared secret adds an
+HMAC-SHA256 challenge handshake per connection; binding a worker to a
+non-loopback interface REQUIRES the secret.
 
-Failure handling mirrors the reference's region-error model: a worker
-RPC failure fails the query with a diagnosable error (retry/replica
-logic would slot in at Cluster._call)."""
+Failure handling mirrors the reference's region-error model: each
+partition may have a REPLICA on another worker (its copy lives in
+`<table>__part<i>`); a worker RPC failure retries the partial there
+before failing the query."""
 
 from __future__ import annotations
 
-import pickle
+import datetime
+import decimal
+import hashlib
+import hmac
+import os
 import socket
 import struct
 import threading
 from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from tidb_tpu.errors import ExecutionError, UnsupportedError
 from tidb_tpu.parser import ast as A
@@ -43,18 +52,191 @@ from tidb_tpu.parser.printer import expr_to_sql
 
 __all__ = ["Worker", "Cluster", "partial_rewrite"]
 
+
+class DcnCodecError(ExecutionError):
+    """Malformed wire frame: the connection is desynced and must die."""
+
 _LEN = struct.Struct(">I")
+_D = struct.Struct(">d")
+
+# ---------------------------------------------------------------------------
+# restricted wire codec (replaces pickle: data only, no code)
+# ---------------------------------------------------------------------------
+
+_DTYPES = {
+    "bool", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "float32", "float64",
+}
+
+
+def _enc(obj, out: List[bytes]) -> None:
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, (int, np.integer)):
+        b = str(int(obj)).encode()
+        out += [b"I", _LEN.pack(len(b)), b]
+    elif isinstance(obj, (float, np.floating)):
+        out += [b"D", _D.pack(float(obj))]
+    elif isinstance(obj, str):
+        b = obj.encode()
+        out += [b"S", _LEN.pack(len(b)), b]
+    elif isinstance(obj, (bytes, bytearray)):
+        out += [b"B", _LEN.pack(len(obj)), bytes(obj)]
+    elif isinstance(obj, np.bool_):
+        out.append(b"T" if bool(obj) else b"F")
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.name not in _DTYPES:
+            raise DcnCodecError(f"dcn codec: dtype {obj.dtype} not allowed")
+        a = np.ascontiguousarray(obj)
+        dt = a.dtype.name.encode()
+        raw = a.tobytes()
+        out += [b"A", _LEN.pack(len(dt)), dt,
+                _LEN.pack(a.ndim), b"".join(_LEN.pack(d) for d in a.shape),
+                _LEN.pack(len(raw)), raw]
+    elif isinstance(obj, (list, tuple)):
+        out += [b"L" if isinstance(obj, list) else b"U", _LEN.pack(len(obj))]
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, dict):
+        out += [b"M", _LEN.pack(len(obj))]
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise DcnCodecError("dcn codec: dict keys must be str")
+            kb = k.encode()
+            out += [_LEN.pack(len(kb)), kb]
+            _enc(v, out)
+    elif isinstance(obj, datetime.datetime):  # before date (subclass)
+        b = obj.isoformat().encode()
+        out += [b"t", _LEN.pack(len(b)), b]
+    elif isinstance(obj, datetime.date):
+        b = obj.isoformat().encode()
+        out += [b"d", _LEN.pack(len(b)), b]
+    elif isinstance(obj, decimal.Decimal):
+        b = str(obj).encode()
+        out += [b"c", _LEN.pack(len(b)), b]
+    else:
+        raise DcnCodecError(
+            f"dcn codec: type {type(obj).__name__} not serializable")
+
+
+def _need(buf: bytes, pos: int, n: int) -> int:
+    if pos + n > len(buf):
+        raise DcnCodecError("dcn codec: truncated message")
+    return pos + n
+
+
+def _dec(buf: bytes, pos: int):
+    tag = buf[pos:pos + 1]
+    pos += 1
+    if tag == b"N":
+        return None, pos
+    if tag == b"T":
+        return True, pos
+    if tag == b"F":
+        return False, pos
+    if tag in (b"I", b"S", b"B", b"d", b"t", b"c"):
+        end = _need(buf, pos, _LEN.size)
+        (n,) = _LEN.unpack(buf[pos:end])
+        pos = end
+        end = _need(buf, pos, n)
+        raw = buf[pos:end]
+        pos = end
+        if tag == b"I":
+            return int(raw), pos
+        if tag == b"B":
+            return raw, pos
+        s = raw.decode()
+        if tag == b"S":
+            return s, pos
+        if tag == b"d":
+            return datetime.date.fromisoformat(s), pos
+        if tag == b"t":
+            return datetime.datetime.fromisoformat(s), pos
+        return decimal.Decimal(s), pos
+    if tag == b"D":
+        end = _need(buf, pos, _D.size)
+        (v,) = _D.unpack(buf[pos:end])
+        return v, end
+    if tag in (b"L", b"U"):
+        end = _need(buf, pos, _LEN.size)
+        (n,) = _LEN.unpack(buf[pos:end])
+        pos = end
+        items = []
+        for _ in range(n):
+            v, pos = _dec(buf, pos)
+            items.append(v)
+        return (items if tag == b"L" else tuple(items)), pos
+    if tag == b"M":
+        end = _need(buf, pos, _LEN.size)
+        (n,) = _LEN.unpack(buf[pos:end])
+        pos = end
+        d = {}
+        for _ in range(n):
+            end = _need(buf, pos, _LEN.size)
+            (kn,) = _LEN.unpack(buf[pos:end])
+            pos = end
+            end = _need(buf, pos, kn)
+            k = buf[pos:end].decode()
+            pos = end
+            d[k], pos = _dec(buf, pos)
+        return d, pos
+    if tag == b"A":
+        end = _need(buf, pos, _LEN.size)
+        (dn,) = _LEN.unpack(buf[pos:end])
+        pos = end
+        end = _need(buf, pos, dn)
+        dtname = buf[pos:end].decode()
+        pos = end
+        if dtname not in _DTYPES:
+            raise DcnCodecError(f"dcn codec: dtype {dtname} not allowed")
+        end = _need(buf, pos, _LEN.size)
+        (ndim,) = _LEN.unpack(buf[pos:end])
+        pos = end
+        shape = []
+        for _ in range(ndim):
+            end = _need(buf, pos, _LEN.size)
+            shape.append(_LEN.unpack(buf[pos:end])[0])
+            pos = end
+        end = _need(buf, pos, _LEN.size)
+        (rn,) = _LEN.unpack(buf[pos:end])
+        pos = end
+        end = _need(buf, pos, rn)
+        arr = np.frombuffer(buf[pos:end], dtype=dtname).reshape(shape).copy()
+        return arr, end
+    raise DcnCodecError(f"dcn codec: bad tag {tag!r}")
+
+
+def _dumps(obj) -> bytes:
+    out: List[bytes] = []
+    _enc(obj, out)
+    return b"".join(out)
+
+
+def _loads(buf: bytes):
+    try:
+        obj, pos = _dec(buf, 0)
+    except DcnCodecError:
+        raise
+    except Exception as e:  # noqa: BLE001 — int()/decode()/reshape/...
+        raise DcnCodecError(f"dcn codec: malformed message ({e})")
+    if pos != len(buf):
+        raise DcnCodecError("dcn codec: trailing bytes")
+    return obj
 
 
 def _send(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = _dumps(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def _recv(sock: socket.socket):
     hdr = _recv_exact(sock, _LEN.size)
     (n,) = _LEN.unpack(hdr)
-    return pickle.loads(_recv_exact(sock, n))
+    return _loads(_recv_exact(sock, n))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -67,6 +249,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+def _is_loopback(host: str) -> bool:
+    # NB "" binds INADDR_ANY (all interfaces) — decidedly not loopback
+    return host in ("127.0.0.1", "::1", "localhost")
+
+
 # ---------------------------------------------------------------------------
 # worker
 # ---------------------------------------------------------------------------
@@ -75,9 +262,15 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 class Worker:
     """One host's coprocessor service: a Session over its partition."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None):
         from tidb_tpu.session import Session
 
+        if not _is_loopback(host) and not secret:
+            raise ExecutionError(
+                "dcn worker: binding a non-loopback interface requires a "
+                "shared secret (--secret-file / DCN_SECRET)")
+        self.secret = secret
         self.session = Session()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -96,8 +289,28 @@ class Worker:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
+    def _handshake(self, conn: socket.socket) -> bool:
+        """Challenge/response before any message is decoded. The flag
+        byte tells the client whether auth is demanded."""
+        if not self.secret:
+            conn.sendall(b"\x00")
+            return True
+        nonce = os.urandom(16)
+        conn.sendall(b"\x01" + nonce)
+        try:
+            mac = _recv_exact(conn, 32)
+        except (ConnectionError, OSError):
+            return False
+        want = hmac.new(self.secret.encode(), nonce, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            conn.close()
+            return False
+        return True
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
+            if not self._handshake(conn):
+                return
             while True:
                 msg = _recv(conn)
                 try:
@@ -115,8 +328,13 @@ class Worker:
                         pass
                     self._sock.close()
                     return
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError, DcnCodecError):
+            pass  # desynced or dropped peer: close this connection
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _handle(self, msg: Dict):
         cmd = msg["cmd"]
@@ -126,7 +344,23 @@ class Worker:
             rs = self.session.execute(msg["sql"])
             return rs.rows if rs is not None else None
         if cmd == "load_columns":
-            table = self.session.catalog.table("test", msg["table"])
+            db = msg.get("db") or self.session.db
+            name = msg["table"]
+            cat = self.session.catalog
+            like = msg.get("like")
+            if like is not None:
+                # replica partitions clone the base table's schema into
+                # their own namespaced table on first load
+                try:
+                    cat.table(db, name)
+                except Exception:  # noqa: BLE001 — absent: clone it
+                    import copy
+
+                    base = cat.table(db, like)
+                    schema = copy.deepcopy(base.schema)
+                    schema.name = name
+                    cat.create_table(db, schema)
+            table = cat.table(db, name)
             return table.insert_columns(
                 msg.get("arrays") or {}, msg.get("valids"),
                 strings=msg.get("strings"))
@@ -148,12 +382,19 @@ def worker_main(argv=None) -> None:  # pragma: no cover - subprocess entry
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--device", default=None,
                     help="force a jax platform (e.g. cpu) before serving")
+    ap.add_argument("--secret-file", default=None,
+                    help="path to the cluster shared secret (else DCN_SECRET)")
     args = ap.parse_args(argv)
     if args.device:
         import jax
 
         jax.config.update("jax_platforms", args.device)
-    w = Worker(args.host, args.port)
+    secret = None
+    if args.secret_file:
+        secret = open(args.secret_file).read().strip()
+    elif os.environ.get("DCN_SECRET"):
+        secret = os.environ["DCN_SECRET"]
+    w = Worker(args.host, args.port, secret=secret)
     print(f"DCN_WORKER_PORT={w.port}", flush=True)
     sys.stdout.flush()
     w.serve_forever()
@@ -170,12 +411,16 @@ if __name__ == "__main__":  # pragma: no cover
 _DIST_AGGS = {"count", "sum", "min", "max", "avg"}
 
 
-def partial_rewrite(sql: str) -> Tuple[str, str, List[str]]:
-    """One single-table aggregate SELECT -> (partial_sql, final_sql,
-    out_names). partial_sql runs on every worker; its result rows are
-    unioned into the staging table __dcn_partial__ on the coordinator,
-    where final_sql computes the merge (incl. HAVING-free ORDER BY /
-    LIMIT from the original)."""
+def partial_rewrite(sql: str, table_as: Optional[str] = None
+                    ) -> Tuple[str, str, List[str]]:
+    """One single-table SELECT -> (partial_sql, final_sql, out_names).
+    partial_sql runs on every worker; its result rows are unioned into
+    the staging table __dcn_partial__ on the coordinator, where
+    final_sql computes the merge. Aggregates use the partial/final
+    split; a plain SELECT with ORDER BY+LIMIT becomes a local TopN per
+    worker merged by the same sort on the coordinator (coprocessor TopN
+    pushdown). `table_as` substitutes the scanned table name — the
+    replica-partition retry path reads `<table>__part<i>`."""
     stmts = parse(sql)
     if len(stmts) != 1 or not isinstance(stmts[0], A.SelectStmt):
         raise UnsupportedError("dcn tier handles a single SELECT")
@@ -185,6 +430,30 @@ def partial_rewrite(sql: str) -> Tuple[str, str, List[str]]:
         raise UnsupportedError(
             "dcn tier pushes single-table aggregates (the coprocessor "
             "shape); joins execute above it")
+
+    def has_agg(e) -> bool:
+        import dataclasses as _dc
+
+        if isinstance(e, A.EFunc) and e.name in _DIST_AGGS:
+            return True
+        if not _dc.is_dataclass(e):
+            return False
+        for fld in _dc.fields(e):
+            v = getattr(e, fld.name)
+            items = v if isinstance(v, (list, tuple)) else [v]
+            for item in items:
+                if isinstance(item, tuple):
+                    if any(_dc.is_dataclass(x) and has_agg(x) for x in item):
+                        return True
+                elif _dc.is_dataclass(item) and has_agg(item):
+                    return True
+        return False
+
+    tname = table_as or st.from_.name
+    where = f" where {expr_to_sql(st.where)}" if st.where is not None else ""
+
+    if not st.group_by and not any(has_agg(it.expr) for it in st.items):
+        return _topn_rewrite(st, tname, where)
 
     group_sqls = [expr_to_sql(g) for g in st.group_by]
     part_items: List[str] = []
@@ -222,8 +491,6 @@ def partial_rewrite(sql: str) -> Tuple[str, str, List[str]]:
             part_items.append(f"count({argsql}) as p{i}c")
             final_items.append(f"sum(p{i}s) / sum(p{i}c) as `{alias}`")
 
-    tname = st.from_.name
-    where = f" where {expr_to_sql(st.where)}" if st.where is not None else ""
     groupby = f" group by {', '.join(group_sqls)}" if group_sqls else ""
     partial_sql = (f"select {', '.join(part_items)} from `{tname}`"
                    f"{where}{groupby}")
@@ -251,31 +518,114 @@ def partial_rewrite(sql: str) -> Tuple[str, str, List[str]]:
     return partial_sql, final_sql, out_names
 
 
+def _topn_rewrite(st: A.SelectStmt, tname: str, where: str
+                  ) -> Tuple[str, str, List[str]]:
+    """Plain SELECT [ORDER BY ... LIMIT n]: each worker returns its
+    local rows (top n+offset when limited); the coordinator re-sorts and
+    applies the final limit/offset. Without a LIMIT this is a plain
+    distributed scan-gather."""
+    part_items, out_names = [], []
+    for i, item in enumerate(st.items):
+        e = item.expr
+        alias = item.alias or (
+            e.name if isinstance(e, A.EName) else f"col{i}")
+        out_names.append(alias)
+        part_items.append(f"{expr_to_sql(e)} as `{alias}`")
+
+    item_sqls = [expr_to_sql(it.expr) for it in st.items]
+    order_terms = []
+    for o in st.order_by:
+        osql = expr_to_sql(o.expr)
+        if isinstance(o.expr, A.EName) and o.expr.qualifier is None \
+                and o.expr.name in out_names:
+            ref = f"`{o.expr.name}`"
+        elif osql in item_sqls:
+            ref = f"`{out_names[item_sqls.index(osql)]}`"
+        else:
+            raise UnsupportedError(
+                "dcn TopN ORDER BY must reference output columns")
+        order_terms.append(ref + (" desc" if o.desc else ""))
+    order = (" order by " + ", ".join(order_terms)) if order_terms else ""
+
+    part_limit = ""
+    if st.limit is not None:
+        if not order_terms:
+            raise UnsupportedError("dcn LIMIT without ORDER BY is ambiguous")
+        part_limit = f" limit {st.limit + (st.offset or 0)}"
+    partial_sql = (f"select {', '.join(part_items)} from `{tname}`"
+                   f"{where}{order}{part_limit}")
+    limit = f" limit {st.limit}" if st.limit is not None else ""
+    offset = f" offset {st.offset}" if st.offset is not None else ""
+    final_sql = (f"select {', '.join(f'`{n}`' for n in out_names)} "
+                 f"from `__dcn_partial__`{order}{limit}{offset}")
+    return partial_sql, final_sql, out_names
+
+
 # ---------------------------------------------------------------------------
 # coordinator
 # ---------------------------------------------------------------------------
 
 
 class Cluster:
-    """Coordinator-side handle on the worker fleet."""
+    """Coordinator-side handle on the worker fleet.
 
-    def __init__(self, endpoints: List[Tuple[str, int]]):
-        self._socks: List[socket.socket] = []
+    `replicas` maps partition/worker index -> replica worker index; a
+    partition loaded with load_partition is mirrored into the replica's
+    `<table>__part<i>` table, and a failed partial RPC retries there
+    (the region-replica failover analogue)."""
+
+    def __init__(self, endpoints: List[Tuple[str, int]],
+                 secret: Optional[str] = None,
+                 replicas: Optional[Dict[int, int]] = None):
+        self.secret = secret
+        self.replicas = dict(replicas or {})
+        self._socks: List[Optional[socket.socket]] = []
+        self._endpoints = list(endpoints)
         for host, port in endpoints:
-            s = socket.create_connection((host, port), timeout=30)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks.append(s)
+            self._socks.append(self._connect(host, port))
         from tidb_tpu.session import Session
 
         self._merge_session = Session()
+
+    def _connect(self, host: str, port: int) -> socket.socket:
+        s = socket.create_connection((host, port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        flag = _recv_exact(s, 1)
+        if flag == b"\x01":
+            if not self.secret:
+                s.close()
+                raise ExecutionError(
+                    "dcn worker demands auth but no secret configured")
+            nonce = _recv_exact(s, 16)
+            s.sendall(hmac.new(self.secret.encode(), nonce,
+                               hashlib.sha256).digest())
+        elif self.secret:
+            # downgrade refusal: a coordinator configured for auth must
+            # not talk to an endpoint that waives it (spoofed worker)
+            s.close()
+            raise ExecutionError(
+                f"dcn worker {host}:{port} does not require auth but this "
+                "cluster is configured with a secret")
+        return s
 
     def __len__(self):
         return len(self._socks)
 
     def _call(self, i: int, msg: Dict):
         sock = self._socks[i]
-        _send(sock, msg)
-        resp = _recv(sock)
+        if sock is None:
+            raise ConnectionError(f"dcn worker {i} is down")
+        try:
+            _send(sock, msg)
+            resp = _recv(sock)
+        except (ConnectionError, OSError, DcnCodecError) as e:
+            # mark dead so retries don't reuse a broken socket
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._socks[i] = None
+            raise ConnectionError(f"dcn worker {i}: {e}") from e
         if not resp["ok"]:
             raise ExecutionError(f"dcn worker {i}: {resp['error']}")
         return resp["result"]
@@ -305,19 +655,60 @@ class Cluster:
         self._call_all([{"cmd": "exec", "sql": sql}] * len(self._socks))
 
     def load_partition(self, worker: int, table: str, arrays=None,
-                       valids=None, strings=None) -> int:
-        return self._call(worker, {
+                       valids=None, strings=None, db: Optional[str] = None
+                       ) -> int:
+        n = self._call(worker, {
             "cmd": "load_columns", "table": table, "arrays": arrays,
-            "valids": valids, "strings": strings,
+            "valids": valids, "strings": strings, "db": db,
         })
+        rep = self.replicas.get(worker)
+        if rep is not None:
+            self._call(rep, {
+                "cmd": "load_columns", "table": f"{table}__part{worker}",
+                "like": table, "arrays": arrays, "valids": valids,
+                "strings": strings, "db": db,
+            })
+        return n
+
+    def _partials_with_failover(self, sql: str, partial_sql: str) -> List:
+        """Fan the partial out; a dead worker's partition re-runs on its
+        replica (reading `<table>__part<i>`)."""
+        results: List = [None] * len(self._socks)
+        failed: List[Tuple[int, Exception]] = []
+        lock = threading.Lock()
+
+        def run(i):
+            try:
+                results[i] = self._call(i, {"cmd": "partial",
+                                            "sql": partial_sql})
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    failed.append((i, e))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(self._socks))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tname = None
+        for i, err in failed:
+            rep = self.replicas.get(i)
+            if rep is None or self._socks[rep] is None:
+                raise err
+            if tname is None:
+                tname = parse(sql)[0].from_.name
+            rep_sql, _f, _n = partial_rewrite(
+                sql, table_as=f"{tname}__part{i}")
+            results[i] = self._call(rep, {"cmd": "partial", "sql": rep_sql})
+        return results
 
     def query(self, sql: str, schema_sql: Optional[str] = None) -> List[tuple]:
-        """Distributed aggregate: partial on every worker, final merge
-        here. schema_sql overrides the staging table DDL; by default
-        column types are inferred from the partial rows."""
+        """Distributed aggregate / TopN: partial on every worker, final
+        merge here. schema_sql overrides the staging table DDL; by
+        default column types are inferred from the partial rows."""
         partial_sql, final_sql, _names = partial_rewrite(sql)
-        worker_rows = self._call_all(
-            [{"cmd": "partial", "sql": partial_sql}] * len(self._socks))
+        worker_rows = self._partials_with_failover(sql, partial_sql)
         all_rows = [r for rows in worker_rows for r in rows]
         s = self._merge_session
         s.execute("drop table if exists __dcn_partial__")
@@ -346,6 +737,8 @@ class Cluster:
 
     def shutdown(self) -> None:
         for i in range(len(self._socks)):
+            if self._socks[i] is None:
+                continue
             try:
                 self._call(i, {"cmd": "shutdown"})
             except Exception:  # noqa: BLE001
@@ -354,6 +747,8 @@ class Cluster:
 
     def close(self) -> None:
         for s in self._socks:
+            if s is None:
+                continue
             try:
                 s.close()
             except OSError:
@@ -362,7 +757,6 @@ class Cluster:
 
 
 def _infer_type(values) -> str:
-    import datetime
     import re
 
     for v in values:
@@ -387,8 +781,6 @@ def _infer_type(values) -> str:
 
 
 def _sql_literal(v) -> str:
-    import datetime
-
     if v is None:
         return "null"
     if isinstance(v, bool):

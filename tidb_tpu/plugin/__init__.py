@@ -65,10 +65,23 @@ class PluginRegistry:
             raise ExecutionError(f"plugin {plugin.name!r} already installed")
         self._plugins[plugin.name] = plugin
 
+    # SQL-reachable import allowlist: module path prefixes INSTALL
+    # PLUGIN may load, configured at process start (never via SQL) —
+    # MySQL likewise restricts SONAME to the server-local plugin_dir.
+    # None = embedding default (trusted in-process callers); the server
+    # entrypoint sets it explicitly (--plugin-modules / config).
+    allowed_prefixes: "Optional[tuple]" = None
+
     def load_module(self, name: str, module: str) -> None:
         """INSTALL PLUGIN name SONAME 'module': import and init. The
         module's plugin_init may register several plugins; `name` must
         be among them (MySQL errors likewise on a name mismatch)."""
+        if self.allowed_prefixes is not None and not any(
+                module == p or module.startswith(p + ".")
+                for p in self.allowed_prefixes):
+            raise ExecutionError(
+                f"plugin module {module!r} is outside the configured "
+                f"allowlist")
         try:
             mod = importlib.import_module(module)
         except ImportError as e:
