@@ -73,7 +73,8 @@ def _group_hash(kbits: List[jax.Array], kvalids: List[jax.Array]) -> jax.Array:
 
 def _sort_reduce(kbits: List[jax.Array], kvalids: List[jax.Array],
                  kdatas: List[jax.Array], live: jax.Array,
-                 payload: List[jax.Array], reduce_ops: List[str]):
+                 payload: List[jax.Array], reduce_ops: List[str],
+                 exact: bool = False):
     """Shared core: sort rows by (dead, key identity), find segment
     boundaries, reduce payload arrays into dense per-group slots.
 
@@ -96,6 +97,13 @@ def _sort_reduce(kbits: List[jax.Array], kvalids: List[jax.Array],
         # exact: equal bits tie-break on validity (NULL run != live-0 run)
         out = jax.lax.sort(
             (dead, kbits[0], kvalids[0].astype(jnp.int32), iota), num_keys=3)
+    elif exact:
+        # hash first (cheap comparisons), exact bits as tie-breaks: equal
+        # keys are guaranteed contiguous, so the output table can never
+        # hold a collision-split duplicate — consumers may emit it
+        # directly without a dedup pass
+        keys = (dead, _group_hash(kbits, kvalids)) + tuple(kbits) + (iota,)
+        out = jax.lax.sort(keys, num_keys=len(keys) - 1)
     else:
         out = jax.lax.sort(
             (dead, _group_hash(kbits, kvalids), iota), num_keys=2)

@@ -537,7 +537,10 @@ class _Compiler:
             rbits = [_key_bits(d, v) for d, v in zip(rkd, rkv)]
             payload = [recv[name] for name, _ in layout]
             ops = [op for _, op in layout]
-            n, fk, fkv, red = _sort_reduce(rbits, rkv, rkd, recv_sel, payload, ops)
+            # exact mode: the emitted tables are duplicate-free, so the
+            # host finalize is a straight per-part conversion — no merge
+            n, fk, fkv, red = _sort_reduce(rbits, rkv, rkd, recv_sel,
+                                           payload, ops, exact=True)
             out = {"n": n[None]}
             for i in range(nk):
                 out[f"k{i}.d"] = fk[i]
