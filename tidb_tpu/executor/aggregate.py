@@ -147,7 +147,14 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
             if a.func in ("sum", "avg"):
                 acc = state[f"{a.uid}.sum"]
                 contrib = jnp.where(ok, d, 0).astype(acc.dtype)
-                out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
+                if acc.dtype == jnp.int64:
+                    # decimal/int sums: exact Pallas limb kernel on TPU
+                    from tidb_tpu.ops import segment_sum_i64
+
+                    out[f"{a.uid}.sum"] = acc + segment_sum_i64(
+                        contrib, packed, G)
+                else:
+                    out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
                 out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(ok, packed, G)
             elif a.func == "count":
                 cm = sel if a.arg is None else ok

@@ -14,8 +14,10 @@ from tidb_tpu.ops.segment_sum import (
     pallas_enabled,
     segment_count,
     segment_sum_f32,
+    segment_sum_i64,
     set_pallas_enabled,
 )
 
-__all__ = ["segment_count", "segment_sum_f32", "pallas_enabled",
+__all__ = ["segment_count", "segment_sum_f32", "segment_sum_i64",
+           "pallas_enabled",
            "set_pallas_enabled", "force_platform"]

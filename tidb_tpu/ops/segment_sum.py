@@ -28,8 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["segment_count", "segment_sum_f32", "pallas_enabled",
-           "set_pallas_enabled", "xla_segment_sum", "force_platform"]
+__all__ = ["segment_count", "segment_sum_f32", "segment_sum_i64",
+           "pallas_enabled", "set_pallas_enabled", "xla_segment_sum",
+           "force_platform"]
 
 _TILE = 1024
 _MAX_PALLAS_G = 8192  # above this the [TILE, G] one-hot exceeds VMEM budget
@@ -159,6 +160,85 @@ def segment_sum_f32(vals: jax.Array, seg: jax.Array, G: int) -> jax.Array:
     if not pallas_enabled() or G > _MAX_PALLAS_G:
         return xla_segment_sum(vals.astype(jnp.float32), seg, G)
     return _pallas_segsum_f32(vals, seg, G, _gp(G))
+
+
+_N_LIMBS = 8
+_LIMB_BITS = 8
+
+
+@functools.partial(jax.jit, static_argnames=("G", "Gp"))
+def _pallas_segsum_i64(vals: jax.Array, seg: jax.Array, G: int, Gp: int) -> jax.Array:
+    """EXACT int64 (decimal) segment sum on the Pallas path.
+
+    The value splits into 8 unsigned byte limbs OUTSIDE the kernel (the
+    kernel traces with x64 off — Mosaic cannot legalize i64); each limb
+    accumulates in int32 on the VPU against the shared one-hot, and the
+    limb sums recombine in uint64 with natural wraparound — exact for
+    any int64 inputs because two's-complement addition is mod 2^64.
+    Exactness bound: per-limb sums must fit int32, i.e. 255 * R < 2^31
+    (R < 2^23 rows), enforced by the dispatcher."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from jax._src.config import enable_x64
+
+    u = jax.lax.bitcast_convert_type(vals.astype(jnp.int64), jnp.uint64)
+    limbs = [
+        ((u >> jnp.uint64(_LIMB_BITS * j)) & jnp.uint64(0xFF)).astype(jnp.int32)
+        for j in range(_N_LIMBS)
+    ]
+    limbs2 = jnp.stack([_pad_tile(l, 0) for l in limbs], axis=1)
+    # [n_tiles, 8 limbs, 8 sub, 128 lanes]
+    seg2 = _pad_tile(seg.astype(jnp.int32), Gp)
+    n_tiles = seg2.shape[0]
+
+    def kernel(limbs_ref, seg_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        s = seg_ref[0]  # [8, 128] int32
+        gid = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANES, Gp), 2)
+        onehot = (s[:, :, None] == gid).astype(jnp.int32)
+        for j in range(_N_LIMBS):
+            v = limbs_ref[0, j]  # [8, 128] int32
+            part = jnp.sum(v[:, :, None] * onehot, axis=(0, 1))  # [Gp]
+            out_ref[j, :] = out_ref[j, :] + part
+
+    with enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((_N_LIMBS, Gp), jnp.int32),
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((1, _N_LIMBS, _SUB, _LANES),
+                             lambda i: (i, 0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, _SUB, _LANES), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((_N_LIMBS, Gp), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=_target_platform() != "tpu",
+        )(limbs2, seg2)
+    # recombine: limb sums (int32, exact) widen to uint64, shift, add —
+    # wraparound is exactly int64 addition's
+    acc = jnp.zeros(Gp, dtype=jnp.uint64)
+    for j in range(_N_LIMBS):
+        acc = acc + (out[j].astype(jnp.uint64) << jnp.uint64(_LIMB_BITS * j))
+    return jax.lax.bitcast_convert_type(acc, jnp.int64)[:G]
+
+
+def segment_sum_i64(vals: jax.Array, seg: jax.Array, G: int) -> jax.Array:
+    """Exact int64/decimal segment sum; Pallas limb kernel on TPU, XLA
+    scatter elsewhere. Covers Q1's decimal sum_qty/sum_base_price/
+    sum_disc_price/sum_charge accumulators."""
+    if (not pallas_enabled() or G > _MAX_PALLAS_G
+            or vals.shape[0] >= (1 << 23)):  # 255 * R < 2^31 limb bound
+        return xla_segment_sum(vals.astype(jnp.int64), seg, G)
+    return _pallas_segsum_i64(vals, seg, G, _gp(G))
 
 
 def segment_count(mask: jax.Array, seg: jax.Array, G: int) -> jax.Array:
