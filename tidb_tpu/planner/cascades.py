@@ -13,7 +13,10 @@ tidb_enable_cascades_planner (the reference's sysvar of the same name);
 falls back to greedy beyond MAX_LEAVES (memo size is exponential).
 
 Cost model: shared with the greedy orderer (statistics-driven row
-estimates; cost = sum of intermediate result cardinalities)."""
+estimates; cost = sum over join steps of output cardinality + the
+exchange volume the mesh executor would pay — hash-shuffle of both
+sides vs broadcast of the smaller side, whichever is cheaper; see
+rules._join_step_cost)."""
 
 from __future__ import annotations
 
@@ -66,7 +69,8 @@ def _splits(mask: int):
         sub = (sub - 1) & mask
 
 def memo_join_search(leaves: List[LogicalPlan], eqs, others,
-                     classify_edges, conj_join, pushdown_rule):
+                     classify_edges, conj_join, pushdown_rule,
+                     n_parts: int = 1):
     """Exhaustive join-order search over the memo. Returns the best
     plan, or None when the search doesn't apply (too many leaves).
 
@@ -113,7 +117,10 @@ def memo_join_search(leaves: List[LogicalPlan], eqs, others,
                         g1.plan, g2.plan, conds, g1.rows, g2.rows))
                 else:
                     rows = g1.rows * g2.rows
-                cost = g1.cost + g2.cost + rows
+                from tidb_tpu.planner.rules import _join_step_cost
+
+                cost = (g1.cost + g2.cost
+                        + _join_step_cost(g1.rows, g2.rows, rows, n_parts))
                 cur = memo.best(mask)
                 if cur is not None and cost >= cur.cost:
                     continue

@@ -20,6 +20,7 @@ def plan_statement(
     db: str = "test",
     execute_subplan: Optional[Callable] = None,
     cascades: bool = False,
+    n_parts: int = 1,
 ) -> PhysicalPlan:
     """SELECT/UNION AST -> optimized physical plan."""
     assert isinstance(stmt, (A.SelectStmt, A.UnionStmt)), type(stmt)
@@ -28,5 +29,5 @@ def plan_statement(
     )
     logical = build_select(stmt, ctx)
     logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or (),
-                               cascades=cascades)
+                               cascades=cascades, n_parts=n_parts)
     return inject_point_get(lower(logical))

@@ -444,7 +444,25 @@ def _classify_edges(leaves, eqs, others):
     return edges, leftover
 
 
-def _greedy_order(leaves, eqs, others) -> LogicalPlan:
+def _join_step_cost(l_rows: float, r_rows: float, out_rows: float,
+                    n_parts: int) -> float:
+    """Mesh-aware cost of one join step: output cardinality plus the
+    exchange volume the executor will pay. A hash shuffle repartitions
+    BOTH sides over ICI (l + r rows); broadcasting the smaller side
+    replicates it to every shard (small * n_parts) and skips the
+    repartition — charge whichever the executor would pick (ref:
+    planner/core's cop/mpp cost factors for exchange types)."""
+    from tidb_tpu.parallel.fragment import BROADCAST_LIMIT
+
+    shuffle = l_rows + r_rows
+    small = min(l_rows, r_rows)
+    exch = shuffle
+    if small <= BROADCAST_LIMIT:
+        exch = min(exch, small * n_parts)
+    return out_rows + exch
+
+
+def _greedy_order(leaves, eqs, others, n_parts: int = 1) -> LogicalPlan:
     from tidb_tpu.planner.physical import _estimate, eq_join_rows
 
     n = len(leaves)
@@ -471,10 +489,14 @@ def _greedy_order(leaves, eqs, others) -> LogicalPlan:
                 return cur_rows * est[c]  # forced cross join
             return eq_join_rows(tree, leaves[c], conds, cur_rows, est[c])
 
+        def step_cost(c, conds):
+            return _join_step_cost(cur_rows, est[c], join_rows(c, conds),
+                                   n_parts)
+
         cands = [(c, conn_edges(c)) for c in remaining]
         connected = [(c, e) for c, e in cands if e]
         pool = connected or cands  # avoid cross joins whenever possible
-        best, conds = min(pool, key=lambda ce: join_rows(*ce))
+        best, conds = min(pool, key=lambda ce: step_cost(*ce))
         cur_rows = join_rows(best, conds)
         tree = LJoin(
             schema=list(tree.schema) + list(leaves[best].schema),
@@ -544,7 +566,8 @@ def _match_leading(leaves, leading):
         by_name[n.lower()] for n in leading if n.lower() in by_name))
 
 
-def _rule_reorder(plan: LogicalPlan, leading=None, cascades=False) -> LogicalPlan:
+def _rule_reorder(plan: LogicalPlan, leading=None, cascades=False,
+                  n_parts: int = 1) -> LogicalPlan:
     if getattr(plan, "_block_boundary", False):
         leading = None  # hints don't cross into derived query blocks
     if isinstance(plan, LJoin) and plan.kind in ("inner", "cross"):
@@ -556,19 +579,23 @@ def _rule_reorder(plan: LogicalPlan, leading=None, cascades=False) -> LogicalPla
         if leading and len(leaves) >= 2 and _match_leading(leaves, leading):
             # the hint pins THIS block's order; subtrees keep the
             # session's planner mode
-            leaves = [_rule_reorder(l, cascades=cascades) for l in leaves]
+            leaves = [_rule_reorder(l, cascades=cascades, n_parts=n_parts)
+                      for l in leaves]
             return _forced_order(leaves, eqs, others, leading)
         if len(leaves) > 2:
-            leaves = [_rule_reorder(l, cascades=cascades) for l in leaves]
+            leaves = [_rule_reorder(l, cascades=cascades, n_parts=n_parts)
+                      for l in leaves]
             if cascades:
                 from tidb_tpu.planner.cascades import memo_join_search
 
                 best = memo_join_search(leaves, eqs, others, _classify_edges,
-                                        _conj_join, _rule_pushdown)
+                                        _conj_join, _rule_pushdown,
+                                        n_parts=n_parts)
                 if best is not None:
                     return best
-            return _greedy_order(leaves, eqs, others)
-    plan.children = [_rule_reorder(c, leading, cascades) for c in plan.children]
+            return _greedy_order(leaves, eqs, others, n_parts=n_parts)
+    plan.children = [_rule_reorder(c, leading, cascades, n_parts)
+                     for c in plan.children]
     return plan
 
 
@@ -652,11 +679,12 @@ def _rule_distinct_two_phase(plan: LogicalPlan) -> LogicalPlan:
     )
 
 
-def optimize_logical(plan: LogicalPlan, hints=(), cascades=False) -> LogicalPlan:
+def optimize_logical(plan: LogicalPlan, hints=(), cascades=False,
+                     n_parts: int = 1) -> LogicalPlan:
     plan = _rule_distinct_two_phase(plan)
     plan = _rule_fold(plan)
     plan = _rule_pushdown(plan)
     leading = next((args for name, args in hints if name == "leading"), None)
-    plan = _rule_reorder(plan, leading, cascades)
+    plan = _rule_reorder(plan, leading, cascades, n_parts)
     plan = _rule_prune(plan, None)
     return plan

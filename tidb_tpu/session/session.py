@@ -296,9 +296,13 @@ class Session:
         return rs.rows
 
     def _plan_select(self, stmt):
+        n_parts = 1
+        if self.mesh is not None:
+            n_parts = int(np.prod(list(self.mesh.shape.values())))
         return plan_statement(
             stmt, self.catalog, db=self.db, execute_subplan=self._execute_subplan,
             cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
+            n_parts=n_parts,
         )
 
     def _apply_binding(self, stmt):
