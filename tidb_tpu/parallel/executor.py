@@ -258,16 +258,43 @@ class DistJoinAggExec(HashAggExec):
                          plan.aggs, "segment",
                          segment_sizes=getattr(plan, "segment_sizes", None))
         self.children = []
+        self._plan = plan
+        self._delegate = None
         self._join = join
         self._probe_scan, self._probe_stages = probe_scan, probe_stages
         self._build_scan, self._build_stages = build_scan, build_stages
         self._post_stages = post_stages
         self._cache = cache
 
+    def next(self):
+        if self._delegate is not None:
+            return self._delegate.next()
+        return super().next()
+
+    def close(self):
+        if self._delegate is not None:
+            self._delegate.close()
+            self._delegate = None
+        super().close()
+
     def _run_segment(self):
+        from tidb_tpu.parallel.partition import table_bytes
+
         sizes = self.segment_sizes or []
         domains = [s + 1 for s in sizes]
         join = self._join
+        if max(table_bytes(self._probe_scan.table),
+               table_bytes(self._build_scan.table)) > self.ctx.device_cache_bytes:
+            # >HBM side: the general fragment path streams it in fixed
+            # [P, R] batches; this resident fast path cannot
+            mesh = self._cache.mesh
+            prog = compile_fragment(
+                self._plan, mesh, int(np.prod(list(mesh.shape.values()))))
+            if prog is not None:
+                d = DistFragmentExec(self._plan, prog, self._cache)
+                d.open(self.ctx)
+                self._delegate = d
+                return
         probe_idx = 1 - join.build_side
         probe_keys = join.eq_left if probe_idx == 0 else join.eq_right
         build_keys = join.eq_right if join.build_side == 1 else join.eq_left
@@ -399,10 +426,37 @@ class DistFragmentExec(HashAggExec):
         sel[:n] = True
         return data, valid, sel, n
 
+    def _pick_stream_source(self, prog):
+        """Index of the source to stream, or None. A table above the
+        device-cache budget streams in fixed [P, R] batches IF it
+        appears exactly once among the fragment's sources — a self-join
+        of a streamed table would pair only same-batch rows. Running
+        the fragment per batch is otherwise sound: probe rows partition
+        across batches (each contributes once), build/broadcast sides
+        are identical every batch, and the agg outputs merge (segment:
+        state merge; generic: per-part table merge)."""
+        from tidb_tpu.parallel.partition import table_bytes
+
+        best, best_bytes = None, 0
+        for i, src in enumerate(prog.sources):
+            b = table_bytes(src.scan.table)
+            if b > self.ctx.device_cache_bytes and b > best_bytes:
+                best, best_bytes = i, b
+        if best is None:
+            return None
+        t = prog.sources[best].scan.table
+        if sum(1 for s in prog.sources if s.scan.table is t) != 1:
+            return None  # self-join of the big table: no streaming
+        return best
+
     def _run_fragment(self):
         import jax
 
         prog = self._prog
+        stream_idx = self._pick_stream_source(prog)
+        if stream_idx is not None:
+            self._run_fragment_streaming(prog, stream_idx)
+            return
         args, sts = [], []
         for src in prog.sources:
             st = self._cache.get(src.scan.table)
@@ -423,6 +477,26 @@ class DistFragmentExec(HashAggExec):
         shapes_sig = (tuple((st.n_parts, st.rows_per_part) for st in sts),
                       tuple(bcast_shapes))
         types_sig = tuple(_types_sig(st) for st in sts)
+        out, growths = self._dispatch_retry(prog, args, shapes_sig,
+                                            types_sig, growths)
+        if out is None:
+            self._fall_back_single_chip()
+            return
+        touch(self._cache.growth, gkey, growths, ShardCache.MAX_FRAGMENTS)
+        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
+
+        FRAGMENT_DISPATCH.inc(kind=f"general_{prog.out_kind}")
+
+        if prog.out_kind == "segment":
+            self._finalize_segment_state(out, prog.domains)
+        else:
+            self._finalize_generic_tables(out)
+
+    def _dispatch_retry(self, prog, args, shapes_sig, types_sig, growths):
+        """Run the fragment, growing only blown capacity knobs: "exch"
+        knobs double; "expand"/"compact" jump to the reported required
+        factor in one recompile (skewed joins can demand 100x+ at once).
+        Returns (out, growths) or (None, growths) past the ceilings."""
         while True:
             key = ("frag", prog.sig, growths, shapes_sig, types_sig)
             fn = self._cache.get_fragment(
@@ -430,11 +504,7 @@ class DistFragmentExec(HashAggExec):
             out, ovf = fn(*args)
             ovf = np.asarray(ovf)
             if not (ovf > 0).any():
-                break
-            # grow only the blown capacities; re-runs with proven growths
-            # start from the cached vector next time. "exch" knobs double;
-            # "expand" knobs jump to the reported required factor in one
-            # recompile (skewed joins can demand 100x+ at once)
+                return out, growths
             new = []
             for g, o, kind in zip(growths, ovf, prog.growth_kinds):
                 if o <= 0:
@@ -450,17 +520,118 @@ class DistFragmentExec(HashAggExec):
             growths = tuple(new)
             if any(g > self.MAX_GROWTH[k]
                    for g, k in zip(growths, prog.growth_kinds)):
-                self._fall_back_single_chip()
-                return
-        touch(self._cache.growth, gkey, growths, ShardCache.MAX_FRAGMENTS)
+                return None, growths
+
+    def _run_fragment_streaming(self, prog, stream_idx):
+        """>HBM sources: stream the oversized table through the compiled
+        fragment in fixed [P, R] batches against resident build sides
+        (ref: SURVEY.md:315 hard-part 6 generalized beyond scan-agg;
+        VERDICT round-2 item 4). Segment states merge on device across
+        batches; generic group tables merge per-part on host (parts stay
+        disjoint — the exchange routing is identical every batch)."""
+        import jax
+
+        from tidb_tpu.executor.agg_device import table_to_host_partial
+        from tidb_tpu.executor.aggregate import merge_op_for
+        from tidb_tpu.parallel.partition import stream_batches
         from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
 
-        FRAGMENT_DISPATCH.inc(kind=f"general_{prog.out_kind}")
+        mesh = self._cache.mesh
+        src = prog.sources[stream_idx]
+        table = src.scan.table
+        self._cache.evict(table)  # its full sharding must not stay resident
+        scan_cols = [c.name for c in src.scan.schema]
+        n_parts = int(np.prod(list(mesh.shape.values())))
+        bytes_per_row = sum(
+            table.data[n].dtype.itemsize + 1 for n in scan_cols) + 1
+        rows_per_part = max(4096, int(
+            self.ctx.device_cache_bytes // (4 * n_parts * bytes_per_row)))
+
+        sts = {}
+        for i, s2 in enumerate(prog.sources):
+            if i != stream_idx:
+                sts[i] = self._cache.get(s2.scan.table)
+        bcast_args, bcast_shapes = [], []
+        for bc in prog.broadcasts:
+            data, valid, sel, n = self._materialize_broadcast(bc)
+            if n > BROADCAST_LIMIT:
+                raise ExecutionError(
+                    f"broadcast side too large ({n} rows); "
+                    "disable tidb_enable_tpu_exec for this query")
+            bcast_args += [data, valid, sel]
+            bcast_shapes.append(len(sel))
+
+        gkey = ((prog.sig, "stream", rows_per_part)
+                + tuple(sts[i].serial for i in sorted(sts)))
+        growths = self._cache.growth.get(gkey) or prog.growth_defaults
+        types_fixed = tuple(_types_sig(sts[i]) for i in sorted(sts))
+
+        seg_state = None
+        gen_parts = None  # part index -> [host partial dicts]
+        nk = len(self.group_exprs)
+        for batch in stream_batches(table, mesh, scan_cols, rows_per_part):
+            args = []
+            shapes = []
+            for i in range(len(prog.sources)):
+                st = batch if i == stream_idx else sts[i]
+                args += [st.data, st.valid, st.sel]
+                shapes.append((st.n_parts, st.rows_per_part))
+            args += bcast_args
+            shapes_sig = (tuple(shapes), tuple(bcast_shapes))
+            types_sig = types_fixed + (_types_sig(batch), "stream")
+            out, growths = self._dispatch_retry(prog, args, shapes_sig,
+                                                types_sig, growths)
+            if out is None:
+                self._fall_back_single_chip()
+                return
+            FRAGMENT_DISPATCH.inc(kind=f"general_{prog.out_kind}_stream")
+            if prog.out_kind == "segment":
+                if seg_state is None:
+                    seg_state = out
+                else:
+                    merged = {}
+                    for k, v in seg_state.items():
+                        op = merge_op_for(k)
+                        if op == "sum":
+                            merged[k] = v + out[k]
+                        elif op == "min":
+                            merged[k] = jnp.minimum(v, out[k])
+                        else:
+                            merged[k] = jnp.maximum(v, out[k])
+                    seg_state = merged
+            else:
+                host = jax.device_get(out)
+                n_per = np.asarray(host["n"]).reshape(-1)
+                if gen_parts is None:
+                    gen_parts = [[] for _ in range(len(n_per))]
+                for pi in range(len(n_per)):
+                    if n_per[pi] == 0:
+                        continue
+                    t = {"n": n_per[pi]}
+                    for name, arr in host.items():
+                        if name == "n":
+                            continue
+                        S = len(arr) // len(n_per)
+                        t[name] = arr[pi * S:(pi + 1) * S]
+                    gen_parts[pi].append(
+                        table_to_host_partial(t, nk, self.aggs))
+        touch(self._cache.growth, gkey, growths, ShardCache.MAX_FRAGMENTS)
 
         if prog.out_kind == "segment":
-            self._finalize_segment_state(out, prog.domains)
-        else:
-            self._finalize_generic_tables(out)
+            self._finalize_segment_state(seg_state, prog.domains)
+            return
+        cap = self.ctx.chunk_capacity
+        emitted = False
+        for partials in (gen_parts or []):
+            if not partials:
+                continue
+            # same key appears across batches of one part: exact merge
+            merged = (partials[0] if len(partials) == 1
+                      else self._merge_partials(partials))
+            self._emit_merged(merged, cap)
+            emitted = True
+        if not emitted:
+            self._out = []
 
     def _finalize_generic_tables(self, out):
         """Fetch the sharded per-part group tables (one device_get) and
