@@ -133,3 +133,32 @@ class TestFragmentStreaming:
         after = FRAGMENT_DISPATCH.value(kind="general_segment_stream")
         assert after > before, "expected the streaming fragment path"
         assert got == want
+
+
+def test_build_side_of_anti_join_never_streams(devices8):
+    """Streaming the build side of a NOT IN would re-decide matches per
+    batch (review finding): such sources are pinned resident and results
+    stay exact even when the build table exceeds the budget."""
+    import numpy as np
+
+    from tidb_tpu.parallel import make_mesh
+    from tidb_tpu.session import Session
+
+    s = Session(chunk_capacity=1 << 13, mesh=make_mesh(devices=devices8))
+    s.execute("set tidb_device_engine_mode = 'force'")
+    s.execute("create table small (k bigint)")
+    s.execute("create table big (k bigint, pad1 bigint, pad2 bigint)")
+    sm = s.catalog.table("test", "small")
+    sm.insert_columns({"k": np.arange(100, dtype=np.int64)})
+    bg = s.catalog.table("test", "big")
+    n = 50_000
+    # big holds only even keys < 100 (and lots of padding bytes)
+    bg.insert_columns({"k": (np.arange(n) % 50 * 2).astype(np.int64),
+                       "pad1": np.zeros(n, dtype=np.int64),
+                       "pad2": np.zeros(n, dtype=np.int64)})
+    sql = "select count(*) from small where k not in (select k from big)"
+    want = s.query(sql)
+    assert want == [(50,)], want  # odd keys survive
+    s.execute("set tidb_device_cache_bytes = 1048576")
+    got = s.query(sql)
+    assert got == want, got

@@ -117,6 +117,30 @@ class ShardCache:
         touch(self.growth, gkey, growth, self.MAX_FRAGMENTS)
 
 
+def _segment_state_combine(sig):
+    """Jitted elementwise merge of two segment-state dicts (sum/min/max
+    per key via merge_op_for) — shared by every streaming path."""
+    from tidb_tpu.executor.aggregate import merge_op_for
+    from tidb_tpu.utils.jitcache import cached_jit
+
+    def build():
+        def combine(s1, s2):
+            out = {}
+            for k, v in s1.items():
+                op = merge_op_for(k)
+                if op == "sum":
+                    out[k] = v + s2[k]
+                elif op == "min":
+                    out[k] = jnp.minimum(v, s2[k])
+                else:
+                    out[k] = jnp.maximum(v, s2[k])
+            return out
+
+        return combine
+
+    return cached_jit("aggcombine", repr(sig), build)
+
+
 def _types_sig(st: ShardedTable) -> str:
     """Schema signature of a sharding: the compiled fragments close over
     st.types (column name -> SQLType), so the cache key must distinguish
@@ -207,28 +231,13 @@ class DistAggExec(HashAggExec):
         [G] states on device; one fetch at the end. jax's async dispatch
         overlaps batch k's compute with batch k+1's host staging (the
         IndexLookUp double-pipeline analogue)."""
-        from tidb_tpu.executor.aggregate import merge_op_for
         from tidb_tpu.parallel.partition import stream_batches
-        from tidb_tpu.utils.jitcache import cached_jit
         from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
 
         table = self._scan.table
         mesh = self._cache.mesh
         sig = repr((self._stages, self.group_exprs, self.aggs, domains))
-
-        def combine(s1, s2):
-            out = {}
-            for k, v in s1.items():
-                op = merge_op_for(k)
-                if op == "sum":
-                    out[k] = v + s2[k]
-                elif op == "min":
-                    out[k] = jnp.minimum(v, s2[k])
-                else:
-                    out[k] = jnp.maximum(v, s2[k])
-            return out
-
-        combine = cached_jit("aggcombine", sig, lambda: combine)
+        combine = _segment_state_combine(sig)
         state = None
         fn = None
         for st in stream_batches(table, mesh, scan_cols,
@@ -292,9 +301,13 @@ class DistJoinAggExec(HashAggExec):
                 self._plan, mesh, int(np.prod(list(mesh.shape.values()))))
             if prog is not None:
                 d = DistFragmentExec(self._plan, prog, self._cache)
-                d.open(self.ctx)
-                self._delegate = d
-                return
+            else:
+                # never shard an over-budget table resident: the host
+                # executors stream chunk-wise within the budget
+                d = build_executor(self._plan)
+            d.open(self.ctx)
+            self._delegate = d
+            return
         probe_idx = 1 - join.build_side
         probe_keys = join.eq_left if probe_idx == 0 else join.eq_right
         build_keys = join.eq_right if join.build_side == 1 else join.eq_left
@@ -386,6 +399,36 @@ class DistFragmentExec(HashAggExec):
 
     # ------------------------------------------------------------------
 
+    def _gather_broadcasts(self, prog):
+        """Materialize every broadcast subtree; returns (args, shapes)."""
+        args, shapes = [], []
+        for bc in prog.broadcasts:
+            data, valid, sel, n = self._materialize_broadcast(bc)
+            if n > BROADCAST_LIMIT:
+                raise ExecutionError(
+                    f"broadcast side too large ({n} rows); "
+                    "disable tidb_enable_tpu_exec for this query")
+            args += [data, valid, sel]
+            shapes.append(len(sel))
+        return args, shapes
+
+    @staticmethod
+    def _iter_host_parts(host):
+        """Split a fetched [n_parts * S] group-table dict into per-part
+        tables; yields (part_index, table_dict) for non-empty parts."""
+        n_per = np.asarray(host["n"]).reshape(-1)
+        n_parts = len(n_per)
+        for p in range(n_parts):
+            if n_per[p] == 0:
+                continue
+            t = {"n": n_per[p]}
+            for name, arr in host.items():
+                if name == "n":
+                    continue
+                S = len(arr) // n_parts
+                t[name] = arr[p * S:(p + 1) * S]
+            yield p, t
+
     def _materialize_broadcast(self, bc):
         """Run a non-scan subtree and return replicated (data, valid, sel)
         arrays — the broadcast exchange input. The subtree itself runs
@@ -439,6 +482,8 @@ class DistFragmentExec(HashAggExec):
 
         best, best_bytes = None, 0
         for i, src in enumerate(prog.sources):
+            if i in prog.stream_unsafe:
+                continue
             b = table_bytes(src.scan.table)
             if b > self.ctx.device_cache_bytes and b > best_bytes:
                 best, best_bytes = i, b
@@ -462,15 +507,8 @@ class DistFragmentExec(HashAggExec):
             st = self._cache.get(src.scan.table)
             args += [st.data, st.valid, st.sel]
             sts.append(st)
-        bcast_shapes = []
-        for bc in prog.broadcasts:
-            data, valid, sel, n = self._materialize_broadcast(bc)
-            if n > BROADCAST_LIMIT:
-                raise ExecutionError(
-                    f"broadcast side too large ({n} rows); "
-                    "disable tidb_enable_tpu_exec for this query")
-            args += [data, valid, sel]
-            bcast_shapes.append(len(sel))
+        bcast_args, bcast_shapes = self._gather_broadcasts(prog)
+        args += bcast_args
 
         gkey = (prog.sig,) + tuple(st.serial for st in sts)
         growths = self._cache.growth.get(gkey) or prog.growth_defaults
@@ -551,15 +589,7 @@ class DistFragmentExec(HashAggExec):
         for i, s2 in enumerate(prog.sources):
             if i != stream_idx:
                 sts[i] = self._cache.get(s2.scan.table)
-        bcast_args, bcast_shapes = [], []
-        for bc in prog.broadcasts:
-            data, valid, sel, n = self._materialize_broadcast(bc)
-            if n > BROADCAST_LIMIT:
-                raise ExecutionError(
-                    f"broadcast side too large ({n} rows); "
-                    "disable tidb_enable_tpu_exec for this query")
-            bcast_args += [data, valid, sel]
-            bcast_shapes.append(len(sel))
+        bcast_args, bcast_shapes = self._gather_broadcasts(prog)
 
         gkey = ((prog.sig, "stream", rows_per_part)
                 + tuple(sts[i].serial for i in sorted(sts)))
@@ -589,30 +619,14 @@ class DistFragmentExec(HashAggExec):
                 if seg_state is None:
                     seg_state = out
                 else:
-                    merged = {}
-                    for k, v in seg_state.items():
-                        op = merge_op_for(k)
-                        if op == "sum":
-                            merged[k] = v + out[k]
-                        elif op == "min":
-                            merged[k] = jnp.minimum(v, out[k])
-                        else:
-                            merged[k] = jnp.maximum(v, out[k])
-                    seg_state = merged
+                    seg_state = _segment_state_combine(prog.sig)(
+                        seg_state, out)
             else:
                 host = jax.device_get(out)
-                n_per = np.asarray(host["n"]).reshape(-1)
                 if gen_parts is None:
-                    gen_parts = [[] for _ in range(len(n_per))]
-                for pi in range(len(n_per)):
-                    if n_per[pi] == 0:
-                        continue
-                    t = {"n": n_per[pi]}
-                    for name, arr in host.items():
-                        if name == "n":
-                            continue
-                        S = len(arr) // len(n_per)
-                        t[name] = arr[pi * S:(pi + 1) * S]
+                    n_parts_out = len(np.asarray(host["n"]).reshape(-1))
+                    gen_parts = [[] for _ in range(n_parts_out)]
+                for pi, t in self._iter_host_parts(host):
                     gen_parts[pi].append(
                         table_to_host_partial(t, nk, self.aggs))
         touch(self._cache.growth, gkey, growths, ShardCache.MAX_FRAGMENTS)
@@ -646,20 +660,10 @@ class DistFragmentExec(HashAggExec):
         from tidb_tpu.executor.agg_device import table_to_host_partial
 
         host = jax.device_get(out)
-        n_per = np.asarray(host["n"]).reshape(-1)
-        n_parts = len(n_per)
         nk = len(self.group_exprs)
         cap = self.ctx.chunk_capacity
         emitted = False
-        for p in range(n_parts):
-            if n_per[p] == 0:
-                continue
-            t = {"n": n_per[p]}
-            for name, arr in host.items():
-                if name == "n":
-                    continue
-                S = len(arr) // n_parts
-                t[name] = arr[p * S:(p + 1) * S]
+        for _p, t in self._iter_host_parts(host):
             # linear conversion + emission, one part at a time
             self._emit_merged(table_to_host_partial(t, nk, self.aggs), cap)
             emitted = True

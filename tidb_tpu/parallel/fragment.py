@@ -143,6 +143,10 @@ class FragmentProgram:
     domains: List[int] = field(default_factory=list)
     growth_defaults: Tuple[float, ...] = ()
     growth_kinds: Tuple[str, ...] = ()
+    # source indexes that must NOT be streamed in batches: they sit on
+    # the build side of a non-inner join, where partitioning the build
+    # set changes per-probe-row match decisions (semi/anti/left)
+    stream_unsafe: frozenset = frozenset()
 
 
 class _Unsupported(Exception):
@@ -164,6 +168,7 @@ class _Compiler:
         self.growth_defaults: List[float] = []
         self.growth_kinds: List[str] = []
         self.sig: List[str] = []
+        self.stream_unsafe: set = set()
 
     def _add_growth(self, default: float, kind: str) -> int:
         idx = self.n_growth
@@ -268,7 +273,13 @@ class _Compiler:
             raise _Unsupported("broadcast probe side")
 
         probe_emit = self.producer(probe_plan)
+        n_before_build = len(self.sources)
         build_emit = self.producer(build_plan)
+        if join.kind != "inner":
+            # a batched build side would re-decide semi/anti/left matches
+            # per batch: every source under it is pinned resident
+            self.stream_unsafe.update(
+                range(n_before_build, len(self.sources)))
 
         exchange = not build_is_bcast
         g_exch = self._add_growth(2.0, "exch") if exchange else None
@@ -602,4 +613,5 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int) -> Optional[FragmentProg
         out_kind=out_kind, domains=domains,
         growth_defaults=tuple(c.growth_defaults),
         growth_kinds=tuple(c.growth_kinds),
+        stream_unsafe=frozenset(c.stream_unsafe),
     )
