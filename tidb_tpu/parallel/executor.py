@@ -347,6 +347,11 @@ class DistJoinAggExec(HashAggExec):
         self._finalize_segment_state(state, domains)
 
 
+class _BroadcastTooLarge(Exception):
+    def __init__(self, rows):
+        super().__init__(f"broadcast side too large ({rows} rows)")
+
+
 class DistFragmentExec(HashAggExec):
     """Agg root over a general compiled fragment (parallel/fragment.py):
     join trees, broadcast build sides, segment or generic aggregation —
@@ -400,14 +405,16 @@ class DistFragmentExec(HashAggExec):
     # ------------------------------------------------------------------
 
     def _gather_broadcasts(self, prog):
-        """Materialize every broadcast subtree; returns (args, shapes)."""
+        """Materialize every broadcast subtree; returns (args, shapes).
+        A subtree too large to replicate raises _BroadcastTooLarge; the
+        fragment runners catch it and fall back to single-chip execution
+        like every other unsupported shape (round-2 review weak #6 — it
+        used to be a hard error telling the user to flip a sysvar)."""
         args, shapes = [], []
         for bc in prog.broadcasts:
             data, valid, sel, n = self._materialize_broadcast(bc)
             if n > BROADCAST_LIMIT:
-                raise ExecutionError(
-                    f"broadcast side too large ({n} rows); "
-                    "disable tidb_enable_tpu_exec for this query")
+                raise _BroadcastTooLarge(n)
             args += [data, valid, sel]
             shapes.append(len(sel))
         return args, shapes
@@ -507,7 +514,11 @@ class DistFragmentExec(HashAggExec):
             st = self._cache.get(src.scan.table)
             args += [st.data, st.valid, st.sel]
             sts.append(st)
-        bcast_args, bcast_shapes = self._gather_broadcasts(prog)
+        try:
+            bcast_args, bcast_shapes = self._gather_broadcasts(prog)
+        except _BroadcastTooLarge:
+            self._fall_back_single_chip()
+            return
         args += bcast_args
 
         gkey = (prog.sig,) + tuple(st.serial for st in sts)
@@ -589,7 +600,11 @@ class DistFragmentExec(HashAggExec):
         for i, s2 in enumerate(prog.sources):
             if i != stream_idx:
                 sts[i] = self._cache.get(s2.scan.table)
-        bcast_args, bcast_shapes = self._gather_broadcasts(prog)
+        try:
+            bcast_args, bcast_shapes = self._gather_broadcasts(prog)
+        except _BroadcastTooLarge:
+            self._fall_back_single_chip()
+            return
 
         gkey = ((prog.sig, "stream", rows_per_part)
                 + tuple(sts[i].serial for i in sorted(sts)))

@@ -118,6 +118,10 @@ class Session:
         TXN_TOTAL.inc(outcome="commit")
         if txn.logs and self.sysvars.get("tidb_gc_enable"):
             self.catalog.auto_gc([t for t, _ in txn.logs.values()])
+        if txn.logs and self.sysvars.get("tidb_enable_auto_analyze"):
+            self.catalog.maybe_auto_analyze(
+                [t for t, _ in txn.logs.values()],
+                ratio=float(self.sysvars.get("tidb_auto_analyze_ratio")))
 
     def _rollback(self) -> None:
         txn, self.txn = self.txn, None
@@ -551,7 +555,9 @@ class Session:
             from tidb_tpu.statistics import analyze_table
 
             for tn in stmt.tables:
-                analyze_table(self.catalog.table(tn.schema or self.db, tn.name))
+                t = self.catalog.table(tn.schema or self.db, tn.name)
+                analyze_table(t)
+                t.modify_count = 0
             return None
         if isinstance(stmt, A.CreateIndexStmt):
             t = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)

@@ -24,7 +24,7 @@ class SysVar:
     name: str
     default: object
     scope: str = BOTH
-    kind: str = "str"  # bool | int | str | enum
+    kind: str = "str"  # bool | int | float | str | enum
     min_: Optional[int] = None
     max_: Optional[int] = None
     enum_values: Optional[tuple] = None  # kind == "enum": allowed (lowercase)
@@ -55,6 +55,10 @@ _reg(
     # and the sysvar of the same name); greedy ordering otherwise
     SysVar("tidb_enable_cascades_planner", False, BOTH, "bool"),
     SysVar("tidb_gc_enable", True, BOTH, "bool"),
+    # stats lifecycle (ref: statistics auto-analyze): after DML commits,
+    # re-ANALYZE a table whose modified-row count crossed ratio * rows
+    SysVar("tidb_enable_auto_analyze", True, BOTH, "bool"),
+    SysVar("tidb_auto_analyze_ratio", 0.5, BOTH, "float"),
     # statements slower than this (ms) go to the slow-query log
     SysVar("tidb_slow_log_threshold", 300, BOTH, "int", min_=0, max_=1 << 31),
     # non-empty: wrap query execution in jax.profiler.trace(dir)
@@ -106,6 +110,12 @@ def canonical(var: SysVar, value) -> object:
         if var.max_ is not None and n > var.max_:
             n = var.max_
         return n
+    if var.kind == "float":
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            raise ExecutionError(
+                f"invalid float value {value!r} for {var.name}")
     if var.kind == "enum":
         s = str(value).strip().lower()
         if s not in (var.enum_values or ()):

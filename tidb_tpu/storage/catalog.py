@@ -232,6 +232,30 @@ class Catalog:
             GC_RECLAIMED.inc(sum(out.values()))
         return out
 
+    def maybe_auto_analyze(self, tables, ratio: float = 0.5,
+                           min_rows: int = 1024) -> int:
+        """Stats lifecycle (ref: statistics auto-analyze): re-collect a
+        touched table's statistics when the rows modified since the last
+        ANALYZE cross ratio * analyzed row count (or the table has grown
+        past min_rows with no stats at all). Runs inline after commit —
+        the single-process analogue of the reference's stats-owner
+        background worker. Returns how many tables were analyzed."""
+        from tidb_tpu.statistics import analyze_table
+
+        done = 0
+        for t in tables:
+            mc = getattr(t, "modify_count", 0)
+            stats = getattr(t, "stats", None)
+            if stats is None:
+                if t.n < min_rows or mc == 0:
+                    continue
+            elif mc < ratio * max(stats.n_rows, min_rows):
+                continue
+            analyze_table(t)
+            t.modify_count = 0
+            done += 1
+        return done
+
     def auto_gc(self, tables=None, min_dead: int = 4096,
                 ratio: float = 0.3) -> Dict[str, int]:
         """Opportunistic GC after DML: compact tables whose dead-version

@@ -130,6 +130,8 @@ class Table:
         # rows provisionally ended per open txn marker (REPLACE/upsert
         # re-insert freedom + O(dead) instead of O(n) scans)
         self._txn_dead: Dict[int, list] = {}
+        # rows modified since the last ANALYZE (auto-analyze trigger)
+        self.modify_count = 0
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -499,9 +501,11 @@ class Table:
             for s, e in log.ranges:
                 b = self.begin_ts[s:e]
                 b[b == marker] = commit_ts
+                self.modify_count += e - s
             for ids in log.ended:
                 e_ = self.end_ts[ids]
                 self.end_ts[ids] = np.where(e_ == marker, commit_ts, e_)
+                self.modify_count += len(ids)
         else:
             b = self.begin_ts[: self.n]
             e = self.end_ts[: self.n]
