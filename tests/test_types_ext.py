@@ -166,3 +166,24 @@ class TestReviewRegressions:
             sess.query("select time '9999'")
         with pytest.raises(Exception):
             sess.execute("insert into e (id, t) values (9, '0:99:00')")
+
+
+def test_decimal_sum_overflow_detected():
+    """A scaled-int64 decimal SUM that would wrap raises out-of-range
+    instead of returning silently wrong values (round-2 weak #8)."""
+    import pytest
+
+    from tidb_tpu.errors import ExecutionError
+    from tidb_tpu.session import Session
+
+    s = Session()
+    s.execute("create table d (g bigint, p decimal(18,2))")
+    # each value is ~1e18 scaled units; 20 of them pass 2^63
+    rows = ", ".join("(1, 9999999999999999.99)" for _ in range(20))
+    s.execute(f"insert into d values {rows}")
+    with pytest.raises(ExecutionError, match="out of range"):
+        s.query("select g, sum(p) from d group by g")
+    # small sums remain fine
+    s.execute("create table ok_t (g bigint, p decimal(10,2))")
+    s.execute("insert into ok_t values (1, 10.50), (1, 2.25)")
+    assert s.query("select sum(p) from ok_t") == [("12.75",)]

@@ -52,7 +52,8 @@ def _max_identity(dtype):
 # Key suffix -> collective: the distributed path (parallel/distsql.py) maps
 # these onto lax.psum / lax.pmin / lax.pmax over the shard mesh axis —
 # exactly the partial/final split of the reference's HashAggExec pipeline.
-MERGE_OPS = {".sum": "sum", ".cnt": "sum", ".min": "min", ".max": "max"}
+MERGE_OPS = {".sum": "sum", ".sumf": "sum", ".cnt": "sum",
+             ".min": "min", ".max": "max"}
 
 
 def merge_op_for(key: str) -> str:
@@ -111,6 +112,10 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
             if a.func in ("sum", "avg"):
                 dt = jnp.float64 if a.arg.type_.kind == TypeKind.FLOAT else jnp.int64
                 st[f"{a.uid}.sum"] = jnp.zeros(G, dtype=dt)
+                if dt == jnp.int64 and a.arg.type_.kind == TypeKind.DECIMAL:
+                    # f64 shadow: a scaled-int64 decimal sum can silently
+                    # wrap at scale; the shadow's magnitude exposes it
+                    st[f"{a.uid}.sumf"] = jnp.zeros(G, dtype=jnp.float64)
                 st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
             elif a.func == "count":
                 st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
@@ -155,6 +160,9 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
                         contrib, packed, G)
                 else:
                     out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
+                if f"{a.uid}.sumf" in state:
+                    out[f"{a.uid}.sumf"] = state[f"{a.uid}.sumf"].at[packed].add(
+                        contrib.astype(jnp.float64))
                 out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(ok, packed, G)
             elif a.func == "count":
                 cm = sel if a.arg is None else ok
@@ -255,6 +263,9 @@ class HashAggExec(Executor):
             out_arrays[a.uid] = self._finalize_agg_host(a, host, occupied)
         self._chunks_from_host(out_arrays, n, cap)
 
+    # scaled-int64 sums whose f64 shadow exceeds this have likely wrapped
+    _DECIMAL_SUM_GUARD = float(1 << 62)
+
     def _finalize_agg_host(self, a: AggSpec, host, occupied):
         cnt = host.get(f"{a.uid}.cnt")
         cnt = cnt[occupied] if cnt is not None else None
@@ -262,6 +273,12 @@ class HashAggExec(Executor):
             return cnt.astype(np.int64), np.ones(len(occupied), dtype=np.bool_)
         if a.func in ("sum",):
             s = host[f"{a.uid}.sum"][occupied]
+            shadow = host.get(f"{a.uid}.sumf")
+            if shadow is not None and np.abs(
+                    shadow[occupied]).max(initial=0.0) > self._DECIMAL_SUM_GUARD:
+                raise ExecutionError(
+                    "DECIMAL SUM value is out of range (scaled-int64 "
+                    "accumulator overflow)")
             return s.astype(a.type_.np_dtype), cnt > 0
         if a.func == "avg":
             s = host[f"{a.uid}.sum"][occupied].astype(np.float64)
@@ -643,6 +660,13 @@ class HashAggExec(Executor):
         if a.func in ("sum", "avg"):
             dt = np.float64 if a.arg.type_.kind == TypeKind.FLOAT or a.func == "avg" else np.int64
             s = np.zeros(ngroups, dtype=np.int64 if a.arg.type_.kind != TypeKind.FLOAT else np.float64)
+            if a.func == "sum" and a.arg.type_.kind == TypeKind.DECIMAL:
+                shadow = np.zeros(ngroups, dtype=np.float64)
+                np.add.at(shadow, inverse[ok], vals[ok].astype(np.float64))
+                if np.abs(shadow).max(initial=0.0) > self._DECIMAL_SUM_GUARD:
+                    raise ExecutionError(
+                        "DECIMAL SUM value is out of range (scaled-int64 "
+                        "accumulator overflow)")
             np.add.at(s, inverse[ok], vals[ok])
             if a.func == "sum":
                 return s.astype(a.type_.np_dtype), cnt > 0
