@@ -228,10 +228,28 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["join_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # release the SF1 working set before the join-heavy configs: keeping
+    # gigabytes of prior sessions resident measurably slows the numpy/
+    # XLA paths of later configs (page-cache pressure)
+    import gc
+
+    def drop(*objs):
+        for o in objs:
+            try:
+                if hasattr(o, "close"):
+                    o.close()
+            except Exception:  # noqa: BLE001
+                pass
+        gc.collect()
+
     # Q18: 3-way join + large-key agg (BASELINE flagship config) -------------
     try:
         log(f"# q18 at sf={SF_Q18}")
         if abs(SF_Q18 - SF) > 1e-9:
+            # separate data set: the SF1 working set is no longer needed
+            drop(conn)
+            s = counts = conn = None
+            gc.collect()
             s18 = Session(chunk_capacity=CAP, mesh=mesh)
             c18 = load_tpch(s18.catalog, sf=SF_Q18)
             conn18 = None
@@ -256,6 +274,9 @@ def main():
     # SSB Q3.2: 4-way star join (BASELINE flagship config) -------------------
     try:
         log(f"# ssb q3.2 at sf={SF_SSB}")
+        drop(locals().get("conn18"))
+        s18 = conn18 = c18 = None
+        gc.collect()
         from tidb_tpu.storage.ssb import SSB_QUERIES, load_ssb
 
         s_ssb = Session(chunk_capacity=CAP, mesh=mesh)
@@ -280,6 +301,9 @@ def main():
     # TPC-DS Q95: semi-join / MPP exchange config ----------------------------
     try:
         log(f"# tpcds q95 at sf={SF_DS}")
+        drop(locals().get("conn_ssb"))
+        s_ssb = conn_ssb = c_ssb = None
+        gc.collect()
         from tidb_tpu.storage.tpcds import Q95, Q95_SQLITE, load_tpcds_q95
 
         s_ds = Session(chunk_capacity=CAP, mesh=mesh)

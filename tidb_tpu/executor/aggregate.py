@@ -52,7 +52,7 @@ def _max_identity(dtype):
 # Key suffix -> collective: the distributed path (parallel/distsql.py) maps
 # these onto lax.psum / lax.pmin / lax.pmax over the shard mesh axis —
 # exactly the partial/final split of the reference's HashAggExec pipeline.
-MERGE_OPS = {".sum": "sum", ".sumf": "sum", ".cnt": "sum",
+MERGE_OPS = {".sum": "sum", ".gabs": "sum", ".cnt": "sum",
              ".min": "min", ".max": "max"}
 
 
@@ -113,9 +113,11 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
                 dt = jnp.float64 if a.arg.type_.kind == TypeKind.FLOAT else jnp.int64
                 st[f"{a.uid}.sum"] = jnp.zeros(G, dtype=dt)
                 if dt == jnp.int64 and a.arg.type_.kind == TypeKind.DECIMAL:
-                    # f64 shadow: a scaled-int64 decimal sum can silently
-                    # wrap at scale; the shadow's magnitude exposes it
-                    st[f"{a.uid}.sumf"] = jnp.zeros(G, dtype=jnp.float64)
+                    # overflow sentinel: one scalar tracking sum(|v|)
+                    # globally. |any group sum| <= that total, so while
+                    # it stays under 2^62 no group can have wrapped —
+                    # a fused reduction instead of a second scatter
+                    st[f"{a.uid}.gabs"] = jnp.zeros(1, dtype=jnp.float64)
                 st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
             elif a.func == "count":
                 st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
@@ -160,9 +162,10 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
                         contrib, packed, G)
                 else:
                     out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
-                if f"{a.uid}.sumf" in state:
-                    out[f"{a.uid}.sumf"] = state[f"{a.uid}.sumf"].at[packed].add(
-                        contrib.astype(jnp.float64))
+                if f"{a.uid}.gabs" in state:
+                    out[f"{a.uid}.gabs"] = (
+                        state[f"{a.uid}.gabs"]
+                        + jnp.sum(jnp.abs(contrib.astype(jnp.float64)))[None])
                 out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(ok, packed, G)
             elif a.func == "count":
                 cm = sel if a.arg is None else ok
@@ -273,9 +276,9 @@ class HashAggExec(Executor):
             return cnt.astype(np.int64), np.ones(len(occupied), dtype=np.bool_)
         if a.func in ("sum",):
             s = host[f"{a.uid}.sum"][occupied]
-            shadow = host.get(f"{a.uid}.sumf")
-            if shadow is not None and np.abs(
-                    shadow[occupied]).max(initial=0.0) > self._DECIMAL_SUM_GUARD:
+            gabs = host.get(f"{a.uid}.gabs")
+            if gabs is not None and float(
+                    np.asarray(gabs).reshape(-1)[0]) > self._DECIMAL_SUM_GUARD:
                 raise ExecutionError(
                     "DECIMAL SUM value is out of range (scaled-int64 "
                     "accumulator overflow)")
@@ -661,9 +664,8 @@ class HashAggExec(Executor):
             dt = np.float64 if a.arg.type_.kind == TypeKind.FLOAT or a.func == "avg" else np.int64
             s = np.zeros(ngroups, dtype=np.int64 if a.arg.type_.kind != TypeKind.FLOAT else np.float64)
             if a.func == "sum" and a.arg.type_.kind == TypeKind.DECIMAL:
-                shadow = np.zeros(ngroups, dtype=np.float64)
-                np.add.at(shadow, inverse[ok], vals[ok].astype(np.float64))
-                if np.abs(shadow).max(initial=0.0) > self._DECIMAL_SUM_GUARD:
+                if float(np.abs(vals[ok].astype(np.float64)).sum()) \
+                        > self._DECIMAL_SUM_GUARD:
                     raise ExecutionError(
                         "DECIMAL SUM value is out of range (scaled-int64 "
                         "accumulator overflow)")
