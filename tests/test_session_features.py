@@ -108,3 +108,59 @@ class TestShowShortcuts:
         assert isinstance(s1, A.ExplainStmt) and isinstance(s1.stmt, A.InsertStmt)
         s2 = parse("explain truncate t")[0]
         assert isinstance(s2, A.ExplainStmt)
+
+
+class TestCTEMaterialization:
+    """Multi-reference CTEs materialize once (ref: the planner's CTE
+    MERGE vs MATERIALIZE choice); single-reference CTEs keep inlining."""
+
+    def test_multi_ref_correctness(self):
+        s = Session()
+        s.execute("create table b (k bigint, s varchar(6), p decimal(8,2), d date)")
+        s.execute("insert into b values (1,'a',1.50,'2020-01-01'),"
+                  "(2,'b',2.25,'2020-01-02'),(2,'b',0.25,NULL),"
+                  "(NULL,NULL,NULL,'2020-01-03')")
+        got = s.query(
+            "with c as (select k, sum(p) as sp from b group by k) "
+            "select a.k, a.sp, x.sp from c a join c x on a.k = x.k order by a.k")
+        assert got == [(1, "1.50", "1.50"), (2, "2.50", "2.50")], got
+        # all types ride through materialization
+        got = s.query("with c as (select s, d from b) "
+                      "select count(*) from c x, c y where x.s = y.s")
+        assert got == [(5,)], got
+
+    def test_single_ref_still_inlines(self):
+        s = Session()
+        s.execute("create table t1 (k bigint)")
+        s.execute("insert into t1 values (1), (2)")
+        from tidb_tpu.planner import logical as L
+
+        calls = []
+        orig = L._materialized_cte_scan
+
+        def spy(name, ctx):
+            calls.append(name)
+            return orig(name, ctx)
+
+        L._materialized_cte_scan = spy
+        try:
+            assert s.query("with c as (select k from t1) "
+                           "select count(*) from c") == [(2,)]
+        finally:
+            L._materialized_cte_scan = orig
+        assert calls == []  # one reference -> inline, no materialization
+
+    def test_cte_privileges_checked(self):
+        import pytest
+
+        from tidb_tpu.errors import PrivilegeError
+
+        s = Session()
+        s.execute("create table sec (x bigint)")
+        s.execute("insert into sec values (1)")
+        s.execute("create user eve")
+        u = Session(catalog=s.catalog)
+        u.user = "eve"
+        with pytest.raises(PrivilegeError):
+            u.query("with c as (select x from sec) "
+                    "select a.x from c a join c b on a.x = b.x")

@@ -193,6 +193,8 @@ class BuildContext:
     # python values in device repr (used for scalar/EXISTS subqueries)
     execute_subplan: Optional[Callable] = None
     ctes: Dict[str, object] = field(default_factory=dict)  # name -> AST select
+    cte_multi: set = field(default_factory=set)   # names referenced >= 2x
+    cte_tables: Dict[str, tuple] = field(default_factory=dict)  # materialized
 
 
 def _conjuncts(e) -> List:
@@ -212,6 +214,68 @@ def _and_ir(parts: List[Expr]) -> Optional[Expr]:
 # FROM clause
 # ---------------------------------------------------------------------------
 
+def _count_table_refs(node, name: str) -> int:
+    """Occurrences of `name` as an unqualified TableName in the AST."""
+    import dataclasses as _dc
+
+    count = 0
+    stack = [node]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, A.TableName):
+            if e.name == name and e.schema is None:
+                count += 1
+            continue
+        if _dc.is_dataclass(e) and not isinstance(e, type):
+            for f in _dc.fields(e):
+                v = getattr(e, f.name)
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for item in vs:
+                    if isinstance(item, tuple):
+                        stack.extend(item)
+                    elif _dc.is_dataclass(item):
+                        stack.append(item)
+    return count
+
+
+def _materialized_cte_scan(name: str, ctx: BuildContext) -> LogicalPlan:
+    """Plan + run the CTE body once; later references scan the
+    materialized rows from an anonymous host table."""
+    hit = ctx.cte_tables.get(name)
+    if hit is None:
+        from tidb_tpu.planner.rules import optimize_logical
+        from tidb_tpu.storage.table import ColumnInfo, Table, TableSchema
+
+        body = build_select(ctx.ctes[name], ctx, None)
+        rows = ctx.execute_subplan(body)
+        schema = TableSchema(
+            name=f"__cte_{name}__",
+            columns=[ColumnInfo(name=c.name or c.uid, type_=c.type_)
+                     for c in body.schema])
+        # uniquify duplicate display names (SELECT a, a ...)
+        seen = {}
+        for c in schema.columns:
+            if c.name in seen:
+                seen[c.name] += 1
+                c.name = f"{c.name}_{seen[c.name]}"
+            else:
+                seen[c.name] = 0
+        table = Table(schema)
+        if rows:
+            table.insert_rows(rows)
+        hit = (table, [c.name for c in schema.columns])
+        ctx.cte_tables[name] = hit
+    table, names = hit
+    cols = [
+        PlanCol(uid=ctx.binder.new_uid(n), name=n,
+                type_=table.schema.col(n).type_,
+                dict_=table.dicts.get(n))
+        for n in names
+    ]
+    return LScan(schema=cols, db=ctx.db, table_name=f"__cte_{name}__",
+                 table=table)
+
+
 def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalPlan, Scope]:
     if src is None:
         # SELECT without FROM: one-row dual table
@@ -220,7 +284,11 @@ def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalP
     if isinstance(src, A.TableName):
         alias = src.alias or src.name
         if src.name in ctx.ctes and src.schema is None:
-            sub = build_select(ctx.ctes[src.name], ctx, outer)
+            if (src.name in ctx.cte_multi
+                    and ctx.execute_subplan is not None):
+                sub = _materialized_cte_scan(src.name, ctx)
+            else:
+                sub = build_select(ctx.ctes[src.name], ctx, outer)
             cols = [
                 dataclasses.replace(c, qualifier=alias) for c in sub.schema
             ]
@@ -567,12 +635,17 @@ def build_select(stmt, ctx: BuildContext, outer: Optional[Scope] = None) -> Logi
         return _build_union(stmt, ctx, outer)
     assert isinstance(stmt, A.SelectStmt)
 
-    # CTEs visible in this select (inlined on reference)
+    # CTEs visible in this select; single-reference CTEs inline (MERGE),
+    # multi-reference ones materialize once at plan time (the reference
+    # planner's CTE MATERIALIZE default for shared CTEs) so an expensive
+    # body — e.g. TPC-DS Q95's web_sales self-join — computes once
     old_ctes = dict(ctx.ctes)
     for cte in stmt.ctes:
         if cte.columns:
             raise UnsupportedError("CTE column lists not supported yet")
         ctx.ctes[cte.name] = cte.select
+        if _count_table_refs(stmt, cte.name) >= 2:
+            ctx.cte_multi.add(cte.name)
     try:
         return _build_select_core(stmt, ctx, outer)
     finally:

@@ -294,7 +294,9 @@ class Session:
         # and fold into literals, so they must be checked here or a
         # scalar subquery leaks unprivileged tables
         self._check_plan_privs(phys)
-        root = build_executor(phys)
+        # the subplan earns the same engine routing as a top-level query
+        # (a materialized CTE body can be a heavy join)
+        root = self._build_root(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
         rs = run_plan(root, self._exec_ctx(), n_visible=n_vis)
         return rs.rows
