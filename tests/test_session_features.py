@@ -164,3 +164,24 @@ class TestCTEMaterialization:
         with pytest.raises(PrivilegeError):
             u.query("with c as (select x from sec) "
                     "select a.x from c a join c b on a.x = b.x")
+
+    def test_shadowed_cte_names_do_not_alias(self):
+        s = Session()
+        got = s.query(
+            "with c as (select 1 as x) "
+            "select count(*) from c a join c b on a.x = b.x "
+            "union all "
+            "select x from (with c as (select 7 as x) select x from c) d")
+        assert got == [(1,), (7,)], got
+
+    def test_granted_user_can_use_multi_ref_cte(self):
+        s = Session()
+        s.execute("create table g (x bigint)")
+        s.execute("insert into g values (3)")
+        s.execute("create user bob")
+        s.execute("grant select on g to bob")
+        u = Session(catalog=s.catalog)
+        u.user = "bob"
+        got = u.query("with c as (select x from g) "
+                      "select count(*) from c a join c b on a.x = b.x")
+        assert got == [(1,)], got

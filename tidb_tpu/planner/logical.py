@@ -215,17 +215,22 @@ def _and_ir(parts: List[Expr]) -> Optional[Expr]:
 # ---------------------------------------------------------------------------
 
 def _count_table_refs(node, name: str) -> int:
-    """Occurrences of `name` as an unqualified TableName in the AST."""
+    """Occurrences of `name` as an unqualified TableName in the AST,
+    NOT descending into scopes where an inner WITH shadows the name."""
     import dataclasses as _dc
 
     count = 0
     stack = [node]
+    seen_root = node
     while stack:
         e = stack.pop()
         if isinstance(e, A.TableName):
             if e.name == name and e.schema is None:
                 count += 1
             continue
+        if (isinstance(e, A.SelectStmt) and e is not seen_root
+                and any(c.name == name for c in e.ctes)):
+            continue  # inner WITH shadows the name: out of scope
         if _dc.is_dataclass(e) and not isinstance(e, type):
             for f in _dc.fields(e):
                 v = getattr(e, f.name)
@@ -241,12 +246,12 @@ def _count_table_refs(node, name: str) -> int:
 def _materialized_cte_scan(name: str, ctx: BuildContext) -> LogicalPlan:
     """Plan + run the CTE body once; later references scan the
     materialized rows from an anonymous host table."""
-    hit = ctx.cte_tables.get(name)
+    body_ast = ctx.ctes[name]
+    hit = ctx.cte_tables.get(id(body_ast))
     if hit is None:
-        from tidb_tpu.planner.rules import optimize_logical
         from tidb_tpu.storage.table import ColumnInfo, Table, TableSchema
 
-        body = build_select(ctx.ctes[name], ctx, None)
+        body = build_select(body_ast, ctx, None)
         rows = ctx.execute_subplan(body)
         schema = TableSchema(
             name=f"__cte_{name}__",
@@ -261,10 +266,11 @@ def _materialized_cte_scan(name: str, ctx: BuildContext) -> LogicalPlan:
             else:
                 seen[c.name] = 0
         table = Table(schema)
+        table._anonymous = True  # plan-time temp: exempt from priv walk
         if rows:
             table.insert_rows(rows)
         hit = (table, [c.name for c in schema.columns])
-        ctx.cte_tables[name] = hit
+        ctx.cte_tables[id(body_ast)] = hit
     table, names = hit
     cols = [
         PlanCol(uid=ctx.binder.new_uid(n), name=n,
@@ -284,7 +290,7 @@ def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalP
     if isinstance(src, A.TableName):
         alias = src.alias or src.name
         if src.name in ctx.ctes and src.schema is None:
-            if (src.name in ctx.cte_multi
+            if (id(ctx.ctes[src.name]) in ctx.cte_multi
                     and ctx.execute_subplan is not None):
                 sub = _materialized_cte_scan(src.name, ctx)
             else:
@@ -645,7 +651,9 @@ def build_select(stmt, ctx: BuildContext, outer: Optional[Scope] = None) -> Logi
             raise UnsupportedError("CTE column lists not supported yet")
         ctx.ctes[cte.name] = cte.select
         if _count_table_refs(stmt, cte.name) >= 2:
-            ctx.cte_multi.add(cte.name)
+            # keyed by the BODY's identity: a same-named CTE in another
+            # scope is a different object and never aliases this one
+            ctx.cte_multi.add(id(cte.select))
     try:
         return _build_select_core(stmt, ctx, outer)
     finally:

@@ -414,6 +414,11 @@ class Session:
         while stack:
             node = stack.pop()
             if isinstance(node, PScan) and node.table is not None:
+                if getattr(node.table, "_anonymous", False):
+                    # plan-time temp (materialized CTE): its body was
+                    # privilege-checked when the subplan executed
+                    stack.extend(getattr(node, "children", ()))
+                    continue
                 db = getattr(node, "db", None) or self.db
                 if db.lower() != "information_schema":  # world-readable
                     self._priv("select", db, node.table_name)
