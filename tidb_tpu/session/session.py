@@ -98,6 +98,10 @@ class Session:
         txn, self.txn = self.txn, None
         if txn is None:
             return
+        with self.catalog.lock:  # single-writer commit point
+            self._commit_locked(txn)
+
+    def _commit_locked(self, txn) -> None:
         from tidb_tpu.storage.txn2pc import TwoPhaseCommitter
 
         committer = TwoPhaseCommitter(
@@ -127,6 +131,10 @@ class Session:
         txn, self.txn = self.txn, None
         if txn is None:
             return
+        with self.catalog.lock:
+            self._rollback_locked(txn)
+
+    def _rollback_locked(self, txn) -> None:
         from tidb_tpu.storage.txn2pc import TwoPhaseCommitter
 
         TwoPhaseCommitter(
@@ -141,22 +149,33 @@ class Session:
         """Run a write inside the session txn; implicit txns commit (or
         roll back on error) at statement end. A write conflict against a
         marker whose txn already DECIDED (crashed mid-2PC) resolves the
-        stale locks and retries once — the Backoffer/resolve-lock flow."""
+        stale locks and retries once — the Backoffer/resolve-lock flow.
+
+        The mutation + implicit commit run under the catalog's writer
+        lock: the storage layout is single-writer by design (ref: one
+        leaseholder per region), and the wire server executes sessions
+        on concurrent threads. Readers stay lock-free — MVCC timestamps
+        make committed rows stable under concurrent appends."""
         txn, implicit = self._ensure_txn()
-        try:
+        with self.catalog.lock:
             try:
-                fn(txn)
-            except WriteConflictError:
-                if self.catalog.resolve_locks():
-                    fn(txn)  # stale locks cleared; one retry
-                else:
-                    raise
-        except Exception:
+                try:
+                    fn(txn)
+                except WriteConflictError:
+                    if self.catalog.resolve_locks():
+                        fn(txn)  # stale locks cleared; one retry
+                    else:
+                        raise
+            except Exception:
+                if implicit:
+                    txn2, self.txn = self.txn, None
+                    if txn2 is not None:
+                        self._rollback_locked(txn2)
+                raise
             if implicit:
-                self._rollback()
-            raise
-        if implicit:
-            self._commit()
+                txn2, self.txn = self.txn, None
+                if txn2 is not None:
+                    self._commit_locked(txn2)
         return None
 
     # -- execution ---------------------------------------------------------
