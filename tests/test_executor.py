@@ -478,3 +478,35 @@ class TestFullOuterJoin:
     def test_where_after_full(self, fj):
         check(fj, "select lv, rv from l full join r on l.k = r.k"
                   " where rv is null")
+
+
+def test_host_join_many_to_many_windows():
+    """Numpy host-probe path: a many-to-many expansion larger than the
+    chunk capacity windows correctly, including probe rows whose match
+    runs straddle window boundaries (review: full-expansion OOM fix)."""
+    import numpy as np
+
+    from tidb_tpu.session import Session
+
+    s = Session(chunk_capacity=1 << 10)  # small windows force straddling
+    s.execute("set tidb_enable_tpu_exec = 0")
+    s.execute("create table p (k bigint, pi bigint)")
+    s.execute("create table b (k bigint, bi bigint)")
+    tp = s.catalog.table("test", "p")
+    tb = s.catalog.table("test", "b")
+    rng = np.random.default_rng(11)
+    pk = rng.integers(0, 40, 3000)
+    bk = rng.integers(0, 40, 900)
+    tp.insert_columns({"k": pk, "pi": np.arange(3000, dtype=np.int64)})
+    tb.insert_columns({"k": bk, "bi": np.arange(900, dtype=np.int64)})
+    got = s.query("select count(*), sum(p.pi), sum(b.bi) from p join b on p.k = b.k")
+    import collections
+
+    cnt = collections.Counter(bk.tolist())
+    want_n = sum(cnt[k] for k in pk.tolist())
+    want_pi = sum(i * cnt[k] for i, k in enumerate(pk.tolist()))
+    bsum = collections.defaultdict(int)
+    for i, k in enumerate(bk.tolist()):
+        bsum[k] += i
+    want_bi = sum(bsum[k] for k in pk.tolist())
+    assert got == [(want_n, want_pi, want_bi)], got
