@@ -23,12 +23,16 @@ def main():
     from tidb_tpu.ops.segment_sum import (
         segment_count,
         segment_sum_f32,
+        segment_sum_i64,
         xla_segment_sum,
     )
 
     rng = np.random.default_rng(0)
     R = 1 << 20
     vals = jnp.asarray(rng.standard_normal(R).astype(np.float32))
+    # i64 config: scaled-decimal magnitudes (Q1 extendedprice ~ 1e7 at
+    # scale 2); exactness matters, not just speed
+    ivals = jnp.asarray(rng.integers(-(10 ** 7), 10 ** 7, R, dtype=np.int64))
     mask = jnp.asarray(rng.random(R) < 0.7)
 
     def bench(fn, *args, reps=20):
@@ -54,19 +58,29 @@ def main():
         wc = np.zeros(g, np.int64)
         np.add.at(wc, np.asarray(seg)[np.asarray(mask)], 1)
         exact = bool((np.asarray(segment_count(mask, seg, g)) == wc).all())
+        wi = np.zeros(g, np.int64)
+        np.add.at(wi, np.asarray(seg), np.asarray(ivals))
+        i64_exact = bool((np.asarray(segment_sum_i64(ivals, seg, g)) == wi).all())
         t_ps = bench(lambda v, s, g=g: segment_sum_f32(v, s, g), vals, seg)
         t_xs = bench(jax.jit(lambda v, s, g=g: xla_segment_sum(v, s, g)), vals, seg)
         t_pc = bench(lambda m, s, g=g: segment_count(m, s, g), mask, seg)
         t_xc = bench(jax.jit(
             lambda m, s, g=g: xla_segment_sum(m.astype(jnp.int64), s, g)), mask, seg)
+        t_pi = bench(lambda v, s, g=g: segment_sum_i64(v, s, g), ivals, seg)
+        t_xi = bench(jax.jit(
+            lambda v, s, g=g: xla_segment_sum(v, s, g)), ivals, seg)
         results["configs"].append({
             "G": g, "sum_rel_err": err, "count_exact": exact,
+            "i64_exact": i64_exact,
             "sum_pallas_ms": round(t_ps * 1e3, 3),
             "sum_xla_ms": round(t_xs * 1e3, 3),
             "sum_speedup": round(t_xs / t_ps, 2),
             "count_pallas_ms": round(t_pc * 1e3, 3),
             "count_xla_i64_ms": round(t_xc * 1e3, 3),
             "count_speedup": round(t_xc / t_pc, 2),
+            "i64_pallas_ms": round(t_pi * 1e3, 3),
+            "i64_xla_ms": round(t_xi * 1e3, 3),
+            "i64_speedup": round(t_xi / t_pi, 2),
         })
         print(results["configs"][-1])
 

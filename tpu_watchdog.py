@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Standing TPU-capture watchdog.
+
+The tunneled TPU chip has been flaky for two rounds (BASELINE.md r2/r3
+notes): it may come alive at any hour and numbers must be captured the
+moment it does, unattended. This daemon:
+
+  loop:
+    - probe the default jax backend in a DETACHED child (never killed:
+      killing a mid-claim TPU client wedges the tunnel — BASELINE.md r2)
+    - if the probe hangs, WAIT for that child to exit before probing
+      again (two overlapping TPU clients also wedge the tunnel)
+    - on the first healthy TPU probe: claim the chip ONCE while holding
+      the shared chip lock (/tmp/tpu_chip.lock, honored by bench.py),
+      run the full 5-config bench -> BENCH_tpu.json, then refresh
+      ops/SEGSUM_BENCH.json (the i64 limb kernel has never run on
+      silicon), release, and exit.
+
+Every probe attempt and outcome is appended to tpu_watchdog.log with a
+timestamp so the log itself is evidence of tunnel liveness (or the lack
+of it) across the round.
+
+Run detached:  nohup setsid python tpu_watchdog.py >/dev/null 2>&1 &
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(REPO, "tpu_watchdog.log")
+LOCK = os.environ.get("TPU_CHIP_LOCK", "/tmp/tpu_chip.lock")
+PROBE_DIR = "/tmp/tpu_watch"
+PROBE_INTERVAL = float(os.environ.get("TPU_PROBE_INTERVAL", "600"))
+PROBE_TIMEOUT = float(os.environ.get("TPU_PROBE_TIMEOUT", "420"))
+CAPTURE_ATTEMPTS = int(os.environ.get("TPU_CAPTURE_ATTEMPTS", "3"))
+BENCH_OUT = os.path.join(REPO, "BENCH_tpu.json")
+
+
+def log(msg):
+    line = f"{time.strftime('%Y-%m-%d %H:%M:%S')} {msg}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def acquire_lock(why, patience=None):
+    """Atomic mkdir lock shared with bench.py so chip clients never
+    overlap. Blocks (with periodic logging) until acquired."""
+    t0 = time.time()
+    while True:
+        try:
+            os.mkdir(LOCK)
+            with open(os.path.join(LOCK, "owner"), "w") as f:
+                f.write(f"tpu_watchdog pid={os.getpid()} why={why}\n")
+            return True
+        except FileExistsError:
+            if patience is not None and time.time() - t0 > patience:
+                return False
+            if int(time.time() - t0) % 600 < 2:
+                log(f"waiting on chip lock {LOCK} (held by: "
+                    f"{_lock_owner()}) for {why}")
+            time.sleep(2)
+
+
+def _lock_owner():
+    try:
+        with open(os.path.join(LOCK, "owner")) as f:
+            return f.read().strip()
+    except OSError:
+        return "?"
+
+
+def release_lock():
+    try:
+        os.unlink(os.path.join(LOCK, "owner"))
+    except OSError:
+        pass
+    try:
+        os.rmdir(LOCK)
+    except OSError:
+        pass
+
+
+def probe_once(idx):
+    """Detached probe child; returns (status, detail).
+
+    status: 'tpu' (healthy TPU backend), 'cpu' (backend unavailable,
+    fast-failed), 'hung' (child still alive at timeout — caller must
+    wait for it to exit before any other chip client starts)."""
+    os.makedirs(PROBE_DIR, exist_ok=True)
+    marker = os.path.join(PROBE_DIR, f"r4_probe_{idx}.json")
+    errpath = marker + ".err"
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass
+    code = (
+        "import time, json\n"
+        "t0 = time.time()\n"
+        "try:\n"
+        "    import jax\n"
+        "    d = jax.devices()\n"
+        "    out = {'ok': True, 'n': len(d), 'platform': d[0].platform,\n"
+        "           'secs': round(time.time()-t0, 1)}\n"
+        "except Exception as e:\n"
+        "    out = {'ok': False, 'err': str(e)[:400],\n"
+        "           'secs': round(time.time()-t0, 1)}\n"
+        f"open({marker!r}, 'w').write(json.dumps(out))\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # probe the DEFAULT backend
+    with open(errpath, "w") as errf:
+        child = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL, stderr=errf,
+            start_new_session=True, env=env,
+        )
+    deadline = time.time() + PROBE_TIMEOUT
+    while time.time() < deadline:
+        if os.path.exists(marker):
+            time.sleep(0.5)  # let the write land
+            try:
+                res = json.load(open(marker))
+            except Exception:  # noqa: BLE001
+                time.sleep(1)
+                continue
+            if res.get("ok") and res.get("platform") not in ("cpu", None):
+                return "tpu", res
+            if res.get("ok"):
+                return "cpu", res
+            return "cpu", res
+        if child.poll() is not None and not os.path.exists(marker):
+            try:
+                tail = open(errpath).read()[-400:]
+            except OSError:
+                tail = ""
+            return "cpu", {"err": f"probe exited rc={child.returncode}: {tail}"}
+        time.sleep(2)
+    return "hung", {"child": child}
+
+
+def wait_for_child(child):
+    """A hung probe child is never killed; wait for it to exit (it holds
+    a mid-claim chip client). Log hourly."""
+    t0 = time.time()
+    while child.poll() is None:
+        waited = time.time() - t0
+        if waited > 0 and int(waited) % 3600 < 5:
+            log(f"hung probe child pid={child.pid} still alive after "
+                f"{waited/3600:.1f}h; waiting (never kill a mid-claim client)")
+        time.sleep(5)
+    log(f"hung probe child pid={child.pid} exited rc={child.returncode} "
+        f"after {(time.time()-t0)/60:.1f} min")
+
+
+def run_capture():
+    """Chip is healthy and we hold the lock: take every on-chip number
+    in one claim. Returns True if BENCH_tpu.json landed."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["BENCH_PLATFORM"] = "default"   # probe already succeeded; go direct
+    env.setdefault("BENCH_REPS", "2")   # tunnel dispatch latency is high
+    env.setdefault("BENCH_LOCK_SKIP", "1")  # we already hold the chip lock
+    log("capture: starting full 5-config bench on TPU backend")
+    t0 = time.time()
+    with open(os.path.join(REPO, "bench_tpu_r4.log"), "a") as blog:
+        rc = subprocess.call(
+            [sys.executable, "bench.py"], cwd=REPO, env=env,
+            stdout=open(BENCH_OUT + ".tmp", "w"), stderr=blog,
+            timeout=None)
+    ok = False
+    try:
+        with open(BENCH_OUT + ".tmp") as f:
+            line = f.read().strip().splitlines()[-1]
+        res = json.loads(line)
+        plat = res.get("extra", {}).get("platform")
+        ok = rc == 0 and res.get("value", 0) > 0 and plat == "default"
+        if ok:
+            os.replace(BENCH_OUT + ".tmp", BENCH_OUT)
+        log(f"capture: bench rc={rc} platform={plat} "
+            f"value={res.get('value')} ok={ok} ({(time.time()-t0)/60:.1f} min)")
+    except Exception as e:  # noqa: BLE001
+        log(f"capture: bench artifact unreadable: {e!r}")
+    log("capture: refreshing ops/SEGSUM_BENCH.json (i64 limb kernel)")
+    with open(os.path.join(REPO, "bench_tpu_r4.log"), "a") as blog:
+        rc2 = subprocess.call(
+            [sys.executable, "-m", "tidb_tpu.ops.bench_segsum"],
+            cwd=REPO, env=env, stdout=blog, stderr=blog)
+    log(f"capture: segsum bench rc={rc2}")
+    return ok
+
+
+def main():
+    log(f"watchdog up pid={os.getpid()} interval={PROBE_INTERVAL}s "
+        f"probe_timeout={PROBE_TIMEOUT}s")
+    if os.path.exists(BENCH_OUT):
+        log(f"{BENCH_OUT} already exists; exiting")
+        return
+    captures = 0
+    idx = 0
+    while True:
+        idx += 1
+        if not acquire_lock(f"probe #{idx}"):
+            continue
+        try:
+            status, detail = probe_once(idx)
+            if status == "hung":
+                log(f"probe #{idx}: HUNG at {PROBE_TIMEOUT}s; holding lock "
+                    "until the child exits")
+                wait_for_child(detail["child"])
+                continue
+            if status == "cpu":
+                d = detail.get("err") or detail
+                log(f"probe #{idx}: tpu unavailable ({str(d)[:200]})")
+                continue
+            log(f"probe #{idx}: TPU HEALTHY {detail} — claiming once")
+            captures += 1
+            if run_capture():
+                log("capture complete; BENCH_tpu.json written. Exiting.")
+                return
+            if captures >= CAPTURE_ATTEMPTS:
+                log(f"capture failed {captures}x; giving up to avoid "
+                    "wedging the tunnel further")
+                return
+            log("capture failed; will re-probe")
+        finally:
+            release_lock()
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    main()
