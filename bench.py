@@ -40,6 +40,8 @@ import time
 import traceback
 
 SF = float(os.environ.get("BENCH_SF", "1.0"))
+LOCK = os.environ.get("TPU_CHIP_LOCK", "/tmp/tpu_chip.lock")
+LOCK_TIMEOUT = float(os.environ.get("BENCH_LOCK_TIMEOUT", "600"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
 CAP = int(os.environ.get("BENCH_CHUNK", str(1 << 20)))
 ORACLE = os.environ.get("BENCH_ORACLE", "1") != "0"
@@ -51,6 +53,43 @@ SF_DS = float(os.environ.get("BENCH_SF_DS", str(min(SF, 0.5))))
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def chip_lock():
+    """Serialize chip clients with tpu_watchdog.py via the shared mkdir
+    lock: overlapping TPU clients wedge the tunnel (BASELINE.md r2).
+    Bounded wait so a stale lock can't deadlock the driver's bench —
+    on timeout we proceed and record it in the artifact. Returns
+    (acquired: bool, detail: str)."""
+    if os.environ.get("BENCH_LOCK_SKIP") == "1":
+        return False, "skipped (caller holds the lock)"
+    deadline = time.time() + LOCK_TIMEOUT
+    while True:
+        try:
+            os.mkdir(LOCK)
+            with open(os.path.join(LOCK, "owner"), "w") as f:
+                f.write(f"bench.py pid={os.getpid()}\n")
+            return True, "acquired"
+        except FileExistsError:
+            if time.time() > deadline:
+                try:
+                    owner = open(os.path.join(LOCK, "owner")).read().strip()
+                except OSError:
+                    owner = "?"
+                return False, (f"lock wait timed out after {LOCK_TIMEOUT}s; "
+                               f"held by {owner}; proceeding anyway")
+            time.sleep(2)
+
+
+def chip_unlock(acquired):
+    if not acquired:
+        return
+    for fn in (lambda: os.unlink(os.path.join(LOCK, "owner")),
+               lambda: os.rmdir(LOCK)):
+        try:
+            fn()
+        except OSError:
+            pass
 
 
 def pick_platform():
@@ -149,8 +188,9 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
     return rows / best, vs, best, check
 
 
-def main():
+def main(locked_detail=("", "")):
     extra = {}
+    extra["chip_lock"] = locked_detail[1]
     platform, detail = pick_platform()
     extra["platform"] = platform
     if platform != "default":
@@ -333,8 +373,9 @@ def main():
 
 
 if __name__ == "__main__":
+    _lock = chip_lock()
     try:
-        main()
+        main(_lock)
     except Exception as e:  # noqa: BLE001
         # a failed bench must still produce a diagnosable one-line artifact
         traceback.print_exc()
@@ -346,3 +387,5 @@ if __name__ == "__main__":
             "extra": {"error": f"{type(e).__name__}: {e}"[:500]},
         }))
         sys.exit(0)
+    finally:
+        chip_unlock(_lock[0])

@@ -56,3 +56,18 @@ def test_disabled_by_sysvar(sess):
     # explicit ANALYZE still works and resets the churn counter
     sess.execute("analyze table a")
     assert t.stats is not None and t.modify_count == 0
+
+
+def test_logless_commit_advances_modify_count():
+    """Advisor r3 (low): the log-less txn_commit full-scan path (lock
+    resolution) must advance the auto-analyze trigger too."""
+    from tidb_tpu.session import Session
+
+    s = Session()
+    s.execute("create table t (a bigint)")
+    t = s.catalog.table(s.db, "t")
+    marker, _rts = s.catalog.begin_txn()
+    t.insert_rows([(1,), (2,), (3,)], begin_ts=marker)
+    before = t.modify_count
+    t.txn_commit(marker, s.catalog.next_ts())  # no log: full-scan path
+    assert t.modify_count >= before + 3

@@ -314,3 +314,75 @@ class TestReviewRegressions:
                 w._sock.close()
             except OSError:
                 pass
+
+
+class TestMutualAuth:
+    def test_spoofed_worker_fails_reverse_handshake(self):
+        """A spoofed endpoint that echoes the auth flag but lacks the
+        secret cannot complete the reverse challenge (advisor r3: the
+        old handshake authenticated only the coordinator)."""
+        import socket as sk
+        import threading as th
+
+        from tidb_tpu.errors import ExecutionError
+        from tidb_tpu.parallel.dcn import Cluster
+
+        srv = sk.socket(sk.AF_INET, sk.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def spoof():
+            conn, _ = srv.accept()
+            conn.sendall(b"\x01" + b"A" * 16)  # pretend to demand auth
+            try:
+                conn.recv(4096)  # harvest the client's MAC
+                conn.sendall(b"B" * 32)  # no secret -> garbage reverse MAC
+            except OSError:
+                pass
+
+        t = th.Thread(target=spoof, daemon=True)
+        t.start()
+        with pytest.raises((ExecutionError, ConnectionError, OSError)):
+            Cluster([("127.0.0.1", port)], secret="sesame")
+        srv.close()
+
+    def test_relayed_mac_rejected_by_endpoint_binding(self):
+        """A MAC computed for one endpoint cannot be relayed to a worker
+        at a different address: the claimed endpoint is in the MAC and
+        the worker refuses a claim that is not itself."""
+        import hashlib
+        import hmac as hm
+        import os as _os
+        import socket as sk
+        import threading as th
+
+        from tidb_tpu.parallel.dcn import Worker, _recv_exact
+
+        w = Worker(secret="sesame")
+        t = th.Thread(target=w.serve_forever, daemon=True)
+        t.start()
+        try:
+            s = sk.create_connection(("127.0.0.1", w.port), timeout=10)
+            assert _recv_exact(s, 1) == b"\x01"
+            nonce_w = _recv_exact(s, 16)
+            nonce_c = _os.urandom(16)
+            # valid secret, but the claim names a DIFFERENT endpoint (the
+            # relay scenario: MAC harvested for spoofed host 10.9.9.9)
+            endpoint = f"10.9.9.9:{w.port}".encode()
+            transcript = endpoint + b"|" + nonce_w + nonce_c
+            s.sendall(nonce_c + bytes([len(endpoint)]) + endpoint
+                      + hm.new(b"sesame", b"dcn-coord|" + transcript,
+                               hashlib.sha256).digest())
+            # worker must close without sending its reverse MAC
+            s.settimeout(10)
+            with pytest.raises((ConnectionError, OSError)):
+                got = _recv_exact(s, 32)
+                raise AssertionError(f"worker answered a relayed claim: {got!r}")
+        finally:
+            try:
+                from tidb_tpu.parallel.dcn import Cluster
+
+                Cluster([("127.0.0.1", w.port)], secret="sesame").shutdown()
+            except Exception:
+                pass

@@ -116,3 +116,56 @@ def test_pointget_joined_with_big_table_still_distributes():
     r = s.query("select sum(big.x) from big join dim on big.k = dim.k where dim.k = 7")
     want = sum(i for i in range(5000) if i % 50 == 7)
     assert r == [(want,)], r
+
+
+def test_point_after_insert_select_commit_in_txn(sess):
+    """Advisor r3 (high): a point-lookup cache built between a txn's
+    INSERT and its COMMIT already contains the provisional rows; the
+    commit-time merge must not re-insert them (the duplicate surfaced on
+    every point get after COMMIT)."""
+    sess.execute("begin")
+    sess.execute("insert into p values (9001, 90010, 'new')")
+    # builds the lookup cache AFTER the provisional insert
+    assert sess.query("select v from p where id = 9001") == [(90010,)]
+    sess.execute("commit")
+    assert sess.query("select v from p where id = 9001") == [(90010,)]
+    assert sess.query("select count(*) from p where id = 9001") == [(1,)]
+    # neighbours unaffected
+    assert sess.query("select v from p where id = 9000") == []
+    assert sess.query("select v from p where id = 2000") == [(20000,)]
+
+
+def test_point_cache_merge_autocommit_inserts(sess):
+    """The useful merge direction: a cache built BEFORE an autocommit
+    insert gains the new rows at commit without a full re-sort. (Uses a
+    string-free table: dictionary growth adds its own version bump,
+    which rightly disables the merge — codes may re-encode.)"""
+    sess.execute("create table q (id bigint primary key, v bigint)")
+    sess.execute("insert into q values (1, 10), (2, 20)")
+    assert sess.query("select v from q where id = 1") == [(10,)]  # build cache
+    t = sess.catalog.table(sess.db, "q")
+    v_keys_before = len(t._lookup_cache["PRIMARY"][1])
+    sess.execute("insert into q values (9002, 90020)")
+    hit = t._lookup_cache.get("PRIMARY")
+    # merged cache is current and gained exactly the new row
+    assert hit is not None and hit[0] == t.version, (hit and hit[0], t.version)
+    assert len(hit[1]) == v_keys_before + 1
+    assert sess.query("select v from q where id = 9002") == [(90020,)]
+    assert sess.query("select count(*) from q where id = 9002") == [(1,)]
+    # string-keyed path stays correct even when the merge is skipped
+    sess.execute("insert into p values (9002, 90020, 'm')")
+    assert sess.query("select v from p where id = 9002") == [(90020,)]
+    assert sess.query("select count(*) from p where id = 9002") == [(1,)]
+
+
+def test_point_txn_insert_update_mix(sess):
+    """Inserts + updates in one txn end rows (log.ended non-empty), so
+    the pure-insert carry-forward must not fire — and point gets stay
+    exact through commit."""
+    sess.execute("begin")
+    sess.execute("insert into p values (9003, 1, 'a')")
+    sess.execute("update p set v = 2 where id = 9003")
+    assert sess.query("select v from p where id = 9003") == [(2,)]
+    sess.execute("commit")
+    assert sess.query("select v from p where id = 9003") == [(2,)]
+    assert sess.query("select count(*) from p where id = 9003") == [(1,)]

@@ -240,3 +240,19 @@ def test_engine_mode_sysvar_validation(sess):
     assert sess.query("select @@tidb_device_engine_mode") == [("force",)]
     with pytest.raises(ExecutionError):
         sess.execute("set tidb_device_engine_mode = 'fore'")
+
+
+def test_explain_and_trace_require_select(sess):
+    """EXPLAIN / EXPLAIN ANALYZE / TRACE need the same privileges as the
+    statement (ANALYZE and TRACE even execute it; without the check they
+    leak per-operator row counts for unreadable tables)."""
+    alice = as_user(sess, "alice")
+    for stmt in ("explain select * from t",
+                 "explain analyze select * from t",
+                 "trace select * from t"):
+        with pytest.raises(PrivilegeError):
+            alice.query(stmt)
+    sess.execute("grant select on t to alice")
+    assert alice.query("explain select * from t")
+    assert alice.query("explain analyze select * from t")
+    assert alice.query("trace select * from t")

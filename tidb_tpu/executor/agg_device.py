@@ -101,8 +101,12 @@ def _sort_reduce(kbits: List[jax.Array], kvalids: List[jax.Array],
         # hash first (cheap comparisons), exact bits as tie-breaks: equal
         # keys are guaranteed contiguous, so the output table can never
         # hold a collision-split duplicate — consumers may emit it
-        # directly without a dedup pass
-        keys = (dead, _group_hash(kbits, kvalids)) + tuple(kbits) + (iota,)
+        # directly without a dedup pass. kvalids must join the tie-break:
+        # _bits64 zeroes NULL bits, so a NULL key and a live 0 share bits
+        # and differ only in validity — without it a hash collision could
+        # interleave the two groups
+        keys = ((dead, _group_hash(kbits, kvalids)) + tuple(kbits)
+                + tuple(v.astype(jnp.int32) for v in kvalids) + (iota,))
         out = jax.lax.sort(keys, num_keys=len(keys) - 1)
     else:
         out = jax.lax.sort(

@@ -271,6 +271,8 @@ class Worker:
                 "dcn worker: binding a non-loopback interface requires a "
                 "shared secret (--secret-file / DCN_SECRET)")
         self.secret = secret
+        # normalized for the handshake's endpoint-claim check
+        self._bind_host = "127.0.0.1" if host == "localhost" else host
         self.session = Session()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -290,21 +292,55 @@ class Worker:
                              daemon=True).start()
 
     def _handshake(self, conn: socket.socket) -> bool:
-        """Challenge/response before any message is decoded. The flag
-        byte tells the client whether auth is demanded."""
+        """Mutual challenge/response before any message is decoded. The
+        flag byte tells the client whether auth is demanded.
+
+        The coordinator's MAC is bound to its role, the endpoint it
+        believes it dialed, and both nonces — so a MAC harvested by a
+        spoofed endpoint cannot be relayed to a worker at a different
+        address (the worker refuses an endpoint claim that isn't
+        itself), and neither side's MAC can be replayed in the other
+        direction. The worker then proves knowledge of the secret with
+        its own role-bound MAC over the same transcript. This is
+        authentication only: there is NO transport encryption or
+        post-handshake integrity — run DCN links over trusted networks
+        (the reference's gRPC-over-TLS analogue is out of scope)."""
         if not self.secret:
             conn.sendall(b"\x00")
             return True
-        nonce = os.urandom(16)
-        conn.sendall(b"\x01" + nonce)
+        nonce_w = os.urandom(16)
+        conn.sendall(b"\x01" + nonce_w)
         try:
-            mac = _recv_exact(conn, 32)
+            nonce_c = _recv_exact(conn, 16)
+            elen = _recv_exact(conn, 1)[0]
+            endpoint = _recv_exact(conn, elen)
+            mac_c = _recv_exact(conn, 32)
         except (ConnectionError, OSError):
             return False
-        want = hmac.new(self.secret.encode(), nonce, hashlib.sha256).digest()
-        if not hmac.compare_digest(mac, want):
+        # the claimed endpoint must be this worker: port must match; host
+        # must match the bind host unless bound to a wildcard
+        try:
+            ep_host, ep_port = endpoint.decode().rsplit(":", 1)
+            port_ok = int(ep_port) == self.port
+        except (UnicodeDecodeError, ValueError):
             conn.close()
             return False
+        if ep_host == "localhost":  # match the coordinator's normalization
+            ep_host = "127.0.0.1"
+        host_ok = self._bind_host in ("", "0.0.0.0", "::") \
+            or ep_host == self._bind_host
+        if not port_ok or not host_ok:
+            conn.close()
+            return False
+        transcript = endpoint + b"|" + nonce_w + nonce_c
+        want = hmac.new(self.secret.encode(), b"dcn-coord|" + transcript,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(mac_c, want):
+            conn.close()
+            return False
+        conn.sendall(hmac.new(self.secret.encode(),
+                              b"dcn-worker|" + transcript,
+                              hashlib.sha256).digest())
         return True
 
     def _serve_conn(self, conn: socket.socket) -> None:
@@ -596,9 +632,26 @@ class Cluster:
                 s.close()
                 raise ExecutionError(
                     "dcn worker demands auth but no secret configured")
-            nonce = _recv_exact(s, 16)
-            s.sendall(hmac.new(self.secret.encode(), nonce,
-                               hashlib.sha256).digest())
+            nonce_w = _recv_exact(s, 16)
+            nonce_c = os.urandom(16)
+            claim_host = "127.0.0.1" if host == "localhost" else host
+            endpoint = f"{claim_host}:{port}".encode()
+            transcript = endpoint + b"|" + nonce_w + nonce_c
+            s.sendall(nonce_c + bytes([len(endpoint)]) + endpoint
+                      + hmac.new(self.secret.encode(),
+                                 b"dcn-coord|" + transcript,
+                                 hashlib.sha256).digest())
+            # reverse challenge: the worker must prove the secret too —
+            # a spoofed worker that merely echoed the \x01 flag cannot
+            mac_w = _recv_exact(s, 32)
+            want = hmac.new(self.secret.encode(),
+                            b"dcn-worker|" + transcript,
+                            hashlib.sha256).digest()
+            if not hmac.compare_digest(mac_w, want):
+                s.close()
+                raise ExecutionError(
+                    f"dcn worker {host}:{port} failed the reverse "
+                    "handshake (wrong or missing secret)")
         elif self.secret:
             # downgrade refusal: a coordinator configured for auth must
             # not talk to an endpoint that waives it (spoofed worker)

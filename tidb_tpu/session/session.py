@@ -1107,6 +1107,9 @@ class Session:
             raise UnsupportedError("EXPLAIN only supports SELECT")
         target = self._apply_binding(target)  # EXPLAIN shows the bound plan
         phys = self._plan_select(target)
+        # MySQL requires the same privileges for EXPLAIN as for the
+        # statement itself; ANALYZE even executes it
+        self._check_plan_privs(phys)
         if stmt.analyze:
             from tidb_tpu.utils.execdetails import analyze_text, instrument
 
@@ -1133,6 +1136,7 @@ class Session:
             self._begin()  # same consistent-snapshot rule as _run_select
         t_start = _time.perf_counter()
         phys = self._plan_select(target)
+        self._check_plan_privs(phys)  # TRACE executes the statement
         t_plan = _time.perf_counter()
         root = self._build_root(phys)
         instrument(root)

@@ -42,6 +42,12 @@ class TableTxnLog:
 
     ranges: List[tuple] = field(default_factory=list)  # appended [start,end)
     ended: List[np.ndarray] = field(default_factory=list)  # end_ts-stamped ids
+    # commit-time cache-merge bookkeeping (Table._log_mark): table version
+    # before this txn's first logged write, version after its last one,
+    # and whether every bump in between was this txn's own
+    vstart: int = -1
+    vlast: int = -1
+    contiguous: bool = True
 
 
 @dataclass
@@ -279,6 +285,8 @@ class Table:
         if log is not None:
             log.ranges.append((start, end))
         self.version += 1
+        if log is not None:
+            self._log_mark(log)
         self._uniq_commit()
         return m
 
@@ -410,6 +418,8 @@ class Table:
         if log is not None:
             log.ended.append(ids)
         self.version += 1
+        if log is not None:
+            self._log_mark(log)
         return len(ids)
 
     def update_rows(self, row_ids: np.ndarray, updates: Dict[str, list],
@@ -488,7 +498,23 @@ class Table:
             log.ended.append(ids)
             log.ranges.append((start, end))
         self.version += 1
+        if log is not None:
+            self._log_mark(log)
         return m
+
+    def _log_mark(self, log: "TableTxnLog") -> None:
+        """Called right after each logged mutation's version bump.
+        Records the version window this txn's writes span so txn_commit
+        can tell whether a point-lookup cache predates the txn (safe to
+        merge the new rows into) or postdates its last write (already
+        complete). `contiguous` survives only if every bump since
+        `vstart` was this txn's own — a foreign bump (another writer,
+        GC compaction moving physical ids) disables merging."""
+        if log.vstart < 0:
+            log.vstart = self.version - 1
+        elif log.vlast != self.version - 1:
+            log.contiguous = False
+        log.vlast = self.version
 
     def txn_commit(self, marker: int, commit_ts: int,
                    log: Optional["TableTxnLog"] = None) -> None:
@@ -515,6 +541,9 @@ class Table:
                 return  # no residue here: don't invalidate caches
             b[bm] = commit_ts
             e[em] = commit_ts
+            # full-scan commits must still advance the auto-analyze
+            # trigger or stats silently go stale for these workloads
+            self.modify_count += int(bm.sum()) + int(em.sum())
         self.version += 1
         if log is not None and not log.ended:
             # a pure-insert commit doesn't change the present key set:
@@ -524,20 +553,33 @@ class Table:
             for name, (v, keys) in list(self._uniq_cache.items()):
                 if v == vbefore:
                     self._uniq_cache[name] = (self.version, keys)
-            # same for the point-lookup cache, but the new rows must be
-            # MERGED in (they are new physical positions): O(m log n + n)
-            # memcpy instead of a full re-sort on the next probe
+            # point-lookup caches: one built AFTER this txn's last write
+            # (v == vbefore — inserts bump version at write time, and
+            # index_lookup rebuilds from all physical rows, so it already
+            # holds the new ids) is complete — carry it forward untouched;
+            # merging it back in would duplicate the new rows on every
+            # subsequent point get. One built just BEFORE the txn's first
+            # write (v == vstart, with no foreign bump in the window —
+            # _log_mark's contiguity proof) predates the new physical
+            # positions: MERGE them in, O(m log n + n) memcpy instead of
+            # a full re-sort on the next probe (autocommit insert path).
             if self._lookup_cache:
                 new_ids = (np.concatenate([np.arange(s, e) for s, e in log.ranges])
                            if log.ranges else np.zeros(0, dtype=np.int64))
+                mergeable = (log.contiguous and log.vstart >= 0
+                             and log.vlast == vbefore)
                 for name, hit in list(self._lookup_cache.items()):
                     v, skeys, srows = hit
-                    if v != vbefore:
-                        continue
                     idx = self.indexes.get(name)
                     if idx is None:
                         del self._lookup_cache[name]
                         continue
+                    if v == vbefore:
+                        # commit only rewrites timestamps, not keys/rows
+                        self._lookup_cache[name] = (self.version, skeys, srows)
+                        continue
+                    if not (mergeable and v == log.vstart):
+                        continue  # stale: next probe rebuilds
                     mat, ids = self._uniq_key_rows(idx, new_ids)
                     add = np.ascontiguousarray(mat).view(skeys.dtype).reshape(-1)
                     order = np.argsort(add, kind="stable")

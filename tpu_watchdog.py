@@ -129,8 +129,6 @@ def probe_once(idx):
                 continue
             if res.get("ok") and res.get("platform") not in ("cpu", None):
                 return "tpu", res
-            if res.get("ok"):
-                return "cpu", res
             return "cpu", res
         if child.poll() is not None and not os.path.exists(marker):
             try:
@@ -193,9 +191,33 @@ def run_capture():
     return ok
 
 
+def wait_for_stray_probes():
+    """A restarted watchdog must not probe while an earlier watchdog's
+    hung probe child is still mid-claim (overlapping chip clients wedge
+    the tunnel). Detect them by the probe-marker path embedded in their
+    command line and wait, logging hourly."""
+    t0 = time.time()
+    while True:
+        try:
+            out = subprocess.run(
+                ["pgrep", "-f", PROBE_DIR + "/"], capture_output=True,
+                text=True).stdout.split()
+        except OSError:
+            return
+        strays = [p for p in out if p.isdigit() and int(p) != os.getpid()]
+        if not strays:
+            return
+        waited = time.time() - t0
+        if waited < 5 or int(waited) % 3600 < 15:
+            log(f"stray probe children from a previous watchdog still "
+                f"alive ({','.join(strays)}); waiting before first probe")
+        time.sleep(15)
+
+
 def main():
     log(f"watchdog up pid={os.getpid()} interval={PROBE_INTERVAL}s "
         f"probe_timeout={PROBE_TIMEOUT}s")
+    wait_for_stray_probes()
     if os.path.exists(BENCH_OUT):
         log(f"{BENCH_OUT} already exists; exiting")
         return
@@ -203,29 +225,27 @@ def main():
     idx = 0
     while True:
         idx += 1
-        if not acquire_lock(f"probe #{idx}"):
-            continue
+        acquire_lock(f"probe #{idx}")
         try:
             status, detail = probe_once(idx)
             if status == "hung":
                 log(f"probe #{idx}: HUNG at {PROBE_TIMEOUT}s; holding lock "
                     "until the child exits")
                 wait_for_child(detail["child"])
-                continue
-            if status == "cpu":
+            elif status == "cpu":
                 d = detail.get("err") or detail
                 log(f"probe #{idx}: tpu unavailable ({str(d)[:200]})")
-                continue
-            log(f"probe #{idx}: TPU HEALTHY {detail} — claiming once")
-            captures += 1
-            if run_capture():
-                log("capture complete; BENCH_tpu.json written. Exiting.")
-                return
-            if captures >= CAPTURE_ATTEMPTS:
-                log(f"capture failed {captures}x; giving up to avoid "
-                    "wedging the tunnel further")
-                return
-            log("capture failed; will re-probe")
+            else:
+                log(f"probe #{idx}: TPU HEALTHY {detail} — claiming once")
+                captures += 1
+                if run_capture():
+                    log("capture complete; BENCH_tpu.json written. Exiting.")
+                    return
+                if captures >= CAPTURE_ATTEMPTS:
+                    log(f"capture failed {captures}x; giving up to avoid "
+                        "wedging the tunnel further")
+                    return
+                log("capture failed; will re-probe")
         finally:
             release_lock()
         time.sleep(PROBE_INTERVAL)
