@@ -159,12 +159,48 @@ def pick_platform():
                     pass
 
 
+def machine_load():
+    """Snapshot of everything that could invalidate a measurement:
+    1/5/15-min load averages plus any OTHER busy python/compile process
+    (>50% of a core, cumulative) that would contend for the machine.
+    Recorded into the artifact before and after each config so a
+    perturbed number is visibly perturbed (round-3 lesson: the headline
+    moved -38% with no load evidence either way)."""
+    snap = {"loadavg": [round(x, 2) for x in os.getloadavg()]}
+    try:
+        me = os.getpid()
+        busy = []
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    parts = f.read().split()
+                utime, stime = int(parts[13]), int(parts[14])
+                cpu_s = (utime + stime) / os.sysconf("SC_CLK_TCK")
+                with open(f"/proc/{pid}/cmdline") as f:
+                    cmd = f.read().replace("\x00", " ").strip()
+            except (OSError, IndexError, ValueError):
+                continue
+            if cpu_s > 30 and any(k in cmd for k in
+                                  ("python", "pytest", "cc1plus", "clang",
+                                   "ninja", "node")):
+                busy.append(f"pid{pid}:{int(cpu_s)}s:{cmd[:60]}")
+        snap["busy_procs"] = busy[:8]
+    except OSError:
+        pass
+    return snap
+
+
 def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
-                ordered=True):
+                ordered=True, extra=None, tag=None):
     """Run engine_sql reps times; cross-check once vs sqlite. Returns
-    (rows_per_sec, vs_sqlite, best_s, check)."""
+    (rows_per_sec, vs_sqlite, best_s, check). With extra/tag, records
+    machine-load snapshots around the measurement into the artifact."""
     from tidb_tpu.testutil import rows_equal
 
+    if extra is not None and tag:
+        extra[f"{tag}_load_before"] = machine_load()
     t0 = time.perf_counter()
     got = s.query(engine_sql)  # compile + warmup
     warm = time.perf_counter() - t0
@@ -183,6 +219,8 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
         ok, msg = rows_equal(got, want, ordered=ordered)
         check = "ok" if ok else f"MISMATCH: {msg}"
         vs = cpu_s / best
+    if extra is not None and tag:
+        extra[f"{tag}_load_after"] = machine_load()["loadavg"]
     log(f"#   warm={warm:.2f}s best={best * 1e3:.1f}ms"
         + (f" sqlite={cpu_s * 1e3:.1f}ms" if cpu_s else "") + f" check={check}")
     return rows / best, vs, best, check
@@ -235,7 +273,7 @@ def main(locked_detail=("", "")):
     # headline: Q1 (scan + filter + group-by agg) ---------------------------
     log("# q1")
     q1_rps, q1_vs, q1_best, q1_check = bench_query(
-        s, Q["q1"][0], conn, Q["q1"][1] or Q["q1"][0], rows)
+        s, Q["q1"][0], conn, Q["q1"][1] or Q["q1"][0], rows, extra=extra, tag="q1")
     if "MISMATCH" in q1_check:
         extra["q1_check"] = q1_check
 
@@ -243,7 +281,8 @@ def main(locked_detail=("", "")):
     try:
         log("# q6")
         sql, lite = Q["q6"]
-        rps, vs, best, check = bench_query(s, sql, conn, lite or sql, rows)
+        rps, vs, best, check = bench_query(s, sql, conn, lite or sql, rows,
+                                           extra=extra, tag="q6")
         extra["tpch_q6_rows_per_sec"] = round(rps, 1)
         extra["q6_vs_sqlite"] = round(vs, 3)
         # bytes actually consulted by Q6: 4 numeric lineitem columns
@@ -258,7 +297,8 @@ def main(locked_detail=("", "")):
         log("# join microbench")
         jq = ("select count(*) as n, sum(l_quantity) as q from lineitem "
               "join orders on l_orderkey = o_orderkey where o_totalprice > 100000")
-        rps, vs, best, check = bench_query(s, jq, conn, jq, rows)
+        rps, vs, best, check = bench_query(s, jq, conn, jq, rows,
+                                           extra=extra, tag="join")
         # bytes through the join: probe keys+payload and build keys+filter col
         jbytes = rows * 2 * 8 + counts["orders"] * 2 * 8
         extra["join_build_probe_gbps"] = round(jbytes / best / 1e9, 3)
@@ -302,7 +342,7 @@ def main(locked_detail=("", "")):
             s18, c18, conn18 = s, counts, conn
         sql, lite = Q["q18"]
         rps, vs, best, check = bench_query(
-            s18, sql, conn18, lite or sql, c18["lineitem"])
+            s18, sql, conn18, lite or sql, c18["lineitem"], extra=extra, tag="q18")
         extra["tpch_q18_rows_per_sec"] = round(rps, 1)
         extra["q18_vs_sqlite"] = round(vs, 3)
         extra["q18_sf"] = SF_Q18
@@ -329,7 +369,8 @@ def main(locked_detail=("", "")):
         sql = SSB_QUERIES["q3.2"]
         # unordered: q3.2's ORDER BY doesn't break revenue ties
         rps, vs, best, check = bench_query(
-            s_ssb, sql, conn_ssb, sql, c_ssb["lineorder"], ordered=False)
+            s_ssb, sql, conn_ssb, sql, c_ssb["lineorder"], ordered=False,
+            extra=extra, tag="ssb")
         extra["ssb_q32_rows_per_sec"] = round(rps, 1)
         extra["ssb_q32_vs_sqlite"] = round(vs, 3)
         extra["ssb_sf"] = SF_SSB
@@ -354,7 +395,7 @@ def main(locked_detail=("", "")):
 
             conn_ds = mirror_to_sqlite(s_ds.catalog)
         rps, vs, best, check = bench_query(
-            s_ds, Q95, conn_ds, Q95_SQLITE, c_ds["web_sales"])
+            s_ds, Q95, conn_ds, Q95_SQLITE, c_ds["web_sales"], extra=extra, tag="tpcds")
         extra["tpcds_q95_rows_per_sec"] = round(rps, 1)
         extra["tpcds_q95_vs_sqlite"] = round(vs, 3)
         extra["tpcds_sf"] = SF_DS

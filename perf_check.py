@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Per-round perf regression harness (VERDICT r3 weak #4).
+
+Runs the pinned-seed, pinned-SF engine configs RUN-ALONE and asserts
+each stays within a band of the committed floor in PERF_FLOOR.json.
+Exits 1 on a breach with a diff table; exits 2 (inconclusive, NOT a
+failure) if the machine was visibly busy — a perturbed number must
+never be mistaken for a regression, and vice versa.
+
+    python perf_check.py            # check against committed floors
+    python perf_check.py --set      # (re)write floors from this run
+
+Floors are per-platform (cpu/tpu): the committed file may carry both.
+The band: measured >= floor * (1 - TOLERANCE). TOLERANCE covers normal
+machine-to-machine jitter; a real regression (like r3's unexplained
+-38% on Q1) blows straight through it.
+"""
+
+import json
+import os
+import sys
+import time
+
+TOLERANCE = float(os.environ.get("PERF_TOLERANCE", "0.25"))
+REPS = int(os.environ.get("PERF_REPS", "3"))
+FLOOR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "PERF_FLOOR.json")
+BUSY_LOAD = float(os.environ.get("PERF_BUSY_LOAD", "1.5"))
+
+
+def main():
+    import bench  # repo-root bench module: reuse lock + load machinery
+
+    setting = "--set" in sys.argv
+
+    lock = bench.chip_lock()
+    try:
+        load0 = bench.machine_load()
+        if load0["loadavg"][0] > BUSY_LOAD or load0.get("busy_procs"):
+            print(f"INCONCLUSIVE: machine busy before run: {load0}")
+            if not setting:
+                sys.exit(2)
+
+        # pin platform the same way bench does (probe; fall back to cpu)
+        platform, detail = bench.pick_platform()
+        if platform != "default":
+            os.environ["JAX_PLATFORMS"] = platform
+
+        import tidb_tpu  # noqa: F401
+        import jax
+
+        if platform != "default":
+            jax.config.update("jax_platforms", platform)
+        plat_key = jax.devices()[0].platform
+
+        from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.tpch import load_tpch
+        from tidb_tpu.storage.tpch_queries import Q
+
+        mesh = make_mesh()
+        s = Session(chunk_capacity=1 << 20, mesh=mesh)
+        counts = load_tpch(s.catalog, sf=1.0)  # pinned SF + datagen seed
+        rows = counts["lineitem"]
+
+        def best_of(sql, reps=REPS):
+            s.query(sql)  # warm/compile
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                s.query(sql)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        measured = {}
+        measured["q1_rows_per_sec"] = round(rows / best_of(Q["q1"][0]), 1)
+        measured["q6_rows_per_sec"] = round(rows / best_of(Q["q6"][0]), 1)
+        jq = ("select count(*) as n, sum(l_quantity) as q from lineitem "
+              "join orders on l_orderkey = o_orderkey "
+              "where o_totalprice > 100000")
+        measured["join_rows_per_sec"] = round(rows / best_of(jq), 1)
+
+        load1 = bench.machine_load()
+        busy_after = load1["loadavg"][0] > BUSY_LOAD or load1.get("busy_procs")
+
+        if setting:
+            floors = {}
+            if os.path.exists(FLOOR_PATH):
+                floors = json.load(open(FLOOR_PATH))
+            floors[plat_key] = {
+                "floors": measured,
+                "set_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "load": [load0["loadavg"], load1["loadavg"]],
+            }
+            json.dump(floors, open(FLOOR_PATH, "w"), indent=1)
+            print(f"floors[{plat_key}] set: {measured}")
+            return
+
+        floors = json.load(open(FLOOR_PATH)).get(plat_key)
+        if floors is None:
+            print(f"INCONCLUSIVE: no committed floor for platform {plat_key}")
+            sys.exit(2)
+        bad = []
+        for k, floor in floors["floors"].items():
+            got = measured.get(k, 0.0)
+            need = floor * (1 - TOLERANCE)
+            status = "ok" if got >= need else "REGRESSION"
+            print(f"{k:24s} floor={floor:>12.1f} need>={need:>12.1f} "
+                  f"got={got:>12.1f}  {status}")
+            if got < need:
+                bad.append(k)
+        if bad and busy_after:
+            print(f"INCONCLUSIVE: breaches {bad} but machine went busy "
+                  f"mid-run: {load1}")
+            sys.exit(2)
+        if bad:
+            print(f"PERF REGRESSION: {bad} (band {TOLERANCE:.0%} below "
+                  "committed floor)")
+            sys.exit(1)
+        print("perf check: all configs within band")
+    finally:
+        bench.chip_unlock(lock[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -26,3 +26,72 @@ def test_tpch_query(tpch_session, name):
     want = oracle.execute(osql or sql).fetchall()
     ok, msg = rows_equal(got, want, ordered=True)
     assert ok, f"{name}: {msg}"
+
+
+class TestSelfJoinDistinctness:
+    """The Q95 ws_wh shape: duplicate-detection self-join under semi-join
+    consumers rewrites to GROUP BY key HAVING MIN(col) <> MAX(col)."""
+
+    def _mk(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table ws (ordn bigint, wh bigint, v bigint)")
+        s.execute(
+            "insert into ws values (1, 10, 1), (1, 11, 2), (2, 10, 3), "
+            "(2, 10, 4), (3, 12, 5), (4, NULL, 6), (4, 13, 7), (4, 13, 8)")
+        return s
+
+    def test_inline_in_subquery(self):
+        s = self._mk()
+        # orders shipped from >1 distinct warehouse: 1 only (4's pair is
+        # NULL + 13 — NULL never compares unequal)
+        got = s.query(
+            "select ordn, count(*) from ws where ordn in ("
+            " select w1.ordn from ws w1, ws w2"
+            " where w1.ordn = w2.ordn and w1.wh <> w2.wh)"
+            " group by ordn order by ordn")
+        assert got == [(1, 2)], got
+
+    def test_cte_semi_only_dedup(self):
+        s = self._mk()
+        got = s.query(
+            "with multi as (select w1.ordn as o from ws w1, ws w2"
+            "  where w1.ordn = w2.ordn and w1.wh <> w2.wh) "
+            "select ordn, sum(v) from ws "
+            "where ordn in (select o from multi) "
+            "  and ordn in (select o from multi where o > 0) "
+            "group by ordn order by ordn")
+        assert got == [(1, 3)], got
+
+    def test_outside_semi_context_keeps_multiplicity(self):
+        s = self._mk()
+        # CTE consumed in plain FROM: multiplicities must survive (2 rows
+        # for order 1: (10,11) and (11,10) pairs)
+        got = s.query(
+            "with multi as (select w1.ordn as o from ws w1, ws w2"
+            "  where w1.ordn = w2.ordn and w1.wh <> w2.wh) "
+            "select count(*) from multi where o in (select o from multi)")
+        assert got == [(2,)], got
+
+    def test_aggregating_semi_zone_not_dedup(self):
+        s = self._mk()
+        # IN over an aggregate of the CTE: dedup would change SUM
+        got = s.query(
+            "with multi as (select w1.ordn as o from ws w1, ws w2"
+            "  where w1.ordn = w2.ordn and w1.wh <> w2.wh) "
+            "select ordn from ws where ordn in (select sum(o) from multi) "
+            "group by ordn")
+        assert got == [(2,)], got  # sum(o) = 1+1 = 2
+
+    def test_union_limit_semi_zone_not_dedup(self):
+        s = self._mk()
+        # LIMIT over a sorted UNION ALL picks rows by position: dedup of
+        # the CTE would change which rows survive the LIMIT
+        got = s.query(
+            "with multi as (select w1.ordn as o from ws w1, ws w2"
+            "  where w1.ordn = w2.ordn and w1.wh <> w2.wh) "
+            "select ordn from ws where ordn in ("
+            " select o from multi union all select 5 order by o limit 2) "
+            "group by ordn order by ordn")
+        assert got == [(1,)], got

@@ -119,11 +119,24 @@ class HashJoinExec(Executor):
 
         eval_keys = cached_jit("joinkeys", repr(keys_ir), lambda: eval_keys)
 
+        def eval_keys_any(chunk):
+            # numpy first: key exprs are almost always column refs /
+            # dict lookups, and the jitted evaluator recompiles per
+            # query (per-query uids in its closure)
+            if not keys_ir:
+                z = np.zeros(chunk.capacity, dtype=np.int64)
+                return ([(z, np.ones(chunk.capacity, dtype=np.bool_))],
+                        chunk.sel)
+            outs = [self._np_eval_key(k, chunk) for k in keys_ir]
+            if all(o is not None for o in outs):
+                return outs, chunk.sel
+            return eval_keys(chunk)
+
         key_cols = [[] for _ in (keys_ir or [None])]
         key_ok = []
         payload: dict = {c.uid: ([], []) for c in (self.build_schema or [])}
         for chunk in build_child.chunks():
-            outs, sel = eval_keys(chunk)
+            outs, sel = eval_keys_any(chunk)
             sel = np.asarray(sel)
             live = np.nonzero(sel)[0]
             ok = np.ones(len(live), dtype=np.bool_)
@@ -300,9 +313,80 @@ class HashJoinExec(Executor):
             return sel & ~(ok & matched)
         return sel & ok & ~matched
 
+    def _np_eval_key(self, e, chunk: Chunk):
+        """Numpy (data, valid) for the key shapes the host path meets —
+        column refs, literals, dictionary Lookups. Returns None for
+        anything else (caller falls back to the jitted evaluator).
+        Evaluating keys without jax matters: a per-join jax.jit keyed on
+        per-query uids recompiled EVERY query (~20ms per join — the
+        fixed cost that made every small host join cost ~30ms)."""
+        from tidb_tpu.expression.expr import ColumnRef, Literal, Lookup
+
+        if isinstance(e, ColumnRef):
+            col = chunk.columns[e.name]
+            return np.asarray(col.data), np.asarray(col.valid)
+        if isinstance(e, Literal):
+            cap = chunk.capacity
+            dt = e.type_.np_dtype  # match the jitted evaluator's dtype:
+            # pack-mode selection ('bits' for floats) depends on it
+            if e.value is None:
+                return (np.zeros(cap, dtype=dt),
+                        np.zeros(cap, dtype=np.bool_))
+            return (np.full(cap, e.value, dtype=dt),
+                    np.ones(cap, dtype=np.bool_))
+        if isinstance(e, Lookup):
+            base = self._np_eval_key(e.arg, chunk)
+            if base is None:
+                return None
+            data, valid = base
+            table = np.asarray(e.table, dtype=e.type_.np_dtype)
+            if len(table) == 0:  # empty dictionary: every code is absent
+                return (np.zeros(len(data), dtype=e.type_.np_dtype),
+                        np.zeros(len(data), dtype=np.bool_))
+            idx = np.clip(data.astype(np.int64), 0, len(e.table) - 1)
+            out = table[idx]
+            if e.table_valid is not None:
+                tv = np.asarray(e.table_valid, dtype=np.bool_)
+                valid = valid & tv[idx]
+            valid = valid & (data >= 0) & (data < len(e.table))
+            return out, valid
+        return None
+
+    @staticmethod
+    def _np_as_int64(d: np.ndarray, mode: str) -> np.ndarray:
+        if mode == "bits":
+            return d.astype(np.float64).view(np.int64)
+        return d.astype(np.int64)
+
+    def _np_pack_probe(self, outs):
+        """Numpy mirror of _pack_probe (range packing; hash mode never
+        reaches the numpy path — _host_probe_eligible excludes it)."""
+        info = self._pack_info
+        if len(outs) == 1:
+            d, v = outs[0]
+            return (self._np_as_int64(d, info[0][0]), v,
+                    np.ones_like(v, dtype=np.bool_))
+        packed = np.zeros(len(outs[0][0]), dtype=np.int64)
+        valid = np.ones(len(outs[0][0]), dtype=np.bool_)
+        in_range = np.ones_like(valid)
+        for (d, v), (mode, lo, stride, rng) in zip(outs, info):
+            d = self._np_as_int64(d, mode)
+            valid = valid & v
+            in_range = in_range & (d >= lo) & (d < lo + rng)
+            packed = packed + np.clip(d - lo, 0, max(rng - 1, 0)) * stride
+        return packed, valid, in_range
+
     def _np_probe_keys(self, chunk: Chunk):
-        """Jitted key eval + pack (one compiled fn per join), fetched
-        once per chunk for the numpy probe."""
+        """Key eval + pack for the numpy probe: pure numpy when the key
+        exprs allow it, else a jitted fallback (one fn per join)."""
+        mode = getattr(self, "_np_key_mode", None)
+        if mode != "jit":
+            outs = [self._np_eval_key(k, chunk) for k in self.probe_keys]
+            if self.probe_keys and all(o is not None for o in outs):
+                self._np_key_mode = "np"
+                packed, valid, in_r = self._np_pack_probe(outs)
+                return packed, valid & np.asarray(chunk.sel), in_r
+            self._np_key_mode = "jit"
         if getattr(self, "_np_key_fn", None) is None:
             keys_ir = self.probe_keys
 

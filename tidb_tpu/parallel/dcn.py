@@ -318,7 +318,11 @@ class Worker:
         except (ConnectionError, OSError):
             return False
         # the claimed endpoint must be this worker: port must match; host
-        # must match the bind host unless bound to a wildcard
+        # must match the bind host unless bound to a wildcard. The
+        # compare is literal (no DNS resolution): coordinators must dial
+        # workers by the exact bind address, or bind workers to a
+        # wildcard — a hostname dial against an IP-bound worker is
+        # indistinguishable from a relayed claim and is refused
         try:
             ep_host, ep_port = endpoint.decode().rsplit(":", 1)
             port_ok = int(ep_port) == self.port

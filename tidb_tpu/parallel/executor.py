@@ -152,20 +152,6 @@ def _types_sig(st: ShardedTable) -> str:
 # table is below this run on the device path even without an accelerator
 # (XLA fusion amortizes); above it, sort-bound joins/generic aggs go to
 # the numpy host engine, which wins 2-3x there
-SMALL_FRAGMENT_ROWS = 200_000
-
-
-def _max_scan_rows(plan: PhysicalPlan) -> int:
-    best = 0
-    stack = [plan]
-    while stack:
-        node = stack.pop()
-        if isinstance(node, PScan) and node.table is not None:
-            best = max(best, node.table.n)
-        stack.extend(getattr(node, "children", ()))
-    return best
-
-
 def _collapse_to_scan(plan: PhysicalPlan):
     """Fuse Selection/Projection chain onto a single scan; return
     (scan, stages) or None if the subtree isn't a pushable pipeline."""
@@ -755,11 +741,13 @@ def build_dist_executor(plan: PhysicalPlan, cache: ShardCache,
         # path beats staging tables onto the mesh
         return build_executor(plan)
     if isinstance(plan, PHashAgg):
-        if not full and _max_scan_rows(plan) > SMALL_FRAGMENT_ROWS:
-            # big inputs on a single-CPU backend: keep segment scan-aggs
-            # on device (linear scatter-adds win), run joins and generic
-            # aggregation on the host engine. Small inputs stay on the
-            # device path either way — compiled fusion amortizes.
+        if not full:
+            # single-CPU backend: keep segment scan-aggs on device
+            # (linear scatter-adds win) but run joins and generic
+            # aggregation on the vectorized host engine at EVERY size —
+            # XLA:CPU's sort-based join fragments measured 2.7x slower
+            # than the host engine even at 75k rows (TPC-DS Q95 SF0.5),
+            # and the gap only widens with input size (BASELINE.md).
             if plan.strategy == "segment":
                 frag = _collapse_to_scan(plan.child)
                 if frag is not None:

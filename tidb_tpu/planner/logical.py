@@ -195,6 +195,9 @@ class BuildContext:
     ctes: Dict[str, object] = field(default_factory=dict)  # name -> AST select
     cte_multi: set = field(default_factory=set)   # names referenced >= 2x
     cte_tables: Dict[str, tuple] = field(default_factory=dict)  # materialized
+    # body ids whose every reference is duplicate-insensitive (all inside
+    # IN/EXISTS semi-join zones): materialization may dedup + rewrite
+    cte_duponly: set = field(default_factory=set)
 
 
 def _conjuncts(e) -> List:
@@ -243,6 +246,172 @@ def _count_table_refs(node, name: str) -> int:
     return count
 
 
+_AGG_FUNC_NAMES = {"sum", "count", "avg", "min", "max", "group_concat",
+                   "stddev", "stddev_pop", "stddev_samp", "variance",
+                   "var_pop", "var_samp", "bit_and", "bit_or", "bit_xor"}
+
+
+def _multiplicity_sensitive(node) -> bool:
+    """Does any select inside `node` aggregate, window, or LIMIT? If so,
+    row multiplicity of its inputs can change its result and inputs must
+    not be deduplicated."""
+    import dataclasses as _dc
+
+    stack = [node]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, A.SelectStmt) and (
+                e.group_by or e.having is not None or e.limit is not None
+                or e.offset is not None):
+            return True
+        if isinstance(e, A.UnionStmt) and (
+                e.limit is not None or e.offset is not None
+                or e.all or e.op != "union"):
+            # LIMIT/OFFSET pick rows by position; UNION ALL / EXCEPT /
+            # INTERSECT have bag semantics — all multiplicity-dependent
+            return True
+        if isinstance(e, A.EFunc) and e.name in _AGG_FUNC_NAMES:
+            return True
+        if isinstance(e, A.EWindow):
+            return True
+        if _dc.is_dataclass(e) and not isinstance(e, type):
+            for f in _dc.fields(e):
+                v = getattr(e, f.name)
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for item in vs:
+                    if isinstance(item, tuple):
+                        stack.extend(item)
+                    elif _dc.is_dataclass(item):
+                        stack.append(item)
+    return False
+
+
+def _cte_semi_only(stmt, name: str) -> bool:
+    """True when EVERY reference to CTE `name` sits inside an IN/EXISTS
+    subquery that contains no aggregate/window/LIMIT — a pure semi-join
+    zone where only the DISTINCT row set matters. Such a CTE may be
+    deduplicated at materialization (set(join(A,B)) == set(join(set(A),
+    set(B))), and filters/projections commute with dedup likewise).
+    Ref: the reference planner's semi-join dedup of subquery sources."""
+    import dataclasses as _dc
+
+    stack = [stmt]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, A.TableName):
+            if e.name == name and e.schema is None:
+                return False  # a reference OUTSIDE every semi zone
+            continue
+        if (isinstance(e, A.SelectStmt) and e is not stmt
+                and any(c.name == name for c in e.ctes)):
+            continue  # inner WITH shadows the name
+        sub_zones = []
+        if isinstance(e, A.EIn) and e.subquery is not None:
+            sub_zones.append(e.subquery)
+        elif isinstance(e, A.EExists):
+            sub_zones.append(e.subquery)
+        for z in sub_zones:
+            if _count_table_refs(z, name) and _multiplicity_sensitive(z):
+                return False  # referenced where multiplicity matters
+        if sub_zones:
+            # zone contents are dup-safe; outer parts (e.g. IN's lhs arg
+            # and value list) still need scanning
+            if isinstance(e, A.EIn):
+                stack.append(e.arg)
+                stack.extend(e.values or [])
+            continue
+        if _dc.is_dataclass(e) and not isinstance(e, type):
+            for f in _dc.fields(e):
+                v = getattr(e, f.name)
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for item in vs:
+                    if isinstance(item, tuple):
+                        stack.extend(item)
+                    elif _dc.is_dataclass(item):
+                        stack.append(item)
+    return True
+
+
+def _try_selfjoin_distinctness(stmt):
+    """Rewrite the duplicate-detection self-join — TPC-DS Q95's ws_wh
+    shape (SURVEY.md:131) — into a grouped min/max distinctness test:
+
+        SELECT t1.a FROM t t1, t t2
+        WHERE t1.a = t2.a AND t1.b <> t2.b
+      =set=
+        SELECT a FROM t WHERE a IS NOT NULL AND b IS NOT NULL
+        GROUP BY a HAVING MIN(b) <> MAX(b)
+
+    Set-equal only (the join multiplies rows per matching pair), so
+    callers must be in a duplicate-insensitive context (semi-join zones,
+    dedup'd CTE materialization). The join form is O(sum of group^2)
+    rows through a hash join; the grouped form is one segment min/max.
+    Returns the rewritten SelectStmt or None if the shape doesn't match.
+    """
+    if not isinstance(stmt, A.SelectStmt) or stmt.group_by or stmt.having \
+            or stmt.limit is not None or stmt.offset is not None \
+            or len(stmt.items) != 1 or stmt.ctes:
+        return None
+    f = stmt.from_
+    if not (isinstance(f, A.Join) and f.kind in ("cross", "inner")
+            and f.on is None and f.using is None
+            and isinstance(f.left, A.TableName)
+            and isinstance(f.right, A.TableName)
+            and f.left.name == f.right.name
+            and f.left.schema == f.right.schema):
+        return None
+    a1 = f.left.alias or f.left.name
+    a2 = f.right.alias or f.right.name
+    if a1 == a2:
+        return None
+    aliases = {a1, a2}
+
+    def _same_col_pair(e, op):
+        """e is `q1.x <op> q2.x` with {q1,q2} == aliases -> x, else None."""
+        if (isinstance(e, A.EBinary) and e.op == op
+                and isinstance(e.left, A.EName) and isinstance(e.right, A.EName)
+                and e.left.name == e.right.name
+                and {e.left.qualifier, e.right.qualifier} == aliases):
+            return e.left.name
+        return None
+
+    key_cols, diff_cols, other = [], [], []
+    for conj in _conjuncts(stmt.where) if stmt.where is not None else []:
+        k = _same_col_pair(conj, "=")
+        if k is not None:
+            key_cols.append(k)
+            continue
+        d = _same_col_pair(conj, "<>") or _same_col_pair(conj, "!=")
+        if d is not None:
+            diff_cols.append(d)
+            continue
+        other.append(conj)
+    if not key_cols or len(diff_cols) != 1 or other:
+        return None
+    item = stmt.items[0]
+    if not (isinstance(item.expr, A.EName)
+            and (item.expr.qualifier in aliases or item.expr.qualifier is None)
+            and item.expr.name in key_cols):
+        return None
+    diff = diff_cols[0]
+    not_null = None
+    for c in dict.fromkeys(key_cols + [diff]):  # ordered, unique
+        cond = A.EIsNull(arg=A.EName(name=c), negated=True)
+        not_null = cond if not_null is None else A.EBinary(
+            op="and", left=not_null, right=cond)
+    return A.SelectStmt(
+        items=[A.SelectItem(expr=A.EName(name=item.expr.name),
+                            alias=item.alias or item.expr.name)],
+        from_=A.TableName(name=f.left.name, schema=f.left.schema),
+        where=not_null,
+        group_by=[A.EName(name=k) for k in key_cols],
+        having=A.EBinary(
+            op="<>",
+            left=A.EFunc(name="min", args=[A.EName(name=diff)]),
+            right=A.EFunc(name="max", args=[A.EName(name=diff)])),
+    )
+
+
 def _materialized_cte_scan(name: str, ctx: BuildContext) -> LogicalPlan:
     """Plan + run the CTE body once; later references scan the
     materialized rows from an anonymous host table."""
@@ -251,8 +420,17 @@ def _materialized_cte_scan(name: str, ctx: BuildContext) -> LogicalPlan:
     if hit is None:
         from tidb_tpu.storage.table import ColumnInfo, Table, TableSchema
 
-        body = build_select(body_ast, ctx, None)
+        dup_only = id(body_ast) in ctx.cte_duponly
+        run_ast = body_ast
+        if dup_only:
+            # every consumer is a semi-join zone: the duplicate-detection
+            # self-join may collapse to a grouped min/max distinctness
+            # test, and the materialized rows may dedup either way
+            run_ast = _try_selfjoin_distinctness(body_ast) or body_ast
+        body = build_select(run_ast, ctx, None)
         rows = ctx.execute_subplan(body)
+        if dup_only and rows:
+            rows = list(dict.fromkeys(map(tuple, rows)))
         schema = TableSchema(
             name=f"__cte_{name}__",
             columns=[ColumnInfo(name=c.name or c.uid, type_=c.type_)
@@ -654,6 +832,8 @@ def build_select(stmt, ctx: BuildContext, outer: Optional[Scope] = None) -> Logi
             # keyed by the BODY's identity: a same-named CTE in another
             # scope is a different object and never aliases this one
             ctx.cte_multi.add(id(cte.select))
+            if _cte_semi_only(stmt, cte.name):
+                ctx.cte_duponly.add(id(cte.select))
     try:
         return _build_select_core(stmt, ctx, outer)
     finally:
@@ -1228,7 +1408,10 @@ def _exists_value(conj: A.EExists, ctx: BuildContext, scope: Scope) -> bool:
 
 
 def _in_subquery_to_join(conj: A.EIn, plan, scope, ctx: BuildContext):
-    sub = build_select(conj.subquery, ctx, scope)
+    # IN is duplicate-insensitive: an inline duplicate-detection
+    # self-join collapses to the grouped distinctness form
+    sub_ast = _try_selfjoin_distinctness(conj.subquery) or conj.subquery
+    sub = build_select(sub_ast, ctx, scope)
     if len(sub.schema) != 1:
         raise PlanError("IN subquery must return exactly one column")
     outer_expr = ctx.binder.bind_expr(conj.arg, scope)

@@ -280,7 +280,31 @@ class Session:
 
     # ------------------------------------------------------------------
 
-    def _exec_ctx(self, hints=()) -> ExecContext:
+    def _plan_capacity(self, plan) -> int:
+        """Chunk capacity sized to the plan, clamped to the configured
+        maximum. A fixed 1M-row capacity taxes every operator of a small
+        query with large-buffer allocation (TPC-DS Q95 at SF0.5 spent
+        2x its sqlite runtime on it); sizing to the largest base scan
+        keeps one-chunk execution for everything the plan can produce
+        linearly, while oversized intermediates simply stream in chunks
+        (the Volcano loop the host operators already run)."""
+        cap = self.chunk_capacity
+        if plan is None:
+            return cap
+        biggest = 0
+        stack = [plan]
+        while stack:
+            node = stack.pop()
+            t = getattr(node, "table", None)
+            if t is not None:
+                biggest = max(biggest, getattr(t, "n", 0))
+            stack.extend(getattr(node, "children", ()))
+        if biggest <= 0:
+            return cap
+        want = max(1 << 14, 1 << (biggest + (biggest >> 2)).bit_length())
+        return min(cap, want)
+
+    def _exec_ctx(self, hints=(), plan=None) -> ExecContext:
         from tidb_tpu.utils.memory import MemTracker
 
         quota = int(self.sysvars.get("tidb_mem_quota_query"))
@@ -290,7 +314,7 @@ class Session:
                 if q is not None:
                     quota = q  # unparseable hints are ignored, like TiDB warns
         return ExecContext(
-            chunk_capacity=self.chunk_capacity,
+            chunk_capacity=self._plan_capacity(plan),
             mem_tracker=MemTracker(
                 "query",
                 budget=quota,
@@ -317,7 +341,7 @@ class Session:
         # (a materialized CTE body can be a heavy join)
         root = self._build_root(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
-        rs = run_plan(root, self._exec_ctx(), n_visible=n_vis)
+        rs = run_plan(root, self._exec_ctx(plan=phys), n_visible=n_vis)
         return rs.rows
 
     def _plan_select(self, stmt):
@@ -371,7 +395,8 @@ class Session:
                 c = c.children[0]
             if isinstance(c, PProjection) and c.n_visible is not None and c.n_visible < len(phys.schema):
                 n_vis = c.n_visible
-        return run_plan(root, self._exec_ctx(hints=getattr(stmt, "hints", ())),
+        return run_plan(root, self._exec_ctx(hints=getattr(stmt, "hints", ()),
+                                     plan=phys),
                         n_visible=n_vis)
 
     # ------------------------------------------------------------------
@@ -1115,7 +1140,7 @@ class Session:
 
             root = self._build_root(phys)
             instrument(root)
-            run_plan(root, self._exec_ctx())  # execute; rows discarded
+            run_plan(root, self._exec_ctx(plan=phys))  # execute; rows discarded
             text = analyze_text(root)
             return ResultSet(names=["EXPLAIN ANALYZE"],
                              rows=[(line,) for line in text.split("\n")])
@@ -1141,7 +1166,7 @@ class Session:
         root = self._build_root(phys)
         instrument(root)
         t_build = _time.perf_counter()
-        run_plan(root, self._exec_ctx())
+        run_plan(root, self._exec_ctx(plan=phys))
         t_exec = _time.perf_counter()
 
         def ms(a, b):
