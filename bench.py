@@ -159,33 +159,48 @@ def pick_platform():
                     pass
 
 
-def machine_load():
+def machine_load(sample_s=0.25):
     """Snapshot of everything that could invalidate a measurement:
-    1/5/15-min load averages plus any OTHER busy python/compile process
-    (>50% of a core, cumulative) that would contend for the machine.
-    Recorded into the artifact before and after each config so a
-    perturbed number is visibly perturbed (round-3 lesson: the headline
-    moved -38% with no load evidence either way)."""
+    1/5/15-min load averages plus any OTHER python/compile process
+    CURRENTLY burning >50% of a core — measured as a CPU-time rate over
+    a short two-sample window, not cumulative seconds (a long-lived but
+    idle daemon must not read as busy). Recorded into the artifact
+    before and after each config so a perturbed number is visibly
+    perturbed (round-3 lesson: the headline moved -38% with no load
+    evidence either way)."""
     snap = {"loadavg": [round(x, 2) for x in os.getloadavg()]}
-    try:
+
+    def cpu_sample():
+        out = {}
         me = os.getpid()
-        busy = []
+        tck = os.sysconf("SC_CLK_TCK")
         for pid in os.listdir("/proc"):
             if not pid.isdigit() or int(pid) == me:
                 continue
             try:
                 with open(f"/proc/{pid}/stat") as f:
                     parts = f.read().split()
-                utime, stime = int(parts[13]), int(parts[14])
-                cpu_s = (utime + stime) / os.sysconf("SC_CLK_TCK")
+                cpu_s = (int(parts[13]) + int(parts[14])) / tck
                 with open(f"/proc/{pid}/cmdline") as f:
                     cmd = f.read().replace("\x00", " ").strip()
             except (OSError, IndexError, ValueError):
                 continue
-            if cpu_s > 30 and any(k in cmd for k in
-                                  ("python", "pytest", "cc1plus", "clang",
-                                   "ninja", "node")):
-                busy.append(f"pid{pid}:{int(cpu_s)}s:{cmd[:60]}")
+            if any(k in cmd for k in ("python", "pytest", "cc1plus",
+                                      "clang", "ninja", "node")):
+                out[pid] = (cpu_s, cmd)
+        return out
+
+    try:
+        first = cpu_sample()
+        time.sleep(sample_s)
+        busy = []
+        for pid, (c1, cmd) in cpu_sample().items():
+            c0 = first.get(pid)
+            if c0 is None:
+                continue
+            rate = (c1 - c0[0]) / sample_s
+            if rate > 0.5:
+                busy.append(f"pid{pid}:{rate:.1f}cores:{cmd[:60]}")
         snap["busy_procs"] = busy[:8]
     except OSError:
         pass
@@ -220,7 +235,7 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
         check = "ok" if ok else f"MISMATCH: {msg}"
         vs = cpu_s / best
     if extra is not None and tag:
-        extra[f"{tag}_load_after"] = machine_load()["loadavg"]
+        extra[f"{tag}_load_after"] = machine_load()
     log(f"#   warm={warm:.2f}s best={best * 1e3:.1f}ms"
         + (f" sqlite={cpu_s * 1e3:.1f}ms" if cpu_s else "") + f" check={check}")
     return rows / best, vs, best, check

@@ -96,6 +96,10 @@ def main():
             print(f"floors[{plat_key}] set: {measured}")
             return
 
+        if not os.path.exists(FLOOR_PATH):
+            print("INCONCLUSIVE: no PERF_FLOOR.json committed yet "
+                  "(run with --set on an idle machine to create it)")
+            sys.exit(2)
         floors = json.load(open(FLOOR_PATH)).get(plat_key)
         if floors is None:
             print(f"INCONCLUSIVE: no committed floor for platform {plat_key}")

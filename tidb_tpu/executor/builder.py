@@ -19,6 +19,7 @@ from tidb_tpu.executor.sort import LimitExec, SortExec, TopNExec, UnionExec
 from tidb_tpu.planner.physical import (
     PHashAgg,
     PHashJoin,
+    PIndexRangeScan,
     PLimit,
     PProjection,
     PPointGet,
@@ -75,6 +76,21 @@ def build_executor(plan: PhysicalPlan) -> Executor:
             stages=scan_stages_for(base, stages),
             index_name=base.index_name,
             key_values=base.key_values,
+            out_schema=plan.schema,
+        )
+    if isinstance(base, PIndexRangeScan):
+        from tidb_tpu.executor.scan import IndexRangeScanExec
+
+        return IndexRangeScanExec(
+            schema=base.schema,
+            table=base.table,
+            stages=scan_stages_for(base, stages),
+            index_name=base.index_name,
+            eq_values=base.eq_values,
+            range_lo=base.range_lo,
+            range_hi=base.range_hi,
+            lo_incl=base.lo_incl,
+            hi_incl=base.hi_incl,
             out_schema=plan.schema,
         )
     if isinstance(base, PScan):

@@ -21,7 +21,8 @@ from tidb_tpu.expression.compiler import compile_expr, compile_predicate
 from tidb_tpu.planner.binder import PlanCol
 from tidb_tpu.utils.jitcache import cached_jit
 
-__all__ = ["TableScanExec", "PointGetExec", "make_pipeline_fn", "SelectionExec", "ProjectionExec"]
+__all__ = ["TableScanExec", "PointGetExec", "IndexRangeScanExec",
+           "make_pipeline_fn", "SelectionExec", "ProjectionExec"]
 
 
 def make_pipeline_fn(stages: List) -> Callable:
@@ -105,6 +106,26 @@ class TableScanExec(Executor):
             return chunk
         return None
 
+    def _emit_rows(self, rows) -> Chunk:
+        """Materialize a physical row-id set into one pow2-capacity
+        chunk and run the eager residual pipeline — shared by the point
+        and range index access paths."""
+        cap = 8
+        while cap < len(rows):
+            cap *= 2
+        cols = {}
+        for c in self.scan_schema:
+            d = self.table.data[c.name][rows]
+            v = self.table.valid[c.name][rows]
+            cols[c.uid] = Column.from_numpy(d, c.type_, valid=v, capacity=cap)
+        sel = np.zeros(cap, dtype=np.bool_)
+        sel[: len(rows)] = True
+        chunk = Chunk(cols, sel)
+        if self._fn is not None:
+            chunk = self._fn(chunk)
+        self.stats.chunks += 1
+        return chunk
+
 
 class PointGetExec(TableScanExec):
     """O(log n) unique-index point lookup feeding one small chunk (ref:
@@ -137,22 +158,48 @@ class PointGetExec(TableScanExec):
         if self._i >= len(self._slices):
             return None
         self._i += 1
-        rows = self._rows
-        cap = 8
-        while cap < len(rows):
-            cap *= 2
-        cols = {}
-        for c in self.scan_schema:
-            d = self.table.data[c.name][rows]
-            v = self.table.valid[c.name][rows]
-            cols[c.uid] = Column.from_numpy(d, c.type_, valid=v, capacity=cap)
-        sel = np.zeros(cap, dtype=np.bool_)
-        sel[: len(rows)] = True
-        chunk = Chunk(cols, sel)
-        if self._fn is not None:
-            chunk = self._fn(chunk)
-        self.stats.chunks += 1
-        return chunk
+        return self._emit_rows(self._rows)
+
+
+class IndexRangeScanExec(TableScanExec):
+    """Index range access: binary-search the sorted index cache into a
+    compact row-id set, then stage only those rows (ref: executor's
+    IndexLookUpExecutor index→table double read, SURVEY.md:91). Like
+    PointGetExec, the pipeline runs eagerly — range bounds are literals
+    and a jitted pipeline per ad-hoc range would churn XLA compiles —
+    but rows stream in chunk_capacity batches, so a wide range behaves
+    like a pre-filtered scan, not one giant gather."""
+
+    def __init__(self, schema, table, stages, index_name, eq_values,
+                 range_lo, range_hi, lo_incl, hi_incl, out_schema=None):
+        super().__init__(schema, table, stages, out_schema)
+        self.index_name = index_name
+        self.eq_values = eq_values
+        self.range_lo = range_lo
+        self.range_hi = range_hi
+        self.lo_incl = lo_incl
+        self.hi_incl = hi_incl
+
+    def open(self, ctx: ExecContext) -> None:
+        Executor.open(self, ctx)
+        self.ctx = ctx
+        self._fn = make_pipeline_fn(self.stages) if self.stages else None
+        rows = self.table.index_range_lookup(
+            self.index_name, self.eq_values, self.range_lo, self.range_hi,
+            self.lo_incl, self.hi_incl,
+            read_ts=ctx.read_ts, marker=ctx.txn_marker)
+        self._rows = rows
+        cap = ctx.chunk_capacity
+        self._slices = [(s, min(s + cap, len(rows)))
+                        for s in range(0, len(rows), cap)] or [(0, 0)]
+        self._i = 0
+
+    def next(self) -> Optional[Chunk]:
+        if self._i >= len(self._slices):
+            return None
+        start, end = self._slices[self._i]
+        self._i += 1
+        return self._emit_rows(self._rows[start:end])
 
 
 class SelectionExec(Executor):

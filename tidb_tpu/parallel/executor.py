@@ -714,7 +714,7 @@ def _all_scans_pointy(plan: PhysicalPlan) -> bool:
     whole plan touches a handful of rows, so the O(log n) host path wins.
     A point-get LEAF inside a big join must NOT drag the rest of the
     plan off the mesh — the fragment treats it as a filtered scan."""
-    from tidb_tpu.planner.physical import PPointGet
+    from tidb_tpu.planner.physical import PIndexRangeScan, PPointGet
 
     found = False
     stack = [plan]
@@ -722,6 +722,14 @@ def _all_scans_pointy(plan: PhysicalPlan) -> bool:
         node = stack.pop()
         if isinstance(node, PPointGet):
             found = True
+        elif isinstance(node, PIndexRangeScan):
+            # a selective range behaves like a point get (compact
+            # row-id set via the sorted cache); a wide one must stay
+            # eligible for the mesh like any big scan
+            if node.est_rows <= 4096:
+                found = True
+            else:
+                return False
         elif isinstance(node, PScan) and node.table is not None:
             if node.table.n > 4096:
                 return False

@@ -30,7 +30,8 @@ from tidb_tpu.planner.logical import (
 
 __all__ = [
     "PhysicalPlan", "PScan", "PSelection", "PProjection", "PHashAgg",
-    "PHashJoin", "PSort", "PTopN", "PLimit", "PUnion", "PWindow", "lower", "explain_text",
+    "PHashJoin", "PSort", "PTopN", "PLimit", "PUnion", "PWindow",
+    "PPointGet", "PIndexRangeScan", "lower", "explain_text",
 ]
 
 
@@ -88,65 +89,189 @@ class PPointGet(PScan):
                 f"key:{tuple(self.key_values)!r}")
 
 
+@dataclass
+class PIndexRangeScan(PScan):
+    """Index range access (ref: planner/core's IndexRangeScan feeding
+    IndexLookUpExecutor, SURVEY.md:91): equality literals pin a prefix
+    of the index key, an optional [lo, hi] interval bounds the next key
+    column, and the executor binary-searches the sorted index cache
+    (storage/table.py index_range_lookup) into a compact row-id set.
+    The full pushed_cond is retained so residual conjuncts compose and
+    plain-scan fallback paths stay correct."""
+
+    index_name: str = ""
+    eq_values: Tuple = ()
+    range_lo: object = None
+    range_hi: object = None
+    lo_incl: bool = True
+    hi_incl: bool = True
+
+    def op_name(self):
+        return "IndexRangeScan"
+
+    def op_info(self):
+        parts = [f"table:{self.table_name}", f"index:{self.index_name}"]
+        if self.eq_values:
+            parts.append(f"eq:{tuple(self.eq_values)!r}")
+        if self.range_lo is not None or self.range_hi is not None:
+            lo = "-inf" if self.range_lo is None else str(self.range_lo)
+            hi = "+inf" if self.range_hi is None else str(self.range_hi)
+            lb = "[" if self.lo_incl else "("
+            rb = "]" if self.hi_incl else ")"
+            parts.append(f"range:{lb}{lo},{hi}{rb}")
+        return ", ".join(parts)
+
+
+# a gathered index row costs more than a streamed scan row (random access
+# + eager residual eval); range access must be selective enough to pay it
+_RANGE_ROW_COST = 4.0
+
+
 def inject_point_get(plan: PhysicalPlan) -> PhysicalPlan:
-    """Replace full scans with PPointGet where the pushed filter pins a
-    unique index with integer-typed equality literals."""
+    """Access-path selection over base scans: replace full scans with
+    PPointGet where the pushed filter pins a unique index with
+    integer-typed equality literals, else with PIndexRangeScan where
+    equalities pin an index prefix (plus an optional interval on the
+    next key column) selectively enough to beat the scan."""
     from tidb_tpu.expression.expr import Call, ColumnRef, Literal
+    from tidb_tpu.statistics import table_stats, _range_fraction
     from tidb_tpu.types import TypeKind
     import numpy as np
 
-    def eq_literals(cond, uid_to_col):
-        eqs = {}
+    def _int_col_lit(a, b, uid_to_col):
+        """Resolved (PlanCol, int literal) for an int-typed
+        col-vs-literal compare, else None. Plain INT columns compared
+        to INT literals only: other int64-backed kinds (DECIMAL scale,
+        DATE epoch days, ...) store RESCALED encodings that a raw
+        literal does not match — the compiler rescales at eval time,
+        but an index key probe built from the literal would miss."""
+        if not (isinstance(a, ColumnRef) and isinstance(b, Literal)
+                and b.value is not None):
+            return None
+        col = uid_to_col.get(a.name)
+        if col is None:
+            return None
+        if (col.type_.kind != TypeKind.INT or b.type_.kind != TypeKind.INT
+                or not isinstance(b.value, (int, np.integer))):
+            return None
+        return col, b
+
+    def collect_bounds(cond, uid_to_col):
+        """Per column name: equality literal and/or accumulated range
+        bounds from the AND-tree of the pushed filter."""
+        eqs, los, his = {}, {}, {}
 
         def visit(e):
             if isinstance(e, Call) and e.op == "and":
                 for a in e.args:
                     visit(a)
                 return
-            if isinstance(e, Call) and e.op == "eq" and len(e.args) == 2:
+            if isinstance(e, Call) and e.op in ("eq", "lt", "le", "gt", "ge") \
+                    and len(e.args) == 2:
                 a, b = e.args
+                op = e.op
                 if isinstance(a, Literal):
                     a, b = b, a
-                if (isinstance(a, ColumnRef) and isinstance(b, Literal)
-                        and b.value is not None):
-                    col = uid_to_col.get(a.name)
-                    if col is not None and col.name not in eqs:
-                        eqs[col.name] = (col, b)
+                    op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                          "eq": "eq"}[op]
+                hit = _int_col_lit(a, b, uid_to_col)
+                if hit is None:
+                    return
+                col, lit = hit
+                v = int(lit.value)
+                name = col.name
+                if op == "eq":
+                    if name not in eqs:
+                        eqs[name] = v
+                elif op in ("gt", "ge"):
+                    cur = los.get(name)
+                    cand = (v, op == "ge")
+                    # tightest lower bound wins; exclusivity breaks ties
+                    if cur is None or cand[0] > cur[0] or (
+                            cand[0] == cur[0] and not cand[1]):
+                        los[name] = cand
+                else:
+                    cur = his.get(name)
+                    cand = (v, op == "le")
+                    if cur is None or cand[0] < cur[0] or (
+                            cand[0] == cur[0] and not cand[1]):
+                        his[name] = cand
 
         visit(cond)
-        return eqs
+        return eqs, los, his
+
+    def best_access(node):
+        uid_to_col = {c.uid: c for c in node.schema}
+        eqs, los, his = collect_bounds(node.pushed_cond, uid_to_col)
+        if not eqs and not los and not his:
+            return None
+        table = node.table
+        stats = table_stats(table)
+        n_rows = float(stats.n_rows) if stats is not None \
+            else float(table.live_rows)
+        best = None  # (est, node)
+        for idx in getattr(table, "indexes", {}).values():
+            if not idx.columns:
+                continue
+            prefix = []
+            for cname in idx.columns:
+                if cname in eqs:
+                    prefix.append(eqs[cname])
+                else:
+                    break
+            if idx.unique and len(prefix) == len(idx.columns):
+                return (0.0, PPointGet(
+                    schema=node.schema, est_rows=1.0, db=node.db,
+                    table_name=node.table_name, table=node.table,
+                    pushed_cond=node.pushed_cond,
+                    index_name=idx.name, key_values=tuple(prefix)))
+            # range access: eq prefix plus optional interval on the
+            # next key column
+            lo = hi = None
+            lo_incl = hi_incl = True
+            if len(prefix) < len(idx.columns):
+                nxt = idx.columns[len(prefix)]
+                if nxt in los:
+                    lo, lo_incl = los[nxt]
+                if nxt in his:
+                    hi, hi_incl = his[nxt]
+            if not prefix and lo is None and hi is None:
+                continue
+            # selectivity: product of 1/ndv per eq column, times the
+            # histogram fraction of the interval
+            sel = 1.0
+            for i, _ in enumerate(prefix):
+                cs = stats.cols.get(idx.columns[i]) if stats else None
+                sel *= 1.0 / max(cs.ndv, 1) if cs is not None else 0.1
+            if lo is not None or hi is not None:
+                nxt = idx.columns[len(prefix)]
+                cs = stats.cols.get(nxt) if stats else None
+                if cs is not None:
+                    sel *= _range_fraction(
+                        cs, -np.inf if lo is None else float(lo),
+                        np.inf if hi is None else float(hi))
+                else:
+                    sel *= 0.33
+            est = max(n_rows * sel, 1.0)
+            if est * _RANGE_ROW_COST >= n_rows:
+                continue  # not selective enough: the full scan wins
+            if best is None or est < best[0]:
+                best = (est, PIndexRangeScan(
+                    schema=node.schema, est_rows=est, db=node.db,
+                    table_name=node.table_name, table=node.table,
+                    pushed_cond=node.pushed_cond,
+                    index_name=idx.name, eq_values=tuple(prefix),
+                    range_lo=lo, range_hi=hi,
+                    lo_incl=lo_incl, hi_incl=hi_incl))
+        return best
 
     def rewrite(node):
         node.children = [rewrite(c) for c in node.children]
         if (type(node) is PScan and node.table is not None
                 and node.pushed_cond is not None):
-            uid_to_col = {c.uid: c for c in node.schema}
-            eqs = eq_literals(node.pushed_cond, uid_to_col)
-            for idx in getattr(node.table, "indexes", {}).values():
-                if not idx.unique or not idx.columns:
-                    continue
-                vals = []
-                for cname in idx.columns:
-                    hit = eqs.get(cname)
-                    if hit is None:
-                        break
-                    col, lit = hit
-                    # plain INT columns compared to INT literals only:
-                    # other int64-backed kinds (DECIMAL scale, DATE epoch
-                    # days, ...) store RESCALED encodings that a raw
-                    # literal does not match — the compiler rescales at
-                    # eval time, but the index key probe would miss
-                    if (col.type_.kind != TypeKind.INT
-                            or lit.type_.kind != TypeKind.INT
-                            or not isinstance(lit.value, (int, np.integer))):
-                        break
-                    vals.append(int(lit.value))
-                else:
-                    return PPointGet(
-                        schema=node.schema, est_rows=1.0, db=node.db,
-                        table_name=node.table_name, table=node.table,
-                        pushed_cond=node.pushed_cond,
-                        index_name=idx.name, key_values=tuple(vals))
+            best = best_access(node)
+            if best is not None:
+                return best[1]
         return node
 
     return rewrite(plan)

@@ -805,13 +805,9 @@ class Table:
             return None
         return tuple(mat[0].tolist())
 
-    def index_lookup(self, idx_name: str, key_vals, read_ts=None,
-                     marker: int = 0) -> np.ndarray:
-        """Visible physical row positions whose index key equals
-        `key_vals` — O(log n) against a sorted (key, row) cache per
-        index+version instead of a full scan (ref: the reference's
-        PointGetExecutor reading the index KV record, SURVEY.md:91).
-        MVCC versions share a key; visibility filters them here."""
+    def _sorted_index(self, idx_name: str):
+        """Sorted (keys, row ids) for `idx_name`, cached per version —
+        the shared substrate of point and range index access."""
         idx = self.indexes[idx_name]
         hit = self._lookup_cache.get(idx_name)
         if hit is None or hit[0] != self.version:
@@ -822,13 +818,12 @@ class Table:
             order = np.argsort(keys, kind="stable")
             hit = (self.version, keys[order], ids[order])
             self._lookup_cache[idx_name] = hit
-        _, skeys, srows = hit
-        probe = np.zeros(1, dtype=skeys.dtype)
-        for i, v in enumerate(key_vals):
-            probe[f"k{i}"] = np.int64(v)
-        lo = np.searchsorted(skeys, probe[0], side="left")
-        hi = np.searchsorted(skeys, probe[0], side="right")
-        cand = srows[lo:hi]
+        return hit[1], hit[2]
+
+    def _mvcc_visible(self, cand: np.ndarray, read_ts=None,
+                      marker: int = 0) -> np.ndarray:
+        """Filter candidate physical rows to the versions visible at
+        `read_ts` (own-txn writes included via `marker`)."""
         if len(cand) == 0:
             return cand
         b = self.begin_ts[cand]
@@ -841,6 +836,64 @@ class Table:
                 keep = (((b <= read_ts) | (b == marker))
                         & (e > read_ts) & (e != marker))
         return cand[keep]
+
+    def index_lookup(self, idx_name: str, key_vals, read_ts=None,
+                     marker: int = 0) -> np.ndarray:
+        """Visible physical row positions whose index key equals
+        `key_vals` — O(log n) against a sorted (key, row) cache per
+        index+version instead of a full scan (ref: the reference's
+        PointGetExecutor reading the index KV record, SURVEY.md:91).
+        MVCC versions share a key; visibility filters them here."""
+        skeys, srows = self._sorted_index(idx_name)
+        probe = np.zeros(1, dtype=skeys.dtype)
+        for i, v in enumerate(key_vals):
+            probe[f"k{i}"] = np.int64(v)
+        lo = np.searchsorted(skeys, probe[0], side="left")
+        hi = np.searchsorted(skeys, probe[0], side="right")
+        return self._mvcc_visible(srows[lo:hi], read_ts, marker)
+
+    def index_range_lookup(self, idx_name: str, eq_vals, lo=None, hi=None,
+                           lo_incl: bool = True, hi_incl: bool = True,
+                           read_ts=None, marker: int = 0) -> np.ndarray:
+        """Visible physical rows whose index key has prefix `eq_vals`
+        and whose next key column lies in [lo, hi] (either bound open
+        when None, inclusive per the _incl flags) — two binary searches
+        against the same sorted cache point lookups use (ref: the
+        reference's IndexRangeScan feeding IndexLookUpExecutor,
+        SURVEY.md:91). Rows with NULL in any key column are absent from
+        the cache, matching MySQL range-access semantics."""
+        skeys, srows = self._sorted_index(idx_name)
+        p = len(eq_vals)
+        i64 = np.iinfo(np.int64)
+
+        def bound(range_val, fill, side):
+            probe = np.zeros(1, dtype=skeys.dtype)
+            for i, v in enumerate(eq_vals):
+                probe[f"k{i}"] = np.int64(v)
+            for i, name in enumerate(skeys.dtype.names):
+                if i < p:
+                    continue
+                probe[name] = np.int64(range_val) if (
+                    i == p and range_val is not None) else fill
+            return int(np.searchsorted(skeys, probe[0], side=side))
+
+        # lower edge: >= lo (or > lo when exclusive); open bound floors
+        # the suffix at int64 min so the whole eq-prefix group is kept
+        if lo is None:
+            start = bound(None, i64.min, "left")
+        elif lo_incl:
+            start = bound(lo, i64.min, "left")
+        else:
+            start = bound(lo, i64.max, "right")
+        if hi is None:
+            stop = bound(None, i64.max, "right")
+        elif hi_incl:
+            stop = bound(hi, i64.max, "right")
+        else:
+            stop = bound(hi, i64.min, "left")
+        if stop <= start:
+            return np.zeros(0, dtype=np.int64)
+        return self._mvcc_visible(srows[start:stop], read_ts, marker)
 
     def _uniq_sorted(self, idx: IndexInfo) -> np.ndarray:
         """Sorted key set of present rows, cached per table version.
