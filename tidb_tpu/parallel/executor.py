@@ -572,6 +572,16 @@ class DistFragmentExec(HashAggExec):
         from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
 
         mesh = self._cache.mesh
+        if prog.topn is not None:
+            # a group's partials span batches: a per-batch top-k would
+            # drop state a later batch needed — recompile without it
+            # (the root TopNExec still bounds what the user sees)
+            prog = compile_fragment(
+                prog.agg, mesh,
+                mesh.shape[dcn_axis] * mesh.shape[shard_axis])
+            if prog is None:
+                self._fall_back_single_chip()
+                return
         src = prog.sources[stream_idx]
         table = src.scan.table
         self._cache.evict(table)  # its full sharding must not stay resident
@@ -737,6 +747,38 @@ def _all_scans_pointy(plan: PhysicalPlan) -> bool:
     return found
 
 
+def _try_dist_topn(plan, cache) -> Optional[Executor]:
+    """TopN whose sort keys resolved onto a generic dist agg below
+    (planner's resolve_topn_pushdown): compile the fragment with a
+    per-shard partial top-k, so only n_parts * k candidate groups ever
+    reach the host; the root TopNExec applies the exact ordering over
+    that superset (SURVEY.md:93 — the reference pushes TopN into
+    coprocessors the same way)."""
+    from tidb_tpu.planner.physical import PProjection, PTopN
+
+    if getattr(plan, "pushdown", None) is None:
+        return None
+    agg, items = plan.pushdown
+    k = plan.count + plan.offset  # bounds pre-checked by the resolver
+    prog = compile_fragment(
+        agg, cache.mesh,
+        cache.mesh.shape[dcn_axis] * cache.mesh.shape[shard_axis],
+        topn=(tuple(items), k))
+    if prog is None:
+        return None
+    ex: Executor = DistFragmentExec(agg, prog, cache)
+    chain = []
+    node = plan.child
+    while isinstance(node, PProjection):
+        chain.append(node)
+        node = node.child
+    if node is not agg:
+        return None  # resolver and builder walked different chains
+    for p in reversed(chain):
+        ex = ProjectionExec(p.schema, ex, p.exprs)
+    return TopNExec(plan.schema, ex, plan.items, plan.count, plan.offset)
+
+
 def build_dist_executor(plan: PhysicalPlan, cache: ShardCache,
                         full: bool = True) -> Executor:
     """Build an executor tree, running distributable fragments on the mesh.
@@ -791,6 +833,10 @@ def build_dist_executor(plan: PhysicalPlan, cache: ShardCache,
     if isinstance(plan, PSort):
         return SortExec(plan.schema, build_dist_executor(plan.child, cache, full), plan.items)
     if isinstance(plan, PTopN):
+        if full:
+            ex = _try_dist_topn(plan, cache)
+            if ex is not None:
+                return ex
         return TopNExec(plan.schema, build_dist_executor(plan.child, cache, full), plan.items,
                         plan.count, plan.offset)
     if isinstance(plan, PLimit):

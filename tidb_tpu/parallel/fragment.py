@@ -147,6 +147,10 @@ class FragmentProgram:
     # the build side of a non-inner join, where partitioning the build
     # set changes per-probe-row match decisions (semi/anti/left)
     stream_unsafe: frozenset = frozenset()
+    # (resolved items, k) when a per-shard partial top-k is compiled in;
+    # streaming executions must recompile without it (a group's partials
+    # span batches — dropping it in one batch would corrupt its state)
+    topn: object = None
 
 
 class _Unsupported(Exception):
@@ -469,9 +473,68 @@ class _Compiler:
 
         return emit
 
+    # -- per-shard partial top-k ------------------------------------------
+
+    def _topn_select(self, items, nk, layout, kmax):
+        """Build fn(n, fk, fkv, red) -> (n', fk', fkv', red') keeping
+        each shard's top `kmax` groups under the resolved sort items —
+        the exchange routes every group to exactly one shard, so the
+        union of per-shard top-k sets contains the global top-k; the
+        root TopNExec applies the exact host ordering over that superset
+        (the mesh analogue of the reference's TopN-into-coprocessor
+        pushdown, SURVEY.md:93). Encodings mirror sort.py's _sort_order:
+        NULLs first ASC / last DESC, dead lanes always last; desc ints
+        invert via ~x (order-exact), floats negate."""
+        self.sig.append(f"topn:{items!r}:{kmax}")
+
+        def select(n, fk, fkv, red):
+            state = {name: arr for (name, _), arr in zip(layout, red)}
+            S = (fk[0] if nk else red[0]).shape[0]
+            kcap = min(kmax, S)
+            live = jnp.arange(S, dtype=jnp.int64) < n
+            ops = []
+            for kind, idx, desc in items:
+                if kind == "key":
+                    data, valid = fk[idx], fkv[idx]
+                elif kind == "cnt":
+                    data = state[f"a{idx}.cnt"]
+                    valid = jnp.ones(S, dtype=jnp.bool_)
+                elif kind == "avg":
+                    c = state[f"a{idx}.cnt"]
+                    data = (state[f"a{idx}.sum"].astype(jnp.float64)
+                            / jnp.maximum(c, 1).astype(jnp.float64))
+                    valid = c > 0
+                else:  # sum | min | max: NULL when no non-null input
+                    data = state[f"a{idx}.{kind}"]
+                    valid = state[f"a{idx}.cnt"] > 0
+                rank = jnp.where(
+                    ~live, jnp.int32(2),
+                    jnp.where(valid, jnp.int32(0) if desc else jnp.int32(1),
+                              jnp.int32(1) if desc else jnp.int32(0)))
+                if data.dtype == jnp.bool_:
+                    data = data.astype(jnp.int64)
+                if jnp.issubdtype(data.dtype, jnp.floating):
+                    key = jnp.where(valid & live, data.astype(jnp.float64), 0.0)
+                    if desc:
+                        key = -key
+                else:
+                    key = jnp.where(valid & live, data.astype(jnp.int64), 0)
+                    if desc:
+                        key = ~key
+                ops += [rank, key]
+            perm = jax.lax.sort(
+                tuple(ops) + (jnp.arange(S, dtype=jnp.int64),),
+                num_keys=len(ops))[-1][:kcap]
+            return (jnp.minimum(n, kcap),
+                    [a[perm] for a in fk], [a[perm] for a in fkv],
+                    [a[perm] for a in red])
+
+        return select
+
     # -- aggregation root --------------------------------------------------
 
-    def compile_agg(self, agg: PHashAgg) -> Tuple[Callable, str, List[int]]:
+    def compile_agg(self, agg: PHashAgg,
+                    topn=None) -> Tuple[Callable, str, List[int]]:
         # the agg child must peel to a real sharded scan or a join tree;
         # anything else would make the whole input a replicated broadcast
         _, base = peel_stages(agg.child)
@@ -502,6 +565,8 @@ class _Compiler:
         partial = make_partial_kernel(agg.group_exprs, agg.aggs)
         layout = _state_layout(agg.aggs)
         nk = len(agg.group_exprs)
+        topn_fn = (self._topn_select(topn[0], nk, layout, topn[1])
+                   if topn is not None else None)
         g_agg = self._add_growth(2.0, "exch")
         n_parts = self.n_parts
         # estimate-sized shrink targets (see _compact): the partial sort
@@ -552,6 +617,8 @@ class _Compiler:
             # host finalize is a straight per-part conversion — no merge
             n, fk, fkv, red = _sort_reduce(rbits, rkv, rkd, recv_sel,
                                            payload, ops, exact=True)
+            if topn_fn is not None:
+                n, fk, fkv, red = topn_fn(n, fk, fkv, red)
             out = {"n": n[None]}
             for i in range(nk):
                 out[f"k{i}.d"] = fk[i]
@@ -563,11 +630,16 @@ class _Compiler:
         return emit, "generic", []
 
 
-def compile_fragment(agg: PHashAgg, mesh, n_parts: int) -> Optional[FragmentProgram]:
-    """Try to compile an agg-rooted subtree; None if not distributable."""
+def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
+                     topn=None) -> Optional[FragmentProgram]:
+    """Try to compile an agg-rooted subtree; None if not distributable.
+    `topn` = (resolved items, k) applies a per-shard partial top-k to
+    the generic group tables before they leave the device (SURVEY.md:93
+    TopN pushdown); ignored for segment aggs, whose bounded states are
+    already cheap to rank on the host."""
     c = _Compiler(n_parts)
     try:
-        emit, out_kind, domains = c.compile_agg(agg)
+        emit, out_kind, domains = c.compile_agg(agg, topn=topn)
     except _Unsupported:
         return None
     if not c.sources:
@@ -614,4 +686,5 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int) -> Optional[FragmentProg
         growth_defaults=tuple(c.growth_defaults),
         growth_kinds=tuple(c.growth_kinds),
         stream_unsafe=frozenset(c.stream_unsafe),
+        topn=topn if out_kind == "generic" else None,
     )

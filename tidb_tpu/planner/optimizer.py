@@ -8,7 +8,13 @@ from typing import Callable, Optional
 from tidb_tpu.parser import ast as A
 from tidb_tpu.planner.binder import Binder
 from tidb_tpu.planner.logical import BuildContext, build_select
-from tidb_tpu.planner.physical import PhysicalPlan, inject_point_get, lower
+from tidb_tpu.planner.physical import (
+    PhysicalPlan,
+    PTopN,
+    inject_point_get,
+    lower,
+    resolve_topn_pushdown,
+)
 from tidb_tpu.planner.rules import optimize_logical
 
 __all__ = ["plan_statement"]
@@ -30,4 +36,17 @@ def plan_statement(
     logical = build_select(stmt, ctx)
     logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or (),
                                cascades=cascades, n_parts=n_parts)
-    return inject_point_get(lower(logical))
+    phys = inject_point_get(lower(logical))
+    if n_parts > 1:
+        _annotate_topn(phys)
+    return phys
+
+
+def _annotate_topn(plan: PhysicalPlan) -> None:
+    """Mark TopN nodes whose sort keys resolve onto a distributable
+    generic agg below (per-shard partial top-k; SURVEY.md:93). The
+    dist builder consumes the descriptor; EXPLAIN shows the intent."""
+    if isinstance(plan, PTopN):
+        plan.pushdown = resolve_topn_pushdown(plan)
+    for c in plan.children:
+        _annotate_topn(c)

@@ -350,9 +350,69 @@ class PTopN(PhysicalPlan):
     count: int = 0
     offset: int = 0
     task: str = "root"
+    # per-shard partial top-k descriptor (resolve_topn_pushdown): each
+    # sort item mapped onto the distributed agg's group-key/state slots
+    pushdown: object = None
 
     def op_info(self):
-        return f"limit:{self.count} offset:{self.offset}"
+        info = f"limit:{self.count} offset:{self.offset}"
+        if self.pushdown is not None:
+            info += ", partial_topn:device"
+        return info
+
+
+def resolve_topn_pushdown(topn: PTopN):
+    """Map a TopN's sort items onto the group-key/agg-state slots of a
+    generic-strategy HashAgg reached through pass-through projections —
+    the mesh analogue of the reference's TopN-into-coprocessor pushdown
+    (SURVEY.md:93). Returns (agg, [(kind, index, desc), ...]) with kind
+    in {key, cnt, sum, min, max, avg}, or None when any item fails to
+    resolve (a Selection/HAVING between TopN and agg, a computed sort
+    expression, DISTINCT aggregates). The per-shard top-k is a superset
+    filter: the root TopNExec still applies the exact host ordering."""
+    from tidb_tpu.expression.expr import ColumnRef
+
+    k = topn.count + topn.offset
+    if k <= 0 or k > (1 << 18):
+        return None  # a huge k gains nothing over fetching every group
+    node = topn.child
+    # walk pass-through projections, accumulating uid -> expr maps;
+    # projections are 1:1 on rows so they never change which groups
+    # belong in the top k — a Selection (HAVING) would, so it bails
+    maps = []
+    while isinstance(node, PProjection):
+        maps.append({c.uid: e for c, e in zip(node.schema, node.exprs)})
+        node = node.child
+    if not isinstance(node, PHashAgg) or node.strategy != "generic":
+        return None
+    if not node.group_exprs or any(a.distinct for a in node.aggs):
+        return None
+    key_of = {uid: i for i, uid in enumerate(node.group_uids)}
+    agg_of = {a.uid: j for j, a in enumerate(node.aggs)}
+    resolved = []
+    for expr, desc in topn.items:
+        e = expr
+        for m in maps:  # outermost projection first
+            if not isinstance(e, ColumnRef):
+                return None
+            e = m.get(e.name)
+            if e is None:
+                return None
+        if not isinstance(e, ColumnRef):
+            return None
+        if e.name in key_of:
+            resolved.append(("key", key_of[e.name], desc))
+        elif e.name in agg_of:
+            j = agg_of[e.name]
+            func = node.aggs[j].func
+            kind = {"count": "cnt", "sum": "sum", "min": "min",
+                    "max": "max", "avg": "avg"}.get(func)
+            if kind is None:
+                return None
+            resolved.append((kind, j, desc))
+        else:
+            return None
+    return node, resolved
 
 
 @dataclass
