@@ -172,11 +172,16 @@ def _ident_max(dtype):
 def _state_layout(aggs: List[AggSpec]) -> List[Tuple[str, str]]:
     """Per-agg mergeable state arrays: [(name, merge op)]. Mirrors
     aggregate.py's partial-state dict keys (cnt/sum/min/max)."""
+    from tidb_tpu.executor.aggregate import needs_sum_limbs
+
     layout = []
     for j, a in enumerate(aggs):
         layout.append((f"a{j}.cnt", "sum"))
         if a.func in ("sum", "avg"):
             layout.append((f"a{j}.sum", "sum"))
+            if needs_sum_limbs(a):
+                # two-limb exact decimal states: .sum = low 32-bit limb
+                layout.append((f"a{j}.sumhi", "sum"))
         elif a.func == "min":
             layout.append((f"a{j}.min", "min"))
         elif a.func == "max":
@@ -208,9 +213,22 @@ def make_partial_kernel(group_exprs, aggs: List[AggSpec]):
             payload.append(ok.astype(jnp.int64))
             ops.append("sum")  # the .cnt slot
             if a.func in ("sum", "avg"):
+                from tidb_tpu.executor.aggregate import (
+                    needs_sum_limbs,
+                    split_limbs,
+                )
+
                 dt = jnp.float64 if a.arg.type_.kind == TypeKind.FLOAT else jnp.int64
-                payload.append(jnp.where(ok, d, 0).astype(dt))
-                ops.append("sum")
+                contrib = jnp.where(ok, d, 0).astype(dt)
+                if needs_sum_limbs(a):
+                    clo, chi = split_limbs(contrib)
+                    payload.append(clo)
+                    ops.append("sum")
+                    payload.append(chi)
+                    ops.append("sum")
+                else:
+                    payload.append(contrib)
+                    ops.append("sum")
             elif a.func == "min":
                 dt = a.arg.type_.np_dtype
                 payload.append(jnp.where(ok, d, _ident_min(dt)).astype(dt))
@@ -313,6 +331,8 @@ def table_to_host_partial(host_table: Dict[str, np.ndarray], nkeys: int,
         st = {"cnt": np.asarray(host_table[f"a{j}.cnt"][:n])}
         if a.func in ("sum", "avg"):
             st["sum"] = np.asarray(host_table[f"a{j}.sum"][:n])
+            if f"a{j}.sumhi" in host_table:
+                st["sumhi"] = np.asarray(host_table[f"a{j}.sumhi"][:n])
         elif a.func in ("min", "max"):
             st[a.func] = np.asarray(host_table[f"a{j}.{a.func}"][:n])
         states.append(st)

@@ -52,7 +52,7 @@ def _max_identity(dtype):
 # Key suffix -> collective: the distributed path (parallel/distsql.py) maps
 # these onto lax.psum / lax.pmin / lax.pmax over the shard mesh axis —
 # exactly the partial/final split of the reference's HashAggExec pipeline.
-MERGE_OPS = {".sum": "sum", ".gabs": "sum", ".cnt": "sum",
+MERGE_OPS = {".sumhi": "sum", ".sum": "sum", ".cnt": "sum",
              ".min": "min", ".max": "max"}
 
 
@@ -63,6 +63,70 @@ def merge_op_for(key: str) -> str:
         if key.endswith(suffix):
             return op
     raise ExecutionError(f"no merge op for state key {key!r}")
+
+
+# ---------------------------------------------------------------------------
+# two-limb exact accumulation for scaled-int64 DECIMAL sums (SURVEY.md:309
+# hard-part 3). A value v splits into lo = v & (2^32-1) in [0, 2^32) and
+# hi = v >> 32 (arithmetic), with v == hi * 2^32 + lo exactly. Sums of each
+# limb stay far from int64 range for any realistic row count (lo adds < 2^32
+# per row, hi adds < 2^31), the pair is psum-mergeable like any other state,
+# and the true total spans ~94 bits — SUM can now be COMPUTED at magnitudes
+# where the old f64-shadow guard could only detect-and-fail.
+# ---------------------------------------------------------------------------
+
+_LO_BITS = 32
+_LO_MASK = (1 << _LO_BITS) - 1
+
+
+def needs_sum_limbs(a: AggSpec) -> bool:
+    """DECIMAL SUM/AVG accumulates in two int64 limbs."""
+    return (a.func in ("sum", "avg") and a.arg is not None
+            and a.arg.type_.kind == TypeKind.DECIMAL)
+
+
+def split_limbs(v):
+    """(lo, hi) limb decomposition — works on jnp and np int64 alike."""
+    return v & _LO_MASK, v >> _LO_BITS
+
+
+def normalize_limbs(lo, hi):
+    """Carry lo's overflow into hi, restoring lo in [0, 2^32)."""
+    return lo & _LO_MASK, hi + (lo >> _LO_BITS)
+
+
+def limbs_to_float(lo, hi) -> np.ndarray:
+    """Approximate float64 value of (lo, hi) pairs (for AVG and guards)."""
+    return (np.asarray(hi, dtype=np.float64) * float(1 << _LO_BITS)
+            + np.asarray(lo, dtype=np.float64))
+
+
+def combine_limbs_exact(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Exact int64 totals from limb pairs; totals outside int64 raise
+    (the DECIMAL result column is scaled int64 — a value that cannot be
+    REPRESENTED is a true out-of-range error, unlike the old accumulator
+    wrap, which hit ~2^62 of summed magnitude even when every group's
+    total was small)."""
+    tf = limbs_to_float(lo, hi)
+    # f64 ulp at 2^63 is 1024: stay 4096 clear of the boundary so a
+    # wrapped value can never masquerade as in-range
+    if np.any(np.abs(tf) > float(1 << 63) - 4096.0):
+        raise ExecutionError(
+            "DECIMAL SUM value is out of range of the result type")
+    t = ((np.asarray(hi).astype(np.uint64) << np.uint64(_LO_BITS))
+         + np.asarray(lo).astype(np.uint64))
+    return t.view(np.int64)
+
+
+def scatter_limbs(vals: np.ndarray, inverse: np.ndarray, n: int):
+    """Host limb accumulation: scatter-add each value's limbs into n
+    group slots (shared by the spill-partial and resident agg paths)."""
+    vlo, vhi = split_limbs(vals.astype(np.int64))
+    lo = np.zeros(n, dtype=np.int64)
+    hi = np.zeros(n, dtype=np.int64)
+    np.add.at(lo, inverse, vlo)
+    np.add.at(hi, inverse, vhi)
+    return lo, hi
 
 
 def _lexsort_groups(cols: List[np.ndarray]):
@@ -112,12 +176,10 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
             if a.func in ("sum", "avg"):
                 dt = jnp.float64 if a.arg.type_.kind == TypeKind.FLOAT else jnp.int64
                 st[f"{a.uid}.sum"] = jnp.zeros(G, dtype=dt)
-                if dt == jnp.int64 and a.arg.type_.kind == TypeKind.DECIMAL:
-                    # overflow sentinel: one scalar tracking sum(|v|)
-                    # globally. |any group sum| <= that total, so while
-                    # it stays under 2^62 no group can have wrapped —
-                    # a fused reduction instead of a second scatter
-                    st[f"{a.uid}.gabs"] = jnp.zeros(1, dtype=jnp.float64)
+                if needs_sum_limbs(a):
+                    # two-limb exact accumulation: .sum holds the low
+                    # 32-bit limb, .sumhi the high — see split_limbs
+                    st[f"{a.uid}.sumhi"] = jnp.zeros(G, dtype=jnp.int64)
                 st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
             elif a.func == "count":
                 st[f"{a.uid}.cnt"] = jnp.zeros(G, dtype=jnp.int64)
@@ -154,18 +216,28 @@ def make_segment_kernel(group_exprs, aggs: List[AggSpec], domains: List[int]):
             if a.func in ("sum", "avg"):
                 acc = state[f"{a.uid}.sum"]
                 contrib = jnp.where(ok, d, 0).astype(acc.dtype)
-                if acc.dtype == jnp.int64:
-                    # decimal/int sums: exact Pallas limb kernel on TPU
+                if f"{a.uid}.sumhi" in state:
+                    # two-limb exact decimal path: scatter each limb via
+                    # the Pallas kernel, then carry-normalize so the lo
+                    # accumulator never approaches int64 range no matter
+                    # how many chunks stream through
+                    from tidb_tpu.ops import segment_sum_i64
+
+                    clo, chi = split_limbs(contrib)
+                    lo = acc + segment_sum_i64(clo, packed, G)
+                    hi = (state[f"{a.uid}.sumhi"]
+                          + segment_sum_i64(chi, packed, G))
+                    lo, hi = normalize_limbs(lo, hi)
+                    out[f"{a.uid}.sum"] = lo
+                    out[f"{a.uid}.sumhi"] = hi
+                elif acc.dtype == jnp.int64:
+                    # int sums: exact Pallas limb kernel on TPU
                     from tidb_tpu.ops import segment_sum_i64
 
                     out[f"{a.uid}.sum"] = acc + segment_sum_i64(
                         contrib, packed, G)
                 else:
                     out[f"{a.uid}.sum"] = acc.at[packed].add(contrib)
-                if f"{a.uid}.gabs" in state:
-                    out[f"{a.uid}.gabs"] = (
-                        state[f"{a.uid}.gabs"]
-                        + jnp.sum(jnp.abs(contrib.astype(jnp.float64)))[None])
                 out[f"{a.uid}.cnt"] = state[f"{a.uid}.cnt"] + segment_count(ok, packed, G)
             elif a.func == "count":
                 cm = sel if a.arg is None else ok
@@ -266,9 +338,6 @@ class HashAggExec(Executor):
             out_arrays[a.uid] = self._finalize_agg_host(a, host, occupied)
         self._chunks_from_host(out_arrays, n, cap)
 
-    # scaled-int64 sums whose f64 shadow exceeds this have likely wrapped
-    _DECIMAL_SUM_GUARD = float(1 << 62)
-
     def _finalize_agg_host(self, a: AggSpec, host, occupied):
         cnt = host.get(f"{a.uid}.cnt")
         cnt = cnt[occupied] if cnt is not None else None
@@ -276,15 +345,17 @@ class HashAggExec(Executor):
             return cnt.astype(np.int64), np.ones(len(occupied), dtype=np.bool_)
         if a.func in ("sum",):
             s = host[f"{a.uid}.sum"][occupied]
-            gabs = host.get(f"{a.uid}.gabs")
-            if gabs is not None and float(
-                    np.asarray(gabs).reshape(-1)[0]) > self._DECIMAL_SUM_GUARD:
-                raise ExecutionError(
-                    "DECIMAL SUM value is out of range (scaled-int64 "
-                    "accumulator overflow)")
+            hi = host.get(f"{a.uid}.sumhi")
+            if hi is not None:
+                s = combine_limbs_exact(s, hi[occupied])
             return s.astype(a.type_.np_dtype), cnt > 0
         if a.func == "avg":
-            s = host[f"{a.uid}.sum"][occupied].astype(np.float64)
+            hi = host.get(f"{a.uid}.sumhi")
+            if hi is not None:
+                s = limbs_to_float(host[f"{a.uid}.sum"][occupied],
+                                   hi[occupied])
+            else:
+                s = host[f"{a.uid}.sum"][occupied].astype(np.float64)
             if a.arg.type_.kind == TypeKind.DECIMAL:
                 s = s / (10 ** a.arg.type_.scale)
             with np.errstate(divide="ignore", invalid="ignore"):
@@ -539,7 +610,10 @@ class HashAggExec(Executor):
             cnt = np.zeros(g, dtype=np.int64)
             np.add.at(cnt, inverse[ok], 1)
             st = {"cnt": cnt}
-            if a.func in ("sum", "avg"):
+            if needs_sum_limbs(a):
+                st["sum"], st["sumhi"] = scatter_limbs(
+                    vals[ok], inverse[ok], g)
+            elif a.func in ("sum", "avg"):
                 dt = np.float64 if a.arg.type_.kind == TypeKind.FLOAT else np.int64
                 s = np.zeros(g, dtype=dt)
                 np.add.at(s, inverse[ok], vals[ok])
@@ -592,6 +666,12 @@ class HashAggExec(Executor):
                 s = np.zeros(ngroups, dtype=parts.dtype)
                 np.add.at(s, inverse, parts)
                 st["sum"] = s
+                if "sumhi" in partials[0]["states"][j]:
+                    ph = np.concatenate(
+                        [p["states"][j]["sumhi"] for p in partials])
+                    h = np.zeros(ngroups, dtype=np.int64)
+                    np.add.at(h, inverse, ph)
+                    st["sumhi"] = h
             elif a.func in ("min", "max"):
                 op, ident = (
                     (np.minimum, _min_identity) if a.func == "min" else (np.maximum, _max_identity)
@@ -619,9 +699,13 @@ class HashAggExec(Executor):
             if a.func == "count":
                 out_arrays[a.uid] = (cnt, np.ones(ngroups, dtype=np.bool_))
             elif a.func == "sum":
-                out_arrays[a.uid] = (st["sum"].astype(a.type_.np_dtype), cnt > 0)
+                s = st["sum"]
+                if "sumhi" in st:
+                    s = combine_limbs_exact(s, st["sumhi"])
+                out_arrays[a.uid] = (s.astype(a.type_.np_dtype), cnt > 0)
             elif a.func == "avg":
-                sf = st["sum"].astype(np.float64)
+                sf = (limbs_to_float(st["sum"], st["sumhi"])
+                      if "sumhi" in st else st["sum"].astype(np.float64))
                 if a.arg.type_.kind == TypeKind.DECIMAL:
                     sf = sf / (10 ** a.arg.type_.scale)
                 with np.errstate(divide="ignore", invalid="ignore"):
@@ -661,20 +745,21 @@ class HashAggExec(Executor):
         if a.func == "count":
             return cnt, np.ones(ngroups, dtype=np.bool_)
         if a.func in ("sum", "avg"):
-            dt = np.float64 if a.arg.type_.kind == TypeKind.FLOAT or a.func == "avg" else np.int64
+            if a.arg.type_.kind == TypeKind.DECIMAL:
+                # two-limb exact host accumulation (same scheme as the
+                # device states — see split_limbs)
+                lo, hi = scatter_limbs(vals[ok], inverse[ok], ngroups)
+                if a.func == "sum":
+                    return (combine_limbs_exact(lo, hi).astype(
+                        a.type_.np_dtype), cnt > 0)
+                s = limbs_to_float(lo, hi) / (10 ** a.arg.type_.scale)
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    return np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0), cnt > 0
             s = np.zeros(ngroups, dtype=np.int64 if a.arg.type_.kind != TypeKind.FLOAT else np.float64)
-            if a.func == "sum" and a.arg.type_.kind == TypeKind.DECIMAL:
-                if float(np.abs(vals[ok].astype(np.float64)).sum()) \
-                        > self._DECIMAL_SUM_GUARD:
-                    raise ExecutionError(
-                        "DECIMAL SUM value is out of range (scaled-int64 "
-                        "accumulator overflow)")
             np.add.at(s, inverse[ok], vals[ok])
             if a.func == "sum":
                 return s.astype(a.type_.np_dtype), cnt > 0
             s = s.astype(np.float64)
-            if a.arg.type_.kind == TypeKind.DECIMAL:
-                s = s / (10 ** a.arg.type_.scale)
             with np.errstate(divide="ignore", invalid="ignore"):
                 return np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0), cnt > 0
         if a.func == "min":

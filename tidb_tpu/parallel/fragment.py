@@ -475,7 +475,7 @@ class _Compiler:
 
     # -- per-shard partial top-k ------------------------------------------
 
-    def _topn_select(self, items, nk, layout, kmax):
+    def _topn_select(self, items, nk, layout, kmax, aggs):
         """Build fn(n, fk, fkv, red) -> (n', fk', fkv', red') keeping
         each shard's top `kmax` groups under the resolved sort items —
         the exchange routes every group to exactly one shard, so the
@@ -494,6 +494,7 @@ class _Compiler:
             live = jnp.arange(S, dtype=jnp.int64) < n
             ops = []
             for kind, idx, desc in items:
+                limbs = None
                 if kind == "key":
                     data, valid = fk[idx], fkv[idx]
                 elif kind == "cnt":
@@ -501,16 +502,45 @@ class _Compiler:
                     valid = jnp.ones(S, dtype=jnp.bool_)
                 elif kind == "avg":
                     c = state[f"a{idx}.cnt"]
-                    data = (state[f"a{idx}.sum"].astype(jnp.float64)
-                            / jnp.maximum(c, 1).astype(jnp.float64))
+                    s = state[f"a{idx}.sum"]
+                    hi = state.get(f"a{idx}.sumhi")
+                    # jnp-native limb->float (limbs_to_float is numpy);
+                    # divide in the SAME order as the host finalize
+                    # (scale first, then count) so rounding can never
+                    # rank two groups differently than the final TopN
+                    sf = (hi.astype(jnp.float64) * float(1 << 32)
+                          + s.astype(jnp.float64)
+                          if hi is not None else s.astype(jnp.float64))
+                    a = aggs[idx]
+                    if a.arg is not None and a.arg.type_.kind == TypeKind.DECIMAL:
+                        sf = sf / (10 ** a.arg.type_.scale)
+                    data = sf / jnp.maximum(c, 1).astype(jnp.float64)
                     valid = c > 0
                 else:  # sum | min | max: NULL when no non-null input
                     data = state[f"a{idx}.{kind}"]
                     valid = state[f"a{idx}.cnt"] > 0
+                    if kind == "sum" and f"a{idx}.sumhi" in state:
+                        # two-limb decimal sum: carry-normalize, then
+                        # (hi, lo) lexicographic IS the numeric order
+                        # (lo in [0, 2^32) after the carry)
+                        from tidb_tpu.executor.aggregate import (
+                            normalize_limbs,
+                        )
+
+                        lo, hi = normalize_limbs(data, state[f"a{idx}.sumhi"])
+                        limbs = (hi, lo)
                 rank = jnp.where(
                     ~live, jnp.int32(2),
                     jnp.where(valid, jnp.int32(0) if desc else jnp.int32(1),
                               jnp.int32(1) if desc else jnp.int32(0)))
+                if limbs is not None:
+                    dead = ~(valid & live)
+                    khi = jnp.where(dead, 0, limbs[0])
+                    klo = jnp.where(dead, 0, limbs[1])
+                    if desc:
+                        khi, klo = ~khi, ~klo
+                    ops += [rank, khi, klo]
+                    continue
                 if data.dtype == jnp.bool_:
                     data = data.astype(jnp.int64)
                 if jnp.issubdtype(data.dtype, jnp.floating):
@@ -565,7 +595,7 @@ class _Compiler:
         partial = make_partial_kernel(agg.group_exprs, agg.aggs)
         layout = _state_layout(agg.aggs)
         nk = len(agg.group_exprs)
-        topn_fn = (self._topn_select(topn[0], nk, layout, topn[1])
+        topn_fn = (self._topn_select(topn[0], nk, layout, topn[1], agg.aggs)
                    if topn is not None else None)
         g_agg = self._add_growth(2.0, "exch")
         n_parts = self.n_parts
