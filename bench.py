@@ -366,6 +366,62 @@ def main(locked_detail=("", "")):
     except Exception as e:  # noqa: BLE001
         extra["q18_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # Q18 streamed: the same query with lineitem forced through the >HBM
+    # streaming fragment path (VERDICT r3 task 7 / SURVEY.md:315 hard-part
+    # 6 rehearsed at bench scale, not toy scale): pin the device cache
+    # budget below the lineitem sharding so _pick_stream_source batches it,
+    # and report the streamed-vs-resident overhead at the same SF
+    try:
+        if "q18_error" not in extra and s18 is not None:
+            from tidb_tpu.parallel.partition import table_bytes
+            from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
+
+            def stream_dispatches():
+                return (FRAGMENT_DISPATCH.value(kind="general_segment_stream")
+                        + FRAGMENT_DISPATCH.value(kind="general_generic_stream"))
+
+            li = s18.catalog.table("test", "lineitem")
+            li_bytes = table_bytes(li)
+            budget = max(1 << 20, li_bytes // 4)
+            log(f"# q18 streamed (lineitem={li_bytes >> 20}MiB, "
+                f"budget={budget >> 20}MiB)")
+            best_res = best
+            s18.execute(f"SET tidb_device_cache_bytes = {budget}")
+            d0 = stream_dispatches()
+            rps_s, vs_s, best_s, check_s = bench_query(
+                s18, sql, conn18, lite or sql, c18["lineitem"],
+                extra=extra, tag="q18_streamed")
+            engaged = stream_dispatches() > d0
+            if not engaged:
+                # single-CPU engine routing sent the joins to the host
+                # engine, where the cache budget is moot — force the
+                # fragment tier for a REAL streamed-vs-resident pair
+                log("# q18 streamed: auto routing bypassed fragments; "
+                    "forcing the device engine for a true pair")
+                s18.execute("SET tidb_device_engine_mode = 'force'")
+                s18.execute("SET tidb_device_cache_bytes = 8589934592")
+                _, _, best_res, _ = bench_query(
+                    s18, sql, conn18, lite or sql, c18["lineitem"])
+                s18.execute(f"SET tidb_device_cache_bytes = {budget}")
+                d0 = stream_dispatches()
+                rps_s, vs_s, best_s, check_s = bench_query(
+                    s18, sql, conn18, lite or sql, c18["lineitem"],
+                    extra=extra, tag="q18_streamed")
+                engaged = stream_dispatches() > d0
+                s18.execute("SET tidb_device_engine_mode = 'auto'")
+            s18.execute("SET tidb_device_cache_bytes = 8589934592")
+            extra["q18_streamed"] = {
+                "rows_per_sec": round(rps_s, 1),
+                "vs_sqlite": round(vs_s, 3),
+                "budget_bytes": budget,
+                "lineitem_bytes": li_bytes,
+                "engaged": bool(engaged),
+                "overhead_vs_resident": round(best_s / best_res, 3),
+                "check": check_s,
+            }
+    except Exception as e:  # noqa: BLE001
+        extra["q18_streamed_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # SSB Q3.2: 4-way star join (BASELINE flagship config) -------------------
     try:
         log(f"# ssb q3.2 at sf={SF_SSB}")

@@ -273,9 +273,25 @@ def make_merge_kernel(nkeys: int, aggs: List[AggSpec]):
             table[f"k{i}.v"] = rkv[i]
         for (name, _), arr in zip(layout, red):
             table[name] = arr
+        _normalize_table_limbs(table, aggs)
         return table
 
     return merge
+
+
+def _normalize_table_limbs(table, aggs: List[AggSpec]) -> None:
+    """Carry-normalize every (lo, hi) limb pair in a group table, so lo
+    stays in [0, 2^32) no matter how many merges stack (a group fed by
+    2^31+ rows would otherwise wrap the lo accumulator — the segment
+    kernel normalizes per chunk; merge trees must do it per level)."""
+    from tidb_tpu.executor.aggregate import normalize_limbs
+
+    for j, a in enumerate(aggs):
+        if f"a{j}.sumhi" in table:
+            lo, hi = normalize_limbs(table[f"a{j}.sum"],
+                                     table[f"a{j}.sumhi"])
+            table[f"a{j}.sum"] = lo
+            table[f"a{j}.sumhi"] = hi
 
 
 class GroupTableStack:

@@ -126,7 +126,7 @@ def scatter_limbs(vals: np.ndarray, inverse: np.ndarray, n: int):
     hi = np.zeros(n, dtype=np.int64)
     np.add.at(lo, inverse, vlo)
     np.add.at(hi, inverse, vhi)
-    return lo, hi
+    return normalize_limbs(lo, hi)
 
 
 def _lexsort_groups(cols: List[np.ndarray]):
@@ -671,7 +671,9 @@ class HashAggExec(Executor):
                         [p["states"][j]["sumhi"] for p in partials])
                     h = np.zeros(ngroups, dtype=np.int64)
                     np.add.at(h, inverse, ph)
-                    st["sumhi"] = h
+                    # carry-normalize per merge so lo never wraps across
+                    # arbitrarily deep merge chains (streaming batches)
+                    st["sum"], st["sumhi"] = normalize_limbs(s, h)
             elif a.func in ("min", "max"):
                 op, ident = (
                     (np.minimum, _min_identity) if a.func == "min" else (np.maximum, _max_identity)

@@ -116,6 +116,23 @@ def _mix_hash(bits: List[jax.Array]) -> jax.Array:
     return h
 
 
+def _normalize_red_limbs(red, layout, aggs):
+    """Carry-normalize (lo, hi) decimal-sum limb pairs in a reduced
+    payload list (post-exchange reduce), keeping lo in [0, 2^32) for
+    the TopN limb sort keys and the host finalize."""
+    from tidb_tpu.executor.aggregate import normalize_limbs
+
+    idx_of = {name: i for i, (name, _) in enumerate(layout)}
+    red = list(red)
+    for j, _a in enumerate(aggs):
+        hi_i = idx_of.get(f"a{j}.sumhi")
+        if hi_i is not None:
+            lo_i = idx_of[f"a{j}.sum"]
+            lo, hi = normalize_limbs(red[lo_i], red[hi_i])
+            red[lo_i], red[hi_i] = lo, hi
+    return red
+
+
 @dataclass
 class _Source:
     """A sharded scan input (3 fragment args: data, valid, sel)."""
@@ -647,6 +664,7 @@ class _Compiler:
             # host finalize is a straight per-part conversion — no merge
             n, fk, fkv, red = _sort_reduce(rbits, rkv, rkd, recv_sel,
                                            payload, ops, exact=True)
+            red = _normalize_red_limbs(red, layout, agg.aggs)
             if topn_fn is not None:
                 n, fk, fkv, red = topn_fn(n, fk, fkv, red)
             out = {"n": n[None]}
