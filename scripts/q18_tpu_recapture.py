@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Focused on-chip recapture of the Q18 config (+ streamed mode).
+
+The full watchdog capture lost exactly one config to a transient tunnel
+error (`remote_compile: Unexpected EOF`); this retakes Q18 under the
+same protocol — chip lock held, load snapshots, sqlite oracle — and
+patches the result into BENCH_tpu.json in place of the error."""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def main():
+    lock = bench.chip_lock()
+    try:
+        extra = {}
+        extra["recapture_load_before"] = bench.machine_load()
+        import tidb_tpu  # noqa: F401
+        from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.tpch import load_tpch
+        from tidb_tpu.storage.tpch_queries import Q
+        from tidb_tpu.testutil import mirror_to_sqlite
+
+        sf = float(os.environ.get("BENCH_SF_Q18", "0.2"))
+        mesh = make_mesh()
+        s = Session(chunk_capacity=1 << 20, mesh=mesh)
+        counts = load_tpch(s.catalog, sf=sf)
+        conn = mirror_to_sqlite(
+            s.catalog, tables=["lineitem", "orders", "customer"])
+        sql, lite = Q["q18"]
+        t0 = time.time()
+        rps, vs, best, check = bench.bench_query(
+            s, sql, conn, lite or sql, counts["lineitem"],
+            reps=int(os.environ.get("BENCH_REPS", "2")),
+            extra=extra, tag="q18")
+        print(f"q18: {rps:.1f} rows/s, {vs:.3f}x sqlite, check={check}, "
+              f"wall={time.time() - t0:.0f}s", flush=True)
+
+        # streamed mode on the real chip (same logic as bench.py)
+        from tidb_tpu.parallel.partition import table_bytes
+        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
+
+        def sd():
+            return (FRAGMENT_DISPATCH.value(kind="general_segment_stream")
+                    + FRAGMENT_DISPATCH.value(kind="general_generic_stream"))
+
+        li = s.catalog.table("test", "lineitem")
+        li_bytes = table_bytes(li)
+        budget = max(1 << 20, li_bytes // 4)
+        s.execute(f"SET tidb_device_cache_bytes = {budget}")
+        d0 = sd()
+        rps_s, vs_s, best_s, check_s = bench.bench_query(
+            s, sql, conn, lite or sql, counts["lineitem"],
+            reps=int(os.environ.get("BENCH_REPS", "2")),
+            extra=extra, tag="q18_streamed")
+        engaged = sd() > d0
+        streamed = {
+            "rows_per_sec": round(rps_s, 1), "vs_sqlite": round(vs_s, 3),
+            "budget_bytes": budget, "lineitem_bytes": li_bytes,
+            "engaged": bool(engaged),
+            "overhead_vs_resident": round(best_s / best, 3),
+            "check": check_s,
+        }
+        print(f"q18_streamed: {streamed}", flush=True)
+        extra["recapture_load_after"] = bench.machine_load()
+
+        path = os.path.join(REPO, "BENCH_tpu.json")
+        art = json.load(open(path))
+        art["extra"].pop("q18_error", None)
+        art["extra"]["tpch_q18_rows_per_sec"] = round(rps, 1)
+        art["extra"]["q18_vs_sqlite"] = round(vs, 3)
+        art["extra"]["q18_sf"] = sf
+        art["extra"]["q18_recaptured"] = (
+            "transient tunnel error in the first pass; retaken solo "
+            "under the chip lock")
+        art["extra"]["q18_streamed"] = streamed
+        for k, v in extra.items():
+            art["extra"][k] = v
+        if "MISMATCH" in check:
+            art["extra"]["q18_check"] = check
+        tmp = path + ".patch"
+        json.dump(art, open(tmp, "w"))
+        os.replace(tmp, path)
+        print("BENCH_tpu.json patched", flush=True)
+    finally:
+        bench.chip_unlock(lock[0])
+
+
+if __name__ == "__main__":
+    main()
