@@ -55,6 +55,7 @@ def main():
         li = s.catalog.table("test", "lineitem")
         li_bytes = table_bytes(li)
         budget = max(1 << 20, li_bytes // 4)
+        best_res = best
         s.execute(f"SET tidb_device_cache_bytes = {budget}")
         d0 = sd()
         rps_s, vs_s, best_s, check_s = bench.bench_query(
@@ -62,11 +63,30 @@ def main():
             reps=int(os.environ.get("BENCH_REPS", "2")),
             extra=extra, tag="q18_streamed")
         engaged = sd() > d0
+        if not engaged:
+            # mirror bench.py: auto routing bypassed the fragment tier,
+            # so force the device engine for a true streamed/resident
+            # pair instead of recording a meaningless ratio
+            print("q18 streamed: forcing device engine for a true pair",
+                  flush=True)
+            s.execute("SET tidb_device_engine_mode = 'force'")
+            s.execute("SET tidb_device_cache_bytes = 8589934592")
+            _, _, best_res, _ = bench.bench_query(
+                s, sql, conn, lite or sql, counts["lineitem"],
+                reps=int(os.environ.get("BENCH_REPS", "2")))
+            s.execute(f"SET tidb_device_cache_bytes = {budget}")
+            d0 = sd()
+            rps_s, vs_s, best_s, check_s = bench.bench_query(
+                s, sql, conn, lite or sql, counts["lineitem"],
+                reps=int(os.environ.get("BENCH_REPS", "2")),
+                extra=extra, tag="q18_streamed")
+            engaged = sd() > d0
+            s.execute("SET tidb_device_engine_mode = 'auto'")
         streamed = {
             "rows_per_sec": round(rps_s, 1), "vs_sqlite": round(vs_s, 3),
             "budget_bytes": budget, "lineitem_bytes": li_bytes,
             "engaged": bool(engaged),
-            "overhead_vs_resident": round(best_s / best, 3),
+            "overhead_vs_resident": round(best_s / best_res, 3),
             "check": check_s,
         }
         print(f"q18_streamed: {streamed}", flush=True)
@@ -75,6 +95,7 @@ def main():
         path = os.path.join(REPO, "BENCH_tpu.json")
         art = json.load(open(path))
         art["extra"].pop("q18_error", None)
+        art["extra"].pop("q18_streamed_error", None)
         art["extra"]["tpch_q18_rows_per_sec"] = round(rps, 1)
         art["extra"]["q18_vs_sqlite"] = round(vs, 3)
         art["extra"]["q18_sf"] = sf

@@ -451,25 +451,88 @@ if __name__ == "__main__":  # pragma: no cover
 _DIST_AGGS = {"count", "sum", "min", "max", "avg"}
 
 
-def partial_rewrite(sql: str, table_as: Optional[str] = None
+def _from_tables(src) -> List[A.TableName]:
+    """Base tables of a FROM tree; only inner/cross joins qualify (an
+    outer join against a broadcast side would need NULL-extension
+    coordination the partial/final split cannot express)."""
+    if isinstance(src, A.TableName):
+        return [src]
+    if isinstance(src, A.Join):
+        if src.kind not in ("inner", "cross"):
+            raise UnsupportedError(f"dcn tier: {src.kind} join")
+        if src.using:
+            raise UnsupportedError("dcn tier: JOIN USING")
+        return _from_tables(src.left) + _from_tables(src.right)
+    raise UnsupportedError("dcn FROM must be base tables")
+
+
+def _from_sql(src, rename: Dict[str, str]) -> str:
+    """Render a FROM tree back to SQL, substituting renamed tables (the
+    replica retry reads `<fact>__part<i>`); a renamed table keeps its
+    original name as an alias so qualified column refs stay valid."""
+    if isinstance(src, A.TableName):
+        t = rename.get(src.name, src.name)
+        out = f"`{t}`"
+        if src.alias:
+            out += f" as `{src.alias}`"
+        elif t != src.name:
+            out += f" as `{src.name}`"
+        return out
+    left = _from_sql(src.left, rename)
+    right = _from_sql(src.right, rename)
+    if src.kind == "cross" and src.on is None:
+        return f"{left} cross join {right}"
+    on = f" on {expr_to_sql(src.on)}" if src.on is not None else ""
+    return f"{left} join {right}{on}"
+
+
+def partial_rewrite(sql: str, table_as: Optional[str] = None,
+                    partitioned=frozenset(), broadcast=frozenset()
                     ) -> Tuple[str, str, List[str]]:
-    """One single-table SELECT -> (partial_sql, final_sql, out_names).
-    partial_sql runs on every worker; its result rows are unioned into
-    the staging table __dcn_partial__ on the coordinator, where
-    final_sql computes the merge. Aggregates use the partial/final
-    split; a plain SELECT with ORDER BY+LIMIT becomes a local TopN per
-    worker merged by the same sort on the coordinator (coprocessor TopN
-    pushdown). `table_as` substitutes the scanned table name — the
-    replica-partition retry path reads `<table>__part<i>`."""
+    """One SELECT -> (partial_sql, final_sql, out_names). partial_sql
+    runs on every worker; its result rows are unioned into the staging
+    table __dcn_partial__ on the coordinator, where final_sql computes
+    the merge. Aggregates use the partial/final split; a plain SELECT
+    with ORDER BY+LIMIT becomes a local TopN per worker merged by the
+    same sort on the coordinator (coprocessor TopN pushdown).
+
+    FROM may be one partitioned table, or `fact JOIN dim...` where
+    exactly one table is partitioned across workers and every other
+    side was broadcast_table()'d to all of them (the star-schema
+    coprocessor-join shape, SURVEY.md:131): each worker joins its fact
+    partition against its full local dim copies, so the partial/final
+    aggregate split stays exact. `table_as` substitutes the partitioned
+    table's name — the replica-partition retry reads `<fact>__part<i>`."""
     stmts = parse(sql)
     if len(stmts) != 1 or not isinstance(stmts[0], A.SelectStmt):
         raise UnsupportedError("dcn tier handles a single SELECT")
     st = stmts[0]
-    if not isinstance(st.from_, A.TableName) or st.having is not None \
-            or st.distinct or st.ctes:
+    if st.having is not None or st.distinct or st.ctes:
         raise UnsupportedError(
-            "dcn tier pushes single-table aggregates (the coprocessor "
-            "shape); joins execute above it")
+            "dcn tier pushes coprocessor-shaped aggregates "
+            "(no HAVING/DISTINCT/CTE)")
+    tables = _from_tables(st.from_)
+    if len(tables) == 1:
+        fact = tables[0].name
+        if fact in broadcast and fact not in partitioned:
+            # every worker holds the FULL copy: fanning a partial out
+            # and summing would multiply aggregates by the worker count
+            raise UnsupportedError(
+                f"table {fact!r} is broadcast (replicated), not "
+                "partitioned; query it on one worker directly")
+    else:
+        parts = [t.name for t in tables if t.name in partitioned]
+        if len(parts) != 1:
+            raise UnsupportedError(
+                "dcn join needs exactly one partitioned table "
+                f"(got {parts or 'none'} among {[t.name for t in tables]})")
+        fact = parts[0]
+        missing = [t.name for t in tables
+                   if t.name != fact and t.name not in broadcast]
+        if missing:
+            raise UnsupportedError(
+                f"dcn join sides {missing} are not broadcast to the "
+                "workers (Cluster.broadcast_table)")
 
     def has_agg(e) -> bool:
         import dataclasses as _dc
@@ -489,11 +552,12 @@ def partial_rewrite(sql: str, table_as: Optional[str] = None
                     return True
         return False
 
-    tname = table_as or st.from_.name
+    rename = {fact: table_as} if table_as else {}
+    from_sql = _from_sql(st.from_, rename)
     where = f" where {expr_to_sql(st.where)}" if st.where is not None else ""
 
     if not st.group_by and not any(has_agg(it.expr) for it in st.items):
-        return _topn_rewrite(st, tname, where)
+        return _topn_rewrite(st, from_sql, where)
 
     group_sqls = [expr_to_sql(g) for g in st.group_by]
     part_items: List[str] = []
@@ -532,7 +596,7 @@ def partial_rewrite(sql: str, table_as: Optional[str] = None
             final_items.append(f"sum(p{i}s) / sum(p{i}c) as `{alias}`")
 
     groupby = f" group by {', '.join(group_sqls)}" if group_sqls else ""
-    partial_sql = (f"select {', '.join(part_items)} from `{tname}`"
+    partial_sql = (f"select {', '.join(part_items)} from {from_sql}"
                    f"{where}{groupby}")
 
     fgroup = f" group by {', '.join(gcol.values())}" if gcol else ""
@@ -558,7 +622,7 @@ def partial_rewrite(sql: str, table_as: Optional[str] = None
     return partial_sql, final_sql, out_names
 
 
-def _topn_rewrite(st: A.SelectStmt, tname: str, where: str
+def _topn_rewrite(st: A.SelectStmt, from_sql: str, where: str
                   ) -> Tuple[str, str, List[str]]:
     """Plain SELECT [ORDER BY ... LIMIT n]: each worker returns its
     local rows (top n+offset when limited); the coordinator re-sorts and
@@ -592,7 +656,7 @@ def _topn_rewrite(st: A.SelectStmt, tname: str, where: str
         if not order_terms:
             raise UnsupportedError("dcn LIMIT without ORDER BY is ambiguous")
         part_limit = f" limit {st.limit + (st.offset or 0)}"
-    partial_sql = (f"select {', '.join(part_items)} from `{tname}`"
+    partial_sql = (f"select {', '.join(part_items)} from {from_sql}"
                    f"{where}{order}{part_limit}")
     limit = f" limit {st.limit}" if st.limit is not None else ""
     offset = f" offset {st.offset}" if st.offset is not None else ""
@@ -614,6 +678,12 @@ class Cluster:
     `<table>__part<i>` table, and a failed partial RPC retries there
     (the region-replica failover analogue)."""
 
+    # a dim bigger than this doesn't broadcast: replicating it to every
+    # worker would cost more than the join saves (ref: the reference's
+    # broadcast-join threshold)
+    BROADCAST_LIMIT_BYTES = int(os.environ.get(
+        "DCN_BROADCAST_LIMIT", str(64 << 20)))
+
     def __init__(self, endpoints: List[Tuple[str, int]],
                  secret: Optional[str] = None,
                  replicas: Optional[Dict[int, int]] = None):
@@ -621,6 +691,8 @@ class Cluster:
         self.replicas = dict(replicas or {})
         self._socks: List[Optional[socket.socket]] = []
         self._endpoints = list(endpoints)
+        self._partitioned: set = set()
+        self._broadcast: set = set()
         for host, port in endpoints:
             self._socks.append(self._connect(host, port))
         from tidb_tpu.session import Session
@@ -714,6 +786,7 @@ class Cluster:
     def load_partition(self, worker: int, table: str, arrays=None,
                        valids=None, strings=None, db: Optional[str] = None
                        ) -> int:
+        self._partitioned.add(table)
         n = self._call(worker, {
             "cmd": "load_columns", "table": table, "arrays": arrays,
             "valids": valids, "strings": strings, "db": db,
@@ -726,6 +799,37 @@ class Cluster:
                 "strings": strings, "db": db,
             })
         return n
+
+    def broadcast_table(self, table: str, arrays=None, valids=None,
+                        strings=None, db: Optional[str] = None) -> int:
+        """Ship a full (dimension) table to EVERY worker so partitioned
+        fact scans can join it locally (the star-schema broadcast join;
+        SURVEY.md:131). Size-capped: replicating a big table would cost
+        more than the join saves."""
+        size = 0
+        for v in (arrays or {}).values():
+            size += np.asarray(v).nbytes
+        for v in (valids or {}).values():
+            size += np.asarray(v).nbytes
+        for pool in (strings or {}).values():
+            size += sum(len(x) for x in pool)
+        if size > self.BROADCAST_LIMIT_BYTES:
+            raise ExecutionError(
+                f"broadcast_table({table!r}): {size} bytes exceeds the "
+                f"{self.BROADCAST_LIMIT_BYTES}-byte broadcast cap")
+        msg = {"cmd": "load_columns", "table": table, "arrays": arrays,
+               "valids": valids, "strings": strings, "db": db}
+        ns = self._call_all([dict(msg) for _ in self._socks])
+        self._broadcast.add(table)
+        return ns[0]
+
+    def mark_broadcast(self, table: str) -> None:
+        """Register a table as present-in-full on every worker when it
+        was loaded out of band (e.g. broadcast_exec INSERTs)."""
+        self._broadcast.add(table)
+
+    def mark_partitioned(self, table: str) -> None:
+        self._partitioned.add(table)
 
     def _partials_with_failover(self, sql: str, partial_sql: str) -> List:
         """Fan the partial out; a dead worker's partition re-runs on its
@@ -754,9 +858,13 @@ class Cluster:
             if rep is None or self._socks[rep] is None:
                 raise err
             if tname is None:
-                tname = parse(sql)[0].from_.name
+                tables = _from_tables(parse(sql)[0].from_)
+                parts = [t.name for t in tables
+                         if t.name in self._partitioned]
+                tname = parts[0] if parts else tables[0].name
             rep_sql, _f, _n = partial_rewrite(
-                sql, table_as=f"{tname}__part{i}")
+                sql, table_as=f"{tname}__part{i}",
+                partitioned=self._partitioned, broadcast=self._broadcast)
             results[i] = self._call(rep, {"cmd": "partial", "sql": rep_sql})
         return results
 
@@ -764,7 +872,8 @@ class Cluster:
         """Distributed aggregate / TopN: partial on every worker, final
         merge here. schema_sql overrides the staging table DDL; by
         default column types are inferred from the partial rows."""
-        partial_sql, final_sql, _names = partial_rewrite(sql)
+        partial_sql, final_sql, _names = partial_rewrite(
+            sql, partitioned=self._partitioned, broadcast=self._broadcast)
         worker_rows = self._partials_with_failover(sql, partial_sql)
         all_rows = [r for rows in worker_rows for r in rows]
         s = self._merge_session
