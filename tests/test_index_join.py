@@ -1,0 +1,163 @@
+"""Access paths in the cascades memo (VERDICT r3 task 9; SURVEY.md:88):
+the memo costs an index-lookup-join alternative — probe the inner
+table's sorted index cache per outer row — against the hash join's
+exchange + local work, so access-path choice and join order optimize
+jointly. Oracle: the same query on the greedy/hash-only planner."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    s.execute("set tidb_enable_cascades_planner = 1")
+    rng = np.random.default_rng(17)
+    # H: huge indexed dimension; A: small dim; F: selective-filtered fact
+    s.execute("create table h (hk bigint primary key, hw bigint)")
+    s.execute("create table a (ak bigint, aw bigint)")
+    s.execute("create table f (fa bigint, fh bigint, v bigint)")
+    for lo in range(0, 40000, 5000):
+        s.execute("insert into h values " + ",".join(
+            f"({i}, {i % 97})" for i in range(lo, lo + 5000)))
+    s.execute("insert into a values " + ",".join(
+        f"({i % 50}, {i})" for i in range(150)))
+    rows = []
+    for i in range(8000):
+        rows.append(f"({int(rng.integers(0, 50))}, "
+                    f"{int(rng.integers(0, 40000))}, {i % 1000})")
+    for lo in range(0, 8000, 2000):
+        s.execute("insert into f values " + ",".join(rows[lo:lo + 2000]))
+    for t in ("h", "a", "f"):
+        s.execute(f"analyze table {t}")
+    return s
+
+
+Q = ("select count(*) as n, sum(v + aw + hw) as s from f "
+     "join a on fa = ak join h on fh = hk where v < 30")
+
+
+def _explain(s, sql):
+    return [r[0] for r in s.query("explain " + sql)]
+
+
+def test_memo_chooses_index_join(sess):
+    rows = _explain(sess, Q)
+    assert any("IndexJoin" in r and "index:PRIMARY" in r for r in rows), rows
+
+
+def test_index_join_results_match_hash_planner(sess):
+    got = sess.query(Q)
+    # rebuild the same data on a greedy-planner session
+    o = Session()
+    o.execute("create table h (hk bigint primary key, hw bigint)")
+    o.execute("create table a (ak bigint, aw bigint)")
+    o.execute("create table f (fa bigint, fh bigint, v bigint)")
+    for t in ("h", "a", "f"):
+        rows = sess.query(f"select * from {t}")
+        for lo in range(0, len(rows), 2000):
+            vals = ",".join(
+                "(" + ",".join(str(x) for x in r) + ")"
+                for r in rows[lo:lo + 2000])
+            o.execute(f"insert into {t} values {vals}")
+    assert got == o.query(Q)
+
+
+def test_big_outer_stays_hash(sess):
+    # without the selective filter the outer is the full fact: probing
+    # 8k rows * log(40k) must lose to the hash join in the memo
+    q = ("select count(*) as n from f join h on fh = hk")
+    rows = _explain(sess, q)
+    assert not any("IndexJoin" in r for r in rows), rows
+
+
+def test_nulls_and_txn_snapshot(sess):
+    s = Session()
+    s.execute("set tidb_enable_cascades_planner = 1")
+    s.execute("create table hh (k bigint primary key, w bigint)")
+    s.execute("insert into hh values " + ",".join(
+        f"({i}, {i})" for i in range(5000)))
+    s.execute("create table aa (x bigint, y bigint)")
+    s.execute("insert into aa values (1, 1), (2, 2), (3, 3)")
+    s.execute("create table ff (fk bigint, fx bigint)")
+    s.execute("insert into ff values (10, 1), (NULL, 2), (20, 3), (99999, 1)")
+    s.execute("analyze table hh")
+    s.execute("analyze table aa")
+    s.execute("analyze table ff")
+    q = ("select fk, y, w from ff join aa on fx = x join hh on fk = k "
+         "order by fk")
+    rows = _explain(s, q)
+    assert any("IndexJoin" in r for r in rows), rows
+    # NULL key and missing key (99999 exists; 20 exists) — oracle by hand
+    assert s.query(q) == [(10, 1, 10), (20, 3, 20), (99999, 1, None)] or \
+        s.query(q) == [(10, 1, 10), (20, 3, 20)]
+    # 99999 < 5000? no — 99999 not in hh -> dropped (inner join)
+    assert s.query(q) == [(10, 1, 10), (20, 3, 20)]
+    # txn snapshot: delete visible inside txn, restored on rollback
+    s.execute("begin")
+    s.execute("delete from hh where k = 10")
+    assert s.query(q) == [(20, 3, 20)]
+    s.execute("rollback")
+    assert s.query(q) == [(10, 1, 10), (20, 3, 20)]
+
+
+def test_composite_index_prefix_probe():
+    """Join key = PREFIX of a composite index (the TPC-H lineitem pk
+    shape): the probe must span the whole equal-prefix run, not just
+    suffix == 0 rows."""
+    s = Session()
+    s.execute("set tidb_enable_cascades_planner = 1")
+    s.execute("create table li (ok bigint, ln bigint, q bigint, "
+              "primary key (ok, ln))")
+    s.execute("insert into li values " + ",".join(
+        f"({i // 4}, {i % 4}, {i})" for i in range(20000)))
+    s.execute("create table od (ok bigint, d bigint)")
+    s.execute("insert into od values " + ",".join(
+        f"({i}, {i % 9})" for i in range(0, 5000, 10)))
+    s.execute("create table cu (d bigint, nm bigint)")
+    s.execute("insert into cu values " + ",".join(
+        f"({i}, {i * 2})" for i in range(9)))
+    for t in ("li", "od", "cu"):
+        s.execute(f"analyze table {t}")
+    q = ("select count(*) as n, sum(q) as sq from od join cu on od.d = cu.d "
+         "join li on od.ok = li.ok where nm < 8")
+    rows = _explain(s, q)
+    assert any("IndexJoin" in r and "table:li" in r for r in rows), rows
+    # oracle by hand: od rows with d%9 -> nm = 2d < 8 -> d in {0,1,2,3};
+    # each od.ok has 4 li rows
+    oks = [i for i in range(0, 5000, 10) if (i % 9) < 4]
+    n = 4 * len(oks)
+    sq = sum(4 * ok * 4 + 6 for ok in oks)  # q values: 4ok..4ok+3
+    assert s.query(q) == [(n, sq)]
+
+
+def test_explain_plan_changes_without_index():
+    """Golden pair: same data, identical query — the available index
+    path changes the chosen EXPLAIN plan (IndexJoin vs hash tree)."""
+    def build(with_index):
+        s = Session()
+        s.execute("set tidb_enable_cascades_planner = 1")
+        pk = " primary key" if with_index else ""
+        s.execute(f"create table h (hk bigint{pk}, hw bigint)")
+        s.execute("create table a (ak bigint, aw bigint)")
+        s.execute("create table f (fa bigint, fh bigint, v bigint)")
+        for lo in range(0, 30000, 5000):
+            s.execute("insert into h values " + ",".join(
+                f"({i}, {i % 7})" for i in range(lo, lo + 5000)))
+        s.execute("insert into a values " + ",".join(
+            f"({i % 40}, {i})" for i in range(120)))
+        s.execute("insert into f values " + ",".join(
+            f"({i % 40}, {(i * 37) % 30000}, {i % 500})" for i in range(6000)))
+        for t in ("h", "a", "f"):
+            s.execute(f"analyze table {t}")
+        return s
+
+    q = ("select count(*) as n from f join a on fa = ak "
+         "join h on fh = hk where v < 25")
+    with_idx = [r[0] for r in build(True).query("explain " + q)]
+    without = [r[0] for r in build(False).query("explain " + q)]
+    assert any("IndexJoin" in r for r in with_idx), with_idx
+    assert not any("IndexJoin" in r for r in without), without
+    assert with_idx != without

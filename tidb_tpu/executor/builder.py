@@ -13,12 +13,13 @@ from typing import List
 from tidb_tpu.errors import PlanError
 from tidb_tpu.executor.aggregate import HashAggExec
 from tidb_tpu.executor.base import Executor
-from tidb_tpu.executor.join import HashJoinExec
+from tidb_tpu.executor.join import HashJoinExec, IndexJoinExec
 from tidb_tpu.executor.scan import ProjectionExec, SelectionExec, TableScanExec
 from tidb_tpu.executor.sort import LimitExec, SortExec, TopNExec, UnionExec
 from tidb_tpu.planner.physical import (
     PHashAgg,
     PHashJoin,
+    PIndexJoin,
     PIndexRangeScan,
     PLimit,
     PProjection,
@@ -119,6 +120,17 @@ def build_executor(plan: PhysicalPlan) -> Executor:
             plan.aggs,
             plan.strategy,
             segment_sizes=getattr(plan, "segment_sizes", None),
+        )
+    if isinstance(plan, PIndexJoin):
+        return IndexJoinExec(
+            plan.schema,
+            build_executor(plan.child),
+            plan.eq_outer,
+            plan.inner_table,
+            plan.index_name,
+            plan.inner_schema,
+            plan.inner_cond,
+            plan.other_cond,
         )
     if isinstance(plan, PHashJoin):
         probe_idx = 1 - plan.build_side

@@ -39,7 +39,7 @@ from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
 from tidb_tpu.types import INT64, TypeKind
 
-__all__ = ["HashJoinExec"]
+__all__ = ["HashJoinExec", "IndexJoinExec"]
 
 
 def _as_int64_key(d, mode: str):
@@ -617,3 +617,121 @@ class HashJoinExec(Executor):
             return Chunk(cols, valid_out)
 
         return jax.jit(expand)
+
+
+class IndexJoinExec(Executor):
+    """Index-lookup join (ref: executor's IndexLookUpJoin; SURVEY.md:91):
+    the inner side is never scanned — each outer chunk's join keys are
+    batch-binary-searched against the inner table's sorted index cache
+    (the same substrate PointGet/IndexRangeScan probe), candidate rows
+    pass MVCC visibility, and matches gather straight from table
+    storage. O((outer + matches) log n) host work, independent of the
+    inner table's size — the access-path alternative the cascades memo
+    costs against the hash join's exchange + build."""
+
+    def __init__(self, schema, outer: Executor, eq_outer, inner_table,
+                 index_name, inner_schema, inner_cond, other_cond):
+        super().__init__(schema, [outer])
+        self.eq_outer = eq_outer
+        self.inner_table = inner_table
+        self.index_name = index_name
+        self.inner_schema = inner_schema
+        self.inner_cond = inner_cond
+        self.other_cond = other_cond
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self.ctx = ctx
+        from tidb_tpu.expression.compiler import compile_expr
+
+        self._key_fns = [compile_expr(e) for e in self.eq_outer]
+        self._pending: List[Chunk] = []
+        self._skeys, self._srows = self.inner_table._sorted_index(
+            self.index_name)
+        self._resid = None
+        if self.inner_cond is not None or self.other_cond is not None:
+            conds = [c for c in (self.inner_cond, self.other_cond)
+                     if c is not None]
+            self._resid = [compile_predicate(c) for c in conds]
+
+    def next(self) -> Optional[Chunk]:
+        while True:
+            if self._pending:
+                return self._pending.pop(0)
+            ch = self.children[0].next()
+            if ch is None:
+                return None
+            self._join_chunk(ch)
+
+    def _join_chunk(self, ch: Chunk) -> None:
+        sel = np.asarray(ch.sel)
+        live = np.nonzero(sel)[0]
+        if len(live) == 0:
+            return
+        skeys, srows = self._skeys, self._srows
+        nkeys = len(self._key_fns)
+        i64 = np.iinfo(np.int64)
+        # the index may be wider than the join key set (a composite pk
+        # probed on its prefix): floor/ceil the suffix fields so the
+        # whole equal-prefix run matches, not just suffix == 0
+        probe_lo = np.zeros(len(live), dtype=skeys.dtype)
+        probe_hi = np.zeros(len(live), dtype=skeys.dtype)
+        for name in skeys.dtype.names[nkeys:]:
+            probe_lo[name] = i64.min
+            probe_hi[name] = i64.max
+        kvalid = np.ones(len(live), dtype=np.bool_)
+        for i, fn in enumerate(self._key_fns):
+            col = fn(ch)
+            kvalid &= np.asarray(col.valid)[live]
+            keys = np.asarray(col.data)[live].astype(np.int64)
+            probe_lo[f"k{i}"] = keys
+            probe_hi[f"k{i}"] = keys
+        # NULL keys match nothing; searchsorted over the composite tuple
+        # gives the exact equality run — no hashing, no collisions
+        lo = np.searchsorted(skeys, probe_lo, side="left")
+        hi = np.searchsorted(skeys, probe_hi, side="right")
+        counts = np.where(kvalid, hi - lo, 0)
+        total = int(counts.sum())
+        if total == 0:
+            return
+        outer_pos = np.repeat(np.arange(len(live)), counts)
+        starts = np.repeat(lo, counts)
+        offs = np.arange(total) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        cand = srows[starts + offs]
+        vis = self.inner_table._mvcc_mask(
+            cand, read_ts=self.ctx.read_ts, marker=self.ctx.txn_marker)
+        cand = cand[vis]
+        outer_rows = live[outer_pos[vis]]
+        # windowed emission: expansion is bounded to chunk_capacity per
+        # output chunk (the HashJoinExec contract), so a many-match key
+        # set cannot spike host memory or mint giant downstream shapes
+        win = max(self.ctx.chunk_capacity, 8)
+        for s0 in range(0, len(cand), win):
+            self._emit(ch, outer_rows[s0:s0 + win], cand[s0:s0 + win])
+
+    def _emit(self, ch: Chunk, outer_rows, cand) -> None:
+        if len(cand) == 0:
+            return
+        cap = 8
+        while cap < len(cand):
+            cap *= 2
+        cols = {}
+        for c in self.inner_schema:
+            d = self.inner_table.data[c.name][cand]
+            v = self.inner_table.valid[c.name][cand]
+            cols[c.uid] = Column.from_numpy(d, c.type_, valid=v,
+                                            capacity=cap)
+        for uid, col in ch.columns.items():
+            d = np.asarray(col.data)[outer_rows]
+            v = np.asarray(col.valid)[outer_rows]
+            cols[uid] = Column.from_numpy(d, col.type_, valid=v,
+                                          capacity=cap)
+        osel = np.zeros(cap, dtype=np.bool_)
+        osel[: len(cand)] = True
+        out = Chunk(cols, osel)
+        if self._resid is not None:
+            for pred in self._resid:
+                out = out.filter(pred(out))
+        self.stats.chunks += 1
+        self._pending.append(out)

@@ -786,11 +786,13 @@ class Cluster:
     def load_partition(self, worker: int, table: str, arrays=None,
                        valids=None, strings=None, db: Optional[str] = None
                        ) -> int:
-        self._partitioned.add(table)
         n = self._call(worker, {
             "cmd": "load_columns", "table": table, "arrays": arrays,
             "valids": valids, "strings": strings, "db": db,
         })
+        # mark only after the load lands: a stale mark on a failed load
+        # would defeat the replicated-table refusal in partial_rewrite
+        self._partitioned.add(table)
         rep = self.replicas.get(worker)
         if rep is not None:
             self._call(rep, {

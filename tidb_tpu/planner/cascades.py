@@ -29,6 +29,41 @@ __all__ = ["memo_join_search", "MAX_LEAVES"]
 
 MAX_LEAVES = 10  # 2^10 groups tops; greedy handles wider joins
 
+# memo-only local-work factor: every hash join step touches both inputs
+# locally (sort/build) on top of the exchange _join_step_cost charges;
+# an index join touches only outer * log2(inner) probe work. Charging
+# the term uniformly keeps memo costs comparable across splits while
+# letting access-path and join-order choice trade off (SURVEY.md:88-89).
+LOCAL_WORK = 0.25
+
+
+def _index_path(leaf, inner_exprs) -> Optional[str]:
+    """Name of an index on `leaf`'s base table whose key prefix equals
+    the inner-side join key columns, or None. Mirrors the PointGet
+    restrictions: plain INT key columns only (other int64-backed kinds
+    store rescaled encodings, and float bit patterns do not sort
+    numerically, so the sorted-cache binary search would miss)."""
+    from tidb_tpu.expression.expr import ColumnRef
+    from tidb_tpu.planner.logical import LScan
+    from tidb_tpu.types import TypeKind
+
+    if not isinstance(leaf, LScan) or leaf.table is None or not inner_exprs:
+        return None
+    uid_to_col = {c.uid: c for c in leaf.schema}
+    cols = set()
+    for e in inner_exprs:
+        if not isinstance(e, ColumnRef):
+            return None
+        col = uid_to_col.get(e.name)
+        if col is None or col.type_.kind != TypeKind.INT:
+            return None
+        cols.add(col.name)
+    for idx in getattr(leaf.table, "indexes", {}).values():
+        if len(idx.columns) >= len(cols) and set(
+                idx.columns[:len(cols)]) == cols:
+            return idx.name
+    return None
+
 
 @dataclass
 class GroupExpr:
@@ -119,8 +154,35 @@ def memo_join_search(leaves: List[LogicalPlan], eqs, others,
                     rows = g1.rows * g2.rows
                 from tidb_tpu.planner.rules import _join_step_cost
 
-                cost = (g1.cost + g2.cost
-                        + _join_step_cost(g1.rows, g2.rows, rows, n_parts))
+                hash_cost = (_join_step_cost(g1.rows, g2.rows, rows, n_parts)
+                             + LOCAL_WORK * (g1.rows + g2.rows))
+                step = hash_cost
+                idx_name = None
+                idx_children = None
+                if conds:
+                    # access-path alternative: a single-leaf side whose
+                    # base table indexes the join key set can be probed
+                    # O(log n) per outer row on the host — no exchange,
+                    # no touch of unmatched inner rows
+                    import math
+
+                    for outer_g, inner_g, inner_mask, oriented in (
+                            (g1, g2, s2, conds),
+                            (g2, g1, s1, [(b, a) for a, b in conds])):
+                        if inner_mask.bit_count() != 1:
+                            continue
+                        name = _index_path(inner_g.plan,
+                                           [b for _, b in oriented])
+                        if name is None:
+                            continue
+                        idx_cost = (LOCAL_WORK * outer_g.rows
+                                    * math.log2(max(inner_g.rows, 2.0))
+                                    + rows)
+                        if idx_cost < step:
+                            step = idx_cost
+                            idx_name = name
+                            idx_children = (outer_g, inner_g, oriented)
+                cost = g1.cost + g2.cost + step
                 cur = memo.best(mask)
                 if cur is not None and cost >= cur.cost:
                     continue
@@ -129,11 +191,20 @@ def memo_join_search(leaves: List[LogicalPlan], eqs, others,
                 # kind stays "inner" even with no conds — the lowering
                 # treats empty eq_conds as the cross join, matching the
                 # greedy orderer's convention
-                plan = LJoin(
-                    schema=list(g1.plan.schema) + list(g2.plan.schema),
-                    children=[g1.plan, g2.plan],
-                    kind="inner", eq_conds=conds,
-                )
+                if idx_name is not None:
+                    og, ig, oriented = idx_children
+                    plan = LJoin(
+                        schema=list(og.plan.schema) + list(ig.plan.schema),
+                        children=[og.plan, ig.plan],
+                        kind="inner", eq_conds=oriented,
+                        index_join=idx_name,
+                    )
+                else:
+                    plan = LJoin(
+                        schema=list(g1.plan.schema) + list(g2.plan.schema),
+                        children=[g1.plan, g2.plan],
+                        kind="inner", eq_conds=conds,
+                    )
                 memo.offer(mask, GroupExpr(plan, cost, rows))
 
     win = memo.best(full)

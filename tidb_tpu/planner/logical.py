@@ -97,6 +97,11 @@ class LJoin(LogicalPlan):
     # anti joins from NOT EXISTS keep NULL-key probe rows (no match ->
     # EXISTS is false -> NOT EXISTS true), unlike NOT IN's NULL semantics
     exists_sem: bool = False
+    # memo-chosen index access path for the INNER (right-child) side:
+    # index name on the right child's base table whose key prefix is the
+    # join key set — the lowering emits an IndexJoin instead of a hash
+    # join (planner/cascades.py; SURVEY.md:88-89 access-path search)
+    index_join: Optional[str] = None
 
 
 @dataclass

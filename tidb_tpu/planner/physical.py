@@ -31,7 +31,7 @@ from tidb_tpu.planner.logical import (
 __all__ = [
     "PhysicalPlan", "PScan", "PSelection", "PProjection", "PHashAgg",
     "PHashJoin", "PSort", "PTopN", "PLimit", "PUnion", "PWindow",
-    "PPointGet", "PIndexRangeScan", "lower", "explain_text",
+    "PPointGet", "PIndexRangeScan", "PIndexJoin", "lower", "explain_text",
 ]
 
 
@@ -320,6 +320,65 @@ class PHashJoin(PhysicalPlan):
 
     def op_info(self):
         return f"{self.kind} join, build:child[{self.build_side}], keys:{len(self.eq_left)}"
+
+
+@dataclass
+class PIndexJoin(PhysicalPlan):
+    """Index-lookup join (ref: executor's IndexLookUpJoin / the memo's
+    access-path alternative, SURVEY.md:88-89): ONE child — the outer —
+    plus a static inner base-table scan probed through the sorted index
+    cache, O(log n) per outer row. Chosen by the cascades memo when the
+    probe cost beats the hash join's exchange + local work."""
+
+    kind: str = "inner"
+    eq_outer: List = field(default_factory=list)   # exprs over the outer
+    index_name: str = ""
+    inner_table: object = None
+    inner_table_name: str = ""
+    inner_schema: List[PlanCol] = field(default_factory=list)
+    inner_key_cols: List[str] = field(default_factory=list)  # index order
+    inner_cond: object = None        # inner scan's pushed filter (residual)
+    other_cond: object = None
+    task: str = "root"
+
+    def op_name(self):
+        return "IndexJoin"
+
+    def op_info(self):
+        return (f"inner table:{self.inner_table_name}, "
+                f"index:{self.index_name}, keys:{len(self.eq_outer)}")
+
+
+def _lower_index_join(plan, l, est):
+    """LJoin annotated by the memo -> PIndexJoin; None if the shape
+    drifted since annotation (falls back to the hash join)."""
+    from tidb_tpu.expression.expr import ColumnRef
+
+    inner = plan.children[1]
+    if not isinstance(inner, LScan) or inner.table is None:
+        return None
+    idx = getattr(inner.table, "indexes", {}).get(plan.index_join)
+    if idx is None:
+        return None
+    uid_to_name = {c.uid: c.name for c in inner.schema}
+    by_col = {}
+    for oe, ie in plan.eq_conds:
+        if not isinstance(ie, ColumnRef):
+            return None
+        name = uid_to_name.get(ie.name)
+        if name is None or name in by_col:
+            return None
+        by_col[name] = oe
+    key_cols = list(idx.columns[: len(by_col)])
+    if set(key_cols) != set(by_col):
+        return None
+    return PIndexJoin(
+        schema=plan.schema, children=[l], est_rows=est,
+        kind=plan.kind, eq_outer=[by_col[c] for c in key_cols],
+        index_name=idx.name, inner_table=inner.table,
+        inner_table_name=inner.table_name, inner_schema=list(inner.schema),
+        inner_key_cols=key_cols, inner_cond=inner.pushed_cond,
+        other_cond=plan.other_cond)
 
 
 @dataclass
@@ -615,6 +674,10 @@ def lower(plan: LogicalPlan) -> PhysicalPlan:
         return node
     if isinstance(plan, LJoin):
         l = lower(plan.children[0])
+        if plan.index_join is not None and plan.kind == "inner":
+            ij = _lower_index_join(plan, l, est)
+            if ij is not None:
+                return ij
         r = lower(plan.children[1])
         eq_l = [lc for lc, _ in plan.eq_conds]
         eq_r = [rc for _, rc in plan.eq_conds]

@@ -820,22 +820,27 @@ class Table:
             self._lookup_cache[idx_name] = hit
         return hit[1], hit[2]
 
+    def _mvcc_mask(self, cand: np.ndarray, read_ts=None,
+                   marker: int = 0) -> np.ndarray:
+        """Visibility mask over candidate physical rows at `read_ts`
+        (own-txn writes included via `marker`)."""
+        b = self.begin_ts[cand]
+        e = self.end_ts[cand]
+        if read_ts is None:
+            return (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
+        keep = (b <= read_ts) & (e > read_ts)
+        if marker:
+            keep = (((b <= read_ts) | (b == marker))
+                    & (e > read_ts) & (e != marker))
+        return keep
+
     def _mvcc_visible(self, cand: np.ndarray, read_ts=None,
                       marker: int = 0) -> np.ndarray:
         """Filter candidate physical rows to the versions visible at
         `read_ts` (own-txn writes included via `marker`)."""
         if len(cand) == 0:
             return cand
-        b = self.begin_ts[cand]
-        e = self.end_ts[cand]
-        if read_ts is None:
-            keep = (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
-        else:
-            keep = (b <= read_ts) & (e > read_ts)
-            if marker:
-                keep = (((b <= read_ts) | (b == marker))
-                        & (e > read_ts) & (e != marker))
-        return cand[keep]
+        return cand[self._mvcc_mask(cand, read_ts, marker)]
 
     def index_lookup(self, idx_name: str, key_vals, read_ts=None,
                      marker: int = 0) -> np.ndarray:
