@@ -1,0 +1,233 @@
+"""MCV/TopN-aware join selectivity and KMV NDV sketch maintenance.
+
+Ref counterpart: statistics/ CMSketch+TopN feeding planner/core's join
+cardinality, and sketch-based NDV maintenance between auto-analyzes
+(round-3 VERDICT task 10). The pinned properties:
+  * ANALYZE collects heavy hitters (MCV) per column;
+  * equi-join estimates match heavy hitters across both sides, so two
+    skewed key columns estimate near |L|*|R|*p^2, not |L|*|R|/ndv;
+  * that difference is EXPLAIN-visible and flips a greedy join order
+    NDV-only estimation gets wrong;
+  * between analyzes, the insert-fed KMV sketch keeps column_ndv
+    tracking churn while histogram/MCV stats go stale.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.parser import parse
+from tidb_tpu.planner.physical import PHashJoin, PScan
+from tidb_tpu.session import Session
+from tidb_tpu.statistics import (NDVSketch, _hash_reprs, analyze_table,
+                                 column_ndv, eq_join_selectivity,
+                                 table_stats)
+
+
+@pytest.fixture
+def sess():
+    return Session(chunk_capacity=1 << 15)
+
+
+def _skewed_keys(n, heavy_frac, heavy_val, ndv, seed):
+    """n int64 keys: heavy_frac of rows = heavy_val, rest uniform over
+    [1000, 1000+ndv)."""
+    rng = np.random.default_rng(seed)
+    k = rng.integers(1000, 1000 + ndv, size=n)
+    k[rng.random(n) < heavy_frac] = heavy_val
+    return k.astype(np.int64)
+
+
+def test_analyze_collects_mcv(sess):
+    sess.execute("create table t (k bigint, s varchar(10))")
+    t = sess.catalog.table("test", "t")
+    k = _skewed_keys(5000, 0.9, 7, 500, seed=1)
+    strs = ["hot" if i % 10 < 9 else f"cold{i}" for i in range(5000)]
+    t.insert_columns({"k": k}, strings={"s": strs})
+    s = analyze_table(t)
+    mk = s.cols["k"].mcv
+    assert mk is not None and 7.0 in mk
+    assert abs(mk[7.0] - (k == 7).sum()) == 0
+    ms = s.cols["s"].mcv
+    assert ms is not None and ms["hot"] == strs.count("hot")
+
+
+def test_eq_join_selectivity_skew():
+    sess = Session()
+    sess.execute("create table l (k bigint)")
+    sess.execute("create table r (k bigint)")
+    tl = sess.catalog.table("test", "l")
+    tr = sess.catalog.table("test", "r")
+    tl.insert_columns({"k": _skewed_keys(8000, 0.9, 7, 1000, seed=2)})
+    tr.insert_columns({"k": _skewed_keys(8000, 0.9, 7, 1000, seed=3)})
+    sl, sr = analyze_table(tl), analyze_table(tr)
+    sel = eq_join_selectivity(sl, sl.cols["k"], sr, sr.cols["k"])
+    # true selectivity ~= 0.9^2 plus a sliver of residual matches; the
+    # uniformity rule would say ~1/1000
+    assert 0.7 <= sel <= 1.0
+    # sanity: exact truth from the data
+    kl, kr = tl.data["k"][:8000], tr.data["k"][:8000]
+    vl, cl_ = np.unique(kl, return_counts=True)
+    vr, cr_ = np.unique(kr, return_counts=True)
+    common, il, ir = np.intersect1d(vl, vr, return_indices=True)
+    truth = float((cl_[il] * cr_[ir]).sum()) / (len(kl) * len(kr))
+    assert abs(sel - truth) / truth < 0.25
+
+
+def _join_order(phys):
+    """Bottom-up list of scan table names in join-tree order."""
+    names = []
+
+    def visit(p):
+        if isinstance(p, PScan):
+            names.append(p.table_name)
+        for c in p.children:
+            visit(c)
+
+    visit(phys)
+    return names
+
+
+def _deepest_join_tables(phys):
+    """The pair of tables joined first (deepest PHashJoin's scan set)."""
+    best = None
+
+    def visit(p, depth):
+        nonlocal best
+        if isinstance(p, PHashJoin):
+            if best is None or depth > best[0]:
+                scans = []
+
+                def leaves(q):
+                    if isinstance(q, PScan):
+                        scans.append(q.table_name)
+                    for c in q.children:
+                        leaves(c)
+
+                leaves(p)
+                best = (depth, set(scans))
+        for c in p.children:
+            visit(c, depth + 1)
+
+    visit(phys, 0)
+    return best[1] if best else set()
+
+
+def test_mcv_flips_join_order(sess):
+    """a.k=b.k is skewed on both sides (huge true output); a.u=c.u is
+    uniform. NDV-only estimation thinks a JOIN b is small and joins it
+    first; MCV-aware estimation defers it behind a JOIN c."""
+    sess.execute("create table a (k bigint, u bigint)")
+    sess.execute("create table b (k bigint, v bigint)")
+    sess.execute("create table c (u bigint, w bigint)")
+    ta = sess.catalog.table("test", "a")
+    tb = sess.catalog.table("test", "b")
+    tc = sess.catalog.table("test", "c")
+    rng = np.random.default_rng(7)
+    na, nb, nc = 10000, 15000, 15000
+    # 50% heavy keeps the key NDV high (~1800 of 2000), so NDV-only
+    # estimation still thinks the skewed join is small (|a||b|/ndv ~ 8e4)
+    # while the true output is ~0.25*|a|*|b| ~ 3.7e7 — a 450x miss
+    ta.insert_columns({"k": _skewed_keys(na, 0.5, 7, 2000, seed=4),
+                       "u": rng.integers(0, 1000, na).astype(np.int64)})
+    tb.insert_columns({"k": _skewed_keys(nb, 0.5, 7, 2000, seed=5),
+                       "v": np.arange(nb, dtype=np.int64)})
+    tc.insert_columns({"u": rng.integers(0, 1000, nc).astype(np.int64),
+                       "w": np.arange(nc, dtype=np.int64)})
+    sess.execute("analyze table a, b, c")
+    sql = ("select count(*) from a, b, c "
+           "where a.k = b.k and a.u = c.u")
+    phys = sess._plan_select(parse(sql)[0])
+    assert _deepest_join_tables(phys) == {"a", "c"}, _join_order(phys)
+
+    # strip the MCVs -> NDV-only estimation joins the skewed pair first
+    # (the misestimate this feature exists to fix)
+    for t in (ta, tb, tc):
+        for cs in t.stats.cols.values():
+            cs.mcv = None
+    phys2 = sess._plan_select(parse(sql)[0])
+    assert _deepest_join_tables(phys2) == {"a", "b"}, _join_order(phys2)
+
+
+def test_skew_estimate_explain_visible(sess):
+    sess.execute("create table l (k bigint)")
+    sess.execute("create table r (k bigint)")
+    tl = sess.catalog.table("test", "l")
+    tr = sess.catalog.table("test", "r")
+    tl.insert_columns({"k": _skewed_keys(4000, 0.9, 7, 1000, seed=8)})
+    tr.insert_columns({"k": _skewed_keys(4000, 0.9, 7, 1000, seed=9)})
+    sess.execute("analyze table l, r")
+    rows = sess.execute("explain select count(*) from l, r where l.k = r.k")
+    txt = "\n".join(" ".join(str(c) for c in row) for row in rows.rows)
+    est = [float(tok) for tok in txt.split() if tok.replace(".", "").isdigit()]
+    # the join's estRows must reflect skew: ~0.81 * 16M >> 4000*4000/1000
+    assert any(e > 5e6 for e in est), txt
+
+
+def test_sketch_tracks_churn(sess):
+    sess.execute("create table t (k bigint)")
+    t = sess.catalog.table("test", "t")
+    t.insert_columns({"k": np.arange(1000, dtype=np.int64)})
+    sess.execute("analyze table t")
+    assert column_ndv(t, "k") == 1000.0  # fresh stats: exact
+    # churn WITHOUT re-analyze: 3000 new distinct values
+    t.insert_columns({"k": np.arange(1000, 4000, dtype=np.int64)})
+    assert table_stats(t) is None  # histograms/MCV are stale...
+    est = column_ndv(t, "k")      # ...but NDV keeps tracking
+    assert est is not None and abs(est - 4000) / 4000 < 0.25
+    # repeated values don't inflate it
+    t.insert_columns({"k": np.arange(1000, dtype=np.int64)})
+    est2 = column_ndv(t, "k")
+    assert abs(est2 - 4000) / 4000 < 0.25
+
+
+def test_sketch_tracks_updates(sess):
+    """UPDATE appends new MVCC versions; their values must feed the
+    sketch too (an update-heavy workload can widen a column's domain
+    without a single INSERT)."""
+    sess.execute("create table t (id bigint, k bigint)")
+    sess.execute("set tidb_enable_auto_analyze = 0")
+    t = sess.catalog.table("test", "t")
+    t.insert_columns({"id": np.arange(3000, dtype=np.int64),
+                      "k": np.zeros(3000, dtype=np.int64)})  # NDV(k)=1
+    sess.execute("analyze table t")
+    assert column_ndv(t, "k") == 1.0
+    # below the auto-analyze ratio, but the domain exploded
+    sess.execute("update t set k = id + 10 where id < 1400")
+    est = column_ndv(t, "k")
+    assert est is not None and est > 1000, est
+
+
+def test_sketch_tracks_strings(sess):
+    sess.execute("create table t (s varchar(16))")
+    t = sess.catalog.table("test", "t")
+    t.insert_columns({}, strings={"s": [f"v{i}" for i in range(500)]})
+    sess.execute("analyze table t")
+    t.insert_columns({}, strings={"s": [f"w{i}" for i in range(1500)]})
+    est = column_ndv(t, "s")
+    assert est is not None and abs(est - 2000) / 2000 < 0.25
+
+
+def test_sketch_via_sql_inserts(sess):
+    """The DML path (insert_rows) feeds the sketch too."""
+    sess.execute("create table t (k bigint)")
+    sess.execute("set tidb_enable_auto_analyze = 0")
+    sess.execute("insert into t values " +
+                 ", ".join(f"({i})" for i in range(600)))
+    sess.execute("analyze table t")
+    sess.execute("insert into t values " +
+                 ", ".join(f"({i})" for i in range(600, 1800)))
+    t = sess.catalog.table("test", "t")
+    est = column_ndv(t, "k")
+    assert est is not None and abs(est - 1800) / 1800 < 0.25
+
+
+def test_kmv_sketch_accuracy():
+    rng = np.random.default_rng(0)
+    for true_ndv in (100, 5000, 200000):
+        sk = NDVSketch()
+        vals = rng.integers(0, true_ndv, size=400000)
+        # feed in chunks like incremental inserts
+        for part in np.array_split(vals, 7):
+            sk.update(_hash_reprs(part))
+        seen = len(np.unique(vals))
+        assert abs(sk.estimate() - seen) / seen < 0.2, (true_ndv, sk.estimate())

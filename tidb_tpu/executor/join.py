@@ -49,11 +49,11 @@ def _as_int64_key(d, mode: str):
     return d.astype(jnp.int64)
 
 
-# splitmix64-style mixing constants (used identically on host numpy and
-# device jnp; only same-function-both-sides matters, not canonicality)
-_MIX_C1 = np.uint64(0x9E3779B97F4A7C15)
-_MIX_C2 = np.uint64(0xBF58476D1CE4E5B9)
-_MIX_C3 = np.uint64(0x94D049BB133111EB)
+# splitmix64-style mixing constants (shared finalizer lives in
+# utils/hashutil; used identically on host numpy and device jnp — only
+# same-function-both-sides matters, not canonicality)
+from tidb_tpu.utils.hashutil import (SM_ADD as _MIX_C1, SM_MUL1 as _MIX_C2,
+                                     SM_MUL2 as _MIX_C3, splitmix64)
 
 
 def _hash_combine_host(key_arrays_i64):
@@ -61,11 +61,7 @@ def _hash_combine_host(key_arrays_i64):
     with np.errstate(over="ignore"):
         h = np.zeros(len(key_arrays_i64[0]), dtype=np.uint64)
         for k in key_arrays_i64:
-            z = k.view(np.uint64) + _MIX_C1
-            z = (z ^ (z >> np.uint64(30))) * _MIX_C2
-            z = (z ^ (z >> np.uint64(27))) * _MIX_C3
-            z = z ^ (z >> np.uint64(31))
-            h = h * _MIX_C1 ^ z
+            h = h * _MIX_C1 ^ splitmix64(k.view(np.uint64))
     return h.view(np.int64)
 
 

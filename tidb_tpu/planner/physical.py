@@ -535,14 +535,49 @@ def _eq_ndv(child: LogicalPlan, expr, child_rows: float) -> Optional[float]:
     return max(min(ndv, child_rows), 1.0)
 
 
+def _key_col_stats(child: LogicalPlan, expr):
+    """(TableStats, ColumnStats) for a join-key column with FRESH stats,
+    else None. Fresh matters: MCV values are only meaningful against the
+    analyzed snapshot."""
+    from tidb_tpu.expression.expr import ColumnRef
+
+    from tidb_tpu.statistics import table_stats
+
+    if not isinstance(expr, ColumnRef):
+        return None
+    r = resolve_scan_col(child, expr.name)
+    if r is None:
+        return None
+    s = table_stats(r[0])
+    if s is None:
+        return None
+    cs = s.cols.get(r[1])
+    return (s, cs) if cs is not None else None
+
+
 def eq_join_rows(left: LogicalPlan, right: LogicalPlan, eq_conds,
                  l: float, r: float, kind: str = "inner") -> float:
-    """|L join R| = |L|*|R| / prod over keys of max(ndv_l, ndv_r); falls
-    back to max(|L|,|R|) when no key has stats. A LEFT join emits every
-    left row at least once, so its estimate floors at |L|. Shared by the
-    cost display (_estimate) and the join reorderer (rules._greedy_order)."""
+    """Equi-join output estimate shared by the cost display (_estimate)
+    and both join orderers (rules._greedy_order, cascades).
+
+    Per key pair, in preference order: MCV-matched selectivity when both
+    sides have fresh analyzed stats (statistics.eq_join_selectivity —
+    catches skewed keys the uniformity rule misestimates by orders of
+    magnitude), else |L|*|R| / max(ndv_l, ndv_r) from whichever side has
+    an NDV (sketch-maintained under churn), else skipped. With no usable
+    key the estimate falls back to max(|L|,|R|). A LEFT join emits every
+    left row at least once, so its estimate floors at |L|."""
+    from tidb_tpu.statistics import eq_join_selectivity
+
     sel = None
     for le, re_ in eq_conds:
+        kl = _key_col_stats(left, le)
+        kr = _key_col_stats(right, re_)
+        if kl is not None and kr is not None and (
+                kl[1].mcv is not None or kr[1].mcv is not None):
+            s = eq_join_selectivity(kl[0], kl[1], kr[0], kr[1])
+            sel = (sel if sel is not None else 1.0) * max(s, 1e-18)
+            continue
         nl = _eq_ndv(left, le, l)
         nr = _eq_ndv(right, re_, r)
         if nl is None and nr is None:

@@ -138,6 +138,10 @@ class Table:
         self._txn_dead: Dict[int, list] = {}
         # rows modified since the last ANALYZE (auto-analyze trigger)
         self.modify_count = 0
+        # per-column KMV NDV sketches (statistics.NDVSketch), seeded by
+        # ANALYZE and fed by every insert so distinct-count estimates
+        # track DML churn between analyzes
+        self.ndv_sketch: Dict[str, object] = {}
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -288,6 +292,7 @@ class Table:
         if log is not None:
             self._log_mark(log)
         self._uniq_commit()
+        self._sketch_insert(start, end)
         return m
 
     def insert_columns(self, arrays: Dict[str, np.ndarray], valids: Optional[Dict[str, np.ndarray]] = None, strings: Optional[Dict[str, list]] = None):
@@ -320,7 +325,29 @@ class Table:
         self.n = end
         self.version += 1
         self._uniq_commit()
+        self._sketch_insert(start, end)
         return m
+
+    def _sketch_insert(self, start: int, end: int) -> None:
+        """Feed newly written rows into the per-column NDV sketches (a
+        no-op until ANALYZE seeds them). Dict-encoded columns hash the
+        decoded strings — codes shift when the sorted dictionary grows,
+        so they are not stable identities over time."""
+        if not self.ndv_sketch:
+            return
+        from tidb_tpu.statistics import _hash_reprs, _hash_strings
+
+        for name, sk in self.ndv_sketch.items():
+            vd = self.valid[name][start:end]
+            vals = self.data[name][start:end][vd]
+            if not len(vals):
+                continue
+            dic = self.dicts.get(name)
+            if dic is not None:
+                codes = np.unique(vals.astype(np.int64))
+                sk.update(_hash_strings([dic.values[int(c)] for c in codes]))
+            else:
+                sk.update(_hash_reprs(vals))
 
     def ingest_encoded(self, arrays: Dict[str, np.ndarray],
                        pools: Dict[str, list]) -> int:
@@ -500,6 +527,7 @@ class Table:
         self.version += 1
         if log is not None:
             self._log_mark(log)
+        self._sketch_insert(start, end)
         return m
 
     def _log_mark(self, log: "TableTxnLog") -> None:

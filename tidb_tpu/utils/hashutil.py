@@ -1,0 +1,27 @@
+"""Shared 64-bit mixing hash (splitmix64 finalizer).
+
+One definition serves every host-side hashing consumer — the hash-join
+key combiner (executor/join.py) and the NDV sketches (statistics/) —
+so the constants and shift schedule can never silently diverge.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SM_ADD = np.uint64(0x9E3779B97F4A7C15)
+SM_MUL1 = np.uint64(0xBF58476D1CE4E5B9)
+SM_MUL2 = np.uint64(0x94D049BB133111EB)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 input (adds the
+    golden-ratio increment, then shift-mixes)."""
+    with np.errstate(over="ignore"):
+        x = (x + SM_ADD).astype(np.uint64)
+        x ^= x >> np.uint64(30)
+        x *= SM_MUL1
+        x ^= x >> np.uint64(27)
+        x *= SM_MUL2
+        x ^= x >> np.uint64(31)
+    return x
