@@ -1,0 +1,146 @@
+"""Extended aggregates: variance/stddev family (plan-time decomposition
+onto SUM/COUNT — exactly mergeable across shards), BIT_AND/BIT_OR/
+BIT_XOR (host generic path with ufunc scatter), GROUP_CONCAT (per-group
+host joins with a RuntimeDictionary output), ANY_VALUE.
+
+Ref counterpart: the reference's aggfuncs evaluators for the same
+functions; the variance rewrite mirrors its partial/final split without
+new state kinds (SURVEY.md aggregation pipeline).
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = Session()
+    sess.execute("create table t (g bigint, x bigint, f double, name varchar(10))")
+    sess.execute(
+        "insert into t values "
+        "(1, 12, 2.0, 'c'), (1, 10, 4.0, 'a'), (1, 10, 6.0, 'b'), "
+        "(2, 7, 5.0, 'z'), (2, 7, 5.0, 'z'), (3, NULL, 7.0, NULL)")
+    return sess
+
+
+def test_variance_family(s):
+    rows = s.query("select g, var_pop(f), stddev(f), var_samp(f), "
+                   "stddev_samp(f) from t group by g order by g")
+    data = {1: [2.0, 4.0, 6.0], 2: [5.0, 5.0], 3: [7.0]}
+    for g, vp, sd, vs, sds in rows:
+        xs = data[g]
+        assert vp == pytest.approx(np.var(xs), abs=1e-9)
+        assert sd == pytest.approx(np.std(xs), abs=1e-9)
+        if len(xs) > 1:
+            assert vs == pytest.approx(np.var(xs, ddof=1), abs=1e-9)
+            assert sds == pytest.approx(np.std(xs, ddof=1), abs=1e-9)
+        else:
+            assert vs is None and sds is None  # n<2 -> NULL (MySQL)
+
+
+def test_variance_global_and_empty(s):
+    allf = [2.0, 4.0, 6.0, 5.0, 5.0, 7.0]
+    assert s.query("select variance(f) from t")[0][0] == \
+        pytest.approx(np.var(allf), abs=1e-9)
+    # empty input -> NULL
+    assert s.query("select std(f) from t where g = 99") == [(None,)]
+    # integer arg computes in double
+    assert s.query("select var_pop(x) from t where g = 1")[0][0] == \
+        pytest.approx(np.var([12, 10, 10]), abs=1e-9)
+
+
+def test_variance_in_having_and_exprs(s):
+    assert s.query("select g from t group by g having stddev(f) > 1 "
+                   "order by g") == [(1,)]
+    got = s.query("select 2 * var_pop(f) + 1 from t where g = 1")[0][0]
+    assert got == pytest.approx(2 * np.var([2.0, 4.0, 6.0]) + 1, abs=1e-9)
+
+
+def test_any_value(s):
+    rows = s.query("select g, any_value(x) from t group by g order by g")
+    assert rows == [(1, 10), (2, 7), (3, None)]
+
+
+def test_bit_aggs(s):
+    rows = s.query("select g, bit_and(x), bit_or(x), bit_xor(x) from t "
+                   "group by g order by g")
+    assert rows[0] == (1, 12 & 10 & 10, 12 | 10, 12 ^ 10 ^ 10)
+    assert rows[1] == (2, 7, 7, 0)
+    # all-NULL group: identities, never NULL (MySQL semantics; BIT_AND's
+    # unsigned all-ones surfaces as the int64 bit pattern -1)
+    assert rows[2] == (3, -1, 0, 0)
+    # DISTINCT dedupes per group before XOR
+    assert s.query("select bit_xor(distinct x) from t where g = 1") == \
+        [(12 ^ 10,)]
+
+
+def test_group_concat_basic(s):
+    rows = s.query("select g, group_concat(name) from t group by g order by g")
+    assert rows == [(1, "c,a,b"), (2, "z,z"), (3, None)]
+
+
+def test_group_concat_order_sep_distinct(s):
+    rows = s.query("select g, group_concat(name order by name separator '|') "
+                   "from t group by g order by g")
+    assert rows == [(1, "a|b|c"), (2, "z|z"), (3, None)]
+    rows = s.query("select g, group_concat(distinct name order by name desc) "
+                   "from t group by g order by g")
+    assert rows == [(1, "c,b,a"), (2, "z"), (3, None)]
+
+
+def test_group_concat_numeric_and_global(s):
+    assert s.query("select group_concat(x order by x) from t where g = 1") == \
+        [("10,10,12",)]
+    assert s.query("select group_concat(f order by f desc separator ';') "
+                   "from t where g = 1") == [("6.0;4.0;2.0",)]
+    # no rows -> NULL
+    assert s.query("select group_concat(name) from t where g = 99") == [(None,)]
+
+
+def test_group_concat_in_join_result(s):
+    """The runtime dictionary must survive plan transforms above the agg."""
+    rows = s.query(
+        "select v.g, v.names from "
+        "(select g, group_concat(name order by name) as names from t group by g) v "
+        "where v.g <= 2 order by v.g")
+    assert rows == [(1, "a,b,c"), (2, "z,z")]
+
+
+def test_bit_aggs_empty_input(s):
+    # global BIT_* over zero rows: identities, never NULL (MySQL; the
+    # unsigned all-ones surfaces as int64 -1)
+    assert s.query("select bit_and(x), bit_or(x), bit_xor(x) from t "
+                   "where g = 99") == [(-1, 0, 0)]
+
+
+def test_extended_aggs_on_device_engine(s):
+    """The device generic-agg router must fall back to the host path for
+    extended aggregates instead of KeyError-ing (third routing point
+    beyond lower() and the fragment tier)."""
+    s.execute("set tidb_device_engine_mode = 'force'")
+    try:
+        assert s.query("select g, bit_or(x), group_concat(name order by name) "
+                       "from t where g <= 2 group by g order by g") == [
+            (1, 12 | 10, "a,b,c"), (2, 7, "z,z")]
+    finally:
+        s.execute("set tidb_device_engine_mode = 'auto'")
+
+
+def test_group_concat_decimal_exact(s):
+    # scaled value 1234567890123456789 > 2^53: float formatting would
+    # round it; integer divmod keeps it exact
+    s.execute("create table dc (d decimal(18, 2))")
+    s.execute("insert into dc values (12345678901234567.89), (-0.05)")
+    assert s.query("select group_concat(d order by d) from dc") == \
+        [("-0.05,12345678901234567.89",)]
+
+
+def test_extended_aggs_wire_through_server_rows(s):
+    # group_concat truncation cap
+    s.execute("create table big (g bigint, v varchar(8))")
+    s.execute("insert into big values " +
+              ", ".join(f"(1, 'v{i:05d}')" for i in range(400)))
+    got = s.query("select group_concat(v) from big")[0][0]
+    assert len(got) == 1024  # MySQL group_concat_max_len default

@@ -151,3 +151,43 @@ class Dictionary:
     @classmethod
     def union(cls, a: "Dictionary", b: "Dictionary") -> "Dictionary":
         return cls(list(a.values) + list(b.values))
+
+
+class RuntimeDictionary(Dictionary):
+    """A dictionary whose values only exist at execution time (e.g. the
+    output of GROUP_CONCAT: result strings are built per run, not at plan
+    time). Plan-time LUT construction over a pending runtime dictionary
+    would bake in an empty table, so those entry points raise until
+    `fill()` provides the values; result decoding (`decode`) then works
+    like any other dictionary."""
+
+    __slots__ = ("pending",)
+
+    def __init__(self, values):
+        super().__init__(values)
+        self.pending = True
+
+    def fill(self, values) -> None:
+        """Replace contents in place (same object stays attached to the
+        plan column across re-executions)."""
+        vals = sorted(set(values))
+        self.values = vals
+        self._index = {v: i for i, v in enumerate(vals)}
+        self.pending = False
+
+    def _guard(self, op: str):
+        if self.pending:
+            raise ValueError(
+                f"{op} over a runtime dictionary before execution")
+
+    def match_table(self, pred):
+        self._guard("match_table")
+        return super().match_table(pred)
+
+    def apply_table(self, fn, out_dtype):
+        self._guard("apply_table")
+        return super().apply_table(fn, out_dtype)
+
+    def translate_to(self, other):
+        self._guard("translate_to")
+        return super().translate_to(other)

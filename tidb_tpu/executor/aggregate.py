@@ -55,6 +55,12 @@ def _max_identity(dtype):
 MERGE_OPS = {".sumhi": "sum", ".sum": "sum", ".cnt": "sum",
              ".min": "min", ".max": "max"}
 
+# host ufunc + identity per bitwise aggregate (generic host path only;
+# the fragment tier rejects these so routing falls back cleanly)
+_BIT_AGGS = {"bit_and": (np.bitwise_and, -1),
+             "bit_or": (np.bitwise_or, 0),
+             "bit_xor": (np.bitwise_xor, 0)}
+
 
 def merge_op_for(key: str) -> str:
     if key == "occ":
@@ -393,8 +399,11 @@ class HashAggExec(Executor):
         from tidb_tpu.utils.memory import SpillableRuns
 
         group_exprs, aggs = self.group_exprs, self.aggs
+        from tidb_tpu.planner.logical import CORE_AGGS
+
         if (group_exprs and self.ctx.device_agg
-                and not any(a.distinct for a in aggs)):
+                and not any(a.distinct for a in aggs)
+                and all(a.func in CORE_AGGS for a in aggs)):
             self._run_generic_device()
             return
 
@@ -446,6 +455,12 @@ class HashAggExec(Executor):
             for c, a in zip(self.schema, self.aggs):
                 if a.func == "count":
                     out_arrays[a.uid] = (np.zeros(1, dtype=np.int64), np.ones(1, dtype=np.bool_))
+                elif a.func in _BIT_AGGS:
+                    # BIT_* never return NULL: empty input keeps the
+                    # identity (MySQL: BIT_AND()=all ones, others 0)
+                    ident = _BIT_AGGS[a.func][1]
+                    out_arrays[a.uid] = (np.full(1, ident, dtype=np.int64),
+                                         np.ones(1, dtype=np.bool_))
                 else:
                     out_arrays[a.uid] = (np.zeros(1, dtype=a.type_.np_dtype), np.zeros(1, dtype=np.bool_))
             self._chunks_from_host(out_arrays, 1, cap)
@@ -626,6 +641,16 @@ class HashAggExec(Executor):
                 m = np.full(g, _max_identity(vals.dtype), dtype=vals.dtype)
                 np.maximum.at(m, inverse[ok], vals[ok])
                 st["max"] = m
+            elif a.func in _BIT_AGGS:
+                op, ident = _BIT_AGGS[a.func]
+                m = np.full(g, ident, dtype=np.int64)
+                op.at(m, inverse[ok], vals[ok].astype(np.int64))
+                st[a.func] = m
+            elif a.func == "group_concat":
+                raise ExecutionError(
+                    "GROUP_CONCAT exceeded the in-memory aggregation "
+                    "budget (spill partials are not supported for it); "
+                    "raise tidb_mem_quota_query")
             states.append(st)
         return {
             "mat": uniq,
@@ -682,6 +707,12 @@ class HashAggExec(Executor):
                 m = np.full(ngroups, ident(parts.dtype), dtype=parts.dtype)
                 op.at(m, inverse, parts)
                 st[a.func] = m
+            elif a.func in _BIT_AGGS:
+                op, ident = _BIT_AGGS[a.func]
+                parts = np.concatenate([p["states"][j][a.func] for p in partials])
+                m = np.full(ngroups, ident, dtype=np.int64)
+                op.at(m, inverse, parts)
+                st[a.func] = m
             states.append(st)
         return {"mat": uniq, "keys": keys, "kvalids": kvalids, "states": states}
 
@@ -713,6 +744,9 @@ class HashAggExec(Executor):
                 with np.errstate(divide="ignore", invalid="ignore"):
                     avg = np.where(cnt > 0, sf / np.maximum(cnt, 1), 0.0)
                 out_arrays[a.uid] = (avg, cnt > 0)
+            elif a.func in _BIT_AGGS:
+                out_arrays[a.uid] = (st[a.func],
+                                     np.ones(ngroups, dtype=np.bool_))
             else:
                 out_arrays[a.uid] = (st[a.func].astype(a.type_.np_dtype), cnt > 0)
         self._chunks_from_host(out_arrays, ngroups, cap)
@@ -732,8 +766,11 @@ class HashAggExec(Executor):
 
     def _generic_agg(self, a: AggSpec, vals, valids, inverse, ngroups):
         ok = valids.astype(np.bool_)
+        if a.func == "group_concat":
+            return self._group_concat(a, vals, ok, inverse, ngroups)
         if a.distinct:
-            if a.func not in ("count", "sum", "avg", "min", "max"):
+            if a.func not in ("count", "sum", "avg", "min", "max",
+                              "bit_and", "bit_or", "bit_xor"):
                 raise UnsupportedError(f"DISTINCT {a.func}")
             bits = self._to_int64_bits(vals, ok)
             trip = np.stack([inverse[ok], bits[ok]], axis=1)
@@ -772,4 +809,82 @@ class HashAggExec(Executor):
             m = np.full(ngroups, _max_identity(vals.dtype), dtype=vals.dtype)
             np.maximum.at(m, inverse[ok], vals[ok])
             return m.astype(a.type_.np_dtype), cnt > 0
+        if a.func in _BIT_AGGS:
+            op, ident = _BIT_AGGS[a.func]
+            m = np.full(ngroups, ident, dtype=np.int64)
+            op.at(m, inverse[ok], vals[ok].astype(np.int64))
+            # MySQL BIT_* ignore NULLs and never return NULL; an empty
+            # group keeps the identity (BIT_AND of nothing = all ones —
+            # we keep the int64 bit pattern of the unsigned value)
+            return m, np.ones(ngroups, dtype=np.bool_)
         raise ExecutionError(f"unknown aggregate {a.func}")
+
+    def _gc_strings(self, a: AggSpec, vv: np.ndarray):
+        """Decode GROUP_CONCAT argument values to their MySQL string
+        forms (strings via the argument's dictionary; numerics/temporals
+        formatted host-side)."""
+        k = a.arg.type_.kind
+        if k in (TypeKind.STRING, TypeKind.JSON):
+            d = getattr(a.arg, "_dict", None)
+            if d is None:
+                raise UnsupportedError("GROUP_CONCAT over dictionary-less string")
+            vals = d.values
+            return [vals[int(c)] for c in vv]
+        if k == TypeKind.DECIMAL:
+            # integer divmod keeps scaled values > 2^53 exact (float
+            # formatting would round them)
+            s = a.arg.type_.scale
+            f = 10 ** s
+
+            def fmt(v):
+                v = int(v)
+                sign = "-" if v < 0 else ""
+                q, r = divmod(abs(v), f)
+                return f"{sign}{q}.{r:0{s}d}" if s else f"{sign}{q}"
+
+            return [fmt(v) for v in vv]
+        if k == TypeKind.FLOAT:
+            return [repr(float(v)) for v in vv]
+        if k in (TypeKind.INT, TypeKind.BOOL):
+            return [str(int(v)) for v in vv]
+        raise UnsupportedError(f"GROUP_CONCAT over {a.arg.type_}")
+
+    # MySQL's group_concat_max_len default: result strings truncate here
+    GROUP_CONCAT_MAX_LEN = 1024
+
+    def _group_concat(self, a: AggSpec, vals, ok, inverse, ngroups):
+        """GROUP_CONCAT(x [ORDER BY x [DESC]] [SEPARATOR s]): per-group
+        string joins on the host generic path. The output dictionary is
+        a RuntimeDictionary filled per execution (result strings cannot
+        exist at plan time)."""
+        sep, order_desc, rdict = a.extra
+        gi = inverse[ok]
+        vv = np.asarray(vals)[ok]
+        if order_desc is None:
+            perm = np.argsort(gi, kind="stable")  # keep input order
+        else:
+            vkey = np.argsort(vv, kind="stable")
+            if order_desc:
+                vkey = vkey[::-1]
+            perm = vkey[np.argsort(gi[vkey], kind="stable")]
+        gi, vv = gi[perm], vv[perm]
+        if a.distinct and len(gi):
+            keep = np.ones(len(gi), dtype=np.bool_)
+            seen = {}
+            for i, (g, v) in enumerate(zip(gi.tolist(), vv.tolist())):
+                if (g, v) in seen:
+                    keep[i] = False
+                seen[(g, v)] = True
+            gi, vv = gi[keep], vv[keep]
+        strs = self._gc_strings(a, vv)
+        out = [None] * ngroups
+        starts = np.flatnonzero(np.diff(gi, prepend=-1)) if len(gi) else []
+        for si, s0 in enumerate(starts):
+            s1 = starts[si + 1] if si + 1 < len(starts) else len(gi)
+            joined = sep.join(strs[s0:s1])
+            out[int(gi[s0])] = joined[: self.GROUP_CONCAT_MAX_LEN]
+        valid = np.array([o is not None for o in out], dtype=np.bool_)
+        rdict.fill([o for o in out if o is not None])
+        codes = np.array([rdict.code_of(o) if o is not None else 0
+                          for o in out], dtype=np.int32)
+        return codes, valid

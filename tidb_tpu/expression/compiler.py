@@ -434,6 +434,111 @@ def _time_extract(which):
     return fn
 
 
+def _week(e: Call, chunk) -> Pair:
+    """WEEK(d) mode 0 (MySQL default): Sunday-first; week 1 starts at the
+    first Sunday of the year, earlier days are week 0."""
+    a = e.args[0]
+    d, v = eval_expr(a, chunk)
+    days = _days(d, a.type_)
+    y, _, _ = dates.civil_from_days(days)
+    jan1 = dates.days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    dow_jan1 = (jan1 + 4) % 7  # 0=Sunday
+    first_sunday = jan1 + (7 - dow_jan1) % 7
+    wk = jnp.where(days < first_sunday, 0, (days - first_sunday) // 7 + 1)
+    return wk.astype(jnp.int64), v
+
+
+def _iso_week(e: Call, chunk) -> Pair:
+    """WEEKOFYEAR(d) = ISO-8601 week number (MySQL WEEK(d, 3)): Monday
+    first, week 1 contains Jan 4. Handles the year-boundary weeks."""
+    a = e.args[0]
+    d, v = eval_expr(a, chunk)
+    days = _days(d, a.type_)
+    y, _, _ = dates.civil_from_days(days)
+
+    def week1_monday(year):
+        jan4 = dates.days_from_civil(year, jnp.full_like(year, 1),
+                                     jnp.full_like(year, 4))
+        return jan4 - (jan4 + 3) % 7  # Monday on/before Jan 4
+
+    w_this, w_next, w_prev = week1_monday(y), week1_monday(y + 1), week1_monday(y - 1)
+    wk = jnp.where(
+        days >= w_next, 1,
+        jnp.where(days < w_this,
+                  (days - w_prev) // 7 + 1,
+                  (days - w_this) // 7 + 1))
+    return wk.astype(jnp.int64), v
+
+
+_DAYS_0000 = 719_528  # days from year 0 ("0000-01-01") to 1970-01-01
+
+
+def _to_days(e: Call, chunk) -> Pair:
+    a = e.args[0]
+    d, v = eval_expr(a, chunk)
+    return _days(d, a.type_) + _DAYS_0000, v
+
+
+def _from_days(e: Call, chunk) -> Pair:
+    d, v = eval_expr(e.args[0], chunk)
+    return (d.astype(jnp.int64) - _DAYS_0000).astype(jnp.int32), v
+
+
+def _last_day(e: Call, chunk) -> Pair:
+    """LAST_DAY(d): the final day of d's month, as a DATE."""
+    a = e.args[0]
+    d, v = eval_expr(a, chunk)
+    days = _days(d, a.type_)
+    y, m, _ = dates.civil_from_days(days)
+    one = jnp.ones_like(m)
+    next_start = dates.days_from_civil(
+        jnp.where(m == 12, y + 1, y), jnp.where(m == 12, one, m + 1), one)
+    return (next_start - 1).astype(jnp.int32), v
+
+
+def _unix_timestamp(e: Call, chunk) -> Pair:
+    a = e.args[0]
+    d, v = eval_expr(a, chunk)
+    if a.type_.kind == TypeKind.DATE:
+        return d.astype(jnp.int64) * 86_400, v
+    return jnp.floor_divide(d.astype(jnp.int64), 1_000_000), v
+
+
+def _from_unixtime(e: Call, chunk) -> Pair:
+    d, v = eval_expr(e.args[0], chunk)
+    return d.astype(jnp.int64) * 1_000_000, v
+
+
+def _tsdiff_months(e: Call, chunk) -> Pair:
+    """TIMESTAMPDIFF(MONTH, a, b): whole months from a to b, boundary-
+    aware the MySQL way — the raw (y,m) delta, minus one when b's
+    (day, time-of-day) hasn't reached a's yet (symmetrically for
+    negative spans)."""
+    a, b = e.args
+
+    def decompose(x):
+        d, v = eval_expr(x, chunk)
+        if x.type_.kind == TypeKind.DATETIME:
+            micros = d.astype(jnp.int64)
+            days = jnp.floor_divide(micros, 86_400_000_000)
+            tod = micros - days * 86_400_000_000
+        else:
+            days = d.astype(jnp.int64)
+            tod = jnp.zeros_like(days)
+        y, m, dd = dates.civil_from_days(days)
+        return y, m, dd, tod, v
+
+    ya, ma, da, ta, va = decompose(a)
+    yb, mb, db, tb, vb = decompose(b)
+    months = (yb - ya) * 12 + (mb - ma)
+    # fractional-month adjustment toward zero
+    frac_b = db * 86_400_000_000 + tb
+    frac_a = da * 86_400_000_000 + ta
+    months = jnp.where((months > 0) & (frac_b < frac_a), months - 1, months)
+    months = jnp.where((months < 0) & (frac_b > frac_a), months + 1, months)
+    return months.astype(jnp.int64), va & vb
+
+
 def _add_months(e: Call, chunk) -> Pair:
     """date/datetime + N months with end-of-month clamping (the device
     path for +/- INTERVAL MONTH/QUARTER/YEAR on column dates)."""
@@ -593,6 +698,18 @@ FUNCS = {
     # MySQL bit ops are BIGINT UNSIGNED: ~ and >> operate on the uint64
     # bit pattern (logical shift, not arithmetic), and shift counts >= 64
     # are defined to produce 0 (XLA leaves them undefined)
+    "week": _week,
+    "weekofyear": _iso_week,
+    "to_days": _to_days,
+    "from_days": _from_days,
+    "last_day": _last_day,
+    "unix_timestamp": _unix_timestamp,
+    "from_unixtime": _from_unixtime,
+    "tsdiff_months": _tsdiff_months,
+    "cot": _strict1(lambda x: 1.0 / jnp.tan(x), cast_float=True),
+    "sinh": _strict1(jnp.sinh, cast_float=True),
+    "cosh": _strict1(jnp.cosh, cast_float=True),
+    "tanh": _strict1(jnp.tanh, cast_float=True),
     "bitand": _strict2(jnp.bitwise_and),
     "bitor": _strict2(jnp.bitwise_or),
     "bitxor": _strict2(jnp.bitwise_xor),

@@ -592,6 +592,11 @@ class _Compiler:
 
         if any(a.distinct for a in agg.aggs):
             raise _Unsupported("DISTINCT aggregates")
+        from tidb_tpu.planner.logical import CORE_AGGS
+
+        for a in agg.aggs:
+            if a.func not in CORE_AGGS:
+                raise _Unsupported(f"aggregate {a.func} on the fragment tier")
 
         if agg.strategy == "segment":
             sizes = agg.segment_sizes or []

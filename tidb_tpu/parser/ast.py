@@ -91,6 +91,9 @@ class EFunc:
     name: str  # lowercased
     args: List["Expr"] = field(default_factory=list)
     distinct: bool = False  # COUNT(DISTINCT x)
+    # GROUP_CONCAT extras: [(expr, desc)] ORDER BY keys and SEPARATOR
+    agg_order: Optional[List[Tuple["Expr", bool]]] = None
+    separator: Optional[str] = None
 
 @dataclass
 class ECase:

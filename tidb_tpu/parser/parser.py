@@ -1204,6 +1204,15 @@ class Parser:
         name = self.expect_ident()
         if self.accept_op("("):
             fname = name.lower()
+            if fname == "position":
+                # POSITION(substr IN str) = LOCATE(substr, str); the
+                # needle parses below IN precedence so IN stays the
+                # separator
+                sub = self.parse_bitor()
+                self.expect_kw("in")
+                s = self.parse_expr()
+                self.expect_op(")")
+                return EFunc("locate", [sub, s])
             distinct = bool(self.accept_kw("distinct"))
             args: List = []
             if not self.at_op(")"):
@@ -1214,6 +1223,29 @@ class Parser:
                     args.append(self.parse_expr())
                     while self.accept_op(","):
                         args.append(self.parse_expr())
+            if fname == "group_concat":
+                # GROUP_CONCAT(x [ORDER BY k [ASC|DESC], ...]
+                #              [SEPARATOR 'sep'])
+                agg_order = None
+                sep = None
+                if self.accept_kw("order"):
+                    self.expect_kw("by")
+                    agg_order = []
+                    while True:
+                        k = self.parse_expr()
+                        desc = bool(self.accept_kw("desc"))
+                        if not desc:
+                            self.accept_kw("asc")
+                        agg_order.append((k, desc))
+                        if not self.accept_op(","):
+                            break
+                t = self.peek()
+                if t.kind == "IDENT" and t.text.lower() == "separator":
+                    self.next()
+                    sep = self.next().text
+                self.expect_op(")")
+                return EFunc(fname, args, distinct=distinct,
+                             agg_order=agg_order, separator=sep)
             self.expect_op(")")
             if self.at_kw("over"):
                 return self._parse_over(fname, args, distinct)
@@ -1260,4 +1292,6 @@ _IDENTISH_KW = {
     # non-reserved in MySQL: usable as identifiers
     "binding", "bindings", "plugin", "plugins", "soname",
     "install", "uninstall", "view", "duplicate",
+    # INSERT(str, pos, len, newstr) the string function
+    "insert",
 }

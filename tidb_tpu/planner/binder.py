@@ -64,7 +64,8 @@ from tidb_tpu.types import (
 
 __all__ = ["PlanCol", "Scope", "Binder", "AGG_FUNCS", "ast_key"]
 
-AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
+AGG_FUNCS = {"sum", "count", "avg", "min", "max",
+             "bit_and", "bit_or", "bit_xor", "group_concat"}
 
 
 @dataclass
@@ -140,6 +141,17 @@ def ast_key(e) -> str:
 class Binder:
     def __init__(self):
         self._uid = 0
+        # session context for DATABASE()/USER()/CONNECTION_ID() etc.;
+        # populated by plan_statement from the owning Session
+        self.session_info: Dict[str, object] = {}
+        # NOW() is statement-start time: every NOW()/CURRENT_TIMESTAMP in
+        # one statement sees the same instant (MySQL semantics)
+        self._now: Optional[datetime.datetime] = None
+
+    def _stmt_now(self) -> datetime.datetime:
+        if self._now is None:
+            self._now = datetime.datetime.now()
+        return self._now
 
     def new_uid(self, base: str) -> str:
         self._uid += 1
@@ -199,7 +211,16 @@ class Binder:
             return self.bind_literal(e)
 
         if isinstance(e, A.EName):
-            pc = scope.resolve(e.name, e.qualifier)
+            try:
+                pc = scope.resolve(e.name, e.qualifier)
+            except UnknownColumnError:
+                # parens-less builtins (CURRENT_DATE, CURRENT_TIMESTAMP,
+                # CURRENT_USER...) parse as names; a real column wins
+                if e.qualifier is None:
+                    lit = self._no_paren_builtin(e.name.lower())
+                    if lit is not None:
+                        return lit
+                raise
             return self.attach_dict(pc.ref(), pc.dict_)
 
         if isinstance(e, A.EUnary):
@@ -680,12 +701,112 @@ class Binder:
 
     # -- scalar functions ----------------------------------------------
 
+    # parens-less keywords usable as 0-arg builtins
+    _NO_PAREN = {
+        "current_date", "current_timestamp", "current_time", "localtime",
+        "localtimestamp", "current_user", "session_user", "utc_date",
+        "utc_time", "utc_timestamp",
+    }
+
+    def _no_paren_builtin(self, name: str) -> Optional[Expr]:
+        if name not in self._NO_PAREN:
+            return None
+        return self._session_builtin(name)
+
+    def _session_builtin(self, name: str) -> Optional[Expr]:
+        """Session/clock builtins folded to literals at bind time (the
+        MySQL statement-start snapshot; ref: expression builtin_time /
+        builtin_info evaluators)."""
+        now = self._stmt_now
+        if name in ("now", "current_timestamp", "localtime", "localtimestamp",
+                    "sysdate"):
+            return Literal(type_=DATETIME, value=datetime_to_micros(now()))
+        if name in ("curdate", "current_date"):
+            return Literal(type_=DATE, value=date_to_days(now().date()))
+        if name == "utc_date":
+            return Literal(
+                type_=DATE,
+                value=date_to_days(datetime.datetime.utcnow().date()))
+        if name == "utc_timestamp":
+            return Literal(
+                type_=DATETIME,
+                value=datetime_to_micros(datetime.datetime.utcnow()))
+        if name in ("curtime", "current_time", "utc_time"):
+            from tidb_tpu.types import time_to_micros
+
+            t = (datetime.datetime.utcnow() if name == "utc_time"
+                 else now()).time()
+            return Literal(type_=TIME, value=time_to_micros(t))
+        if name in ("database", "schema"):
+            db = self.session_info.get("db")
+            return Literal(type_=STRING,
+                           value=None if db is None else str(db))
+        if name in ("user", "current_user", "session_user", "system_user"):
+            return Literal(
+                type_=STRING,
+                value=f"{self.session_info.get('user', 'root')}@%")
+        if name == "version":
+            from tidb_tpu import __version__
+
+            return Literal(type_=STRING, value=f"8.0.11-tidb-tpu-{__version__}")
+        if name == "connection_id":
+            return Literal(type_=INT64,
+                           value=int(self.session_info.get("conn_id", 0)))
+        if name == "unix_timestamp":
+            # derive from the same statement-start instant NOW() folds
+            # to, so UNIX_TIMESTAMP() == UNIX_TIMESTAMP(NOW()) on any
+            # host timezone (the engine clock is naive wall time)
+            return Literal(type_=INT64,
+                           value=datetime_to_micros(now()) // 1_000_000)
+        return None
+
+    _MICRO_UNITS = {
+        "microsecond": 1, "second": 1_000_000, "minute": 60_000_000,
+        "hour": 3_600_000_000, "day": 86_400_000_000,
+        "week": 7 * 86_400_000_000,
+    }
+
     def bind_func(self, e: A.EFunc, scope: Scope) -> Expr:
         name = e.name
         if name in AGG_FUNCS:
             raise PlanError(
                 f"aggregate function {name.upper()} not allowed in this context"
             )
+
+        if not e.args:
+            lit = self._session_builtin(name)
+            if lit is not None:
+                return lit
+
+        if name == "timestampadd" and len(e.args) == 3 and \
+                isinstance(e.args[0], A.EName):
+            return self.bind_interval_arith(
+                "+", e.args[2], A.EInterval(e.args[1], e.args[0].name.lower()),
+                scope)
+
+        if name == "timestampdiff" and len(e.args) == 3 and \
+                isinstance(e.args[0], A.EName):
+            unit = e.args[0].name.lower()
+            a = self.coerce_untyped_literal(self.bind_expr(e.args[1], scope), DATE)
+            b = self.coerce_untyped_literal(self.bind_expr(e.args[2], scope), DATE)
+            if not (a.type_.is_temporal and b.type_.is_temporal):
+                raise PlanError("TIMESTAMPDIFF needs date/datetime arguments")
+            if unit in self._MICRO_UNITS:
+                am = a if a.type_.kind == TypeKind.DATETIME else Cast(
+                    type_=DATETIME, arg=a)
+                bm = b if b.type_.kind == TypeKind.DATETIME else Cast(
+                    type_=DATETIME, arg=b)
+                diff = Call(type_=INT64, op="sub", args=(bm, am))
+                return Call(type_=INT64, op="intdiv", args=(
+                    diff, Literal(type_=INT64, value=self._MICRO_UNITS[unit])))
+            if unit in ("month", "quarter", "year"):
+                months = Call(type_=INT64, op="tsdiff_months", args=(a, b))
+                div = {"month": 1, "quarter": 3, "year": 12}[unit]
+                if div == 1:
+                    return months
+                return Call(type_=INT64, op="intdiv", args=(
+                    months, Literal(type_=INT64, value=div)))
+            raise UnsupportedError(f"TIMESTAMPDIFF unit {unit}")
 
         if name in ("date_add", "adddate", "date_sub", "subdate") and len(e.args) == 2:
             iv = e.args[1]
@@ -778,6 +899,68 @@ class Binder:
             b = self.coerce_untyped_literal(args[1], DATE)
             return Call(type_=INT64, op="sub", args=(a, b))
 
+        if name in ("week", "weekofyear", "to_days", "last_day", "dayname",
+                    "monthname"):
+            a = self.coerce_untyped_literal(args[0], DATE)
+            if not a.type_.is_temporal:
+                raise PlanError(f"{name.upper()} needs a date/datetime argument")
+            if name == "week":
+                mode = 0
+                if len(args) > 1:
+                    if not isinstance(args[1], Literal):
+                        raise UnsupportedError("WEEK mode must be a constant")
+                    mode = int(args[1].value)
+                if mode == 0:
+                    return Call(type_=INT64, op="week", args=(a,))
+                if mode == 3:
+                    return Call(type_=INT64, op="weekofyear", args=(a,))
+                raise UnsupportedError(f"WEEK mode {mode} (0 and 3 supported)")
+            if name == "weekofyear":
+                return Call(type_=INT64, op="weekofyear", args=(a,))
+            if name == "to_days":
+                return Call(type_=INT64, op="to_days", args=(a,))
+            if name == "last_day":
+                return Call(type_=DATE, op="last_day", args=(a,))
+            if name == "dayname":
+                idx = Call(type_=INT64, op="weekday", args=(a,))
+                return self._lut_strings(idx, [
+                    "Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                    "Saturday", "Sunday"])
+            # monthname
+            idx = Call(type_=INT64, op="sub", args=(
+                Call(type_=INT64, op="month", args=(a,)),
+                Literal(type_=INT64, value=1)))
+            return self._lut_strings(idx, [
+                "January", "February", "March", "April", "May", "June",
+                "July", "August", "September", "October", "November",
+                "December"])
+        if name == "from_days":
+            return Call(type_=DATE, op="from_days", args=(args[0],))
+        if name == "unix_timestamp" and len(args) == 1:
+            a = self.coerce_untyped_literal(args[0], DATETIME)
+            if not a.type_.is_temporal:
+                raise PlanError("UNIX_TIMESTAMP needs a date/datetime argument")
+            return Call(type_=INT64, op="unix_timestamp", args=(a,))
+        if name == "from_unixtime" and len(args) >= 1:
+            return Call(type_=DATETIME, op="from_unixtime", args=(args[0],))
+        if name == "str_to_date" and len(args) == 2:
+            return self._bind_str_to_date(args)
+        if name == "date_format" and len(args) == 2:
+            a = self.coerce_untyped_literal(args[0], DATE)
+            if isinstance(a, Literal) and isinstance(args[1], Literal) \
+                    and a.type_.is_temporal and a.value is not None:
+                days = int(a.value)
+                if a.type_.kind == TypeKind.DATETIME:
+                    dt = (datetime.datetime(1970, 1, 1)
+                          + datetime.timedelta(microseconds=days))
+                else:
+                    dt = (datetime.datetime(1970, 1, 1)
+                          + datetime.timedelta(days=days))
+                return Literal(type_=STRING,
+                               value=_mysql_strftime(dt, str(args[1].value)))
+            raise UnsupportedError(
+                "DATE_FORMAT on columns not supported yet (constant fold only)")
+
         if name in ("abs",):
             return Call(type_=args[0].type_, op="abs", args=tuple(args))
         if name in ("ceil", "ceiling", "floor"):
@@ -786,6 +969,10 @@ class Binder:
         if name in ("sqrt", "exp", "ln", "log2", "log10", "sin", "cos"):
             return Call(type_=FLOAT64, op=name, args=tuple(args))
         if name in ("log",):
+            if len(args) == 2:  # LOG(b, x) = LN(x) / LN(b)
+                return Call(type_=FLOAT64, op="div", args=(
+                    Call(type_=FLOAT64, op="ln", args=(args[1],)),
+                    Call(type_=FLOAT64, op="ln", args=(args[0],))))
             return Call(type_=FLOAT64, op="ln", args=tuple(args))
         if name in ("power", "pow"):
             return Call(type_=FLOAT64, op="pow", args=tuple(args))
@@ -826,6 +1013,19 @@ class Binder:
             # LOCATE(substr, str[, pos]) = INSTR(str, substr[, pos])
             return self.bind_string_func("instr", e, [args[1], args[0]] + args[2:])
 
+        if name == "space" and len(args) == 1 and isinstance(args[0], Literal):
+            return Literal(type_=STRING, value=" " * max(int(args[0].value), 0))
+        if name == "strcmp" and len(args) == 2:
+            return self._bind_strcmp(args)
+        if name in ("field", "elt", "find_in_set"):
+            return self._bind_string_list_func(name, args)
+        if name == "char" and all(isinstance(a, Literal) for a in args):
+            return Literal(type_=STRING,
+                           value="".join(chr(int(a.value)) for a in args
+                                         if a.value is not None))
+        if name in ("cot", "sinh", "cosh", "tanh"):
+            return Call(type_=FLOAT64, op=name, args=tuple(args))
+
         # string functions via dictionary LUTs
         if name in _STRING_VALUE_FUNCS:
             return self.bind_string_func(name, e, args)
@@ -841,31 +1041,19 @@ class Binder:
             if isinstance(arg, Literal) and arg.type_.kind == TypeKind.STRING:
                 # fold over the literal host-side
                 val = _apply_string_func(name, str(arg.value), e, args)
-                t = INT64 if name in ("length", "char_length",
-                                      "character_length", "ascii", "instr") else STRING
+                t = INT64 if name in _STRING_INT_FUNCS else STRING
                 return Literal(type_=t, value=val)
             raise UnsupportedError(f"{name} on dictionary-less string")
-        if name in ("length", "char_length", "character_length"):
-            lut = d.apply_table(len, np.int64)
+        if name in _STRING_INT_FUNCS:
+            mapped = [_apply_string_func(name, s, e, args) for s in d.values]
+            lut = np.array(mapped, dtype=np.int64)
             return Lookup.build(arg, lut, INT64)
-        if name == "ascii":
-            lut = d.apply_table(lambda s: ord(s[0]) if s else 0, np.int64)
-            return Lookup.build(arg, lut, INT64)
-        if name == "instr":
-            if len(args) < 2 or not all(isinstance(a, Literal) for a in args[1:]):
-                raise UnsupportedError("INSTR needs constant arguments")
-            sub = str(args[1].value)
-            if len(args) > 2 and int(args[2].value) < 1:
-                return Literal(type_=INT64, value=0)  # MySQL: pos <= 0 -> 0
-            start = int(args[2].value) - 1 if len(args) > 2 else 0
-            lut = d.apply_table(lambda s: s.find(sub, start) + 1, np.int64)
-            return Lookup.build(arg, lut, INT64)
-        # string->string: build the target dictionary
+        # string->string: build the target dictionary; None marks NULL
         mapped = [_apply_string_func(name, s, e, args) for s in d.values]
-        nd = Dictionary(mapped)
-        table = np.array([nd.code_of(m) for m in mapped], dtype=np.int32)
-        out = Lookup.build(arg, table, STRING)
-        return self.attach_dict(out, nd)
+        return self._lut_strings(
+            arg, ["" if m is None else m for m in mapped],
+            valid=None if all(m is not None for m in mapped)
+            else [m is not None for m in mapped])
 
     def bind_json_func(self, name: str, args: List[Expr]) -> Expr:
         """JSON functions as plan-time LUTs over the document dictionary
@@ -932,6 +1120,36 @@ class Binder:
                 valid.append(True)
         return self._lut_strings(arg, outs, valid, type_=JSONTYPE)
 
+    def _bind_str_to_date(self, args: List[Expr]) -> Expr:
+        """STR_TO_DATE(str, fmt): per-dictionary-value host parse -> a
+        numeric date/datetime LUT (the LIKE design); unparseable values
+        are NULL via table_valid."""
+        fmt_lit = args[1]
+        if not isinstance(fmt_lit, Literal):
+            raise UnsupportedError("STR_TO_DATE needs a constant format")
+        pyfmt, has_time = _mysql_fmt_translate(str(fmt_lit.value))
+        t = DATETIME if has_time else DATE
+
+        def parse_one(s):
+            try:
+                dt = datetime.datetime.strptime(s, pyfmt)
+            except (ValueError, TypeError):
+                return None
+            return datetime_to_micros(dt) if has_time else date_to_days(dt.date())
+
+        arg = args[0]
+        if isinstance(arg, Literal) and arg.type_.kind == TypeKind.STRING:
+            v = None if arg.value is None else parse_one(str(arg.value))
+            return Literal(type_=t, value=v)
+        d = self._dict_of(arg)
+        if d is None or arg.type_.kind != TypeKind.STRING:
+            raise UnsupportedError("STR_TO_DATE needs a string column or literal")
+        vals = [parse_one(s) for s in d.values]
+        lut = np.array([0 if v is None else v for v in vals],
+                       dtype=np.int64 if has_time else np.int32)
+        tv = np.array([v is not None for v in vals], dtype=np.bool_)
+        return Lookup.build(arg, lut, t, table_valid=tv)
+
     def _lut_strings(self, arg: Expr, mapped: List[str], valid=None, type_=STRING) -> Expr:
         """Build a string-valued Lookup: mapped[i] is the output for dict
         code i; valid[i]=False marks NULL outputs."""
@@ -941,10 +1159,10 @@ class Binder:
         out = Lookup.build(arg, table, type_, table_valid=tv)
         return self.attach_dict(out, nd)
 
-    def _bind_extreme_strings(self, name: str, args: List[Expr]) -> Expr:
-        """GREATEST/LEAST over strings: translate every operand into one
-        union dictionary (codes are sorted-order-preserving, so max/min
-        over union codes is lexicographic max/min)."""
+    def _union_strings(self, name: str, args: List[Expr]):
+        """Translate string operands into one union dictionary (codes are
+        sorted-order-preserving, so code comparisons are lexicographic).
+        Returns (union, translated args)."""
         union = None
         for a in args:
             if isinstance(a, Literal) and a.type_.kind == TypeKind.STRING:
@@ -966,8 +1184,76 @@ class Binder:
                 else:
                     out_args.append(Lookup.build(
                         a, d.translate_to(union).astype(np.int32), STRING))
+        return union, out_args
+
+    def _bind_extreme_strings(self, name: str, args: List[Expr]) -> Expr:
+        """GREATEST/LEAST over strings: max/min over union codes."""
+        union, out_args = self._union_strings(name, args)
         out = Call(type_=STRING, op=name, args=tuple(out_args))
         return self.attach_dict(out, union)
+
+    def _bind_strcmp(self, args: List[Expr]) -> Expr:
+        """STRCMP(a, b) = sign(a - b) lexicographically, via union-dict
+        code comparison."""
+        _, (ca, cb) = self._union_strings("strcmp", args)
+        diff = Call(type_=INT64, op="sub", args=(ca, cb))
+        return Call(type_=INT64, op="sign", args=(diff,))
+
+    def _bind_string_list_func(self, name: str, args: List[Expr]) -> Expr:
+        """FIELD / ELT / FIND_IN_SET over dictionary LUTs."""
+        if name == "elt":
+            n, items = args[0], args[1:]
+            if not all(isinstance(a, Literal) and a.type_.kind == TypeKind.STRING
+                       for a in items):
+                raise UnsupportedError("ELT items must be string constants")
+            union = Dictionary([str(a.value) for a in items])
+            whens = []
+            for i, a in enumerate(items):
+                cond = Call(type_=BOOL, op="eq",
+                            args=(n, Literal(type_=INT64, value=i + 1)))
+                whens.append((cond, Literal(
+                    type_=STRING, value=union.code_of(str(a.value)))))
+            out = Case(type_=STRING, whens=tuple(whens), else_=None)
+            return self.attach_dict(out, union)
+
+        def set_pos(needle: str, hay: str) -> int:
+            if "," in needle:
+                return 0  # MySQL: a needle containing ',' never matches
+            parts = hay.split(",")
+            return parts.index(needle) + 1 if needle in parts else 0
+
+        if name == "field":
+            arg, items = args[0], []
+            for a in args[1:]:
+                if not isinstance(a, Literal):
+                    raise UnsupportedError("FIELD items must be constants")
+                items.append(str(a.value))
+            if isinstance(arg, Literal):
+                s = str(arg.value)
+                return Literal(type_=INT64,
+                               value=items.index(s) + 1 if s in items else 0)
+            d = self._dict_of(arg)
+            if d is None:
+                raise UnsupportedError("FIELD needs a string column or constant")
+            lut = np.array([items.index(s) + 1 if s in items else 0
+                            for s in d.values], dtype=np.int64)
+            return Lookup.build(arg, lut, INT64)
+
+        # find_in_set(needle, haystack): LUT over whichever side is a column
+        needle, hay = args
+        dn, dh = self._dict_of(needle), self._dict_of(hay)
+        if isinstance(needle, Literal) and isinstance(hay, Literal):
+            return Literal(type_=INT64,
+                           value=set_pos(str(needle.value), str(hay.value)))
+        if isinstance(hay, Literal) and dn is not None:
+            lut = np.array([set_pos(s, str(hay.value)) for s in dn.values],
+                           dtype=np.int64)
+            return Lookup.build(needle, lut, INT64)
+        if isinstance(needle, Literal) and dh is not None:
+            lut = np.array([set_pos(str(needle.value), s) for s in dh.values],
+                           dtype=np.int64)
+            return Lookup.build(hay, lut, INT64)
+        raise UnsupportedError("FIND_IN_SET needs a constant needle or list")
 
     def _bind_concat(self, args: List[Expr]) -> Expr:
         """CONCAT over any mix of dict-encoded string columns and
@@ -1088,10 +1374,57 @@ def _json_path_get(doc, path: str):
 
 _STRING_VALUE_FUNCS = {
     "length", "char_length", "character_length", "upper", "ucase", "lower",
-    "lcase", "trim", "ltrim", "rtrim", "substring", "substr", "left",
+    "lcase", "trim", "ltrim", "rtrim", "substring", "substr", "mid", "left",
     "right", "reverse", "concat", "replace", "lpad", "rpad", "repeat",
-    "ascii", "instr",
+    "ascii", "instr", "substring_index", "md5", "sha1", "sha", "sha2",
+    "to_base64", "from_base64", "hex", "soundex", "quote", "insert",
+    "bit_length", "octet_length", "crc32",
 }
+
+# per-value functions whose result is an integer, not a string
+_STRING_INT_FUNCS = {
+    "length", "char_length", "character_length", "ascii", "instr",
+    "bit_length", "octet_length", "crc32",
+}
+
+
+# MySQL date-format specifier -> python strftime (shared by DATE_FORMAT
+# constant folding and STR_TO_DATE parsing)
+_MYSQL_FMT = {
+    "Y": "%Y", "y": "%y", "m": "%m", "c": "%m", "d": "%d", "e": "%d",
+    "H": "%H", "k": "%H", "h": "%I", "I": "%I", "i": "%M", "s": "%S",
+    "S": "%S", "f": "%f", "p": "%p", "M": "%B", "b": "%b", "a": "%a",
+    "W": "%A", "j": "%j", "w": "%w", "T": "%H:%M:%S", "r": "%I:%M:%S %p",
+    "%": "%%",
+}
+_TIME_SPECS = set("HkhIisSfpTr")
+
+
+def _mysql_fmt_translate(fmt: str) -> Tuple[str, bool]:
+    """MySQL %-format -> (python strftime format, mentions-time)."""
+    out: List[str] = []
+    has_time = False
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            spec = fmt[i + 1]
+            if spec in _TIME_SPECS:
+                has_time = True
+            py = _MYSQL_FMT.get(spec)
+            if py is None:
+                raise UnsupportedError(f"date format specifier %{spec}")
+            out.append(py)
+            i += 2
+        else:
+            out.append("%%" if c == "%" else c)
+            i += 1
+    return "".join(out), has_time
+
+
+def _mysql_strftime(dt: datetime.datetime, fmt: str) -> str:
+    pyfmt, _ = _mysql_fmt_translate(fmt)
+    return dt.strftime(pyfmt)
 
 
 def _apply_string_func(name: str, s: str, e: A.EFunc, args: List[Expr]) -> str:
@@ -1154,6 +1487,79 @@ def _apply_string_func(name: str, s: str, e: A.EFunc, args: List[Expr]) -> str:
             return 0  # MySQL: pos <= 0 -> 0
         start = int(args[2].value) - 1 if len(args) > 2 else 0
         return s.find(str(args[1].value), start) + 1
+    if name == "substring_index":
+        if not all(isinstance(a, Literal) for a in args[1:]):
+            raise UnsupportedError("SUBSTRING_INDEX needs constant arguments")
+        delim, count = str(args[1].value), int(args[2].value)
+        if not delim or count == 0:
+            return ""
+        parts = s.split(delim)
+        if count > 0:
+            return delim.join(parts[:count])
+        return delim.join(parts[count:])
+    if name == "md5":
+        import hashlib
+
+        return hashlib.md5(s.encode()).hexdigest()
+    if name in ("sha1", "sha"):
+        import hashlib
+
+        return hashlib.sha1(s.encode()).hexdigest()
+    if name == "sha2":
+        import hashlib
+
+        bits = int(args[1].value) if len(args) > 1 and isinstance(args[1], Literal) else 256
+        algo = {0: "sha256", 224: "sha224", 256: "sha256",
+                384: "sha384", 512: "sha512"}.get(bits)
+        if algo is None:
+            return None  # MySQL: invalid hash length -> NULL
+        return getattr(hashlib, algo)(s.encode()).hexdigest()
+    if name == "to_base64":
+        import base64
+
+        return base64.b64encode(s.encode()).decode()
+    if name == "from_base64":
+        import base64
+
+        try:
+            return base64.b64decode(s, validate=True).decode()
+        except Exception:  # noqa: BLE001  (binascii or unicode errors)
+            return None  # MySQL: invalid input -> NULL
+    if name == "hex":
+        return s.encode().hex().upper()
+    if name == "soundex":
+        if not s or not s[0].isalpha():
+            return ""
+        codes = {**{c: "1" for c in "BFPV"}, **{c: "2" for c in "CGJKQSXZ"},
+                 **{c: "3" for c in "DT"}, "L": "4",
+                 **{c: "5" for c in "MN"}, "R": "6"}
+        up = [c for c in s.upper() if c.isalpha()]
+        out, last = up[0], codes.get(up[0], "")
+        for c in up[1:]:
+            code = codes.get(c, "")
+            if code and code != last:
+                out += code
+            last = code
+        return (out + "000")[:4]
+    if name == "quote":
+        return "'" + s.replace("\\", "\\\\").replace("'", "\\'") + "'"
+    if name == "insert":
+        if not all(isinstance(a, Literal) for a in args[1:]):
+            raise UnsupportedError("INSERT needs constant arguments")
+        pos, ln, repl = int(args[1].value), int(args[2].value), str(args[3].value)
+        if pos < 1 or pos > len(s):
+            return s
+        return s[: pos - 1] + repl + s[pos - 1 + max(ln, 0):]
+    if name == "bit_length":
+        return len(s.encode()) * 8
+    if name == "octet_length":
+        return len(s.encode())
+    if name == "crc32":
+        import zlib
+
+        return zlib.crc32(s.encode())
+    if name == "mid":
+        return _apply_string_func("substring", s, e, args)
     raise UnsupportedError(f"string function {name}")
 
 

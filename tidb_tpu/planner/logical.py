@@ -72,13 +72,19 @@ class LProjection(LogicalPlan):
     n_visible: Optional[int] = None  # hidden ORDER BY helper columns follow
 
 
+CORE_AGGS = ("sum", "count", "avg", "min", "max")
+
+
 @dataclass
 class AggSpec:
     uid: str
-    func: str            # sum | count | avg | min | max
+    func: str            # sum | count | avg | min | max | bit_* | group_concat
     arg: Optional[Expr]  # None for COUNT(*)
     distinct: bool = False
     type_: SQLType = INT64
+    # GROUP_CONCAT runtime info: (separator, order_desc_or_None,
+    # output RuntimeDictionary to fill at execution)
+    extra: Optional[tuple] = None
 
 
 @dataclass
@@ -615,6 +621,61 @@ def _realias(plan: LogicalPlan, cols: List[PlanCol]) -> LogicalPlan:
 # aggregate extraction
 # ---------------------------------------------------------------------------
 
+_VARIANCE_AGGS = {"variance", "var_pop", "var_samp", "stddev", "std",
+                  "stddev_pop", "stddev_samp"}
+
+
+def _rewrite_extended_aggs(e):
+    """Decompose extended aggregates into the core five (ref: the
+    reference's aggfuncs layer; here rewritten at plan time so every
+    tier — segment kernels, distributed partial/final split, spill —
+    handles them with zero new state kinds):
+
+      VAR_POP(x)  -> (SUM(xf*xf) - SUM(xf)^2/COUNT(x)) / COUNT(x)
+      VAR_SAMP    -> same numerator / (COUNT(x)-1)   (NULL when n<2)
+      STDDEV*     -> SQRT(of the above, floored at 0 for fp jitter)
+      ANY_VALUE   -> MIN
+
+    with xf = CAST(x AS DOUBLE) (MySQL computes variance in double).
+    The rewrite runs on select/having/order-by ASTs before aggregate
+    collection, so arbitrary expressions over these aggregates keep
+    working; sum/count partials stay exactly mergeable across shards."""
+    if not hasattr(e, "__dataclass_fields__") or isinstance(
+            e, (A.SelectStmt, A.UnionStmt)):
+        return e
+    for f in e.__dataclass_fields__:
+        v = getattr(e, f)
+        if isinstance(v, list):
+            setattr(e, f, [
+                _rewrite_extended_aggs(x) if hasattr(x, "__dataclass_fields__")
+                else (tuple(_rewrite_extended_aggs(y) if hasattr(y, "__dataclass_fields__")
+                            else y for y in x) if isinstance(x, tuple) else x)
+                for x in v])
+        elif hasattr(v, "__dataclass_fields__") and not isinstance(
+                v, (A.SelectStmt, A.UnionStmt)):
+            setattr(e, f, _rewrite_extended_aggs(v))
+    if isinstance(e, A.EFunc) and e.name == "any_value" and len(e.args) == 1:
+        return A.EFunc("min", e.args, distinct=False)
+    if isinstance(e, A.EFunc) and e.name in _VARIANCE_AGGS:
+        if len(e.args) != 1:
+            raise UnsupportedError(f"{e.name.upper()} takes one argument")
+        if e.distinct:
+            raise UnsupportedError(f"{e.name.upper()}(DISTINCT) not supported")
+        x = e.args[0]
+        xf = A.ECast(x, "double")
+        sumsq = A.EFunc("sum", [A.EBinary("*", xf, xf)])
+        sm = A.EFunc("sum", [xf])
+        cnt = A.EFunc("count", [x])
+        num = A.EBinary("-", sumsq, A.EBinary("/", A.EBinary("*", sm, sm), cnt))
+        denom = cnt if e.name in ("variance", "var_pop", "stddev", "std",
+                                  "stddev_pop") else A.EBinary("-", cnt, A.ENum("1"))
+        var = A.EFunc("greatest", [A.ENum("0"), A.EBinary("/", num, denom)])
+        if e.name in ("stddev", "std", "stddev_pop", "stddev_samp"):
+            return A.EFunc("sqrt", [var])
+        return var
+    return e
+
+
 def _collect_agg_calls(e, out: Dict[str, A.EFunc]):
     if isinstance(e, A.EFunc) and e.name in AGG_FUNCS:
         out.setdefault(ast_key(e), e)
@@ -806,6 +867,11 @@ def _agg_result_type(func: str, arg: Optional[Expr]) -> SQLType:
         return FLOAT64
     if func in ("min", "max"):
         return arg.type_
+    if func in ("bit_and", "bit_or", "bit_xor"):
+        # MySQL result is BIGINT UNSIGNED; we keep the int64 bit pattern
+        return INT64
+    if func == "group_concat":
+        return STRING
     # sum
     k = arg.type_.kind
     if k == TypeKind.DECIMAL:
@@ -879,6 +945,16 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
             plan = LSelection(schema=plan.schema, children=[plan], cond=cond)
 
     # ---- aggregate detection ----
+    for item in stmt.items:
+        new = _rewrite_extended_aggs(item.expr)
+        if new is not item.expr and item.alias is None:
+            item.alias = expr_display(item.expr)
+        item.expr = new
+    if stmt.having is not None:
+        stmt.having = _rewrite_extended_aggs(stmt.having)
+    for oi in stmt.order_by:
+        oi.expr = _rewrite_extended_aggs(oi.expr)
+
     agg_calls: Dict[str, A.EFunc] = {}
     for item in stmt.items:
         _collect_agg_calls(item.expr, agg_calls)
@@ -1052,10 +1128,29 @@ def _build_aggregate(stmt, plan, scope, ctx, agg_calls, alias_map):
         t = _agg_result_type(func, arg)
         uid = binder.new_uid(func)
         mapping[key] = uid
-        aggs.append(AggSpec(uid=uid, func=func, arg=arg, distinct=call.distinct, type_=t))
+        extra = None
+        out_dict = (getattr(arg, "_dict", None)
+                    if func in ("min", "max") and arg is not None else None)
+        if func == "group_concat":
+            # result strings exist only at execution time: attach a
+            # RuntimeDictionary the executor fills per run
+            from tidb_tpu.chunk.dictionary import RuntimeDictionary
+
+            order_desc = None
+            if call.agg_order is not None:
+                if (len(call.agg_order) != 1
+                        or ast_key(call.agg_order[0][0]) != ast_key(call.args[0])):
+                    raise UnsupportedError(
+                        "GROUP_CONCAT ORDER BY must be the concatenated "
+                        "expression itself")
+                order_desc = call.agg_order[0][1]
+            out_dict = RuntimeDictionary([])
+            extra = (call.separator if call.separator is not None else ",",
+                     order_desc, out_dict)
+        aggs.append(AggSpec(uid=uid, func=func, arg=arg,
+                            distinct=call.distinct, type_=t, extra=extra))
         agg_cols.append(
-            PlanCol(uid=uid, name=expr_display(call), type_=t,
-                    dict_=(getattr(arg, "_dict", None) if func in ("min", "max") and arg is not None else None))
+            PlanCol(uid=uid, name=expr_display(call), type_=t, dict_=out_dict)
         )
 
     node = LAggregate(

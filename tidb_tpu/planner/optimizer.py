@@ -27,11 +27,14 @@ def plan_statement(
     execute_subplan: Optional[Callable] = None,
     cascades: bool = False,
     n_parts: int = 1,
+    session_info: Optional[dict] = None,
 ) -> PhysicalPlan:
     """SELECT/UNION AST -> optimized physical plan."""
     assert isinstance(stmt, (A.SelectStmt, A.UnionStmt)), type(stmt)
+    binder = Binder()
+    binder.session_info = dict(session_info or {}, db=db)
     ctx = BuildContext(
-        catalog=catalog, db=db, binder=Binder(), execute_subplan=execute_subplan
+        catalog=catalog, db=db, binder=binder, execute_subplan=execute_subplan
     )
     logical = build_select(stmt, ctx)
     logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or (),

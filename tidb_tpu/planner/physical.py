@@ -696,9 +696,15 @@ def lower(plan: LogicalPlan) -> PhysicalPlan:
             exprs=plan.exprs, n_visible=plan.n_visible,
         )
     if isinstance(plan, LAggregate):
+        from tidb_tpu.planner.logical import CORE_AGGS
+
         sizes = _segment_domain(plan)
         has_distinct = any(a.distinct for a in plan.aggs)
-        strategy = "segment" if sizes is not None and not has_distinct else "generic"
+        # extended aggregates (bit_*, group_concat) only have host
+        # generic-path implementations
+        core_only = all(a.func in CORE_AGGS for a in plan.aggs)
+        strategy = ("segment" if sizes is not None and not has_distinct
+                    and core_only else "generic")
         node = PHashAgg(
             schema=plan.schema, children=[lower(plan.child)], est_rows=est,
             group_exprs=plan.group_exprs, group_uids=plan.group_uids,

@@ -352,6 +352,8 @@ class Session:
             stmt, self.catalog, db=self.db, execute_subplan=self._execute_subplan,
             cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
             n_parts=n_parts,
+            session_info={"user": self.user,
+                          "conn_id": getattr(self, "conn_id", 0)},
         )
 
     def _apply_binding(self, stmt):

@@ -126,16 +126,23 @@ class NDVSketch:
         return (self.K - 1) * (2.0 ** 64) / float(max(self.mins[-1], 1))
 
 
+def hash_column_values(vals: np.ndarray, dic) -> np.ndarray:
+    """Hash a column's device-representation values for the NDV sketch.
+    Dict-encoded columns hash the decoded strings — codes shift when the
+    sorted dictionary grows, so they are not stable identities over
+    time. The ONE definition shared by ANALYZE seeding and the insert
+    hook (desynchronized hashing would corrupt estimates)."""
+    if dic is not None:
+        codes = np.unique(np.asarray(vals).astype(np.int64))
+        return _hash_strings([dic.values[int(c)] for c in codes])
+    return _hash_reprs(vals)
+
+
 def _seed_sketch(table, col_name: str, vals: np.ndarray) -> None:
     """Seed the per-column NDV sketch from ANALYZE's value pass."""
     sk = NDVSketch()
     if len(vals):
-        dic = table.dicts.get(col_name)
-        if dic is not None:
-            codes = np.unique(vals.astype(np.int64))
-            sk.update(_hash_strings([dic.values[c] for c in codes]))
-        else:
-            sk.update(_hash_reprs(vals))
+        sk.update(hash_column_values(vals, table.dicts.get(col_name)))
     table.ndv_sketch[col_name] = sk
 
 

@@ -335,19 +335,14 @@ class Table:
         so they are not stable identities over time."""
         if not self.ndv_sketch:
             return
-        from tidb_tpu.statistics import _hash_reprs, _hash_strings
+        from tidb_tpu.statistics import hash_column_values
 
         for name, sk in self.ndv_sketch.items():
             vd = self.valid[name][start:end]
             vals = self.data[name][start:end][vd]
             if not len(vals):
                 continue
-            dic = self.dicts.get(name)
-            if dic is not None:
-                codes = np.unique(vals.astype(np.int64))
-                sk.update(_hash_strings([dic.values[int(c)] for c in codes]))
-            else:
-                sk.update(_hash_reprs(vals))
+            sk.update(hash_column_values(vals, self.dicts.get(name)))
 
     def ingest_encoded(self, arrays: Dict[str, np.ndarray],
                        pools: Dict[str, list]) -> int:
