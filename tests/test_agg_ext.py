@@ -58,6 +58,42 @@ def test_variance_in_having_and_exprs(s):
     assert got == pytest.approx(2 * np.var([2.0, 4.0, 6.0]) + 1, abs=1e-9)
 
 
+def test_variance_large_magnitude(s):
+    """The E[x^2]-E[x]^2 decomposition cancels catastrophically here
+    (sum of squares ~2e18 where double spacing is ~256); the two-pass
+    m2 states must return the exact answer."""
+    s.execute("create table lm (x double)")
+    s.execute("insert into lm values (1000000000.0), (1000000001.0)")
+    assert s.query("select var_pop(x) from lm")[0][0] == pytest.approx(0.25)
+    assert s.query("select var_samp(x) from lm")[0][0] == pytest.approx(0.5)
+    assert s.query("select stddev(x) from lm")[0][0] == pytest.approx(0.5)
+    # epoch-timestamp-scale ints
+    s.execute("create table ts (t bigint)")
+    s.execute("insert into ts values " +
+              ", ".join(f"({1700000000 + i})" for i in range(100)))
+    assert s.query("select var_pop(t) from ts")[0][0] == \
+        pytest.approx(np.var(np.arange(100)), rel=1e-9)
+
+
+def test_variance_spill_merge():
+    """Variance across spilled runs merges via the exact pairwise m2
+    combine, not by re-summing squares."""
+    sess = Session()
+    sess.execute("create table sp (g bigint, x double)")
+    rng = np.random.default_rng(3)
+    t = sess.catalog.table("test", "sp")
+    g = rng.integers(0, 5, 20000).astype(np.int64)
+    x = rng.normal(1e9, 3.0, 20000)
+    t.insert_columns({"g": g, "x": x})
+    sess.execute("set tidb_mem_quota_query = 400000")  # force run spills
+    rows = sess.query("select g, var_pop(x), stddev_samp(x) from sp "
+                      "group by g order by g")
+    for gi, vp, sds in rows:
+        xs = x[g == gi]
+        assert vp == pytest.approx(np.var(xs), rel=1e-6), gi
+        assert sds == pytest.approx(np.std(xs, ddof=1), rel=1e-6), gi
+
+
 def test_any_value(s):
     rows = s.query("select g, any_value(x) from t group by g order by g")
     assert rows == [(1, 10), (2, 7), (3, None)]

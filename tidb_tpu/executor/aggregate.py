@@ -61,6 +61,39 @@ _BIT_AGGS = {"bit_and": (np.bitwise_and, -1),
              "bit_or": (np.bitwise_or, 0),
              "bit_xor": (np.bitwise_xor, 0)}
 
+_VAR_AGGS = ("var_pop", "var_samp", "stddev_pop", "stddev_samp")
+
+
+def _var_m2(vals: np.ndarray, inverse: np.ndarray, ngroups: int):
+    """Two-pass per-group variance core: (cnt, sum, m2) with
+    m2 = sum((x - group_mean)^2). Numerically stable — never forms
+    E[x^2]-E[x]^2, whose cancellation destroys large-magnitude data
+    (epoch timestamps, money-in-cents)."""
+    v = vals.astype(np.float64)
+    cnt = np.zeros(ngroups, dtype=np.int64)
+    np.add.at(cnt, inverse, 1)
+    s = np.zeros(ngroups, dtype=np.float64)
+    np.add.at(s, inverse, v)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        mean = np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0)
+    m2 = np.zeros(ngroups, dtype=np.float64)
+    np.add.at(m2, inverse, (v - mean[inverse]) ** 2)
+    return cnt, s, m2
+
+
+def _var_finalize(func: str, cnt: np.ndarray, m2: np.ndarray):
+    """(values, valid) per MySQL: VAR_POP needs n>=1, VAR_SAMP n>=2."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        if func in ("var_pop", "stddev_pop"):
+            out = np.where(cnt > 0, m2 / np.maximum(cnt, 1), 0.0)
+            valid = cnt > 0
+        else:
+            out = np.where(cnt > 1, m2 / np.maximum(cnt - 1, 1), 0.0)
+            valid = cnt > 1
+    if func.startswith("stddev"):
+        out = np.sqrt(np.maximum(out, 0.0))
+    return out, valid
+
 
 def merge_op_for(key: str) -> str:
     if key == "occ":
@@ -646,6 +679,13 @@ class HashAggExec(Executor):
                 m = np.full(g, ident, dtype=np.int64)
                 op.at(m, inverse[ok], vals[ok].astype(np.int64))
                 st[a.func] = m
+            elif a.func in _VAR_AGGS:
+                v = vals[ok]
+                if a.arg.type_.kind == TypeKind.DECIMAL:
+                    v = v.astype(np.float64) / (10 ** a.arg.type_.scale)
+                _, s, m2 = _var_m2(v, inverse[ok], g)
+                st["vsum"] = s
+                st["vm2"] = m2
             elif a.func == "group_concat":
                 raise ExecutionError(
                     "GROUP_CONCAT exceeded the in-memory aggregation "
@@ -713,6 +753,23 @@ class HashAggExec(Executor):
                 m = np.full(ngroups, ident, dtype=np.int64)
                 op.at(m, inverse, parts)
                 st[a.func] = m
+            elif a.func in _VAR_AGGS:
+                # exact m2 combine: sum_i [m2_i + n_i (mean_i - mean)^2]
+                # == sum over all x of (x - mean)^2
+                pc = np.concatenate(
+                    [p["states"][j]["cnt"] for p in partials]).astype(np.float64)
+                ps = np.concatenate([p["states"][j]["vsum"] for p in partials])
+                pm2 = np.concatenate([p["states"][j]["vm2"] for p in partials])
+                tot_s = np.zeros(ngroups, dtype=np.float64)
+                np.add.at(tot_s, inverse, ps)
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    mean_t = np.where(cnt > 0, tot_s / np.maximum(cnt, 1), 0.0)
+                    mean_i = np.where(pc > 0, ps / np.maximum(pc, 1), 0.0)
+                m2 = np.zeros(ngroups, dtype=np.float64)
+                np.add.at(m2, inverse,
+                          pm2 + pc * (mean_i - mean_t[inverse]) ** 2)
+                st["vsum"] = tot_s
+                st["vm2"] = m2
             states.append(st)
         return {"mat": uniq, "keys": keys, "kvalids": kvalids, "states": states}
 
@@ -747,6 +804,8 @@ class HashAggExec(Executor):
             elif a.func in _BIT_AGGS:
                 out_arrays[a.uid] = (st[a.func],
                                      np.ones(ngroups, dtype=np.bool_))
+            elif a.func in _VAR_AGGS:
+                out_arrays[a.uid] = _var_finalize(a.func, cnt, st["vm2"])
             else:
                 out_arrays[a.uid] = (st[a.func].astype(a.type_.np_dtype), cnt > 0)
         self._chunks_from_host(out_arrays, ngroups, cap)
@@ -770,7 +829,7 @@ class HashAggExec(Executor):
             return self._group_concat(a, vals, ok, inverse, ngroups)
         if a.distinct:
             if a.func not in ("count", "sum", "avg", "min", "max",
-                              "bit_and", "bit_or", "bit_xor"):
+                              "bit_and", "bit_or", "bit_xor") + _VAR_AGGS:
                 raise UnsupportedError(f"DISTINCT {a.func}")
             bits = self._to_int64_bits(vals, ok)
             trip = np.stack([inverse[ok], bits[ok]], axis=1)
@@ -817,6 +876,12 @@ class HashAggExec(Executor):
             # group keeps the identity (BIT_AND of nothing = all ones —
             # we keep the int64 bit pattern of the unsigned value)
             return m, np.ones(ngroups, dtype=np.bool_)
+        if a.func in _VAR_AGGS:
+            v = vals[ok]
+            if a.arg.type_.kind == TypeKind.DECIMAL:
+                v = v.astype(np.float64) / (10 ** a.arg.type_.scale)
+            gcnt, _, m2 = _var_m2(v, inverse[ok], ngroups)
+            return _var_finalize(a.func, gcnt, m2)
         raise ExecutionError(f"unknown aggregate {a.func}")
 
     def _gc_strings(self, a: AggSpec, vv: np.ndarray):

@@ -41,6 +41,7 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -280,6 +281,16 @@ class Worker:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(4)
         self._running = False
+        # paged-partial result cursors: handle -> (created_ts, rows).
+        # Bounded so a crashed coordinator can't leak worker memory, but
+        # eviction is age-aware: an actively-draining cursor must never
+        # be expired just because other coordinators opened newer ones.
+        self._cursors: Dict[int, Tuple[float, List[tuple]]] = {}
+        self._next_cursor = 1
+        self._cursor_lock = threading.Lock()
+
+    CURSOR_CAP = 64          # hard cap on concurrently open cursors
+    CURSOR_TTL_S = 600.0     # only cursors idle this long are evictable
 
     def serve_forever(self) -> None:
         self._running = True
@@ -407,6 +418,47 @@ class Worker:
         if cmd == "partial":
             rs = self.session.execute(msg["sql"])
             return rs.rows
+        if cmd == "partial_paged":
+            # run the partial once, return the first page + a cursor the
+            # coordinator drains with "fetch" — bounds the coordinator's
+            # in-flight volume to one page per worker
+            rs = self.session.execute(msg["sql"])
+            rows = rs.rows
+            page = int(msg.get("page_rows", 8192))
+            if len(rows) <= page:
+                return {"rows": rows, "cursor": None, "total": len(rows)}
+            now = time.time()
+            with self._cursor_lock:
+                # reap abandoned cursors (a crashed coordinator must not
+                # leak result memory); live drains are refreshed on every
+                # fetch so they never look idle
+                stale = [h for h, (ts, _r) in self._cursors.items()
+                         if now - ts > self.CURSOR_TTL_S]
+                for h in stale:
+                    del self._cursors[h]
+                if len(self._cursors) >= self.CURSOR_CAP:
+                    raise ExecutionError(
+                        f"dcn worker: {self.CURSOR_CAP} partial cursors "
+                        "already open")
+                h = self._next_cursor
+                self._next_cursor += 1
+                self._cursors[h] = (now, rows)
+            return {"rows": rows[:page], "cursor": h, "total": len(rows)}
+        if cmd == "fetch":
+            h = msg["cursor"]
+            off = int(msg["offset"])
+            page = int(msg.get("page_rows", 8192))
+            with self._cursor_lock:
+                ent = self._cursors.get(h)
+                if ent is None:
+                    raise ExecutionError(f"dcn cursor {h} expired")
+                rows = ent[1]
+                out = rows[off: off + page]
+                if off + page >= len(rows):
+                    del self._cursors[h]
+                else:
+                    self._cursors[h] = (time.time(), rows)  # refresh idle clock
+            return out
         if cmd == "shutdown":
             return "bye"
         raise ExecutionError(f"unknown dcn command {cmd!r}")
@@ -449,6 +501,10 @@ if __name__ == "__main__":  # pragma: no cover
 # ---------------------------------------------------------------------------
 
 _DIST_AGGS = {"count", "sum", "min", "max", "avg"}
+# engine aggregates with no partial/final SQL split on this tier
+_NONDIST_AGGS = {"bit_and", "bit_or", "bit_xor", "group_concat", "any_value",
+                 "variance", "var_pop", "var_samp", "stddev", "std",
+                 "stddev_pop", "stddev_samp"}
 
 
 def _from_tables(src) -> List[A.TableName]:
@@ -539,6 +595,12 @@ def partial_rewrite(sql: str, table_as: Optional[str] = None,
 
         if isinstance(e, A.EFunc) and e.name in _DIST_AGGS:
             return True
+        if isinstance(e, A.EFunc) and e.name in _NONDIST_AGGS:
+            # an extended aggregate must NOT fall into the TopN
+            # scan-gather path — the workers would each return a local
+            # value and the union would silently be wrong
+            raise UnsupportedError(
+                f"dcn tier: aggregate {e.name} has no partial/final split")
         if not _dc.is_dataclass(e):
             return False
         for fld in _dc.fields(e):
@@ -833,65 +895,109 @@ class Cluster:
     def mark_partitioned(self, table: str) -> None:
         self._partitioned.add(table)
 
-    def _partials_with_failover(self, sql: str, partial_sql: str) -> List:
-        """Fan the partial out; a dead worker's partition re-runs on its
-        replica (reading `<table>__part<i>`)."""
-        results: List = [None] * len(self._socks)
-        failed: List[Tuple[int, Exception]] = []
-        lock = threading.Lock()
+    # coordinator-side streaming: one page per round trip; the staging
+    # table (columnar, engine-managed) is the only full-volume buffer
+    PAGE_ROWS = 8192
 
-        def run(i):
+    def _drain_pages(self, i: int, first: Dict) -> List[tuple]:
+        """Collect one worker's partial from its first page + cursor."""
+        rows = list(first["rows"])
+        cur = first.get("cursor")
+        while cur is not None and len(rows) < first["total"]:
+            rows.extend(self._call(i, {"cmd": "fetch", "cursor": cur,
+                                       "offset": len(rows),
+                                       "page_rows": self.PAGE_ROWS}))
+        return rows
+
+    def _failover_partial(self, i: int, sql: str, err: Exception) -> List[tuple]:
+        """A dead worker's partition re-runs on its replica (reading
+        `<table>__part<i>`)."""
+        rep = self.replicas.get(i)
+        if rep is None or self._socks[rep] is None:
+            raise err
+        tables = _from_tables(parse(sql)[0].from_)
+        parts = [t.name for t in tables if t.name in self._partitioned]
+        tname = parts[0] if parts else tables[0].name
+        rep_sql, _f, _n = partial_rewrite(
+            sql, table_as=f"{tname}__part{i}",
+            partitioned=self._partitioned, broadcast=self._broadcast)
+        first = self._call(rep, {"cmd": "partial_paged", "sql": rep_sql,
+                                 "page_rows": self.PAGE_ROWS})
+        return self._drain_pages(rep, first)
+
+    def query(self, sql: str, schema_sql: Optional[str] = None) -> List[tuple]:
+        """Distributed aggregate / TopN: partial on every worker, final
+        merge here. schema_sql overrides the staging table DDL; by
+        default column types are inferred from the partial rows.
+
+        The merge is streaming: workers compute partials concurrently
+        but hold their own result behind a cursor; the coordinator
+        drains one worker at a time in PAGE_ROWS pages straight into the
+        columnar staging table (bulk insert_rows, no SQL-literal round
+        trip), so its transient footprint is one partition's partial —
+        not the union of all of them. A worker that dies before its
+        partition was ingested fails over to its replica; the final SQL
+        then runs on the coordinator's own engine, whose memory tracker/
+        spill machinery bounds the merge itself. The coordinator holds
+        no state workers depend on, so a replacement coordinator can
+        re-attach to the same workers and re-run (see
+        test_dcn.py::test_coordinator_restart)."""
+        partial_sql, final_sql, _names = partial_rewrite(
+            sql, partitioned=self._partitioned, broadcast=self._broadcast)
+
+        # kick every worker's partial concurrently; each returns only
+        # its first page (the rest waits behind the worker's cursor)
+        firsts: List = [None] * len(self._socks)
+        errs: List = [None] * len(self._socks)
+
+        def start(i):
             try:
-                results[i] = self._call(i, {"cmd": "partial",
-                                            "sql": partial_sql})
+                firsts[i] = self._call(i, {
+                    "cmd": "partial_paged", "sql": partial_sql,
+                    "page_rows": self.PAGE_ROWS})
             except Exception as e:  # noqa: BLE001
-                with lock:
-                    failed.append((i, e))
+                errs[i] = e
 
-        threads = [threading.Thread(target=run, args=(i,))
+        threads = [threading.Thread(target=start, args=(i,))
                    for i in range(len(self._socks))]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        tname = None
-        for i, err in failed:
-            rep = self.replicas.get(i)
-            if rep is None or self._socks[rep] is None:
-                raise err
-            if tname is None:
-                tables = _from_tables(parse(sql)[0].from_)
-                parts = [t.name for t in tables
-                         if t.name in self._partitioned]
-                tname = parts[0] if parts else tables[0].name
-            rep_sql, _f, _n = partial_rewrite(
-                sql, table_as=f"{tname}__part{i}",
-                partitioned=self._partitioned, broadcast=self._broadcast)
-            results[i] = self._call(rep, {"cmd": "partial", "sql": rep_sql})
-        return results
 
-    def query(self, sql: str, schema_sql: Optional[str] = None) -> List[tuple]:
-        """Distributed aggregate / TopN: partial on every worker, final
-        merge here. schema_sql overrides the staging table DDL; by
-        default column types are inferred from the partial rows."""
-        partial_sql, final_sql, _names = partial_rewrite(
-            sql, partitioned=self._partitioned, broadcast=self._broadcast)
-        worker_rows = self._partials_with_failover(sql, partial_sql)
-        all_rows = [r for rows in worker_rows for r in rows]
         s = self._merge_session
         s.execute("drop table if exists __dcn_partial__")
-        if schema_sql is not None:
+        ddl_done = schema_sql is not None
+        if ddl_done:
             s.execute(schema_sql)
-        else:
-            s.execute(self._infer_staging_ddl(partial_sql, all_rows))
-        if all_rows:
-            # batched inserts through the coordinator's own SQL surface
-            for start in range(0, len(all_rows), 512):
-                chunk = all_rows[start : start + 512]
-                vals = ", ".join(
-                    "(" + ", ".join(_sql_literal(v) for v in r) + ")"
-                    for r in chunk)
-                s.execute(f"insert into __dcn_partial__ values {vals}")
+        staging = None
+
+        def ingest(rows: List[tuple]) -> None:
+            nonlocal ddl_done, staging
+            if not rows:
+                return
+            if not ddl_done:
+                s.execute(self._infer_staging_ddl(partial_sql, rows))
+                ddl_done = True
+            if staging is None:
+                staging = s.catalog.table(s.db, "__dcn_partial__")
+            for st in range(0, len(rows), 4096):
+                staging.insert_rows(rows[st: st + 4096])
+
+        # drain one partition at a time; a partition is ingested only
+        # after it arrived completely, so mid-drain failover can re-run
+        # it on the replica without duplicating staged rows
+        for i in range(len(self._socks)):
+            try:
+                if errs[i] is not None:
+                    raise errs[i]
+                rows = self._drain_pages(i, firsts[i])
+            except (ConnectionError, OSError, ExecutionError) as e:
+                rows = self._failover_partial(i, sql, e)
+            ingest(rows)
+
+        if not ddl_done:
+            s.execute(self._infer_staging_ddl(partial_sql, []))
         return s.query(final_sql)
 
     def _infer_staging_ddl(self, partial_sql: str, rows: List[tuple]) -> str:
@@ -948,13 +1054,3 @@ def _infer_type(values) -> str:
     return "bigint"
 
 
-def _sql_literal(v) -> str:
-    if v is None:
-        return "null"
-    if isinstance(v, bool):
-        return "true" if v else "false"
-    if isinstance(v, (int, float)):
-        return repr(v)
-    if isinstance(v, (datetime.date, datetime.datetime)):
-        return "'" + str(v) + "'"
-    return "'" + str(v).replace("'", "''") + "'"

@@ -65,7 +65,8 @@ from tidb_tpu.types import (
 __all__ = ["PlanCol", "Scope", "Binder", "AGG_FUNCS", "ast_key"]
 
 AGG_FUNCS = {"sum", "count", "avg", "min", "max",
-             "bit_and", "bit_or", "bit_xor", "group_concat"}
+             "bit_and", "bit_or", "bit_xor", "group_concat",
+             "var_pop", "var_samp", "stddev_pop", "stddev_samp"}
 
 
 @dataclass
@@ -145,12 +146,15 @@ class Binder:
         # populated by plan_statement from the owning Session
         self.session_info: Dict[str, object] = {}
         # NOW() is statement-start time: every NOW()/CURRENT_TIMESTAMP in
-        # one statement sees the same instant (MySQL semantics)
+        # one statement sees the same instant (MySQL semantics). The
+        # engine session timezone is fixed to UTC — stored DATETIMEs are
+        # naive UTC wall time, so UNIX_TIMESTAMP(col) == epoch seconds on
+        # any host timezone (documented deviation: @@time_zone = UTC)
         self._now: Optional[datetime.datetime] = None
 
     def _stmt_now(self) -> datetime.datetime:
         if self._now is None:
-            self._now = datetime.datetime.now()
+            self._now = datetime.datetime.utcnow()
         return self._now
 
     def new_uid(self, base: str) -> str:
@@ -1549,7 +1553,11 @@ def _apply_string_func(name: str, s: str, e: A.EFunc, args: List[Expr]) -> str:
         pos, ln, repl = int(args[1].value), int(args[2].value), str(args[3].value)
         if pos < 1 or pos > len(s):
             return s
-        return s[: pos - 1] + repl + s[pos - 1 + max(ln, 0):]
+        # MySQL: a length that is negative or runs past the end replaces
+        # through the end of the string
+        if ln < 0 or pos - 1 + ln > len(s):
+            return s[: pos - 1] + repl
+        return s[: pos - 1] + repl + s[pos - 1 + ln:]
     if name == "bit_length":
         return len(s.encode()) * 8
     if name == "octet_length":

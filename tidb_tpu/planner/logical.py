@@ -258,8 +258,9 @@ def _count_table_refs(node, name: str) -> int:
 
 
 _AGG_FUNC_NAMES = {"sum", "count", "avg", "min", "max", "group_concat",
-                   "stddev", "stddev_pop", "stddev_samp", "variance",
-                   "var_pop", "var_samp", "bit_and", "bit_or", "bit_xor"}
+                   "stddev", "std", "stddev_pop", "stddev_samp", "variance",
+                   "var_pop", "var_samp", "bit_and", "bit_or", "bit_xor",
+                   "any_value"}
 
 
 def _multiplicity_sensitive(node) -> bool:
@@ -621,25 +622,19 @@ def _realias(plan: LogicalPlan, cols: List[PlanCol]) -> LogicalPlan:
 # aggregate extraction
 # ---------------------------------------------------------------------------
 
-_VARIANCE_AGGS = {"variance", "var_pop", "var_samp", "stddev", "std",
-                  "stddev_pop", "stddev_samp"}
+# normalization of aggregate aliases; variance/stddev are REAL agg funcs
+# (two-pass m2 states in the executor — the E[x^2]-E[x]^2 decomposition
+# cancels catastrophically on large-magnitude data and is NOT used)
+VARIANCE_AGGS = ("var_pop", "var_samp", "stddev_pop", "stddev_samp")
+_AGG_ALIASES = {"variance": "var_pop", "std": "stddev_pop",
+                "stddev": "stddev_pop", "any_value": "min"}
 
 
 def _rewrite_extended_aggs(e):
-    """Decompose extended aggregates into the core five (ref: the
-    reference's aggfuncs layer; here rewritten at plan time so every
-    tier — segment kernels, distributed partial/final split, spill —
-    handles them with zero new state kinds):
-
-      VAR_POP(x)  -> (SUM(xf*xf) - SUM(xf)^2/COUNT(x)) / COUNT(x)
-      VAR_SAMP    -> same numerator / (COUNT(x)-1)   (NULL when n<2)
-      STDDEV*     -> SQRT(of the above, floored at 0 for fp jitter)
-      ANY_VALUE   -> MIN
-
-    with xf = CAST(x AS DOUBLE) (MySQL computes variance in double).
-    The rewrite runs on select/having/order-by ASTs before aggregate
-    collection, so arbitrary expressions over these aggregates keep
-    working; sum/count partials stay exactly mergeable across shards."""
+    """Normalize aggregate aliases on select/having/order-by ASTs before
+    collection: VARIANCE->VAR_POP, STD/STDDEV->STDDEV_POP,
+    ANY_VALUE->MIN (ref: the reference's aggfuncs name canonicalization).
+    """
     if not hasattr(e, "__dataclass_fields__") or isinstance(
             e, (A.SelectStmt, A.UnionStmt)):
         return e
@@ -654,25 +649,8 @@ def _rewrite_extended_aggs(e):
         elif hasattr(v, "__dataclass_fields__") and not isinstance(
                 v, (A.SelectStmt, A.UnionStmt)):
             setattr(e, f, _rewrite_extended_aggs(v))
-    if isinstance(e, A.EFunc) and e.name == "any_value" and len(e.args) == 1:
-        return A.EFunc("min", e.args, distinct=False)
-    if isinstance(e, A.EFunc) and e.name in _VARIANCE_AGGS:
-        if len(e.args) != 1:
-            raise UnsupportedError(f"{e.name.upper()} takes one argument")
-        if e.distinct:
-            raise UnsupportedError(f"{e.name.upper()}(DISTINCT) not supported")
-        x = e.args[0]
-        xf = A.ECast(x, "double")
-        sumsq = A.EFunc("sum", [A.EBinary("*", xf, xf)])
-        sm = A.EFunc("sum", [xf])
-        cnt = A.EFunc("count", [x])
-        num = A.EBinary("-", sumsq, A.EBinary("/", A.EBinary("*", sm, sm), cnt))
-        denom = cnt if e.name in ("variance", "var_pop", "stddev", "std",
-                                  "stddev_pop") else A.EBinary("-", cnt, A.ENum("1"))
-        var = A.EFunc("greatest", [A.ENum("0"), A.EBinary("/", num, denom)])
-        if e.name in ("stddev", "std", "stddev_pop", "stddev_samp"):
-            return A.EFunc("sqrt", [var])
-        return var
+    if isinstance(e, A.EFunc) and e.name in _AGG_ALIASES and len(e.args) == 1:
+        return A.EFunc(_AGG_ALIASES[e.name], e.args, distinct=e.distinct)
     return e
 
 
@@ -872,6 +850,8 @@ def _agg_result_type(func: str, arg: Optional[Expr]) -> SQLType:
         return INT64
     if func == "group_concat":
         return STRING
+    if func in VARIANCE_AGGS:
+        return FLOAT64
     # sum
     k = arg.type_.kind
     if k == TypeKind.DECIMAL:
