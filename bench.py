@@ -259,6 +259,14 @@ def main(locked_detail=("", "")):
 
     if platform != "default":
         jax.config.update("jax_platforms", platform)
+    else:
+        # tunneled-TPU path: every remote_compile pays seconds of tunnel
+        # latency regardless of program size, and serialized executables
+        # DO round-trip through the persistent cache here — cache nearly
+        # everything. (The 10s default stays for CPU runs: XLA:CPU AOT
+        # artifacts embed host-feature flags and must not be shared
+        # across processes with/without the TPU plugin loaded.)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     from tidb_tpu.parallel import make_mesh
     from tidb_tpu.session import Session
     from tidb_tpu.storage.tpch import load_tpch

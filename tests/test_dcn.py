@@ -341,6 +341,61 @@ class TestStreamingMerge:
             cl._call = orig_call
             cl.shutdown()
 
+    def test_staging_types_from_all_partitions(self):
+        """Staging DDL must type a column from whichever partition has
+        values — partition 0 being all-NULL in a string column must not
+        bake in a bigint staging column (review finding)."""
+        import threading as th
+
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        workers = [Worker() for _ in range(2)]
+        for w in workers:
+            th.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in workers])
+        try:
+            cl.broadcast_exec("create table sn (k bigint, s varchar(8))")
+            cl.load_partition(0, "sn",
+                              arrays={"k": np.zeros(3, dtype=np.int64)},
+                              strings={"s": [None, None, None]}, db="test")
+            cl.load_partition(1, "sn",
+                              arrays={"k": np.ones(3, dtype=np.int64)},
+                              strings={"s": ["aa", "bb", None]}, db="test")
+            got = cl.query("select k, min(s) as ms, count(s) as c from sn "
+                           "group by k order by k")
+            assert got == [(0, None, 0), (1, "aa", 2)], got
+        finally:
+            cl.shutdown()
+
+    def test_abandoned_cursor_closed_on_failure(self):
+        """A query that dies mid-drain must close the cursors it opened
+        on the surviving workers (review finding: leaked cursors pinned
+        full partials until the TTL and could exhaust the cap)."""
+        workers, cl = self._mk_cluster()
+        cl.PAGE_ROWS = 64  # both partials exceed one page
+        orig_call = cl._call
+
+        def flaky_call(i, msg):
+            if msg.get("cmd") == "fetch" and i == 0:
+                raise ConnectionError("worker 0 link broken")
+            return orig_call(i, msg)
+
+        # no replica for worker 0 in this run -> query must FAIL...
+        cl.replicas = {}
+        cl._call = flaky_call
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                cl.query("select k, sum(v) as s from big group by k")
+        finally:
+            cl._call = orig_call
+        # ...but worker 1's (and 0's) cursors must be released
+        import time as _time
+
+        _time.sleep(0.1)
+        assert all(not w._cursors for w in workers), [
+            len(w._cursors) for w in workers]
+        cl.shutdown()
+
     def test_coordinator_restart(self):
         """The coordinator holds no state workers depend on: a fresh
         coordinator attaches to the same workers and completes (the

@@ -1,0 +1,220 @@
+"""The layered storage-engine boundary (kvapi) and the delta engine.
+
+Ref counterpart: the reference's kv/ Storage abstraction — engines swap
+behind one interface (VERDICT row 12). The contract test pins the
+surface; the parametrized suite proves the SAME SQL behaves identically
+on both engines; the delta-specific tests pin what the engine exists
+for (deferred dictionary merges / bulk compaction) and that MVCC txn
+semantics survive buffering.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage.kvapi import ENGINES, conforms, make_table
+from tidb_tpu.storage.table import Table, TableSchema
+
+
+def test_contract_both_engines():
+    from tidb_tpu.types import INT64
+
+    from tidb_tpu.storage.table import ColumnInfo
+
+    schema = TableSchema("t", [ColumnInfo("a", INT64)])
+    for eng in ENGINES:
+        t = make_table(schema, eng)
+        assert conforms(t) == [], (eng, conforms(t))
+        assert t.engine == eng
+
+
+def test_unknown_engine_rejected():
+    s = Session()
+    from tidb_tpu.errors import SchemaError
+
+    with pytest.raises(SchemaError):
+        s.execute("create table bad (a bigint) engine=rocksdb")
+
+
+@pytest.fixture(params=["columnar", "delta"])
+def sess(request):
+    s = Session()
+    s.engine = request.param
+    return s
+
+
+def _create(s, name, cols):
+    s.execute(f"create table {name} ({cols}) engine={s.engine}")
+
+
+class TestEngineEquivalence:
+    """The same SQL, row for row, on both engines."""
+
+    def test_crud_and_scan(self, sess):
+        _create(sess, "t", "a bigint, s varchar(10), d double")
+        sess.execute("insert into t values (1, 'x', 1.5), (2, 'y', NULL)")
+        sess.execute("insert into t values (3, NULL, 2.5)")
+        assert sess.query("select a, s, d from t order by a") == [
+            (1, "x", 1.5), (2, "y", None), (3, None, 2.5)]
+        sess.execute("update t set d = 9.0 where a = 2")
+        sess.execute("delete from t where a = 1")
+        assert sess.query("select a, d from t order by a") == [
+            (2, 9.0), (3, 2.5)]
+
+    def test_aggregation_and_strings(self, sess):
+        _create(sess, "g", "k varchar(4), v bigint")
+        sess.execute("insert into g values " + ", ".join(
+            f"('k{i % 3}', {i})" for i in range(300)))
+        got = sess.query("select k, count(*), sum(v) from g "
+                         "group by k order by k")
+        assert [r[1] for r in got] == [100, 100, 100]
+        assert sum(r[2] for r in got) == sum(range(300))
+        assert sess.query("select count(*) from g where k = 'k1'") == [(100,)]
+
+    def test_txn_commit_and_rollback(self, sess):
+        _create(sess, "tx", "a bigint")
+        sess.execute("insert into tx values (1)")
+        sess.execute("begin")
+        sess.execute("insert into tx values (2), (3)")
+        assert sess.query("select count(*) from tx") == [(3,)]  # own writes
+        sess.execute("rollback")
+        assert sess.query("select count(*) from tx") == [(1,)]
+        sess.execute("begin")
+        sess.execute("insert into tx values (4)")
+        sess.execute("commit")
+        assert sess.query("select a from tx order by a") == [(1,), (4,)]
+
+    def test_unique_pk_enforced(self, sess):
+        from tidb_tpu.errors import ExecutionError
+
+        _create(sess, "u", "a bigint primary key, b bigint")
+        sess.execute("insert into u values (1, 10)")
+        with pytest.raises(ExecutionError):
+            sess.execute("insert into u values (1, 20)")
+        assert sess.query("select b from u") == [(10,)]
+
+    def test_inline_unique_key_clause(self, sess):
+        from tidb_tpu.errors import ExecutionError
+
+        sess.execute(f"create table iu (a bigint, b bigint, unique key (b)) "
+                     f"engine={sess.engine}")
+        sess.execute("insert into iu values (1, 5)")
+        with pytest.raises(ExecutionError):
+            sess.execute("insert into iu values (2, 5)")
+
+    def test_analyze_and_autoanalyze(self, sess):
+        from tidb_tpu.statistics import table_stats
+
+        _create(sess, "an", "a bigint")
+        sess.execute("insert into an values " + ", ".join(
+            f"({i})" for i in range(1200)))
+        t = sess.catalog.table("test", "an")
+        assert table_stats(t) is not None  # auto-analyze fired
+        assert table_stats(t).n_rows == 1200
+
+
+class TestDeltaEngine:
+    def test_buffers_and_compacts_in_bulk(self):
+        s = Session()
+        s.execute("create table d (a bigint, s varchar(12)) engine=delta")
+        t = s.catalog.table("test", "d")
+        v0 = t._base.version
+        # 40 single-row inserts with NEW strings each: the columnar
+        # engine would do 40 dictionary merges; delta buffers them
+        for i in range(40):
+            s.execute(f"insert into d values ({i}, 'str{i:04d}')")
+        assert t.buffered_rows == 40
+        assert t._base.n == 0              # nothing materialized yet
+        # first read compacts: ONE bulk append, ONE version window
+        assert s.query("select count(*), min(s), max(s) from d") == [
+            (40, "str0000", "str0039")]
+        assert t.buffered_rows == 0
+        assert t._base.n == 40
+        assert t._base.version - v0 <= 3   # one bulk append, not 40
+
+    def test_read_then_commit_keeps_rows(self):
+        """Mid-txn compaction (a SELECT inside the txn) moves buffered
+        marker rows into the base; their base ranges must register in
+        the txn log so COMMIT rewrites them (review finding: the empty-
+        log fast path was skipping base.txn_commit and committed rows
+        silently vanished)."""
+        s = Session()
+        s.execute("create table d (a bigint) engine=delta")
+        s.execute("begin")
+        s.execute("insert into d values (1), (2), (3)")
+        assert s.query("select count(*) from d") == [(3,)]  # compacts
+        s.execute("commit")
+        # rows must be committed-visible to a NEW snapshot
+        assert s.query("select a from d order by a") == [(1,), (2,), (3,)]
+        # and survive GC at a later safepoint (no orphaned markers)
+        t = s.catalog.table("test", "d")
+        t.gc(s.catalog.next_ts())
+        assert s.query("select count(*) from d") == [(3,)]
+
+    def test_read_then_rollback_no_residue(self):
+        s = Session()
+        s.execute("create table d (a bigint) engine=delta")
+        s.execute("insert into d values (9)")
+        s.execute("begin")
+        s.execute("insert into d values (1), (2)")
+        assert s.query("select count(*) from d") == [(3,)]  # compacts
+        s.execute("rollback")
+        assert s.query("select a from d") == [(9,)]
+        # rolled-back versions are dead, not provisional forever
+        t = s.catalog.table("test", "d")
+        t.gc(s.catalog.next_ts())
+        assert s.query("select a from d") == [(9,)]
+
+    def test_txn_visibility_through_buffer(self):
+        s = Session()
+        s.execute("create table d (a bigint) engine=delta")
+        s.execute("begin")
+        s.execute("insert into d values (1), (2)")
+        # a read inside the txn compacts and sees provisional rows
+        assert s.query("select count(*) from d") == [(2,)]
+        s.execute("rollback")
+        assert s.query("select count(*) from d") == [(0,)]
+
+    def test_rollback_discards_buffered_rows(self):
+        s = Session()
+        s.execute("create table d (a bigint) engine=delta")
+        s.execute("insert into d values (99)")
+        s.execute("begin")
+        s.execute("insert into d values (1), (2)")
+        t = s.catalog.table("test", "d")
+        assert t.buffered_rows >= 2  # still buffered (no read yet)
+        s.execute("rollback")
+        assert s.query("select a from d") == [(99,)]
+
+    def test_threshold_compaction(self):
+        from tidb_tpu.storage import delta as delta_mod
+
+        s = Session()
+        s.execute("create table d (a bigint) engine=delta")
+        t = s.catalog.table("test", "d")
+        n = delta_mod.FLUSH_ROWS + 5
+        t.insert_rows([(i,) for i in range(n)])
+        assert t.buffered_rows < delta_mod.FLUSH_ROWS
+        assert t._base.n >= delta_mod.FLUSH_ROWS
+
+    def test_statement_accurate_errors(self):
+        s = Session()
+        s.execute("create table d (a bigint not null, b bigint) engine=delta")
+        from tidb_tpu.errors import ExecutionError
+
+        with pytest.raises(ExecutionError):
+            s.execute("insert into d (b) values (1)")  # NOT NULL, no default
+        with pytest.raises(Exception):
+            s.execute("insert into d values ('xx', 1)")  # bad int
+        assert s.query("select count(*) from d") == [(0,)]
+
+    def test_auto_increment_through_buffer(self):
+        s = Session()
+        s.execute("create table d (id bigint auto_increment, v bigint) "
+                  "engine=delta")
+        # auto_increment without unique index: ids assigned at buffer time
+        t = s.catalog.table("test", "d")
+        t.insert_rows([(7,), (8,)], columns=["v"])
+        t.insert_rows([(9,)], columns=["v"])
+        assert s.query("select id, v from d order by id") == [
+            (1, 7), (2, 8), (3, 9)]

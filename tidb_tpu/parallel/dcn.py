@@ -459,6 +459,10 @@ class Worker:
                 else:
                     self._cursors[h] = (time.time(), rows)  # refresh idle clock
             return out
+        if cmd == "close_cursor":
+            with self._cursor_lock:
+                self._cursors.pop(msg["cursor"], None)
+            return "closed"
         if cmd == "shutdown":
             return "bye"
         raise ExecutionError(f"unknown dcn command {cmd!r}")
@@ -970,6 +974,16 @@ class Cluster:
         ddl_done = schema_sql is not None
         if ddl_done:
             s.execute(schema_sql)
+        else:
+            # infer column types from the union of every partition's
+            # FIRST page — one partition may be all-NULL in a column
+            # another types (the old all-rows inference saw everything;
+            # sampling only partition 0 would mistype such columns)
+            sample = [r for f in firsts if f is not None
+                      for r in f["rows"][:64]]
+            if sample:
+                s.execute(self._infer_staging_ddl(partial_sql, sample))
+                ddl_done = True
         staging = None
 
         def ingest(rows: List[tuple]) -> None:
@@ -987,14 +1001,27 @@ class Cluster:
         # drain one partition at a time; a partition is ingested only
         # after it arrived completely, so mid-drain failover can re-run
         # it on the replica without duplicating staged rows
-        for i in range(len(self._socks)):
-            try:
-                if errs[i] is not None:
-                    raise errs[i]
-                rows = self._drain_pages(i, firsts[i])
-            except (ConnectionError, OSError, ExecutionError) as e:
-                rows = self._failover_partial(i, sql, e)
-            ingest(rows)
+        try:
+            for i in range(len(self._socks)):
+                try:
+                    if errs[i] is not None:
+                        raise errs[i]
+                    rows = self._drain_pages(i, firsts[i])
+                    firsts[i] = None  # fully drained: cursor is gone
+                except (ConnectionError, OSError, ExecutionError) as e:
+                    rows = self._failover_partial(i, sql, e)
+                    firsts[i] = None
+                ingest(rows)
+        finally:
+            # a failed query must not pin worker memory: close any
+            # cursor we opened but never fully drained
+            for i, f in enumerate(firsts):
+                if f is not None and f.get("cursor") is not None:
+                    try:
+                        self._call(i, {"cmd": "close_cursor",
+                                       "cursor": f["cursor"]})
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
 
         if not ddl_done:
             s.execute(self._infer_staging_ddl(partial_sql, []))

@@ -280,6 +280,7 @@ class CreateTableStmt:
     unique_keys: List[Tuple[str, List[str]]] = field(default_factory=list)
     indexes: List[Tuple[str, List[str]]] = field(default_factory=list)
     if_not_exists: bool = False
+    engine: Optional[str] = None  # storage engine (kvapi.ENGINES)
 
 @dataclass
 class DropTableStmt:

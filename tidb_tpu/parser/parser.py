@@ -569,12 +569,15 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        # table options: ENGINE=..., CHARSET=..., COMMENT '...'
+        # table options: ENGINE=... selects the storage engine
+        # (kvapi.make_table); CHARSET/COMMENT/COLLATE accepted + ignored
         while self.peek().kind == "KW" and self.peek().text in ("engine", "charset", "character", "comment", "collate"):
-            self.next()
+            opt = self.next().text
             self.accept_kw("set")
             self.accept_op("=")
-            self.next()
+            val = self.next().text
+            if opt == "engine":
+                stmt.engine = val.lower()
         return stmt
 
     def _if_not_exists(self) -> bool:

@@ -745,7 +745,16 @@ class Session:
                 pk = [c.name]
             cols.append(self._column_info(c))
         schema = TableSchema(stmt.table.name, cols, primary_key=pk)
-        self.catalog.create_table(stmt.table.schema or self.db, schema, stmt.if_not_exists)
+        t = self.catalog.create_table(stmt.table.schema or self.db, schema,
+                                      stmt.if_not_exists, engine=stmt.engine)
+        if t is not None and t.schema is schema:
+            # inline UNIQUE KEY / KEY clauses become real (enforced)
+            # indexes — only on a table this statement actually created
+            for kname, kcols in stmt.unique_keys:
+                t.create_index(kname or f"uk_{'_'.join(kcols)}", kcols,
+                               unique=True)
+            for kname, kcols in stmt.indexes:
+                t.create_index(kname or f"idx_{'_'.join(kcols)}", kcols)
         return None
 
     def _run_insert(self, stmt: A.InsertStmt):

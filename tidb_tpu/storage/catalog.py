@@ -247,7 +247,9 @@ class Catalog:
             mc = getattr(t, "modify_count", 0)
             stats = getattr(t, "stats", None)
             if stats is None:
-                if t.n < min_rows or mc == 0:
+                # maintenance_stats: threshold probe that must not force
+                # a delta-engine compaction on every commit
+                if t.maintenance_stats()[0] < min_rows or mc == 0:
                     continue
             elif mc < ratio * max(stats.n_rows, min_rows):
                 continue
@@ -271,8 +273,8 @@ class Catalog:
                       for t in db.tables.values()]
         out: Dict[str, int] = {}
         for t in tables:
-            dead = t.n - t.live_rows
-            if dead >= min_dead and dead >= ratio * t.n:
+            phys, dead = t.maintenance_stats()
+            if dead >= min_dead and dead >= ratio * phys:
                 r = t.gc(sp)
                 if r:
                     out[t.schema.name] = r
@@ -310,7 +312,9 @@ class Catalog:
 
     # -- tables ------------------------------------------------------------
 
-    def create_table(self, db: str, schema: TableSchema, if_not_exists: bool = False) -> Table:
+    def create_table(self, db: str, schema: TableSchema,
+                     if_not_exists: bool = False,
+                     engine: str = None) -> Table:
         d = self.database(db)
         if schema.name in d.tables:
             if if_not_exists:
@@ -322,7 +326,9 @@ class Catalog:
                 # shared table/view namespace — warning, nothing created
                 return None
             raise DuplicateTableError(f"view {schema.name!r} exists")
-        t = Table(schema)
+        from tidb_tpu.storage.kvapi import make_table
+
+        t = make_table(schema, engine)
         t.ts_source = self.next_ts
         d.tables[schema.name] = t
         self.schema_version += 1

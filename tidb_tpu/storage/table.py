@@ -99,7 +99,10 @@ MAX_TS = 1 << 62
 
 
 class Table:
-    """Append-friendly columnar store for one table."""
+    """Append-friendly columnar store for one table (the default
+    ``columnar`` engine of kvapi.TABLE_ENGINE_API)."""
+
+    engine = "columnar"
 
     def __init__(self, schema: TableSchema):
         self.schema = schema
@@ -159,6 +162,13 @@ class Table:
         b = self.begin_ts[: self.n]
         e = self.end_ts[: self.n]
         return int(((b < TXN_TS_BASE) & (e >= TXN_TS_BASE)).sum())
+
+    def maintenance_stats(self):
+        """(physical_rows, dead_rows) for background-maintenance
+        thresholds (auto-analyze / auto-GC). Engines may answer this
+        WITHOUT materializing buffered writes — it drives threshold
+        checks, not query answers."""
+        return self.n, self.n - self.live_rows
 
     def _ensure(self, extra: int):
         need = self.n + extra
