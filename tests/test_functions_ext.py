@@ -208,6 +208,36 @@ def test_position_locate(s):
     assert q1(s, "select locate('zzz', 'hello')") == 0
 
 
+def test_regexp_operator(s):
+    # partial match, case-insensitive default (MySQL _ci collations)
+    assert s.query("select s from st where s regexp 'MYSQL' order by s") == \
+        [("www.mysql.com",)]
+    assert s.query("select s from st where s rlike '^hello' ") == \
+        [("hello world",)]
+    assert s.query("select count(*) from st where s not regexp 'o'") == [(1,)]
+    # NULL rows never match either way
+    assert s.query("select count(*) from st where s regexp '.'") == [(3,)]
+    assert q1(s, "select 'abc' regexp 'B'") == 1
+    assert q1(s, "select 'abc' not regexp 'z'") == 1
+
+
+def test_regexp_functions(s):
+    assert q1(s, "select regexp_like('Michael', '^mi')") == 1
+    assert q1(s, "select regexp_like('Michael', '^mi', 'c')") == 0
+    assert q1(s, "select regexp_replace('a1b2c3', '[0-9]', 'X')") == "aXbXcX"
+    assert q1(s, "select regexp_replace('John Smith', "
+                 "'(\\\\w+) (\\\\w+)', '$2 $1')") == "Smith John"
+    assert q1(s, "select regexp_substr('abc123def', '[0-9]+')") == "123"
+    assert q1(s, "select regexp_substr('abcdef', '[0-9]+')") is None
+    assert q1(s, "select regexp_instr('abc123', '[0-9]')") == 4
+    assert q1(s, "select regexp_instr('abcdef', '[0-9]')") == 0
+    # over a column
+    assert s.query("select regexp_substr(s, '[a-z]+') from st "
+                   "where s = 'www.mysql.com'") == [("www",)]
+    assert s.query("select count(*) from st where regexp_like(s, 'world$')") == \
+        [(1,)]
+
+
 def test_math_ext(s):
     import math
 

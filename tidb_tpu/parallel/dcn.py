@@ -913,9 +913,20 @@ class Cluster:
                                        "page_rows": self.PAGE_ROWS}))
         return rows
 
-    def _failover_partial(self, i: int, sql: str, err: Exception) -> List[tuple]:
+    def _close_cursor(self, i: int, cursor) -> None:
+        """Best-effort release of a worker-held partial cursor."""
+        if cursor is None or self._socks[i] is None:
+            return
+        try:
+            self._call(i, {"cmd": "close_cursor", "cursor": cursor})
+        except Exception:  # noqa: BLE001 — the worker may be gone
+            pass
+
+    def _failover_partial(self, i: int, sql: str, err: Exception,
+                          open_cursors: List) -> List[tuple]:
         """A dead worker's partition re-runs on its replica (reading
-        `<table>__part<i>`)."""
+        `<table>__part<i>`); the replica's cursor is tracked in
+        `open_cursors` so a second failure can't leak it."""
         rep = self.replicas.get(i)
         if rep is None or self._socks[rep] is None:
             raise err
@@ -927,7 +938,11 @@ class Cluster:
             partitioned=self._partitioned, broadcast=self._broadcast)
         first = self._call(rep, {"cmd": "partial_paged", "sql": rep_sql,
                                  "page_rows": self.PAGE_ROWS})
-        return self._drain_pages(rep, first)
+        ent = [rep, first.get("cursor")]
+        open_cursors.append(ent)
+        rows = self._drain_pages(rep, first)
+        open_cursors.remove(ent)
+        return rows
 
     def query(self, sql: str, schema_sql: Optional[str] = None) -> List[tuple]:
         """Distributed aggregate / TopN: partial on every worker, final
@@ -979,8 +994,7 @@ class Cluster:
             # FIRST page — one partition may be all-NULL in a column
             # another types (the old all-rows inference saw everything;
             # sampling only partition 0 would mistype such columns)
-            sample = [r for f in firsts if f is not None
-                      for r in f["rows"][:64]]
+            sample = [r for f in firsts if f is not None for r in f["rows"]]
             if sample:
                 s.execute(self._infer_staging_ddl(partial_sql, sample))
                 ddl_done = True
@@ -998,6 +1012,14 @@ class Cluster:
             for st in range(0, len(rows), 4096):
                 staging.insert_rows(rows[st: st + 4096])
 
+        # every cursor this query opens — on primaries AND replicas — is
+        # tracked here until fully drained; the finally block releases
+        # whatever a failure left behind, so no worker pins a partial
+        # until the TTL (one worker can hold two entries: its own
+        # partition's cursor and a replica partition's)
+        open_cursors: List = [[i, f["cursor"]] for i, f in enumerate(firsts)
+                              if f is not None and f.get("cursor") is not None]
+
         # drain one partition at a time; a partition is ingested only
         # after it arrived completely, so mid-drain failover can re-run
         # it on the replica without duplicating staged rows
@@ -1007,21 +1029,21 @@ class Cluster:
                     if errs[i] is not None:
                         raise errs[i]
                     rows = self._drain_pages(i, firsts[i])
-                    firsts[i] = None  # fully drained: cursor is gone
+                    open_cursors[:] = [e for e in open_cursors if e[0] != i
+                                       or e[1] != firsts[i].get("cursor")]
                 except (ConnectionError, OSError, ExecutionError) as e:
-                    rows = self._failover_partial(i, sql, e)
-                    firsts[i] = None
+                    # the primary may be alive (coordinator-side error):
+                    # release its cursor before the replica re-run
+                    for ent in list(open_cursors):
+                        if firsts[i] is not None and ent[0] == i \
+                                and ent[1] == firsts[i].get("cursor"):
+                            self._close_cursor(*ent)
+                            open_cursors.remove(ent)
+                    rows = self._failover_partial(i, sql, e, open_cursors)
                 ingest(rows)
         finally:
-            # a failed query must not pin worker memory: close any
-            # cursor we opened but never fully drained
-            for i, f in enumerate(firsts):
-                if f is not None and f.get("cursor") is not None:
-                    try:
-                        self._call(i, {"cmd": "close_cursor",
-                                       "cursor": f["cursor"]})
-                    except Exception:  # noqa: BLE001 — best effort
-                        pass
+            for ent in open_cursors:
+                self._close_cursor(*ent)
 
         if not ddl_done:
             s.execute(self._infer_staging_ddl(partial_sql, []))

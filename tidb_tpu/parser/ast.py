@@ -15,7 +15,8 @@ __all__ = [
     # expressions
     "EName", "ENum", "EStr", "ENull", "EBool", "EStar", "EParam",
     "EBinary", "EUnary", "EFunc", "ECase", "ECast", "EIn", "EBetween",
-    "ELike", "EExists", "ESubquery", "EInterval", "EIsNull", "EVar", "EWindow",
+    "ELike", "ERegexp", "EExists", "ESubquery", "EInterval", "EIsNull",
+    "EVar", "EWindow",
     # query structure
     "SelectItem", "TableName", "SubqueryTable", "Join", "OrderItem",
     "SelectStmt", "UnionStmt", "CTE",
@@ -127,6 +128,12 @@ class ELike:
     pattern: "Expr"
     negated: bool = False
     escape: Optional[str] = None
+
+@dataclass
+class ERegexp:
+    arg: "Expr"
+    pattern: "Expr"
+    negated: bool = False
 
 @dataclass
 class EExists:

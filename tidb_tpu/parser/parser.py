@@ -1012,6 +1012,12 @@ class Parser:
                     escape = self.next().text
                 left = ELike(left, pattern, negated=negated, escape=escape)
                 continue
+            t = self.peek()
+            if t.kind == "IDENT" and t.text.lower() in ("regexp", "rlike"):
+                self.next()
+                pattern = self.parse_bitor()
+                left = ERegexp(left, pattern, negated=negated)
+                continue
             if negated:
                 self.pos = save
                 break

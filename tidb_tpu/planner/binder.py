@@ -256,6 +256,9 @@ class Binder:
         if isinstance(e, A.ELike):
             return self.bind_like(e, scope)
 
+        if isinstance(e, A.ERegexp):
+            return self.bind_regexp(e, scope)
+
         if isinstance(e, A.ECase):
             return self.bind_case(e, scope)
 
@@ -647,6 +650,29 @@ class Binder:
             lut = ~lut
         return Lookup.build(arg, lut, BOOL)
 
+    def bind_regexp(self, e: A.ERegexp, scope: Scope) -> Expr:
+        """col REGEXP/RLIKE 'pat' — same plan-time-LUT design as LIKE.
+        MySQL semantics: partial match (re.search), case-insensitive by
+        default (the _ci collation default; python `re` dialect stands
+        in for ICU — the shared subset covers common patterns)."""
+        arg = self.bind_expr(e.arg, scope)
+        pat = self.bind_expr(e.pattern, scope)
+        if not isinstance(pat, Literal):
+            raise UnsupportedError("non-constant REGEXP pattern")
+        neg = e.negated
+        if isinstance(arg, Literal) and arg.type_.kind == TypeKind.STRING:
+            hit = re.search(str(pat.value), str(arg.value),
+                            re.IGNORECASE) is not None
+            return Literal(type_=BOOL, value=hit != neg)
+        d = self._dict_of(arg)
+        if d is None:
+            raise UnsupportedError("REGEXP on non-string or dictionary-less value")
+        rx = re.compile(str(pat.value), re.IGNORECASE)
+        lut = d.match_table(lambda s: rx.search(s) is not None)
+        if neg:
+            lut = ~lut
+        return Lookup.build(arg, lut, BOOL)
+
     # -- CASE -----------------------------------------------------------
 
     def bind_case(self, e: A.ECase, scope: Scope) -> Expr:
@@ -1030,6 +1056,10 @@ class Binder:
         if name in ("cot", "sinh", "cosh", "tanh"):
             return Call(type_=FLOAT64, op=name, args=tuple(args))
 
+        if name in ("regexp_like", "regexp_replace", "regexp_substr",
+                    "regexp_instr"):
+            return self._bind_regexp_func(name, args)
+
         # string functions via dictionary LUTs
         if name in _STRING_VALUE_FUNCS:
             return self.bind_string_func(name, e, args)
@@ -1123,6 +1153,65 @@ class Binder:
                 outs.append(_json.dumps(out, separators=(", ", ": ")))
                 valid.append(True)
         return self._lut_strings(arg, outs, valid, type_=JSONTYPE)
+
+    def _bind_regexp_func(self, name: str, args: List[Expr]) -> Expr:
+        """REGEXP_LIKE / REGEXP_REPLACE / REGEXP_SUBSTR / REGEXP_INSTR
+        as per-dictionary-value host evaluations (the LIKE design).
+        Case-insensitive by default like the _ci collations; a trailing
+        match_type literal of 'c' flips REGEXP_LIKE case-sensitive."""
+        if len(args) < 2 or not isinstance(args[1], Literal):
+            raise UnsupportedError(f"{name.upper()} needs a constant pattern")
+        # MySQL's pos/occurrence/return_option/match_type extras are not
+        # implemented — reject rather than silently answer for the
+        # defaults (regexp_like accepts a match_type of 'c'/'i')
+        max_args = {"regexp_like": 3, "regexp_replace": 3,
+                    "regexp_substr": 2, "regexp_instr": 2}[name]
+        if len(args) > max_args:
+            raise UnsupportedError(
+                f"{name.upper()} extra arguments (pos/occurrence/"
+                "match_type) not supported yet")
+        flags = re.IGNORECASE
+        if name == "regexp_like" and len(args) > 2:
+            if not isinstance(args[2], Literal):
+                raise UnsupportedError("REGEXP_LIKE match_type must be constant")
+            if "c" in str(args[2].value):
+                flags = 0
+        rx = re.compile(str(args[1].value), flags)
+        repl = None
+        if name == "regexp_replace":
+            if len(args) < 3 or not isinstance(args[2], Literal):
+                raise UnsupportedError(
+                    "REGEXP_REPLACE needs a constant replacement")
+            # MySQL backrefs are $1..$9; python's are \1..\9
+            repl = re.sub(r"\$(\d)", r"\\\1", str(args[2].value))
+
+        def apply(s: str):
+            if name == "regexp_like":
+                return rx.search(s) is not None
+            if name == "regexp_replace":
+                return rx.sub(repl, s)
+            m = rx.search(s)
+            if name == "regexp_substr":
+                return m.group(0) if m else None
+            return (m.start() + 1) if m else 0  # regexp_instr
+
+        arg = args[0]
+        if isinstance(arg, Literal) and arg.type_.kind == TypeKind.STRING:
+            v = apply(str(arg.value))
+            t = {"regexp_like": BOOL, "regexp_instr": INT64}.get(name, STRING)
+            return Literal(type_=t, value=v)
+        d = self._dict_of(arg)
+        if d is None:
+            raise UnsupportedError(f"{name.upper()} needs a string column")
+        if name == "regexp_like":
+            return Lookup.build(arg, d.match_table(apply), BOOL)
+        if name == "regexp_instr":
+            return Lookup.build(arg, d.apply_table(apply, np.int64), INT64)
+        mapped = [apply(s) for s in d.values]
+        return self._lut_strings(
+            arg, ["" if m is None else m for m in mapped],
+            valid=None if all(m is not None for m in mapped)
+            else [m is not None for m in mapped])
 
     def _bind_str_to_date(self, args: List[Expr]) -> Expr:
         """STR_TO_DATE(str, fmt): per-dictionary-value host parse -> a
