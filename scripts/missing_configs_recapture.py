@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""On-chip recapture of the configs the tunnel has denied so far:
+Q18 (+streamed), SSB Q3.2, TPC-DS Q95.
+
+Both round-4 captures lost these to mid-run tunnel deaths (remote
+compiles through the HTTP tunnel take minutes per program and the
+backend drops). This retakes ONLY the still-missing configs under the
+chip lock — configs that already landed in BENCH_tpu.json are skipped,
+each success patches in immediately, and a mid-run tunnel death
+records its error and leaves earlier results intact.
+
+Run solo (acquires the chip lock via bench.chip_lock).
+"""
+
+import gc
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def patch(updates):
+    path = os.path.join(REPO, "BENCH_tpu.json")
+    art = json.load(open(path))
+    art["extra"].update(updates)
+    for k in [k for k in art["extra"]
+              if k.endswith("_error") and k[:-6] + "_recaptured" in updates]:
+        art["extra"].pop(k, None)
+    tmp = path + ".patch"
+    json.dump(art, open(tmp, "w"))
+    os.replace(tmp, path)
+
+
+def capture_q18(mesh, out):
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.tpch import load_tpch
+    from tidb_tpu.storage.tpch_queries import Q
+    from tidb_tpu.testutil import mirror_to_sqlite
+
+    sf = float(os.environ.get("BENCH_SF_Q18", "0.2"))
+    s = Session(chunk_capacity=1 << 20, mesh=mesh)
+    counts = load_tpch(s.catalog, sf=sf)
+    conn = mirror_to_sqlite(s.catalog,
+                            tables=["lineitem", "orders", "customer"])
+    sql, lite = Q["q18"]
+    rps, vs, best, check = bench.bench_query(
+        s, sql, conn, lite or sql, counts["lineitem"], reps=2,
+        extra=out, tag="q18")
+    out["tpch_q18_rows_per_sec"] = round(rps, 1)
+    out["q18_vs_sqlite"] = round(vs, 3)
+    out["q18_sf"] = sf
+    out["q18_recaptured"] = True
+    if "MISMATCH" in check:
+        out["q18_check"] = check
+    print(f"q18: {rps:.1f} rows/s {vs:.3f}x {check}", flush=True)
+
+    from tidb_tpu.parallel.partition import table_bytes
+    from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
+
+    def sd():
+        return (FRAGMENT_DISPATCH.value(kind="general_segment_stream")
+                + FRAGMENT_DISPATCH.value(kind="general_generic_stream"))
+
+    li = s.catalog.table("test", "lineitem")
+    budget = max(1 << 20, table_bytes(li) // 4)
+    s.execute(f"SET tidb_device_cache_bytes = {budget}")
+    d0 = sd()
+    rps_s, vs_s, best_s, check_s = bench.bench_query(
+        s, sql, conn, lite or sql, counts["lineitem"], reps=2,
+        extra=out, tag="q18_streamed")
+    out["q18_streamed"] = {
+        "rows_per_sec": round(rps_s, 1), "vs_sqlite": round(vs_s, 3),
+        "budget_bytes": budget, "lineitem_bytes": table_bytes(li),
+        "engaged": bool(sd() > d0),
+        "overhead_vs_resident": round(best_s / best, 3),
+        "check": check_s,
+    }
+    s.execute("SET tidb_device_cache_bytes = 8589934592")
+    conn.close()
+
+
+def capture_ssb(mesh, out):
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.ssb import SSB_QUERIES, load_ssb
+    from tidb_tpu.testutil import mirror_to_sqlite
+
+    sf = float(os.environ.get("BENCH_SF_SSB", "0.1"))
+    s = Session(chunk_capacity=1 << 20, mesh=mesh)
+    c = load_ssb(s.catalog, sf=sf)
+    conn = mirror_to_sqlite(s.catalog)
+    sql = SSB_QUERIES["q3.2"]
+    rps, vs, _best, check = bench.bench_query(
+        s, sql, conn, sql, c["lineorder"], reps=2, ordered=False,
+        extra=out, tag="ssb")
+    out["ssb_q32_rows_per_sec"] = round(rps, 1)
+    out["ssb_q32_vs_sqlite"] = round(vs, 3)
+    out["ssb_sf"] = sf
+    out["ssb_recaptured"] = True
+    if "MISMATCH" in check:
+        out["ssb_q32_check"] = check
+    print(f"ssb: {rps:.1f} rows/s {vs:.3f}x {check}", flush=True)
+    conn.close()
+
+
+def capture_tpcds(mesh, out):
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.tpcds import Q95, Q95_SQLITE, load_tpcds_q95
+    from tidb_tpu.testutil import mirror_to_sqlite
+
+    sf = float(os.environ.get("BENCH_SF_DS", "0.5"))
+    s = Session(chunk_capacity=1 << 20, mesh=mesh)
+    c = load_tpcds_q95(s.catalog, sf=sf)
+    conn = mirror_to_sqlite(s.catalog)
+    rps, vs, _best, check = bench.bench_query(
+        s, Q95, conn, Q95_SQLITE, c["web_sales"], reps=2,
+        extra=out, tag="tpcds")
+    out["tpcds_q95_rows_per_sec"] = round(rps, 1)
+    out["tpcds_q95_vs_sqlite"] = round(vs, 3)
+    out["tpcds_sf"] = sf
+    out["tpcds_recaptured"] = True
+    if "MISMATCH" in check:
+        out["tpcds_q95_check"] = check
+    print(f"tpcds: {rps:.1f} rows/s {vs:.3f}x {check}", flush=True)
+    conn.close()
+
+
+CONFIGS = [
+    ("tpch_q18_rows_per_sec", "q18", capture_q18),
+    ("ssb_q32_rows_per_sec", "ssb", capture_ssb),
+    ("tpcds_q95_rows_per_sec", "tpcds", capture_tpcds),
+]
+
+
+def main():
+    lock = bench.chip_lock()
+    ok = True
+    try:
+        import jax
+
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        from tidb_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        have = json.load(open(os.path.join(REPO, "BENCH_tpu.json")))["extra"]
+        for metric, tag, fn in CONFIGS:
+            if metric in have and f"{tag}_error" not in have:
+                print(f"{tag}: already captured; skipping", flush=True)
+                continue
+            out = {f"{tag}_recapture_ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+                   f"{tag}_load_before": bench.machine_load()}
+            try:
+                fn(mesh, out)
+            except Exception as e:  # noqa: BLE001
+                out[f"{tag}_error"] = f"{type(e).__name__}: {e}"[:300]
+                ok = False
+            out[f"{tag}_load_after"] = bench.machine_load()
+            patch(out)
+            gc.collect()
+            if not ok:
+                break  # tunnel likely dead; let the watchdog re-probe
+    finally:
+        bench.chip_unlock(lock[0])
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -156,7 +156,22 @@ def wait_for_child(child):
 
 def run_capture():
     """Chip is healthy and we hold the lock: take every on-chip number
-    in one claim. Returns True if BENCH_tpu.json landed."""
+    in one claim. Returns True if BENCH_tpu.json landed.
+
+    TPU_CAPTURE_MODE=missing runs scripts/missing_configs_recapture.py
+    instead: only configs absent from (or errored in) BENCH_tpu.json
+    re-run, each patching in as it lands."""
+    if os.environ.get("TPU_CAPTURE_MODE") == "missing":
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.setdefault("BENCH_LOCK_SKIP", "1")
+        log("capture: recapturing missing configs on the TPU backend")
+        with open(os.path.join(REPO, "bench_tpu_r4.log"), "a") as blog:
+            rc = subprocess.call(
+                [sys.executable, "scripts/missing_configs_recapture.py"],
+                cwd=REPO, env=env, stdout=blog, stderr=blog)
+        log(f"capture: missing-configs recapture rc={rc}")
+        return rc == 0
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env["BENCH_PLATFORM"] = "default"   # probe already succeeded; go direct
@@ -218,7 +233,8 @@ def main():
     log(f"watchdog up pid={os.getpid()} interval={PROBE_INTERVAL}s "
         f"probe_timeout={PROBE_TIMEOUT}s")
     wait_for_stray_probes()
-    if os.path.exists(BENCH_OUT):
+    if os.path.exists(BENCH_OUT) and \
+            os.environ.get("TPU_CAPTURE_MODE") != "missing":
         log(f"{BENCH_OUT} already exists; exiting")
         return
     captures = 0
