@@ -1,0 +1,166 @@
+"""Eager aggregation: partial-agg pushdown below joins (ref: planner/
+core's aggregation-pushdown rule; the Q18 shape — lineitem pre-
+aggregated by l_orderkey before joining orders — is the canonical win).
+
+Pinned properties:
+  * the rewrite fires on stats evidence of shrink and is EXPLAIN-visible
+    (a HashAgg below the join);
+  * results are row-identical to the unrewritten plan for SUM/COUNT/
+    MIN/MAX through inner joins, and through left/semi joins on the
+    probe side;
+  * it bails where the math doesn't hold (DISTINCT, AVG, global COUNT,
+    right side of a left join, no stats).
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.parser import parse
+from tidb_tpu.planner.physical import PHashAgg, PHashJoin
+from tidb_tpu.session import Session
+
+
+def _agg_below_join(phys) -> bool:
+    """Is there a PHashAgg strictly below a PHashJoin?"""
+    found = [False]
+
+    def visit(p, under_join):
+        if isinstance(p, PHashAgg) and under_join:
+            found[0] = True
+        for c in p.children:
+            visit(c, under_join or isinstance(p, PHashJoin))
+
+    visit(phys, False)
+    return found[0]
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(chunk_capacity=1 << 15)
+    s.execute("create table fact (k bigint, g bigint, x bigint, f double)")
+    s.execute("create table dim (k bigint, label bigint)")
+    rng = np.random.default_rng(5)
+    n = 20000
+    tf = s.catalog.table("test", "fact")
+    tf.insert_columns({
+        "k": rng.integers(0, 500, n).astype(np.int64),       # ~40 rows/key
+        "g": rng.integers(0, 8, n).astype(np.int64),
+        "x": rng.integers(-100, 100, n).astype(np.int64),
+        "f": rng.normal(0, 2.0, n)})
+    td = s.catalog.table("test", "dim")
+    td.insert_columns({"k": np.arange(500, dtype=np.int64),
+                       "label": (np.arange(500) % 7).astype(np.int64)})
+    s.execute("analyze table fact, dim")
+    return s
+
+
+def test_explain_shows_partial_below_join(sess):
+    sql = ("select d.label, sum(f.x), count(*), min(f.f), max(f.x) "
+           "from fact f join dim d on f.k = d.k group by d.label")
+    phys = sess._plan_select(parse(sql)[0])
+    assert _agg_below_join(phys)
+
+
+def test_results_match_unrewritten(sess):
+    sql = ("select d.label, sum(f.x) as sx, count(*) as n, min(f.f) as mf, "
+           "max(f.x) as xx from fact f join dim d on f.k = d.k "
+           "group by d.label order by d.label")
+    got = sess.query(sql)
+    sess.execute("set tidb_opt_agg_push_down = 0")
+    try:
+        phys = sess._plan_select(parse(sql)[0])
+        assert not _agg_below_join(phys)
+        want = sess.query(sql)
+    finally:
+        sess.execute("set tidb_opt_agg_push_down = 1")
+    assert len(got) == len(want) == 7
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[1] == w[1] and g[2] == w[2] and g[4] == w[4]
+        assert g[3] == pytest.approx(w[3])
+
+
+def test_group_key_from_fact_side(sess):
+    """Group keys the fact side supplies move into the partial."""
+    sql = ("select f.g, d.label, sum(f.x) from fact f join dim d "
+           "on f.k = d.k group by f.g, d.label order by f.g, d.label")
+    phys = sess._plan_select(parse(sql)[0])
+    assert _agg_below_join(phys)
+    got = sess.query(sql)
+    sess.execute("set tidb_opt_agg_push_down = 0")
+    try:
+        want = sess.query(sql)
+    finally:
+        sess.execute("set tidb_opt_agg_push_down = 1")
+    assert got == want
+
+
+def test_semi_join_path(sess):
+    """Descending the left side of a semi join (the Q18 shape)."""
+    sql = ("select f.g, sum(f.x) from fact f join dim d on f.k = d.k "
+           "where d.k in (select k from dim where label < 3) "
+           "group by f.g order by f.g")
+    got = sess.query(sql)
+    sess.execute("set tidb_opt_agg_push_down = 0")
+    try:
+        want = sess.query(sql)
+    finally:
+        sess.execute("set tidb_opt_agg_push_down = 1")
+    assert got == want
+
+
+def test_bails_without_stats(sess):
+    s2 = Session(chunk_capacity=1 << 15)
+    s2.execute("create table a (k bigint, x bigint)")
+    s2.execute("create table b (k bigint)")
+    s2.execute("insert into a values (1, 10), (1, 20), (2, 5)")
+    s2.execute("insert into b values (1), (2)")
+    s2.execute("set tidb_enable_auto_analyze = 0")
+    phys = s2._plan_select(parse(
+        "select sum(a.x) from a join b on a.k = b.k")[0])
+    # no ANALYZE -> no NDV evidence -> no rewrite (and global agg is
+    # segment/generic over the join as before)
+    assert not _agg_below_join(phys)
+
+
+def test_bails_on_avg_distinct_and_global_count(sess):
+    for sql in (
+        "select d.label, avg(f.x) from fact f join dim d on f.k = d.k "
+        "group by d.label",
+        "select d.label, sum(distinct f.x) from fact f join dim d "
+        "on f.k = d.k group by d.label",
+        "select count(*) from fact f join dim d on f.k = d.k",
+    ):
+        phys = sess._plan_select(parse(sql)[0])
+        assert not _agg_below_join(phys), sql
+
+
+def test_left_join_right_side_bails(sess):
+    """Args from the RIGHT side of a LEFT join: membership in partial
+    groups would change (NULL-padding), so no rewrite."""
+    sql = ("select d.label, sum(f.x) from dim d left join fact f "
+           "on d.k = f.k group by d.label order by d.label")
+    phys = sess._plan_select(parse(sql)[0])
+    assert not _agg_below_join(phys)
+    # and the unrewritten result is the oracle truth
+    got = sess.query(sql)
+    sess.execute("set tidb_opt_agg_push_down = 0")
+    try:
+        want = sess.query(sql)
+    finally:
+        sess.execute("set tidb_opt_agg_push_down = 1")
+    assert got == want
+
+
+def test_left_join_probe_side_pushes(sess):
+    """Args from the LEFT (probe) side of a LEFT join push fine: left
+    rows are never duplicated by padding."""
+    sql = ("select f.g, sum(f.x) as sx, count(f.x) as cn from fact f "
+           "left join dim d on f.k = d.k and d.label > 2 "
+           "group by f.g order by f.g")
+    got = sess.query(sql)
+    sess.execute("set tidb_opt_agg_push_down = 0")
+    try:
+        want = sess.query(sql)
+    finally:
+        sess.execute("set tidb_opt_agg_push_down = 1")
+    assert got == want

@@ -28,6 +28,7 @@ def plan_statement(
     cascades: bool = False,
     n_parts: int = 1,
     session_info: Optional[dict] = None,
+    agg_push_down: bool = True,
 ) -> PhysicalPlan:
     """SELECT/UNION AST -> optimized physical plan."""
     assert isinstance(stmt, (A.SelectStmt, A.UnionStmt)), type(stmt)
@@ -38,7 +39,8 @@ def plan_statement(
     )
     logical = build_select(stmt, ctx)
     logical = optimize_logical(logical, hints=getattr(stmt, "hints", ()) or (),
-                               cascades=cascades, n_parts=n_parts)
+                               cascades=cascades, n_parts=n_parts,
+                               agg_push_down=agg_push_down)
     phys = inject_point_get(lower(logical))
     if n_parts > 1:
         _annotate_topn(phys)

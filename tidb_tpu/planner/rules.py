@@ -679,12 +679,200 @@ def _rule_distinct_two_phase(plan: LogicalPlan) -> LogicalPlan:
     )
 
 
+_EAGG_N = [0]
+
+
+def _eagg_uid(base: str) -> str:
+    _EAGG_N[0] += 1
+    return f"{base}#eagg{_EAGG_N[0]}"
+
+
+def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
+    """Eager aggregation: push a partial aggregate below a join when
+    every aggregate argument comes from one join side (ref: planner/
+    core's aggregation-pushdown rule; the canonical win is Q18's
+    lineitem pre-aggregated by l_orderkey before joining orders — the
+    join input shrinks by the average group size BEFORE the expensive
+    exchange/build).
+
+    For inner joins, grouping side S by (its join-key exprs on the path
+    + the upper group keys it supplies) and summing partials upstream
+    is exact: all rows of one partial group share their join keys, so
+    each partial joins to the same match set, and SUM/COUNT partials
+    multiplied out by matches reproduce the row-level totals (MIN/MAX
+    are duplicate-insensitive). Gated on fresh-stats evidence that the
+    partial actually shrinks its side (<70%); bails on DISTINCT / AVG /
+    non-inner joins on the path / expressions straddling both sides /
+    global COUNT (an empty join must still report 0, not NULL)."""
+    if plan.children:
+        plan.children[:] = [_rule_eager_agg(c) for c in plan.children]
+    if not (isinstance(plan, LAggregate) and isinstance(plan.children[0], LJoin)):
+        return plan
+    agg = plan
+    if any(a.distinct or a.func not in ("sum", "count", "min", "max")
+           for a in agg.aggs):
+        return plan
+    if not agg.group_exprs and any(a.func == "count" for a in agg.aggs):
+        return plan  # global COUNT over an empty join must be 0
+    arg_refs: Set[str] = set()
+    for a in agg.aggs:
+        if a.arg is not None:
+            arg_refs |= _refs(a.arg)
+    if not arg_refs:
+        return plan  # COUNT(*) only: no side owns it more than another
+
+    # descend the join tree to the unique subtree S holding every agg
+    # argument; every join on the path must be inner, and its conds must
+    # not mix S columns with the other side inside one expression
+    path = []  # (join, side) from top to S's parent
+    node = agg.child if hasattr(agg, "child") else agg.children[0]
+    while isinstance(node, LJoin):
+        luids = {c.uid for c in node.children[0].schema}
+        ruids = {c.uid for c in node.children[1].schema}
+        if arg_refs <= luids:
+            side = 0
+        elif arg_refs <= ruids:
+            side = 1
+        else:
+            return plan
+        # inner joins preserve the multiplicity math on either side;
+        # left/semi/anti joins never DUPLICATE their left rows (they
+        # filter or NULL-pad), so descending their left side is exact —
+        # their right side would change partial-group membership
+        if node.kind != "inner" and not (
+                side == 0 and node.kind in ("left", "semi", "anti")):
+            return plan
+        path.append((node, side))
+        node = node.children[side]
+    if not path:
+        return plan
+    S = node
+    s_uids = {c.uid for c in S.schema}
+
+    # collect S-side join-key exprs along the path and upper group keys
+    # that S supplies; anything else touching S bails
+    key_exprs: List[Expr] = []  # identity-ordered
+
+    def add_key(e: Expr) -> int:
+        for i, k in enumerate(key_exprs):
+            if k is e or (isinstance(k, ColumnRef) and isinstance(e, ColumnRef)
+                          and k.name == e.name):
+                return i
+        key_exprs.append(e)
+        return len(key_exprs) - 1
+
+    join_key_slots = []  # (join, side-expr index in eq_conds, key slot)
+    for join, side in path:
+        if join.other_cond is not None and _refs(join.other_cond) & s_uids:
+            return plan
+        for ci, (le, re_) in enumerate(join.eq_conds):
+            se = le if side == 0 else re_
+            oe = re_ if side == 0 else le
+            if _refs(oe) & s_uids:
+                return plan
+            if _refs(se) & s_uids:
+                if not _refs(se) <= s_uids:
+                    return plan
+                join_key_slots.append((join, ci, add_key(se)))
+    group_slots = []  # (upper group index, key slot)
+    for gi, g in enumerate(agg.group_exprs):
+        r = _refs(g)
+        if r & s_uids:
+            if not r <= s_uids:
+                return plan
+            group_slots.append((gi, add_key(g)))
+
+    # build the partial aggregate over S
+    from tidb_tpu.planner.binder import PlanCol
+
+    key_uids = [_eagg_uid("k") for _ in key_exprs]
+    key_cols = [PlanCol(uid=u, name=u, type_=e.type_,
+                        dict_=getattr(e, "_dict", None))
+                for u, e in zip(key_uids, key_exprs)]
+    p_aggs: List[AggSpec] = []
+    p_cols: List[PlanCol] = []
+    upper_aggs: List[AggSpec] = []
+    for a in agg.aggs:
+        u = _eagg_uid(a.func)
+        p_aggs.append(AggSpec(uid=u, func=a.func, arg=a.arg, type_=a.type_))
+        p_cols.append(PlanCol(uid=u, name=u, type_=a.type_,
+                              dict_=(getattr(a.arg, "_dict", None)
+                                     if a.func in ("min", "max") and a.arg is not None
+                                     else None)))
+        ref = ColumnRef(type_=a.type_, name=u)
+        if getattr(a.arg, "_dict", None) is not None and a.func in ("min", "max"):
+            object.__setattr__(ref, "_dict", a.arg._dict)
+        # partials combine upstream: SUM/COUNT re-sum (each partial row
+        # re-counts once per join match — the multiplicity the original
+        # row-level aggregation saw), MIN/MAX re-extremize
+        upper_func = "sum" if a.func in ("sum", "count") else a.func
+        upper_aggs.append(AggSpec(uid=a.uid, func=upper_func, arg=ref,
+                                  type_=a.type_))
+    partial = LAggregate(
+        schema=key_cols + p_cols, children=[S],
+        group_exprs=list(key_exprs), group_uids=list(key_uids),
+        aggs=p_aggs,
+    )
+
+    # shrink gate: only rewrite on STATS EVIDENCE the partial helps —
+    # every key must be a ColumnRef with a known NDV (heuristic
+    # fallbacks would fire the rewrite blind and can regress plans)
+    from tidb_tpu.planner.physical import _eq_ndv, _estimate
+
+    s_rows = _estimate(S)
+    if not all(isinstance(e, ColumnRef)
+               and _eq_ndv(S, e, s_rows) is not None for e in key_exprs):
+        return plan
+    p_rows = _estimate(partial)
+    if not (p_rows < 0.7 * s_rows):
+        return plan
+
+    # splice: replace S, rebuild path joins bottom-up with rewritten
+    # S-side key exprs and recomposed schemas
+    child: LogicalPlan = partial
+
+    def key_ref(slot: int) -> Expr:
+        e = key_exprs[slot]
+        ref = ColumnRef(type_=e.type_, name=key_uids[slot])
+        d = getattr(e, "_dict", None)
+        if d is not None:
+            object.__setattr__(ref, "_dict", d)
+        return ref
+
+    for join, side in reversed(path):
+        new_eq = list(join.eq_conds)
+        for j, ci, slot in join_key_slots:
+            if j is join:
+                le, re_ = new_eq[ci]
+                new_eq[ci] = (key_ref(slot), re_) if side == 0 \
+                    else (le, key_ref(slot))
+        kids = list(join.children)
+        kids[side] = child
+        child = LJoin(
+            schema=list(kids[0].schema) + list(kids[1].schema),
+            children=kids, kind=join.kind, eq_conds=new_eq,
+            other_cond=join.other_cond, exists_sem=join.exists_sem,
+            index_join=getattr(join, "index_join", None),
+        )
+
+    new_groups = list(agg.group_exprs)
+    for gi, slot in group_slots:
+        new_groups[gi] = key_ref(slot)
+    return LAggregate(
+        schema=agg.schema, children=[child],
+        group_exprs=new_groups, group_uids=list(agg.group_uids),
+        aggs=upper_aggs,
+    )
+
+
 def optimize_logical(plan: LogicalPlan, hints=(), cascades=False,
-                     n_parts: int = 1) -> LogicalPlan:
+                     n_parts: int = 1, agg_push_down: bool = True) -> LogicalPlan:
     plan = _rule_distinct_two_phase(plan)
     plan = _rule_fold(plan)
     plan = _rule_pushdown(plan)
     leading = next((args for name, args in hints if name == "leading"), None)
     plan = _rule_reorder(plan, leading, cascades, n_parts)
+    if agg_push_down:
+        plan = _rule_eager_agg(plan)
     plan = _rule_prune(plan, None)
     return plan

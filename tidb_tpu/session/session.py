@@ -331,7 +331,8 @@ class Session:
         """Planner callback: run a bound logical subplan to completion."""
         logical = optimize_logical(
             logical,
-            cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")))
+            cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
+            agg_push_down=bool(self.sysvars.get("tidb_opt_agg_push_down")))
         phys = lower(logical)
         # plan-time subqueries execute before the statement-level check
         # and fold into literals, so they must be checked here or a
@@ -354,6 +355,7 @@ class Session:
             n_parts=n_parts,
             session_info={"user": self.user,
                           "conn_id": getattr(self, "conn_id", 0)},
+            agg_push_down=bool(self.sysvars.get("tidb_opt_agg_push_down")),
         )
 
     def _apply_binding(self, stmt):

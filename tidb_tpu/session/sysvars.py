@@ -54,6 +54,9 @@ _reg(
     # memo-based exhaustive join-order search (ref: planner/cascades
     # and the sysvar of the same name); greedy ordering otherwise
     SysVar("tidb_enable_cascades_planner", False, BOTH, "bool"),
+    # eager aggregation (partial agg below joins); stats-gated, so ON by
+    # default unlike the reference's blind-push variant
+    SysVar("tidb_opt_agg_push_down", True, BOTH, "bool"),
     SysVar("tidb_gc_enable", True, BOTH, "bool"),
     # stats lifecycle (ref: statistics auto-analyze): after DML commits,
     # re-ANALYZE a table whose modified-row count crossed ratio * rows

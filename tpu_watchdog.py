@@ -84,6 +84,13 @@ def release_lock():
         pass
 
 
+def _artifact_mtime():
+    try:
+        return os.path.getmtime(BENCH_OUT)
+    except OSError:
+        return 0.0
+
+
 def probe_once(idx):
     """Detached probe child; returns (status, detail).
 
@@ -253,15 +260,22 @@ def main():
                 log(f"probe #{idx}: tpu unavailable ({str(d)[:200]})")
             else:
                 log(f"probe #{idx}: TPU HEALTHY {detail} — claiming once")
-                captures += 1
+                before = _artifact_mtime()
                 if run_capture():
                     log("capture complete; BENCH_tpu.json written. Exiting.")
                     return
-                if captures >= CAPTURE_ATTEMPTS:
-                    log(f"capture failed {captures}x; giving up to avoid "
-                        "wedging the tunnel further")
-                    return
-                log("capture failed; will re-probe")
+                if _artifact_mtime() != before:
+                    # partial progress (a config patched in before the
+                    # tunnel died) — the standing recapture must keep
+                    # going, not burn an attempt
+                    log("capture incomplete but made progress; will re-probe")
+                else:
+                    captures += 1
+                    if captures >= CAPTURE_ATTEMPTS:
+                        log(f"capture failed {captures}x with no progress; "
+                            "giving up to avoid wedging the tunnel further")
+                        return
+                    log("capture failed; will re-probe")
         finally:
             release_lock()
         time.sleep(PROBE_INTERVAL)
