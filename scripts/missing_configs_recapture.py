@@ -86,6 +86,9 @@ def capture_q18(mesh, out):
             "overhead_vs_resident": round(best_s / best, 3),
             "check": check_s,
         }
+        # marks a stale q18_streamed_error from an earlier half-failed
+        # run for removal by patch()
+        out["q18_streamed_recaptured"] = True
     except Exception as e:  # noqa: BLE001 — q18 itself still landed
         out["q18_streamed_error"] = f"{type(e).__name__}: {e}"[:300]
     finally:

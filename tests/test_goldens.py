@@ -1,0 +1,82 @@
+"""Plan-level golden tests (ref: cmd/explaintest — replay .test files of
+SQL and diff EXPLAIN output against golden .result files).
+
+Each tests/goldens/<name>.test file is a sequence of SQL statements;
+statements beginning with `explain` (or `explain format=...`) have their
+full output captured. The captured transcript must match
+tests/goldens/<name>.result byte for byte.
+
+Regenerate after an intentional planner change with:
+
+    UPDATE_GOLDENS=1 python -m pytest tests/test_goldens.py
+
+and review the .result diff like any code change — that diff IS the
+review surface for plan changes (estimates, join order, access paths,
+pushdowns all live in it).
+"""
+
+import os
+import pathlib
+
+import pytest
+
+from tidb_tpu.session import Session
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "goldens"
+UPDATE = os.environ.get("UPDATE_GOLDENS") == "1"
+
+
+def _statements(text: str):
+    """Split a .test file into statements: one per line; lines ending
+    with `\\` continue; `#` lines are comments."""
+    buf = []
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line or line.lstrip().startswith("#"):
+            continue
+        if line.endswith("\\"):
+            buf.append(line[:-1])
+            continue
+        buf.append(line)
+        yield " ".join(buf)
+        buf = []
+    if buf:
+        yield " ".join(buf)
+
+
+def _run_case(path: pathlib.Path) -> str:
+    s = Session(chunk_capacity=1 << 14)
+    out = []
+    for stmt in _statements(path.read_text()):
+        if stmt.lower().startswith("explain"):
+            rs = s.execute(stmt)
+            out.append(f"> {stmt}")
+            for row in rs.rows:
+                out.append(" | ".join(str(c) for c in row))
+            out.append("")
+        else:
+            s.execute(stmt)
+    return "\n".join(out) + "\n"
+
+
+CASES = sorted(p.stem for p in GOLDEN_DIR.glob("*.test"))
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_golden(name):
+    test_path = GOLDEN_DIR / f"{name}.test"
+    result_path = GOLDEN_DIR / f"{name}.result"
+    got = _run_case(test_path)
+    if UPDATE or not result_path.exists():
+        result_path.write_text(got)
+        if UPDATE:
+            pytest.skip(f"golden {name}.result rewritten")
+    want = result_path.read_text()
+    assert got == want, (
+        f"EXPLAIN output for {name} drifted from its golden file.\n"
+        f"If the plan change is intentional, regenerate with "
+        f"UPDATE_GOLDENS=1 and review the diff.")
+
+
+def test_cases_exist():
+    assert CASES, "no golden .test files found"

@@ -679,12 +679,6 @@ def _rule_distinct_two_phase(plan: LogicalPlan) -> LogicalPlan:
     )
 
 
-_EAGG_N = [0]
-
-
-def _eagg_uid(base: str) -> str:
-    _EAGG_N[0] += 1
-    return f"{base}#eagg{_EAGG_N[0]}"
 
 
 def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
@@ -782,10 +776,23 @@ def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
                 return plan
             group_slots.append((gi, add_key(g)))
 
-    # build the partial aggregate over S
+    # first half of the shrink gate: every key must be a ColumnRef with
+    # a known NDV (heuristic fallbacks would fire the rewrite blind) —
+    # checked BEFORE construction so uid derivation below can rely on it
+    from tidb_tpu.planner.physical import _eq_ndv, _estimate
+
+    s_rows = _estimate(S)
+    if not all(isinstance(e, ColumnRef)
+               and _eq_ndv(S, e, s_rows) is not None for e in key_exprs):
+        return plan
+
+    # build the partial aggregate over S. Uids derive from the inputs
+    # (NOT a global counter): re-planning the same SQL must produce the
+    # same uids, or the fragment/JIT caches — keyed on expr reprs — miss
+    # on every execution (the _rule_distinct_two_phase invariant)
     from tidb_tpu.planner.binder import PlanCol
 
-    key_uids = [_eagg_uid("k") for _ in key_exprs]
+    key_uids = [f"eaggk.{e.name}" for e in key_exprs]
     key_cols = [PlanCol(uid=u, name=u, type_=e.type_,
                         dict_=getattr(e, "_dict", None))
                 for u, e in zip(key_uids, key_exprs)]
@@ -793,7 +800,7 @@ def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
     p_cols: List[PlanCol] = []
     upper_aggs: List[AggSpec] = []
     for a in agg.aggs:
-        u = _eagg_uid(a.func)
+        u = f"eagg.{a.uid}"
         p_aggs.append(AggSpec(uid=u, func=a.func, arg=a.arg, type_=a.type_))
         p_cols.append(PlanCol(uid=u, name=u, type_=a.type_,
                               dict_=(getattr(a.arg, "_dict", None)
@@ -814,15 +821,7 @@ def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
         aggs=p_aggs,
     )
 
-    # shrink gate: only rewrite on STATS EVIDENCE the partial helps —
-    # every key must be a ColumnRef with a known NDV (heuristic
-    # fallbacks would fire the rewrite blind and can regress plans)
-    from tidb_tpu.planner.physical import _eq_ndv, _estimate
-
-    s_rows = _estimate(S)
-    if not all(isinstance(e, ColumnRef)
-               and _eq_ndv(S, e, s_rows) is not None for e in key_exprs):
-        return plan
+    # second half of the shrink gate: stats must show the partial helps
     p_rows = _estimate(partial)
     if not (p_rows < 0.7 * s_rows):
         return plan

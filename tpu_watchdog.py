@@ -84,11 +84,23 @@ def release_lock():
         pass
 
 
-def _artifact_mtime():
+def _missing_count():
+    """How many bench configs are still missing/errored in the artifact
+    (the progress measure for TPU_CAPTURE_MODE=missing — an error-only
+    patch changes the file's mtime but NOT this count)."""
     try:
-        return os.path.getmtime(BENCH_OUT)
-    except OSError:
-        return 0.0
+        extra = json.load(open(BENCH_OUT))["extra"]
+    except (OSError, ValueError, KeyError):
+        return 99
+    missing = 0
+    for metric, tag in (("tpch_q18_rows_per_sec", "q18"),
+                        ("ssb_q32_rows_per_sec", "ssb"),
+                        ("tpcds_q95_rows_per_sec", "tpcds")):
+        if metric not in extra or f"{tag}_error" in extra:
+            missing += 1
+    if "q18_streamed" not in extra or "q18_streamed_error" in extra:
+        missing += 1
+    return missing
 
 
 def probe_once(idx):
@@ -260,12 +272,12 @@ def main():
                 log(f"probe #{idx}: tpu unavailable ({str(d)[:200]})")
             else:
                 log(f"probe #{idx}: TPU HEALTHY {detail} — claiming once")
-                before = _artifact_mtime()
+                before = _missing_count()
                 if run_capture():
                     log("capture complete; BENCH_tpu.json written. Exiting.")
                     return
-                if _artifact_mtime() != before:
-                    # partial progress (a config patched in before the
+                if _missing_count() < before:
+                    # partial progress (a config landed before the
                     # tunnel died) — the standing recapture must keep
                     # going, not burn an attempt
                     log("capture incomplete but made progress; will re-probe")
