@@ -148,6 +148,19 @@ CONFIGS = [
 ]
 
 
+def missing_count(extra: dict) -> int:
+    """How many configs (incl. the q18_streamed pair) are still missing
+    or errored — the SINGLE definition consumed by both this script's
+    completeness check and the watchdog's progress measure."""
+    missing = 0
+    for metric, tag, _fn in CONFIGS:
+        if metric not in extra or f"{tag}_error" in extra:
+            missing += 1
+    if "q18_streamed" not in extra or "q18_streamed_error" in extra:
+        missing += 1
+    return missing
+
+
 def main():
     lock = bench.chip_lock()
     ok = True
@@ -182,10 +195,7 @@ def main():
         # success means EVERYTHING is captured (including q18_streamed,
         # whose failure doesn't abort the q18 config)
         have = json.load(open(os.path.join(REPO, "BENCH_tpu.json")))["extra"]
-        for metric, tag, _fn in CONFIGS:
-            if metric not in have or f"{tag}_error" in have:
-                ok = False
-        if "q18_streamed" not in have or "q18_streamed_error" in have:
+        if missing_count(have):
             ok = False
     finally:
         bench.chip_unlock(lock[0])

@@ -67,10 +67,12 @@ def test_golden(name):
     test_path = GOLDEN_DIR / f"{name}.test"
     result_path = GOLDEN_DIR / f"{name}.result"
     got = _run_case(test_path)
-    if UPDATE or not result_path.exists():
+    if UPDATE:
         result_path.write_text(got)
-        if UPDATE:
-            pytest.skip(f"golden {name}.result rewritten")
+        pytest.skip(f"golden {name}.result rewritten")
+    assert result_path.exists(), (
+        f"no golden for {name}: generate + review it with UPDATE_GOLDENS=1 "
+        f"(a silently minted golden enshrines unreviewed plans)")
     want = result_path.read_text()
     assert got == want, (
         f"EXPLAIN output for {name} drifted from its golden file.\n"

@@ -93,7 +93,12 @@ class TableScanExec(Executor):
                 n = end - start
                 cols = {}
                 for c in self.scan_schema:
-                    data, valid = self.table.column_slice(c.name, start, end)
+                    if c.name == "__rowid__":
+                        # physical-rowid pseudo-column (multi-table DML)
+                        data = np.arange(start, end, dtype=np.int64)
+                        valid = np.ones(n, dtype=np.bool_)
+                    else:
+                        data, valid = self.table.column_slice(c.name, start, end)
                     cols[c.uid] = Column.from_numpy(data, c.type_, valid=valid, capacity=cap)
                 live = np.zeros(cap, dtype=np.bool_)
                 live[:n] = self.table.live_mask(
@@ -115,8 +120,12 @@ class TableScanExec(Executor):
             cap *= 2
         cols = {}
         for c in self.scan_schema:
-            d = self.table.data[c.name][rows]
-            v = self.table.valid[c.name][rows]
+            if c.name == "__rowid__":
+                d = np.asarray(rows, dtype=np.int64)
+                v = np.ones(len(rows), dtype=np.bool_)
+            else:
+                d = self.table.data[c.name][rows]
+                v = self.table.valid[c.name][rows]
             cols[c.uid] = Column.from_numpy(d, c.type_, valid=v, capacity=cap)
         sel = np.zeros(cap, dtype=np.bool_)
         sel[: len(rows)] = True

@@ -232,6 +232,10 @@ class _Compiler:
         return self._broadcast_producer(plan)
 
     def _scan_producer(self, scan: PScan, stages) -> Callable:
+        if any(c.name == "__rowid__" for c in scan.schema):
+            # physical rowids are a host-engine concept (shardings
+            # re-partition rows); DML selects fall back to the host path
+            raise _Unsupported("__rowid__ pseudo-column in a fragment")
         idx = len(self.sources)
         self.sources.append(_Source(scan, stages))
         uid_of = {c.name: c.uid for c in scan.schema}

@@ -262,11 +262,16 @@ class UpdateStmt:
     table: TableName
     sets: List[Tuple[EName, Expr]] = field(default_factory=list)
     where: Optional[Expr] = None
+    # multi-table form (UPDATE t1 JOIN t2 ...): the full table-refs tree;
+    # `table` then names the single UPDATED target
+    from_: Optional["TableSource"] = None
 
 @dataclass
 class DeleteStmt:
     table: TableName
     where: Optional[Expr] = None
+    # multi-table form (DELETE t FROM ... / DELETE FROM t USING ...)
+    from_: Optional["TableSource"] = None
 
 @dataclass
 class ColumnDef:

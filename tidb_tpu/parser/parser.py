@@ -453,7 +453,7 @@ class Parser:
 
     def parse_update(self) -> UpdateStmt:
         self.expect_kw("update")
-        table = self._table_name()
+        refs = self.parse_table_sources()
         self.expect_kw("set")
         sets = []
         while True:
@@ -466,14 +466,29 @@ class Parser:
             if not self.accept_op(","):
                 break
         where = self.parse_expr() if self.accept_kw("where") else None
-        return UpdateStmt(table, sets, where)
+        if isinstance(refs, TableName):
+            return UpdateStmt(refs, sets, where)
+        # multi-table: the single updated target resolves from the SET
+        # column qualifiers at execution time (placeholder name here)
+        return UpdateStmt(TableName(""), sets, where, from_=refs)
 
     def parse_delete(self) -> DeleteStmt:
         self.expect_kw("delete")
-        self.expect_kw("from")
+        if self.accept_kw("from"):
+            table = self._table_name()
+            if self.accept_kw("using"):
+                # DELETE FROM t USING <table_refs> WHERE ...
+                refs = self.parse_table_sources()
+                where = self.parse_expr() if self.accept_kw("where") else None
+                return DeleteStmt(table, where, from_=refs)
+            where = self.parse_expr() if self.accept_kw("where") else None
+            return DeleteStmt(table, where)
+        # DELETE t FROM <table_refs> WHERE ...  (single target supported)
         table = self._table_name()
+        self.expect_kw("from")
+        refs = self.parse_table_sources()
         where = self.parse_expr() if self.accept_kw("where") else None
-        return DeleteStmt(table, where)
+        return DeleteStmt(table, where, from_=refs)
 
     # -- DDL -----------------------------------------------------------------
 

@@ -76,6 +76,7 @@ class PlanCol:
     type_: SQLType
     qualifier: Optional[str] = None  # table alias for qualified resolution
     dict_: Optional[Dictionary] = None  # for STRING columns
+    hidden: bool = False  # pseudo-columns (__rowid__): resolvable, not in *
 
     def ref(self) -> ColumnRef:
         return ColumnRef(type_=self.type_, name=self.uid)

@@ -532,6 +532,12 @@ def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalP
             )
             for c in table.schema.columns
         ]
+        # hidden physical-rowid pseudo-column: resolvable by name (the
+        # multi-table DML path selects it through joins), invisible to
+        # SELECT * and pruned away when unreferenced
+        cols.append(PlanCol(
+            uid=ctx.binder.new_uid(f"{src.name}.__rowid__"),
+            name="__rowid__", type_=INT64, qualifier=alias, hidden=True))
         return (
             LScan(schema=cols, db=db, table_name=src.name, table=table),
             Scope(cols, outer),
@@ -986,6 +992,8 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
             if src_scope is None:
                 raise PlanError("SELECT * with GROUP BY requires explicit columns")
             for c in src_scope.cols:
+                if c.hidden:
+                    continue
                 if item.expr.qualifier and (c.qualifier or "").lower() != item.expr.qualifier.lower():
                     continue
                 items.append((c.name, A.EName(c.name, c.qualifier)))

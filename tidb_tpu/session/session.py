@@ -327,12 +327,25 @@ class Session:
             device_cache_bytes=int(self.sysvars.get("tidb_device_cache_bytes")),
         )
 
+    def _agg_push_down(self) -> bool:
+        """Effective eager-aggregation switch: the sysvar, minus
+        device-engine sessions (the fragment tier can't shard a
+        partial-agg join side yet — losing fragmentation costs far more
+        than eager agg saves; DistAggExec-as-join-input lifts this)."""
+        if not self.sysvars.get("tidb_opt_agg_push_down"):
+            return False
+        if self._shard_cache is not None and \
+                self.sysvars.get("tidb_enable_tpu_exec") and \
+                self._device_engine_auto():
+            return False
+        return True
+
     def _execute_subplan(self, logical) -> List[tuple]:
         """Planner callback: run a bound logical subplan to completion."""
         logical = optimize_logical(
             logical,
             cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
-            agg_push_down=bool(self.sysvars.get("tidb_opt_agg_push_down")))
+            agg_push_down=self._agg_push_down())
         phys = lower(logical)
         # plan-time subqueries execute before the statement-level check
         # and fold into literals, so they must be checked here or a
@@ -355,7 +368,7 @@ class Session:
             n_parts=n_parts,
             session_info={"user": self.user,
                           "conn_id": getattr(self, "conn_id", 0)},
-            agg_push_down=bool(self.sysvars.get("tidb_opt_agg_push_down")),
+            agg_push_down=self._agg_push_down(),
         )
 
     def _apply_binding(self, stmt):
@@ -497,10 +510,12 @@ class Session:
             self._priv_table("insert", stmt.table)
             return self._run_insert(stmt)
         if isinstance(stmt, A.UpdateStmt):
-            self._priv_table("update", stmt.table)
-            return self._run_update(stmt)
+            if stmt.from_ is None:
+                self._priv_table("update", stmt.table)
+            return self._run_update(stmt)  # multi-table checks its target
         if isinstance(stmt, A.DeleteStmt):
-            self._priv_table("delete", stmt.table)
+            if stmt.from_ is None:
+                self._priv_table("delete", stmt.table)
             return self._run_delete(stmt)
         if isinstance(stmt, (A.CreateTableStmt, A.DropTableStmt, A.CreateDatabaseStmt,
                              A.DropDatabaseStmt, A.TruncateStmt, A.CreateIndexStmt,
@@ -1040,7 +1055,49 @@ class Session:
             scan.close()
         return np.concatenate(ids) if ids else np.zeros(0, dtype=np.int64)
 
+    def _multi_table_targets(self, stmt) -> List[A.TableName]:
+        """All base tables in a multi-table DML's table-refs tree."""
+        out = []
+
+        def visit(src):
+            if isinstance(src, A.TableName):
+                out.append(src)
+            elif isinstance(src, A.Join):
+                visit(src.left)
+                visit(src.right)
+
+        visit(stmt.from_)
+        return out
+
+    def _multi_dml_rowids(self, stmt, target: A.TableName,
+                          val_asts=()) -> tuple:
+        """Run the multi-table DML's join as a real SELECT of the
+        target's hidden __rowid__ (+ SET value expressions), dedup by
+        rowid keeping the first match (MySQL: a row matching multiple
+        times is updated once)."""
+        alias = target.alias or target.name
+        items = [A.SelectItem(A.EName("__rowid__", qualifier=alias),
+                              alias="__rid")]
+        for i, v in enumerate(val_asts):
+            items.append(A.SelectItem(v, alias=f"__v{i}"))
+        sel = A.SelectStmt(items=items, from_=stmt.from_, where=stmt.where)
+        rs = self._run_select(sel)
+        seen = set()
+        ids, vals = [], []
+        for row in rs.rows:
+            rid = row[0]
+            # outer joins NULL-pad the target side; those rows have no
+            # target row to touch (MySQL: unmatched rows are untouched)
+            if rid is None or rid in seen:
+                continue
+            seen.add(rid)
+            ids.append(rid)
+            vals.append(row[1:])
+        return np.array(ids, dtype=np.int64), vals
+
     def _run_update(self, stmt: A.UpdateStmt):
+        if stmt.from_ is not None:
+            return self._run_update_multi(stmt)
         table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
 
         def do(txn):
@@ -1061,6 +1118,54 @@ class Session:
                     # expression over current row values: evaluate via scan
                     vals = self._eval_update_expr(table, stmt.table.name, val_ast, ids, col)
                     updates[col.name] = vals
+            table.update_rows(ids, updates, begin_ts=txn.marker,
+                              end_ts=txn.marker, marker=txn.marker,
+                              log=txn.log_for(table))
+
+        return self._run_dml(do)
+
+    def _run_update_multi(self, stmt: A.UpdateStmt):
+        """UPDATE t1 JOIN t2 ... SET t1.c = expr [WHERE ...]: the join
+        runs as a real SELECT of t1's hidden rowid + the SET values
+        (evaluated in full join context — expressions may reference any
+        joined table), then the target applies a plain MVCC update."""
+        refs = self._multi_table_targets(stmt)
+        by_alias = {(t.alias or t.name).lower(): t for t in refs}
+        quals = {q.lower() for q, _ in
+                 ((n.qualifier, n) for n, _ in stmt.sets) if q}
+        if len(quals) > 1:
+            raise UnsupportedError(
+                "multi-table UPDATE touching several target tables")
+        if quals:
+            target = by_alias.get(next(iter(quals)))
+            if target is None:
+                raise PlanError(f"unknown table {next(iter(quals))!r} in SET")
+        else:
+            # unqualified SET columns: the owning table must be unique
+            owners = set()
+            for name_ast, _ in stmt.sets:
+                for t in refs:
+                    tab = self.catalog.table(t.schema or self.db, t.name)
+                    if any(c.name == name_ast.name
+                           for c in tab.schema.columns):
+                        owners.add((t.alias or t.name).lower())
+            if len(owners) != 1:
+                raise PlanError(
+                    "SET columns must name their table in a multi-table "
+                    "UPDATE")
+            target = by_alias[next(iter(owners))]
+        table = self.catalog.table(target.schema or self.db, target.name)
+        self._priv("update", target.schema or self.db, target.name)
+
+        def do(txn):
+            ids, vals = self._multi_dml_rowids(
+                stmt, target, [v for _, v in stmt.sets])
+            if len(ids) == 0:
+                return
+            updates = {}
+            for j, (name_ast, _) in enumerate(stmt.sets):
+                col = table.schema.col(name_ast.name)
+                updates[col.name] = [v[j] for v in vals]
             table.update_rows(ids, updates, begin_ts=txn.marker,
                               end_ts=txn.marker, marker=txn.marker,
                               log=txn.log_for(table))
@@ -1128,6 +1233,32 @@ class Session:
         return out
 
     def _run_delete(self, stmt: A.DeleteStmt):
+        if stmt.from_ is not None:
+            # DELETE t FROM <refs> / DELETE FROM t USING <refs>: rows to
+            # delete come from the join (dedup'd target rowids). The
+            # DELETE target names a table OR its alias in the refs.
+            refs = self._multi_table_targets(stmt)
+            want = (stmt.table.alias or stmt.table.name).lower()
+            target = next(
+                (t for t in refs
+                 if (t.alias or t.name).lower() == want
+                 or t.name.lower() == want), None)
+            if target is None:
+                raise PlanError(
+                    f"DELETE target {stmt.table.name!r} is not in the "
+                    "table references")
+            table = self.catalog.table(target.schema or self.db, target.name)
+            self._priv("delete", target.schema or self.db, target.name)
+
+            def do(txn):
+                ids, _ = self._multi_dml_rowids(stmt, target)
+                if len(ids):
+                    table.delete_rows(ids, end_ts=txn.marker,
+                                      marker=txn.marker,
+                                      log=txn.log_for(table))
+
+            return self._run_dml(do)
+
         table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
 
         def do(txn):

@@ -87,20 +87,19 @@ def release_lock():
 def _missing_count():
     """How many bench configs are still missing/errored in the artifact
     (the progress measure for TPU_CAPTURE_MODE=missing — an error-only
-    patch changes the file's mtime but NOT this count)."""
+    patch changes the file's mtime but NOT this count). The config list
+    itself lives in ONE place: scripts/missing_configs_recapture.py."""
     try:
         extra = json.load(open(BENCH_OUT))["extra"]
     except (OSError, ValueError, KeyError):
         return 99
-    missing = 0
-    for metric, tag in (("tpch_q18_rows_per_sec", "q18"),
-                        ("ssb_q32_rows_per_sec", "ssb"),
-                        ("tpcds_q95_rows_per_sec", "tpcds")):
-        if metric not in extra or f"{tag}_error" in extra:
-            missing += 1
-    if "q18_streamed" not in extra or "q18_streamed_error" in extra:
-        missing += 1
-    return missing
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import missing_configs_recapture as mcr
+
+        return mcr.missing_count(extra)
+    finally:
+        sys.path.pop(0)
 
 
 def probe_once(idx):
