@@ -151,6 +151,49 @@ def test_left_join_right_side_bails(sess):
     assert got == want
 
 
+def test_mesh_fragment_takes_partial(sess):
+    """On a mesh, the eager partial runs INSIDE the fragment as a
+    sharded join input (per-shard group tables; no cross-shard merge —
+    the upper aggregate re-sums), instead of knocking the whole plan
+    off the mesh."""
+    from tidb_tpu.parallel import make_mesh
+    from tidb_tpu.parallel.executor import build_dist_executor
+
+    m = Session(chunk_capacity=1 << 12, mesh=make_mesh())
+    m.execute("create table f (k bigint, x bigint)")
+    m.execute("create table d (k bigint, l bigint)")
+    rng = np.random.default_rng(2)
+    m.catalog.table("test", "f").insert_columns({
+        "k": rng.integers(0, 64, 6000).astype(np.int64),
+        "x": rng.integers(0, 100, 6000).astype(np.int64)})
+    m.catalog.table("test", "d").insert_columns({
+        "k": np.arange(64, dtype=np.int64),
+        "l": (np.arange(64) % 5).astype(np.int64)})
+    m.execute("analyze table f, d")
+    sql = ("select d.l, count(*) as n, sum(f.x) as s from f "
+           "join d on f.k = d.k group by d.l order by d.l")
+    phys = m._plan_select(parse(sql)[0])
+    assert _agg_below_join(phys)
+    root = build_dist_executor(phys, m._shard_cache)
+    names = set()
+    stack = [root]
+    while stack:
+        e = stack.pop()
+        names.add(type(e).__name__)
+        stack.extend(e.children)
+    assert any(n.startswith("Dist") for n in names), names
+    got = m.query(sql)
+    fk = m.catalog.table("test", "f").data["k"][:6000]
+    fx = m.catalog.table("test", "f").data["x"][:6000]
+    import collections
+
+    acc, cnt = collections.Counter(), collections.Counter()
+    for k, x in zip(fk, fx):
+        acc[int(k) % 5] += int(x)
+        cnt[int(k) % 5] += 1
+    assert got == sorted((l, cnt[l], acc[l]) for l in cnt), got
+
+
 def test_left_join_probe_side_pushes(sess):
     """Args from the LEFT (probe) side of a LEFT join push fine: left
     rows are never duplicated by padding."""

@@ -228,8 +228,83 @@ class _Compiler:
                 self.sig.append(f"stages{stages!r}")
                 return emit
             return join_emit
+        if isinstance(base, PHashAgg) and not stages:
+            p = self._try_partial_agg_producer(base)
+            if p is not None:
+                return p
         # anything else (agg subtree, union, limit...) becomes a broadcast
         return self._broadcast_producer(plan)
+
+    def _partial_agg_ok(self, plan) -> bool:
+        """Can `plan` run as a per-shard partial aggregate (a SHARDED
+        join input, not a broadcast)?"""
+        stages, agg = peel_stages(plan)
+        if stages or not isinstance(agg, PHashAgg):
+            return False
+        from tidb_tpu.planner.logical import CORE_AGGS
+
+        if (agg.strategy != "generic" or not agg.group_exprs
+                or any(a.distinct or a.func not in CORE_AGGS
+                       or a.func == "avg" for a in agg.aggs)):
+            return False
+        # ONLY eager-agg partials (rule-derived 'eagg.' uids): per-shard
+        # emission is sound because THAT rule's upper aggregate re-sums
+        # partial rows; a user-written derived-table aggregate has plain
+        # uids and must broadcast (shard-local groups would duplicate)
+        if not all(a.uid.startswith("eagg.") for a in agg.aggs):
+            return False
+        _, base = peel_stages(agg.child)
+        return isinstance(base, PScan) and base.table is not None
+
+    def _try_partial_agg_producer(self, agg: PHashAgg):
+        """A partial aggregate as a JOIN INPUT (the device side of eager
+        aggregation): each shard reduces its local rows into a group
+        table and emits the groups as ordinary rows. No cross-shard
+        merge is needed — the rewrite's upper aggregate re-sums partial
+        rows, so shard-local groups with duplicate keys are exactly what
+        the row-level semantics produced. Returns None for shapes the
+        kernel can't take (falls back to the broadcast producer).
+
+        DECIMAL sums recombine their two limbs on device (hi*2^32+lo):
+        exact while a per-shard per-group partial stays inside int64 —
+        the same representability bound as the final DECIMAL result."""
+        from tidb_tpu.executor.agg_device import make_partial_kernel
+
+        if not self._partial_agg_ok(agg):
+            return None
+        child_emit = self.producer(agg.child)
+        partial = make_partial_kernel(agg.group_exprs, agg.aggs)
+        types = {c.uid: c.type_ for c in agg.schema}
+        self.sig.append(
+            f"eagg:{agg.group_exprs!r}:{agg.aggs!r}:{agg.group_uids!r}")
+
+        def emit(env, growths):
+            chunk, ovfs = child_emit(env, growths)
+            t = partial(chunk)
+            live = jnp.arange(chunk.capacity) < t["n"]
+            cols = {}
+            for i, uid in enumerate(agg.group_uids):
+                cols[uid] = Column(data=t[f"k{i}.d"],
+                                   valid=t[f"k{i}.v"] & live,
+                                   type_=types[uid])
+            for j, a in enumerate(agg.aggs):
+                cnt = t[f"a{j}.cnt"]
+                if a.func == "count":
+                    data, valid = cnt, live
+                elif a.func == "sum":
+                    data = t[f"a{j}.sum"]
+                    if f"a{j}.sumhi" in t:
+                        data = data + (t[f"a{j}.sumhi"] << 32)
+                    valid = live & (cnt > 0)
+                else:  # min / max
+                    data = t[f"a{j}.{a.func}"]
+                    valid = live & (cnt > 0)
+                cols[a.uid] = Column(
+                    data=data.astype(types[a.uid].np_dtype),
+                    valid=valid, type_=types[a.uid])
+            return Chunk(cols, live), ovfs
+
+        return emit
 
     def _scan_producer(self, scan: PScan, stages) -> Callable:
         if any(c.name == "__rowid__" for c in scan.schema):
@@ -288,8 +363,15 @@ class _Compiler:
         # skips both exchanges
         def _is_bcast(plan) -> bool:
             _, base = peel_stages(plan)
-            return not (isinstance(base, PScan) and base.table is not None
-                        ) and not isinstance(base, PHashJoin)
+            if isinstance(base, PScan) and base.table is not None:
+                return False
+            if isinstance(base, PHashJoin):
+                return False
+            if self._partial_agg_ok(plan):
+                # eager-agg partial over a sharded scan: each shard emits
+                # its local groups exactly once — sharded, not replicated
+                return False
+            return True
 
         build_is_bcast = _is_bcast(build_plan)
         if _is_bcast(probe_plan):

@@ -25,6 +25,33 @@ from tidb_tpu.types import TypeKind, parse_type_name
 __all__ = ["Session", "TxnState"]
 
 
+def _has_eager_partial(phys) -> bool:
+    """Does this physical plan contain an eager-agg partial (a HashAgg
+    whose outputs carry the rule's derived 'eagg' uids)?"""
+    from tidb_tpu.planner.physical import PHashAgg
+
+    stack = [phys]
+    while stack:
+        p = stack.pop()
+        if isinstance(p, PHashAgg) and any(
+                a.uid.startswith("eagg.") for a in p.aggs):
+            return True
+        stack.extend(p.children)
+    return False
+
+
+def _dist_engaged(root) -> bool:
+    """Did the dist builder actually place mesh executors (vs a silent
+    full host fallback)?"""
+    stack = [root]
+    while stack:
+        e = stack.pop()
+        if type(e).__name__.startswith("Dist"):
+            return True
+        stack.extend(e.children)
+    return False
+
+
 @dataclasses.dataclass
 class TxnState:
     """An open transaction (ref: session txn lifecycle over the Percolator
@@ -328,17 +355,12 @@ class Session:
         )
 
     def _agg_push_down(self) -> bool:
-        """Effective eager-aggregation switch: the sysvar, minus
-        device-engine sessions (the fragment tier can't shard a
-        partial-agg join side yet — losing fragmentation costs far more
-        than eager agg saves; DistAggExec-as-join-input lifts this)."""
-        if not self.sysvars.get("tidb_opt_agg_push_down"):
-            return False
-        if self._shard_cache is not None and \
-                self.sysvars.get("tidb_enable_tpu_exec") and \
-                self._device_engine_auto():
-            return False
-        return True
+        """Effective eager-aggregation switch. Device-engine sessions
+        also push: the fragment tier runs scan-rooted generic partials
+        per shard (no cross-shard merge needed — the upper aggregate
+        re-sums); shapes it can't take re-plan without the rewrite in
+        _run_select rather than falling off the mesh."""
+        return bool(self.sysvars.get("tidb_opt_agg_push_down"))
 
     def _execute_subplan(self, logical) -> List[tuple]:
         """Planner callback: run a bound logical subplan to completion."""
@@ -358,7 +380,18 @@ class Session:
         rs = run_plan(root, self._exec_ctx(plan=phys), n_visible=n_vis)
         return rs.rows
 
-    def _plan_select(self, stmt):
+    def _dist_expected(self) -> bool:
+        """Would this session route eligible plans to the mesh tier?
+        Mirrors _build_root's full routing: an executor plugin takes
+        over BEFORE the dist branch, so plugin sessions never expect
+        Dist executors (and must not re-plan away eager aggregation)."""
+        if str(self.sysvars.get("tidb_executor_plugin")):
+            return False
+        return (self.txn is None and self._shard_cache is not None
+                and bool(self.sysvars.get("tidb_enable_tpu_exec"))
+                and self._device_engine_auto())
+
+    def _plan_select(self, stmt, agg_push_down=None):
         n_parts = 1
         if self.mesh is not None:
             n_parts = int(np.prod(list(self.mesh.shape.values())))
@@ -368,7 +401,8 @@ class Session:
             n_parts=n_parts,
             session_info={"user": self.user,
                           "conn_id": getattr(self, "conn_id", 0)},
-            agg_push_down=self._agg_push_down(),
+            agg_push_down=(self._agg_push_down() if agg_push_down is None
+                           else agg_push_down),
         )
 
     def _apply_binding(self, stmt):
@@ -404,6 +438,14 @@ class Session:
         phys = self._plan_select(stmt)
         self._check_plan_privs(phys)
         root = self._build_root(phys)
+        if self._dist_expected() and _has_eager_partial(phys) \
+                and not _dist_engaged(root):
+            # the eager-agg shape kept this plan off the mesh (the
+            # fragment tier takes scan-rooted generic partials, not every
+            # shape) — losing fragmentation costs more than the rewrite
+            # saves, so re-plan without it and keep the fragments
+            phys = self._plan_select(stmt, agg_push_down=False)
+            root = self._build_root(phys)
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
         if n_vis is None and hasattr(phys, "children") and phys.children:
             # Sort/Limit on top of the projection keep hidden sort columns
