@@ -1,0 +1,133 @@
+"""LOAD DATA INFILE: streamed CSV ingest (ref: executor/load_data).
+MySQL semantics: TAB-separated default, FIELDS TERMINATED/ENCLOSED BY,
+IGNORE n LINES, column subsets, \\N and empty-field NULLs; gated on
+INSERT + SUPER (the FILE-privilege analogue)."""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture
+def s(tmp_path):
+    sess = Session()
+    sess.execute("create table t (a bigint, s varchar(20), d double)")
+    sess._tmp = tmp_path
+    return sess
+
+
+def test_basic_tab_separated(s):
+    p = s._tmp / "t.tsv"
+    p.write_text("1\thello\t1.5\n2\tworld\t2.5\n")
+    rs = s.execute(f"load data infile '{p}' into table t")
+    assert rs.rows == [(2,)]
+    assert s.query("select a, s, d from t order by a") == [
+        (1, "hello", 1.5), (2, "world", 2.5)]
+
+
+def test_csv_options_and_nulls(s):
+    p = s._tmp / "t.csv"
+    p.write_text('id,name,val\n1,"a,b",\\N\n2,,3.5\n\\N,x,\n')
+    rs = s.execute(
+        f"load data infile '{p}' into table t "
+        f"fields terminated by ',' optionally enclosed by '\"' "
+        f"lines terminated by '\\n' ignore 1 lines")
+    assert rs.rows == [(3,)]
+    got = s.query("select a, s, d from t order by a")
+    # \N -> NULL everywhere; '' -> NULL for numerics, '' for strings
+    assert got == [(None, "x", None), (1, "a,b", None), (2, "", 3.5)]
+
+
+def test_column_subset_and_defaults(s):
+    s.execute("create table u (id bigint auto_increment, v bigint, "
+              "tag varchar(8) default 'none')")
+    p = s._tmp / "u.tsv"
+    p.write_text("10\n20\n30\n")
+    s.execute(f"load data infile '{p}' into table u (v)")
+    assert s.query("select id, v, tag from u order by id") == [
+        (1, 10, "none"), (2, 20, "none"), (3, 30, "none")]
+
+
+def test_unique_violation_rolls_back(s):
+    s.execute("create table pkt (k bigint primary key)")
+    p = s._tmp / "pk.tsv"
+    p.write_text("1\n2\n1\n")
+    with pytest.raises(Exception):
+        s.execute(f"load data infile '{p}' into table pkt")
+    # implicit txn rolled back: nothing half-loaded
+    assert s.query("select count(*) from pkt") == [(0,)]
+
+
+def test_delta_engine_target(s):
+    s.execute("create table ev (a bigint, s varchar(12)) engine=delta")
+    p = s._tmp / "ev.tsv"
+    p.write_text("".join(f"{i}\ttag{i}\n" for i in range(500)))
+    rs = s.execute(f"load data infile '{p}' into table ev")
+    assert rs.rows == [(500,)]
+    assert s.query("select count(*), min(s) from ev") == [(500, "tag0")]
+
+
+def test_mysql_escape_semantics(s):
+    """mysqldump-format escapes: \\t inside a field survives the split,
+    \\\\ collapses, quoted 'N' is data while bare \\N is NULL."""
+    p = s._tmp / "esc.tsv"
+    p.write_text("1\ta\\tb\t1.0\n2\tc\\\\d\t2.0\n3\t\\N\t3.0\n")
+    s.execute(f"load data infile '{p}' into table t")
+    assert s.query("select a, s from t order by a") == [
+        (1, "a\tb"), (2, "c\\d"), (3, None)]
+
+
+def test_quoted_N_is_data(s):
+    p = s._tmp / "qn.csv"
+    p.write_text('1,"N",1.0\n2,\\N,2.0\n')
+    s.execute(f"load data infile '{p}' into table t "
+              f"fields terminated by ',' enclosed by '\"'")
+    assert s.query("select a, s from t order by a") == [
+        (1, "N"), (2, None)]
+
+
+def test_multichar_delim_refused(s):
+    from tidb_tpu.errors import UnsupportedError
+
+    p = s._tmp / "x.tsv"
+    p.write_text("1||y||2.0\n")
+    with pytest.raises(UnsupportedError):
+        s.execute(f"load data infile '{p}' into table t "
+                  f"fields terminated by '||'")
+
+
+def test_bool_zero_loads_false(s):
+    s.execute("create table bt (b boolean, x bigint)")
+    p = s._tmp / "b.tsv"
+    p.write_text("0\t1\n1\t2\nfalse\t3\ntrue\t4\n")
+    s.execute(f"load data infile '{p}' into table bt")
+    assert s.query("select b, x from bt order by x") == [
+        (False, 1), (True, 2), (False, 3), (True, 4)]
+
+
+def test_local_needs_only_insert(s):
+    p = s._tmp / "l.tsv"
+    p.write_text("7\tz\t1.0\n")
+    s.execute("create user 'carl'")
+    s.execute("grant insert on *.* to 'carl'")
+    s.user = "carl"
+    try:
+        rs = s.execute(f"load data local infile '{p}' into table t")
+        assert rs.rows == [(1,)]
+    finally:
+        s.user = "root"
+
+
+def test_requires_privileges(s):
+    p = s._tmp / "x.tsv"
+    p.write_text("1\ty\t2.0\n")
+    s.execute("create user 'bob'")
+    s.execute("grant insert on *.* to 'bob'")  # but not SUPER
+    s.user = "bob"
+    from tidb_tpu.errors import PrivilegeError
+
+    try:
+        with pytest.raises(PrivilegeError):
+            s.execute(f"load data infile '{p}' into table t")
+    finally:
+        s.user = "root"

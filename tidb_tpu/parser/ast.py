@@ -24,7 +24,7 @@ __all__ = [
     "InsertStmt", "UpdateStmt", "DeleteStmt", "ColumnDef", "CreateTableStmt",
     "DropTableStmt", "CreateIndexStmt", "DropIndexStmt", "AlterTableStmt",
     "ExplainStmt", "TraceStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
-    "RollbackStmt", "UseStmt", "TruncateStmt", "AnalyzeStmt",
+    "RollbackStmt", "UseStmt", "TruncateStmt", "LoadDataStmt", "AnalyzeStmt",
     "CreateDatabaseStmt", "DropDatabaseStmt",
     "CreateUserStmt", "DropUserStmt", "GrantStmt", "RevokeStmt",
     "InstallPluginStmt", "UninstallPluginStmt",
@@ -395,6 +395,17 @@ class RollbackStmt:
 @dataclass
 class UseStmt:
     db: str
+
+@dataclass
+class LoadDataStmt:
+    path: str
+    table: TableName
+    columns: Optional[List[str]] = None
+    fields_term: str = "\t"      # MySQL LOAD DATA defaults
+    enclosed: Optional[str] = None
+    lines_term: str = "\n"
+    ignore_lines: int = 0
+    local: bool = False
 
 @dataclass
 class TruncateStmt:

@@ -122,6 +122,8 @@ class Parser:
         if self.at_op("("):  # parenthesized SELECT statement
             return self.parse_select_or_union()
         t = self.peek()
+        if t.kind == "IDENT" and t.text.lower() == "load":
+            return self.parse_load_data()
         if t.kind != "KW":
             raise self.error("expected statement keyword")
         kw = t.text
@@ -450,6 +452,72 @@ class Parser:
         if self.accept_op("."):
             schema, name = name, self.expect_ident()
         return TableName(name, schema=schema)
+
+    def _accept_word(self, word: str) -> bool:
+        """Accept an IDENT-or-keyword token by lowercase text (LOAD DATA
+        options like FIELDS/LINES/TERMINATED aren't reserved words)."""
+        t = self.peek()
+        if t.kind in ("IDENT", "KW") and t.text.lower() == word:
+            self.next()
+            return True
+        return False
+
+    def _expect_word(self, word: str):
+        if not self._accept_word(word):
+            raise self.error(f"expected {word.upper()}")
+
+    def parse_load_data(self) -> LoadDataStmt:
+        self._expect_word("load")
+        self._expect_word("data")
+        local = self._accept_word("local")
+        self._expect_word("infile")
+        if self.peek().kind != "STR":
+            raise self.error("expected a quoted file path after INFILE")
+        path = self.next().text
+        self.expect_kw("into")
+        self.expect_kw("table")
+        table = self._table_name()
+        stmt = LoadDataStmt(path, table, local=local)
+        if self._accept_word("fields") or self._accept_word("columns"):
+            while True:
+                if self._accept_word("terminated"):
+                    self.expect_kw("by")
+                    stmt.fields_term = self.next().text
+                elif self._accept_word("optionally"):
+                    self._expect_word("enclosed")
+                    self.expect_kw("by")
+                    stmt.enclosed = self.next().text
+                elif self._accept_word("enclosed"):
+                    self.expect_kw("by")
+                    stmt.enclosed = self.next().text
+                elif self._accept_word("escaped"):
+                    self.expect_kw("by")
+                    self.next()  # accepted, backslash semantics built in
+                else:
+                    break
+        if self._accept_word("lines"):
+            while True:
+                if self._accept_word("terminated"):
+                    self.expect_kw("by")
+                    stmt.lines_term = self.next().text
+                elif self._accept_word("starting"):
+                    self.expect_kw("by")
+                    self.next()
+                else:
+                    break
+        if self._accept_word("ignore"):
+            if self.peek().kind != "NUM":
+                raise self.error("expected a line count after IGNORE")
+            stmt.ignore_lines = int(self.next().text)
+            if not (self._accept_word("lines") or self._accept_word("rows")):
+                raise self.error("expected LINES/ROWS")
+        if self.accept_op("("):
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+            stmt.columns = cols
+        return stmt
 
     def parse_update(self) -> UpdateStmt:
         self.expect_kw("update")

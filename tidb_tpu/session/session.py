@@ -25,6 +25,64 @@ from tidb_tpu.types import TypeKind, parse_type_name
 __all__ = ["Session", "TxnState"]
 
 
+_LOAD_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", "0": "\0", "b": "\b",
+                 "Z": "\x1a"}
+
+
+def _split_load_fields(line: str, delim: str, quote):
+    """Split one LOAD DATA line into fields with MySQL semantics the csv
+    module cannot express: backslash escapes delimiters/specials
+    (\\t \\n \\\\, an escaped delimiter stays inside the field), the NULL
+    sentinel is the two-character sequence \\N standing ALONE unquoted
+    (a quoted "N" or literal N is data), and an optional enclosure char
+    with doubled- or backslash-escaped quotes. Returns a list of
+    str-or-None."""
+    out = []
+    i, n = 0, len(line)
+    while True:
+        buf = []
+        is_null = False
+        if quote and i < n and line[i] == quote:
+            i += 1
+            while i < n:
+                c = line[i]
+                if c == "\\" and i + 1 < n:
+                    nxt = line[i + 1]
+                    buf.append(_LOAD_ESCAPES.get(nxt, nxt))
+                    i += 2
+                    continue
+                if c == quote:
+                    if i + 1 < n and line[i + 1] == quote:  # doubled
+                        buf.append(quote)
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                buf.append(c)
+                i += 1
+        else:
+            start = i
+            while i < n and line[i] != delim:
+                c = line[i]
+                if c == "\\" and i + 1 < n:
+                    nxt = line[i + 1]
+                    if (nxt == "N" and i == start
+                            and (i + 2 == n or line[i + 2] == delim)):
+                        is_null = True
+                        i += 2
+                        continue
+                    buf.append(_LOAD_ESCAPES.get(nxt, nxt))
+                    i += 2
+                    continue
+                buf.append(c)
+                i += 1
+        out.append(None if is_null else "".join(buf))
+        if i >= n:
+            break
+        i += 1  # consume the delimiter
+    return out
+
+
 def _has_eager_partial(phys) -> bool:
     """Does this physical plan contain an eager-agg partial (a HashAgg
     whose outputs carry the rule's derived 'eagg' uids)?"""
@@ -598,6 +656,8 @@ class Session:
         if isinstance(stmt, A.TruncateStmt):
             self.catalog.table(stmt.table.schema or self.db, stmt.table.name).truncate()
             return None
+        if isinstance(stmt, A.LoadDataStmt):
+            return self._run_load_data(stmt)
         if isinstance(stmt, A.UseStmt):
             self.catalog.database(stmt.db)  # raises if missing
             self.db = stmt.db
@@ -1136,6 +1196,73 @@ class Session:
             ids.append(rid)
             vals.append(row[1:])
         return np.array(ids, dtype=np.int64), vals
+
+    def _run_load_data(self, stmt: A.LoadDataStmt):
+        """LOAD DATA INFILE: streamed ingest in txn'd batches (ref:
+        executor/load_data). Server-side reads gate on SUPER — the FILE
+        privilege analogue; LOCAL (the caller supplies its own file, as
+        in MySQL) needs only INSERT. MySQL field semantics via
+        _split_load_fields: backslash escapes (\\t \\n \\\\ and escaped
+        delimiters), the \\N NULL sentinel, optional enclosure with
+        doubled or escaped quotes; empty fields are NULL for non-string
+        columns and '' for strings; IGNORE n LINES skips headers."""
+        db = stmt.table.schema or self.db
+        self._priv("insert", db, stmt.table.name)
+        if not stmt.local:
+            self._priv("super")  # server-side file access (FILE analogue)
+        table = self.catalog.table(db, stmt.table.name)
+        if stmt.lines_term not in ("\n", "\r\n"):
+            raise UnsupportedError("LINES TERMINATED BY must be \\n or \\r\\n")
+        if len(stmt.fields_term) != 1 or (
+                stmt.enclosed is not None and len(stmt.enclosed) != 1):
+            raise UnsupportedError(
+                "FIELDS TERMINATED/ENCLOSED BY must be one character")
+        names = stmt.columns or table.schema.names()
+        cols = [table.schema.col(n) for n in names]
+        str_col = [c.type_.kind in (TypeKind.STRING, TypeKind.JSON)
+                   for c in cols]
+        bool_col = [c.type_.kind == TypeKind.BOOL for c in cols]
+
+        def convert(row):
+            out = []
+            for j in range(len(cols)):
+                raw = row[j] if j < len(row) else None
+                if raw is None or (raw == "" and not str_col[j]):
+                    out.append(None)
+                elif bool_col[j]:
+                    # raw text reaches to_device_value, whose bool(v)
+                    # would make the STRING "0" truthy
+                    out.append(raw.strip().lower() not in ("0", "false", ""))
+                else:
+                    out.append(raw)
+            return out
+
+        total = [0]
+
+        def do(txn):
+            with open(stmt.path, newline="") as f:
+                for _ in range(stmt.ignore_lines):
+                    f.readline()
+                batch = []
+                for line in f:
+                    line = line.rstrip("\r\n")
+                    if not line:
+                        continue
+                    batch.append(convert(_split_load_fields(
+                        line, stmt.fields_term, stmt.enclosed)))
+                    if len(batch) >= 4096:
+                        total[0] += table.insert_rows(
+                            batch, columns=names, begin_ts=txn.marker,
+                            log=txn.log_for(table))
+                        batch = []
+                if batch:
+                    total[0] += table.insert_rows(
+                        batch, columns=names, begin_ts=txn.marker,
+                        log=txn.log_for(table))
+
+        self._run_dml(do)
+        return ResultSet(names=["rows"], rows=[(total[0],)],
+                         types=[TypeKind.INT])
 
     def _run_update(self, stmt: A.UpdateStmt):
         if stmt.from_ is not None:
