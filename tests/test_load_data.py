@@ -118,6 +118,73 @@ def test_local_needs_only_insert(s):
         s.user = "root"
 
 
+def test_blank_line_is_empty_field_row(s):
+    s.execute("create table one (v varchar(8))")
+    p = s._tmp / "one.tsv"
+    p.write_text("a\n\nb\n")
+    rs = s.execute(f"load data infile '{p}' into table one")
+    assert rs.rows == [(3,)]  # the blank line IS a row ('')
+    assert s.query("select v from one order by v") == [("",), ("a",), ("b",)]
+
+
+def test_escape_table_delimiter_roundtrip(s):
+    """Delimiter chars that collide with escape keys (t, n, 0...) must
+    still round-trip: an escaped delimiter is the delimiter."""
+    s.execute("create table zt (v varchar(8), w bigint)")
+    s.execute("insert into zt values ('a0b', 1), ('plain', 2)")
+    p = s._tmp / "z.txt"
+    s.execute(f"select v, w from zt into outfile '{p}' "
+              f"fields terminated by '0'")
+    s.execute("create table zt2 (v varchar(8), w bigint)")
+    s.execute(f"load data infile '{p}' into table zt2 "
+              f"fields terminated by '0'")
+    assert s.query("select v, w from zt2 order by w") == [
+        ("a0b", 1), ("plain", 2)]
+
+
+def test_nested_into_outfile_refused(s):
+    from tidb_tpu.errors import UnsupportedError
+
+    p = s._tmp / "n.tsv"
+    with pytest.raises(UnsupportedError):
+        s.execute(f"select a from t union select a from t "
+                  f"into outfile '{p}'")
+    assert not p.exists()
+
+
+def test_into_outfile_roundtrip(s):
+    """SELECT ... INTO OUTFILE writes the format LOAD DATA reads: every
+    value — NULLs, embedded delimiters/newlines/backslashes — survives
+    the round trip."""
+    s.execute("insert into t values (1, 'plain', 1.5), (2, NULL, NULL), "
+              "(3, 'has\ttab', 2.5)")
+    s.execute("insert into t values (4, 'back\\\\slash', 3.5)")
+    p = s._tmp / "out.tsv"
+    rs = s.execute(f"select a, s, d from t into outfile '{p}'")
+    assert rs.rows == [(4,)]
+    s.execute("create table t2 (a bigint, s varchar(20), d double)")
+    s.execute(f"load data infile '{p}' into table t2")
+    assert s.query("select a, s, d from t2 order by a") == \
+        s.query("select a, s, d from t order by a")
+    # refuses to overwrite
+    from tidb_tpu.errors import ExecutionError
+
+    with pytest.raises(ExecutionError):
+        s.execute(f"select a from t into outfile '{p}'")
+
+
+def test_into_outfile_csv_quoted(s):
+    s.execute("insert into t values (1, 'a,b', 1.0), (2, 'say \"hi\"', 2.0)")
+    p = s._tmp / "out.csv"
+    s.execute(f"select a, s from t into outfile '{p}' "
+              f"fields terminated by ',' enclosed by '\"'")
+    s.execute("create table t3 (a bigint, s varchar(20))")
+    s.execute(f"load data infile '{p}' into table t3 "
+              f"fields terminated by ',' enclosed by '\"'")
+    assert s.query("select a, s from t3 order by a") == [
+        (1, "a,b"), (2, 'say "hi"')]
+
+
 def test_requires_privileges(s):
     p = s._tmp / "x.tsv"
     p.write_text("1\ty\t2.0\n")

@@ -24,7 +24,8 @@ __all__ = [
     "InsertStmt", "UpdateStmt", "DeleteStmt", "ColumnDef", "CreateTableStmt",
     "DropTableStmt", "CreateIndexStmt", "DropIndexStmt", "AlterTableStmt",
     "ExplainStmt", "TraceStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
-    "RollbackStmt", "UseStmt", "TruncateStmt", "LoadDataStmt", "AnalyzeStmt",
+    "RollbackStmt", "UseStmt", "TruncateStmt", "LoadDataStmt", "IntoOutfile",
+    "AnalyzeStmt",
     "CreateDatabaseStmt", "DropDatabaseStmt",
     "CreateUserStmt", "DropUserStmt", "GrantStmt", "RevokeStmt",
     "InstallPluginStmt", "UninstallPluginStmt",
@@ -230,6 +231,7 @@ class SelectStmt:
     ctes: List[CTE] = field(default_factory=list)
     hints: List[Tuple[str, List[str]]] = field(default_factory=list)
     # (HINT_NAME_lower, [args]) from /*+ ... */ after SELECT
+    into_outfile: Optional["IntoOutfile"] = None  # SELECT ... INTO OUTFILE
 
 @dataclass
 class UnionStmt:
@@ -395,6 +397,13 @@ class RollbackStmt:
 @dataclass
 class UseStmt:
     db: str
+
+@dataclass
+class IntoOutfile:
+    path: str
+    fields_term: str = "\t"
+    enclosed: Optional[str] = None
+    lines_term: str = "\n"
 
 @dataclass
 class LoadDataStmt:

@@ -261,7 +261,41 @@ class Parser:
             stmt.order_by = self.parse_order_items()
         if self.accept_kw("limit"):
             stmt.limit, stmt.offset = self.parse_limit_clause()
+        if self.accept_kw("into"):
+            # SELECT ... INTO OUTFILE 'path' [FIELDS ...] [LINES ...]
+            self._expect_word("outfile")
+            if self.peek().kind != "STR":
+                raise self.error("expected a quoted file path after OUTFILE")
+            into = IntoOutfile(self.next().text)
+            self._parse_field_options(into)
+            if self._accept_word("lines"):
+                self._expect_word("terminated")
+                self.expect_kw("by")
+                into.lines_term = self.next().text
+            stmt.into_outfile = into
         return stmt
+
+    def _parse_field_options(self, target) -> None:
+        """FIELDS TERMINATED / [OPTIONALLY] ENCLOSED / ESCAPED BY —
+        shared by LOAD DATA and SELECT ... INTO OUTFILE."""
+        if not (self._accept_word("fields") or self._accept_word("columns")):
+            return
+        while True:
+            if self._accept_word("terminated"):
+                self.expect_kw("by")
+                target.fields_term = self.next().text
+            elif self._accept_word("optionally"):
+                self._expect_word("enclosed")
+                self.expect_kw("by")
+                target.enclosed = self.next().text
+            elif self._accept_word("enclosed"):
+                self.expect_kw("by")
+                target.enclosed = self.next().text
+            elif self._accept_word("escaped"):
+                self.expect_kw("by")
+                self.next()  # accepted; backslash semantics built in
+            else:
+                break
 
     def parse_select_item(self) -> SelectItem:
         if self.at_op("*"):
@@ -478,23 +512,7 @@ class Parser:
         self.expect_kw("table")
         table = self._table_name()
         stmt = LoadDataStmt(path, table, local=local)
-        if self._accept_word("fields") or self._accept_word("columns"):
-            while True:
-                if self._accept_word("terminated"):
-                    self.expect_kw("by")
-                    stmt.fields_term = self.next().text
-                elif self._accept_word("optionally"):
-                    self._expect_word("enclosed")
-                    self.expect_kw("by")
-                    stmt.enclosed = self.next().text
-                elif self._accept_word("enclosed"):
-                    self.expect_kw("by")
-                    stmt.enclosed = self.next().text
-                elif self._accept_word("escaped"):
-                    self.expect_kw("by")
-                    self.next()  # accepted, backslash semantics built in
-                else:
-                    break
+        self._parse_field_options(stmt)
         if self._accept_word("lines"):
             while True:
                 if self._accept_word("terminated"):

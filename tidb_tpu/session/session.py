@@ -71,7 +71,10 @@ def _split_load_fields(line: str, delim: str, quote):
                         is_null = True
                         i += 2
                         continue
-                    buf.append(_LOAD_ESCAPES.get(nxt, nxt))
+                    # an escaped delimiter is the delimiter, even when
+                    # the delimiter char is also an escape-table key
+                    buf.append(delim if nxt == delim
+                               else _LOAD_ESCAPES.get(nxt, nxt))
                     i += 2
                     continue
                 buf.append(c)
@@ -81,6 +84,33 @@ def _split_load_fields(line: str, delim: str, quote):
             break
         i += 1  # consume the delimiter
     return out
+
+
+def _nested_into_outfile(node, top) -> bool:
+    """INTO OUTFILE anywhere except the top-level SelectStmt (inside a
+    UNION arm, derived table, or subquery) is a silent-no-op hazard —
+    detect it so execute() can refuse loudly (MySQL errors likewise)."""
+    import dataclasses as _dc
+
+    stack = [node]
+    seen = set()
+    while stack:
+        e = stack.pop()
+        if id(e) in seen or not _dc.is_dataclass(e):
+            continue
+        seen.add(id(e))
+        if isinstance(e, A.SelectStmt) and e is not top \
+                and e.into_outfile is not None:
+            return True
+        for f in _dc.fields(e):
+            v = getattr(e, f.name)
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for item in vs:
+                if isinstance(item, tuple):
+                    stack.extend(item)
+                else:
+                    stack.append(item)
+    return False
 
 
 def _has_eager_partial(phys) -> bool:
@@ -589,7 +619,16 @@ class Session:
         if not isinstance(stmt, A.SetStmt) and _ast_contains(stmt, A.EVar):
             stmt = self._sub_vars(stmt)
         if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
-            return self._run_select(self._apply_binding(stmt))
+            into = getattr(stmt, "into_outfile", None)
+            if _nested_into_outfile(stmt, top=stmt):
+                raise UnsupportedError(
+                    "INTO OUTFILE is only supported on a top-level SELECT")
+            if into is not None:
+                self._precheck_outfile(into)  # fail BEFORE the query runs
+            rs = self._run_select(self._apply_binding(stmt))
+            if into is not None:
+                return self._write_outfile(rs, into)
+            return rs
         if isinstance(stmt, A.CreateBindingStmt):
             from tidb_tpu.bindinfo import normalize_sql
 
@@ -1197,6 +1236,45 @@ class Session:
             vals.append(row[1:])
         return np.array(ids, dtype=np.int64), vals
 
+    def _precheck_outfile(self, into) -> None:
+        """OUTFILE refusals run BEFORE the query: a non-SUPER user or a
+        pre-existing target must not pay for the whole scan first."""
+        import os
+
+        self._priv("super")  # server-side file write (FILE analogue)
+        if len(into.fields_term) != 1 or (
+                into.enclosed is not None and len(into.enclosed) != 1):
+            raise UnsupportedError(
+                "FIELDS TERMINATED/ENCLOSED BY must be one character")
+        if os.path.exists(into.path):
+            raise ExecutionError(f"file {into.path!r} already exists")
+
+    def _write_outfile(self, rs: ResultSet, into) -> ResultSet:
+        """SELECT ... INTO OUTFILE: the LOAD DATA-compatible export pair
+        (round-trips through _split_load_fields). mode='x' keeps the
+        no-overwrite guarantee atomic under concurrent exporters."""
+        delim, quote = into.fields_term, into.enclosed
+
+        def field_text(v):
+            if v is None:
+                return "\\N"
+            # control chars escape FIRST (line framing is \n; a tab
+            # delim is covered by the \t mapping), then the delimiter
+            s = (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+                 .replace("\t", "\\t").replace("\r", "\\r"))
+            if quote:
+                return quote + s.replace(quote, quote + quote) + quote
+            if delim not in ("\t", "\n", "\r"):
+                s = s.replace(delim, "\\" + delim)
+            return s
+
+        with open(into.path, "x", newline="") as f:
+            for row in rs.rows:
+                f.write(delim.join(field_text(v) for v in row))
+                f.write(into.lines_term)
+        return ResultSet(names=["rows"], rows=[(len(rs.rows),)],
+                         types=[TypeKind.INT])
+
     def _run_load_data(self, stmt: A.LoadDataStmt):
         """LOAD DATA INFILE: streamed ingest in txn'd batches (ref:
         executor/load_data). Server-side reads gate on SUPER — the FILE
@@ -1238,27 +1316,35 @@ class Session:
             return out
 
         total = [0]
+        resume_pos = [None]  # retry resumes AFTER already-staged batches
 
         def do(txn):
             with open(stmt.path, newline="") as f:
-                for _ in range(stmt.ignore_lines):
-                    f.readline()
+                if resume_pos[0] is not None:
+                    # a WriteConflict retry re-enters with the earlier
+                    # batches already provisionally inserted under this
+                    # txn marker (a failing insert leaves the table
+                    # untouched) — continue from the saved offset
+                    f.seek(resume_pos[0])
+                else:
+                    for _ in range(stmt.ignore_lines):
+                        f.readline()
                 batch = []
                 for line in f:
                     line = line.rstrip("\r\n")
-                    if not line:
-                        continue
                     batch.append(convert(_split_load_fields(
                         line, stmt.fields_term, stmt.enclosed)))
                     if len(batch) >= 4096:
                         total[0] += table.insert_rows(
                             batch, columns=names, begin_ts=txn.marker,
                             log=txn.log_for(table))
+                        resume_pos[0] = f.tell()
                         batch = []
                 if batch:
                     total[0] += table.insert_rows(
                         batch, columns=names, begin_ts=txn.marker,
                         log=txn.log_for(table))
+                    resume_pos[0] = f.tell()
 
         self._run_dml(do)
         return ResultSet(names=["rows"], rows=[(total[0],)],
