@@ -1,0 +1,159 @@
+"""Explicit ROWS window frames (ref: executor/window.go frame clauses):
+ROWS BETWEEN [n PRECEDING | CURRENT ROW | n FOLLOWING | UNBOUNDED ...]
+for SUM/COUNT/AVG (prefix-sum differences), MIN/MAX (sliding extremes /
+prefix-suffix accumulates), FIRST/LAST_VALUE (frame-edge gathers).
+RANGE frames with value offsets refuse at parse; frames on ranking
+functions are ignored (MySQL)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = Session()
+    sess.execute("create table w (g bigint, i bigint, v bigint)")
+    rng = np.random.default_rng(9)
+    rows = []
+    for g in range(3):
+        for i in range(50):
+            rows.append((g, i, int(rng.integers(-20, 20))))
+    sess.execute("insert into w values " +
+                 ", ".join(f"({g},{i},{v})" for g, i, v in rows))
+    sess._rows = rows
+    return sess
+
+
+def _by_g(s):
+    out = {}
+    for g, i, v in s._rows:
+        out.setdefault(g, []).append(v)
+    return out
+
+
+def _frame(vs, i, lo, hi):
+    a = 0 if lo is None else max(i + lo, 0)
+    b = len(vs) - 1 if hi is None else min(i + hi, len(vs) - 1)
+    return vs[a: b + 1] if a <= b else []
+
+
+@pytest.mark.parametrize("spec,lo,hi", [
+    ("rows between 2 preceding and 2 following", -2, 2),
+    ("rows between 4 preceding and 1 preceding", -4, -1),
+    ("rows between current row and 3 following", 0, 3),
+    ("rows between unbounded preceding and 1 following", None, 1),
+    ("rows between 1 preceding and unbounded following", -1, None),
+    ("rows 3 preceding", -3, 0),  # shorthand: .. AND CURRENT ROW
+])
+def test_sum_count_min_max(s, spec, lo, hi):
+    q = (f"select g, i, sum(v) over (partition by g order by i {spec}) as sm, "
+         f"count(*) over (partition by g order by i {spec}) as cn, "
+         f"min(v) over (partition by g order by i {spec}) as mn, "
+         f"max(v) over (partition by g order by i {spec}) as mx "
+         f"from w order by g, i")
+    by = _by_g(s)
+    for g, i, sm, cn, mn, mx in s.query(q):
+        f = _frame(by[g], i, lo, hi)
+        if f:
+            assert (sm, cn, mn, mx) == (sum(f), len(f), min(f), max(f)), \
+                (g, i, spec)
+        else:
+            assert sm is None and cn == 0 and mn is None and mx is None
+
+
+def test_avg_and_edges(s):
+    q = ("select g, i, avg(v) over (partition by g order by i "
+         "rows between 3 preceding and 1 preceding) from w order by g, i")
+    by = _by_g(s)
+    for g, i, av in s.query(q):
+        f = _frame(by[g], i, -3, -1)
+        if f:
+            assert av == pytest.approx(sum(f) / len(f))
+        else:
+            assert av is None  # first row: empty frame
+
+
+def test_first_last_value_frames(s):
+    q = ("select g, i, "
+         "first_value(v) over (partition by g order by i "
+         "  rows between 1 following and 3 following) as fv, "
+         "last_value(v) over (partition by g order by i "
+         "  rows between 2 preceding and 1 preceding) as lv "
+         "from w order by g, i")
+    by = _by_g(s)
+    for g, i, fv, lv in s.query(q):
+        f1 = _frame(by[g], i, 1, 3)
+        f2 = _frame(by[g], i, -2, -1)
+        assert fv == (f1[0] if f1 else None), (g, i)
+        assert lv == (f2[-1] if f2 else None), (g, i)
+
+
+def test_range_frames_with_ties():
+    """RANGE frames operate on PEER GROUPS: CURRENT ROW spans the whole
+    tie group at either bound."""
+    sess = Session()
+    sess.execute("create table r (k bigint, v bigint)")
+    # ties on k: (1,1),(1,2) | (2,10) | (3,4),(3,5),(3,6)
+    sess.execute("insert into r values (1,1),(1,2),(2,10),(3,4),(3,5),(3,6)")
+    rows = [(1, 1), (1, 2), (2, 10), (3, 4), (3, 5), (3, 6)]
+    tot = sum(v for _, v in rows)
+    got = sess.query(
+        "select k, v, "
+        "sum(v) over (order by k range between unbounded preceding and "
+        "  unbounded following) as whole, "
+        "sum(v) over (order by k range between current row and "
+        "  unbounded following) as rev, "
+        "sum(v) over (order by k range between current row and "
+        "  current row) as peers, "
+        "min(v) over (order by k range between current row and "
+        "  current row) as pmin "
+        "from r order by k, v")
+    for k, v, whole, rev, peers, pmin in got:
+        peer_vals = [pv for pk, pv in rows if pk == k]
+        tail = sum(pv for pk, pv in rows if pk >= k)
+        assert whole == tot
+        assert rev == tail, (k, rev, tail)
+        assert peers == sum(peer_vals)
+        assert pmin == min(peer_vals)
+
+
+def test_wide_rows_window_fast_path(s):
+    # width >= partition size: prefix/suffix shortcut, same answers
+    q = ("select g, i, min(v) over (partition by g order by i "
+         "rows between 1000 preceding and 2 preceding) from w "
+         "order by g, i")
+    by = _by_g(s)
+    for g, i, mn in s.query(q):
+        f = _frame(by[g], i, -1000, -2)
+        assert mn == (min(f) if f else None), (g, i)
+
+
+def test_illegal_bounds_refused(s):
+    from tidb_tpu.errors import ParseError
+
+    with pytest.raises(ParseError):
+        s.execute("select max(v) over (order by i rows unbounded following) "
+                  "from w")
+    with pytest.raises(ParseError):
+        s.execute("select max(v) over (order by i rows between current row "
+                  "and unbounded preceding) from w")
+    with pytest.raises(ParseError):
+        s.execute("select sum(v) over (order by i rows 1.5 preceding) from w")
+
+
+def test_range_offset_refused(s):
+    from tidb_tpu.errors import ParseError
+
+    with pytest.raises(ParseError):
+        s.execute("select sum(v) over (order by i "
+                  "range between 1 preceding and current row) from w")
+
+
+def test_frame_on_ranking_ignored(s):
+    # MySQL ignores frames for ranking functions
+    got = s.query("select i, row_number() over (partition by g order by i "
+                  "rows between 1 preceding and current row) from w "
+                  "where g = 0 order by i limit 3")
+    assert got == [(0, 1), (1, 2), (2, 3)]

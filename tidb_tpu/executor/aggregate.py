@@ -914,7 +914,8 @@ class HashAggExec(Executor):
             return [str(int(v)) for v in vv]
         raise UnsupportedError(f"GROUP_CONCAT over {a.arg.type_}")
 
-    # MySQL's group_concat_max_len default: result strings truncate here
+    # MySQL's group_concat_max_len default (overridden by the sysvar
+    # through ExecContext)
     GROUP_CONCAT_MAX_LEN = 1024
 
     def _group_concat(self, a: AggSpec, vals, ok, inverse, ngroups):
@@ -944,10 +945,12 @@ class HashAggExec(Executor):
         strs = self._gc_strings(a, vv)
         out = [None] * ngroups
         starts = np.flatnonzero(np.diff(gi, prepend=-1)) if len(gi) else []
+        max_len = getattr(getattr(self, "ctx", None), "group_concat_max_len",
+                          self.GROUP_CONCAT_MAX_LEN)
         for si, s0 in enumerate(starts):
             s1 = starts[si + 1] if si + 1 < len(starts) else len(gi)
             joined = sep.join(strs[s0:s1])
-            out[int(gi[s0])] = joined[: self.GROUP_CONCAT_MAX_LEN]
+            out[int(gi[s0])] = joined[: max_len]
         valid = np.array([o is not None for o in out], dtype=np.bool_)
         rdict.fill([o for o in out if o is not None])
         codes = np.array([rdict.code_of(o) if o is not None else 0

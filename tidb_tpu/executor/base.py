@@ -43,6 +43,8 @@ class ExecContext:
     # tables above this stream through staged batches on the dist scan
     # path instead of full device residency (tidb_device_cache_bytes)
     device_cache_bytes: int = 8 << 30
+    # GROUP_CONCAT result truncation (group_concat_max_len sysvar)
+    group_concat_max_len: int = 1024
 
     def __post_init__(self):
         if self.mem_tracker is None:

@@ -169,7 +169,8 @@ def build_executor(plan: PhysicalPlan) -> Executor:
 
         return WindowExec(plan.schema, build_executor(plan.child), plan.func,
                           plan.args, plan.partition_by, plan.order_by,
-                          plan.out_uid, plan.out_type, plan.params)
+                          plan.out_uid, plan.out_type, plan.params,
+                          frame=plan.frame)
     if isinstance(plan, PTopN):
         return TopNExec(plan.schema, build_executor(plan.child), plan.items, plan.count, plan.offset)
     if isinstance(plan, PLimit):

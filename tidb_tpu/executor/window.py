@@ -31,7 +31,8 @@ __all__ = ["WindowExec"]
 
 class WindowExec(_Materializing):
     def __init__(self, schema, child, func: str, args, partition_by,
-                 order_by, out_uid: str, out_type, params: tuple = ()):
+                 order_by, out_uid: str, out_type, params: tuple = (),
+                 frame=None):
         super().__init__(schema, [child])
         self.func = func
         self.args = args
@@ -40,6 +41,7 @@ class WindowExec(_Materializing):
         self.out_uid = out_uid
         self.out_type = out_type
         self.params = params
+        self.frame = frame  # ("rows", lo_bound, hi_bound) or None
 
     def open(self, ctx: ExecContext) -> None:
         Executor.open(self, ctx)
@@ -68,7 +70,7 @@ class WindowExec(_Materializing):
                 list(self.order_by),
                 host_keys[np_part + np_ord :],
                 n, self.out_type, avg_descale=descale,
-                params=self.params)
+                params=self.params, frame=self.frame)
             self._emit(runs, None, n)  # original row order
         finally:
             self.schema = saved
@@ -91,9 +93,34 @@ class WindowExec(_Materializing):
         self._chunks = patched
 
 
+def _frame_edges(frame, idx, part_start, part_end,
+                 tie_start=None, tie_last=None):
+    """Per-row inclusive [s, e] sorted-index window for an explicit
+    frame; empty windows surface as s > e. ROWS counts physical rows
+    from the current row; RANGE's CURRENT ROW means the current PEER
+    GROUP (tie_start/tie_last), per the standard."""
+    kind, lo, hi = frame
+
+    def edge(bound, is_lo):
+        if bound[0] == "unbounded_preceding":
+            return part_start.copy()
+        if bound[0] == "unbounded_following":
+            return part_end.copy()
+        if bound[0] == "current":
+            if kind == "range":
+                return (tie_start if is_lo else tie_last).copy()
+            return idx.copy()
+        off = bound[1]
+        return idx + (-off if bound[0] == "preceding" else off)
+
+    s = np.maximum(edge(lo, True), part_start)
+    e = np.minimum(edge(hi, False), part_end)
+    return s, e
+
+
 def _compute_window(func, part_keys, order_keys, order_items, arg_keys,
                     n: int, out_type, avg_descale: float = 1.0,
-                    params: tuple = ()):
+                    params: tuple = (), frame=None):
     """Returns (values[n], valid[n]) in ORIGINAL row order."""
     if n == 0:
         return (np.zeros(0, dtype=out_type.np_dtype),
@@ -160,13 +187,25 @@ def _compute_window(func, part_keys, order_keys, order_items, arg_keys,
         else:
             ad, av = arg_keys[0][0][perm], arg_keys[0][1][perm]
             if func == "first_value":
-                src_i = part_start
-                inwin = np.ones(n, dtype=np.bool_)
+                if frame is not None:
+                    fs, fe = _frame_edges(frame, idx, part_start, part_end,
+                                          tie_start, tie_last)
+                    src_i = np.clip(fs, 0, n - 1)
+                    inwin = fs <= fe
+                else:
+                    src_i = part_start
+                    inwin = np.ones(n, dtype=np.bool_)
             elif func == "last_value":
-                # default frame: up to the current tie group (ordered),
-                # whole partition otherwise
-                src_i = tie_last if order_items else part_end
-                inwin = np.ones(n, dtype=np.bool_)
+                if frame is not None:
+                    fs, fe = _frame_edges(frame, idx, part_start, part_end,
+                                          tie_start, tie_last)
+                    src_i = np.clip(fe, 0, n - 1)
+                    inwin = fs <= fe
+                else:
+                    # default frame: up to the current tie group
+                    # (ordered), whole partition otherwise
+                    src_i = tie_last if order_items else part_end
+                    inwin = np.ones(n, dtype=np.bool_)
             else:
                 off = int(params[0])
                 src_i = idx - off if func == "lag" else idx + off
@@ -200,7 +239,19 @@ def _compute_window(func, part_keys, order_keys, order_items, arg_keys,
                 np.int64 if not np.issubdtype(ad.dtype, np.floating) else np.float64)
             ones = av.astype(np.int64)
             contrib = np.where(av, fd, 0)
-            if framed:
+            if frame is not None:
+                # explicit ROWS frame: windowed prefix-sum differences;
+                # no peer sharing (ROWS counts physical rows)
+                fs, fe = _frame_edges(frame, idx, part_start, part_end,
+                                          tie_start, tie_last)
+                cs = np.concatenate(([0], np.cumsum(contrib)))
+                cn = np.concatenate(([0], np.cumsum(ones)))
+                lo = np.clip(fs, 0, n)
+                hi = np.clip(fe + 1, 0, n)
+                nonempty = fs <= fe
+                run_s = np.where(nonempty, cs[hi] - cs[lo], 0)
+                run_n = np.where(nonempty, cn[hi] - cn[lo], 0)
+            elif framed:
                 cs = np.cumsum(contrib)
                 cn = np.cumsum(ones)
                 base_s = cs[part_start] - contrib[part_start]
@@ -233,7 +284,84 @@ def _compute_window(func, part_keys, order_keys, order_items, arg_keys,
             ident = big if func == "min" else -big
             cd = np.where(av, ad, ident)
             ones = av.astype(np.int64)
-            if framed:
+            if frame is not None:
+                fs, fe = _frame_edges(frame, idx, part_start, part_end,
+                                          tie_start, tie_last)
+                cn = np.concatenate(([0], np.cumsum(ones)))
+                lo = np.clip(fs, 0, n)
+                hi = np.clip(fe + 1, 0, n)
+                nonempty = fs <= fe
+                run_n = np.where(nonempty, cn[hi] - cn[lo], 0)
+                # per-partition sliding extremes (O(P) loop like the
+                # running path; sliding_window_view when both bounds are
+                # finite, prefix/suffix accumulates otherwise)
+                run = np.full(n, ident, dtype=cd.dtype)
+                _k, flo, fhi = frame
+
+                def _off(b):
+                    if b[0] == "current":
+                        return 0
+                    return -b[1] if b[0] == "preceding" else b[1]
+
+                for s0, e0 in zip(starts, list(starts[1:]) + [n]):
+                    seg = cd[s0:e0]
+                    m = e0 - s0
+                    if flo[0] == "unbounded_preceding":
+                        # prefix extreme at the (clipped) frame end
+                        pref = red.accumulate(seg)
+                        eseg = np.clip(fe[s0:e0] - s0, 0, m - 1)
+                        run[s0:e0] = pref[eseg]
+                    elif fhi[0] == "unbounded_following":
+                        suf = red.accumulate(seg[::-1])[::-1]
+                        sseg = np.clip(fs[s0:e0] - s0, 0, m - 1)
+                        run[s0:e0] = suf[sseg]
+                    elif _k == "range":
+                        # CURRENT..CURRENT peer-group extreme (the only
+                        # remaining RANGE combo: bounds are tie groups)
+                        tl = np.clip(fe[s0:e0] - s0, 0, m - 1)
+                        pref = red.accumulate(seg)
+                        ts_ = np.clip(fs[s0:e0] - s0, 0, m - 1)
+                        suf = red.accumulate(seg[::-1])[::-1]
+                        # extreme over [ts, tl]: windows never overlap
+                        # across tie groups, so prefix-from-tie-start
+                        # works: min(prefix[tl], suffix[ts]) over the
+                        # group equals reduceat — use reduceat directly
+                        gstart = np.unique(ts_)
+                        gmin = red.reduceat(seg, gstart)
+                        gmap = np.searchsorted(gstart, ts_)
+                        run[s0:e0] = gmin[gmap]
+                    else:
+                        lo_off, hi_off = _off(flo), _off(fhi)
+                        w = hi_off - lo_off + 1
+                        if w < 1:
+                            continue  # every window empty
+                        if w >= m:
+                            # windows at least partition-sized: every
+                            # clipped window is a prefix or a suffix —
+                            # O(m) instead of O(m*w)
+                            pref = red.accumulate(seg)
+                            suf = red.accumulate(seg[::-1])[::-1]
+                            a = np.arange(m) + lo_off   # unclipped start
+                            b = np.clip(np.arange(m) + hi_off, 0, m - 1)
+                            run[s0:e0] = np.where(
+                                a <= 0, pref[b],
+                                suf[np.clip(a, 0, m - 1)])
+                            continue
+                        # both bounds finite, narrow: identity padding
+                        # makes edge-clipped windows fall out of one
+                        # vectorized sliding extreme
+                        pad = np.full(w - 1, ident, dtype=seg.dtype)
+                        padded = np.concatenate([pad, seg, pad])
+                        sw = np.lib.stride_tricks.sliding_window_view(
+                            padded, w)
+                        ext = (sw.min(axis=1) if func == "min"
+                               else sw.max(axis=1))
+                        # seg-coord window start r+lo_off lives at
+                        # sliding index r+lo_off+(w-1)
+                        widx = np.arange(m) + lo_off + (w - 1)
+                        run[s0:e0] = ext[np.clip(widx, 0, len(ext) - 1)]
+                run = np.where(nonempty, run, ident)
+            elif framed:
                 # partition-segmented running min/max (O(P) python loop
                 # over partitions; acceptable for a root operator)
                 run = np.empty_like(cd)

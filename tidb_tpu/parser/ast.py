@@ -167,6 +167,10 @@ class EWindow:
     args: List["Expr"] = field(default_factory=list)
     partition_by: List["Expr"] = field(default_factory=list)
     order_by: List["OrderItem"] = field(default_factory=list)
+    # explicit frame: ("rows"|"range", lo_bound, hi_bound), each bound
+    # one of ("unbounded_preceding",) ("unbounded_following",)
+    # ("current",) ("preceding", k) ("following", k); None = defaults
+    frame: Optional[Tuple] = None
 
 
 Expr = Union[

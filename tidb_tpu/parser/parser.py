@@ -991,8 +991,47 @@ class Parser:
                 order.append(OrderItem(e, desc))
                 if not self.accept_op(","):
                     break
+        frame = None
+        is_rows = self._accept_word("rows")
+        if is_rows or self._accept_word("range"):
+            def bound():
+                if self._accept_word("unbounded"):
+                    if self._accept_word("preceding"):
+                        return ("unbounded_preceding",)
+                    self._expect_word("following")
+                    return ("unbounded_following",)
+                if self._accept_word("current"):
+                    self._expect_word("row")
+                    return ("current",)
+                if self.peek().kind != "NUM" or \
+                        not self.peek().text.isdigit():
+                    raise self.error("expected an integer frame bound")
+                k = int(self.next().text)
+                if self._accept_word("preceding"):
+                    return ("preceding", k)
+                self._expect_word("following")
+                return ("following", k)
+
+            if self.accept_kw("between"):
+                lo = bound()
+                self.expect_kw("and")
+                hi = bound()
+            else:
+                lo, hi = bound(), ("current",)
+            # MySQL ER_WINDOW_FRAME_START/END_ILLEGAL
+            if lo[0] == "unbounded_following":
+                raise self.error("frame start cannot be UNBOUNDED FOLLOWING")
+            if hi[0] == "unbounded_preceding":
+                raise self.error("frame end cannot be UNBOUNDED PRECEDING")
+            kind = "rows" if is_rows else "range"
+            if kind == "range" and any(
+                    b[0] in ("preceding", "following") for b in (lo, hi)):
+                raise self.error(
+                    "RANGE frames with value offsets are not supported "
+                    "(use ROWS)")
+            frame = (kind, lo, hi)
         self.expect_op(")")
-        return EWindow(fname, args, part, order)
+        return EWindow(fname, args, part, order, frame=frame)
 
     def _parse_hints(self, text: str):
         """'LEADING(a, b) MEMORY_QUOTA(1048576)' -> [(name, [args])]."""

@@ -125,6 +125,8 @@ class LWindow(LogicalPlan):
     # positional params: LEAD/LAG -> (offset, default_value_or_None,
     # default_is_null); NTILE -> (n,)
     params: tuple = ()
+    # explicit ROWS frame bounds (ast.EWindow.frame); None = defaults
+    frame: object = None
 
 
 @dataclass
@@ -796,10 +798,17 @@ def _plan_window(w: A.EWindow, plan: LogicalPlan, scope: Scope,
         uid = binder.new_uid(f"win.{w.func}")
         col = PlanCol(uid=uid, name=uid, type_=arg.type_,
                       dict_=binder._dict_of(arg))
+        frame = getattr(w, "frame", None)
+        if frame is not None and w.func in ("lead", "lag"):
+            frame = None  # frames don't apply to LEAD/LAG
+        if frame is not None and frame[0] == "range" and \
+                frame[1] == ("unbounded_preceding",) and \
+                frame[2] == ("current",):
+            frame = None  # THE default frame; others execute as range
         node = LWindow(schema=list(plan.schema) + [col], children=[plan],
                        func=w.func, args=node_args, partition_by=part,
                        order_by=order, out_uid=uid, out_type=arg.type_,
-                       params=params)
+                       params=params, frame=frame)
         return node, Scope(list(scope.cols) + [col], scope.parent), uid
     if w.func == "ntile":
         if len(w.args) != 1:
@@ -836,10 +845,18 @@ def _plan_window(w: A.EWindow, plan: LogicalPlan, scope: Scope,
             d = binder._dict_of(arg) if w.func in ("min", "max") else None
     uid = binder.new_uid(f"win.{w.func}")
     col = PlanCol(uid=uid, name=uid, type_=out_type, dict_=d)
+    frame = getattr(w, "frame", None)
+    if frame is not None and w.func in ("row_number", "rank", "dense_rank",
+                                        "ntile", "lead", "lag"):
+        frame = None  # MySQL: frames don't apply to these functions
+    if frame is not None and frame[0] == "range" and \
+            frame[1] == ("unbounded_preceding",) and \
+            frame[2] == ("current",):
+        frame = None  # THE default frame; other RANGE combos execute
     node = LWindow(
         schema=list(plan.schema) + [col], children=[plan],
         func=w.func, args=args, partition_by=part, order_by=order,
-        out_uid=uid, out_type=out_type,
+        out_uid=uid, out_type=out_type, frame=frame,
     )
     return node, Scope(list(scope.cols) + [col], scope.parent), uid
 

@@ -396,6 +396,7 @@ class PWindow(PhysicalPlan):
     out_uid: str = ""
     out_type: object = None
     params: tuple = ()
+    frame: object = None
     task: str = "root"
 
     def op_info(self):
@@ -738,7 +739,7 @@ def lower(plan: LogicalPlan) -> PhysicalPlan:
             schema=plan.schema, children=[lower(plan.child)], est_rows=est,
             func=plan.func, args=plan.args, partition_by=plan.partition_by,
             order_by=plan.order_by, out_uid=plan.out_uid, out_type=plan.out_type,
-            params=plan.params)
+            params=plan.params, frame=plan.frame)
     if isinstance(plan, LLimit):
         c = lower(plan.child)
         if isinstance(c, PSort):

@@ -430,6 +430,8 @@ class Session:
                     quota = q  # unparseable hints are ignored, like TiDB warns
         return ExecContext(
             chunk_capacity=self._plan_capacity(plan),
+            group_concat_max_len=int(
+                self.sysvars.get("group_concat_max_len")),
             mem_tracker=MemTracker(
                 "query",
                 budget=quota,

@@ -57,6 +57,7 @@ _reg(
     # eager aggregation (partial agg below joins); stats-gated, so ON by
     # default unlike the reference's blind-push variant
     SysVar("tidb_opt_agg_push_down", True, BOTH, "bool"),
+    SysVar("group_concat_max_len", 1024, BOTH, "int"),
     SysVar("tidb_gc_enable", True, BOTH, "bool"),
     # stats lifecycle (ref: statistics auto-analyze): after DML commits,
     # re-ANALYZE a table whose modified-row count crossed ratio * rows
