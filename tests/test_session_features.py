@@ -185,3 +185,45 @@ class TestCTEMaterialization:
         got = u.query("with c as (select x from g) "
                       "select count(*) from c a join c b on a.x = b.x")
         assert got == [(1,)], got
+
+
+class TestShowCreateTable:
+    def test_round_trip(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute(
+            "create table sct (id bigint auto_increment, "
+            "name varchar(20) not null, amt decimal(10,2) default 0, "
+            "b boolean, unique key uk_n (name)) engine=delta")
+        s.execute("create index idx_amt on sct (amt)")
+        tbl, ddl = s.execute("show create table sct").rows[0]
+        assert tbl == "sct"
+        for frag in ("AUTO_INCREMENT", "NOT NULL", "UNIQUE KEY `uk_n`",
+                     "KEY `idx_amt`", "decimal(10,2)", "DEFAULT '0'",
+                     "ENGINE=delta", "varchar(20)"):
+            assert frag in ddl, ddl
+        # the emitted DDL must parse back into an equivalent table
+        s2 = Session()
+        s2.execute(ddl.replace("`sct`", "`sct2`"))
+        t2 = s2.catalog.table("test", "sct2")
+        assert [c.name for c in t2.schema.columns] == ["id", "name", "amt", "b"]
+        assert t2.engine == "delta"
+        assert "uk_n" in t2.indexes and "idx_amt" in t2.indexes
+        assert t2.schema.col("name").not_null
+
+    def test_requires_select_priv(self):
+        from tidb_tpu.errors import PrivilegeError
+        from tidb_tpu.session import Session
+
+        import pytest as _pytest
+
+        s = Session()
+        s.execute("create table p (a bigint)")
+        s.execute("create user 'eve'")
+        s.user = "eve"
+        try:
+            with _pytest.raises(PrivilegeError):
+                s.execute("show create table p")
+        finally:
+            s.user = "root"

@@ -141,6 +141,12 @@ def test_illegal_bounds_refused(s):
                   "and unbounded preceding) from w")
     with pytest.raises(ParseError):
         s.execute("select sum(v) over (order by i rows 1.5 preceding) from w")
+    with pytest.raises(ParseError):  # start category after end category
+        s.execute("select sum(v) over (order by i rows between current row "
+                  "and 2 preceding) from w")
+    with pytest.raises(ParseError):
+        s.execute("select sum(v) over (order by i rows between 2 following "
+                  "and current row) from w")
 
 
 def test_range_offset_refused(s):

@@ -914,10 +914,6 @@ class HashAggExec(Executor):
             return [str(int(v)) for v in vv]
         raise UnsupportedError(f"GROUP_CONCAT over {a.arg.type_}")
 
-    # MySQL's group_concat_max_len default (overridden by the sysvar
-    # through ExecContext)
-    GROUP_CONCAT_MAX_LEN = 1024
-
     def _group_concat(self, a: AggSpec, vals, ok, inverse, ngroups):
         """GROUP_CONCAT(x [ORDER BY x [DESC]] [SEPARATOR s]): per-group
         string joins on the host generic path. The output dictionary is
@@ -945,8 +941,7 @@ class HashAggExec(Executor):
         strs = self._gc_strings(a, vv)
         out = [None] * ngroups
         starts = np.flatnonzero(np.diff(gi, prepend=-1)) if len(gi) else []
-        max_len = getattr(getattr(self, "ctx", None), "group_concat_max_len",
-                          self.GROUP_CONCAT_MAX_LEN)
+        max_len = self.ctx.group_concat_max_len
         for si, s0 in enumerate(starts):
             s1 = starts[si + 1] if si + 1 < len(starts) else len(gi)
             joined = sep.join(strs[s0:s1])

@@ -1018,11 +1018,15 @@ class Parser:
                 hi = bound()
             else:
                 lo, hi = bound(), ("current",)
-            # MySQL ER_WINDOW_FRAME_START/END_ILLEGAL
-            if lo[0] == "unbounded_following":
-                raise self.error("frame start cannot be UNBOUNDED FOLLOWING")
-            if hi[0] == "unbounded_preceding":
-                raise self.error("frame end cannot be UNBOUNDED PRECEDING")
+            # MySQL ER_WINDOW_FRAME_*_ILLEGAL: bound CATEGORIES must be
+            # ordered (offsets within a category are not validated,
+            # matching MySQL — 5 PRECEDING AND 2 PRECEDING is legal)
+            rank = {"unbounded_preceding": 0, "preceding": 1, "current": 2,
+                    "following": 3, "unbounded_following": 4}
+            if rank[lo[0]] > rank[hi[0]]:
+                raise self.error(
+                    "frame start cannot come after its end "
+                    f"({lo[0].upper()} .. {hi[0].upper()})")
             kind = "rows" if is_rows else "range"
             if kind == "range" and any(
                     b[0] in ("preceding", "following") for b in (lo, hi)):

@@ -764,6 +764,23 @@ def _window_default_repr(binder, d0: Literal, arg: Expr, fname: str):
     return d0.value, False
 
 
+def _normalize_frame(w: A.EWindow):
+    """One rule for both LWindow construction sites: frames don't
+    apply to ranking functions or LEAD/LAG (MySQL ignores them), and
+    RANGE UNBOUNDED PRECEDING..CURRENT ROW IS the default — every other
+    combination executes explicitly."""
+    frame = getattr(w, "frame", None)
+    if frame is None:
+        return None
+    if w.func in ("row_number", "rank", "dense_rank", "ntile",
+                  "lead", "lag"):
+        return None
+    if frame[0] == "range" and frame[1] == ("unbounded_preceding",) \
+            and frame[2] == ("current",):
+        return None
+    return frame
+
+
 def _plan_window(w: A.EWindow, plan: LogicalPlan, scope: Scope,
                  ctx: BuildContext):
     """Stack one LWindow node; returns (plan, widened scope, out uid)."""
@@ -798,13 +815,7 @@ def _plan_window(w: A.EWindow, plan: LogicalPlan, scope: Scope,
         uid = binder.new_uid(f"win.{w.func}")
         col = PlanCol(uid=uid, name=uid, type_=arg.type_,
                       dict_=binder._dict_of(arg))
-        frame = getattr(w, "frame", None)
-        if frame is not None and w.func in ("lead", "lag"):
-            frame = None  # frames don't apply to LEAD/LAG
-        if frame is not None and frame[0] == "range" and \
-                frame[1] == ("unbounded_preceding",) and \
-                frame[2] == ("current",):
-            frame = None  # THE default frame; others execute as range
+        frame = _normalize_frame(w)
         node = LWindow(schema=list(plan.schema) + [col], children=[plan],
                        func=w.func, args=node_args, partition_by=part,
                        order_by=order, out_uid=uid, out_type=arg.type_,
@@ -845,14 +856,7 @@ def _plan_window(w: A.EWindow, plan: LogicalPlan, scope: Scope,
             d = binder._dict_of(arg) if w.func in ("min", "max") else None
     uid = binder.new_uid(f"win.{w.func}")
     col = PlanCol(uid=uid, name=uid, type_=out_type, dict_=d)
-    frame = getattr(w, "frame", None)
-    if frame is not None and w.func in ("row_number", "rank", "dense_rank",
-                                        "ntile", "lead", "lag"):
-        frame = None  # MySQL: frames don't apply to these functions
-    if frame is not None and frame[0] == "range" and \
-            frame[1] == ("unbounded_preceding",) and \
-            frame[2] == ("current",):
-        frame = None  # THE default frame; other RANGE combos execute
+    frame = _normalize_frame(w)
     node = LWindow(
         schema=list(plan.schema) + [col], children=[plan],
         func=w.func, args=args, partition_by=part, order_by=order,

@@ -872,11 +872,15 @@ class Session:
                 # literals carry scaled-int decimals; defaults are stored
                 # in logical form (DEFAULT 1.5 is 1.5, not 15), exactly
                 default = _dec.Decimal(default).scaleb(-lit.type_.scale)
+        text = c.type_name.lower()
+        if c.type_args:
+            text += "(" + ",".join(str(a) for a in c.type_args) + ")"
         return ColumnInfo(
             c.name, t,
             not_null=c.not_null or c.primary_key,
             default=default,
             auto_increment=c.auto_increment,
+            type_text=text,
         )
 
     def _run_alter_table(self, stmt: A.AlterTableStmt):
@@ -1639,6 +1643,40 @@ class Session:
                 names=["Table", "Non_unique", "Key_name", "Seq_in_index",
                        "Column_name"],
                 rows=rows)
+        if stmt.kind == "create_table":
+            # privilege BEFORE the lookup: an unprivileged probe must not
+            # learn which table names exist
+            self._priv("select", self.db, stmt.target)
+            t = self.catalog.table(self.db, stmt.target)
+            kindmap = {"int": "bigint", "float": "double",
+                       "string": "varchar(255)", "bool": "tinyint(1)"}
+            lines = []
+            for c in t.schema.columns:
+                ty = c.type_text or kindmap.get(str(c.type_), str(c.type_))
+                parts = [f"  `{c.name}` {ty}"]
+                if c.not_null:
+                    parts.append("NOT NULL")
+                if c.auto_increment:
+                    parts.append("AUTO_INCREMENT")
+                if c.default is not None:
+                    dv = str(c.default).replace("\\", "\\\\")
+                    dv = dv.replace("'", "''")
+                    parts.append(f"DEFAULT '{dv}'")
+                lines.append(" ".join(parts))
+            if t.schema.primary_key:
+                keys = ", ".join(f"`{k}`" for k in t.schema.primary_key)
+                lines.append(f"  PRIMARY KEY ({keys})")
+            for name, ix in t.indexes.items():
+                if name == "PRIMARY":
+                    continue
+                keys = ", ".join(f"`{k}`" for k in ix.columns)
+                kw = "UNIQUE KEY" if ix.unique else "KEY"
+                lines.append(f"  {kw} `{name}` ({keys})")
+            ddl = (f"CREATE TABLE `{stmt.target}` (\n"
+                   + ",\n".join(lines)
+                   + f"\n) ENGINE={t.engine}")
+            return ResultSet(names=["Table", "Create Table"],
+                             rows=[(stmt.target, ddl)])
         if stmt.kind == "create_view":
             v = self.catalog.view(self.db, stmt.target)
             if v is None:

@@ -57,6 +57,10 @@ class ColumnInfo:
     not_null: bool = False
     default: object = None
     auto_increment: bool = False
+    # the DDL's declared type text (e.g. "varchar(20)") — SQLType erases
+    # display-only details like string lengths; SHOW CREATE TABLE needs
+    # them back verbatim
+    type_text: Optional[str] = None
 
 
 @dataclass
