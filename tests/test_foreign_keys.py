@@ -1,0 +1,144 @@
+"""FOREIGN KEY constraints (single-column, RESTRICT; ref: ddl/
+foreign-key DDL + constraint checks). Child writes probe the parent's
+live keys; parent deletes/updates/truncates/drops probe the children.
+NULL FK values are always allowed (MySQL)."""
+
+import pytest
+
+from tidb_tpu.errors import ExecutionError, SchemaError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture
+def s():
+    sess = Session()
+    sess.execute("create table p (id bigint primary key, v bigint)")
+    sess.execute("insert into p values (1, 10), (2, 20), (3, 30)")
+    sess.execute("create table c (x bigint, pid bigint, "
+                 "foreign key (pid) references p(id))")
+    return sess
+
+
+def test_child_insert_checked(s):
+    s.execute("insert into c values (100, 1), (101, NULL)")  # ok incl. NULL
+    with pytest.raises(ExecutionError, match="foreign key"):
+        s.execute("insert into c values (102, 99)")
+    assert s.query("select count(*) from c") == [(2,)]
+
+
+def test_child_update_checked(s):
+    s.execute("insert into c values (100, 1)")
+    s.execute("update c set pid = 2 where x = 100")  # ok
+    with pytest.raises(ExecutionError, match="foreign key"):
+        s.execute("update c set pid = 77 where x = 100")
+    assert s.query("select pid from c") == [(2,)]
+
+
+def test_parent_delete_restricted(s):
+    s.execute("insert into c values (100, 2)")
+    with pytest.raises(ExecutionError, match="referenced"):
+        s.execute("delete from p where id = 2")
+    s.execute("delete from p where id = 3")  # unreferenced: fine
+    s.execute("delete from c where x = 100")
+    s.execute("delete from p where id = 2")  # now released
+
+
+def test_parent_key_update_restricted(s):
+    s.execute("insert into c values (100, 1)")
+    with pytest.raises(ExecutionError, match="referenced"):
+        s.execute("update p set id = 9 where id = 1")
+    s.execute("update p set v = 11 where id = 1")  # non-key update fine
+
+
+def test_drop_and_truncate_restricted(s):
+    s.execute("insert into c values (100, 1)")
+    with pytest.raises(SchemaError, match="referenced"):
+        s.execute("drop table p")
+    with pytest.raises(ExecutionError, match="referenced"):
+        s.execute("truncate table p")
+    # dropping the CHILD releases the parent
+    s.execute("drop table c")
+    s.execute("drop table p")
+
+
+def test_target_must_be_unique(s):
+    with pytest.raises(SchemaError, match="UNIQUE"):
+        s.execute("create table c2 (y bigint, "
+                  "foreign key (y) references p(v))")
+
+
+def test_txn_scoped_fk(s):
+    """Provisional parent rows satisfy the child check inside the txn;
+    rollback restores enforcement."""
+    s.execute("begin")
+    s.execute("insert into p values (50, 1)")
+    s.execute("insert into c values (1, 50)")  # sees provisional parent
+    s.execute("commit")
+    assert s.query("select count(*) from c where pid = 50") == [(1,)]
+
+
+def test_string_fk_compares_values_not_codes(s):
+    """Dict codes are table-local: FK checks must compare decoded
+    strings (review finding: code 0 vs code 0 accepted 'zzz')."""
+    s.execute("create table sp (name varchar(12), unique key (name))")
+    s.execute("insert into sp values ('apple'), ('pear')")
+    s.execute("create table sc (tag varchar(12), "
+              "foreign key (tag) references sp(name))")
+    s.execute("insert into sc values ('pear')")  # legit
+    with pytest.raises(ExecutionError, match="foreign key"):
+        s.execute("insert into sc values ('zzz')")
+    assert s.query("select tag from sc") == [("pear",)]
+    with pytest.raises(ExecutionError, match="referenced"):
+        s.execute("delete from sp where name = 'pear'")
+    s.execute("delete from sp where name = 'apple'")  # unreferenced
+
+
+def test_failed_create_leaves_no_phantom_edges(s):
+    with pytest.raises(SchemaError):
+        s.execute("create table c2 (a bigint, b bigint, "
+                  "foreign key (a) references p(id), "
+                  "foreign key (b) references missing(x))")
+    # the half-created table left no back-edge: p is droppable
+    s.execute("drop table c")
+    s.execute("drop table p")
+
+
+def test_same_value_parent_key_update_allowed(s):
+    s.execute("insert into c values (100, 1)")
+    s.execute("update p set id = 1 where id = 1")  # no-op rekey: legal
+    s.execute("update p set id = id where id = 1")
+    with pytest.raises(ExecutionError, match="referenced"):
+        s.execute("update p set id = 9 where id = 1")
+
+
+def test_drop_fk_column_refused(s):
+    with pytest.raises(SchemaError, match="foreign key"):
+        s.execute("alter table c drop column pid")
+    with pytest.raises(SchemaError, match="foreign key"):
+        s.execute("alter table p drop column id")
+
+
+def test_show_create_renders_fk(s):
+    _tbl, ddl = s.execute("show create table c").rows[0]
+    assert "FOREIGN KEY (`pid`) REFERENCES `p` (`id`)" in ddl
+
+
+def test_drop_database_fk_hygiene():
+    sess = Session()
+    sess.execute("create database other")
+    sess.execute("create table par (id bigint primary key)")
+    sess.execute("create table other.kid (pid bigint, "
+                 "foreign key (pid) references test.par(id))")
+    from tidb_tpu.errors import SchemaError as SE
+
+    with pytest.raises(SE, match="referenced"):
+        sess.execute("drop table par")
+    sess.execute("drop database other")  # releases the back-edge
+    sess.execute("drop table par")
+
+
+def test_load_data_checked(s, tmp_path):
+    f = tmp_path / "c.tsv"
+    f.write_text("1\t1\n2\t42\n")
+    with pytest.raises(ExecutionError, match="foreign key"):
+        s.execute(f"load data infile '{f}' into table c")

@@ -299,6 +299,9 @@ class CreateTableStmt:
     indexes: List[Tuple[str, List[str]]] = field(default_factory=list)
     if_not_exists: bool = False
     engine: Optional[str] = None  # storage engine (kvapi.ENGINES)
+    # FOREIGN KEY clauses: (fk_columns, referenced TableName, ref_columns)
+    foreign_keys: List[Tuple[List[str], TableName, List[str]]] = \
+        field(default_factory=list)
 
 @dataclass
 class DropTableStmt:

@@ -655,16 +655,16 @@ class Parser:
                     stmt.unique_keys.append(("", self._paren_name_list()))
                 elif self.accept_kw("foreign"):
                     self.expect_kw("key")
-                    self._paren_name_list()
-                    self.expect_kw("references")
-                    self._table_name()
-                    self._paren_name_list()
+                    stmt.foreign_keys.append((
+                        self._paren_name_list(),
+                        (self.expect_kw("references"), self._table_name())[1],
+                        self._paren_name_list()))
             elif self.accept_kw("foreign"):
                 self.expect_kw("key")
-                self._paren_name_list()
-                self.expect_kw("references")
-                self._table_name()
-                self._paren_name_list()
+                stmt.foreign_keys.append((
+                    self._paren_name_list(),
+                    (self.expect_kw("references"), self._table_name())[1],
+                    self._paren_name_list()))
             else:
                 stmt.columns.append(self.parse_column_def())
             if not self.accept_op(","):

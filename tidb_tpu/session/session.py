@@ -910,7 +910,8 @@ class Session:
             cols.append(self._column_info(c))
         schema = TableSchema(stmt.table.name, cols, primary_key=pk)
         t = self.catalog.create_table(stmt.table.schema or self.db, schema,
-                                      stmt.if_not_exists, engine=stmt.engine)
+                                      stmt.if_not_exists, engine=stmt.engine,
+                                      foreign_keys=stmt.foreign_keys)
         if t is not None and t.schema is schema:
             # inline UNIQUE KEY / KEY clauses become real (enforced)
             # indexes — only on a table this statement actually created
@@ -1672,6 +1673,10 @@ class Session:
                 keys = ", ".join(f"`{k}`" for k in ix.columns)
                 kw = "UNIQUE KEY" if ix.unique else "KEY"
                 lines.append(f"  {kw} `{name}` ({keys})")
+            for fk in t.foreign_keys:
+                lines.append(
+                    f"  FOREIGN KEY (`{fk.column}`) REFERENCES "
+                    f"`{fk.parent.schema.name}` (`{fk.parent_col}`)")
             ddl = (f"CREATE TABLE `{stmt.target}` (\n"
                    + ",\n".join(lines)
                    + f"\n) ENGINE={t.engine}")

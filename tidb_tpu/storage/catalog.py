@@ -299,6 +299,23 @@ class Catalog:
             if if_exists:
                 return
             raise SchemaError(f"no database {name!r}")
+        dropped = set(self.databases[name].tables.values())
+        # FK hygiene matching drop_table: refuse when a table here is
+        # referenced from OUTSIDE the database; release the back-edges
+        # dropped children hold on external parents
+        for t in dropped:
+            for child, _fk in getattr(t, "referencing", ()):
+                if child is not t and child not in dropped:
+                    raise SchemaError(
+                        f"cannot drop database {name!r}: "
+                        f"{t.schema.name!r} is referenced by a foreign "
+                        "key outside it")
+        for t in dropped:
+            for fk in getattr(t, "foreign_keys", ()):
+                if fk.parent not in dropped:
+                    fk.parent.referencing = [
+                        (c, f) for c, f in fk.parent.referencing
+                        if c is not t]
         del self.databases[name]
         self.schema_version += 1
 
@@ -314,7 +331,8 @@ class Catalog:
 
     def create_table(self, db: str, schema: TableSchema,
                      if_not_exists: bool = False,
-                     engine: str = None) -> Table:
+                     engine: str = None,
+                     foreign_keys=None) -> Table:
         d = self.database(db)
         if schema.name in d.tables:
             if if_not_exists:
@@ -330,9 +348,42 @@ class Catalog:
 
         t = make_table(schema, engine)
         t.ts_source = self.next_ts
+        # two-pass: every FK spec must RESOLVE before any back-edge is
+        # written — a failure after partial wiring would leave phantom
+        # references blocking DROP of the parents forever
+        resolved = [self._resolve_foreign_key(db, t, spec)
+                    for spec in foreign_keys or ()]
+        for parent, fk in resolved:
+            t.foreign_keys.append(fk)
+            parent.referencing.append((t, fk))
         d.tables[schema.name] = t
         self.schema_version += 1
         return t
+
+    def _resolve_foreign_key(self, db: str, child, spec):
+        """Resolve one FOREIGN KEY spec (single-column, RESTRICT; ref:
+        ddl foreign-key jobs) WITHOUT mutating anything. The referenced
+        column must carry a unique index — the same requirement MySQL
+        enforces — so parent probes are well-defined."""
+        from tidb_tpu.storage.table import FKInfo
+
+        cols, ref, ref_cols = spec
+        if len(cols) != 1 or len(ref_cols) != 1:
+            raise SchemaError(
+                "composite FOREIGN KEYs are not supported yet")
+        child.schema.col(cols[0])  # raises if absent
+        parent = self.table(ref.schema or db, ref.name)
+        parent.schema.col(ref_cols[0])
+        unique_on_ref = any(
+            ix.unique and ix.columns == [ref_cols[0]]
+            for ix in parent.indexes.values())
+        if not unique_on_ref:
+            raise SchemaError(
+                f"foreign key target {ref.name}.{ref_cols[0]} must be a "
+                "PRIMARY KEY or single-column UNIQUE index")
+        fk = FKInfo(column=cols[0], parent=parent, parent_col=ref_cols[0],
+                    name=f"fk_{child.schema.name}_{cols[0]}")
+        return parent, fk
 
     def drop_table(self, db: str, name: str, if_exists: bool = False):
         d = self.database(db)
@@ -340,6 +391,14 @@ class Catalog:
             if if_exists:
                 return
             raise SchemaError(f"no table {db}.{name}")
+        t = d.tables[name]
+        if any(child is not t for child, _fk in t.referencing):
+            raise SchemaError(
+                f"cannot drop {name!r}: referenced by a foreign key")
+        # a dropped child releases its back-edges on every parent
+        for fk in getattr(t, "foreign_keys", ()):
+            fk.parent.referencing = [
+                (c, f) for c, f in fk.parent.referencing if c is not t]
         del d.tables[name]
         self.schema_version += 1
 

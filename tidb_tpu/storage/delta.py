@@ -95,9 +95,11 @@ class DeltaTable:
         return self._base.modify_count + self._count
 
     def _bufferable(self) -> bool:
-        # deferred unique enforcement would raise on the wrong
-        # statement; unique-keyed tables write through
-        return not any(ix.unique for ix in self._base.indexes.values())
+        # deferred unique/FK enforcement would raise on the wrong
+        # statement; constrained tables write through
+        base = self._base
+        return not (any(ix.unique for ix in base.indexes.values())
+                    or base.foreign_keys or base.referencing)
 
     # -- write surface -----------------------------------------------------
 

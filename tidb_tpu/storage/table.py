@@ -64,6 +64,19 @@ class ColumnInfo:
 
 
 @dataclass
+class FKInfo:
+    """A single-column FOREIGN KEY with RESTRICT semantics (ref: ddl/
+    foreign-key DDL + the executor's constraint checks). `parent` is the
+    referenced Table object (wired by the catalog at CREATE time), whose
+    `referencing` list holds the back-edge for parent-side checks."""
+
+    column: str
+    parent: object          # storage Table of the referenced table
+    parent_col: str
+    name: str = ""
+
+
+@dataclass
 class IndexInfo:
     """Secondary index metadata. Unique indexes are ENFORCED on every
     write (ref: the reference's index KV records + unique-key checks);
@@ -149,6 +162,12 @@ class Table:
         # ANALYZE and fed by every insert so distinct-count estimates
         # track DML churn between analyzes
         self.ndv_sketch: Dict[str, object] = {}
+        # FOREIGN KEY constraints: this table's child-side FKs, and
+        # back-edges from tables whose FKs reference THIS table
+        self.foreign_keys: List[FKInfo] = []
+        self.referencing: List[tuple] = []  # (child Table, FKInfo)
+        # fk-check cache: col -> (version, sorted live values)
+        self._fk_keys: Dict[str, tuple] = {}
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -296,6 +315,7 @@ class Table:
         txn_deleted = log is not None and bool(log.ended)
         self._enforce_unique_new(
             start, end, marker=begin_ts if in_txn and txn_deleted else None)
+        self._check_fk_parents(start, end)
         # before n advances: a violation leaves the table untouched
         self.begin_ts[start:end] = self._next_ts() if begin_ts is None else begin_ts
         self.end_ts[start:end] = MAX_TS
@@ -334,6 +354,7 @@ class Table:
             elif c.not_null:
                 raise ExecutionError(f"bulk insert missing NOT NULL column {name!r}")
         self._enforce_unique_new(start, end)
+        self._check_fk_parents(start, end)
         self.begin_ts[start:end] = 0  # bulk loads are committed "at origin"
         self.end_ts[start:end] = MAX_TS
         self.n = end
@@ -341,6 +362,74 @@ class Table:
         self._uniq_commit()
         self._sketch_insert(start, end)
         return m
+
+    # -- foreign keys ------------------------------------------------------
+
+    def _live_key_values(self, col: str) -> np.ndarray:
+        """Sorted committed-or-provisional values of `col` (the parent
+        side of an FK probe), cached per version. Dict-encoded columns
+        return the DECODED strings — codes are table-local and must
+        never be compared across tables."""
+        hit = self._fk_keys.get(col)
+        if hit is not None and hit[0] == self.version:
+            return hit[1]
+        present = self._present_mask()
+        vals = self.data[col][: self.n][present & self.valid[col][: self.n]]
+        keys = np.unique(vals)
+        dic = self.dicts.get(col)
+        if dic is not None:
+            keys = np.array([dic.values[int(c)] for c in keys], dtype=object)
+        self._fk_keys[col] = (self.version, keys)
+        return keys
+
+    def _fk_decode(self, col: str, vals: np.ndarray) -> np.ndarray:
+        """Decode this table's values of `col` for cross-table FK
+        comparison (strings for dict columns, raw otherwise)."""
+        dic = self.dicts.get(col)
+        if dic is None:
+            return vals
+        return np.array([dic.values[int(c)] for c in vals], dtype=object)
+
+    def _check_fk_parents(self, start: int, end: int,
+                          cols: Optional[set] = None) -> None:
+        """Every non-NULL FK value in rows [start, end) must exist in
+        its parent (RESTRICT on the child write). Raises BEFORE the rows
+        become visible."""
+        for fk in self.foreign_keys:
+            if cols is not None and fk.column not in cols:
+                continue
+            vd = self.valid[fk.column][start:end]
+            vals = self._fk_decode(fk.column,
+                                   self.data[fk.column][start:end][vd])
+            if not len(vals):
+                continue
+            keys = fk.parent._live_key_values(fk.parent_col)
+            ok = np.isin(vals, keys)
+            if not ok.all():
+                bad = vals[~ok][0]
+                raise ExecutionError(
+                    f"foreign key {fk.name or fk.column!r} violation: "
+                    f"{bad!r} not present in "
+                    f"{fk.parent.schema.name}.{fk.parent_col}")
+
+    def _check_fk_children(self, ids: np.ndarray) -> None:
+        """Rows `ids` are about to be deleted/re-keyed: no child row may
+        reference their key values (RESTRICT on the parent write)."""
+        if not self.referencing or not len(ids):
+            return
+        for child, fk in self.referencing:
+            pv = self.valid[fk.parent_col][ids]
+            keys = np.unique(self._fk_decode(
+                fk.parent_col, self.data[fk.parent_col][ids][pv]))
+            if not len(keys):
+                continue
+            refs = child._live_key_values(fk.column)
+            hit = np.isin(keys, refs)
+            if hit.any():
+                raise ExecutionError(
+                    f"cannot delete or update {self.schema.name!r} row: "
+                    f"key {keys[hit][0]!r} is referenced by "
+                    f"{child.schema.name}.{fk.column}")
 
     def _sketch_insert(self, start: int, end: int) -> None:
         """Feed newly written rows into the per-column NDV sketches (a
@@ -448,6 +537,7 @@ class Table:
         provisional deletes). Returns count newly deleted."""
         ids = np.asarray(row_ids, dtype=np.int64)
         ids = ids[self._writable_mask(ids, marker)]
+        self._check_fk_children(ids)
         self.end_ts[ids] = self._next_ts() if end_ts is None else end_ts
         if end_ts is not None and end_ts >= TXN_TS_BASE and len(ids):
             self._txn_dead.setdefault(end_ts, []).extend(ids.tolist())
@@ -524,6 +614,21 @@ class Table:
             finally:
                 self.end_ts[ids] = saved
 
+        upd_cols = set(converted)
+        try:
+            self._check_fk_parents(start, end, cols=upd_cols)
+            for pcol in {fk.parent_col for _c, fk in self.referencing
+                         if fk.parent_col in upd_cols}:
+                old = self.data[pcol][ids]
+                ov = self.valid[pcol][ids]
+                new = self.data[pcol][start:end]
+                nv = self.valid[pcol][start:end]
+                changed = (ov != nv) | (ov & nv & (old != new))
+                self._check_fk_children(ids[changed])
+        except ExecutionError:
+            for name in self.valid:
+                self.valid[name][start:end] = False
+            raise
         self.end_ts[ids] = end_ts
         if end_ts >= TXN_TS_BASE and m:
             self._txn_dead.setdefault(end_ts, []).extend(ids.tolist())
@@ -683,6 +788,10 @@ class Table:
         self.version += 1
 
     def drop_column(self, name: str) -> None:
+        if any(fk.column == name for fk in self.foreign_keys) or any(
+                fk.parent_col == name for _c, fk in self.referencing):
+            raise SchemaError(
+                f"cannot drop column {name!r}: used by a foreign key")
         col = self.schema.col(name)  # raises if absent
         if self.schema.primary_key and name in self.schema.primary_key:
             raise ExecutionError(f"cannot drop primary-key column {name!r}")
@@ -1138,6 +1247,10 @@ class Table:
         return k
 
     def truncate(self):
+        if any(child is not self for child, _fk in self.referencing):
+            raise ExecutionError(
+                f"cannot truncate {self.schema.name!r}: referenced by a "
+                "foreign key")
         self.n = 0
         self.version += 1
         self.begin_ts[:] = 0
