@@ -142,3 +142,16 @@ def test_load_data_checked(s, tmp_path):
     f.write_text("1\t1\n2\t42\n")
     with pytest.raises(ExecutionError, match="foreign key"):
         s.execute(f"load data infile '{f}' into table c")
+
+
+def test_information_schema_fk_introspection(s):
+    rows = s.query(
+        "select constraint_name, column_name, referenced_table_schema, "
+        "referenced_table_name, referenced_column_name "
+        "from information_schema.key_column_usage "
+        "where referenced_table_name is not null")
+    assert rows == [("fk_c_pid", "pid", "test", "p", "id")]
+    rows = s.query(
+        "select constraint_name, table_name, referenced_table_name, "
+        "delete_rule from information_schema.referential_constraints")
+    assert rows == [("fk_c_pid", "c", "p", "RESTRICT")]

@@ -382,7 +382,8 @@ class Catalog:
                 f"foreign key target {ref.name}.{ref_cols[0]} must be a "
                 "PRIMARY KEY or single-column UNIQUE index")
         fk = FKInfo(column=cols[0], parent=parent, parent_col=ref_cols[0],
-                    name=f"fk_{child.schema.name}_{cols[0]}")
+                    name=f"fk_{child.schema.name}_{cols[0]}",
+                    parent_db=ref.schema or db)
         return parent, fk
 
     def drop_table(self, db: str, name: str, if_exists: bool = False):
@@ -570,6 +571,49 @@ class Catalog:
                  ("column_key", STRING)],
                 rows,
             )
+        if name == "key_column_usage":
+            rows = []
+            for dbn in sorted(self.databases):
+                for tn in sorted(self.databases[dbn].tables):
+                    t = self.databases[dbn].tables[tn]
+                    for idx in t.indexes.values():
+                        if not idx.unique:
+                            continue
+                        for i, cname in enumerate(idx.columns):
+                            rows.append(("def", dbn, idx.name, dbn, tn,
+                                         cname, i + 1, None, None, None))
+                    for fk in getattr(t, "foreign_keys", ()):
+                        rows.append(("def", dbn, fk.name, dbn, tn,
+                                     fk.column, 1, fk.parent_db,
+                                     fk.parent.schema.name, fk.parent_col))
+            return make(
+                [("constraint_catalog", STRING),
+                 ("constraint_schema", STRING), ("constraint_name", STRING),
+                 ("table_schema", STRING), ("table_name", STRING),
+                 ("column_name", STRING), ("ordinal_position", INT64),
+                 ("referenced_table_schema", STRING),
+                 ("referenced_table_name", STRING),
+                 ("referenced_column_name", STRING)],
+                rows,
+            )
+        if name == "referential_constraints":
+            rows = []
+            for dbn in sorted(self.databases):
+                for tn in sorted(self.databases[dbn].tables):
+                    t = self.databases[dbn].tables[tn]
+                    for fk in getattr(t, "foreign_keys", ()):
+                        rows.append(("def", dbn, fk.name, tn,
+                                     fk.parent_db, fk.parent.schema.name,
+                                     "RESTRICT", "RESTRICT"))
+            return make(
+                [("constraint_catalog", STRING),
+                 ("constraint_schema", STRING), ("constraint_name", STRING),
+                 ("table_name", STRING),
+                 ("unique_constraint_schema", STRING),
+                 ("referenced_table_name", STRING),
+                 ("update_rule", STRING), ("delete_rule", STRING)],
+                rows,
+            )
         if name == "slow_query":
             return make(
                 [("time", STRING), ("db", STRING), ("query_time", FLOAT64),
@@ -596,4 +640,5 @@ class Catalog:
         return None
 
 
-_INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query")
+_INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
+                "key_column_usage", "referential_constraints")

@@ -74,6 +74,7 @@ class FKInfo:
     parent: object          # storage Table of the referenced table
     parent_col: str
     name: str = ""
+    parent_db: str = ""     # the parent's database (cross-db introspection)
 
 
 @dataclass
