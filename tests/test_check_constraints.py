@@ -1,0 +1,102 @@
+"""CHECK constraints (MySQL-8 enforced mode): column-level and named
+table-level predicates validated on every write path; NULL/UNKNOWN
+passes (SQL semantics); string columns refuse at DDL (dictionary codes
+are not stable)."""
+
+import pytest
+
+from tidb_tpu.errors import ExecutionError, UnsupportedError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture
+def s():
+    sess = Session()
+    sess.execute(
+        "create table t (a bigint check (a > 0), b bigint, d date, "
+        "constraint b_lt_100 check (b < 100), check (b >= a))")
+    return sess
+
+
+def test_insert_checked(s):
+    s.execute("insert into t values (1, 50, '2024-01-01')")
+    with pytest.raises(ExecutionError, match="chk"):
+        s.execute("insert into t values (-1, 50, '2024-01-01')")
+    with pytest.raises(ExecutionError, match="b_lt_100"):
+        s.execute("insert into t values (1, 200, '2024-01-01')")
+    with pytest.raises(ExecutionError, match="CHECK"):
+        s.execute("insert into t values (60, 50, '2024-01-01')")  # b >= a
+    assert s.query("select count(*) from t") == [(1,)]
+
+
+def test_null_passes(s):
+    # a NULL operand makes the predicate UNKNOWN -> passes (SQL)
+    s.execute("insert into t values (NULL, NULL, NULL)")
+    assert s.query("select count(*) from t") == [(1,)]
+
+
+def test_update_checked(s):
+    s.execute("insert into t values (1, 50, '2024-01-01')")
+    s.execute("update t set b = 99 where a = 1")
+    with pytest.raises(ExecutionError, match="b_lt_100"):
+        s.execute("update t set b = 150 where a = 1")
+    assert s.query("select b from t") == [(99,)]
+    # multi-column check re-validates when either side changes
+    with pytest.raises(ExecutionError, match="CHECK"):
+        s.execute("update t set a = 100 where a = 1")  # b(99) >= a fails
+
+
+def test_multi_row_batch_atomic(s):
+    with pytest.raises(ExecutionError):
+        s.execute("insert into t values (1, 10, NULL), (2, -5, NULL), "
+                  "(0, 1, NULL)")
+    assert s.query("select count(*) from t") == [(0,)]
+
+
+def test_string_check_refused():
+    sess = Session()
+    with pytest.raises(UnsupportedError, match="string"):
+        sess.execute("create table sc (s varchar(8) check (s <> ''))")
+
+
+def test_show_create_renders_checks(s):
+    _t, ddl = s.execute("show create table t").rows[0]
+    assert "CONSTRAINT `b_lt_100` CHECK (b < 100)" in ddl
+    assert "CHECK (a > 0)" in ddl
+    # emitted DDL round-trips with constraints intact
+    s.execute(ddl.replace("`t`", "`t2`"))
+    with pytest.raises(ExecutionError, match="CHECK"):
+        s.execute("insert into t2 values (-1, 1, NULL)")
+
+
+def test_load_data_checked(s, tmp_path):
+    f = tmp_path / "t.tsv"
+    f.write_text("1\t10\t\\N\n2\t500\t\\N\n")
+    with pytest.raises(ExecutionError, match="b_lt_100"):
+        s.execute(f"load data infile '{f}' into table t")
+    assert s.query("select count(*) from t") == [(0,)]
+
+
+def test_failed_check_wire_leaves_no_table():
+    sess = Session()
+    with pytest.raises(UnsupportedError):
+        sess.execute("create table half (s varchar(8), a bigint, "
+                     "check (s <> ''))")
+    # the failed CREATE left nothing behind: the name is reusable
+    sess.execute("create table half (a bigint check (a > 0))")
+    with pytest.raises(ExecutionError):
+        sess.execute("insert into half values (-1)")
+
+
+def test_drop_checked_column_refused(s):
+    from tidb_tpu.errors import SchemaError
+
+    with pytest.raises(SchemaError, match="CHECK"):
+        s.execute("alter table t drop column b")
+
+
+def test_anonymous_constraint_check():
+    sess = Session()
+    sess.execute("create table ac (a bigint, constraint check (a > 0))")
+    with pytest.raises(ExecutionError, match="CHECK"):
+        sess.execute("insert into ac values (0)")

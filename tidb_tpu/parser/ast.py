@@ -289,6 +289,8 @@ class ColumnDef:
     unique: bool = False
     default: Optional[Expr] = None
     auto_increment: bool = False
+    # column-level CHECK constraints: (expr, verbatim sql text)
+    checks: List[Tuple["Expr", str]] = field(default_factory=list)
 
 @dataclass
 class CreateTableStmt:
@@ -302,6 +304,8 @@ class CreateTableStmt:
     # FOREIGN KEY clauses: (fk_columns, referenced TableName, ref_columns)
     foreign_keys: List[Tuple[List[str], TableName, List[str]]] = \
         field(default_factory=list)
+    # table-level CHECK constraints: (name, expr, verbatim sql text)
+    checks: List[Tuple[str, "Expr", str]] = field(default_factory=list)
 
 @dataclass
 class DropTableStmt:

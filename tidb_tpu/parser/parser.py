@@ -644,11 +644,21 @@ class Parser:
                 if self.peek().kind in ("IDENT", "QIDENT"):
                     kname = self.expect_ident()
                 stmt.indexes.append((kname, self._paren_name_list()))
+            elif self.peek().kind == "IDENT" and \
+                    self.peek().text.lower() == "check":
+                self.next()
+                stmt.checks.append(("", *self._parse_check_expr()))
             elif self.accept_kw("constraint"):
                 # named constraint: swallow FOREIGN KEY / etc. for parse-compat
-                if self.peek().kind in ("IDENT", "QIDENT"):
-                    self.expect_ident()
-                if self.accept_kw("primary"):
+                cname = ""
+                if self.peek().kind in ("IDENT", "QIDENT") and \
+                        self.peek().text.lower() != "check":
+                    cname = self.expect_ident()
+                if self.peek().kind == "IDENT" and \
+                        self.peek().text.lower() == "check":
+                    self.next()
+                    stmt.checks.append((cname, *self._parse_check_expr()))
+                elif self.accept_kw("primary"):
                     self.expect_kw("key")
                     stmt.primary_key = self._paren_name_list()
                 elif self.accept_kw("unique"):
@@ -742,8 +752,21 @@ class Parser:
                 col.auto_increment = True
             elif self.accept_kw("comment"):
                 self.next()
+            elif self.peek().kind == "IDENT" and \
+                    self.peek().text.lower() == "check":
+                self.next()
+                col.checks.append(self._parse_check_expr())
             else:
                 return col
+
+    def _parse_check_expr(self):
+        """CHECK ( expr ) -> (ast expr, verbatim sql text)."""
+        self.expect_op("(")
+        p0 = self.peek().pos
+        e = self.parse_expr()
+        p1 = self.peek().pos
+        self.expect_op(")")
+        return e, self.sql[p0:p1].strip()
 
     def _user_name(self) -> str:
         """'user'[@'host'] — host accepted and ignored (single node)."""

@@ -86,6 +86,29 @@ def _split_load_fields(line: str, delim: str, quote):
     return out
 
 
+def _ast_names(e):
+    """Every EName in an expression AST (dataclass walk)."""
+    import dataclasses as _dc
+
+    out = []
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, A.EName):
+            out.append(x)
+        if _dc.is_dataclass(x) and not isinstance(
+                x, (A.SelectStmt, A.UnionStmt)):
+            for f in _dc.fields(x):
+                v = getattr(x, f.name)
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for item in vs:
+                    if isinstance(item, tuple):
+                        stack.extend(item)
+                    else:
+                        stack.append(item)
+    return out
+
+
 def _nested_into_outfile(node, top) -> bool:
     """INTO OUTFILE anywhere except the top-level SelectStmt (inside a
     UNION arm, derived table, or subquery) is a silent-no-op hazard —
@@ -913,14 +936,54 @@ class Session:
                                       stmt.if_not_exists, engine=stmt.engine,
                                       foreign_keys=stmt.foreign_keys)
         if t is not None and t.schema is schema:
-            # inline UNIQUE KEY / KEY clauses become real (enforced)
-            # indexes — only on a table this statement actually created
-            for kname, kcols in stmt.unique_keys:
-                t.create_index(kname or f"uk_{'_'.join(kcols)}", kcols,
-                               unique=True)
-            for kname, kcols in stmt.indexes:
-                t.create_index(kname or f"idx_{'_'.join(kcols)}", kcols)
+            # inline constraint wiring happens only on a table this
+            # statement actually created — and a failure must UNDO the
+            # creation, or the catalog keeps a half-constrained table
+            try:
+                for kname, kcols in stmt.unique_keys:
+                    t.create_index(kname or f"uk_{'_'.join(kcols)}", kcols,
+                                   unique=True)
+                for kname, kcols in stmt.indexes:
+                    t.create_index(kname or f"idx_{'_'.join(kcols)}", kcols)
+                specs = [("", e, txt) for c in stmt.columns
+                         for e, txt in c.checks] + list(stmt.checks)
+                for i, (cname, e_ast, txt) in enumerate(specs):
+                    self._wire_check(
+                        t, cname or f"{schema.name}_chk_{i + 1}", e_ast, txt)
+            except Exception:
+                self.catalog.drop_table(stmt.table.schema or self.db,
+                                        schema.name, if_exists=True)
+                raise
         return None
+
+    def _wire_check(self, t, name: str, e_ast, sql_text: str) -> None:
+        """Bind + compile one CHECK constraint at DDL time (ref: the
+        reference's CHECK enforcement in MySQL-8 mode). Uids are column
+        names, so the stored evaluator is schema-stable. Dict-encoded
+        string columns are refused: a plan-time LUT would bake in codes
+        of the CREATE-time (empty) dictionary and go stale as it
+        grows."""
+        from tidb_tpu.expression.compiler import compile_expr
+        from tidb_tpu.planner.binder import Binder, PlanCol, Scope
+        from tidb_tpu.planner.rules import _refs
+        from tidb_tpu.storage.table import CheckInfo
+
+        dict_cols = {c.name for c in t.schema.columns
+                     if c.type_.is_dict_encoded}
+        # refuse string-column checks BEFORE binding: the binder's own
+        # dictionary-context errors would otherwise mask this message
+        named = {n.name.lower() for n in _ast_names(e_ast)}
+        if named & {c.lower() for c in dict_cols}:
+            raise UnsupportedError(
+                "CHECK constraints over string columns are not supported "
+                "(dictionary codes are not stable across inserts)")
+        cols = [PlanCol(uid=c.name, name=c.name, type_=c.type_)
+                for c in t.schema.columns]
+        binder = Binder()
+        bound = binder.to_bool(binder.bind_expr(e_ast, Scope(cols, None)))
+        refs = sorted(_refs(bound))
+        t.checks.append(CheckInfo(name=name, pred=compile_expr(bound),
+                                  cols=refs, sql=sql_text))
 
     def _run_insert(self, stmt: A.InsertStmt):
         table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
@@ -1677,6 +1740,9 @@ class Session:
                 lines.append(
                     f"  FOREIGN KEY (`{fk.column}`) REFERENCES "
                     f"`{fk.parent.schema.name}` (`{fk.parent_col}`)")
+            for chk in getattr(t, "checks", ()):
+                lines.append(
+                    f"  CONSTRAINT `{chk.name}` CHECK ({chk.sql})")
             ddl = (f"CREATE TABLE `{stmt.target}` (\n"
                    + ",\n".join(lines)
                    + f"\n) ENGINE={t.engine}")

@@ -99,7 +99,8 @@ class DeltaTable:
         # statement; constrained tables write through
         base = self._base
         return not (any(ix.unique for ix in base.indexes.values())
-                    or base.foreign_keys or base.referencing)
+                    or base.foreign_keys or base.referencing
+                    or base.checks)
 
     # -- write surface -----------------------------------------------------
 

@@ -78,6 +78,19 @@ class FKInfo:
 
 
 @dataclass
+class CheckInfo:
+    """A CHECK constraint: bound predicate over this table's columns
+    (uids == column names), compiled once at DDL time. SQL semantics:
+    a row violates only when the predicate is FALSE — NULL/UNKNOWN
+    passes."""
+
+    name: str
+    pred: object          # compiled chunk -> Column evaluator
+    cols: List[str]
+    sql: str
+
+
+@dataclass
 class IndexInfo:
     """Secondary index metadata. Unique indexes are ENFORCED on every
     write (ref: the reference's index KV records + unique-key checks);
@@ -169,6 +182,8 @@ class Table:
         self.referencing: List[tuple] = []  # (child Table, FKInfo)
         # fk-check cache: col -> (version, sorted live values)
         self._fk_keys: Dict[str, tuple] = {}
+        # CHECK constraints (CheckInfo), wired by the session at DDL time
+        self.checks: List[CheckInfo] = []
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -317,6 +332,7 @@ class Table:
         self._enforce_unique_new(
             start, end, marker=begin_ts if in_txn and txn_deleted else None)
         self._check_fk_parents(start, end)
+        self._check_row_constraints(start, end)
         # before n advances: a violation leaves the table untouched
         self.begin_ts[start:end] = self._next_ts() if begin_ts is None else begin_ts
         self.end_ts[start:end] = MAX_TS
@@ -356,6 +372,7 @@ class Table:
                 raise ExecutionError(f"bulk insert missing NOT NULL column {name!r}")
         self._enforce_unique_new(start, end)
         self._check_fk_parents(start, end)
+        self._check_row_constraints(start, end)
         self.begin_ts[start:end] = 0  # bulk loads are committed "at origin"
         self.end_ts[start:end] = MAX_TS
         self.n = end
@@ -431,6 +448,42 @@ class Table:
                     f"cannot delete or update {self.schema.name!r} row: "
                     f"key {keys[hit][0]!r} is referenced by "
                     f"{child.schema.name}.{fk.column}")
+
+    def _check_row_constraints(self, start: int, end: int,
+                               cols: Optional[set] = None) -> None:
+        """CHECK constraints over newly written rows [start, end):
+        violation = predicate FALSE (NULL passes, per SQL). Runs the
+        compiled evaluator on the host backend regardless of the default
+        device."""
+        if not self.checks:
+            return
+        from tidb_tpu.chunk.chunk import Chunk
+        from tidb_tpu.chunk.column import Column
+        from tidb_tpu.utils.device import host_eager
+
+        n = end - start
+        cap = 8
+        while cap < n:
+            cap *= 2
+        for chk in self.checks:
+            if cols is not None and not (set(chk.cols) & cols):
+                continue
+            cs = {}
+            for cname in chk.cols:
+                t = self.schema.col(cname).type_
+                cs[cname] = Column.from_numpy(
+                    self.data[cname][start:end], t,
+                    valid=self.valid[cname][start:end], capacity=cap)
+            sel = np.zeros(cap, dtype=np.bool_)
+            sel[:n] = True
+            with host_eager():
+                col = chk.pred(Chunk(cs, sel))
+                data = np.asarray(col.data)[:n]
+                valid = np.asarray(col.valid)[:n]
+            bad = valid & ~data.astype(bool)
+            if bad.any():
+                raise ExecutionError(
+                    f"CHECK constraint {chk.name!r} violated: ({chk.sql})")
 
     def _sketch_insert(self, start: int, end: int) -> None:
         """Feed newly written rows into the per-column NDV sketches (a
@@ -618,6 +671,7 @@ class Table:
         upd_cols = set(converted)
         try:
             self._check_fk_parents(start, end, cols=upd_cols)
+            self._check_row_constraints(start, end, cols=upd_cols)
             for pcol in {fk.parent_col for _c, fk in self.referencing
                          if fk.parent_col in upd_cols}:
                 old = self.data[pcol][ids]
@@ -793,6 +847,9 @@ class Table:
                 fk.parent_col == name for _c, fk in self.referencing):
             raise SchemaError(
                 f"cannot drop column {name!r}: used by a foreign key")
+        if any(name in chk.cols for chk in self.checks):
+            raise SchemaError(
+                f"cannot drop column {name!r}: used by a CHECK constraint")
         col = self.schema.col(name)  # raises if absent
         if self.schema.primary_key and name in self.schema.primary_key:
             raise ExecutionError(f"cannot drop primary-key column {name!r}")
