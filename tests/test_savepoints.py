@@ -1,0 +1,142 @@
+"""SAVEPOINT / ROLLBACK TO / RELEASE SAVEPOINT (ref: the session txn
+layer's staging checkpoints). Partial rollback undoes inserts, deletes,
+and updates made after the savepoint — including through the delta
+engine's memtable — while earlier writes and the txn itself survive."""
+
+import pytest
+
+from tidb_tpu.errors import ExecutionError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture
+def s():
+    sess = Session()
+    sess.execute("create table t (a bigint, b bigint)")
+    sess.execute("insert into t values (1, 10), (2, 20)")
+    return sess
+
+
+def test_rollback_to_undoes_inserts(s):
+    s.execute("begin")
+    s.execute("insert into t values (3, 30)")
+    s.execute("savepoint sp1")
+    s.execute("insert into t values (4, 40), (5, 50)")
+    assert s.query("select count(*) from t") == [(5,)]
+    s.execute("rollback to sp1")
+    assert s.query("select a from t order by a") == [(1,), (2,), (3,)]
+    s.execute("commit")
+    assert s.query("select a from t order by a") == [(1,), (2,), (3,)]
+
+
+def test_rollback_to_restores_deletes_and_updates(s):
+    s.execute("begin")
+    s.execute("savepoint sp1")
+    s.execute("delete from t where a = 1")
+    s.execute("update t set b = 99 where a = 2")
+    assert s.query("select a, b from t order by a") == [(2, 99)]
+    s.execute("rollback to savepoint sp1")
+    assert s.query("select a, b from t order by a") == [(1, 10), (2, 20)]
+    s.execute("commit")
+    assert s.query("select a, b from t order by a") == [(1, 10), (2, 20)]
+
+
+def test_nested_savepoints(s):
+    s.execute("begin")
+    s.execute("insert into t values (3, 30)")
+    s.execute("savepoint a")
+    s.execute("insert into t values (4, 40)")
+    s.execute("savepoint b")
+    s.execute("insert into t values (5, 50)")
+    s.execute("rollback to b")  # drops only row 5
+    assert s.query("select max(a) from t") == [(4,)]
+    s.execute("rollback to a")  # drops row 4; a survives (MySQL)
+    assert s.query("select max(a) from t") == [(3,)]
+    with pytest.raises(ExecutionError):  # b died with the rollback to a
+        s.execute("rollback to b")
+    s.execute("rollback to a")  # still valid a second time
+    s.execute("commit")
+    assert s.query("select a from t order by a") == [(1,), (2,), (3,)]
+
+
+def test_release_savepoint(s):
+    s.execute("begin")
+    s.execute("savepoint a")
+    s.execute("insert into t values (3, 30)")
+    s.execute("savepoint b")
+    s.execute("release savepoint a")  # releases a AND b; keeps changes
+    assert s.query("select count(*) from t") == [(3,)]
+    for name in ("a", "b"):
+        with pytest.raises(ExecutionError):
+            s.execute(f"rollback to {name}")
+    s.execute("commit")
+    assert s.query("select count(*) from t") == [(3,)]
+
+
+def test_unknown_savepoint_errors(s):
+    s.execute("begin")
+    with pytest.raises(ExecutionError, match="does not exist"):
+        s.execute("rollback to nope")
+    s.execute("rollback")
+
+
+def test_full_rollback_after_partial(s):
+    s.execute("begin")
+    s.execute("insert into t values (3, 30)")
+    s.execute("savepoint sp")
+    s.execute("insert into t values (4, 40)")
+    s.execute("rollback to sp")
+    s.execute("rollback")  # the whole txn unwinds, incl. row 3
+    assert s.query("select a from t order by a") == [(1,), (2,)]
+
+
+def test_redeclared_savepoint_moves(s):
+    s.execute("begin")
+    s.execute("insert into t values (3, 30)")
+    s.execute("savepoint sp")
+    s.execute("insert into t values (4, 40)")
+    s.execute("savepoint sp")  # re-declare: moves forward
+    s.execute("insert into t values (5, 50)")
+    s.execute("rollback to sp")
+    assert s.query("select max(a) from t") == [(4,)]
+    s.execute("commit")
+
+
+def test_savepoint_with_delta_engine():
+    s = Session()
+    s.execute("create table d (a bigint, tag varchar(8)) engine=delta")
+    s.execute("begin")
+    s.execute("insert into d values (1, 'keep')")
+    s.execute("savepoint sp")
+    s.execute("insert into d values (2, 'drop'), (3, 'drop')")
+    s.execute("rollback to sp")
+    assert s.query("select a, tag from d") == [(1, "keep")]
+    s.execute("commit")
+    assert s.query("select a, tag from d") == [(1, "keep")]
+
+
+def test_replace_after_rollback_to_keeps_uniqueness(s):
+    """_txn_dead pruning: rows whose provisional delete was undone must
+    conflict again (a stale this-txn-deleted mark would open a unique
+    hole)."""
+    s.execute("create table u (k bigint primary key)")
+    s.execute("insert into u values (1)")
+    s.execute("begin")
+    s.execute("savepoint sp")
+    s.execute("delete from u where k = 1")
+    s.execute("rollback to sp")  # the delete is undone: k=1 lives
+    with pytest.raises(Exception):
+        s.execute("insert into u values (1)")  # must be a duplicate again
+    s.execute("rollback")
+
+
+def test_savepoint_starts_txn_without_autocommit(s):
+    s.execute("set autocommit = 0")
+    try:
+        s.execute("savepoint sp1")  # begins the txn (MySQL)
+        s.execute("insert into t values (9, 90)")
+        s.execute("rollback to sp1")
+        assert s.query("select count(*) from t where a = 9") == [(0,)]
+        s.execute("rollback")
+    finally:
+        s.execute("set autocommit = 1")

@@ -24,7 +24,8 @@ __all__ = [
     "InsertStmt", "UpdateStmt", "DeleteStmt", "ColumnDef", "CreateTableStmt",
     "DropTableStmt", "CreateIndexStmt", "DropIndexStmt", "AlterTableStmt",
     "ExplainStmt", "TraceStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
-    "RollbackStmt", "UseStmt", "TruncateStmt", "LoadDataStmt", "IntoOutfile",
+    "RollbackStmt", "SavepointStmt", "RollbackToStmt", "ReleaseSavepointStmt",
+    "UseStmt", "TruncateStmt", "LoadDataStmt", "IntoOutfile",
     "AnalyzeStmt",
     "CreateDatabaseStmt", "DropDatabaseStmt",
     "CreateUserStmt", "DropUserStmt", "GrantStmt", "RevokeStmt",
@@ -426,6 +427,18 @@ class LoadDataStmt:
     lines_term: str = "\n"
     ignore_lines: int = 0
     local: bool = False
+
+@dataclass
+class SavepointStmt:
+    name: str
+
+@dataclass
+class RollbackToStmt:
+    name: str
+
+@dataclass
+class ReleaseSavepointStmt:
+    name: str
 
 @dataclass
 class TruncateStmt:

@@ -124,6 +124,13 @@ class Parser:
         t = self.peek()
         if t.kind == "IDENT" and t.text.lower() == "load":
             return self.parse_load_data()
+        if t.kind == "IDENT" and t.text.lower() == "savepoint":
+            self.next()
+            return SavepointStmt(self.expect_ident())
+        if t.kind == "IDENT" and t.text.lower() == "release":
+            self.next()
+            self._expect_word("savepoint")
+            return ReleaseSavepointStmt(self.expect_ident())
         if t.kind != "KW":
             raise self.error("expected statement keyword")
         kw = t.text
@@ -145,7 +152,7 @@ class Parser:
             "begin": lambda: (self.next(), BeginStmt())[1],
             "start": self.parse_start_txn,
             "commit": lambda: (self.next(), CommitStmt())[1],
-            "rollback": lambda: (self.next(), RollbackStmt())[1],
+            "rollback": self.parse_rollback,
             "use": self.parse_use,
             "truncate": self.parse_truncate,
             "analyze": self.parse_analyze,
@@ -499,6 +506,13 @@ class Parser:
     def _expect_word(self, word: str):
         if not self._accept_word(word):
             raise self.error(f"expected {word.upper()}")
+
+    def parse_rollback(self):
+        self.expect_kw("rollback")
+        if self.accept_kw("to"):
+            self._accept_word("savepoint")
+            return RollbackToStmt(self.expect_ident())
+        return RollbackStmt()
 
     def parse_load_data(self) -> LoadDataStmt:
         self._expect_word("load")

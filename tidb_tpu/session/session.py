@@ -173,6 +173,8 @@ class TxnState:
     # id(table) -> (table, TableTxnLog): commit/rollback touch only the
     # logged rows, not whole version arrays
     logs: dict = dataclasses.field(default_factory=dict)
+    # ordered savepoints: (name, {table_id: (n_ranges, n_ended)})
+    savepoints: list = dataclasses.field(default_factory=list)
 
     def log_for(self, table):
         from tidb_tpu.storage.table import TableTxnLog
@@ -182,6 +184,73 @@ class TxnState:
             entry = (table, TableTxnLog())
             self.logs[id(table)] = entry
         return entry[1]
+
+    def set_savepoint(self, name: str) -> None:
+        """Snapshot per-table log positions (ref: the txn memdb's
+        staging checkpoints backing SAVEPOINT). Delta-engine buffers
+        compact first so every pre-savepoint write has a logged range
+        a later partial rollback will never touch."""
+        for table, _log in list(self.logs.values()):
+            _ = table.n  # delta tables compact on this read
+        snap = {tid: (len(log.ranges), len(log.ended))
+                for tid, (_t, log) in self.logs.items()}
+        # re-declaring a name moves it (MySQL: old one is deleted)
+        self.savepoints = [(n, s) for n, s in self.savepoints if n != name]
+        self.savepoints.append((name, snap))
+
+    def rollback_to(self, name: str) -> bool:
+        """Undo every write made after `name` (kept, per MySQL).
+        Inserted versions after the snapshot die; provisional deletes
+        after it are restored; logs truncate to the snapshot."""
+        import numpy as np
+
+        from tidb_tpu.storage.table import MAX_TS
+
+        idx = next((i for i, (n, _s) in enumerate(self.savepoints)
+                    if n == name), None)
+        if idx is None:
+            return False
+        snap = self.savepoints[idx][1]
+        for tid, (table, log) in self.logs.items():
+            _ = table.n  # compact delta buffers so undo sees every row
+            nr, ne = snap.get(tid, (0, 0))
+            if len(log.ranges) == nr and len(log.ended) == ne:
+                continue  # untouched since the savepoint: keep caches
+            # restore deletes first, then kill inserted versions (a row
+            # both inserted and deleted after the savepoint ends dead)
+            for ids in log.ended[ne:]:
+                e_ = table.end_ts[ids]
+                table.end_ts[ids] = np.where(
+                    e_ == self.marker, MAX_TS, e_)
+            for s, e in log.ranges[nr:]:
+                b = table.begin_ts[s:e]
+                dead = b == self.marker
+                table.end_ts[s:e][dead] = 0
+                b[dead] = 0
+            del log.ranges[nr:]
+            del log.ended[ne:]
+            log.contiguous = False  # version window no longer this txn's own
+            # prune _txn_dead to the restored delete set: stale ids would
+            # let REPLACE treat rows as this-txn-deleted (unique holes)
+            if self.marker in table._txn_dead:
+                keep = set()
+                for ids in log.ended:
+                    keep.update(int(i) for i in ids)
+                table._txn_dead[self.marker] = [
+                    i for i in table._txn_dead[self.marker] if i in keep]
+            table.version += 1
+        del self.savepoints[idx + 1:]
+        return True
+
+    def release_savepoint(self, name: str) -> bool:
+        """Drop `name` and every later savepoint (MySQL semantics); the
+        txn's changes are untouched."""
+        idx = next((i for i, (n, _s) in enumerate(self.savepoints)
+                    if n == name), None)
+        if idx is None:
+            return False
+        del self.savepoints[idx:]
+        return True
 
 
 class Session:
@@ -786,6 +855,27 @@ class Session:
             return None
         if isinstance(stmt, A.RollbackStmt):
             self._rollback()
+            return None
+        if isinstance(stmt, A.SavepointStmt):
+            if self.txn is None and not self.sysvars.get("autocommit"):
+                self._begin()  # MySQL: SAVEPOINT joins/starts the txn
+            if self.txn is not None:  # no-op in autocommit (MySQL)
+                with self.catalog.lock:
+                    self.txn.set_savepoint(stmt.name)
+            return None
+        if isinstance(stmt, A.RollbackToStmt):
+            ok = False
+            if self.txn is not None:
+                with self.catalog.lock:
+                    ok = self.txn.rollback_to(stmt.name)
+            if not ok:
+                raise ExecutionError(
+                    f"SAVEPOINT {stmt.name} does not exist")
+            return None
+        if isinstance(stmt, A.ReleaseSavepointStmt):
+            if self.txn is None or not self.txn.release_savepoint(stmt.name):
+                raise ExecutionError(
+                    f"SAVEPOINT {stmt.name} does not exist")
             return None
         if isinstance(stmt, A.AnalyzeStmt):
             from tidb_tpu.statistics import analyze_table
