@@ -251,3 +251,36 @@ def test_null_string_col_propagates(s):
     # NULL input rows stay NULL through LUT string functions
     assert s.query("select md5(s), substring_index(s, '.', 1) from st "
                    "where s is null") == [(None, None)]
+
+
+def test_time_arithmetic_functions(s):
+    assert q1(s, "select time_to_sec('01:30:05')") == 5405
+    assert q1(s, "select sec_to_time(5405)") == "01:30:05"
+    assert q1(s, "select maketime(2, 10, 30)") == "02:10:30"
+    assert q1(s, "select makedate(2024, 60)") == "2024-02-29"  # leap year
+    assert q1(s, "select makedate(2024, 0)") is None  # MySQL: day<1 -> NULL
+    assert q1(s, "select addtime(timestamp '2024-01-01 23:30:00', "
+                 "'01:45:00')") == "2024-01-02 01:15:00"
+    # datetime-STRING first argument (MySQL accepts it)
+    assert q1(s, "select addtime('2024-01-01 23:30:00', '01:45:00')") == \
+        "2024-01-02 01:15:00"
+    assert q1(s, "select subtime('10:00:00', '00:30:00')") == "09:30:00"
+
+
+def test_time_functions_over_columns(s):
+    s.execute("create table tt (t time, dt datetime)")
+    s.execute("insert into tt values ('08:15:30', '2024-05-05 10:00:00'), "
+              "(NULL, NULL)")
+    assert s.query("select time_to_sec(t), addtime(dt, '02:00:00') "
+                   "from tt") == [
+        (29730, "2024-05-05 12:00:00"), (None, None)]
+    assert s.query("select sec_to_time(time_to_sec(t)) from tt "
+                   "where t is not null") == [("08:15:30",)]
+    # DATETIME arg: seconds OF DAY, not epoch seconds
+    assert s.query("select time_to_sec(dt) from tt where dt is not null") == \
+        [(36000,)]
+    # negative hours through the expression path match the literal path
+    s.execute("create table mh (h bigint)")
+    s.execute("insert into mh values (-2), (2)")
+    assert s.query("select maketime(h, 10, 30) from mh order by h") == \
+        [("-02:10:30",), ("02:10:30",)]

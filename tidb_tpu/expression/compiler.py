@@ -539,6 +539,50 @@ def _tsdiff_months(e: Call, chunk) -> Pair:
     return months.astype(jnp.int64), va & vb
 
 
+def _time_to_sec(e: Call, chunk) -> Pair:
+    a = e.args[0]
+    d, v = eval_expr(a, chunk)
+    micros = d.astype(jnp.int64)
+    if a.type_.kind == TypeKind.DATETIME:
+        # seconds OF DAY, not epoch seconds
+        micros = micros % 86_400_000_000
+    # truncate toward zero (MySQL drops fractional seconds)
+    q = jnp.where(micros >= 0, micros // 1_000_000,
+                  -((-micros) // 1_000_000))
+    return q, v
+
+
+# MySQL TIME range: +-838:59:59
+_TIME_MAX_SECS = 838 * 3600 + 59 * 60 + 59
+
+
+def _sec_to_time(e: Call, chunk) -> Pair:
+    d, v = eval_expr(e.args[0], chunk)
+    secs = jnp.clip(d.astype(jnp.int64), -_TIME_MAX_SECS, _TIME_MAX_SECS)
+    return secs * 1_000_000, v
+
+
+def _makedate(e: Call, chunk) -> Pair:
+    """MAKEDATE(year, dayofyear): day 0 or negative -> NULL (MySQL)."""
+    y, vy = eval_expr(e.args[0], chunk)
+    dn, vd = eval_expr(e.args[1], chunk)
+    y = y.astype(jnp.int64)
+    dn = dn.astype(jnp.int64)
+    jan1 = dates.days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    out = (jan1 + dn - 1).astype(jnp.int32)
+    return out, vy & vd & (dn >= 1)
+
+
+def _addtime(sign: int):
+    def fn(e: Call, chunk) -> Pair:
+        a, b = e.args
+        (da, va), (db, vb) = eval_expr(a, chunk), eval_expr(b, chunk)
+        out = da.astype(jnp.int64) + sign * db.astype(jnp.int64)
+        return out, va & vb
+
+    return fn
+
+
 def _add_months(e: Call, chunk) -> Pair:
     """date/datetime + N months with end-of-month clamping (the device
     path for +/- INTERVAL MONTH/QUARTER/YEAR on column dates)."""
@@ -706,6 +750,11 @@ FUNCS = {
     "unix_timestamp": _unix_timestamp,
     "from_unixtime": _from_unixtime,
     "tsdiff_months": _tsdiff_months,
+    "time_to_sec": _time_to_sec,
+    "sec_to_time": _sec_to_time,
+    "makedate": _makedate,
+    "addtime": _addtime(1),
+    "subtime": _addtime(-1),
     "cot": _strict1(lambda x: 1.0 / jnp.tan(x), cast_float=True),
     "sinh": _strict1(jnp.sinh, cast_float=True),
     "cosh": _strict1(jnp.cosh, cast_float=True),

@@ -967,6 +967,51 @@ class Binder:
                 "December"])
         if name == "from_days":
             return Call(type_=DATE, op="from_days", args=(args[0],))
+        if name == "time_to_sec" and len(args) == 1:
+            a = self._coerce_time_str(args[0])
+            if a.type_.kind not in (TypeKind.TIME, TypeKind.DATETIME):
+                raise PlanError("TIME_TO_SEC needs a time/datetime argument")
+            return Call(type_=INT64, op="time_to_sec", args=(a,))
+        if name == "sec_to_time" and len(args) == 1:
+            a = args[0]
+            if a.type_.kind not in (TypeKind.INT, TypeKind.FLOAT,
+                                    TypeKind.DECIMAL, TypeKind.BOOL):
+                raise PlanError("SEC_TO_TIME needs a numeric argument")
+            if a.type_.kind != TypeKind.INT:
+                a = Cast(type_=INT64, arg=a)
+            return Call(type_=TIME, op="sec_to_time", args=(a,))
+        if name == "makedate" and len(args) == 2:
+            return Call(type_=DATE, op="makedate", args=tuple(args))
+        if name == "maketime" and len(args) == 3:
+            if all(isinstance(a, Literal) for a in args):
+                h, m, sec = (int(a.value) for a in args)
+                sign = -1 if h < 0 else 1
+                total = (abs(h) * 3600 + m * 60 + sec) * 1_000_000
+                return Literal(type_=TIME, value=sign * total)
+            # sign follows the HOUR for column arguments too:
+            # h >= 0 -> h*3600 + m*60 + s; h < 0 -> h*3600 - m*60 - s
+            h, m, sec = args
+
+            def _c(op, x, y):
+                return Call(type_=INT64, op=op, args=(x, y))
+
+            h3600 = _c("mul", h, Literal(type_=INT64, value=3600))
+            m60 = _c("mul", m, Literal(type_=INT64, value=60))
+            pos = _c("add", _c("add", h3600, m60), sec)
+            neg = _c("sub", _c("sub", h3600, m60), sec)
+            secs = Call(type_=INT64, op="if", args=(
+                Call(type_=BOOL, op="lt",
+                     args=(h, Literal(type_=INT64, value=0))),
+                neg, pos))
+            return Call(type_=TIME, op="sec_to_time", args=(secs,))
+        if name in ("addtime", "subtime") and len(args) == 2:
+            a = self._coerce_time_str(args[0])
+            b = self._coerce_time_str(args[1])
+            if b.type_.kind != TypeKind.TIME or a.type_.kind not in (
+                    TypeKind.TIME, TypeKind.DATETIME):
+                raise PlanError(
+                    f"{name.upper()} needs (time|datetime, time) arguments")
+            return Call(type_=a.type_, op=name, args=(a, b))
         if name == "unix_timestamp" and len(args) == 1:
             a = self.coerce_untyped_literal(args[0], DATETIME)
             if not a.type_.is_temporal:
@@ -1213,6 +1258,20 @@ class Binder:
             arg, ["" if m is None else m for m in mapped],
             valid=None if all(m is not None for m in mapped)
             else [m is not None for m in mapped])
+
+    def _coerce_time_str(self, a: Expr) -> Expr:
+        """A string literal in time position: date-dashes mean a
+        DATETIME ('2024-01-01 23:30:00'), otherwise a TIME duration
+        ('01:45:00') — the same heuristic HOUR()/MINUTE() use."""
+        if isinstance(a, Literal) and a.type_.kind == TypeKind.STRING:
+            from tidb_tpu.types import time_to_micros
+
+            s = str(a.value)
+            if "-" in s.lstrip("-"):
+                return Literal(type_=DATETIME,
+                               value=self.parse_datetime_literal(s))
+            return Literal(type_=TIME, value=time_to_micros(s))
+        return a
 
     def _bind_str_to_date(self, args: List[Expr]) -> Expr:
         """STR_TO_DATE(str, fmt): per-dictionary-value host parse -> a
