@@ -1484,4 +1484,6 @@ _IDENTISH_KW = {
     "install", "uninstall", "view", "duplicate",
     # INSERT(str, pos, len, newstr) the string function
     "insert",
+    # non-reserved statement-leading words usable as column names
+    "start", "begin", "rollback", "commit",
 }
