@@ -90,6 +90,9 @@ class ResultSet:
     # column type kinds (tidb_tpu.types.TypeKind) for wire-protocol column
     # metadata; None for synthetic result sets (SHOW/EXPLAIN)
     types: Optional[list] = None
+    # full SQLTypes (precision/scale preserved) when produced by a real
+    # plan — CTAS derives its schema from these
+    sql_types: Optional[list] = None
 
     def __len__(self):
         return len(self.rows)
@@ -123,6 +126,7 @@ def _run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None)
             names=[c.name for c in visible],
             rows=rows,
             types=[c.type_.kind for c in visible],
+            sql_types=[c.type_ for c in visible],
         )
     finally:
         try:

@@ -307,6 +307,8 @@ class CreateTableStmt:
         field(default_factory=list)
     # table-level CHECK constraints: (name, expr, verbatim sql text)
     checks: List[Tuple[str, "Expr", str]] = field(default_factory=list)
+    like: Optional[TableName] = None           # CREATE TABLE t LIKE src
+    as_select: Optional["SelectStmt"] = None   # CREATE TABLE t AS SELECT
 
 @dataclass
 class DropTableStmt:

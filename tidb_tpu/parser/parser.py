@@ -642,6 +642,21 @@ class Parser:
         ine = self._if_not_exists()
         table = self._table_name()
         stmt = CreateTableStmt(table, if_not_exists=ine)
+        if self.accept_kw("like"):
+            stmt.like = self._table_name()
+            return stmt
+        if self.at_op("(") and self.peek(1).kind == "KW" \
+                and self.peek(1).text == "like":
+            self.next()  # (
+            self.next()  # LIKE
+            stmt.like = self._table_name()
+            self.expect_op(")")
+            return stmt
+        if self.at_kw("as", "select", "with"):
+            # CREATE TABLE t AS SELECT ... (AS optional, like MySQL)
+            self.accept_kw("as")
+            stmt.as_select = self.parse_select_or_union()
+            return stmt
         self.expect_op("(")
         while True:
             if self.accept_kw("primary"):

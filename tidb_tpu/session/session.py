@@ -1015,6 +1015,10 @@ class Session:
         return None
 
     def _run_create_table(self, stmt: A.CreateTableStmt):
+        if stmt.like is not None:
+            return self._run_create_like(stmt)
+        if stmt.as_select is not None:
+            return self._run_ctas(stmt)
         cols = []
         pk = list(stmt.primary_key) if stmt.primary_key else None
         for c in stmt.columns:
@@ -1044,6 +1048,90 @@ class Session:
                 self.catalog.drop_table(stmt.table.schema or self.db,
                                         schema.name, if_exists=True)
                 raise
+        return None
+
+    def _run_create_like(self, stmt: A.CreateTableStmt):
+        """CREATE TABLE t LIKE src: clone columns (incl. declared type
+        text, defaults, auto-increment), primary key, and secondary
+        indexes — NOT data, foreign keys, or the source's rows (MySQL
+        semantics; FKs are deliberately not copied, like MySQL)."""
+        import copy
+
+        src_tn = stmt.like
+        self._priv("select", src_tn.schema or self.db, src_tn.name)
+        # (FKs are deliberately not copied — MySQL LIKE semantics)
+        src = self.catalog.table(src_tn.schema or self.db, src_tn.name)
+        schema = copy.deepcopy(src.schema)
+        schema.name = stmt.table.name
+        t = self.catalog.create_table(stmt.table.schema or self.db, schema,
+                                      stmt.if_not_exists, engine=src.engine)
+        if t is not None and t.schema is schema:
+            for name, ix in src.indexes.items():
+                if name != "PRIMARY" and name not in t.indexes:
+                    t.create_index(name, list(ix.columns), unique=ix.unique)
+            # MySQL 8 clones CHECK constraints too (preds bind by column
+            # name against an identical schema, so sharing is sound)
+            t.checks = list(src.checks)
+        return None
+
+    def _run_ctas(self, stmt: A.CreateTableStmt):
+        """CREATE TABLE t AS SELECT ...: infer the schema from the
+        select's output columns (engine types; strings land as varchar)
+        and bulk-insert the result (ref: the reference's CTAS path)."""
+        # refuse BEFORE running the (possibly expensive) select
+        db = stmt.table.schema or self.db
+        if self.catalog.has_table(db, stmt.table.name):
+            if stmt.if_not_exists:
+                return None
+            from tidb_tpu.errors import DuplicateTableError
+
+            raise DuplicateTableError(f"table {stmt.table.name!r} exists")
+        rs = self._run_select(stmt.as_select)
+        from tidb_tpu.types import (DATE, DATETIME, FLOAT64, INT64, STRING,
+                                    TIME, TypeKind)
+
+        kind_to_type = {
+            TypeKind.INT: INT64, TypeKind.FLOAT: FLOAT64,
+            TypeKind.BOOL: parse_type_name("boolean", ()),
+            TypeKind.DATE: DATE, TypeKind.DATETIME: DATETIME,
+            TypeKind.TIME: TIME,
+        }
+        cols = []
+        seen = set()
+        fulls = rs.sql_types or [None] * len(rs.names)
+        for name, kind, full in zip(rs.names, rs.types, fulls):
+            cname = name
+            i = 2
+            while cname in seen:  # duplicate output names disambiguate
+                cname = f"{name}_{i}"
+                i += 1
+            seen.add(cname)
+            if kind == TypeKind.DECIMAL:
+                # the select's exact precision/scale carries over
+                t_ = full if full is not None else parse_type_name(
+                    "decimal", (18, 4))
+            elif kind in (TypeKind.STRING, TypeKind.JSON):
+                t_ = STRING
+            elif full is not None and kind in (TypeKind.ENUM, TypeKind.SET):
+                t_ = full
+            else:
+                t_ = kind_to_type.get(kind, STRING)
+            cols.append(ColumnInfo(cname, t_))
+        schema = TableSchema(stmt.table.name, cols)
+        t = self.catalog.create_table(stmt.table.schema or self.db, schema,
+                                      stmt.if_not_exists)
+        if t is not None and t.schema is schema and rs.rows:
+            def do(txn):
+                for start in range(0, len(rs.rows), 4096):
+                    t.insert_rows(rs.rows[start:start + 4096],
+                                  begin_ts=txn.marker, log=txn.log_for(t))
+
+            self._run_dml(do)
+        # CTAS is DDL: implicit commit even under autocommit=0 (MySQL) —
+        # _run_select may have opened a snapshot txn that would otherwise
+        # hold the inserted rows provisional forever
+        if self.txn is not None:
+            self._commit()
         return None
 
     def _wire_check(self, t, name: str, e_ast, sql_text: str) -> None:
