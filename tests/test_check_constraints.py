@@ -100,3 +100,88 @@ def test_anonymous_constraint_check():
     sess.execute("create table ac (a bigint, constraint check (a > 0))")
     with pytest.raises(ExecutionError, match="CHECK"):
         sess.execute("insert into ac values (0)")
+
+
+class TestAlterConstraints:
+    def test_add_check_validates_existing(self):
+        sess = Session()
+        sess.execute("create table a (v bigint)")
+        sess.execute("insert into a values (5), (10)")
+        sess.execute("alter table a add constraint vmax check (v < 100)")
+        with pytest.raises(ExecutionError, match="vmax"):
+            sess.execute("insert into a values (500)")
+        # existing data violating -> refused, constraint not added
+        with pytest.raises(ExecutionError):
+            sess.execute("alter table a add check (v > 7)")
+        sess.execute("insert into a values (1)")  # only vmax applies
+
+    def test_add_check_ignores_dead_versions(self):
+        sess = Session()
+        sess.execute("create table a (v bigint)")
+        sess.execute("insert into a values (-5)")
+        sess.execute("delete from a where v = -5")  # dead version remains
+        sess.execute("alter table a add check (v > 0)")  # must succeed
+        with pytest.raises(ExecutionError):
+            sess.execute("insert into a values (-1)")
+
+    def test_drop_check(self, s):
+        s.execute("alter table t drop check b_lt_100")
+        s.execute("insert into t values (1, 500, NULL)")  # now legal
+        from tidb_tpu.errors import SchemaError
+
+        with pytest.raises(SchemaError):
+            s.execute("alter table t drop check nope")
+
+    def test_alter_add_drop_foreign_key(self):
+        sess = Session()
+        sess.execute("create table p (id bigint primary key)")
+        sess.execute("insert into p values (1), (2)")
+        sess.execute("create table c (pid bigint)")
+        sess.execute("insert into c values (1), (NULL)")
+        sess.execute("alter table c add constraint fk1 foreign key (pid) "
+                     "references p(id)")
+        with pytest.raises(ExecutionError, match="foreign key"):
+            sess.execute("insert into c values (99)")
+        with pytest.raises(ExecutionError, match="referenced"):
+            sess.execute("delete from p where id = 1")
+        # existing violating data refuses the ADD
+        sess.execute("create table c2 (pid bigint)")
+        sess.execute("insert into c2 values (42)")
+        with pytest.raises(ExecutionError, match="not present"):
+            sess.execute("alter table c2 add foreign key (pid) "
+                         "references p(id)")
+        # drop releases both sides
+        sess.execute("alter table c drop foreign key fk1")
+        sess.execute("insert into c values (99)")
+        sess.execute("delete from p where id = 1")
+
+    def test_constant_check_validated_and_dup_names_refused(self):
+        from tidb_tpu.errors import SchemaError
+
+        sess = Session()
+        sess.execute("create table a (v bigint)")
+        sess.execute("insert into a values (5)")
+        with pytest.raises(ExecutionError):  # constant FALSE caught
+            sess.execute("alter table a add check (1 < 0)")
+        sess.execute("alter table a add constraint c1 check (v > 0)")
+        with pytest.raises(SchemaError, match="duplicate"):
+            sess.execute("alter table a add constraint c1 check (v < 9)")
+        # generated names never collide after drops
+        sess.execute("alter table a add check (v < 1000)")   # a_chk_1
+        sess.execute("alter table a add check (v <> 13)")    # a_chk_2
+        sess.execute("alter table a drop check a_chk_1")
+        sess.execute("alter table a add check (v < 500)")    # a_chk_1 again
+        names = [c.name for c in sess.catalog.table("test", "a").checks]
+        assert sorted(names) == ["a_chk_1", "a_chk_2", "c1"]
+
+    def test_duplicate_fk_name_refused(self):
+        from tidb_tpu.errors import SchemaError
+
+        sess = Session()
+        sess.execute("create table p (id bigint primary key)")
+        sess.execute("create table c (x bigint, y bigint)")
+        sess.execute("alter table c add constraint fk foreign key (x) "
+                     "references p(id)")
+        with pytest.raises(SchemaError, match="duplicate"):
+            sess.execute("alter table c add constraint fk foreign key (y) "
+                         "references p(id)")

@@ -331,10 +331,14 @@ class DropIndexStmt:
 class AlterTableStmt:
     table: TableName
     action: str = ""          # add_column | drop_column | rename | add_index
+                              # | add_foreign_key | drop_foreign_key
+                              # | add_check | drop_check
     column: Optional[ColumnDef] = None
     old_name: Optional[str] = None
     new_name: Optional[str] = None
     index: Optional[Tuple[str, List[str]]] = None
+    fk: Optional[Tuple[List[str], TableName, List[str]]] = None
+    check: Optional[Tuple[str, "Expr", str]] = None
 
 @dataclass
 class TraceStmt:

@@ -872,9 +872,36 @@ class Parser:
                 if self.peek().kind in ("IDENT", "QIDENT"):
                     name = self.expect_ident()
                 return AlterTableStmt(table, "add_index", index=(name, self._paren_name_list()))
+            cname = ""
+            if self.accept_kw("constraint"):
+                if self.peek().kind in ("IDENT", "QIDENT") and \
+                        self.peek().text.lower() != "check":
+                    cname = self.expect_ident()
+            if self.accept_kw("foreign"):
+                self.expect_kw("key")
+                cols = self._paren_name_list()
+                self.expect_kw("references")
+                ref = self._table_name()
+                refcols = self._paren_name_list()
+                return AlterTableStmt(table, "add_foreign_key",
+                                      fk=(cols, ref, refcols), new_name=cname)
+            if self.peek().kind == "IDENT" and \
+                    self.peek().text.lower() == "check":
+                self.next()
+                e, txt = self._parse_check_expr()
+                return AlterTableStmt(table, "add_check", check=(cname, e, txt))
             self.accept_kw("column")
             return AlterTableStmt(table, "add_column", column=self.parse_column_def())
         if self.accept_kw("drop"):
+            if self.accept_kw("foreign"):
+                self.expect_kw("key")
+                return AlterTableStmt(table, "drop_foreign_key",
+                                      old_name=self.expect_ident())
+            if self.peek().kind == "IDENT" and \
+                    self.peek().text.lower() == "check":
+                self.next()
+                return AlterTableStmt(table, "drop_check",
+                                      old_name=self.expect_ident())
             self.accept_kw("column")
             return AlterTableStmt(table, "drop_column", old_name=self.expect_ident())
         if self.accept_kw("rename"):

@@ -1010,6 +1010,52 @@ class Session:
         elif stmt.action == "add_index":
             name, columns = stmt.index
             t.create_index(name or f"idx_{'_'.join(columns)}", columns)
+        elif stmt.action == "add_foreign_key":
+            parent, fk = self.catalog._resolve_foreign_key(db, t, stmt.fk)
+            if stmt.new_name:
+                fk.name = stmt.new_name
+            if any(f.name == fk.name for f in t.foreign_keys):
+                raise SchemaError(
+                    f"duplicate foreign key constraint name {fk.name!r}")
+            # existing rows must already satisfy the constraint (same
+            # probe as every write path, live versions only)
+            if t.n:
+                t._check_fk_parents(0, t.n, fks=[fk], live_only=True)
+            t.foreign_keys.append(fk)
+            parent.referencing.append((t, fk))
+        elif stmt.action == "drop_foreign_key":
+            fk = next((f for f in t.foreign_keys
+                       if f.name == stmt.old_name), None)
+            if fk is None:
+                raise SchemaError(f"no foreign key {stmt.old_name!r}")
+            t.foreign_keys.remove(fk)
+            fk.parent.referencing = [
+                (c, f) for c, f in fk.parent.referencing if f is not fk]
+        elif stmt.action == "add_check":
+            cname, e_ast, txt = stmt.check
+            name = cname
+            if not name:  # first free generated slot
+                i = 1
+                while any(c.name == f"{t.schema.name}_chk_{i}"
+                          for c in t.checks):
+                    i += 1
+                name = f"{t.schema.name}_chk_{i}"
+            self._wire_check(t, name, e_ast, txt)
+            # existing rows must satisfy THE NEW CHECK specifically (no
+            # column filter: a constant predicate has no columns at all)
+            chk = t.checks[-1]
+            try:
+                if t.n:
+                    t._check_row_constraints(0, t.n, live_only=True,
+                                             checks=[chk])
+            except ExecutionError:
+                t.checks.pop()
+                raise
+        elif stmt.action == "drop_check":
+            before = len(t.checks)
+            t.checks = [c for c in t.checks if c.name != stmt.old_name]
+            if len(t.checks) == before:
+                raise SchemaError(f"no CHECK constraint {stmt.old_name!r}")
         else:
             raise UnsupportedError(f"ALTER TABLE {stmt.action}")
         return None
@@ -1160,6 +1206,9 @@ class Session:
         binder = Binder()
         bound = binder.to_bool(binder.bind_expr(e_ast, Scope(cols, None)))
         refs = sorted(_refs(bound))
+        if any(c.name == name for c in t.checks):
+            raise SchemaError(
+                f"duplicate check constraint name {name!r}")
         t.checks.append(CheckInfo(name=name, pred=compile_expr(bound),
                                   cols=refs, sql=sql_text))
 

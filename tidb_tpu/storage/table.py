@@ -409,14 +409,20 @@ class Table:
         return np.array([dic.values[int(c)] for c in vals], dtype=object)
 
     def _check_fk_parents(self, start: int, end: int,
-                          cols: Optional[set] = None) -> None:
+                          cols: Optional[set] = None,
+                          fks=None, live_only: bool = False) -> None:
         """Every non-NULL FK value in rows [start, end) must exist in
         its parent (RESTRICT on the child write). Raises BEFORE the rows
-        become visible."""
-        for fk in self.foreign_keys:
+        become visible. `fks` restricts to specific constraints and
+        `live_only` to present row versions (ALTER TABLE ADD FOREIGN KEY
+        back-filling existing data)."""
+        rows_live = self._present_mask()[start:end] if live_only else None
+        for fk in (fks if fks is not None else self.foreign_keys):
             if cols is not None and fk.column not in cols:
                 continue
             vd = self.valid[fk.column][start:end]
+            if rows_live is not None:
+                vd = vd & rows_live
             vals = self._fk_decode(fk.column,
                                    self.data[fk.column][start:end][vd])
             if not len(vals):
@@ -450,11 +456,14 @@ class Table:
                     f"{child.schema.name}.{fk.column}")
 
     def _check_row_constraints(self, start: int, end: int,
-                               cols: Optional[set] = None) -> None:
-        """CHECK constraints over newly written rows [start, end):
-        violation = predicate FALSE (NULL passes, per SQL). Runs the
-        compiled evaluator on the host backend regardless of the default
-        device."""
+                               cols: Optional[set] = None,
+                               live_only: bool = False,
+                               checks=None) -> None:
+        """CHECK constraints over rows [start, end): violation =
+        predicate FALSE (NULL passes, per SQL). Runs the compiled
+        evaluator on the host backend regardless of the default device.
+        `live_only` restricts to present row versions (ALTER TABLE ADD
+        CHECK validating existing data must skip dead versions)."""
         if not self.checks:
             return
         from tidb_tpu.chunk.chunk import Chunk
@@ -465,7 +474,10 @@ class Table:
         cap = 8
         while cap < n:
             cap *= 2
-        for chk in self.checks:
+        rows_live = None
+        if live_only:
+            rows_live = self._present_mask()[start:end]
+        for chk in (checks if checks is not None else self.checks):
             if cols is not None and not (set(chk.cols) & cols):
                 continue
             cs = {}
@@ -481,6 +493,8 @@ class Table:
                 data = np.asarray(col.data)[:n]
                 valid = np.asarray(col.valid)[:n]
             bad = valid & ~data.astype(bool)
+            if rows_live is not None:
+                bad &= rows_live
             if bad.any():
                 raise ExecutionError(
                     f"CHECK constraint {chk.name!r} violated: ({chk.sql})")
