@@ -34,6 +34,11 @@ def main():
     setting = "--set" in sys.argv
 
     lock = bench.chip_lock()
+    if lock[0] == "unavailable":
+        # chip held by a live client: measure CPU-only, never start a
+        # second TPU client (overlapping clients wedge the tunnel)
+        os.environ["BENCH_PLATFORM"] = "cpu"
+        print(f"chip lock {lock[1]}")
     try:
         load0 = bench.machine_load()
         if load0["loadavg"][0] > BUSY_LOAD or load0.get("busy_procs"):

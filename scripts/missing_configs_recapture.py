@@ -163,6 +163,12 @@ def missing_count(extra: dict) -> int:
 
 def main():
     lock = bench.chip_lock()
+    if lock[0] == "unavailable":
+        # never start a TPU client while a live process holds the chip
+        # (overlapping clients wedge the tunnel — BASELINE.md r2)
+        print(f"chip lock {lock[1]}; aborting on-chip recapture")
+        bench.chip_unlock(lock[0])
+        sys.exit(3)
     ok = True
     try:
         import jax

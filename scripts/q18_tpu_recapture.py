@@ -19,6 +19,12 @@ import bench  # noqa: E402
 
 def main():
     lock = bench.chip_lock()
+    if lock[0] == "unavailable":
+        # never start a TPU client while a live process holds the chip
+        # (overlapping clients wedge the tunnel — BASELINE.md r2)
+        print(f"chip lock {lock[1]}; aborting on-chip recapture")
+        bench.chip_unlock(lock[0])
+        sys.exit(3)
     try:
         extra = {}
         extra["recapture_load_before"] = bench.machine_load()
