@@ -308,6 +308,8 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
     into the artifact."""
     from tidb_tpu.testutil import rows_equal
 
+    from tidb_tpu.utils import dispatch as _dsp
+
     if extra is not None and tag:
         wait_for_idle(tag, extra)
         extra[f"{tag}_load_before"] = machine_load()
@@ -315,10 +317,16 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
     got = s.query(engine_sql)  # compile + warmup
     warm = time.perf_counter() - t0
     best = float("inf")
+    d0 = _dsp.count()
     for _ in range(reps):
+        d0 = _dsp.count()
         t0 = time.perf_counter()
         got = s.query(engine_sql)
         best = min(best, time.perf_counter() - t0)
+    if extra is not None and tag:
+        # device round trips of the last exec: the tunnel pays ~0.5 s
+        # per dispatch, so this is the latency floor in one number
+        extra[f"{tag}_dispatches"] = _dsp.count() - d0
     vs, check, cpu_s = 0.0, "skipped", None
     if sqlite_conn is not None:
         cpu_s = float("inf")

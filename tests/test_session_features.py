@@ -227,3 +227,43 @@ class TestShowCreateTable:
                 s.execute("show create table p")
         finally:
             s.user = "root"
+
+
+class TestDispatchCounting:
+    """Device round trips are first-class in EXPLAIN ANALYZE (the
+    reference surfaces coprocessor request counts the same way): the
+    tunnel pays ~0.5 s per dispatch, so per-operator counts are the
+    latency story in one column."""
+
+    def test_analyze_shows_dispatches(self, sess):
+        rows = sess.query(
+            "explain analyze select b, count(*) from t group by b order by b")
+        text = "\n".join(r[0] for r in rows)
+        assert "dispatches:" in text
+
+    def test_fragment_path_is_o1_dispatches(self):
+        """A 3-table join+agg through the mesh fragment tier must cost a
+        CONSTANT number of device round trips — not per-part or
+        per-chunk (VERDICT r4: per-part emission paid 28 dispatches on
+        q18; now bounded)."""
+        from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.session import Session
+        from tidb_tpu.utils import dispatch
+
+        s = Session(chunk_capacity=1 << 12, mesh=make_mesh())
+        s.execute("create table f (k bigint, v bigint)")
+        s.execute("create table d (k bigint primary key, grp bigint)")
+        s.execute("insert into f values " + ",".join(
+            f"({i % 37}, {i})" for i in range(2000)))
+        s.execute("insert into d values " + ",".join(
+            f"({i}, {i % 5})" for i in range(37)))
+        s.execute("set tidb_device_engine_mode = 'force'")
+        sql = ("select grp, count(*), sum(v) from f join d on f.k = d.k "
+               "group by grp order by grp")
+        want = s.query(sql)  # warm (compiles cached)
+        d0 = dispatch.count()
+        got = s.query(sql)
+        used = dispatch.count() - d0
+        assert got == want
+        # 1 fragment + 1 fetch + a bounded tail of root-side kernels
+        assert used <= 6, f"fragment path used {used} dispatches"

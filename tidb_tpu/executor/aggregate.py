@@ -352,7 +352,10 @@ class HashAggExec(Executor):
         # would pay a round trip per state array
         import jax
 
+        from tidb_tpu.utils import dispatch as dsp
+
         host = jax.device_get(state)
+        dsp.record(site="fetch")
         if self.group_exprs:
             occupied = np.nonzero(host["occ"] > 0)[0]
         else:

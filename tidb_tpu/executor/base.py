@@ -23,6 +23,10 @@ class RuntimeStats:
     chunks: int = 0
     open_wall: float = 0.0
     next_wall: float = 0.0
+    # device round trips (kernel launches + transfers) issued while this
+    # operator (incl. its children) ran — utils.dispatch deltas; EXPLAIN
+    # ANALYZE shows own = cumulative - children's
+    dispatches: int = 0
 
 
 @dataclass

@@ -35,6 +35,7 @@ import numpy as np
 from tidb_tpu.chunk.chunk import Chunk
 from tidb_tpu.chunk.column import Column
 from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.utils.dispatch import counted_jit
 from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
 from tidb_tpu.types import INT64, TypeKind
@@ -275,7 +276,7 @@ class HashJoinExec(Executor):
             count = jnp.where(ok & in_range, end - start, 0)
             return start, count, ok
 
-        return jax.jit(probe)
+        return counted_jit(probe)
 
     def next(self) -> Optional[Chunk]:
         while True:
@@ -394,7 +395,7 @@ class HashJoinExec(Executor):
                 outs = [eval_expr(k, ch) for k in keys_ir]
                 return self._pack_probe(outs)
 
-            self._np_key_fn = jax.jit(keyfn)
+            self._np_key_fn = counted_jit(keyfn)
         packed, valid, in_range = self._np_key_fn(chunk)
         return (np.asarray(packed), np.asarray(valid) & np.asarray(chunk.sel),
                 np.asarray(in_range))
@@ -556,7 +557,7 @@ class HashJoinExec(Executor):
                     keep = keep & other(ch)
                 return ch.with_sel(keep)
 
-            self._filter_fns["mf"] = jax.jit(fn)
+            self._filter_fns["mf"] = counted_jit(fn)
         return self._filter_fns["mf"](out)
 
     def _null_build_chunk(self, chunk: Chunk, sel) -> Chunk:
@@ -612,7 +613,7 @@ class HashJoinExec(Executor):
                 cols[uid] = Column(data, valid, c.type_)
             return Chunk(cols, valid_out)
 
-        return jax.jit(expand)
+        return counted_jit(expand)
 
 
 class IndexJoinExec(Executor):

@@ -118,11 +118,15 @@ def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
             buf[p, :m] = d[s:e]
             vbuf[p, :m] = v[s:e]
 
+    from tidb_tpu.utils import dispatch as dsp
+
     for name in names:
         buf, vbuf, _, _ = host_cols[name]
         data[name] = jax.device_put(buf, spec)
         valid[name] = jax.device_put(vbuf, spec)
+        dsp.record(2, site="stage")
     sel = jax.device_put(live, spec)
+    dsp.record(site="stage")
 
     return ShardedTable(
         mesh=mesh, n_parts=n_parts, rows_per_part=R, total_rows=n,

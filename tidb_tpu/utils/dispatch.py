@@ -1,0 +1,61 @@
+"""Device-dispatch accounting.
+
+Per-dispatch round-trip latency is the dominant cost on a tunneled or
+remote accelerator (VERDICT r4: the on-chip join path paid a ~500 ms
+floor per dispatch and nothing surfaced the count). This module keeps a
+process-global counter incremented at the engine's device choke points:
+
+  - every invocation of a ``cached_jit`` kernel (the local executor
+    engine's compiled expression/sort/join/agg programs)
+  - every mesh fragment dispatch (``ShardCache.get_fragment``)
+  - every host->device staging transfer (``parallel.partition``)
+
+``execdetails`` snapshots the counter around each operator's open/next
+so EXPLAIN ANALYZE shows per-operator dispatch counts — the visibility
+knob the reference gets from its coprocessor request counters
+(ref: util/execdetails CopRuntimeStats' distsql request counts).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+__all__ = ["record", "count", "counted_jit"]
+
+import threading
+
+# thread-local: the server runs each connection's queries on its own
+# thread, so per-operator EXPLAIN ANALYZE deltas must not absorb a
+# concurrent session's kernel launches
+_tls = threading.local()
+
+
+def record(n: int = 1, site: str = "other") -> None:
+    """Count n device round trips (program launches or transfers)."""
+    _tls.count = getattr(_tls, "count", 0) + n
+    by = getattr(_tls, "by_site", None)
+    if by is None:
+        by = _tls.by_site = {}
+    by[site] = by.get(site, 0) + n
+
+
+def count() -> int:
+    return getattr(_tls, "count", 0)
+
+
+def by_site() -> dict:
+    """Cumulative per-site breakdown (for profiling, not EXPLAIN)."""
+    return dict(getattr(_tls, "by_site", {}))
+
+
+def counted_jit(fn: Callable, site: str = "jit", **jit_kwargs) -> Callable:
+    """jax.jit with dispatch accounting on every invocation."""
+    jitted = jax.jit(fn, **jit_kwargs)
+
+    def counted(*args, **kwargs):
+        record(site=site)
+        return jitted(*args, **kwargs)
+
+    return counted

@@ -30,20 +30,26 @@ def instrument(root) -> List:
 
 
 def _wrap(e) -> None:
+    from tidb_tpu.utils import dispatch
+
     orig_open, orig_next = e.open, e.next
     st = e.stats
 
     def open_(ctx):
         t0 = time.perf_counter()
+        d0 = dispatch.count()
         try:
             return orig_open(ctx)
         finally:
             st.open_wall += time.perf_counter() - t0
+            st.dispatches += dispatch.count() - d0
 
     def next_():
         t0 = time.perf_counter()
+        d0 = dispatch.count()
         ch = orig_next()
         st.next_wall += time.perf_counter() - t0
+        st.dispatches += dispatch.count() - d0
         if ch is not None:
             st.chunks += 1
             st.rows += int(np.asarray(ch.sel).sum())
@@ -63,11 +69,14 @@ def analyze_text(root) -> str:
         total = e.stats.open_wall + e.stats.next_wall
         child_total = sum(c.stats.open_wall + c.stats.next_wall for c in e.children)
         own = max(total - child_total, 0.0)
+        own_disp = max(
+            e.stats.dispatches - sum(c.stats.dispatches for c in e.children), 0)
         rows.append((
             indent + type(e).__name__.replace("Exec", ""),
             str(e.stats.rows),
             f"{total * 1e3:.1f}ms",
-            f"open:{e.stats.open_wall * 1e3:.1f}ms own:{own * 1e3:.1f}ms loops:{e.stats.chunks}",
+            f"open:{e.stats.open_wall * 1e3:.1f}ms own:{own * 1e3:.1f}ms "
+            f"loops:{e.stats.chunks} dispatches:{own_disp}",
         ))
         for i, c in enumerate(e.children):
             visit(c, depth + 1, i == len(e.children) - 1)

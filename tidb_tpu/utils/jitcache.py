@@ -35,9 +35,15 @@ def cached_jit(ns: str, key: str, build: Callable[[], Callable], **jit_kwargs) -
     `build` returns the raw python function; it is only called on a miss.
     The jitted fn itself remains shape-polymorphic (jax retraces per
     shape under the same identity), so one entry serves all chunk sizes.
+    Every invocation is dispatch-counted (utils.dispatch) so EXPLAIN
+    ANALYZE can surface per-operator device round trips.
     """
+    from tidb_tpu.utils import dispatch
+
     return get_or_build(
-        _CACHE, (ns, key), lambda: jax.jit(build(), **jit_kwargs), MAX_ENTRIES
+        _CACHE, (ns, key),
+        lambda: dispatch.counted_jit(build(), site=f"jit:{ns}", **jit_kwargs),
+        MAX_ENTRIES
     )
 
 
