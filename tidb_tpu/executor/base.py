@@ -97,6 +97,9 @@ class ResultSet:
     # full SQLTypes (precision/scale preserved) when produced by a real
     # plan — CTAS derives its schema from these
     sql_types: Optional[list] = None
+    # per-column string collation (from the plan column's dictionary)
+    # so CTAS keeps the source's collation; None entries = non-string
+    collations: Optional[list] = None
 
     def __len__(self):
         return len(self.rows)
@@ -131,6 +134,8 @@ def _run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None)
             rows=rows,
             types=[c.type_.kind for c in visible],
             sql_types=[c.type_ for c in visible],
+            collations=[getattr(c.dict_, "collation", None)
+                        for c in visible],
         )
     finally:
         try:

@@ -292,6 +292,8 @@ class ColumnDef:
     auto_increment: bool = False
     # column-level CHECK constraints: (expr, verbatim sql text)
     checks: List[Tuple["Expr", str]] = field(default_factory=list)
+    # COLLATE clause (None = the engine default, utf8mb4_general_ci)
+    collation: Optional[str] = None
 
 @dataclass
 class CreateTableStmt:
@@ -302,6 +304,7 @@ class CreateTableStmt:
     indexes: List[Tuple[str, List[str]]] = field(default_factory=list)
     if_not_exists: bool = False
     engine: Optional[str] = None  # storage engine (kvapi.ENGINES)
+    collation: Optional[str] = None  # table default COLLATE
     # FOREIGN KEY clauses: (fk_columns, referenced TableName, ref_columns)
     foreign_keys: List[Tuple[List[str], TableName, List[str]]] = \
         field(default_factory=list)

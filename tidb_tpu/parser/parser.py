@@ -710,14 +710,22 @@ class Parser:
                 break
         self.expect_op(")")
         # table options: ENGINE=... selects the storage engine
-        # (kvapi.make_table); CHARSET/COMMENT/COLLATE accepted + ignored
-        while self.peek().kind == "KW" and self.peek().text in ("engine", "charset", "character", "comment", "collate"):
+        # (kvapi.make_table); COLLATE=... sets the default collation for
+        # columns without an explicit one; CHARSET/COMMENT accepted
+        # (charset is always utf8mb4 here)
+        while self.peek().kind == "KW" and self.peek().text in (
+                "engine", "charset", "character", "comment", "collate",
+                "default"):
             opt = self.next().text
+            if opt == "default":
+                continue  # DEFAULT CHARSET=... / DEFAULT COLLATE=...
             self.accept_kw("set")
             self.accept_op("=")
             val = self.next().text
             if opt == "engine":
                 stmt.engine = val.lower()
+            elif opt == "collate":
+                stmt.collation = val.lower()
         return stmt
 
     def _if_not_exists(self) -> bool:
@@ -757,12 +765,16 @@ class Parser:
             args = tuple(a)
         self.accept_kw("unsigned")
         self.accept_kw("zerofill")
+        collation = None
         if self.accept_kw("character"):
             self.expect_kw("set")
-            self.next()
+            cs = self.next().text.lower()
+            if cs == "binary":
+                collation = "utf8mb4_bin"
         if self.accept_kw("collate"):
-            self.next()
+            collation = self.next().text.lower()
         col = ColumnDef(name, type_name, args)
+        col.collation = collation
         while True:
             if self.accept_kw("not"):
                 self.expect_kw("null")

@@ -501,6 +501,15 @@ class Binder:
         return e
 
     def bind_string_comparison(self, op: str, l: Expr, r: Expr) -> Expr:
+        # NULL literal: = / <> yield NULL; <=> is IS NULL — no dictionary
+        # context needed (codes are irrelevant against NULL)
+        for side in (l, r):
+            if isinstance(side, Literal) and side.value is None:
+                other = r if side is l else l
+                if op == "<=>":
+                    return Call(type_=BOOL, op="nseq",
+                                args=(other, Literal(type_=other.type_, value=None)))
+                return Literal(type_=BOOL, value=None)
         ld, rd = self._dict_of(l), self._dict_of(r)
 
         # literal vs column: host-side code lookup
@@ -512,14 +521,20 @@ class Binder:
             base = flipped.get(base, base)
             return self._string_col_vs_literal_op(base, r, rd, str(l.value))
 
-        # column vs column
+        # column vs column: compare CANONICAL codes so a _ci collation's
+        # fold-equal values ('abc' = 'ABC') compare equal; canon is
+        # monotone, so order comparisons stay correct too
         if ld is not None and rd is not None:
             ir_op = {"<=>": "nseq"}.get(op) or self._CMP[op]
             if ld == rd:
+                if ld.is_ci:
+                    lut = ld.canon_lut()
+                    l = Lookup.build(l, lut, STRING)
+                    r = Lookup.build(r, lut, STRING)
                 return Call(type_=BOOL, op=ir_op, args=(l, r))
             union = Dictionary.union(ld, rd)
-            lt = Lookup.build(l, ld.translate_to(union).astype(np.int32), STRING)
-            rt = Lookup.build(r, rd.translate_to(union).astype(np.int32), STRING)
+            lt = Lookup.build(l, ld.translate_canon_to(union).astype(np.int32), STRING)
+            rt = Lookup.build(r, rd.translate_canon_to(union).astype(np.int32), STRING)
             return Call(type_=BOOL, op=ir_op, args=(lt, rt))
 
         # literal vs literal
@@ -541,18 +556,30 @@ class Binder:
     def _string_col_vs_literal_op(self, ir_op: str, col: Expr, d: Dictionary, s: str) -> Expr:
         i32 = STRING  # codes are int32; compare as ints
         if ir_op in ("eq", "nseq"):
-            code = d.code_of(s)
-            if code < 0:
+            lo, hi = d.eq_range(s)  # collation class: a code RANGE for _ci
+            if lo >= hi:
                 if ir_op == "nseq":
                     return Literal(type_=BOOL, value=False)
                 # col = 'absent': FALSE for non-null, NULL for null
                 return Call(type_=BOOL, op="ne", args=(col, col))
-            return Call(type_=BOOL, op=ir_op, args=(col, Literal(type_=i32, value=code)))
+            if hi - lo == 1:
+                return Call(type_=BOOL, op=ir_op, args=(col, Literal(type_=i32, value=lo)))
+            if ir_op == "nseq":
+                # null-safe over a class: canon-code compare (NULL -> FALSE)
+                ccol = Lookup.build(col, d.canon_lut(), STRING)
+                return Call(type_=BOOL, op="nseq", args=(ccol, Literal(type_=i32, value=lo)))
+            return Call(type_=BOOL, op="and", args=(
+                Call(type_=BOOL, op="ge", args=(col, Literal(type_=i32, value=lo))),
+                Call(type_=BOOL, op="lt", args=(col, Literal(type_=i32, value=hi)))))
         if ir_op == "ne":
-            code = d.code_of(s)
-            if code < 0:
+            lo, hi = d.eq_range(s)
+            if lo >= hi:
                 return Call(type_=BOOL, op="eq", args=(col, col))  # TRUE/NULL
-            return Call(type_=BOOL, op="ne", args=(col, Literal(type_=i32, value=code)))
+            if hi - lo == 1:
+                return Call(type_=BOOL, op="ne", args=(col, Literal(type_=i32, value=lo)))
+            return Call(type_=BOOL, op="or", args=(
+                Call(type_=BOOL, op="lt", args=(col, Literal(type_=i32, value=lo))),
+                Call(type_=BOOL, op="ge", args=(col, Literal(type_=i32, value=hi)))))
         if ir_op == "lt":
             return Call(type_=BOOL, op="lt", args=(col, Literal(type_=i32, value=d.lower_bound(s))))
         if ir_op == "le":
@@ -617,9 +644,8 @@ class Binder:
             if arg.type_.kind == TypeKind.STRING:
                 if d is None:
                     raise UnsupportedError("IN on string without dictionary")
-                code = d.code_of(str(v.value))
-                if code >= 0:
-                    vals.append(code)
+                lo, hi = d.eq_range(str(v.value))
+                vals.extend(range(lo, hi))  # every collation-equal code
             else:
                 v = self.coerce_untyped_literal(v, arg.type_)
                 val = v.value
@@ -646,6 +672,16 @@ class Binder:
         if d is None:
             raise UnsupportedError("LIKE on non-string or dictionary-less value")
         rx = _like_to_regex(str(pat.value), e.escape)
+        if d.is_ci:
+            # MySQL LIKE honors the column collation: case-insensitive
+            # under the default _ci collations. ASCII keeps the fold
+            # identical to the dictionary's (and sqlite NOCASE's) —
+            # full-Unicode IGNORECASE would make LIKE disagree with =
+            import re as _re
+
+            rx = _re.compile(
+                rx.pattern,
+                (rx.flags | _re.IGNORECASE | _re.ASCII) & ~_re.UNICODE)
         lut = d.match_table(lambda s: rx.fullmatch(s) is not None)
         if e.negated:
             lut = ~lut

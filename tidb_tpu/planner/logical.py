@@ -1072,7 +1072,7 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
         plan = LAggregate(
             schema=list(proj_cols),
             children=[plan],
-            group_exprs=[c.ref() for c in proj_cols],
+            group_exprs=_canon_group_refs(proj_cols),
             group_uids=[c.uid for c in proj_cols],
             aggs=[],
         )
@@ -1086,6 +1086,22 @@ def _build_select_core(stmt: A.SelectStmt, ctx: BuildContext, outer) -> LogicalP
             count=stmt.limit, offset=stmt.offset or 0,
         )
     return plan
+
+
+def _canon_group_refs(cols) -> List[Expr]:
+    """Group-key exprs for DISTINCT / set-operation dedup: _ci string
+    columns dedup by CANONICAL code so fold-equal rows collapse into one
+    (MySQL's case-insensitive DISTINCT); other columns pass through."""
+    out = []
+    for c in cols:
+        e = c.ref()
+        d = getattr(e, "_dict", None) or c.dict_
+        if d is not None and getattr(d, "is_ci", False):
+            ne = Lookup.build(e, d.canon_lut(), STRING)
+            object.__setattr__(ne, "_dict", d)
+            e = ne
+        out.append(e)
+    return out
 
 
 def _build_aggregate(stmt, plan, scope, ctx, agg_calls, alias_map):
@@ -1110,6 +1126,13 @@ def _build_aggregate(stmt, plan, scope, ctx, agg_calls, alias_map):
         ):
             g_ast = alias_map[g_ast.name.lower()]
         bound = binder.bind_expr(g_ast, scope)
+        gdict = getattr(bound, "_dict", None)
+        if gdict is not None and gdict.is_ci:
+            # group CANONICAL codes so fold-equal strings land in one
+            # group (MySQL _ci GROUP BY); the canonical code decodes to
+            # the class representative in the same dictionary
+            bound = binder.attach_dict(
+                Lookup.build(bound, gdict.canon_lut(), STRING), gdict)
         uid = binder.new_uid("group")
         mapping[ast_key(g_ast)] = uid
         group_exprs.append(bound)
@@ -1134,6 +1157,15 @@ def _build_aggregate(stmt, plan, scope, ctx, agg_calls, alias_map):
             if len(call.args) != 1:
                 raise UnsupportedError(f"{func.upper()} with {len(call.args)} args")
             arg = binder.bind_expr(call.args[0], scope)
+            adict = getattr(arg, "_dict", None)
+            if (call.distinct and adict is not None and adict.is_ci
+                    and func not in ("min", "max", "group_concat")):
+                # DISTINCT dedups fold-equal strings under _ci (MySQL);
+                # min/max keep raw codes — code order already collates —
+                # and group_concat keeps its raw arg (its two-phase
+                # rewrite owns the arg shape; its DISTINCT stays bytewise)
+                arg = binder.attach_dict(
+                    Lookup.build(arg, adict.canon_lut(), STRING), adict)
         t = _agg_result_type(func, arg)
         uid = binder.new_uid(func)
         mapping[key] = uid
@@ -1310,8 +1342,14 @@ def _align_dicts(outer_expr: Expr, inner_expr: Expr, inner_dict) -> Tuple[Expr, 
         import numpy as np
 
         union = Dictionary.union(od, idd)
-        outer_expr = Lookup.build(outer_expr, od.translate_to(union).astype(np.int32), STRING)
-        inner_expr = Lookup.build(inner_expr, idd.translate_to(union).astype(np.int32), STRING)
+        outer_expr = Lookup.build(outer_expr, od.translate_canon_to(union).astype(np.int32), STRING)
+        inner_expr = Lookup.build(inner_expr, idd.translate_canon_to(union).astype(np.int32), STRING)
+    elif od.is_ci:
+        # same dictionary on both sides still needs canon codes: raw
+        # codes would compare case-sensitively under a _ci collation
+        lut = od.canon_lut()
+        outer_expr = Lookup.build(outer_expr, lut, STRING)
+        inner_expr = Lookup.build(inner_expr, lut, STRING)
     return outer_expr, inner_expr
 
 
@@ -1537,8 +1575,13 @@ def _in_subquery_to_join(conj: A.EIn, plan, scope, ctx: BuildContext):
             import numpy as np
 
             union = Dictionary.union(od, idd)
-            outer_expr = Lookup.build(outer_expr, od.translate_to(union).astype(np.int32), STRING)
-            inner_expr = Lookup.build(inner_expr, idd.translate_to(union).astype(np.int32), STRING)
+            outer_expr = Lookup.build(outer_expr, od.translate_canon_to(union).astype(np.int32), STRING)
+            inner_expr = Lookup.build(inner_expr, idd.translate_canon_to(union).astype(np.int32), STRING)
+        elif od.is_ci:
+            # same dictionary still needs canonical codes under _ci
+            lut = od.canon_lut()
+            outer_expr = Lookup.build(outer_expr, lut, STRING)
+            inner_expr = Lookup.build(inner_expr, lut, STRING)
 
     kind = "anti" if conj.negated else "semi"
     join = LJoin(
@@ -1687,7 +1730,7 @@ def _build_union(stmt: A.UnionStmt, ctx: BuildContext, outer) -> LogicalPlan:
         ]
         node = LAggregate(
             schema=agg_schema, children=[node],
-            group_exprs=[c.ref() for c in out_cols],
+            group_exprs=_canon_group_refs(out_cols),
             group_uids=[c.uid for c in out_cols],
             aggs=[AggSpec(uid=sl_uid, func="sum", arg=lcol.ref(), type_=INT64),
                   AggSpec(uid=cnt_uid, func="count", arg=None, type_=INT64)],
@@ -1711,7 +1754,7 @@ def _build_union(stmt: A.UnionStmt, ctx: BuildContext, outer) -> LogicalPlan:
             node = LAggregate(
                 schema=list(out_cols),
                 children=[node],
-                group_exprs=[c.ref() for c in out_cols],
+                group_exprs=_canon_group_refs(out_cols),
                 group_uids=[c.uid for c in out_cols],
                 aggs=[],
             )

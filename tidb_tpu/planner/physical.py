@@ -521,10 +521,14 @@ def resolve_scan_col(plan: LogicalPlan, uid: str):
 def _eq_ndv(child: LogicalPlan, expr, child_rows: float) -> Optional[float]:
     """NDV of a join-key expression over `child`, clamped by the child's
     estimated rows (filters reduce distinct counts)."""
-    from tidb_tpu.expression.expr import ColumnRef
+    from tidb_tpu.expression.expr import ColumnRef, Lookup
 
     from tidb_tpu.statistics import column_ndv
 
+    # a collation-canon (or other dictionary) gather cannot raise the
+    # distinct count: estimate through to the underlying column
+    while isinstance(expr, Lookup):
+        expr = expr.arg
     if not isinstance(expr, ColumnRef):
         return None
     r = resolve_scan_col(child, expr.name)
@@ -661,6 +665,13 @@ def _segment_domain(agg: LAggregate) -> Optional[List[int]]:
             d = c.dict_ if c else None
         if d is not None:
             sizes.append(max(len(d), 1))
+        elif (isinstance(g, Lookup) and g.type_.kind == TypeKind.STRING
+                and g.table):
+            # a string-typed gather (collation canon, UPPER, ...) maps
+            # into code space bounded by its LUT's largest output —
+            # plan rewrites drop attached _dict objects, so read the
+            # domain off the table itself
+            sizes.append(int(max(g.table)) + 1)
         elif g.type_.kind == TypeKind.BOOL:
             sizes.append(2)
         else:

@@ -994,17 +994,23 @@ class Session:
             default=default,
             auto_increment=c.auto_increment,
             type_text=text,
+            collation=c.collation,
         )
 
     def _run_alter_table(self, stmt: A.AlterTableStmt):
         db = stmt.table.schema or self.db
         t = self.catalog.table(db, stmt.table.name)
+        def with_table_coll(info):
+            if info.collation is None and t.schema.collation:
+                info.collation = t.schema.collation
+            return info
+
         if stmt.action == "add_column":
-            t.add_column(self._column_info(stmt.column))
+            t.add_column(with_table_coll(self._column_info(stmt.column)))
         elif stmt.action == "drop_column":
             t.drop_column(stmt.old_name)
         elif stmt.action == "modify_column":
-            t.modify_column(self._column_info(stmt.column))
+            t.modify_column(with_table_coll(self._column_info(stmt.column)))
         elif stmt.action == "rename":
             self.catalog.rename_table(db, stmt.table.name, stmt.new_name)
         elif stmt.action == "add_index":
@@ -1070,8 +1076,12 @@ class Session:
         for c in stmt.columns:
             if c.primary_key:
                 pk = [c.name]
-            cols.append(self._column_info(c))
-        schema = TableSchema(stmt.table.name, cols, primary_key=pk)
+            info = self._column_info(c)
+            if info.collation is None and stmt.collation:
+                info.collation = stmt.collation  # table default COLLATE
+            cols.append(info)
+        schema = TableSchema(stmt.table.name, cols, primary_key=pk,
+                             collation=stmt.collation)
         t = self.catalog.create_table(stmt.table.schema or self.db, schema,
                                       stmt.if_not_exists, engine=stmt.engine,
                                       foreign_keys=stmt.foreign_keys)
@@ -1145,7 +1155,8 @@ class Session:
         cols = []
         seen = set()
         fulls = rs.sql_types or [None] * len(rs.names)
-        for name, kind, full in zip(rs.names, rs.types, fulls):
+        colls = rs.collations or [None] * len(rs.names)
+        for name, kind, full, coll in zip(rs.names, rs.types, fulls, colls):
             cname = name
             i = 2
             while cname in seen:  # duplicate output names disambiguate
@@ -1162,7 +1173,8 @@ class Session:
                 t_ = full
             else:
                 t_ = kind_to_type.get(kind, STRING)
-            cols.append(ColumnInfo(cname, t_))
+            # the source column's collation carries over (MySQL CTAS)
+            cols.append(ColumnInfo(cname, t_, collation=coll))
         schema = TableSchema(stmt.table.name, cols)
         t = self.catalog.create_table(stmt.table.schema or self.db, schema,
                                       stmt.if_not_exists)
@@ -1945,6 +1957,10 @@ class Session:
             for c in t.schema.columns:
                 ty = c.type_text or kindmap.get(str(c.type_), str(c.type_))
                 parts = [f"  `{c.name}` {ty}"]
+                if c.type_.is_dict_encoded and c.collation is not None:
+                    # a non-default collation round-trips (the default,
+                    # utf8mb4_general_ci, is implied like MySQL's)
+                    parts.append(f"COLLATE {c.collation}")
                 if c.not_null:
                     parts.append("NOT NULL")
                 if c.auto_increment:

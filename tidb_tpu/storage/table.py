@@ -61,6 +61,15 @@ class ColumnInfo:
     # display-only details like string lengths; SHOW CREATE TABLE needs
     # them back verbatim
     type_text: Optional[str] = None
+    # string collation (ref: MySQL per-column collations); None means the
+    # MySQL-compatible default (utf8mb4_general_ci — case-insensitive)
+    collation: Optional[str] = None
+
+    @property
+    def coll(self) -> str:
+        from tidb_tpu.chunk.dictionary import DEFAULT_COLLATION
+
+        return self.collation or DEFAULT_COLLATION
 
 
 @dataclass
@@ -107,6 +116,9 @@ class TableSchema:
     name: str
     columns: List[ColumnInfo]
     primary_key: Optional[List[str]] = None
+    # table default COLLATE: applied to later ADD/MODIFY COLUMN when the
+    # column declares none (MySQL persists the table default the same way)
+    collation: Optional[str] = None
 
     def col(self, name: str) -> ColumnInfo:
         for c in self.columns:
@@ -151,7 +163,7 @@ class Table:
             self.data[c.name] = np.zeros(cap, dtype=c.type_.np_dtype)
             self.valid[c.name] = np.zeros(cap, dtype=np.bool_)
             if c.type_.is_dict_encoded:
-                self.dicts[c.name] = Dictionary([])
+                self.dicts[c.name] = Dictionary([], c.coll)
         # MVCC visibility range per physical row (see TXN_TS_BASE above)
         self.begin_ts = np.zeros(cap, dtype=np.int64)
         self.end_ts = np.full(cap, MAX_TS, dtype=np.int64)
@@ -540,7 +552,14 @@ class Table:
                         codes.min() < 0 or codes.max() >= len(pool)):
                     raise ExecutionError(
                         f"codes for {name!r} outside [0, {len(pool)})")
-                self.dicts[name] = Dictionary(pool)
+                d = Dictionary(pool, c.coll)
+                self.dicts[name] = d
+                if codes is not None and d.values != list(pool):
+                    # a _ci collation reorders the bytewise pool: remap
+                    # the pre-encoded codes onto the collation order
+                    remap = np.array([d._index[v] for v in pool],
+                                     dtype=np.int32)
+                    arrays[name] = remap[codes]
             if name in arrays:
                 self.data[name][:m] = arrays[name].astype(
                     c.type_.np_dtype, copy=False)
@@ -560,7 +579,7 @@ class Table:
         new = {v for v in vals if v is not None and v not in d}
         if new:
             # dictionary grows: build union dict and re-encode existing codes
-            nd = Dictionary(list(d.values) + list(new))
+            nd = Dictionary(list(d.values) + list(new), d.collation)
             if self.n > 0 and len(d) > 0:
                 trans = d.translate_to(nd)
                 self.data[name][: self.n] = trans[self.data[name][: self.n]]
@@ -845,7 +864,7 @@ class Table:
         self.data[col.name] = np.zeros(self._cap, dtype=col.type_.np_dtype)
         self.valid[col.name] = np.zeros(self._cap, dtype=np.bool_)
         if col.type_.is_dict_encoded:
-            self.dicts[col.name] = Dictionary([])
+            self.dicts[col.name] = Dictionary([], col.coll)
         if col.default is not None:
             # backfill existing rows with the default
             dv = self.to_device_value(col, col.default)
@@ -1004,6 +1023,13 @@ class Table:
             d = self.data[cname][sel]
             v = self.valid[cname][sel]
             ok &= v
+            dic = self.dicts.get(cname)
+            if dic is not None and dic.is_ci:
+                # fold-class representative: 'abc' and 'ABC' must collide
+                # in a unique index under a _ci collation (MySQL)
+                lut = dic.canon_lut()
+                d = lut[np.clip(d.astype(np.int64), 0, max(len(lut) - 1, 0))] \
+                    if len(lut) else d
             if np.issubdtype(d.dtype, np.floating):
                 d = d.astype(np.float64).view(np.int64)
             cols.append(d.astype(np.int64))
@@ -1237,10 +1263,12 @@ class Table:
             col = self.schema.col(cname)
             dv = self.to_device_value(col, v)
             if col.type_.is_dict_encoded:
-                code = self.dicts[cname].code_of(str(dv))
-                if code < 0:
+                # collation-equal class, canonically coded (matches
+                # _uniq_key_rows' canon mapping for _ci columns)
+                lo, hi = self.dicts[cname].eq_range(str(dv))
+                if lo >= hi:
                     return None  # new string: cannot equal any stored key
-                out.append(int(code))
+                out.append(int(lo))
             elif col.type_.kind == TypeKind.FLOAT:
                 out.append(int(np.float64(dv).view(np.int64)))
             else:
@@ -1333,7 +1361,7 @@ class Table:
             self.valid[c.name][:] = False
             self.data[c.name][:] = 0
             if c.type_.is_dict_encoded:
-                self.dicts[c.name] = Dictionary([])
+                self.dicts[c.name] = Dictionary([], c.coll)
 
     # -- reads -------------------------------------------------------------
 

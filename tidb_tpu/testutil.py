@@ -28,7 +28,14 @@ def mirror_to_sqlite(catalog: Catalog, db: str = "test", tables: Optional[Iterab
     for name in tables or catalog.tables(db):
         t = catalog.table(db, name)
         cols = t.schema.columns
-        decls = ", ".join(f"{c.name} {_sqlite_type(c.type_.kind)}" for c in cols)
+        # _ci collations mirror as NOCASE (identical ASCII folding), so
+        # the oracle agrees on equality/LIKE/ORDER BY by construction
+        decls = ", ".join(
+            f"{c.name} {_sqlite_type(c.type_.kind)}"
+            + (" COLLATE NOCASE"
+               if c.type_.kind == TypeKind.STRING and c.coll.endswith("_ci")
+               else "")
+            for c in cols)
         conn.execute(f"CREATE TABLE {name} ({decls})")
         n = t.n
         if n == 0:
