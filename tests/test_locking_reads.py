@@ -1,0 +1,299 @@
+"""Pessimistic locking reads — SELECT ... FOR UPDATE / FOR SHARE /
+LOCK IN SHARE MODE (VERDICT r4 missing #4; SURVEY.md:174-178: the
+reference runs optimistic AND pessimistic transactions over 2PC row
+locks; here the pessimistic tier rides the same provisional-marker
+machinery plus an explicit row-lock map)."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import ExecutionError, WriteConflictError
+from tidb_tpu.session import Session
+
+
+def fresh(catalog=None, **kw):
+    s = Session(catalog=catalog, **kw) if catalog else Session(**kw)
+    return s
+
+
+@pytest.fixture()
+def acct():
+    s = Session()
+    s.execute("create table acct (id bigint primary key, v bigint)")
+    s.execute("insert into acct values (1, 100), (2, 100)")
+    return s
+
+
+class TestBasics:
+    def test_parse_forms(self, acct):
+        assert acct.query("select v from acct where id = 1 for update") == \
+            [(100,)]
+        assert acct.query("select v from acct where id = 1 for share") == \
+            [(100,)]
+        assert acct.query(
+            "select v from acct where id = 1 lock in share mode") == [(100,)]
+
+    def test_locks_release_on_commit(self, acct):
+        a = Session(catalog=acct.catalog)
+        b = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("select * from acct where id = 1 for update")
+        t = acct.catalog.table("test", "acct")
+        assert t.row_locks  # held
+        a.execute("commit")
+        assert not t.row_locks  # released
+        b.execute("update acct set v = 1 where id = 1")  # free again
+
+    def test_locks_release_on_rollback(self, acct):
+        a = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("select * from acct for update")
+        t = acct.catalog.table("test", "acct")
+        assert len(t.row_locks) == 2
+        a.execute("rollback")
+        assert not t.row_locks
+
+    def test_for_update_blocks_writer(self, acct):
+        a = Session(catalog=acct.catalog)
+        b = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("select * from acct where id = 1 for update")
+        with pytest.raises(WriteConflictError):
+            b.execute("update acct set v = 0 where id = 1")
+        # unlocked row stays writable
+        b.execute("update acct set v = 55 where id = 2")
+        a.execute("commit")
+        b.execute("update acct set v = 0 where id = 1")
+        assert acct.query("select v from acct order by id") == [(0,), (55,)]
+
+    def test_share_locks_are_compatible(self, acct):
+        a = Session(catalog=acct.catalog)
+        b = Session(catalog=acct.catalog)
+        a.execute("begin")
+        b.execute("begin")
+        a.execute("select * from acct where id = 1 for share")
+        b.execute("select * from acct where id = 1 for share")  # no wait
+        # but a shared lock still blocks writers
+        c = Session(catalog=acct.catalog)
+        with pytest.raises(WriteConflictError):
+            c.execute("update acct set v = 0 where id = 1")
+        a.execute("commit")
+        b.execute("commit")
+
+    def test_nowait_fails_fast(self, acct):
+        a = Session(catalog=acct.catalog)
+        b = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("select * from acct where id = 1 for update")
+        b.execute("begin")
+        t0 = time.monotonic()
+        with pytest.raises(ExecutionError, match="Lock wait timeout"):
+            b.execute("select * from acct where id = 1 for update nowait")
+        assert time.monotonic() - t0 < 1.0
+        a.execute("rollback")
+        b.execute("rollback")
+
+    def test_wait_timeout(self, acct):
+        a = Session(catalog=acct.catalog)
+        b = Session(catalog=acct.catalog)
+        b.execute("set innodb_lock_wait_timeout = 1")
+        a.execute("begin")
+        a.execute("select * from acct where id = 1 for update")
+        b.execute("begin")
+        t0 = time.monotonic()
+        with pytest.raises(ExecutionError, match="Lock wait timeout"):
+            b.execute("select * from acct where id = 1 for update")
+        assert 0.9 <= time.monotonic() - t0 < 4.0
+        a.execute("rollback")
+        b.execute("rollback")
+
+    def test_waiter_proceeds_after_release(self, acct):
+        a = Session(catalog=acct.catalog)
+        b = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("update acct set v = 77 where id = 1")
+
+        got = []
+
+        def reader():
+            b.execute("begin")
+            got.append(b.query(
+                "select v from acct where id = 1 for update")[0][0])
+            b.execute("commit")
+
+        th = threading.Thread(target=reader)
+        th.start()
+        time.sleep(0.2)
+        a.execute("commit")
+        th.join(timeout=10)
+        assert not th.is_alive()
+        # the locking read waited for the writer and saw the LATEST
+        # committed value, not a stale snapshot
+        assert got == [77]
+
+    def test_locking_read_sees_latest_not_snapshot(self, acct):
+        a = Session(catalog=acct.catalog)
+        b = Session(catalog=acct.catalog)
+        a.execute("begin")
+        assert a.query("select v from acct where id = 1") == [(100,)]
+        b.execute("update acct set v = 42 where id = 1")
+        # consistent read keeps the snapshot...
+        assert a.query("select v from acct where id = 1") == [(100,)]
+        # ...the locking read is a current read (MySQL semantics)
+        assert a.query(
+            "select v from acct where id = 1 for update") == [(42,)]
+        a.execute("commit")
+
+
+class TestBankTransfer:
+    """The VERDICT's acceptance shape: a read-compute-write transfer
+    that is WRONG without locking reads and RIGHT with them."""
+
+    N = 4
+    PER = 5
+
+    def _run(self, catalog, lock_suffix):
+        errs = []
+
+        def worker(tid):
+            s = Session(catalog=catalog)
+            src, dst = (1, 2) if tid % 2 == 0 else (2, 1)
+            for _ in range(self.PER):
+                for _attempt in range(300):
+                    try:
+                        s.execute("begin")
+                        # ordered acquisition (always id 1 then 2):
+                        # deadlock-free without relying on the timeout
+                        b1 = s.query(
+                            "select v from acct where id = 1"
+                            + lock_suffix)[0][0]
+                        b2 = s.query(
+                            "select v from acct where id = 2"
+                            + lock_suffix)[0][0]
+                        amt = 7
+                        nb1 = b1 - amt if src == 1 else b1 + amt
+                        nb2 = b2 + amt if src == 1 else b2 - amt
+                        s.execute(f"update acct set v = {nb1} where id = 1")
+                        s.execute(f"update acct set v = {nb2} where id = 2")
+                        s.execute("commit")
+                        break
+                    except (WriteConflictError, ExecutionError):
+                        try:
+                            s.execute("rollback")
+                        except Exception:  # noqa: BLE001
+                            pass
+                        time.sleep(0.01)
+                else:
+                    errs.append("retries exhausted")
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(self.N)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return errs
+
+    def test_transfers_correct_with_for_update(self, acct):
+        errs = self._run(acct.catalog, " for update")
+        assert not errs, errs
+        # equal numbers of opposite transfers: balances return to 100
+        assert acct.query("select sum(v) from acct") == [(200,)]
+        assert acct.query("select v from acct order by id") == \
+            [(100,), (100,)]
+
+    def test_snapshot_reads_lose_updates_without_locks(self, acct):
+        """The SAME transfer loop with plain snapshot reads goes wrong:
+        stale balances are written back (the write itself no longer
+        conflicts once the first writer committed). This documents WHY
+        FOR UPDATE exists; if this ever starts passing with correct
+        totals, the snapshot model changed and the locking tests above
+        are the contract."""
+        barrier = threading.Barrier(2, timeout=30)
+        s1 = Session(catalog=acct.catalog)
+        s2 = Session(catalog=acct.catalog)
+
+        def t1():
+            s1.execute("begin")
+            b = s1.query("select v from acct where id = 1")[0][0]
+            barrier.wait()  # both have read 100
+            for _ in range(100):
+                try:
+                    s1.execute(f"update acct set v = {b - 7} where id = 1")
+                    s1.execute("commit")
+                    return
+                except (WriteConflictError, ExecutionError):
+                    try:
+                        s1.execute("rollback")
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.02)
+
+        def t2():
+            s2.execute("begin")
+            b = s2.query("select v from acct where id = 1")[0][0]
+            barrier.wait()
+            for _ in range(100):
+                try:
+                    s2.execute(f"update acct set v = {b + 7} where id = 1")
+                    s2.execute("commit")
+                    return
+                except (WriteConflictError, ExecutionError):
+                    try:
+                        s2.execute("rollback")
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.02)
+
+        th1, th2 = threading.Thread(target=t1), threading.Thread(target=t2)
+        th1.start(), th2.start()
+        th1.join(30), th2.join(30)
+        # +7 and -7 against the same 100: a correct interleaving ends at
+        # 100; the stale write ends at 93 or 107 — a LOST update
+        final = acct.query("select v from acct where id = 1")[0][0]
+        assert final in (93, 107), final
+
+
+class TestReviewRegressions:
+    """Round-5 review findings on the locking-read surface."""
+
+    def test_derived_table_refused(self, acct):
+        with pytest.raises(Exception, match="derived tables"):
+            acct.execute("select * from (select v from acct) d for update")
+
+    def test_union_refused(self, acct):
+        with pytest.raises(Exception, match="UNION"):
+            acct.execute("select v from acct union "
+                         "select v from acct for update")
+
+    def test_outfile_with_lock_writes_file(self, acct, tmp_path):
+        p = tmp_path / "out.txt"
+        acct.execute(
+            f"select v from acct where id = 1 into outfile '{p}' for update")
+        assert p.read_text().strip() == "100"
+
+    def test_modify_column_collate(self):
+        s = Session()
+        s.execute("create table mc (a varchar(10))")
+        s.execute("insert into mc values ('abc'),('ABC')")
+        assert s.query("select count(*) from mc where a = 'ABC'") == [(2,)]
+        s.execute("alter table mc modify column a varchar(10) "
+                  "collate utf8mb4_bin")
+        assert s.query("select count(*) from mc where a = 'ABC'") == [(1,)]
+        assert "COLLATE utf8mb4_bin" in s.query("show create table mc")[0][1]
+        s.execute("alter table mc modify column a varchar(10) "
+                  "collate utf8mb4_general_ci")
+        assert s.query("select count(*) from mc where a = 'abc'") == [(2,)]
+
+    def test_modify_collate_unique_violation_rolls_back(self):
+        s = Session()
+        s.execute("create table mu (a varchar(10) collate utf8mb4_bin "
+                  "unique)")
+        s.execute("insert into mu values ('abc'),('ABC')")
+        with pytest.raises(Exception, match="[Dd]uplicate|unique"):
+            s.execute("alter table mu modify column a varchar(10) "
+                      "collate utf8mb4_general_ci")
+        # unchanged semantics after the failed ALTER
+        assert s.query("select count(*) from mu where a = 'abc'") == [(1,)]

@@ -237,6 +237,10 @@ class SelectStmt:
     hints: List[Tuple[str, List[str]]] = field(default_factory=list)
     # (HINT_NAME_lower, [args]) from /*+ ... */ after SELECT
     into_outfile: Optional["IntoOutfile"] = None  # SELECT ... INTO OUTFILE
+    # locking read: None | "update" (FOR UPDATE) | "share" (FOR SHARE /
+    # LOCK IN SHARE MODE); NOWAIT fails instead of waiting
+    lock_mode: Optional[str] = None
+    lock_nowait: bool = False
 
 @dataclass
 class UnionStmt:

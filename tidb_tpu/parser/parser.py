@@ -280,6 +280,22 @@ class Parser:
                 self.expect_kw("by")
                 into.lines_term = self.next().text
             stmt.into_outfile = into
+        # locking reads: FOR UPDATE / FOR SHARE / LOCK IN SHARE MODE
+        # (ref: pessimistic SELECT locking over the 2PC row locks)
+        if self.accept_kw("for"):
+            if self.accept_kw("update"):
+                stmt.lock_mode = "update"
+            elif self._accept_word("share"):
+                stmt.lock_mode = "share"
+            else:
+                raise self.error("expected UPDATE or SHARE after FOR")
+            if self._accept_word("nowait"):
+                stmt.lock_nowait = True
+        elif self._accept_word("lock"):
+            self.expect_kw("in")
+            self._expect_word("share")
+            self._expect_word("mode")
+            stmt.lock_mode = "share"
         return stmt
 
     def _parse_field_options(self, target) -> None:

@@ -109,6 +109,15 @@ def _ast_names(e):
     return out
 
 
+def _union_arms(u):
+    """Leaf SelectStmts of a UnionStmt tree."""
+    for side in (u.left, u.right):
+        if isinstance(side, A.UnionStmt):
+            yield from _union_arms(side)
+        else:
+            yield side
+
+
 def _nested_into_outfile(node, top) -> bool:
     """INTO OUTFILE anywhere except the top-level SelectStmt (inside a
     UNION arm, derived table, or subquery) is a silent-no-op hazard —
@@ -173,6 +182,8 @@ class TxnState:
     # id(table) -> (table, TableTxnLog): commit/rollback touch only the
     # logged rows, not whole version arrays
     logs: dict = dataclasses.field(default_factory=dict)
+    # tables holding this txn's pessimistic row locks (FOR UPDATE/SHARE)
+    lock_tables: dict = dataclasses.field(default_factory=dict)
     # ordered savepoints: (name, {table_id: (n_ranges, n_ended)})
     savepoints: list = dataclasses.field(default_factory=list)
 
@@ -271,6 +282,9 @@ class Session:
         self._prepared: dict = {}  # stmt_id -> (ast, n_params)
         self._stmt_id = 0
         self.txn: Optional[TxnState] = None
+        # set while a FOR UPDATE/SHARE read runs: reads latest committed
+        # instead of the txn snapshot (MySQL locking reads are current)
+        self._lock_read = False
         self.mesh = mesh
         self._shard_cache = None
         if mesh is not None:
@@ -324,6 +338,10 @@ class Session:
             if self.catalog.txn_status(txn.marker) is None:
                 committer.rollback()
             raise
+        finally:
+            # the txn is decided either way: pessimistic locks release
+            for t in txn.lock_tables.values():
+                t.release_locks(txn.marker)
         from tidb_tpu.utils.metrics import TXN_TOTAL
 
         TXN_TOTAL.inc(outcome="commit")
@@ -344,6 +362,8 @@ class Session:
     def _rollback_locked(self, txn) -> None:
         from tidb_tpu.storage.txn2pc import TwoPhaseCommitter
 
+        for t in txn.lock_tables.values():
+            t.release_locks(txn.marker)
         TwoPhaseCommitter(
             self.catalog, txn.marker, list(txn.logs.values())).rollback()
         from tidb_tpu.utils.metrics import TXN_TOTAL
@@ -529,7 +549,8 @@ class Session:
                 budget=quota,
                 spill_enabled=bool(self.sysvars.get("tidb_enable_tmp_storage_on_oom")),
             ),
-            read_ts=self.txn.read_ts if self.txn is not None else None,
+            read_ts=(None if self._lock_read else
+                     self.txn.read_ts if self.txn is not None else None),
             txn_marker=self.txn.marker if self.txn is not None else 0,
             device_agg=bool(self.sysvars.get("tidb_enable_tpu_exec"))
             and self._device_engine_auto(),
@@ -613,6 +634,93 @@ class Session:
 
             return _dc.replace(stmt, hints=list(b.stmt.hints))
         return stmt
+
+    def _run_locking_select(self, stmt) -> ResultSet:
+        # NOTE on cost: the visible query runs once, plus one hidden
+        # __rowid__ shadow query per base table. Folding rowids into the
+        # main select is impossible in general (DISTINCT/GROUP BY/agg
+        # shapes have no per-row identity), so the shadow pass is the
+        # uniform mechanism; locking reads are OLTP-sized by nature.
+        """SELECT ... FOR UPDATE / SHARE (ref: pessimistic locking reads
+        over the 2PC row locks; SURVEY.md:174-178).
+
+        Pessimistic protocol: under the catalog lock, (1) read at the
+        LATEST committed snapshot (MySQL locking reads are current
+        reads, not consistent reads), (2) collect the matched base-table
+        row ids via the hidden __rowid__ columns, (3) if every row is
+        free, register the locks and return. On conflict: release the
+        catalog lock, wait, retry the whole read — bounded by
+        innodb_lock_wait_timeout (timeout breaks any deadlock cycle);
+        NOWAIT fails on the first conflict. Locks release at
+        commit/rollback; without an open txn the check still serializes
+        against other txns' locks but registers nothing (the statement
+        is its own transaction)."""
+        import time as _time
+
+        mode = "x" if stmt.lock_mode == "update" else "s"
+        targets = []
+        if stmt.from_ is not None:
+            # refuse shapes whose rows we cannot map back to base-table
+            # row ids: silently locking NOTHING would hand the caller a
+            # read-modify-write foot-gun (review r5 finding)
+            def visit(src):
+                if isinstance(src, A.TableName):
+                    yield src
+                elif isinstance(src, A.Join):
+                    yield from visit(src.left)
+                    yield from visit(src.right)
+                else:
+                    raise UnsupportedError(
+                        "FOR UPDATE/SHARE over derived tables is not "
+                        "supported; lock the base tables directly")
+            for tn in visit(stmt.from_):
+                db = tn.schema or self.db
+                if any(c.name == tn.name for c in getattr(stmt, "ctes", ())):
+                    raise UnsupportedError(
+                        "FOR UPDATE/SHARE over a CTE is not supported")
+                targets.append((tn, self.catalog.table(db, tn.name)))
+        timeout = 0.0 if stmt.lock_nowait else float(
+            self.sysvars.get("innodb_lock_wait_timeout"))
+        deadline = _time.monotonic() + timeout
+        marker = self.txn.marker if self.txn is not None else 0
+        while True:
+            with self.catalog.lock:
+                self._lock_read = True
+                try:
+                    rs = self._run_select(stmt)
+                    per_table = []
+                    for tn, table in targets:
+                        alias = tn.alias or tn.name
+                        shadow = A.SelectStmt(
+                            items=[A.SelectItem(
+                                A.EName("__rowid__", qualifier=alias))],
+                            from_=stmt.from_, where=stmt.where,
+                            ctes=getattr(stmt, "ctes", []))
+                        srs = self._run_select(shadow)
+                        ids = np.array(
+                            sorted({r[0] for r in srs.rows
+                                    if r[0] is not None}),
+                            dtype=np.int64)
+                        per_table.append((table, ids))
+                finally:
+                    self._lock_read = False
+                conflict = None
+                for table, ids in per_table:
+                    conflict = table.lock_conflict(ids, marker, mode)
+                    if conflict:
+                        conflict = f"{table.schema.name}: {conflict}"
+                        break
+                if conflict is None:
+                    if self.txn is not None:
+                        for table, ids in per_table:
+                            table.lock_rows(ids, marker, mode)
+                            self.txn.lock_tables[id(table)] = table
+                    return rs
+            if _time.monotonic() >= deadline:
+                raise ExecutionError(
+                    "Lock wait timeout exceeded; try restarting "
+                    f"transaction ({conflict})")
+            _time.sleep(0.02)
 
     def _run_select(self, stmt) -> ResultSet:
         if self.txn is None and not self.sysvars.get("autocommit"):
@@ -719,7 +827,15 @@ class Session:
                     "INTO OUTFILE is only supported on a top-level SELECT")
             if into is not None:
                 self._precheck_outfile(into)  # fail BEFORE the query runs
-            rs = self._run_select(self._apply_binding(stmt))
+            if isinstance(stmt, A.UnionStmt) and any(
+                    getattr(arm, "lock_mode", None)
+                    for arm in _union_arms(stmt)):
+                # MySQL rejects FOR UPDATE on union arms too
+                raise UnsupportedError("FOR UPDATE is not allowed with UNION")
+            if getattr(stmt, "lock_mode", None) is not None:
+                rs = self._run_locking_select(self._apply_binding(stmt))
+            else:
+                rs = self._run_select(self._apply_binding(stmt))
             if into is not None:
                 return self._write_outfile(rs, into)
             return rs
@@ -1093,6 +1209,12 @@ class Session:
                 for kname, kcols in stmt.unique_keys:
                     t.create_index(kname or f"uk_{'_'.join(kcols)}", kcols,
                                    unique=True)
+                for c in stmt.columns:
+                    # column-level UNIQUE attribute == a unique key
+                    if c.unique and not any(
+                            ix.columns == [c.name] and ix.unique
+                            for ix in t.indexes.values()):
+                        t.create_index(f"uk_{c.name}", [c.name], unique=True)
                 for kname, kcols in stmt.indexes:
                     t.create_index(kname or f"idx_{'_'.join(kcols)}", kcols)
                 specs = [("", e, txt) for c in stmt.columns

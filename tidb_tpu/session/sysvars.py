@@ -78,6 +78,9 @@ _reg(
     # spill host operator state to disk instead of cancelling on OOM
     SysVar("tidb_enable_tmp_storage_on_oom", True, BOTH, "bool"),
     SysVar("autocommit", True, BOTH, "bool"),
+    # pessimistic locking-read wait bound (seconds; MySQL default is 50,
+    # shortened here — analytics sessions should fail fast)
+    SysVar("innodb_lock_wait_timeout", 5, BOTH, "int"),
     SysVar("sql_mode", "STRICT_TRANS_TABLES", BOTH, "str"),
     SysVar("version", "8.0.11-tidb-tpu-0.1.0", GLOBAL, "str"),
     SysVar("version_comment", "tidb_tpu: TPU-native SQL execution engine", GLOBAL, "str"),

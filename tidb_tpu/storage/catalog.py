@@ -190,6 +190,7 @@ class Catalog:
                         t.txn_commit(marker, ts)
                     else:
                         t.txn_rollback(marker)
+                    t.release_locks(marker)  # crashed FOR UPDATE locks
             self.finish_txn(marker)
             n += 1
         return n

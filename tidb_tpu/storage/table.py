@@ -196,6 +196,11 @@ class Table:
         self._fk_keys: Dict[str, tuple] = {}
         # CHECK constraints (CheckInfo), wired by the session at DDL time
         self.checks: List[CheckInfo] = []
+        # pessimistic row locks from SELECT ... FOR UPDATE / SHARE
+        # (ref: the pessimistic-txn lock CF): rid -> {txn marker: "x"|"s"}.
+        # Guarded by the catalog lock like every mutation; writers check
+        # it in _writable_mask, commit/rollback release by marker.
+        self.row_locks: Dict[int, Dict[int, str]] = {}
 
     def _next_ts(self) -> int:
         if self.ts_source is not None:
@@ -599,7 +604,18 @@ class Table:
     def _writable_mask(self, ids: np.ndarray, marker: int) -> np.ndarray:
         """Mask over `ids` this write may stamp: rows already ended by
         another txn's marker (lock conflict) or by a commit (optimistic
-        conflict) raise; rows already ended by OUR marker are skipped."""
+        conflict) raise; rows already ended by OUR marker are skipped.
+        Rows pessimistically locked by ANOTHER txn (FOR UPDATE/SHARE)
+        also conflict — a shared lock blocks writers too."""
+        if self.row_locks:
+            for rid in ids.tolist():
+                holders = self.row_locks.get(int(rid))
+                if holders and any(m != marker for m in holders):
+                    from tidb_tpu.errors import WriteConflictError
+
+                    raise WriteConflictError(
+                        "write conflict: row locked by another "
+                        f"transaction (table {self.schema.name!r})")
         in_bounds = (ids >= 0) & (ids < self.n)
         clipped = np.clip(ids, 0, max(self.n - 1, 0))
         cur = np.where(in_bounds, self.end_ts[clipped], MAX_TS)
@@ -617,6 +633,46 @@ class Table:
                 f"(table {self.schema.name!r})"
             )
         return in_bounds & ~ours
+
+    def lock_conflict(self, ids: np.ndarray, marker: int, mode: str):
+        """First conflict preventing `marker` from locking `ids` in
+        `mode` ("x"|"s"), or None. Caller holds the catalog lock.
+        Conflicts: another holder when either side is exclusive, or a
+        provisional write (insert/update/delete marker) by another txn."""
+        for rid in ids.tolist():
+            holders = self.row_locks.get(int(rid))
+            if holders and any(
+                    m != marker and (mode == "x" or md == "x")
+                    for m, md in holders.items()):
+                return f"row {int(rid)} locked"
+        if len(ids):
+            in_b = (ids >= 0) & (ids < self.n)
+            cl = np.clip(ids, 0, max(self.n - 1, 0))
+            ets = np.where(in_b, self.end_ts[cl], MAX_TS)
+            bts = np.where(in_b, self.begin_ts[cl], 0)
+            prov = ((ets >= TXN_TS_BASE) & (ets < MAX_TS) & (ets != marker)) \
+                | ((bts >= TXN_TS_BASE) & (bts != marker))
+            if prov.any():
+                return f"row {int(ids[prov.argmax()])} has an uncommitted write"
+        return None
+
+    def lock_rows(self, ids: np.ndarray, marker: int, mode: str) -> None:
+        """Register `marker`'s locks over `ids` (no conflict checking —
+        call lock_conflict first, same catalog-lock hold). An existing
+        shared lock upgrades to exclusive, never downgrades."""
+        for rid in ids.tolist():
+            holders = self.row_locks.setdefault(int(rid), {})
+            if mode == "x" or holders.get(marker) != "x":
+                holders[marker] = mode
+
+    def release_locks(self, marker: int) -> None:
+        """Drop every lock `marker` holds (commit/rollback/resolve)."""
+        if not self.row_locks:
+            return
+        for rid in list(self.row_locks):
+            holders = self.row_locks[rid]
+            if holders.pop(marker, None) is not None and not holders:
+                del self.row_locks[rid]
 
     def delete_rows(self, row_ids: np.ndarray, end_ts: Optional[int] = None,
                     marker: int = 0, log: Optional["TableTxnLog"] = None) -> int:
@@ -920,8 +976,22 @@ class Table:
         def lossy(msg):
             raise ExecutionError(f"MODIFY {col.name}: {msg}")
 
-        if ok == nk and not (ok == TypeKind.DECIMAL
-                             and old.type_.scale != col.type_.scale):
+        saved_dict = saved_coll = None
+        if (ok == nk == TypeKind.STRING
+                and col.collation is not None and col.collation != old.coll):
+            # MODIFY ... COLLATE: re-sort the dictionary under the new
+            # collation and translate stored codes; new-collation unique
+            # semantics re-validate below like any narrowing
+            d_old = self.dicts[col.name]
+            saved_dict, saved_coll = d_old, old.collation
+            d_new = Dictionary(list(d_old.values), col.collation)
+            trans = d_old.translate_to(d_new)
+            conv = np.where(valid, trans[np.clip(data, 0, max(len(trans) - 1, 0))]
+                            if len(trans) else data, 0)
+            self.dicts[col.name] = d_new
+            old.collation = col.collation
+        elif ok == nk and not (ok == TypeKind.DECIMAL
+                               and old.type_.scale != col.type_.scale):
             conv = data
         elif ok not in ok_kinds or nk not in ok_kinds:
             lossy(f"cannot convert {ok.name} to {nk.name}")
@@ -980,6 +1050,9 @@ class Table:
                     self._check_unique(idx)
         except ExecutionError:
             self.data[col.name] = saved
+            if saved_dict is not None:
+                self.dicts[col.name] = saved_dict
+                old.collation = saved_coll
             raise
         old.type_ = col.type_
         old.not_null = col.not_null
@@ -1219,6 +1292,13 @@ class Table:
             d = self.data[cname][sel]
             v = self.valid[cname][sel]
             ok &= v
+            dic = self.dicts.get(cname)
+            if dic is not None and dic.is_ci:
+                # _ci uniqueness folds case variants (same mapping as
+                # _uniq_key_rows)
+                lut = dic.canon_lut()
+                if len(lut):
+                    d = lut[np.clip(d.astype(np.int64), 0, len(lut) - 1)]
             if np.issubdtype(d.dtype, np.floating):
                 d = d.astype(np.float64).view(np.int64)
             cols.append(d.astype(np.int64))
