@@ -155,3 +155,146 @@ def test_information_schema_fk_introspection(s):
         "select constraint_name, table_name, referenced_table_name, "
         "delete_rule from information_schema.referential_constraints")
     assert rows == [("fk_c_pid", "c", "p", "RESTRICT")]
+
+
+class TestCompositeAndActions:
+    """Round-5 FK completeness (VERDICT r4 weak #7): multi-column keys
+    and CASCADE / SET NULL referential actions."""
+
+    @pytest.fixture()
+    def s(self):
+        s = Session()
+        s.execute("create table p (a bigint, b bigint, v bigint, "
+                  "primary key (a, b))")
+        s.execute("insert into p values (1,1,10),(1,2,20),(2,1,30)")
+        return s
+
+    def test_composite_fk_restrict(self, s):
+        s.execute("create table c (x bigint, y bigint, "
+                  "foreign key (x, y) references p (a, b))")
+        s.execute("insert into c values (1,1),(2,1)")
+        with pytest.raises(Exception, match="foreign key"):
+            s.execute("insert into c values (9,9)")
+        # partial NULL passes (MySQL simple match)
+        s.execute("insert into c values (9, NULL)")
+        with pytest.raises(Exception, match="referenced"):
+            s.execute("delete from p where a = 1 and b = 1")
+
+    def test_composite_requires_matching_unique(self, s):
+        with pytest.raises(Exception, match="UNIQUE|PRIMARY"):
+            s.execute("create table c2 (x bigint, y bigint, "
+                      "foreign key (x, y) references p (b, v))")
+
+    def test_on_delete_cascade(self, s):
+        s.execute("create table c (x bigint, y bigint, w bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on delete cascade)")
+        s.execute("insert into c values (1,1,100),(1,2,200),(2,1,300)")
+        s.execute("delete from p where a = 1")
+        assert s.query("select w from c order by w") == [(300,)]
+
+    def test_cascade_recurses(self, s):
+        s.execute("create table mid (m bigint primary key, a bigint, "
+                  "b bigint, foreign key (a, b) references p (a, b) "
+                  "on delete cascade)")
+        s.execute("create table leaf (m bigint, "
+                  "foreign key (m) references mid (m) on delete cascade)")
+        s.execute("insert into mid values (7, 1, 1)")
+        s.execute("insert into leaf values (7)")
+        s.execute("delete from p where a = 1 and b = 1")
+        assert s.query("select count(*) from mid") == [(0,)]
+        assert s.query("select count(*) from leaf") == [(0,)]
+
+    def test_on_delete_set_null(self, s):
+        s.execute("create table c (x bigint, y bigint, w bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on delete set null)")
+        s.execute("insert into c values (1,1,100),(2,1,300)")
+        s.execute("delete from p where a = 1 and b = 1")
+        assert s.query("select x, y, w from c order by w") == \
+            [(None, None, 100), (2, 1, 300)]
+
+    def test_set_null_rejects_not_null_child(self, s):
+        s.execute("create table c (x bigint not null, y bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on delete set null)")
+        s.execute("insert into c values (1,1)")
+        with pytest.raises(Exception, match="NOT NULL"):
+            s.execute("delete from p where a = 1 and b = 1")
+
+    def test_on_update_cascade(self, s):
+        s.execute("create table c (x bigint, y bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on update cascade)")
+        s.execute("insert into c values (1,1),(1,2)")
+        s.execute("update p set b = 5 where a = 1 and b = 1")
+        assert s.query("select x, y from c order by y") == [(1, 2), (1, 5)]
+        # and the child still FK-checks against the NEW parent keys
+        with pytest.raises(Exception, match="foreign key"):
+            s.execute("insert into c values (1, 1)")
+
+    def test_on_update_set_null(self, s):
+        s.execute("create table c (x bigint, y bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on update set null)")
+        s.execute("insert into c values (1,1)")
+        s.execute("update p set b = 9 where a = 1 and b = 1")
+        assert s.query("select x, y from c") == [(None, None)]
+
+    def test_on_update_restrict_default(self, s):
+        s.execute("create table c (x bigint, y bigint, "
+                  "foreign key (x, y) references p (a, b))")
+        s.execute("insert into c values (1,1)")
+        with pytest.raises(Exception, match="referenced"):
+            s.execute("update p set b = 9 where a = 1 and b = 1")
+
+    def test_cascade_rolls_back_with_txn(self, s):
+        s.execute("create table c (x bigint, y bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on delete cascade)")
+        s.execute("insert into c values (1,1),(2,1)")
+        s.execute("begin")
+        s.execute("delete from p where a = 1 and b = 1")
+        assert s.query("select count(*) from c") == [(1,)]
+        s.execute("rollback")
+        assert s.query("select count(*) from c") == [(2,)]
+        assert s.query("select count(*) from p") == [(3,)]
+
+    def test_show_create_actions(self, s):
+        s.execute("create table c (x bigint, y bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on delete cascade on update set null)")
+        ddl = s.query("show create table c")[0][1]
+        assert "FOREIGN KEY (`x`, `y`) REFERENCES `p` (`a`, `b`)" in ddl
+        assert "ON DELETE CASCADE" in ddl
+        assert "ON UPDATE SET NULL" in ddl
+
+    def test_referential_constraints_rules(self, s):
+        s.execute("create table c (x bigint, y bigint, "
+                  "foreign key (x, y) references p (a, b) "
+                  "on delete cascade)")
+        rows = s.query(
+            "select delete_rule, update_rule from "
+            "information_schema.referential_constraints "
+            "where table_name = 'c'")
+        assert rows == [("CASCADE", "RESTRICT")]
+
+
+class TestFkCollation:
+    def test_ci_fk_matches_across_case(self):
+        s = Session()
+        s.execute("create table p2 (name varchar(20) primary key)")
+        s.execute("insert into p2 values ('ABC')")
+        s.execute("create table c2 (n varchar(20), "
+                  "foreign key (n) references p2 (name) on delete cascade)")
+        s.execute("insert into c2 values ('abc')")  # ci-equal: accepted
+        s.execute("delete from p2 where name = 'abc'")  # cascades
+        assert s.query("select count(*) from c2") == [(0,)]
+
+    def test_mixed_collation_fk_rejected(self):
+        s = Session()
+        s.execute("create table p3 (name varchar(20) collate utf8mb4_bin "
+                  "primary key)")
+        with pytest.raises(Exception, match="collation"):
+            s.execute("create table c3 (n varchar(20), "
+                      "foreign key (n) references p3 (name))")

@@ -21,6 +21,7 @@ from tidb_tpu.planner.physical import (
     PHashJoin,
     PIndexJoin,
     PIndexRangeScan,
+    PPartitionScan,
     PLimit,
     PProjection,
     PPointGet,
@@ -92,6 +93,16 @@ def build_executor(plan: PhysicalPlan) -> Executor:
             range_hi=base.range_hi,
             lo_incl=base.lo_incl,
             hi_incl=base.hi_incl,
+            out_schema=plan.schema,
+        )
+    if isinstance(base, PPartitionScan):
+        from tidb_tpu.executor.scan import PartitionScanExec
+
+        return PartitionScanExec(
+            schema=base.schema,
+            table=base.table,
+            stages=scan_stages_for(base, stages),
+            part_ids=base.part_ids,
             out_schema=plan.schema,
         )
     if isinstance(base, PScan):

@@ -170,33 +170,24 @@ class PointGetExec(TableScanExec):
         return self._emit_rows(self._rows)
 
 
-class IndexRangeScanExec(TableScanExec):
-    """Index range access: binary-search the sorted index cache into a
-    compact row-id set, then stage only those rows (ref: executor's
-    IndexLookUpExecutor index→table double read, SURVEY.md:91). Like
-    PointGetExec, the pipeline runs eagerly — range bounds are literals
-    and a jitted pipeline per ad-hoc range would churn XLA compiles —
-    but rows stream in chunk_capacity batches, so a wide range behaves
-    like a pre-filtered scan, not one giant gather."""
+class RowIdScanExec(TableScanExec):
+    """Base for access paths that resolve to a compact row-id set
+    (index ranges, pruned partitions) and stage only those rows (ref:
+    executor's IndexLookUpExecutor index→table double read,
+    SURVEY.md:91). Like PointGetExec, the pipeline runs eagerly —
+    the row sets come from literal-keyed probes and a jitted pipeline
+    per ad-hoc probe would churn XLA compiles — but rows stream in
+    chunk_capacity batches, so a wide set behaves like a pre-filtered
+    scan, not one giant gather."""
 
-    def __init__(self, schema, table, stages, index_name, eq_values,
-                 range_lo, range_hi, lo_incl, hi_incl, out_schema=None):
-        super().__init__(schema, table, stages, out_schema)
-        self.index_name = index_name
-        self.eq_values = eq_values
-        self.range_lo = range_lo
-        self.range_hi = range_hi
-        self.lo_incl = lo_incl
-        self.hi_incl = hi_incl
+    def _row_ids(self, ctx: ExecContext):
+        raise NotImplementedError
 
     def open(self, ctx: ExecContext) -> None:
         Executor.open(self, ctx)
         self.ctx = ctx
         self._fn = make_pipeline_fn(self.stages) if self.stages else None
-        rows = self.table.index_range_lookup(
-            self.index_name, self.eq_values, self.range_lo, self.range_hi,
-            self.lo_incl, self.hi_incl,
-            read_ts=ctx.read_ts, marker=ctx.txn_marker)
+        rows = self._row_ids(ctx)
         self._rows = rows
         cap = ctx.chunk_capacity
         self._slices = [(s, min(s + cap, len(rows)))
@@ -209,6 +200,40 @@ class IndexRangeScanExec(TableScanExec):
         start, end = self._slices[self._i]
         self._i += 1
         return self._emit_rows(self._rows[start:end])
+
+
+class IndexRangeScanExec(RowIdScanExec):
+    """Index range access: binary-search the sorted index cache into a
+    compact row-id set."""
+
+    def __init__(self, schema, table, stages, index_name, eq_values,
+                 range_lo, range_hi, lo_incl, hi_incl, out_schema=None):
+        super().__init__(schema, table, stages, out_schema)
+        self.index_name = index_name
+        self.eq_values = eq_values
+        self.range_lo = range_lo
+        self.range_hi = range_hi
+        self.lo_incl = lo_incl
+        self.hi_incl = hi_incl
+
+    def _row_ids(self, ctx: ExecContext):
+        return self.table.index_range_lookup(
+            self.index_name, self.eq_values, self.range_lo, self.range_hi,
+            self.lo_incl, self.hi_incl,
+            read_ts=ctx.read_ts, marker=ctx.txn_marker)
+
+
+class PartitionScanExec(RowIdScanExec):
+    """Pruned partitioned-table access: reads only the matching
+    partitions' cached row ids (storage/table.py partition_rows)."""
+
+    def __init__(self, schema, table, stages, part_ids, out_schema=None):
+        super().__init__(schema, table, stages, out_schema)
+        self.part_ids = part_ids
+
+    def _row_ids(self, ctx: ExecContext):
+        return self.table.partition_rows(
+            self.part_ids, read_ts=ctx.read_ts, marker=ctx.txn_marker)
 
 
 class SelectionExec(Executor):

@@ -309,6 +309,9 @@ class CreateTableStmt:
     if_not_exists: bool = False
     engine: Optional[str] = None  # storage engine (kvapi.ENGINES)
     collation: Optional[str] = None  # table default COLLATE
+    # PARTITION BY: ("range", col, [(pname, upper_or_None_for_MAXVALUE)])
+    # or ("hash", col, n_partitions)
+    partition: Optional[tuple] = None
     # FOREIGN KEY clauses: (fk_columns, referenced TableName, ref_columns)
     foreign_keys: List[Tuple[List[str], TableName, List[str]]] = \
         field(default_factory=list)

@@ -710,16 +710,10 @@ class Parser:
                     stmt.unique_keys.append(("", self._paren_name_list()))
                 elif self.accept_kw("foreign"):
                     self.expect_kw("key")
-                    stmt.foreign_keys.append((
-                        self._paren_name_list(),
-                        (self.expect_kw("references"), self._table_name())[1],
-                        self._paren_name_list()))
+                    stmt.foreign_keys.append(self._parse_fk_spec())
             elif self.accept_kw("foreign"):
                 self.expect_kw("key")
-                stmt.foreign_keys.append((
-                    self._paren_name_list(),
-                    (self.expect_kw("references"), self._table_name())[1],
-                    self._paren_name_list()))
+                stmt.foreign_keys.append(self._parse_fk_spec())
             else:
                 stmt.columns.append(self.parse_column_def())
             if not self.accept_op(","):
@@ -742,7 +736,55 @@ class Parser:
                 stmt.engine = val.lower()
             elif opt == "collate":
                 stmt.collation = val.lower()
+        # PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n)...)
+        # | PARTITION BY HASH (col) PARTITIONS n   (ref: table partitions
+        # pruned like the reference's partition pruning)
+        if self._accept_word("partition"):
+            self.expect_kw("by")
+            if self._accept_word("range"):
+                self.expect_op("(")
+                col = self.expect_ident()
+                self.expect_op(")")
+                self.expect_op("(")
+                parts = []
+                while True:
+                    self._expect_word("partition")
+                    pname = self.expect_ident()
+                    self._expect_word("values")
+                    self._expect_word("less")
+                    self._expect_word("than")
+                    if self.accept_op("("):
+                        upper = self._int_literal("partition bound")
+                        self.expect_op(")")
+                    else:
+                        self._expect_word("maxvalue")
+                        upper = None
+                    parts.append((pname, upper))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                stmt.partition = ("range", col, parts)
+            elif self._accept_word("hash"):
+                self.expect_op("(")
+                col = self.expect_ident()
+                self.expect_op(")")
+                self._expect_word("partitions")
+                n = self._int_literal("partition count")
+                if n <= 0:
+                    raise self.error("PARTITIONS must be positive")
+                stmt.partition = ("hash", col, n)
+            else:
+                raise self.error("expected RANGE or HASH after PARTITION BY")
         return stmt
+
+    def _int_literal(self, what: str) -> int:
+        """A (possibly negative) integer literal token."""
+        neg = bool(self.accept_op("-"))
+        t = self.peek()
+        if t.kind != "NUM" or "." in t.text:
+            raise self.error(f"expected integer {what}")
+        self.next()
+        return -int(t.text) if neg else int(t.text)
 
     def _if_not_exists(self) -> bool:
         if self.accept_kw("if"):
@@ -815,6 +857,39 @@ class Parser:
                 col.checks.append(self._parse_check_expr())
             else:
                 return col
+
+    def _parse_fk_spec(self):
+        """FOREIGN KEY (...) REFERENCES t (...) [ON DELETE act] [ON
+        UPDATE act] -> (cols, ref_table, ref_cols, on_delete, on_update)."""
+        cols = self._paren_name_list()
+        self.expect_kw("references")
+        ref = self._table_name()
+        refcols = self._paren_name_list()
+        on_delete = on_update = "restrict"
+        while self.accept_kw("on"):
+            if self.accept_kw("delete"):
+                tgt = "delete"
+            else:
+                self.expect_kw("update")
+                tgt = "update"
+            if self._accept_word("cascade"):
+                act = "cascade"
+            elif self._accept_word("restrict"):
+                act = "restrict"
+            elif self.accept_kw("set"):
+                self.expect_kw("null")
+                act = "set_null"
+            elif self._accept_word("no"):
+                self._expect_word("action")
+                act = "restrict"  # NO ACTION == RESTRICT here (no
+                # deferred checking exists)
+            else:
+                raise self.error("expected FK referential action")
+            if tgt == "delete":
+                on_delete = act
+            else:
+                on_update = act
+        return cols, ref, refcols, on_delete, on_update
 
     def _parse_check_expr(self):
         """CHECK ( expr ) -> (ast expr, verbatim sql text)."""
@@ -907,12 +982,9 @@ class Parser:
                     cname = self.expect_ident()
             if self.accept_kw("foreign"):
                 self.expect_kw("key")
-                cols = self._paren_name_list()
-                self.expect_kw("references")
-                ref = self._table_name()
-                refcols = self._paren_name_list()
                 return AlterTableStmt(table, "add_foreign_key",
-                                      fk=(cols, ref, refcols), new_name=cname)
+                                      fk=self._parse_fk_spec(),
+                                      new_name=cname)
             if self.peek().kind == "IDENT" and \
                     self.peek().text.lower() == "check":
                 self.next()

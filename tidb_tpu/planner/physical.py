@@ -31,7 +31,8 @@ from tidb_tpu.planner.logical import (
 __all__ = [
     "PhysicalPlan", "PScan", "PSelection", "PProjection", "PHashAgg",
     "PHashJoin", "PSort", "PTopN", "PLimit", "PUnion", "PWindow",
-    "PPointGet", "PIndexRangeScan", "PIndexJoin", "lower", "explain_text",
+    "PPointGet", "PIndexRangeScan", "PPartitionScan", "PIndexJoin",
+    "lower", "explain_text",
 ]
 
 
@@ -120,6 +121,25 @@ class PIndexRangeScan(PScan):
             rb = "]" if self.hi_incl else ")"
             parts.append(f"range:{lb}{lo},{hi}{rb}")
         return ", ".join(parts)
+
+
+@dataclass
+class PPartitionScan(PScan):
+    """Pruned access over a partitioned table (ref: the planner's
+    partition pruning feeding per-partition scans): the WHERE's bounds
+    on the partition column keep only matching partitions; the executor
+    reads those partitions' cached row-id sets (storage/table.py
+    partition_rows) instead of the full table."""
+
+    part_ids: Tuple[int, ...] = ()
+    part_names: Tuple[str, ...] = ()
+
+    def op_name(self):
+        return "PartitionScan"
+
+    def op_info(self):
+        return (f"table:{self.table_name}, "
+                f"partitions:{','.join(self.part_names)}")
 
 
 # a gathered index row costs more than a streamed scan row (random access
@@ -265,6 +285,43 @@ def inject_point_get(plan: PhysicalPlan) -> PhysicalPlan:
                     lo_incl=lo_incl, hi_incl=hi_incl))
         return best
 
+    def prune_partitions(node):
+        """Matching partition ids for the scan's pushed bounds on the
+        partition column, or None when nothing prunes."""
+        import bisect
+
+        pi = getattr(node.table.schema, "partition", None)
+        if pi is None:
+            return None
+        uid_to_col = {c.uid: c for c in node.schema}
+        eqs, los, his = collect_bounds(node.pushed_cond, uid_to_col)
+        name = pi.column
+        total = pi.count()
+        if name in eqs:
+            v = eqs[name]
+            if pi.kind == "hash":
+                return [v % max(pi.n_parts, 1)]
+            pid = int(pi.ids_of_values(
+                np.array([v]), np.array([True]))[0])
+            return [pid] if pid < total else []
+        if pi.kind == "hash":
+            return None  # hash prunes on equality only
+        lo, hi = los.get(name), his.get(name)
+        if lo is None and hi is None:
+            return None
+        bounds = [u for u in pi.uppers if u is not None]
+        lo_pid, hi_pid = 0, total - 1
+        if lo is not None:
+            v, incl = lo
+            lo_pid = bisect.bisect_right(bounds, v if incl else v + 1)
+        if hi is not None:
+            v, incl = hi
+            hi_pid = min(bisect.bisect_right(bounds, v if incl else v - 1),
+                         total - 1)
+        if lo_pid > hi_pid or lo_pid >= total:
+            return []
+        return list(range(lo_pid, hi_pid + 1))
+
     def rewrite(node):
         node.children = [rewrite(c) for c in node.children]
         if (type(node) is PScan and node.table is not None
@@ -272,6 +329,18 @@ def inject_point_get(plan: PhysicalPlan) -> PhysicalPlan:
             best = best_access(node)
             if best is not None:
                 return best[1]
+            kept = prune_partitions(node)
+            pi = getattr(node.table.schema, "partition", None)
+            if kept is not None and pi is not None \
+                    and len(kept) < pi.count():
+                frac = max(len(kept), 0) / max(pi.count(), 1)
+                return PPartitionScan(
+                    schema=node.schema,
+                    est_rows=max(node.est_rows * frac, 0.0),
+                    db=node.db, table_name=node.table_name,
+                    table=node.table, pushed_cond=node.pushed_cond,
+                    part_ids=tuple(kept),
+                    part_names=tuple(pi.part_name(p) for p in kept))
         return node
 
     return rewrite(plan)

@@ -1196,8 +1196,35 @@ class Session:
             if info.collation is None and stmt.collation:
                 info.collation = stmt.collation  # table default COLLATE
             cols.append(info)
+        part = None
+        if stmt.partition is not None:
+            from tidb_tpu.storage.table import PartitionInfo
+
+            kind, pcol, spec = stmt.partition
+            pinfo = next((c for c in cols if c.name == pcol), None)
+            if pinfo is None:
+                raise SchemaError(f"unknown partition column {pcol!r}")
+            if pinfo.type_.kind != TypeKind.INT:
+                # MySQL likewise rejects non-integer partition functions
+                raise SchemaError(
+                    f"partition column {pcol!r} must be integer-typed")
+            if kind == "range":
+                uppers = [u for _n, u in spec]
+                finite = [u for u in uppers if u is not None]
+                strictly_inc = all(a < b for a, b in zip(finite, finite[1:]))
+                maxvalue_ok = all(u is not None for u in uppers[:-1])
+                if not strictly_inc or not maxvalue_ok:
+                    raise SchemaError(
+                        "RANGE partition bounds must be strictly "
+                        "increasing with MAXVALUE last")
+                part = PartitionInfo(kind="range", column=pcol,
+                                     names=[n for n, _u in spec],
+                                     uppers=uppers)
+            else:
+                part = PartitionInfo(kind="hash", column=pcol,
+                                     n_parts=int(spec))
         schema = TableSchema(stmt.table.name, cols, primary_key=pk,
-                             collation=stmt.collation)
+                             collation=stmt.collation, partition=part)
         t = self.catalog.create_table(stmt.table.schema or self.db, schema,
                                       stmt.if_not_exists, engine=stmt.engine,
                                       foreign_keys=stmt.foreign_keys)
@@ -1442,7 +1469,8 @@ class Session:
                     m[key] = ("p", pi)
         if dead:
             table.delete_rows(np.array(dead, dtype=np.int64),
-                              end_ts=txn.marker, marker=txn.marker, log=log)
+                              end_ts=txn.marker, marker=txn.marker, log=log,
+                              log_for=txn.log_for)
         live = [r for r in pending if r is not None]
         if live:
             table.insert_rows(live, columns=columns, begin_ts=txn.marker,
@@ -1495,7 +1523,8 @@ class Session:
                     updates[col.name] = self._eval_update_expr(
                         table, tname, val_ast2, ids, col)
             table.update_rows(ids, updates, begin_ts=txn.marker,
-                              end_ts=txn.marker, marker=txn.marker, log=log)
+                              end_ts=txn.marker, marker=txn.marker, log=log,
+                              log_for=txn.log_for)
             # the update superseded `hit` with a new version: refresh
             # EVERY index's mapping (assignments may change key columns;
             # a later VALUES row hitting the stale id would silently
@@ -1806,7 +1835,7 @@ class Session:
                     updates[col.name] = vals
             table.update_rows(ids, updates, begin_ts=txn.marker,
                               end_ts=txn.marker, marker=txn.marker,
-                              log=txn.log_for(table))
+                              log=txn.log_for(table), log_for=txn.log_for)
 
         return self._run_dml(do)
 
@@ -1854,7 +1883,7 @@ class Session:
                 updates[col.name] = [v[j] for v in vals]
             table.update_rows(ids, updates, begin_ts=txn.marker,
                               end_ts=txn.marker, marker=txn.marker,
-                              log=txn.log_for(table))
+                              log=txn.log_for(table), log_for=txn.log_for)
 
         return self._run_dml(do)
 
@@ -1941,7 +1970,7 @@ class Session:
                 if len(ids):
                     table.delete_rows(ids, end_ts=txn.marker,
                                       marker=txn.marker,
-                                      log=txn.log_for(table))
+                                      log=txn.log_for(table), log_for=txn.log_for)
 
             return self._run_dml(do)
 
@@ -1950,7 +1979,7 @@ class Session:
         def do(txn):
             ids = self._rows_matching(table, stmt.where, stmt.table.name)
             table.delete_rows(ids, end_ts=txn.marker, marker=txn.marker,
-                              log=txn.log_for(table))
+                              log=txn.log_for(table), log_for=txn.log_for)
 
         return self._run_dml(do)
 
@@ -2102,15 +2131,33 @@ class Session:
                 kw = "UNIQUE KEY" if ix.unique else "KEY"
                 lines.append(f"  {kw} `{name}` ({keys})")
             for fk in t.foreign_keys:
-                lines.append(
-                    f"  FOREIGN KEY (`{fk.column}`) REFERENCES "
-                    f"`{fk.parent.schema.name}` (`{fk.parent_col}`)")
+                cols = ", ".join(f"`{c}`" for c in fk.columns)
+                pcols = ", ".join(f"`{c}`" for c in fk.parent_cols)
+                line = (f"  FOREIGN KEY ({cols}) REFERENCES "
+                        f"`{fk.parent.schema.name}` ({pcols})")
+                for clause, act in (("ON DELETE", fk.on_delete),
+                                    ("ON UPDATE", fk.on_update)):
+                    if act != "restrict":
+                        line += f" {clause} {act.replace('_', ' ').upper()}"
+                lines.append(line)
             for chk in getattr(t, "checks", ()):
                 lines.append(
                     f"  CONSTRAINT `{chk.name}` CHECK ({chk.sql})")
             ddl = (f"CREATE TABLE `{stmt.target}` (\n"
                    + ",\n".join(lines)
                    + f"\n) ENGINE={t.engine}")
+            pi = t.schema.partition
+            if pi is not None:
+                if pi.kind == "hash":
+                    ddl += (f"\nPARTITION BY HASH (`{pi.column}`) "
+                            f"PARTITIONS {pi.n_parts}")
+                else:
+                    parts = ", ".join(
+                        f"PARTITION `{n}` VALUES LESS THAN "
+                        + ("MAXVALUE" if u is None else f"({u})")
+                        for n, u in zip(pi.names, pi.uppers))
+                    ddl += (f"\nPARTITION BY RANGE (`{pi.column}`) "
+                            f"({parts})")
             return ResultSet(names=["Table", "Create Table"],
                              rows=[(stmt.target, ddl)])
         if stmt.kind == "create_view":

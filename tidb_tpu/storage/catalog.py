@@ -362,29 +362,46 @@ class Catalog:
         return t
 
     def _resolve_foreign_key(self, db: str, child, spec):
-        """Resolve one FOREIGN KEY spec (single-column, RESTRICT; ref:
-        ddl foreign-key jobs) WITHOUT mutating anything. The referenced
-        column must carry a unique index — the same requirement MySQL
-        enforces — so parent probes are well-defined."""
+        """Resolve one FOREIGN KEY spec (multi-column, with referential
+        actions; ref: ddl foreign-key jobs) WITHOUT mutating anything.
+        The referenced column list must carry a matching unique index —
+        the same requirement MySQL effectively imposes for well-defined
+        parent probes."""
         from tidb_tpu.storage.table import FKInfo
 
-        cols, ref, ref_cols = spec
-        if len(cols) != 1 or len(ref_cols) != 1:
+        cols, ref, ref_cols = spec[:3]
+        on_delete = spec[3] if len(spec) > 3 else "restrict"
+        on_update = spec[4] if len(spec) > 4 else "restrict"
+        if len(cols) != len(ref_cols) or not cols:
             raise SchemaError(
-                "composite FOREIGN KEYs are not supported yet")
-        child.schema.col(cols[0])  # raises if absent
+                "FOREIGN KEY column count must match REFERENCES")
+        for c in cols:
+            child.schema.col(c)  # raises if absent
         parent = self.table(ref.schema or db, ref.name)
-        parent.schema.col(ref_cols[0])
+        for c in ref_cols:
+            parent.schema.col(c)
         unique_on_ref = any(
-            ix.unique and ix.columns == [ref_cols[0]]
+            ix.unique and ix.columns == list(ref_cols)
             for ix in parent.indexes.values())
         if not unique_on_ref:
             raise SchemaError(
-                f"foreign key target {ref.name}.{ref_cols[0]} must be a "
-                "PRIMARY KEY or single-column UNIQUE index")
-        fk = FKInfo(column=cols[0], parent=parent, parent_col=ref_cols[0],
-                    name=f"fk_{child.schema.name}_{cols[0]}",
-                    parent_db=ref.schema or db)
+                f"foreign key target {ref.name}.({', '.join(ref_cols)}) "
+                "must be a PRIMARY KEY or matching UNIQUE index")
+        for c, pc in zip(cols, ref_cols):
+            cc, pcc = child.schema.col(c), parent.schema.col(pc)
+            if (cc.type_.is_dict_encoded and pcc.type_.is_dict_encoded
+                    and cc.coll != pcc.coll):
+                # FK matching compares fold keys; mixed collations would
+                # compare apples to oranges (MySQL requires identical
+                # collations on FK column pairs too)
+                raise SchemaError(
+                    f"foreign key column {c!r} collation {cc.coll!r} must "
+                    f"match referenced {pc!r} collation {pcc.coll!r}")
+        fk = FKInfo(columns=list(cols), parent=parent,
+                    parent_cols=list(ref_cols),
+                    name=f"fk_{child.schema.name}_{'_'.join(cols)}",
+                    parent_db=ref.schema or db,
+                    on_delete=on_delete, on_update=on_update)
         return parent, fk
 
     def drop_table(self, db: str, name: str, if_exists: bool = False):
@@ -584,9 +601,11 @@ class Catalog:
                             rows.append(("def", dbn, idx.name, dbn, tn,
                                          cname, i + 1, None, None, None))
                     for fk in getattr(t, "foreign_keys", ()):
-                        rows.append(("def", dbn, fk.name, dbn, tn,
-                                     fk.column, 1, fk.parent_db,
-                                     fk.parent.schema.name, fk.parent_col))
+                        for i, (c, pc) in enumerate(
+                                zip(fk.columns, fk.parent_cols)):
+                            rows.append(("def", dbn, fk.name, dbn, tn,
+                                         c, i + 1, fk.parent_db,
+                                         fk.parent.schema.name, pc))
             return make(
                 [("constraint_catalog", STRING),
                  ("constraint_schema", STRING), ("constraint_name", STRING),
@@ -603,9 +622,11 @@ class Catalog:
                 for tn in sorted(self.databases[dbn].tables):
                     t = self.databases[dbn].tables[tn]
                     for fk in getattr(t, "foreign_keys", ()):
-                        rows.append(("def", dbn, fk.name, tn,
-                                     fk.parent_db, fk.parent.schema.name,
-                                     "RESTRICT", "RESTRICT"))
+                        rows.append(
+                            ("def", dbn, fk.name, tn,
+                             fk.parent_db, fk.parent.schema.name,
+                             fk.on_update.replace("_", " ").upper(),
+                             fk.on_delete.replace("_", " ").upper()))
             return make(
                 [("constraint_catalog", STRING),
                  ("constraint_schema", STRING), ("constraint_name", STRING),

@@ -74,16 +74,28 @@ class ColumnInfo:
 
 @dataclass
 class FKInfo:
-    """A single-column FOREIGN KEY with RESTRICT semantics (ref: ddl/
-    foreign-key DDL + the executor's constraint checks). `parent` is the
-    referenced Table object (wired by the catalog at CREATE time), whose
-    `referencing` list holds the back-edge for parent-side checks."""
+    """A FOREIGN KEY constraint (ref: ddl/ foreign-key DDL + the
+    executor's constraint checks): multi-column, with referential
+    actions. `parent` is the referenced Table object (wired by the
+    catalog at CREATE time), whose `referencing` list holds the
+    back-edge for parent-side checks/actions. NULL matching is MySQL's
+    simple match: a child row with ANY NULL component passes."""
 
-    column: str
+    columns: List[str]
     parent: object          # storage Table of the referenced table
-    parent_col: str
+    parent_cols: List[str]
     name: str = ""
     parent_db: str = ""     # the parent's database (cross-db introspection)
+    on_delete: str = "restrict"   # restrict | cascade | set_null
+    on_update: str = "restrict"
+
+    @property
+    def column(self) -> str:  # single-column convenience (display)
+        return self.columns[0]
+
+    @property
+    def parent_col(self) -> str:
+        return self.parent_cols[0]
 
 
 @dataclass
@@ -112,6 +124,41 @@ class IndexInfo:
 
 
 @dataclass
+class PartitionInfo:
+    """Logical table partitioning (ref: MySQL PARTITION BY RANGE/HASH;
+    the reference prunes partitions in the planner the same way).
+    RANGE: partition i holds rows with uppers[i-1] <= col < uppers[i]
+    (None = MAXVALUE). HASH: pid = value % n_parts (NULL rows land in
+    partition 0, like MySQL)."""
+
+    kind: str                     # "range" | "hash"
+    column: str
+    names: List[str] = field(default_factory=list)
+    uppers: List[Optional[int]] = field(default_factory=list)  # range
+    n_parts: int = 0              # hash
+
+    def count(self) -> int:
+        return len(self.names) if self.kind == "range" else self.n_parts
+
+    def part_name(self, pid: int) -> str:
+        if self.kind == "range":
+            return self.names[pid]
+        return f"p{pid}"
+
+    def ids_of_values(self, vals: np.ndarray, valid: np.ndarray) -> np.ndarray:
+        """Partition id per value. Without a MAXVALUE partition the
+        returned id can equal count() — an overflow the write path
+        rejects (_check_partition)."""
+        v = np.where(valid, vals.astype(np.int64), 0)
+        if self.kind == "hash":
+            return np.where(valid, v % max(self.n_parts, 1), 0)
+        bounds = np.array(
+            [u for u in self.uppers if u is not None], dtype=np.int64)
+        pid = np.searchsorted(bounds, v, side="right")
+        return np.where(valid, pid, 0)
+
+
+@dataclass
 class TableSchema:
     name: str
     columns: List[ColumnInfo]
@@ -119,6 +166,8 @@ class TableSchema:
     # table default COLLATE: applied to later ADD/MODIFY COLUMN when the
     # column declares none (MySQL persists the table default the same way)
     collation: Optional[str] = None
+    # PARTITION BY metadata; None = unpartitioned
+    partition: Optional[PartitionInfo] = None
 
     def col(self, name: str) -> ColumnInfo:
         for c in self.columns:
@@ -350,6 +399,7 @@ class Table:
             start, end, marker=begin_ts if in_txn and txn_deleted else None)
         self._check_fk_parents(start, end)
         self._check_row_constraints(start, end)
+        self._check_partition(start, end)
         # before n advances: a violation leaves the table untouched
         self.begin_ts[start:end] = self._next_ts() if begin_ts is None else begin_ts
         self.end_ts[start:end] = MAX_TS
@@ -390,6 +440,7 @@ class Table:
         self._enforce_unique_new(start, end)
         self._check_fk_parents(start, end)
         self._check_row_constraints(start, end)
+        self._check_partition(start, end)
         self.begin_ts[start:end] = 0  # bulk loads are committed "at origin"
         self.end_ts[start:end] = MAX_TS
         self.n = end
@@ -400,12 +451,47 @@ class Table:
 
     # -- foreign keys ------------------------------------------------------
 
-    def _live_key_values(self, col: str) -> np.ndarray:
-        """Sorted committed-or-provisional values of `col` (the parent
-        side of an FK probe), cached per version. Dict-encoded columns
-        return the DECODED strings — codes are table-local and must
-        never be compared across tables."""
-        hit = self._fk_keys.get(col)
+    def _fk_decode(self, col: str, vals: np.ndarray) -> np.ndarray:
+        """Decode this table's values of `col` for cross-table FK
+        comparison: the collation FOLD KEY for dict columns (so
+        'abc' matches a parent's 'ABC' under _ci — canonical codes are
+        table-local and must never cross tables), raw otherwise."""
+        dic = self.dicts.get(col)
+        if dic is None:
+            return vals
+        return np.array(
+            [dic.fold(dic.values[int(c)]) for c in vals], dtype=object)
+
+    def _fk_tuples(self, cols: List[str], rows: np.ndarray):
+        """(key tuples, all-components-valid mask) at `rows` — MySQL's
+        simple match: a row with ANY NULL component never participates."""
+        ok = np.ones(len(rows), dtype=np.bool_)
+        for c in cols:
+            ok &= self.valid[c][rows]
+        sel = rows[ok]
+        decoded = [self._fk_decode(c, self.data[c][sel]) for c in cols]
+        return list(zip(*decoded)) if len(sel) else [], ok
+
+    def _live_key_tuples(self, cols: List[str]) -> set:
+        """Key-tuple set of present rows (the parent side of an FK
+        probe), cached per version; values are decoded so they compare
+        across tables."""
+        key = tuple(cols)
+        hit = self._fk_keys.get(key)
+        if hit is not None and hit[0] == self.version:
+            return hit[1]
+        present = np.nonzero(self._present_mask())[0]
+        tuples, _ok = self._fk_tuples(cols, present)
+        keys = set(tuples)
+        self._fk_keys[key] = (self.version, keys)
+        return keys
+
+    def _live_key_array(self, col: str) -> np.ndarray:
+        """Single-column vectorized variant of _live_key_tuples: sorted
+        unique decoded values of present rows, cached per version —
+        keeps the common one-column FK probe on the np.isin fast path."""
+        key = (col, "arr")
+        hit = self._fk_keys.get(key)
         if hit is not None and hit[0] == self.version:
             return hit[1]
         present = self._present_mask()
@@ -413,64 +499,160 @@ class Table:
         keys = np.unique(vals)
         dic = self.dicts.get(col)
         if dic is not None:
-            keys = np.array([dic.values[int(c)] for c in keys], dtype=object)
-        self._fk_keys[col] = (self.version, keys)
+            keys = np.unique(np.array(
+                [dic.fold(dic.values[int(c)]) for c in keys], dtype=object))
+        self._fk_keys[key] = (self.version, keys)
         return keys
-
-    def _fk_decode(self, col: str, vals: np.ndarray) -> np.ndarray:
-        """Decode this table's values of `col` for cross-table FK
-        comparison (strings for dict columns, raw otherwise)."""
-        dic = self.dicts.get(col)
-        if dic is None:
-            return vals
-        return np.array([dic.values[int(c)] for c in vals], dtype=object)
 
     def _check_fk_parents(self, start: int, end: int,
                           cols: Optional[set] = None,
                           fks=None, live_only: bool = False) -> None:
-        """Every non-NULL FK value in rows [start, end) must exist in
-        its parent (RESTRICT on the child write). Raises BEFORE the rows
-        become visible. `fks` restricts to specific constraints and
+        """Every fully-non-NULL FK key in rows [start, end) must exist
+        in its parent (RESTRICT on the child write). Raises BEFORE the
+        rows become visible. `fks` restricts to specific constraints and
         `live_only` to present row versions (ALTER TABLE ADD FOREIGN KEY
         back-filling existing data)."""
-        rows_live = self._present_mask()[start:end] if live_only else None
+        rows = np.arange(start, end)
+        if live_only:
+            rows = rows[self._present_mask()[start:end]]
         for fk in (fks if fks is not None else self.foreign_keys):
-            if cols is not None and fk.column not in cols:
+            if cols is not None and not (set(fk.columns) & cols):
                 continue
-            vd = self.valid[fk.column][start:end]
-            if rows_live is not None:
-                vd = vd & rows_live
-            vals = self._fk_decode(fk.column,
-                                   self.data[fk.column][start:end][vd])
-            if not len(vals):
+            if len(fk.columns) == 1:
+                # vectorized single-column fast path (the common case)
+                c = fk.columns[0]
+                vd = self.valid[c][rows]
+                vals = self._fk_decode(c, self.data[c][rows][vd])
+                if not len(vals):
+                    continue
+                keys = fk.parent._live_key_array(fk.parent_cols[0])
+                ok = np.isin(vals, keys)
+                if not ok.all():
+                    raise ExecutionError(
+                        f"foreign key {fk.name or fk.column!r} violation: "
+                        f"{vals[~ok][0]!r} not present in "
+                        f"{fk.parent.schema.name}.{fk.parent_cols[0]}")
                 continue
-            keys = fk.parent._live_key_values(fk.parent_col)
-            ok = np.isin(vals, keys)
-            if not ok.all():
-                bad = vals[~ok][0]
-                raise ExecutionError(
-                    f"foreign key {fk.name or fk.column!r} violation: "
-                    f"{bad!r} not present in "
-                    f"{fk.parent.schema.name}.{fk.parent_col}")
+            tuples, _ok = self._fk_tuples(fk.columns, rows)
+            if not tuples:
+                continue
+            keys = fk.parent._live_key_tuples(fk.parent_cols)
+            for t in tuples:
+                if t not in keys:
+                    raise ExecutionError(
+                        f"foreign key {fk.name or fk.column!r} violation: "
+                        f"{t if len(t) > 1 else t[0]!r} not present in "
+                        f"{fk.parent.schema.name}"
+                        f".({', '.join(fk.parent_cols)})")
 
-    def _check_fk_children(self, ids: np.ndarray) -> None:
-        """Rows `ids` are about to be deleted/re-keyed: no child row may
-        reference their key values (RESTRICT on the parent write)."""
+    def _fk_referencing_rows(self, cols: List[str], keys: set) -> np.ndarray:
+        """Present row ids whose (fully non-NULL) FK tuple is in `keys`."""
+        present = np.nonzero(self._present_mask())[0]
+        if len(cols) == 1:
+            c = cols[0]
+            vd = self.valid[c][present]
+            sel = present[vd]
+            if not len(sel):
+                return np.zeros(0, dtype=np.int64)
+            vals = self._fk_decode(c, self.data[c][sel])
+            karr = np.array([k[0] for k in keys], dtype=object)
+            return sel[np.isin(vals, karr)]
+        tuples, ok = self._fk_tuples(cols, present)
+        sel = present[ok]
+        if not tuples:
+            return np.zeros(0, dtype=np.int64)
+        hit = np.fromiter((t in keys for t in tuples), dtype=np.bool_,
+                          count=len(tuples))
+        return sel[hit]
+
+    def _fk_tuples_aligned(self, cols: List[str], rows: np.ndarray):
+        """Row-aligned key tuples with None for NULL components."""
+        out = []
+        for i in rows.tolist():
+            t = []
+            for c in cols:
+                if self.valid[c][i]:
+                    t.append(self._fk_decode(
+                        c, self.data[c][i:i + 1])[0])
+                else:
+                    t.append(None)
+            out.append(tuple(t))
+        return out
+
+    def _check_fk_children(self, ids: np.ndarray, *, action: str = "delete",
+                           end_ts=None, marker: int = 0, log_for=None,
+                           new_rows: Optional[np.ndarray] = None,
+                           depth: int = 0, phase: str = "both") -> None:
+        """Rows `ids` are about to be deleted (action="delete") or have
+        their key columns rewritten (action="update", with `new_keys`
+        mapping old key tuple -> new key tuple). Applies each child FK's
+        referential action: restrict raises, cascade deletes/updates the
+        child rows (recursively, bounded like MySQL's 15-level cascade
+        limit), set_null NULLs the child key columns. `log_for` maps a
+        child Table to its TableTxnLog so cascaded writes stay inside
+        the caller's transaction."""
         if not self.referencing or not len(ids):
             return
-        for child, fk in self.referencing:
-            pv = self.valid[fk.parent_col][ids]
-            keys = np.unique(self._fk_decode(
-                fk.parent_col, self.data[fk.parent_col][ids][pv]))
-            if not len(keys):
+        if depth > 15:
+            raise ExecutionError("foreign key cascade depth exceeded")
+        for child, fk in list(self.referencing):
+            act = fk.on_delete if action == "delete" else fk.on_update
+            # phase="pre" runs BEFORE the parent mutation (abort-early
+            # restrict checks); phase="post" runs after the parent's new
+            # versions are visible, so a cascaded child write re-checks
+            # its FK against the UPDATED parent keys
+            if phase == "pre" and act != "restrict":
                 continue
-            refs = child._live_key_values(fk.column)
-            hit = np.isin(keys, refs)
-            if hit.any():
+            if phase == "post" and act == "restrict":
+                continue
+            tuples, _ok = self._fk_tuples(fk.parent_cols, ids)
+            keys = set(tuples)
+            if not keys:
+                continue
+            rows = child._fk_referencing_rows(fk.columns, keys)
+            if not len(rows):
+                continue
+            if act == "restrict":
+                hit_c, _ok = child._fk_tuples(fk.columns, rows[:1])
+                bad = hit_c[0] if hit_c else "?"
                 raise ExecutionError(
                     f"cannot delete or update {self.schema.name!r} row: "
-                    f"key {keys[hit][0]!r} is referenced by "
-                    f"{child.schema.name}.{fk.column}")
+                    f"key {bad if len(fk.columns) > 1 else bad[0]!r} is "
+                    f"referenced by "
+                    f"{child.schema.name}.({', '.join(fk.columns)})")
+            clog = log_for(child) if log_for is not None else None
+            if act == "set_null":
+                for c in fk.columns:
+                    if child.schema.col(c).not_null:
+                        raise ExecutionError(
+                            f"FK {fk.name!r} ON {action.upper()} SET NULL: "
+                            f"{child.schema.name}.{c} is NOT NULL")
+                child.update_rows(
+                    rows, {c: [None] * len(rows) for c in fk.columns},
+                    begin_ts=marker or None, end_ts=end_ts if marker else None,
+                    marker=marker, log=clog, log_for=log_for,
+                    _fk_depth=depth + 1)
+            elif act == "cascade" and action == "delete":
+                child.delete_rows(rows, end_ts=end_ts, marker=marker,
+                                  log=clog, log_for=log_for,
+                                  _fk_depth=depth + 1)
+            elif act == "cascade":  # update: rewrite child keys old->new
+                old_al = self._fk_tuples_aligned(fk.parent_cols, ids)
+                new_al = self._fk_tuples_aligned(
+                    fk.parent_cols, new_rows) if new_rows is not None else old_al
+                new_keys = {o: n for o, n in zip(old_al, new_al)
+                            if None not in o}
+                tuples_c, ok_c = child._fk_tuples(fk.columns, rows)
+                updates = {c: [] for c in fk.columns}
+                for t in tuples_c:
+                    nt = new_keys.get(t, t)
+                    for c, v in zip(fk.columns, nt):
+                        updates[c].append(v)
+                child.update_rows(
+                    rows, updates,
+                    begin_ts=marker or None, end_ts=end_ts if marker else None,
+                    marker=marker, log=clog, log_for=log_for,
+                    _fk_depth=depth + 1)
 
     def _check_row_constraints(self, start: int, end: int,
                                cols: Optional[set] = None,
@@ -675,12 +857,17 @@ class Table:
                 del self.row_locks[rid]
 
     def delete_rows(self, row_ids: np.ndarray, end_ts: Optional[int] = None,
-                    marker: int = 0, log: Optional["TableTxnLog"] = None) -> int:
+                    marker: int = 0, log: Optional["TableTxnLog"] = None,
+                    log_for=None, _fk_depth: int = 0) -> int:
         """End rows' visibility at end_ts (a commit ts, or a txn marker for
-        provisional deletes). Returns count newly deleted."""
+        provisional deletes). Returns count newly deleted. `log_for`
+        maps child tables to their txn logs so ON DELETE CASCADE /
+        SET NULL writes join the caller's transaction."""
         ids = np.asarray(row_ids, dtype=np.int64)
         ids = ids[self._writable_mask(ids, marker)]
-        self._check_fk_children(ids)
+        self._check_fk_children(ids, action="delete", end_ts=end_ts,
+                                marker=marker, log_for=log_for,
+                                depth=_fk_depth)
         self.end_ts[ids] = self._next_ts() if end_ts is None else end_ts
         if end_ts is not None and end_ts >= TXN_TS_BASE and len(ids):
             self._txn_dead.setdefault(end_ts, []).extend(ids.tolist())
@@ -693,7 +880,8 @@ class Table:
 
     def update_rows(self, row_ids: np.ndarray, updates: Dict[str, list],
                     begin_ts: Optional[int] = None, end_ts: Optional[int] = None,
-                    marker: int = 0, log: Optional["TableTxnLog"] = None) -> int:
+                    marker: int = 0, log: Optional["TableTxnLog"] = None,
+                    log_for=None, _fk_depth: int = 0) -> int:
         """MVCC update: end the old row versions and append new versions
         carrying the updated values (ref: TiDB writes a new MVCC version
         per update; here the version chain is physical-row append)."""
@@ -761,14 +949,28 @@ class Table:
         try:
             self._check_fk_parents(start, end, cols=upd_cols)
             self._check_row_constraints(start, end, cols=upd_cols)
-            for pcol in {fk.parent_col for _c, fk in self.referencing
-                         if fk.parent_col in upd_cols}:
-                old = self.data[pcol][ids]
-                ov = self.valid[pcol][ids]
-                new = self.data[pcol][start:end]
-                nv = self.valid[pcol][start:end]
-                changed = (ov != nv) | (ov & nv & (old != new))
-                self._check_fk_children(ids[changed])
+            if (self.schema.partition is not None
+                    and self.schema.partition.column in upd_cols):
+                self._check_partition(start, end)
+            ref_cols = set()
+            for _c, fk in self.referencing:
+                ref_cols |= set(fk.parent_cols)
+            fk_changed = None
+            if ref_cols & upd_cols:
+                changed = np.zeros(len(ids), dtype=np.bool_)
+                for pcol in ref_cols & upd_cols:
+                    old = self.data[pcol][ids]
+                    ov = self.valid[pcol][ids]
+                    new = self.data[pcol][start:end]
+                    nv = self.valid[pcol][start:end]
+                    changed |= (ov != nv) | (ov & nv & (old != new))
+                if changed.any():
+                    fk_changed = (ids[changed].copy(),
+                                  np.arange(start, end)[changed])
+                    # abort-early half: ON UPDATE RESTRICT children
+                    self._check_fk_children(
+                        fk_changed[0], action="update", phase="pre",
+                        depth=_fk_depth)
         except ExecutionError:
             for name in self.valid:
                 self.valid[name][start:end] = False
@@ -786,6 +988,15 @@ class Table:
         if log is not None:
             self._log_mark(log)
         self._sketch_insert(start, end)
+        if fk_changed is not None:
+            # action half AFTER the new parent keys are visible, so a
+            # cascaded child write FK-checks against the updated parent;
+            # statement atomicity on a mid-cascade failure is the txn
+            # layer's (marker rollback), like any multi-table statement
+            self._check_fk_children(
+                fk_changed[0], action="update", phase="post",
+                end_ts=end_ts, marker=marker, log_for=log_for,
+                new_rows=fk_changed[1], depth=_fk_depth)
         return m
 
     def _log_mark(self, log: "TableTxnLog") -> None:
@@ -932,8 +1143,8 @@ class Table:
         self.version += 1
 
     def drop_column(self, name: str) -> None:
-        if any(fk.column == name for fk in self.foreign_keys) or any(
-                fk.parent_col == name for _c, fk in self.referencing):
+        if any(name in fk.columns for fk in self.foreign_keys) or any(
+                name in fk.parent_cols for _c, fk in self.referencing):
             raise SchemaError(
                 f"cannot drop column {name!r}: used by a foreign key")
         if any(name in chk.cols for chk in self.checks):
@@ -1462,6 +1673,47 @@ class Table:
         if marker:
             vis = ((b <= read_ts) | (b == marker)) & (e > read_ts) & (e != marker)
         return vis
+
+    def _check_partition(self, start: int, end: int) -> None:
+        """RANGE partitioning without a MAXVALUE partition rejects
+        out-of-range rows at write time (MySQL: 'no partition for
+        value')."""
+        pi = self.schema.partition
+        if pi is None or pi.kind != "range" or pi.uppers[-1] is None:
+            return
+        vals = self.data[pi.column][start:end]
+        valid = self.valid[pi.column][start:end]
+        pids = pi.ids_of_values(vals, valid)
+        if (pids[valid] >= pi.count()).any():
+            bad = vals[valid][pids[valid] >= pi.count()][0]
+            raise ExecutionError(
+                f"table {self.schema.name!r} has no partition for "
+                f"value {int(bad)}")
+
+    def partition_rows(self, pids, read_ts=None, marker: int = 0) -> np.ndarray:
+        """Visible physical rows in the given partitions, via a
+        per-version cache of partition -> physical row ids (one
+        vectorized pass over the partition column; the pruned-scan
+        analogue of the sorted index cache)."""
+        pi = self.schema.partition
+        assert pi is not None
+        hit = getattr(self, "_part_cache", None)
+        if hit is None or hit[0] != self.version:
+            vals = self.data[pi.column][: self.n]
+            valid = self.valid[pi.column][: self.n]
+            all_pids = pi.ids_of_values(vals, valid)
+            by_pid = {}
+            for pid in range(pi.count() + 1):  # +1: overflow bucket
+                rows = np.nonzero(all_pids == pid)[0]
+                if len(rows):
+                    by_pid[pid] = rows
+            hit = (self.version, by_pid)
+            self._part_cache = hit
+        rows = [hit[1].get(int(p), np.zeros(0, dtype=np.int64))
+                for p in pids]
+        allrows = np.sort(np.concatenate(rows)) if rows else \
+            np.zeros(0, dtype=np.int64)
+        return self._mvcc_visible(allrows, read_ts, marker)
 
     def partition_bounds(self, num_partitions: int) -> List[tuple]:
         """Split [0, n) into near-equal contiguous partitions (the region/
