@@ -1,0 +1,259 @@
+"""Online multi-version schema change (VERDICT r4 missing #5;
+SURVEY.md:180-185): write_only intermediate states for ADD COLUMN /
+ADD INDEX, stepped per-instance so concurrent DML from an instance one
+schema version behind stays correct — exercised both in-process and
+across REAL worker subprocesses on the DCN tier."""
+
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+class TestStagedColumn:
+    def test_write_only_column_hidden_but_written(self):
+        s = Session()
+        s.execute("create table t (a bigint, b bigint)")
+        s.execute("insert into t values (1, 10)")
+        s.apply_ddl_stage(
+            "alter table t add column c bigint default 7", "write_only")
+        # invisible to reads...
+        assert s.query("select * from t") == [(1, 10)]
+        assert [r[0] for r in s.query("show columns from t")] == ["a", "b"]
+        # ...but a positional INSERT of the OLD shape still works and
+        # default-fills the staged column (the one-version-behind writer)
+        s.execute("insert into t values (2, 20)")
+        s.apply_ddl_stage(
+            "alter table t add column c bigint default 7", "public")
+        assert s.query("select * from t order by a") == \
+            [(1, 10, 7), (2, 20, 7)]
+
+    def test_abort_drops_staged_column(self):
+        s = Session()
+        s.execute("create table t (a bigint)")
+        s.apply_ddl_stage("alter table t add column c bigint", "write_only")
+        s.apply_ddl_stage("alter table t add column c bigint", "abort")
+        s.execute("insert into t values (1)")
+        assert s.query("select * from t") == [(1,)]
+
+    def test_schema_version_bumps_per_stage(self):
+        s = Session()
+        s.execute("create table t (a bigint)")
+        v0 = s.catalog.schema_version
+        s.apply_ddl_stage("alter table t add column c bigint", "write_only")
+        s.apply_ddl_stage("alter table t add column c bigint", "public")
+        assert s.catalog.schema_version == v0 + 2
+
+
+class TestStagedIndex:
+    def test_write_only_unique_enforced_not_readable(self):
+        s = Session()
+        s.execute("create table t (a bigint, b bigint)")
+        s.execute("insert into t values (1, 1)")
+        sql = "alter table t add unique uq (b)"
+        s.apply_ddl_stage(sql, "write_only")
+        # enforced on new writes...
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            s.execute("insert into t values (2, 1)")
+        # ...but not an access path yet
+        plan = "\n".join(r[0] for r in s.query(
+            "explain select * from t where b = 1"))
+        assert "PointGet" not in plan and "IndexRangeScan" not in plan
+        s.apply_ddl_stage(sql, "backfill")
+        s.apply_ddl_stage(sql, "public")
+        plan = "\n".join(r[0] for r in s.query(
+            "explain select * from t where b = 1"))
+        assert "PointGet" in plan or "IndexRangeScan" in plan
+
+    def test_backfill_failure_aborts(self):
+        s = Session()
+        s.execute("create table t (a bigint)")
+        s.execute("insert into t values (1), (1)")  # pre-existing dup
+        sql = "alter table t add unique uq (a)"
+        s.apply_ddl_stage(sql, "write_only")
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            s.apply_ddl_stage(sql, "backfill")
+        assert "uq" not in s.catalog.table("test", "t").indexes
+        s.execute("insert into t values (1)")  # enforcement gone
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from tidb_tpu.parallel.dcn import Cluster
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs, ports = [], []
+    for _ in range(2):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.parallel.dcn", "--device", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        line = p.stdout.readline()
+        m = re.search(r"DCN_WORKER_PORT=(\d+)", line)
+        assert m, f"worker failed to start: {line!r}"
+        procs.append(p)
+        ports.append(int(m.group(1)))
+    cl = Cluster([("127.0.0.1", port) for port in ports])
+    yield cl
+    for i in range(len(procs)):
+        try:
+            cl._call(i, {"cmd": "shutdown"})
+        except Exception:
+            pass
+    for p in procs:
+        p.terminate()
+        p.wait(timeout=10)
+
+
+class TestMultiProcessOnlineDDL:
+    """Coordinator + 2 REAL worker processes: DML keeps flowing while an
+    ALTER steps through its states; a worker one schema version behind
+    writes correctly (the reference's lease guarantee)."""
+
+    def test_concurrent_dml_during_staged_alter(self, cluster):
+        cluster.broadcast_exec(
+            "create table od (k bigint, v bigint)")
+        for w in range(2):
+            cluster._call(w, {"cmd": "exec", "sql":
+                              "insert into od values "
+                              + ",".join(f"({w * 1000 + i}, 1)"
+                                         for i in range(50))})
+        stop = threading.Event()
+        counts = [50, 50]
+        errs = []
+
+        def dml(w):
+            i = 100
+            while not stop.is_set():
+                try:
+                    # explicit old columns: legal at EVERY schema stage
+                    cluster._call(w, {"cmd": "exec", "sql":
+                                      f"insert into od (k, v) values "
+                                      f"({w * 1000 + i + 500}, 1)"})
+                    counts[w] += 1
+                    i += 1
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=dml, args=(w,)) for w in range(2)]
+        for t in threads:
+            t.start()
+
+        def window(stage):
+            if stage == "write_only":
+                # the OLD positional shape still inserts correctly while
+                # the staged column is write_only on every worker
+                for w in range(2):
+                    cluster._call(w, {"cmd": "exec", "sql":
+                                      f"insert into od values "
+                                      f"({w * 1000 + 999}, 1)"})
+                    counts[w] += 1
+            time.sleep(0.15)
+
+        cluster.online_ddl(
+            "alter table od add column extra bigint default 42",
+            between_stages=window)
+        time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs, errs
+        for w in range(2):
+            rows = cluster._call(w, {"cmd": "exec", "sql":
+                                     "select count(*), min(extra), "
+                                     "max(extra) from od"})
+            assert rows == [(counts[w], 42, 42)], (w, rows)
+
+    def test_mixed_version_window_writes_correctly(self, cluster):
+        """Drive ONE worker ahead to write_only while the other stays a
+        schema version behind; both keep accepting the OLD insert shape;
+        converge and verify every row carries the default."""
+        cluster.broadcast_exec("create table mv (k bigint)")
+        sql = "alter table mv add column c bigint default 9"
+        cluster._call(0, {"cmd": "ddl_stage", "sql": sql,
+                          "stage": "write_only"})
+        # worker 0 at write_only, worker 1 one version behind: both
+        # accept the old positional shape
+        cluster._call(0, {"cmd": "exec", "sql": "insert into mv values (1)"})
+        cluster._call(1, {"cmd": "exec", "sql": "insert into mv values (2)"})
+        # worker 0's staged column is invisible to its reads
+        assert cluster._call(0, {"cmd": "exec",
+                                 "sql": "select * from mv"}) == [(1,)]
+        cluster._call(1, {"cmd": "ddl_stage", "sql": sql,
+                          "stage": "write_only"})
+        for w in range(2):
+            cluster._call(w, {"cmd": "ddl_stage", "sql": sql,
+                              "stage": "public"})
+        assert cluster._call(0, {"cmd": "exec",
+                                 "sql": "select k, c from mv"}) == [(1, 9)]
+        assert cluster._call(1, {"cmd": "exec",
+                                 "sql": "select k, c from mv"}) == [(2, 9)]
+
+    def test_online_unique_index_backfill_abort_across_workers(self, cluster):
+        cluster.broadcast_exec("create table oi (a bigint)")
+        # a pre-existing duplicate on worker 1 only
+        cluster._call(0, {"cmd": "exec", "sql": "insert into oi values (1)"})
+        cluster._call(1, {"cmd": "exec",
+                          "sql": "insert into oi values (7), (7)"})
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            cluster.online_ddl("alter table oi add unique uqa (a)")
+        # aborted everywhere: the staged index must be gone on BOTH
+        for w in range(2):
+            cluster._call(w, {"cmd": "exec",
+                              "sql": "insert into oi values (99), (99)"})
+
+
+class TestReviewRegressions:
+    def test_abort_never_drops_preexisting_objects(self):
+        s = Session()
+        s.execute("create table t (a bigint, b bigint)")
+        s.execute("insert into t values (1, 2)")
+        s.execute("alter table t add index idx (b)")
+        # duplicate-name staged DDL fails; abort must NOT touch the
+        # user's real column/index
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            s.apply_ddl_stage("alter table t add column a bigint",
+                              "write_only")
+        s.apply_ddl_stage("alter table t add column a bigint", "abort")
+        assert s.query("select * from t") == [(1, 2)]
+        with pytest.raises(Exception):
+            s.apply_ddl_stage("alter table t add index idx (b)",
+                              "write_only")
+        s.apply_ddl_stage("alter table t add index idx (b)", "abort")
+        assert "idx" in s.catalog.table("test", "t").indexes
+
+    def test_online_not_null_without_default_rejected(self):
+        s = Session()
+        s.execute("create table t (a bigint)")
+        with pytest.raises(Exception, match="DEFAULT"):
+            s.apply_ddl_stage("alter table t add column c bigint not null",
+                              "write_only")
+        s.execute("insert into t values (1)")  # DML never wedged
+
+    def test_staged_objects_hidden_from_show(self):
+        s = Session()
+        s.execute("create table t (a bigint)")
+        s.apply_ddl_stage("alter table t add column c bigint", "write_only")
+        s.apply_ddl_stage("alter table t add index ix (a)", "write_only")
+        ddl = s.query("show create table t")[0][1]
+        assert "`c`" not in ddl and "`ix`" not in ddl
+        assert all(r[2] != "ix" for r in s.query("show index from t"))
+
+    def test_like_clone_resets_staged_state(self):
+        s = Session()
+        s.execute("create table t (a bigint)")
+        s.apply_ddl_stage("alter table t add column c bigint default 3",
+                          "write_only")
+        s.execute("create table t2 like t")
+        s.execute("insert into t2 values (1, 5)")  # both columns public
+        assert s.query("select * from t2") == [(1, 5)]

@@ -394,6 +394,15 @@ class Worker:
         if cmd == "exec":
             rs = self.session.execute(msg["sql"])
             return rs.rows if rs is not None else None
+        if cmd == "ddl_stage":
+            # one step of an online schema change (ref: schema-version
+            # leases + state machine, SURVEY.md:180-185): the
+            # coordinator barriers every worker through the same stage
+            # before advancing, so at most two adjacent schema states
+            # coexist; DML between stages stays correct (write_only
+            # columns default-fill, write_only indexes enforce)
+            self.session.apply_ddl_stage(msg["sql"], msg["stage"])
+            return {"schema_version": self.session.catalog.schema_version}
         if cmd == "load_columns":
             db = msg.get("db") or self.session.db
             name = msg["table"]
@@ -848,6 +857,47 @@ class Cluster:
 
     def broadcast_exec(self, sql: str) -> None:
         self._call_all([{"cmd": "exec", "sql": sql}] * len(self._socks))
+
+    def online_ddl(self, sql: str, between_stages=None) -> None:
+        """ONLINE multi-version schema change across worker processes
+        (ref: the DDL owner stepping the schema state machine one
+        version at a time while every instance keeps serving,
+        SURVEY.md:180-185). Each stage is an all-worker barrier — the
+        synchronous-ack equivalent of waiting out a schema lease, giving
+        the same ≤2-adjacent-versions guarantee. Concurrent DML between
+        stages is exactly the window the write_only states make safe.
+        `between_stages(stage)` is a test hook to widen that window.
+        A backfill failure (or dead worker) aborts the staged object on
+        every reachable worker."""
+        from tidb_tpu.parser import parse
+        from tidb_tpu.parser import ast as A
+
+        stmt = parse(sql)[0]
+        if not (isinstance(stmt, A.AlterTableStmt)
+                and stmt.action in ("add_column", "add_index")):
+            # shapes without intermediate states apply atomically
+            self.broadcast_exec(sql)
+            return
+        stages = (["write_only", "public"] if stmt.action == "add_column"
+                  else ["write_only", "backfill", "public"])
+        done = []
+        try:
+            for stage in stages:
+                self._call_all(
+                    [{"cmd": "ddl_stage", "sql": sql, "stage": stage}]
+                    * len(self._socks))
+                done.append(stage)
+                if between_stages is not None:
+                    between_stages(stage)
+        except Exception:
+            if "public" not in done:
+                try:
+                    self._call_all(
+                        [{"cmd": "ddl_stage", "sql": sql, "stage": "abort"}]
+                        * len(self._socks))
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            raise
 
     def load_partition(self, worker: int, table: str, arrays=None,
                        valids=None, strings=None, db: Optional[str] = None

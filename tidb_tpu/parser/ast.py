@@ -347,6 +347,7 @@ class AlterTableStmt:
     old_name: Optional[str] = None
     new_name: Optional[str] = None
     index: Optional[Tuple[str, List[str]]] = None
+    unique: bool = False      # ADD UNIQUE [INDEX|KEY]
     fk: Optional[Tuple[List[str], TableName, List[str]]] = None
     check: Optional[Tuple[str, "Expr", str]] = None
 

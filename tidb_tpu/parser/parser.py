@@ -970,11 +970,14 @@ class Parser:
         self.expect_kw("table")
         table = self._table_name()
         if self.accept_kw("add"):
-            if self.accept_kw("index") or self.accept_kw("key"):
+            uniq = bool(self.accept_kw("unique"))
+            if self.accept_kw("index") or self.accept_kw("key") or uniq:
                 name = ""
                 if self.peek().kind in ("IDENT", "QIDENT"):
                     name = self.expect_ident()
-                return AlterTableStmt(table, "add_index", index=(name, self._paren_name_list()))
+                return AlterTableStmt(table, "add_index",
+                                      index=(name, self._paren_name_list()),
+                                      unique=uniq)
             cname = ""
             if self.accept_kw("constraint"):
                 if self.peek().kind in ("IDENT", "QIDENT") and \

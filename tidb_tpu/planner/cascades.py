@@ -59,6 +59,8 @@ def _index_path(leaf, inner_exprs) -> Optional[str]:
             return None
         cols.add(col.name)
     for idx in getattr(leaf.table, "indexes", {}).values():
+        if getattr(idx, "state", "public") != "public":
+            continue  # online-DDL write_only: not an access path yet
         if len(idx.columns) >= len(cols) and set(
                 idx.columns[:len(cols)]) == cols:
             return idx.name

@@ -532,7 +532,7 @@ def build_from(src, ctx: BuildContext, outer: Optional[Scope]) -> Tuple[LogicalP
                 qualifier=alias,
                 dict_=table.dicts.get(c.name),
             )
-            for c in table.schema.columns
+            for c in table.schema.public_columns()
         ]
         # hidden physical-rowid pseudo-column: resolvable by name (the
         # multi-table DML path selects it through joins), invisible to

@@ -233,6 +233,8 @@ def inject_point_get(plan: PhysicalPlan) -> PhysicalPlan:
         for idx in getattr(table, "indexes", {}).values():
             if not idx.columns:
                 continue
+            if getattr(idx, "state", "public") != "public":
+                continue  # online-DDL write_only: not readable yet
             prefix = []
             for cname in idx.columns:
                 if cname in eqs:

@@ -1113,6 +1113,80 @@ class Session:
             collation=c.collation,
         )
 
+    def apply_ddl_stage(self, sql: str, stage: str) -> None:
+        """One step of an ONLINE schema change (ref: the multi-version
+        none→write-only→public state machine with schema-version leases,
+        SURVEY.md:180-185). The DCN coordinator drives every instance
+        through the same stage before advancing, so at most two adjacent
+        states coexist cluster-wide:
+
+        ADD COLUMN:  write_only -> public
+          write_only: the column exists in storage (default-backfilled)
+          and is written by new DML, but is invisible to reads — an
+          instance still at the previous version keeps inserting the
+          old positional shape correctly.
+        ADD INDEX:   write_only -> backfill -> public
+          write_only: enforced on every new write, invisible to access
+          paths; backfill: validate all existing rows (abort drops the
+          staged index); public: readable.
+        abort: undo a staged ADD (crash/validation-failure path)."""
+        stmt = parse(sql)[0]
+        if not isinstance(stmt, A.AlterTableStmt) or stmt.action not in (
+                "add_column", "add_index"):
+            raise UnsupportedError(
+                "online DDL stages cover ADD COLUMN / ADD INDEX only")
+        db = stmt.table.schema or self.db
+        t = self.catalog.table(db, stmt.table.name)
+        with self.catalog.lock:
+            if stmt.action == "add_column":
+                info = self._column_info(stmt.column)
+                if info.collation is None and t.schema.collation:
+                    info.collation = t.schema.collation
+                if stage == "write_only":
+                    if info.not_null and info.default is None:
+                        raise ExecutionError(
+                            "online ADD COLUMN requires a DEFAULT for a "
+                            "NOT NULL column (writers one schema version "
+                            "behind cannot supply it)")
+                    info.state = "write_only"
+                    t.add_column(info)
+                elif stage == "public":
+                    t.schema.col(info.name).state = "public"
+                    t.version += 1
+                elif stage == "abort":
+                    # only a STAGED column may be dropped: a duplicate-
+                    # name failure must never destroy the user's column
+                    if any(c.name == info.name and c.state == "write_only"
+                           for c in t.schema.columns):
+                        t.schema.col(info.name).state = "public"
+                        t.drop_column(info.name)
+                else:
+                    raise UnsupportedError(f"bad ddl stage {stage!r}")
+            else:
+                name, columns = stmt.index
+                iname = name or f"idx_{'_'.join(columns)}"
+                if stage == "write_only":
+                    t.create_index(iname, columns, unique=stmt.unique,
+                                   state="write_only")
+                elif stage == "backfill":
+                    idx = t.indexes[iname]
+                    if idx.unique:
+                        try:
+                            t._check_unique(idx)
+                        except Exception:
+                            t.drop_index(iname)
+                            raise
+                elif stage == "public":
+                    t.indexes[iname].state = "public"
+                    t.version += 1
+                elif stage == "abort":
+                    staged = t.indexes.get(iname)
+                    if staged is not None and staged.state == "write_only":
+                        t.drop_index(iname)
+                else:
+                    raise UnsupportedError(f"bad ddl stage {stage!r}")
+            self.catalog.schema_version += 1
+
     def _run_alter_table(self, stmt: A.AlterTableStmt):
         db = stmt.table.schema or self.db
         t = self.catalog.table(db, stmt.table.name)
@@ -1131,7 +1205,8 @@ class Session:
             self.catalog.rename_table(db, stmt.table.name, stmt.new_name)
         elif stmt.action == "add_index":
             name, columns = stmt.index
-            t.create_index(name or f"idx_{'_'.join(columns)}", columns)
+            t.create_index(name or f"idx_{'_'.join(columns)}", columns,
+                           unique=stmt.unique)
         elif stmt.action == "add_foreign_key":
             parent, fk = self.catalog._resolve_foreign_key(db, t, stmt.fk)
             if stmt.new_name:
@@ -1268,6 +1343,8 @@ class Session:
         src = self.catalog.table(src_tn.schema or self.db, src_tn.name)
         schema = copy.deepcopy(src.schema)
         schema.name = stmt.table.name
+        for c in schema.columns:
+            c.state = "public"
         t = self.catalog.create_table(stmt.table.schema or self.db, schema,
                                       stmt.if_not_exists, engine=src.engine)
         if t is not None and t.schema is schema:
@@ -1395,7 +1472,7 @@ class Session:
 
         binder = Binder()
         rows = []
-        names = stmt.columns or table.schema.names()
+        names = stmt.columns or table.schema.public_names()
         for r_ast in stmt.rows:
             if len(r_ast) != len(names):
                 raise ExecutionError(
@@ -1443,7 +1520,7 @@ class Session:
         a later VALUES row colliding with an earlier one of the same
         statement supersedes it (last row wins). One delete + one
         insert call per statement."""
-        names = columns or table.schema.names()
+        names = columns or table.schema.public_names()
         maps = self._conflict_maps(table, txn.marker)
         log = txn.log_for(table)
         pending: list = []
@@ -1484,7 +1561,7 @@ class Session:
         from tidb_tpu.planner.binder import Binder
 
         binder = Binder()
-        names = columns or table.schema.names()
+        names = columns or table.schema.public_names()
         maps = self._conflict_maps(table, txn.marker)
         log = txn.log_for(table)
         for row, r_ast in zip(rows, row_asts):
@@ -1755,7 +1832,7 @@ class Session:
                 stmt.enclosed is not None and len(stmt.enclosed) != 1):
             raise UnsupportedError(
                 "FIELDS TERMINATED/ENCLOSED BY must be one character")
-        names = stmt.columns or table.schema.names()
+        names = stmt.columns or table.schema.public_names()
         cols = [table.schema.col(n) for n in names]
         str_col = [c.type_.kind in (TypeKind.STRING, TypeKind.JSON)
                    for c in cols]
@@ -2083,13 +2160,15 @@ class Session:
             t = self.catalog.table(self.db, stmt.target)
             rows = [
                 (c.name, str(c.type_), "NO" if c.not_null else "YES")
-                for c in t.schema.columns
+                for c in t.schema.public_columns()
             ]
             return ResultSet(names=["Field", "Type", "Null"], rows=rows)
         if stmt.kind == "index":
             t = self.catalog.table(self.db, stmt.target)
             rows = []
             for idx in t.indexes.values():
+                if idx.state != "public":
+                    continue  # staged online-DDL index: not visible yet
                 for seq, col in enumerate(idx.columns, 1):
                     rows.append((stmt.target, 0 if idx.unique else 1,
                                  idx.name, seq, col))
@@ -2105,7 +2184,7 @@ class Session:
             kindmap = {"int": "bigint", "float": "double",
                        "string": "varchar(255)", "bool": "tinyint(1)"}
             lines = []
-            for c in t.schema.columns:
+            for c in t.schema.public_columns():
                 ty = c.type_text or kindmap.get(str(c.type_), str(c.type_))
                 parts = [f"  `{c.name}` {ty}"]
                 if c.type_.is_dict_encoded and c.collation is not None:
@@ -2125,7 +2204,7 @@ class Session:
                 keys = ", ".join(f"`{k}`" for k in t.schema.primary_key)
                 lines.append(f"  PRIMARY KEY ({keys})")
             for name, ix in t.indexes.items():
-                if name == "PRIMARY":
+                if name == "PRIMARY" or ix.state != "public":
                     continue
                 keys = ", ".join(f"`{k}`" for k in ix.columns)
                 kw = "UNIQUE KEY" if ix.unique else "KEY"

@@ -110,7 +110,7 @@ class DeltaTable:
             self._compact()
             return base.insert_rows(rows, columns=columns,
                                     begin_ts=begin_ts, log=log)
-        names = columns or base.schema.names()
+        names = columns or base.schema.public_names()
         cols = [base.schema.col(n) for n in names]
         m = len(rows)
         if m == 0:

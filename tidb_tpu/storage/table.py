@@ -64,6 +64,12 @@ class ColumnInfo:
     # string collation (ref: MySQL per-column collations); None means the
     # MySQL-compatible default (utf8mb4_general_ci — case-insensitive)
     collation: Optional[str] = None
+    # online-DDL schema state (ref: the none→delete-only→write-only→
+    # public state machine, SURVEY.md:180-185): "write_only" columns are
+    # invisible to reads (star expansion, positional INSERT width) but
+    # default-filled on writes, so an instance one schema version behind
+    # still writes correct rows during ADD COLUMN
+    state: str = "public"
 
     @property
     def coll(self) -> str:
@@ -121,6 +127,10 @@ class IndexInfo:
     name: str
     columns: List[str]
     unique: bool = False
+    # online-DDL state: "write_only" indexes are maintained/enforced on
+    # every write but invisible to the planner's access paths until the
+    # backfill validates existing rows and flips them public
+    state: str = "public"
 
 
 @dataclass
@@ -177,6 +187,13 @@ class TableSchema:
 
     def names(self) -> List[str]:
         return [c.name for c in self.columns]
+
+    def public_columns(self) -> List[ColumnInfo]:
+        """Columns visible to reads (online-DDL write_only excluded)."""
+        return [c for c in self.columns if c.state == "public"]
+
+    def public_names(self) -> List[str]:
+        return [c.name for c in self.public_columns()]
 
 
 _GROW = 1.5
@@ -348,7 +365,10 @@ class Table:
         dates as date/str, decimals as str/float). Returns rows inserted.
         begin_ts: commit timestamp, or a txn marker for provisional writes;
         None commits immediately at the next TSO tick."""
-        names = columns or self.schema.names()
+        # positional inserts carry the PUBLIC column width: a writer one
+        # schema version behind an in-flight ADD COLUMN (write_only)
+        # supplies the old shape and the new column default-fills below
+        names = columns or self.schema.public_names()
         cols = [self.schema.col(n) for n in names]
         m = len(rows)
         if m == 0:
@@ -1273,14 +1293,18 @@ class Table:
 
     # -- indexes -----------------------------------------------------------
 
-    def create_index(self, name: str, columns: List[str], unique: bool = False) -> None:
+    def create_index(self, name: str, columns: List[str],
+                     unique: bool = False, state: str = "public") -> None:
         for c in columns:
             self.schema.col(c)  # raises if absent
         if name in self.indexes:
             raise SchemaError(f"duplicate index {name!r}")
-        idx = IndexInfo(name=name, columns=list(columns), unique=unique)
-        if unique:
-            self._check_unique(idx)  # validate existing data before adding
+        idx = IndexInfo(name=name, columns=list(columns), unique=unique,
+                        state=state)
+        if unique and state == "public":
+            # atomic path validates now; a write_only (online DDL)
+            # index defers existing-row validation to its backfill stage
+            self._check_unique(idx)
         self.indexes[name] = idx
         self.version += 1
 
