@@ -129,10 +129,11 @@ class TestPrunedIsFaster:
         plan = "\n".join(r[0] for r in s.query("explain " + sql))
         assert "partitions:p0" in plan
         s.query(sql)  # warm compile
-        t0 = time.perf_counter()
-        for _ in range(3):
+        pruned = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
             got = s.query(sql)
-        pruned = time.perf_counter() - t0
+            pruned = min(pruned, time.perf_counter() - t0)
         assert got == [(1000, sum(range(1000)) * 3)]
         # same query forced unpruned: widen the predicate so pruning
         # keeps every partition (planner falls back to the full scan)
@@ -140,10 +141,12 @@ class TestPrunedIsFaster:
                     "where id < 1000 and v >= 0")
         plan2 = "\n".join(r[0] for r in s.query("explain " + sql_full))
         s.query(sql_full)
-        t0 = time.perf_counter()
-        for _ in range(3):
+        full = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
             s.query(sql_full)
-        full = time.perf_counter() - t0
+            full = min(full, time.perf_counter() - t0)
+        # best-of-5 comparison: robust to background load spikes
         assert pruned < full, (pruned, full, plan2)
 
 

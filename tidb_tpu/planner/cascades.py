@@ -117,8 +117,11 @@ def memo_join_search(leaves: List[LogicalPlan], eqs, others,
     from tidb_tpu.planner.physical import _estimate, eq_join_rows
 
     n = len(leaves)
-    if n < 2 or n > MAX_LEAVES:
+    if n < 2:
         return None
+    if n > MAX_LEAVES:
+        return _idp_search(leaves, eqs, others, classify_edges,
+                           conj_join, pushdown_rule, n_parts)
     edges, leftover = classify_edges(leaves, eqs, others)
 
     memo = Memo()
@@ -218,3 +221,59 @@ def memo_join_search(leaves: List[LogicalPlan], eqs, others,
                          cond=conj_join(leftover))
         return pushdown_rule(sel)
     return tree
+
+
+def _idp_search(leaves, eqs, others, classify_edges, conj_join,
+                pushdown_rule, n_parts):
+    """Iterative dynamic programming beyond MAX_LEAVES (IDP-1, the
+    standard widening of exhaustive join DP): memo-optimize a CONNECTED
+    window of MAX_LEAVES leaves (BFS over join edges from the
+    smallest-estimate leaf), collapse the winner into one composite
+    leaf, and repeat until the remaining graph fits the memo. Each
+    window is exhaustively ordered under the shared cost model; only
+    cross-window orderings are approximated — an 11+-table query still
+    optimizes instead of falling back to greedy wholesale."""
+    from tidb_tpu.planner.physical import _estimate
+    from tidb_tpu.planner.rules import _refs
+
+    leaves, eqs, others = list(leaves), list(eqs), list(others)
+    while len(leaves) > MAX_LEAVES:
+        edges, _leftover = classify_edges(leaves, eqs, others)
+        adj = {i: set() for i in range(len(leaves))}
+        for ia, ib, _a, _b in edges:
+            adj[ia].add(ib)
+            adj[ib].add(ia)
+        est = [float(_estimate(l)) for l in leaves]  # once per round
+        window, seen = [], set()
+        # BFS whole components smallest-estimate-first: padding must
+        # stay connectivity-aware — a leaf windowed without its join
+        # partners would force a REAL cartesian product inside the
+        # collapsed composite
+        while len(window) < MAX_LEAVES and len(seen) < len(leaves):
+            start = min((i for i in range(len(leaves)) if i not in seen),
+                        key=est.__getitem__)
+            frontier = [start]
+            while frontier and len(window) < MAX_LEAVES:
+                i = frontier.pop(0)
+                if i in seen:
+                    continue
+                seen.add(i)
+                window.append(i)
+                frontier.extend(sorted(adj[i] - seen, key=est.__getitem__))
+        uid_w = set()
+        for i in window:
+            uid_w |= {c.uid for c in leaves[i].schema}
+        in_eqs = [p for p in eqs if (_refs(p[0]) | _refs(p[1])) <= uid_w]
+        in_others = [o for o in others if _refs(o) <= uid_w]
+        sub = memo_join_search([leaves[i] for i in window], in_eqs,
+                               in_others, classify_edges, conj_join,
+                               pushdown_rule, n_parts=n_parts)
+        if sub is None:
+            return None
+        wset = set(window)
+        in_ids = {id(p) for p in in_eqs} | {id(o) for o in in_others}
+        leaves = [l for i, l in enumerate(leaves) if i not in wset] + [sub]
+        eqs = [p for p in eqs if id(p) not in in_ids]
+        others = [o for o in others if id(o) not in in_ids]
+    return memo_join_search(leaves, eqs, others, classify_edges,
+                            conj_join, pushdown_rule, n_parts=n_parts)

@@ -681,7 +681,8 @@ def _rule_distinct_two_phase(plan: LogicalPlan) -> LogicalPlan:
 
 
 
-def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
+def _rule_eager_agg(plan: LogicalPlan, cost_based: bool = False,
+                    n_parts: int = 1) -> LogicalPlan:
     """Eager aggregation: push a partial aggregate below a join when
     every aggregate argument comes from one join side (ref: planner/
     core's aggregation-pushdown rule; the canonical win is Q18's
@@ -699,7 +700,8 @@ def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
     non-inner joins on the path / expressions straddling both sides /
     global COUNT (an empty join must still report 0, not NULL)."""
     if plan.children:
-        plan.children[:] = [_rule_eager_agg(c) for c in plan.children]
+        plan.children[:] = [_rule_eager_agg(c, cost_based, n_parts)
+                            for c in plan.children]
     if not (isinstance(plan, LAggregate) and isinstance(plan.children[0], LJoin)):
         return plan
     agg = plan
@@ -821,9 +823,33 @@ def _rule_eager_agg(plan: LogicalPlan) -> LogicalPlan:
         aggs=p_aggs,
     )
 
-    # second half of the shrink gate: stats must show the partial helps
+    # placement decision. Cascades mode prices BOTH alternatives with
+    # the memo's shared cost model (_join_step_cost + LOCAL_WORK, the
+    # terms the join-order search itself minimizes) over the join path
+    # the partial would ride — pre-agg vs post-agg trades off against
+    # the same units as join order and access paths (SURVEY.md:88-89).
+    # The heuristic mode keeps the fresh-stats 70% shrink gate.
     p_rows = _estimate(partial)
-    if not (p_rows < 0.7 * s_rows):
+    if cost_based:
+        from tidb_tpu.planner.cascades import LOCAL_WORK
+
+        def path_cost(side_rows: float) -> float:
+            # join outputs scale linearly in the S-side cardinality
+            # under the key-join model the estimator already assumes
+            cost, cur = 0.0, side_rows
+            scale = side_rows / max(s_rows, 1.0)
+            for join, side in reversed(path):
+                o_rows = float(_estimate(join.children[1 - side]))
+                out = float(_estimate(join)) * scale
+                cost += (_join_step_cost(cur, o_rows, out, n_parts)
+                         + LOCAL_WORK * (cur + o_rows))
+                cur = out
+            return cost
+
+        build = LOCAL_WORK * s_rows + p_rows  # partial's own pass
+        if build + path_cost(p_rows) >= path_cost(s_rows):
+            return plan
+    elif not (p_rows < 0.7 * s_rows):
         return plan
 
     # splice: replace S, rebuild path joins bottom-up with rewritten
@@ -872,6 +898,6 @@ def optimize_logical(plan: LogicalPlan, hints=(), cascades=False,
     leading = next((args for name, args in hints if name == "leading"), None)
     plan = _rule_reorder(plan, leading, cascades, n_parts)
     if agg_push_down:
-        plan = _rule_eager_agg(plan)
+        plan = _rule_eager_agg(plan, cost_based=cascades, n_parts=n_parts)
     plan = _rule_prune(plan, None)
     return plan
