@@ -132,7 +132,7 @@ def test_session_info_builtins(s):
     assert q1(s, "select user()") == "root@%"
     assert q1(s, "select current_user") == "root@%"
     assert "tidb-tpu" in q1(s, "select version()")
-    assert q1(s, "select connection_id()") == 0
+    assert q1(s, "select connection_id()") == s.conn_id  # real processlist id
 
 
 # -- strings -----------------------------------------------------------------

@@ -1,0 +1,226 @@
+"""MySQL surface tail (VERDICT r4 missing #6 / weak #8): TEMPORARY
+tables, generated columns, SHOW PROCESSLIST + KILL, and warnings for
+accepted-but-ignored clauses."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+
+
+class TestTemporaryTables:
+    def test_session_local_and_shadowing(self):
+        s = Session()
+        s.execute("create table t (a bigint)")
+        s.execute("insert into t values (1)")
+        s.execute("create temporary table tt (x bigint)")
+        s.execute("insert into tt values (5), (6)")
+        assert s.query("select sum(x) from tt") == [(11,)]
+        # a temp table SHADOWS the permanent one by name...
+        s.execute("create temporary table t (z bigint)")
+        s.execute("insert into t values (99)")
+        assert s.query("select * from t") == [(99,)]
+        # ...and DROP removes the temp first, unshadowing (MySQL)
+        s.execute("drop table t")
+        assert s.query("select * from t") == [(1,)]
+
+    def test_invisible_to_other_sessions(self):
+        s = Session()
+        s.execute("create temporary table tt (x bigint)")
+        s2 = Session(catalog=s.catalog)
+        with pytest.raises(Exception, match="tt"):
+            s2.query("select * from tt")
+        assert s2.catalog.base is s.catalog.base
+
+    def test_dml_and_txn_work(self):
+        s = Session()
+        s.execute("create temporary table tt (a bigint primary key, "
+                  "b bigint)")
+        s.execute("insert into tt values (1, 10), (2, 20)")
+        s.execute("begin")
+        s.execute("update tt set b = 11 where a = 1")
+        s.execute("rollback")
+        assert s.query("select b from tt where a = 1") == [(10,)]
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            s.execute("insert into tt values (1, 0)")
+
+    def test_temp_fk_rejected(self):
+        s = Session()
+        s.execute("create table p (a bigint primary key)")
+        with pytest.raises(Exception, match="TEMPORARY"):
+            s.execute("create temporary table c (a bigint, "
+                      "foreign key (a) references p (a))")
+
+
+class TestGeneratedColumns:
+    def test_stored_and_virtual_compute_on_write(self):
+        s = Session()
+        s.execute("create table g (a bigint, b bigint, "
+                  "c bigint generated always as (a + b) stored, "
+                  "d bigint as (a * 2) virtual)")
+        s.execute("insert into g values (1, 10), (2, 20)")
+        assert s.query("select * from g order by a") == \
+            [(1, 10, 11, 2), (2, 20, 22, 4)]
+        s.execute("update g set b = 100 where a = 1")
+        assert s.query("select c from g where a = 1") == [(101,)]
+
+    def test_explicit_values_rejected(self):
+        s = Session()
+        s.execute("create table g (a bigint, c bigint as (a + 1))")
+        with pytest.raises(Exception, match="generated"):
+            s.execute("insert into g (a, c) values (1, 5)")
+        s.execute("insert into g values (1)")
+        with pytest.raises(Exception, match="generated"):
+            s.execute("update g set c = 9")
+
+    def test_usable_in_where_and_index(self):
+        s = Session()
+        s.execute("create table g (a bigint, c bigint as (a * a) stored)")
+        s.execute("insert into g values (2), (3), (4)")
+        assert s.query("select a from g where c > 5 order by a") == \
+            [(3,), (4,)]
+        s.execute("create unique index uc on g (c)")
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            s.execute("insert into g values (-3)")  # (-3)^2 == 9 dup
+
+    def test_self_or_gen_reference_rejected(self):
+        s = Session()
+        with pytest.raises(Exception, match="generated"):
+            s.execute("create table g (a bigint, c bigint as (c + 1))")
+        with pytest.raises(Exception, match="generated"):
+            s.execute("create table g2 (a bigint, c bigint as (a + 1), "
+                      "d bigint as (c + 1))")
+
+
+class TestProcesslistKill:
+    def test_show_processlist_lists_sessions(self):
+        s = Session()
+        s2 = Session(catalog=s.catalog)
+        rows = s.query("show processlist")
+        ids = [r[0] for r in rows]
+        assert s.conn_id in ids and s2.conn_id in ids
+        me = next(r for r in rows if r[0] == s.conn_id)
+        assert me[1] == "root" and me[4] == "Query"  # our own SHOW
+
+    def test_kill_query_interrupts_once(self):
+        s = Session()
+        s2 = Session(catalog=s.catalog)
+        s2.execute("create table big (a bigint)")
+        s.catalog.table("test", "big").insert_columns(
+            {"a": np.arange(400_000)})
+        got = []
+
+        def victim():
+            try:
+                s2.query("select count(*) from big b1 "
+                         "join big b2 on b1.a = b2.a")
+                got.append("finished")
+            except Exception as e:  # noqa: BLE001
+                got.append(str(e))
+
+        th = threading.Thread(target=victim)
+        th.start()
+        time.sleep(0.25)
+        s.execute(f"kill query {s2.conn_id}")
+        th.join(timeout=60)
+        assert not th.is_alive()
+        # either interrupted, or the query legitimately beat the KILL
+        assert got and ("interrupted" in got[0] or got[0] == "finished")
+        # KILL QUERY is one-shot: the session keeps working
+        assert s2.query("select 1") == [(1,)]
+
+    def test_kill_connection_is_permanent(self):
+        s = Session()
+        s2 = Session(catalog=s.catalog)
+        s.execute(f"kill {s2.conn_id}")
+        with pytest.raises(Exception, match="killed"):
+            s2.query("select 1")
+        with pytest.raises(Exception, match="killed"):
+            s2.query("select 1")
+
+    def test_kill_unknown_id(self):
+        s = Session()
+        with pytest.raises(Exception, match="Unknown thread"):
+            s.execute("kill 999999")
+
+
+class TestIgnoredClauseWarnings:
+    def test_comment_and_charset_warn(self):
+        s = Session()
+        s.execute("create table w (a bigint comment 'x') "
+                  "comment = 'tbl' charset = utf8mb4")
+        rows = s.query("show warnings")
+        msgs = " | ".join(r[2] for r in rows)
+        assert "COMMENT" in msgs and "CHARSET" in msgs
+        assert all(r[0] == "Warning" for r in rows)
+
+    def test_warnings_clear_next_statement(self):
+        s = Session()
+        s.execute("create table w (a bigint) comment = 'x'")
+        assert s.query("show warnings")
+        # SHOW WARNINGS itself must NOT clear them (MySQL)
+        assert s.query("show warnings")
+        s.query("select 1")
+        assert s.query("show warnings") == []
+
+
+class TestReviewRegressions:
+    def test_temp_like_and_ctas_stay_session_local(self):
+        s = Session()
+        s.execute("create table src (a bigint)")
+        s.execute("insert into src values (1)")
+        s.execute("create temporary table tl like src")
+        s.execute("create temporary table tc as select a from src")
+        s2 = Session(catalog=s.catalog)
+        for name in ("tl", "tc"):
+            with pytest.raises(Exception):
+                s2.query(f"select * from {name}")
+        assert s.query("select * from tc") == [(1,)]
+
+    def test_generated_not_null_inserts(self):
+        s = Session()
+        s.execute("create table g (a bigint, "
+                  "c bigint generated always as (a + 1) not null)")
+        s.execute("insert into g values (1)")
+        assert s.query("select c from g") == [(2,)]
+
+    def test_string_generated_target_rejected(self):
+        s = Session()
+        with pytest.raises(Exception, match="generated"):
+            s.execute("create table g (a bigint, v varchar(10) as (a))")
+
+    def test_insert_select_generated_rejected(self):
+        s = Session()
+        s.execute("create table src (x bigint)")
+        s.execute("insert into src values (9)")
+        s.execute("create table g (a bigint, c bigint as (a + 1))")
+        with pytest.raises(Exception, match="generated"):
+            s.execute("insert into g (a, c) select x, x from src")
+
+    def test_processlist_non_super_sees_own(self):
+        s = Session()
+        s.execute("create user 'bob' identified by ''")
+        s2 = Session(catalog=s.catalog)
+        s2.user = "bob"
+        rows = s2.query("show processlist")
+        assert rows and all(r[1] == "bob" for r in rows)
+
+    def test_temp_shadow_ddl_stays_inline(self):
+        """DDL on a temp-shadowed name must never reach the DDL owner
+        (which cannot see the session's temp namespace)."""
+        from tidb_tpu.owner import DDLWorker
+
+        s = Session()
+        s.execute("create table shadowed (a bigint)")
+        s.execute("insert into shadowed values (1)")
+        w = DDLWorker(s.catalog.base, "w1")
+        w.start()
+        try:
+            s.execute("create temporary table shadowed (z bigint)")
+            s.execute("drop table shadowed")  # drops the TEMP one
+            assert s.query("select * from shadowed") == [(1,)]
+        finally:
+            w.stop()

@@ -37,6 +37,8 @@ class ExecContext:
     # start ts and marker so it sees its own provisional writes
     read_ts: Optional[int] = None
     txn_marker: int = 0
+    # KILL support: polled between chunks; return True to cancel
+    cancel_check: Optional[object] = None
     # host-side memory accounting root (budget + spill/OOM actions live
     # here; ref: the per-query memory.Tracker in sessionctx)
     mem_tracker: "object" = None
@@ -128,6 +130,11 @@ def _run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None)
         dicts = {c.uid: c.dict_ for c in visible if c.dict_ is not None}
         rows: List[tuple] = []
         for ch in root.chunks():
+            if ctx.cancel_check is not None and ctx.cancel_check():
+                from tidb_tpu.errors import ExecutionError
+
+                raise ExecutionError(
+                    "Query execution was interrupted (KILL)")
             rows.extend(ch.to_pylist(dicts=dicts, names=uids))
         return ResultSet(
             names=[c.name for c in visible],

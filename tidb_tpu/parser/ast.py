@@ -23,7 +23,8 @@ __all__ = [
     # statements
     "InsertStmt", "UpdateStmt", "DeleteStmt", "ColumnDef", "CreateTableStmt",
     "DropTableStmt", "CreateIndexStmt", "DropIndexStmt", "AlterTableStmt",
-    "ExplainStmt", "TraceStmt", "SetStmt", "ShowStmt", "BeginStmt", "CommitStmt",
+    "ExplainStmt", "TraceStmt", "SetStmt", "ShowStmt", "KillStmt",
+    "BeginStmt", "CommitStmt",
     "RollbackStmt", "SavepointStmt", "RollbackToStmt", "ReleaseSavepointStmt",
     "UseStmt", "TruncateStmt", "LoadDataStmt", "IntoOutfile",
     "AnalyzeStmt",
@@ -298,6 +299,10 @@ class ColumnDef:
     checks: List[Tuple["Expr", str]] = field(default_factory=list)
     # COLLATE clause (None = the engine default, utf8mb4_general_ci)
     collation: Optional[str] = None
+    # GENERATED ALWAYS AS: (expr ast, verbatim sql, stored?) or None
+    generated: Optional[tuple] = None
+    # clauses accepted but not implemented (-> SHOW WARNINGS)
+    ignored: List[str] = field(default_factory=list)
 
 @dataclass
 class CreateTableStmt:
@@ -312,6 +317,9 @@ class CreateTableStmt:
     # PARTITION BY: ("range", col, [(pname, upper_or_None_for_MAXVALUE)])
     # or ("hash", col, n_partitions)
     partition: Optional[tuple] = None
+    temporary: bool = False  # CREATE TEMPORARY TABLE (session-local)
+    # table options accepted but not implemented (-> SHOW WARNINGS)
+    ignored: List[str] = field(default_factory=list)
     # FOREIGN KEY clauses: (fk_columns, referenced TableName, ref_columns)
     foreign_keys: List[Tuple[List[str], TableName, List[str]]] = \
         field(default_factory=list)
@@ -364,6 +372,12 @@ class ExplainStmt:
 class SetStmt:
     assignments: List[Tuple[str, str, Expr]] = field(default_factory=list)
     # (scope 'global'|'session'|'user', name, value)
+
+@dataclass
+class KillStmt:
+    conn_id: int
+    query_only: bool = False  # KILL QUERY vs KILL [CONNECTION]
+
 
 @dataclass
 class ShowStmt:

@@ -127,6 +127,11 @@ class Parser:
         if t.kind == "IDENT" and t.text.lower() == "savepoint":
             self.next()
             return SavepointStmt(self.expect_ident())
+        if t.kind == "IDENT" and t.text.lower() == "kill":
+            self.next()
+            query_only = bool(self._accept_word("query"))
+            self._accept_word("connection")
+            return KillStmt(self._int_literal("connection id"), query_only)
         if t.kind == "IDENT" and t.text.lower() == "release":
             self.next()
             self._expect_word("savepoint")
@@ -643,6 +648,13 @@ class Parser:
                 self.expect_kw("by")
                 password = self.next().text
             return CreateUserStmt(user, password, ine)
+        temporary = self._accept_word("temporary")
+        if temporary:
+            self.expect_kw("table")
+            ine = self._if_not_exists()
+            stmt = CreateTableStmt(self._table_name(), if_not_exists=ine,
+                                   temporary=True)
+            return self._create_table_tail(stmt)
         unique = bool(self.accept_kw("unique"))
         if self.accept_kw("index"):
             name = self.expect_ident()
@@ -658,6 +670,9 @@ class Parser:
         ine = self._if_not_exists()
         table = self._table_name()
         stmt = CreateTableStmt(table, if_not_exists=ine)
+        return self._create_table_tail(stmt)
+
+    def _create_table_tail(self, stmt):
         if self.accept_kw("like"):
             stmt.like = self._table_name()
             return stmt
@@ -736,6 +751,10 @@ class Parser:
                 stmt.engine = val.lower()
             elif opt == "collate":
                 stmt.collation = val.lower()
+            else:
+                # accepted-and-ignored: surfaced via SHOW WARNINGS
+                # instead of vanishing silently (r4 review weak #8)
+                stmt.ignored.append(f"table option {opt.upper()}")
         # PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n)...)
         # | PARTITION BY HASH (col) PARTITIONS n   (ref: table partitions
         # pruned like the reference's partition pruning)
@@ -833,6 +852,13 @@ class Parser:
             collation = self.next().text.lower()
         col = ColumnDef(name, type_name, args)
         col.collation = collation
+        # generated column: [GENERATED ALWAYS] AS (expr) [VIRTUAL|STORED]
+        if self._accept_word("generated"):
+            self._expect_word("always")
+            self.expect_kw("as")
+            col.generated = self._parse_generated_expr()
+        elif self.accept_kw("as"):
+            col.generated = self._parse_generated_expr()
         while True:
             if self.accept_kw("not"):
                 self.expect_kw("null")
@@ -851,6 +877,7 @@ class Parser:
                 col.auto_increment = True
             elif self.accept_kw("comment"):
                 self.next()
+                col.ignored.append(f"column {name!r} COMMENT")
             elif self.peek().kind == "IDENT" and \
                     self.peek().text.lower() == "check":
                 self.next()
@@ -890,6 +917,20 @@ class Parser:
             else:
                 on_update = act
         return cols, ref, refcols, on_delete, on_update
+
+    def _parse_generated_expr(self):
+        """(expr) [VIRTUAL | STORED] -> (ast, verbatim sql, stored)."""
+        self.expect_op("(")
+        p0 = self.peek().pos
+        e = self.parse_expr()
+        p1 = self.peek().pos
+        self.expect_op(")")
+        stored = True
+        if self._accept_word("virtual"):
+            stored = False
+        elif self._accept_word("stored"):
+            stored = True
+        return e, self.sql[p0:p1].strip(), stored
 
     def _parse_check_expr(self):
         """CHECK ( expr ) -> (ast expr, verbatim sql text)."""
@@ -1087,6 +1128,10 @@ class Parser:
             return ShowStmt("variables", like=like)
         if self.accept_kw("status"):
             return ShowStmt("status")
+        if self._accept_word("processlist"):
+            return ShowStmt("processlist")
+        if self._accept_word("warnings"):
+            return ShowStmt("warnings")
         if self.accept_kw("plugins"):
             return ShowStmt("plugins")
         if self.accept_kw("index") or (

@@ -125,6 +125,7 @@ class Server:
         from tidb_tpu.utils.metrics import CONN_GAUGE
 
         CONN_GAUGE.inc()
+        sess = None
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sess = Session(catalog=self.catalog, mesh=self.mesh)
@@ -157,6 +158,12 @@ class Server:
             traceback.print_exc()
         finally:
             CONN_GAUGE.dec()
+            try:
+                # connection end: the session's TEMPORARY tables vanish
+                if sess is not None:
+                    sess.catalog.drop_temp_tables()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
             try:
                 conn.close()
             except OSError:

@@ -267,7 +267,12 @@ class TxnState:
 class Session:
     def __init__(self, catalog: Optional[Catalog] = None, db: str = "test",
                  chunk_capacity: Optional[int] = None, mesh=None):
-        self.catalog = catalog or Catalog()
+        from tidb_tpu.storage.catalog import SessionCatalog
+
+        # per-session overlay: TEMPORARY-table namespace over the shared
+        # catalog (unwraps another session's proxy to the common base)
+        self.catalog = SessionCatalog(catalog if catalog is not None
+                                      else Catalog())
         self.db = db
         self._chunk_capacity = chunk_capacity  # explicit override; else sysvar
         self.sysvars = SysVarStore(self.catalog.global_vars)
@@ -285,6 +290,15 @@ class Session:
         # set while a FOR UPDATE/SHARE read runs: reads latest committed
         # instead of the txn snapshot (MySQL locking reads are current)
         self._lock_read = False
+        # processlist registration (ref: server/ connection registry)
+        self.conn_id = self.catalog.next_conn_id()
+        self.catalog.processes[self.conn_id] = self
+        self._current_sql: Optional[str] = None
+        self._current_t0: float = 0.0
+        self._killed = False       # KILL <id>: connection is dead
+        self._kill_query = False   # KILL QUERY <id>: one-shot cancel
+        # diagnostics area for SHOW WARNINGS (cleared per statement)
+        self._warnings: list = []
         self.mesh = mesh
         self._shard_cache = None
         if mesh is not None:
@@ -471,6 +485,14 @@ class Session:
         # gets the same guarantee.
         if self.catalog.has_stale_txns():
             self.catalog.resolve_locks()
+        if self._killed:
+            raise ExecutionError("connection was killed")
+        self._kill_query = False  # a prior KILL QUERY cancels only its query
+        if not (isinstance(stmt, A.ShowStmt)
+                and getattr(stmt, "kind", "") == "warnings"):
+            self._warnings.clear()  # MySQL: each statement resets the area
+        self._current_sql = sql
+        self._current_t0 = _time.time()
         stype = type(stmt).__name__.removesuffix("Stmt").lower()
         self.catalog.plugins.statement_begin(self, sql, stype)
         prof_dir = str(self.sysvars.get("tidb_profile_dir"))
@@ -488,6 +510,8 @@ class Session:
             self.catalog.plugins.statement_end(
                 self, sql, stype, _time.perf_counter() - t0, exc)
             raise
+        finally:
+            self._current_sql = None
         dur = _time.perf_counter() - t0
         self.catalog.plugins.statement_end(self, sql, stype, dur, None)
         M.QUERY_TOTAL.inc(type=stype, status="ok")
@@ -555,6 +579,7 @@ class Session:
             device_agg=bool(self.sysvars.get("tidb_enable_tpu_exec"))
             and self._device_engine_auto(),
             device_cache_bytes=int(self.sysvars.get("tidb_device_cache_bytes")),
+            cancel_check=lambda: self._killed or self._kill_query,
         )
 
     def _agg_push_down(self) -> bool:
@@ -634,6 +659,24 @@ class Session:
 
             return _dc.replace(stmt, hints=list(b.stmt.hints))
         return stmt
+
+    def _targets_temp_table(self, stmt) -> bool:
+        """True when a DDL statement targets a table shadowed by this
+        session's TEMPORARY namespace — such DDL must run inline (the
+        DDL owner's session cannot see session-local tables)."""
+        temp = getattr(self.catalog, "_temp", {})
+        if not temp:
+            return False
+        names = []
+        if isinstance(stmt, A.DropTableStmt):
+            names = [(t.schema or self.db, t.name) for t in stmt.tables]
+        elif isinstance(stmt, (A.TruncateStmt, A.AlterTableStmt)):
+            tn = stmt.table
+            names = [(tn.schema or self.db, tn.name)]
+        elif isinstance(stmt, (A.CreateIndexStmt, A.DropIndexStmt)):
+            tn = stmt.table
+            names = [(tn.schema or self.db, tn.name)]
+        return any(k in temp for k in names)
 
     def _run_locking_select(self, stmt) -> ResultSet:
         # NOTE on cost: the visible query runs once, plus one hidden
@@ -874,7 +917,12 @@ class Session:
             # multi-instance deployments run DDL through the elected
             # owner's worker (ref: ddl job queue + owner election);
             # inline otherwise (embedded / the worker's own session)
-            if self.catalog.ddl_workers and not getattr(self, "_ddl_direct", False):
+            if (self.catalog.ddl_workers
+                    and not getattr(self, "_ddl_direct", False)
+                    and not getattr(stmt, "temporary", False)
+                    and not self._targets_temp_table(stmt)):
+                # TEMPORARY tables are session-local: routing them to the
+                # DDL owner would create them in the WORKER's namespace
                 source = getattr(stmt, "_source", None)
                 if source:
                     job = self.catalog.submit_ddl(source, self.db)
@@ -936,6 +984,22 @@ class Session:
             return None
         if isinstance(stmt, A.ShowStmt):
             return self._run_show(stmt)
+        if isinstance(stmt, A.KillStmt):
+            # KILL [QUERY|CONNECTION] <id> (ref: server/'s kill flow):
+            # QUERY cancels the victim's in-flight statement at its next
+            # chunk boundary; CONNECTION also fails every later statement
+            if self.user != "root":
+                victim0 = self.catalog.processes.get(stmt.conn_id)
+                if victim0 is None or victim0.user != self.user:
+                    self._priv("super")  # only SUPER kills others
+            victim = self.catalog.processes.get(stmt.conn_id)
+            if victim is None:
+                raise ExecutionError(f"Unknown thread id: {stmt.conn_id}")
+            if stmt.query_only:
+                victim._kill_query = True
+            else:
+                victim._killed = True
+            return None
         if isinstance(stmt, A.CreateViewStmt):
             self._priv("create", stmt.schema or self.db)
             self._commit()  # DDL semantics
@@ -1300,9 +1364,18 @@ class Session:
                                      n_parts=int(spec))
         schema = TableSchema(stmt.table.name, cols, primary_key=pk,
                              collation=stmt.collation, partition=part)
-        t = self.catalog.create_table(stmt.table.schema or self.db, schema,
-                                      stmt.if_not_exists, engine=stmt.engine,
-                                      foreign_keys=stmt.foreign_keys)
+        if stmt.temporary:
+            if stmt.foreign_keys:
+                raise UnsupportedError(
+                    "TEMPORARY tables cannot have foreign keys (MySQL)")
+            t = self.catalog.create_temp_table(
+                stmt.table.schema or self.db, schema, stmt.if_not_exists,
+                engine=stmt.engine)
+        else:
+            t = self.catalog.create_table(
+                stmt.table.schema or self.db, schema,
+                stmt.if_not_exists, engine=stmt.engine,
+                foreign_keys=stmt.foreign_keys)
         if t is not None and t.schema is schema:
             # inline constraint wiring happens only on a table this
             # statement actually created — and a failure must UNDO the
@@ -1324,10 +1397,20 @@ class Session:
                 for i, (cname, e_ast, txt) in enumerate(specs):
                     self._wire_check(
                         t, cname or f"{schema.name}_chk_{i + 1}", e_ast, txt)
+                for c in stmt.columns:
+                    if c.generated is not None:
+                        e_ast, txt, stored = c.generated
+                        self._wire_generated(t, c.name, e_ast, txt, stored)
             except Exception:
                 self.catalog.drop_table(stmt.table.schema or self.db,
                                         schema.name, if_exists=True)
                 raise
+            for item in stmt.ignored + [i for c in stmt.columns
+                                        for i in c.ignored]:
+                # accepted-but-ignored clauses surface as warnings
+                # instead of vanishing (SHOW WARNINGS; MySQL code 1235)
+                self._warnings.append(
+                    ("Warning", 1235, f"{item} is parsed but ignored"))
         return None
 
     def _run_create_like(self, stmt: A.CreateTableStmt):
@@ -1345,8 +1428,14 @@ class Session:
         schema.name = stmt.table.name
         for c in schema.columns:
             c.state = "public"
-        t = self.catalog.create_table(stmt.table.schema or self.db, schema,
-                                      stmt.if_not_exists, engine=src.engine)
+        if stmt.temporary:
+            t = self.catalog.create_temp_table(
+                stmt.table.schema or self.db, schema, stmt.if_not_exists,
+                engine=src.engine)
+        else:
+            t = self.catalog.create_table(
+                stmt.table.schema or self.db, schema,
+                stmt.if_not_exists, engine=src.engine)
         if t is not None and t.schema is schema:
             for name, ix in src.indexes.items():
                 if name != "PRIMARY" and name not in t.indexes:
@@ -1402,8 +1491,12 @@ class Session:
             # the source column's collation carries over (MySQL CTAS)
             cols.append(ColumnInfo(cname, t_, collation=coll))
         schema = TableSchema(stmt.table.name, cols)
-        t = self.catalog.create_table(stmt.table.schema or self.db, schema,
-                                      stmt.if_not_exists)
+        if stmt.temporary:
+            t = self.catalog.create_temp_table(
+                stmt.table.schema or self.db, schema, stmt.if_not_exists)
+        else:
+            t = self.catalog.create_table(
+                stmt.table.schema or self.db, schema, stmt.if_not_exists)
         if t is not None and t.schema is schema and rs.rows:
             def do(txn):
                 for start in range(0, len(rs.rows), 4096):
@@ -1450,8 +1543,51 @@ class Session:
         t.checks.append(CheckInfo(name=name, pred=compile_expr(bound),
                                   cols=refs, sql=sql_text))
 
+    def _wire_generated(self, t, colname: str, e_ast, sql_text: str,
+                        stored: bool) -> None:
+        """Bind + compile one generated column at DDL time (ref: MySQL
+        GENERATED ALWAYS AS). Same machinery and restrictions as CHECK
+        constraints: uids are column names; string source columns are
+        refused (plan-time dictionary LUTs go stale); self-reference and
+        reference to other generated columns are refused like MySQL's
+        ordering rule (only columns earlier in the row)."""
+        from tidb_tpu.expression.compiler import compile_expr
+        from tidb_tpu.planner.binder import Binder, PlanCol, Scope
+        from tidb_tpu.planner.rules import _refs
+        from tidb_tpu.storage.table import GeneratedInfo
+
+        dict_cols = {c.name for c in t.schema.columns
+                     if c.type_.is_dict_encoded}
+        named = {n.name.lower() for n in _ast_names(e_ast)}
+        if named & {c.lower() for c in dict_cols}:
+            raise UnsupportedError(
+                "generated columns over string columns are not supported "
+                "(dictionary codes are not stable across inserts)")
+        gen_cols = {g.col.lower() for g in t.generated} | {colname.lower()}
+        if named & gen_cols:
+            raise UnsupportedError(
+                "a generated column cannot reference itself or another "
+                "generated column")
+        if t.schema.col(colname).type_.is_dict_encoded:
+            raise UnsupportedError(
+                "string-typed generated columns are not supported "
+                "(computed values cannot be dictionary-encoded at "
+                "write time)")
+        cols = [PlanCol(uid=c.name, name=c.name, type_=c.type_)
+                for c in t.schema.columns]
+        bound = Binder().bind_expr(e_ast, Scope(cols, None))
+        t.generated.append(GeneratedInfo(
+            col=colname, fn=compile_expr(bound), cols=sorted(_refs(bound)),
+            sql=sql_text, stored=stored))
+
     def _run_insert(self, stmt: A.InsertStmt):
         table = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
+        gen_cols = {g.col for g in table.generated}
+        if stmt.columns and gen_cols & set(stmt.columns):
+            bad = sorted(gen_cols & set(stmt.columns))[0]
+            raise ExecutionError(
+                f"column {bad!r} is a generated column: "
+                "its value cannot be inserted")
         if stmt.select is not None:
             def do(txn):
                 rs = self._run_select(stmt.select)
@@ -1472,7 +1608,7 @@ class Session:
 
         binder = Binder()
         rows = []
-        names = stmt.columns or table.schema.public_names()
+        names = stmt.columns or table.insertable_names()
         for r_ast in stmt.rows:
             if len(r_ast) != len(names):
                 raise ExecutionError(
@@ -1900,8 +2036,13 @@ class Session:
 
             binder = Binder()
             updates = {}
+            gen_cols = {g.col for g in table.generated}
             for name_ast, val_ast in stmt.sets:
                 col = table.schema.col(name_ast.name)
+                if col.name in gen_cols:
+                    raise ExecutionError(
+                        f"column {col.name!r} is a generated column: "
+                        "its value cannot be set")
                 has_refs = _ast_has_name(val_ast)
                 if not has_refs:
                     v = self._bind_const(binder, val_ast, col)
@@ -2148,6 +2289,33 @@ class Session:
                 raise ExecutionError(f"no user {user!r}")
             rows = [(g,) for g in self.catalog.privileges.grants_for(user)]
             return ResultSet(names=[f"Grants for {user}"], rows=rows)
+        if stmt.kind == "processlist":
+            import time as _time
+
+            try:
+                self._priv("super")
+                all_users = True
+            except Exception:  # noqa: BLE001 — MySQL: without PROCESS
+                all_users = False  # priv you still see your own threads
+            rows = []
+            for cid in sorted(self.catalog.processes.keys()):
+                sess = self.catalog.processes.get(cid)
+                if sess is None or (not all_users
+                                    and sess.user != self.user):
+                    continue
+                sql_now = sess._current_sql
+                rows.append((
+                    cid, sess.user, "localhost", sess.db,
+                    "Query" if sql_now else "Sleep",
+                    int(_time.time() - sess._current_t0) if sql_now else 0,
+                    "" if sql_now else None,
+                    (sql_now or "")[:100] or None))
+            return ResultSet(
+                names=["Id", "User", "Host", "db", "Command", "Time",
+                       "State", "Info"], rows=rows)
+        if stmt.kind == "warnings":
+            return ResultSet(names=["Level", "Code", "Message"],
+                             rows=list(self._warnings))
         if stmt.kind == "databases":
             rows = [(n,) for n in sorted(self.catalog.databases)]
             return ResultSet(names=["Database"], rows=self._like_filter(rows, stmt.like))

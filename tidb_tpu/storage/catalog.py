@@ -76,6 +76,16 @@ class Catalog:
         from collections import deque
 
         self.slow_queries = deque(maxlen=128)
+        # live sessions for SHOW PROCESSLIST / KILL (ref: server/'s
+        # connection registry); weak values — a dropped session vanishes
+        import weakref
+
+        self.processes = weakref.WeakValueDictionary()
+        self._conn_id = 0
+
+    def next_conn_id(self) -> int:
+        self._conn_id += 1
+        return self._conn_id
 
     def submit_ddl(self, sql: str, db: str):
         """Enqueue a DDL job for the elected owner's worker."""
@@ -664,3 +674,66 @@ class Catalog:
 
 _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
                 "key_column_usage", "referential_constraints")
+
+
+class SessionCatalog:
+    """Per-session overlay adding a TEMPORARY-table namespace over the
+    shared catalog (ref: MySQL temporary tables — session-local, shadow
+    permanent tables by name, vanish with the connection). Everything
+    except table resolution/creation/drop delegates to the base; the
+    planner and executors only ever resolve through `table()`, so temp
+    tables flow through every downstream path unchanged."""
+
+    def __init__(self, base: "Catalog"):
+        while isinstance(base, SessionCatalog):
+            base = base._base
+        object.__setattr__(self, "_base", base)
+        object.__setattr__(self, "_temp", {})  # (db, name) -> Table
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+    def __setattr__(self, name, value):
+        # attribute writes always land on the shared base — a proxy-local
+        # shadow (e.g. schema_version) would silently fork the catalog
+        setattr(self._base, name, value)
+
+    @property
+    def base(self) -> "Catalog":
+        return self._base
+
+    def table(self, db: str, name: str) -> Table:
+        t = self._temp.get((db, name))
+        if t is not None:
+            return t
+        return self._base.table(db, name)
+
+    def tables(self, db: str):
+        out = list(self._base.tables(db))
+        out.extend(n for (d, n) in self._temp if d == db and n not in out)
+        return out
+
+    def create_temp_table(self, db: str, schema: TableSchema,
+                          if_not_exists: bool = False,
+                          engine: str = None) -> Table:
+        if (db, schema.name) in self._temp:
+            if if_not_exists:
+                return self._temp[(db, schema.name)]
+            raise DuplicateTableError(
+                f"temporary table {schema.name!r} exists")
+        from tidb_tpu.storage.kvapi import make_table
+
+        t = make_table(schema, engine)
+        t.ts_source = self._base.next_ts
+        self._temp[(db, schema.name)] = t
+        return t
+
+    def drop_table(self, db: str, name: str, if_exists: bool = False):
+        if (db, name) in self._temp:
+            del self._temp[(db, name)]
+            return
+        return self._base.drop_table(db, name, if_exists=if_exists)
+
+    def drop_temp_tables(self) -> None:
+        """Connection end: the whole temp namespace vanishes."""
+        self._temp.clear()

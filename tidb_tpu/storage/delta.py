@@ -42,6 +42,8 @@ from tidb_tpu.errors import ExecutionError
 _PASSTHROUGH = {
     "schema", "indexes", "ts_source", "stats", "ndv_sketch",
     "modify_count", "to_device_value", "engine",
+    # schema-derived reads: must not force a compaction per statement
+    "insertable_names", "generated", "foreign_keys", "checks",
 }
 
 _OWN = {"_base", "_cols", "_ts", "_logs", "_count"}
@@ -110,7 +112,7 @@ class DeltaTable:
             self._compact()
             return base.insert_rows(rows, columns=columns,
                                     begin_ts=begin_ts, log=log)
-        names = columns or base.schema.public_names()
+        names = columns or base.insertable_names()
         cols = [base.schema.col(n) for n in names]
         m = len(rows)
         if m == 0:
@@ -131,10 +133,12 @@ class DeltaTable:
                 staged[c.name] = list(range(base._auto_inc, base._auto_inc + m))
             elif c.default is not None:
                 staged[c.name] = [base.to_device_value(c, c.default)] * m
-            elif c.not_null:
+            elif c.not_null and not any(
+                    g.col == c.name for g in base.generated):
                 raise ExecutionError(
                     f"column {c.name!r} has no default and is NOT NULL")
             else:
+                # NULL, or a generated column computed at compaction
                 staged[c.name] = [None] * m
         for j, (name, c) in enumerate(zip(names, cols)):
             vals = [base.to_device_value(c, r[j]) for r in rows]

@@ -105,6 +105,22 @@ class FKInfo:
 
 
 @dataclass
+class GeneratedInfo:
+    """A generated column (ref: MySQL GENERATED ALWAYS AS): `fn` is the
+    compiled chunk->Column evaluator over the row's other columns,
+    bound at DDL time like CHECK constraints. Both STORED and VIRTUAL
+    are materialized at write time here (a columnar engine reads
+    columns, not rows — recomputing per read would cost more than the
+    storage, so VIRTUAL is accepted syntax with STORED semantics)."""
+
+    col: str
+    fn: object
+    cols: List[str]
+    sql: str
+    stored: bool = True
+
+
+@dataclass
 class CheckInfo:
     """A CHECK constraint: bound predicate over this table's columns
     (uids == column names), compiled once at DDL time. SQL semantics:
@@ -262,6 +278,9 @@ class Table:
         self._fk_keys: Dict[str, tuple] = {}
         # CHECK constraints (CheckInfo), wired by the session at DDL time
         self.checks: List[CheckInfo] = []
+        # generated columns (GeneratedInfo), wired at DDL time; computed
+        # on every write before constraints run
+        self.generated: List[GeneratedInfo] = []
         # pessimistic row locks from SELECT ... FOR UPDATE / SHARE
         # (ref: the pessimistic-txn lock CF): rid -> {txn marker: "x"|"s"}.
         # Guarded by the catalog lock like every mutation; writers check
@@ -368,7 +387,7 @@ class Table:
         # positional inserts carry the PUBLIC column width: a writer one
         # schema version behind an in-flight ADD COLUMN (write_only)
         # supplies the old shape and the new column default-fills below
-        names = columns or self.schema.public_names()
+        names = columns or self.insertable_names()
         cols = [self.schema.col(n) for n in names]
         m = len(rows)
         if m == 0:
@@ -392,7 +411,10 @@ class Table:
                 else:
                     self.data[c.name][start:end] = dv
                     self.valid[c.name][start:end] = True
-            elif c.not_null:
+            elif c.not_null and not any(
+                    g.col == c.name for g in self.generated):
+                # generated columns compute below (_apply_generated),
+                # so NOT NULL on them never needs a default
                 raise ExecutionError(f"column {c.name!r} has no default and is NOT NULL")
             # else: stays NULL
         for j, (name, c) in enumerate(zip(names, cols)):
@@ -415,6 +437,7 @@ class Table:
         # something in this table (REPLACE / upsert flows)
         in_txn = begin_ts is not None and begin_ts >= TXN_TS_BASE
         txn_deleted = log is not None and bool(log.ended)
+        self._apply_generated(start, end)
         self._enforce_unique_new(
             start, end, marker=begin_ts if in_txn and txn_deleted else None)
         self._check_fk_parents(start, end)
@@ -457,6 +480,7 @@ class Table:
                     self.valid[name][start:end] = True
             elif c.not_null:
                 raise ExecutionError(f"bulk insert missing NOT NULL column {name!r}")
+        self._apply_generated(start, end)
         self._enforce_unique_new(start, end)
         self._check_fk_parents(start, end)
         self._check_row_constraints(start, end)
@@ -673,6 +697,45 @@ class Table:
                     begin_ts=marker or None, end_ts=end_ts if marker else None,
                     marker=marker, log=clog, log_for=log_for,
                     _fk_depth=depth + 1)
+
+    def _apply_generated(self, start: int, end: int) -> None:
+        """Materialize generated columns for buffer rows [start, end)
+        from their source columns — BEFORE uniqueness/CHECK/FK
+        validation, which may reference them."""
+        if not self.generated:
+            return
+        from tidb_tpu.chunk.chunk import Chunk
+        from tidb_tpu.chunk.column import Column
+        from tidb_tpu.utils.device import host_eager
+
+        n = end - start
+        cap = 8
+        while cap < n:
+            cap *= 2
+        for gen in self.generated:
+            cs = {}
+            for cname in gen.cols:
+                t = self.schema.col(cname).type_
+                cs[cname] = Column.from_numpy(
+                    self.data[cname][start:end], t,
+                    valid=self.valid[cname][start:end], capacity=cap)
+            sel = np.zeros(cap, dtype=np.bool_)
+            sel[:n] = True
+            with host_eager():
+                col = gen.fn(Chunk(cs, sel))
+                data = np.asarray(col.data)[:n]
+                valid = np.asarray(col.valid)[:n]
+            dt = self.schema.col(gen.col).type_.np_dtype
+            self.data[gen.col][start:end] = data.astype(dt, copy=False)
+            self.valid[gen.col][start:end] = valid
+
+    def insertable_names(self) -> List[str]:
+        """Positional-INSERT width: public columns minus generated ones
+        (their values are never supplied; MySQL requires DEFAULT in the
+        slot — omitting the slot entirely is the friendlier contract
+        for a columnar engine and keeps old writers working)."""
+        gen = {g.col for g in self.generated}
+        return [n for n in self.schema.public_names() if n not in gen]
 
     def _check_row_constraints(self, start: int, end: int,
                                cols: Optional[set] = None,
@@ -947,6 +1010,7 @@ class Table:
                     else:
                         self.data[name][i] = v
                         self.valid[name][i] = True
+        self._apply_generated(start, end)
         if any(ix.unique for ix in self.indexes.values()):
             # the replaced versions don't count as present for uniqueness;
             # full-scan check (the incremental cache can't express the
