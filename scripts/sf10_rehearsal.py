@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""SF10 scale rehearsal (VERDICT r4 task #4; SURVEY.md:315 hard-part 6
+at design scale): generate TPC-H orders+lineitem at SF10 with the
+native C++ generator (~60M lineitem rows, ~7.7 GB of columns), run Q18
+resident and then under a memory budget of lineitem/4, and record
+times + engagement + result equality into SF10_REHEARSAL.json.
+
+No sqlite oracle at this scale (mirroring 60M rows through Python
+objects would dominate the rehearsal); correctness = the budgeted run
+must produce byte-identical rows to the resident run, whose plan shape
+is itself oracle-checked at every smaller SF by the test suite."""
+
+import json
+import os
+import resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SF = float(os.environ.get("REHEARSAL_SF", "10"))
+
+
+def rss_gb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def main():
+    import jax
+
+    if os.environ.get("REHEARSAL_PLATFORM", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import tidb_tpu  # noqa: F401
+    from tidb_tpu.parallel import make_mesh
+    from tidb_tpu.parallel.partition import table_bytes
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.tpch import load_tpch
+    from tidb_tpu.storage.tpch_queries import Q
+    from tidb_tpu.utils.metrics import EXTERNAL_AGG, FRAGMENT_DISPATCH
+
+    out = {"sf": SF}
+    t0 = time.time()
+    mesh = make_mesh()
+    s = Session(chunk_capacity=1 << 20, mesh=mesh)
+    counts = load_tpch(s.catalog, sf=SF)
+    out["gen_s"] = round(time.time() - t0, 1)
+    out["lineitem_rows"] = counts["lineitem"]
+    li = s.catalog.table("test", "lineitem")
+    out["lineitem_gb"] = round(table_bytes(li) / 1e9, 2)
+    out["rss_after_gen_gb"] = round(rss_gb(), 1)
+    print(f"# generated sf={SF}: {counts['lineitem']} lineitem rows, "
+          f"{out['lineitem_gb']} GB, {out['gen_s']}s", flush=True)
+
+    sql, _lite = Q["q18"]
+    t0 = time.time()
+    resident = s.query(sql)
+    out["q18_resident_warm_s"] = round(time.time() - t0, 1)
+    t0 = time.time()
+    resident = s.query(sql)
+    out["q18_resident_s"] = round(time.time() - t0, 1)
+    out["q18_resident_rows_per_sec"] = round(
+        counts["lineitem"] / out["q18_resident_s"], 1)
+    print(f"# resident: {out['q18_resident_s']}s", flush=True)
+
+    budget = max(1 << 20, table_bytes(li) // 4)
+    out["budget_gb"] = round(budget / 1e9, 2)
+    s.execute(f"SET tidb_device_cache_bytes = {budget}")
+    s.execute(f"SET tidb_mem_quota_query = {budget}")
+    s.execute("SET tidb_enable_tmp_storage_on_oom = 1")
+
+    def engagements():
+        return (FRAGMENT_DISPATCH.value(kind="general_segment_stream")
+                + FRAGMENT_DISPATCH.value(kind="general_generic_stream")
+                + EXTERNAL_AGG.value())
+
+    e0 = engagements()
+    t0 = time.time()
+    streamed = s.query(sql)
+    out["q18_streamed_warm_s"] = round(time.time() - t0, 1)
+    t0 = time.time()
+    streamed = s.query(sql)
+    out["q18_streamed_s"] = round(time.time() - t0, 1)
+    out["q18_streamed_rows_per_sec"] = round(
+        counts["lineitem"] / out["q18_streamed_s"], 1)
+    out["engaged"] = engagements() > e0
+    out["overhead_vs_resident"] = round(
+        out["q18_streamed_s"] / out["q18_resident_s"], 3)
+    out["identical_to_resident"] = streamed == resident
+    out["rss_peak_gb"] = round(rss_gb(), 1)
+    print(f"# streamed: {out['q18_streamed_s']}s engaged={out['engaged']} "
+          f"identical={out['identical_to_resident']}", flush=True)
+
+    with open(os.path.join(REPO, "SF10_REHEARSAL.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

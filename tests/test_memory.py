@@ -111,3 +111,77 @@ class TestSpillCorrectness:
         s._exec_ctx = tiny_ctx
         with pytest.raises(QueryOOMError):
             s.query("select a from t order by a")
+
+
+class TestExternalRangeMerge:
+    """Key-range external aggregation (round 5, SURVEY.md:315 hard-part
+    6): when the spilled runs' TOTAL group state exceeds the memory
+    budget (near-unique keys), the agg merges and emits one key range
+    at a time instead of OOMing."""
+
+    def test_near_unique_keys_under_tight_quota(self):
+        import numpy as np
+
+        from tidb_tpu.utils.metrics import EXTERNAL_AGG
+
+        s = Session(chunk_capacity=1 << 14)
+        s.execute("create table e (k bigint, v bigint)")
+        n = 200_000
+        t = s.catalog.table("test", "e")
+        t.insert_columns({"k": np.arange(n), "v": np.arange(n) * 3})
+        s.execute("set tidb_mem_quota_query = 1048576")  # 1 MiB
+        s.execute("set tidb_enable_tmp_storage_on_oom = 1")
+        e0 = EXTERNAL_AGG.value()
+        got = s.query("select count(*), sum(s2) from "
+                      "(select k, sum(v) as s2 from e group by k) d")
+        assert got == [(n, sum(range(n)) * 3)]
+        assert EXTERNAL_AGG.value() > e0, "external merge never engaged"
+
+    def test_results_match_unbudgeted(self):
+        import numpy as np
+
+        s = Session(chunk_capacity=1 << 14)
+        s.execute("create table e2 (k bigint, v bigint)")
+        n = 120_000
+        rng = np.random.default_rng(3)
+        t = s.catalog.table("test", "e2")
+        t.insert_columns({"k": rng.integers(0, n, n), "v": rng.integers(-50, 50, n)})
+        sql = ("select k, count(*), sum(v), min(v), max(v) from e2 "
+               "group by k order by k limit 500")
+        want = s.query(sql)
+        s.execute("set tidb_mem_quota_query = 1048576")
+        s.execute("set tidb_enable_tmp_storage_on_oom = 1")
+        assert s.query(sql) == want
+
+    def test_low_cardinality_stays_in_memory(self):
+        """A 10-group aggregation under quota must use the cheap
+        in-memory merge, not the external path (round-5 review)."""
+        import numpy as np
+
+        from tidb_tpu.utils.metrics import EXTERNAL_AGG
+
+        s = Session(chunk_capacity=1 << 14)
+        s.execute("create table lo (k bigint, v bigint)")
+        n = 300_000
+        t = s.catalog.table("test", "lo")
+        t.insert_columns({"k": np.arange(n) % 10, "v": np.ones(n, np.int64)})
+        s.execute("set tidb_mem_quota_query = 2097152")
+        s.execute("set tidb_enable_tmp_storage_on_oom = 1")
+        e0 = EXTERNAL_AGG.value()
+        got = s.query("select k, count(*) from lo group by k order by k")
+        assert got == [(k, n // 10) for k in range(10)]
+        assert EXTERNAL_AGG.value() == e0, "external path fired needlessly"
+
+    def test_scalar_agg_under_quota(self):
+        """No GROUP BY (nk==0) under a tight quota: single-range merge,
+        no searchsorted crash (round-5 review)."""
+        import numpy as np
+
+        s = Session(chunk_capacity=1 << 14)
+        s.execute("create table sc (v bigint)")
+        n = 400_000
+        t = s.catalog.table("test", "sc")
+        t.insert_columns({"v": np.ones(n, np.int64)})
+        s.execute("set tidb_mem_quota_query = 1048576")
+        s.execute("set tidb_enable_tmp_storage_on_oom = 1")
+        assert s.query("select count(*), sum(v) from sc") == [(n, n)]

@@ -505,29 +505,68 @@ class HashAggExec(Executor):
         run_list = runs.all_runs()
         has_distinct = any(a.distinct for a in aggs)
         if len(run_list) > 1 and not has_distinct:
-            # spilled: per-run partial groupby states, merged like the
-            # reference's partial/final HashAgg worker split — memory peaks
-            # at the partial group tables (bounded by distinct keys per
-            # run), which are tracked so a near-unique key space surfaces
-            # as OOM instead of silently exceeding the budget
+            # spilled: per-run partial groupby states merged like the
+            # reference's partial/final HashAgg worker split. When the
+            # TOTAL group state overflows the budget (near-unique keys),
+            # fall to a key-RANGE-partitioned external merge: each run's
+            # partial is key-sorted, so a range is a contiguous slice of
+            # every run — merge one range at a time with O(state/ranges)
+            # memory (the external grouped aggregation the reference's
+            # spill-to-disk agg performs; SURVEY.md:315 hard part 6).
             tracker = self.ctx.mem_tracker.child("hashagg.final")
             tracked = 0
+            budget = getattr(self.ctx.mem_tracker, "budget", 0) or 0
+            # per-group partial bytes: mat + keys + kvalids + states
+            nk_ = len(self.group_exprs)
+            per_group = 8 * (2 * nk_ + 1) + nk_ + 24 * max(len(aggs), 1)
+            go_external = False
+            if budget:
+                # estimate total group state from a bounded sample of
+                # the first run (its partial keys/rows ratio); a
+                # worst-case rows-based bound would send LOW-cardinality
+                # aggregations external too (round-5 review)
+                l0, r0 = run_list[0]
+                samp = min(r0, 1 << 14)
+
+                def _s(name, _l=l0, _n=samp):
+                    return np.asarray(_l(name))[:_n]
+
+                p0 = self._partial_states(_s)
+                density = max(len(p0["mat"]), 1) / max(samp, 1)
+                del p0
+                total_rows = sum(r for _, r in run_list)
+                go_external = (density * total_rows * per_group
+                               > budget // 2)
             try:
                 merged = None
-                for loader, _rows in run_list:
-                    p = self._partial_states(loader)
-                    b_p = _partial_nbytes(p)
-                    tracker.consume(b_p)
-                    tracked += b_p
-                    if merged is not None:
-                        merged = self._merge_partials([merged, p])
-                        b_m = _partial_nbytes(merged)
-                        tracker.consume(b_m)
-                        tracker.release(tracked)  # old merged + p are dead
-                        tracked = b_m
-                    else:
-                        merged = p
-                self._emit_merged(merged, cap)
+                if not go_external:
+                    for loader, _rows in run_list:
+                        p = self._partial_states(loader)
+                        b_p = _partial_nbytes(p)
+                        if budget and tracked + b_p > budget // 2:
+                            # sampled estimate was low (skew): bail to
+                            # the external path after all
+                            del p
+                            tracker.release(tracked)
+                            tracked = 0
+                            merged = None
+                            go_external = True
+                            break
+                        tracker.consume(b_p)
+                        tracked += b_p
+                        if merged is not None:
+                            merged = self._merge_partials([merged, p])
+                            b_m = _partial_nbytes(merged)
+                            tracker.consume(b_m)
+                            tracker.release(tracked)  # merged + p dead
+                            tracked = b_m
+                        else:
+                            merged = p
+                if go_external:
+                    self._external_range_merge(run_list, cap, tracker,
+                                               budget)
+                elif merged is not None:
+                    self._emit_merged(merged, cap)
             finally:
                 tracker.release(tracked)
             runs.close()
@@ -637,6 +676,105 @@ class HashAggExec(Executor):
             out_arrays[a.uid] = self._generic_agg(a, vals, valids, inverse, ngroups)
 
         self._chunks_from_host(out_arrays, ngroups, cap)
+
+    def _external_range_merge(self, run_list, cap, tracker, budget) -> None:
+        """External grouped aggregation: spill each run's key-sorted
+        partial to disk, then merge and emit one KEY RANGE at a time.
+        Ranges slice on the first key column (the lexsorted mat's major
+        key), so every run contributes a contiguous, cheap-to-load
+        mmap slice; resident state is ~total/ranges instead of total."""
+        from tidb_tpu.utils.memory import SpillFile
+        from tidb_tpu.utils.metrics import EXTERNAL_AGG
+
+        EXTERNAL_AGG.inc()
+
+        flat_files = []  # (SpillFile, state field names per agg)
+        total = 0
+        nk = len(self.group_exprs)
+        # sub-slice runs so even a near-unique-key partial stays inside
+        # the budget while it is being built
+        step = max((budget // 8) // 64 if budget else (1 << 20), 1 << 13)
+        for loader, rows in run_list:
+            for i0 in range(0, rows, step):
+                i1 = min(i0 + step, rows)
+
+                def sub(name, _l=loader, _a=i0, _b=i1):
+                    return np.asarray(_l(name))[_a:_b]
+
+                p = self._partial_states(sub)
+                b = _partial_nbytes(p)
+                tracker.consume(b)
+                arrays = {"mat": p["mat"]}
+                for ki in range(nk):
+                    arrays[f"k{ki}"] = p["keys"][ki]
+                    arrays[f"kv{ki}"] = p["kvalids"][ki]
+                for j, st in enumerate(p["states"]):
+                    for f, a in st.items():
+                        arrays[f"s{j}.{f}"] = a
+                fields = [sorted(st.keys()) for st in p["states"]]
+                flat_files.append((SpillFile(arrays), fields))
+                total += b
+                tracker.release(b)
+                del p, arrays
+        try:
+            # pivots: quantiles of the major key, estimated from a
+            # BOUNDED per-file sample (each file's mat[:, 0] is already
+            # sorted, so a strided sample is itself quantile-spaced) —
+            # materializing every group's key here would allocate the
+            # very state the budget forbids (round-5 review)
+            per_range = max(budget // 8, 1 << 17)
+            n_ranges = max(1, int(np.ceil(total / per_range)))
+            if nk and n_ranges > 1:
+                samples = []
+                for f, _ in flat_files:
+                    col0 = np.asarray(f.load("mat"))[:, 0]
+                    stride = max(len(col0) // 256, 1)
+                    samples.append(np.array(col0[::stride]))
+                majors = np.concatenate(samples)
+                majors.sort()
+                qs = np.linspace(0, len(majors) - 1, n_ranges + 1)[1:-1]
+                pivots = np.unique(majors[qs.astype(np.int64)])
+            else:
+                # keyless partials have a single logical group: one range
+                pivots = np.zeros(0, dtype=np.int64)
+            bounds = ([None] + list(pivots), list(pivots) + [None])
+            for lo, hi in zip(*bounds):
+                slices = []
+                sliced_bytes = 0
+                for f, fields in flat_files:
+                    mat = np.asarray(f.load("mat"))
+                    col0 = mat[:, 0] if mat.shape[1] else mat[:, :0]
+                    a = 0 if lo is None else int(
+                        np.searchsorted(col0, lo, "left"))
+                    b_ = len(mat) if hi is None else int(
+                        np.searchsorted(col0, hi, "left"))
+                    if a >= b_:
+                        continue
+                    p = {
+                        "mat": mat[a:b_],
+                        "keys": [np.asarray(f.load(f"k{ki}"))[a:b_]
+                                 for ki in range(nk)],
+                        "kvalids": [np.asarray(f.load(f"kv{ki}"))[a:b_]
+                                    for ki in range(nk)],
+                        "states": [
+                            {fl: np.asarray(f.load(f"s{j}.{fl}"))[a:b_]
+                             for fl in fields[j]}
+                            for j in range(len(fields))],
+                    }
+                    sliced_bytes += _partial_nbytes(p)
+                    slices.append(p)
+                if not slices:
+                    continue
+                tracker.consume(sliced_bytes)
+                try:
+                    merged = (slices[0] if len(slices) == 1
+                              else self._merge_partials(slices))
+                    self._emit_merged(merged, cap)
+                finally:
+                    tracker.release(sliced_bytes)
+        finally:
+            for f, _ in flat_files:
+                f.close()
 
     def _partial_states(self, loader):
         """Groupby one run into (group key table, mergeable agg states)."""

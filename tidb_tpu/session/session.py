@@ -676,6 +676,31 @@ class Session:
         elif isinstance(stmt, (A.CreateIndexStmt, A.DropIndexStmt)):
             tn = stmt.table
             names = [(tn.schema or self.db, tn.name)]
+        elif isinstance(stmt, A.CreateTableStmt):
+            # LIKE / AS SELECT reading a temp-shadowed SOURCE must also
+            # stay inline: the DDL owner's session resolves the
+            # permanent table instead (round-5 review)
+            if stmt.like is not None:
+                tn = stmt.like
+                names.append((tn.schema or self.db, tn.name))
+            sel = getattr(stmt, "as_select", None)
+            if sel is not None:
+                def walk_sources(node):
+                    if isinstance(node, A.TableName):
+                        names.append((node.schema or self.db, node.name))
+                    elif isinstance(node, A.Join):
+                        walk_sources(node.left)
+                        walk_sources(node.right)
+                    elif isinstance(node, A.SubqueryTable):
+                        walk_select(node.select)
+
+                def walk_select(st):
+                    for arm in ([st] if isinstance(st, A.SelectStmt)
+                                else list(_union_arms(st))):
+                        if arm.from_ is not None:
+                            walk_sources(arm.from_)
+
+                walk_select(sel)
         return any(k in temp for k in names)
 
     def _run_locking_select(self, stmt) -> ResultSet:

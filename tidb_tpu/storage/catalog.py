@@ -82,10 +82,15 @@ class Catalog:
 
         self.processes = weakref.WeakValueDictionary()
         self._conn_id = 0
+        self._conn_id_lock = threading.Lock()
 
     def next_conn_id(self) -> int:
-        self._conn_id += 1
-        return self._conn_id
+        # its own tiny lock: the catalog statement lock can be held for
+        # a whole long statement, and session CREATION must never block
+        # behind it (the wire server handshakes on a fresh thread)
+        with self._conn_id_lock:
+            self._conn_id += 1
+            return self._conn_id
 
     def submit_ddl(self, sql: str, db: str):
         """Enqueue a DDL job for the elected owner's worker."""

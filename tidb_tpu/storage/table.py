@@ -478,7 +478,8 @@ class Table:
                     self.valid[name][start:end] = valids[name]
                 else:
                     self.valid[name][start:end] = True
-            elif c.not_null:
+            elif c.not_null and not any(
+                    g.col == c.name for g in self.generated):
                 raise ExecutionError(f"bulk insert missing NOT NULL column {name!r}")
         self._apply_generated(start, end)
         self._enforce_unique_new(start, end)
@@ -725,8 +726,13 @@ class Table:
                 col = gen.fn(Chunk(cs, sel))
                 data = np.asarray(col.data)[:n]
                 valid = np.asarray(col.valid)[:n]
-            dt = self.schema.col(gen.col).type_.np_dtype
-            self.data[gen.col][start:end] = data.astype(dt, copy=False)
+            col = self.schema.col(gen.col)
+            if col.not_null and not valid.all():
+                raise ExecutionError(
+                    f"generated column {gen.col!r} computed NULL but is "
+                    "declared NOT NULL")
+            self.data[gen.col][start:end] = data.astype(
+                col.type_.np_dtype, copy=False)
             self.valid[gen.col][start:end] = valid
 
     def insertable_names(self) -> List[str]:

@@ -151,3 +151,6 @@ GC_RECLAIMED = Counter("tidb_tpu_gc_reclaimed_rows_total",
 CONN_GAUGE = Gauge("tidb_tpu_connections", "Open server connections")
 FRAGMENT_DISPATCH = Counter("tidb_tpu_fragment_dispatch_total",
                             "Distributed fragment executions, by kind")
+EXTERNAL_AGG = Counter("tidb_tpu_external_agg_total",
+                       "Key-range external aggregation merges (group "
+                       "state exceeded the memory budget)")
