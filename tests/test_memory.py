@@ -185,3 +185,24 @@ class TestExternalRangeMerge:
         s.execute("set tidb_mem_quota_query = 1048576")
         s.execute("set tidb_enable_tmp_storage_on_oom = 1")
         assert s.query("select count(*), sum(v) from sc") == [(n, n)]
+
+    def test_mid_merge_bail_on_underestimated_density(self):
+        """A low-cardinality PREFIX fools the 16k-row density sample
+        into choosing the in-memory merge; the high-cardinality tail
+        must then hit the mid-merge headroom bail to the external path
+        instead of OOMing (round-5 bench regression: q18's key-sorted
+        lineitem had the same sample-undershoot shape)."""
+        import numpy as np
+
+        s = Session(chunk_capacity=1 << 20)
+        s.execute("create table bs (k bigint, v bigint)")
+        n = 1_200_000
+        keys = np.concatenate(
+            [np.zeros(200_000, np.int64), np.arange(n - 200_000)])
+        t = s.catalog.table("test", "bs")
+        t.insert_columns({"k": keys, "v": np.ones(n, np.int64)})
+        s.execute("set tidb_mem_quota_query = 8388608")  # 8 MiB
+        s.execute("set tidb_enable_tmp_storage_on_oom = 1")
+        got = s.query("select count(*), sum(s2) from (select k, sum(v) s2 "
+                      "from bs group by k) d")
+        assert got == [(n - 200_000, n)]

@@ -543,9 +543,15 @@ class HashAggExec(Executor):
                     for loader, _rows in run_list:
                         p = self._partial_states(loader)
                         b_p = _partial_nbytes(p)
-                        if budget and tracked + b_p > budget // 2:
-                            # sampled estimate was low (skew): bail to
-                            # the external path after all
+                        # the pairwise merge transiently holds old
+                        # merged + p + the new merged (~2x their sum) ON
+                        # TOP of whatever the rest of the query already
+                        # consumes on the root tracker: bail to the
+                        # external path BEFORE that peak when the
+                        # sampled estimate undershot (sorted or skewed
+                        # keys make early rows look low-card)
+                        root_used = self.ctx.mem_tracker.consumed
+                        if budget and root_used + 2 * b_p + tracked > budget:
                             del p
                             tracker.release(tracked)
                             tracked = 0
