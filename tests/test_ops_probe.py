@@ -1,0 +1,98 @@
+"""Open-addressing hash-probe kernel (ops/hash_probe.py — the
+SURVEY.md:294-296 Pallas join-probe fast path). Pinned against
+searchsorted on every consumption the fragment join makes: counts
+(hi - lo) everywhere, lo wherever the count is non-zero. The Pallas
+path runs in interpret mode on CPU — same arithmetic Mosaic compiles
+on TPU."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tidb_tpu.ops import hash_probe as hp
+
+
+def check(build_vals, probe_vals, use_pallas):
+    sh = jnp.asarray(np.sort(np.asarray(build_vals, dtype=np.int64)))
+    pr = jnp.asarray(np.asarray(probe_vals, dtype=np.int64))
+    lo1, hi1 = hp.xla_probe_ranges(sh, pr)
+    lo2, hi2 = hp.probe_ranges(sh, pr, use_pallas=use_pallas)
+    c1 = np.asarray(hi1) - np.asarray(lo1)
+    c2 = np.asarray(hi2) - np.asarray(lo2)
+    assert (c1 == c2).all(), f"count mismatch: {int((c1 != c2).sum())}"
+    nz = c1 > 0
+    assert (np.asarray(lo1)[nz] == np.asarray(lo2)[nz]).all(), "lo mismatch"
+
+
+@pytest.mark.parametrize("use_pallas", [False, True],
+                         ids=["xla-window", "pallas-interpret"])
+class TestProbeRanges:
+    def test_random_with_duplicates(self, use_pallas):
+        rng = np.random.default_rng(1)
+        build = rng.integers(-500, 500, 4000) * 7919
+        probes = rng.integers(-800, 800, 9000) * 7919
+        check(build, probes, use_pallas)
+
+    def test_unique_dense(self, use_pallas):
+        rng = np.random.default_rng(2)
+        build = rng.permutation(50_000).astype(np.int64)
+        probes = rng.integers(-10_000, 60_000, 80_000)
+        check(build, probes, use_pallas)
+
+    def test_all_absent_and_all_present(self, use_pallas):
+        build = np.arange(0, 1000, 2)
+        check(build, np.arange(1, 1001, 2), use_pallas)  # all miss
+        check(build, build.copy(), use_pallas)           # all hit
+
+    def test_tiny_and_empty(self, use_pallas):
+        check([42], [42, 43], use_pallas)
+        check([], [1, 2, 3], use_pallas)
+
+    def test_adversarial_same_home_cluster(self, use_pallas):
+        # many values multiplied so their mixed homes cluster; the
+        # in-jit lax.cond fallback must keep results exact regardless
+        build = np.arange(64, dtype=np.int64) * (1 << 40)
+        probes = np.arange(-8, 72, dtype=np.int64) * (1 << 40)
+        check(build, probes, use_pallas)
+
+    def test_over_capacity_falls_back(self, use_pallas):
+        n = hp.MAX_CAPACITY  # 2n slots would exceed the VMEM cap
+        rng = np.random.default_rng(3)
+        build = rng.integers(0, 1 << 40, n)
+        probes = rng.integers(0, 1 << 40, 1000)
+        check(build, probes, use_pallas)
+
+
+class TestJoinIntegration:
+    """End-to-end fragment joins with the table probe forced on."""
+
+    @pytest.mark.parametrize("mode", ["xla", "pallas"])
+    def test_q18_shape_matches_oracle(self, mode):
+        from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.session import Session
+        from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+        from tidb_tpu.utils import jitcache
+
+        saved = hp._mode
+        hp.set_mode(mode)
+        jitcache.clear()
+        try:
+            s = Session(chunk_capacity=1 << 14, mesh=make_mesh())
+            s.execute("create table f (k bigint, v bigint)")
+            s.execute("create table d (k bigint primary key, g bigint)")
+            s.execute("insert into f values " + ",".join(
+                f"({i % 53}, {i})" for i in range(3000)))
+            s.execute("insert into d values " + ",".join(
+                f"({i}, {i % 7})" for i in range(53)))
+            s.execute("set tidb_device_engine_mode = 'force'")
+            sql = ("select g, count(*), sum(v) from f join d on f.k = d.k "
+                   "group by g order by g")
+            got = s.query(sql)
+            conn = mirror_to_sqlite(s.catalog)
+            ok, msg = rows_equal(got, conn.execute(sql).fetchall(),
+                                 ordered=True)
+            assert ok, msg
+        finally:
+            hp.set_mode(saved)
+            jitcache.clear()

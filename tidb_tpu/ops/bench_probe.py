@@ -1,0 +1,71 @@
+"""Microbenchmark: hash-table probe vs searchsorted (the fragment
+join's probe step). Run on whatever backend is live:
+
+    python -m tidb_tpu.ops.bench_probe
+
+Writes ops/PROBE_BENCH.json: per-size best-of-5 timings for the two
+strategies (plus the Pallas kernel on TPU), the same (lo, hi) contract
+the join consumes."""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import tidb_tpu  # noqa: F401 (x64)
+    from tidb_tpu.ops import hash_probe as hp
+    from tidb_tpu.ops.segment_sum import pallas_enabled
+
+    plat = jax.devices()[0].platform
+    out = {"platform": plat, "max_probes": hp.MAX_PROBES, "sizes": []}
+    rng = np.random.default_rng(7)
+    for nb, npr in [(1 << 12, 1 << 20), (1 << 16, 1 << 20), (1 << 18, 1 << 21)]:
+        build = np.sort(rng.integers(0, 1 << 40, nb))
+        probes = rng.integers(0, 1 << 41, npr)
+        sh = jnp.asarray(build)
+        pr = jnp.asarray(probes)
+        row = {"build": nb, "probes": npr}
+
+        def timed(fn):
+            r = fn()
+            jax.block_until_ready(r)
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                best = min(best, time.perf_counter() - t0)
+            return best, r
+
+        ss = jax.jit(lambda a, b: hp.xla_probe_ranges(a, b))
+        t_ss, r_ss = timed(lambda: ss(sh, pr))
+        row["searchsorted_s"] = round(t_ss, 5)
+        t_tab, r_tab = timed(lambda: hp.probe_ranges(sh, pr, use_pallas=False))
+        row["table_xla_s"] = round(t_tab, 5)
+        c_ok = bool((np.asarray(r_ss[1]) - np.asarray(r_ss[0])
+                     == np.asarray(r_tab[1]) - np.asarray(r_tab[0])).all())
+        row["counts_match"] = c_ok
+        if pallas_enabled():
+            t_pl, r_pl = timed(
+                lambda: hp.probe_ranges(sh, pr, use_pallas=True))
+            row["table_pallas_s"] = round(t_pl, 5)
+            row["pallas_counts_match"] = bool(
+                (np.asarray(r_pl[1]) - np.asarray(r_pl[0])
+                 == np.asarray(r_ss[1]) - np.asarray(r_ss[0])).all())
+        row["speedup_vs_searchsorted"] = round(
+            t_ss / min(t_tab, row.get("table_pallas_s", t_tab)), 2)
+        out["sizes"].append(row)
+        print(row, flush=True)
+    path = os.path.join(os.path.dirname(__file__), "PROBE_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

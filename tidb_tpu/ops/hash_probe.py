@@ -1,0 +1,276 @@
+"""Open-addressing hash probe for the fragment join (Pallas).
+
+The fragment join (parallel/fragment.py) sorts the build side by key
+hash and probes with two `jnp.searchsorted` calls — O(log Rb) dependent
+gather rounds per probe element on TPU. The reference's hash join probes
+an O(1)-expected hash table instead (ref: executor/'s HashJoinExec
+build+probe workers; SURVEY.md:294-296 names this kernel as the planned
+fast path). This module supplies that table:
+
+  * BUILD (XLA, inside the same jit): runs of equal values in the sorted
+    hash array become (lo, hi) ranges; each run's FIRST row inserts
+    (hash, lo, hi) into an open-addressing table of power-of-two
+    capacity ~2x the run count via bounded scatter rounds (linear
+    probing; round r claims slot (home + r) & mask with scatter-min
+    arbitration). `placed` tracks success — if any run needs more than
+    MAX_PROBES displacements the whole probe falls back to searchsorted
+    THROUGH lax.cond, so results never depend on table luck.
+  * PROBE (Pallas): the table lives in VMEM (the kernel targets
+    dimension-sized build sides; capacity is capped so three i32 tables
+    fit comfortably), each probe element scans its MAX_PROBES window
+    with vectorized selects — no data-dependent loop, no divergence.
+
+Correctness envelope: every inserted run sits within MAX_PROBES slots
+of its home (else the searchsorted branch runs), so a probe that scans
+the full window and finds no match has PROVEN absence. Duplicate probe
+hashes, absent keys, and invalid rows all resolve exactly like
+searchsorted — pinned by tests against it (tests/test_ops_probe.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.ops.segment_sum import pallas_enabled
+
+__all__ = ["probe_ranges", "xla_probe_ranges", "probe_for_join",
+           "set_mode", "MAX_CAPACITY"]
+
+import os
+
+# "off" (default): always searchsorted; "auto": hash table when the
+# computation targets TPU (trace-time force_platform aware, like
+# segment_sum); "xla": hash table everywhere (window-scan probe);
+# "pallas": hash table with the Pallas VMEM kernel.
+#
+# Default is OFF because the table path has never run on real silicon
+# (the tunnel was dead all round): on CPU searchsorted measured faster
+# (ops/PROBE_BENCH.json — 32 fixed window rounds vs ~2*log2(Rb)
+# cache-friendly binary rounds), and the on-chip recapture path must
+# not gamble on unvalidated Mosaic/axon codegen. The expected TPU win
+# (VMEM-resident table vs HBM binary search) is one env var away:
+# TIDB_HASH_PROBE=xla or =pallas.
+_mode = os.environ.get("TIDB_HASH_PROBE", "off")
+
+
+def set_mode(m: str) -> None:
+    global _mode
+    _mode = m
+
+
+def probe_for_join(sorted_hashes: jax.Array, probes: jax.Array):
+    """The fragment join's probe entry point: (lo, hi) ranges over the
+    sorted build hashes via the configured strategy."""
+    if _mode == "off" or (_mode == "auto" and not pallas_enabled()):
+        lo, hi = xla_probe_ranges(sorted_hashes, probes)
+        return lo.astype(jnp.int64), hi.astype(jnp.int64)
+    return probe_ranges(sorted_hashes, probes,
+                        use_pallas=(_mode == "pallas"))
+
+MAX_PROBES = 32
+# three int32 tables of this capacity ~= 6 MiB of VMEM: dimension-sized
+# build sides (the star-join case) qualify; big fact-fact joins keep the
+# searchsorted path
+MAX_CAPACITY = 1 << 19
+
+_EMPTY = jnp.int32(0x7FFFFFFF)
+
+
+def _mix32(h: jax.Array, salt: int = 0) -> jax.Array:
+    """int64 hash -> well-spread int32 (splitmix tail); `salt` derives
+    the independent fingerprint stream."""
+    h = h.astype(jnp.uint64) ^ jnp.uint64(salt)
+    h = (h ^ (h >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    out = (h ^ (h >> 31)).astype(jnp.uint32).astype(jnp.int32)
+    # the table's EMPTY sentinel must be unreachable as a fingerprint:
+    # a run stored as 0x7FFFFFFF would look like a free slot (silent
+    # match loss); remap it consistently on build AND probe sides
+    return jnp.where(out == _EMPTY, jnp.int32(0), out)
+
+
+_FP_SALT = 0x9E3779B97F4A7C15
+
+
+def xla_probe_ranges(sorted_hashes: jax.Array, probes: jax.Array):
+    """Reference path: (lo, hi) = searchsorted left/right."""
+    lo = jnp.searchsorted(sorted_hashes, probes, side="left")
+    hi = jnp.searchsorted(sorted_hashes, probes, side="right")
+    return lo, hi
+
+
+def _next_pow2(n: int) -> int:
+    c = 1
+    while c < n:
+        c *= 2
+    return c
+
+
+def _build_table(sh: jax.Array, cap: int):
+    """(keys32[cap], lo32[cap], hi32[cap], all_placed) from the sorted
+    hash array. keys32 stores the mixed 32-bit fingerprint of the run's
+    hash; collisions between DIFFERENT 64-bit hashes on both slot AND
+    fingerprint are resolved by verifying via the (lo) range's actual
+    hash at probe time."""
+    Rb = sh.shape[0]
+    idx = jnp.arange(Rb, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones(1, dtype=jnp.bool_), sh[1:] != sh[:-1]])
+    # hi of the run starting at i = index of the NEXT start (suffix min)
+    start_pos = jnp.where(is_start, idx, Rb)
+    next_start = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.concatenate([start_pos[1:], jnp.array([Rb], jnp.int32)]))))
+    mask = cap - 1
+    home = _mix32(sh) & mask
+    fp = _mix32(sh, salt=_FP_SALT)
+
+    # one PARKING slot at index cap: losers scatter there, never into a
+    # live slot (a parked .set at a shared fixed index could clobber a
+    # genuine win landing on that same slot in the same scatter)
+    keys = jnp.full(cap + 1, _EMPTY, dtype=jnp.int32)
+    los = jnp.zeros(cap + 1, dtype=jnp.int32)
+    his = jnp.zeros(cap + 1, dtype=jnp.int32)
+    placed = ~is_start  # non-starts have nothing to insert
+
+    def round_(r, state):
+        keys, los, his, placed = state
+        pos = (home + r) & mask
+        want = ~placed
+        # scatter-min arbitration: the lowest claiming row wins the slot
+        claim = jnp.full(cap + 1, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+        claim = claim.at[jnp.where(want, pos, cap)].min(
+            jnp.where(want, idx, jnp.iinfo(jnp.int32).max))
+        free = keys[pos] == _EMPTY
+        won = want & free & (claim[pos] == idx)
+        park = jnp.where(won, pos, cap)
+        keys = keys.at[park].set(jnp.where(won, fp, _EMPTY))
+        los = los.at[park].set(jnp.where(won, idx, 0))
+        his = his.at[park].set(jnp.where(won, next_start, 0))
+        return keys, los, his, placed | won
+
+    keys, los, his, placed = jax.lax.fori_loop(
+        0, MAX_PROBES, round_, (keys, los, his, placed))
+    return keys[:cap], los[:cap], his[:cap], placed.all()
+
+
+def _probe_xla(keys, los, his, sh, probes, cap):
+    """Window-scan probe expressed in plain XLA (the same arithmetic the
+    Pallas kernel runs; also the interpret-mode/CPU executable path)."""
+    mask = cap - 1
+    home = _mix32(probes) & mask
+    fp = _mix32(probes, salt=_FP_SALT)
+    lo = jnp.zeros(probes.shape[0], dtype=jnp.int32)
+    hi = jnp.zeros(probes.shape[0], dtype=jnp.int32)
+    found = jnp.zeros(probes.shape[0], dtype=jnp.bool_)
+
+    def round_(r, state):
+        lo, hi, found = state
+        pos = (home + r) & mask
+        k = keys[pos]
+        cand_lo = los[pos]
+        # fingerprint match is only a CANDIDATE: verify via the run's
+        # actual 64-bit hash (two different hashes can share slot + fp)
+        hit = (~found) & (k == fp) & (sh[jnp.clip(cand_lo, 0, sh.shape[0] - 1)]
+                                      == probes)
+        lo = jnp.where(hit, cand_lo, lo)
+        hi = jnp.where(hit, his[pos], hi)
+        found = found | hit
+        return lo, hi, found
+
+    lo, hi, found = jax.lax.fori_loop(
+        0, MAX_PROBES, round_, (lo, hi, found))
+    # miss => empty range (searchsorted yields lo == hi there; the join
+    # only consumes hi - lo and lo + k under cnt, so any equal pair works)
+    lo = jnp.where(found, lo, 0)
+    hi = jnp.where(found, hi, 0)
+    return lo.astype(jnp.int64), hi.astype(jnp.int64)
+
+
+def _probe_pallas(keys, los, his, sh, probes, cap):
+    """VMEM-resident table scan: one grid step per probe tile, the three
+    [cap] tables mapped whole into VMEM, MAX_PROBES vectorized rounds."""
+    from jax.experimental import pallas as pl
+
+    T = 2048
+    Rp = probes.shape[0]
+    n_tiles = (Rp + T - 1) // T
+    pad = n_tiles * T - Rp
+    probes_p = jnp.concatenate(
+        [probes, jnp.full(pad, -1, dtype=probes.dtype)]) if pad else probes
+    mask = cap - 1
+    home = (_mix32(probes_p) & mask).astype(jnp.int32)
+    fp = _mix32(probes_p, salt=_FP_SALT)
+    # probe-side hash identity check runs on the table's lo -> sh lookup;
+    # precompute sh as int32 pair to keep the kernel i32-only
+    sh_hi = (sh >> 32).astype(jnp.int32)
+    sh_lo = sh.astype(jnp.int32)
+    pr_hi = (probes_p >> 32).astype(jnp.int32)
+    pr_lo = probes_p.astype(jnp.int32)
+
+    def kernel(home_ref, fp_ref, prhi_ref, prlo_ref, keys_ref, los_ref,
+               his_ref, shhi_ref, shlo_ref, lo_ref, hi_ref):
+        h = home_ref[...]
+        f = fp_ref[...]
+        phi = prhi_ref[...]
+        plo = prlo_ref[...]
+        lo = jnp.zeros_like(h)
+        hi = jnp.zeros_like(h)
+        found = jnp.zeros(h.shape, dtype=jnp.bool_)
+        Rb = shhi_ref.shape[0]
+        for r in range(MAX_PROBES):
+            pos = (h + r) & mask
+            k = keys_ref[pos]
+            cand = los_ref[pos]
+            ci = jnp.clip(cand, 0, Rb - 1)
+            hit = ((~found) & (k == f)
+                   & (shhi_ref[ci] == phi) & (shlo_ref[ci] == plo))
+            lo = jnp.where(hit, cand, lo)
+            hi = jnp.where(hit, his_ref[pos], hi)
+            found = found | hit
+        lo_ref[...] = lo
+        hi_ref[...] = hi
+
+    grid = (n_tiles,)
+    tile = pl.BlockSpec((T,), lambda i: (i,))
+    whole_cap = pl.BlockSpec((cap,), lambda i: (0,))
+    whole_rb = pl.BlockSpec((sh.shape[0],), lambda i: (0,))
+    lo32, hi32 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[tile, tile, tile, tile, whole_cap, whole_cap, whole_cap,
+                  whole_rb, whole_rb],
+        out_specs=[tile, tile],
+        out_shape=[jax.ShapeDtypeStruct((n_tiles * T,), jnp.int32)] * 2,
+        interpret=not pallas_enabled(),
+    )(home, fp, pr_hi, pr_lo, keys, los, his, sh_hi, sh_lo)
+    return lo32[:Rp].astype(jnp.int64), hi32[:Rp].astype(jnp.int64)
+
+
+@functools.partial(jax.jit, static_argnames=("use_pallas",))
+def probe_ranges(sorted_hashes: jax.Array, probes: jax.Array,
+                 use_pallas: bool = False):
+    """(lo, hi) per probe element over the sorted build hashes —
+    numerically identical to searchsorted left/right wherever the join
+    consumes them (hi - lo counts and lo + k positions). Falls back to
+    searchsorted inside the SAME jit when the table build overflows its
+    displacement bound, so callers never see a behavioral difference."""
+    Rb = sorted_hashes.shape[0]
+    cap = min(_next_pow2(max(2 * Rb, 16)), MAX_CAPACITY)
+    if cap < 2 * Rb or Rb == 0:
+        # load factor would exceed 1/2 (or VMEM): stay on searchsorted
+        return xla_probe_ranges(sorted_hashes, probes)
+    keys, los, his, ok = _build_table(sorted_hashes, cap)
+
+    def fast(_):
+        if use_pallas:
+            return _probe_pallas(keys, los, his, sorted_hashes, probes, cap)
+        return _probe_xla(keys, los, his, sorted_hashes, probes, cap)
+
+    def slow(_):
+        lo, hi = xla_probe_ranges(sorted_hashes, probes)
+        return lo.astype(jnp.int64), hi.astype(jnp.int64)
+
+    return jax.lax.cond(ok, fast, slow, None)

@@ -496,8 +496,13 @@ class _Compiler:
                 jnp.zeros(1, dtype=jnp.int64),
                 jnp.cumsum((sinv == 0).astype(jnp.int64)),
             ])
-            lo = jnp.searchsorted(sh, p_hash2, side="left")
-            hi = jnp.searchsorted(sh, p_hash2, side="right")
+            # probe strategy: open-addressing hash table (ops/
+            # hash_probe.py — O(1)-expected VMEM probes on TPU, the
+            # SURVEY.md:294-296 fast path) or searchsorted (O(log Rb));
+            # identical (lo, hi) semantics either way
+            from tidb_tpu.ops.hash_probe import probe_for_join
+
+            lo, hi = probe_for_join(sh, p_hash2)
             p_ok = pch2.sel & p_kvalid2
             cnt = jnp.where(p_ok, cvi[hi] - cvi[lo], 0)
 
