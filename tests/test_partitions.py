@@ -184,3 +184,21 @@ class TestReviewRegressions:
             s.execute("create table ts (name varchar(10)) "
                       "partition by range (name) "
                       "(partition p0 values less than (3))")
+
+
+class TestInformationSchema:
+    def test_partitions_table(self, s):
+        rows = s.query(
+            "select partition_name, partition_ordinal_position, "
+            "partition_method, partition_description from "
+            "information_schema.partitions where table_name = 'pt' "
+            "order by partition_ordinal_position")
+        assert rows == [("p0", 1, "RANGE", "100"), ("p1", 2, "RANGE", "200"),
+                        ("p2", 3, "RANGE", "MAXVALUE")]
+
+    def test_unpartitioned_single_null_row(self, s):
+        s.execute("create table up (a bigint)")
+        rows = s.query("select partition_name from "
+                       "information_schema.partitions "
+                       "where table_name = 'up'")
+        assert rows == [(None,)]

@@ -224,3 +224,13 @@ class TestReviewRegressions:
             assert s.query("select * from shadowed") == [(1,)]
         finally:
             w.stop()
+
+
+class TestProcesslistInfoschema:
+    def test_processlist_table(self):
+        s = Session()
+        s2 = Session(catalog=s.catalog)
+        rows = s.query("select id, user, command from "
+                       "information_schema.processlist order by id")
+        ids = [r[0] for r in rows]
+        assert s.conn_id in ids and s2.conn_id in ids

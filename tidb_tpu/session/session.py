@@ -293,6 +293,9 @@ class Session:
         # processlist registration (ref: server/ connection registry)
         self.conn_id = self.catalog.next_conn_id()
         self.catalog.processes[self.conn_id] = self
+        import weakref
+
+        object.__setattr__(self.catalog, "_viewer", weakref.ref(self))
         self._current_sql: Optional[str] = None
         self._current_t0: float = 0.0
         self._killed = False       # KILL <id>: connection is dead
@@ -2315,26 +2318,11 @@ class Session:
             rows = [(g,) for g in self.catalog.privileges.grants_for(user)]
             return ResultSet(names=[f"Grants for {user}"], rows=rows)
         if stmt.kind == "processlist":
-            import time as _time
-
-            try:
-                self._priv("super")
-                all_users = True
-            except Exception:  # noqa: BLE001 — MySQL: without PROCESS
-                all_users = False  # priv you still see your own threads
-            rows = []
-            for cid in sorted(self.catalog.processes.keys()):
-                sess = self.catalog.processes.get(cid)
-                if sess is None or (not all_users
-                                    and sess.user != self.user):
-                    continue
-                sql_now = sess._current_sql
-                rows.append((
-                    cid, sess.user, "localhost", sess.db,
-                    "Query" if sql_now else "Sleep",
-                    int(_time.time() - sess._current_t0) if sql_now else 0,
-                    "" if sql_now else None,
-                    (sql_now or "")[:100] or None))
+            # shared builder: privilege filtering (non-SUPER users see
+            # their own threads only) lives in ONE place with the
+            # information_schema.processlist path
+            rows = self.catalog.processlist_rows(
+                viewer_user=self.user, with_state=True)
             return ResultSet(
                 names=["Id", "User", "Host", "db", "Command", "Time",
                        "State", "Info"], rows=rows)

@@ -84,6 +84,32 @@ class Catalog:
         self._conn_id = 0
         self._conn_id_lock = threading.Lock()
 
+    def processlist_rows(self, viewer_user=None, with_state=False):
+        """Live-session rows for SHOW PROCESSLIST and
+        information_schema.processlist — ONE implementation so the
+        privilege filter and field derivations can never diverge. A
+        viewer without the SUPER/PROCESS privilege sees only their own
+        threads (MySQL)."""
+        import time as _time
+
+        all_users = (viewer_user is None
+                     or self.privileges.has(viewer_user, "super"))
+        rows = []
+        for cid in sorted(self.processes.keys()):
+            sess = self.processes.get(cid)
+            if sess is None or (not all_users
+                                and sess.user != viewer_user):
+                continue
+            sql_now = getattr(sess, "_current_sql", None)
+            row = [cid, sess.user, "localhost", sess.db,
+                   "Query" if sql_now else "Sleep",
+                   int(_time.time() - sess._current_t0) if sql_now else 0]
+            if with_state:
+                row.append("" if sql_now else None)
+            row.append((sql_now or "")[:100] or None)
+            rows.append(tuple(row))
+        return rows
+
     def next_conn_id(self) -> int:
         # its own tiny lock: the catalog statement lock can be held for
         # a whole long statement, and session CREATION must never block
@@ -553,7 +579,7 @@ class Catalog:
             d.tables[name] = self._info_schema_table(name)
         return d
 
-    def _info_schema_table(self, name: str):
+    def _info_schema_table(self, name: str, viewer=None):
         from tidb_tpu.types import FLOAT64, INT64, STRING
 
         def make(cols, rows):
@@ -651,6 +677,37 @@ class Catalog:
                  ("update_rule", STRING), ("delete_rule", STRING)],
                 rows,
             )
+        if name == "partitions":
+            rows = []
+            for dbn in sorted(self.databases):
+                for tn in sorted(self.databases[dbn].tables):
+                    pi = self.databases[dbn].tables[tn].schema.partition
+                    if pi is None:
+                        rows.append(("def", dbn, tn, None, None, None, None))
+                        continue
+                    for p in range(pi.count()):
+                        desc = None
+                        if pi.kind == "range":
+                            u = pi.uppers[p]
+                            desc = "MAXVALUE" if u is None else str(u)
+                        rows.append(("def", dbn, tn, pi.part_name(p), p + 1,
+                                     pi.kind.upper(), desc))
+            return make(
+                [("table_catalog", STRING), ("table_schema", STRING),
+                 ("table_name", STRING), ("partition_name", STRING),
+                 ("partition_ordinal_position", INT64),
+                 ("partition_method", STRING),
+                 ("partition_description", STRING)],
+                rows,
+            )
+        if name == "processlist":
+            rows = self.processlist_rows(viewer_user=viewer)
+            return make(
+                [("id", INT64), ("user", STRING), ("host", STRING),
+                 ("db", STRING), ("command", STRING), ("time", INT64),
+                 ("info", STRING)],
+                rows,
+            )
         if name == "slow_query":
             return make(
                 [("time", STRING), ("db", STRING), ("query_time", FLOAT64),
@@ -678,7 +735,8 @@ class Catalog:
 
 
 _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
-                "key_column_usage", "referential_constraints")
+                "key_column_usage", "referential_constraints",
+                "partitions", "processlist")
 
 
 class SessionCatalog:
@@ -694,6 +752,7 @@ class SessionCatalog:
             base = base._base
         object.__setattr__(self, "_base", base)
         object.__setattr__(self, "_temp", {})  # (db, name) -> Table
+        object.__setattr__(self, "_viewer", None)  # weakref to Session
 
     def __getattr__(self, name):
         return getattr(self._base, name)
@@ -711,6 +770,16 @@ class SessionCatalog:
         t = self._temp.get((db, name))
         if t is not None:
             return t
+        if (db.lower() == "information_schema"
+                and name.lower() == "processlist"):
+            # viewer-aware: a session without SUPER sees only its own
+            # threads, same as SHOW PROCESSLIST (round-5 review)
+            viewer = self._viewer() if self._viewer is not None else None
+            t = self._base._info_schema_table(
+                "processlist",
+                viewer=getattr(viewer, "user", None) or "")
+            if t is not None:
+                return t
         return self._base.table(db, name)
 
     def tables(self, db: str):
