@@ -506,7 +506,11 @@ def main(locked_detail=("acquired", "acquired")):
                 f"budget={budget >> 20}MiB)")
             best_res = best
             s18.execute(f"SET tidb_device_cache_bytes = {budget}")
-            s18.execute(f"SET tidb_mem_quota_query = {budget}")
+            # the HOST quota floors at the engine's fixed per-query
+            # working set (chunk buffers + scan staging ~ tens of MB):
+            # at toy smoke SFs lineitem/4 dips below it and would OOM
+            # on overhead, not on group state
+            s18.execute(f"SET tidb_mem_quota_query = {max(budget, 32 << 20)}")
             s18.execute("SET tidb_enable_tmp_storage_on_oom = 1")
             d0 = stream_engagements()
             rps_s, vs_s, best_s, check_s = bench_query(

@@ -701,11 +701,12 @@ class Catalog:
                 rows,
             )
         if name == "processlist":
-            rows = self.processlist_rows(viewer_user=viewer)
+            rows = self.processlist_rows(viewer_user=viewer,
+                                         with_state=True)
             return make(
                 [("id", INT64), ("user", STRING), ("host", STRING),
                  ("db", STRING), ("command", STRING), ("time", INT64),
-                 ("info", STRING)],
+                 ("state", STRING), ("info", STRING)],
                 rows,
             )
         if name == "slow_query":
@@ -775,11 +776,11 @@ class SessionCatalog:
             # viewer-aware: a session without SUPER sees only its own
             # threads, same as SHOW PROCESSLIST (round-5 review)
             viewer = self._viewer() if self._viewer is not None else None
-            t = self._base._info_schema_table(
+            # always returns a Table — never fall through to the
+            # base path, whose viewer-less build is unfiltered
+            return self._base._info_schema_table(
                 "processlist",
                 viewer=getattr(viewer, "user", None) or "")
-            if t is not None:
-                return t
         return self._base.table(db, name)
 
     def tables(self, db: str):
