@@ -309,6 +309,12 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
     from tidb_tpu.testutil import rows_equal
 
     from tidb_tpu.utils import dispatch as _dsp
+    from tidb_tpu.utils import metrics as _M
+
+    def engine_dispatches():
+        # the ENGINE-reported figure: the process-global dispatch
+        # counter the engine itself maintains (rendered on /metrics)
+        return int(sum(v for _lbl, v in _M.DISPATCH_TOTAL.samples()))
 
     if extra is not None and tag:
         wait_for_idle(tag, extra)
@@ -317,16 +323,29 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
     got = s.query(engine_sql)  # compile + warmup
     warm = time.perf_counter() - t0
     best = float("inf")
-    d0 = _dsp.count()
+    d0 = engine_dispatches()
+    d0_local = _dsp.count()
     for _ in range(reps):
-        d0 = _dsp.count()
+        d0 = engine_dispatches()
+        d0_local = _dsp.count()
         t0 = time.perf_counter()
         got = s.query(engine_sql)
         best = min(best, time.perf_counter() - t0)
     if extra is not None and tag:
         # device round trips of the last exec: the tunnel pays ~0.5 s
-        # per dispatch, so this is the latency floor in one number
-        extra[f"{tag}_dispatches"] = _dsp.count() - d0
+        # per dispatch, so this is the latency floor in one number.
+        # Headline figure comes from the engine metric; the bench's own
+        # thread-local count stays as a cross-check that fails loudly
+        # (the bench is the only engine thread, so they must agree)
+        eng = engine_dispatches() - d0
+        local = _dsp.count() - d0_local
+        extra[f"{tag}_dispatches"] = eng
+        if eng != local:
+            extra[f"{tag}_dispatch_crosscheck"] = (
+                f"MISMATCH: engine metric says {eng}, bench-local "
+                f"dispatch count says {local}")
+            log(f"# DISPATCH CROSS-CHECK MISMATCH ({tag}): "
+                f"engine={eng} local={local}")
     vs, check, cpu_s = 0.0, "skipped", None
     if sqlite_conn is not None:
         cpu_s = float("inf")

@@ -137,3 +137,19 @@ class TestMultiDevice:
         put = jax.device_put(ch, jax.tree_util.tree_map(lambda _: sharding, ch))
         total = jax.jit(lambda c: jnp.sum(jnp.where(c.sel, c.col("a").data, 0)))(put)
         assert int(total) == sum(range(64))
+
+
+class TestRuntimeDictionaryRefill:
+    def test_fill_invalidates_bytewise_cache(self):
+        """ADVICE low: fill() re-inits the dictionary in place; the lazy
+        bytewise view cached for encode_with must not survive it, or a
+        refilled dictionary emits codes of the OLD contents."""
+        from tidb_tpu.chunk.dictionary import RuntimeDictionary
+
+        d = RuntimeDictionary([])
+        d.fill(["pear", "apple"])
+        codes, valid = d.encode_with(["apple"])  # primes the cache
+        assert d.values[int(codes[0])] == "apple" and valid[0]
+        d.fill(["zebra", "apple", "mango"])
+        codes, valid = d.encode_with(["zebra", "mango"])
+        assert [d.values[int(c)] for c in codes] == ["zebra", "mango"]

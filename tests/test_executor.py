@@ -450,6 +450,13 @@ class TestUpdateStringExpr:
             [(1, "aa"), (2, "yy"), (3, "zz")]
 
 
+import sqlite3 as _sqlite3
+
+_SQLITE_VER = tuple(int(x) for x in _sqlite3.sqlite_version.split("."))
+
+
+@pytest.mark.skipif(_SQLITE_VER < (3, 39),
+                    reason="FULL JOIN oracle needs sqlite >= 3.39")
 class TestFullOuterJoin:
     """FULL JOIN = (left join) UNION ALL (anti right w/ NULL left
     payload) — planner rewrite, sqlite >= 3.39 as oracle."""

@@ -291,6 +291,21 @@ class TestFkCollation:
         s.execute("delete from p2 where name = 'abc'")  # cascades
         assert s.query("select count(*) from c2") == [(0,)]
 
+    def test_on_update_cascade_preserves_case(self):
+        """ADVICE medium: fold keys are for MATCHING only — the cascade
+        must write the parent's raw new value, not its lowercase fold."""
+        s = Session()
+        s.execute("create table p4 (name varchar(20) primary key)")
+        s.execute("insert into p4 values ('Alice')")
+        s.execute("create table c4 (n varchar(20), "
+                  "foreign key (n) references p4 (name) on update cascade)")
+        s.execute("insert into c4 values ('ALICE')")  # ci-equal: accepted
+        s.execute("update p4 set name = 'BOB' where name = 'alice'")
+        assert s.query("select n from c4") == [("BOB",)]
+        # a second hop keeps the raw case too
+        s.execute("update p4 set name = 'Carol-X' where name = 'bob'")
+        assert s.query("select n from c4") == [("Carol-X",)]
+
     def test_mixed_collation_fk_rejected(self):
         s = Session()
         s.execute("create table p3 (name varchar(20) collate utf8mb4_bin "

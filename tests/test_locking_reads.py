@@ -297,3 +297,37 @@ class TestReviewRegressions:
                       "collate utf8mb4_general_ci")
         # unchanged semantics after the failed ALTER
         assert s.query("select count(*) from mu where a = 'abc'") == [(1,)]
+
+
+class TestOwnTxnWrites:
+    """ADVICE high: the committed-latest (read_ts=None) visibility branch
+    must honor the txn marker — a locking read inside a transaction sees
+    that transaction's own provisional writes, like MySQL."""
+
+    def test_for_update_sees_own_update(self, acct):
+        a = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("update acct set v = 250 where id = 1")
+        # current read, but of THIS txn's provisional version
+        assert a.query(
+            "select v from acct where id = 1 for update") == [(250,)]
+        a.execute("commit")
+        assert acct.query("select v from acct where id = 1") == [(250,)]
+
+    def test_for_update_hides_own_delete(self, acct):
+        a = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("delete from acct where id = 2")
+        assert a.query("select id from acct for update") == [(1,)]
+        a.execute("rollback")
+        assert sorted(acct.query("select id from acct")) == [(1,), (2,)]
+
+    def test_insert_then_for_update_locks_new_row(self, acct):
+        a = Session(catalog=acct.catalog)
+        a.execute("begin")
+        a.execute("insert into acct values (3, 300)")
+        assert a.query(
+            "select v from acct where id = 3 for update") == [(300,)]
+        t = acct.catalog.table("test", "acct")
+        assert t.row_locks  # the new row is actually locked
+        a.execute("commit")

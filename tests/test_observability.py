@@ -84,7 +84,169 @@ def test_status_port():
         assert status["status"] == "ok" and "version" in status
         metrics = urllib.request.urlopen(base + "/metrics").read().decode()
         assert "tidb_tpu_query_total" in metrics
+        # the distributed-telemetry collectors render too
+        assert "tidb_tpu_device_dispatch_total" in metrics
+        assert "tidb_tpu_fragment_seconds" in metrics
         schema = json.loads(urllib.request.urlopen(base + "/schema").read())
         assert schema["test"]["st"] == 2
     finally:
         srv.stop()
+
+
+# -- statement-digest summaries ---------------------------------------------
+
+
+class TestStatementsSummary:
+    def test_aggregation_and_normalization(self):
+        s = Session()
+        s.execute("CREATE TABLE ss (a bigint, b bigint)")
+        s.execute("INSERT INTO ss VALUES (1, 2), (3, 4)")
+        s.query("select b from ss where a = 1")
+        s.query("select b from ss where a = 3")  # same digest, new literal
+        rows = s.query(
+            "select digest, exec_count, avg_latency, max_latency, rows_sent,"
+            " plan_digest from information_schema.statements_summary"
+            " where digest_text = 'select b from ss where a = ?'")
+        assert len(rows) == 1, rows
+        digest, n, avg, mx, sent, plan_digest = rows[0]
+        assert n == 2 and sent == 2
+        assert len(digest) == 32 and len(plan_digest) == 32
+        assert mx >= avg > 0
+
+    def test_error_count(self):
+        s = Session()
+        with pytest.raises(Exception):
+            s.query("select * from missing_tbl_for_summary")
+        rows = s.query(
+            "select exec_count, errors from"
+            " information_schema.statements_summary where digest_text ="
+            " 'select * from missing_tbl_for_summary'")
+        assert rows == [(1, 1)]
+
+    def test_eviction_cap(self):
+        s = Session()
+        # GLOBAL-only: the store is catalog-wide, a session-local cap
+        # would evict other sessions' diagnostics
+        with pytest.raises(Exception, match="GLOBAL"):
+            s.execute("SET tidb_stmt_summary_max_stmt_count = 4")
+        s.execute("SET GLOBAL tidb_stmt_summary_max_stmt_count = 4")
+        s.execute("CREATE TABLE ev (a bigint)")
+        for k in range(10):  # distinct aliases -> distinct digests
+            s.query(f"select a as col{k} from ev")
+        assert len(s.catalog.stmt_summary) <= 4
+        assert s.catalog.stmt_summary.evicted > 0
+
+    def test_dispatches_come_from_engine_accounting(self):
+        from tidb_tpu.utils import dispatch as dsp
+
+        s = Session()
+        s.execute("CREATE TABLE dd (a bigint)")
+        s.execute("INSERT INTO dd VALUES (1), (2), (3)")
+
+        def engine_total():
+            return int(sum(v for _l, v in M.DISPATCH_TOTAL.samples()))
+
+        e0, l0 = engine_total(), dsp.count()
+        s.query("select count(*) from dd where a > 1")
+        eng, local = engine_total() - e0, dsp.count() - l0
+        # this thread's dispatches all land in the engine metric (other
+        # live threads may add more, never less)
+        assert local > 0 and eng >= local
+        rows = s.query(
+            "select dispatches from information_schema.statements_summary"
+            " where digest_text = 'select count ( * ) from dd where a > ?'")
+        assert rows and rows[0][0] == local
+
+    def test_slow_log_enriched_with_digest(self):
+        s = Session()
+        s.execute("SET tidb_slow_log_threshold = 0")
+        s.execute("CREATE TABLE sl (a bigint)")
+        s.query("select count(*) from sl")
+        s.execute("SET tidb_slow_log_threshold = 300000")
+        rows = s.query(
+            "select query, digest, plan_digest, max_mem, dispatches"
+            " from information_schema.slow_query")
+        hit = [r for r in rows if r[0] == "select count(*) from sl"]
+        assert hit, rows
+        _q, digest, plan_digest, max_mem, dispatches = hit[-1]
+        assert len(digest) == 32 and len(plan_digest) == 32
+        assert max_mem >= 0 and dispatches >= 0
+        # the digest joins back to the summary table
+        j = s.query("select exec_count from"
+                    " information_schema.statements_summary"
+                    f" where digest = '{digest}'")
+        assert j and j[0][0] >= 1
+
+    def test_statements_endpoint(self):
+        cat = Catalog()
+        s = Session(catalog=cat)
+        s.execute("CREATE TABLE se (a bigint)")
+        s.query("select count(*) from se")
+        srv = Server(catalog=cat, port=0, status_port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.status_port}"
+            body = json.loads(
+                urllib.request.urlopen(base + "/statements?top=5").read())
+            assert "statements" in body and len(body["statements"]) <= 5
+            top = body["statements"][0]
+            for field in ("digest", "digest_text", "exec_count",
+                          "sum_latency", "max_mem", "dispatches"):
+                assert field in top
+        finally:
+            srv.stop()
+
+
+# -- distributed execution telemetry ----------------------------------------
+
+
+class TestDistributedTelemetry:
+    def test_trace_shows_fragment_spans(self):
+        from tidb_tpu.parallel import make_mesh
+
+        mesh = make_mesh(n_shards=2, n_dcn=1)
+        s = Session(chunk_capacity=4096, mesh=mesh)
+        s.execute("SET tidb_device_engine_mode = force")
+        s.execute("CREATE TABLE dt (a bigint, b bigint)")
+        s.execute("INSERT INTO dt VALUES "
+                  + ",".join(f"({i % 3},{i})" for i in range(300)))
+        before = M.FRAGMENT_SECONDS.count(kind="general_generic") \
+            + M.FRAGMENT_SECONDS.count(kind="scan_agg")
+        rs = s.execute(
+            "TRACE select a, sum(b) from dt where b > 10 group by a")
+        spans = [r[0].strip() for r in rs.rows]
+        frag_spans = [sp for sp in spans if sp.startswith("fragment.")]
+        assert frag_spans, spans
+        assert "[parts=" in frag_spans[0]
+        after = M.FRAGMENT_SECONDS.count(kind="general_generic") \
+            + M.FRAGMENT_SECONDS.count(kind="scan_agg")
+        assert after > before
+        # the summary's engine-reported fragment figure saw it too
+        rows = s.query("select fragments from"
+                       " information_schema.statements_summary"
+                       " where stmt_type = 'trace'")
+        assert rows and rows[0][0] >= 1
+
+    def test_dcn_byte_and_rtt_counters(self):
+        import threading
+
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        w = Worker()
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+        sent0 = M.DCN_BYTES.value(direction="sent")
+        recv0 = M.DCN_BYTES.value(direction="recv")
+        rtt0 = M.DCN_RTT.count()
+        cl = Cluster([("127.0.0.1", w.port)])
+        try:
+            cl.broadcast_exec("create table dm (k bigint, v bigint)")
+            cl.broadcast_exec("insert into dm values (1, 10), (2, 20)")
+            cl.mark_partitioned("dm")
+            got = cl.query(
+                "select k, sum(v) as s from dm group by k order by k")
+            assert got == [(1, 10), (2, 20)]
+        finally:
+            cl.shutdown()
+        assert M.DCN_BYTES.value(direction="sent") > sent0
+        assert M.DCN_BYTES.value(direction="recv") > recv0
+        assert M.DCN_RTT.count() > rtt0

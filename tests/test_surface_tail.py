@@ -146,6 +146,17 @@ class TestProcesslistKill:
         with pytest.raises(Exception, match="Unknown thread"):
             s.execute("kill 999999")
 
+    def test_kill_unknown_id_without_super(self):
+        # ADVICE low: existence is checked BEFORE privilege — a plain
+        # user killing a dead id gets MySQL's "Unknown thread id", not
+        # an access-denied error
+        s = Session()
+        s.execute("create user plain_killer")
+        s2 = Session(catalog=s.catalog)
+        s2.user = "plain_killer"
+        with pytest.raises(Exception, match="Unknown thread"):
+            s2.execute("kill 999999")
+
 
 class TestIgnoredClauseWarnings:
     def test_comment_and_charset_warn(self):

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 from tidb_tpu.parser.lexer import Lexer
 
-__all__ = ["normalize_sql", "Binding", "BindHandle"]
+__all__ = ["normalize_sql", "sql_digest", "Binding", "BindHandle"]
 
 
 def normalize_sql(sql: str) -> str:
@@ -35,6 +35,16 @@ def normalize_sql(sql: str) -> str:
         else:
             out.append(t.text)
     return " ".join(out)
+
+
+def sql_digest(normalized: str) -> str:
+    """Statement digest: hex SHA-256 of the normalized text (truncated —
+    32 hex chars keep full practical collision resistance while staying
+    readable in I_S rows and log lines). Shared by the statements-summary
+    store and the slow-query log so their digests always join."""
+    import hashlib
+
+    return hashlib.sha256(normalized.encode()).hexdigest()[:32]
 
 
 @dataclass

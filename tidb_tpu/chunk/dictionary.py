@@ -81,6 +81,10 @@ class Dictionary:
             self._folded = None
             self._canon = None
         self._index = {v: i for i, v in enumerate(vals)}
+        # lazy bytewise view for encode_with; reset HERE so a
+        # RuntimeDictionary.fill() (which re-runs __init__ in place)
+        # can never serve codes computed against the old contents
+        self._bytewise = None
 
     def fold(self, s: str) -> str:
         """Collation fold key (identity for _bin)."""
@@ -172,9 +176,10 @@ class Dictionary:
         return codes, valid
 
     def _bytewise_view(self):
-        """(permutation, bytewise-sorted values) — lazy, cached; the
-        dictionary is immutable so it never invalidates."""
-        cached = getattr(self, "_bytewise", None)
+        """(permutation, bytewise-sorted values) — lazy, cached;
+        __init__ resets the cache, so a refilled RuntimeDictionary
+        rebuilds it against its new contents."""
+        cached = self._bytewise
         if cached is None:
             vals = np.array(self.values, dtype=str)
             order = np.argsort(vals).astype(np.int64)

@@ -232,12 +232,19 @@ def _loads(buf: bytes):
 def _send(sock: socket.socket, obj) -> None:
     payload = _dumps(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
+    from tidb_tpu.utils.metrics import DCN_BYTES
+
+    DCN_BYTES.inc(_LEN.size + len(payload), direction="sent")
 
 
 def _recv(sock: socket.socket):
     hdr = _recv_exact(sock, _LEN.size)
     (n,) = _LEN.unpack(hdr)
-    return _loads(_recv_exact(sock, n))
+    obj = _loads(_recv_exact(sock, n))
+    from tidb_tpu.utils.metrics import DCN_BYTES
+
+    DCN_BYTES.inc(_LEN.size + n, direction="recv")
+    return obj
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -768,6 +775,12 @@ class Cluster:
         self._endpoints = list(endpoints)
         self._partitioned: set = set()
         self._broadcast: set = set()
+        # one lock per worker socket: callers may issue RPCs to the same
+        # worker from several threads (a DML thread racing online_ddl's
+        # stage barriers); an interleaved send/recv pair desyncs the
+        # length-prefixed framing permanently
+        self._sock_locks: List[threading.Lock] = [
+            threading.Lock() for _ in endpoints]
         for host, port in endpoints:
             self._socks.append(self._connect(host, port))
         from tidb_tpu.session import Session
@@ -816,20 +829,27 @@ class Cluster:
         return len(self._socks)
 
     def _call(self, i: int, msg: Dict):
-        sock = self._socks[i]
-        if sock is None:
-            raise ConnectionError(f"dcn worker {i} is down")
-        try:
-            _send(sock, msg)
-            resp = _recv(sock)
-        except (ConnectionError, OSError, DcnCodecError) as e:
-            # mark dead so retries don't reuse a broken socket
+        t0 = time.perf_counter()
+        with self._sock_locks[i]:  # one in-flight RPC per worker
+            sock = self._socks[i]
+            if sock is None:
+                raise ConnectionError(f"dcn worker {i} is down")
             try:
-                sock.close()
-            except OSError:
-                pass
-            self._socks[i] = None
-            raise ConnectionError(f"dcn worker {i}: {e}") from e
+                _send(sock, msg)
+                resp = _recv(sock)
+            except (ConnectionError, OSError, DcnCodecError) as e:
+                # mark dead so retries don't reuse a broken socket —
+                # still under the lock, so a concurrent caller can never
+                # have its healthy RPC closed out from underneath it
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._socks[i] = None
+                raise ConnectionError(f"dcn worker {i}: {e}") from e
+        from tidb_tpu.utils.metrics import DCN_RTT
+
+        DCN_RTT.observe(time.perf_counter() - t0)
         if not resp["ok"]:
             raise ExecutionError(f"dcn worker {i}: {resp['error']}")
         return resp["result"]

@@ -31,7 +31,7 @@ from tidb_tpu.chunk.column import Column
 from tidb_tpu.executor.aggregate import make_segment_kernel, merge_op_for
 from tidb_tpu.executor.scan import make_pipeline_fn
 from tidb_tpu.expression.compiler import eval_expr
-from tidb_tpu.parallel.mesh import dcn_axis, shard_axis
+from tidb_tpu.parallel.mesh import dcn_axis, shard_axis, shard_map_compat
 from tidb_tpu.parallel.partition import ShardedTable
 
 __all__ = [
@@ -106,7 +106,7 @@ def make_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
         chunk = pipeline(_shard_chunk(types, data, valid, sel, uid_map))
         return merge_state(update(init_state(), chunk))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         per_shard, mesh=mesh,
         in_specs=(_SPEC, _SPEC, _SPEC), out_specs=P(), check_vma=False,
     ))
@@ -258,7 +258,7 @@ def make_join_agg_fragment(
         ovf = jax.lax.psum(p_ovf + b_ovf, _AXES)
         return state, ovf
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         per_shard, mesh=mesh,
         in_specs=(_SPEC,) * 6, out_specs=(P(), P()), check_vma=False,
     ))

@@ -15,6 +15,7 @@ when a subtree isn't pushable (ref: planner "cop task" vs "root task").
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -47,6 +48,36 @@ from tidb_tpu.planner.physical import (
 )
 
 __all__ = ["ShardCache", "build_dist_executor", "DistAggExec", "DistJoinAggExec"]
+
+
+def _note_fragment(exec_, kind: str, n_parts: int, t0: float) -> None:
+    """Record one fragment dispatch: the FRAGMENT_SECONDS collector for
+    /metrics and a span on the executor that TRACE renders under the
+    operator row. Wall time covers launch plus any synchronous
+    trace/compile (jax dispatch is async — device busy time is not host
+    observable without forcing a sync, which TRACE must not pay for).
+    One call is one fragment execution, so the dispatch counter lives
+    here too — the count and the histogram can never desynchronize."""
+    from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH, FRAGMENT_SECONDS
+
+    dt = time.perf_counter() - t0
+    FRAGMENT_DISPATCH.inc(kind=kind)
+    FRAGMENT_SECONDS.observe(dt, kind=kind)
+    spans = getattr(exec_, "frag_spans", None)
+    if spans is None:
+        spans = exec_.frag_spans = []
+    spans.append((f"fragment.{kind}[parts={n_parts}]", dt))
+
+
+def _timed_combine(sig, state, part):
+    """Merge two per-shard collective states, timing the host-driven
+    merge into COLLECTIVE_MERGE_SECONDS."""
+    from tidb_tpu.utils.metrics import COLLECTIVE_MERGE_SECONDS
+
+    t0 = time.perf_counter()
+    out = _segment_state_combine(sig)(state, part)
+    COLLECTIVE_MERGE_SECONDS.observe(time.perf_counter() - t0)
+    return out
 
 
 class ShardCache:
@@ -208,10 +239,9 @@ class DistAggExec(HashAggExec):
             lambda: make_agg_fragment(st, self._stages, self.group_exprs,
                                       self.aggs, domains, uid_map=_uid_map(self._scan)),
         )
+        t0 = time.perf_counter()
         state = fn(st.data, st.valid, st.sel)
-        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
-
-        FRAGMENT_DISPATCH.inc(kind="scan_agg")
+        _note_fragment(self, "scan_agg", st.n_parts, t0)
         self._finalize_segment_state(state, domains)
 
     def _run_segment_streaming(self, domains, scan_cols):
@@ -221,12 +251,10 @@ class DistAggExec(HashAggExec):
         overlaps batch k's compute with batch k+1's host staging (the
         IndexLookUp double-pipeline analogue)."""
         from tidb_tpu.parallel.partition import stream_batches
-        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
 
         table = self._scan.table
         mesh = self._cache.mesh
         sig = repr((self._stages, self.group_exprs, self.aggs, domains))
-        combine = _segment_state_combine(sig)
         state = None
         fn = None
         for st in stream_batches(table, mesh, scan_cols,
@@ -240,9 +268,11 @@ class DistAggExec(HashAggExec):
                         st, self._stages, self.group_exprs, self.aggs,
                         domains, uid_map=_uid_map(self._scan)),
                 )
+            t0 = time.perf_counter()
             part = fn(st.data, st.valid, st.sel)
-            state = part if state is None else combine(state, part)
-            FRAGMENT_DISPATCH.inc(kind="scan_agg_stream")
+            _note_fragment(self, "scan_agg_stream", st.n_parts, t0)
+            state = part if state is None else _timed_combine(
+                sig, state, part)
         self._finalize_segment_state(state, domains)
 
 
@@ -325,9 +355,11 @@ class DistJoinAggExec(HashAggExec):
                     growth=growth,
                 ),
             )
+            t0 = time.perf_counter()
             state, ovf = fn(probe_st.data, probe_st.valid, probe_st.sel,
                             build_st.data, build_st.valid, build_st.sel)
             if int(ovf) == 0:
+                _note_fragment(self, "join_agg", probe_st.n_parts, t0)
                 self._cache.put_growth(gkey, growth)
                 break
             growth *= 2  # skewed exchange: retry with bigger buckets
@@ -515,15 +547,15 @@ class DistFragmentExec(HashAggExec):
         shapes_sig = (tuple((st.n_parts, st.rows_per_part) for st in sts),
                       tuple(bcast_shapes))
         types_sig = tuple(_types_sig(st) for st in sts)
+        t0 = time.perf_counter()
         out, growths = self._dispatch_retry(prog, args, shapes_sig,
                                             types_sig, growths)
         if out is None:
             self._fall_back_single_chip()
             return
+        _note_fragment(self, f"general_{prog.out_kind}",
+                       sts[0].n_parts if sts else 0, t0)
         touch(self._cache.growth, gkey, growths, ShardCache.MAX_FRAGMENTS)
-        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
-
-        FRAGMENT_DISPATCH.inc(kind=f"general_{prog.out_kind}")
 
         if prog.out_kind == "segment":
             self._finalize_segment_state(out, prog.domains)
@@ -572,7 +604,6 @@ class DistFragmentExec(HashAggExec):
         from tidb_tpu.executor.agg_device import table_to_host_partial
         from tidb_tpu.executor.aggregate import merge_op_for
         from tidb_tpu.parallel.partition import stream_batches
-        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
 
         mesh = self._cache.mesh
         if prog.topn is not None:
@@ -623,18 +654,19 @@ class DistFragmentExec(HashAggExec):
             args += bcast_args
             shapes_sig = (tuple(shapes), tuple(bcast_shapes))
             types_sig = types_fixed + (_types_sig(batch), "stream")
+            t0 = time.perf_counter()
             out, growths = self._dispatch_retry(prog, args, shapes_sig,
                                                 types_sig, growths)
             if out is None:
                 self._fall_back_single_chip()
                 return
-            FRAGMENT_DISPATCH.inc(kind=f"general_{prog.out_kind}_stream")
+            _note_fragment(self, f"general_{prog.out_kind}_stream",
+                           batch.n_parts, t0)
             if prog.out_kind == "segment":
                 if seg_state is None:
                     seg_state = out
                 else:
-                    seg_state = _segment_state_combine(prog.sig)(
-                        seg_state, out)
+                    seg_state = _timed_combine(prog.sig, seg_state, out)
             else:
                 host = jax.device_get(out)
                 from tidb_tpu.utils import dispatch as dsp

@@ -51,7 +51,7 @@ from tidb_tpu.executor.builder import peel_stages, scan_stages_for
 from tidb_tpu.executor.scan import make_pipeline_fn
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
 from tidb_tpu.parallel.distsql import merge_state, pmax_compat, repartition_by_key
-from tidb_tpu.parallel.mesh import dcn_axis, shard_axis
+from tidb_tpu.parallel.mesh import dcn_axis, shard_axis, shard_map_compat
 from tidb_tpu.planner.physical import PHashAgg, PHashJoin, PScan
 from tidb_tpu.types import TypeKind
 
@@ -788,6 +788,9 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
         return None
     if not c.sources:
         return None  # nothing sharded: run single-chip
+    from tidb_tpu.utils.metrics import FRAGMENT_COMPILE
+
+    FRAGMENT_COMPILE.inc(kind=out_kind)
 
     n_src = len(c.sources)
     n_bc = len(c.broadcasts)
@@ -816,7 +819,7 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
 
         out_spec = P() if out_kind == "segment" else P(_AXES)
         in_specs = tuple([_SPEC, _SPEC, _SPEC] * n_src + [P(), P(), P()] * n_bc)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map_compat(
             frag, mesh=mesh, in_specs=in_specs, out_specs=(out_spec, P()),
             # pallas_call outputs carry no vma metadata; the fragment's
             # out_specs are the authority here

@@ -21,7 +21,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "shard_axis", "dcn_axis"]
+__all__ = ["make_mesh", "shard_axis", "dcn_axis", "shard_map_compat"]
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: new jax exposes it as
+    jax.shard_map(check_vma=...); 0.4.x has
+    jax.experimental.shard_map.shard_map(check_rep=...). Both flags
+    disable the same replication/vma verification, which pallas_call
+    outputs fail spuriously."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm
+
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+    except TypeError:  # very old/new experimental signature: no flag
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 shard_axis = "shard"
 dcn_axis = "dcn"

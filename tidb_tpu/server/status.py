@@ -2,9 +2,11 @@
 Prometheus, /status for liveness/version, plus schema introspection).
 
 Endpoints:
-    /metrics  - Prometheus text exposition of tidb_tpu_* collectors
-    /status   - JSON: version, connections, schema version, uptime
-    /schema   - JSON: databases -> tables -> row counts
+    /metrics     - Prometheus text exposition of tidb_tpu_* collectors
+    /status      - JSON: version, connections, schema version, uptime
+    /schema      - JSON: databases -> tables -> row counts
+    /statements  - JSON: top-N statement digests by cumulative latency
+                   (?top=N, default 50) from the statements-summary store
 """
 
 from __future__ import annotations
@@ -46,6 +48,21 @@ class StatusServer:
                             "connections": CONN_GAUGE.value(),
                             "schema_version": outer.catalog.schema_version,
                             "uptime_s": round(time.time() - outer.started, 1),
+                        }).encode()
+                        ctype = "application/json"
+                    elif self.path == "/statements" or \
+                            self.path.startswith("/statements?"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        q = parse_qs(urlparse(self.path).query)
+                        try:
+                            top = int(q.get("top", ["50"])[0])
+                        except ValueError:
+                            top = 50
+                        body = json.dumps({
+                            "statements":
+                                outer.catalog.stmt_summary.top(top),
+                            "evicted": outer.catalog.stmt_summary.evicted,
                         }).encode()
                         ctype = "application/json"
                     elif self.path == "/schema":

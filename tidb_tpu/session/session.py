@@ -298,6 +298,11 @@ class Session:
         object.__setattr__(self.catalog, "_viewer", weakref.ref(self))
         self._current_sql: Optional[str] = None
         self._current_t0: float = 0.0
+        # per-statement diagnostics context (statements-summary + slow
+        # log enrichment): trackers created this statement and the last
+        # SELECT's plan digest
+        self._stmt_trackers: list = []
+        self._last_plan_digest: Optional[str] = None
         self._killed = False       # KILL <id>: connection is dead
         self._kill_query = False   # KILL QUERY <id>: one-shot cancel
         # diagnostics area for SHOW WARNINGS (cleared per statement)
@@ -494,6 +499,8 @@ class Session:
         if not (isinstance(stmt, A.ShowStmt)
                 and getattr(stmt, "kind", "") == "warnings"):
             self._warnings.clear()  # MySQL: each statement resets the area
+        from tidb_tpu.utils import dispatch as _dsp
+
         self._current_sql = sql
         self._current_t0 = _time.time()
         stype = type(stmt).__name__.removesuffix("Stmt").lower()
@@ -504,14 +511,20 @@ class Session:
             import jax
 
             ctx = jax.profiler.trace(prof_dir)
+        self._stmt_trackers = []
+        self._last_plan_digest = None
+        d0 = _dsp.count()
+        f0 = _dsp.by_site().get("fragment", 0)
         t0 = _time.perf_counter()
         try:
             with ctx:
                 result = self._execute_stmt(stmt)
         except Exception as exc:
+            dur = _time.perf_counter() - t0
             M.QUERY_TOTAL.inc(type=stype, status="error")
-            self.catalog.plugins.statement_end(
-                self, sql, stype, _time.perf_counter() - t0, exc)
+            self._record_stmt(stmt, sql, stype, dur, d0, f0, None,
+                              error=True)
+            self.catalog.plugins.statement_end(self, sql, stype, dur, exc)
             raise
         finally:
             self._current_sql = None
@@ -519,12 +532,54 @@ class Session:
         self.catalog.plugins.statement_end(self, sql, stype, dur, None)
         M.QUERY_TOTAL.inc(type=stype, status="ok")
         M.QUERY_DURATION.observe(dur, type=stype)
+        detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, result)
         # threshold in ms; 0 logs every statement (long_query_time=0)
         threshold = int(self.sysvars.get("tidb_slow_log_threshold"))
         if dur * 1e3 >= threshold:
             M.SLOW_QUERY_TOTAL.inc()
-            self.catalog.log_slow_query(self.db, sql, dur)
+            self.catalog.log_slow_query(
+                self.db, sql, dur, digest=detail[0],
+                plan_digest=self._last_plan_digest or "",
+                max_mem=detail[1], dispatches=detail[2])
         return result
+
+    def _record_stmt(self, stmt, sql: str, stype: str, dur: float,
+                     d0: int, f0: int, result, error: bool = False):
+        """Fold one execution into the per-digest statements summary;
+        returns (digest, max_mem, dispatches) for the slow-query log.
+        Digests come from the bindinfo normalizer, so parameterized
+        variants of one statement aggregate under one entry."""
+        from tidb_tpu.bindinfo import normalize_sql, sql_digest
+        from tidb_tpu.utils import dispatch as _dsp
+
+        try:
+            src = getattr(stmt, "_source", None) or sql
+            if len(src) > 16384:
+                # bound the second lex: per-shape dedup matters for
+                # OLTP-sized statements, not megabyte bulk loads —
+                # those digest their raw text and keep a prefix
+                norm = src[:2048]
+                digest = sql_digest(src)
+            else:
+                norm = normalize_sql(src)
+                digest = sql_digest(norm)
+            max_mem = max((t.max_consumed for t in self._stmt_trackers),
+                          default=0)
+            self._stmt_trackers = []  # don't pin operator state while idle
+            dispatches = _dsp.count() - d0
+            fragments = _dsp.by_site().get("fragment", 0) - f0
+            self.catalog.stmt_summary.record(
+                digest, norm, stype, self._last_plan_digest or "", dur,
+                max_mem=max_mem,
+                rows_sent=len(result.rows) if result is not None else 0,
+                dispatches=dispatches, fragments=fragments, error=error,
+                max_stmt_count=int(
+                    self.sysvars.get("tidb_stmt_summary_max_stmt_count")))
+            return digest, max_mem, dispatches
+        except Exception:  # noqa: BLE001 — diagnostics must never fail
+            # (or mask) the statement; an unrecordable statement is
+            # simply absent from the summary
+            return "", 0, 0
 
     def query(self, sql: str) -> List[tuple]:
         rs = self.execute(sql)
@@ -567,15 +622,20 @@ class Session:
                 q = _parse_quota(hargs[0])  # MEMORY_QUOTA(bytes | N MB | N GB)
                 if q is not None:
                     quota = q  # unparseable hints are ignored, like TiDB warns
+        tracker = MemTracker(
+            "query",
+            budget=quota,
+            spill_enabled=bool(self.sysvars.get("tidb_enable_tmp_storage_on_oom")),
+        )
+        # the statement may build several contexts (shadow rowid scans,
+        # subplans): the summary reports the max over all of them
+        self._stmt_trackers.append(tracker)
+        del self._stmt_trackers[:-64]  # bound pathological statements
         return ExecContext(
             chunk_capacity=self._plan_capacity(plan),
             group_concat_max_len=int(
                 self.sysvars.get("group_concat_max_len")),
-            mem_tracker=MemTracker(
-                "query",
-                budget=quota,
-                spill_enabled=bool(self.sysvars.get("tidb_enable_tmp_storage_on_oom")),
-            ),
+            mem_tracker=tracker,
             read_ts=(None if self._lock_read else
                      self.txn.read_ts if self.txn is not None else None),
             txn_marker=self.txn.marker if self.txn is not None else 0,
@@ -807,6 +867,13 @@ class Session:
             # saves, so re-plan without it and keep the fragments
             phys = self._plan_select(stmt, agg_push_down=False)
             root = self._build_root(phys)
+        # plan digest: hash of the plan's shape (explain text), paired
+        # with the statement digest in statements_summary/slow log so a
+        # regressed plan choice is visible as a digest change
+        import hashlib as _hl
+
+        self._last_plan_digest = _hl.sha256(
+            explain_text(phys).encode()).hexdigest()[:32]
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
         if n_vis is None and hasattr(phys, "children") and phys.children:
             # Sort/Limit on top of the projection keep hidden sort columns
@@ -1016,13 +1083,13 @@ class Session:
             # KILL [QUERY|CONNECTION] <id> (ref: server/'s kill flow):
             # QUERY cancels the victim's in-flight statement at its next
             # chunk boundary; CONNECTION also fails every later statement
-            if self.user != "root":
-                victim0 = self.catalog.processes.get(stmt.conn_id)
-                if victim0 is None or victim0.user != self.user:
-                    self._priv("super")  # only SUPER kills others
             victim = self.catalog.processes.get(stmt.conn_id)
             if victim is None:
+                # existence BEFORE privilege (MySQL): a nonexistent id is
+                # "Unknown thread id" for every user, not an access error
                 raise ExecutionError(f"Unknown thread id: {stmt.conn_id}")
+            if self.user != "root" and victim.user != self.user:
+                self._priv("super")  # only SUPER kills others
             if stmt.query_only:
                 victim._kill_query = True
             else:
@@ -2292,6 +2359,12 @@ class Session:
                 None,
                 round((e.stats.open_wall + e.stats.next_wall) * 1e3, 3),
             ))
+            # mesh executors record one span per fragment dispatch
+            # (parallel/executor.py), so a distributed plan shows where
+            # its device time went per fragment/per shard count
+            for span_name, span_s in getattr(e, "frag_spans", ()):
+                rows.append(("  " * (depth + 1) + span_name, None,
+                             round(span_s * 1e3, 3)))
             for c in e.children:
                 visit(c, depth + 1)
 

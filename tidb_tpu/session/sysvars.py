@@ -65,6 +65,12 @@ _reg(
     SysVar("tidb_auto_analyze_ratio", 0.5, BOTH, "float"),
     # statements slower than this (ms) go to the slow-query log
     SysVar("tidb_slow_log_threshold", 300, BOTH, "int", min_=0, max_=1 << 31),
+    # LRU cap on distinct digests kept by the statements-summary store
+    # (ref: tidb_stmt_summary_max_stmt_count); evictions are counted.
+    # GLOBAL-only like the reference: the store is catalog-wide, so a
+    # session-local cap would evict other sessions' diagnostics
+    SysVar("tidb_stmt_summary_max_stmt_count", 200, GLOBAL, "int",
+           min_=1, max_=1 << 16),
     # non-empty: wrap query execution in jax.profiler.trace(dir)
     SysVar("tidb_profile_dir", "", BOTH, "str"),
     # tables above this size stream through fixed [P,R] staging batches

@@ -76,6 +76,12 @@ class Catalog:
         from collections import deque
 
         self.slow_queries = deque(maxlen=128)
+        # per-digest statement aggregates, surfaced via
+        # information_schema.statements_summary and /statements (ref:
+        # the statements-summary tables fed by stmtsummary/)
+        from tidb_tpu.utils.stmtsummary import StmtSummary
+
+        self.stmt_summary = StmtSummary()
         # live sessions for SHOW PROCESSLIST / KILL (ref: server/'s
         # connection registry); weak values — a dropped session vanishes
         import weakref
@@ -244,16 +250,21 @@ class Catalog:
         future log-remapping GC that can run under open snapshots."""
         return min(self._open_txns.values(), default=self._ts)
 
-    def log_slow_query(self, db: str, sql: str, duration_s: float) -> None:
+    def log_slow_query(self, db: str, sql: str, duration_s: float,
+                       digest: str = "", plan_digest: str = "",
+                       max_mem: int = 0, dispatches: int = 0) -> None:
         import logging
         import time
 
         self.slow_queries.append((
             time.strftime("%Y-%m-%d %H:%M:%S"), db, round(duration_s, 4),
-            sql.strip()[:2048],
+            sql.strip()[:2048], digest, plan_digest, int(max_mem),
+            int(dispatches),
         ))
         logging.getLogger("tidb_tpu.slowlog").warning(
-            "slow query (%.3fs) db=%s: %s", duration_s, db, sql.strip()[:512])
+            "slow query (%.3fs) db=%s digest=%s mem=%d dispatches=%d: %s",
+            duration_s, db, digest, max_mem, dispatches,
+            sql.strip()[:512])
 
     def gc(self) -> Dict[str, int]:
         """Reclaim dead MVCC versions in every table. Conservative: a
@@ -712,8 +723,22 @@ class Catalog:
         if name == "slow_query":
             return make(
                 [("time", STRING), ("db", STRING), ("query_time", FLOAT64),
-                 ("query", STRING)],
+                 ("query", STRING), ("digest", STRING),
+                 ("plan_digest", STRING), ("max_mem", INT64),
+                 ("dispatches", INT64)],
                 list(self.slow_queries),
+            )
+        if name == "statements_summary":
+            return make(
+                [("digest", STRING), ("stmt_type", STRING),
+                 ("digest_text", STRING), ("plan_digest", STRING),
+                 ("exec_count", INT64), ("sum_latency", FLOAT64),
+                 ("avg_latency", FLOAT64), ("max_latency", FLOAT64),
+                 ("p95_latency", FLOAT64), ("max_mem", INT64),
+                 ("rows_sent", INT64), ("errors", INT64),
+                 ("dispatches", INT64), ("fragments", INT64),
+                 ("first_seen", STRING), ("last_seen", STRING)],
+                self.stmt_summary.rows(),
             )
         if name == "statistics":
             rows = []
@@ -737,7 +762,7 @@ class Catalog:
 
 _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
                 "key_column_usage", "referential_constraints",
-                "partitions", "processlist")
+                "partitions", "processlist", "statements_summary")
 
 
 class SessionCatalog:

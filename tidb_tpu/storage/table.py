@@ -496,14 +496,20 @@ class Table:
 
     # -- foreign keys ------------------------------------------------------
 
-    def _fk_decode(self, col: str, vals: np.ndarray) -> np.ndarray:
+    def _fk_decode(self, col: str, vals: np.ndarray,
+                   fold: bool = True) -> np.ndarray:
         """Decode this table's values of `col` for cross-table FK
         comparison: the collation FOLD KEY for dict columns (so
         'abc' matches a parent's 'ABC' under _ci — canonical codes are
-        table-local and must never cross tables), raw otherwise."""
+        table-local and must never cross tables), raw otherwise.
+        fold=False decodes the raw stored strings — what a cascade WRITE
+        must use, or a _ci cascade would lowercase the child's data."""
         dic = self.dicts.get(col)
         if dic is None:
             return vals
+        if not fold:
+            return np.array(
+                [dic.values[int(c)] for c in vals], dtype=object)
         return np.array(
             [dic.fold(dic.values[int(c)]) for c in vals], dtype=object)
 
@@ -610,15 +616,17 @@ class Table:
                           count=len(tuples))
         return sel[hit]
 
-    def _fk_tuples_aligned(self, cols: List[str], rows: np.ndarray):
-        """Row-aligned key tuples with None for NULL components."""
+    def _fk_tuples_aligned(self, cols: List[str], rows: np.ndarray,
+                           fold: bool = True):
+        """Row-aligned key tuples with None for NULL components.
+        fold=True yields comparison keys; fold=False the raw values."""
         out = []
         for i in rows.tolist():
             t = []
             for c in cols:
                 if self.valid[c][i]:
                     t.append(self._fk_decode(
-                        c, self.data[c][i:i + 1])[0])
+                        c, self.data[c][i:i + 1], fold=fold)[0])
                 else:
                     t.append(None)
             out.append(tuple(t))
@@ -682,19 +690,27 @@ class Table:
                                   log=clog, log_for=log_for,
                                   _fk_depth=depth + 1)
             elif act == "cascade":  # update: rewrite child keys old->new
+                # match on FOLD keys (how the referencing rows were
+                # found), but write the parent's RAW new values — a _ci
+                # cascade must not replace 'BOB' with its fold 'bob'
                 old_al = self._fk_tuples_aligned(fk.parent_cols, ids)
-                new_al = self._fk_tuples_aligned(
-                    fk.parent_cols, new_rows) if new_rows is not None else old_al
-                new_keys = {o: n for o, n in zip(old_al, new_al)
+                new_raw = self._fk_tuples_aligned(
+                    fk.parent_cols,
+                    new_rows if new_rows is not None else ids, fold=False)
+                new_keys = {o: n for o, n in zip(old_al, new_raw)
                             if None not in o}
                 tuples_c, ok_c = child._fk_tuples(fk.columns, rows)
+                rows_ok = rows[ok_c]
+                raw_c = child._fk_tuples_aligned(fk.columns, rows_ok,
+                                                 fold=False)
                 updates = {c: [] for c in fk.columns}
-                for t in tuples_c:
-                    nt = new_keys.get(t, t)
+                for t, raw in zip(tuples_c, raw_c):
+                    # unmatched keys keep the child's own raw value
+                    nt = new_keys.get(t, raw)
                     for c, v in zip(fk.columns, nt):
                         updates[c].append(v)
                 child.update_rows(
-                    rows, updates,
+                    rows_ok, updates,
                     begin_ts=marker or None, end_ts=end_ts if marker else None,
                     marker=marker, log=clog, log_for=log_for,
                     _fk_depth=depth + 1)
@@ -1449,7 +1465,13 @@ class Table:
         b = self.begin_ts[cand]
         e = self.end_ts[cand]
         if read_ts is None:
-            return (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
+            keep = (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
+            if marker:
+                # same own-writes rule as live_mask's committed-latest
+                # branch (point gets / index lookups under FOR UPDATE)
+                keep = (((b < TXN_TS_BASE) | (b == marker))
+                        & (e >= TXN_TS_BASE) & (e != marker))
+            return keep
         keep = (b <= read_ts) & (e > read_ts)
         if marker:
             keep = (((b <= read_ts) | (b == marker))
@@ -1762,7 +1784,14 @@ class Table:
         b = self.begin_ts[start:end]
         e = self.end_ts[start:end]
         if read_ts is None:
-            return (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
+            vis = (b < TXN_TS_BASE) & (e >= TXN_TS_BASE)
+            if marker:
+                # committed-latest (locking reads) still sees the txn's
+                # OWN provisional writes: an UPDATE then FOR UPDATE in
+                # one txn must lock the new version, not the stale row
+                vis = (((b < TXN_TS_BASE) | (b == marker))
+                       & (e >= TXN_TS_BASE) & (e != marker))
+            return vis
         vis = (b <= read_ts) & (e > read_ts)
         if marker:
             vis = ((b <= read_ts) | (b == marker)) & (e > read_ts) & (e != marker)

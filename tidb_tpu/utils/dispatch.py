@@ -39,6 +39,13 @@ def record(n: int = 1, site: str = "other") -> None:
     if by is None:
         by = _tls.by_site = {}
     by[site] = by.get(site, 0) + n
+    # process-wide mirror: /metrics exposes dispatch totals so external
+    # drivers (bench.py) read the engine's own figure instead of
+    # re-deriving it — the thread-local stays the per-query source for
+    # EXPLAIN ANALYZE deltas
+    from tidb_tpu.utils.metrics import DISPATCH_TOTAL
+
+    DISPATCH_TOTAL.inc(n, site=site)
 
 
 def count() -> int:

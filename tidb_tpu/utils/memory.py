@@ -37,6 +37,7 @@ class MemTracker:
         self.spill_enabled = spill_enabled
         self.consumed = 0
         self.max_consumed = 0
+        self._quota_engaged = False  # first budget crossing counted once
         self._spillables: List[object] = []  # objects with spill() -> int
 
     def child(self, label: str) -> "MemTracker":
@@ -67,6 +68,11 @@ class MemTracker:
     # ------------------------------------------------------------------
 
     def _on_exceed(self) -> None:
+        if not self._quota_engaged:
+            self._quota_engaged = True
+            from tidb_tpu.utils.metrics import MEM_QUOTA_ENGAGED
+
+            MEM_QUOTA_ENGAGED.inc()
         # shed the largest spillable first until we're back under budget;
         # spillables register on the budget-holding (root) tracker
         while self.budget is not None and self.consumed > self.budget:
@@ -164,6 +170,10 @@ class SpillableRuns:
         self._frozen = None
         self.buf_bytes = 0
         self.tracker.release(freed)
+        from tidb_tpu.utils.metrics import SPILL_BYTES, SPILL_TOTAL
+
+        SPILL_TOTAL.inc()
+        SPILL_BYTES.inc(freed)
         return freed
 
     @property

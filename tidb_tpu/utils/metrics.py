@@ -127,9 +127,11 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
                 acc = 0
                 for b, c in zip(m.buckets, counts):
                     acc += c
-                    out.append(f"{m.name}_bucket{_fmt_labels(labels, f'le=\"{b}\"')} {acc}")
+                    le = _fmt_labels(labels, f'le="{b}"')
+                    out.append(f"{m.name}_bucket{le} {acc}")
                 acc += counts[-1]
-                out.append(f"{m.name}_bucket{_fmt_labels(labels, 'le=\"+Inf\"')} {acc}")
+                le = _fmt_labels(labels, 'le="+Inf"')
+                out.append(f"{m.name}_bucket{le} {acc}")
                 out.append(f"{m.name}_sum{_fmt_labels(labels)} {total}")
                 out.append(f"{m.name}_count{_fmt_labels(labels)} {acc}")
         else:
@@ -154,3 +156,38 @@ FRAGMENT_DISPATCH = Counter("tidb_tpu_fragment_dispatch_total",
 EXTERNAL_AGG = Counter("tidb_tpu_external_agg_total",
                        "Key-range external aggregation merges (group "
                        "state exceeded the memory budget)")
+
+# -- distributed-execution telemetry (fragments, DCN, memory) ---------------
+# The engine-reported side of what bench.py used to measure externally:
+# per-dispatch accounting, fragment wall time, DCN traffic, and
+# memory-quota events all render on /metrics.
+
+DISPATCH_TOTAL = Counter(
+    "tidb_tpu_device_dispatch_total",
+    "Device round trips (kernel launches + transfers), by site — the "
+    "process-wide mirror of utils.dispatch's thread-local counter")
+FRAGMENT_SECONDS = Histogram(
+    "tidb_tpu_fragment_seconds",
+    "Wall time of one mesh-fragment dispatch, by kind (async dispatch: "
+    "measures launch + any synchronous trace/compile, not device busy)")
+FRAGMENT_COMPILE = Counter(
+    "tidb_tpu_fragment_compile_total",
+    "Fragment programs compiled from plan subtrees, by output kind")
+COLLECTIVE_MERGE_SECONDS = Histogram(
+    "tidb_tpu_collective_merge_seconds",
+    "Host-driven merge of per-shard collective (psum) states across "
+    "streamed fragment batches")
+DCN_BYTES = Counter(
+    "tidb_tpu_dcn_bytes_total",
+    "DCN tier wire traffic through this process, by direction")
+DCN_RTT = Histogram(
+    "tidb_tpu_dcn_rtt_seconds",
+    "Coordinator-observed round-trip time of one DCN worker call")
+MEM_QUOTA_ENGAGED = Counter(
+    "tidb_tpu_mem_quota_engaged_total",
+    "Queries whose host memory consumption crossed tidb_mem_quota_query "
+    "(spill or cancel followed)")
+SPILL_TOTAL = Counter(
+    "tidb_tpu_spill_total", "Operator-state spill events to tmp storage")
+SPILL_BYTES = Counter(
+    "tidb_tpu_spill_bytes_total", "Bytes shed to tmp storage by spills")
