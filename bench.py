@@ -363,6 +363,101 @@ def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
     return rows / best, vs, best, check
 
 
+def bench_plan_cache(extra):
+    """Plan-cache microbench: repeated point-SELECT and prepared-execute
+    loops, statements/sec cold (cache off / first-touch) vs warm
+    (cache-hit), plus the ENGINE-reported hit rate cross-checked loudly
+    against the loop's own accounting (the PR-1 dispatch-cross-check
+    pattern: the engine metric is the headline, the bench's local figure
+    must agree or the artifact says so)."""
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.catalog import Catalog
+    from tidb_tpu.utils import metrics as _M
+
+    n_rows, n_iter = 1000, 400
+    s = Session(catalog=Catalog())
+    s.execute("SET tidb_slow_log_threshold = 300000")
+    # an OLTP-realistic row: wide schema, secondary indexes, fresh
+    # stats — planning cost reflects real access-path selection, not a
+    # two-column toy
+    s.execute("CREATE TABLE pcb (id bigint, k bigint,"
+              " a bigint, b bigint, c bigint, d bigint, e bigint,"
+              " f bigint, primary key (id, k))")
+    s.execute("CREATE INDEX pcb_k ON pcb (k)")
+    s.execute("CREATE INDEX pcb_ab ON pcb (a, b)")
+    s.execute("INSERT INTO pcb VALUES "
+              + ",".join(f"({i},{i % 97},{i % 11},{i % 13},{i * 2},"
+                         f"{i * 3},{i * 5},{i * 7})" for i in range(n_rows)))
+    s.execute("ANALYZE TABLE pcb")
+    # sysbench-style composite-key point read: access-path selection
+    # works over three indexes, the probe pins both key columns
+    point = "select c, d, e, f from pcb where id = %d and k = %d"
+    out = {"iters": n_iter}
+
+    def args(i):
+        return i % n_rows, (i % n_rows) % 97
+
+    def loop_text(n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            s.query(point % args(i))
+        return n / (time.perf_counter() - t0)
+
+    def loop_prepared(sid, n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            s.execute_prepared(sid, list(args(i)))
+        return n / (time.perf_counter() - t0)
+
+    # cold: full parse+plan per statement (non-prepared cache is off by
+    # default, so this is the engine's pre-cache statement path)
+    s.query(point % args(0))  # jit warmup out of band
+    out["cold_stmts_per_sec"] = round(loop_text(n_iter), 1)
+
+    # warm prepared: one fill execution, then the loop runs on cache hits
+    sid, _ = s.prepare(
+        "select c, d, e, f from pcb where id = ? and k = ?")
+    s.execute_prepared(sid, list(args(0)))  # fill (miss pays the verify)
+    h0 = s.catalog.plan_cache.hits
+    m0 = _M.PLAN_CACHE_TOTAL.value(event="hit")
+    out["warm_prepared_stmts_per_sec"] = round(loop_prepared(sid, n_iter), 1)
+    eng_hits = _M.PLAN_CACHE_TOTAL.value(event="hit") - m0
+    local_hits = s.catalog.plan_cache.hits - h0
+    out["hit_rate"] = round(eng_hits / n_iter, 4)
+    if eng_hits != local_hits:
+        out["hit_crosscheck"] = (
+            f"MISMATCH: engine metric says {eng_hits}, cache-object "
+            f"accounting says {local_hits}")
+        log(f"# PLAN-CACHE CROSS-CHECK MISMATCH: metric={eng_hits} "
+            f"cache={local_hits}")
+    # the summary table must tell the same story per digest
+    rows = s.query(
+        "select exec_count, plan_cache_hits from"
+        " information_schema.statements_summary where digest_text ="
+        " 'select c , d , e , f from pcb where id = ? and k = ?'")
+    summ_hits = rows[0][1] if rows else -1
+    if rows and summ_hits != local_hits:
+        out["summary_crosscheck"] = (
+            f"MISMATCH: statements_summary says {summ_hits}, cache "
+            f"says {local_hits}")
+        log(f"# PLAN-CACHE SUMMARY CROSS-CHECK MISMATCH: "
+            f"summary={summ_hits} cache={local_hits}")
+
+    # warm non-prepared: text statements through the opt-in cache
+    s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+    s.query(point % args(0))  # fill
+    out["warm_text_stmts_per_sec"] = round(loop_text(n_iter), 1)
+    s.execute("SET tidb_enable_non_prepared_plan_cache = 0")
+
+    out["warm_over_cold"] = round(
+        out["warm_prepared_stmts_per_sec"]
+        / max(out["cold_stmts_per_sec"], 1e-9), 3)
+    log(f"# plan cache: cold={out['cold_stmts_per_sec']}/s warm_prep="
+        f"{out['warm_prepared_stmts_per_sec']}/s warm_text="
+        f"{out['warm_text_stmts_per_sec']}/s hit_rate={out['hit_rate']}")
+    return out
+
+
 def main(locked_detail=("acquired", "acquired")):
     extra = {}
     extra["chip_lock"] = locked_detail[1]
@@ -456,6 +551,14 @@ def main(locked_detail=("acquired", "acquired")):
             extra["join_check"] = check
     except Exception as e:  # noqa: BLE001
         extra["join_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # plan-cache microbench: the OLTP statement path (host-only; no mesh
+    # or sqlite involvement — the win being measured is Python planning)
+    try:
+        log("# plan cache microbench")
+        extra["plan_cache"] = bench_plan_cache(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["plan_cache_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # release the SF1 working set before the join-heavy configs: keeping
     # gigabytes of prior sessions resident measurably slows the numpy/

@@ -85,6 +85,23 @@ def main():
               "where o_totalprice > 100000")
         measured["join_rows_per_sec"] = round(rows / best_of(jq), 1)
 
+        # plan-cache FIXED floors (not PERF_FLOOR.json bands): a change
+        # that silently disables the cache must fail loudly. The ratio
+        # is self-relative (cold and warm run back to back), so it is
+        # robust to absolute machine speed. Best-of-3 absorbs jitter.
+        pc_ratio, pc_hit = 0.0, 0.0
+        for _ in range(3):
+            pc = bench.bench_plan_cache({})
+            pc_ratio = max(pc_ratio, pc["warm_over_cold"])
+            pc_hit = max(pc_hit, pc["hit_rate"])
+        print(f"plan_cache_warm_over_cold {pc_ratio}  (need >= 3.0)")
+        print(f"plan_cache_hit_rate      {pc_hit}  (need >= 0.9)")
+        pc_bad = []
+        if pc_ratio < 3.0:
+            pc_bad.append(f"plan_cache_warm_over_cold={pc_ratio} < 3.0")
+        if pc_hit < 0.9:
+            pc_bad.append(f"plan_cache_hit_rate={pc_hit} < 0.9")
+
         load1 = bench.machine_load()
         busy_after = load1["loadavg"][0] > BUSY_LOAD or load1.get("busy_procs")
 
@@ -109,7 +126,7 @@ def main():
         if floors is None:
             print(f"INCONCLUSIVE: no committed floor for platform {plat_key}")
             sys.exit(2)
-        bad = []
+        bad = list(pc_bad)
         for k, floor in floors["floors"].items():
             got = measured.get(k, 0.0)
             need = floor * (1 - TOLERANCE)

@@ -1,0 +1,460 @@
+"""Digest-keyed plan cache: reuse, parameter rebinding, invalidation
+(DDL / ANALYZE / stats churn), cacheability gating, and the
+observability surfaces (@@last_plan_from_cache, statements_summary,
+/plan_cache, metrics)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage.catalog import Catalog
+from tidb_tpu.utils import metrics as M
+
+
+def _mk(rows=64):
+    s = Session(catalog=Catalog())
+    s.execute("CREATE TABLE pc (id bigint primary key, v bigint,"
+              " name varchar(20))")
+    s.execute("INSERT INTO pc VALUES "
+              + ",".join(f"({i},{i * 10},'n{i}')" for i in range(rows)))
+    return s
+
+
+def _lp(s):
+    return bool(s.sysvars.get("last_plan_from_cache"))
+
+
+class TestPreparedReuse:
+    def test_different_params_reuse_plan_with_correct_results(self):
+        s = _mk()
+        sid, n = s.prepare("select v from pc where id = ?")
+        assert n == 1
+        assert s.execute_prepared(sid, [3]).rows == [(30,)]
+        assert not _lp(s)  # first execution fills the cache
+        h0 = s.catalog.plan_cache.hits
+        assert s.execute_prepared(sid, [7]).rows == [(70,)]
+        assert _lp(s)
+        assert s.execute_prepared(sid, [11]).rows == [(110,)]
+        assert _lp(s)
+        assert s.catalog.plan_cache.hits == h0 + 2
+
+    def test_last_plan_from_cache_readable_via_select(self):
+        s = _mk()
+        sid, _ = s.prepare("select v from pc where id = ?")
+        s.execute_prepared(sid, [1])
+        s.execute_prepared(sid, [2])
+        # @@ substitution happens before this SELECT re-plans, so it
+        # reports the PREVIOUS statement — the prepared hit
+        assert s.query("select @@last_plan_from_cache") == [(1,)]
+
+    def test_prepared_and_text_share_a_digest_entry(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        assert s.query("select v from pc where id = 5") == [(50,)]
+        sid, _ = s.prepare("select v from pc where id = ?")
+        # '?' markers normalize exactly like literals: same digest, hit
+        assert s.execute_prepared(sid, [6]).rows == [(60,)]
+        assert _lp(s)
+
+    def test_no_mutated_ast_leak_across_executions(self):
+        # guards the no-mutation contract: a cached plan rebound twice
+        # must not bleed the first params into the second execution
+        s = _mk()
+        sid, _ = s.prepare(
+            "select id from pc where id in (?, ?) order by id")
+        assert s.execute_prepared(sid, [1, 2]).rows == [(1,), (2,)]
+        assert s.execute_prepared(sid, [3, 4]).rows == [(3,), (4,)]
+        assert _lp(s)
+        # and the original still answers correctly after the rebind
+        assert s.execute_prepared(sid, [1, 2]).rows == [(1,), (2,)]
+
+
+class TestNonPrepared:
+    def test_disabled_by_default(self):
+        s = _mk()
+        s.query("select v from pc where id = 1")
+        s.query("select v from pc where id = 2")
+        assert not _lp(s)
+
+    def test_enabled_hits_with_new_literals(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        assert s.query("select v from pc where id = 1") == [(10,)]
+        assert s.query("select v from pc where id = 2") == [(20,)]
+        assert _lp(s)
+        assert s.query(
+            "select id from pc where id between 10 and 12 order by id"
+            " limit 2") == [(10,), (11,)]
+        assert s.query(
+            "select id from pc where id between 20 and 30 order by id"
+            " limit 3") == [(20,), (21,), (22,)]
+        assert _lp(s)
+
+    def test_toggling_enable_off_bypasses(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        s.query("select v from pc where id = 1")
+        s.query("select v from pc where id = 2")
+        assert _lp(s)
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 0")
+        s.query("select v from pc where id = 3")
+        assert not _lp(s)
+
+    def test_prepared_enable_off_bypasses(self):
+        s = _mk()
+        s.execute("SET tidb_enable_prepared_plan_cache = 0")
+        sid, _ = s.prepare("select v from pc where id = ?")
+        s.execute_prepared(sid, [1])
+        s.execute_prepared(sid, [2])
+        assert not _lp(s)
+
+
+class TestInvalidation:
+    def _warm(self, s):
+        sid, _ = s.prepare("select v from pc where id = ?")
+        s.execute_prepared(sid, [1])
+        s.execute_prepared(sid, [2])
+        assert _lp(s)
+        return sid
+
+    def test_alter_table_evicts(self):
+        s = _mk()
+        sid = self._warm(s)
+        s.execute("ALTER TABLE pc ADD COLUMN extra bigint")
+        assert s.execute_prepared(sid, [3]).rows == [(30,)]
+        assert not _lp(s)  # schema_version bump cleared the cache
+        s.execute_prepared(sid, [4])
+        assert _lp(s)
+
+    def test_drop_create_table_evicts(self):
+        s = _mk()
+        self._warm(s)
+        s.execute("DROP TABLE pc")
+        s.execute("CREATE TABLE pc (id bigint primary key, v bigint)")
+        s.execute("INSERT INTO pc VALUES (1, 111)")
+        # the fresh same-named table must not serve the stale plan
+        assert s.query("select v from pc where id = 1") == [(111,)]
+
+    def test_create_index_evicts(self):
+        s = _mk()
+        sid = self._warm(s)
+        s.execute("CREATE INDEX ix_v ON pc (v)")
+        s.execute_prepared(sid, [5])
+        assert not _lp(s)
+
+    def test_analyze_evicts(self):
+        s = _mk()
+        sid = self._warm(s)
+        s.execute("ANALYZE TABLE pc")
+        assert s.execute_prepared(sid, [3]).rows == [(30,)]
+        assert not _lp(s)  # new stats object invalidated the entry
+        s.execute_prepared(sid, [4])
+        assert _lp(s)
+
+    def test_dml_after_analyze_invalidates_once(self):
+        s = _mk()
+        s.execute("ANALYZE TABLE pc")
+        sid = self._warm(s)
+        s.execute("INSERT INTO pc VALUES (100, 1000, 'x')")
+        assert s.execute_prepared(sid, [100]).rows == [(1000,)]
+        assert not _lp(s)  # freshness flipped: fresh -> stale
+        assert s.execute_prepared(sid, [100]).rows == [(1000,)]
+        assert _lp(s)  # stale is a stable state
+
+
+class TestCacheabilityGates:
+    def test_plan_time_subquery_stays_fresh(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        q = "select id from pc where v = (select max(v) from pc)"
+        first = s.query(q)
+        assert not _lp(s)
+        s.execute("INSERT INTO pc VALUES (500, 99999, 'big')")
+        assert s.query(q) == [(500,)]
+        assert not _lp(s)
+        assert first != [(500,)]
+
+    def test_string_predicates_not_cached_but_correct(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        assert s.query("select id from pc where name = 'n3'") == [(3,)]
+        assert s.query("select id from pc where name = 'n7'") == [(7,)]
+        assert not _lp(s)
+
+    def test_locking_reads_bypass(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        s.execute("BEGIN")
+        assert s.query("select v from pc where id = 1 for update") == [(10,)]
+        assert not _lp(s)
+        s.execute("COMMIT")
+
+    def test_volatile_builtin_bypasses(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        q = ("select count(*) from pc where id >= 0"
+             " and now() > '2000-01-01'")
+        s.query(q)
+        s.query(q)
+        assert not _lp(s)
+
+    def test_information_schema_stays_fresh(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        q = "select count(*) from information_schema.tables"
+        (n1,), = s.query(q)
+        s.execute("CREATE TABLE extra_t (a bigint)")
+        (n2,), = s.query(q)
+        assert n2 == n1 + 1
+
+    def test_foldable_param_context_never_caches(self):
+        # abs(?) folds to a value that is identity on non-negative
+        # samples; patching a later negative param raw into the folded
+        # slot would flip the predicate. The foldable-context gate must
+        # refuse the statement outright.
+        s = _mk()
+        s.execute("CREATE TABLE fx (id bigint primary key, x bigint)")
+        s.execute("INSERT INTO fx VALUES (1,-10),(2,0),(3,5),(4,10)")
+        sid, _ = s.prepare("select id from fx where x > abs(?)")
+        assert s.execute_prepared(sid, [5]).rows == [(4,)]
+        assert s.execute_prepared(sid, [-7]).rows == [(4,)]  # abs(-7)=7
+        assert not _lp(s)
+        sid2, _ = s.prepare("select id from fx where x > greatest(?, 3)")
+        assert s.execute_prepared(sid2, [5]).rows == [(4,)]
+        assert s.execute_prepared(sid2, [-99]).rows == [(3,), (4,)]
+        assert not _lp(s)
+
+    def test_temp_table_recreate_never_serves_old_plan(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        s.execute("CREATE TEMPORARY TABLE tt (id bigint, v bigint)")
+        s.execute("INSERT INTO tt VALUES (1, 111)")
+        assert s.query("select v from tt where id = 1") == [(111,)]
+        assert s.query("select v from tt where id = 1") == [(111,)]
+        s.execute("DROP TABLE tt")
+        s.execute("CREATE TEMPORARY TABLE tt (id bigint, v bigint)")
+        s.execute("INSERT INTO tt VALUES (1, 999)")
+        assert s.query("select v from tt where id = 1") == [(999,)]
+
+    def test_ddl_releases_cached_plans_eagerly(self):
+        # entries pin table objects; the schema_version setter must
+        # clear the cache at the DDL itself, not at the next probe
+        s = _mk()
+        sid, _ = s.prepare("select v from pc where id = ?")
+        s.execute_prepared(sid, [1])
+        assert len(s.catalog.plan_cache) == 1
+        s.execute("DROP TABLE pc")
+        assert len(s.catalog.plan_cache) == 0
+
+    def test_temp_table_shadowing_is_safe(self):
+        cat = Catalog()
+        a = Session(catalog=cat)
+        a.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        a.execute("CREATE TABLE sh (a bigint)")
+        a.execute("INSERT INTO sh VALUES (1)")
+        a.query("select a from sh")
+        a.query("select a from sh")
+        assert _lp(a)
+        # shadowing temp table must be read, not the cached permanent plan
+        a.execute("CREATE TEMPORARY TABLE sh (a bigint)")
+        a.execute("INSERT INTO sh VALUES (42)")
+        assert a.query("select a from sh") == [(42,)]
+
+    def test_sessions_share_the_instance_cache(self):
+        cat = Catalog()
+        a = Session(catalog=cat)
+        a.execute("CREATE TABLE shared (id bigint primary key, v bigint)")
+        a.execute("INSERT INTO shared VALUES (1, 10), (2, 20)")
+        sid, _ = a.prepare("select v from shared where id = ?")
+        a.execute_prepared(sid, [1])
+        b = Session(catalog=cat)
+        sid_b, _ = b.prepare("select v from shared where id = ?")
+        assert b.execute_prepared(sid_b, [2]).rows == [(20,)]
+        assert _lp(b)  # session B hit session A's entry
+
+
+class TestObservability:
+    def test_statements_summary_columns(self):
+        s = _mk()
+        sid, _ = s.prepare("select v from pc where id = ?")
+        for k in range(4):
+            s.execute_prepared(sid, [k])
+        rows = s.query(
+            "select exec_count, plan_cache_hits, sum_plan_latency from"
+            " information_schema.statements_summary where digest_text ="
+            " 'select v from pc where id = ?'")
+        assert rows, "digest missing from statements_summary"
+        n, hits, plan_lat = rows[0]
+        assert n == 4 and hits == 3  # first execution is the miss
+        assert plan_lat > 0
+
+    def test_metrics_counters(self):
+        s = _mk()
+        h0 = M.PLAN_CACHE_TOTAL.value(event="hit")
+        m0 = M.PLAN_CACHE_TOTAL.value(event="miss")
+        sid, _ = s.prepare("select v from pc where id = ?")
+        s.execute_prepared(sid, [1])
+        s.execute_prepared(sid, [2])
+        s.execute_prepared(sid, [3])
+        assert M.PLAN_CACHE_TOTAL.value(event="miss") >= m0 + 1
+        assert M.PLAN_CACHE_TOTAL.value(event="hit") == h0 + 2
+        assert M.PLAN_SECONDS.count() > 0
+        assert M.PARSE_SECONDS.count() > 0
+
+    def test_eviction_counted_under_tiny_capacity(self):
+        s = _mk()
+        s.execute("SET GLOBAL tidb_prepared_plan_cache_size = 2")
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        for k in range(6):  # distinct aliases -> distinct digests
+            s.query(f"select v as col{k} from pc where id = 1")
+        assert len(s.catalog.plan_cache) <= 2
+        assert s.catalog.plan_cache.evictions > 0
+        s.execute("SET GLOBAL tidb_prepared_plan_cache_size = 256")
+
+    def test_plan_cache_endpoint_consistent_with_engine(self):
+        from tidb_tpu.server.server import Server
+
+        cat = Catalog()
+        s = Session(catalog=cat)
+        s.execute("CREATE TABLE ep (id bigint primary key, v bigint)")
+        s.execute("INSERT INTO ep VALUES (1, 10), (2, 20)")
+        sid, _ = s.prepare("select v from ep where id = ?")
+        s.execute_prepared(sid, [1])
+        s.execute_prepared(sid, [2])
+        s.execute_prepared(sid, [1])
+        srv = Server(catalog=cat, port=0, status_port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.status_port}"
+            body = json.loads(
+                urllib.request.urlopen(base + "/plan_cache").read())
+            assert body["hits"] == cat.plan_cache.hits == 2
+            assert body["misses"] == cat.plan_cache.misses
+            assert body["size"] >= 1
+            ent = body["entries"][0]
+            assert ent["cacheable"] and ent["hits"] == 2
+            # and the summary's per-digest figure agrees
+            rows = s.query(
+                "select plan_cache_hits from"
+                " information_schema.statements_summary where digest_text"
+                " = 'select v from ep where id = ?'")
+            assert rows[0][0] == body["hits"]
+        finally:
+            srv.stop()
+
+    def test_global_only_capacity_var(self):
+        s = _mk()
+        with pytest.raises(Exception, match="GLOBAL"):
+            s.execute("SET tidb_prepared_plan_cache_size = 4")
+
+
+class TestSlotOrderInvariants:
+    """analyze_statement, analyze_template and transform_literals must
+    agree on literal-slot order — the patch map is positional."""
+
+    SQL = ("select id, v from pc where id in (1, 2) and v between 3 and 4"
+           " and name = 'x' union all select id, v from pc where id = 7"
+           " order by 1 limit 5 offset 6")
+
+    def test_transform_order_matches_analysis(self):
+        from tidb_tpu.parser import parse
+        from tidb_tpu.planner import plancache as pc
+
+        stmt = parse(self.SQL)[0]
+        info = pc.analyze_statement(stmt)
+        seen = []
+        pc.transform_literals(stmt, lambda v: (seen.append(v), v)[1])
+        assert seen == info.params
+        assert len(info.params) == 9  # 1,2,3,4,'x',7, ordinal 1, 5, 6
+
+    def test_template_analysis_matches_substituted(self):
+        from tidb_tpu.parser import parse
+        from tidb_tpu.planner import plancache as pc
+        from tidb_tpu.session.session import _sub_params
+
+        sql = ("select v from pc where id = ? and v in (?, 9)"
+               " and name = ? limit 2")
+        stmt = parse(sql)[0]
+        tinfo = pc.analyze_template(stmt)
+        params = [5, 7, "abc"]
+        fast = pc.bind_template_params(tinfo, params)
+        slow = pc.analyze_statement(_sub_params(stmt, params))
+        assert fast.params == slow.params
+        assert fast.kinds == slow.kinds
+        assert fast.struct == slow.struct
+
+
+class TestCorrectnessUnderReuse:
+    def test_join_reuse_with_shifting_params(self):
+        s = _mk()
+        s.execute("CREATE TABLE o (oid bigint primary key, tid bigint,"
+                  " amt bigint)")
+        s.execute("INSERT INTO o VALUES "
+                  + ",".join(f"({i},{i % 8},{i * 7})" for i in range(64)))
+        sid, _ = s.prepare(
+            "select pc.id, sum(o.amt) as sa from pc join o on pc.id ="
+            " o.tid where pc.id < ? group by pc.id order by pc.id")
+        full = s.execute_prepared(sid, [8]).rows
+        assert len(full) == 8
+        part = s.execute_prepared(sid, [3]).rows
+        assert _lp(s)
+        assert part == full[:3]
+
+    def test_aggregate_reuse_zero_params_exact(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        q = "select count(*), sum(v) from pc"
+        a = s.query(q)
+        b = s.query(q)
+        assert a == b and _lp(s)
+        s.execute("INSERT INTO pc VALUES (900, 9000, 'z')")
+        c = s.query(q)  # DML must be visible through a (re)used plan
+        assert c[0][0] == a[0][0] + 1
+
+    def test_union_reuse(self):
+        s = _mk()
+        s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+        q = ("select id from pc where id = %d union all"
+             " select id from pc where id = %d order by id")
+        assert s.query(q % (1, 2)) == [(1,), (2,)]
+        assert s.query(q % (5, 9)) == [(5,), (9,)]
+        assert _lp(s)
+
+    def test_covered_pointget_never_rebinds_uncovered(self):
+        # adversarial interplay of cond_covered and rebinding: filled
+        # with equal params the plan's probe subsumes the filter; a
+        # rebind to unequal params would silently skip the residual.
+        # The sentinel pass must refuse to cache this shape.
+        s = _mk()
+        sid, _ = s.prepare("select v from pc where id = ? and id = ?")
+        assert s.execute_prepared(sid, [5, 5]).rows == [(50,)]
+        assert s.execute_prepared(sid, [5, 6]).rows == []
+        assert s.execute_prepared(sid, [6, 6]).rows == [(60,)]
+        assert not _lp(s)
+        ent = next(iter(s.catalog.plan_cache._od.values()))
+        assert ent.patches is None and ent.reason
+
+    def test_point_get_plan_is_reused(self):
+        # the OLTP shape the cache exists for: the cached plan is a
+        # PointGet and rebinding patches its key
+        s = _mk()
+        sid, _ = s.prepare("select v from pc where id = ?")
+        s.execute_prepared(sid, [1])
+        entry = next(iter(s.catalog.plan_cache._od.values()))
+        from tidb_tpu.planner.physical import PPointGet
+
+        def find_pg(p):
+            if isinstance(p, PPointGet):
+                return p
+            for c in p.children:
+                r = find_pg(c)
+                if r is not None:
+                    return r
+            return None
+
+        assert find_pg(entry.phys) is not None
+        assert entry.patches  # parameter slots were verified
+        assert s.execute_prepared(sid, [9]).rows == [(90,)]
+        assert _lp(s)

@@ -63,6 +63,10 @@ class BindHandle:
     def __init__(self, scope: str):
         self.scope = scope
         self._by_norm: Dict[str, Binding] = {}
+        # bumped on every create/drop; the plan cache keys on it so a
+        # binding change can never serve a stale (differently-hinted)
+        # cached plan
+        self.version = 0
 
     def create(self, target_sql: str, using_sql: str) -> None:
         from tidb_tpu.parser import parse
@@ -71,9 +75,13 @@ class BindHandle:
         stmts = parse(using_sql)
         stmt = stmts[0] if len(stmts) == 1 else None
         self._by_norm[norm] = Binding(target_sql, using_sql, self.scope, stmt=stmt)
+        self.version += 1
 
     def drop(self, target_sql: str) -> bool:
-        return self._by_norm.pop(normalize_sql(target_sql), None) is not None
+        hit = self._by_norm.pop(normalize_sql(target_sql), None) is not None
+        if hit:
+            self.version += 1
+        return hit
 
     def match(self, norm: str) -> Optional[Binding]:
         b = self._by_norm.get(norm)

@@ -75,7 +75,12 @@ def build_executor(plan: PhysicalPlan) -> Executor:
         return PointGetExec(
             schema=base.schema,
             table=base.table,
-            stages=scan_stages_for(base, stages),
+            # a key-covered filter is subsumed by the unique-index probe
+            # itself; only this single-chip point path may skip it — the
+            # dist tier treats PPointGet as a plain scan and still needs
+            # the pushed filter
+            stages=(stages if base.cond_covered
+                    else scan_stages_for(base, stages)),
             index_name=base.index_name,
             key_values=base.key_values,
             out_schema=plan.schema,

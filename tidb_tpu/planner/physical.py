@@ -81,13 +81,18 @@ class PPointGet(PScan):
 
     index_name: str = ""
     key_values: Tuple = ()
+    # the pushed filter is EXACTLY the key equalities: the unique-index
+    # probe already enforces it, so the executor skips the residual
+    # evaluation (ref: PointGetExecutor reads by key, no Selection)
+    cond_covered: bool = False
 
     def op_name(self):
         return "PointGet"
 
     def op_info(self):
         return (f"table:{self.table_name}, index:{self.index_name}, "
-                f"key:{tuple(self.key_values)!r}")
+                f"key:{tuple(self.key_values)!r}"
+                + (", key_only" if self.cond_covered else ""))
 
 
 @dataclass
@@ -220,6 +225,34 @@ def inject_point_get(plan: PhysicalPlan) -> PhysicalPlan:
         visit(cond)
         return eqs, los, his
 
+    def cond_covered_by_key(cond, key_cols, eqs, uid_to_col):
+        """True when EVERY conjunct of the pushed filter is an integer
+        equality on a key column matching the probe value — then the
+        unique-index lookup subsumes the filter and the executor can
+        skip the residual evaluation. A conjunct on a key column with a
+        DIFFERENT value (`a = 5 AND a = 6`) fails the check, and the
+        plan cache's sentinel diff turns the same situation with
+        parameters (`a = ? AND a = ?`) into a shape change, so a
+        covered plan can never be rebound into an uncovered one."""
+        keyset = set(key_cols)
+
+        def ok(e):
+            if isinstance(e, Call) and e.op == "and":
+                return all(ok(a) for a in e.args)
+            if not (isinstance(e, Call) and e.op == "eq"
+                    and len(e.args) == 2):
+                return False
+            a, b = e.args
+            if isinstance(a, Literal):
+                a, b = b, a
+            hit = _int_col_lit(a, b, uid_to_col)
+            if hit is None:
+                return False
+            col, lit = hit
+            return col.name in keyset and int(lit.value) == eqs.get(col.name)
+
+        return ok(cond)
+
     def best_access(node):
         uid_to_col = {c.uid: c for c in node.schema}
         eqs, los, his = collect_bounds(node.pushed_cond, uid_to_col)
@@ -246,7 +279,9 @@ def inject_point_get(plan: PhysicalPlan) -> PhysicalPlan:
                     schema=node.schema, est_rows=1.0, db=node.db,
                     table_name=node.table_name, table=node.table,
                     pushed_cond=node.pushed_cond,
-                    index_name=idx.name, key_values=tuple(prefix)))
+                    index_name=idx.name, key_values=tuple(prefix),
+                    cond_covered=cond_covered_by_key(
+                        node.pushed_cond, idx.columns, eqs, uid_to_col)))
             # range access: eq prefix plus optional interval on the
             # next key column
             lo = hi = None

@@ -225,7 +225,7 @@ class Server:
             if ent is None:
                 P.write_packet(conn, 1, P.err_packet(1243, f"unknown statement {stmt_id}"))
                 return
-            _, n_params, _sql = ent
+            n_params = ent[1]
             # param types arrive only on the first execute; cache them
             # per statement for re-executions (per protocol)
             if not hasattr(sess, "_stmt_types"):

@@ -7,6 +7,8 @@ Endpoints:
     /schema      - JSON: databases -> tables -> row counts
     /statements  - JSON: top-N statement digests by cumulative latency
                    (?top=N, default 50) from the statements-summary store
+    /plan_cache  - JSON: plan-cache hit/miss/bypass/evict/invalidate
+                   totals plus per-entry digests (?top=N, default 50)
 """
 
 from __future__ import annotations
@@ -64,6 +66,18 @@ class StatusServer:
                                 outer.catalog.stmt_summary.top(top),
                             "evicted": outer.catalog.stmt_summary.evicted,
                         }).encode()
+                        ctype = "application/json"
+                    elif self.path == "/plan_cache" or \
+                            self.path.startswith("/plan_cache?"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        q = parse_qs(urlparse(self.path).query)
+                        try:
+                            top = int(q.get("top", ["50"])[0])
+                        except ValueError:
+                            top = 50
+                        body = json.dumps(
+                            outer.catalog.plan_cache.stats_dict(top)).encode()
                         ctype = "application/json"
                     elif self.path == "/schema":
                         # snapshot under the catalog lock: concurrent DDL

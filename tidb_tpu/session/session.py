@@ -303,6 +303,24 @@ class Session:
         # SELECT's plan digest
         self._stmt_trackers: list = []
         self._last_plan_digest: Optional[str] = None
+        # plan-cache per-statement context: is the current statement a
+        # prepared execution (picks the enable sysvar), did its plan
+        # come from the cache, and the plan-acquisition wall time
+        self._exec_prepared = False
+        self._plan_from_cache_stmt = False
+        self._stmt_plan_s = 0.0
+        # (source, normalized, digest) computed by the plan-cache probe,
+        # reused by _record_stmt so the hot path lexes the text once
+        self._stmt_digest_memo = None
+        # prepare-time (sql, norm, digest, StmtInfo) for the current
+        # prepared execution: the probe skips lexing + AST analysis
+        self._ps_ctx = None
+        # deferred parameter binding: on the prepared SELECT hot path
+        # the template AST flows through unchanged (a cache hit never
+        # reads it); any path that actually PLANS materializes the
+        # bound AST through _materialize_stmt first
+        self._ps_params = None
+        self._ps_materialized = None
         self._killed = False       # KILL <id>: connection is dead
         self._kill_query = False   # KILL QUERY <id>: one-shot cancel
         # diagnostics area for SHOW WARNINGS (cleared per statement)
@@ -470,8 +488,15 @@ class Session:
 
     def execute(self, sql: str) -> Optional[ResultSet]:
         """Execute one or more statements; returns the last result set."""
+        import time as _time
+
+        from tidb_tpu.utils import metrics as M
+
+        t0 = _time.perf_counter()
+        stmts = parse(sql)
+        M.PARSE_SECONDS.observe(_time.perf_counter() - t0)
         result = None
-        for stmt in parse(sql):
+        for stmt in stmts:
             result = self._execute_timed(stmt, sql)
         return result
 
@@ -513,6 +538,9 @@ class Session:
             ctx = jax.profiler.trace(prof_dir)
         self._stmt_trackers = []
         self._last_plan_digest = None
+        self._plan_from_cache_stmt = False
+        self._stmt_plan_s = 0.0
+        self._stmt_digest_memo = None
         d0 = _dsp.count()
         f0 = _dsp.by_site().get("fragment", 0)
         t0 = _time.perf_counter()
@@ -554,7 +582,10 @@ class Session:
 
         try:
             src = getattr(stmt, "_source", None) or sql
-            if len(src) > 16384:
+            memo = self._stmt_digest_memo
+            if memo is not None and memo[0] == src:
+                _, norm, digest = memo  # plan-cache probe already lexed
+            elif len(src) > 16384:
                 # bound the second lex: per-shape dedup matters for
                 # OLTP-sized statements, not megabyte bulk loads —
                 # those digest their raw text and keep a prefix
@@ -573,6 +604,8 @@ class Session:
                 max_mem=max_mem,
                 rows_sent=len(result.rows) if result is not None else 0,
                 dispatches=dispatches, fragments=fragments, error=error,
+                plan_from_cache=self._plan_from_cache_stmt,
+                plan_latency_s=self._stmt_plan_s,
                 max_stmt_count=int(
                     self.sysvars.get("tidb_stmt_summary_max_stmt_count")))
             return digest, max_mem, dispatches
@@ -682,19 +715,170 @@ class Session:
                 and bool(self.sysvars.get("tidb_enable_tpu_exec"))
                 and self._device_engine_auto())
 
-    def _plan_select(self, stmt, agg_push_down=None):
-        n_parts = 1
-        if self.mesh is not None:
-            n_parts = int(np.prod(list(self.mesh.shape.values())))
-        return plan_statement(
-            stmt, self.catalog, db=self.db, execute_subplan=self._execute_subplan,
+    def _n_parts(self) -> int:
+        if self.mesh is None:
+            return 1
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def _plan_select(self, stmt, agg_push_down=None, execute_subplan=None):
+        import time as _time
+
+        from tidb_tpu.utils import metrics as M
+
+        t0 = _time.perf_counter()
+        phys = plan_statement(
+            stmt, self.catalog, db=self.db,
+            execute_subplan=execute_subplan or self._execute_subplan,
             cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
-            n_parts=n_parts,
+            n_parts=self._n_parts(),
             session_info={"user": self.user,
                           "conn_id": getattr(self, "conn_id", 0)},
             agg_push_down=(self._agg_push_down() if agg_push_down is None
                            else agg_push_down),
         )
+        M.PLAN_SECONDS.observe(_time.perf_counter() - t0)
+        return phys
+
+    def _acquire_plan(self, stmt, agg_push_down=None):
+        """Physical plan for a SELECT/UNION, through the digest-keyed
+        plan cache when the statement is eligible (ref: planner/core
+        plan_cache*). Sets @@last_plan_from_cache and accumulates the
+        acquisition wall time for the statements summary."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return self._acquire_plan_inner(stmt, agg_push_down)
+        finally:
+            self._stmt_plan_s += _time.perf_counter() - t0
+
+    def _materialize_stmt(self, stmt):
+        """Bind deferred prepared parameters into `stmt` (identity memo:
+        the dist re-plan branch may plan the same statement twice, and
+        _apply_binding may hand over a hinted COPY of the template)."""
+        params = self._ps_params
+        if params is None:
+            return stmt
+        memo = self._ps_materialized
+        if memo is not None and memo[0] is stmt:
+            return memo[1]
+        src = getattr(stmt, "_source", None)
+        out = _sub_params(stmt, params)
+        if src is not None:
+            out._source = src
+        self._ps_materialized = (stmt, out)
+        return out
+
+    def _acquire_plan_inner(self, stmt, agg_push_down):
+        from tidb_tpu.planner import plancache as _pc
+
+        self._last_plan_digest = None  # _run_select hashes the fresh
+        # plan unless a cache hit installs the entry's memoized digest
+        self.sysvars.set("last_plan_from_cache", False, "session")
+        enabled = bool(self.sysvars.get(
+            "tidb_enable_prepared_plan_cache" if self._exec_prepared
+            else "tidb_enable_non_prepared_plan_cache"))
+        cache = getattr(self.catalog, "plan_cache", None)
+        if not enabled or cache is None:
+            return self._plan_select(self._materialize_stmt(stmt),
+                                     agg_push_down=agg_push_down)
+
+        def bypass(reason):
+            cache.note_bypass(reason)
+            return self._plan_select(self._materialize_stmt(stmt),
+                                     agg_push_down=agg_push_down)
+
+        if self._lock_read:
+            return bypass("locking read")
+        if getattr(stmt, "into_outfile", None) is not None:
+            return bypass("INTO OUTFILE")
+        # only parser-produced statements carry _source; synthetic ASTs
+        # (DML subselects, locking-read shadow scans) must never share a
+        # digest with the statement that spawned them
+        src = getattr(stmt, "_source", None)
+        if not src or len(src) > 16384:
+            return bypass("no normalizable source")
+        ps = self._ps_ctx
+        if ps is not None and ps[0] == src:
+            _, norm, digest, info = ps  # prepare-time analysis
+        else:
+            try:
+                info = _pc.analyze_statement(stmt)
+            except Exception:  # noqa: BLE001 — analysis is best-effort
+                return bypass("analysis failed")
+            from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+            norm = normalize_sql(src)
+            digest = sql_digest(norm)
+        if info.volatile:
+            return bypass(f"volatile builtin {info.volatile}()")
+        if info.unsafe:
+            # a literal inside a foldable expression (abs(?), ?+1, ...)
+            # can bake a derived value the patcher would overwrite with
+            # the raw parameter — refuse the whole statement
+            return bypass("literal in foldable expression context")
+        self._stmt_digest_memo = (src, norm, digest)
+        eff_apd = (self._agg_push_down() if agg_push_down is None
+                   else agg_push_down)
+        hints_fp = tuple((h, tuple(str(a) for a in args))
+                         for h, args in getattr(stmt, "hints", ()) or ())
+        key = (
+            digest, self.db, info.kinds, info.struct, hints_fp,
+            bool(self.sysvars.get("tidb_enable_cascades_planner")),
+            bool(eff_apd), self._n_parts(),
+            self._bindings.version, self.catalog.bind_handle.version,
+            # TEMPORARY tables shadow names without a schema_version
+            # bump: a session holding any gets private entries, re-keyed
+            # by the temp epoch so drop+recreate can never serve the old
+            # table object's plan
+            ((self.conn_id, getattr(self.catalog, "_temp_epoch", 0))
+             if getattr(self.catalog, "_temp", None) else 0),
+        )
+        sv = self.catalog.schema_version
+        cap = int(self.sysvars.get("tidb_prepared_plan_cache_size"))
+        entry = cache.lookup(key, sv, cap)
+        if entry is not None and entry.patches is None:
+            return bypass(entry.reason or "known uncacheable")
+        if entry is not None and entry.n_params == len(info.params):
+            try:
+                phys = _pc.instantiate(entry, info.params)
+            except Exception:  # noqa: BLE001 — fall back to planning
+                phys = None
+            if phys is not None:
+                cache.note_hit(entry)
+                self.sysvars.set("last_plan_from_cache", True, "session")
+                self._plan_from_cache_stmt = True
+                if not entry.plan_digest:
+                    import hashlib as _hl
+
+                    entry.plan_digest = _hl.sha256(
+                        explain_text(entry.phys).encode()).hexdigest()[:32]
+                self._last_plan_digest = entry.plan_digest
+                if self._n_parts() > 1:
+                    from tidb_tpu.planner.optimizer import _annotate_topn
+
+                    _annotate_topn(phys)  # re-derive on the patched tree
+                return phys
+        cache.note_miss()
+        used = [False]
+
+        def _sub(logical):
+            used[0] = True
+            return self._execute_subplan(logical)
+
+        stmt = self._materialize_stmt(stmt)
+        phys = self._plan_select(stmt, agg_push_down=agg_push_down,
+                                 execute_subplan=_sub)
+        try:
+            new = _pc.build_entry(
+                stmt, phys, info, digest, self.db, sv,
+                plan_sentinel=lambda s2: self._plan_select(
+                    s2, agg_push_down=agg_push_down, execute_subplan=_sub),
+                subplan_used=lambda: used[0])
+            cache.store(key, new, sv)
+        except Exception:  # noqa: BLE001 — the cache must never fail
+            pass          # (or slow-path-block) the statement
+        return phys
 
     def _apply_binding(self, stmt):
         """Plan-binding lookup (ref: bindinfo BindHandle): on a match of
@@ -720,7 +904,11 @@ class Session:
                 and b.stmt.hints):
             import dataclasses as _dc
 
-            return _dc.replace(stmt, hints=list(b.stmt.hints))
+            out = _dc.replace(stmt, hints=list(b.stmt.hints))
+            out._source = source  # replace() drops parser attrs; the
+            # plan cache keys on (digest, hints, binding versions), so
+            # a hinted copy is still safely distinguishable
+            return out
         return stmt
 
     def _targets_temp_table(self, stmt) -> bool:
@@ -856,7 +1044,7 @@ class Session:
     def _run_select(self, stmt) -> ResultSet:
         if self.txn is None and not self.sysvars.get("autocommit"):
             self._begin()  # consistent-snapshot reads without autocommit
-        phys = self._plan_select(stmt)
+        phys = self._acquire_plan(stmt)
         self._check_plan_privs(phys)
         root = self._build_root(phys)
         if self._dist_expected() and _has_eager_partial(phys) \
@@ -864,16 +1052,19 @@ class Session:
             # the eager-agg shape kept this plan off the mesh (the
             # fragment tier takes scan-rooted generic partials, not every
             # shape) — losing fragmentation costs more than the rewrite
-            # saves, so re-plan without it and keep the fragments
-            phys = self._plan_select(stmt, agg_push_down=False)
+            # saves, so re-plan without it and keep the fragments (the
+            # no-push variant caches under its own key)
+            phys = self._acquire_plan(stmt, agg_push_down=False)
             root = self._build_root(phys)
         # plan digest: hash of the plan's shape (explain text), paired
         # with the statement digest in statements_summary/slow log so a
-        # regressed plan choice is visible as a digest change
-        import hashlib as _hl
+        # regressed plan choice is visible as a digest change; a cache
+        # hit already set the entry's memoized digest
+        if self._last_plan_digest is None:
+            import hashlib as _hl
 
-        self._last_plan_digest = _hl.sha256(
-            explain_text(phys).encode()).hexdigest()[:32]
+            self._last_plan_digest = _hl.sha256(
+                explain_text(phys).encode()).hexdigest()[:32]
         n_vis = phys.n_visible if isinstance(phys, PProjection) else None
         if n_vis is None and hasattr(phys, "children") and phys.children:
             # Sort/Limit on top of the projection keep hidden sort columns
@@ -956,11 +1147,21 @@ class Session:
             stack.extend(getattr(node, "children", ()))
 
     def _execute_stmt(self, stmt) -> Optional[ResultSet]:
-        if not isinstance(stmt, A.SetStmt) and _ast_contains(stmt, A.EVar):
+        # textual fast-paths for the per-statement AST sweeps: the
+        # parser can only produce EVar / into_outfile nodes from the
+        # literal '@' / OUTFILE tokens, so sources without them skip
+        # the walk entirely (the OLTP hot path runs these per statement)
+        src_txt = getattr(stmt, "_source", None)
+        if (not isinstance(stmt, A.SetStmt)
+                and (src_txt is None or "@" in src_txt)
+                and _ast_contains(stmt, A.EVar)):
             stmt = self._sub_vars(stmt)
+            if src_txt is not None:
+                stmt._source = src_txt  # the rebuild drops parser attrs
         if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
             into = getattr(stmt, "into_outfile", None)
-            if _nested_into_outfile(stmt, top=stmt):
+            if ((src_txt is None or "outfile" in src_txt.lower())
+                    and _nested_into_outfile(stmt, top=stmt)):
                 raise UnsupportedError(
                     "INTO OUTFILE is only supported on a top-level SELECT")
             if into is not None:
@@ -1163,10 +1364,14 @@ class Session:
         if isinstance(stmt, A.CreateIndexStmt):
             t = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
             t.create_index(stmt.name, stmt.columns, unique=stmt.unique)
+            # index DDL changes access-path choices: cached plans built
+            # without (or with) this index must not survive it
+            self.catalog.schema_version += 1
             return None
         if isinstance(stmt, A.DropIndexStmt):
             t = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)
             t.drop_index(stmt.name)
+            self.catalog.schema_version += 1
             return None
         if isinstance(stmt, A.AlterTableStmt):
             return self._run_alter_table(stmt)
@@ -1218,28 +1423,80 @@ class Session:
 
     def prepare(self, sql: str) -> tuple:
         """Parse once, count placeholders. Returns (stmt_id, n_params)."""
+        import time as _time
+
+        from tidb_tpu.utils import metrics as M
+
+        t0 = _time.perf_counter()
         stmts = parse(sql)
+        M.PARSE_SECONDS.observe(_time.perf_counter() - t0)
         if len(stmts) != 1:
             raise UnsupportedError("PREPARE requires exactly one statement")
         stmt = stmts[0]
         n_params = _count_params(stmt)
+        # prepare-time plan-cache context: the normalized digest and the
+        # template's literal-slot analysis are value-independent, so the
+        # per-execution hot path never re-lexes or re-walks the AST
+        from tidb_tpu.bindinfo import normalize_sql, sql_digest
+        from tidb_tpu.planner import plancache as _pc
+
+        try:
+            norm = normalize_sql(sql) if len(sql) <= 16384 else None
+            digest = sql_digest(norm) if norm is not None else None
+            tinfo = _pc.analyze_template(stmt)
+        except Exception:  # noqa: BLE001 — fall back to per-exec analysis
+            norm = digest = tinfo = None
         self._stmt_id += 1
-        self._prepared[self._stmt_id] = (stmt, n_params, sql)
+        self._prepared[self._stmt_id] = (stmt, n_params, sql, norm, digest,
+                                         tinfo)
         return self._stmt_id, n_params
 
     def execute_prepared(self, stmt_id: int, params: list) -> Optional[ResultSet]:
         ent = self._prepared.get(stmt_id)
         if ent is None:
             raise ExecutionError(f"unknown prepared statement {stmt_id}")
-        stmt, n_params, sql = ent
+        stmt, n_params, sql, norm, digest, tinfo = ent
         if len(params) != n_params:
             raise ExecutionError(
                 f"prepared statement takes {n_params} params, got {len(params)}")
-        if n_params:
+        info = None
+        if tinfo is not None and digest is not None:
+            from tidb_tpu.planner import plancache as _pc
+
+            info = _pc.bind_template_params(tinfo, params)
+        # defer parameter substitution for plain SELECT/UNION templates
+        # when the fast probe context is available: a plan-cache hit
+        # executes without ever needing the bound AST, and every
+        # planning path materializes it via _materialize_stmt. Locking
+        # reads and DML consume literals outside the planner, so they
+        # always bind eagerly.
+        defer = (info is not None and n_params
+                 and isinstance(stmt, (A.SelectStmt, A.UnionStmt))
+                 and getattr(stmt, "lock_mode", None) is None
+                 and getattr(stmt, "into_outfile", None) is None
+                 and not (isinstance(stmt, A.UnionStmt) and any(
+                     getattr(arm, "lock_mode", None)
+                     for arm in _union_arms(stmt))))
+        if n_params and not defer:
             stmt = _sub_params(stmt, params)
+            # the rebuilt AST loses the parser's _source attr; restore
+            # it — the plan cache and statements summary digest it (the
+            # '?' markers normalize exactly like substituted literals)
+            stmt._source = sql
         # through the timed path: prepared executions must hit the same
         # metrics / slow-query log / profiler hooks as text queries
-        return self._execute_timed(stmt, sql)
+        self._exec_prepared = True
+        if info is not None:
+            self._ps_ctx = (sql, norm, digest, info)
+        if defer:
+            self._ps_params = params
+        try:
+            return self._execute_timed(stmt, sql)
+        finally:
+            self._exec_prepared = False
+            self._ps_ctx = None
+            self._ps_params = None
+            self._ps_materialized = None
 
     def close_prepared(self, stmt_id: int) -> None:
         self._prepared.pop(stmt_id, None)
@@ -1414,6 +1671,9 @@ class Session:
                 raise SchemaError(f"no CHECK constraint {stmt.old_name!r}")
         else:
             raise UnsupportedError(f"ALTER TABLE {stmt.action}")
+        # every completed ALTER advances the schema version (ref: one
+        # version per DDL job) — plan-cache invalidation hangs off it
+        self.catalog.schema_version += 1
         return None
 
     def _run_create_table(self, stmt: A.CreateTableStmt):

@@ -71,6 +71,18 @@ _reg(
     # session-local cap would evict other sessions' diagnostics
     SysVar("tidb_stmt_summary_max_stmt_count", 200, GLOBAL, "int",
            min_=1, max_=1 << 16),
+    # digest-keyed plan cache (ref: tidb_enable_prepared_plan_cache /
+    # the instance plan cache): prepared statements reuse verified plans
+    # by default; non-prepared SELECT reuse is opt-in like the reference
+    SysVar("tidb_enable_prepared_plan_cache", True, BOTH, "bool"),
+    SysVar("tidb_enable_non_prepared_plan_cache", False, BOTH, "bool"),
+    # LRU cap on the instance-wide plan cache; GLOBAL-only for the same
+    # reason as the statements-summary cap (one shared store)
+    SysVar("tidb_prepared_plan_cache_size", 256, GLOBAL, "int",
+           min_=1, max_=1 << 16),
+    # whether the previous SELECT's plan came from the plan cache
+    # (read via @@last_plan_from_cache, like the reference)
+    SysVar("last_plan_from_cache", False, SESSION, "bool"),
     # non-empty: wrap query execution in jax.profiler.trace(dir)
     SysVar("tidb_profile_dir", "", BOTH, "str"),
     # tables above this size stream through fixed [P,R] staging batches

@@ -82,6 +82,13 @@ class Catalog:
         from tidb_tpu.utils.stmtsummary import StmtSummary
 
         self.stmt_summary = StmtSummary()
+        # instance-wide digest-keyed plan cache (ref: the prepared plan
+        # cache + tidb_enable_non_prepared_plan_cache); sessions probe
+        # it from _run_select. Imported lazily: planner pulls in the
+        # whole optimizer stack at import time.
+        from tidb_tpu.planner.plancache import PlanCache
+
+        self.plan_cache = PlanCache()
         # live sessions for SHOW PROCESSLIST / KILL (ref: server/'s
         # connection registry); weak values — a dropped session vanishes
         import weakref
@@ -89,6 +96,20 @@ class Catalog:
         self.processes = weakref.WeakValueDictionary()
         self._conn_id = 0
         self._conn_id_lock = threading.Lock()
+
+    @property
+    def schema_version(self) -> int:
+        return self._schema_version
+
+    @schema_version.setter
+    def schema_version(self, v: int) -> None:
+        self._schema_version = int(v)
+        # eager plan-cache invalidation: entries pin table objects (and
+        # their column arrays), so waiting for the next cache probe
+        # would keep DROPped tables alive indefinitely
+        pc = getattr(self, "plan_cache", None)
+        if pc is not None:
+            pc.on_schema_change(self._schema_version)
 
     def processlist_rows(self, viewer_user=None, with_state=False):
         """Live-session rows for SHOW PROCESSLIST and
@@ -737,7 +758,8 @@ class Catalog:
                  ("p95_latency", FLOAT64), ("max_mem", INT64),
                  ("rows_sent", INT64), ("errors", INT64),
                  ("dispatches", INT64), ("fragments", INT64),
-                 ("first_seen", STRING), ("last_seen", STRING)],
+                 ("first_seen", STRING), ("last_seen", STRING),
+                 ("plan_cache_hits", INT64), ("sum_plan_latency", FLOAT64)],
                 self.stmt_summary.rows(),
             )
         if name == "statistics":
@@ -778,6 +800,11 @@ class SessionCatalog:
             base = base._base
         object.__setattr__(self, "_base", base)
         object.__setattr__(self, "_temp", {})  # (db, name) -> Table
+        # bumped on every temp create/drop: temp DDL never advances the
+        # shared schema_version, so the plan cache keys on this instead
+        # (a dropped-and-recreated temp table must never serve the old
+        # table object's cached plan)
+        object.__setattr__(self, "_temp_epoch", 0)
         object.__setattr__(self, "_viewer", None)  # weakref to Session
 
     def __getattr__(self, name):
@@ -826,14 +853,17 @@ class SessionCatalog:
         t = make_table(schema, engine)
         t.ts_source = self._base.next_ts
         self._temp[(db, schema.name)] = t
+        object.__setattr__(self, "_temp_epoch", self._temp_epoch + 1)
         return t
 
     def drop_table(self, db: str, name: str, if_exists: bool = False):
         if (db, name) in self._temp:
             del self._temp[(db, name)]
+            object.__setattr__(self, "_temp_epoch", self._temp_epoch + 1)
             return
         return self._base.drop_table(db, name, if_exists=if_exists)
 
     def drop_temp_tables(self) -> None:
         """Connection end: the whole temp namespace vanishes."""
         self._temp.clear()
+        object.__setattr__(self, "_temp_epoch", self._temp_epoch + 1)

@@ -76,6 +76,24 @@ class TypeKind(enum.Enum):
     NULL = "null"
 
 
+# device representation per kind, built once (np_dtype sits on the
+# per-chunk hot path; rebuilding the mapping per call measurably cost)
+_NP_DTYPES = {
+    TypeKind.INT: np.dtype(np.int64),
+    TypeKind.FLOAT: np.dtype(np.float64),
+    TypeKind.DECIMAL: np.dtype(np.int64),
+    TypeKind.STRING: np.dtype(np.int32),
+    TypeKind.DATE: np.dtype(np.int32),
+    TypeKind.DATETIME: np.dtype(np.int64),
+    TypeKind.TIME: np.dtype(np.int64),
+    TypeKind.ENUM: np.dtype(np.int32),
+    TypeKind.SET: np.dtype(np.int64),
+    TypeKind.JSON: np.dtype(np.int32),
+    TypeKind.BOOL: np.dtype(np.bool_),
+    TypeKind.NULL: np.dtype(np.bool_),
+}
+
+
 @dataclass(frozen=True)
 class SQLType:
     """Static (trace-time) type descriptor for a column or expression."""
@@ -89,20 +107,7 @@ class SQLType:
 
     @property
     def np_dtype(self) -> np.dtype:
-        return {
-            TypeKind.INT: np.dtype(np.int64),
-            TypeKind.FLOAT: np.dtype(np.float64),
-            TypeKind.DECIMAL: np.dtype(np.int64),
-            TypeKind.STRING: np.dtype(np.int32),
-            TypeKind.DATE: np.dtype(np.int32),
-            TypeKind.DATETIME: np.dtype(np.int64),
-            TypeKind.TIME: np.dtype(np.int64),
-            TypeKind.ENUM: np.dtype(np.int32),
-            TypeKind.SET: np.dtype(np.int64),
-            TypeKind.JSON: np.dtype(np.int32),
-            TypeKind.BOOL: np.dtype(np.bool_),
-            TypeKind.NULL: np.dtype(np.bool_),
-        }[self.kind]
+        return _NP_DTYPES[self.kind]
 
     @property
     def is_numeric(self) -> bool:

@@ -183,6 +183,18 @@ DCN_BYTES = Counter(
 DCN_RTT = Histogram(
     "tidb_tpu_dcn_rtt_seconds",
     "Coordinator-observed round-trip time of one DCN worker call")
+PLAN_CACHE_TOTAL = Counter(
+    "tidb_tpu_plan_cache_total",
+    "Plan-cache events by kind: hit, miss, bypass (ineligible or "
+    "known-uncacheable statement), evict (LRU), invalidate (schema/"
+    "stats change)")
+PARSE_SECONDS = Histogram(
+    "tidb_tpu_parse_seconds",
+    "SQL text -> AST wall time per parse() call")
+PLAN_SECONDS = Histogram(
+    "tidb_tpu_plan_seconds",
+    "Logical optimization + physical lowering wall time per "
+    "plan_statement call (cache hits skip this entirely)")
 MEM_QUOTA_ENGAGED = Counter(
     "tidb_tpu_mem_quota_engaged_total",
     "Queries whose host memory consumption crossed tidb_mem_quota_query "

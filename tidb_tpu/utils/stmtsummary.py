@@ -34,7 +34,8 @@ class _Entry:
     __slots__ = ("digest", "digest_text", "stmt_type", "plan_digest",
                  "exec_count", "sum_latency", "max_latency", "latencies",
                  "max_mem", "rows_sent", "errors", "dispatches",
-                 "fragments", "first_seen", "last_seen")
+                 "fragments", "first_seen", "last_seen",
+                 "plan_cache_hits", "sum_plan_latency")
 
     def __init__(self, digest: str, digest_text: str, stmt_type: str):
         self.digest = digest
@@ -52,6 +53,12 @@ class _Entry:
         self.fragments = 0
         self.first_seen = time.time()
         self.last_seen = self.first_seen
+        # plan-cache observability: executions whose plan came from the
+        # cache, and cumulative plan-acquisition wall time (cold plans
+        # dominate it; hits contribute near-zero — the cache's win is
+        # visible per digest, not just end-to-end)
+        self.plan_cache_hits = 0
+        self.sum_plan_latency = 0.0
 
     def p95(self) -> float:
         if not self.latencies:
@@ -76,7 +83,8 @@ class StmtSummary:
     def record(self, digest: str, digest_text: str, stmt_type: str,
                plan_digest: str, latency_s: float, *, max_mem: int = 0,
                rows_sent: int = 0, dispatches: int = 0, fragments: int = 0,
-               error: bool = False,
+               error: bool = False, plan_from_cache: bool = False,
+               plan_latency_s: float = 0.0,
                max_stmt_count: Optional[int] = None) -> None:
         with self.lock:
             if max_stmt_count is not None:
@@ -98,6 +106,8 @@ class StmtSummary:
             e.errors += 1 if error else 0
             e.dispatches += int(dispatches)
             e.fragments += int(fragments)
+            e.plan_cache_hits += 1 if plan_from_cache else 0
+            e.sum_plan_latency += plan_latency_s
             e.last_seen = time.time()
             if plan_digest:
                 e.plan_digest = plan_digest
@@ -129,6 +139,7 @@ class StmtSummary:
                 round(e.max_latency, 6), round(e.p95(), 6),
                 e.max_mem, e.rows_sent, e.errors, e.dispatches,
                 e.fragments, _fmt_ts(e.first_seen), _fmt_ts(e.last_seen),
+                e.plan_cache_hits, round(e.sum_plan_latency, 6),
             ))
         return out
 
@@ -138,5 +149,6 @@ class StmtSummary:
         cols = ("digest", "stmt_type", "digest_text", "plan_digest",
                 "exec_count", "sum_latency", "avg_latency", "max_latency",
                 "p95_latency", "max_mem", "rows_sent", "errors",
-                "dispatches", "fragments", "first_seen", "last_seen")
+                "dispatches", "fragments", "first_seen", "last_seen",
+                "plan_cache_hits", "sum_plan_latency")
         return [dict(zip(cols, r)) for r in self.rows()[:max(0, n)]]
