@@ -102,6 +102,31 @@ def main():
         if pc_hit < 0.9:
             pc_bad.append(f"plan_cache_hit_rate={pc_hit} < 0.9")
 
+        # join microbench FIXED floors (ISSUE 3): warm probe >= 3x cold
+        # (a warm join that re-traces pays cold-compile cost every run
+        # and fails this), 0 warm recompiles, and result-hash equality
+        # with the sqlite oracle. Best-of-3 on the ratio absorbs jitter;
+        # correctness floors must hold on EVERY run.
+        jm_ratio = 0.0
+        jm_bad = {}  # keyed: a config failing on every retry reports once
+        for _ in range(3):
+            jm = bench.bench_join_micro({})
+            head = jm["configs"][0]
+            jm_ratio = max(jm_ratio, head["warm_over_cold"])
+            for cfg in jm["configs"]:
+                tag = f"{cfg['build_rows']}x{cfg['probe_rows']}"
+                if cfg["check"] != "ok" or not cfg["hash_equal"]:
+                    jm_bad[f"join_result_hash[{tag}]"] = cfg["check"]
+                if cfg["warm_recompiles"] != 0:
+                    jm_bad[f"join_warm_recompiles[{tag}]"] = (
+                        f"{cfg['warm_recompiles']} != 0")
+            if jm_ratio >= 3.0 and not jm_bad:
+                break
+        print(f"join_warm_over_cold      {jm_ratio}  (need >= 3.0)")
+        pc_bad.extend(f"{k}={v}" for k, v in jm_bad.items())
+        if jm_ratio < 3.0:
+            pc_bad.append(f"join_warm_over_cold={jm_ratio} < 3.0")
+
         load1 = bench.machine_load()
         busy_after = load1["loadavg"][0] > BUSY_LOAD or load1.get("busy_procs")
 

@@ -115,7 +115,12 @@ class TestPrunedIsFaster:
         """The judge's bar: an EXPLAIN-visible pruned scan measured
         faster than the unpruned equivalent."""
         s = Session()
-        n = 200_000
+        # big enough that the unpruned side's scan+filter+agg clearly
+        # dominates fixed per-query overhead: the PR-3 global-agg
+        # reduction (xla_segment_sum G==1) made the full scan ~30 ms
+        # faster, which at 200k rows had compressed the pruned-vs-full
+        # margin into timing noise
+        n = 1_000_000
         s.execute("""create table big (id bigint, v bigint)
           partition by range (id) (
             partition p0 values less than (1000),
@@ -125,27 +130,30 @@ class TestPrunedIsFaster:
         ids = np.arange(n)
         t = s.catalog.table("test", "big")
         t.insert_columns({"id": ids, "v": ids * 3})
+        # settle stats NOW: otherwise auto-analyze triggered by the first
+        # query runs DURING the first timing loop and biases whichever
+        # side measures first
+        s.execute("ANALYZE TABLE big")
         sql = "select count(*), sum(v) from big where id < 1000"
         plan = "\n".join(r[0] for r in s.query("explain " + sql))
         assert "partitions:p0" in plan
-        s.query(sql)  # warm compile
-        pruned = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            got = s.query(sql)
-            pruned = min(pruned, time.perf_counter() - t0)
-        assert got == [(1000, sum(range(1000)) * 3)]
         # same query forced unpruned: widen the predicate so pruning
         # keeps every partition (planner falls back to the full scan)
         sql_full = ("select count(*), sum(v) from big "
                     "where id < 1000 and v >= 0")
         plan2 = "\n".join(r[0] for r in s.query("explain " + sql_full))
+        got = s.query(sql)  # warm compile
         s.query(sql_full)
-        full = float("inf")
+        pruned = full = float("inf")
+        # interleave the loops so load drift hits both sides equally
         for _ in range(5):
+            t0 = time.perf_counter()
+            got = s.query(sql)
+            pruned = min(pruned, time.perf_counter() - t0)
             t0 = time.perf_counter()
             s.query(sql_full)
             full = min(full, time.perf_counter() - t0)
+        assert got == [(1000, sum(range(1000)) * 3)]
         # best-of-5 comparison: robust to background load spikes
         assert pruned < full, (pruned, full, plan2)
 

@@ -53,6 +53,22 @@ class Column:
         if cap < n:
             raise ValueError(f"capacity {cap} < data length {n}")
         dt = type_.np_dtype
+        if cap == n and data.dtype == dt and data.base is None:
+            # no padding and the buffer is OWNED (not a view of table
+            # storage, which update_rows mutates in place): adopt it.
+            # Join expansion and agg emission mint fresh full-capacity
+            # gather results per chunk — copying them again was pure
+            # memory-bandwidth overhead. Scan slices keep the copy.
+            if valid is None:
+                v = np.ones(cap, dtype=np.bool_)
+            else:
+                v = np.asarray(valid)
+                if (v.shape != (cap,) or v.dtype != np.bool_
+                        or v.base is not None):
+                    vv = np.zeros(cap, dtype=np.bool_)
+                    vv[:cap] = v[:cap]
+                    v = vv
+            return Column(data, v, type_)
         buf = np.zeros(cap, dtype=dt)
         buf[:n] = data.astype(dt, copy=False)
         v = np.zeros(cap, dtype=np.bool_)

@@ -27,6 +27,10 @@ class RuntimeStats:
     # operator (incl. its children) ran — utils.dispatch deltas; EXPLAIN
     # ANALYZE shows own = cumulative - children's
     dispatches: int = 0
+    # kernel (re)traces while this operator ran (dispatch.compile_count
+    # deltas): nonzero on a warm re-execution means a shape key leaked
+    # into traced code
+    recompiles: int = 0
 
 
 @dataclass
@@ -51,6 +55,16 @@ class ExecContext:
     device_cache_bytes: int = 8 << 30
     # GROUP_CONCAT result truncation (group_concat_max_len sysvar)
     group_concat_max_len: int = 1024
+    # device-resident hash-join build: pack+sort on device instead of a
+    # host np.argsort round trip (tidb_tpu_join_device_build sysvar)
+    join_device_build: bool = True
+    # output tiles one fused join-expand dispatch may emit; bounds the
+    # [T, C] buffer a many-many join materializes per dispatch
+    # (tidb_tpu_join_tiles_per_dispatch sysvar)
+    join_tiles: int = 8
+    # rows above which a fragment build side refuses to replicate and
+    # the query falls back single-chip (tidb_broadcast_join_threshold_count)
+    broadcast_rows_limit: int = 1 << 21
 
     def __post_init__(self):
         if self.mem_tracker is None:

@@ -2,15 +2,24 @@
 
 TPU redesign: hash tables are scatter-hostile, so the build side becomes a
 *sorted* key array (+ row payload) on device, and each probe chunk runs
-one jitted kernel:
+through the fused kernels in ops/join_kernels.py:
 
-    searchsorted(build_keys, probe_keys)  -> start, count per probe row
-    windowed expansion                    -> static-capacity output chunks
+    probe_count:  key pack -> searchsorted -> match count -> prefix sum
+    expand_tiles: [T, C] fixed-capacity output tiles per dispatch
 
-The only host syncs are the per-chunk match total (to pick the number of
-output windows) — everything else stays on device. Duplicate build keys
-are handled naturally by the [start, start+count) ranges; NULL keys never
-match by masking them out of both sides.
+The build phase is device-resident on the jitted tier: packed keys +
+payload are staged once (padded to a power-of-two shape bucket) and the
+pack + sort + payload gather run as ONE device program — no host
+``np.argsort`` round trip. The host tier (``tidb_enable_tpu_exec`` off)
+keeps its numpy probe and pays exactly one sort and one gather per
+payload column.
+
+The kernels live at module level in ops/join_kernels.py and take every
+query-specific value as an argument, so a repeated join re-traces
+NOTHING at steady state (``JOIN_COMPILE_TOTAL`` guards this; EXPLAIN
+ANALYZE shows per-operator ``recompiles:``). The only host syncs per
+probe chunk are the match total (to size the expansion) — everything
+else stays on device.
 
 Multi-key equi joins pack keys into one int64 using host-known ranges
 (offset+stride per key); if ranges overflow int64, packing switches to
@@ -26,6 +35,7 @@ any NULL build key -> empty result).
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 
 import jax
@@ -35,7 +45,7 @@ import numpy as np
 from tidb_tpu.chunk.chunk import Chunk
 from tidb_tpu.chunk.column import Column
 from tidb_tpu.executor.base import ExecContext, Executor
-from tidb_tpu.utils.dispatch import counted_jit
+from tidb_tpu.ops import join_kernels as jk
 from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
 from tidb_tpu.types import INT64, TypeKind
@@ -43,39 +53,24 @@ from tidb_tpu.types import INT64, TypeKind
 __all__ = ["HashJoinExec", "IndexJoinExec"]
 
 
-def _as_int64_key(d, mode: str):
-    """Device-side: make a comparable int64 key (floats via bit pattern)."""
-    if mode == "bits":
-        return jax.lax.bitcast_convert_type(d.astype(jnp.float64), jnp.int64)
-    return d.astype(jnp.int64)
+def _pad_np(a: np.ndarray, cap: int, fill=0) -> np.ndarray:
+    """Pad a host array to a shape-bucket capacity."""
+    n = len(a)
+    if n == cap:
+        return a
+    out = np.full(cap, fill, dtype=a.dtype)
+    out[:n] = a
+    return out
 
 
-# splitmix64-style mixing constants (shared finalizer lives in
-# utils/hashutil; used identically on host numpy and device jnp — only
-# same-function-both-sides matters, not canonicality)
-from tidb_tpu.utils.hashutil import (SM_ADD as _MIX_C1, SM_MUL1 as _MIX_C2,
-                                     SM_MUL2 as _MIX_C3, splitmix64)
-
-
-def _hash_combine_host(key_arrays_i64):
-    """uint64 mixing hash of composite int64 keys -> int64 (numpy)."""
-    with np.errstate(over="ignore"):
-        h = np.zeros(len(key_arrays_i64[0]), dtype=np.uint64)
-        for k in key_arrays_i64:
-            h = h * _MIX_C1 ^ splitmix64(k.view(np.uint64))
-    return h.view(np.int64)
-
-
-def _hash_combine_device(keys_i64):
-    """Same mixing hash on device (jnp uint64, logical shifts)."""
-    h = jnp.zeros_like(keys_i64[0], dtype=jnp.uint64)
-    for k in keys_i64:
-        z = jax.lax.bitcast_convert_type(k, jnp.uint64) + jnp.uint64(_MIX_C1)
-        z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(_MIX_C2)
-        z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(_MIX_C3)
-        z = z ^ (z >> jnp.uint64(31))
-        h = h * jnp.uint64(_MIX_C1) ^ z
-    return jax.lax.bitcast_convert_type(h, jnp.int64)
+def _pad_dev(a, cap: int, fill=0):
+    """Pad a (possibly device) array to a shape-bucket capacity."""
+    n = a.shape[0]
+    if n == cap:
+        return a
+    if isinstance(a, np.ndarray):
+        return _pad_np(a, cap, fill)
+    return jnp.concatenate([a, jnp.full(cap - n, fill, dtype=a.dtype)])
 
 
 class HashJoinExec(Executor):
@@ -101,8 +96,11 @@ class HashJoinExec(Executor):
         self._build()
 
     def _build(self):
-        """Drain the build child; compact key + payload columns to host;
-        sort by key; stage back to device."""
+        """Drain the build child; compact key + payload columns; then
+        EITHER one host sort (host numpy tier — no device staging at
+        all) OR one padded staging transfer + the fused device
+        pack/sort/gather kernel (jitted tier)."""
+        t0 = time.perf_counter()
         build_child = self.children[1]
         keys_ir = self.build_keys
 
@@ -149,38 +147,73 @@ class HashJoinExec(Executor):
         key_arrays = [np.concatenate(p) if p else np.zeros(0, dtype=np.int64) for p in key_cols]
         ok = np.concatenate(key_ok) if key_ok else np.zeros(0, dtype=np.bool_)
         self._build_had_null = bool((~ok).any())
-        # NULL keys can never match: drop them from the build side
-        key_arrays = [k[ok] for k in key_arrays]
+        self._n_build = int(ok.sum())
 
-        packed, self._pack_info = self._pack_keys_host(key_arrays)
-        order = np.argsort(packed, kind="stable")
-        self._n_build = len(packed)
+        # pack parameters (and the hash-mode decision) come from the
+        # VALID keys only — a NULL slot's garbage value must not blow
+        # the range into hash mode
+        valid_keys = [k[ok] for k in key_arrays]
+        self._pack_info = self._key_pack_info(valid_keys)
+        self._has_filter = self.other_cond is not None or self._hash_mode
+        self._payload_uids = list(payload)
+        self._build_schema_by_uid = {c.uid: c for c in (self.build_schema or [])}
+
         keep_np = self._host_probe_eligible()
-        self._sorted_keys_np = packed[order] if keep_np else None
-        self._sorted_keys = jnp.asarray(packed[order])
-        if self._hash_mode:
-            # raw per-column key values, build-sorted, for exact
-            # verification of hash-expanded candidate rows on device
-            self._build_keyvals_sorted = [
-                jnp.asarray(k[order]) for k in self._build_keyvals
-            ]
-        self._build_payload = {}
-        self._build_payload_np = {}
-        nbytes = packed.nbytes
-        for uid, (dlist, vlist) in payload.items():
-            d = np.concatenate(dlist) if dlist else np.zeros(0)
-            v = np.concatenate(vlist) if vlist else np.zeros(0, dtype=np.bool_)
-            d, v = d[ok][order], v[ok][order]
-            nbytes += d.nbytes + v.nbytes
-            if keep_np:
+        nbytes = 0
+        tier = "host" if keep_np else "device"
+        if keep_np:
+            # host tier: ONE argsort and ONE gather per column — the
+            # sorted arrays are derived once and never staged to device
+            # (the numpy probe path is the only consumer; the
+            # tidb_tpu_join_device_build=0 escape hatch shares
+            # _host_firsts but pads to a jit shape bucket)
+            packed = self._pack_host(valid_keys)
+            order = np.argsort(packed, kind="stable")
+            self._sorted_keys_np = packed[order]
+            live_idx = np.flatnonzero(ok)[order]
+            self._sorted_keys = None
+            self._build_payload = {}
+            self._build_payload_np = {}
+            nbytes = self._sorted_keys_np.nbytes
+            # direct-address probe index (radix histogram) for dense
+            # packed domains: O(1) gathers beat per-element binary search
+            dom = self._direct_domain(len(self._sorted_keys_np))
+            self._firsts_np = None
+            if dom is not None:
+                lo, rng = dom
+                self._firsts_np = self._host_firsts(
+                    self._sorted_keys_np, lo, rng)
+                self._direct_lo_np, self._direct_rng_np = lo, rng
+                nbytes += self._firsts_np.nbytes
+            for uid, (dlist, vlist) in payload.items():
+                c = self._build_schema_by_uid[uid]
+                d = (np.concatenate(dlist) if dlist
+                     else np.zeros(0, dtype=c.type_.np_dtype))
+                v = (np.concatenate(vlist) if vlist
+                     else np.zeros(0, dtype=np.bool_))
+                d, v = d[live_idx], v[live_idx]
+                nbytes += d.nbytes + v.nbytes
                 self._build_payload_np[uid] = (d, v)
-            self._build_payload[uid] = (jnp.asarray(d), jnp.asarray(v))
+        elif (getattr(self.ctx, "join_device_build", True)
+                or self._hash_mode):
+            # hash mode always builds on device: its packed keys only
+            # exist there (the host combiner was retired with the old
+            # double-sort build)
+            nbytes = self._stage_device_build(key_arrays, ok, payload)
+        else:
+            # tidb_tpu_join_device_build = 0 escape hatch: sort on host,
+            # stage the already-sorted arrays. The probe kernels are
+            # identical — only the sort placement changes.
+            nbytes = self._stage_host_sorted_build(key_arrays, ok, payload)
+            tier = "host_sorted"
         # account the materialized build side against the query budget
         # (ref: HashJoinExec's build RowContainer under the memory tracker)
         self._mem_tracker = self.ctx.mem_tracker.child("hashjoin.build")
         self._build_bytes = int(nbytes)
         self._mem_tracker.consume(self._build_bytes)
-        self._probe_fn = None
+        from tidb_tpu.utils.metrics import JOIN_BUILD_SECONDS
+
+        JOIN_BUILD_SECONDS.observe(time.perf_counter() - t0, tier=tier)
 
     def close(self) -> None:
         if getattr(self, "_build_bytes", 0):
@@ -188,28 +221,33 @@ class HashJoinExec(Executor):
             self._build_bytes = 0
         super().close()
 
-    def _pack_keys_host(self, key_arrays: List[np.ndarray]):
-        """Combine multi-keys into one int64 via range packing. Returns
-        (packed, info) where info lets the probe side apply the same
-        transform. If the range product overflows int64, switch to a
-        64-bit mixing hash with exact device-side verification (see
-        module docstring) — sets self._hash_mode."""
+    def _key_pack_info(self, key_arrays: List[np.ndarray]):
+        """Pack parameters per key WITHOUT materializing packed keys
+        (the jitted tier packs on device). Sets self._hash_mode; returns
+        [(mode, lo, stride, rng), ...] or [("hash", modes)] when the
+        range product overflows int64."""
         self._hash_mode = False
+        modes = ["bits" if np.issubdtype(k.dtype, np.floating) else "int"
+                 for k in key_arrays]
         if len(key_arrays) == 1:
             k = key_arrays[0]
-            if np.issubdtype(k.dtype, np.floating):
-                return k.astype(np.float64).view(np.int64), [("bits", 0, 1, 0)]
-            return k.astype(np.int64), [("int", 0, 1, 0)]
-        conv, modes = [], []
-        for k in key_arrays:
-            if np.issubdtype(k.dtype, np.floating):
-                conv.append(k.astype(np.float64).view(np.int64))
-                modes.append("bits")
-            else:
-                conv.append(k.astype(np.int64))
-                modes.append("int")
+            if modes[0] == "int" and len(k):
+                # lo/rng of the packed domain feed the direct-address
+                # index decision (the probe packer ignores them for
+                # single keys, so recording real values is free)
+                lo, hi = int(k.min()), int(k.max())
+                rng = hi - lo + 1
+                if rng >= (1 << 63):
+                    # keys span (almost) the whole int64 domain: the rng
+                    # itself doesn't fit int64 (the probe-param arrays
+                    # would overflow). Direct indexing is ineligible
+                    # anyway — record 0, the "unknown range" marker.
+                    rng = 0
+                return [(modes[0], lo, 1, rng)]
+            return [(modes[0], 0, 1, 0)]
+        conv = [k.astype(np.float64).view(np.int64) if m == "bits"
+                else k.astype(np.int64) for k, m in zip(key_arrays, modes)]
         info = []
-        packed = np.zeros(len(key_arrays[0]), dtype=np.int64)
         stride = 1
         for k, mode in zip(conv, modes):
             lo = int(k.min()) if len(k) else 0
@@ -217,66 +255,196 @@ class HashJoinExec(Executor):
             rng = hi - lo + 1
             if rng <= 0 or rng * stride > (1 << 62):
                 self._hash_mode = True
-                self._build_keyvals = conv
-                return _hash_combine_host(conv), [("hash", modes)]
+                return [("hash", tuple(modes))]
             info.append((mode, lo, stride, rng))
-            packed = packed + (k - lo) * stride
             stride *= rng
-        return packed, info
+        return info
 
-    def _pack_probe(self, outs):
-        """Device-side packing of probe keys with the build-side info.
-        Returns (packed int64, ok mask) — keys outside the build range get
-        ok=False (they cannot match)."""
+    # direct-address index ceilings: absolute (host/device memory for the
+    # [rng + 1] prefix array) and relative to the build bucket (don't
+    # mint a giant histogram for a tiny build over a sparse domain)
+    DIRECT_ABS_LIMIT = 1 << 23
+    DIRECT_REL_LIMIT = 32
+
+    def _direct_domain(self, n_bucket: int):
+        """(lo, rng) of the packed-key domain when the direct-address
+        (radix histogram) probe index pays off, else None. Dense build
+        keys — the PK-FK common case — resolve probes in O(1) gathers."""
+        if self._hash_mode or self._n_build == 0:
+            return None
         info = self._pack_info
-        if len(outs) == 1:
-            d, v = outs[0]
-            ones = jnp.ones_like(v)
-            return _as_int64_key(d, info[0][0]), v, ones
-        if info[0][0] == "hash":
-            modes = info[0][1]
-            valid = jnp.ones_like(outs[0][1])
-            keys = []
-            for (d, v), mode in zip(outs, modes):
-                keys.append(_as_int64_key(d, mode))
-                valid = valid & v
-            # all hashes are "in range"; false candidates are removed by
-            # the exact verification filter after expansion
-            return _hash_combine_device(keys), valid, jnp.ones_like(valid)
-        packed = jnp.zeros_like(outs[0][0], dtype=jnp.int64)
-        valid = jnp.ones_like(outs[0][1])
-        in_range = jnp.ones_like(outs[0][1])
-        for (d, v), (mode, lo, stride, rng) in zip(outs, info):
-            d = _as_int64_key(d, mode)
-            valid = valid & v
-            # probe keys outside the build range can't match; without this
-            # mask they'd alias into other (lo, stride) cells and collide.
-            # kept separate from `valid`: an out-of-range key is a definite
-            # non-match (anti joins must keep the row), not a NULL.
-            in_range = in_range & (d >= lo) & (d < lo + rng)
-            packed = packed + jnp.clip(d - lo, 0, max(rng - 1, 0)) * stride
-        return packed, valid, in_range
+        if len(info) == 1:
+            mode, lo, _stride, rng = info[0]
+            if mode != "int" or rng <= 0:
+                return None
+        else:
+            lo = 0
+            rng = info[-1][2] * info[-1][3]  # prod of per-key ranges
+        if rng > min(self.DIRECT_ABS_LIMIT,
+                     max(1 << 18, self.DIRECT_REL_LIMIT * n_bucket)):
+            return None
+        return lo, rng
 
-    # ------------------------------------------------------------------
+    @staticmethod
+    def _host_firsts(sorted_packed: np.ndarray, lo: int, rng: int,
+                     pad_to: int = 0) -> np.ndarray:
+        """The direct-address index, built on host: bincount + cumsum
+        prefix array over the dense packed domain [lo, lo+rng). One
+        definition for BOTH host consumers — the numpy probe tier
+        (exact length) and the host_sorted escape hatch, whose jit
+        consumer needs `pad_to` shape-bucket padding (fill = n so
+        out-of-domain gathers read an empty range). The device twin is
+        ops/join_kernels.build_direct_index."""
+        counts = np.bincount(sorted_packed - lo, minlength=rng)
+        firsts = np.concatenate([np.zeros(1, dtype=np.int64),
+                                 np.cumsum(counts, dtype=np.int64)])
+        if pad_to > rng:
+            firsts = _pad_np(firsts, pad_to + 1, len(sorted_packed))
+        return firsts
 
-    def _make_probe_fn(self):
-        keys_ir = self.probe_keys
-        sorted_keys = self._sorted_keys
+    def _pack_host(self, key_arrays: List[np.ndarray]) -> np.ndarray:
+        """Range-pack valid build keys on host (host tier only; hash
+        mode never reaches here — it forces the jitted path)."""
+        info = self._pack_info
+        if len(key_arrays) == 1:
+            return self._np_as_int64(key_arrays[0], info[0][0])
+        packed = np.zeros(len(key_arrays[0]), dtype=np.int64)
+        for k, (mode, lo, stride, rng) in zip(key_arrays, info):
+            packed = packed + (self._np_as_int64(k, mode) - lo) * stride
+        return packed
 
-        def probe(chunk):
-            if not keys_ir:
-                packed = jnp.zeros(chunk.capacity, dtype=jnp.int64)
-                valid = in_range = jnp.ones(chunk.capacity, dtype=jnp.bool_)
-            else:
-                outs = [eval_expr(k, chunk) for k in keys_ir]
-                packed, valid, in_range = self._pack_probe(outs)
-            ok = valid & chunk.sel
-            start = jnp.searchsorted(sorted_keys, packed, side="left")
-            end = jnp.searchsorted(sorted_keys, packed, side="right")
-            count = jnp.where(ok & in_range, end - start, 0)
-            return start, count, ok
+    def _set_probe_pack_params(self, nk: int) -> None:
+        """Device copies of the pack parameters the probe kernel takes
+        as traced args (modes stay static)."""
+        info = self._pack_info
+        if self._hash_mode:
+            self._modes = tuple(info[0][1])
+            los = strides = rngs = np.zeros(nk, dtype=np.int64)
+        else:
+            self._modes = tuple(e[0] for e in info)
+            los = np.asarray([e[1] for e in info], dtype=np.int64)
+            strides = np.asarray([e[2] for e in info], dtype=np.int64)
+            rngs = np.asarray([e[3] for e in info], dtype=np.int64)
+        self._los = jnp.asarray(los)
+        self._strides = jnp.asarray(strides)
+        self._rngs = jnp.asarray(rngs)
 
-        return counted_jit(probe)
+    def _stage_host_sorted_build(self, key_arrays, ok, payload) -> int:
+        """tidb_tpu_join_device_build = 0 escape hatch: the build sorts
+        on host (one argsort + one gather per column, like the numpy
+        tier) and the SORTED arrays stage to device for the same fused
+        probe kernels. Correctness-identical to the device build."""
+        from tidb_tpu.utils import dispatch as dsp
+
+        self._set_probe_pack_params(len(key_arrays))
+        valid_keys = [k[ok] for k in key_arrays]
+        packed = self._pack_host(valid_keys)
+        order = np.argsort(packed, kind="stable")
+        sorted_np = packed[order]
+        live_idx = np.flatnonzero(ok)[order]
+        n = len(sorted_np)
+        B = jk.shape_bucket(n)
+        # padding must keep the array sorted: dead slots -> INT64_MAX
+        self._sorted_keys = jnp.asarray(
+            _pad_np(sorted_np, B, np.iinfo(np.int64).max))
+        self._n_build_dev = jnp.asarray(n, dtype=jnp.int64)
+        self._sorted_keys_np = None
+        self._build_payload_np = {}
+        self._build_keyvals_dev = ()  # hash mode never takes this path
+        self._build_payload = {}
+        nbytes = self._sorted_keys.nbytes
+        n_staged = 1
+        for uid in self._payload_uids:
+            dlist, vlist = payload[uid]
+            c = self._build_schema_by_uid[uid]
+            d = (np.concatenate(dlist) if dlist
+                 else np.zeros(0, dtype=c.type_.np_dtype))
+            v = (np.concatenate(vlist) if vlist
+                 else np.zeros(0, dtype=np.bool_))
+            dd = jnp.asarray(_pad_np(d[live_idx], B))
+            vv = jnp.asarray(_pad_np(v[live_idx], B, False))
+            self._build_payload[uid] = (dd, vv)
+            nbytes += dd.nbytes + vv.nbytes
+            n_staged += 2
+        dom = self._direct_domain(B)
+        self._direct = dom is not None
+        if self._direct:
+            lo, rng = dom
+            # bucket the histogram length like the device build does, or
+            # the probe kernel would re-trace per build data size
+            self._firsts = jnp.asarray(self._host_firsts(
+                sorted_np, lo, rng,
+                pad_to=jk.shape_bucket(rng, floor=64)))
+            self._direct_lo, self._direct_rng = lo, rng
+            n_staged += 1
+        else:
+            self._firsts = jnp.zeros(2, dtype=jnp.int64)
+            self._direct_lo = self._direct_rng = 0
+        nbytes += self._firsts.nbytes
+        dsp.record(n_staged, site="stage")
+        return nbytes
+
+    def _stage_device_build(self, key_arrays, ok, payload) -> int:
+        """Pad to a power-of-two shape bucket, stage ONCE, and run the
+        fused pack+sort+gather kernel — the build side becomes
+        device-resident sorted arrays with NULL/dead keys at the tail.
+        Returns resident bytes for the memory tracker."""
+        from tidb_tpu.utils import dispatch as dsp
+
+        nk = len(key_arrays)
+        self._set_probe_pack_params(nk)
+        B = jk.shape_bucket(len(ok))
+        ok_p = jnp.asarray(_pad_np(ok, B, False))
+        kd = tuple(jnp.asarray(_pad_np(np.asarray(k), B)) for k in key_arrays)
+        kv = (ok_p,) * nk  # key validity is already folded into ok
+        pd, pv = [], []
+        for uid in self._payload_uids:
+            dlist, vlist = payload[uid]
+            c = self._build_schema_by_uid[uid]
+            d = (np.concatenate(dlist) if dlist
+                 else np.zeros(0, dtype=c.type_.np_dtype))
+            v = (np.concatenate(vlist) if vlist
+                 else np.zeros(0, dtype=np.bool_))
+            pd.append(jnp.asarray(_pad_np(d, B)))
+            pv.append(jnp.asarray(_pad_np(v, B, False)))
+        dsp.record(1 + nk + 2 * len(pd), site="stage")
+
+        sorted_keys, n_build_dev, out_d, out_v, out_k = jk.build_sort(
+            kd, kv, ok_p, tuple(pd), tuple(pv),
+            self._los, self._strides, self._rngs,
+            modes=self._modes, hash_mode=self._hash_mode)
+        self._sorted_keys = sorted_keys
+        self._n_build_dev = n_build_dev
+        # direct-address probe index over a dense packed domain, built on
+        # device from the sorted keys (shape-bucketed so repeats reuse
+        # the compiled histogram kernel)
+        dom = self._direct_domain(B)
+        self._direct = dom is not None
+        if self._direct:
+            lo, rng = dom
+            rng_bucket = jk.shape_bucket(rng, floor=64)
+            self._firsts = jk.build_direct_index(
+                sorted_keys, n_build_dev, lo, rng_bucket)
+            self._direct_lo, self._direct_rng = lo, rng
+        else:
+            self._firsts = jnp.zeros(2, dtype=jnp.int64)
+            self._direct_lo = self._direct_rng = 0
+        self._sorted_keys_np = None
+        self._build_payload_np = {}
+        self._build_payload = {
+            uid: (d, v)
+            for uid, d, v in zip(self._payload_uids, out_d, out_v)
+        }
+        # raw key values build-sorted: exact verification of
+        # hash-expanded candidate rows reads them (passed as kernel
+        # ARGS, never closure state — see _match_filter)
+        self._build_keyvals_dev = out_k if self._hash_mode else ()
+        nbytes = sorted_keys.nbytes + self._firsts.nbytes
+        for d, v in zip(out_d, out_v):
+            nbytes += d.nbytes + v.nbytes
+        for k in self._build_keyvals_dev:
+            nbytes += k.nbytes
+        return nbytes
 
     def next(self) -> Optional[Chunk]:
         while True:
@@ -292,10 +460,10 @@ class HashJoinExec(Executor):
 
     def _host_probe_eligible(self) -> bool:
         """The numpy probe path covers the workhorse shapes on the host
-        engine (ctx.device_agg off): sorted-array binary search + exact
-        np.repeat expansion beat the jitted XLA:CPU searchsorted + padded
-        window gathers ~3x. Left joins and filtered/hash-verified probes
-        keep the jitted path (NULL padding + re-verification logic)."""
+        engine (ctx.device_agg off): direct-address gathers (or binary
+        search) + exact np.repeat expansion with no staging at all.
+        Left joins and filtered/hash-verified probes take the fused
+        device kernels (NULL padding + re-verification logic)."""
         return (not getattr(self.ctx, "device_agg", True)
                 and self.kind in ("inner", "semi", "anti")
                 and self.other_cond is None
@@ -356,7 +524,7 @@ class HashJoinExec(Executor):
         return d.astype(np.int64)
 
     def _np_pack_probe(self, outs):
-        """Numpy mirror of _pack_probe (range packing; hash mode never
+        """Numpy mirror of the device packer (range packing; hash mode never
         reaches the numpy path — _host_probe_eligible excludes it)."""
         info = self._pack_info
         if len(outs) == 1:
@@ -373,39 +541,71 @@ class HashJoinExec(Executor):
             packed = packed + np.clip(d - lo, 0, max(rng - 1, 0)) * stride
         return packed, valid, in_range
 
-    def _np_probe_keys(self, chunk: Chunk):
-        """Key eval + pack for the numpy probe: pure numpy when the key
-        exprs allow it, else a jitted fallback (one fn per join)."""
-        mode = getattr(self, "_np_key_mode", None)
-        if mode != "jit":
+    def _probe_key_arrays(self, chunk: Chunk, host: bool = True):
+        """(key datas, key valids) for one probe chunk.
+
+        ``host=True`` (the numpy tier): pure numpy when the key exprs
+        allow it (almost always — column refs / dictionary lookups),
+        else the cached jitted evaluator.
+
+        ``host=False`` (the device tier): plain ColumnRef keys pass
+        their arrays through UNTOUCHED — a device-resident column must
+        not detour through np.asarray (a synchronous device->host
+        round trip per probe chunk on real hardware); anything else
+        evaluates in one cached jitted kernel per key-expr repr
+        (reused across executions; binder uids are deterministic)."""
+        if not self.probe_keys:
+            return (), ()
+        if not host:
+            from tidb_tpu.expression.expr import ColumnRef
+
+            if all(isinstance(k, ColumnRef) for k in self.probe_keys):
+                cols = [chunk.columns[k.name] for k in self.probe_keys]
+                return (tuple(c.data for c in cols),
+                        tuple(c.valid for c in cols))
+        elif getattr(self, "_probe_key_mode", None) != "jit":
             outs = [self._np_eval_key(k, chunk) for k in self.probe_keys]
-            if self.probe_keys and all(o is not None for o in outs):
-                self._np_key_mode = "np"
-                packed, valid, in_r = self._np_pack_probe(outs)
-                return packed, valid & np.asarray(chunk.sel), in_r
-            self._np_key_mode = "jit"
-        if getattr(self, "_np_key_fn", None) is None:
+            if all(o is not None for o in outs):
+                self._probe_key_mode = "np"
+                return (tuple(o[0] for o in outs),
+                        tuple(o[1] for o in outs))
+            self._probe_key_mode = "jit"
+        if getattr(self, "_probe_key_fn", None) is None:
             keys_ir = self.probe_keys
 
             def keyfn(ch):
-                if not keys_ir:
-                    ones = jnp.ones(ch.capacity, dtype=jnp.bool_)
-                    return (jnp.zeros(ch.capacity, dtype=jnp.int64),
-                            ones, ones)
-                outs = [eval_expr(k, ch) for k in keys_ir]
-                return self._pack_probe(outs)
+                return tuple(tuple(eval_expr(k, ch)) for k in keys_ir)
 
-            self._np_key_fn = counted_jit(keyfn)
-        packed, valid, in_range = self._np_key_fn(chunk)
-        return (np.asarray(packed), np.asarray(valid) & np.asarray(chunk.sel),
-                np.asarray(in_range))
+            self._probe_key_fn = cached_jit(
+                "joinprobekeys", repr(keys_ir), lambda: keyfn)
+        outs = self._probe_key_fn(chunk)
+        return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+    def _np_probe_keys(self, chunk: Chunk):
+        """Key eval + pack for the numpy probe path."""
+        if not self.probe_keys:
+            cap = chunk.capacity
+            return (np.zeros(cap, dtype=np.int64), np.asarray(chunk.sel),
+                    np.ones(cap, dtype=np.bool_))
+        kd, kv = self._probe_key_arrays(chunk)
+        outs = [(np.asarray(d), np.asarray(v)) for d, v in zip(kd, kv)]
+        packed, valid, in_r = self._np_pack_probe(outs)
+        return packed, valid & np.asarray(chunk.sel), in_r
 
     def _process_probe_chunk_np(self, chunk: Chunk):
         packed, ok, in_r = self._np_probe_keys(chunk)
-        sk = self._sorted_keys_np
-        start = np.searchsorted(sk, packed, side="left")
-        end = np.searchsorted(sk, packed, side="right")
-        count = np.where(ok & in_r, end - start, 0)
+        if self._firsts_np is not None:
+            # dense packed domain: O(1) gathers into the radix histogram
+            idx = packed - self._direct_lo_np
+            in_r = in_r & (idx >= 0) & (idx < self._direct_rng_np)
+            idx = np.clip(idx, 0, self._direct_rng_np - 1)
+            start = self._firsts_np[idx]
+            count = np.where(ok & in_r, self._firsts_np[idx + 1] - start, 0)
+        else:
+            sk = self._sorted_keys_np
+            start = np.searchsorted(sk, packed, side="left")
+            end = np.searchsorted(sk, packed, side="right")
+            count = np.where(ok & in_r, end - start, 0)
 
         if self.kind in ("semi", "anti"):
             matched = count > 0
@@ -428,6 +628,11 @@ class HashJoinExec(Executor):
         build_schema = {c.uid: c for c in (self.build_schema or [])}
         probe_np = {uid: (np.asarray(col.data), np.asarray(col.valid))
                     for uid, col in chunk.columns.items()}
+        # columns with no NULLs skip the validity gather entirely (scan
+        # output is usually all-valid; from_numpy mints the ones mask)
+        all_valid = {uid: bool(v.all()) for uid, (d, v) in probe_np.items()}
+        ball_valid = {uid: bool(v.all())
+                      for uid, (d, v) in self._build_payload_np.items()}
         types = {uid: chunk.columns[uid].type_ for uid in probe_np}
         types.update({uid: build_schema[uid].type_
                       for uid in self._build_payload_np})
@@ -443,15 +648,19 @@ class HashJoinExec(Executor):
             rows = np.arange(lo_row, hi_row + 1)
             reps = np.minimum(cum[rows], hi) - np.maximum(cum_excl[rows], w)
             probe_row = np.repeat(rows, reps)
-            k = np.arange(w, hi, dtype=np.int64) - cum_excl[probe_row]
-            build_pos = start[probe_row] + k
+            # one repeat of the per-row offset replaces two per-output
+            # gathers: build_pos = j + (start[row] - cum_excl[row])
+            build_pos = (np.arange(w, hi, dtype=np.int64)
+                         + np.repeat(start[rows] - cum_excl[rows], reps))
             arrays, valids = {}, {}
             for uid, (d, v) in probe_np.items():
                 arrays[uid] = d[probe_row]
-                valids[uid] = v[probe_row]
+                if not all_valid[uid]:
+                    valids[uid] = v[probe_row]
             for uid, (d, v) in self._build_payload_np.items():
                 arrays[uid] = d[build_pos]
-                valids[uid] = v[build_pos]
+                if not ball_valid[uid]:
+                    valids[uid] = v[build_pos]
             ccap = 8
             while ccap < m:
                 ccap *= 2
@@ -462,50 +671,69 @@ class HashJoinExec(Executor):
         if self._host_probe_eligible():
             self._process_probe_chunk_np(chunk)
             return
-        if self._probe_fn is None:
-            self._probe_fn = self._make_probe_fn()
-            self._expand_fn = self._make_expand_fn()
-            self._filter_fns = {}
-        start, count, ok = self._probe_fn(chunk)
+        t0 = time.perf_counter()
+        try:
+            self._process_probe_chunk_device(chunk)
+        finally:
+            from tidb_tpu.utils.metrics import JOIN_PROBE_SECONDS
+
+            JOIN_PROBE_SECONDS.observe(time.perf_counter() - t0,
+                                       kind=self.kind)
+
+    def _process_probe_chunk_device(self, chunk: Chunk):
         # hash-packed keys need exact re-verification of every candidate
         # row, so they take the same filtered paths as other_cond
-        has_filter = self.other_cond is not None or self._hash_mode
+        has_filter = self._has_filter
+        key_datas, key_valids = self._probe_key_arrays(chunk, host=False)
+        cap = chunk.capacity
+        Rp = jk.shape_bucket(cap)
+        sel = chunk.sel
+        if Rp != cap:  # shape-bucket the probe: pad keys + sel to pow2
+            key_datas = tuple(_pad_dev(d, Rp) for d in key_datas)
+            key_valids = tuple(_pad_dev(v, Rp, False) for v in key_valids)
+            sel = _pad_dev(sel, Rp, False)
+        left_pad = self.kind == "left" and not has_filter
+        start, count, real_count, cum, total_dev, ok, matched = jk.probe_count(
+            self._sorted_keys, self._n_build_dev, key_datas, key_valids,
+            sel, self._los, self._strides, self._rngs,
+            self._firsts, self._direct_lo, self._direct_rng,
+            modes=self._modes, hash_mode=self._hash_mode,
+            left_pad=left_pad, direct=self._direct)
 
         if self.kind in ("semi", "anti"):
-            if not has_filter:
-                matched = count > 0
-            else:
-                matched = self._qualified_matches(chunk, start, count)
+            if has_filter:
+                matched = self._qualified_matches(
+                    chunk, start, real_count, cum, int(total_dev))
+            elif Rp != cap:
+                matched = matched[:cap]
+            okc = ok[:cap] if Rp != cap else ok
             if self.kind == "semi":
-                self._pending.append(chunk.with_sel(ok & matched))
+                self._pending.append(chunk.with_sel(okc & matched))
                 return
             if self._build_had_null and not self.exists_sem:
                 return  # NOT IN with NULL in subquery: no row is ever TRUE
             if self.exists_sem:
                 # NOT EXISTS: a NULL probe key never matches -> row kept
-                keep = chunk.sel & ~(ok & matched)
+                keep = chunk.sel & ~(okc & matched)
             else:
-                keep = chunk.sel & ok & ~matched
+                keep = chunk.sel & okc & ~matched
             self._pending.append(chunk.with_sel(keep))
             return
 
-        real_count = count
+        total = int(total_dev)  # the one host sync: sizes the expansion
         left_other = self.kind == "left" and has_filter
-        if self.kind == "left" and not left_other:
-            count = jnp.where(chunk.sel, jnp.maximum(count, 1), 0)
-
-        cum = jnp.cumsum(count)
-        total = int(cum[-1])
-        cap = self.ctx.chunk_capacity
-        matched = np.zeros(chunk.capacity, dtype=np.bool_) if left_other else None
-        for w in range(0, total, cap):
-            out = self._expand_fn(chunk, start, count, real_count, cum, jnp.int64(w))
+        if total == 0 and not left_other:
+            return
+        matched_np = (np.zeros(cap, dtype=np.bool_) if left_other else None)
+        for out in self._expand_windows(chunk, start, count, real_count,
+                                        cum, total, bookkeeping=has_filter):
             if has_filter:
                 out = self._match_filter(out)
                 if left_other:
-                    sel = np.asarray(out.sel)
-                    rows = np.asarray(out.columns["__probe_row__"].data)[sel]
-                    matched[rows] = True
+                    osel = np.asarray(out.sel)
+                    rows = np.asarray(
+                        out.columns["__probe_row__"].data)[osel]
+                    matched_np[rows] = True
                 # bookkeeping columns stay internal to the match tracking
                 out = Chunk(
                     {u: c for u, c in out.columns.items()
@@ -516,49 +744,93 @@ class HashJoinExec(Executor):
         if left_other:
             # probe rows whose every match failed other_cond (or that had
             # none) emit one NULL-payload row each, per LEFT JOIN semantics
-            unmatched = chunk.sel & jnp.asarray(~matched)
+            unmatched = chunk.sel & jnp.asarray(~matched_np)
             if bool(np.asarray(unmatched).any()):
                 self._pending.append(self._null_build_chunk(chunk, unmatched))
 
-    def _qualified_matches(self, chunk: Chunk, start, count):
+    def _expand_windows(self, chunk: Chunk, start, count, real_count, cum,
+                        total: int, bookkeeping: bool):
+        """Yield output Chunks of capacity ctx.chunk_capacity via fused
+        [T, C] tile dispatches — up to ctx.join_tiles output tiles per
+        device round trip instead of one dispatch per window."""
+        C = self.ctx.chunk_capacity
+        max_tiles = max(1, getattr(self.ctx, "join_tiles", 8))
+        p_uids = list(chunk.columns.keys())
+        p_datas = tuple(chunk.columns[u].data for u in p_uids)
+        p_valids = tuple(chunk.columns[u].valid for u in p_uids)
+        b_uids = self._payload_uids
+        b_datas = tuple(self._build_payload[u][0] for u in b_uids)
+        b_valids = tuple(self._build_payload[u][1] for u in b_uids)
+        w0 = 0
+        while w0 < total:
+            rem = -(-(total - w0) // C)  # ceil-div: tiles still needed
+            T = min(jk.shape_bucket(rem, floor=1), max_tiles)
+            out_p, out_b, sel_t, prow, bpos = jk.expand_tiles(
+                start, count, real_count, cum, w0, p_datas, p_valids,
+                b_datas, b_valids, n_tiles=T, tile_cap=C,
+                build_cap=self._sorted_keys.shape[0],
+                left=self.kind == "left",
+                with_probe_row=bookkeeping,
+                with_build_pos=bookkeeping and self._hash_mode)
+            for i in range(min(T, rem)):
+                cols = {}
+                for u, (d2, v2) in zip(p_uids, out_p):
+                    cols[u] = Column(d2[i], v2[i], chunk.columns[u].type_)
+                for u, (d2, v2) in zip(b_uids, out_b):
+                    cols[u] = Column(d2[i], v2[i],
+                                     self._build_schema_by_uid[u].type_)
+                if prow is not None:
+                    cols["__probe_row__"] = Column(prow[i], sel_t[i], INT64)
+                if bpos is not None:
+                    cols["__build_pos__"] = Column(bpos[i], sel_t[i], INT64)
+                yield Chunk(cols, sel_t[i])
+            w0 += T * C
+
+    def _qualified_matches(self, chunk: Chunk, start, count, cum,
+                           total: int):
         """[capacity] bool: probe rows with at least one build match passing
         other_cond — via windowed expansion (semi/anti joins carrying extra
         conditions, e.g. decorrelated EXISTS with non-equi predicates)."""
-        cum = jnp.cumsum(count)
-        total = int(cum[-1])
         matched = np.zeros(chunk.capacity, dtype=np.bool_)
-        cap = self.ctx.chunk_capacity
-        for w in range(0, total, cap):
-            out = self._expand_fn(chunk, start, count, count, cum, jnp.int64(w))
+        for out in self._expand_windows(chunk, start, count, count, cum,
+                                        total, bookkeeping=True):
             out = self._match_filter(out)
-            sel = np.asarray(out.sel)
-            rows = np.asarray(out.columns["__probe_row__"].data)[sel]
+            osel = np.asarray(out.sel)
+            rows = np.asarray(out.columns["__probe_row__"].data)[osel]
             matched[rows] = True
         return jnp.asarray(matched)
 
     def _match_filter(self, out: Chunk) -> Chunk:
         """Filter expanded candidate rows: exact key equality when the
-        keys were hash-packed, then other_cond if present."""
-        if "mf" not in self._filter_fns:
-            other = compile_predicate(self.other_cond) if self.other_cond is not None else None
+        keys were hash-packed, then other_cond if present. The compiled
+        fn is cached across queries by expr repr; the build key values
+        are ARGS (not closure state), so a cache hit can never read a
+        stale build side."""
+        if getattr(self, "_filter_fn", None) is None:
+            other = (compile_predicate(self.other_cond)
+                     if self.other_cond is not None else None)
             hash_mode = self._hash_mode
             probe_keys = self.probe_keys
             modes = self._pack_info[0][1] if hash_mode else ()
-            keyvals = getattr(self, "_build_keyvals_sorted", ())
 
-            def fn(ch):
+            def fn(ch, keyvals):
                 keep = ch.sel
                 if hash_mode:
                     pos = ch.columns["__build_pos__"].data
                     for k_ir, mode, bv in zip(probe_keys, modes, keyvals):
-                        pv = _as_int64_key(eval_expr(k_ir, ch)[0], mode)
+                        pv = jk.as_int64_key(eval_expr(k_ir, ch)[0], mode)
                         keep = keep & (jnp.take(bv, pos, mode="clip") == pv)
                 if other is not None:
                     keep = keep & other(ch)
                 return ch.with_sel(keep)
 
-            self._filter_fns["mf"] = counted_jit(fn)
-        return self._filter_fns["mf"](out)
+            self._filter_fn = cached_jit(
+                "joinfilter",
+                f"{hash_mode}:{modes}:{self.probe_keys!r}"
+                f":{self.other_cond!r}",
+                lambda: fn)
+        return self._filter_fn(out, tuple(
+            getattr(self, "_build_keyvals_dev", ())))
 
     def _null_build_chunk(self, chunk: Chunk, sel) -> Chunk:
         """Probe columns pass through; build payload is all-NULL."""
@@ -572,49 +844,6 @@ class HashJoinExec(Executor):
                 c.type_,
             )
         return Chunk(cols, sel)
-
-    def _make_expand_fn(self):
-        payload = self._build_payload
-        build_schema = {c.uid: c for c in (self.build_schema or [])}
-        kind = self.kind
-        n_build = max(self._n_build, 1)
-        cap = self.ctx.chunk_capacity
-        # only the match-filter path reads the bookkeeping columns;
-        # don't make the hot inner-join path carry them
-        with_probe_row = self.other_cond is not None or self._hash_mode
-        with_build_pos = self._hash_mode
-
-        def expand(chunk, start, count, real_count, cum, w):
-            j = jnp.arange(cap, dtype=jnp.int64) + w
-            total = cum[-1]
-            valid_out = j < total
-            probe_row = jnp.searchsorted(cum, j, side="right")
-            probe_row = jnp.clip(probe_row, 0, chunk.capacity - 1)
-            cum_excl = cum[probe_row] - count[probe_row]
-            k = j - cum_excl
-            build_pos = jnp.clip(start[probe_row] + k, 0, n_build - 1)
-
-            cols = {}
-            for uid, col in chunk.columns.items():
-                cols[uid] = col.gather(probe_row, valid_out)
-            if with_probe_row:
-                cols["__probe_row__"] = Column(probe_row, valid_out, INT64)
-            if with_build_pos:
-                cols["__build_pos__"] = Column(build_pos, valid_out, INT64)
-            # left join emits one slot even for unmatched probe rows; the
-            # build payload is NULL there (k beyond the real match count)
-            real = k < real_count[probe_row]
-            for uid, (d, v) in payload.items():
-                data = jnp.take(d, build_pos, mode="clip")
-                valid = jnp.take(v, build_pos, mode="clip") & valid_out
-                if kind == "left":
-                    valid = valid & real
-                c = build_schema[uid]
-                cols[uid] = Column(data, valid, c.type_)
-            return Chunk(cols, valid_out)
-
-        return counted_jit(expand)
-
 
 class IndexJoinExec(Executor):
     """Index-lookup join (ref: executor's IndexLookUpJoin; SURVEY.md:91):

@@ -257,6 +257,9 @@ def probe_ranges(sorted_hashes: jax.Array, probes: jax.Array,
     consumes them (hi - lo counts and lo + k positions). Falls back to
     searchsorted inside the SAME jit when the table build overflows its
     displacement bound, so callers never see a behavioral difference."""
+    from tidb_tpu.ops.join_kernels import _note_trace
+
+    _note_trace("hash_probe")  # trace-time only: joins the retrace guard
     Rb = sorted_hashes.shape[0]
     cap = min(_next_pow2(max(2 * Rb, 16)), MAX_CAPACITY)
     if cap < 2 * Rb or Rb == 0:

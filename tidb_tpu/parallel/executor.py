@@ -432,9 +432,10 @@ class DistFragmentExec(HashAggExec):
         like every other unsupported shape (round-2 review weak #6 — it
         used to be a hard error telling the user to flip a sysvar)."""
         args, shapes = [], []
+        limit = getattr(self.ctx, "broadcast_rows_limit", BROADCAST_LIMIT)
         for bc in prog.broadcasts:
             data, valid, sel, n = self._materialize_broadcast(bc)
-            if n > BROADCAST_LIMIT:
+            if n > limit:
                 raise _BroadcastTooLarge(n)
             args += [data, valid, sel]
             shapes.append(len(sel))

@@ -486,25 +486,22 @@ class _Compiler:
             Rp = pch2.capacity
             Rb = bch2.capacity
 
-            # local sorted-range join on the hash; validity is a secondary
-            # sort key so valid rows prefix each equal-hash run
-            b_live = bch2.sel & b_kvalid2
-            inval = (~b_live).astype(jnp.int32)
-            sh, sinv, order = jax.lax.sort(
-                (b_hash2, inval, jnp.arange(Rb)), num_keys=2)
-            cvi = jnp.concatenate([
-                jnp.zeros(1, dtype=jnp.int64),
-                jnp.cumsum((sinv == 0).astype(jnp.int64)),
-            ])
-            # probe strategy: open-addressing hash table (ops/
-            # hash_probe.py — O(1)-expected VMEM probes on TPU, the
-            # SURVEY.md:294-296 fast path) or searchsorted (O(log Rb));
-            # identical (lo, hi) semantics either way
-            from tidb_tpu.ops.hash_probe import probe_for_join
+            # local sorted-range join on the hash, through the SAME
+            # fused primitives the single-chip executor runs
+            # (ops/join_kernels): sorted build runs with dead rows
+            # sorted after live ones, probe via the configured strategy
+            # (ops/hash_probe's open-addressing VMEM table on TPU,
+            # searchsorted elsewhere), and scatter+prefix-sum expansion
+            from tidb_tpu.ops.join_kernels import (
+                probe_hash_ranges,
+                sort_build_hashes,
+                tile_positions,
+            )
 
-            lo, hi = probe_for_join(sh, p_hash2)
+            b_live = bch2.sel & b_kvalid2
+            sh, cvi, order = sort_build_hashes(b_hash2, b_live)
             p_ok = pch2.sel & p_kvalid2
-            cnt = jnp.where(p_ok, cvi[hi] - cvi[lo], 0)
+            lo, cnt = probe_hash_ranges(sh, cvi, p_hash2, p_ok)
 
             cum = jnp.cumsum(cnt)
             total = cum[-1]
@@ -514,11 +511,8 @@ class _Compiler:
             factor = (total + capJ - 1) // capJ
             ovfs.append((g_expand, pmax_compat(jnp.maximum(factor - 1, 0), _AXES)))
 
-            j = jnp.arange(capJ, dtype=jnp.int64)
-            valid_out = j < total
-            p_row = jnp.clip(jnp.searchsorted(cum, j, side="right"), 0, Rp - 1)
-            k = j - (cum[p_row] - cnt[p_row])
-            b_sorted_pos = jnp.clip(lo[p_row] + k, 0, Rb - 1)
+            valid_out, p_row, b_sorted_pos, k = tile_positions(
+                lo, cnt, cum, 0, capJ, Rp, Rb)
             b_row = order[b_sorted_pos]
 
             sel_out = valid_out

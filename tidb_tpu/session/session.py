@@ -675,6 +675,12 @@ class Session:
             device_agg=bool(self.sysvars.get("tidb_enable_tpu_exec"))
             and self._device_engine_auto(),
             device_cache_bytes=int(self.sysvars.get("tidb_device_cache_bytes")),
+            join_device_build=bool(
+                self.sysvars.get("tidb_tpu_join_device_build")),
+            join_tiles=int(
+                self.sysvars.get("tidb_tpu_join_tiles_per_dispatch")),
+            broadcast_rows_limit=int(
+                self.sysvars.get("tidb_broadcast_join_threshold_count")),
             cancel_check=lambda: self._killed or self._kill_query,
         )
 

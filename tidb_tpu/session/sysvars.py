@@ -89,6 +89,13 @@ _reg(
     # instead of residing wholly in device memory (the >HBM path)
     SysVar("tidb_device_cache_bytes", 8 << 30, BOTH, "int",
            min_=1 << 20, max_=1 << 45),
+    # partitioned device join (ISSUE 3): device-resident build sort,
+    # fused-expand tile budget, and the fragment broadcast-build ceiling
+    SysVar("tidb_tpu_join_device_build", True, BOTH, "bool"),
+    SysVar("tidb_tpu_join_tiles_per_dispatch", 8, BOTH, "int",
+           min_=1, max_=64),
+    SysVar("tidb_broadcast_join_threshold_count", 1 << 21, BOTH, "int",
+           min_=1 << 10, max_=1 << 28),
     # fixed device batch capacity (ref: tidb_max_chunk_size)
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)

@@ -52,6 +52,18 @@ def count() -> int:
     return getattr(_tls, "count", 0)
 
 
+def record_compile(kernel: str = "join") -> None:
+    """Count one kernel (re)trace on this thread. Called from inside
+    traced jit bodies (they only execute at trace time), so the counter
+    moves on real XLA compilations — EXPLAIN ANALYZE diffs it around
+    each operator to surface per-operator recompiles."""
+    _tls.compiles = getattr(_tls, "compiles", 0) + 1
+
+
+def compile_count() -> int:
+    return getattr(_tls, "compiles", 0)
+
+
 def by_site() -> dict:
     """Cumulative per-site breakdown (for profiling, not EXPLAIN)."""
     return dict(getattr(_tls, "by_site", {}))

@@ -38,18 +38,22 @@ def _wrap(e) -> None:
     def open_(ctx):
         t0 = time.perf_counter()
         d0 = dispatch.count()
+        c0 = dispatch.compile_count()
         try:
             return orig_open(ctx)
         finally:
             st.open_wall += time.perf_counter() - t0
             st.dispatches += dispatch.count() - d0
+            st.recompiles += dispatch.compile_count() - c0
 
     def next_():
         t0 = time.perf_counter()
         d0 = dispatch.count()
+        c0 = dispatch.compile_count()
         ch = orig_next()
         st.next_wall += time.perf_counter() - t0
         st.dispatches += dispatch.count() - d0
+        st.recompiles += dispatch.compile_count() - c0
         if ch is not None:
             st.chunks += 1
             st.rows += int(np.asarray(ch.sel).sum())
@@ -71,12 +75,15 @@ def analyze_text(root) -> str:
         own = max(total - child_total, 0.0)
         own_disp = max(
             e.stats.dispatches - sum(c.stats.dispatches for c in e.children), 0)
+        own_rc = max(
+            e.stats.recompiles - sum(c.stats.recompiles for c in e.children), 0)
         rows.append((
             indent + type(e).__name__.replace("Exec", ""),
             str(e.stats.rows),
             f"{total * 1e3:.1f}ms",
             f"open:{e.stats.open_wall * 1e3:.1f}ms own:{own * 1e3:.1f}ms "
-            f"loops:{e.stats.chunks} dispatches:{own_disp}",
+            f"loops:{e.stats.chunks} dispatches:{own_disp}"
+            + (f" recompiles:{own_rc}" if own_rc else ""),
         ))
         for i, c in enumerate(e.children):
             visit(c, depth + 1, i == len(e.children) - 1)

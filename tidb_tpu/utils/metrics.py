@@ -195,6 +195,21 @@ PLAN_SECONDS = Histogram(
     "tidb_tpu_plan_seconds",
     "Logical optimization + physical lowering wall time per "
     "plan_statement call (cache hits skip this entirely)")
+JOIN_COMPILE_TOTAL = Counter(
+    "tidb_tpu_join_compile_total",
+    "Join kernel (re)traces by kernel (build_sort/probe/expand) — "
+    "incremented at TRACE time inside the fused join kernels, so a "
+    "steady-state repeated join must not move it (the retrace guard "
+    "test and EXPLAIN ANALYZE's recompiles field both read it)")
+JOIN_PROBE_SECONDS = Histogram(
+    "tidb_tpu_join_probe_seconds",
+    "Wall time of one fused probe+expand pass over a probe chunk, by "
+    "join kind")
+JOIN_BUILD_SECONDS = Histogram(
+    "tidb_tpu_join_build_seconds",
+    "Wall time of one hash-join build phase (drain + pack + sort), by "
+    "tier: host (numpy probe path), device (fused on-device sort), "
+    "host_sorted (tidb_tpu_join_device_build=0 escape hatch)")
 MEM_QUOTA_ENGAGED = Counter(
     "tidb_tpu_mem_quota_engaged_total",
     "Queries whose host memory consumption crossed tidb_mem_quota_query "
