@@ -1,0 +1,342 @@
+"""DCN chaos suite: a grid of (failpoint x query shape) where every run
+must either return rows identical to the no-fault run (retry / replica
+failover) or raise a clean TYPED error — never a hang, never a leaked
+cursor or socket (asserted by post-run worker state). The failpoints sit
+at every protocol boundary: coordinator connect/send/recv, mid-page
+fetch, and the worker's handler/partial/page edges.
+
+Workers run IN-PROCESS (threads) so the process-global failpoint
+registry reaches both sides of the wire."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import ExecutionError, TiDBTPUError
+from tidb_tpu.parallel.dcn import DOWN, SUSPECT, UP, Cluster, Worker
+from tidb_tpu.utils import failpoint as fp
+from tidb_tpu.utils.failpoint import failpoint
+
+N_ROWS = 600
+PAGE = 32  # force multi-page drains so mid-page faults have a window
+
+
+def _mk_cluster(replicas={0: 1, 1: 0}, n_rows=N_ROWS):
+    workers = [Worker() for _ in range(2)]
+    for w in workers:
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+    cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                 replicas=replicas, rpc_timeout_s=15.0,
+                 connect_timeout_s=5.0)
+    cl.PAGE_ROWS = PAGE
+    cl.broadcast_exec("create table c (k bigint, grp bigint, v bigint)")
+    half = n_rows // 2
+    ks = np.arange(n_rows, dtype=np.int64)
+    cl.load_partition(0, "c", arrays={
+        "k": ks[:half], "grp": ks[:half] % 7, "v": ks[:half] * 3}, db="test")
+    cl.load_partition(1, "c", arrays={
+        "k": ks[half:], "grp": ks[half:] % 7, "v": ks[half:] * 3}, db="test")
+    return workers, cl
+
+
+QUERIES = {
+    "group_agg": ("select grp, count(*) as n, sum(v) as s from c "
+                  "group by grp order by grp"),
+    "global_agg": "select count(*) as n, sum(v) as s, avg(k) as a from c",
+    "topn": "select k, v from c order by v desc, k limit 9",
+    "scan": "select k, v from c order by k",  # ~9 pages/worker at PAGE=32
+}
+
+# (failpoint name, kwargs) — coordinator link faults surface as broken
+# sockets (ConnectionError), worker faults travel back as error
+# responses; times=1 so the retry/failover attempt finds a healthy path
+FAULTS = [
+    ("dcn.coord.send", dict(exc=ConnectionError, times=1)),
+    ("dcn.coord.recv", dict(exc=ConnectionError, times=1)),
+    ("dcn.coord.fetch", dict(exc=ConnectionError, times=1)),
+    ("dcn.worker.handle", dict(times=1)),
+    ("dcn.worker.partial", dict(times=1)),
+    ("dcn.worker.page", dict(times=1)),
+]
+
+
+def _kill_worker(w):
+    """Hard-kill an in-process worker. shutdown() is required: close()
+    alone leaves the blocked accept() holding the kernel socket, which
+    would serve one last zombie connection."""
+    w._running = False
+    try:
+        w._sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    w._sock.close()
+
+
+def _assert_clean(workers, cl):
+    """Post-run invariants: no cursor pinned on any worker, no cancel
+    event leaked, and the fleet answers a fresh no-fault query."""
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if all(not w._cursors for w in workers) \
+                and all(not w._inflight for w in workers):
+            break
+        time.sleep(0.02)
+    assert all(not w._cursors for w in workers), \
+        [len(w._cursors) for w in workers]
+    assert all(not w._inflight for w in workers), \
+        [len(w._inflight) for w in workers]
+
+
+class TestChaosGrid:
+    @pytest.mark.parametrize("qname", sorted(QUERIES))
+    @pytest.mark.parametrize("fault", [f[0] for f in FAULTS])
+    def test_fault_is_survivable_or_typed(self, fault, qname):
+        kwargs = dict(next(kw for n, kw in FAULTS if n == fault))
+        sql = QUERIES[qname]
+        workers, cl = _mk_cluster()
+        try:
+            want = cl.query(sql)  # no-fault baseline on this cluster
+            with failpoint(fault, **kwargs):
+                try:
+                    got = cl.query(sql, timeout_s=30.0)
+                except (TiDBTPUError, ConnectionError, OSError):
+                    got = None  # clean typed failure is acceptable
+            if got is not None:
+                assert got == want, f"{fault} x {qname}"
+            _assert_clean(workers, cl)
+            # the failure domain recovered: same query, no fault, exact
+            assert cl.query(sql) == want
+        finally:
+            cl.shutdown()
+
+    def test_reconnect_refused_falls_to_replica(self):
+        """A link fault whose reconnect ALSO fails (dcn.connect armed)
+        must exhaust the retry and land on the replica — same rows."""
+        workers, cl = _mk_cluster()
+        try:
+            sql = QUERIES["group_agg"]
+            want = cl.query(sql)
+            from tidb_tpu.utils.metrics import DCN_FAILOVER_TOTAL
+
+            f0 = DCN_FAILOVER_TOTAL.value()
+            with failpoint("dcn.coord.send", exc=ConnectionError, times=1):
+                with failpoint("dcn.connect", exc=ConnectionError, times=1):
+                    assert cl.query(sql, timeout_s=30.0) == want
+            assert DCN_FAILOVER_TOTAL.value() > f0
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+
+class TestChaosModes:
+    def test_probabilistic_faults_never_corrupt(self):
+        """Seeded probabilistic mid-drain link faults over repeated
+        runs: every query is exact or fails typed; never silent loss."""
+        workers, cl = _mk_cluster()
+        try:
+            sql = QUERIES["scan"]  # fetch-heavy: ~9 pages per worker
+            want = cl.query(sql)
+            survived = 0
+            # ~18 fetches per no-fault run: p=0.05 keeps whole-drain
+            # survival likely while still firing across the batch
+            with failpoint("dcn.coord.fetch", exc=ConnectionError,
+                           prob=0.05, seed=7):
+                for _ in range(6):
+                    try:
+                        got = cl.query(sql, timeout_s=30.0)
+                    except (TiDBTPUError, ConnectionError, OSError):
+                        continue
+                    assert got == want
+                    survived += 1
+            assert fp.hits("dcn.coord.fetch") > 0  # the fault was live
+            _assert_clean(workers, cl)
+            assert cl.query(sql) == want
+            assert survived > 0  # failover did save at least one run
+        finally:
+            cl.shutdown()
+
+    def test_nth_trigger_hits_mid_drain(self):
+        """nth=3 arms the THIRD fetch — a mid-page fault after real
+        progress; failover must still produce exact rows."""
+        workers, cl = _mk_cluster()
+        try:
+            sql = QUERIES["scan"]  # fetch-heavy: ~9 pages per worker
+            want = cl.query(sql)
+            with failpoint("dcn.coord.fetch", exc=ConnectionError, nth=3):
+                assert cl.query(sql, timeout_s=30.0) == want
+            assert fp.hits("dcn.coord.fetch") >= 3
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+    def test_fragment_compile_fault_is_clean(self):
+        """The mesh-tier compile boundary: an injected failure surfaces
+        as the injected error, not a half-built fragment program."""
+        from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.session import Session
+
+        mesh = make_mesh(n_shards=4, n_dcn=2)
+        s = Session(chunk_capacity=4096, mesh=mesh)
+        s.execute("set tidb_device_engine_mode = 'force'")
+        s.execute("create table fc (a bigint, b bigint)")
+        s.catalog.table("test", "fc").insert_columns(
+            {"a": np.arange(1000, dtype=np.int64),
+             "b": np.arange(1000, dtype=np.int64) % 5})
+        sql = "select b, sum(a) as s from fc group by b order by b"
+        want = s.query(sql)
+        with failpoint("fragment.compile", exc=ExecutionError):
+            with pytest.raises(ExecutionError):
+                s.query(sql)
+        assert s.query(sql) == want  # recovered, exact
+
+
+class TestHealthMachine:
+    def test_states_and_backoff(self):
+        """UP -> SUSPECT on first failure (immediate half-open probe),
+        -> DOWN with growing backoff while the worker stays dead, -> UP
+        again once it answers; /cluster-visible via health_snapshot."""
+        workers, cl = _mk_cluster(replicas={})
+        try:
+            assert cl.health_snapshot()["workers"][0]["state"] == UP
+            # sever the link without killing the worker: SUSPECT's
+            # immediate reconnect probe succeeds
+            cl._socks[0].close()
+            assert cl._call_retry(0, {"cmd": "ping"}) == "pong"
+            h = cl._health[0]
+            assert h.reconnects >= 1 and h.state == UP
+            # now kill the worker for real: DOWN with a backoff window
+            _kill_worker(workers[0])
+            cl._socks[0].close()
+            with pytest.raises((ConnectionError, OSError)):
+                cl._call_retry(0, {"cmd": "ping"})
+            for _ in range(3):
+                with pytest.raises((ConnectionError, OSError)):
+                    cl._call(0, {"cmd": "ping"})
+                time.sleep(0.05)
+            snap = cl.health_snapshot()["workers"][0]
+            assert snap["state"] == DOWN and snap["attempts"] >= 1
+            assert snap["last_error"]
+        finally:
+            cl.shutdown()
+
+    def test_worker_restart_readmitted_without_coordinator_restart(self):
+        """Kill a worker, restart it on the same port, reload its
+        partition: the backoff/reconnect machine re-admits it — no new
+        Cluster object — and the retry metric reflects the episode."""
+        from tidb_tpu.utils.metrics import DCN_RETRY_TOTAL
+
+        workers, cl = _mk_cluster(replicas={})
+        try:
+            sql = QUERIES["global_agg"]
+            want = cl.query(sql)
+            port0 = workers[0].port
+            _kill_worker(workers[0])
+            cl._socks[0].close()
+            with pytest.raises((ConnectionError, OSError, ExecutionError)):
+                cl.query(sql, timeout_s=10.0)  # no replica: typed failure
+            assert cl.health_snapshot()["workers"][0]["state"] in (
+                SUSPECT, DOWN)
+            r0 = DCN_RETRY_TOTAL.value(kind="reconnect")
+            # resurrect on the SAME endpoint and repopulate its partition
+            w0b = Worker(port=port0)
+            threading.Thread(target=w0b.serve_forever, daemon=True).start()
+            workers[0] = w0b
+            time.sleep(cl.RECONNECT_CAP_S * (1 + cl.JITTER_FRAC) + 0.05)
+            w0b.session.execute(
+                "create table c (k bigint, grp bigint, v bigint)")
+            half = N_ROWS // 2
+            ks = np.arange(N_ROWS, dtype=np.int64)
+            cl.load_partition(0, "c", arrays={
+                "k": ks[:half], "grp": ks[:half] % 7,
+                "v": ks[:half] * 3}, db="test")
+            assert cl.query(sql) == want  # exact, through the new link
+            snap = cl.health_snapshot()["workers"][0]
+            assert snap["state"] == UP and snap["reconnects"] >= 1
+            assert DCN_RETRY_TOTAL.value(kind="reconnect") > r0
+        finally:
+            cl.shutdown()
+
+    def test_partial_results_mode_serves_survivors(self):
+        """With no replica and partial results opted in, losing one
+        worker degrades to the reachable partitions plus a warning —
+        instead of failing the query."""
+        workers, cl = _mk_cluster(replicas={})
+        cl.partial_results = True
+        try:
+            full = cl.query(QUERIES["global_agg"])
+            _kill_worker(workers[0])
+            cl._socks[0].close()
+            got = cl.query(QUERIES["global_agg"], timeout_s=10.0)
+            assert got != full  # half the rows are gone, loudly
+            assert cl.last_warnings and "PARTIAL" in cl.last_warnings[0]
+            assert got[0][0] == N_ROWS // 2  # exactly worker 1's share
+        finally:
+            cl.shutdown()
+
+
+class TestSatelliteFixes:
+    def test_nonadvancing_cursor_raises_not_hangs(self):
+        """A fetch that returns 0 rows while rows are still owed must
+        raise a clean ExecutionError, not spin forever."""
+        workers, cl = _mk_cluster()
+        try:
+            orig = cl._call
+
+            def stuck(i, msg):
+                if msg.get("cmd") == "fetch":
+                    return []
+                return orig(i, msg)
+
+            cl._call = stuck
+            first = {"rows": [(1,)], "cursor": 9, "total": 5}
+            with pytest.raises(ExecutionError, match="stopped advancing"):
+                cl._drain_pages(0, first)
+        finally:
+            cl._call = orig
+            cl.shutdown()
+
+    def test_call_all_reports_every_failed_worker(self):
+        """Concurrent fan-out failures: the raised error is the LOWEST
+        failed index's, and the message lists all of them."""
+        workers, cl = _mk_cluster()
+        try:
+            orig = cl._call
+
+            def boom(i, msg):
+                raise ConnectionError(f"boom{i}")
+
+            cl._call = boom
+            with pytest.raises(ConnectionError) as ei:
+                cl._call_all([{"cmd": "ping"}] * 2)
+            msg = str(ei.value)
+            assert "boom0" in msg and "boom1" in msg
+        finally:
+            cl._call = orig
+            cl.shutdown()
+
+    def test_cluster_status_endpoint(self):
+        """/cluster on the status port renders the live health machine."""
+        import json
+        import urllib.request
+
+        from tidb_tpu.server.status import StatusServer
+
+        workers, cl = _mk_cluster()
+        srv = StatusServer(cl._merge_session.catalog, port=0)
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/cluster", timeout=10).read()
+            snap = json.loads(body)
+            ours = [c for c in snap["clusters"]
+                    if {w["endpoint"] for w in c["workers"]}
+                    == {f"127.0.0.1:{w.port}" for w in workers}]
+            assert ours, snap
+            assert all(w["state"] == UP for w in ours[0]["workers"])
+            assert ours[0]["partitioned"] == ["c"]
+        finally:
+            srv.stop()
+            cl.shutdown()

@@ -1,0 +1,240 @@
+"""Statement deadlines (max_execution_time) and KILL propagation —
+local chunk loops AND the DCN tier, including that remote workers
+observably stop (asserted via worker-side counters).
+
+Worker slowness is made deterministic with failpoint ACTIONS (a sleep at
+the worker's partial boundary), not wall-clock-sized data."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import QueryKilledError, QueryTimeoutError
+from tidb_tpu.parallel.dcn import Cluster, Worker
+from tidb_tpu.session import Session
+from tidb_tpu.utils.failpoint import failpoint
+
+
+def _settle(pred, timeout=8.0):
+    """Worker-side effects (counters, inflight cleanup) land when the
+    worker's own thread reaches its next poll — wait for them."""
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _mk_cluster(n_rows=400):
+    workers = [Worker() for _ in range(2)]
+    for w in workers:
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+    cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                 replicas={0: 1, 1: 0}, rpc_timeout_s=15.0,
+                 connect_timeout_s=5.0)
+    cl.broadcast_exec("create table d (k bigint, v bigint)")
+    half = n_rows // 2
+    ks = np.arange(n_rows, dtype=np.int64)
+    cl.load_partition(0, "d", arrays={"k": ks[:half], "v": ks[:half] * 2},
+                      db="test")
+    cl.load_partition(1, "d", arrays={"k": ks[half:], "v": ks[half:] * 2},
+                      db="test")
+    return workers, cl
+
+
+class TestLocalDeadline:
+    def test_max_execution_time_aborts_local_statement(self):
+        from tidb_tpu.utils.metrics import DEADLINE_EXCEEDED_TOTAL
+
+        s = Session(chunk_capacity=1024)
+        s.execute("create table big (a bigint)")
+        s.catalog.table("test", "big").insert_columns(
+            {"a": np.arange(200_000, dtype=np.int64)})
+        s.execute("set max_execution_time = 1")  # 1 ms: must expire
+        d0 = DEADLINE_EXCEEDED_TOTAL.value()
+        with pytest.raises(QueryTimeoutError,
+                           match="maximum statement execution time exceeded"):
+            s.query("select count(*) from big b1 join big b2 "
+                    "on b1.a = b2.a where b1.a % 3 = 0")
+        assert DEADLINE_EXCEEDED_TOTAL.value() > d0
+        assert QueryTimeoutError.code == 3024  # ER_QUERY_TIMEOUT
+        # 0 disarms: the same statement completes
+        s.execute("set max_execution_time = 0")
+        assert s.query("select count(*) from big")[0][0] == 200_000
+
+    def test_deadline_scoped_per_statement(self):
+        """The deadline re-arms per statement — a fast statement under
+        the same budget is untouched, and the budget never leaks into
+        the next statement."""
+        s = Session()
+        s.execute("set max_execution_time = 5000")
+        assert s.query("select 1 + 1") == [(2,)]
+        assert s._stmt_deadline is None  # disarmed at statement end
+
+
+class TestDcnDeadline:
+    def test_deadline_propagates_to_workers(self):
+        """A worker that would outlive the statement budget aborts
+        SERVER-SIDE: the shipped deadline_s arms the worker session's
+        external deadline, its chunk poll raises the typed error, and
+        the worker's deadline_exceeded counter proves it stopped."""
+        workers, cl = _mk_cluster()
+        try:
+            s = Session()
+            s.execute("set max_execution_time = 120")
+            # make every worker partial deterministically outlive 120ms
+            with failpoint("dcn.worker.partial",
+                           action=lambda: time.sleep(0.3)):
+                with pytest.raises(QueryTimeoutError):
+                    cl.query("select count(*) as n, sum(v) as sv from d",
+                             session=s)
+            def stopped():
+                # through the wire, like an operator would ask
+                return sum(st["deadline_exceeded"] + st["cancelled"]
+                           for st in cl.worker_stats())
+
+            assert _settle(lambda: stopped() >= 1), cl.worker_stats()
+            assert _settle(lambda: all(not w._cursors for w in workers))
+            # the OTHER worker's partial may still be unwinding when
+            # the coordinator raises — wait for its cleanup too
+            assert _settle(lambda: all(not w._inflight for w in workers))
+            # the cluster is healthy afterwards: exact rows, no budget
+            s.execute("set max_execution_time = 0")
+            n = 400
+            assert cl.query("select count(*) as n, sum(v) as sv from d",
+                            session=s) == [(n, sum(range(n)) * 2)]
+        finally:
+            cl.shutdown()
+
+    def test_timeout_s_without_session(self):
+        """Cluster.query's explicit timeout_s bounds a session-less
+        query the same way."""
+        workers, cl = _mk_cluster()
+        try:
+            with failpoint("dcn.worker.partial",
+                           action=lambda: time.sleep(0.3)):
+                with pytest.raises(QueryTimeoutError):
+                    cl.query("select count(*) as n from d", timeout_s=0.1)
+            assert all(not w._inflight for w in workers)
+        finally:
+            cl.shutdown()
+
+    def test_rpc_timeout_sysvar_bounds_round_trips(self):
+        """tidb_tpu_dcn_rpc_timeout (ms) bounds ONE RPC even with no
+        statement deadline: a worker stalled far past it surfaces a
+        clean ConnectionError instead of pinning the coordinator."""
+        workers, cl = _mk_cluster()
+        try:
+            s = Session()
+            s.execute("set tidb_tpu_dcn_rpc_timeout = 150")
+            with failpoint("dcn.worker.partial",
+                           action=lambda: time.sleep(1.0)):
+                t0 = time.monotonic()
+                with pytest.raises((ConnectionError, OSError)):
+                    cl.query("select count(*) as n from d", session=s)
+                assert time.monotonic() - t0 < 10.0  # not the full stall x4
+        finally:
+            cl.shutdown()
+
+
+class TestKillDistributed:
+    def _run_query_in_thread(self, cl, sql, session):
+        box = {}
+
+        def victim():
+            try:
+                box["rows"] = cl.query(sql, session=session)
+            except Exception as e:  # noqa: BLE001
+                box["err"] = e
+
+        th = threading.Thread(target=victim)
+        th.start()
+        return th, box
+
+    def test_kill_query_stops_remote_partials(self):
+        """KILL QUERY against a session blocked in Cluster.query:
+        the coordinator-side join is interrupted, a cancel fans out on
+        fresh connections, and every worker's poll aborts its partial —
+        observable via the cancelled/cancel_rpcs counters."""
+        workers, cl = _mk_cluster()
+        try:
+            s = Session()
+            killer = Session(catalog=s.catalog)
+            # hold every worker partial long enough for the KILL to land
+            with failpoint("dcn.worker.partial",
+                           action=lambda: time.sleep(0.6)):
+                th, box = self._run_query_in_thread(
+                    cl, "select count(*) as n, sum(v) as sv from d", s)
+                time.sleep(0.15)  # let the dispatch reach the workers
+                killer.execute(f"kill query {s.conn_id}")
+                th.join(timeout=30)
+            assert not th.is_alive()
+            assert isinstance(box.get("err"), QueryKilledError), box
+            assert sum(w.stats["cancel_rpcs"] for w in workers) >= 1
+            assert _settle(lambda: sum(w.stats["cancelled"]
+                                       for w in workers) >= 1), \
+                [dict(w.stats) for w in workers]
+            assert _settle(
+                lambda: all(not w._inflight for w in workers))
+            # KILL QUERY is one-shot: the session and fleet keep working
+            n = 400
+            assert cl.query("select count(*) as n, sum(v) as sv from d",
+                            session=s) == [(n, sum(range(n)) * 2)]
+        finally:
+            cl.shutdown()
+
+    def test_kill_connection_fails_distributed_query_permanently(self):
+        workers, cl = _mk_cluster()
+        try:
+            s = Session()
+            killer = Session(catalog=s.catalog)
+            with failpoint("dcn.worker.partial",
+                           action=lambda: time.sleep(0.6)):
+                th, box = self._run_query_in_thread(
+                    cl, "select count(*) as n from d", s)
+                time.sleep(0.15)
+                killer.execute(f"kill {s.conn_id}")
+                th.join(timeout=30)
+            assert not th.is_alive()
+            assert isinstance(box.get("err"), QueryKilledError), box
+            assert "killed" in str(box["err"])
+            with pytest.raises(Exception, match="killed"):
+                s.execute("select 1")
+        finally:
+            cl.shutdown()
+
+
+class TestKillLocal:
+    def test_kill_query_long_local_scan_is_typed(self):
+        """KILL QUERY against a long LOCAL chunked scan raises the typed
+        QueryKilledError (ER_QUERY_INTERRUPTED), not a bare
+        ExecutionError. Timing-tolerant like the surface test: the query
+        may legitimately finish first, but a kill that lands must be
+        typed."""
+        s = Session(chunk_capacity=2048)
+        killer = Session(catalog=s.catalog)
+        s.execute("create table lk (a bigint)")
+        s.catalog.table("test", "lk").insert_columns(
+            {"a": np.arange(400_000, dtype=np.int64)})
+        box = {}
+
+        def victim():
+            try:
+                box["rows"] = s.query(
+                    "select count(*) from lk t1 join lk t2 on t1.a = t2.a")
+            except Exception as e:  # noqa: BLE001
+                box["err"] = e
+
+        th = threading.Thread(target=victim)
+        th.start()
+        time.sleep(0.2)
+        killer.execute(f"kill query {s.conn_id}")
+        th.join(timeout=60)
+        assert not th.is_alive()
+        if "err" in box:
+            assert isinstance(box["err"], QueryKilledError)
+            assert QueryKilledError.code == 1317  # ER_QUERY_INTERRUPTED
+        assert s.query("select 1") == [(1,)]  # one-shot

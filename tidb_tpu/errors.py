@@ -71,3 +71,20 @@ class OOMError(ExecutionError):
     code = 1105
 
 
+class QueryKilledError(ExecutionError):
+    """Statement cancelled by KILL QUERY / KILL CONNECTION (ref:
+    ER_QUERY_INTERRUPTED — the executor's chunk loop and the DCN
+    coordinator both raise it so a kill is typed end to end)."""
+
+    code = 1317  # ER_QUERY_INTERRUPTED
+
+
+class QueryTimeoutError(ExecutionError):
+    """max_execution_time deadline exceeded (ref: ER_QUERY_TIMEOUT;
+    MySQL's "maximum statement execution time exceeded"). Raised by the
+    local chunk loop, by DCN workers that received the statement's
+    remaining budget, and by the coordinator when an RPC outlives it."""
+
+    code = 3024  # ER_QUERY_TIMEOUT
+
+

@@ -11,7 +11,29 @@ import numpy as np
 from tidb_tpu.chunk.chunk import Chunk
 from tidb_tpu.planner.binder import PlanCol
 
-__all__ = ["ExecContext", "Executor", "ResultSet", "RuntimeStats", "run_plan"]
+__all__ = ["ExecContext", "Executor", "ResultSet", "RuntimeStats",
+           "run_plan", "raise_if_cancelled"]
+
+
+def raise_if_cancelled(ctx: "ExecContext") -> None:
+    """Poll the context's cancel hook (KILL flags + statement deadline).
+
+    The hook may return a bool (legacy callers) or an exception instance
+    carrying the cancellation REASON — a deadline expiry must surface as
+    the MySQL "maximum statement execution time exceeded" error, not as
+    a generic KILL. Every long executor loop (the chunk loop here, the
+    streamed fragment loops on the dist tier) polls through this one
+    function so the classification can never diverge."""
+    if ctx.cancel_check is None:
+        return
+    r = ctx.cancel_check()
+    if not r:
+        return
+    if isinstance(r, BaseException):
+        raise r
+    from tidb_tpu.errors import QueryKilledError
+
+    raise QueryKilledError("Query execution was interrupted (KILL)")
 
 
 @dataclass
@@ -144,11 +166,7 @@ def _run_plan(root: Executor, ctx: ExecContext, n_visible: Optional[int] = None)
         dicts = {c.uid: c.dict_ for c in visible if c.dict_ is not None}
         rows: List[tuple] = []
         for ch in root.chunks():
-            if ctx.cancel_check is not None and ctx.cancel_check():
-                from tidb_tpu.errors import ExecutionError
-
-                raise ExecutionError(
-                    "Query execution was interrupted (KILL)")
+            raise_if_cancelled(ctx)
             rows.extend(ch.to_pylist(dicts=dicts, names=uids))
         return ResultSet(
             names=[c.name for c in visible],

@@ -37,25 +37,55 @@ import datetime
 import decimal
 import hashlib
 import hmac
+import itertools
 import os
+import random
 import socket
 import struct
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from tidb_tpu.errors import ExecutionError, UnsupportedError
+from tidb_tpu.errors import (
+    ExecutionError,
+    QueryKilledError,
+    QueryTimeoutError,
+    UnsupportedError,
+)
 from tidb_tpu.parser import ast as A
 from tidb_tpu.parser import parse
 from tidb_tpu.parser.printer import expr_to_sql
+from tidb_tpu.utils.failpoint import inject
 
-__all__ = ["Worker", "Cluster", "partial_rewrite"]
+__all__ = ["Worker", "Cluster", "partial_rewrite", "clusters_alive"]
+
+# health-machine states, exported for tests and /cluster
+UP, SUSPECT, DOWN = "up", "suspect", "down"
+_STATE_CODE = {UP: 0, SUSPECT: 1, DOWN: 2}
+
+# live coordinator registry for the status port's /cluster endpoint
+_CLUSTERS: "weakref.WeakSet" = weakref.WeakSet()
+
+_TOKEN_SEQ = itertools.count(1)
+
+
+def clusters_alive() -> List["Cluster"]:
+    return list(_CLUSTERS)
 
 
 class DcnCodecError(ExecutionError):
     """Malformed wire frame: the connection is desynced and must die."""
+
+
+class DcnRpcTimeoutError(ConnectionError):
+    """An RPC outlived its socket deadline. Distinguished from a broken
+    link because the worker is PROBABLY STILL EXECUTING the request —
+    idempotent retry must not re-send (it would double the worker's
+    load and collide with the first attempt's cancel token); the caller
+    falls to replica failover instead."""
 
 _LEN = struct.Struct(">I")
 _D = struct.Struct(">d")
@@ -293,8 +323,53 @@ class Worker:
         # eviction is age-aware: an actively-draining cursor must never
         # be expired just because other coordinators opened newer ones.
         self._cursors: Dict[int, Tuple[float, List[tuple]]] = {}
+        # idempotency: token -> open cursor handle. A coordinator that
+        # lost a partial_paged RESPONSE retries with the same token; the
+        # retry evicts the orphaned first-attempt cursor so a lossy link
+        # can't pin partials until the TTL
+        self._token_cursors: Dict[str, int] = {}
         self._next_cursor = 1
         self._cursor_lock = threading.Lock()
+        # coordinator-cancellable in-flight statements: token -> Event.
+        # The cancel RPC arrives on its OWN connection (the statement's
+        # connection is blocked producing the response), sets the event,
+        # and the executing session's chunk-boundary poll aborts. A
+        # cancel can RACE the statement it targets (the side channel is
+        # faster than a queued partial): unknown tokens are remembered
+        # so a late-registering statement starts already-cancelled.
+        self._inflight: Dict[str, threading.Event] = {}
+        self._cancelled_tokens: Dict[str, float] = {}
+        self._inflight_lock = threading.Lock()
+        # ONE statement at a time on the shared session: an abandoned
+        # RPC's thread may still be executing when the coordinator
+        # reconnects and sends the next statement — unsynchronized,
+        # both would mutate session state concurrently. Cancels bypass
+        # this lock (own connection, _inflight only), so a queued
+        # statement can't deadlock behind one being cancelled.
+        self._exec_lock = threading.Lock()
+        # observable failure-domain counters (cmd "stats"): chaos tests
+        # and the kill/deadline suites assert workers actually stopped
+        self.stats: Dict[str, int] = {
+            "executed": 0, "cancelled": 0, "deadline_exceeded": 0,
+            "cancel_rpcs": 0, "pages": 0,
+        }
+        self._stats_lock = threading.Lock()
+
+    def _bump(self, key: str) -> None:
+        with self._stats_lock:
+            self.stats[key] += 1
+
+    def _drop_cursor_locked(self, h) -> None:
+        self._cursors.pop(h, None)
+        for t in [t for t, c in self._token_cursors.items() if c == h]:
+            del self._token_cursors[t]
+
+    def _drop_token_cursor_locked(self, token) -> None:
+        if token is None:
+            return
+        h = self._token_cursors.pop(token, None)
+        if h is not None:
+            self._cursors.pop(h, None)
 
     CURSOR_CAP = 64          # hard cap on concurrently open cursors
     CURSOR_TTL_S = 600.0     # only cursors idle this long are evictable
@@ -394,12 +469,99 @@ class Worker:
             except OSError:
                 pass
 
+    def _run_sql(self, msg: Dict):
+        """Execute a shipped statement under the RPC's failure domain:
+        the message's `deadline_s` (the coordinator statement's
+        REMAINING budget) arms the session's external deadline, and its
+        `token` registers a cancel event a coordinator-side KILL can set
+        out of band. Both are polled by the session's chunk loop, so the
+        worker stops burning CPU server-side instead of computing a
+        result nobody will read.
+
+        One worker session serves every connection, so the hooks are
+        save/restored around each statement — concurrent statements from
+        two coordinators would contend, which matches the single-session
+        design of the rest of this Worker."""
+        token = msg.get("token")
+        ev: Optional[threading.Event] = None
+        if token is not None:
+            ev = threading.Event()
+            with self._inflight_lock:
+                self._inflight[token] = ev
+                # the cancel may have beaten us here
+                if self._cancelled_tokens.pop(token, None) is not None:
+                    ev.set()
+        sess = self.session
+        # ownership-guarded hooks: an ABANDONED earlier attempt (the
+        # coordinator timed out and moved on, this thread kept running)
+        # finishes later — its cleanup must not clobber a newer
+        # statement's cancel event or deadline. Each attempt only
+        # resets state that is still ITS OWN (belt-and-braces under
+        # the exec lock; still needed for two-coordinator workers).
+        my_cancel = ev.is_set if ev is not None else None
+        my_deadline = msg.get("_deadline_mono")  # anchored at RECEIPT
+        self._bump("executed")
+        try:
+            with self._exec_lock:
+                if my_cancel is not None:
+                    sess._ext_cancel = my_cancel
+                if my_deadline is not None:
+                    sess._ext_deadline = my_deadline
+                try:
+                    return sess.execute(msg["sql"])
+                finally:
+                    if my_cancel is not None \
+                            and sess._ext_cancel is my_cancel:
+                        sess._ext_cancel = None
+                    if my_deadline is not None \
+                            and sess._ext_deadline == my_deadline:
+                        sess._ext_deadline = None
+        except QueryTimeoutError:
+            self._bump("deadline_exceeded")
+            raise
+        except QueryKilledError:
+            self._bump("cancelled")
+            raise
+        finally:
+            if token is not None:
+                with self._inflight_lock:
+                    if self._inflight.get(token) is ev:
+                        del self._inflight[token]
+
     def _handle(self, msg: Dict):
+        if msg.get("deadline_s") is not None:
+            # statement budget anchored NOW, before any injected fault
+            # or queueing delay can defer it
+            msg["_deadline_mono"] = time.monotonic() + float(
+                msg["deadline_s"])
+        inject("dcn.worker.handle")
         cmd = msg["cmd"]
         if cmd == "ping":
             return "pong"
+        if cmd == "cancel":
+            # out-of-band: stop the statement registered under `token`
+            self._bump("cancel_rpcs")
+            token = msg.get("token")
+            with self._inflight_lock:
+                ev = self._inflight.get(token)
+                if ev is None and token is not None:
+                    # not started yet: poison the token (bounded memory)
+                    self._cancelled_tokens[token] = time.time()
+                    while len(self._cancelled_tokens) > 256:
+                        self._cancelled_tokens.pop(
+                            next(iter(self._cancelled_tokens)))
+            if ev is None:
+                return False  # not in flight (finished, or poisoned)
+            ev.set()
+            return True
+        if cmd == "stats":
+            with self._stats_lock:
+                out = dict(self.stats)
+            with self._cursor_lock:
+                out["open_cursors"] = len(self._cursors)
+            return out
         if cmd == "exec":
-            rs = self.session.execute(msg["sql"])
+            rs = self._run_sql(msg)
             return rs.rows if rs is not None else None
         if cmd == "ddl_stage":
             # one step of an online schema change (ref: schema-version
@@ -432,26 +594,45 @@ class Worker:
                 msg.get("arrays") or {}, msg.get("valids"),
                 strings=msg.get("strings"))
         if cmd == "partial":
-            rs = self.session.execute(msg["sql"])
+            inject("dcn.worker.partial")
+            rs = self._run_sql(msg)
             return rs.rows
         if cmd == "partial_paged":
             # run the partial once, return the first page + a cursor the
             # coordinator drains with "fetch" — bounds the coordinator's
             # in-flight volume to one page per worker
-            rs = self.session.execute(msg["sql"])
+            inject("dcn.worker.partial")
+            rs = self._run_sql(msg)
             rows = rs.rows
             page = int(msg.get("page_rows", 8192))
+            token = msg.get("token")
             if len(rows) <= page:
+                with self._cursor_lock:
+                    self._drop_token_cursor_locked(token)
                 return {"rows": rows, "cursor": None, "total": len(rows)}
             now = time.time()
+            if token is not None:
+                with self._inflight_lock:
+                    poisoned = self._cancelled_tokens.pop(
+                        token, None) is not None
+                if poisoned:
+                    # the coordinator abandoned this statement (cancel
+                    # arrived after execution finished): don't pin a
+                    # cursor nobody will ever drain
+                    return {"rows": rows[:page], "cursor": None,
+                            "total": len(rows)}
             with self._cursor_lock:
+                # a RETRY of this token (first response lost on the
+                # wire) must not leave the first attempt's cursor
+                # pinned: evict it before opening the replacement
+                self._drop_token_cursor_locked(token)
                 # reap abandoned cursors (a crashed coordinator must not
                 # leak result memory); live drains are refreshed on every
                 # fetch so they never look idle
                 stale = [h for h, (ts, _r) in self._cursors.items()
                          if now - ts > self.CURSOR_TTL_S]
                 for h in stale:
-                    del self._cursors[h]
+                    self._drop_cursor_locked(h)
                 if len(self._cursors) >= self.CURSOR_CAP:
                     raise ExecutionError(
                         f"dcn worker: {self.CURSOR_CAP} partial cursors "
@@ -459,8 +640,12 @@ class Worker:
                 h = self._next_cursor
                 self._next_cursor += 1
                 self._cursors[h] = (now, rows)
+                if token is not None:
+                    self._token_cursors[token] = h
             return {"rows": rows[:page], "cursor": h, "total": len(rows)}
         if cmd == "fetch":
+            inject("dcn.worker.page")
+            self._bump("pages")
             h = msg["cursor"]
             off = int(msg["offset"])
             page = int(msg.get("page_rows", 8192))
@@ -471,13 +656,13 @@ class Worker:
                 rows = ent[1]
                 out = rows[off: off + page]
                 if off + page >= len(rows):
-                    del self._cursors[h]
+                    self._drop_cursor_locked(h)
                 else:
                     self._cursors[h] = (time.time(), rows)  # refresh idle clock
             return out
         if cmd == "close_cursor":
             with self._cursor_lock:
-                self._cursors.pop(msg["cursor"], None)
+                self._drop_cursor_locked(msg["cursor"])
             return "closed"
         if cmd == "shutdown":
             return "bye"
@@ -752,13 +937,37 @@ def _topn_rewrite(st: A.SelectStmt, from_sql: str, where: str
 # ---------------------------------------------------------------------------
 
 
+class _LinkHealth:
+    """One worker link's health-machine record (UP -> SUSPECT -> DOWN
+    with exponential backoff + jitter between reconnect probes). All
+    transitions happen under the link's socket lock."""
+
+    __slots__ = ("state", "attempts", "next_retry", "last_error",
+                 "reconnects", "since")
+
+    def __init__(self):
+        self.state = UP
+        self.attempts = 0        # consecutive failed reconnects
+        self.next_retry = 0.0    # monotonic: earliest half-open probe
+        self.last_error = ""
+        self.reconnects = 0      # successful re-establishments, ever
+        self.since = time.monotonic()
+
+
 class Cluster:
     """Coordinator-side handle on the worker fleet.
 
     `replicas` maps partition/worker index -> replica worker index; a
     partition loaded with load_partition is mirrored into the replica's
     `<table>__part<i>` table, and a failed partial RPC retries there
-    (the region-replica failover analogue)."""
+    (the region-replica failover analogue).
+
+    Failure domain: every RPC runs under a per-call socket deadline
+    (min of `rpc_timeout_s` and the statement deadline's remainder); a
+    failed link moves through UP -> SUSPECT (one immediate reconnect
+    allowed) -> DOWN (exponential backoff + jitter between half-open
+    probes) instead of being permanently dead. Idempotent RPCs retry
+    once on a fresh connection before replica failover."""
 
     # a dim bigger than this doesn't broadcast: replicating it to every
     # worker would cost more than the join saves (ref: the reference's
@@ -766,29 +975,65 @@ class Cluster:
     BROADCAST_LIMIT_BYTES = int(os.environ.get(
         "DCN_BROADCAST_LIMIT", str(64 << 20)))
 
+    # reconnect backoff: SUSPECT probes immediately; each further
+    # failure doubles the wait (plus up to 25% jitter so a fleet of
+    # coordinators doesn't probe a recovering worker in lockstep),
+    # capped so a restarted worker is re-admitted within ~RECONNECT_CAP_S
+    RECONNECT_BASE_S = 0.05
+    RECONNECT_CAP_S = 2.0
+    RECONNECT_MAX_DOUBLINGS = 6   # attempts beyond this probe at the cap
+    JITTER_FRAC = 0.25
+    CANCEL_DIAL_TIMEOUT_S = 2.0   # side-channel cancel must never hang
+
     def __init__(self, endpoints: List[Tuple[str, int]],
                  secret: Optional[str] = None,
-                 replicas: Optional[Dict[int, int]] = None):
+                 replicas: Optional[Dict[int, int]] = None,
+                 rpc_timeout_s: Optional[float] = 30.0,
+                 connect_timeout_s: float = 30.0,
+                 partial_results: bool = False):
         self.secret = secret
         self.replicas = dict(replicas or {})
+        self.rpc_timeout_s = rpc_timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        # a partition with primary AND replica unreachable: fail the
+        # query (False) or serve reachable partitions with a warning
+        self.partial_results = partial_results
+        self.last_warnings: List[str] = []
         self._socks: List[Optional[socket.socket]] = []
         self._endpoints = list(endpoints)
         self._partitioned: set = set()
         self._broadcast: set = set()
+        self._health: List[_LinkHealth] = [_LinkHealth() for _ in endpoints]
+        # per-call RPC budget (deadline + timeout) travels thread-local
+        # so _call keeps its monkeypatch-friendly (i, msg) signature
+        self._tl = threading.local()
         # one lock per worker socket: callers may issue RPCs to the same
         # worker from several threads (a DML thread racing online_ddl's
         # stage barriers); an interleaved send/recv pair desyncs the
         # length-prefixed framing permanently
         self._sock_locks: List[threading.Lock] = [
             threading.Lock() for _ in endpoints]
-        for host, port in endpoints:
+        for i, (host, port) in enumerate(endpoints):
             self._socks.append(self._connect(host, port))
+            self._set_state(i, UP)
         from tidb_tpu.session import Session
 
         self._merge_session = Session()
+        _CLUSTERS.add(self)
 
-    def _connect(self, host: str, port: int) -> socket.socket:
-        s = socket.create_connection((host, port), timeout=30)
+    def _set_state(self, i: int, state: str) -> None:
+        self._health[i].state = state
+        self._health[i].since = time.monotonic()
+        from tidb_tpu.utils.metrics import WORKER_STATE
+
+        host, port = self._endpoints[i]
+        WORKER_STATE.set(_STATE_CODE[state], endpoint=f"{host}:{port}")
+
+    def _connect(self, host: str, port: int,
+                 timeout: Optional[float] = None) -> socket.socket:
+        inject("dcn.connect")
+        s = socket.create_connection(
+            (host, port), timeout=timeout or self.connect_timeout_s)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         flag = _recv_exact(s, 1)
         if flag == b"\x01":
@@ -823,20 +1068,112 @@ class Cluster:
             raise ExecutionError(
                 f"dcn worker {host}:{port} does not require auth but this "
                 "cluster is configured with a secret")
+        # create_connection leaves its connect timeout armed on the
+        # socket; RPC deadlines are applied per call in _call instead
+        s.settimeout(None)
         return s
 
     def __len__(self):
         return len(self._socks)
 
+    # -- failure domain: budgets, health transitions, reconnect ---------
+
+    def _rpc_budget(self, i: int) -> Optional[float]:
+        """Per-call socket deadline: min(rpc timeout, statement
+        deadline remainder). Raises the typed timeout when the
+        statement's budget is already spent — don't even send."""
+        timeout = getattr(self._tl, "rpc_timeout", None)
+        if timeout is None:
+            timeout = self.rpc_timeout_s
+        if timeout is not None and timeout <= 0:
+            timeout = None
+        dl = getattr(self._tl, "deadline", None)
+        if dl is not None:
+            rem = dl - time.monotonic()
+            if rem <= 0:
+                raise QueryTimeoutError(
+                    "Query execution was interrupted, maximum statement "
+                    f"execution time exceeded (before dcn worker {i} rpc)")
+            timeout = rem if timeout is None else min(timeout, rem)
+        return timeout
+
+    def _note_failure_locked(self, i: int, e: Exception) -> None:
+        """UP -> SUSPECT (one immediate reconnect), further failures ->
+        DOWN with exponential backoff + jitter before the next half-open
+        probe. Caller holds self._sock_locks[i]."""
+        h = self._health[i]
+        h.last_error = str(e)
+        if h.state == UP:
+            self._set_state(i, SUSPECT)
+            h.next_retry = 0.0  # half-open immediately: maybe a blip
+        else:
+            self._set_state(i, DOWN)
+            h.attempts += 1
+            backoff = self.RECONNECT_BASE_S * (
+                2 ** min(h.attempts, self.RECONNECT_MAX_DOUBLINGS))
+            backoff = min(backoff, self.RECONNECT_CAP_S)
+            backoff *= 1.0 + self.JITTER_FRAC * random.random()
+            h.next_retry = time.monotonic() + backoff
+
+    def _note_ok_locked(self, i: int) -> None:
+        h = self._health[i]
+        if h.state != UP:
+            self._set_state(i, UP)
+        h.attempts = 0
+        h.next_retry = 0.0
+
+    def _reconnect_locked(self, i: int) -> socket.socket:
+        """Half-open probe: re-dial a SUSPECT/DOWN worker. Honors the
+        circuit breaker — inside the backoff window the call fails fast
+        without touching the network. Caller holds the socket lock."""
+        h = self._health[i]
+        now = time.monotonic()
+        if now < h.next_retry:
+            raise ConnectionError(
+                f"dcn worker {i} is down (circuit open for another "
+                f"{h.next_retry - now:.2f}s; last error: {h.last_error})")
+        host, port = self._endpoints[i]
+        try:
+            sock = self._connect(host, port)
+        except (ConnectionError, OSError, ExecutionError) as e:
+            self._note_failure_locked(i, e)
+            raise ConnectionError(
+                f"dcn worker {i}: reconnect failed: {e}") from e
+        self._socks[i] = sock
+        h.reconnects += 1
+        from tidb_tpu.utils.metrics import DCN_RETRY_TOTAL
+
+        DCN_RETRY_TOTAL.inc(kind="reconnect")
+        return sock
+
+    def _remote_error(self, i: int, err: str) -> ExecutionError:
+        """Re-type a worker-reported error: kill/deadline travel the
+        wire as `ClassName: message` and must stay typed end to end."""
+        msg = f"dcn worker {i}: {err}"
+        if err.startswith("QueryTimeoutError:"):
+            return QueryTimeoutError(msg)
+        if err.startswith("QueryKilledError:"):
+            return QueryKilledError(msg)
+        return ExecutionError(msg)
+
     def _call(self, i: int, msg: Dict):
         t0 = time.perf_counter()
+        timeout = self._rpc_budget(i)
         with self._sock_locks[i]:  # one in-flight RPC per worker
             sock = self._socks[i]
             if sock is None:
-                raise ConnectionError(f"dcn worker {i} is down")
+                if not getattr(self._tl, "reconnect", True):
+                    raise ConnectionError(f"dcn worker {i} is down")
+                sock = self._reconnect_locked(i)
             try:
+                inject("dcn.coord.send")
+                if timeout is not None:
+                    sock.settimeout(timeout)
                 _send(sock, msg)
+                inject("dcn.coord.recv")
                 resp = _recv(sock)
+                if timeout is not None:
+                    sock.settimeout(None)
             except (ConnectionError, OSError, DcnCodecError) as e:
                 # mark dead so retries don't reuse a broken socket —
                 # still under the lock, so a concurrent caller can never
@@ -846,24 +1183,61 @@ class Cluster:
                 except OSError:
                     pass
                 self._socks[i] = None
+                self._note_failure_locked(i, e)
+                if isinstance(e, (socket.timeout, TimeoutError)):
+                    dl = getattr(self._tl, "deadline", None)
+                    if dl is not None and time.monotonic() >= dl:
+                        raise QueryTimeoutError(
+                            "Query execution was interrupted, maximum "
+                            "statement execution time exceeded "
+                            f"(dcn worker {i} rpc)") from e
+                    # timeout may be None here (timeouts disabled, TCP
+                    # stack raised ETIMEDOUT on the blocking socket)
+                    after = (f" after {timeout:.2f}s"
+                             if timeout is not None else "")
+                    raise DcnRpcTimeoutError(
+                        f"dcn worker {i}: rpc timed out{after}") from e
                 raise ConnectionError(f"dcn worker {i}: {e}") from e
+            self._note_ok_locked(i)
         from tidb_tpu.utils.metrics import DCN_RTT
 
         DCN_RTT.observe(time.perf_counter() - t0)
         if not resp["ok"]:
-            raise ExecutionError(f"dcn worker {i}: {resp['error']}")
+            raise self._remote_error(i, resp["error"])
         return resp["result"]
 
-    def _call_all(self, msgs: List[Dict]) -> List:
-        """One message per worker, dispatched concurrently."""
+    def _call_retry(self, i: int, msg: Dict):
+        """IDEMPOTENT RPCs only (reads, ping, stats): one retry on a
+        fresh connection before the caller falls to replica failover.
+        Never retries an RPC TIMEOUT (the worker is probably still
+        executing the first attempt — re-sending would run it twice
+        concurrently and collide the cancel token) nor typed
+        kill/deadline errors (the budget is spent)."""
+        try:
+            return self._call(i, msg)
+        except DcnRpcTimeoutError:
+            raise
+        except ConnectionError:
+            from tidb_tpu.utils.metrics import DCN_RETRY_TOTAL
+
+            DCN_RETRY_TOTAL.inc(kind="rpc")
+            return self._call(i, msg)
+
+    def _call_all(self, msgs: List[Dict], idempotent: bool = False) -> List:
+        """One message per worker, dispatched concurrently. Errors are
+        collected PER INDEX: the raised error is the lowest failed
+        worker's, and when several died the message carries the full
+        list — one failure must not hide that others also failed (nor
+        may the raised one be whichever thread lost the append race)."""
         results: List = [None] * len(self._socks)
-        errors: List = []
+        errors: List[Optional[Exception]] = [None] * len(self._socks)
 
         def run(i):
             try:
-                results[i] = self._call(i, msgs[i])
+                fn = self._call_retry if idempotent else self._call
+                results[i] = fn(i, msgs[i])
             except Exception as e:  # noqa: BLE001
-                errors.append(e)
+                errors[i] = e
 
         threads = [threading.Thread(target=run, args=(i,))
                    for i in range(len(self._socks))]
@@ -871,8 +1245,18 @@ class Cluster:
             t.start()
         for t in threads:
             t.join()
-        if errors:
-            raise errors[0]
+        failed = [i for i, e in enumerate(errors) if e is not None]
+        if failed:
+            first = errors[failed[0]]
+            if len(failed) == 1:
+                raise first
+            detail = "; ".join(f"worker {j}: {errors[j]}" for j in failed)
+            try:
+                err = type(first)(
+                    f"{len(failed)} dcn workers failed — {detail}")
+            except Exception:  # noqa: BLE001 — exotic ctor: keep first
+                err = first
+            raise err from first
         return results
 
     def broadcast_exec(self, sql: str) -> None:
@@ -973,48 +1357,137 @@ class Cluster:
     # table (columnar, engine-managed) is the only full-volume buffer
     PAGE_ROWS = 8192
 
-    def _drain_pages(self, i: int, first: Dict) -> List[tuple]:
-        """Collect one worker's partial from its first page + cursor."""
+    def _drain_pages(self, i: int, first: Dict, cancel=None) -> List[tuple]:
+        """Collect one worker's partial from its first page + cursor.
+        Bounded: a fetch page that comes back EMPTY while rows are still
+        owed means the cursor stopped advancing (worker restarted and
+        re-issued cursor ids, or evicted ours) — raise a clean error
+        instead of fetching the same offset forever."""
         rows = list(first["rows"])
         cur = first.get("cursor")
-        while cur is not None and len(rows) < first["total"]:
-            rows.extend(self._call(i, {"cmd": "fetch", "cursor": cur,
-                                       "offset": len(rows),
-                                       "page_rows": self.PAGE_ROWS}))
+        total = int(first["total"])
+        while cur is not None and len(rows) < total:
+            if cancel is not None:
+                r = cancel()
+                if r is not None:
+                    raise r
+            inject("dcn.coord.fetch")
+            page = self._call(i, {"cmd": "fetch", "cursor": cur,
+                                  "offset": len(rows),
+                                  "page_rows": self.PAGE_ROWS})
+            if not page:
+                raise ExecutionError(
+                    f"dcn worker {i}: cursor {cur} stopped advancing at "
+                    f"{len(rows)}/{total} rows (restarted worker or "
+                    "evicted cursor)")
+            rows.extend(page)
         return rows
 
     def _close_cursor(self, i: int, cursor) -> None:
-        """Best-effort release of a worker-held partial cursor."""
-        if cursor is None or self._socks[i] is None:
+        """Best-effort release of a worker-held partial cursor. The
+        socket state is only examined INSIDE _call's per-socket lock —
+        checking it out here raced a concurrent _call marking the worker
+        dead and could slip a send onto a closing socket. A dead LINK
+        (worker alive, cursor pinned) reconnects via the health machine
+        and releases for real; a dead WORKER fails fast once the
+        circuit opens, and its restart has no cursors anyway."""
+        if cursor is None:
             return
+        # cleanup runs AFTER a deadline expiry too: the statement's
+        # spent budget must not strangle the release RPC itself (the
+        # rpc timeout still bounds it)
+        old_dl = getattr(self._tl, "deadline", None)
+        self._tl.deadline = None
         try:
             self._call(i, {"cmd": "close_cursor", "cursor": cursor})
         except Exception:  # noqa: BLE001 — the worker may be gone
             pass
+        finally:
+            self._tl.deadline = old_dl
 
     def _failover_partial(self, i: int, sql: str, err: Exception,
-                          open_cursors: List) -> List[tuple]:
+                          open_cursors: List, cancel=None,
+                          tokens: Optional[List[str]] = None) -> List[tuple]:
         """A dead worker's partition re-runs on its replica (reading
         `<table>__part<i>`); the replica's cursor is tracked in
         `open_cursors` so a second failure can't leak it."""
         rep = self.replicas.get(i)
-        if rep is None or self._socks[rep] is None:
+        if rep is None:
             raise err
+        from tidb_tpu.utils.metrics import DCN_FAILOVER_TOTAL
+
         tables = _from_tables(parse(sql)[0].from_)
         parts = [t.name for t in tables if t.name in self._partitioned]
         tname = parts[0] if parts else tables[0].name
         rep_sql, _f, _n = partial_rewrite(
             sql, table_as=f"{tname}__part{i}",
             partitioned=self._partitioned, broadcast=self._broadcast)
-        first = self._call(rep, {"cmd": "partial_paged", "sql": rep_sql,
-                                 "page_rows": self.PAGE_ROWS})
+        msg = {"cmd": "partial_paged", "sql": rep_sql,
+               "page_rows": self.PAGE_ROWS}
+        if tokens:
+            # DISTINCT token: the replica may still hold its OWN
+            # partition's cursor under the main token (its drain comes
+            # later in the sequential pass) — reusing the token would
+            # evict it mid-query. Appended to the query's token list so
+            # a KILL's cancel fan-out reaches this re-run too.
+            fo_token = f"{tokens[0]}-fo{i}"
+            tokens.append(fo_token)
+            msg["token"] = fo_token
+        dl = getattr(self._tl, "deadline", None)
+        if dl is not None:
+            msg["deadline_s"] = max(dl - time.monotonic(), 1e-3)
+        first = self._call_retry(rep, msg)
+        DCN_FAILOVER_TOTAL.inc()
         ent = [rep, first.get("cursor")]
         open_cursors.append(ent)
-        rows = self._drain_pages(rep, first)
+        rows = self._drain_pages(rep, first, cancel)
         open_cursors.remove(ent)
         return rows
 
-    def query(self, sql: str, schema_sql: Optional[str] = None) -> List[tuple]:
+    def cancel_token(self, token: str) -> None:
+        self.cancel_tokens([token])
+
+    def cancel_tokens(self, tokens: List[str]) -> None:
+        """Tell every worker to stop the in-flight statements registered
+        under `tokens` (the statement's own token plus any failover
+        re-runs it spawned). Dials a FRESH connection per worker: the
+        primary sockets are busy carrying the very RPCs being
+        cancelled. All dials run CONCURRENTLY — a KILL must not queue
+        behind connect timeouts to unreachable workers. Best effort —
+        an unreachable worker has nothing running that anyone will wait
+        on past its socket deadline."""
+        from tidb_tpu.utils.metrics import DCN_CANCEL_TOTAL
+
+        DCN_CANCEL_TOTAL.inc()
+        dials = [threading.Thread(target=self._cancel_endpoint,
+                                  args=(i, tok), daemon=True)
+                 for i in range(len(self._endpoints)) for tok in tokens]
+        for t in dials:
+            t.start()
+        for t in dials:
+            t.join()
+
+    def _cancel_endpoint(self, i: int, token: str) -> None:
+        """Best-effort cancel dial to ONE worker on a fresh connection."""
+        from tidb_tpu.utils.metrics import DCN_RETRY_TOTAL
+
+        host, port = self._endpoints[i]
+        try:
+            s = self._connect(host, port,
+                              timeout=self.CANCEL_DIAL_TIMEOUT_S)
+            try:
+                s.settimeout(self.CANCEL_DIAL_TIMEOUT_S)
+                _send(s, {"cmd": "cancel", "token": token})
+                _recv(s)
+            finally:
+                s.close()
+            DCN_RETRY_TOTAL.inc(kind="cancel_dial")
+        except Exception:  # noqa: BLE001 — best-effort side channel
+            pass
+
+    def query(self, sql: str, schema_sql: Optional[str] = None,
+              session=None, timeout_s: Optional[float] = None,
+              cancel=None) -> List[tuple]:
         """Distributed aggregate / TopN: partial on every worker, final
         merge here. schema_sql overrides the staging table DDL; by
         default column types are inferred from the partial rows.
@@ -1030,29 +1503,130 @@ class Cluster:
         spill machinery bounds the merge itself. The coordinator holds
         no state workers depend on, so a replacement coordinator can
         re-attach to the same workers and re-run (see
-        test_dcn.py::test_coordinator_restart)."""
+        test_dcn.py::test_coordinator_restart).
+
+        Failure domain: `session` ties the query to a Session — its
+        max_execution_time becomes the statement deadline (shipped to
+        workers as each RPC's remaining budget), its
+        tidb_tpu_dcn_rpc_timeout bounds each round trip, and a KILL
+        QUERY/CONNECTION against it interrupts the coordinator-side
+        join AND fans a cancel out to every worker. `timeout_s`
+        overrides the deadline; `cancel` is an extra callable polled
+        alongside. When a partition's primary AND replica are
+        unreachable the query fails fast, unless partial results were
+        opted into (constructor flag or tidb_tpu_dcn_partial_results) —
+        then reachable partitions are served and a warning is recorded
+        in `last_warnings` (and the session's warning area)."""
         partial_sql, final_sql, _names = partial_rewrite(
             sql, partitioned=self._partitioned, broadcast=self._broadcast)
 
+        rpc_timeout = self.rpc_timeout_s
+        budget_s = timeout_s
+        partial_ok = self.partial_results
+        if session is not None:
+            # this call is the session's "statement": like
+            # _execute_timed, entering it consumes any stale one-shot
+            # KILL QUERY aimed at a PREVIOUS query
+            session._kill_query = False
+            to_ms = int(session.sysvars.get("tidb_tpu_dcn_rpc_timeout"))
+            rpc_timeout = to_ms / 1e3 if to_ms > 0 else None
+            if budget_s is None:
+                met = int(session.sysvars.get("max_execution_time"))
+                budget_s = met / 1e3 if met > 0 else None
+            partial_ok = partial_ok or bool(
+                session.sysvars.get("tidb_tpu_dcn_partial_results"))
+        deadline = (time.monotonic() + budget_s
+                    if budget_s is not None else None)
+        token = f"q{os.getpid()}-{next(_TOKEN_SEQ)}"
+        self.last_warnings = []
+
+        def cancel_reason():
+            if session is not None:
+                r = session.cancel_reason()
+                if r is not None:
+                    return r
+            if cancel is not None and cancel():
+                return QueryKilledError(
+                    "Query execution was interrupted (KILL)")
+            if deadline is not None and time.monotonic() > deadline:
+                return QueryTimeoutError(
+                    "Query execution was interrupted, maximum statement "
+                    "execution time exceeded")
+            return None
+
+        old_dl = getattr(self._tl, "deadline", None)
+        old_to = getattr(self._tl, "rpc_timeout", None)
+        self._tl.deadline = deadline
+        self._tl.rpc_timeout = rpc_timeout
+        try:
+            return self._query_inner(
+                sql, partial_sql, final_sql, schema_sql, session,
+                deadline, rpc_timeout, token, cancel_reason, partial_ok)
+        finally:
+            self._tl.deadline = old_dl
+            self._tl.rpc_timeout = old_to
+
+    def _query_inner(self, sql, partial_sql, final_sql, schema_sql,
+                     session, deadline, rpc_timeout, token,
+                     cancel_reason, partial_ok) -> List[tuple]:
         # kick every worker's partial concurrently; each returns only
-        # its first page (the rest waits behind the worker's cursor)
+        # its first page (the rest waits behind the worker's cursor).
+        # The message carries the statement's REMAINING budget and the
+        # cancel token so the worker enforces both server-side.
         firsts: List = [None] * len(self._socks)
         errs: List = [None] * len(self._socks)
 
         def start(i):
+            self._tl.deadline = deadline
+            self._tl.rpc_timeout = rpc_timeout
+            msg = {"cmd": "partial_paged", "sql": partial_sql,
+                   "page_rows": self.PAGE_ROWS, "token": token}
+            if deadline is not None:
+                msg["deadline_s"] = max(deadline - time.monotonic(), 1e-3)
             try:
-                firsts[i] = self._call(i, {
-                    "cmd": "partial_paged", "sql": partial_sql,
-                    "page_rows": self.PAGE_ROWS})
+                firsts[i] = self._call_retry(i, msg)
             except Exception as e:  # noqa: BLE001
                 errs[i] = e
 
-        threads = [threading.Thread(target=start, args=(i,))
+        threads = [threading.Thread(target=start, args=(i,), daemon=True)
                    for i in range(len(self._socks))]
         for t in threads:
             t.start()
+        # interruptible join: a KILL (or deadline expiry) while workers
+        # compute must not wait for them to finish — fan the cancel out
+        # on fresh connections, then collect the (now aborting) RPCs.
+        # Every RPC carries a socket deadline, so this join is bounded.
+        tokens = [token]  # grows with failover re-run tokens
+        interrupted = None
+        cancel_sent = False
+        while any(t.is_alive() for t in threads):
+            interrupted = cancel_reason()
+            if interrupted is not None:
+                self.cancel_tokens(tokens)
+                cancel_sent = True
+                for t in threads:
+                    t.join()
+                break
+            # the last thread may die between the while-check and here
+            alive = next((t for t in threads if t.is_alive()), None)
+            if alive is not None:
+                alive.join(timeout=0.05)
         for t in threads:
             t.join()
+        if interrupted is None:
+            interrupted = cancel_reason()
+        if interrupted is not None:
+            # the dispatch may have died on its own (RPC timeouts) the
+            # same instant the budget expired: the cancel must STILL fan
+            # out, or a worker stalled before execution would run its
+            # partial for a coordinator that already gave up
+            if not cancel_sent:
+                self.cancel_tokens(tokens)
+            # release whatever cursors the partials managed to open
+            for i, f in enumerate(firsts):
+                if f is not None:
+                    self._close_cursor(i, f.get("cursor"))
+            raise interrupted
 
         s = self._merge_session
         s.execute("drop table if exists __dcn_partial__")
@@ -1095,13 +1669,23 @@ class Cluster:
         # it on the replica without duplicating staged rows
         try:
             for i in range(len(self._socks)):
+                r = cancel_reason()
+                if r is not None:
+                    self.cancel_tokens(tokens)
+                    raise r
                 try:
                     if errs[i] is not None:
                         raise errs[i]
-                    rows = self._drain_pages(i, firsts[i])
+                    rows = self._drain_pages(i, firsts[i], cancel_reason)
                     open_cursors[:] = [e for e in open_cursors if e[0] != i
                                        or e[1] != firsts[i].get("cursor")]
                 except (ConnectionError, OSError, ExecutionError) as e:
+                    if isinstance(e, (QueryKilledError, QueryTimeoutError)):
+                        # the statement's budget is spent / it was
+                        # killed: a replica re-run cannot help, and the
+                        # error must keep its type
+                        self.cancel_tokens(tokens)
+                        raise
                     # the primary may be alive (coordinator-side error):
                     # release its cursor before the replica re-run
                     for ent in list(open_cursors):
@@ -1109,7 +1693,30 @@ class Cluster:
                                 and ent[1] == firsts[i].get("cursor"):
                             self._close_cursor(*ent)
                             open_cursors.remove(ent)
-                    rows = self._failover_partial(i, sql, e, open_cursors)
+                    if isinstance(e, DcnRpcTimeoutError):
+                        # the primary is probably still EXECUTING the
+                        # abandoned partial: tell it to stop (and, via
+                        # token poisoning, not to pin a cursor if it
+                        # already finished) before paying the replica
+                        self._cancel_endpoint(i, token)
+                    try:
+                        rows = self._failover_partial(
+                            i, sql, e, open_cursors, cancel_reason, tokens)
+                    except (ConnectionError, OSError, ExecutionError) as e2:
+                        if isinstance(e2, (QueryKilledError,
+                                           QueryTimeoutError)):
+                            self.cancel_tokens(tokens)
+                            raise
+                        if not partial_ok:
+                            raise
+                        # degraded mode: serve the reachable partitions
+                        warn = (f"dcn partition {i} unavailable (primary "
+                                f"and replica): {e2}; results are PARTIAL")
+                        self.last_warnings.append(warn)
+                        if session is not None:
+                            session._warnings.append(
+                                ("Warning", 1105, warn))
+                        continue
                 ingest(rows)
         finally:
             for ent in open_cursors:
@@ -1128,14 +1735,51 @@ class Cluster:
             cols.append(f"`{name}` {_infer_type(r[j] for r in rows)}")
         return "create table __dcn_partial__ (" + ", ".join(cols) + ")"
 
+    def worker_stats(self) -> List[Optional[Dict]]:
+        """Fleet-wide failure-domain counters (executed/cancelled/
+        deadline_exceeded/cancel_rpcs/pages/open_cursors per worker) —
+        the kill/deadline suites assert remote partials observably
+        stopped through this. Idempotent, so it rides the retry path."""
+        return self._call_all([{"cmd": "stats"}] * len(self._socks),
+                              idempotent=True)
+
+    def health_snapshot(self) -> Dict:
+        """JSON-friendly view of the per-worker health machine — the
+        /cluster status-port endpoint and tests read this."""
+        now = time.monotonic()
+        workers = []
+        for i, (host, port) in enumerate(self._endpoints):
+            h = self._health[i]
+            workers.append({
+                "index": i,
+                "endpoint": f"{host}:{port}",
+                "state": h.state,
+                "connected": (i < len(self._socks)
+                              and self._socks[i] is not None),
+                "attempts": h.attempts,
+                "reconnects": h.reconnects,
+                "retry_in_s": round(max(h.next_retry - now, 0.0), 3),
+                "last_error": h.last_error,
+                "replica": self.replicas.get(i),
+            })
+        return {"workers": workers,
+                "partitioned": sorted(self._partitioned),
+                "broadcast": sorted(self._broadcast),
+                "warnings": list(self.last_warnings)}
+
     def shutdown(self) -> None:
-        for i in range(len(self._socks)):
-            if self._socks[i] is None:
-                continue
-            try:
-                self._call(i, {"cmd": "shutdown"})
-            except Exception:  # noqa: BLE001
-                pass
+        prev = getattr(self._tl, "reconnect", True)
+        self._tl.reconnect = False  # don't resurrect links to say goodbye
+        try:
+            for i in range(len(self._socks)):
+                if self._socks[i] is None:
+                    continue
+                try:
+                    self._call(i, {"cmd": "shutdown"})
+                except Exception:  # noqa: BLE001
+                    pass
+        finally:
+            self._tl.reconnect = prev
         self.close()
 
     def close(self) -> None:

@@ -28,7 +28,7 @@ from tidb_tpu.utils.lru import get_or_build, touch
 from tidb_tpu.errors import ExecutionError
 from tidb_tpu.executor.aggregate import HashAggExec
 from tidb_tpu.executor.builder import build_executor, peel_stages, scan_stages_for
-from tidb_tpu.executor.base import Executor
+from tidb_tpu.executor.base import Executor, raise_if_cancelled
 from tidb_tpu.executor.scan import ProjectionExec, SelectionExec
 from tidb_tpu.executor.sort import LimitExec, SortExec, TopNExec
 from tidb_tpu.parallel.distsql import make_agg_fragment, make_join_agg_fragment
@@ -259,6 +259,7 @@ class DistAggExec(HashAggExec):
         fn = None
         for st in stream_batches(table, mesh, scan_cols,
                                  self.STREAM_ROWS_PER_PART):
+            raise_if_cancelled(self.ctx)  # see _run_fragment_streaming
             if fn is None:
                 key = ("agg", sig, st.n_parts, st.rows_per_part,
                        _types_sig(st), "stream")
@@ -569,6 +570,9 @@ class DistFragmentExec(HashAggExec):
         factor in one recompile (skewed joins can demand 100x+ at once).
         Returns (out, growths) or (None, growths) past the ceilings."""
         while True:
+            # each retry pays a recompile: bail between attempts if the
+            # statement was killed or ran out of its deadline
+            raise_if_cancelled(self.ctx)
             key = ("frag", prog.sig, growths, shapes_sig, types_sig)
             fn = self._cache.get_fragment(
                 key, lambda: prog.build_fn(growths))
@@ -646,6 +650,10 @@ class DistFragmentExec(HashAggExec):
         gen_parts = None  # part index -> [host partial dicts]
         nk = len(self.group_exprs)
         for batch in stream_batches(table, mesh, scan_cols, rows_per_part):
+            # a KILL or deadline expiry must interrupt a >HBM streamed
+            # fragment between batches, not only at the root chunk loop
+            # (which never runs until every batch has been merged)
+            raise_if_cancelled(self.ctx)
             args = []
             shapes = []
             for i in range(len(prog.sources)):

@@ -775,6 +775,11 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
     the generic group tables before they leave the device (SURVEY.md:93
     TopN pushdown); ignored for segment aggs, whose bounded states are
     already cheap to rank on the host."""
+    from tidb_tpu.utils.failpoint import inject
+
+    # chaos hook: fail fragment compilation itself (the coordinator
+    # must surface a clean error, not a half-built program)
+    inject("fragment.compile")
     c = _Compiler(n_parts)
     try:
         emit, out_kind, domains = c.compile_agg(agg, topn=topn)

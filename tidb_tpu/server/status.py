@@ -9,6 +9,9 @@ Endpoints:
                    (?top=N, default 50) from the statements-summary store
     /plan_cache  - JSON: plan-cache hit/miss/bypass/evict/invalidate
                    totals plus per-entry digests (?top=N, default 50)
+    /cluster     - JSON: per-worker DCN health machine (up/suspect/down,
+                   reconnect counts, backoff windows) for every live
+                   Cluster in this process
 """
 
 from __future__ import annotations
@@ -78,6 +81,14 @@ class StatusServer:
                             top = 50
                         body = json.dumps(
                             outer.catalog.plan_cache.stats_dict(top)).encode()
+                        ctype = "application/json"
+                    elif self.path == "/cluster":
+                        from tidb_tpu.parallel.dcn import clusters_alive
+
+                        body = json.dumps({
+                            "clusters": [c.health_snapshot()
+                                         for c in clusters_alive()],
+                        }).encode()
                         ctype = "application/json"
                     elif self.path == "/schema":
                         # snapshot under the catalog lock: concurrent DDL

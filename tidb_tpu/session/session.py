@@ -323,6 +323,14 @@ class Session:
         self._ps_materialized = None
         self._killed = False       # KILL <id>: connection is dead
         self._kill_query = False   # KILL QUERY <id>: one-shot cancel
+        # statement deadline (monotonic seconds) armed per statement
+        # from max_execution_time; None = unbounded
+        self._stmt_deadline: Optional[float] = None
+        # external cancellation hooks: a DCN worker serving an RPC arms
+        # these so a coordinator-sent cancel or the RPC's shipped
+        # deadline aborts the local execution at its next chunk boundary
+        self._ext_cancel = None            # callable -> truthy to cancel
+        self._ext_deadline: Optional[float] = None  # monotonic seconds
         # diagnostics area for SHOW WARNINGS (cleared per statement)
         self._warnings: list = []
         self.mesh = mesh
@@ -519,8 +527,16 @@ class Session:
         if self.catalog.has_stale_txns():
             self.catalog.resolve_locks()
         if self._killed:
-            raise ExecutionError("connection was killed")
+            from tidb_tpu.errors import QueryKilledError
+
+            raise QueryKilledError("connection was killed")
         self._kill_query = False  # a prior KILL QUERY cancels only its query
+        # arm the statement deadline: max_execution_time is a per-
+        # statement budget in ms (0 = unbounded). Monotonic so wall-
+        # clock jumps can't fire (or defuse) it.
+        met = int(self.sysvars.get("max_execution_time"))
+        self._stmt_deadline = (
+            _time.monotonic() + met / 1e3) if met > 0 else None
         if not (isinstance(stmt, A.ShowStmt)
                 and getattr(stmt, "kind", "") == "warnings"):
             self._warnings.clear()  # MySQL: each statement resets the area
@@ -550,12 +566,19 @@ class Session:
         except Exception as exc:
             dur = _time.perf_counter() - t0
             M.QUERY_TOTAL.inc(type=stype, status="error")
+            from tidb_tpu.errors import QueryTimeoutError
+
+            if isinstance(exc, QueryTimeoutError):
+                M.DEADLINE_EXCEEDED_TOTAL.inc()
             self._record_stmt(stmt, sql, stype, dur, d0, f0, None,
                               error=True)
             self.catalog.plugins.statement_end(self, sql, stype, dur, exc)
             raise
         finally:
             self._current_sql = None
+            # disarm: a later Cluster.query(session=...) poll must not
+            # see this statement's (possibly long-expired) deadline
+            self._stmt_deadline = None
         dur = _time.perf_counter() - t0
         self.catalog.plugins.statement_end(self, sql, stype, dur, None)
         M.QUERY_TOTAL.inc(type=stype, status="ok")
@@ -620,6 +643,33 @@ class Session:
             return []
         return rs.rows
 
+    def cancel_reason(self):
+        """Why the in-flight statement should stop, or None. Returns a
+        TYPED exception instance (the executor raises it verbatim) so a
+        KILL and a deadline expiry surface as different MySQL errors.
+        Polled at every chunk boundary and by the DCN coordinator's
+        dispatch/drain loops."""
+        import time as _time
+
+        from tidb_tpu.errors import QueryKilledError, QueryTimeoutError
+
+        if self._killed:
+            return QueryKilledError("connection was killed")
+        if self._kill_query:
+            return QueryKilledError("Query execution was interrupted (KILL)")
+        now = None
+        for dl in (self._stmt_deadline, self._ext_deadline):
+            if dl is not None:
+                now = _time.monotonic() if now is None else now
+                if now > dl:
+                    return QueryTimeoutError(
+                        "Query execution was interrupted, maximum "
+                        "statement execution time exceeded")
+        ext = self._ext_cancel
+        if ext is not None and ext():
+            return QueryKilledError("Query execution was interrupted (KILL)")
+        return None
+
     # ------------------------------------------------------------------
 
     def _plan_capacity(self, plan) -> int:
@@ -681,7 +731,7 @@ class Session:
                 self.sysvars.get("tidb_tpu_join_tiles_per_dispatch")),
             broadcast_rows_limit=int(
                 self.sysvars.get("tidb_broadcast_join_threshold_count")),
-            cancel_check=lambda: self._killed or self._kill_query,
+            cancel_check=self.cancel_reason,
         )
 
     def _agg_push_down(self) -> bool:

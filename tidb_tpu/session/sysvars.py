@@ -111,6 +111,16 @@ _reg(
     SysVar("version_comment", "tidb_tpu: TPU-native SQL execution engine", GLOBAL, "str"),
     SysVar("time_zone", "SYSTEM", BOTH, "str"),
     SysVar("max_execution_time", 0, BOTH, "int", min_=0, max_=1 << 31),
+    # per-RPC socket deadline on the DCN tier, ms; 0 disables. Distinct
+    # from max_execution_time: the statement deadline bounds the whole
+    # query, this bounds any SINGLE coordinator<->worker round trip (a
+    # hung worker must not pin a statement for the full statement budget)
+    SysVar("tidb_tpu_dcn_rpc_timeout", 30000, BOTH, "int",
+           min_=0, max_=1 << 31),
+    # a partition whose primary AND replica are unreachable: fail the
+    # query (default, exact results) or serve the reachable partitions
+    # with a warning (availability over completeness)
+    SysVar("tidb_tpu_dcn_partial_results", False, BOTH, "bool"),
     SysVar("tx_isolation", "REPEATABLE-READ", BOTH, "str"),
     SysVar("transaction_isolation", "REPEATABLE-READ", BOTH, "str"),
     SysVar("character_set_client", "utf8mb4", BOTH, "str"),

@@ -210,6 +210,26 @@ JOIN_BUILD_SECONDS = Histogram(
     "Wall time of one hash-join build phase (drain + pack + sort), by "
     "tier: host (numpy probe path), device (fused on-device sort), "
     "host_sorted (tidb_tpu_join_device_build=0 escape hatch)")
+DCN_RETRY_TOTAL = Counter(
+    "tidb_tpu_dcn_retry_total",
+    "DCN recovery actions by kind: rpc (idempotent call re-sent on a "
+    "fresh connection), reconnect (worker socket re-established by the "
+    "health machine), cancel_dial (side-channel connection opened to "
+    "deliver a cancel)")
+DCN_FAILOVER_TOTAL = Counter(
+    "tidb_tpu_dcn_failover_total",
+    "Partition partials re-run on a replica worker after the primary "
+    "(and its retry) was unreachable")
+WORKER_STATE = Gauge(
+    "tidb_tpu_dcn_worker_state",
+    "Per-worker health-machine state: 0=up, 1=suspect, 2=down")
+DCN_CANCEL_TOTAL = Counter(
+    "tidb_tpu_dcn_cancel_total",
+    "Coordinator-initiated cancels of in-flight worker partials "
+    "(KILL propagation / statement deadline expiry)")
+DEADLINE_EXCEEDED_TOTAL = Counter(
+    "tidb_tpu_deadline_exceeded_total",
+    "Statements aborted because max_execution_time expired")
 MEM_QUOTA_ENGAGED = Counter(
     "tidb_tpu_mem_quota_engaged_total",
     "Queries whose host memory consumption crossed tidb_mem_quota_query "
