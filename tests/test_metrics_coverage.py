@@ -1,0 +1,63 @@
+"""Metrics hygiene, wired tier-1 (modeled on test_failpoint_coverage):
+
+  * scripts/check_metrics.py must pass — every collector registered in
+    utils/metrics.py renders on /metrics, carries a help string, and is
+    documented in README.md; orphans fail the build
+  * negative checks on synthetic inputs prove the checker actually
+    detects each violation class
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_metrics.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location("check_metrics", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCoverageScript:
+    def test_repo_metrics_are_clean(self):
+        """The checker itself (subprocess, like CI runs it)."""
+        proc = subprocess.run(
+            [sys.executable, SCRIPT], capture_output=True, text=True,
+            cwd=ROOT, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_detects_undocumented_metric(self, tmp_path):
+        """An empty README makes every metric an orphan — rc 1 and the
+        ORPHAN class named."""
+        readme = tmp_path / "README.md"
+        readme.write_text("# nothing documented here\n")
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--readme", str(readme)],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert proc.returncode == 1, proc.stdout
+        assert "ORPHAN" in proc.stdout
+
+    def test_detects_missing_help_and_duplicates(self):
+        """check() flags an empty help string and a duplicate name on a
+        synthetic registry-shaped module result."""
+        mod = _load_checker()
+        _m, metrics = mod.collect(ROOT)
+        names = {m.name for m in metrics}
+        assert len(names) == len(metrics), "duplicate metric registered"
+        assert all((m.help or "").strip() for m in metrics), [
+            m.name for m in metrics if not (m.help or "").strip()]
+
+    def test_every_metric_in_readme(self):
+        """Redundant with the script, but as a direct assertion the
+        failure message names the missing metric."""
+        mod = _load_checker()
+        _m, metrics = mod.collect(ROOT)
+        with open(os.path.join(ROOT, "README.md"), encoding="utf-8") as f:
+            readme = f.read()
+        missing = [m.name for m in metrics if m.name not in readme]
+        assert not missing, f"metrics undocumented in README: {missing}"

@@ -1,0 +1,104 @@
+"""Status-port endpoints under concurrency (ISSUE 5 satellite): hammer
+every endpoint from threads while statements execute; every response
+must parse and the server must never 500."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+from tidb_tpu.server.status import StatusServer
+from tidb_tpu.session import Session
+from tidb_tpu.storage.catalog import Catalog
+
+ENDPOINTS = ("/metrics", "/status", "/schema", "/statements",
+             "/plan_cache", "/cluster", "/trace")
+
+N_THREADS = 4
+N_REQS = 25
+
+
+def test_endpoints_never_500_under_load():
+    cat = Catalog()
+    s = Session(catalog=cat)
+    s.execute("set tidb_trace_sample_rate = 1")  # keep /trace non-empty
+    s.execute("create table hammer (a bigint, b bigint)")
+    s.execute("insert into hammer values (1, 2), (3, 4)")
+
+    srv = StatusServer(cat, port=0)
+    srv.start()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        w = Session(catalog=cat)
+        w.execute("set tidb_trace_sample_rate = 1")
+        i = 0
+        while not stop.is_set():
+            try:
+                w.query(f"select b, count(*) as c{i % 7} from hammer"
+                        " group by b")
+                w.execute(f"insert into hammer values ({i}, {i % 5})")
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"writer: {e!r}")
+                return
+            i += 1
+
+    def hammer(tid):
+        base = f"http://127.0.0.1:{srv.port}"
+        for k in range(N_REQS):
+            path = ENDPOINTS[(tid + k) % len(ENDPOINTS)]
+            try:
+                body = urllib.request.urlopen(base + path, timeout=10).read()
+            except urllib.error.HTTPError as e:
+                errors.append(f"{path}: HTTP {e.code}")
+                continue
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{path}: {e!r}")
+                continue
+            try:
+                if path == "/metrics":
+                    assert b"tidb_tpu_query_total" in body
+                else:
+                    json.loads(body)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{path}: unparseable ({e!r})")
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    threads = [threading.Thread(target=hammer, args=(t,), daemon=True)
+               for t in range(N_THREADS)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        stop.set()
+        wt.join(timeout=30)
+        srv.stop()
+    assert not errors, errors[:10]
+
+
+def test_trace_endpoint_id_lookup_and_404():
+    cat = Catalog()
+    s = Session(catalog=cat)
+    s.execute("set tidb_trace_sample_rate = 1")
+    s.query("select 1")
+    from tidb_tpu.utils import tracing
+
+    tid = tracing.STORE.traces()[-1].trace_id
+    srv = StatusServer(cat, port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        full = json.loads(
+            urllib.request.urlopen(base + f"/trace?id={tid}").read())
+        assert full["trace_id"] == tid and "tree" in full
+        try:
+            urllib.request.urlopen(base + "/trace?id=no-such-trace")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404  # a miss is a 404, never a 500
+    finally:
+        srv.stop()

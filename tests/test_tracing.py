@@ -1,0 +1,420 @@
+"""End-to-end distributed tracing (ISSUE 5): trace-context propagation
+over DCN, worker span shipping, the tail-sampled trace store, metric
+exemplars, and the satellites that ride along (errored statements in
+the slow log / statements_summary, information_schema.dcn_worker_stats,
+EXPLAIN ANALYZE start offsets).
+
+Workers run IN-PROCESS (threads) so failpoints and the process-global
+trace store reach both sides of the wire."""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import QueryTimeoutError
+from tidb_tpu.parallel.dcn import Cluster, Worker
+from tidb_tpu.session import Session
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils import tracing
+from tidb_tpu.utils.failpoint import failpoint
+
+
+# -- unit: Trace / Span / store ---------------------------------------------
+
+
+class TestTraceUnit:
+    def test_trace_id_format(self):
+        tid = tracing.make_trace_id("a" * 32)
+        assert re.fullmatch(r"a{16}-\d+", tid)
+        assert tracing.make_trace_id("").startswith("anon-")
+
+    def test_head_sampling_edges(self):
+        assert tracing.head_sampled(0.0) is False
+        assert tracing.head_sampled(-1) is False
+        assert tracing.head_sampled(1.0) is True
+
+    def test_span_bound_counts_drops(self):
+        tr = tracing.Trace("t-1", max_spans=4)
+        spans = [tr.begin(f"s{i}") for i in range(10)]
+        assert len(tr.spans) == 4
+        assert tr.dropped == 6
+        for s in spans:  # ending a dropped span must not blow up
+            tr.end(s)
+
+    def test_graft_remaps_ids_and_offsets(self):
+        tr = tracing.Trace("t-2")
+        rpc = tr.begin("dcn.rpc")
+        time.sleep(0.001)
+        tr.end(rpc)
+        # a worker-local tree: root (id 1) with a child (id 2); ids
+        # collide with coordinator-side ids on purpose
+        remote = [
+            {"i": 1, "p": 0, "n": "worker.partial", "s": 100, "d": 500,
+             "a": ["partial:rows=3"]},
+            {"i": 2, "p": 1, "n": "stmt.select", "s": 150, "d": 400,
+             "a": []},
+        ]
+        tr.graft(remote, rpc, proc="10.0.0.1:9999")
+        by_name = {s.name: s for s in tr.spans}
+        wroot, wchild = by_name["worker.partial"], by_name["stmt.select"]
+        assert wroot.parent_id == rpc.span_id
+        assert wchild.parent_id == wroot.span_id
+        assert wroot.span_id != 1 and wchild.span_id != 2  # remapped
+        assert wroot.start_us == rpc.start_us + 100  # re-anchored
+        assert wroot.proc == wchild.proc == "10.0.0.1:9999"
+        assert "partial:rows=3" in wroot.notes
+        # malformed remote spans are skipped, not fatal
+        tr.graft([{"n": "missing keys"}], rpc, proc="x")
+
+    def test_to_dict_builds_tree(self):
+        tr = tracing.Trace("t-3")
+        a = tr.begin("a")
+        b = tr.begin("b", parent_id=a.span_id)
+        tr.end(b)
+        tr.end(a)
+        d = tr.to_dict()
+        json.dumps(d)  # JSON-clean
+        assert d["tree"][0]["name"] == "a"
+        assert d["tree"][0]["children"][0]["name"] == "b"
+
+    def test_store_capacity_and_lookup(self):
+        st = tracing.TraceStore(capacity=2)
+        ts = [tracing.Trace(f"cap-{i}") for i in range(3)]
+        for t in ts:
+            t.keep("slow")
+            st.add(t)
+        assert len(st) == 2
+        assert st.get("cap-0") is None  # trimmed
+        assert st.get("cap-2") is ts[2]
+        assert [s["trace_id"] for s in st.list(10)] == ["cap-2", "cap-1"]
+
+    def test_tls_span_nesting(self):
+        tr = tracing.Trace("t-4")
+        tracing.push(tr)
+        try:
+            with tracing.span("outer") as o:
+                with tracing.span("inner") as i:
+                    tracing.annotate("note")
+                assert i.parent_id == o.span_id
+                assert "note" in i.notes
+        finally:
+            assert tracing.pop() is tr
+        assert tracing.current() is None
+
+
+# -- statement-level: head/tail sampling, slow log, summary -----------------
+
+
+def _quiet(s):
+    """No head sampling, no slow-threshold keeps: only explicit tail
+    rules can retain a trace from this session."""
+    s.execute("set tidb_trace_sample_rate = 0")
+    s.execute("set tidb_slow_log_threshold = 300000")
+    return s
+
+
+class TestStatementTracing:
+    def test_head_sampled_statement_is_kept(self):
+        # compare by id set, not len(): a store at ring capacity evicts
+        # one trace per add, so its length never grows
+        s = Session()
+        s.execute("set tidb_trace_sample_rate = 1")
+        s.execute("set tidb_slow_log_threshold = 300000")
+        before = {t.trace_id for t in tracing.STORE.traces()}
+        s.query("select 1")
+        new = [t for t in tracing.STORE.traces()
+               if t.trace_id not in before]
+        assert new
+        tr = new[-1]
+        assert tr.keep_reasons == ["sampled"]
+        assert tr.spans[0].name == "stmt.select"
+
+    def test_uneventful_statement_is_discarded(self):
+        s = _quiet(Session())
+        s.query("select 1")  # warm
+        before = {t.trace_id for t in tracing.STORE.traces()}
+        s.query("select 1")
+        after = {t.trace_id for t in tracing.STORE.traces()}
+        assert after <= before  # nothing new kept
+        assert tracing.current() is None  # nothing leaked onto the thread
+
+    def test_slow_statement_tail_kept_with_trace_id_in_slow_log(self):
+        s = _quiet(Session())
+        s.query("select 1")  # jit/warm out of band
+        s.execute("set tidb_slow_log_threshold = 0")  # everything is slow
+        s.query("select 41 + 1")
+        s.execute("set tidb_slow_log_threshold = 300000")
+        rows = s.query("select query, trace_id, disposition from"
+                       " information_schema.slow_query")
+        hit = [r for r in rows if r[0] == "select 41 + 1"]
+        assert hit, rows
+        _q, trace_id, dispo = hit[-1]
+        assert dispo == ""
+        tr = tracing.STORE.get(trace_id)
+        assert tr is not None and "slow" in tr.keep_reasons
+
+    def test_error_statement_tail_kept_and_logged(self):
+        """Satellite: statements that die mid-execution reach the slow
+        log with an error disposition (they used to be invisible) and
+        count an error in statements_summary."""
+        s = _quiet(Session())
+        s.execute("set tidb_slow_log_threshold = 0")
+        with pytest.raises(Exception):
+            s.query("select * from missing_tbl_for_tracing")
+        s.execute("set tidb_slow_log_threshold = 300000")
+        rows = s.query("select query, trace_id, disposition from"
+                       " information_schema.slow_query")
+        hit = [r for r in rows if "missing_tbl_for_tracing" in r[0]]
+        assert hit, rows
+        _q, trace_id, dispo = hit[-1]
+        assert dispo == "error:SchemaError"
+        tr = tracing.STORE.get(trace_id)
+        assert tr is not None
+        assert "error:SchemaError" in tr.keep_reasons
+
+    def test_deadline_killed_statement_recorded_everywhere(self):
+        """A QueryTimeoutError mid-chunk-loop lands in the slow log
+        (error disposition), statements_summary (errors=1), and keeps
+        its trace — the exact blind spot the satellite names."""
+        s = _quiet(Session(chunk_capacity=1024))
+        s.execute("create table big_to (a bigint)")
+        s.catalog.table("test", "big_to").insert_columns(
+            {"a": np.arange(120_000, dtype=np.int64)})
+        s.execute("set tidb_slow_log_threshold = 0")
+        s.execute("set max_execution_time = 1")  # 1 ms: must expire
+        q = ("select count(*) from big_to b1 join big_to b2"
+             " on b1.a = b2.a where b1.a > 10")
+        with pytest.raises(QueryTimeoutError):
+            s.query(q)
+        s.execute("set max_execution_time = 0")
+        s.execute("set tidb_slow_log_threshold = 300000")
+        rows = s.query("select query, trace_id, disposition from"
+                       " information_schema.slow_query")
+        hit = [r for r in rows if "big_to b1" in r[0]]
+        assert hit, rows
+        assert hit[-1][2] == "error:QueryTimeoutError"
+        tr = tracing.STORE.get(hit[-1][1])
+        assert tr is not None
+        assert "error:QueryTimeoutError" in tr.keep_reasons
+        summ = s.query(
+            "select exec_count, errors from"
+            " information_schema.statements_summary where digest_text like"
+            " '%big_to b1%'")
+        assert summ and summ[0][1] >= 1
+
+    def test_trace_statement_start_offsets(self):
+        """TRACE rows come from the tracer: real start_ms offsets,
+        monotone nondecreasing across the session phases."""
+        s = _quiet(Session())
+        s.execute("create table tso (a bigint)")
+        s.execute("insert into tso values (1), (2)")
+        rs = s.execute("TRACE select count(*) from tso")
+        assert rs.names == ["span", "start_ms", "duration_ms"]
+        by_name = {r[0]: r for r in rs.rows}
+        plan, execute = by_name["session.plan"], by_name["session.execute"]
+        assert execute[1] >= plan[1] >= 0.0
+        assert any(r[0].strip().startswith("executor.") for r in rs.rows)
+        # TRACE always keeps its trace, regardless of sampling
+        tr = tracing.STORE.traces()[-1]
+        assert "trace" in tr.keep_reasons
+
+    def test_cluster_trace_table_rows(self):
+        s = Session()
+        s.execute("set tidb_trace_sample_rate = 1")
+        s.execute("set tidb_slow_log_threshold = 300000")
+        s.query("select 7")
+        tid = tracing.STORE.traces()[-1].trace_id
+        rows = s.query(
+            "select trace_id, name, proc, start_us, duration_us from"
+            f" information_schema.cluster_trace where trace_id = '{tid}'")
+        assert rows
+        assert any(r[1] == "stmt.select" for r in rows)
+
+
+# -- EXPLAIN ANALYZE start offsets (satellite) -------------------------------
+
+
+def test_explain_analyze_start_offset_column():
+    s = Session()
+    s.execute("create table ea (a bigint, b bigint)")
+    s.execute("insert into ea values (1, 2), (3, 4), (5, 6)")
+    rows = s.query("explain analyze select b, count(*) from ea"
+                   " group by b order by b")
+    text = "\n".join(r[0] for r in rows)
+    header = rows[0][0]
+    assert "start" in header and "execution info" in header
+    # proportional gutter + numeric offset on every operator row
+    assert re.search(r"\| \+\d+us", text), text
+
+
+# -- distributed: the acceptance scenario ------------------------------------
+
+
+def _mk_cluster(n_rows=600):
+    workers = [Worker() for _ in range(2)]
+    for w in workers:
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+    cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                 replicas={0: 1, 1: 0}, rpc_timeout_s=15.0,
+                 connect_timeout_s=5.0)
+    cl.broadcast_exec("create table ct (k bigint, grp bigint, v bigint)")
+    half = n_rows // 2
+    ks = np.arange(n_rows, dtype=np.int64)
+    cl.load_partition(0, "ct", arrays={
+        "k": ks[:half], "grp": ks[:half] % 7, "v": ks[:half] * 3}, db="test")
+    cl.load_partition(1, "ct", arrays={
+        "k": ks[half:], "grp": ks[half:] % 7, "v": ks[half:] * 3}, db="test")
+    return workers, cl
+
+
+QUERY = "select grp, count(*) as n, sum(v) as s from ct group by grp order by grp"
+
+
+def _last_dcn_trace():
+    """Newest kept trace rooted at dcn.query — head sampling on some
+    other session's statement must not misdirect the assertions."""
+    for tr in reversed(tracing.STORE.traces()):
+        if tr.spans and tr.spans[0].name == "dcn.query":
+            return tr
+    raise AssertionError(
+        f"no dcn.query trace kept; store: {tracing.STORE.list(10)}")
+
+
+class TestDistributedTracing:
+    def test_stalled_worker_trace_assembles_end_to_end(self):
+        """The acceptance scenario: sampling at 0%, one worker's partial
+        deliberately stalled then failed -> the query is slow AND takes
+        the failover path -> the kept trace's assembled tree holds
+        coordinator dispatch spans, the stalled worker's server-side
+        spans, and the retry/failover span — asserted through /trace
+        and information_schema.cluster_trace."""
+        from tidb_tpu.server.status import StatusServer
+
+        workers, cl = _mk_cluster()
+        session = Session()
+        session.execute("set tidb_trace_sample_rate = 0")
+
+        def stall_then_fail():
+            time.sleep(0.35)
+            raise ConnectionError("injected stall")
+
+        try:
+            with failpoint("dcn.worker.partial", action=stall_then_fail,
+                           nth=1):
+                got = cl.query(QUERY, session=session)
+            assert len(got) == 7
+            tr = _last_dcn_trace()
+            assert tr.sampled is False
+            assert "failover" in tr.keep_reasons
+            names = [s.name for s in tr.spans]
+            assert "dcn.dispatch[w0]" in names and "dcn.dispatch[w1]" in names
+            # nth=1 fires on whichever worker's partial lands first, so
+            # the failover direction varies run to run
+            assert any(n.startswith("dcn.failover[") for n in names), names
+            worker_spans = [s for s in tr.spans
+                            if s.name.startswith("worker.") and s.proc]
+            assert worker_spans, names
+            # the stalled attempt's server-side span shows the stall
+            stalled = [s for s in worker_spans if s.dur_us >= 300_000]
+            assert stalled, [(s.name, s.dur_us) for s in worker_spans]
+            # rpc spans carry per-call byte counts
+            rpc_notes = [n for s in tr.spans if s.name.startswith("dcn.rpc")
+                         for n in s.notes]
+            assert any(n.startswith("recv_bytes=") for n in rpc_notes)
+
+            # surface 1: /trace endpoint
+            srv = StatusServer(session.catalog.base, port=0)
+            srv.start()
+            try:
+                base = f"http://127.0.0.1:{srv.port}"
+                listing = json.loads(
+                    urllib.request.urlopen(base + "/trace").read())
+                ids = [t["trace_id"] for t in listing["traces"]]
+                assert tr.trace_id in ids
+                full = json.loads(urllib.request.urlopen(
+                    base + f"/trace?id={tr.trace_id}").read())
+                assert full["keep"] and "failover" in full["keep"]
+
+                def walk(nodes):
+                    for n in nodes:
+                        yield n
+                        yield from walk(n["children"])
+
+                flat = list(walk(full["tree"]))
+                assert any(n["name"].startswith("dcn.dispatch")
+                           for n in flat)
+                assert any(n["name"].startswith("worker.") and n["proc"]
+                           for n in flat)
+                assert any("failover" in n["name"] for n in flat)
+            finally:
+                srv.stop()
+
+            # surface 2: information_schema.cluster_trace
+            rows = session.query(
+                "select name, proc from information_schema.cluster_trace"
+                f" where trace_id = '{tr.trace_id}'")
+            names_sql = [r[0] for r in rows]
+            assert any(n.startswith("dcn.dispatch") for n in names_sql)
+            assert any(n.startswith("worker.") for n in names_sql)
+            assert any("failover" in n for n in names_sql)
+            assert any(r[1] not in ("", "local") for r in rows)  # remote proc
+
+            # surface 3: exemplars — the worst recent DCN rpc links to a
+            # trace id in the Prometheus exposition
+            ex = M.DCN_RPC_SECONDS.exemplar(cmd="partial_paged")
+            assert ex is not None and "-" in ex[1]
+            text = M.render_prometheus()
+            assert re.search(
+                r'tidb_tpu_dcn_rpc_seconds_bucket\{.*le="\+Inf"\} \d+ '
+                r'# \{trace_id="[^"]+"\}', text)
+        finally:
+            cl.shutdown()
+
+    def test_uneventful_query_discarded_and_worker_stats_table(self):
+        """An uneventful distributed query's trace is recorded but NOT
+        kept (sampling 0, no tail rule), and the dcn_worker_stats I_S
+        table exposes the fleet counters from SQL (satellite)."""
+        workers, cl = _mk_cluster(n_rows=100)
+        session = _quiet(Session())
+        try:
+            before = {t.trace_id for t in tracing.STORE.traces()}
+            got = cl.query(QUERY, session=session)
+            assert len(got) == 7
+            after = {t.trace_id for t in tracing.STORE.traces()}
+            assert after <= before  # nothing new kept
+            rows = session.query(
+                "select worker, endpoint, state, executed, error from"
+                " information_schema.dcn_worker_stats")
+            ours = [r for r in rows if r[1] in
+                    {f"127.0.0.1:{w.port}" for w in workers}]
+            assert len(ours) == 2
+            for _w, _ep, state, executed, err in ours:
+                assert state == "up" and err == "" and executed >= 1
+        finally:
+            cl.shutdown()
+
+    def test_cancel_observation_spans(self):
+        """A deadline expiry fans cancels out; the workers' cancel
+        observations come back as grafted spans under dcn.cancel."""
+        workers, cl = _mk_cluster(n_rows=100)
+        session = Session()
+        session.execute("set tidb_trace_sample_rate = 0")
+        try:
+            with failpoint("dcn.worker.partial",
+                           action=lambda: time.sleep(0.6)):
+                with pytest.raises(QueryTimeoutError):
+                    cl.query(QUERY, session=session, timeout_s=0.15)
+            tr = _last_dcn_trace()
+            assert "error:QueryTimeoutError" in tr.keep_reasons
+            names = [s.name for s in tr.spans]
+            assert "dcn.cancel" in names
+            cancel_obs = [n for s in tr.spans if s.proc
+                          for n in s.notes if n.startswith("cancel:")]
+            assert cancel_obs, names
+        finally:
+            cl.shutdown()

@@ -53,6 +53,10 @@ class RuntimeStats:
     # deltas): nonzero on a warm re-execution means a shape key leaked
     # into traced code
     recompiles: int = 0
+    # perf_counter of this operator's FIRST open/next activity — async
+    # fragment dispatches overlap, and without a start offset EXPLAIN
+    # ANALYZE / TRACE render them as if sequential
+    first_ts: Optional[float] = None
 
 
 @dataclass

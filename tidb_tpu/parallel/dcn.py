@@ -58,6 +58,7 @@ from tidb_tpu.errors import (
 from tidb_tpu.parser import ast as A
 from tidb_tpu.parser import parse
 from tidb_tpu.parser.printer import expr_to_sql
+from tidb_tpu.utils import tracing
 from tidb_tpu.utils.failpoint import inject
 
 __all__ = ["Worker", "Cluster", "partial_rewrite", "clusters_alive"]
@@ -259,11 +260,18 @@ def _loads(buf: bytes):
     return obj
 
 
+# last frame sizes on THIS thread: _call annotates its rpc span with
+# per-call (and per-page) byte counts without threading them through
+# every return value — send/recv pairs never change threads mid-call
+_IO_TLS = threading.local()
+
+
 def _send(sock: socket.socket, obj) -> None:
     payload = _dumps(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
     from tidb_tpu.utils.metrics import DCN_BYTES
 
+    _IO_TLS.last_sent = _LEN.size + len(payload)
     DCN_BYTES.inc(_LEN.size + len(payload), direction="sent")
 
 
@@ -273,6 +281,7 @@ def _recv(sock: socket.socket):
     obj = _loads(_recv_exact(sock, n))
     from tidb_tpu.utils.metrics import DCN_BYTES
 
+    _IO_TLS.last_recv = _LEN.size + n
     DCN_BYTES.inc(_LEN.size + n, direction="recv")
     return obj
 
@@ -440,15 +449,47 @@ class Worker:
                               hashlib.sha256).digest())
         return True
 
+    # a worker-side RPC trace is small: the statement's own spans plus
+    # page/cancel observations — far below the coordinator's budget
+    RPC_TRACE_MAX_SPANS = 128
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             if not self._handshake(conn):
                 return
             while True:
                 msg = _recv(conn)
+                # trace-context arrival: record this RPC's server-side
+                # spans (receive -> parse/plan -> execute -> page drain)
+                # into a per-request trace anchored at RECEIPT, and
+                # piggyback them on the response — errors included (a
+                # failing attempt's spans matter most). The executing
+                # session nests its statement spans automatically via
+                # the thread-local tracing context.
+                wtr = wroot = None
+                if isinstance(msg, dict) and msg.get("trace_id"):
+                    wtr = tracing.Trace(str(msg["trace_id"]),
+                                        max_spans=self.RPC_TRACE_MAX_SPANS)
+                    wroot = wtr.begin(f"worker.{msg.get('cmd', '?')}")
+                    tracing.push(wtr, wroot)
                 try:
-                    _send(conn, {"ok": True, "result": self._handle(msg)})
-                except Exception as e:  # noqa: BLE001 — error travels back
+                    try:
+                        resp = {"ok": True, "result": self._handle(msg)}
+                    except Exception as e:  # noqa: BLE001 — travels back
+                        resp = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}"}
+                finally:
+                    if wtr is not None:
+                        tracing.pop()
+                        wtr.end(wroot)
+                if wtr is not None:
+                    resp["trace"] = wtr.export()
+                try:
+                    _send(conn, resp)
+                except DcnCodecError as e:
+                    # an unserializable RESULT fails before any bytes
+                    # hit the wire: the connection is still synced, so
+                    # the error can travel back like a handler error
                     _send(conn, {"ok": False,
                                  "error": f"{type(e).__name__}: {e}"})
                 if msg.get("cmd") == "shutdown":
@@ -550,6 +591,11 @@ class Worker:
                     while len(self._cancelled_tokens) > 256:
                         self._cancelled_tokens.pop(
                             next(iter(self._cancelled_tokens)))
+            # cancel observation onto the shipped-back trace: which
+            # token, and whether it caught a statement in flight or
+            # poisoned ahead of one
+            tracing.annotate(f"cancel:token={token} "
+                             f"inflight={ev is not None}")
             if ev is None:
                 return False  # not in flight (finished, or poisoned)
             ev.set()
@@ -604,6 +650,7 @@ class Worker:
             inject("dcn.worker.partial")
             rs = self._run_sql(msg)
             rows = rs.rows
+            tracing.annotate(f"partial:rows={len(rows)}")
             page = int(msg.get("page_rows", 8192))
             token = msg.get("token")
             if len(rows) <= page:
@@ -659,6 +706,7 @@ class Worker:
                     self._drop_cursor_locked(h)
                 else:
                     self._cursors[h] = (time.time(), rows)  # refresh idle clock
+            tracing.annotate(f"page:offset={off} rows={len(out)}")
             return out
         if cmd == "close_cursor":
             with self._cursor_lock:
@@ -1144,6 +1192,7 @@ class Cluster:
         from tidb_tpu.utils.metrics import DCN_RETRY_TOTAL
 
         DCN_RETRY_TOTAL.inc(kind="reconnect")
+        tracing.annotate(f"reconnect:w{i}")
         return sock
 
     def _remote_error(self, i: int, err: str) -> ExecutionError:
@@ -1159,49 +1208,82 @@ class Cluster:
     def _call(self, i: int, msg: Dict):
         t0 = time.perf_counter()
         timeout = self._rpc_budget(i)
-        with self._sock_locks[i]:  # one in-flight RPC per worker
-            sock = self._socks[i]
-            if sock is None:
-                if not getattr(self._tl, "reconnect", True):
-                    raise ConnectionError(f"dcn worker {i} is down")
-                sock = self._reconnect_locked(i)
-            try:
-                inject("dcn.coord.send")
-                if timeout is not None:
-                    sock.settimeout(timeout)
-                _send(sock, msg)
-                inject("dcn.coord.recv")
-                resp = _recv(sock)
-                if timeout is not None:
-                    sock.settimeout(None)
-            except (ConnectionError, OSError, DcnCodecError) as e:
-                # mark dead so retries don't reuse a broken socket —
-                # still under the lock, so a concurrent caller can never
-                # have its healthy RPC closed out from underneath it
+        # trace-context propagation: under an active trace every RPC
+        # gets a span, the message carries (trace_id, span_id) so the
+        # worker records server-side spans against it, and the response
+        # piggybacks those spans back for grafting under the rpc span
+        tr = tracing.current()
+        sp = None
+        if tr is not None:
+            sp = tr.begin(f"dcn.rpc.{msg.get('cmd', '?')}[w{i}]",
+                          parent_id=tracing.current_span_id())
+            # copy before annotating: call sites share one msg dict
+            # across workers (`[{...}] * n`), and the trace context is
+            # per-call — in-place writes would cross span ids between
+            # workers and race the codec
+            msg = dict(msg, trace_id=tr.trace_id, span_id=sp.span_id)
+        try:
+            with self._sock_locks[i]:  # one in-flight RPC per worker
+                sock = self._socks[i]
+                if sock is None:
+                    if not getattr(self._tl, "reconnect", True):
+                        raise ConnectionError(f"dcn worker {i} is down")
+                    sock = self._reconnect_locked(i)
                 try:
-                    sock.close()
-                except OSError:
-                    pass
-                self._socks[i] = None
-                self._note_failure_locked(i, e)
-                if isinstance(e, (socket.timeout, TimeoutError)):
-                    dl = getattr(self._tl, "deadline", None)
-                    if dl is not None and time.monotonic() >= dl:
-                        raise QueryTimeoutError(
-                            "Query execution was interrupted, maximum "
-                            "statement execution time exceeded "
-                            f"(dcn worker {i} rpc)") from e
-                    # timeout may be None here (timeouts disabled, TCP
-                    # stack raised ETIMEDOUT on the blocking socket)
-                    after = (f" after {timeout:.2f}s"
-                             if timeout is not None else "")
-                    raise DcnRpcTimeoutError(
-                        f"dcn worker {i}: rpc timed out{after}") from e
-                raise ConnectionError(f"dcn worker {i}: {e}") from e
-            self._note_ok_locked(i)
-        from tidb_tpu.utils.metrics import DCN_RTT
+                    inject("dcn.coord.send")
+                    if timeout is not None:
+                        sock.settimeout(timeout)
+                    _send(sock, msg)
+                    inject("dcn.coord.recv")
+                    resp = _recv(sock)
+                    if timeout is not None:
+                        sock.settimeout(None)
+                except (ConnectionError, OSError, DcnCodecError) as e:
+                    # mark dead so retries don't reuse a broken socket —
+                    # still under the lock, so a concurrent caller can
+                    # never have its healthy RPC closed out from
+                    # underneath it
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    self._socks[i] = None
+                    self._note_failure_locked(i, e)
+                    if isinstance(e, (socket.timeout, TimeoutError)):
+                        dl = getattr(self._tl, "deadline", None)
+                        if dl is not None and time.monotonic() >= dl:
+                            raise QueryTimeoutError(
+                                "Query execution was interrupted, maximum "
+                                "statement execution time exceeded "
+                                f"(dcn worker {i} rpc)") from e
+                        # timeout may be None here (timeouts disabled, TCP
+                        # stack raised ETIMEDOUT on the blocking socket)
+                        after = (f" after {timeout:.2f}s"
+                                 if timeout is not None else "")
+                        raise DcnRpcTimeoutError(
+                            f"dcn worker {i}: rpc timed out{after}") from e
+                    raise ConnectionError(f"dcn worker {i}: {e}") from e
+                self._note_ok_locked(i)
+        except Exception as e:
+            if sp is not None:
+                sp.notes.append(f"error:{type(e).__name__}")
+                tr.end(sp)
+            raise
+        dt = time.perf_counter() - t0
+        if sp is not None:
+            sp.notes.append(
+                f"sent_bytes={getattr(_IO_TLS, 'last_sent', 0)}")
+            sp.notes.append(
+                f"recv_bytes={getattr(_IO_TLS, 'last_recv', 0)}")
+            tr.end(sp)
+            remote = resp.get("trace") if isinstance(resp, dict) else None
+            if remote:
+                host, port = self._endpoints[i]
+                tr.graft(remote, sp, proc=f"{host}:{port}")
+        from tidb_tpu.utils.metrics import DCN_RPC_SECONDS, DCN_RTT
 
-        DCN_RTT.observe(time.perf_counter() - t0)
+        DCN_RTT.observe(dt)
+        DCN_RPC_SECONDS.observe(dt, cmd=str(msg.get("cmd", "?")))
         if not resp["ok"]:
             raise self._remote_error(i, resp["error"])
         return resp["result"]
@@ -1221,6 +1303,9 @@ class Cluster:
             from tidb_tpu.utils.metrics import DCN_RETRY_TOTAL
 
             DCN_RETRY_TOTAL.inc(kind="rpc")
+            # a retry path is exactly what tail sampling wants to keep
+            tracing.keep("retry")
+            tracing.annotate(f"retry:w{i}")
             return self._call(i, msg)
 
     def _call_all(self, msgs: List[Dict], idempotent: bool = False) -> List:
@@ -1416,6 +1501,13 @@ class Cluster:
             raise err
         from tidb_tpu.utils.metrics import DCN_FAILOVER_TOTAL
 
+        # a failover is a headline tail-sampling event: keep the trace
+        # and give the re-run its own span so the assembled tree shows
+        # which replica absorbed the partition
+        tracing.keep("failover")
+        fo_span = tracing.begin(f"dcn.failover[w{i}->w{rep}]")
+        if fo_span is not None:
+            fo_span.notes.append(f"cause:{type(err).__name__}")
         tables = _from_tables(parse(sql)[0].from_)
         parts = [t.name for t in tables if t.name in self._partitioned]
         tname = parts[0] if parts else tables[0].name
@@ -1436,13 +1528,16 @@ class Cluster:
         dl = getattr(self._tl, "deadline", None)
         if dl is not None:
             msg["deadline_s"] = max(dl - time.monotonic(), 1e-3)
-        first = self._call_retry(rep, msg)
-        DCN_FAILOVER_TOTAL.inc()
-        ent = [rep, first.get("cursor")]
-        open_cursors.append(ent)
-        rows = self._drain_pages(rep, first, cancel)
-        open_cursors.remove(ent)
-        return rows
+        try:
+            first = self._call_retry(rep, msg)
+            DCN_FAILOVER_TOTAL.inc()
+            ent = [rep, first.get("cursor")]
+            open_cursors.append(ent)
+            rows = self._drain_pages(rep, first, cancel)
+            open_cursors.remove(ent)
+            return rows
+        finally:
+            tracing.finish(fo_span)
 
     def cancel_token(self, token: str) -> None:
         self.cancel_tokens([token])
@@ -1459,31 +1554,58 @@ class Cluster:
         from tidb_tpu.utils.metrics import DCN_CANCEL_TOTAL
 
         DCN_CANCEL_TOTAL.inc()
-        dials = [threading.Thread(target=self._cancel_endpoint,
-                                  args=(i, tok), daemon=True)
-                 for i in range(len(self._endpoints)) for tok in tokens]
+        # the dial threads have no tracing context of their own: hand
+        # them the calling statement's trace so each worker's cancel
+        # observation spans assemble under one dcn.cancel span
+        tr = tracing.current()
+        sp = (tr.begin("dcn.cancel", tracing.current_span_id())
+              if tr is not None else None)
+        dials = [threading.Thread(
+            target=self._cancel_endpoint,
+            args=(i, tok, tr, sp.span_id if sp is not None else None),
+            daemon=True)
+            for i in range(len(self._endpoints)) for tok in tokens]
         for t in dials:
             t.start()
         for t in dials:
             t.join()
+        if tr is not None:
+            tr.end(sp)
 
-    def _cancel_endpoint(self, i: int, token: str) -> None:
-        """Best-effort cancel dial to ONE worker on a fresh connection."""
+    def _cancel_endpoint(self, i: int, token: str, tr=None,
+                         parent_id=None) -> None:
+        """Best-effort cancel dial to ONE worker on a fresh connection.
+        `tr`/`parent_id` (optional) carry the statement's trace: the
+        cancel RPC ships trace context so the worker's observation
+        (token, was-it-in-flight) comes back as a grafted span."""
         from tidb_tpu.utils.metrics import DCN_RETRY_TOTAL
 
         host, port = self._endpoints[i]
+        sp = (tr.begin(f"dcn.cancel_dial[w{i}]", parent_id)
+              if tr is not None else None)
         try:
             s = self._connect(host, port,
                               timeout=self.CANCEL_DIAL_TIMEOUT_S)
             try:
                 s.settimeout(self.CANCEL_DIAL_TIMEOUT_S)
-                _send(s, {"cmd": "cancel", "token": token})
-                _recv(s)
+                msg = {"cmd": "cancel", "token": token}
+                if tr is not None and sp is not None:
+                    msg["trace_id"] = tr.trace_id
+                    msg["span_id"] = sp.span_id
+                _send(s, msg)
+                resp = _recv(s)
+                if tr is not None and sp is not None \
+                        and isinstance(resp, dict) and resp.get("trace"):
+                    tr.graft(resp["trace"], sp, proc=f"{host}:{port}")
             finally:
                 s.close()
             DCN_RETRY_TOTAL.inc(kind="cancel_dial")
         except Exception:  # noqa: BLE001 — best-effort side channel
-            pass
+            if sp is not None:
+                sp.notes.append("unreachable")
+        finally:
+            if tr is not None:
+                tr.end(sp)
 
     def query(self, sql: str, schema_sql: Optional[str] = None,
               session=None, timeout_s: Optional[float] = None,
@@ -1554,6 +1676,27 @@ class Cluster:
                     "execution time exceeded")
             return None
 
+        # this call is statement-shaped: when no trace is installed on
+        # the thread (standalone Python-API use) it owns one, with the
+        # same tail rules as Session._execute_timed; inside a session
+        # statement it nests into the statement's trace instead
+        owns_trace = tracing.current() is None
+        tr = tracing.current()
+        if owns_trace:
+            try:
+                from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+                digest = sql_digest(normalize_sql(sql))
+            except Exception:  # noqa: BLE001 — diagnostics only
+                digest = ""
+            rate = (float(session.sysvars.get("tidb_trace_sample_rate"))
+                    if session is not None else 0.0)
+            tr = tracing.Trace(tracing.make_trace_id(digest),
+                               sampled=tracing.head_sampled(rate))
+            tracing.push(tr)
+        root_span = tracing.begin("dcn.query")
+        t_q = time.perf_counter()
+        err: Optional[BaseException] = None
         old_dl = getattr(self._tl, "deadline", None)
         old_to = getattr(self._tl, "rpc_timeout", None)
         self._tl.deadline = deadline
@@ -1562,9 +1705,32 @@ class Cluster:
             return self._query_inner(
                 sql, partial_sql, final_sql, schema_sql, session,
                 deadline, rpc_timeout, token, cancel_reason, partial_ok)
+        except BaseException as e:
+            err = e
+            raise
         finally:
             self._tl.deadline = old_dl
             self._tl.rpc_timeout = old_to
+            self._finish_query_trace(tr, root_span, owns_trace, err,
+                                     time.perf_counter() - t_q, session)
+
+    @staticmethod
+    def _finish_query_trace(tr, root_span, owns: bool, err, dur_s: float,
+                            session) -> None:
+        """Tail rules for a standalone Cluster.query trace (nested calls
+        just close their dcn.query span — the owning statement decides)."""
+        try:
+            tracing.finish(root_span)
+            if not owns or tr is None:
+                return
+            thresh_ms = (int(session.sysvars.get("tidb_slow_log_threshold"))
+                         if session is not None else 300)
+            cap = (int(session.sysvars.get("tidb_trace_store_capacity"))
+                   if session is not None else None)
+            tracing.apply_tail_rules(tr, dur_s, thresh_ms, error=err,
+                                     capacity=cap)
+        except Exception:  # noqa: BLE001 — diagnostics never fail a query
+            pass
 
     def _query_inner(self, sql, partial_sql, final_sql, schema_sql,
                      session, deadline, rpc_timeout, token,
@@ -1575,10 +1741,19 @@ class Cluster:
         # cancel token so the worker enforces both server-side.
         firsts: List = [None] * len(self._socks)
         errs: List = [None] * len(self._socks)
+        # coordinator dispatch spans: one per worker, recorded directly
+        # on the trace object (the dispatch threads install it on their
+        # own thread-local context so _call's rpc spans nest under them)
+        tr = tracing.current()
+        dispatch_parent = tracing.current_span_id()
 
         def start(i):
             self._tl.deadline = deadline
             self._tl.rpc_timeout = rpc_timeout
+            sp = None
+            if tr is not None:
+                sp = tr.begin(f"dcn.dispatch[w{i}]", dispatch_parent)
+                tracing.push(tr, sp)
             msg = {"cmd": "partial_paged", "sql": partial_sql,
                    "page_rows": self.PAGE_ROWS, "token": token}
             if deadline is not None:
@@ -1587,6 +1762,12 @@ class Cluster:
                 firsts[i] = self._call_retry(i, msg)
             except Exception as e:  # noqa: BLE001
                 errs[i] = e
+                if sp is not None:
+                    sp.notes.append(f"error:{type(e).__name__}")
+            finally:
+                if tr is not None:
+                    tracing.pop()
+                    tr.end(sp)
 
         threads = [threading.Thread(target=start, args=(i,), daemon=True)
                    for i in range(len(self._socks))]
@@ -1673,58 +1854,74 @@ class Cluster:
                 if r is not None:
                     self.cancel_tokens(tokens)
                     raise r
-                try:
-                    if errs[i] is not None:
-                        raise errs[i]
-                    rows = self._drain_pages(i, firsts[i], cancel_reason)
-                    open_cursors[:] = [e for e in open_cursors if e[0] != i
-                                       or e[1] != firsts[i].get("cursor")]
-                except (ConnectionError, OSError, ExecutionError) as e:
-                    if isinstance(e, (QueryKilledError, QueryTimeoutError)):
-                        # the statement's budget is spent / it was
-                        # killed: a replica re-run cannot help, and the
-                        # error must keep its type
-                        self.cancel_tokens(tokens)
-                        raise
-                    # the primary may be alive (coordinator-side error):
-                    # release its cursor before the replica re-run
-                    for ent in list(open_cursors):
-                        if firsts[i] is not None and ent[0] == i \
-                                and ent[1] == firsts[i].get("cursor"):
-                            self._close_cursor(*ent)
-                            open_cursors.remove(ent)
-                    if isinstance(e, DcnRpcTimeoutError):
-                        # the primary is probably still EXECUTING the
-                        # abandoned partial: tell it to stop (and, via
-                        # token poisoning, not to pin a cursor if it
-                        # already finished) before paying the replica
-                        self._cancel_endpoint(i, token)
-                    try:
-                        rows = self._failover_partial(
-                            i, sql, e, open_cursors, cancel_reason, tokens)
-                    except (ConnectionError, OSError, ExecutionError) as e2:
-                        if isinstance(e2, (QueryKilledError,
-                                           QueryTimeoutError)):
-                            self.cancel_tokens(tokens)
-                            raise
-                        if not partial_ok:
-                            raise
-                        # degraded mode: serve the reachable partitions
-                        warn = (f"dcn partition {i} unavailable (primary "
-                                f"and replica): {e2}; results are PARTIAL")
-                        self.last_warnings.append(warn)
-                        if session is not None:
-                            session._warnings.append(
-                                ("Warning", 1105, warn))
-                        continue
-                ingest(rows)
+                with tracing.span(f"dcn.drain[w{i}]") as drain_sp:
+                    self._drain_one(i, firsts, errs, open_cursors, sql,
+                                    cancel_reason, tokens, partial_ok,
+                                    session, ingest, drain_sp)
         finally:
             for ent in open_cursors:
                 self._close_cursor(*ent)
 
         if not ddl_done:
             s.execute(self._infer_staging_ddl(partial_sql, []))
-        return s.query(final_sql)
+        with tracing.span("dcn.final_merge"):
+            return s.query(final_sql)
+
+    def _drain_one(self, i, firsts, errs, open_cursors, sql,
+                   cancel_reason, tokens, partial_ok, session, ingest,
+                   drain_sp) -> None:
+        """Drain worker i's partial into the staging table, failing over
+        to its replica on a non-typed error (split out of _query_inner
+        so each drain can carry its own trace span)."""
+        try:
+            if errs[i] is not None:
+                raise errs[i]
+            rows = self._drain_pages(i, firsts[i], cancel_reason)
+            open_cursors[:] = [e for e in open_cursors if e[0] != i
+                               or e[1] != firsts[i].get("cursor")]
+        except (ConnectionError, OSError, ExecutionError) as e:
+            if isinstance(e, (QueryKilledError, QueryTimeoutError)):
+                # the statement's budget is spent / it was killed: a
+                # replica re-run cannot help, and the error must keep
+                # its type
+                self.cancel_tokens(tokens)
+                raise
+            # the primary may be alive (coordinator-side error):
+            # release its cursor before the replica re-run
+            for ent in list(open_cursors):
+                if firsts[i] is not None and ent[0] == i \
+                        and ent[1] == firsts[i].get("cursor"):
+                    self._close_cursor(*ent)
+                    open_cursors.remove(ent)
+            if isinstance(e, DcnRpcTimeoutError):
+                # the primary is probably still EXECUTING the abandoned
+                # partial: tell it to stop (and, via token poisoning,
+                # not to pin a cursor if it already finished) before
+                # paying the replica
+                self._cancel_endpoint(i, tokens[0], tracing.current(),
+                                      drain_sp.span_id
+                                      if drain_sp is not None else None)
+            try:
+                rows = self._failover_partial(
+                    i, sql, e, open_cursors, cancel_reason, tokens)
+            except (ConnectionError, OSError, ExecutionError) as e2:
+                if isinstance(e2, (QueryKilledError,
+                                   QueryTimeoutError)):
+                    self.cancel_tokens(tokens)
+                    raise
+                if not partial_ok:
+                    raise
+                # degraded mode: serve the reachable partitions
+                warn = (f"dcn partition {i} unavailable (primary "
+                        f"and replica): {e2}; results are PARTIAL")
+                self.last_warnings.append(warn)
+                if drain_sp is not None:
+                    drain_sp.notes.append(f"partial_results:{warn[:120]}")
+                if session is not None:
+                    session._warnings.append(
+                        ("Warning", 1105, warn))
+                return
+        ingest(rows)
 
     def _infer_staging_ddl(self, partial_sql: str, rows: List[tuple]) -> str:
         # column names from the partial SELECT's aliases
@@ -1742,6 +1939,41 @@ class Cluster:
         stopped through this. Idempotent, so it rides the retry path."""
         return self._call_all([{"cmd": "stats"}] * len(self._socks),
                               idempotent=True)
+
+    _STAT_KEYS = ("executed", "cancelled", "deadline_exceeded",
+                  "cancel_rpcs", "pages", "open_cursors")
+
+    def worker_stats_rows(self) -> List[tuple]:
+        """Row-per-worker form of worker_stats() for
+        information_schema.dcn_worker_stats — gathered per worker so one
+        unreachable endpoint yields a row with an error instead of
+        failing the whole fleet read. Gathered CONCURRENTLY: down
+        workers pay connect/rpc timeouts, and a catalog read must cost
+        one timeout, not one per dead worker."""
+        rows: List = [None] * len(self._endpoints)
+
+        def gather(i: int, host: str, port: int) -> None:
+            h = self._health[i]
+            base = (i, f"{host}:{port}", h.state)
+            try:
+                st = self._call_retry(i, {"cmd": "stats"})
+                rows[i] = (base
+                           + tuple(int(st.get(k, 0))
+                                   for k in self._STAT_KEYS)
+                           + (h.reconnects, self.replicas.get(i), ""))
+            except Exception as e:  # noqa: BLE001 — down worker: a row,
+                rows[i] = (base + (None,) * len(self._STAT_KEYS)
+                           + (h.reconnects, self.replicas.get(i),
+                              f"{type(e).__name__}: {e}"))
+
+        threads = [threading.Thread(target=gather, args=(i, host, port),
+                                    daemon=True)
+                   for i, (host, port) in enumerate(self._endpoints)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return rows
 
     def health_snapshot(self) -> Dict:
         """JSON-friendly view of the per-worker health machine — the

@@ -52,21 +52,23 @@ __all__ = ["ShardCache", "build_dist_executor", "DistAggExec", "DistJoinAggExec"
 
 def _note_fragment(exec_, kind: str, n_parts: int, t0: float) -> None:
     """Record one fragment dispatch: the FRAGMENT_SECONDS collector for
-    /metrics and a span on the executor that TRACE renders under the
-    operator row. Wall time covers launch plus any synchronous
-    trace/compile (jax dispatch is async — device busy time is not host
-    observable without forcing a sync, which TRACE must not pay for).
-    One call is one fragment execution, so the dispatch counter lives
-    here too — the count and the histogram can never desynchronize."""
+    /metrics (with a trace_id exemplar) and a span on the statement's
+    trace that TRACE/the trace store render with a real start offset.
+    Wall time covers launch plus any synchronous trace/compile (jax
+    dispatch is async — device busy time is not host observable without
+    forcing a sync, which tracing must not pay for). One call is one
+    fragment execution, so the dispatch counter lives here too — the
+    count and the histogram can never desynchronize."""
+    from tidb_tpu.utils import tracing
     from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH, FRAGMENT_SECONDS
 
     dt = time.perf_counter() - t0
     FRAGMENT_DISPATCH.inc(kind=kind)
+    tr = tracing.current()
+    if tr is not None:
+        tr.add_complete(f"fragment.{kind}[parts={n_parts}]", t0, dt,
+                        parent_id=tracing.current_span_id())
     FRAGMENT_SECONDS.observe(dt, kind=kind)
-    spans = getattr(exec_, "frag_spans", None)
-    if spans is None:
-        spans = exec_.frag_spans = []
-    spans.append((f"fragment.{kind}[parts={n_parts}]", dt))
 
 
 def _timed_combine(sig, state, part):

@@ -787,9 +787,12 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
         return None
     if not c.sources:
         return None  # nothing sharded: run single-chip
+    from tidb_tpu.utils import tracing
     from tidb_tpu.utils.metrics import FRAGMENT_COMPILE
 
     FRAGMENT_COMPILE.inc(kind=out_kind)
+    # compile events become annotations on the statement's trace span
+    tracing.annotate(f"compile:fragment:{out_kind}")
 
     n_src = len(c.sources)
     n_bc = len(c.broadcasts)

@@ -12,6 +12,9 @@ Endpoints:
     /cluster     - JSON: per-worker DCN health machine (up/suspect/down,
                    reconnect counts, backoff windows) for every live
                    Cluster in this process
+    /trace       - JSON: summaries of the kept (tail-sampled) traces
+                   (?top=N, default 50); /trace?id=<trace_id> returns
+                   one trace's full cross-process span tree
 """
 
 from __future__ import annotations
@@ -81,6 +84,30 @@ class StatusServer:
                             top = 50
                         body = json.dumps(
                             outer.catalog.plan_cache.stats_dict(top)).encode()
+                        ctype = "application/json"
+                    elif self.path == "/trace" or \
+                            self.path.startswith("/trace?"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        from tidb_tpu.utils import tracing
+
+                        q = parse_qs(urlparse(self.path).query)
+                        tid = q.get("id", [None])[0]
+                        if tid is not None:
+                            t = tracing.STORE.get(tid)
+                            if t is None:
+                                self.send_error(404, "no such trace")
+                                return
+                            body = json.dumps(t.to_dict()).encode()
+                        else:
+                            try:
+                                top = int(q.get("top", ["50"])[0])
+                            except ValueError:
+                                top = 50
+                            body = json.dumps({
+                                "traces": tracing.STORE.list(top),
+                                "capacity": tracing.STORE.capacity,
+                            }).encode()
                         ctype = "application/json"
                     elif self.path == "/cluster":
                         from tidb_tpu.parallel.dcn import clusters_alive

@@ -557,6 +557,25 @@ class Session:
         self._plan_from_cache_stmt = False
         self._stmt_plan_s = 0.0
         self._stmt_digest_memo = None
+        # always-on tracing (utils/tracing.py): every statement RECORDS
+        # a span tree; tail rules / head sampling decide at the end
+        # whether it is kept. A statement arriving with a trace already
+        # installed (a DCN worker serving a traced RPC, Cluster.query
+        # inside a statement) nests instead of owning.
+        from tidb_tpu.utils import tracing
+
+        try:
+            digest_now = self._stmt_digest(stmt, sql)[1]
+        except Exception:  # noqa: BLE001 — diagnostics never fail a stmt
+            digest_now = ""
+        tr = tracing.current()
+        owns_trace = tr is None
+        if owns_trace:
+            rate = float(self.sysvars.get("tidb_trace_sample_rate"))
+            tr = tracing.Trace(tracing.make_trace_id(digest_now),
+                               sampled=tracing.head_sampled(rate))
+            tracing.push(tr)
+        stmt_span = tracing.begin(f"stmt.{stype}")
         d0 = _dsp.count()
         f0 = _dsp.by_site().get("fragment", 0)
         t0 = _time.perf_counter()
@@ -570,8 +589,17 @@ class Session:
 
             if isinstance(exc, QueryTimeoutError):
                 M.DEADLINE_EXCEEDED_TOTAL.inc()
-            self._record_stmt(stmt, sql, stype, dur, d0, f0, None,
-                              error=True)
+            detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, None,
+                                       error=True)
+            tracing.annotate(f"error:{type(exc).__name__}: {exc}")
+            trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur,
+                                          error=exc)
+            # statements that die mid-chunk-loop (deadline/kill/error)
+            # used to be invisible here — they are exactly the ones
+            # whose traces tail-sampling keeps, so log them with an
+            # error disposition (same threshold rule as successes)
+            self._maybe_log_slow(sql, dur, detail, trace_id,
+                                 disposition=f"error:{type(exc).__name__}")
             self.catalog.plugins.statement_end(self, sql, stype, dur, exc)
             raise
         finally:
@@ -579,20 +607,92 @@ class Session:
             # disarm: a later Cluster.query(session=...) poll must not
             # see this statement's (possibly long-expired) deadline
             self._stmt_deadline = None
+            # BaseException safety net (KeyboardInterrupt & co bypass
+            # the except): a trace must never leak onto the thread. The
+            # normal paths pop via _finish_trace before this runs.
+            import sys as _sys
+
+            if owns_trace and _sys.exc_info()[0] is not None \
+                    and tracing.current() is tr:
+                tracing.pop()
         dur = _time.perf_counter() - t0
-        self.catalog.plugins.statement_end(self, sql, stype, dur, None)
         M.QUERY_TOTAL.inc(type=stype, status="ok")
         M.QUERY_DURATION.observe(dur, type=stype)
         detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, result)
-        # threshold in ms; 0 logs every statement (long_query_time=0)
-        threshold = int(self.sysvars.get("tidb_slow_log_threshold"))
-        if dur * 1e3 >= threshold:
-            M.SLOW_QUERY_TOTAL.inc()
-            self.catalog.log_slow_query(
-                self.db, sql, dur, digest=detail[0],
-                plan_digest=self._last_plan_digest or "",
-                max_mem=detail[1], dispatches=detail[2])
+        trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur)
+        self._maybe_log_slow(sql, dur, detail, trace_id)
+        # plugin hooks run LAST (mirroring the error path): an audit
+        # plugin that raises must not be able to skip trace
+        # finalization — a never-popped trace would swallow every later
+        # statement on this thread into a dead span tree
+        self.catalog.plugins.statement_end(self, sql, stype, dur, None)
         return result
+
+    def _maybe_log_slow(self, sql: str, dur: float, detail, trace_id: str,
+                        disposition: str = "") -> None:
+        """One slow-log decision for both the success and the error path
+        of _execute_timed. Threshold in ms; 0 logs every statement
+        (long_query_time=0)."""
+        from tidb_tpu.utils import metrics as M
+
+        threshold = int(self.sysvars.get("tidb_slow_log_threshold"))
+        if dur * 1e3 < threshold:
+            return
+        M.SLOW_QUERY_TOTAL.inc()
+        self.catalog.log_slow_query(
+            self.db, sql, dur, digest=detail[0],
+            plan_digest=self._last_plan_digest or "",
+            max_mem=detail[1], dispatches=detail[2],
+            trace_id=trace_id, disposition=disposition)
+
+    def _stmt_digest(self, stmt, sql: str):
+        """(normalized_text, digest) for this statement, memoized per
+        source text — computed at statement START so the trace_id can
+        carry it; the plan-cache probe and _record_stmt reuse the memo,
+        keeping the total at one lex per statement."""
+        from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+        src = getattr(stmt, "_source", None) or sql
+        memo = self._stmt_digest_memo
+        if memo is not None and memo[0] == src:
+            return memo[1], memo[2]
+        ps = self._ps_ctx
+        if ps is not None and ps[0] == src:
+            # prepared execution: prepare-time analysis already lexed —
+            # the hot path must stay lex/walk-free (PR 2's contract)
+            self._stmt_digest_memo = (src, ps[1], ps[2])
+            return ps[1], ps[2]
+        if len(src) > 16384:
+            # bound the lex: per-shape dedup matters for OLTP-sized
+            # statements, not megabyte bulk loads — those digest their
+            # raw text and keep a prefix
+            norm = src[:2048]
+            digest = sql_digest(src)
+        else:
+            norm = normalize_sql(src)
+            digest = sql_digest(norm)
+        self._stmt_digest_memo = (src, norm, digest)
+        return norm, digest
+
+    def _finish_trace(self, tr, stmt_span, owns: bool, dur_s: float,
+                      error=None) -> str:
+        """Close the statement span; when this statement OWNS the trace,
+        apply the tail rules (slow / error; retry-failover keeps were
+        set where they happened), pop it off the thread, and store it if
+        kept. Returns the trace_id for the slow-log row."""
+        from tidb_tpu.utils import tracing
+
+        try:
+            tracing.finish(stmt_span)
+            if not owns or tr is None:
+                return tr.trace_id if tr is not None else ""
+            return tracing.apply_tail_rules(
+                tr, dur_s,
+                int(self.sysvars.get("tidb_slow_log_threshold")),
+                error=error,
+                capacity=int(self.sysvars.get("tidb_trace_store_capacity")))
+        except Exception:  # noqa: BLE001 — diagnostics never fail a stmt
+            return ""
 
     def _record_stmt(self, stmt, sql: str, stype: str, dur: float,
                      d0: int, f0: int, result, error: bool = False):
@@ -600,23 +700,12 @@ class Session:
         returns (digest, max_mem, dispatches) for the slow-query log.
         Digests come from the bindinfo normalizer, so parameterized
         variants of one statement aggregate under one entry."""
-        from tidb_tpu.bindinfo import normalize_sql, sql_digest
         from tidb_tpu.utils import dispatch as _dsp
 
         try:
-            src = getattr(stmt, "_source", None) or sql
-            memo = self._stmt_digest_memo
-            if memo is not None and memo[0] == src:
-                _, norm, digest = memo  # plan-cache probe already lexed
-            elif len(src) > 16384:
-                # bound the second lex: per-shape dedup matters for
-                # OLTP-sized statements, not megabyte bulk loads —
-                # those digest their raw text and keep a prefix
-                norm = src[:2048]
-                digest = sql_digest(src)
-            else:
-                norm = normalize_sql(src)
-                digest = sql_digest(norm)
+            # memoized: the statement-start trace_id computation (or the
+            # plan-cache probe) already lexed this source
+            norm, digest = self._stmt_digest(stmt, sql)
             max_mem = max((t.max_consumed for t in self._stmt_trackers),
                           default=0)
             self._stmt_trackers = []  # don't pin operator state while idle
@@ -862,10 +951,14 @@ class Session:
                 info = _pc.analyze_statement(stmt)
             except Exception:  # noqa: BLE001 — analysis is best-effort
                 return bypass("analysis failed")
-            from tidb_tpu.bindinfo import normalize_sql, sql_digest
+            memo = self._stmt_digest_memo
+            if memo is not None and memo[0] == src:
+                _src, norm, digest = memo  # statement start already lexed
+            else:
+                from tidb_tpu.bindinfo import normalize_sql, sql_digest
 
-            norm = normalize_sql(src)
-            digest = sql_digest(norm)
+                norm = normalize_sql(src)
+                digest = sql_digest(norm)
         if info.volatile:
             return bypass(f"volatile builtin {info.volatile}()")
         if info.unsafe:
@@ -1098,20 +1191,23 @@ class Session:
             _time.sleep(0.02)
 
     def _run_select(self, stmt) -> ResultSet:
+        from tidb_tpu.utils import tracing
+
         if self.txn is None and not self.sysvars.get("autocommit"):
             self._begin()  # consistent-snapshot reads without autocommit
-        phys = self._acquire_plan(stmt)
-        self._check_plan_privs(phys)
-        root = self._build_root(phys)
-        if self._dist_expected() and _has_eager_partial(phys) \
-                and not _dist_engaged(root):
-            # the eager-agg shape kept this plan off the mesh (the
-            # fragment tier takes scan-rooted generic partials, not every
-            # shape) — losing fragmentation costs more than the rewrite
-            # saves, so re-plan without it and keep the fragments (the
-            # no-push variant caches under its own key)
-            phys = self._acquire_plan(stmt, agg_push_down=False)
+        with tracing.span("session.plan"):
+            phys = self._acquire_plan(stmt)
+            self._check_plan_privs(phys)
             root = self._build_root(phys)
+            if self._dist_expected() and _has_eager_partial(phys) \
+                    and not _dist_engaged(root):
+                # the eager-agg shape kept this plan off the mesh (the
+                # fragment tier takes scan-rooted generic partials, not
+                # every shape) — losing fragmentation costs more than the
+                # rewrite saves, so re-plan without it and keep the
+                # fragments (the no-push variant caches under its own key)
+                phys = self._acquire_plan(stmt, agg_push_down=False)
+                root = self._build_root(phys)
         # plan digest: hash of the plan's shape (explain text), paired
         # with the statement digest in statements_summary/slow log so a
         # regressed plan choice is visible as a digest change; a cache
@@ -1129,9 +1225,11 @@ class Session:
                 c = c.children[0]
             if isinstance(c, PProjection) and c.n_visible is not None and c.n_visible < len(phys.schema):
                 n_vis = c.n_visible
-        return run_plan(root, self._exec_ctx(hints=getattr(stmt, "hints", ()),
-                                     plan=phys),
-                        n_visible=n_vis)
+        with tracing.span("session.execute"):
+            return run_plan(root,
+                            self._exec_ctx(hints=getattr(stmt, "hints", ()),
+                                           plan=phys),
+                            n_visible=n_vis)
 
     # ------------------------------------------------------------------
 
@@ -2636,56 +2734,87 @@ class Session:
         return ResultSet(names=["EXPLAIN"], rows=[(line,) for line in text.split("\n")])
 
     def _run_trace(self, stmt: A.TraceStmt):
-        """TRACE <select>: phase + per-operator span tree with timings
-        (ref: util/tracing + the TRACE statement's span rendering)."""
-        import time as _time
-
+        """TRACE <select>: execute under the statement's (always-on)
+        trace and render ITS span tree — one tracer serves TRACE, the
+        slow log, /trace, and information_schema.cluster_trace (ref:
+        util/tracing; the bespoke TRACE-only span code died with the
+        tail-sampling tentpole). Fragment dispatches, DCN worker spans,
+        and recompile annotations all appear because they record into
+        the same trace the statement already carries."""
         target = stmt.stmt
         if not isinstance(target, (A.SelectStmt, A.UnionStmt)):
             raise UnsupportedError("TRACE only supports SELECT")
+        from tidb_tpu.utils import tracing
         from tidb_tpu.utils.execdetails import instrument
 
         if self.txn is None and not self.sysvars.get("autocommit"):
             self._begin()  # same consistent-snapshot rule as _run_select
-        t_start = _time.perf_counter()
-        phys = self._plan_select(target)
-        self._check_plan_privs(phys)  # TRACE executes the statement
-        t_plan = _time.perf_counter()
-        root = self._build_root(phys)
-        instrument(root)
-        t_build = _time.perf_counter()
-        run_plan(root, self._exec_ctx(plan=phys))
-        t_exec = _time.perf_counter()
+        tracing.keep("trace")  # the trace IS the output: always retain
+        tr = tracing.current()
+        with tracing.span("session.plan"):
+            phys = self._plan_select(target)
+            self._check_plan_privs(phys)  # TRACE executes the statement
+        with tracing.span("session.build_executor"):
+            root = self._build_root(phys)
+            instrument(root)
+        with tracing.span("session.execute") as exec_span:
+            run_plan(root, self._exec_ctx(plan=phys))
+        if tr is not None and exec_span is not None:
+            self._graft_operator_spans(tr, exec_span, root)
+        return ResultSet(names=["span", "start_ms", "duration_ms"],
+                         rows=self._trace_rows(tr))
 
-        def ms(a, b):
-            return round((b - a) * 1e3, 3)
-
-        rows = [
-            ("session.plan", 0.0, ms(t_start, t_plan)),
-            ("session.build_executor", ms(t_start, t_plan), ms(t_plan, t_build)),
-            ("session.execute", ms(t_start, t_build), ms(t_build, t_exec)),
-        ]
-
-        def visit(e, depth):
-            # operator spans have no meaningful absolute start (they
-            # interleave); start_ms is NULL, duration = open + next time
-            name = "  " * depth + "executor." + type(e).__name__
-            rows.append((
-                name,
-                None,
-                round((e.stats.open_wall + e.stats.next_wall) * 1e3, 3),
-            ))
-            # mesh executors record one span per fragment dispatch
-            # (parallel/executor.py), so a distributed plan shows where
-            # its device time went per fragment/per shard count
-            for span_name, span_s in getattr(e, "frag_spans", ()):
-                rows.append(("  " * (depth + 1) + span_name, None,
-                             round(span_s * 1e3, 3)))
+    @staticmethod
+    def _graft_operator_spans(tr, exec_span, root) -> None:
+        """Per-operator spans from the EXPLAIN ANALYZE instrumentation:
+        start = the operator's first open/next activity, duration = its
+        cumulative open+next wall (operators interleave per chunk, so
+        the span is a coverage envelope, not one contiguous interval)."""
+        def visit(e, parent_id):
+            st = e.stats
+            t0 = (st.first_ts if st.first_ts is not None
+                  else tr.t0_perf + exec_span.start_us / 1e6)
+            notes = [f"rows={st.rows}", f"loops={st.chunks}",
+                     f"dispatches={st.dispatches}"]
+            if st.recompiles:
+                notes.append(f"recompiles={st.recompiles}")
+            s = tr.add_complete("executor." + type(e).__name__, t0,
+                                st.open_wall + st.next_wall,
+                                parent_id=parent_id, notes=notes)
+            pid = s.span_id if s.span_id > 0 else parent_id
             for c in e.children:
+                visit(c, pid)
+
+        visit(root, exec_span.span_id)
+
+    @staticmethod
+    def _trace_rows(tr) -> list:
+        """Render the current statement span's subtree as the TRACE
+        result rows: (indented name, start_ms offset, duration_ms)."""
+        from tidb_tpu.utils import tracing
+
+        if tr is None:
+            return []
+        base_id = tracing.current_span_id()
+        spans = list(tr.spans)
+        base = next((s for s in spans if s.span_id == base_id), None)
+        base_start = base.start_us if base is not None else 0
+        children: dict = {}
+        for s in spans:
+            children.setdefault(s.parent_id, []).append(s)
+        rows: list = []
+
+        def visit(s, depth):
+            rows.append(("  " * depth + s.name,
+                         round((s.start_us - base_start) / 1e3, 3),
+                         round(max(s.dur_us, 0) / 1e3, 3)))
+            for c in sorted(children.get(s.span_id, ()),
+                            key=lambda x: x.start_us):
                 visit(c, depth + 1)
 
-        visit(root, 1)
-        return ResultSet(names=["span", "start_ms", "duration_ms"], rows=rows)
+        for c in sorted(children.get(base_id, ()), key=lambda x: x.start_us):
+            visit(c, 0)
+        return rows
 
     @staticmethod
     def _like_filter(rows, like: Optional[str], col: int = 0):

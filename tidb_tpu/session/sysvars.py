@@ -65,6 +65,16 @@ _reg(
     SysVar("tidb_auto_analyze_ratio", 0.5, BOTH, "float"),
     # statements slower than this (ms) go to the slow-query log
     SysVar("tidb_slow_log_threshold", 300, BOTH, "int", min_=0, max_=1 << 31),
+    # head-sampling rate for always-on statement tracing: every
+    # statement RECORDS a trace; this decides whether an uneventful one
+    # is kept. Tail rules (slow, error, deadline/kill, retry/failover)
+    # keep their traces regardless, so 0 still captures the interesting
+    # statements — see utils/tracing.py
+    SysVar("tidb_trace_sample_rate", 0.01, BOTH, "float"),
+    # ring capacity of the tail-sampled trace store (/trace +
+    # information_schema.cluster_trace); GLOBAL: one store per process
+    SysVar("tidb_trace_store_capacity", 64, GLOBAL, "int",
+           min_=1, max_=4096),
     # LRU cap on distinct digests kept by the statements-summary store
     # (ref: tidb_stmt_summary_max_stmt_count); evictions are counted.
     # GLOBAL-only like the reference: the store is catalog-wide, so a

@@ -273,18 +273,27 @@ class Catalog:
 
     def log_slow_query(self, db: str, sql: str, duration_s: float,
                        digest: str = "", plan_digest: str = "",
-                       max_mem: int = 0, dispatches: int = 0) -> None:
+                       max_mem: int = 0, dispatches: int = 0,
+                       trace_id: str = "", disposition: str = "") -> None:
+        """One slow-log row. `trace_id` joins the row to the kept trace
+        in information_schema.cluster_trace / /trace?id= (tail sampling
+        retains every over-threshold statement's trace, so the id is
+        live). `disposition` is "" for a completed statement or
+        "error:<Type>" for one that died mid-execution (deadline, kill,
+        runtime error) — those used to be invisible here."""
         import logging
         import time
 
         self.slow_queries.append((
             time.strftime("%Y-%m-%d %H:%M:%S"), db, round(duration_s, 4),
             sql.strip()[:2048], digest, plan_digest, int(max_mem),
-            int(dispatches),
+            int(dispatches), trace_id, disposition,
         ))
         logging.getLogger("tidb_tpu.slowlog").warning(
-            "slow query (%.3fs) db=%s digest=%s mem=%d dispatches=%d: %s",
-            duration_s, db, digest, max_mem, dispatches,
+            "slow query (%.3fs) db=%s digest=%s mem=%d dispatches=%d "
+            "trace=%s%s: %s",
+            duration_s, db, digest, max_mem, dispatches, trace_id,
+            f" [{disposition}]" if disposition else "",
             sql.strip()[:512])
 
     def gc(self) -> Dict[str, int]:
@@ -606,12 +615,16 @@ class Catalog:
     # they always reflect the current schema version.
 
     def _info_schema_db(self) -> Database:
+        # listing=True: a SHOW TABLES / schema walk materializes every
+        # info table — dcn_worker_stats must not fan RPCs out to live
+        # clusters just to report that it exists
         d = Database("information_schema")
         for name in _INFO_TABLES:
-            d.tables[name] = self._info_schema_table(name)
+            d.tables[name] = self._info_schema_table(name, listing=True)
         return d
 
-    def _info_schema_table(self, name: str, viewer=None):
+    def _info_schema_table(self, name: str, viewer=None,
+                           listing: bool = False):
         from tidb_tpu.types import FLOAT64, INT64, STRING
 
         def make(cols, rows):
@@ -746,8 +759,55 @@ class Catalog:
                 [("time", STRING), ("db", STRING), ("query_time", FLOAT64),
                  ("query", STRING), ("digest", STRING),
                  ("plan_digest", STRING), ("max_mem", INT64),
-                 ("dispatches", INT64)],
+                 ("dispatches", INT64), ("trace_id", STRING),
+                 ("disposition", STRING)],
                 list(self.slow_queries),
+            )
+        if name == "cluster_trace":
+            # one row per span of every KEPT trace (the process-global
+            # tail-sampled store) — joinable against slow_query.trace_id
+            # and the /metrics exemplars
+            from tidb_tpu.utils import tracing
+
+            rows = []
+            for t in tracing.STORE.traces():
+                ts = _time_strftime(t.start_ts)
+                keep = ",".join(t.keep_reasons)
+                for s in list(t.spans):
+                    rows.append((
+                        t.trace_id, ts, keep, s.span_id, s.parent_id,
+                        s.name, s.proc or "local", s.start_us,
+                        max(s.dur_us, 0), ";".join(s.notes)))
+            return make(
+                [("trace_id", STRING), ("time", STRING), ("keep", STRING),
+                 ("span_id", INT64), ("parent_span_id", INT64),
+                 ("name", STRING), ("proc", STRING), ("start_us", INT64),
+                 ("duration_us", INT64), ("annotations", STRING)],
+                rows,
+            )
+        if name == "dcn_worker_stats":
+            # per-worker failure-domain counters of every live Cluster
+            # in this process (PR 4's Cluster.worker_stats() was Python-
+            # API-only; this makes it joinable from SQL)
+            rows = []
+            if not listing:
+                from tidb_tpu.parallel.dcn import clusters_alive
+
+                for ci, cl in enumerate(clusters_alive()):
+                    try:
+                        rows.extend((ci,) + r
+                                    for r in cl.worker_stats_rows())
+                    except Exception:  # noqa: BLE001 — a dying cluster
+                        continue       # must not fail the whole read
+            return make(
+                [("cluster", INT64), ("worker", INT64),
+                 ("endpoint", STRING), ("state", STRING),
+                 ("executed", INT64), ("cancelled", INT64),
+                 ("deadline_exceeded", INT64), ("cancel_rpcs", INT64),
+                 ("pages", INT64), ("open_cursors", INT64),
+                 ("reconnects", INT64), ("replica", INT64),
+                 ("error", STRING)],
+                rows,
             )
         if name == "statements_summary":
             return make(
@@ -782,9 +842,16 @@ class Catalog:
         return None
 
 
+def _time_strftime(ts: float) -> str:
+    import time
+
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+
+
 _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
                 "key_column_usage", "referential_constraints",
-                "partitions", "processlist", "statements_summary")
+                "partitions", "processlist", "statements_summary",
+                "cluster_trace", "dcn_worker_stats")
 
 
 class SessionCatalog:

@@ -56,8 +56,12 @@ def record_compile(kernel: str = "join") -> None:
     """Count one kernel (re)trace on this thread. Called from inside
     traced jit bodies (they only execute at trace time), so the counter
     moves on real XLA compilations — EXPLAIN ANALYZE diffs it around
-    each operator to surface per-operator recompiles."""
+    each operator to surface per-operator recompiles, and the statement
+    trace (if one is active) gets the event as a span annotation."""
     _tls.compiles = getattr(_tls, "compiles", 0) + 1
+    from tidb_tpu.utils import tracing
+
+    tracing.annotate(f"recompile:{kernel}")
 
 
 def compile_count() -> int:

@@ -37,6 +37,8 @@ def _wrap(e) -> None:
 
     def open_(ctx):
         t0 = time.perf_counter()
+        if st.first_ts is None:
+            st.first_ts = t0
         d0 = dispatch.count()
         c0 = dispatch.compile_count()
         try:
@@ -48,6 +50,8 @@ def _wrap(e) -> None:
 
     def next_():
         t0 = time.perf_counter()
+        if st.first_ts is None:
+            st.first_ts = t0
         d0 = dispatch.count()
         c0 = dispatch.compile_count()
         ch = orig_next()
@@ -62,9 +66,22 @@ def _wrap(e) -> None:
     e.open, e.next = open_, next_
 
 
+_GANTT_W = 10  # character width of the proportional start-offset column
+
+
 def analyze_text(root) -> str:
-    """TiDB-style EXPLAIN ANALYZE table over an executed executor tree."""
-    rows: List[Tuple[str, str, str, str]] = []
+    """TiDB-style EXPLAIN ANALYZE table over an executed executor tree.
+
+    The `start` column is each operator's first-activity offset from
+    the earliest operator start (stats.first_ts), rendered with a
+    proportional gutter — overlapping async fragment executors used to
+    render as if they ran sequentially."""
+    rows: List[Tuple[str, str, str, str, str]] = []
+    anchor = min((e_ts for e_ts in _walk_first_ts(root)), default=None)
+    span_total = 0.0
+    if anchor is not None:
+        for ts in _walk_first_ts(root):
+            span_total = max(span_total, ts - anchor)
 
     def visit(e, depth: int, last: bool):
         indent = ""
@@ -77,10 +94,18 @@ def analyze_text(root) -> str:
             e.stats.dispatches - sum(c.stats.dispatches for c in e.children), 0)
         own_rc = max(
             e.stats.recompiles - sum(c.stats.recompiles for c in e.children), 0)
+        if anchor is not None and e.stats.first_ts is not None:
+            off = e.stats.first_ts - anchor
+            pos = (round(off / span_total * (_GANTT_W - 1))
+                   if span_total > 0 else 0)
+            start = "·" * pos + "|" + f" +{off * 1e6:.0f}us"
+        else:
+            start = "|"
         rows.append((
             indent + type(e).__name__.replace("Exec", ""),
             str(e.stats.rows),
             f"{total * 1e3:.1f}ms",
+            start,
             f"open:{e.stats.open_wall * 1e3:.1f}ms own:{own * 1e3:.1f}ms "
             f"loops:{e.stats.chunks} dispatches:{own_disp}"
             + (f" recompiles:{own_rc}" if own_rc else ""),
@@ -92,7 +117,18 @@ def analyze_text(root) -> str:
     w0 = max(len(r[0]) for r in rows) + 2
     w1 = max(len(r[1]) for r in rows) + 2
     w2 = max(len(r[2]) for r in rows) + 2
-    lines = [f"{'id':<{w0}}{'actRows':<{w1}}{'time':<{w2}}execution info"]
+    w3 = max(len(r[3]) for r in rows) + 2
+    lines = [f"{'id':<{w0}}{'actRows':<{w1}}{'time':<{w2}}"
+             f"{'start':<{w3}}execution info"]
     for r in rows:
-        lines.append(f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:<{w2}}{r[3]}")
+        lines.append(f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:<{w2}}{r[3]:<{w3}}{r[4]}")
     return "\n".join(lines)
+
+
+def _walk_first_ts(root):
+    stack = [root]
+    while stack:
+        e = stack.pop()
+        if e.stats.first_ts is not None:
+            yield e.stats.first_ts
+        stack.extend(e.children)

@@ -75,17 +75,34 @@ class Gauge(Counter):
         self.inc(-n, **labels)
 
 
+# a stored exemplar older than this stops shielding its (possibly
+# smaller) value: the "worst recent observation" window
+_EXEMPLAR_WINDOW_S = 60.0
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name, help_="", buckets=_DEFAULT_BUCKETS, registry=None):
+    def __init__(self, name, help_="", buckets=_DEFAULT_BUCKETS,
+                 registry=None, exemplars: bool = False):
         super().__init__(name, help_, registry)
         self.buckets = tuple(buckets)
         self._counts: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
+        # exemplars=True: each observation under an active trace may
+        # become the label set's exemplar — the trace_id of the worst
+        # recent observation, rendered OpenMetrics-style on the +Inf
+        # bucket so /metrics links straight to /trace?id=<trace_id>
+        self.exemplars_enabled = exemplars
+        self._exemplars: Dict[Tuple, Tuple[float, str, float]] = {}
 
     def observe(self, v: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
+        trace_id = ""
+        if self.exemplars_enabled:
+            from tidb_tpu.utils import tracing
+
+            trace_id = tracing.current_trace_id()
         with self.lock:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
             i = 0
@@ -93,6 +110,20 @@ class Histogram(_Metric):
                 i += 1
             counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + v
+            if trace_id:
+                import time as _time
+
+                now = _time.time()
+                cur = self._exemplars.get(key)
+                if cur is None or v >= cur[0] \
+                        or now - cur[2] > _EXEMPLAR_WINDOW_S:
+                    self._exemplars[key] = (v, trace_id, now)
+
+    def exemplar(self, **labels) -> Optional[Tuple[float, str]]:
+        """(value, trace_id) of the worst recent observation, or None."""
+        with self.lock:
+            e = self._exemplars.get(tuple(sorted(labels.items())))
+        return (e[0], e[1]) if e is not None else None
 
     def count(self, **labels) -> int:
         with self.lock:
@@ -100,10 +131,11 @@ class Histogram(_Metric):
 
     def samples(self):
         with self.lock:  # snapshot under the lock (see Counter.samples)
-            items = [(k, list(self._counts[k]), self._sums.get(k, 0.0))
+            items = [(k, list(self._counts[k]), self._sums.get(k, 0.0),
+                      self._exemplars.get(k))
                      for k in sorted(self._counts)]
-        for key, counts, total in items:
-            yield dict(key), counts, total
+        for key, counts, total, ex in items:
+            yield dict(key), counts, total, ex
 
 
 def _fmt_labels(labels: Dict, extra: str = "") -> str:
@@ -123,7 +155,7 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
         out.append(f"# HELP {m.name} {m.help}")
         out.append(f"# TYPE {m.name} {m.kind}")
         if isinstance(m, Histogram):
-            for labels, counts, total in m.samples():
+            for labels, counts, total, ex in m.samples():
                 acc = 0
                 for b, c in zip(m.buckets, counts):
                     acc += c
@@ -131,7 +163,11 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
                     out.append(f"{m.name}_bucket{le} {acc}")
                 acc += counts[-1]
                 le = _fmt_labels(labels, 'le="+Inf"')
-                out.append(f"{m.name}_bucket{le} {acc}")
+                # OpenMetrics exemplar: the worst recent observation's
+                # trace_id, linking the histogram to /trace?id=...
+                tail = (f' # {{trace_id="{ex[1]}"}} {round(ex[0], 6)}'
+                        if ex is not None else "")
+                out.append(f"{m.name}_bucket{le} {acc}{tail}")
                 out.append(f"{m.name}_sum{_fmt_labels(labels)} {total}")
                 out.append(f"{m.name}_count{_fmt_labels(labels)} {acc}")
         else:
@@ -169,7 +205,9 @@ DISPATCH_TOTAL = Counter(
 FRAGMENT_SECONDS = Histogram(
     "tidb_tpu_fragment_seconds",
     "Wall time of one mesh-fragment dispatch, by kind (async dispatch: "
-    "measures launch + any synchronous trace/compile, not device busy)")
+    "measures launch + any synchronous trace/compile, not device busy); "
+    "carries a trace_id exemplar for the worst recent dispatch",
+    exemplars=True)
 FRAGMENT_COMPILE = Counter(
     "tidb_tpu_fragment_compile_total",
     "Fragment programs compiled from plan subtrees, by output kind")
@@ -204,7 +242,8 @@ JOIN_COMPILE_TOTAL = Counter(
 JOIN_PROBE_SECONDS = Histogram(
     "tidb_tpu_join_probe_seconds",
     "Wall time of one fused probe+expand pass over a probe chunk, by "
-    "join kind")
+    "join kind; carries a trace_id exemplar for the worst recent pass",
+    exemplars=True)
 JOIN_BUILD_SECONDS = Histogram(
     "tidb_tpu_join_build_seconds",
     "Wall time of one hash-join build phase (drain + pack + sort), by "
@@ -238,3 +277,16 @@ SPILL_TOTAL = Counter(
     "tidb_tpu_spill_total", "Operator-state spill events to tmp storage")
 SPILL_BYTES = Counter(
     "tidb_tpu_spill_bytes_total", "Bytes shed to tmp storage by spills")
+
+# -- distributed tracing (ISSUE 5) ------------------------------------------
+
+DCN_RPC_SECONDS = Histogram(
+    "tidb_tpu_dcn_rpc_seconds",
+    "One coordinator->worker RPC round trip, by rpc command; carries a "
+    "trace_id exemplar for the worst recent call so /metrics links "
+    "straight to the offending trace on /trace?id=",
+    exemplars=True)
+TRACE_KEPT_TOTAL = Counter(
+    "tidb_tpu_trace_kept_total",
+    "Traces retained in the tail-sampled store, by first keep reason "
+    "(sampled, slow, error:*, retry, failover, trace)")
