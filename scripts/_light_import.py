@@ -1,0 +1,35 @@
+"""Import tidb_tpu.analysis without the engine's device stack.
+
+``tidb_tpu/__init__.py`` imports jax and mutates global jax config
+(x64 mode, compilation cache) as an import side effect.  The invariant
+analyzer's contract is the opposite: pure AST + stdlib, a couple of
+seconds end to end, runnable on a box with no jax at all.  Importing
+``tidb_tpu.analysis`` (or ``tidb_tpu.utils.metrics`` — stdlib-only
+itself) the normal way would execute the parent package first and
+break that contract.
+
+``ensure_light_tidb_tpu(root)`` registers a bare namespace package for
+``tidb_tpu`` so submodule imports resolve against ``root`` WITHOUT
+running the package ``__init__``.  It is a no-op when the real package
+is already imported (pytest: the suite imports the engine first, and
+the analyzer modules must be shared, not shadowed).
+
+Only the check CLIs may call this: the stub skips the x64 flag, so a
+process that later imports the engine proper would compute wrong
+decimals.  Scripts are single-purpose processes; that cannot happen.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import sys
+
+
+def ensure_light_tidb_tpu(root: str) -> None:
+    if "tidb_tpu" in sys.modules:
+        return
+    spec = importlib.machinery.ModuleSpec("tidb_tpu", None, is_package=True)
+    spec.submodule_search_locations = [os.path.join(root, "tidb_tpu")]
+    sys.modules["tidb_tpu"] = importlib.util.module_from_spec(spec)

@@ -1,21 +1,18 @@
 #!/usr/bin/env python
 """Failpoint coverage checker (tier-1; see tests/test_failpoint_coverage.py).
 
+Thin CLI shim: the scan lives in ``tidb_tpu.analysis.registry`` (the
+``failpoint-coverage`` pass of ``scripts/check_invariants.py``).  The
+original surface (``scan``/``main``) is preserved.
+
 Cross-references the two halves of the fault-injection surface:
 
   * injection SITES — `inject("name")` calls inside tidb_tpu/
   * ARMED names    — `failpoint("name", ...)` / `enable("name", ...)`
-                     in tests/ (and anywhere else under the repo root)
 
 A name armed by a test with no matching inject() site is a DEAD
-failpoint: the test believes it is exercising a fault path that cannot
-fire (usually a refactor moved or renamed the call site). That is an
-error — exit 1.
-
-An inject() site no test ever arms is an UNCOVERED injection point: the
-fault boundary exists but nothing drives it. Listed on stdout; fails
-only under --strict (the chaos suite keeps DCN points covered, but a
-freshly added boundary shouldn't break CI before its test lands).
+failpoint — exit 1.  An inject() site no test ever arms is UNCOVERED:
+listed on stdout; fails only under --strict.
 
 Usage: python scripts/check_failpoints.py [--strict] [--root DIR]
 """
@@ -24,79 +21,36 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
-from typing import Dict, List, Set, Tuple
+from typing import Set
 
-# inject("...") — the call-site half. Matches only string literals: a
-# dynamically computed name can't be statically checked and must not
-# silently pass, so we also flag non-literal inject() calls.
-_SITE_RE = re.compile(r"""\binject\(\s*(['"])([^'"]+)\1\s*\)""")
-_SITE_DYN_RE = re.compile(r"""\binject\(\s*[^'")]""")
-# failpoint("...")/enable("...") — the arming half (context manager or
-# module function, with or without the `fp.` prefix)
-_ARM_RE = re.compile(r"""\b(?:failpoint|enable)\(\s*(['"])([^'"]+)\1""")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-_SELF = {"failpoint.py", "check_failpoints.py"}
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+try:
+    # keep this checker jax-free: stub the tidb_tpu namespace so the
+    # analysis import never executes the engine __init__ (which
+    # imports jax). No-op under pytest.
+    from _light_import import ensure_light_tidb_tpu  # noqa: E402
+finally:
+    sys.path.pop(0)
+ensure_light_tidb_tpu(_ROOT)
 
-
-def _py_files(root: str, subdir: str) -> List[str]:
-    out = []
-    for dirpath, dirnames, filenames in os.walk(os.path.join(root, subdir)):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        out.extend(os.path.join(dirpath, f) for f in filenames
-                   if f.endswith(".py") and f not in _SELF)
-    return sorted(out)
+from tidb_tpu.analysis.registry import failpoint_scan  # noqa: E402
 
 
-def scan(root: str) -> Tuple[Dict[str, List[str]], Dict[str, List[str]],
-                             List[str]]:
-    """-> (sites, armed, dynamic_sites): name -> ["file:line", ...].
-
-    A site also counts as ARMED (covered) when its exact name appears
-    as a string literal anywhere under tests/ — chaos grids arm
-    failpoints through parametrized lists (`failpoint(fault, ...)`), so
-    requiring the literal inside the failpoint() call itself would
-    misreport every grid as uncovered. The DEAD direction stays strict:
-    only names inside literal failpoint()/enable() calls can be dead."""
-    sites: Dict[str, List[str]] = {}
-    armed: Dict[str, List[str]] = {}
-    dynamic: List[str] = []
-    for path in _py_files(root, "tidb_tpu"):
-        rel = os.path.relpath(path, root)
-        with open(path, encoding="utf-8") as f:
-            for ln, line in enumerate(f, 1):
-                for m in _SITE_RE.finditer(line):
-                    sites.setdefault(m.group(2), []).append(f"{rel}:{ln}")
-                if _SITE_DYN_RE.search(line) and "def inject" not in line:
-                    dynamic.append(f"{rel}:{ln}")
-    test_blobs: List[Tuple[str, str]] = []
-    for sub in ("tests", "tidb_tpu", "scripts"):
-        for path in _py_files(root, sub):
-            rel = os.path.relpath(path, root)
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            if sub == "tests":
-                test_blobs.append((rel, text))
-            for ln, line in enumerate(text.splitlines(), 1):
-                for m in _ARM_RE.finditer(line):
-                    armed.setdefault(m.group(2), []).append(f"{rel}:{ln}")
-    for name in sites:
-        if name in armed:
-            continue
-        for rel, text in test_blobs:
-            if f'"{name}"' in text or f"'{name}'" in text:
-                armed.setdefault(name, []).append(f"{rel} (mention)")
-                break
-    return sites, armed, dynamic
+def scan(root: str):
+    """Back-compat: -> (sites, armed, dynamic): name -> ["file:line"]."""
+    return failpoint_scan(root)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--strict", action="store_true",
                     help="also fail on uncovered injection points")
-    ap.add_argument("--root", default=os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--root", default=_ROOT)
     args = ap.parse_args(argv)
 
     sites, armed, dynamic = scan(args.root)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Engine invariant analyzer CLI (tier-1; see tests/test_static_analysis.py).
+
+Runs the AST lint passes in tidb_tpu/analysis/ over the repo:
+
+  jit-hygiene          device programs module-level + argument-driven
+  host-sync            no silent device→host syncs in hot loop bodies
+  lock-discipline      lock-order cycles, mixed locked/unlocked writes
+  metrics-coverage     /metrics collectors rendered + documented
+  failpoint-coverage   no dead/armed-but-siteless failpoints
+  sysvar-coverage      tidb_* sysvars registered, read, documented
+  error-shape          no bare/swallowing excepts; errors carry codes
+
+Exit 0 only with zero unsuppressed violations.  Suppressions need an
+inline reason (`# lint: disable=<pass> -- <reason>`, or
+`# host-sync: <reason>` for intentional syncs) and are counted in the
+report so the allowlist stays visible.
+
+Usage: python scripts/check_invariants.py [--root DIR] [--pass NAME]
+       [--list] [--syncs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_analysis(root: str):
+    sys.path.insert(0, root)
+    try:
+        import importlib.util as _ilu
+        _spec = _ilu.spec_from_file_location(
+            "_light_import",
+            os.path.join(root, "scripts", "_light_import.py"))
+        _light = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_light)
+        # keep the analyzer jax-free: register a namespace stub for
+        # tidb_tpu so importing the analysis subpackage never executes
+        # the engine __init__ (which imports jax). No-op under pytest.
+        _light.ensure_light_tidb_tpu(root)
+        from tidb_tpu.analysis import core  # noqa: F401
+        from tidb_tpu import analysis
+    finally:
+        sys.path.pop(0)
+    return analysis
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=ROOT)
+    ap.add_argument("--pass", dest="passes", action="append", default=None,
+                    metavar="NAME", help="run only the named pass(es)")
+    ap.add_argument("--list", action="store_true",
+                    help="list available passes and exit")
+    ap.add_argument("--syncs", action="store_true",
+                    help="also print the annotated intentional host-sync "
+                         "table (the README source of truth)")
+    args = ap.parse_args(argv)
+
+    analysis = _import_analysis(ROOT)
+    passes = analysis.all_passes()
+    if args.list:
+        for p in passes:
+            print(f"{p.id:20s} {p.doc}")
+        return 0
+    if args.passes:
+        known = {p.id for p in passes}
+        unknown = [n for n in args.passes if n not in known]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)} "
+                  f"(have: {', '.join(sorted(known))})")
+            return 2
+        passes = [p for p in passes if p.id in args.passes]
+
+    driver = analysis.Driver(args.root, passes)
+    reports = driver.run()
+    text, rc = driver.render(reports)
+    print(text)
+
+    if args.syncs:
+        from tidb_tpu.analysis.host_sync import annotated_sites
+
+        print("\nannotated intentional host syncs:")
+        for rel, line, reason in annotated_sites(driver.project):
+            print(f"  {rel}:{line}  {reason}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Fixture: an errors.py whose class resolves no MySQL code. Must be
+flagged by error-shape when placed as tidb_tpu/errors.py."""
+
+
+class GoodError(Exception):
+    code = 1105
+
+
+class CodelessError(Exception):   # BAD: no code anywhere in the chain
+    pass

@@ -1,0 +1,16 @@
+"""Fixture: bare except + silent broad swallow. Must be flagged by
+error-shape (twice)."""
+
+
+def cleanup(conn):
+    try:
+        conn.close()
+    except:                  # BAD: bare except
+        pass
+
+
+def best_effort(hook):
+    try:
+        hook()
+    except Exception:        # BAD: silent swallow, no inline reason
+        pass

@@ -1,0 +1,23 @@
+"""Fixture: implicit device→host syncs on device values. Must be
+flagged by host-sync (when placed under tidb_tpu/executor/)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def drain(chunks):
+    total = 0
+    for ch in chunks:
+        y = jnp.sum(ch)
+        total += int(y)            # BAD: scalar sync per chunk
+        host = np.asarray(y * 2)   # BAD: implicit transfer per chunk
+        total += host.size
+    return total
+
+
+def item_sync(xs):
+    out = []
+    for x in xs:
+        d = jnp.max(x)
+        out.append(d.item())       # BAD: .item() sync per element
+    return out

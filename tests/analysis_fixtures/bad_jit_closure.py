@@ -1,0 +1,15 @@
+"""Fixture: the PR 3 retrace bug class — a jit minted per call whose
+closure freezes a query-specific value. Must be flagged by jit-hygiene."""
+
+import jax
+
+
+def make_kernel(scale, offset):
+    def kernel(x):
+        return x * scale + offset
+
+    return jax.jit(kernel)  # BAD: function-scope jit, closes over both
+
+
+def make_lambda(table):
+    return jax.jit(lambda x: x + table.base)  # BAD: lambda closure

@@ -1,0 +1,22 @@
+"""Fixture: AB/BA lock-acquisition cycle — a statically-provable
+deadlock candidate. Must be flagged by lock-discipline."""
+
+import threading
+
+
+class Exchange:
+    def __init__(self):
+        self.send_lock = threading.Lock()
+        self.recv_lock = threading.Lock()
+        self.inbox = []
+        self.outbox = []
+
+    def push(self, item):
+        with self.send_lock:
+            with self.recv_lock:       # BAD: send -> recv here ...
+                self.outbox.append(item)
+
+    def pull(self):
+        with self.recv_lock:
+            with self.send_lock:       # ... recv -> send here: cycle
+                return self.inbox.pop()

@@ -1,0 +1,11 @@
+"""Fixture: a suppression with NO reason — itself a violation (the
+`suppressions` hygiene report must flag it)."""
+
+import jax
+
+
+def make_kernel(scale):
+    def kernel(x):
+        return x * scale
+
+    return jax.jit(kernel)  # lint: disable=jit-hygiene

@@ -1,0 +1,8 @@
+"""Fixture: reads a sysvar that is not registered. Must be flagged by
+sysvar-coverage (with a mini sysvars.py registering tidb_dead_knob)."""
+
+
+def route(session):
+    if session.sysvars.get("tidb_ghost_knob"):   # BAD: unregistered
+        return "device"
+    return "host"

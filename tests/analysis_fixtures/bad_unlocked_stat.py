@@ -1,0 +1,22 @@
+"""Fixture: shared stats dict mutated under a lock on one path and
+bare on another. Must be flagged by lock-discipline."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self.stats = {"executed": 0, "cancelled": 0}
+
+    def bump(self, key):
+        with self._stats_lock:
+            self.stats[key] += 1
+
+    def serve(self):
+        self.stats["executed"] += 1   # BAD: unlocked write, races bump()
+
+    def reset(self):
+        # BAD: tuple-assign rebind is a mutation too (the dcn close()
+        # bug class) — must not slip past the target peel
+        self.stats, self.extra = {}, None

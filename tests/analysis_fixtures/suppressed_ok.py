@@ -1,0 +1,24 @@
+"""Fixture: the same violations as the bad_* files, each carrying a
+reasoned suppression — the driver must report ZERO unsuppressed
+violations (and count the suppressions)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_kernel(scale):
+    def kernel(x):
+        return x * scale
+
+    # lint: disable=jit-hygiene -- fixture: pretend this is cached by
+    # a signature key covering `scale`
+    return jax.jit(kernel)
+
+
+def drain(chunks):
+    total = 0
+    for ch in chunks:
+        y = jnp.sum(ch)
+        # host-sync: fixture — the one intentional scalar per chunk
+        total += int(y)
+    return total

@@ -1,0 +1,421 @@
+"""Engine invariant analyzer, wired tier-1 (ISSUE 6; modeled on
+test_metrics_coverage / test_failpoint_coverage):
+
+  * scripts/check_invariants.py must exit 0 on the real tree — zero
+    unsuppressed violations across all passes, every suppression with
+    a reason
+  * each fixture snippet in tests/analysis_fixtures/ is provably
+    caught by its pass (negative checks: the analyzer actually detects
+    every violation class it claims to)
+  * suppression comments are honored, counted, and reasonless ones are
+    themselves violations
+  * the migrated check_metrics / check_failpoints shims keep their
+    original function surfaces (back-compat)
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_invariants.py")
+FIXTURES = os.path.join(ROOT, "tests", "analysis_fixtures")
+
+sys.path.insert(0, ROOT) if ROOT not in sys.path else None
+
+from tidb_tpu.analysis import Driver  # noqa: E402
+from tidb_tpu.analysis.core import Project  # noqa: E402
+from tidb_tpu.analysis.error_shape import ErrorShapePass  # noqa: E402
+from tidb_tpu.analysis.host_sync import (  # noqa: E402
+    HostSyncPass,
+    annotated_sites,
+)
+from tidb_tpu.analysis.jit_hygiene import JitHygienePass  # noqa: E402
+from tidb_tpu.analysis.lock_discipline import (  # noqa: E402
+    LockDisciplinePass,
+)
+from tidb_tpu.analysis.registry import SysvarCoveragePass  # noqa: E402
+
+
+def _mini_root(tmp_path, *files, sysvars=None, readme="# nothing\n"):
+    """Build a synthetic repo root: (subdir, fixture_name) pairs are
+    copied under tidb_tpu/<subdir>/; a mini sysvars.py and README are
+    always present so the registry passes have their anchors."""
+    pkg = tmp_path / "tidb_tpu"
+    (pkg / "session").mkdir(parents=True)
+    (pkg / "session" / "sysvars.py").write_text(
+        sysvars if sysvars is not None else "SYSVARS = {}\n")
+    (tmp_path / "README.md").write_text(readme)
+    for subdir, name in files:
+        dst_dir = pkg / subdir if subdir else pkg
+        dst_dir.mkdir(parents=True, exist_ok=True)
+        dst_name = "errors.py" if name == "bad_error_code.py" else name
+        shutil.copy(os.path.join(FIXTURES, name), dst_dir / dst_name)
+    return str(tmp_path)
+
+
+def _run_pass(root, p):
+    """Unsuppressed violations + suppression/hygiene report for one pass."""
+    driver = Driver(root, [p])
+    reports = driver.run()
+    by_id = {r.pass_id: r for r in reports}
+    return by_id[p.id], by_id["suppressions"]
+
+
+@pytest.fixture(scope="module")
+def real_tree_cli():
+    """ONE subprocess run of the tier-1 gate over the real tree (with
+    --syncs riding along so the annotated-sync table shares the same
+    invocation) — a full analyzer run costs seconds, so every CLI
+    assertion reuses this instead of re-running it."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--syncs"], capture_output=True,
+        text=True, cwd=ROOT, timeout=120)
+    return proc, time.monotonic() - t0
+
+
+@pytest.fixture(scope="module")
+def real_tree_reports():
+    """ONE in-process Driver run over the real tree, shared likewise."""
+    return Driver(ROOT).run()
+
+
+class TestRealTree:
+    def test_repo_is_clean(self, real_tree_cli):
+        """The tier-1 gate: the checker itself, as CI runs it. Must
+        finish fast (budget: well under the 10s target on warm FS) and
+        exit 0 with zero unsuppressed violations."""
+        proc, elapsed = real_tree_cli
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "invariants ok: 0 violation(s)" in proc.stdout
+        # generous CI headroom; measured ~5s cold on this box
+        assert elapsed < 60, f"invariant run took {elapsed:.1f}s"
+
+    def test_suppressions_all_carry_reasons(self, real_tree_reports):
+        reports = real_tree_reports
+        hygiene = [r for r in reports if r.pass_id == "suppressions"][0]
+        assert not hygiene.problems, [v.render() for v in hygiene.problems]
+        total = sum(len(r.suppressed) for r in reports)
+        assert total > 0, "expected the documented allowlist to be nonempty"
+        for r in reports:
+            for v, s in r.suppressed:
+                assert s.reason, f"reasonless suppression at {v.path}:{v.line}"
+
+    def test_probe_count_sync_is_annotated(self):
+        """The ISSUE's flagship annotation: the join's one intentional
+        per-chunk sync is documented, not invisible."""
+        sites = annotated_sites(Project(ROOT))
+        join_sites = [s for s in sites if s[0].endswith("join.py")]
+        assert join_sites, sites
+        assert any("intentional sync" in r or "sync" in r
+                   for _, _, r in join_sites)
+
+    def test_list_and_pass_filter_cli(self):
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--list"], capture_output=True,
+            text=True, cwd=ROOT, timeout=120)
+        assert proc.returncode == 0
+        for pid in ("jit-hygiene", "host-sync", "lock-discipline",
+                    "metrics-coverage", "failpoint-coverage",
+                    "sysvar-coverage", "error-shape"):
+            assert pid in proc.stdout
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--pass", "no-such-pass"],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert proc.returncode == 2
+
+    def test_syncs_table_renders(self, real_tree_cli):
+        proc, _elapsed = real_tree_cli
+        assert proc.returncode == 0
+        assert "annotated intentional host syncs:" in proc.stdout
+        assert "executor/join.py" in proc.stdout
+
+
+class TestJitHygieneFixture:
+    def test_closure_jit_is_flagged(self, tmp_path):
+        root = _mini_root(tmp_path, ("ops", "bad_jit_closure.py"))
+        rep, _ = _run_pass(root, JitHygienePass())
+        lines = {v.line for v in rep.violations}
+        msgs = " | ".join(v.message for v in rep.violations)
+        assert len(rep.violations) == 2, msgs
+        assert "scale" in msgs and "offset" in msgs  # captured names named
+        assert lines == {11, 15}, lines  # both jax.jit call sites
+
+    def test_module_level_jit_is_clean(self, tmp_path):
+        pkg = tmp_path / "tidb_tpu"
+        pkg.mkdir()
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "ok.py").write_text(
+            "import functools\nimport jax\n\n\n"
+            "@functools.partial(jax.jit, static_argnames=('n',))\n"
+            "def kernel(x, n):\n    return x * n\n")
+        rep, _ = _run_pass(str(tmp_path), JitHygienePass())
+        assert not rep.violations, [v.render() for v in rep.violations]
+
+
+class TestHostSyncFixture:
+    def test_loop_syncs_are_flagged(self, tmp_path):
+        root = _mini_root(tmp_path, ("executor", "bad_host_sync.py"))
+        rep, _ = _run_pass(root, HostSyncPass())
+        kinds = sorted(v.message for v in rep.violations)
+        assert len(rep.violations) == 3, kinds
+        assert any("int(y)" in m for m in kinds)
+        assert any("np.asarray" in m for m in kinds)
+        assert any(".item" in m for m in kinds)
+
+    def test_out_of_scope_dir_is_ignored(self, tmp_path):
+        # same file under parser/ (host tier): not in the pass scope
+        root = _mini_root(tmp_path, ("parser", "bad_host_sync.py"))
+        rep, _ = _run_pass(root, HostSyncPass())
+        assert not rep.violations
+
+
+class TestLockDisciplineFixture:
+    def test_cycle_is_flagged(self, tmp_path):
+        root = _mini_root(tmp_path, ("parallel", "bad_lock_cycle.py"))
+        p = LockDisciplinePass(modules=("tidb_tpu/parallel/bad_lock_cycle.py",))
+        rep, _ = _run_pass(root, p)
+        cyc = [v for v in rep.violations if "cycle" in v.message]
+        assert cyc, [v.render() for v in rep.violations]
+        assert "Exchange.send_lock" in cyc[0].message
+        assert "Exchange.recv_lock" in cyc[0].message
+
+    def test_unlocked_stat_is_flagged(self, tmp_path):
+        root = _mini_root(tmp_path, ("parallel", "bad_unlocked_stat.py"))
+        p = LockDisciplinePass(
+            modules=("tidb_tpu/parallel/bad_unlocked_stat.py",))
+        rep, _ = _run_pass(root, p)
+        hits = [v for v in rep.violations if "self.stats" in v.message]
+        # two unlocked sites: the bare subscript write AND the
+        # tuple-assign rebind (the dcn close() bug class)
+        assert len(hits) == 2, [v.render() for v in rep.violations]
+        assert all("without a lock" in v.message for v in hits)
+        assert {v.message.split(" in ")[1].split(" ")[0] for v in hits} == \
+            {"Worker.serve", "Worker.reset"}
+
+    def test_real_modules_use_the_locked_suffix_convention(self):
+        """The convention the pass leans on must hold: *_locked methods
+        exist in dcn.py (documentation that the heuristic is live)."""
+        with open(os.path.join(ROOT, "tidb_tpu", "parallel", "dcn.py"),
+                  encoding="utf-8") as f:
+            text = f.read()
+        assert "_locked(" in text
+
+
+class TestSysvarFixture:
+    SYSVARS = (
+        "SYSVARS = {}\n\n\n"
+        "class SysVar:\n"
+        "    def __init__(self, name, default):\n"
+        "        self.name = name\n\n\n"
+        "def _reg(*vs):\n"
+        "    for v in vs:\n"
+        "        SYSVARS[v.name] = v\n\n\n"
+        "_reg(\n"
+        "    SysVar('tidb_dead_knob', True),\n"
+        ")\n")
+
+    def test_unregistered_dead_and_undocumented(self, tmp_path):
+        root = _mini_root(tmp_path, ("session2", "bad_sysvar.py"),
+                          sysvars=self.SYSVARS)
+        rep, _ = _run_pass(root, SysvarCoveragePass())
+        msgs = [v.message for v in rep.violations]
+        assert any("tidb_ghost_knob" in m and "not registered" in m
+                   for m in msgs), msgs
+        assert any("dead sysvar 'tidb_dead_knob'" in m for m in msgs), msgs
+        assert any("tidb_dead_knob" in m and "not documented" in m
+                   for m in msgs), msgs
+
+    def test_clean_when_registered_read_and_documented(self, tmp_path):
+        root = _mini_root(
+            tmp_path,
+            sysvars=self.SYSVARS.replace("tidb_dead_knob", "tidb_live_knob"),
+            readme="docs: tidb_live_knob controls things\n")
+        pkg = os.path.join(root, "tidb_tpu")
+        with open(os.path.join(pkg, "reader.py"), "w") as f:
+            f.write("def f(s):\n    return s.sysvars.get('tidb_live_knob')\n")
+        rep, _ = _run_pass(root, SysvarCoveragePass())
+        assert not rep.violations, [v.render() for v in rep.violations]
+
+
+class TestErrorShapeFixture:
+    def test_bare_and_swallowing_excepts(self, tmp_path):
+        root = _mini_root(tmp_path, ("server", "bad_except.py"))
+        rep, _ = _run_pass(root, ErrorShapePass())
+        msgs = [v.message for v in rep.violations]
+        assert len(msgs) == 2, msgs
+        assert any("bare" in m for m in msgs)
+        assert any("swallows" in m for m in msgs)
+
+    def test_codeless_error_class(self, tmp_path):
+        root = _mini_root(tmp_path, ("", "bad_error_code.py"))
+        rep, _ = _run_pass(root, ErrorShapePass())
+        msgs = [v.message for v in rep.violations]
+        assert any("CodelessError" in m for m in msgs), msgs
+        assert not any("GoodError" in m for m in msgs), msgs
+
+    def test_annotated_broad_catch_is_allowed(self, tmp_path):
+        pkg = tmp_path / "tidb_tpu"
+        pkg.mkdir()
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "ok.py").write_text(
+            "def f(h):\n"
+            "    try:\n"
+            "        h()\n"
+            "    except Exception:  # noqa: BLE001 — best-effort hook\n"
+            "        pass\n")
+        rep, _ = _run_pass(str(tmp_path), ErrorShapePass())
+        assert not rep.violations, [v.render() for v in rep.violations]
+
+
+class TestSuppressions:
+    def test_reasoned_suppressions_are_honored_and_counted(self, tmp_path):
+        root = _mini_root(tmp_path, ("executor", "suppressed_ok.py"))
+        for p in (JitHygienePass(), HostSyncPass()):
+            rep, hygiene = _run_pass(root, p)
+            assert not rep.violations, [v.render() for v in rep.violations]
+            assert not hygiene.problems
+        rep, _ = _run_pass(root, JitHygienePass())
+        assert len(rep.suppressed) == 1
+        _v, s = rep.suppressed[0]
+        assert "signature key" in s.reason or "fixture" in s.reason
+
+    def test_reasonless_suppression_is_a_violation(self, tmp_path):
+        root = _mini_root(tmp_path, ("ops", "bad_suppression.py"))
+        rep, hygiene = _run_pass(root, JitHygienePass())
+        # the jit violation itself is suppressed...
+        assert not rep.violations
+        # ...but the reasonless directive fails the build
+        assert any("without a reason" in v.message
+                   for v in hygiene.problems), hygiene.problems
+
+    def test_stale_line_suppression_is_flagged(self, tmp_path):
+        # a line-level disable whose governed line is clean (the code it
+        # covered was fixed or drifted away) must not linger silently
+        pkg = tmp_path / "tidb_tpu"
+        pkg.mkdir()
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "x.py").write_text(
+            "A = 1  # lint: disable=error-shape -- covered code is gone\n")
+        rep, hygiene = _run_pass(str(tmp_path), ErrorShapePass())
+        assert not rep.violations
+        assert any("stale suppression" in v.message
+                   for v in hygiene.problems), hygiene.problems
+
+    def test_module_disable_is_not_stale(self, tmp_path):
+        # module-wide disables are prophylactic: clean-today is fine
+        pkg = tmp_path / "tidb_tpu"
+        pkg.mkdir()
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "x.py").write_text(
+            "# lint: module-disable=error-shape -- bench-style file\n"
+            "A = 1\n")
+        rep, hygiene = _run_pass(str(tmp_path), ErrorShapePass())
+        assert not rep.violations
+        assert not hygiene.problems, hygiene.problems
+
+    def test_other_pass_suppression_not_stale_under_pass_filter(
+            self, tmp_path):
+        # running `--pass error-shape` must not misreport a (used-by-
+        # jit-hygiene) suppression as stale just because that pass
+        # didn't run this invocation
+        root = _mini_root(tmp_path, ("executor", "suppressed_ok.py"))
+        rep, hygiene = _run_pass(root, ErrorShapePass())
+        assert not rep.violations
+        assert not hygiene.problems, hygiene.problems
+
+    def test_unknown_pass_in_directive_is_flagged(self, tmp_path):
+        pkg = tmp_path / "tidb_tpu"
+        pkg.mkdir()
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "x.py").write_text(
+            "A = 1  # lint: disable=not-a-pass -- whatever\n")
+        rep, hygiene = _run_pass(str(tmp_path), ErrorShapePass())
+        assert any("unknown pass" in v.message for v in hygiene.problems)
+
+    def test_stale_host_sync_annotation_is_flagged(self, tmp_path):
+        # an annotation covering no sync would silently pre-allowlist a
+        # future sync on that line — it must be flagged, not ignored
+        pkg = tmp_path / "tidb_tpu" / "executor"
+        pkg.mkdir(parents=True)
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "x.py").write_text(
+            "def f(xs):\n"
+            "    # host-sync: covered sync was refactored away\n"
+            "    return sum(xs)\n")
+        rep, _ = _run_pass(str(tmp_path), HostSyncPass())
+        assert any("stale host-sync" in v.message
+                   for v in rep.violations), rep.violations
+
+    def test_trailing_directive_covers_wrapped_statement(self, tmp_path):
+        # violation anchors to the sync call's line inside a wrapped
+        # statement; a directive trailing ANY line of that statement
+        # (here: the closing one) must still suppress it
+        pkg = tmp_path / "tidb_tpu" / "executor"
+        pkg.mkdir(parents=True)
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "x.py").write_text(
+            "import jax.numpy as jnp\n\n\n"
+            "def f(chunks, g):\n"
+            "    total = 0\n"
+            "    for ch in chunks:\n"
+            "        y = jnp.sum(ch)\n"
+            "        total += g(\n"
+            "            int(y),\n"
+            "            2)  # host-sync: one scalar per chunk\n"
+            "    return total\n")
+        rep, hygiene = _run_pass(str(tmp_path), HostSyncPass())
+        assert not rep.violations, [v.render() for v in rep.violations]
+        assert not hygiene.problems, hygiene.problems
+
+    def test_multiline_reason_is_joined(self, tmp_path):
+        root = _mini_root(tmp_path, ("executor", "suppressed_ok.py"))
+        rep, _ = _run_pass(root, JitHygienePass())
+        assert len(rep.suppressed) == 1
+        _v, s = rep.suppressed[0]
+        # the reason wraps onto a continuation comment line in the
+        # fixture; the recorded reason must carry the whole sentence
+        assert "signature key covering" in s.reason, s.reason
+
+
+class TestShimBackCompat:
+    """The migrated scripts keep their original function surfaces."""
+
+    def _load(self, name):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(ROOT, "scripts", f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_check_metrics_surface(self):
+        mod = self._load("check_metrics")
+        assert callable(mod.collect) and callable(mod.check) \
+            and callable(mod.main)
+        problems, names = mod.check(ROOT, os.path.join(ROOT, "README.md"))
+        assert problems == [] and len(names) > 20
+
+    def test_check_failpoints_surface(self):
+        mod = self._load("check_failpoints")
+        sites, armed, dynamic = mod.scan(ROOT)
+        assert sites and not dynamic
+        assert mod.main([]) == 0
+
+    def test_driver_pass_parity_with_shims(self, real_tree_reports):
+        """The driver's registry passes and the shims must agree: a
+        clean shim run implies clean passes (same code underneath)."""
+        by_id = {r.pass_id: r for r in real_tree_reports}
+        for pid in ("metrics-coverage", "failpoint-coverage"):
+            rep = by_id[pid]
+            assert not rep.violations, [v.render() for v in rep.violations]
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

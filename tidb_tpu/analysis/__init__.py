@@ -1,0 +1,31 @@
+"""Engine invariant analyzer (ISSUE 6): AST lint passes over tidb_tpu/.
+
+The paper's premise — TPU-native relational execution — rests on a
+handful of code invariants nothing used to enforce:
+
+  * device programs must be module-level and argument-driven (PR 3
+    found every join re-tracing because of per-instance jit closures)
+  * hot paths must not silently sync the host (ROADMAP items 1 and 3)
+  * the multi-threaded DCN/coordinator layer must keep a cycle-free
+    lock-acquisition order and never mutate shared state unlocked
+  * every registry (metrics, failpoints, sysvars) must stay covered
+  * errors must stay typed, coded, and never silently swallowed
+
+``scripts/check_invariants.py`` drives the passes (tier-1 via
+tests/test_static_analysis.py).  Suppressions require an inline reason:
+
+    # lint: disable=<pass>[,<pass>] -- <reason>            (line scope)
+    # lint: module-disable=<pass> -- <reason>              (file scope)
+    # host-sync: <reason>           (host-sync pass only; the annotated
+                                     allowlist of intentional syncs)
+
+A suppression with no reason is itself a violation, and every
+suppression is counted and reported so the allowlist stays visible.
+"""
+
+from tidb_tpu.analysis.core import (  # noqa: F401
+    Driver,
+    Project,
+    Violation,
+    all_passes,
+)

@@ -1,0 +1,319 @@
+"""host-sync pass: no silent device→host syncs on the execution tiers.
+
+Every ``int()`` / ``float()`` / ``bool()`` / ``.item()`` /
+``np.asarray`` / ``np.array`` applied to a device value blocks the
+host on the accelerator.  The executor operators run once per chunk and
+the fragment runners once per dispatch, so ANY such sync in
+``executor/``/``ops/``/``parallel/`` is a per-chunk round trip
+(ROADMAP items 1 and 3: the join's ``probe_count`` sync, the drain
+loops).  An *intentional* sync must be visible and justified: annotate
+the line with ``# host-sync: <reason>`` and it is allowlisted, counted,
+and surfaced in the README table.
+
+Device-ness is a forward dataflow within each function (no fixpoint):
+
+  seeds       calls on ``jnp.*`` / ``jax.*`` (except ``jax.device_get``,
+              whose RESULT is host), calls of names imported from
+              ``tidb_tpu.ops.*`` / ``tidb_tpu.expression.compiler``
+              (the device-kernel modules), and calls of locals bound
+              from jit builders (``jax.jit`` / ``counted_jit`` /
+              ``cached_jit`` / ``*.get_fragment`` / ``*.build_fn``)
+  propagates  through attributes, subscripts, arithmetic, tuples, and
+              (tuple-)assignment
+  launders    through the sync calls themselves (their result is host)
+
+Host-tier numpy code (spill loaders, drained chunks) stays untainted by
+design — the pass guards the *device-result* sync class, not every
+np.asarray.  ``jax.device_get`` is the sanctioned explicit fetch: it
+moves a whole pytree in ONE transfer and its result is host.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tidb_tpu.analysis.core import Pass, Project, SourceFile, Violation
+
+__all__ = ["HostSyncPass", "annotated_sites"]
+
+_SYNC_BUILTINS = {"int", "float", "bool"}
+_DEVICE_MODULE_PREFIXES = ("tidb_tpu.ops", "tidb_tpu.expression.compiler")
+_JIT_BUILDER_ATTRS = {"get_fragment", "build_fn"}
+_JIT_BUILDER_NAMES = {"cached_jit", "counted_jit"}
+
+
+def _module_device_names(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """-> (device_fn_names, device_module_aliases) for one module."""
+    fns: Set[str] = set()
+    mods: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            from_device = node.module.startswith(_DEVICE_MODULE_PREFIXES)
+            for alias in node.names:
+                full = f"{node.module}.{alias.name}"
+                if from_device:
+                    # `from tidb_tpu.ops import join_kernels as jk`
+                    # imports a MODULE; a plain name import is a kernel fn
+                    if full.startswith(_DEVICE_MODULE_PREFIXES) and \
+                            "." not in alias.name and \
+                            node.module in ("tidb_tpu.ops",):
+                        mods.add(alias.asname or alias.name)
+                    else:
+                        fns.add(alias.asname or alias.name)
+                elif full.startswith(_DEVICE_MODULE_PREFIXES):
+                    mods.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith(_DEVICE_MODULE_PREFIXES):
+                    mods.add(alias.asname or alias.name.split(".")[0])
+                if alias.name in ("jax.numpy",):
+                    mods.add(alias.asname or "jax")
+    return fns, mods
+
+
+class _FnScan:
+    """Forward taint over one function body."""
+
+    def __init__(self, sf: SourceFile, fn: ast.AST,
+                 device_fns: Set[str], device_mods: Set[str]):
+        self.sf = sf
+        self.fn = fn
+        self.device_fns = device_fns
+        self.device_mods = device_mods
+        self.tainted: Set[str] = set()
+        self.local_device_fns: Set[str] = set()
+        self.hits: List[Tuple[int, str, str]] = []  # (line, kind, detail)
+
+    # -- expression taint ------------------------------------------------
+
+    def _root_name(self, node: ast.AST) -> str:
+        while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+            node = node.func if isinstance(node, ast.Call) else node.value
+        return node.id if isinstance(node, ast.Name) else ""
+
+    def _is_device_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            root = self._root_name(f)
+            if root == "jnp" or root in self.device_mods:
+                return True
+            if root == "jax":
+                # only the array APIs produce device values; jax.devices()
+                # / jax.config / jax.device_get results live on host
+                txt = ast.unparse(f)
+                return txt.startswith("jax.lax.") or txt == "jax.device_put"
+            if root == "lax":
+                return True
+        if isinstance(f, ast.Name):
+            if f.id in self.device_fns or f.id in self.local_device_fns:
+                return True
+        return False
+
+    def _is_jit_builder_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _JIT_BUILDER_ATTRS:
+                return True
+            if f.attr == "jit" and self._root_name(f) == "jax":
+                return True
+        if isinstance(f, ast.Name) and f.id in _JIT_BUILDER_NAMES:
+            return True
+        return False
+
+    def _sync_kind(self, call: ast.Call) -> str:
+        """'' or the sync-op name when `call` is a sync operation."""
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in _SYNC_BUILTINS and call.args:
+            return f.id
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not call.args:
+                return ".item()"
+            if f.attr in ("asarray", "array") and \
+                    isinstance(f.value, ast.Name) and f.value.id == "np":
+                return f"np.{f.attr}"
+        return ""
+
+    def taint(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.taint(e.value)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.taint(x) for x in e.elts)
+        if isinstance(e, ast.BinOp):
+            return self.taint(e.left) or self.taint(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.taint(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self.taint(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            return self.taint(e.left) or any(
+                self.taint(c) for c in e.comparators)
+        if isinstance(e, ast.IfExp):
+            return self.taint(e.body) or self.taint(e.orelse)
+        if isinstance(e, ast.Call):
+            if self._sync_kind(e):
+                return False  # the sync's own result lives on host
+            if self._is_device_call(e):
+                return True
+            # method on a tainted value (x.sum(), x.astype(...)) stays
+            # on device
+            if isinstance(e.func, ast.Attribute) and self.taint(e.func.value):
+                return True
+            return False
+        return False
+
+    # -- statement walk ---------------------------------------------------
+
+    def run(self) -> None:
+        body = self.fn.body if isinstance(self.fn.body, list) else []
+        self._walk(body)
+
+    def _bind(self, target: ast.AST, tainted: bool, device_fn: bool) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.discard(target.id)
+            self.local_device_fns.discard(target.id)
+            if tainted:
+                self.tainted.add(target.id)
+            if device_fn:
+                self.local_device_fns.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, tainted, device_fn)
+
+    def _walk(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate scope, scanned on its own
+            # a device-result sync is flagged wherever it sits, not just
+            # in source-level loops: operators run once per chunk, so
+            # "outside the loop" in source is still inside one at runtime
+            self._scan_exprs(stmt)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = stmt.value
+                t = self.taint(value) if value is not None else False
+                dfn = (isinstance(value, ast.Call)
+                       and self._is_jit_builder_call(value))
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for tgt in targets:
+                    self._bind(tgt, t, dfn)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._bind(stmt.target, self.taint(stmt.iter), False)
+                self._walk(stmt.body)
+                self._walk(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self._walk(stmt.body)
+                self._walk(stmt.orelse)
+            elif isinstance(stmt, ast.If):
+                self._walk(stmt.body)
+                self._walk(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        self._bind(item.optional_vars,
+                                   self.taint(item.context_expr), False)
+                self._walk(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body)
+                for h in stmt.handlers:
+                    self._walk(h.body)
+                self._walk(stmt.orelse)
+                self._walk(stmt.finalbody)
+
+    def _scan_exprs(self, stmt: ast.stmt) -> None:
+        """Flag sync calls on tainted values anywhere in `stmt`'s own
+        expressions (not descending into nested compound statements —
+        the walk visits those itself, with taint state up to date)."""
+        compound = (ast.For, ast.AsyncFor, ast.While, ast.If, ast.With,
+                    ast.AsyncWith, ast.Try, ast.FunctionDef,
+                    ast.AsyncFunctionDef)
+        if isinstance(stmt, compound):
+            # only the header expressions belong to this statement
+            headers: List[ast.AST] = []
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                headers = [stmt.iter]
+            elif isinstance(stmt, ast.While):
+                headers = [stmt.test]
+            elif isinstance(stmt, ast.If):
+                headers = [stmt.test]
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                headers = [i.context_expr for i in stmt.items]
+            nodes = [n for h in headers for n in ast.walk(h)]
+        else:
+            nodes = list(ast.walk(stmt))
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._sync_kind(node)
+            if not kind:
+                continue
+            if kind in _SYNC_BUILTINS or kind.startswith("np."):
+                arg = node.args[0] if node.args else None
+                if arg is None or not self.taint(arg):
+                    continue
+                detail = ast.unparse(node)
+            else:  # .item(): receiver must be tainted
+                if not self.taint(node.func.value):
+                    continue
+                detail = ast.unparse(node)
+            self.hits.append((node.lineno, kind, detail[:80]))
+
+
+def _scan_file(sf: SourceFile) -> List[Tuple[int, str, str]]:
+    device_fns, device_mods = _module_device_names(sf.tree)
+    hits: List[Tuple[int, str, str]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan = _FnScan(sf, node, device_fns, device_mods)
+            scan.run()
+            hits.extend(scan.hits)
+    return hits
+
+
+class HostSyncPass(Pass):
+    id = "host-sync"
+    doc = ("no implicit device→host syncs (int/float/bool/.item()/"
+           "np.asarray on device values) in executor/ops/parallel; "
+           "intentional ones carry `# host-sync: <reason>`")
+
+    SCOPE = ("executor", "ops", "parallel")
+
+    def run(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for sf in project.files_under(*self.SCOPE):
+            used_notes = set()
+            for line, kind, detail in _scan_file(sf):
+                note = sf.host_sync_note(line)
+                if note is not None:
+                    used_notes.add(note[0])
+                    continue  # annotated allowlist (reported separately)
+                out.append(Violation(
+                    self.id, sf.rel, line,
+                    f"implicit device→host sync `{detail}` on the hot "
+                    f"tier ({kind} forces the device to flush). Batch it "
+                    "into one jax.device_get, hoist it off the per-chunk "
+                    "path, or annotate the line with `# host-sync: "
+                    "<reason>` if the sync is intentional."))
+            # an annotation covering no sync is stale: left behind, it
+            # would silently pre-allowlist a FUTURE sync on that line —
+            # the exact invisible-sync class this pass exists to catch
+            for line in sorted(set(sf.host_sync_notes) - used_notes):
+                out.append(Violation(
+                    self.id, sf.rel, line,
+                    "stale host-sync annotation: no device→host sync "
+                    "on the governed line — delete it (or re-anchor "
+                    "it; a refactor may have moved the sync)"))
+        return out
+
+
+def annotated_sites(project: Project) -> List[Tuple[str, int, str]]:
+    """Every `# host-sync:` annotation in scope — the documented
+    allowlist of intentional syncs (rendered by check_invariants and
+    mirrored in the README table)."""
+    out = []
+    for sf in project.files_under(*HostSyncPass.SCOPE):
+        for line, reason in sorted(sf.host_sync_notes.items()):
+            out.append((sf.rel, line, reason))
+    return out

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -460,7 +461,10 @@ class HashAggExec(Executor):
         self._runs = runs
         total = 0
         for chunk in self.children[0].chunks():
-            outs, sel = eval_all(chunk)
+            # ONE device fetch per chunk: device_get moves the whole
+            # (outs, sel) pytree in a single transfer where per-column
+            # np.asarray paid 2K+1 separate syncs (host-sync pass)
+            outs, sel = jax.device_get(eval_all(chunk))
             sel = np.asarray(sel)
             live = np.nonzero(sel)[0]
             total += len(live)

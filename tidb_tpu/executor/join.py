@@ -703,6 +703,8 @@ class HashJoinExec(Executor):
         if self.kind in ("semi", "anti"):
             if has_filter:
                 matched = self._qualified_matches(
+                    # host-sync: the probe_count total sizes the
+                    # qualification expansion — one scalar per chunk
                     chunk, start, real_count, cum, int(total_dev))
             elif Rp != cap:
                 matched = matched[:cap]
@@ -720,7 +722,10 @@ class HashJoinExec(Executor):
             self._pending.append(chunk.with_sel(keep))
             return
 
-        total = int(total_dev)  # the one host sync: sizes the expansion
+        # host-sync: THE one intentional sync per probe chunk — the
+        # match total sizes the tile expansion (ROADMAP item 1 wants
+        # it gone; until then it is documented here and in README)
+        total = int(total_dev)
         left_other = self.kind == "left" and has_filter
         if total == 0 and not left_other:
             return
@@ -745,6 +750,8 @@ class HashJoinExec(Executor):
             # probe rows whose every match failed other_cond (or that had
             # none) emit one NULL-payload row each, per LEFT JOIN semantics
             unmatched = chunk.sel & jnp.asarray(~matched_np)
+            # host-sync: left-join + other_cond tail — one bool per
+            # chunk decides whether a NULL-pad chunk is emitted at all
             if bool(np.asarray(unmatched).any()):
                 self._pending.append(self._null_build_chunk(chunk, unmatched))
 

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -43,7 +44,10 @@ class _Materializing(Executor):
         runs = SpillableRuns(self.ctx.mem_tracker.child("sort"), "sort")
         self._runs = runs
         for ch in child.chunks():
-            kcols, ch = eval_chunk(ch)
+            # ONE device fetch per chunk (Chunk/Column are pytrees):
+            # the per-column np.asarray calls below then see numpy and
+            # cost nothing — was 2 syncs per column (host-sync pass)
+            kcols, ch = jax.device_get(eval_chunk(ch))
             sel = np.asarray(ch.sel)
             live = np.nonzero(sel)[0]
             named = {}

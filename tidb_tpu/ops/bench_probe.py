@@ -7,6 +7,11 @@ Writes ops/PROBE_BENCH.json: per-size best-of-5 timings for the two
 strategies (plus the Pallas kernel on TPU), the same (lo, hi) contract
 the join consumes."""
 
+# lint: module-disable=jit-hygiene -- offline microbench: per-config
+# fresh jits ARE the experiment (cold compile + steady state timed)
+# lint: module-disable=host-sync -- result fetch is the measurement
+# boundary, not a hot path; nothing here runs under a query
+
 import json
 import os
 import time

@@ -1048,6 +1048,7 @@ class Cluster:
         self.partial_results = partial_results
         self.last_warnings: List[str] = []
         self._socks: List[Optional[socket.socket]] = []
+        self._closed = False
         self._endpoints = list(endpoints)
         self._partitioned: set = set()
         self._broadcast: set = set()
@@ -1224,6 +1225,11 @@ class Cluster:
             msg = dict(msg, trace_id=tr.trace_id, span_id=sp.span_id)
         try:
             with self._sock_locks[i]:  # one in-flight RPC per worker
+                if self._closed:
+                    # a late dispatch/drain thread must not redial a
+                    # worker after close() — fail loudly instead
+                    raise ConnectionError(
+                        f"dcn cluster is closed (worker {i})")
                 sock = self._socks[i]
                 if sock is None:
                     if not getattr(self._tl, "reconnect", True):
@@ -2008,21 +2014,66 @@ class Cluster:
                     continue
                 try:
                     self._call(i, {"cmd": "shutdown"})
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — goodbye is best
+                    pass  # effort; close() below drops the link anyway
         finally:
             self._tl.reconnect = prev
         self.close()
 
     def close(self) -> None:
-        for s in self._socks:
-            if s is None:
-                continue
+        # shutdown+close the fd FIRST, without the lock: an in-flight
+        # _call stuck in a blocking recv (rpc timeout 0, no deadline)
+        # HOLDS its socket lock, so taking the lock first would
+        # deadlock close(). shutdown() is what actually wakes a blocked
+        # recv on Linux — close() alone leaves it sleeping (same lesson
+        # as the PR 4 worker-kill listener). The slot is then cleared
+        # UNDER the lock — which the aborted _call has now released —
+        # because the old unlocked `self._socks = []` rebind raced a
+        # concurrent _call indexing into the previous list
+        # (lock-discipline pass: mixed locked/unlocked mutation).
+        self._closed = True  # _call refuses new RPCs/redials from here
+        for i in range(len(self._socks)):
+            # A _call that passed the _closed check before we set it may
+            # still be mid-reconnect: the slot reads None while it dials,
+            # then it installs a fresh socket and blocks in recv — all
+            # while HOLDING the sock lock. So a single snapshot-then-wait
+            # would block on the lock without ever waking that recv.
+            # Re-shutdown whatever socket is currently installed until
+            # the lock is won; shutdown on an already-dead fd is a no-op.
+            while True:
+                s = self._socks[i]
+                if s is not None:
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                if self._sock_locks[i].acquire(timeout=0.05):
+                    break
             try:
-                s.close()
-            except OSError:
-                pass
-        self._socks = []
+                cur = self._socks[i]
+                if cur is not None and cur is not s:
+                    # installed between our last shutdown and winning
+                    # the lock — no recv can be blocked on it (recv
+                    # happens under this lock), just release the fd
+                    try:
+                        cur.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        cur.close()
+                    except OSError:
+                        pass
+                # lint: disable=lock-discipline -- the lock IS held:
+                # acquired above via acquire(timeout=) because a
+                # blocking `with` is the close-vs-stuck-recv deadlock
+                # this loop exists to break
+                self._socks[i] = None
+            finally:
+                self._sock_locks[i].release()
 
 
 def _infer_type(values) -> str:

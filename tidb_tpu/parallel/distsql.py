@@ -106,6 +106,9 @@ def make_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
         chunk = pipeline(_shard_chunk(types, data, valid, sel, uid_map))
         return merge_state(update(init_state(), chunk))
 
+    # lint: disable=jit-hygiene -- signature-keyed: callers cache the
+    # returned fn via ShardCache.get_fragment (plan/shape/type key);
+    # the closure carries only schema metadata, never table arrays
     return jax.jit(shard_map_compat(
         per_shard, mesh=mesh,
         in_specs=(_SPEC, _SPEC, _SPEC), out_specs=P(), check_vma=False,
@@ -258,6 +261,9 @@ def make_join_agg_fragment(
         ovf = jax.lax.psum(p_ovf + b_ovf, _AXES)
         return state, ovf
 
+    # lint: disable=jit-hygiene -- signature-keyed via
+    # ShardCache.get_fragment like make_agg_fragment; closure carries
+    # plan metadata only (types/mesh/keys), never the ShardedTables
     return jax.jit(shard_map_compat(
         per_shard, mesh=mesh,
         in_specs=(_SPEC,) * 6, out_specs=(P(), P()), check_vma=False,

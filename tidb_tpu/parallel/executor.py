@@ -361,6 +361,8 @@ class DistJoinAggExec(HashAggExec):
             t0 = time.perf_counter()
             state, ovf = fn(probe_st.data, probe_st.valid, probe_st.sel,
                             build_st.data, build_st.valid, build_st.sel)
+            # host-sync: one scalar per dispatch — the exchange
+            # overflow count decides the grow-and-retry loop
             if int(ovf) == 0:
                 _note_fragment(self, "join_agg", probe_st.n_parts, t0)
                 self._cache.put_growth(gkey, growth)
@@ -579,6 +581,8 @@ class DistFragmentExec(HashAggExec):
             fn = self._cache.get_fragment(
                 key, lambda: prog.build_fn(growths))
             out, ovf = fn(*args)
+            # host-sync: the per-knob overflow vector (a few int64s)
+            # gates the capacity-retry loop — one fetch per dispatch
             ovf = np.asarray(ovf)
             if not (ovf > 0).any():
                 return out, growths

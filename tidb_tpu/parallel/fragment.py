@@ -821,6 +821,10 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
 
         out_spec = P() if out_kind == "segment" else P(_AXES)
         in_specs = tuple([_SPEC, _SPEC, _SPEC] * n_src + [P(), P(), P()] * n_bc)
+        # lint: disable=jit-hygiene -- signature-keyed: DistFragmentExec
+        # caches build_fn(growths) under (sig, growths, shapes, types)
+        # via ShardCache.get_fragment; the closure carries the compiled
+        # plan description only — every array arrives as an argument
         return jax.jit(shard_map_compat(
             frag, mesh=mesh, in_specs=in_specs, out_specs=(out_spec, P()),
             # pallas_call outputs carry no vma metadata; the fragment's

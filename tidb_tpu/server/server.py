@@ -59,8 +59,8 @@ class Server:
 
             jax.default_backend()
             jax.local_devices(backend="cpu")
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — probe is best-effort:
+            pass  # a failed backend init falls back per host_eager()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))

@@ -42,6 +42,9 @@ def cached_jit(ns: str, key: str, build: Callable[[], Callable], **jit_kwargs) -
 
     return get_or_build(
         _CACHE, (ns, key),
+        # lint: disable=jit-hygiene -- the sanctioned signature-keyed
+        # cache: identity is (ns, key) covering every baked constant,
+        # so a hit can never see a stale closure (module doc)
         lambda: dispatch.counted_jit(build(), site=f"jit:{ns}", **jit_kwargs),
         MAX_ENTRIES
     )

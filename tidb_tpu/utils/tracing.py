@@ -128,9 +128,14 @@ class Trace:
 
     def begin(self, name: str, parent_id: Optional[int] = None) -> Span:
         if len(self.spans) >= self.max_spans:
+            # lint: disable=lock-discipline -- lock-cheap by design (see
+            # module doc): appends/counts ride CPython atomicity; the
+            # lock only guards export/graft snapshots
             self.dropped += 1
             return _DROPPED
         s = Span(next(self._ids), parent_id, name, self._now_us())
+        # lint: disable=lock-discipline -- CPython-atomic append; the
+        # hot record path must not pay a lock per span (module doc)
         self.spans.append(s)
         return s
 
@@ -145,6 +150,8 @@ class Trace:
         """Record an already-measured interval (fragment dispatches and
         other code that timed itself with perf_counter)."""
         if len(self.spans) >= self.max_spans:
+            # lint: disable=lock-discipline -- lock-cheap by design (see
+            # module doc and begin())
             self.dropped += 1
             return _DROPPED
         s = Span(next(self._ids), parent_id, name,
@@ -152,6 +159,8 @@ class Trace:
         s.dur_us = int(dur_s * 1e6)
         if notes:
             s.notes.extend(notes)
+        # lint: disable=lock-discipline -- CPython-atomic append (see
+        # module doc and begin())
         self.spans.append(s)
         return s
 
