@@ -572,6 +572,156 @@ def bench_plan_cache(extra):
     return out
 
 
+def bench_oltp(extra, clients_list=(8, 16), iters=150):
+    """Multi-client OLTP benchmark (ISSUE 7): sysbench-style point-get
+    workload at N client threads through the serving tier, coalesced
+    (gather window on) vs unbatched (window=0 — every statement runs
+    singleton through the same scheduler), reporting stmts/s, p99,
+    engine batch/admission counters, the plan-cache hit rate, and a
+    serial-oracle byte-identical cross-check of every statement's
+    result. A small update mix rides along (reported, not floored)."""
+    import threading
+
+    from tidb_tpu.serving import StatementScheduler
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.catalog import Catalog
+    from tidb_tpu.utils import metrics as _M
+
+    n_rows = 5000
+    cat = Catalog()
+    boot = Session(catalog=cat)
+    boot.execute("SET GLOBAL tidb_slow_log_threshold = 300000")
+    boot.execute("SET GLOBAL tidb_trace_sample_rate = 0")
+    boot.execute("CREATE TABLE sbtest (id bigint primary key, k bigint,"
+                 " c varchar(64), pad varchar(32))")
+    boot.execute("INSERT INTO sbtest VALUES " + ",".join(
+        f"({i},{i % 499},'c-{i:010d}-{i * 7 % 997:04d}','pad-{i % 83}')"
+        for i in range(n_rows)))
+    boot.execute("ANALYZE TABLE sbtest")
+    point_tmpl = "select c, pad, k from sbtest where id = ?"
+
+    def key_of(client, i):
+        return (client * 7919 + i * 97) % n_rows
+
+    def run_config(n_clients, window_us, collect=None):
+        """One (clients, window) config; returns (stmts/s, p99_ms)."""
+        boot.execute(f"SET GLOBAL tidb_tpu_batch_window_us = {window_us}")
+        sched = StatementScheduler(cat, workers=4)
+        sessions = [Session(catalog=cat) for _ in range(n_clients)]
+        sids = [s.prepare(point_tmpl)[0] for s in sessions]
+        # fill + per-session warm (the miss pays sentinel verification)
+        sched.submit_prepared(sessions[0], sids[0], [0])
+        lats = [[] for _ in range(n_clients)]
+        barrier = threading.Barrier(n_clients + 1)
+
+        def client(ci):
+            sess, sid = sessions[ci], sids[ci]
+            barrier.wait()
+            for i in range(iters):
+                t0 = time.perf_counter()
+                rs = sched.submit_prepared(sess, sid, [key_of(ci, i)])
+                lats[ci].append(time.perf_counter() - t0)
+                if collect is not None:
+                    collect[ci].append(rs.rows)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        sched.shutdown()
+        flat = sorted(x for l in lats for x in l)
+        p99 = flat[int(len(flat) * 0.99) - 1] if flat else 0.0
+        return n_clients * iters / wall, p99 * 1e3
+
+    out = {"iters": iters, "rows": n_rows, "configs": []}
+    for n_clients in clients_list:
+        h0 = _M.PLAN_CACHE_TOTAL.value(event="hit")
+        cold_rps, cold_p99 = run_config(n_clients, 0)
+        bat_collect = [[] for _ in range(n_clients)]
+        c0 = _M.BATCH_COALESCE_TOTAL.value()
+        hist0 = list(next(
+            (c for _l, c, _s, _e in _M.BATCH_SIZE.samples()), [])) or None
+        warm_rps, warm_p99 = run_config(n_clients, 1500, collect=bat_collect)
+        hits = _M.PLAN_CACHE_TOTAL.value(event="hit") - h0
+        total_stmts = 2 * n_clients * iters + 2  # + the two fills
+        hist1 = list(next(
+            (c for _l, c, _s, _e in _M.BATCH_SIZE.samples()), []))
+        hist = (hist1 if hist0 is None
+                else [a - b for a, b in zip(hist1, hist0)])
+        # oracle: the same statements serially, compared byte-identical
+        oracle = Session(catalog=cat)
+        osid, _ = oracle.prepare(point_tmpl)
+        mismatches = 0
+        for ci in range(n_clients):
+            for i, got in enumerate(bat_collect[ci]):
+                want = oracle.execute_prepared(osid, [key_of(ci, i)]).rows
+                if repr(got) != repr(want):
+                    mismatches += 1
+        cfg = {
+            "clients": n_clients,
+            "unbatched_stmts_per_sec": round(cold_rps, 1),
+            "batched_stmts_per_sec": round(warm_rps, 1),
+            "speedup": round(warm_rps / max(cold_rps, 1e-9), 3),
+            "p99_ms_unbatched": round(cold_p99, 2),
+            "p99_ms_batched": round(warm_p99, 2),
+            "coalesced_stmts": _M.BATCH_COALESCE_TOTAL.value() - c0,
+            "batch_size_hist": {
+                str(b): int(c) for b, c in
+                zip(list(_M.BATCH_SIZE.buckets) + ["+Inf"], hist) if c},
+            "hit_rate": round(hits / total_stmts, 4),
+            "oracle": "ok" if mismatches == 0 else f"{mismatches} MISMATCHES",
+        }
+        out["configs"].append(cfg)
+        log(f"# oltp {n_clients} clients: unbatched={cfg['unbatched_stmts_per_sec']}/s "
+            f"batched={cfg['batched_stmts_per_sec']}/s ({cfg['speedup']}x) "
+            f"p99 {cfg['p99_ms_unbatched']}->{cfg['p99_ms_batched']}ms "
+            f"hit_rate={cfg['hit_rate']} oracle={cfg['oracle']}")
+        if mismatches:
+            log(f"# OLTP ORACLE MISMATCH at {n_clients} clients")
+    # update mix (reported only): 90/10 point-get/update at the largest
+    # client count, everything through the scheduler
+    n_clients = clients_list[-1]
+    sched = StatementScheduler(cat, workers=4)
+    sessions = [Session(catalog=cat) for _ in range(n_clients)]
+    sids = [s.prepare(point_tmpl)[0] for s in sessions]
+    sched.submit_prepared(sessions[0], sids[0], [0])
+    barrier = threading.Barrier(n_clients + 1)
+
+    def mixed(ci):
+        sess, sid = sessions[ci], sids[ci]
+        barrier.wait()
+        for i in range(iters):
+            k = key_of(ci, i)
+            if i % 10 == 9:
+                sched.submit_query(
+                    sess, f"update sbtest set k = k + 1 where id = {k}")
+            else:
+                sched.submit_prepared(sess, sid, [k])
+
+    threads = [threading.Thread(target=mixed, args=(ci,))
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    adm = sched.stats_dict()
+    sched.shutdown()
+    out["mixed_90_10_stmts_per_sec"] = round(n_clients * iters / wall, 1)
+    out["admission"] = {k: adm[k] for k in
+                        ("admitted", "rejected", "timed_out")}
+    log(f"# oltp mixed 90/10 at {n_clients} clients: "
+        f"{out['mixed_90_10_stmts_per_sec']}/s admission={out['admission']}")
+    return out
+
+
 def main(locked_detail=("acquired", "acquired")):
     extra = {}
     extra["chip_lock"] = locked_detail[1]
@@ -833,6 +983,15 @@ def main(locked_detail=("acquired", "acquired")):
         extra["join_micro"] = bench_join_micro(extra)
     except Exception as e:  # noqa: BLE001
         extra["join_micro_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # multi-client OLTP through the serving tier (ISSUE 7): coalesced vs
+    # unbatched stmts/s + p99 + admission counters, serial-oracle checked
+    # (host-only: the win being measured is scheduling + batched dispatch)
+    try:
+        log("# oltp serving bench")
+        extra["oltp"] = bench_oltp(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["oltp_error"] = f"{type(e).__name__}: {e}"[:300]
 
     print(json.dumps({
         "metric": "tpch_q1_rows_per_sec",

@@ -127,6 +127,35 @@ def main():
         if jm_ratio < 3.0:
             pc_bad.append(f"join_warm_over_cold={jm_ratio} < 3.0")
 
+        # OLTP serving FIXED floors (ISSUE 7): coalesced throughput must
+        # beat unbatched at >= 8 clients and by >= 1.5x at 16, with the
+        # plan-cache hit rate preserved and every statement's result
+        # byte-identical to serial execution. Ratios are self-relative
+        # (both arms run back to back through the SAME scheduler), so
+        # they're robust to machine speed; best-of-3 absorbs jitter.
+        # Correctness floors (oracle, hit rate) must hold on EVERY run.
+        ol_bad = {}
+        ol_speed = {}
+        for _ in range(3):
+            ol = bench.bench_oltp({})
+            for cfg in ol["configs"]:
+                nc = cfg["clients"]
+                ol_speed[nc] = max(ol_speed.get(nc, 0.0), cfg["speedup"])
+                if cfg["oracle"] != "ok":
+                    ol_bad[f"oltp_oracle[{nc}]"] = cfg["oracle"]
+                if cfg["hit_rate"] < 0.9:
+                    ol_bad[f"oltp_hit_rate[{nc}]"] = (
+                        f"{cfg['hit_rate']} < 0.9")
+            if (not ol_bad and ol_speed.get(8, 0.0) >= 1.0
+                    and ol_speed.get(16, 0.0) >= 1.5):
+                break
+        for nc, need in ((8, 1.0), (16, 1.5)):
+            got = ol_speed.get(nc, 0.0)
+            print(f"oltp_batched_speedup[{nc}] {got}  (need >= {need})")
+            if got < need:
+                ol_bad[f"oltp_batched_speedup[{nc}]"] = f"{got} < {need}"
+        pc_bad.extend(f"{k}={v}" for k, v in ol_bad.items())
+
         load1 = bench.machine_load()
         busy_after = load1["loadavg"][0] > BUSY_LOAD or load1.get("busy_procs")
 

@@ -1,0 +1,32 @@
+"""Fixture: a gather-window wait while holding another lock — the
+serving-tier stall the lock-discipline wait check must flag."""
+
+import threading
+
+
+class BadGather:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cv = threading.Condition()
+        self.members = []
+
+    def gather(self, deadline):
+        with self.lock:            # catalog-lock stand-in
+            with self.cv:
+                while not self.members:
+                    self.cv.wait(deadline)   # BAD: parks with self.lock held
+
+    def gather_ok(self, deadline):
+        with self.cv:
+            while not self.members:
+                self.cv.wait(deadline)       # ok: only the cv's own lock
+
+    def gather_match(self, mode, deadline):
+        match mode:
+            case "bad":
+                with self.lock:
+                    with self.cv:
+                        self.cv.wait(deadline)   # BAD: inside a match arm
+            case _:
+                with self.cv:
+                    self.cv.wait(deadline)       # ok: own lock only

@@ -198,6 +198,28 @@ class TestLockDisciplineFixture:
         assert {v.message.split(" in ")[1].split(" ")[0] for v in hits} == \
             {"Worker.serve", "Worker.reset"}
 
+    def test_gather_wait_under_foreign_lock_is_flagged(self, tmp_path):
+        """ISSUE 7 serving discipline: a cv.wait() while holding another
+        lock (the batch gather window parked with the catalog lock held)
+        is flagged; waiting with only the cv's own lock is not."""
+        root = _mini_root(tmp_path, ("serving", "bad_gather_wait.py"))
+        p = LockDisciplinePass(
+            modules=(), wait_modules=("tidb_tpu/serving/bad_gather_wait.py",))
+        rep, _ = _run_pass(root, p)
+        hits = [v for v in rep.violations if "wait()" in v.message]
+        # the plain nested-with site AND the one inside a match arm
+        assert len(hits) == 2, [v.render() for v in rep.violations]
+        assert all("self.lock" in v.message for v in hits)
+        assert all("gather-window" in v.message for v in hits)
+
+    def test_real_serving_modules_wait_lock_free(self):
+        """The real serving tier must pass its own wait discipline (the
+        default wait_modules cover scheduler.py + batcher.py)."""
+        from tidb_tpu.analysis.lock_discipline import DEFAULT_WAIT_MODULES
+
+        assert any("batcher" in m for m in DEFAULT_WAIT_MODULES)
+        assert any("scheduler" in m for m in DEFAULT_WAIT_MODULES)
+
     def test_real_modules_use_the_locked_suffix_convention(self):
         """The convention the pass leans on must hold: *_locked methods
         exist in dcn.py (documentation that the heuristic is live)."""

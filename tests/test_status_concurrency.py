@@ -12,7 +12,7 @@ from tidb_tpu.session import Session
 from tidb_tpu.storage.catalog import Catalog
 
 ENDPOINTS = ("/metrics", "/status", "/schema", "/statements",
-             "/plan_cache", "/cluster", "/trace")
+             "/plan_cache", "/cluster", "/scheduler", "/trace")
 
 N_THREADS = 4
 N_REQS = 25

@@ -275,10 +275,10 @@ def _scan_file(sf: SourceFile) -> List[Tuple[int, str, str]]:
 class HostSyncPass(Pass):
     id = "host-sync"
     doc = ("no implicit device→host syncs (int/float/bool/.item()/"
-           "np.asarray on device values) in executor/ops/parallel; "
-           "intentional ones carry `# host-sync: <reason>`")
+           "np.asarray on device values) in executor/ops/parallel/"
+           "serving; intentional ones carry `# host-sync: <reason>`")
 
-    SCOPE = ("executor", "ops", "parallel")
+    SCOPE = ("executor", "ops", "parallel", "serving")
 
     def run(self, project: Project) -> List[Violation]:
         out: List[Violation] = []

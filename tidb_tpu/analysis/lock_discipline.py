@@ -44,6 +44,18 @@ DEFAULT_MODULES = (
     "tidb_tpu/planner/plancache.py",
     "tidb_tpu/utils/stmtsummary.py",
     "tidb_tpu/storage/catalog.py",
+    "tidb_tpu/serving/scheduler.py",
+    "tidb_tpu/serving/batcher.py",
+)
+
+# serving-tier gather discipline (ISSUE 7): modules where a blocking
+# wait() must never park the thread while it holds any OTHER lock — the
+# batch gather window with (say) the catalog statement lock held would
+# stall every singleton statement and every other batch's device
+# dispatch for the whole window
+DEFAULT_WAIT_MODULES = (
+    "tidb_tpu/serving/scheduler.py",
+    "tidb_tpu/serving/batcher.py",
 )
 
 
@@ -229,13 +241,18 @@ class _ClassScan:
 class LockDisciplinePass(Pass):
     id = "lock-discipline"
     doc = ("no lock-acquisition-order cycles; no attribute mutated both "
-           "under a lock and without one")
+           "under a lock and without one; no blocking wait() while "
+           "holding another lock in the serving tier")
 
-    def __init__(self, modules: Sequence[str] = DEFAULT_MODULES):
+    def __init__(self, modules: Sequence[str] = DEFAULT_MODULES,
+                 wait_modules: Sequence[str] = DEFAULT_WAIT_MODULES):
         self.modules = tuple(m.replace("/", os.sep) for m in modules)
+        self.wait_modules = tuple(m.replace("/", os.sep)
+                                  for m in wait_modules)
 
     def run(self, project: Project) -> List[Violation]:
         out: List[Violation] = []
+        out.extend(self._check_waits(project))
         scans: List[_ClassScan] = []
         for sf in project.files():
             if sf.rel not in self.modules:
@@ -293,6 +310,88 @@ class LockDisciplinePass(Pass):
                 + " -> ".join(path)
                 + " ; acquisition sites: " + "; ".join(locs)))
         return out
+
+    # -- gather-window wait discipline (serving tier) -------------------
+
+    def _check_waits(self, project: Project) -> List[Violation]:
+        """Flag `X.wait(...)` reached while a `with`-acquired lock OTHER
+        than X itself is held. Condition.wait releases only its OWN
+        lock; any other lock held across the wait is held for the whole
+        gather window (and, transitively, across other batches' device
+        dispatches — the exact stall ISSUE 7 forbids)."""
+        out: List[Violation] = []
+        for sf in project.files():
+            if sf.rel not in self.wait_modules:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._walk_waits(sf, node.body, (), out)
+        return out
+
+    def _walk_waits(self, sf: SourceFile, stmts, held, out) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # closure/method bodies run later, outside
+                # this lock scope (methods get their own walk from run())
+            for node in ast.walk(stmt) if not isinstance(
+                    stmt, (ast.With, ast.AsyncWith, ast.For, ast.AsyncFor,
+                           ast.While, ast.If, ast.Try, ast.Match)) else ():
+                self._flag_wait(sf, node, held, out)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new = list(held)
+                for item in stmt.items:
+                    ctx = item.context_expr
+                    # only attribute/name contexts count as locks —
+                    # `with host_eager():` / `with tracing.span(...):`
+                    # are not synchronization
+                    if isinstance(ctx, (ast.Attribute, ast.Name)):
+                        new.append(ast.unparse(ctx))
+                    for sub in ast.walk(ctx):
+                        self._flag_wait(sf, sub, held, out)
+                self._walk_waits(sf, stmt.body, tuple(new), out)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                for sub in ast.walk(stmt.iter if isinstance(
+                        stmt, (ast.For, ast.AsyncFor)) else stmt.test):
+                    self._flag_wait(sf, sub, held, out)
+                self._walk_waits(sf, stmt.body, held, out)
+                self._walk_waits(sf, stmt.orelse, held, out)
+            elif isinstance(stmt, ast.If):
+                for sub in ast.walk(stmt.test):
+                    self._flag_wait(sf, sub, held, out)
+                self._walk_waits(sf, stmt.body, held, out)
+                self._walk_waits(sf, stmt.orelse, held, out)
+            elif isinstance(stmt, ast.Try):
+                self._walk_waits(sf, stmt.body, held, out)
+                for h in stmt.handlers:
+                    self._walk_waits(sf, h.body, held, out)
+                self._walk_waits(sf, stmt.orelse, held, out)
+                self._walk_waits(sf, stmt.finalbody, held, out)
+            elif isinstance(stmt, ast.Match):
+                for sub in ast.walk(stmt.subject):
+                    self._flag_wait(sf, sub, held, out)
+                for case in stmt.cases:
+                    if case.guard is not None:
+                        for sub in ast.walk(case.guard):
+                            self._flag_wait(sf, sub, held, out)
+                    self._walk_waits(sf, case.body, held, out)
+
+    def _flag_wait(self, sf: SourceFile, node, held, out) -> None:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("wait", "wait_for")):
+            return
+        target = ast.unparse(node.func.value)
+        others = [h for h in held if h != target]
+        if others:
+            out.append(Violation(
+                self.id, sf.rel, node.lineno,
+                f"blocking {node.func.attr}() on `{target}` while holding "
+                f"{', '.join(sorted(set(others)))} — a gather-window wait "
+                "must not park the worker with another lock held (it "
+                "stalls every statement and batch dispatch behind that "
+                "lock for the whole window). Release the outer lock "
+                "before waiting."))
 
     @staticmethod
     def _find_cycle(edges: Dict[str, Dict[str, str]]

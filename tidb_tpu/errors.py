@@ -88,3 +88,20 @@ class QueryTimeoutError(ExecutionError):
     code = 3024  # ER_QUERY_TIMEOUT
 
 
+class AdmissionRejectedError(ExecutionError):
+    """The statement scheduler refused to enqueue this statement (queue
+    full, server memory quota exhausted, or the scheduler is draining
+    for shutdown). TiDB-style "server is busy" — the client should back
+    off and retry; the statement never started executing."""
+
+    code = 9008  # TiKV ServerIsBusy as surfaced by TiDB
+
+
+class SchedulerQueueTimeoutError(ExecutionError):
+    """The statement was admitted but no scheduler worker picked it up
+    within tidb_tpu_sched_queue_timeout_ms. It was removed from the
+    queue without executing — safe to retry."""
+
+    code = 9008  # same busy-class error: the server is saturated
+
+

@@ -59,7 +59,7 @@ from tidb_tpu.parser import ast as A
 __all__ = ["PlanCache", "PlanCacheEntry", "StmtInfo", "TemplateInfo",
            "analyze_statement", "analyze_template", "bind_template_params",
            "transform_literals", "make_sentinels", "build_entry",
-           "instantiate", "DEFAULT_CAPACITY"]
+           "instantiate", "batchable_plan", "DEFAULT_CAPACITY"]
 
 DEFAULT_CAPACITY = 256
 
@@ -488,6 +488,10 @@ class PlanCacheEntry:
     # the first hit and reused — hits identify the SAME plan, so
     # re-hashing per execution would be pure waste
     plan_digest: str = ""
+    # memoized batchable_plan() verdict: None = not yet asked, "" =
+    # batchable, else the blocking reason (the serving tier asks on
+    # every coalescing probe; the plan never changes after publication)
+    batch_reason: Optional[str] = None
 
 
 def _plan_hazards(phys):
@@ -586,6 +590,45 @@ def instantiate(entry: PlanCacheEntry, params) -> object:
     for path, idx in entry.patches:
         plan = _patch(plan, path, params[idx])
     return plan
+
+
+def batchable_plan(entry: PlanCacheEntry) -> str:
+    """'' when `entry`'s plan can carry several sessions' parameter
+    vectors in ONE gathered dispatch (the serving tier's cross-session
+    micro-batching), else the blocking reason.
+
+    Batchable shape: a ``cond_covered`` PPointGet, optionally under a
+    fused Projection chain, whose verified patch slots ALL live in the
+    access path (``key_values``, or the ``pushed_cond`` the unique-index
+    probe subsumes). The projection pipeline is then parameter-free —
+    identical for every member — so one pass over the gathered union of
+    every member's fetched rows followed by a positional split yields
+    exactly what N singleton executions would have produced."""
+    r = entry.batch_reason
+    if r is None:
+        r = _batchable_reason(entry)
+        entry.batch_reason = r
+    return r
+
+
+def _batchable_reason(entry: PlanCacheEntry) -> str:
+    from tidb_tpu.planner.physical import PPointGet, PProjection
+
+    if entry.patches is None or entry.phys is None:
+        return "uncacheable"
+    node = entry.phys
+    while isinstance(node, PProjection):
+        node = node.children[0]
+    if not isinstance(node, PPointGet):
+        return "not a covered point get"
+    if not node.cond_covered:
+        return "residual filter over fetched rows"
+    for path, _idx in entry.patches:
+        names = [p for p in path if isinstance(p, str)]
+        anchor = next((n for n in names if n != "children"), "")
+        if anchor not in ("key_values", "pushed_cond"):
+            return f"param outside the access path ({anchor or '?'})"
+    return ""
 
 
 # ---------------------------------------------------------------------------

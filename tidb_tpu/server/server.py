@@ -4,6 +4,13 @@ Server.Run + clientConn.Run: accept, handshake, command dispatch loop).
 One Session per connection, all sharing one Catalog — the same shape as
 the reference's one-process-many-connections SQL node. The executor tier
 underneath (single-chip or mesh) is whatever the Session was built with.
+
+Connection threads do protocol I/O only; statements execute on the
+serving tier's bounded worker pool (tidb_tpu/serving — admission
+control, typed busy/timeout rejections, cross-session micro-batching of
+plan-cache-hit point reads). The accept loop itself is capped by
+tidb_max_connections: over-limit handshakes get MySQL error 1040
+instead of an unbounded daemon thread.
 """
 
 from __future__ import annotations
@@ -17,9 +24,12 @@ from typing import Optional
 from tidb_tpu.errors import TiDBTPUError as TidbError
 from tidb_tpu.server import protocol as P
 from tidb_tpu.session import Session
+from tidb_tpu.session.sysvars import SysVarStore
 from tidb_tpu.storage.catalog import Catalog
 
 __all__ = ["Server"]
+
+ER_CON_COUNT_ERROR = 1040  # MySQL "Too many connections"
 
 COM_QUIT = 0x01
 COM_INIT_DB = 0x02
@@ -45,6 +55,14 @@ class Server:
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_id = 0
         self._running = False
+        # server-scope view of the GLOBAL sysvars (tidb_max_connections,
+        # scheduler knobs) — the accept loop has no session of its own
+        self.sysvars = SysVarStore(self.catalog.global_vars)
+        # the serving tier: bounded execution + admission control +
+        # micro-batching (created in start(), drained in shutdown())
+        self.scheduler = None
+        self._active_conns = 0
+        self._conn_lock = threading.Lock()
 
     # ------------------------------------------------------------------
 
@@ -82,23 +100,35 @@ class Server:
 
         self._ddl_worker = DDLWorker(self.catalog, f"server-{id(self):x}")
         self._ddl_worker.start()
+        from tidb_tpu.serving import StatementScheduler
+
+        self.scheduler = StatementScheduler(self.catalog)
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
 
-    def stop(self) -> None:
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful stop: close the accept socket (no new connections),
+        drain the scheduler pool deterministically (queued statements
+        finish — or are rejected typed with drain=False — and workers
+        join), then stop the auxiliary tiers."""
         self._running = False
-        if getattr(self, "_ddl_worker", None) is not None:
-            self._ddl_worker.stop()
-            self._ddl_worker = None
-        if self._status_server is not None:
-            self._status_server.stop()
-            self._status_server = None
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+        if self.scheduler is not None:
+            self.scheduler.shutdown(drain=drain, timeout=timeout)
+        if getattr(self, "_ddl_worker", None) is not None:
+            self._ddl_worker.stop()
+            self._ddl_worker = None
+        if self._status_server is not None:
+            self._status_server.stop()
+            self._status_server = None
+
+    def stop(self) -> None:
+        self.shutdown(drain=True)
 
     def serve_forever(self) -> None:
         self.start()
@@ -115,6 +145,27 @@ class Server:
                 conn, _addr = self._sock.accept()
             except OSError:
                 return
+            # connection cap (ref: server.go's checkConnectionCount):
+            # over-limit clients get MySQL 1040 as the FIRST packet and
+            # the socket closes — no daemon thread, no session
+            limit = int(self.sysvars.get("tidb_max_connections"))
+            with self._conn_lock:
+                if limit and self._active_conns >= limit:
+                    over = True
+                else:
+                    over = False
+                    self._active_conns += 1
+            if over:
+                try:
+                    P.write_packet(conn, 0, P.err_packet(
+                        ER_CON_COUNT_ERROR, "Too many connections", "08004"))
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             self._conn_id += 1
             t = threading.Thread(
                 target=self._serve_conn, args=(conn, self._conn_id), daemon=True
@@ -129,6 +180,8 @@ class Server:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sess = Session(catalog=self.catalog, mesh=self.mesh)
+            if self.scheduler is not None:
+                self.scheduler.attach_session(sess)
             salt = os.urandom(20).replace(b"\x00", b"\x01")
             version = str(sess.sysvars.get("version"))
             P.write_packet(conn, 0, P.handshake_v10(conn_id, version, salt))
@@ -158,6 +211,8 @@ class Server:
             traceback.print_exc()
         finally:
             CONN_GAUGE.dec()
+            with self._conn_lock:
+                self._active_conns -= 1
             try:
                 # connection end: the session's TEMPORARY tables vanish
                 if sess is not None:
@@ -233,8 +288,10 @@ class Server:
             stmt_id, params, types = P.parse_stmt_execute(
                 body, n_params, sess._stmt_types.get(stmt_id))
             sess._stmt_types[stmt_id] = types
-            with self.catalog.lock:
-                rs = sess.execute_prepared(stmt_id, params)
+            # serving tier: admission control + micro-batching; the
+            # worker takes the catalog statement lock (this thread only
+            # parks on the result)
+            rs = self.scheduler.submit_prepared(sess, stmt_id, params)
         except TidbError as e:
             P.write_packet(conn, 1, P.err_packet(getattr(e, "code", 1105), str(e)))
             return
@@ -266,10 +323,9 @@ class Server:
 
     def _run_sql(self, conn: socket.socket, sess: Session, sql: str) -> None:
         try:
-            # the storage layer is single-writer: statements across
-            # connections serialize on the catalog lock
-            with self.catalog.lock:
-                rs = sess.execute(sql)
+            # serving tier: bounded workers execute (and serialize on
+            # the catalog lock there); this thread does protocol I/O only
+            rs = self.scheduler.submit_query(sess, sql)
         except TidbError as e:
             P.write_packet(conn, 1, P.err_packet(getattr(e, "code", 1105), str(e)))
             return

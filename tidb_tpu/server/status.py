@@ -12,6 +12,9 @@ Endpoints:
     /cluster     - JSON: per-worker DCN health machine (up/suspect/down,
                    reconnect counts, backoff windows) for every live
                    Cluster in this process
+    /scheduler   - JSON: serving-tier stats for every live statement
+                   scheduler (queue depth, inflight batches, admission
+                   counters, per-digest coalesce counts)
     /trace       - JSON: summaries of the kept (tail-sampled) traces
                    (?top=N, default 50); /trace?id=<trace_id> returns
                    one trace's full cross-process span tree
@@ -115,6 +118,14 @@ class StatusServer:
                         body = json.dumps({
                             "clusters": [c.health_snapshot()
                                          for c in clusters_alive()],
+                        }).encode()
+                        ctype = "application/json"
+                    elif self.path == "/scheduler":
+                        from tidb_tpu.serving import schedulers_alive
+
+                        body = json.dumps({
+                            "schedulers": [s.stats_dict()
+                                           for s in schedulers_alive()],
                         }).encode()
                         ctype = "application/json"
                     elif self.path == "/schema":

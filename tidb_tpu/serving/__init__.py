@@ -1,0 +1,28 @@
+"""Serving tier (ISSUE 7): the layer between the MySQL-protocol server
+and Session that turns a thread-per-connection SQL node into an
+admission-controlled, throughput-oriented statement scheduler.
+
+Two pieces:
+
+``scheduler.py``  — a bounded worker pool with admission control (queue
+    depth cap, queue-claim timeout, per-session and server-wide memory
+    quotas wired into utils/memory.py's tracker tree) and typed
+    rejection errors instead of unbounded thread spawn.
+
+``batcher.py``    — cross-session micro-batching: concurrent statements
+    that would hit the plan cache under the SAME key (digest +
+    param-type fingerprint + planner sysvars — PR 2's key) on a
+    batchable plan coalesce during a short gather window into ONE
+    gathered device dispatch, with results de-multiplexed per session
+    and every per-statement semantic (warnings, @@last_plan_from_cache,
+    stmt-summary, deadlines/KILL) preserved exactly. Anything unsafe
+    falls back to singleton execution — a correctness gate, not
+    best-effort.
+"""
+
+from tidb_tpu.serving.scheduler import (
+    StatementScheduler,
+    schedulers_alive,
+)
+
+__all__ = ["StatementScheduler", "schedulers_alive"]

@@ -323,6 +323,18 @@ class Session:
         self._ps_materialized = None
         self._killed = False       # KILL <id>: connection is dead
         self._kill_query = False   # KILL QUERY <id>: one-shot cancel
+        # serving-tier seams (tidb_tpu/serving): a coalesced batch
+        # member executes through _execute_timed with the REAL executor
+        # replaced by a runner returning the pre-demuxed result, so
+        # every per-statement semantic (warnings reset, kill/deadline,
+        # tracing, summary, slow log, plugin hooks) stays exact
+        self._stmt_runner = None
+        # parent for per-statement MemTrackers (the scheduler's
+        # session-level tracker, itself a child of the server tracker)
+        self._mem_parent = None
+        # scheduler queue wait of the statement about to execute
+        # (seconds); _execute_timed consumes it into a sched.queue span
+        self._sched_queue_s = 0.0
         # statement deadline (monotonic seconds) armed per statement
         # from max_execution_time; None = unbounded
         self._stmt_deadline: Optional[float] = None
@@ -576,12 +588,22 @@ class Session:
                                sampled=tracing.head_sampled(rate))
             tracing.push(tr)
         stmt_span = tracing.begin(f"stmt.{stype}")
+        q_s, self._sched_queue_s = self._sched_queue_s, 0.0
+        if q_s > 0 and tr is not None and stmt_span is not None:
+            # the scheduler queue wait happened BEFORE this trace
+            # existed; anchor the span at the trace start so offsets
+            # stay non-negative and the wait is still visible
+            qs = tr.add_complete("sched.queue", tr.t0_perf, q_s,
+                                 parent_id=stmt_span.span_id)
+            qs.notes.append(f"queued {int(q_s * 1e6)}us before execution")
         d0 = _dsp.count()
         f0 = _dsp.by_site().get("fragment", 0)
         t0 = _time.perf_counter()
         try:
             with ctx:
-                result = self._execute_stmt(stmt)
+                runner = self._stmt_runner
+                result = (self._execute_stmt(stmt) if runner is None
+                          else runner(stmt))
         except Exception as exc:
             dur = _time.perf_counter() - t0
             M.QUERY_TOTAL.inc(type=stype, status="error")
@@ -607,6 +629,12 @@ class Session:
             # disarm: a later Cluster.query(session=...) poll must not
             # see this statement's (possibly long-expired) deadline
             self._stmt_deadline = None
+            # serving tier: return residual (never-released) operator
+            # consumption to the session/server trackers — an executor
+            # tree freed wholesale must not leak accounting forever
+            if self._mem_parent is not None:
+                for t in self._stmt_trackers:
+                    t.detach()
             # BaseException safety net (KeyboardInterrupt & co bypass
             # the except): a trace must never leak onto the thread. The
             # normal paths pop via _finish_trace before this runs.
@@ -708,6 +736,9 @@ class Session:
             norm, digest = self._stmt_digest(stmt, sql)
             max_mem = max((t.max_consumed for t in self._stmt_trackers),
                           default=0)
+            if self._mem_parent is not None:
+                for t in self._stmt_trackers:
+                    t.detach()  # idempotent; the finally path re-runs it
             self._stmt_trackers = []  # don't pin operator state while idle
             dispatches = _dsp.count() - d0
             fragments = _dsp.by_site().get("fragment", 0) - f0
@@ -797,11 +828,18 @@ class Session:
         tracker = MemTracker(
             "query",
             budget=quota,
+            # serving tier: chain into the scheduler's session/server
+            # trackers so per-session and server-wide quotas see this
+            # statement; spill decisions stay anchored HERE (spill_root)
+            parent=self._mem_parent,
             spill_enabled=bool(self.sysvars.get("tidb_enable_tmp_storage_on_oom")),
+            spill_root=True,
         )
         # the statement may build several contexts (shadow rowid scans,
         # subplans): the summary reports the max over all of them
         self._stmt_trackers.append(tracker)
+        for old in self._stmt_trackers[:-64]:
+            old.detach()  # evicted trackers must not pin parent bytes
         del self._stmt_trackers[:-64]  # bound pathological statements
         return ExecContext(
             chunk_capacity=self._plan_capacity(plan),
@@ -969,20 +1007,7 @@ class Session:
         self._stmt_digest_memo = (src, norm, digest)
         eff_apd = (self._agg_push_down() if agg_push_down is None
                    else agg_push_down)
-        hints_fp = tuple((h, tuple(str(a) for a in args))
-                         for h, args in getattr(stmt, "hints", ()) or ())
-        key = (
-            digest, self.db, info.kinds, info.struct, hints_fp,
-            bool(self.sysvars.get("tidb_enable_cascades_planner")),
-            bool(eff_apd), self._n_parts(),
-            self._bindings.version, self.catalog.bind_handle.version,
-            # TEMPORARY tables shadow names without a schema_version
-            # bump: a session holding any gets private entries, re-keyed
-            # by the temp epoch so drop+recreate can never serve the old
-            # table object's plan
-            ((self.conn_id, getattr(self.catalog, "_temp_epoch", 0))
-             if getattr(self.catalog, "_temp", None) else 0),
-        )
+        key = self._plan_cache_key(stmt, info, digest, eff_apd)
         sv = self.catalog.schema_version
         cap = int(self.sysvars.get("tidb_prepared_plan_cache_size"))
         entry = cache.lookup(key, sv, cap)
@@ -1028,6 +1053,75 @@ class Session:
         except Exception:  # noqa: BLE001 — the cache must never fail
             pass          # (or slow-path-block) the statement
         return phys
+
+    def _plan_cache_key(self, stmt, info, digest, eff_apd):
+        """THE plan-cache key — shared by the probe/fill path above and
+        the serving tier's coalescing probe (batch_probe), so two
+        statements coalesce exactly when they would share a cache entry
+        (same digest, db, param-type fingerprint, structural constants,
+        hints, planner sysvars, mesh width, binding versions)."""
+        hints_fp = tuple((h, tuple(str(a) for a in args))
+                         for h, args in getattr(stmt, "hints", ()) or ())
+        return (
+            digest, self.db, info.kinds, info.struct, hints_fp,
+            bool(self.sysvars.get("tidb_enable_cascades_planner")),
+            bool(eff_apd), self._n_parts(),
+            self._bindings.version, self.catalog.bind_handle.version,
+            # TEMPORARY tables shadow names without a schema_version
+            # bump: a session holding any gets private entries, re-keyed
+            # by the temp epoch so drop+recreate can never serve the old
+            # table object's plan
+            ((self.conn_id, getattr(self.catalog, "_temp_epoch", 0))
+             if getattr(self.catalog, "_temp", None) else 0),
+        )
+
+    def batch_probe(self, stmt_id: int, params: list):
+        """Serving-tier coalescing probe (tidb_tpu/serving/batcher.py):
+        decide WITHOUT executing whether this prepared execution would
+        be a plan-cache hit on a batchable plan. Returns
+        (key, entry, info) when every safety gate passes, else None.
+        Fallback to singleton execution is the correctness gate, so any
+        doubt answers None — the statement then runs the full fidelity
+        path and nothing is lost but the coalescing win."""
+        ent = self._prepared.get(stmt_id)
+        if ent is None:
+            return None  # execute_prepared raises the real error
+        stmt, n_params, sql, norm, digest, tinfo = ent
+        if (tinfo is None or digest is None or len(params) != n_params
+                or not isinstance(stmt, A.SelectStmt)
+                or getattr(stmt, "lock_mode", None) is not None
+                or getattr(stmt, "into_outfile", None) is not None):
+            return None
+        # session-state gates: txn snapshots, kill flags, mesh routing,
+        # plugins and plan bindings all change execution — the singleton
+        # path handles every one of them with full fidelity
+        if (self.txn is not None or self._killed or self._kill_query
+                or self._lock_read
+                or not self.sysvars.get("autocommit")
+                or self._shard_cache is not None
+                or str(self.sysvars.get("tidb_executor_plugin"))
+                or len(self._bindings) or len(self.catalog.bind_handle)
+                or not self.sysvars.get("tidb_enable_prepared_plan_cache")):
+            return None
+        cache = getattr(self.catalog, "plan_cache", None)
+        if cache is None:
+            return None
+        from tidb_tpu.planner import plancache as _pc
+
+        info = _pc.bind_template_params(tinfo, params)
+        if info is None or info.volatile or info.unsafe:
+            return None
+        key = self._plan_cache_key(stmt, info, digest,
+                                   self._agg_push_down())
+        entry = cache.lookup(
+            key, self.catalog.schema_version,
+            int(self.sysvars.get("tidb_prepared_plan_cache_size")))
+        if (entry is None or entry.patches is None
+                or entry.n_params != len(info.params)):
+            return None
+        if _pc.batchable_plan(entry):
+            return None  # non-empty string = the blocking reason
+        return key, entry, info
 
     def _apply_binding(self, stmt):
         """Plan-binding lookup (ref: bindinfo BindHandle): on a match of

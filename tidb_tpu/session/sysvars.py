@@ -106,6 +106,40 @@ _reg(
            min_=1, max_=64),
     SysVar("tidb_broadcast_join_threshold_count", 1 << 21, BOTH, "int",
            min_=1 << 10, max_=1 << 28),
+    # -- serving tier (ISSUE 7): admission-controlled scheduler +
+    # cross-session micro-batched dispatch -----------------------------
+    # wire-connection cap enforced at the accept loop; over-limit
+    # handshakes get MySQL error 1040 (ER_CON_COUNT_ERROR). 0 = unbounded
+    SysVar("tidb_max_connections", 0, GLOBAL, "int", min_=0, max_=1 << 20),
+    # gather window for cross-session micro-batching: the first
+    # coalescible statement waits up to this long for same-shaped
+    # followers before the batch dispatches. 0 disables coalescing
+    # (every statement runs singleton through the scheduler)
+    SysVar("tidb_tpu_batch_window_us", 250, GLOBAL, "int",
+           min_=0, max_=1_000_000),
+    # hard cap on members per coalesced dispatch; a full group seals
+    # immediately without waiting out the window
+    SysVar("tidb_tpu_max_batch_size", 64, GLOBAL, "int", min_=1, max_=4096),
+    # scheduler worker-pool width (read at scheduler construction)
+    SysVar("tidb_tpu_scheduler_workers", 4, GLOBAL, "int", min_=1, max_=256),
+    # admission control: statements queued beyond this are rejected with
+    # a typed "server is busy" error instead of queuing unboundedly
+    SysVar("tidb_tpu_sched_max_queue", 256, GLOBAL, "int",
+           min_=1, max_=1 << 20),
+    # admitted statements not claimed by a worker within this budget are
+    # evicted from the queue with a typed queue-timeout error (they
+    # never started, so retry is always safe)
+    SysVar("tidb_tpu_sched_queue_timeout_ms", 10_000, GLOBAL, "int",
+           min_=1, max_=1 << 31),
+    # server-wide host-memory budget across all in-flight statements
+    # (the scheduler's root MemTracker); 0 = unlimited. New statements
+    # are rejected at admission while consumption sits above it
+    SysVar("tidb_tpu_sched_mem_quota", 0, GLOBAL, "int",
+           min_=0, max_=1 << 45),
+    # per-session host-memory budget across that session's in-flight
+    # statement (a child of the server tracker); 0 = unlimited
+    SysVar("tidb_tpu_mem_quota_session", 0, BOTH, "int",
+           min_=0, max_=1 << 45),
     # fixed device batch capacity (ref: tidb_max_chunk_size)
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)

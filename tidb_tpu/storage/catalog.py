@@ -809,6 +809,41 @@ class Catalog:
                  ("error", STRING)],
                 rows,
             )
+        if name == "scheduler_stats":
+            # serving-tier counters of every live statement scheduler in
+            # this process: one summary row per scheduler (digest = '')
+            # plus one row per coalesced digest. Guarded like
+            # dcn_worker_stats: a SHOW TABLES / schema walk (listing)
+            # must not touch live schedulers just to report existence.
+            rows = []
+            if not listing:
+                from tidb_tpu.serving import schedulers_alive
+
+                for si, sch in enumerate(schedulers_alive()):
+                    try:
+                        d = sch.stats_dict()
+                    except Exception:  # noqa: BLE001 — a dying scheduler
+                        continue       # must not fail the whole read
+                    rows.append((
+                        si, "", d["workers"], d["queue_depth"],
+                        d["inflight_batches"], d["admitted"],
+                        d["rejected"], d["timed_out"], d["batches"],
+                        d["coalesced_stmts"], d["mem_consumed"],
+                        d["mem_budget"],
+                        "draining" if d["draining"] else "running"))
+                    for dg, cnt in sorted(d["coalesce_by_digest"].items()):
+                        rows.append((si, dg, None, None, None, None, None,
+                                     None, None, cnt, None, None, ""))
+            return make(
+                [("scheduler", INT64), ("digest", STRING),
+                 ("workers", INT64), ("queue_depth", INT64),
+                 ("inflight_batches", INT64), ("admitted", INT64),
+                 ("rejected", INT64), ("timed_out", INT64),
+                 ("batches", INT64), ("coalesced_stmts", INT64),
+                 ("mem_consumed", INT64), ("mem_budget", INT64),
+                 ("state", STRING)],
+                rows,
+            )
         if name == "statements_summary":
             return make(
                 [("digest", STRING), ("stmt_type", STRING),
@@ -851,7 +886,7 @@ def _time_strftime(ts: float) -> str:
 _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
                 "key_column_usage", "referential_constraints",
                 "partitions", "processlist", "statements_summary",
-                "cluster_trace", "dcn_worker_stats")
+                "cluster_trace", "dcn_worker_stats", "scheduler_stats")
 
 
 class SessionCatalog:

@@ -30,11 +30,18 @@ class QueryOOMError(ExecutionError):
 
 class MemTracker:
     def __init__(self, label: str = "query", budget: Optional[int] = None,
-                 parent: Optional["MemTracker"] = None, spill_enabled: bool = True):
+                 parent: Optional["MemTracker"] = None, spill_enabled: bool = True,
+                 spill_root: bool = False):
         self.label = label
         self.budget = budget
         self.parent = parent
         self.spill_enabled = spill_enabled
+        # marks the statement-level tracker: spillables anchor here even
+        # when the serving tier parents it under session/server trackers
+        # (those aggregate accounting only — operator state from one
+        # statement must never spill in response to ANOTHER statement's
+        # pressure, and their budgets cancel rather than spill)
+        self.spill_root = spill_root
         self.consumed = 0
         self.max_consumed = 0
         self._quota_engaged = False  # first budget crossing counted once
@@ -42,6 +49,21 @@ class MemTracker:
 
     def child(self, label: str) -> "MemTracker":
         return MemTracker(label, parent=self)
+
+    def detach(self) -> None:
+        """Disconnect from the parent chain, returning any un-released
+        residual consumption to the ancestors. Statement end under the
+        serving tier: operator state the statement never release()d
+        (freed wholesale with the executor tree) must not leak into the
+        session/server accounting forever."""
+        p, self.parent = self.parent, None
+        if p is None or self.consumed == 0:
+            return
+        n = self.consumed
+        node = p
+        while node is not None:
+            node.consumed -= n
+            node = node.parent
 
     def register_spillable(self, obj) -> None:
         self._spillables.append(obj)
@@ -126,7 +148,7 @@ class SpillableRuns:
     def __init__(self, tracker: MemTracker, label: str = "runs"):
         self.tracker = tracker
         root = tracker
-        while root.parent is not None:
+        while root.parent is not None and not root.spill_root:
             root = root.parent
         self._root = root
         if root.spill_enabled:

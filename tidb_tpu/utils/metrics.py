@@ -290,3 +290,24 @@ TRACE_KEPT_TOTAL = Counter(
     "tidb_tpu_trace_kept_total",
     "Traces retained in the tail-sampled store, by first keep reason "
     "(sampled, slow, error:*, retry, failover, trace)")
+
+# -- serving tier: admission-controlled scheduler + micro-batching ----------
+
+SCHED_QUEUE_DEPTH = Gauge(
+    "tidb_tpu_sched_queue_depth",
+    "Statements admitted but not yet claimed by a scheduler worker "
+    "(queued singletons + members of still-gathering batch groups)")
+SCHED_ADMISSION_TOTAL = Counter(
+    "tidb_tpu_sched_admission_total",
+    "Scheduler admission decisions, by outcome: admitted, rejected "
+    "(queue full / server memory quota / draining), timed_out (admitted "
+    "but evicted after tidb_tpu_sched_queue_timeout_ms unclaimed)")
+BATCH_SIZE = Histogram(
+    "tidb_tpu_batch_size",
+    "Members per coalesced device dispatch (1 = a batchable statement "
+    "whose gather window closed alone)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+BATCH_COALESCE_TOTAL = Counter(
+    "tidb_tpu_batch_coalesce_total",
+    "Statements that rode a multi-statement coalesced dispatch (members "
+    "of batches with n >= 2; singleton executions never count)")
