@@ -722,6 +722,169 @@ def bench_oltp(extra, clients_list=(8, 16), iters=150):
     return out
 
 
+def bench_zone_pruning(extra=None, sf=None, reps=None):
+    """Zone-map pruning microbench (ISSUE 8): TPC-H Q6 over a
+    time-ordered (l_shipdate-clustered) lineitem — the production
+    fact-table layout — pruned (columnar on) vs unpruned (columnar
+    off), on the LOCAL engine where the segment store lives. Loud
+    cross-checks: the engine-reported pruned fraction (the acceptance
+    counter), result equality across both modes, and an exact
+    sqlite-oracle comparison over an integer mirror of the four Q6
+    columns (scaled-int arithmetic: no float fuzz in the check)."""
+    import sqlite3
+    from decimal import Decimal
+
+    import numpy as np
+
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.catalog import Catalog
+    from tidb_tpu.storage.tpch import load_tpch
+    from tidb_tpu.storage.tpch_queries import Q
+    from tidb_tpu.types import date_to_days
+    from tidb_tpu.utils import metrics as _M
+
+    sf = min(SF, 0.2) if sf is None else sf
+    reps = REPS if reps is None else reps
+    s = Session(catalog=Catalog(), chunk_capacity=1 << 20)
+    load_tpch(s.catalog, sf=sf, native=False, cluster_lineitem=True)
+    t = s.catalog.table("test", "lineitem")
+    n = t.n
+    sql = Q["q6"][0]
+
+    def segs():
+        return (int(_M.SCAN_SEGMENTS_SCANNED_TOTAL.value()),
+                int(_M.SCAN_SEGMENTS_PRUNED_TOTAL.value()))
+
+    # warm both modes (store build + XLA compiles happen here)
+    got_on = s.query(sql)
+    s0 = segs()
+    got_on = s.query(sql)
+    s1 = segs()
+    scanned, pruned = s1[0] - s0[0], s1[1] - s0[1]
+    frac = pruned / max(scanned + pruned, 1)
+    best_on = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got_on = s.query(sql)
+        best_on = min(best_on, time.perf_counter() - t0)
+    s.execute("set tidb_tpu_columnar_enable = 0")
+    got_off = s.query(sql)  # warm the raw path
+    best_off = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got_off = s.query(sql)
+        best_off = min(best_off, time.perf_counter() - t0)
+    s.execute("set tidb_tpu_columnar_enable = 1")
+
+    # exact oracle: integer mirror of the four Q6 columns; revenue at
+    # scale 4 (price scale 2 x discount scale 2) compares as an int
+    conn = sqlite3.connect(":memory:")
+    conn.execute("create table li (ship integer, disc integer, "
+                 "qty integer, ext integer)")
+    rows = np.stack([
+        np.asarray(t.data["l_shipdate"][:n], dtype=np.int64),
+        np.asarray(t.data["l_discount"][:n], dtype=np.int64),
+        np.asarray(t.data["l_quantity"][:n], dtype=np.int64),
+        np.asarray(t.data["l_extendedprice"][:n], dtype=np.int64),
+    ], axis=1)
+    conn.executemany("insert into li values (?,?,?,?)",
+                     map(tuple, rows.tolist()))
+    d1 = date_to_days(__import__("datetime").date(1994, 1, 1))
+    d2 = date_to_days(__import__("datetime").date(1995, 1, 1))
+    want = conn.execute(
+        f"select sum(ext * disc) from li where ship >= {d1} and "
+        f"ship < {d2} and disc between 5 and 7 and qty < 2400"
+    ).fetchone()[0] or 0
+    conn.close()
+    got_scaled = int(Decimal(str(got_on[0][0] or 0)).scaleb(4))
+    check = "ok"
+    if got_scaled != int(want):
+        check = f"MISMATCH: engine {got_scaled} != sqlite {int(want)}"
+    if got_on != got_off:
+        # append, don't overwrite: both diagnostics matter when both fail
+        extra_msg = f"MISMATCH: pruned {got_on} != unpruned {got_off}"
+        check = extra_msg if check == "ok" else f"{check}; {extra_msg}"
+    out = {
+        "sf": sf,
+        "rows": int(n),
+        "pruned_s": round(best_on, 4),
+        "unpruned_s": round(best_off, 4),
+        "pruned_over_unpruned": round(best_off / max(best_on, 1e-9), 3),
+        "segs_scanned": scanned,
+        "segs_pruned": pruned,
+        "pruned_fraction": round(frac, 4),
+        "check": check,
+    }
+    log(f"# zone pruning q6 sf={sf}: pruned={best_on * 1e3:.1f}ms "
+        f"unpruned={best_off * 1e3:.1f}ms "
+        f"({out['pruned_over_unpruned']}x), "
+        f"segs {scanned}/{scanned + pruned} scanned "
+        f"(frac pruned {frac:.2f}) check={check}")
+    if extra is not None:
+        extra["zone_pruning"] = out
+    return out
+
+
+def bench_budget_q18(catalog, extra=None):
+    """Budget-capped q18 via segment spill (ISSUE 8): the same query,
+    resident vs under a statement memory budget of half the segment
+    store's resident bytes, on a LOCAL (no-mesh) session over an
+    already-loaded TPC-H catalog. The budget run must complete by
+    evicting/re-materializing segments (engine spill counters move)
+    and produce byte-identical rows."""
+    import hashlib
+
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.tpch_queries import Q
+    from tidb_tpu.utils import metrics as _M
+
+    s = Session(catalog=catalog, chunk_capacity=1 << 20)
+    sql = Q["q18"][0]
+
+    def result_hash(rows):
+        h = hashlib.sha256()
+        for r in rows:
+            h.update(repr(r).encode())
+        return h.hexdigest()[:16]
+
+    s.query(sql)  # warm: builds stores, compiles
+    t0 = time.perf_counter()
+    resident = s.query(sql)
+    resident_s = time.perf_counter() - t0
+    li = s.catalog.table("test", "lineitem")
+    store = getattr(li, "_segment_store", None)
+    seg_bytes = store.resident_bytes() if store is not None else 0
+    budget = max(64 << 20, seg_bytes // 2)
+    out0 = _M.SPILL_SEGMENT_BYTES.value(dir="out")
+    in0 = _M.SPILL_SEGMENT_BYTES.value(dir="in")
+    s.execute(f"set tidb_mem_quota_query = {budget}")
+    s.execute("set tidb_enable_tmp_storage_on_oom = 1")
+    t0 = time.perf_counter()
+    budgeted = s.query(sql)
+    budget_s = time.perf_counter() - t0
+    s.execute("set tidb_mem_quota_query = 2147483648")
+    spill_out = int(_M.SPILL_SEGMENT_BYTES.value(dir="out") - out0)
+    spill_in = int(_M.SPILL_SEGMENT_BYTES.value(dir="in") - in0)
+    out = {
+        "budget_bytes": int(budget),
+        "segment_resident_bytes": int(seg_bytes),
+        "resident_s": round(resident_s, 4),
+        "budget_s": round(budget_s, 4),
+        "overhead_vs_resident": round(budget_s / max(resident_s, 1e-9), 3),
+        "spill_out_bytes": int(spill_out),
+        "spill_in_bytes": int(spill_in),
+        "hash_equal": result_hash(budgeted) == result_hash(resident),
+        "result_hash": result_hash(resident),
+    }
+    log(f"# q18 budget: resident={resident_s:.2f}s "
+        f"budget({budget >> 20}MiB)={budget_s:.2f}s "
+        f"spill out={spill_out >> 20}MiB in={spill_in >> 20}MiB "
+        f"hash_equal={out['hash_equal']}")
+    if extra is not None:
+        extra["q18_budget"] = out
+    return out
+
+
 def main(locked_detail=("acquired", "acquired")):
     extra = {}
     extra["chip_lock"] = locked_detail[1]
@@ -917,6 +1080,16 @@ def main(locked_detail=("acquired", "acquired")):
     except Exception as e:  # noqa: BLE001
         extra["q18_streamed_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # Q18 under a segment-spill budget (ISSUE 8): local engine over the
+    # same catalog — completes by evicting/re-materializing segments,
+    # byte-identical to the resident run
+    try:
+        if "q18_error" not in extra and s18 is not None:
+            log("# q18 budget (segment spill)")
+            bench_budget_q18(s18.catalog, extra)
+    except Exception as e:  # noqa: BLE001
+        extra["q18_budget_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # SSB Q3.2: 4-way star join (BASELINE flagship config) -------------------
     try:
         log(f"# ssb q3.2 at sf={SF_SSB}")
@@ -969,6 +1142,17 @@ def main(locked_detail=("acquired", "acquired")):
             extra["tpcds_q95_check"] = check
     except Exception as e:  # noqa: BLE001
         extra["tpcds_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # zone-map pruning microbench (ISSUE 8): Q6 over time-ordered
+    # lineitem, pruned vs unpruned, engine counters + exact oracle
+    try:
+        drop(locals().get("conn_ds"))
+        s_ds = conn_ds = c_ds = None
+        gc.collect()
+        log("# zone-map pruning microbench")
+        bench_zone_pruning(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["zone_pruning_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # join microbench: the local-engine partitioned join (ISSUE 3) —
     # build x probe grid, cold vs warm, sqlite oracle + retrace guards.

@@ -156,6 +156,37 @@ def main():
                 ol_bad[f"oltp_batched_speedup[{nc}]"] = f"{got} < {need}"
         pc_bad.extend(f"{k}={v}" for k, v in ol_bad.items())
 
+        # columnar segment store FIXED floors (ISSUE 8). Zone pruning:
+        # TPC-H Q6 at SF1 over time-ordered lineitem must skip >= 50%
+        # of segments (the ENGINE-reported counter), run >= 2x faster
+        # than the unpruned scan (self-relative: both arms back to
+        # back), and match the exact scaled-int sqlite oracle. Budget:
+        # q18 capped below the store's resident bytes must complete
+        # via segment spill (spill-out counter moves) with rows
+        # byte-identical to the resident run.
+        zp_bad = {}
+        zp = bench.bench_zone_pruning({}, sf=1.0)
+        print(f"zone_pruned_fraction     {zp['pruned_fraction']}  "
+              "(need >= 0.5)")
+        print(f"zone_pruned_speedup      {zp['pruned_over_unpruned']}  "
+              "(need >= 2.0)")
+        if zp["check"] != "ok":
+            zp_bad["zone_pruning_oracle"] = zp["check"]
+        if zp["pruned_fraction"] < 0.5:
+            zp_bad["zone_pruned_fraction"] = (
+                f"{zp['pruned_fraction']} < 0.5")
+        if zp["pruned_over_unpruned"] < 2.0:
+            zp_bad["zone_pruned_speedup"] = (
+                f"{zp['pruned_over_unpruned']} < 2.0")
+        bq = bench.bench_budget_q18(s.catalog)
+        print(f"q18_budget_hash_equal    {bq['hash_equal']}  "
+              f"(spill out {bq['spill_out_bytes'] >> 20}MiB)")
+        if not bq["hash_equal"]:
+            zp_bad["q18_budget_hash"] = "budgeted != resident rows"
+        if bq["spill_out_bytes"] <= 0:
+            zp_bad["q18_budget_spill"] = "no segment spill engaged"
+        pc_bad.extend(f"{k}={v}" for k, v in zp_bad.items())
+
         load1 = bench.machine_load()
         busy_after = load1["loadavg"][0] > BUSY_LOAD or load1.get("busy_procs")
 

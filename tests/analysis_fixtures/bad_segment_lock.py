@@ -1,0 +1,31 @@
+"""Fixture: columnar-store-shaped lock bugs — a rebuild/spill lock
+cycle (the store lock and a spill-file lock taken in both orders) and
+an unlocked residency-state write racing the locked path. Both must be
+flagged by lock-discipline over the columnar/ root."""
+
+import threading
+
+
+class SegStore:
+    def __init__(self):
+        self.store_lock = threading.Lock()
+        self.spill_lock = threading.Lock()
+        self.resident = {}
+
+    def rebuild(self):
+        with self.store_lock:
+            with self.spill_lock:      # BAD: store -> spill here ...
+                self.resident.clear()
+
+    def evict(self):
+        with self.spill_lock:
+            with self.store_lock:      # ... spill -> store here: cycle
+                self.resident.pop("seg", None)
+
+    def scan(self):
+        with self.store_lock:
+            self.resident["seg"] = True
+
+    def serve(self):
+        # BAD: unlocked write to state every other path guards
+        self.resident = {}

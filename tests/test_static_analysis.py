@@ -198,6 +198,37 @@ class TestLockDisciplineFixture:
         assert {v.message.split(" in ")[1].split(" ")[0] for v in hits} == \
             {"Worker.serve", "Worker.reset"}
 
+
+class TestColumnarScope:
+    """ISSUE 8: the analyzer roots extend to tidb_tpu/columnar/ — the
+    host-sync and lock-discipline passes govern the new store exactly
+    like the serving/dcn tiers."""
+
+    def test_columnar_in_default_roots(self):
+        from tidb_tpu.analysis.lock_discipline import DEFAULT_MODULES
+
+        assert "tidb_tpu/columnar/store.py" in DEFAULT_MODULES
+        assert "columnar" in HostSyncPass.SCOPE
+
+    def test_host_sync_flagged_under_columnar(self, tmp_path):
+        root = _mini_root(tmp_path, ("columnar", "bad_host_sync.py"))
+        rep, _ = _run_pass(root, HostSyncPass())
+        assert len(rep.violations) == 3, \
+            [v.render() for v in rep.violations]
+
+    def test_spill_rebuild_lock_cycle_flagged(self, tmp_path):
+        root = _mini_root(tmp_path, ("columnar", "bad_segment_lock.py"))
+        p = LockDisciplinePass(
+            modules=("tidb_tpu/columnar/bad_segment_lock.py",))
+        rep, _ = _run_pass(root, p)
+        cyc = [v for v in rep.violations if "cycle" in v.message]
+        assert cyc, [v.render() for v in rep.violations]
+        assert "SegStore.store_lock" in cyc[0].message
+        assert "SegStore.spill_lock" in cyc[0].message
+        unlocked = [v for v in rep.violations
+                    if "without a lock" in v.message]
+        assert unlocked, [v.render() for v in rep.violations]
+
     def test_gather_wait_under_foreign_lock_is_flagged(self, tmp_path):
         """ISSUE 7 serving discipline: a cv.wait() while holding another
         lock (the batch gather window parked with the catalog lock held)

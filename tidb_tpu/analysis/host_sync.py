@@ -276,9 +276,10 @@ class HostSyncPass(Pass):
     id = "host-sync"
     doc = ("no implicit device→host syncs (int/float/bool/.item()/"
            "np.asarray on device values) in executor/ops/parallel/"
-           "serving; intentional ones carry `# host-sync: <reason>`")
+           "serving/columnar; intentional ones carry "
+           "`# host-sync: <reason>`")
 
-    SCOPE = ("executor", "ops", "parallel", "serving")
+    SCOPE = ("executor", "ops", "parallel", "serving", "columnar")
 
     def run(self, project: Project) -> List[Violation]:
         out: List[Violation] = []

@@ -46,6 +46,9 @@ DEFAULT_MODULES = (
     "tidb_tpu/storage/catalog.py",
     "tidb_tpu/serving/scheduler.py",
     "tidb_tpu/serving/batcher.py",
+    # columnar segment store (ISSUE 8): the store's leaf lock guards
+    # segment residency/spill state shared across concurrent scans
+    "tidb_tpu/columnar/store.py",
 )
 
 # serving-tier gather discipline (ISSUE 7): modules where a blocking

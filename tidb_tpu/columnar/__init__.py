@@ -1,0 +1,43 @@
+"""Columnar segment store (ISSUE 8).
+
+The layer between host table storage (`storage/table.py` /
+`storage/delta.py`) and the executors: a table's physical rows are
+sliced into fixed-capacity immutable **segments**, each holding
+
+  * per-column ENCODED payloads — dictionary codes (strings are already
+    int32 codes via `chunk/dictionary.py`) and integer-backed kinds
+    (INT/DECIMAL/DATE/DATETIME/TIME/ENUM/SET) stored frame-of-reference
+    with the narrowest bit width that holds the value range, floats and
+    bools raw — so the bytes staged host→device shrink with the data,
+    not just host RSS (`encoding.py`);
+  * per-column **zone maps** (min/max/null_count/NDV estimate) consulted
+    by scan planning against pushed-down range/equality predicates to
+    skip whole segments before any staging (`zonemap.py`), and doubling
+    as the planner's fallback statistics (`statistics.zone_map_stats`);
+  * a spill lifecycle: cold segments serialize to disk
+    (`spillfile.py`) under memory pressure through the statement-
+    anchored MemTracker spill protocol and re-materialize on demand
+    (`store.py`), so a budget-capped scan completes by evicting instead
+    of dying.
+
+Delta rows — physical rows appended after the last segment build
+(inserts and MVCC update versions) — stay in the existing raw scan path
+and merge at scan time; MVCC visibility (`begin_ts`/`end_ts`) is always
+read live from the table, so deletes and txn markers need no segment
+maintenance. In-place rewrites of existing rows (dictionary growth
+re-encodes, GC compaction, MODIFY/ADD/DROP COLUMN, TRUNCATE) bump
+`Table.data_epoch`, which invalidates the whole store; DML past
+`tidb_tpu_segment_delta_rows` appended rows triggers an incremental
+coverage extension with fresh zone maps.
+"""
+
+from tidb_tpu.columnar.store import (  # noqa: F401
+    SegmentStore,
+    build_for_result,
+    scan_counts,
+    store_for,
+)
+from tidb_tpu.columnar.zonemap import collect_prune_bounds  # noqa: F401
+
+__all__ = ["SegmentStore", "store_for", "build_for_result", "scan_counts",
+           "collect_prune_bounds"]

@@ -57,6 +57,11 @@ class RuntimeStats:
     # fragment dispatches overlap, and without a start offset EXPLAIN
     # ANALYZE / TRACE render them as if sequential
     first_ts: Optional[float] = None
+    # columnar segment store (ISSUE 8): segments this scan skipped via
+    # zone-map pruning vs segments it actually staged; zero/zero on
+    # operators (or tables) without a segment store
+    segs_pruned: int = 0
+    segs_scanned: int = 0
 
 
 @dataclass
@@ -91,6 +96,18 @@ class ExecContext:
     # rows above which a fragment build side refuses to replicate and
     # the query falls back single-chip (tidb_broadcast_join_threshold_count)
     broadcast_rows_limit: int = 1 << 21
+    # columnar segment store (ISSUE 8): scans over stored tables go
+    # through encoded, zone-mapped segments (tidb_tpu_columnar_enable)
+    columnar_enable: bool = True
+    # fixed segment capacity in rows (tidb_tpu_segment_rows); the first
+    # store built for a table pins its value
+    segment_rows: int = 1 << 16
+    # appended delta rows that trigger a coverage extension + zone-map
+    # refresh at the next scan (tidb_tpu_segment_delta_rows)
+    segment_delta_rows: int = 1 << 16
+    # directory for spilled segment files (tidb_tpu_columnar_spill_dir;
+    # empty = system tmp)
+    columnar_spill_dir: str = ""
 
     def __post_init__(self):
         if self.mem_tracker is None:

@@ -66,6 +66,20 @@ def scan_stages_for(scan: PScan, stages) -> list:
     return out
 
 
+def scan_prune_bounds(scan: PScan):
+    """Zone-consultable bounds from the scan's pushed filter (ISSUE 8):
+    the columnar segment store prunes whole segments against these
+    before any host→device staging. Computed here — at executor-build
+    time — so a plan-cache hit with freshly patched literal slots
+    always re-derives bounds from the CURRENT literals."""
+    if scan.pushed_cond is None or scan.table is None:
+        return ()
+    from tidb_tpu.columnar.zonemap import collect_prune_bounds
+
+    uid_map = {c.uid: (c.name, c.type_) for c in scan.schema}
+    return collect_prune_bounds(scan.pushed_cond, uid_map)
+
+
 def build_executor(plan: PhysicalPlan) -> Executor:
     # pipeline fusion: Selection/Projection chains over a scan
     stages, base = peel_stages(plan)
@@ -116,6 +130,7 @@ def build_executor(plan: PhysicalPlan) -> Executor:
             table=base.table,
             stages=scan_stages_for(base, stages),
             out_schema=plan.schema,
+            prune_bounds=scan_prune_bounds(base),
         )
 
     if isinstance(plan, PSelection):
@@ -126,7 +141,9 @@ def build_executor(plan: PhysicalPlan) -> Executor:
         scan_stages = []
         if plan.pushed_cond is not None:
             scan_stages.append(("filter", plan.pushed_cond))
-        return TableScanExec(schema=plan.schema, table=plan.table, stages=scan_stages)
+        return TableScanExec(schema=plan.schema, table=plan.table,
+                             stages=scan_stages,
+                             prune_bounds=scan_prune_bounds(plan))
     if isinstance(plan, PHashAgg):
         return HashAggExec(
             plan.schema,

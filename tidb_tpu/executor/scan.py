@@ -50,14 +50,21 @@ def make_pipeline_fn(stages: List) -> Callable:
 
 
 class TableScanExec(Executor):
-    def __init__(self, schema: List[PlanCol], table, stages: List, out_schema: Optional[List[PlanCol]] = None):
+    def __init__(self, schema: List[PlanCol], table, stages: List,
+                 out_schema: Optional[List[PlanCol]] = None,
+                 prune_bounds=()):
         super().__init__(out_schema or schema, [])
         self.scan_schema = schema  # storage columns staged (pre-pipeline)
         self.table = table
         self.stages = stages
+        self.prune_bounds = prune_bounds  # zone-consultable pushed bounds
         self._fn = None
         self._slices = []
         self._i = 0
+        self._seg_chunks = []   # (Segment, rel_start, rel_end) to stage
+        self._seg_i = 0
+        self._seg_fn = None
+        self._pin = None
 
     def open(self, ctx: ExecContext) -> None:
         self.ctx = ctx
@@ -68,18 +75,118 @@ class TableScanExec(Executor):
             else None
         )
         self._slices = []
+        self._seg_chunks = []
+        self._seg_i = 0
+        self._seg_fn = None
+        self._pin = None
         if self.table is not None:
+            tail_start = 0
+            if ctx.columnar_enable:
+                tail_start = self._open_segments(ctx, cap)
             n = self.table.n
-            for s in range(0, max(n, 1), cap):
+            for s in range(tail_start, max(n, 1), cap):
                 self._slices.append((s, min(s + cap, n)))
-            if n == 0:
+            if n <= tail_start:
                 self._slices = []
         else:
             # dual table: one empty-schema row (SELECT without FROM)
             self._slices = [None]
         self._i = 0
 
+    def _open_segments(self, ctx: ExecContext, cap: int) -> int:
+        """Plan the segment portion of the scan: consult zone maps to
+        skip whole segments before any host→device staging, build the
+        fused decode+pipeline program, and register the spill pin on
+        the statement tracker. Returns the first delta (uncovered) row."""
+        from tidb_tpu.columnar.store import ScanPin, store_for
+        from tidb_tpu.ops.segment_scan import (
+            make_segment_scan_fn,
+            segment_scan_key,
+        )
+
+        store = store_for(
+            self.table, segment_rows=ctx.segment_rows,
+            delta_rows=ctx.segment_delta_rows,
+            spill_dir=ctx.columnar_spill_dir or None)
+        if store is None:
+            return 0
+        # the pin exists BEFORE planning so every snapshot segment is
+        # reference-counted against a concurrent store invalidation
+        # from the moment this scan learns about it
+        self._pin = ScanPin(store, ctx.mem_tracker)
+        segs, pruned, covered = store.plan_scan(self.prune_bounds,
+                                                pin=self._pin)
+        self.stats.segs_scanned += len(segs)
+        self.stats.segs_pruned += pruned
+        # segment chunks size to the SEGMENT, not the plan's chunk
+        # capacity: padding a 64k-row segment into a 1M-row buffer
+        # would stage mostly zeros and erase the pruning win. One
+        # shared power-of-two capacity keeps a single trace across
+        # every segment chunk (the tail partial included).
+        seg_cap = 1
+        while seg_cap < min(store.segment_rows, cap):
+            seg_cap *= 2
+        self._seg_cap = seg_cap
+        for seg in segs:
+            for s in range(0, seg.rows, seg_cap):
+                self._seg_chunks.append((seg, s, min(s + seg_cap, seg.rows)))
+        if self._seg_chunks:
+            col_types = [(c.uid, c.type_) for c in self.scan_schema]
+            stages = self.stages
+            self._seg_fn = cached_jit(
+                "segscan", segment_scan_key(stages, col_types),
+                lambda: make_segment_scan_fn(stages, col_types))
+        else:
+            self._pin.close()  # nothing to stage: drop the refs now
+            self._pin = None
+        return covered
+
+    def _stage_segment(self, seg, s: int, e: int) -> Chunk:
+        """Stage one segment sub-range as a Chunk through the fused
+        decode+pipeline program. The narrow encoded bytes are what
+        crosses to the device; live-row visibility is read fresh from
+        the table's MVCC arrays, so deletes/txn markers since the
+        segment build are honored exactly."""
+        self._pin.touch(seg)
+        cap = self._seg_cap
+        n = e - s
+        data, valid, refs = {}, {}, {}
+        for c in self.scan_schema:
+            if c.name == "__rowid__":
+                d = np.zeros(cap, dtype=np.int64)
+                d[:n] = np.arange(seg.start + s, seg.start + e,
+                                  dtype=np.int64)
+                v = np.zeros(cap, dtype=np.bool_)
+                v[:n] = True
+            else:
+                enc, sd, sv = seg.col(c.name)
+                d = np.zeros(cap, dtype=sd.dtype)
+                d[:n] = sd[s:e]
+                v = np.zeros(cap, dtype=np.bool_)
+                v[:n] = sv[s:e]
+                if enc.kind == "for":
+                    refs[c.uid] = np.int64(enc.ref)
+            data[c.uid] = d
+            valid[c.uid] = v
+        sel = np.zeros(cap, dtype=np.bool_)
+        sel[:n] = self.table.live_mask(
+            seg.start + s, seg.start + e,
+            read_ts=self.ctx.read_ts, marker=self.ctx.txn_marker)
+        return self._seg_fn(data, valid, refs, sel)
+
+    def close(self) -> None:
+        if self._pin is not None:
+            self._pin.close()
+            self._pin = None
+        super().close()
+
     def next(self) -> Optional[Chunk]:
+        while self._seg_i < len(self._seg_chunks):
+            seg, s, e = self._seg_chunks[self._seg_i]
+            self._seg_i += 1
+            chunk = self._stage_segment(seg, s, e)
+            self.stats.chunks += 1
+            return chunk
         while self._i < len(self._slices):
             sl = self._slices[self._i]
             self._i += 1

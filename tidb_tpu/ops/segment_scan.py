@@ -1,0 +1,65 @@
+"""Fused segment decode + scan pipeline (device side of the columnar
+segment store).
+
+One module-level program per (pipeline stages, column layout) pair:
+the encoded columns cross the host→device boundary in their narrow
+storage dtypes (int8/int16/int32 frame-of-reference payloads, raw
+floats/bools), and the decode — ``ref + stored`` widened to the
+column's device repr — happens INSIDE the jitted program, fused with
+the scan's pushed filter and projections. Device bytes moved shrink
+with the encoding; XLA dead-code-eliminates the decode of columns the
+pipeline projects away.
+
+Frame-of-reference refs arrive as ARGS (per-segment values must not
+bake into the trace — jit keys on the dict structure and dtypes only),
+so a repeated scan re-traces nothing across segments of the same
+layout. Callers go through ``cached_jit`` keyed on (stages, layout).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
+
+__all__ = ["make_segment_scan_fn", "segment_scan_key"]
+
+
+def segment_scan_key(stages, col_types) -> str:
+    """Cache key covering everything the closure bakes in: the compiled
+    pipeline IR and the (uid -> SQLType) output layout."""
+    return repr(stages) + "|" + repr(
+        [(uid, t.kind.value, t.precision, t.scale, t.members)
+         for uid, t in col_types])
+
+
+def make_segment_scan_fn(stages, col_types: List[Tuple[str, object]]
+                         ) -> Callable:
+    """Build the Chunk-producing program for one scan layout.
+
+    `col_types`: (uid, SQLType) pairs of the staged storage columns.
+    The returned function takes (data, valid, refs, sel) dicts/arrays —
+    refs holds the FoR base per encoded uid (absent for raw columns) —
+    and returns the post-pipeline Chunk.
+    """
+    from tidb_tpu.executor.scan import make_pipeline_fn
+
+    pipeline = make_pipeline_fn(stages) if stages else None
+    types = list(col_types)
+
+    def run(data: Dict, valid: Dict, refs: Dict, sel) -> Chunk:
+        cols = {}
+        for uid, t in types:
+            d = data[uid]
+            dt = t.np_dtype
+            r = refs.get(uid)
+            if r is not None:
+                d = d.astype(dt) + r.astype(dt)  # fused FoR decode
+            elif d.dtype != dt:
+                d = d.astype(dt)
+            cols[uid] = Column(d, valid[uid], t)
+        ch = Chunk(cols, sel)
+        return pipeline(ch) if pipeline is not None else ch
+
+    return run

@@ -598,6 +598,9 @@ class Session:
             qs.notes.append(f"queued {int(q_s * 1e6)}us before execution")
         d0 = _dsp.count()
         f0 = _dsp.by_site().get("fragment", 0)
+        from tidb_tpu.columnar.store import scan_counts as _seg_counts
+
+        seg0 = _seg_counts()
         t0 = _time.perf_counter()
         try:
             with ctx:
@@ -612,7 +615,7 @@ class Session:
             if isinstance(exc, QueryTimeoutError):
                 M.DEADLINE_EXCEEDED_TOTAL.inc()
             detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, None,
-                                       error=True)
+                                       seg0=seg0, error=True)
             tracing.annotate(f"error:{type(exc).__name__}: {exc}")
             trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur,
                                           error=exc)
@@ -646,7 +649,8 @@ class Session:
         dur = _time.perf_counter() - t0
         M.QUERY_TOTAL.inc(type=stype, status="ok")
         M.QUERY_DURATION.observe(dur, type=stype)
-        detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, result)
+        detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, result,
+                                   seg0=seg0)
         trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur)
         self._maybe_log_slow(sql, dur, detail, trace_id)
         # plugin hooks run LAST (mirroring the error path): an audit
@@ -671,6 +675,7 @@ class Session:
             self.db, sql, dur, digest=detail[0],
             plan_digest=self._last_plan_digest or "",
             max_mem=detail[1], dispatches=detail[2],
+            segs_scanned=detail[3], segs_pruned=detail[4],
             trace_id=trace_id, disposition=disposition)
 
     def _stmt_digest(self, stmt, sql: str):
@@ -723,11 +728,13 @@ class Session:
             return ""
 
     def _record_stmt(self, stmt, sql: str, stype: str, dur: float,
-                     d0: int, f0: int, result, error: bool = False):
+                     d0: int, f0: int, result, seg0=(0, 0),
+                     error: bool = False):
         """Fold one execution into the per-digest statements summary;
-        returns (digest, max_mem, dispatches) for the slow-query log.
-        Digests come from the bindinfo normalizer, so parameterized
-        variants of one statement aggregate under one entry."""
+        returns (digest, max_mem, dispatches, segs_scanned, segs_pruned)
+        for the slow-query log. Digests come from the bindinfo
+        normalizer, so parameterized variants of one statement
+        aggregate under one entry."""
         from tidb_tpu.utils import dispatch as _dsp
 
         try:
@@ -742,6 +749,11 @@ class Session:
             self._stmt_trackers = []  # don't pin operator state while idle
             dispatches = _dsp.count() - d0
             fragments = _dsp.by_site().get("fragment", 0) - f0
+            from tidb_tpu.columnar.store import scan_counts as _seg_counts
+
+            seg1 = _seg_counts()
+            segs_scanned = seg1[0] - seg0[0]
+            segs_pruned = seg1[1] - seg0[1]
             self.catalog.stmt_summary.record(
                 digest, norm, stype, self._last_plan_digest or "", dur,
                 max_mem=max_mem,
@@ -751,11 +763,11 @@ class Session:
                 plan_latency_s=self._stmt_plan_s,
                 max_stmt_count=int(
                     self.sysvars.get("tidb_stmt_summary_max_stmt_count")))
-            return digest, max_mem, dispatches
+            return digest, max_mem, dispatches, segs_scanned, segs_pruned
         except Exception:  # noqa: BLE001 — diagnostics must never fail
             # (or mask) the statement; an unrecordable statement is
             # simply absent from the summary
-            return "", 0, 0
+            return "", 0, 0, 0, 0
 
     def query(self, sql: str) -> List[tuple]:
         rs = self.execute(sql)
@@ -858,6 +870,13 @@ class Session:
                 self.sysvars.get("tidb_tpu_join_tiles_per_dispatch")),
             broadcast_rows_limit=int(
                 self.sysvars.get("tidb_broadcast_join_threshold_count")),
+            columnar_enable=bool(
+                self.sysvars.get("tidb_tpu_columnar_enable")),
+            segment_rows=int(self.sysvars.get("tidb_tpu_segment_rows")),
+            segment_delta_rows=int(
+                self.sysvars.get("tidb_tpu_segment_delta_rows")),
+            columnar_spill_dir=str(
+                self.sysvars.get("tidb_tpu_columnar_spill_dir")),
             cancel_check=self.cancel_reason,
         )
 
@@ -915,7 +934,13 @@ class Session:
             cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
             n_parts=self._n_parts(),
             session_info={"user": self.user,
-                          "conn_id": getattr(self, "conn_id", 0)},
+                          "conn_id": getattr(self, "conn_id", 0),
+                          # columnar knobs for plan-time materialization
+                          # (CTE reuse segments its result iff enabled)
+                          "columnar_enable": bool(
+                              self.sysvars.get("tidb_tpu_columnar_enable")),
+                          "segment_rows": int(
+                              self.sysvars.get("tidb_tpu_segment_rows"))},
             agg_push_down=(self._agg_push_down() if agg_push_down is None
                            else agg_push_down),
         )

@@ -140,6 +140,22 @@ _reg(
     # statement (a child of the server tracker); 0 = unlimited
     SysVar("tidb_tpu_mem_quota_session", 0, BOTH, "int",
            min_=0, max_=1 << 45),
+    # -- columnar segment store (ISSUE 8) ------------------------------
+    # scans over stored tables stage encoded, zone-mapped segments with
+    # decompression fused into the jitted scan program; off = raw slices
+    SysVar("tidb_tpu_columnar_enable", True, BOTH, "bool"),
+    # fixed segment capacity in rows; the first store built for a table
+    # pins its value for that table's lifetime
+    SysVar("tidb_tpu_segment_rows", 1 << 16, BOTH, "int",
+           min_=1 << 10, max_=1 << 22),
+    # appended (delta) rows that trigger a coverage extension + zone-map
+    # refresh at the next scan; smaller = fresher zone maps, more
+    # build churn
+    SysVar("tidb_tpu_segment_delta_rows", 1 << 16, BOTH, "int",
+           min_=1 << 10, max_=1 << 24),
+    # directory for spilled segment files (empty = system tmp); cold
+    # segments evicted under the statement memory budget land here
+    SysVar("tidb_tpu_columnar_spill_dir", "", BOTH, "str"),
     # fixed device batch capacity (ref: tidb_max_chunk_size)
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)

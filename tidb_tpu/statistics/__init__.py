@@ -29,8 +29,8 @@ import numpy as np
 from tidb_tpu.types import TypeKind
 
 __all__ = ["ColumnStats", "TableStats", "analyze_table", "table_stats",
-           "scan_selectivity", "column_ndv", "eq_join_selectivity",
-           "NDVSketch", "HIST_BUCKETS", "MCV_SIZE"]
+           "zone_map_stats", "scan_selectivity", "column_ndv",
+           "eq_join_selectivity", "NDVSketch", "HIST_BUCKETS", "MCV_SIZE"]
 
 HIST_BUCKETS = 64
 MCV_SIZE = 16
@@ -198,6 +198,23 @@ def table_stats(table) -> Optional[TableStats]:
     return None
 
 
+def zone_map_stats(table) -> Optional[TableStats]:
+    """Fallback stats derived from the columnar segment store's zone
+    maps (ISSUE 8): per-column min/max as a two-point histogram,
+    null counts, and a summed per-segment NDV upper bound. Only
+    consulted when no fresh ANALYZE stats exist, and never stored on
+    `table.stats` (the plan cache keys entry freshness on that object's
+    identity). Reads an EXISTING store only — estimation must not
+    trigger a segment build."""
+    store = getattr(table, "_segment_store", None)
+    if store is None:
+        return None
+    try:
+        return store.stats_view()
+    except Exception:  # noqa: BLE001 — estimation must never fail a plan
+        return None
+
+
 # ---------------------------------------------------------------------------
 # estimation
 # ---------------------------------------------------------------------------
@@ -216,6 +233,11 @@ def column_ndv(table, col_name: str) -> Optional[float]:
     sk = getattr(table, "ndv_sketch", {}).get(col_name)
     if sk is not None:
         return max(sk.estimate(), 1.0)
+    # never analyzed: the segment store's zone maps still carry a
+    # per-segment exact NDV whose sum upper-bounds the table's
+    zs = zone_map_stats(table)
+    if zs is not None and col_name in zs.cols and zs.cols[col_name].ndv:
+        return max(float(zs.cols[col_name].ndv), 1.0)
     return None
 
 
@@ -342,6 +364,11 @@ def scan_selectivity(table, cond, uid_to_col: Dict[str, str]) -> float:
     """Estimated fraction of rows passing `cond` (compiled IR over scan
     uids); falls back to fixed heuristics without fresh stats."""
     stats = table_stats(table)
+    if stats is None or stats.n_rows == 0:
+        # between analyzes the segment store's zone maps still give
+        # per-column min/max + null counts — range predicates estimate
+        # against real bounds instead of the 0.25-per-conjunct guess
+        stats = zone_map_stats(table)
     if stats is None or stats.n_rows == 0:
         n = sum(1 for _ in _conjuncts(cond))
         return 0.25 ** min(n, 2)

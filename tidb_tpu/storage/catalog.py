@@ -274,25 +274,32 @@ class Catalog:
     def log_slow_query(self, db: str, sql: str, duration_s: float,
                        digest: str = "", plan_digest: str = "",
                        max_mem: int = 0, dispatches: int = 0,
+                       segs_scanned: int = 0, segs_pruned: int = 0,
                        trace_id: str = "", disposition: str = "") -> None:
         """One slow-log row. `trace_id` joins the row to the kept trace
         in information_schema.cluster_trace / /trace?id= (tail sampling
         retains every over-threshold statement's trace, so the id is
         live). `disposition` is "" for a completed statement or
         "error:<Type>" for one that died mid-execution (deadline, kill,
-        runtime error) — those used to be invisible here."""
+        runtime error) — those used to be invisible here.
+        `segs_scanned`/`segs_pruned`: columnar segments staged vs
+        zone-map-skipped across the statement's scans — a slow scan
+        with zero pruning on a range predicate is the "no clustering /
+        stale zone maps" signature."""
         import logging
         import time
 
         self.slow_queries.append((
             time.strftime("%Y-%m-%d %H:%M:%S"), db, round(duration_s, 4),
             sql.strip()[:2048], digest, plan_digest, int(max_mem),
-            int(dispatches), trace_id, disposition,
+            int(dispatches), int(segs_scanned), int(segs_pruned),
+            trace_id, disposition,
         ))
         logging.getLogger("tidb_tpu.slowlog").warning(
             "slow query (%.3fs) db=%s digest=%s mem=%d dispatches=%d "
-            "trace=%s%s: %s",
-            duration_s, db, digest, max_mem, dispatches, trace_id,
+            "segs=%d/%d trace=%s%s: %s",
+            duration_s, db, digest, max_mem, dispatches, segs_scanned,
+            segs_scanned + segs_pruned, trace_id,
             f" [{disposition}]" if disposition else "",
             sql.strip()[:512])
 
@@ -500,6 +507,14 @@ class Catalog:
         for fk in getattr(t, "foreign_keys", ()):
             fk.parent.referencing = [
                 (c, f) for c, f in fk.parent.referencing if c is not t]
+        # columnar segment store: release spilled payloads promptly
+        # (a weakref finalizer on the store backstops GC'd tables)
+        store = getattr(t, "_segment_store", None)
+        if store is not None:
+            try:
+                store.close()
+            except Exception:  # noqa: BLE001 — cleanup must not block DROP
+                pass
         del d.tables[name]
         self.schema_version += 1
 
@@ -759,7 +774,8 @@ class Catalog:
                 [("time", STRING), ("db", STRING), ("query_time", FLOAT64),
                  ("query", STRING), ("digest", STRING),
                  ("plan_digest", STRING), ("max_mem", INT64),
-                 ("dispatches", INT64), ("trace_id", STRING),
+                 ("dispatches", INT64), ("segs_scanned", INT64),
+                 ("segs_pruned", INT64), ("trace_id", STRING),
                  ("disposition", STRING)],
                 list(self.slow_queries),
             )

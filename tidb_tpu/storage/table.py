@@ -233,6 +233,15 @@ class Table:
         self.schema = schema
         self.n = 0  # physical rows incl. dead versions
         self.version = 0
+        # bumps whenever EXISTING physical rows' data/valid buffers are
+        # rewritten in place (dictionary-growth re-encode, GC
+        # compaction, MODIFY/ADD/DROP COLUMN, TRUNCATE) — appends and
+        # MVCC timestamp changes don't count. The columnar segment
+        # store (tidb_tpu/columnar) snapshots row-range payloads and
+        # invalidates on any epoch move; `version` alone over-triggers
+        # (every DML bumps it) and under-describes (it can't tell an
+        # append from a rewrite).
+        self.data_epoch = 0
         self._auto_inc = 1
         self._local_ts = 0  # fallback TSO for catalog-less tables
         self.ts_source = None  # catalog-provided TSO (set by create_table)
@@ -882,6 +891,7 @@ class Table:
             # unique check later in this same statement would otherwise
             # compare old-code cache entries against new-code rows
             self.version += 1
+            self.data_epoch += 1  # existing codes rewrote in place
         codes, valid = d.encode_with(vals)
         self.data[name][start:end] = codes
         self.valid[name][start:end] = valid
@@ -1247,6 +1257,7 @@ class Table:
                 self.data[col.name][: self.n] = dv
                 self.valid[col.name][: self.n] = True
         self.version += 1
+        self.data_epoch += 1  # column set changed under existing rows
 
     def drop_column(self, name: str) -> None:
         if any(name in fk.columns for fk in self.foreign_keys) or any(
@@ -1268,6 +1279,7 @@ class Table:
         del self.valid[name]
         self.dicts.pop(name, None)
         self.version += 1
+        self.data_epoch += 1  # column set changed under existing rows
 
     def modify_column(self, col: ColumnInfo) -> None:
         """Change a column's type, converting existing values. Numeric
@@ -1376,6 +1388,7 @@ class Table:
         if col.default is not None:
             old.default = col.default
         self.version += 1
+        self.data_epoch += 1  # stored values converted in place
 
     # -- indexes -----------------------------------------------------------
 
@@ -1741,6 +1754,7 @@ class Table:
         self.begin_ts[:m] = self.begin_ts[:n][keep]
         self.end_ts[:m] = self.end_ts[:n][keep]
         self.n = m
+        self.data_epoch += 1  # physical row positions moved
         # release buffer memory when the table shrank far below capacity
         want = max(_MIN_CAP, int(m * _GROW))
         if self._cap > 4 * want:
@@ -1760,6 +1774,7 @@ class Table:
                 "foreign key")
         self.n = 0
         self.version += 1
+        self.data_epoch += 1  # every stored payload discarded
         self.begin_ts[:] = 0
         self.end_ts[:] = MAX_TS
         for c in self.schema.columns:

@@ -208,14 +208,22 @@ def _load_orders_lineitem_native(make_table, counts, sf, seed,
 
 
 def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 7,
-              native: Optional[bool] = None) -> Dict[str, int]:
+              native: Optional[bool] = None,
+              cluster_lineitem: bool = False) -> Dict[str, int]:
     """Generate and ingest all eight TPC-H tables at scale factor `sf`.
     Returns table -> row count.
 
     `native` selects the C++ generator (native/tpch_gen.cpp) for the two
     big tables — orders and lineitem fill as int64 columns + dictionary
     codes with no per-row Python objects. None = auto (native when the
-    library builds/loads); False forces the numpy oracle generator."""
+    library builds/loads); False forces the numpy oracle generator.
+
+    `cluster_lineitem` ingests lineitem in l_shipdate order — the
+    time-ordered-arrival layout production fact tables have (rows land
+    as they ship), which is what makes the columnar store's date zone
+    maps prune (ISSUE 8's Q6 floor measures exactly this). Implies the
+    numpy generator for orders/lineitem; query results are unaffected
+    (row order is not observable through SQL)."""
     rng = np.random.default_rng(seed)
     counts = {}
 
@@ -318,7 +326,7 @@ def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 
     )
 
     # orders + lineitem ------------------------------------------------------
-    if native is not False:
+    if native is not False and not cluster_lineitem:
         done = _load_orders_lineitem_native(
             make_table, counts, sf, seed, npart, ns, nc)
         if done:
@@ -349,6 +357,25 @@ def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 
     returned = l_receipt <= _CURRENT
     rflag = np.where(returned, np.where(rng.random(nl) < 0.5, "R", "A"), "N")
     lstatus = np.where(l_ship > _CURRENT, "O", "F")
+    l_instruct = _pool_pick(rng, _INSTRUCT, nl)
+    l_shipmode = _pool_pick(rng, _SHIPMODES, nl)
+    l_comment = _pool_pick(rng, _COMMENT_POOL, nl)
+
+    if cluster_lineitem:
+        # time-ordered ingest: every per-row array permutes together
+        # (aggregate derivations below key on l_orderkey, so the
+        # permutation is invisible to them)
+        order = np.argsort(l_ship, kind="stable")
+        l_orderkey, l_linenumber = l_orderkey[order], l_linenumber[order]
+        l_partkey, l_suppkey = l_partkey[order], l_suppkey[order]
+        l_qty, l_extended = l_qty[order], l_extended[order]
+        l_discount, l_tax = l_discount[order], l_tax[order]
+        l_ship, l_commit = l_ship[order], l_commit[order]
+        l_receipt = l_receipt[order]
+        rflag, lstatus = rflag[order], lstatus[order]
+        l_instruct = [l_instruct[i] for i in order]
+        l_shipmode = [l_shipmode[i] for i in order]
+        l_comment = [l_comment[i] for i in order]
 
     t = make_table("lineitem")
     counts["lineitem"] = t.insert_columns(
@@ -368,9 +395,9 @@ def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 
         strings={
             "l_returnflag": rflag.tolist(),
             "l_linestatus": lstatus.tolist(),
-            "l_shipinstruct": _pool_pick(rng, _INSTRUCT, nl),
-            "l_shipmode": _pool_pick(rng, _SHIPMODES, nl),
-            "l_comment": _pool_pick(rng, _COMMENT_POOL, nl),
+            "l_shipinstruct": l_instruct,
+            "l_shipmode": l_shipmode,
+            "l_comment": l_comment,
         },
     )
 

@@ -48,6 +48,19 @@ def record(n: int = 1, site: str = "other") -> None:
     DISPATCH_TOTAL.inc(n, site=site)
 
 
+def event(site: str) -> None:
+    """Count a per-site EVENT without touching the device round-trip
+    totals: by_site() observers (tests, profiling) see it, but EXPLAIN
+    ANALYZE dispatch deltas, stmt-summary dispatch counts, and the
+    /metrics dispatch totals stay honest. Used for engine milestones
+    that are observable like dispatches but aren't one (e.g. one CTE
+    materialization per WITH body)."""
+    by = getattr(_tls, "by_site", None)
+    if by is None:
+        by = _tls.by_site = {}
+    by[site] = by.get(site, 0) + 1
+
+
 def count() -> int:
     return getattr(_tls, "count", 0)
 

@@ -108,7 +108,12 @@ def analyze_text(root) -> str:
             start,
             f"open:{e.stats.open_wall * 1e3:.1f}ms own:{own * 1e3:.1f}ms "
             f"loops:{e.stats.chunks} dispatches:{own_disp}"
-            + (f" recompiles:{own_rc}" if own_rc else ""),
+            + (f" recompiles:{own_rc}" if own_rc else "")
+            # columnar segment store: staged vs zone-map-pruned counts
+            # per scan operator (absent on non-segmented scans)
+            + (f" segs_scanned:{e.stats.segs_scanned}"
+               f" segs_pruned:{e.stats.segs_pruned}"
+               if e.stats.segs_scanned or e.stats.segs_pruned else ""),
         ))
         for i, c in enumerate(e.children):
             visit(c, depth + 1, i == len(e.children) - 1)

@@ -21,7 +21,19 @@ from typing import Callable, List, Optional
 
 from tidb_tpu.errors import ExecutionError
 
-__all__ = ["MemTracker", "QueryOOMError", "SpillFile", "SpillableRuns"]
+__all__ = ["MemTracker", "QueryOOMError", "SpillFile", "SpillableRuns",
+           "spill_root_of"]
+
+
+def spill_root_of(tracker: "MemTracker") -> "MemTracker":
+    """The tracker spillables anchor on: the nearest statement-level
+    spill root up the parent chain (falling back to the chain's top).
+    The ONE definition of the protocol walk — SpillableRuns and the
+    columnar ScanPin both register through it."""
+    root = tracker
+    while root.parent is not None and not root.spill_root:
+        root = root.parent
+    return root
 
 
 class QueryOOMError(ExecutionError):
@@ -147,9 +159,7 @@ class SpillableRuns:
 
     def __init__(self, tracker: MemTracker, label: str = "runs"):
         self.tracker = tracker
-        root = tracker
-        while root.parent is not None and not root.spill_root:
-            root = root.parent
+        root = spill_root_of(tracker)
         self._root = root
         if root.spill_enabled:
             root.register_spillable(self)

@@ -278,6 +278,25 @@ SPILL_TOTAL = Counter(
 SPILL_BYTES = Counter(
     "tidb_tpu_spill_bytes_total", "Bytes shed to tmp storage by spills")
 
+# -- columnar segment store (ISSUE 8) ---------------------------------------
+
+SCAN_SEGMENTS_SCANNED_TOTAL = Counter(
+    "tidb_tpu_scan_segments_scanned_total",
+    "Columnar segments staged by table scans (after zone-map pruning); "
+    "with ..._pruned_total this gives the engine-reported pruning "
+    "fraction the Q6 perf floor asserts on")
+SCAN_SEGMENTS_PRUNED_TOTAL = Counter(
+    "tidb_tpu_scan_segments_pruned_total",
+    "Columnar segments skipped before host->device staging because the "
+    "scan's pushed range/equality predicates cannot match the "
+    "segment's zone maps (min/max/null_count)")
+SPILL_SEGMENT_BYTES = Counter(
+    "tidb_tpu_spill_segment_bytes_total",
+    "Encoded segment payload bytes moved across the disk spill "
+    "boundary, by direction: out = evicted to a segment spill file "
+    "under the statement memory budget, in = re-materialized from "
+    "disk on a later touch")
+
 # -- distributed tracing (ISSUE 5) ------------------------------------------
 
 DCN_RPC_SECONDS = Histogram(
