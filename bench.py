@@ -722,6 +722,91 @@ def bench_oltp(extra, clients_list=(8, 16), iters=150):
     return out
 
 
+def bench_pipeline(extra=None, sf=None, reps=None):
+    """Fused-pipeline microbench (ISSUE 9): TPC-H Q1 + Q6 on the LOCAL
+    single-chip engine — the executor spine the fused
+    scan→filter→project→partial-agg path rebuilt. Two arms through the
+    SAME session: the pre-PR chunk-synced tree (pipeline_fuse=0: one
+    scan dispatch + one agg update + per-chunk staging every chunk) vs
+    the fused pipeline (one device program per chunk, double-buffered
+    prefetch, device buffer cache — a warm re-run stages nothing).
+    Loud cross-checks: arms byte-identical to each other AND to the
+    sqlite oracle, warm dispatch counts from the ENGINE counter
+    (single-digit per fragment is the acceptance floor)."""
+    from tidb_tpu.executor.pipeline import DEVICE_CACHE
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.catalog import Catalog
+    from tidb_tpu.storage.tpch import load_tpch
+    from tidb_tpu.storage.tpch_queries import Q
+    from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+    from tidb_tpu.utils import dispatch as _dsp
+
+    sf = min(SF, 0.2) if sf is None else sf
+    reps = REPS if reps is None else reps
+    # production chunk capacity: the fragment is still genuinely
+    # chunked (the 64k-row segment store feeds the unfused arm one
+    # chunk per segment — the per-chunk ping-pong being measured —
+    # while the fused arm packs k segments per capacity-sized batch,
+    # which is where the single-digit dispatch budget comes from)
+    s = Session(catalog=Catalog(), chunk_capacity=CAP)
+    s.execute("SET tidb_slow_log_threshold = 300000")
+    # plan reuse ON: both arms must measure EXECUTION, not re-planning
+    s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+    counts = load_tpch(s.catalog, sf=sf, native=False)
+    rows = counts["lineitem"]
+    conn = mirror_to_sqlite(s.catalog, tables=["lineitem"])
+    out = {"sf": sf, "lineitem_rows": rows, "queries": {}}
+
+    def one(sql, fuse: bool):
+        s.execute(f"SET tidb_tpu_pipeline_fuse = {int(fuse)}")
+        d0 = _dsp.count()
+        t0 = time.perf_counter()
+        got = s.query(sql)
+        return got, time.perf_counter() - t0, _dsp.count() - d0
+
+    for name in ("q1", "q6"):
+        sql, lite = Q[name]
+        DEVICE_CACHE.clear()
+        # warm BOTH arms (compiles, device cache fill), then interleave
+        # the measured reps A/B — machine drift between back-to-back
+        # blocks would otherwise bias whichever arm runs first (the
+        # test_partitions lesson)
+        one(sql, True)
+        one(sql, False)
+        fused_best = unf_best = float("inf")
+        fused_disp = unf_disp = 0
+        fused_rows = unf_rows = None
+        for _ in range(max(reps, 2)):
+            fused_rows, dt, fused_disp = one(sql, True)
+            fused_best = min(fused_best, dt)
+            unf_rows, dt, unf_disp = one(sql, False)
+            unf_best = min(unf_best, dt)
+        s.execute("SET tidb_tpu_pipeline_fuse = 1")
+        ok_arms, msg = rows_equal(fused_rows, unf_rows, ordered=True)
+        want = conn.execute(lite or sql).fetchall()
+        ok_oracle, msg2 = rows_equal(fused_rows, want, ordered=True)
+        q = {
+            "fused_warm_s": round(fused_best, 4),
+            "unfused_warm_s": round(unf_best, 4),
+            "fused_over_unfused": round(unf_best / fused_best, 3),
+            "fused_warm_dispatches": fused_disp,
+            "unfused_warm_dispatches": unf_disp,
+            "rows_per_sec_fused": round(rows / fused_best, 1),
+            "hash_equal": bool(ok_arms),
+            "check": "ok" if ok_oracle else f"MISMATCH: {msg2}"[:300],
+        }
+        if not ok_arms:
+            q["arm_mismatch"] = str(msg)[:300]
+        out["queries"][name] = q
+        log(f"#   {name}: fused={fused_best * 1e3:.1f}ms "
+            f"({fused_disp} disp) unfused={unf_best * 1e3:.1f}ms "
+            f"({unf_disp} disp) speedup={q['fused_over_unfused']}x "
+            f"check={q['check']}")
+    if extra is not None:
+        extra["pipeline"] = out
+    return out
+
+
 def bench_zone_pruning(extra=None, sf=None, reps=None):
     """Zone-map pruning microbench (ISSUE 8): TPC-H Q6 over a
     time-ordered (l_shipdate-clustered) lineitem — the production
@@ -1142,6 +1227,14 @@ def main(locked_detail=("acquired", "acquired")):
             extra["tpcds_q95_check"] = check
     except Exception as e:  # noqa: BLE001
         extra["tpcds_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # fused-pipeline microbench (ISSUE 9): Q1/Q6 fused vs chunk-synced
+    # on the single-chip spine, warm dispatch counts + oracle
+    try:
+        log("# pipeline microbench")
+        bench_pipeline(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["pipeline_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # zone-map pruning microbench (ISSUE 8): Q6 over time-ordered
     # lineitem, pruned vs unpruned, engine counters + exact oracle

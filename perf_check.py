@@ -156,6 +156,42 @@ def main():
                 ol_bad[f"oltp_batched_speedup[{nc}]"] = f"{got} < {need}"
         pc_bad.extend(f"{k}={v}" for k, v in ol_bad.items())
 
+        # fused-pipeline FIXED floors (ISSUE 9). The core acceptance is
+        # the DISPATCH budget: a warm Q1/Q6 fragment on the single-chip
+        # spine must issue single-digit device round trips (engine
+        # counter) — on the tunneled TPU each dispatch floors at ~0.5s,
+        # so the chunk-synced path's ~40 dispatches vs the pipeline's
+        # <=9 IS a multi-x win there. On XLA:CPU (this harness) Q1 is
+        # compute-bound and dispatch-insensitive, so the wall-clock
+        # ratio floors split: the staging-bound Q6 must show the
+        # fusion + overlap + device-cache win (>=1.5x best-of-3
+        # interleaved; measured 1.6-2.4x), and the compute-bound Q1
+        # must not regress under fusion (>=0.9x; measured 1.02-1.09x —
+        # its win on CPU is the dispatch budget, not wall clock).
+        # Correctness floors (arms identical + sqlite oracle) hold on
+        # EVERY run.
+        pl_bad = {}
+        pl_speed = {"q1": 0.0, "q6": 0.0}
+        for _ in range(3):
+            pl = bench.bench_pipeline({})
+            for qn, q in pl["queries"].items():
+                pl_speed[qn] = max(pl_speed[qn], q["fused_over_unfused"])
+                if q["fused_warm_dispatches"] > 9:
+                    pl_bad[f"pipeline_dispatches[{qn}]"] = (
+                        f"{q['fused_warm_dispatches']} > 9")
+                if not q["hash_equal"] or q["check"] != "ok":
+                    pl_bad[f"pipeline_oracle[{qn}]"] = q["check"]
+            if (not pl_bad and pl_speed["q6"] >= 1.5
+                    and pl_speed["q1"] >= 0.9):
+                break
+        print(f"pipeline_q6_speedup      {pl_speed['q6']}  (need >= 1.5)")
+        print(f"pipeline_q1_speedup      {pl_speed['q1']}  (need >= 0.9)")
+        if pl_speed["q6"] < 1.5:
+            pl_bad["pipeline_q6_speedup"] = f"{pl_speed['q6']} < 1.5"
+        if pl_speed["q1"] < 0.9:
+            pl_bad["pipeline_q1_speedup"] = f"{pl_speed['q1']} < 0.9"
+        pc_bad.extend(f"{k}={v}" for k, v in pl_bad.items())
+
         # columnar segment store FIXED floors (ISSUE 8). Zone pruning:
         # TPC-H Q6 at SF1 over time-ordered lineitem must skip >= 50%
         # of segments (the ENGINE-reported counter), run >= 2x faster

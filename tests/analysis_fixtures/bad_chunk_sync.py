@@ -1,0 +1,43 @@
+"""Known-bad fixture for the host-sync pass's chunk-loop sync budget
+(ISSUE 9): a per-iteration ``jax.device_get`` inside a chunk loop is a
+device round trip per chunk — it must be batched per window, hoisted to
+finalize, or annotated with ``# host-sync: <reason>``.
+
+Expected violations: the two un-annotated loop fetches below (for and
+while forms). The annotated one and the post-loop finalize fetch are
+clean.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def drain_per_chunk(chunks, fn):
+    out = []
+    for ch in chunks:
+        out.append(jax.device_get(fn(ch)))  # BAD: one fetch per chunk
+    return out
+
+
+def poll_until_done(step, state):
+    while True:
+        state, done = step(state)
+        if jax.device_get(done):  # BAD: per-iteration scalar fetch
+            break
+    return state
+
+
+def drain_annotated(chunks, fn):
+    out = []
+    for ch in chunks:
+        # host-sync: fixture's sanctioned loop fetch — reasoned syncs
+        # inside loops stay allowlisted
+        out.append(jax.device_get(fn(ch)))
+    return out
+
+
+def accumulate_then_fetch(chunks, update):
+    state = jnp.zeros(8)
+    for ch in chunks:
+        state = update(state, ch)
+    return jax.device_get(state)  # OK: one fetch at finalize

@@ -64,19 +64,29 @@ def test_device_matches_host(sessions, sql):
 def test_uses_device_path(sessions):
     dev, _ = sessions
     from tidb_tpu.executor import aggregate as agg
+    from tidb_tpu.executor import pipeline as pipe
 
     called = {}
     orig = agg.HashAggExec._run_generic_device
+    orig_fused = pipe.FusedScanAggExec._run_generic_fused
 
     def spy(self):
         called["yes"] = True
         return orig(self)
 
+    def spy_fused(self):
+        # the fused scan→partial-agg pipeline (ISSUE 9) IS the device
+        # path: group tables accumulate on device, one fetch at the end
+        called["yes"] = True
+        return orig_fused(self)
+
     agg.HashAggExec._run_generic_device = spy
+    pipe.FusedScanAggExec._run_generic_fused = spy_fused
     try:
         dev.query("select k, count(*) from g group by k")
     finally:
         agg.HashAggExec._run_generic_device = orig
+        pipe.FusedScanAggExec._run_generic_fused = orig_fused
     assert called.get("yes"), "generic agg did not take the device path"
 
 

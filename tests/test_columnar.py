@@ -363,24 +363,33 @@ class TestSegmentSpill:
             "c": np.asarray(rng.integers(-(1 << 40), 1 << 40, n),
                             dtype=np.int64),
         })
-        resident = s.query("select sum(a), sum(b), sum(c) from big")
-        out0 = SPILL_SEGMENT_BYTES.value(dir="out")
-        # a budget far below the store's resident bytes: the scan must
-        # evict already-streamed segments instead of dying. The floor
-        # covers the engine's fixed per-statement working set.
-        s.execute("set tidb_mem_quota_query = 1048576")
-        budget = s.query("select sum(a), sum(b), sum(c) from big")
-        assert budget == resident
-        out1 = SPILL_SEGMENT_BYTES.value(dir="out")
-        assert out1 > out0, "budgeted scan must spill segments out"
-        assert any(p.name.endswith(".npz")
-                   for p in tmp_path.rglob("*")), "spill dir honored"
-        # a rescan under the same budget re-materializes from disk
-        in0 = SPILL_SEGMENT_BYTES.value(dir="in")
-        again = s.query("select sum(a), sum(b), sum(c) from big")
-        assert again == resident
-        assert SPILL_SEGMENT_BYTES.value(dir="in") > in0
-        s.execute("set tidb_mem_quota_query = 2147483648")
+        # the cross-statement device buffer cache (ISSUE 9) would serve
+        # the budgeted rescan from already-staged buffers — a warm
+        # statement legitimately stages nothing and never needs spill —
+        # so it is disabled HERE to exercise the spill machinery itself
+        s.execute("set global tidb_tpu_device_buffer_cache_bytes = 0")
+        try:
+            resident = s.query("select sum(a), sum(b), sum(c) from big")
+            out0 = SPILL_SEGMENT_BYTES.value(dir="out")
+            # a budget far below the store's resident bytes: the scan
+            # must evict already-streamed segments instead of dying. The
+            # floor covers the engine's fixed per-statement working set.
+            s.execute("set tidb_mem_quota_query = 1048576")
+            budget = s.query("select sum(a), sum(b), sum(c) from big")
+            assert budget == resident
+            out1 = SPILL_SEGMENT_BYTES.value(dir="out")
+            assert out1 > out0, "budgeted scan must spill segments out"
+            assert any(p.name.endswith(".npz")
+                       for p in tmp_path.rglob("*")), "spill dir honored"
+            # a rescan under the same budget re-materializes from disk
+            in0 = SPILL_SEGMENT_BYTES.value(dir="in")
+            again = s.query("select sum(a), sum(b), sum(c) from big")
+            assert again == resident
+            assert SPILL_SEGMENT_BYTES.value(dir="in") > in0
+            s.execute("set tidb_mem_quota_query = 2147483648")
+        finally:
+            s.execute("set global tidb_tpu_device_buffer_cache_bytes = "
+                      f"{256 << 20}")
 
     def test_invalidation_retires_referenced_segments(self, seg_session):
         """A store rebuild (epoch bump) racing an in-flight scan must

@@ -174,6 +174,21 @@ class TestHostSyncFixture:
         rep, _ = _run_pass(root, HostSyncPass())
         assert not rep.violations
 
+    def test_chunk_loop_device_get_budget(self, tmp_path):
+        """ISSUE 9 satellite: a jax.device_get inside a chunk loop
+        without a # host-sync: reason fails; the annotated loop fetch
+        and the post-loop finalize fetch stay clean."""
+        root = _mini_root(tmp_path, ("executor", "bad_chunk_sync.py"))
+        rep, _ = _run_pass(root, HostSyncPass())
+        msgs = [v.render() for v in rep.violations]
+        # exactly the un-annotated for-loop and while-loop fetches: the
+        # annotated loop fetch is allowlisted and the finalize fetch
+        # after the loop is the sanctioned shape
+        assert len(rep.violations) == 2, msgs
+        assert all("chunk loop" in v.message
+                   and "device_get" in v.message
+                   for v in rep.violations), msgs
+
 
 class TestLockDisciplineFixture:
     def test_cycle_is_flagged(self, tmp_path):

@@ -26,6 +26,14 @@ Host-tier numpy code (spill loaders, drained chunks) stays untainted by
 design — the pass guards the *device-result* sync class, not every
 np.asarray.  ``jax.device_get`` is the sanctioned explicit fetch: it
 moves a whole pytree in ONE transfer and its result is host.
+
+Chunk-loop sync budget (ISSUE 9): even the sanctioned fetch is a
+device→host round trip, and one PER CHUNK-LOOP ITERATION re-creates
+exactly the ping-pong the pipelined executor exists to remove.  A
+``jax.device_get`` lexically inside a ``for``/``while`` loop therefore
+requires its own ``# host-sync: <reason>`` annotation — the loop sync
+must be *batched* (one fetch per window, like the join probe's deferred
+totals), hoisted to finalize, or visibly justified.
 """
 
 from __future__ import annotations
@@ -84,6 +92,7 @@ class _FnScan:
         self.tainted: Set[str] = set()
         self.local_device_fns: Set[str] = set()
         self.hits: List[Tuple[int, str, str]] = []  # (line, kind, detail)
+        self._loop_depth = 0  # chunk-loop sync budget (device_get-in-loop)
 
     # -- expression taint ------------------------------------------------
 
@@ -120,6 +129,12 @@ class _FnScan:
         if isinstance(f, ast.Name) and f.id in _JIT_BUILDER_NAMES:
             return True
         return False
+
+    def _is_device_get(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "device_get":
+            return True
+        return isinstance(f, ast.Name) and f.id == "device_get"
 
     def _sync_kind(self, call: ast.Call) -> str:
         """'' or the sync-op name when `call` is a sync operation."""
@@ -201,10 +216,14 @@ class _FnScan:
                     self._bind(tgt, t, dfn)
             elif isinstance(stmt, (ast.For, ast.AsyncFor)):
                 self._bind(stmt.target, self.taint(stmt.iter), False)
+                self._loop_depth += 1
                 self._walk(stmt.body)
+                self._loop_depth -= 1
                 self._walk(stmt.orelse)
             elif isinstance(stmt, ast.While):
+                self._loop_depth += 1
                 self._walk(stmt.body)
+                self._loop_depth -= 1
                 self._walk(stmt.orelse)
             elif isinstance(stmt, ast.If):
                 self._walk(stmt.body)
@@ -245,6 +264,12 @@ class _FnScan:
             nodes = list(ast.walk(stmt))
         for node in nodes:
             if not isinstance(node, ast.Call):
+                continue
+            if self._loop_depth > 0 and self._is_device_get(node):
+                # chunk-loop sync budget: the sanctioned batch fetch is
+                # still one round trip per iteration inside a loop
+                self.hits.append((node.lineno, "device_get-in-loop",
+                                  ast.unparse(node)[:80]))
                 continue
             kind = self._sync_kind(node)
             if not kind:
@@ -290,6 +315,16 @@ class HostSyncPass(Pass):
                 if note is not None:
                     used_notes.add(note[0])
                     continue  # annotated allowlist (reported separately)
+                if kind == "device_get-in-loop":
+                    out.append(Violation(
+                        self.id, sf.rel, line,
+                        f"per-iteration device fetch `{detail}` inside a "
+                        "chunk loop (one round trip per iteration — the "
+                        "ping-pong the pipelined executor removes). "
+                        "Batch it into one fetch per window, hoist it to "
+                        "finalize, or annotate with `# host-sync: "
+                        "<reason>`."))
+                    continue
                 out.append(Violation(
                     self.id, sf.rel, line,
                     f"implicit device→host sync `{detail}` on the hot "

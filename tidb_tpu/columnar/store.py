@@ -433,7 +433,9 @@ class ScanPin:
             self.tracker.consume(seg.nbytes)
 
     def spillable_bytes(self) -> int:
-        return sum(b for s, b in self.charged.values()
+        # snapshot like spill(): the pipeline staging thread touch()-
+        # inserts into `charged` while budget pressure walks it
+        return sum(b for s, b in list(self.charged.values())
                    if s.resident and s.pins == 0)
 
     def spill(self) -> int:
@@ -442,7 +444,11 @@ class ScanPin:
         retired segments remain evictable — their files outlive the
         segment list). Returns the bytes released from this
         statement's accounting."""
-        order = sorted((s for s, _b in self.charged.values()
+        # snapshot first: the pipeline staging thread (ISSUE 9) may be
+        # touch()-inserting into `charged` while another thread's
+        # budget pressure walks it
+        charged = list(self.charged.values())
+        order = sorted((s for s, _b in charged
                         if s.resident and s.pins == 0),
                        key=lambda s: s.last_touch)
         for seg in order:

@@ -13,6 +13,10 @@ device fragments:
   join.py      -- HashJoinExec: device sort+searchsorted build/probe with
                   static-capacity windowed expansion
   sort.py      -- SortExec / TopNExec / LimitExec / UnionExec (root, host)
+  pipeline.py  -- FusedScanAggExec: push-based scan→filter→project→
+                  partial-agg fragments (one program per chunk, device
+                  state, one finalize fetch), double-buffered staging,
+                  cross-statement device buffer cache (ISSUE 9)
   builder.py   -- physical plan -> executor tree (ref: executorBuilder)
   base.py      -- Executor protocol, ExecContext, ResultSet, RuntimeStats
 """

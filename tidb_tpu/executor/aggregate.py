@@ -436,11 +436,9 @@ class HashAggExec(Executor):
         from tidb_tpu.utils.memory import SpillableRuns
 
         group_exprs, aggs = self.group_exprs, self.aggs
-        from tidb_tpu.planner.logical import CORE_AGGS
+        from tidb_tpu.planner.logical import core_generic_agg
 
-        if (group_exprs and self.ctx.device_agg
-                and not any(a.distinct for a in aggs)
-                and all(a.func in CORE_AGGS for a in aggs)):
+        if self.ctx.device_agg and core_generic_agg(group_exprs, aggs):
             self._run_generic_device()
             return
 
@@ -461,9 +459,12 @@ class HashAggExec(Executor):
         self._runs = runs
         total = 0
         for chunk in self.children[0].chunks():
-            # ONE device fetch per chunk: device_get moves the whole
-            # (outs, sel) pytree in a single transfer where per-column
-            # np.asarray paid 2K+1 separate syncs (host-sync pass)
+            # host-sync: host-groupby tier — the host accumulates raw
+            # values, so each chunk's (outs, sel) pytree must land
+            # host-side; ONE device_get per chunk replaces the 2K+1
+            # per-column np.asarray syncs this loop used to pay. The
+            # device tiers (fused pipeline / _run_generic_device) are
+            # the no-per-chunk-fetch paths
             outs, sel = jax.device_get(eval_all(chunk))
             sel = np.asarray(sel)
             live = np.nonzero(sel)[0]
@@ -622,13 +623,22 @@ class HashAggExec(Executor):
         stack = GroupTableStack(len(self.group_exprs), self.aggs, sig)
         for chunk in self.children[0].chunks():
             stack.push(partial_fn(chunk))
+        self._finalize_group_tables(stack.tables())
 
-        tables = stack.tables()
+    def _finalize_group_tables(self, tables):
+        """ONE batched fetch of the device group tables, host merge,
+        emit. Shared by the pull-based device path above and the fused
+        scan→partial-agg pipeline (executor/pipeline.py), which
+        accumulates the same tables from its fused chunk programs."""
+        import jax
+
+        from tidb_tpu.executor.agg_device import table_to_host_partial
+
         cap = self.ctx.chunk_capacity
         if not tables:
             self._out = []  # grouped agg over empty input -> no rows
             return
-        host_tables = jax.device_get(tables)  # ONE round trip
+        host_tables = jax.device_get(tables)  # ONE round trip (finalize)
         # account the durable (ngroups-sliced) partial tables with the
         # same incremental discipline as the host spill-merge path; the
         # padded slot arrays are transients

@@ -62,6 +62,10 @@ class RuntimeStats:
     # operators (or tables) without a segment store
     segs_pruned: int = 0
     segs_scanned: int = 0
+    # pipelined execution (ISSUE 9): chunks whose staged device buffers
+    # were already in place when the compute loop asked — prefetch hits
+    # plus device-buffer-cache hits. EXPLAIN ANALYZE's `staged` column
+    staged: int = 0
 
 
 @dataclass
@@ -108,6 +112,19 @@ class ExecContext:
     # directory for spilled segment files (tidb_tpu_columnar_spill_dir;
     # empty = system tmp)
     columnar_spill_dir: str = ""
+    # pipelined device-resident execution (ISSUE 9): fuse eligible
+    # scan->filter->project->partial-agg fragments into one jitted
+    # program per chunk (tidb_tpu_pipeline_fuse)
+    pipeline_fuse: bool = True
+    # staging chunks kept in flight ahead of compute by the prefetch
+    # thread; 0 = stage inline (tidb_tpu_pipeline_prefetch_depth)
+    prefetch_depth: int = 2
+    # byte budget of the cross-statement device buffer cache; 0 = off
+    # (tidb_tpu_device_buffer_cache_bytes)
+    device_buffer_cache_bytes: int = 256 << 20
+    # stage fragment inputs FoR-encoded in narrow dtypes, decoded inside
+    # the fragment program (tidb_tpu_stage_encoded)
+    stage_encoded: bool = True
 
     def __post_init__(self):
         if self.mem_tracker is None:

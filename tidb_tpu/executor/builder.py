@@ -80,6 +80,45 @@ def scan_prune_bounds(scan: PScan):
     return collect_prune_bounds(scan.pushed_cond, uid_map)
 
 
+def _try_fused_scan_agg(plan: PHashAgg):
+    """HashAgg whose child peels to a PLAIN table scan pipeline runs as
+    one fused scan→filter→project→partial-agg fragment (ISSUE 9): one
+    jitted program per chunk, device-resident state, one fetch at
+    finalize. Point/range/partition access paths keep the classic tree
+    (their row sets come from literal-keyed host probes), as does
+    anything the context later rules out — FusedScanAggExec falls back
+    through `fallback_build` at open() in that case, so the routing
+    decision needing ExecContext state doesn't have to happen here."""
+    from tidb_tpu.executor.pipeline import FusedScanAggExec
+
+    stages, base = peel_stages(plan.child)
+    if type(base) is not PScan or base.table is None:
+        return None
+    if plan.strategy != "segment":
+        # plan-STATIC generic-strategy gates decide here so permanently
+        # unfusible shapes (DISTINCT, non-core funcs, global generic)
+        # keep the classic tree — and its per-operator EXPLAIN ANALYZE
+        # breakdown. Only ctx-dependent gates (sysvars, device_agg)
+        # defer to the open()-time delegate.
+        from tidb_tpu.planner.logical import core_generic_agg
+
+        if not core_generic_agg(plan.group_exprs, plan.aggs):
+            return None
+
+    def fallback(plan=plan):
+        return HashAggExec(
+            plan.schema, build_executor(plan.child), plan.group_exprs,
+            plan.group_uids, plan.aggs, plan.strategy,
+            segment_sizes=getattr(plan, "segment_sizes", None))
+
+    return FusedScanAggExec(
+        plan.schema, base.schema, base.table,
+        scan_stages_for(base, stages), scan_prune_bounds(base),
+        plan.group_exprs, plan.group_uids, plan.aggs, plan.strategy,
+        segment_sizes=getattr(plan, "segment_sizes", None),
+        fallback_build=fallback)
+
+
 def build_executor(plan: PhysicalPlan) -> Executor:
     # pipeline fusion: Selection/Projection chains over a scan
     stages, base = peel_stages(plan)
@@ -145,6 +184,9 @@ def build_executor(plan: PhysicalPlan) -> Executor:
                              stages=scan_stages,
                              prune_bounds=scan_prune_bounds(plan))
     if isinstance(plan, PHashAgg):
+        fused = _try_fused_scan_agg(plan)
+        if fused is not None:
+            return fused
         return HashAggExec(
             plan.schema,
             build_executor(plan.child),

@@ -44,7 +44,7 @@ import numpy as np
 
 from tidb_tpu.chunk.chunk import Chunk
 from tidb_tpu.chunk.column import Column
-from tidb_tpu.executor.base import ExecContext, Executor
+from tidb_tpu.executor.base import ExecContext, Executor, raise_if_cancelled
 from tidb_tpu.ops import join_kernels as jk
 from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.expression.compiler import compile_predicate, eval_expr
@@ -131,6 +131,8 @@ class HashJoinExec(Executor):
         key_ok = []
         payload: dict = {c.uid: ([], []) for c in (self.build_schema or [])}
         for chunk in build_child.chunks():
+            # KILL/deadline interrupts the build drain chunk-by-chunk
+            raise_if_cancelled(self.ctx)
             outs, sel = eval_keys_any(chunk)
             sel = np.asarray(sel)
             live = np.nonzero(sel)[0]
@@ -446,17 +448,58 @@ class HashJoinExec(Executor):
             nbytes += k.nbytes
         return nbytes
 
+    # deferred-sync window for the device probe: per-chunk match totals
+    # accumulate as device scalars and resolve in ONE batched fetch per
+    # window instead of one int() sync per chunk (ISSUE 9). The byte cap
+    # bounds how many probe chunks (plus their count arrays) stay
+    # referenced on device while their totals are in flight.
+    PROBE_SYNC_CHUNKS = 8
+    PROBE_DEFER_BYTES = 128 << 20
+
     def next(self) -> Optional[Chunk]:
         while True:
             if self._pending:
                 return self._pending.pop(0)
             if self._drained:
                 return None
+            self._fill_pending()
+
+    def _fill_pending(self) -> None:
+        """Pull probe chunks until output lands in _pending or the child
+        drains. Device-tier chunks needing a match total (inner/left,
+        filtered semi/anti) DEFER it: probe_count results queue with
+        their device totals, and one batched device_get per window
+        resolves every queued chunk — the probe phase of a fragment now
+        syncs O(chunks / window), not O(chunks)."""
+        deferred: List[dict] = []
+        dbytes = 0
+        while not self._pending and not self._drained:
             chunk = self.children[0].next()
             if chunk is None:
                 self._drained = True
+                break
+            # a KILL/deadline must interrupt the probe drain between
+            # device steps, not wait for the root chunk loop
+            raise_if_cancelled(self.ctx)
+            if self._host_probe_eligible():
+                self._process_probe_chunk_np(chunk)
                 continue
-            self._process_probe_chunk(chunk)
+            tok = self._probe_start_device(chunk)
+            if tok is None:
+                continue  # fully handled (unfiltered semi/anti)
+            deferred.append(tok)
+            # the window pins the chunk's columns AND the probe_count
+            # results: 4 int64 + 2 bool [Rp] arrays per token
+            dbytes += sum(c.data.nbytes + c.valid.nbytes
+                          for c in chunk.columns.values())
+            dbytes += tok["Rp"] * 34
+            if (len(deferred) >= self.PROBE_SYNC_CHUNKS
+                    or dbytes >= self.PROBE_DEFER_BYTES):
+                self._probe_finish_batch(deferred)
+                deferred = []
+                dbytes = 0
+        if deferred:
+            self._probe_finish_batch(deferred)
 
     def _host_probe_eligible(self) -> bool:
         """The numpy probe path covers the workhorse shapes on the host
@@ -667,20 +710,37 @@ class HashJoinExec(Executor):
             self._pending.append(
                 Chunk.from_numpy(arrays, types, valids=valids, capacity=ccap))
 
-    def _process_probe_chunk(self, chunk: Chunk):
-        if self._host_probe_eligible():
-            self._process_probe_chunk_np(chunk)
-            return
+    def _probe_finish_batch(self, tokens: List[dict]) -> None:
+        """Resolve a deferred window: ONE device_get moves every queued
+        chunk's match total, then each chunk finishes (expansion /
+        qualification) with its now-host-known size."""
+        # THE intentional probe sync, batched: one fetch of the
+        # accumulated per-chunk match totals per deferred window
+        # (PROBE_SYNC_CHUNKS chunks), replacing the per-chunk int()
+        # round trip this loop used to pay; the totals size the tile
+        # expansions (sanctioned device_get outside any loop — the
+        # chunk-loop sync-budget pass watches the loop form)
+        totals = jax.device_get([t["total_dev"] for t in tokens])
+        from tidb_tpu.utils import dispatch as dsp
+
+        dsp.record(site="fetch")
+        for tok, total in zip(tokens, totals):
+            try:
+                self._probe_finish(tok, int(total))
+            finally:
+                from tidb_tpu.utils.metrics import JOIN_PROBE_SECONDS
+
+                # spans launch -> expansion incl. any deferral wait;
+                # overlapped chunks legitimately overlap their windows
+                JOIN_PROBE_SECONDS.observe(time.perf_counter() - tok["t0"],
+                                           kind=self.kind)
+
+    def _probe_start_device(self, chunk: Chunk) -> Optional[dict]:
+        """Launch the fused probe_count for one chunk. Unfiltered
+        semi/anti joins finish here (their keep mask needs no total);
+        everything else returns a deferral token carrying the device
+        results, resolved later by _probe_finish_batch."""
         t0 = time.perf_counter()
-        try:
-            self._process_probe_chunk_device(chunk)
-        finally:
-            from tidb_tpu.utils.metrics import JOIN_PROBE_SECONDS
-
-            JOIN_PROBE_SECONDS.observe(time.perf_counter() - t0,
-                                       kind=self.kind)
-
-    def _process_probe_chunk_device(self, chunk: Chunk):
         # hash-packed keys need exact re-verification of every candidate
         # row, so they take the same filtered paths as other_cond
         has_filter = self._has_filter
@@ -700,14 +760,45 @@ class HashJoinExec(Executor):
             modes=self._modes, hash_mode=self._hash_mode,
             left_pad=left_pad, direct=self._direct)
 
-        if self.kind in ("semi", "anti"):
-            if has_filter:
-                matched = self._qualified_matches(
-                    # host-sync: the probe_count total sizes the
-                    # qualification expansion — one scalar per chunk
-                    chunk, start, real_count, cum, int(total_dev))
-            elif Rp != cap:
+        if self.kind in ("semi", "anti") and not has_filter:
+            if Rp != cap:
                 matched = matched[:cap]
+            okc = ok[:cap] if Rp != cap else ok
+            if self.kind == "semi":
+                self._pending.append(chunk.with_sel(okc & matched))
+            elif self._build_had_null and not self.exists_sem:
+                pass  # NOT IN with NULL in subquery: no row is ever TRUE
+            elif self.exists_sem:
+                # NOT EXISTS: a NULL probe key never matches -> row kept
+                self._pending.append(
+                    chunk.with_sel(chunk.sel & ~(okc & matched)))
+            else:
+                self._pending.append(
+                    chunk.with_sel(chunk.sel & okc & ~matched))
+            from tidb_tpu.utils.metrics import JOIN_PROBE_SECONDS
+
+            JOIN_PROBE_SECONDS.observe(time.perf_counter() - t0,
+                                       kind=self.kind)
+            return None
+        return {"chunk": chunk, "start": start, "count": count,
+                "real_count": real_count, "cum": cum,
+                "total_dev": total_dev, "ok": ok, "matched": matched,
+                "cap": cap, "Rp": Rp, "t0": t0}
+
+    def _probe_finish(self, tok: dict, total: int) -> None:
+        """Complete one deferred probe chunk with its host-known match
+        total: qualification for filtered semi/anti, tile expansion for
+        inner/left."""
+        chunk = tok["chunk"]
+        start, count, real_count = tok["start"], tok["count"], \
+            tok["real_count"]
+        cum, ok = tok["cum"], tok["ok"]
+        cap, Rp = tok["cap"], tok["Rp"]
+        has_filter = self._has_filter
+
+        if self.kind in ("semi", "anti"):  # has_filter: qualified path
+            matched = self._qualified_matches(
+                chunk, start, real_count, cum, total)
             okc = ok[:cap] if Rp != cap else ok
             if self.kind == "semi":
                 self._pending.append(chunk.with_sel(okc & matched))
@@ -722,10 +813,6 @@ class HashJoinExec(Executor):
             self._pending.append(chunk.with_sel(keep))
             return
 
-        # host-sync: THE one intentional sync per probe chunk — the
-        # match total sizes the tile expansion (ROADMAP item 1 wants
-        # it gone; until then it is documented here and in README)
-        total = int(total_dev)
         left_other = self.kind == "left" and has_filter
         if total == 0 and not left_other:
             return
@@ -750,8 +837,9 @@ class HashJoinExec(Executor):
             # probe rows whose every match failed other_cond (or that had
             # none) emit one NULL-payload row each, per LEFT JOIN semantics
             unmatched = chunk.sel & jnp.asarray(~matched_np)
-            # host-sync: left-join + other_cond tail — one bool per
-            # chunk decides whether a NULL-pad chunk is emitted at all
+            # host-sync: intentional sync on the left-join + other_cond
+            # tail — one bool per chunk decides whether a NULL-pad
+            # chunk is emitted at all
             if bool(np.asarray(unmatched).any()):
                 self._pending.append(self._null_build_chunk(chunk, unmatched))
 

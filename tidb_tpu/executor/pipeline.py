@@ -1,0 +1,708 @@
+"""Pipelined device-resident fragment execution (ISSUE 9 / ROADMAP 3).
+
+Three pieces collapse the per-chunk host ping-pong of the single-chip
+executor spine into a push-based, device-resident pipeline:
+
+  * ``FusedScanAggExec`` — scan→filter→project→partial-agg as ONE
+    module-level jitted program per chunk. The scan's staged inputs
+    (encoded segment payloads or raw slices) and the running agg state
+    are the only things that cross the jit boundary; the [G]-shaped
+    (segment strategy) or group-table (generic strategy) state
+    accumulates ON DEVICE across chunks and is fetched exactly once at
+    finalize. Columnar segments pack SEVERAL per batch at a fixed
+    ``seg_cap`` stride inside one capacity-sized buffer, so a fragment
+    over a 64k-row segment store still issues ~n/chunk_capacity
+    dispatches, not one per segment.
+
+  * ``ChunkPrefetcher`` — double-buffered host→device staging: while
+    chunk *k* computes, a staging thread builds chunk *k+1*'s host
+    buffers and ``jax.device_put``s them, with the in-flight window
+    bounded by ``tidb_tpu_pipeline_prefetch_depth`` and charged to the
+    statement MemTracker. KILL/deadline is polled inside the thread
+    (``raise_if_cancelled``) so a cancelled statement stops staging,
+    not just computing.
+
+  * ``DeviceBufferCache`` — staged scan inputs kept device-resident
+    ACROSS statements, keyed and invalidated exactly like the plan
+    cache: any ``catalog.schema_version`` bump clears it eagerly (the
+    same hook that clears the plan cache), and per-entry identity pins
+    ``Table.version`` / ``Table.data_epoch`` / the stats object / the
+    segment store generation, so DML, DDL, ANALYZE and TRUNCATE all
+    invalidate. A warm TPC-H Q1/Q6 re-run stages nothing.
+
+Glue (finalize, result decode) still runs under ``host_eager`` like the
+rest of the executor tier; the staging device is pinned in the MAIN
+thread (the prefetch thread does not inherit jax's thread-local default
+device) so buffers always land where the fused program runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from tidb_tpu.executor.aggregate import HashAggExec, make_segment_kernel
+from tidb_tpu.executor.base import ExecContext, raise_if_cancelled
+from tidb_tpu.utils.jitcache import cached_jit
+from tidb_tpu.utils.memory import QueryOOMError
+
+__all__ = ["DEVICE_CACHE", "DeviceBufferCache", "ChunkPrefetcher",
+           "FusedScanAggExec", "table_ident"]
+
+
+def table_ident(table) -> tuple:
+    """Everything a cached staged buffer's validity depends on — the
+    plan cache's invalidation axes applied to data instead of plans:
+    ``version`` moves on every DML (and TRUNCATE), ``data_epoch`` on
+    in-place rewrites (column DDL, gc compaction, dict re-encode), the
+    stats identity on ANALYZE, and the segment-store generation/coverage
+    on columnar rebuilds. Schema-version bumps clear the whole cache
+    eagerly via the catalog hook instead."""
+    base = getattr(table, "_base", table)
+    stats = getattr(base, "stats", None)
+    store = getattr(base, "_segment_store", None)
+    return (
+        getattr(base, "version", None),
+        getattr(base, "data_epoch", None),
+        None if stats is None else (id(stats), stats.version),
+        None if store is None else (store.generation, store.covered),
+        getattr(table, "n", None),
+    )
+
+
+def _pytree_nbytes(tree) -> int:
+    return int(sum(getattr(leaf, "nbytes", 0)
+                   for leaf in jax.tree_util.tree_leaves(tree)))
+
+
+class DeviceBufferCache:
+    """Process-global LRU of staged device scan inputs.
+
+    One entry = one (table, staging layout) pair holding the full list
+    of staged per-chunk pytrees a fused fragment consumed, plus the
+    identity tuple that proves them current. The entry pins the table
+    object (like plan-cache entries) so a recycled ``id()`` can never
+    alias a different table; the byte budget
+    (``tidb_tpu_device_buffer_cache_bytes``) bounds resident bytes with
+    LRU eviction."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._bytes = 0
+
+    def _count(self, kind: str, n: int = 1) -> None:
+        from tidb_tpu.utils.metrics import DEVICE_CACHE_TOTAL
+
+        DEVICE_CACHE_TOTAL.inc(n, kind=kind)
+
+    def get(self, table, tag, ident) -> Optional[List]:
+        key = (id(table), tag)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e["table"] is table and e["ident"] == ident:
+                self._entries.move_to_end(key)
+                self._count("hit")
+                return e["chunks"]
+            if e is not None:
+                # same statement shape, stale data: the plan cache's
+                # stats/DML invalidation analogue
+                self._bytes -= e["nbytes"]
+                del self._entries[key]
+                self._count("invalidate")
+        self._count("miss")
+        return None
+
+    def put(self, table, tag, ident, chunks: List, nbytes: int,
+            budget: int) -> None:
+        if budget <= 0 or nbytes > budget:
+            return
+        key = (id(table), tag)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old["nbytes"]
+            self._entries[key] = {"table": table, "ident": ident,
+                                  "chunks": chunks, "nbytes": int(nbytes)}
+            self._bytes += int(nbytes)
+            while self._bytes > budget and len(self._entries) > 1:
+                _k, ev = self._entries.popitem(last=False)
+                self._bytes -= ev["nbytes"]
+                self._count("evict")
+
+    def on_schema_change(self) -> None:
+        """Eager clear on any catalog.schema_version bump (DDL) — the
+        exact hook the plan cache invalidates through."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+        if n:
+            self._count("invalidate", n)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+DEVICE_CACHE = DeviceBufferCache()
+
+
+class ChunkPrefetcher:
+    """Double-buffered host→device staging ahead of the compute loop.
+
+    ``jobs`` is an ordered list of zero-arg callables, each returning
+    one chunk's HOST pytree (numpy buffers). A daemon thread runs them
+    in order, ``jax.device_put``s the result onto the staging device
+    captured in the constructor (thread-locals like ``host_eager`` do
+    not cross threads), and parks when ``depth`` buffers sit staged but
+    unconsumed. In-flight staged bytes are charged to the statement
+    MemTracker — a tight ``tidb_mem_quota_query`` surfaces as the same
+    typed OOM/spill behavior as any other operator state. KILL and
+    statement deadlines are polled before every job AND while parked,
+    so a cancelled statement never keeps staging in the background."""
+
+    POLL_S = 0.05
+
+    def __init__(self, jobs: List[Callable], ctx: ExecContext, stats=None):
+        from tidb_tpu.utils.device import host_cpu_device
+
+        self.jobs = jobs
+        self.ctx = ctx
+        self.stats = stats
+        self.depth = max(int(getattr(ctx, "prefetch_depth", 0) or 0), 0)
+        self.tracker = ctx.mem_tracker.child("pipeline.prefetch")
+        self._device = host_cpu_device()  # None = default backend is CPU
+        self._staged: Dict[int, Tuple[object, int]] = {}
+        self._err: Optional[BaseException] = None
+        self._next_get = 0
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = None
+        if self.depth > 0 and len(jobs) > 1:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tidb-tpu-prefetch")
+            self._thread.start()
+
+    # -- staging -----------------------------------------------------------
+
+    def _stage(self, host) -> Tuple[object, int]:
+        from tidb_tpu.utils import dispatch as dsp
+        from tidb_tpu.utils.metrics import PIPELINE_PREFETCH_BYTES
+
+        nbytes = _pytree_nbytes(host)
+        if self._device is not None:
+            staged = jax.device_put(host, self._device)
+        else:
+            staged = jax.device_put(host)
+        dsp.record(site="stage")
+        PIPELINE_PREFETCH_BYTES.inc(nbytes)
+        return staged, nbytes
+
+    def _run(self) -> None:
+        from tidb_tpu.utils.metrics import PIPELINE_PREFETCH_TOTAL
+
+        try:
+            for i, job in enumerate(self.jobs):
+                with self._cv:
+                    while (not self._stop
+                           and i - self._next_get >= self.depth):
+                        # parked on a full window: keep honoring
+                        # KILL/deadline while the consumer computes
+                        raise_if_cancelled(self.ctx)
+                        self._cv.wait(self.POLL_S)
+                    if self._stop:
+                        return
+                raise_if_cancelled(self.ctx)
+                staged, nbytes = self._stage(job())
+                self.tracker.consume(nbytes)  # typed OOM propagates below
+                with self._cv:
+                    if self._stop:
+                        self.tracker.release(nbytes)
+                        return
+                    self._staged[i] = (staged, nbytes)
+                    self._cv.notify_all()
+        except BaseException as e:  # noqa: BLE001 — relayed to the
+            # consumer thread verbatim via get(); the typed
+            # kill/deadline/OOM classification must survive the hop
+            from tidb_tpu.errors import QueryKilledError, QueryTimeoutError
+
+            # keep the counter honest: "cancelled" means KILL/deadline
+            # stopped staging; quota OOM or a staging bug is "error"
+            cancelled = isinstance(e, (QueryKilledError, QueryTimeoutError))
+            PIPELINE_PREFETCH_TOTAL.inc(
+                outcome="cancelled" if cancelled else "error")
+            with self._cv:
+                self._err = e
+                self._cv.notify_all()
+
+    # -- consumption -------------------------------------------------------
+
+    def get(self, i: int):
+        """Chunk i's staged device pytree, blocking on in-flight staging."""
+        from tidb_tpu.utils.metrics import PIPELINE_PREFETCH_TOTAL
+
+        if self._thread is None:
+            staged, nbytes = self._stage(self.jobs[i]())
+            PIPELINE_PREFETCH_TOTAL.inc(outcome="inline")
+            return staged
+        with self._cv:
+            self._next_get = max(self._next_get, i + 1)
+            self._cv.notify_all()
+            ready = i in self._staged
+            while i not in self._staged and self._err is None:
+                raise_if_cancelled(self.ctx)
+                self._cv.wait(self.POLL_S)
+            if i not in self._staged:
+                raise self._err
+            staged, nbytes = self._staged.pop(i)
+            self._cv.notify_all()
+        self.tracker.release(nbytes)
+        PIPELINE_PREFETCH_TOTAL.inc(outcome="hit" if ready else "wait")
+        if ready and self.stats is not None:
+            self.stats.staged += 1
+        return staged
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+            leftover = sum(n for _v, n in self._staged.values())
+            self._staged.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if leftover:
+            self.tracker.release(leftover)
+
+
+# ---------------------------------------------------------------------------
+# fused scan→filter→project→partial-agg programs
+# ---------------------------------------------------------------------------
+
+
+def _barrier_chunk(chunk):
+    """Materialization boundary between the scan pipeline and the agg
+    update INSIDE the fused program. Without it XLA fuses the
+    decode+filter+projection chain into every aggregate consumer and
+    recomputes it once per state array — a fused Q1 measured ~1.5x
+    SLOWER than the two-dispatch tree it replaced. The barrier keeps
+    one kernel launch while pinning the scan's outputs to be computed
+    once, exactly like the unfused path's intermediate chunk."""
+    return jax.tree_util.tree_map(jax.lax.optimization_barrier, chunk)
+
+
+def _make_fused_segment_fn(stages, col_types, group_exprs, aggs, domains,
+                           seg_cap: Optional[int]):
+    """(state, data, valid, refs, sel) -> state: decode + pipeline +
+    segment-agg update as ONE program.
+
+    Batches whose length is a multiple of ``seg_cap`` stream through an
+    INTERNAL ``lax.scan`` over seg_cap-sized blocks: one device
+    dispatch covers the whole packed batch (the single-digit dispatch
+    budget) while each scan step touches only a cache-sized block —
+    running the update over a monolithic 1M-row batch measurably lost
+    to the chunk-synced path on XLA:CPU purely on locality (its 64k
+    chunks stayed L2-resident). Per-step FoR refs arrive as scan-sliced
+    scalars, so the decode is a scalar add, not a gather."""
+    from tidb_tpu.ops.segment_scan import make_segment_scan_fn
+
+    scan_fn = make_segment_scan_fn(stages, col_types, seg_stride=seg_cap)
+    _init, update, _g = make_segment_kernel(group_exprs, aggs, domains)
+
+    def run(state, data, valid, refs, sel):
+        n = sel.shape[0]
+        if not seg_cap or n <= seg_cap or n % seg_cap:
+            return update(state, _barrier_chunk(scan_fn(data, valid, refs,
+                                                        sel)))
+        k = n // seg_cap
+        bdata = {u: d.reshape((k, seg_cap) + d.shape[1:])
+                 for u, d in data.items()}
+        bvalid = {u: v.reshape(k, seg_cap) for u, v in valid.items()}
+        bsel = sel.reshape(k, seg_cap)
+
+        def step(st, xs):
+            d, v, r, sl = xs
+            return update(st, _barrier_chunk(scan_fn(d, v, r, sl))), None
+
+        state, _ = jax.lax.scan(step, state, (bdata, bvalid, refs, bsel))
+        return state
+
+    return run
+
+
+def _make_fused_generic_fn(stages, col_types, group_exprs, aggs,
+                           seg_cap: Optional[int]):
+    """(data, valid, refs, sel) -> group table: decode + pipeline +
+    sort-based partial grouping as ONE program. No internal blocking
+    here: the partial is a whole-batch sort (one big lax.sort beats
+    per-block sorts + extra merge levels), and its output shape is the
+    input capacity — per-block tables would just re-create the stack's
+    merge work inside the program."""
+    from tidb_tpu.executor.agg_device import make_partial_kernel
+    from tidb_tpu.ops.segment_scan import make_segment_scan_fn
+
+    scan_fn = make_segment_scan_fn(stages, col_types, seg_stride=seg_cap)
+    partial = make_partial_kernel(group_exprs, aggs)
+
+    def run(data, valid, refs, sel):
+        return partial(_barrier_chunk(scan_fn(data, valid, refs, sel)))
+
+    return run
+
+
+class FusedScanAggExec(HashAggExec):
+    """HashAgg whose child is a fusible scan pipeline, executed as a
+    push-based device-resident fragment: staged inputs stream through
+    ONE jitted program per chunk and the aggregation state never visits
+    the host until finalize. Falls back to the classic pull-based tree
+    (``fallback_build``) when the context disables fusion or the
+    aggregate shape needs the host paths (DISTINCT, non-core funcs,
+    ``tidb_enable_tpu_exec`` off for generic strategy)."""
+
+    def __init__(self, schema, scan_schema, table, stages, prune_bounds,
+                 group_exprs, group_uids, aggs, strategy,
+                 segment_sizes=None, fallback_build=None):
+        super().__init__(schema, None, group_exprs, group_uids, aggs,
+                         strategy, segment_sizes=segment_sizes)
+        self.children = []
+        self.scan_schema = scan_schema
+        self.table = table
+        self.scan_stages = stages
+        self.prune_bounds = prune_bounds
+        self._fallback_build = fallback_build
+        self._delegate = None
+        self._pin = None
+        self._prefetcher = None
+        self._seg_cap = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self, ctx: ExecContext) -> None:
+        self.ctx = ctx
+        self._out = []
+        self._emitted = False
+        self._delegate = None
+        if not self._fuse_eligible(ctx):
+            d = self._fallback_build()
+            d.open(ctx)
+            self._delegate = d
+            return
+        try:
+            if self.strategy == "segment":
+                self._run_segment_fused()
+            else:
+                self._run_generic_fused()
+        finally:
+            self._release_staging()
+
+    def next(self):
+        if self._delegate is not None:
+            return self._delegate.next()
+        return super().next()
+
+    def close(self) -> None:
+        if self._delegate is not None:
+            self._delegate.close()
+            self._delegate = None
+        self._release_staging()
+        super().close()
+
+    def _release_staging(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        if self._pin is not None:
+            self._pin.close()
+            self._pin = None
+
+    def _fuse_eligible(self, ctx: ExecContext) -> bool:
+        if not getattr(ctx, "pipeline_fuse", True) or self.table is None:
+            return False
+        if self.strategy == "segment":
+            return True
+        from tidb_tpu.planner.logical import core_generic_agg
+
+        return ctx.device_agg and core_generic_agg(self.group_exprs,
+                                                   self.aggs)
+
+    # -- staging plan ------------------------------------------------------
+
+    def _plan_staging(self, ctx: ExecContext):
+        """The ordered chunk staging schedule (a list of zero-arg host
+        staging jobs). Columnar
+        segments pack ``k = capacity // seg_cap`` per batch at a fixed
+        stride; the uncovered delta tail stages as raw capacity-sized
+        slices. Zone maps prune segments BEFORE anything is staged,
+        exactly like the unfused scan."""
+        cap = ctx.chunk_capacity
+        table = self.table
+        jobs = []
+        tail_start = 0
+        self._seg_cap = None
+        if ctx.columnar_enable:
+            from tidb_tpu.columnar.store import ScanPin, store_for
+
+            store = store_for(
+                table, segment_rows=ctx.segment_rows,
+                delta_rows=ctx.segment_delta_rows,
+                spill_dir=ctx.columnar_spill_dir or None)
+            if store is not None:
+                self._pin = ScanPin(store, ctx.mem_tracker)
+                segs, pruned, covered = store.plan_scan(
+                    self.prune_bounds, pin=self._pin)
+                self.stats.segs_scanned += len(segs)
+                self.stats.segs_pruned += pruned
+                tail_start = covered
+                seg_cap = 1
+                while seg_cap < min(store.segment_rows, cap):
+                    seg_cap *= 2
+                self._seg_cap = seg_cap
+                k = max(cap // seg_cap, 1)
+                slots = []
+                for seg in segs:
+                    for s in range(0, seg.rows, seg_cap):
+                        slots.append((seg, s, min(s + seg_cap, seg.rows)))
+                for i in range(0, len(slots), k):
+                    batch = slots[i:i + k]
+                    # the tail batch sizes to ITS slot count: padding it
+                    # to k segments would run the internal scan over
+                    # dead all-zero blocks (13/16 of a 1M buffer for a
+                    # 3-segment tail — measured ~0.8s of pure waste)
+                    jobs.append(self._seg_batch_job(batch, len(batch),
+                                                    seg_cap))
+                if not slots:
+                    self._pin.close()  # nothing to stage: drop refs now
+                    self._pin = None
+        n = table.n
+        for s in range(tail_start, n, cap):
+            e = min(s + cap, n)
+            jobs.append(self._raw_slice_job(s, e, cap))
+        return jobs
+
+    def _seg_batch_job(self, batch, k: int, seg_cap: int):
+        """Stage up to k encoded segments into ONE [k * seg_cap] buffer
+        set. Payloads keep their narrow encoded dtypes (promoted to the
+        widest within the batch); per-segment FoR bases travel as [k]
+        vectors, decoded on device against an iota-derived segment id.
+        MVCC visibility is read fresh from the table's arrays."""
+        table, pin, schema, ctx = self.table, self._pin, self.scan_schema, \
+            self.ctx
+
+        def job():
+            bcap = k * seg_cap
+            sel = np.zeros(bcap, dtype=np.bool_)
+            per_col: Dict[str, list] = {c.uid: [] for c in schema}
+            for j, (seg, s, e) in enumerate(batch):
+                pin.touch(seg)
+                off = j * seg_cap
+                n = e - s
+                for c in schema:
+                    if c.name == "__rowid__":
+                        per_col[c.uid].append(("rowid", seg.start + s, n))
+                    else:
+                        enc, sd, sv = seg.col(c.name)
+                        # slices VIEW the (immutable) payload arrays;
+                        # the views keep them alive past an eviction
+                        per_col[c.uid].append((enc, sd[s:e], sv[s:e]))
+                sel[off:off + n] = table.live_mask(
+                    seg.start + s, seg.start + e,
+                    read_ts=ctx.read_ts, marker=ctx.txn_marker)
+            data, valid, refs = {}, {}, {}
+            for c in schema:
+                uid = c.uid
+                entries = per_col[uid]
+                if c.name == "__rowid__":
+                    d = np.zeros(bcap, dtype=np.int64)
+                    v = np.zeros(bcap, dtype=np.bool_)
+                    for j, (_tag, start0, n) in enumerate(entries):
+                        off = j * seg_cap
+                        d[off:off + n] = np.arange(start0, start0 + n,
+                                                   dtype=np.int64)
+                        v[off:off + n] = True
+                    data[uid], valid[uid] = d, v
+                    continue
+                dt = entries[0][1].dtype
+                for _enc, sd, _sv in entries[1:]:
+                    dt = np.promote_types(dt, sd.dtype)
+                any_for = any(enc.kind == "for" for enc, _d, _v in entries)
+                d = np.zeros(bcap, dtype=dt)
+                v = np.zeros(bcap, dtype=np.bool_)
+                rv = np.zeros(k, dtype=np.int64)
+                for j, (enc, sd, sv) in enumerate(entries):
+                    off = j * seg_cap
+                    n = len(sd)
+                    d[off:off + n] = sd
+                    v[off:off + n] = sv
+                    if enc.kind == "for":
+                        rv[j] = enc.ref
+                data[uid], valid[uid] = d, v
+                if any_for:
+                    refs[uid] = rv
+            return data, valid, refs, sel
+
+        return job
+
+    def _raw_slice_job(self, s: int, e: int, cap: int):
+        table, schema, ctx = self.table, self.scan_schema, self.ctx
+
+        def job():
+            n = e - s
+            data, valid = {}, {}
+            for c in schema:
+                if c.name == "__rowid__":
+                    d = np.zeros(cap, dtype=np.int64)
+                    d[:n] = np.arange(s, e, dtype=np.int64)
+                    v = np.zeros(cap, dtype=np.bool_)
+                    v[:n] = True
+                else:
+                    cd, cv = table.column_slice(c.name, s, e)
+                    d = np.zeros(cap, dtype=cd.dtype)
+                    d[:n] = cd
+                    v = np.zeros(cap, dtype=np.bool_)
+                    v[:n] = cv
+                data[c.uid], valid[c.uid] = d, v
+            sel = np.zeros(cap, dtype=np.bool_)
+            sel[:n] = table.live_mask(
+                s, e, read_ts=ctx.read_ts, marker=ctx.txn_marker)
+            return data, valid, {}, sel
+
+        return job
+
+    # -- staged chunk stream (prefetch + device buffer cache) --------------
+
+    def _staged_chunks(self, jobs):
+        """Yield staged device pytrees in chunk order: from the device
+        buffer cache when a warm identical statement already staged
+        them, else through the double-buffered prefetcher — filling the
+        cache on the way out when everything fits the budget."""
+        ctx = self.ctx
+        budget = int(getattr(ctx, "device_buffer_cache_bytes", 0) or 0)
+        cacheable = (budget > 0 and jobs
+                     and ctx.read_ts is None and ctx.txn_marker == 0)
+        tag = ident = None
+        if cacheable:
+            # the chunk-set descriptor (descs) is deliberately NOT part
+            # of the tag: it is a deterministic function of (table
+            # identity, bounds, capacities), so folding it into the
+            # key would turn every DML into a silent key change (stale
+            # entry leaks until LRU) instead of a counted invalidation
+            tag = ("scanagg",
+                   tuple((c.uid, c.name) for c in self.scan_schema),
+                   ctx.chunk_capacity, self._seg_cap,
+                   repr(self.prune_bounds))
+            ident = table_ident(self.table)
+            hit = DEVICE_CACHE.get(self.table, tag, ident)
+            if hit is not None:
+                self.stats.staged += len(hit)
+                for staged in hit:
+                    yield staged
+                return
+        pf = ChunkPrefetcher(jobs, ctx, stats=self.stats)
+        self._prefetcher = pf
+        collect: Optional[list] = [] if cacheable else None
+        # the fill holds every staged buffer alive until put(): that
+        # working set is charged to the STATEMENT tracker while the
+        # fragment runs (ownership transfers to the process-level cache
+        # at put). Quota pressure must abandon the fill, never fail the
+        # query — and the fill must not even APPROACH the budget, or
+        # the other consumers (prefetch window, segment pins) would OOM
+        # against consumption the fill inflated: stop filling past half
+        # the statement's remaining headroom.
+        fill_tracker = ctx.mem_tracker.child("pipeline.cache_fill")
+        stmt_budget = getattr(ctx.mem_tracker, "budget", None)
+        nbytes = 0
+
+        def abandon():
+            nonlocal collect, nbytes
+            collect = None
+            fill_tracker.release(nbytes)
+            nbytes = 0
+
+        try:
+            for i in range(len(jobs)):
+                staged = pf.get(i)
+                if collect is not None:
+                    b = _pytree_nbytes(staged)
+                    if nbytes + b > budget:
+                        abandon()  # too big to pin: stream through
+                    elif stmt_budget and (ctx.mem_tracker.consumed + b
+                                          > stmt_budget // 2):
+                        abandon()  # leave the quota to the real work
+                    else:
+                        try:
+                            fill_tracker.consume(b)
+                        except QueryOOMError:
+                            abandon()
+                        else:
+                            nbytes += b
+                            collect.append(staged)
+                yield staged
+            if collect is not None:
+                DEVICE_CACHE.put(self.table, tag, ident, collect, nbytes,
+                                 budget)
+        finally:
+            fill_tracker.release(nbytes)
+
+    # -- fused execution ---------------------------------------------------
+
+    def _run_segment_fused(self):
+        from tidb_tpu.ops.segment_scan import segment_scan_key
+
+        ctx = self.ctx
+        domains = [s + 1 for s in (self.segment_sizes or [])]
+        jobs = self._plan_staging(ctx)
+        col_types = [(c.uid, c.type_) for c in self.scan_schema]
+        stages, seg_cap = self.scan_stages, self._seg_cap
+        key = ("seg|" + segment_scan_key(stages, col_types, seg_cap)
+               + "|" + repr((self.group_exprs, self.aggs, domains)))
+        fused = cached_jit(
+            "fusedagg", key,
+            lambda: _make_fused_segment_fn(stages, col_types,
+                                           self.group_exprs, self.aggs,
+                                           domains, seg_cap),
+            donate_argnums=0)
+        init_state, _u, _g = make_segment_kernel(
+            self.group_exprs, self.aggs, domains)
+        state = init_state()
+        for staged in self._staged_chunks(jobs):
+            # KILL/deadline polls BETWEEN device steps: the fusion must
+            # not turn a chunked fragment into an uninterruptible run
+            raise_if_cancelled(ctx)
+            state = fused(state, *staged)
+        self._finalize_segment_state(state, domains)
+
+    def _run_generic_fused(self):
+        from tidb_tpu.executor.agg_device import GroupTableStack
+        from tidb_tpu.ops.segment_scan import segment_scan_key
+
+        ctx = self.ctx
+        jobs = self._plan_staging(ctx)
+        col_types = [(c.uid, c.type_) for c in self.scan_schema]
+        stages, seg_cap = self.scan_stages, self._seg_cap
+        sig = repr((self.group_exprs, self.aggs))
+        key = ("gen|" + segment_scan_key(stages, col_types, seg_cap)
+               + "|" + sig)
+        fused = cached_jit(
+            "fusedagg", key,
+            lambda: _make_fused_generic_fn(stages, col_types,
+                                           self.group_exprs, self.aggs,
+                                           seg_cap))
+        stack = GroupTableStack(len(self.group_exprs), self.aggs, sig)
+        for staged in self._staged_chunks(jobs):
+            raise_if_cancelled(ctx)  # see _run_segment_fused
+            stack.push(fused(*staged))
+        self._finalize_group_tables(stack.tables())

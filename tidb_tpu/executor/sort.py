@@ -44,9 +44,11 @@ class _Materializing(Executor):
         runs = SpillableRuns(self.ctx.mem_tracker.child("sort"), "sort")
         self._runs = runs
         for ch in child.chunks():
-            # ONE device fetch per chunk (Chunk/Column are pytrees):
-            # the per-column np.asarray calls below then see numpy and
-            # cost nothing — was 2 syncs per column (host-sync pass)
+            # host-sync: sort materializes to HOST runs (spillable under
+            # the query budget), so each chunk crosses once by design;
+            # ONE device_get per chunk (Chunk/Column are pytrees) — the
+            # per-column np.asarray calls below then see numpy and cost
+            # nothing (was 2 syncs per column)
             kcols, ch = jax.device_get(eval_chunk(ch))
             sel = np.asarray(ch.sel)
             live = np.nonzero(sel)[0]

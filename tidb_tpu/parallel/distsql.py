@@ -79,12 +79,18 @@ def merge_state(state: Dict[str, jax.Array], axes=_AXES) -> Dict[str, jax.Array]
     return out
 
 
-def _shard_chunk(types: Dict, data, valid, sel, uid_map) -> Chunk:
+def _shard_chunk(types: Dict, data, valid, sel, uid_map,
+                 refs: Optional[Dict] = None) -> Chunk:
+    from tidb_tpu.ops.segment_scan import decode_for
+
     cols = {}
     for name in data:
         uid = uid_map.get(name, name) if uid_map else name
-        cols[uid] = Column(data=data[name][0], valid=valid[name][0],
-                           type_=types[name])
+        t = types[name]
+        # fused FoR decode: the narrow staged payload widens to the
+        # column's device repr INSIDE the program (ISSUE 9)
+        d = decode_for(data[name][0], (refs or {}).get(name), t.np_dtype)
+        cols[uid] = Column(data=d, valid=valid[name][0], type_=t)
     return Chunk(cols, sel[0])
 
 
@@ -92,18 +98,20 @@ def make_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
                       domains: List[int], uid_map: Optional[Dict[str, str]] = None):
     """Compile scan->filter->partial-agg->merge over the mesh.
 
-    Returns a jitted fn(data, valid, sel) -> merged [G]-state dict
-    (replicated; fetched once). Cache the returned fn — jit keys on
-    function identity, so rebuilding it means recompiling. The closure
-    deliberately captures only st's metadata (types/mesh), never the
-    ShardedTable itself, so a cached fragment cannot pin retired [P,R]
-    device arrays."""
+    Returns a jitted fn(data, valid, sel, refs) -> merged [G]-state dict
+    (replicated; fetched once); refs carries the FoR bases of encoded
+    staged columns ({} for raw staging). Cache the returned fn — jit
+    keys on function identity, so rebuilding it means recompiling. The
+    closure deliberately captures only st's metadata (types/mesh), never
+    the ShardedTable itself, so a cached fragment cannot pin retired
+    [P,R] device arrays."""
     pipeline = make_pipeline_fn(stages) if stages else (lambda c: c)
     init_state, update, _ = make_segment_kernel(group_exprs, aggs, domains)
     types, mesh = dict(st.types), st.mesh
 
-    def per_shard(data, valid, sel):
-        chunk = pipeline(_shard_chunk(types, data, valid, sel, uid_map))
+    def per_shard(data, valid, sel, refs):
+        chunk = pipeline(_shard_chunk(types, data, valid, sel, uid_map,
+                                      refs))
         return merge_state(update(init_state(), chunk))
 
     # lint: disable=jit-hygiene -- signature-keyed: callers cache the
@@ -111,7 +119,8 @@ def make_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
     # the closure carries only schema metadata, never table arrays
     return jax.jit(shard_map_compat(
         per_shard, mesh=mesh,
-        in_specs=(_SPEC, _SPEC, _SPEC), out_specs=P(), check_vma=False,
+        in_specs=(_SPEC, _SPEC, _SPEC, P()), out_specs=P(),
+        check_vma=False,
     ))
 
 
@@ -119,7 +128,7 @@ def dist_agg_fragment(st: ShardedTable, stages: List, group_exprs, aggs,
                       domains: List[int], uid_map: Optional[Dict[str, str]] = None):
     """Compile + run (convenience; see make_agg_fragment for the cached path)."""
     fn = make_agg_fragment(st, stages, group_exprs, aggs, domains, uid_map)
-    return fn(st.data, st.valid, st.sel)
+    return fn(st.data, st.valid, st.sel, st.refs)
 
 
 # ---------------------------------------------------------------------------
@@ -200,14 +209,15 @@ def make_join_agg_fragment(
 ):
     """Compile hash-repartition join + partial agg, all on device.
 
-    Pipeline per shard: scan probe/build -> pushed filters -> eval join
-    keys -> all_to_all exchange both sides -> local unique-build-key join
-    -> post-join filter/project -> partial segment agg -> collective merge.
+    Pipeline per shard: scan probe/build -> fused FoR decode -> pushed
+    filters -> eval join keys -> all_to_all exchange both sides -> local
+    unique-build-key join -> post-join filter/project -> partial segment
+    agg -> collective merge.
 
-    Returns a jitted fn(p_data, p_valid, p_sel, b_data, b_valid, b_sel)
-    -> (state, overflow) — state is the merged [G] dict; overflow is the
-    total row count dropped by exchange capacity (must be 0; caller
-    re-runs with higher growth otherwise).
+    Returns a jitted fn(p_data, p_valid, p_sel, p_refs, b_data, b_valid,
+    b_sel, b_refs) -> (state, overflow) — state is the merged [G] dict;
+    overflow is the total row count dropped by exchange capacity (must
+    be 0; caller re-runs with higher growth otherwise).
     """
     p_pipe = make_pipeline_fn(probe_stages) if probe_stages else (lambda c: c)
     b_pipe = make_pipeline_fn(build_stages) if build_stages else (lambda c: c)
@@ -218,9 +228,12 @@ def make_join_agg_fragment(
     # capture metadata only — never the ShardedTables (see make_agg_fragment)
     probe_types, build_types = dict(probe.types), dict(build.types)
 
-    def per_shard(p_data, p_valid, p_sel, b_data, b_valid, b_sel):
-        pch = p_pipe(_shard_chunk(probe_types, p_data, p_valid, p_sel, probe_uids))
-        bch = b_pipe(_shard_chunk(build_types, b_data, b_valid, b_sel, build_uids))
+    def per_shard(p_data, p_valid, p_sel, p_refs,
+                  b_data, b_valid, b_sel, b_refs):
+        pch = p_pipe(_shard_chunk(probe_types, p_data, p_valid, p_sel,
+                                  probe_uids, p_refs))
+        bch = b_pipe(_shard_chunk(build_types, b_data, b_valid, b_sel,
+                                  build_uids, b_refs))
 
         pk, pkv = eval_expr(probe_key_ir, pch)
         bk, bkv = eval_expr(build_key_ir, bch)
@@ -266,12 +279,13 @@ def make_join_agg_fragment(
     # plan metadata only (types/mesh/keys), never the ShardedTables
     return jax.jit(shard_map_compat(
         per_shard, mesh=mesh,
-        in_specs=(_SPEC,) * 6, out_specs=(P(), P()), check_vma=False,
+        in_specs=(_SPEC, _SPEC, _SPEC, P(), _SPEC, _SPEC, _SPEC, P()),
+        out_specs=(P(), P()), check_vma=False,
     ))
 
 
 def dist_join_agg_fragment(probe: ShardedTable, build: ShardedTable, *args, **kwargs):
     """Compile + run (convenience; see make_join_agg_fragment)."""
     fn = make_join_agg_fragment(probe, build, *args, **kwargs)
-    return fn(probe.data, probe.valid, probe.sel,
-              build.data, build.valid, build.sel)
+    return fn(probe.data, probe.valid, probe.sel, probe.refs,
+              build.data, build.valid, build.sel, build.refs)

@@ -135,7 +135,8 @@ def _normalize_red_limbs(red, layout, aggs):
 
 @dataclass
 class _Source:
-    """A sharded scan input (3 fragment args: data, valid, sel)."""
+    """A sharded scan input (4 fragment args: data, valid, sel, refs —
+    refs carries the FoR bases of encoded staged columns, {} raw)."""
     scan: PScan
     stages: list
 
@@ -319,14 +320,19 @@ class _Compiler:
         self.sig.append(f"scan{idx}:{scan.table_name}:{stages!r}")
 
         def emit(env, growths):
-            data, valid, sel = env["scan"][idx]
+            from tidb_tpu.ops.segment_scan import decode_for
+
+            data, valid, sel, refs = env["scan"][idx]
             # the sharding carries every table column; take only the
-            # (pruned) scan schema
-            cols = {
-                uid_of[name]: Column(data=data[name][0], valid=valid[name][0],
-                                     type_=type_of[name])
-                for name in uid_of
-            }
+            # (pruned) scan schema. Encoded columns decode here, inside
+            # the compiled program (stored + ref, widened to the device
+            # repr), so only the narrow payload crossed the host boundary
+            cols = {}
+            for name in uid_of:
+                t = type_of[name]
+                d = decode_for(data[name][0], refs.get(name), t.np_dtype)
+                cols[uid_of[name]] = Column(data=d, valid=valid[name][0],
+                                            type_=t)
             return pipe(Chunk(cols, sel[0])), []
 
         return emit
@@ -803,8 +809,9 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
             env = {"scan": [], "bcast": []}
             i = 0
             for _ in range(n_src):
-                env["scan"].append((args[i], args[i + 1], args[i + 2]))
-                i += 3
+                env["scan"].append((args[i], args[i + 1], args[i + 2],
+                                    args[i + 3]))
+                i += 4
             for _ in range(n_bc):
                 env["bcast"].append((args[i], args[i + 1], args[i + 2]))
                 i += 3
@@ -820,7 +827,8 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
             return out, ovf
 
         out_spec = P() if out_kind == "segment" else P(_AXES)
-        in_specs = tuple([_SPEC, _SPEC, _SPEC] * n_src + [P(), P(), P()] * n_bc)
+        in_specs = tuple([_SPEC, _SPEC, _SPEC, P()] * n_src
+                         + [P(), P(), P()] * n_bc)
         # lint: disable=jit-hygiene -- signature-keyed: DistFragmentExec
         # caches build_fn(growths) under (sig, growths, shapes, types)
         # via ShardCache.get_fragment; the closure carries the compiled

@@ -28,7 +28,16 @@ __all__ = ["ShardedTable", "shard_table", "stream_batches", "table_bytes"]
 
 @dataclass
 class ShardedTable:
-    """Columns as [P, R] device arrays sharded on axis 0 of `mesh`."""
+    """Columns as [P, R] device arrays sharded on axis 0 of `mesh`.
+
+    With ``encode=True`` staging, integer-backed columns travel
+    frame-of-reference encoded: ``data[name]`` holds ``value - ref`` in
+    the narrowest of int8/int16/int32 that covers the column's valid
+    range, and ``refs[name]`` carries the int64 base. Fragment programs
+    decode (``stored + ref``, widened to the column's device repr)
+    INSIDE the compiled program, so the narrow bytes are all that cross
+    host→device — the columnar store's byte shrink applied to the
+    distributed staging path (ISSUE 9 satellite / ROADMAP 5a)."""
 
     mesh: Mesh
     n_parts: int
@@ -39,6 +48,10 @@ class ShardedTable:
     sel: jax.Array                  # [P, R] bool: live rows
     types: Dict[str, SQLType]
     dicts: Dict[str, object]        # string dictionaries (host-side)
+    # FoR bases for encoded columns (absent name = raw staging); np
+    # scalars passed to fragments as ARGS so per-batch bases never bake
+    # into a trace
+    refs: Dict[str, np.int64] = field(default_factory=dict)
     # process-unique, never-recycled id: cache keys built from it can never
     # alias a different sharding the way id()-based keys can after GC
     serial: int = field(default_factory=itertools.count().__next__)
@@ -56,8 +69,27 @@ def table_bytes(table, columns: Optional[List[str]] = None) -> int:
     return total + n  # + sel mask
 
 
+def _encode_staged(d: np.ndarray, v: np.ndarray, type_: SQLType):
+    """(stored, ref) when FoR staging pays for this column slice, else
+    (None, 0). Delegates the selection rule AND the NULL-pinning shift
+    to columnar.encoding.encode_column — the ONE encoder whose payloads
+    ops/segment_scan.decode_for decodes — keeping only the
+    did-it-actually-shrink guard local (the segment store accepts
+    same-width encodings; the staging path has nothing to gain)."""
+    from tidb_tpu.columnar.encoding import INT_BACKED_KINDS, encode_column
+
+    if type_.kind not in INT_BACKED_KINDS \
+            or not np.issubdtype(d.dtype, np.integer) \
+            or d.dtype.itemsize <= 1 or not v.any():
+        return None, 0
+    enc, stored = encode_column(d, v, type_)
+    if enc.kind != "for" or stored.dtype.itemsize >= d.dtype.itemsize:
+        return None, 0
+    return stored, enc.ref
+
+
 def stream_batches(table, mesh: Mesh, columns: Optional[List[str]],
-                   rows_per_part: int):
+                   rows_per_part: int, encode: bool = False):
     """Yield fixed-shape ShardedTable batches covering the whole table.
 
     The >HBM path (ref: SURVEY.md hard part 6 + the IndexLookUp double
@@ -71,14 +103,17 @@ def stream_batches(table, mesh: Mesh, columns: Optional[List[str]],
     for start in range(0, max(n, 1), rows_per_batch):
         yield shard_table(table, mesh, columns=columns,
                           rows_per_part=rows_per_part,
-                          row_range=(start, min(start + rows_per_batch, n)))
+                          row_range=(start, min(start + rows_per_batch, n)),
+                          encode=encode)
 
 
 def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
                 rows_per_part: Optional[int] = None,
-                row_range: Optional[tuple] = None) -> ShardedTable:
+                row_range: Optional[tuple] = None,
+                encode: bool = False) -> ShardedTable:
     """Partition a host Table (or a row range of it) across the mesh's
-    (dcn x shard) grid."""
+    (dcn x shard) grid. ``encode=True`` stages integer-backed columns
+    FoR-encoded in narrow dtypes (see ShardedTable.refs)."""
     n_parts = mesh.shape[dcn_axis] * mesh.shape[shard_axis]
     lo, hi = row_range if row_range is not None else (0, table.n)
     n = hi - lo
@@ -93,11 +128,17 @@ def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
     valid: Dict[str, jax.Array] = {}
     types: Dict[str, SQLType] = {}
     dicts: Dict[str, object] = {}
+    refs: Dict[str, np.int64] = {}
 
     host_cols = {}
     for name in names:
         info = table.schema.col(name)
         d, v = table.column_slice(name, lo, hi)
+        if encode:
+            stored, ref = _encode_staged(d, v, info.type_)
+            if stored is not None:
+                d = stored
+                refs[name] = np.int64(ref)
         buf = np.zeros((n_parts, R), dtype=d.dtype)
         vbuf = np.zeros((n_parts, R), dtype=np.bool_)
         host_cols[name] = (buf, vbuf, d, v)
@@ -131,4 +172,5 @@ def shard_table(table, mesh: Mesh, columns: Optional[List[str]] = None,
     return ShardedTable(
         mesh=mesh, n_parts=n_parts, rows_per_part=R, total_rows=n,
         data=data, valid=valid, sel=sel, types=types, dicts=dicts,
+        refs=refs,
     )

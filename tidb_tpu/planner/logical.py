@@ -75,6 +75,17 @@ class LProjection(LogicalPlan):
 CORE_AGGS = ("sum", "count", "avg", "min", "max")
 
 
+def core_generic_agg(group_exprs, aggs) -> bool:
+    """THE plan-static eligibility predicate for the device generic-agg
+    kernels (sort-based grouping): grouped, no DISTINCT, core funcs
+    only. One definition shared by the routing gates in
+    executor/builder.py, executor/pipeline.py and executor/aggregate.py
+    — context-dependent gates (tidb_enable_tpu_exec etc.) stay at the
+    call sites."""
+    return bool(group_exprs) and not any(a.distinct for a in aggs) \
+        and all(a.func in CORE_AGGS for a in aggs)
+
+
 @dataclass
 class AggSpec:
     uid: str

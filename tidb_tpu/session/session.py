@@ -877,6 +877,12 @@ class Session:
                 self.sysvars.get("tidb_tpu_segment_delta_rows")),
             columnar_spill_dir=str(
                 self.sysvars.get("tidb_tpu_columnar_spill_dir")),
+            pipeline_fuse=bool(self.sysvars.get("tidb_tpu_pipeline_fuse")),
+            prefetch_depth=int(
+                self.sysvars.get("tidb_tpu_pipeline_prefetch_depth")),
+            device_buffer_cache_bytes=int(
+                self.sysvars.get("tidb_tpu_device_buffer_cache_bytes")),
+            stage_encoded=bool(self.sysvars.get("tidb_tpu_stage_encoded")),
             cancel_check=self.cancel_reason,
         )
 

@@ -156,6 +156,24 @@ _reg(
     # directory for spilled segment files (empty = system tmp); cold
     # segments evicted under the statement memory budget land here
     SysVar("tidb_tpu_columnar_spill_dir", "", BOTH, "str"),
+    # -- pipelined device-resident execution (ISSUE 9) -----------------
+    # fuse scan->filter->project->partial-agg into ONE jitted program
+    # per fragment, accumulating agg state on device across chunks with
+    # a single fetch at finalize; off = the per-operator chunk pipeline
+    SysVar("tidb_tpu_pipeline_fuse", True, BOTH, "bool"),
+    # staging chunks the prefetch thread keeps in flight ahead of the
+    # compute loop (jax.device_put of chunk k+1 while k computes);
+    # 0 disables the thread and stages inline
+    SysVar("tidb_tpu_pipeline_prefetch_depth", 2, BOTH, "int",
+           min_=0, max_=16),
+    # byte budget of the cross-statement device buffer cache (staged
+    # scan inputs kept device-resident between statements, invalidated
+    # like the plan cache); 0 disables it. GLOBAL: one cache per process
+    SysVar("tidb_tpu_device_buffer_cache_bytes", 256 << 20, GLOBAL, "int",
+           min_=0, max_=1 << 40),
+    # stage fragment inputs as frame-of-reference-encoded narrow arrays
+    # (decode fused into the fragment program) instead of raw int64
+    SysVar("tidb_tpu_stage_encoded", True, BOTH, "bool"),
     # fixed device batch capacity (ref: tidb_max_chunk_size)
     SysVar("tidb_max_chunk_size", 1 << 16, BOTH, "int", min_=1 << 10, max_=1 << 24),
     # per-query host-side memory budget in bytes (ref: tidb_mem_quota_query)

@@ -110,6 +110,14 @@ class Catalog:
         pc = getattr(self, "plan_cache", None)
         if pc is not None:
             pc.on_schema_change(self._schema_version)
+        # the device buffer cache pins table objects the same way plan
+        # cache entries do — a schema change clears it just as eagerly
+        # (lazy import: the catalog must stay importable without jax)
+        import sys
+
+        pipe = sys.modules.get("tidb_tpu.executor.pipeline")
+        if pipe is not None:
+            pipe.DEVICE_CACHE.on_schema_change()
 
     def processlist_rows(self, viewer_user=None, with_state=False):
         """Live-session rows for SHOW PROCESSLIST and

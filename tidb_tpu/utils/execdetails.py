@@ -75,8 +75,12 @@ def analyze_text(root) -> str:
     The `start` column is each operator's first-activity offset from
     the earliest operator start (stats.first_ts), rendered with a
     proportional gutter — overlapping async fragment executors used to
-    render as if they ran sequentially."""
-    rows: List[Tuple[str, str, str, str, str]] = []
+    render as if they ran sequentially. The `staged` column counts the
+    chunks whose device buffers were already in place when the compute
+    loop asked (prefetch overlap + device-buffer-cache hits) out of the
+    chunks the operator staged — the observability face of the
+    pipelined staging path (ISSUE 9)."""
+    rows: List[Tuple[str, str, str, str, str, str]] = []
     anchor = min((e_ts for e_ts in _walk_first_ts(root)), default=None)
     span_total = 0.0
     if anchor is not None:
@@ -101,11 +105,13 @@ def analyze_text(root) -> str:
             start = "·" * pos + "|" + f" +{off * 1e6:.0f}us"
         else:
             start = "|"
+        staged = str(e.stats.staged) if e.stats.staged else "-"
         rows.append((
             indent + type(e).__name__.replace("Exec", ""),
             str(e.stats.rows),
             f"{total * 1e3:.1f}ms",
             start,
+            staged,
             f"open:{e.stats.open_wall * 1e3:.1f}ms own:{own * 1e3:.1f}ms "
             f"loops:{e.stats.chunks} dispatches:{own_disp}"
             + (f" recompiles:{own_rc}" if own_rc else "")
@@ -123,10 +129,12 @@ def analyze_text(root) -> str:
     w1 = max(len(r[1]) for r in rows) + 2
     w2 = max(len(r[2]) for r in rows) + 2
     w3 = max(len(r[3]) for r in rows) + 2
+    w4 = max(max(len(r[4]) for r in rows), len("staged")) + 2
     lines = [f"{'id':<{w0}}{'actRows':<{w1}}{'time':<{w2}}"
-             f"{'start':<{w3}}execution info"]
+             f"{'start':<{w3}}{'staged':<{w4}}execution info"]
     for r in rows:
-        lines.append(f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:<{w2}}{r[3]:<{w3}}{r[4]}")
+        lines.append(f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:<{w2}}{r[3]:<{w3}}"
+                     f"{r[4]:<{w4}}{r[5]}")
     return "\n".join(lines)
 
 

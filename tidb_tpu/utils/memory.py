@@ -17,12 +17,22 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 from typing import Callable, List, Optional
 
 from tidb_tpu.errors import ExecutionError
 
 __all__ = ["MemTracker", "QueryOOMError", "SpillFile", "SpillableRuns",
            "spill_root_of"]
+
+# One process-wide reentrant lock for tracker-tree accounting: the
+# pipeline staging thread (ISSUE 9) and the serving tier's concurrent
+# statements both consume() into shared parent trackers, and the
+# read-modify-write on `consumed` must not interleave across threads.
+# Reentrant because _on_exceed -> spill() re-enters release()/consume()
+# on the same thread. Spill I/O under the lock is acceptable: it only
+# happens past the budget, where correctness beats concurrency.
+_ACCOUNT_LOCK = threading.RLock()
 
 
 def spill_root_of(tracker: "MemTracker") -> "MemTracker":
@@ -68,14 +78,15 @@ class MemTracker:
         serving tier: operator state the statement never release()d
         (freed wholesale with the executor tree) must not leak into the
         session/server accounting forever."""
-        p, self.parent = self.parent, None
-        if p is None or self.consumed == 0:
-            return
-        n = self.consumed
-        node = p
-        while node is not None:
-            node.consumed -= n
-            node = node.parent
+        with _ACCOUNT_LOCK:
+            p, self.parent = self.parent, None
+            if p is None or self.consumed == 0:
+                return
+            n = self.consumed
+            node = p
+            while node is not None:
+                node.consumed -= n
+                node = node.parent
 
     def register_spillable(self, obj) -> None:
         self._spillables.append(obj)
@@ -85,19 +96,21 @@ class MemTracker:
             self._spillables.remove(obj)
 
     def consume(self, nbytes: int) -> None:
-        node = self
-        while node is not None:
-            node.consumed += nbytes
-            node.max_consumed = max(node.max_consumed, node.consumed)
-            if node.budget is not None and node.consumed > node.budget:
-                node._on_exceed()
-            node = node.parent
+        with _ACCOUNT_LOCK:
+            node = self
+            while node is not None:
+                node.consumed += nbytes
+                node.max_consumed = max(node.max_consumed, node.consumed)
+                if node.budget is not None and node.consumed > node.budget:
+                    node._on_exceed()
+                node = node.parent
 
     def release(self, nbytes: int) -> None:
-        node = self
-        while node is not None:
-            node.consumed -= nbytes
-            node = node.parent
+        with _ACCOUNT_LOCK:
+            node = self
+            while node is not None:
+                node.consumed -= nbytes
+                node = node.parent
 
     # ------------------------------------------------------------------
 

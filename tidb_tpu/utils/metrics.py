@@ -297,6 +297,29 @@ SPILL_SEGMENT_BYTES = Counter(
     "under the statement memory budget, in = re-materialized from "
     "disk on a later touch")
 
+# -- pipelined device-resident execution (ISSUE 9) --------------------------
+
+PIPELINE_PREFETCH_TOTAL = Counter(
+    "tidb_tpu_pipeline_prefetch_total",
+    "Chunk staging events through the double-buffered pipeline, by "
+    "outcome: hit (buffer was already staged when the compute loop "
+    "asked), wait (the loop blocked on in-flight staging), inline "
+    "(prefetch disabled or depth exhausted — staged synchronously), "
+    "cancelled (KILL/deadline stopped the staging thread mid-fragment), "
+    "error (staging died on quota OOM or another fault — relayed typed "
+    "to the compute loop)")
+PIPELINE_PREFETCH_BYTES = Counter(
+    "tidb_tpu_pipeline_prefetch_bytes_total",
+    "Host->device bytes moved by the pipeline staging thread ahead of "
+    "compute (double-buffered overlap; inline stagings count too)")
+DEVICE_CACHE_TOTAL = Counter(
+    "tidb_tpu_device_cache_total",
+    "Cross-statement device buffer cache events, by kind: hit (a warm "
+    "statement reused staged device buffers and moved zero bytes), "
+    "miss, evict (LRU under tidb_tpu_device_buffer_cache_bytes), "
+    "invalidate (table version/data_epoch/stats moved, or a schema "
+    "change cleared the cache — the plan cache's invalidation rules)")
+
 # -- distributed tracing (ISSUE 5) ------------------------------------------
 
 DCN_RPC_SECONDS = Histogram(
