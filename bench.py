@@ -807,6 +807,181 @@ def bench_pipeline(extra=None, sf=None, reps=None):
     return out
 
 
+def bench_probe(extra=None):
+    """Probe-kernel microbench (ISSUE 10): searchsorted vs the
+    open-addressing hash table over the (lo, hi) range contract the
+    joins consume, per build/probe size, on whatever backend is live
+    (the Pallas kernel rides along on TPU). CPU-runnable: the table
+    path is the TPU-shaped kernel exercised with XLA window scans, so
+    the regression is visible without a chip. Loud cross-check: the
+    table's match counts (and lo wherever the count is non-zero) must
+    equal searchsorted on every size — the chip-free half of the
+    probe-mode equivalence oracle. Folded here from the orphaned
+    ops/bench_probe.py so it runs (and is load-snapshotted) under the
+    same protocol as every other config."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tidb_tpu.ops import hash_probe as hp
+    from tidb_tpu.ops.segment_sum import pallas_enabled
+
+    if extra is not None:
+        wait_for_idle("probe_micro", extra)
+        extra["probe_micro_load"] = machine_load()
+    plat = __import__("jax").devices()[0].platform
+    out = {"platform": plat, "max_probes": hp.MAX_PROBES,
+           "counts_match": True, "sizes": []}
+    rng = np.random.default_rng(7)
+    for nb, npr in [(1 << 12, 1 << 20), (1 << 16, 1 << 20),
+                    (1 << 18, 1 << 21)]:
+        build = np.sort(rng.integers(0, 1 << 40, nb))
+        probes = rng.integers(0, 1 << 41, npr)
+        sh = jnp.asarray(build)
+        pr = jnp.asarray(probes)
+        row = {"build": nb, "probes": npr}
+
+        def timed(fn):
+            r = fn()
+            jax.block_until_ready(r)
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                best = min(best, time.perf_counter() - t0)
+            return best, r
+
+        t_ss, r_ss = timed(lambda: jax.jit(hp.xla_probe_ranges)(sh, pr))
+        row["searchsorted_s"] = round(t_ss, 5)
+        t_tab, r_tab = timed(
+            lambda: hp.probe_ranges(sh, pr, use_pallas=False))
+        row["table_xla_s"] = round(t_tab, 5)
+
+        def counts_ok(r):
+            c_ss = np.asarray(r_ss[1]) - np.asarray(r_ss[0])
+            c = np.asarray(r[1]) - np.asarray(r[0])
+            nz = c_ss > 0
+            return bool((c_ss == c).all()
+                        and (np.asarray(r[0])[nz]
+                             == np.asarray(r_ss[0])[nz]).all())
+
+        row["counts_match"] = counts_ok(r_tab)
+        if pallas_enabled():
+            t_pl, r_pl = timed(
+                lambda: hp.probe_ranges(sh, pr, use_pallas=True))
+            row["table_pallas_s"] = round(t_pl, 5)
+            row["pallas_counts_match"] = counts_ok(r_pl)
+            out["counts_match"] &= row["pallas_counts_match"]
+        out["counts_match"] &= row["counts_match"]
+        row["table_over_searchsorted"] = round(
+            t_ss / min(t_tab, row.get("table_pallas_s", t_tab)), 3)
+        out["sizes"].append(row)
+        log(f"# probe {nb}x{npr}: ss={t_ss * 1e3:.1f}ms "
+            f"table={t_tab * 1e3:.1f}ms "
+            f"({row['table_over_searchsorted']}x) "
+            f"match={row['counts_match']}")
+    if extra is not None:
+        extra["probe_micro"] = out
+    return out
+
+
+def bench_join_fused(extra=None, sf=None, reps=None):
+    """Fused scan→probe microbench (ISSUE 10): the Q18 fragment shape —
+    lineitem (probe, plain scan) joining orders (build) under a group
+    aggregate — on the LOCAL single-chip engine, fused
+    (one scan+probe+expand program per chunk, build side device-cached)
+    vs the chunk-synced classic tree (pipeline_fuse=0: scan dispatch +
+    probe dispatch + expand dispatch per chunk, build re-drained every
+    execution). Arms INTERLEAVED through the SAME session (machine
+    drift must not bias one arm); plan cache on so planning noise
+    cancels; eager-agg push-down off to pin the join shape under test.
+    Loud cross-checks: arms byte-identical to each other AND the sqlite
+    oracle, warm fused dispatches from the engine counter (the <= 12
+    acceptance budget), and probe-mode equivalence (searchsorted vs
+    hash table) result-hash equal on the SAME fused query."""
+    from tidb_tpu.executor.pipeline import DEVICE_CACHE
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.catalog import Catalog
+    from tidb_tpu.storage.tpch import load_tpch
+    from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+    from tidb_tpu.utils import dispatch as _dsp
+
+    sf = min(SF, 0.2) if sf is None else sf
+    reps = REPS if reps is None else reps
+    s = Session(catalog=Catalog(), chunk_capacity=CAP)
+    s.execute("SET tidb_slow_log_threshold = 300000")
+    s.execute("SET tidb_device_engine_mode = 'force'")
+    s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
+    # pin the Q18 join shape: eager aggregation would re-plan a partial
+    # agg below the join and the fragment under test would disappear
+    s.execute("SET tidb_opt_agg_push_down = 0")
+    counts = load_tpch(s.catalog, sf=sf, native=False)
+    rows = counts["lineitem"]
+    conn = mirror_to_sqlite(s.catalog, tables=["lineitem", "orders"])
+    sql = ("select o_orderpriority, count(*) as n, sum(l_quantity) as q "
+           "from lineitem join orders on l_orderkey = o_orderkey "
+           "group by o_orderpriority order by o_orderpriority")
+
+    def one(fuse: bool):
+        s.execute(f"SET tidb_tpu_pipeline_fuse = {int(fuse)}")
+        d0 = _dsp.count()
+        t0 = time.perf_counter()
+        got = s.query(sql)
+        return got, time.perf_counter() - t0, _dsp.count() - d0
+
+    DEVICE_CACHE.clear()
+    one(True)
+    one(True)  # second fill: jits traced, build + scan caches parked
+    one(False)
+    fused_best = classic_best = float("inf")
+    fused_disp = classic_disp = 0
+    fused_rows = classic_rows = None
+    for _ in range(max(reps, 2)):
+        fused_rows, dt, fused_disp = one(True)
+        fused_best = min(fused_best, dt)
+        classic_rows, dt, classic_disp = one(False)
+        classic_best = min(classic_best, dt)
+    s.execute("SET tidb_tpu_pipeline_fuse = 1")
+    ok_arms, msg = rows_equal(fused_rows, classic_rows, ordered=True)
+    want = conn.execute(sql).fetchall()
+    ok_oracle, msg2 = rows_equal(fused_rows, want, ordered=True)
+
+    # probe-mode equivalence on the SAME fused fragment: the hash-table
+    # path (the TPU-shaped kernel, runnable via XLA window scans on
+    # CPU) must hash-equal the searchsorted default on every run
+    s.execute("SET tidb_tpu_join_probe_mode = 'off'")
+    rows_off = s.query(sql)
+    s.execute("SET tidb_tpu_join_probe_mode = 'xla'")
+    rows_xla = s.query(sql)
+    s.execute("SET tidb_tpu_join_probe_mode = 'auto'")
+    modes_equal, mode_msg = rows_equal(rows_off, rows_xla, ordered=True)
+
+    out = {
+        "sf": sf, "lineitem_rows": rows,
+        "fused_warm_s": round(fused_best, 4),
+        "classic_warm_s": round(classic_best, 4),
+        "fused_over_classic": round(classic_best / fused_best, 3),
+        "fused_warm_dispatches": fused_disp,
+        "classic_warm_dispatches": classic_disp,
+        "rows_per_sec_fused": round(rows / fused_best, 1),
+        "hash_equal": bool(ok_arms),
+        "probe_modes_equal": bool(modes_equal),
+        "check": "ok" if ok_oracle else f"MISMATCH: {msg2}"[:300],
+    }
+    if not ok_arms:
+        out["arm_mismatch"] = str(msg)[:300]
+    if not modes_equal:
+        out["mode_mismatch"] = str(mode_msg)[:300]
+    log(f"# join fused: fused={fused_best * 1e3:.1f}ms "
+        f"({fused_disp} disp) classic={classic_best * 1e3:.1f}ms "
+        f"({classic_disp} disp) speedup={out['fused_over_classic']}x "
+        f"modes_equal={modes_equal} check={out['check']}")
+    conn.close()
+    if extra is not None:
+        extra["join_fused"] = out
+    return out
+
+
 def bench_zone_pruning(extra=None, sf=None, reps=None):
     """Zone-map pruning microbench (ISSUE 8): TPC-H Q6 over a
     time-ordered (l_shipdate-clustered) lineitem — the production
@@ -1235,6 +1410,22 @@ def main(locked_detail=("acquired", "acquired")):
         bench_pipeline(extra)
     except Exception as e:  # noqa: BLE001
         extra["pipeline_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # fused scan→probe microbench (ISSUE 10): the Q18 join fragment
+    # fused vs classic + probe-mode equivalence, dispatch budget
+    try:
+        log("# join fused microbench")
+        bench_join_fused(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["join_fused_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # probe-kernel microbench (ISSUE 10): searchsorted vs hash table,
+    # per backend — the TPU-vs-CPU join-kernel regression guard
+    try:
+        log("# probe kernel microbench")
+        bench_probe(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["probe_micro_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # zone-map pruning microbench (ISSUE 8): Q6 over time-ordered
     # lineitem, pruned vs unpruned, engine counters + exact oracle

@@ -192,6 +192,41 @@ def main():
             pl_bad["pipeline_q1_speedup"] = f"{pl_speed['q1']} < 0.9"
         pc_bad.extend(f"{k}={v}" for k, v in pl_bad.items())
 
+        # fused scan→probe FIXED floors (ISSUE 10). The Q18 fragment
+        # shape warm: <= 12 device dispatches (fused chunk programs +
+        # ONE window fetch + agg, build and staged scan device-cached)
+        # and >= 1.3x over the chunk-synced classic tree on CPU
+        # (best-of-3, interleaved arms — the fused win here is the
+        # cached build + single-dispatch chunks; on the tunneled TPU
+        # each saved dispatch is ~0.5s). Correctness floors hold EVERY
+        # run: arms + oracle byte-identical, and the hash-table probe
+        # (mode=xla — the TPU-shaped kernel run via XLA window scans)
+        # result-equal to searchsorted on the same fused fragment.
+        jfu_bad = {}
+        jfu_speed = 0.0
+        for _ in range(3):
+            jfu = bench.bench_join_fused({})
+            jfu_speed = max(jfu_speed, jfu["fused_over_classic"])
+            if jfu["fused_warm_dispatches"] > 12:
+                jfu_bad["join_fused_dispatches"] = (
+                    f"{jfu['fused_warm_dispatches']} > 12")
+            if not jfu["hash_equal"] or jfu["check"] != "ok":
+                jfu_bad["join_fused_oracle"] = jfu["check"]
+            if not jfu["probe_modes_equal"]:
+                jfu_bad["join_probe_mode_equivalence"] = (
+                    jfu.get("mode_mismatch", "table != searchsorted"))
+            if not jfu_bad and jfu_speed >= 1.3:
+                break
+        print(f"join_fused_speedup       {jfu_speed}  (need >= 1.3)")
+        if jfu_speed < 1.3:
+            jfu_bad["join_fused_speedup"] = f"{jfu_speed} < 1.3"
+        # probe-kernel counts oracle (chip-free half of the mode-
+        # equivalence proof): must match on every size, every run
+        pk = bench.bench_probe({})
+        if not pk["counts_match"]:
+            jfu_bad["probe_kernel_counts"] = "table counts != searchsorted"
+        pc_bad.extend(f"{k}={v}" for k, v in jfu_bad.items())
+
         # columnar segment store FIXED floors (ISSUE 8). Zone pruning:
         # TPC-H Q6 at SF1 over time-ordered lineitem must skip >= 50%
         # of segments (the ENGINE-reported counter), run >= 2x faster

@@ -12,11 +12,9 @@ records its error and leaves earlier results intact.
 Run solo (acquires the chip lock via bench.chip_lock).
 """
 
-import gc
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -74,16 +72,35 @@ def capture_q18(mesh, out):
     li = s.catalog.table("test", "lineitem")
     budget = max(1 << 20, table_bytes(li) // 4)
     try:
+        best_res = best
         s.execute(f"SET tidb_device_cache_bytes = {budget}")
         d0 = sd()
         rps_s, vs_s, best_s, check_s = bench.bench_query(
             s, sql, conn, lite or sql, counts["lineitem"], reps=2,
             extra=out, tag="q18_streamed")
+        engaged = sd() > d0
+        if not engaged:
+            # mirror bench.py: auto routing bypassed the fragment tier,
+            # so force the device engine for a true streamed/resident
+            # pair instead of recording a meaningless ratio
+            print("q18 streamed: forcing device engine for a true pair",
+                  flush=True)
+            s.execute("SET tidb_device_engine_mode = 'force'")
+            s.execute("SET tidb_device_cache_bytes = 8589934592")
+            _, _, best_res, _ = bench.bench_query(
+                s, sql, conn, lite or sql, counts["lineitem"], reps=2)
+            s.execute(f"SET tidb_device_cache_bytes = {budget}")
+            d0 = sd()
+            rps_s, vs_s, best_s, check_s = bench.bench_query(
+                s, sql, conn, lite or sql, counts["lineitem"], reps=2,
+                extra=out, tag="q18_streamed")
+            engaged = sd() > d0
+            s.execute("SET tidb_device_engine_mode = 'auto'")
         out["q18_streamed"] = {
             "rows_per_sec": round(rps_s, 1), "vs_sqlite": round(vs_s, 3),
             "budget_bytes": budget, "lineitem_bytes": table_bytes(li),
-            "engaged": bool(sd() > d0),
-            "overhead_vs_resident": round(best_s / best, 3),
+            "engaged": bool(engaged),
+            "overhead_vs_resident": round(best_s / best_res, 3),
             "check": check_s,
         }
         # marks a stale q18_streamed_error from an earlier half-failed
@@ -162,50 +179,16 @@ def missing_count(extra: dict) -> int:
 
 
 def main():
-    lock = bench.chip_lock()
-    if lock[0] == "unavailable":
-        # never start a TPU client while a live process holds the chip
-        # (overlapping clients wedge the tunnel — BASELINE.md r2)
-        print(f"chip lock {lock[1]}; aborting on-chip recapture")
-        bench.chip_unlock(lock[0])
-        sys.exit(3)
-    ok = True
-    try:
-        import jax
+    """Delegates to the hardened driver (scripts/q18_tpu_recapture.py):
+    this module keeps the capture functions + patch/missing_count as
+    the shared library, but there must be ONE recapture loop — the old
+    un-hardened loop here treated a single transient tunnel hiccup as
+    fatal for the rest of the run, exactly what the retry/backoff
+    driver fixes. Kept as an entry point so operator muscle memory and
+    the watchdog both land on the hardened behavior."""
+    import q18_tpu_recapture
 
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        from tidb_tpu.parallel import make_mesh
-
-        mesh = make_mesh()
-        have = json.load(open(os.path.join(REPO, "BENCH_tpu.json")))["extra"]
-        for metric, tag, fn in CONFIGS:
-            done = metric in have and f"{tag}_error" not in have
-            if tag == "q18":  # q18 is complete only WITH its streamed pair
-                done = done and "q18_streamed" in have \
-                    and "q18_streamed_error" not in have
-            if done:
-                print(f"{tag}: already captured; skipping", flush=True)
-                continue
-            out = {f"{tag}_recapture_ts": time.strftime("%Y-%m-%d %H:%M:%S"),
-                   f"{tag}_load_before": bench.machine_load()}
-            try:
-                fn(mesh, out)
-            except Exception as e:  # noqa: BLE001
-                out[f"{tag}_error"] = f"{type(e).__name__}: {e}"[:300]
-                ok = False
-            out[f"{tag}_load_after"] = bench.machine_load()
-            patch(out)
-            gc.collect()
-            if not ok:
-                break  # tunnel likely dead; let the watchdog re-probe
-        # success means EVERYTHING is captured (including q18_streamed,
-        # whose failure doesn't abort the q18 config)
-        have = json.load(open(os.path.join(REPO, "BENCH_tpu.json")))["extra"]
-        if missing_count(have):
-            ok = False
-    finally:
-        bench.chip_unlock(lock[0])
-    sys.exit(0 if ok else 1)
+    q18_tpu_recapture.main()
 
 
 if __name__ == "__main__":

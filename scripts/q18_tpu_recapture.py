@@ -1,11 +1,29 @@
 #!/usr/bin/env python
-"""Focused on-chip recapture of the Q18 config (+ streamed mode).
+"""On-chip recapture of EVERY config the tunnel has denied so far —
+Q18 (+streamed), SSB Q3.2, TPC-DS Q95 — with retry + backoff on the
+transient transport errors that killed them in BENCH_tpu.json.
 
-The full watchdog capture lost exactly one config to a transient tunnel
-error (`remote_compile: Unexpected EOF`); this retakes Q18 under the
-same protocol — chip lock held, load snapshots, sqlite oracle — and
-patches the result into BENCH_tpu.json in place of the error."""
+History: the round-4 captures lost these configs to mid-run tunnel
+deaths (`remote_compile: Unexpected EOF`, `UNAVAILABLE`) — remote
+compiles through the HTTP tunnel take minutes per program and the
+backend drops. The first version of this script retook ONLY Q18 and
+gave up on the first error; scripts/missing_configs_recapture.py then
+generalized it to every missing config but still treated one transient
+hiccup as fatal for the rest of the run. This hardened driver (ISSUE
+10) reuses those capture functions and adds the missing piece: an
+error that MATCHES the known-transient transport signatures is retried
+in place with exponential backoff (the tunnel usually comes back
+within a minute or two), while a non-transient failure records its
+error and moves on. Every successful config patches into
+BENCH_tpu.json immediately, in place of its error entry, so a later
+death never loses earlier results.
 
+Run solo (acquires the chip lock via bench.chip_lock). Env knobs:
+RECAPTURE_ATTEMPTS (default 3), RECAPTURE_BACKOFF_S (default 45,
+doubles per retry).
+"""
+
+import gc
 import json
 import os
 import sys
@@ -15,6 +33,96 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import bench  # noqa: E402
+from missing_configs_recapture import (  # noqa: E402
+    CONFIGS,
+    missing_count,
+    patch,
+)
+
+ATTEMPTS = max(1, int(os.environ.get("RECAPTURE_ATTEMPTS", "3")))
+BACKOFF_S = float(os.environ.get("RECAPTURE_BACKOFF_S", "45"))
+
+# the transport-failure signatures observed across BENCH_tpu rounds:
+# tunnel EOFs mid-remote-compile, gRPC UNAVAILABLE/DEADLINE flaps, and
+# plain socket drops. Anything else (OOM, a real engine error, an
+# oracle mismatch raised as an exception) is NOT retried — re-running
+# would burn the chip window on a deterministic failure.
+TRANSIENT_SIGNATURES = (
+    "UNAVAILABLE",
+    "remote_compile",
+    "Unexpected EOF",
+    "DEADLINE_EXCEEDED",
+    "Connection reset",
+    "Connection refused",
+    "Broken pipe",
+    "Socket closed",
+    "RPC failed",
+    "tunnel",
+)
+
+
+def is_transient(err: str) -> bool:
+    return any(sig.lower() in err.lower() for sig in TRANSIENT_SIGNATURES)
+
+
+def capture_with_retry(tag, fn, mesh):
+    """Run one config's capture, retrying transient transport errors
+    with exponential backoff. Returns the `out` dict to patch (carries
+    either the metrics or the final `<tag>_error`).
+
+    Two error surfaces are classified: exceptions raised by the capture
+    fn, AND `*_error` entries the fn recorded internally instead of
+    raising (capture_q18 swallows its q18_streamed half's failure so a
+    streamed hiccup can't lose the main config) — a transient error on
+    EITHER surface re-runs the whole config."""
+    backoff = BACKOFF_S
+
+    def retry_or_give_up(out, err, attempt):
+        """-> None to retry, else the final (out, False)."""
+        if not is_transient(err):
+            print(f"{tag}: non-transient failure, not retrying: {err}",
+                  flush=True)
+            return out, False
+        if attempt == ATTEMPTS:
+            print(f"{tag}: still transient after {ATTEMPTS} attempts: "
+                  f"{err}", flush=True)
+            return out, False
+        nonlocal backoff
+        print(f"{tag}: transient ({err}); retry {attempt + 1}/"
+              f"{ATTEMPTS} in {backoff:.0f}s", flush=True)
+        gc.collect()
+        time.sleep(backoff)
+        backoff *= 2
+        return None
+
+    final = None
+    for attempt in range(1, ATTEMPTS + 1):
+        out = {f"{tag}_recapture_ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+               f"{tag}_load_before": bench.machine_load()}
+        try:
+            fn(mesh, out)
+            out[f"{tag}_load_after"] = bench.machine_load()
+            if attempt > 1:
+                out[f"{tag}_recapture_attempts"] = attempt
+            # the fn may have recorded a swallowed sub-config error
+            # (q18_streamed) instead of raising: transient ones retry
+            # the whole config like an exception would have
+            recorded = [v for k, v in out.items() if k.endswith("_error")]
+            if not recorded:
+                return out, True
+            final = retry_or_give_up(out, str(recorded[0]), attempt)
+        except Exception as e:  # noqa: BLE001 — classified right below
+            err = f"{type(e).__name__}: {e}"[:300]
+            out[f"{tag}_error"] = err
+            out[f"{tag}_load_after"] = bench.machine_load()
+            final = retry_or_give_up(out, err, attempt)
+            if final is not None and attempt == ATTEMPTS \
+                    and is_transient(err):
+                out[f"{tag}_error"] = (
+                    f"transient after {ATTEMPTS} attempts: {err}"[:300])
+        if final is not None:
+            return final
+    return out, False  # unreachable (ATTEMPTS >= 1), belt-and-braces
 
 
 def main():
@@ -25,100 +133,42 @@ def main():
         print(f"chip lock {lock[1]}; aborting on-chip recapture")
         bench.chip_unlock(lock[0])
         sys.exit(3)
+    ok = True
     try:
-        extra = {}
-        extra["recapture_load_before"] = bench.machine_load()
-        import tidb_tpu  # noqa: F401
+        import jax
+
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         from tidb_tpu.parallel import make_mesh
-        from tidb_tpu.session import Session
-        from tidb_tpu.storage.tpch import load_tpch
-        from tidb_tpu.storage.tpch_queries import Q
-        from tidb_tpu.testutil import mirror_to_sqlite
 
-        sf = float(os.environ.get("BENCH_SF_Q18", "0.2"))
         mesh = make_mesh()
-        s = Session(chunk_capacity=1 << 20, mesh=mesh)
-        counts = load_tpch(s.catalog, sf=sf)
-        conn = mirror_to_sqlite(
-            s.catalog, tables=["lineitem", "orders", "customer"])
-        sql, lite = Q["q18"]
-        t0 = time.time()
-        rps, vs, best, check = bench.bench_query(
-            s, sql, conn, lite or sql, counts["lineitem"],
-            reps=int(os.environ.get("BENCH_REPS", "2")),
-            extra=extra, tag="q18")
-        print(f"q18: {rps:.1f} rows/s, {vs:.3f}x sqlite, check={check}, "
-              f"wall={time.time() - t0:.0f}s", flush=True)
-
-        # streamed mode on the real chip (same logic as bench.py)
-        from tidb_tpu.parallel.partition import table_bytes
-        from tidb_tpu.utils.metrics import FRAGMENT_DISPATCH
-
-        def sd():
-            return (FRAGMENT_DISPATCH.value(kind="general_segment_stream")
-                    + FRAGMENT_DISPATCH.value(kind="general_generic_stream"))
-
-        li = s.catalog.table("test", "lineitem")
-        li_bytes = table_bytes(li)
-        budget = max(1 << 20, li_bytes // 4)
-        best_res = best
-        s.execute(f"SET tidb_device_cache_bytes = {budget}")
-        d0 = sd()
-        rps_s, vs_s, best_s, check_s = bench.bench_query(
-            s, sql, conn, lite or sql, counts["lineitem"],
-            reps=int(os.environ.get("BENCH_REPS", "2")),
-            extra=extra, tag="q18_streamed")
-        engaged = sd() > d0
-        if not engaged:
-            # mirror bench.py: auto routing bypassed the fragment tier,
-            # so force the device engine for a true streamed/resident
-            # pair instead of recording a meaningless ratio
-            print("q18 streamed: forcing device engine for a true pair",
-                  flush=True)
-            s.execute("SET tidb_device_engine_mode = 'force'")
-            s.execute("SET tidb_device_cache_bytes = 8589934592")
-            _, _, best_res, _ = bench.bench_query(
-                s, sql, conn, lite or sql, counts["lineitem"],
-                reps=int(os.environ.get("BENCH_REPS", "2")))
-            s.execute(f"SET tidb_device_cache_bytes = {budget}")
-            d0 = sd()
-            rps_s, vs_s, best_s, check_s = bench.bench_query(
-                s, sql, conn, lite or sql, counts["lineitem"],
-                reps=int(os.environ.get("BENCH_REPS", "2")),
-                extra=extra, tag="q18_streamed")
-            engaged = sd() > d0
-            s.execute("SET tidb_device_engine_mode = 'auto'")
-        streamed = {
-            "rows_per_sec": round(rps_s, 1), "vs_sqlite": round(vs_s, 3),
-            "budget_bytes": budget, "lineitem_bytes": li_bytes,
-            "engaged": bool(engaged),
-            "overhead_vs_resident": round(best_s / best_res, 3),
-            "check": check_s,
-        }
-        print(f"q18_streamed: {streamed}", flush=True)
-        extra["recapture_load_after"] = bench.machine_load()
-
         path = os.path.join(REPO, "BENCH_tpu.json")
-        art = json.load(open(path))
-        art["extra"].pop("q18_error", None)
-        art["extra"].pop("q18_streamed_error", None)
-        art["extra"]["tpch_q18_rows_per_sec"] = round(rps, 1)
-        art["extra"]["q18_vs_sqlite"] = round(vs, 3)
-        art["extra"]["q18_sf"] = sf
-        art["extra"]["q18_recaptured"] = (
-            "transient tunnel error in the first pass; retaken solo "
-            "under the chip lock")
-        art["extra"]["q18_streamed"] = streamed
-        for k, v in extra.items():
-            art["extra"][k] = v
-        if "MISMATCH" in check:
-            art["extra"]["q18_check"] = check
-        tmp = path + ".patch"
-        json.dump(art, open(tmp, "w"))
-        os.replace(tmp, path)
-        print("BENCH_tpu.json patched", flush=True)
+        for metric, tag, fn in CONFIGS:
+            have = json.load(open(path))["extra"]
+            done = metric in have and f"{tag}_error" not in have
+            if tag == "q18":  # q18 is complete only WITH its streamed pair
+                done = done and "q18_streamed" in have \
+                    and "q18_streamed_error" not in have
+            if done:
+                print(f"{tag}: already captured; skipping", flush=True)
+                continue
+            out, captured = capture_with_retry(tag, fn, mesh)
+            patch(out)  # each success lands immediately, error entries
+            # are replaced in place (stale *_error keys stripped by
+            # patch's recaptured-marker scan)
+            gc.collect()
+            if not captured:
+                ok = False
+                if is_transient(out.get(f"{tag}_error", "")):
+                    # the tunnel outlived every backoff window: later
+                    # configs would pay the same dead transport — stop
+                    # and let the watchdog re-probe the chip
+                    break
+        have = json.load(open(path))["extra"]
+        if missing_count(have):
+            ok = False
     finally:
         bench.chip_unlock(lock[0])
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,37 @@
+"""Known-bad fixture for the fused scan→probe sync budget (ISSUE 10):
+the fused probe loop defers per-chunk match totals as device scalars
+and resolves ONE batched ``jax.device_get`` per window — a per-token
+fetch inside the window-drain loop re-creates exactly the per-chunk
+ping-pong the fused path exists to remove, and an un-annotated one must
+fail the host-sync pass.
+
+Expected violations: the two un-annotated probe-window loop fetches
+below (the per-token totals fetch and the per-window overflow-flag
+poll). The batched post-loop fetch is the sanctioned shape.
+"""
+
+import jax
+
+
+def drain_probe_window(tokens):
+    totals = []
+    for tok in tokens:
+        # BAD: one totals fetch per probe chunk — the deferral window
+        # exists so this is ONE batched fetch per PROBE_SYNC_CHUNKS
+        totals.append(jax.device_get(tok["total_dev"]))
+    return totals
+
+
+def poll_overflow_flags(windows):
+    overflowed = []
+    while windows:
+        w = windows.pop()
+        overflowed.append(jax.device_get(w.overflow))  # BAD: per window
+    return overflowed
+
+
+def finish_window_batched(tokens):
+    # OK: the fused contract — every queued chunk's total moves in one
+    # transfer after the launch loop completes
+    totals = jax.device_get([t["total_dev"] for t in tokens])
+    return [int(t) for t in totals]

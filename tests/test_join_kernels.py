@@ -198,6 +198,124 @@ class TestFullInt64DomainKeys:
         assert got == want
 
 
+class TestProbeModeEquivalence:
+    """ISSUE 10: tidb_tpu_join_probe_mode = xla/pallas routes the main
+    join's range lookup through the open-addressing hash table (the
+    TPU-shaped path, exercised here on CPU — same arithmetic Mosaic
+    compiles on chip). Every mode must answer EXACTLY like the
+    searchsorted default across the edge-case grid: NULL-key semi/anti,
+    dup-heavy multi-tile expansion, zero-row sides, full-int64-domain
+    keys, and shape-bucket boundaries."""
+
+    # sparse 40-bit keys defeat the direct-address index, so the table
+    # (or searchsorted) path genuinely runs; dense variants keep the
+    # direct index and prove mode is a no-op there
+    QUERIES = [
+        "select count(*) as n, sum(b.v) as sv, sum(p.w) as sw"
+        " from p join b on p.k = b.k",
+        "select count(*) from p where k in (select k from b)",
+        "select count(*) from p where k not in (select k from b)",
+        "select count(*) from p where not exists"
+        " (select 1 from b where b.k = p.k)",
+        "select count(*), count(b.v) from p left join b on p.k = b.k",
+    ]
+
+    def _fill(self, s, nb, npr, sparse=True, with_null=False, stride=64):
+        s.execute("create table b (k bigint, v bigint)")
+        s.execute("create table p (k bigint, w bigint)")
+        rng = np.random.default_rng(nb + npr)
+        mul = (1 << 40) if sparse else 1
+        if nb:
+            s.catalog.table("test", "b").insert_columns(
+                {"k": rng.integers(0, max(nb // 2, 1), nb) * mul,
+                 "v": np.arange(nb)})
+        if npr:
+            s.catalog.table("test", "p").insert_columns(
+                {"k": rng.integers(0, max(nb, 1) + stride, npr) * mul,
+                 "w": np.arange(npr)})
+        if with_null:
+            s.execute("insert into b values (null, -1)")
+            s.execute("insert into p values (null, -1)")
+
+    def _grid(self, fill):
+        results = {}
+        for mode in ("off", "xla", "pallas"):
+            s = _session(chunk_capacity=256)
+            s.execute(f"SET tidb_tpu_join_probe_mode = '{mode}'")
+            fill(s)
+            results[mode] = [sorted(s.query(q), key=str)
+                             for q in self.QUERIES]
+        assert results["xla"] == results["off"], "xla table != searchsorted"
+        assert results["pallas"] == results["off"], \
+            "pallas table != searchsorted"
+
+    def test_sparse_keys_with_nulls(self):
+        self._grid(lambda s: self._fill(s, 300, 1000, sparse=True,
+                                        with_null=True))
+
+    def test_dup_heavy_multi_tile(self):
+        # 3 keys x 50 dups x many probes: expansion overflows the
+        # per-dispatch tile budget under chunk_capacity=256
+        def fill(s):
+            s.execute("create table b (k bigint, v bigint)")
+            s.execute("create table p (k bigint, w bigint)")
+            bk = np.repeat(np.array([1, 2, 3]) * (1 << 40), 50)
+            s.catalog.table("test", "b").insert_columns(
+                {"k": bk, "v": np.arange(len(bk))})
+            pk = np.repeat(np.array([1, 2, 3, 99]) * (1 << 40), 40)
+            s.catalog.table("test", "p").insert_columns(
+                {"k": pk, "w": np.arange(len(pk))})
+        self._grid(fill)
+
+    def test_zero_row_sides(self):
+        self._grid(lambda s: self._fill(s, 0, 10))
+        self._grid(lambda s: self._fill(s, 10, 0))
+
+    def test_full_int64_domain(self):
+        def fill(s):
+            s.execute("create table b (k bigint, v bigint)")
+            s.execute("create table p (k bigint, w bigint)")
+            lo, hi = -(1 << 63), (1 << 63) - 1
+            s.execute(f"insert into b values ({lo},1),({hi},2),(7,3),"
+                      f"({hi},4)")
+            s.execute(f"insert into p values ({lo},10),({hi},20),(7,30),"
+                      f"(8,40)")
+        self._grid(fill)
+
+    def test_shape_bucket_boundaries(self):
+        for npr in (255, 256, 257):
+            self._grid(lambda s, npr=npr: self._fill(
+                s, 64, npr, sparse=True))
+
+    def test_mode_flip_mid_session_no_stale_plan(self):
+        """SET on a live session must re-route the NEXT statement: the
+        probe strategy is a jit static, so flipping the sysvar picks a
+        different compiled program, never a stale one."""
+        s = _session(chunk_capacity=128)
+        self._fill(s, 200, 800, sparse=True)
+        q = self.QUERIES[0]
+        want = s.query(q)
+        for mode in ("xla", "pallas", "off", "auto"):
+            s.execute(f"SET tidb_tpu_join_probe_mode = '{mode}'")
+            assert s.query(q) == want, mode
+
+    def test_mode_total_metric_moves(self):
+        from tidb_tpu.utils.metrics import JOIN_PROBE_MODE_TOTAL
+
+        def val(mode):
+            # the fused scan→probe path labels itself fused_<mode>;
+            # either surface proves the table path actually ran
+            return sum(v for lbl, v in JOIN_PROBE_MODE_TOTAL.samples()
+                       if lbl.get("mode") in (mode, f"fused_{mode}"))
+
+        s = _session(chunk_capacity=128)
+        self._fill(s, 200, 800, sparse=True)
+        s.execute("SET tidb_tpu_join_probe_mode = 'xla'")
+        c0 = val("xla")
+        s.query(self.QUERIES[0])
+        assert val("xla") > c0, "probe-mode counter did not move"
+
+
 class TestRetraceGuard:
     """Executing the same join twice must not move JOIN_COMPILE_TOTAL on
     the second run: the fused kernels take every query-specific value as

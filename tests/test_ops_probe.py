@@ -63,6 +63,57 @@ class TestProbeRanges:
         probes = rng.integers(0, 1 << 40, 1000)
         check(build, probes, use_pallas)
 
+    def test_full_int64_domain_keys(self, use_pallas):
+        """Keys at INT64_MIN/INT64_MAX and around zero: the mixed-hash
+        home/fingerprint arithmetic must be exact across the whole
+        domain (uint64 wraparound territory)."""
+        i64 = np.iinfo(np.int64)
+        build = np.array([i64.min, i64.min + 1, -1, 0, 1,
+                          i64.max - 1, i64.max, i64.max], dtype=np.int64)
+        probes = np.array([i64.min, i64.min + 2, -1, 0, 2,
+                           i64.max, i64.max - 1, 7], dtype=np.int64)
+        check(build, probes, use_pallas)
+
+    def test_sentinel_value_keys(self, use_pallas):
+        """0x7FFFFFFF-adjacent keys: values whose mixed fingerprint
+        could collide with the table's EMPTY sentinel are remapped
+        consistently on both sides (silent match loss otherwise)."""
+        build = np.array([0x7FFFFFFF, 0x7FFFFFFF, 0x7FFFFFFE, 0],
+                         dtype=np.int64)
+        check(build, build.copy(), use_pallas)
+
+    def test_capacity_boundary_builds(self, use_pallas):
+        """Build sizes straddling a pow2 capacity step: the table's
+        cap = next_pow2(2n) decision must stay exact at the edges."""
+        rng = np.random.default_rng(9)
+        for n in (7, 8, 9, 255, 256, 257):
+            build = rng.integers(0, 1 << 30, n) * 2654435761
+            probes = rng.integers(0, 1 << 30, 512) * 2654435761
+            check(build, probes, use_pallas)
+
+
+class TestModeResolution:
+    def test_resolve_mode_on_cpu(self):
+        # auto on a CPU target = searchsorted; explicit modes pass through
+        assert hp.resolve_mode("off") == "sorted"
+        assert hp.resolve_mode("auto") == "sorted"  # CPU-pinned tier-1
+        assert hp.resolve_mode("xla") == "xla"
+        assert hp.resolve_mode("pallas") == "pallas"
+
+    def test_resolve_mode_tracks_forced_platform(self):
+        from tidb_tpu.ops.segment_sum import force_platform
+
+        with force_platform("tpu"):
+            assert hp.resolve_mode("auto") == "xla"
+        assert hp.resolve_mode("auto") == "sorted"
+
+    def test_table_capacity_envelope(self):
+        assert hp.table_capacity(0) is None
+        assert hp.table_capacity(1) == 16
+        assert hp.table_capacity(1000) == 2048
+        assert hp.table_capacity(hp.MAX_CAPACITY // 2) == hp.MAX_CAPACITY
+        assert hp.table_capacity(hp.MAX_CAPACITY // 2 + 1) is None
+
 
 class TestJoinIntegration:
     """End-to-end fragment joins with the table probe forced on."""
@@ -75,10 +126,13 @@ class TestJoinIntegration:
         from tidb_tpu.utils import jitcache
 
         saved = hp._mode
-        hp.set_mode(mode)
         jitcache.clear()
         try:
             s = Session(chunk_capacity=1 << 14, mesh=make_mesh())
+            # the sysvar is THE knob now: every statement wires it into
+            # hash_probe.set_mode (a direct set_mode here would be
+            # clobbered by the session's next statement)
+            s.execute(f"set tidb_tpu_join_probe_mode = '{mode}'")
             s.execute("create table f (k bigint, v bigint)")
             s.execute("create table d (k bigint primary key, g bigint)")
             s.execute("insert into f values " + ",".join(
@@ -86,6 +140,12 @@ class TestJoinIntegration:
             s.execute("insert into d values " + ",".join(
                 f"({i}, {i % 7})" for i in range(53)))
             s.execute("set tidb_device_engine_mode = 'force'")
+            # the wiring is per-STATEMENT: the query below re-installs
+            # the session's mode right before its executors build (a
+            # background internal session — auto-analyze — may wire its
+            # own default in between, which is why no assert on the
+            # global here)
+            assert s.sysvars.get("tidb_tpu_join_probe_mode") == mode
             sql = ("select g, count(*), sum(v) from f join d on f.k = d.k "
                    "group by g order by g")
             got = s.query(sql)

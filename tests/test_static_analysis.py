@@ -189,6 +189,29 @@ class TestHostSyncFixture:
                    and "device_get" in v.message
                    for v in rep.violations), msgs
 
+    def test_probe_window_loop_fetch_is_flagged(self, tmp_path):
+        """ISSUE 10 satellite: the fused scan→probe module class — an
+        un-annotated per-token device_get inside the probe window-drain
+        loop fails the pass; the batched one-fetch-per-window form (the
+        fused deferral contract) stays clean."""
+        root = _mini_root(tmp_path, ("executor", "bad_probe_window_sync.py"))
+        rep, _ = _run_pass(root, HostSyncPass())
+        msgs = [v.render() for v in rep.violations]
+        assert len(rep.violations) == 2, msgs
+        assert all("device_get" in v.message for v in rep.violations), msgs
+        # exactly the per-token (line 21) and per-window (line 29) loop
+        # fetches — never the batched post-loop fetch at line 36
+        assert sorted(v.line for v in rep.violations) == [21, 29], msgs
+
+    def test_fused_probe_module_is_clean(self, real_tree_reports):
+        """The real fused-probe implementation (executor/pipeline.py)
+        carries zero unsuppressed host-sync violations — its one window
+        fetch sits outside the launch loop, per the budget."""
+        hs = [r for r in real_tree_reports if r.pass_id == "host-sync"][0]
+        pipeline = [v for v in hs.violations
+                    if v.path.endswith("executor/pipeline.py")]
+        assert not pipeline, [v.render() for v in pipeline]
+
 
 class TestLockDisciplineFixture:
     def test_cycle_is_flagged(self, tmp_path):

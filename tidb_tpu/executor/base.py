@@ -97,6 +97,10 @@ class ExecContext:
     # [T, C] buffer a many-many join materializes per dispatch
     # (tidb_tpu_join_tiles_per_dispatch sysvar)
     join_tiles: int = 8
+    # probe strategy for the device join: off = searchsorted, auto =
+    # hash table on TPU / searchsorted on CPU, xla/pallas force the
+    # open-addressing table (tidb_tpu_join_probe_mode sysvar)
+    join_probe_mode: str = "auto"
     # rows above which a fragment build side refuses to replicate and
     # the query falls back single-chip (tidb_broadcast_join_threshold_count)
     broadcast_rows_limit: int = 1 << 21

@@ -119,6 +119,92 @@ def _try_fused_scan_agg(plan: PHashAgg):
         fallback_build=fallback)
 
 
+def _build_hash_join(plan: PHashJoin) -> HashJoinExec:
+    """The classic pull-based hash-join tree (also the fused path's
+    open()-time fallback delegate)."""
+    probe_idx = 1 - plan.build_side
+    probe_plan = plan.children[probe_idx]
+    build_plan = plan.children[plan.build_side]
+    probe_keys = plan.eq_left if probe_idx == 0 else plan.eq_right
+    build_keys = plan.eq_right if plan.build_side == 1 else plan.eq_left
+    # semi/anti joins need no build payload — unless an other_cond must
+    # evaluate build columns during the probe, and then only those
+    if plan.kind in ("semi", "anti"):
+        if plan.other_cond is None:
+            build_payload_schema = []
+        else:
+            from tidb_tpu.expression.expr import ColumnRef, walk
+
+            refs = {n.name for n in walk(plan.other_cond)
+                    if isinstance(n, ColumnRef)}
+            build_payload_schema = [c for c in build_plan.schema
+                                    if c.uid in refs]
+    else:
+        build_payload_schema = list(build_plan.schema)
+    return HashJoinExec(
+        plan.schema,
+        build_executor(probe_plan),
+        build_executor(build_plan),
+        plan.kind,
+        probe_keys,
+        build_keys,
+        other_cond=plan.other_cond,
+        probe_schema=list(probe_plan.schema),
+        build_schema=build_payload_schema,
+        exists_sem=plan.exists_sem,
+    )
+
+
+def _try_fused_scan_probe(plan: PHashJoin):
+    """Inner hash join whose probe side peels to a PLAIN table scan
+    pipeline runs as a fused scan→probe fragment (ISSUE 10): one jitted
+    decode+filter+project+probe+expand program per staged probe chunk,
+    the build side device-resident (and device-buffer-cached when it is
+    itself a plain scan over a stored table). Plan-STATIC gates decide
+    here — outer/semi/anti kinds, other_cond, and multi-key joins
+    (whose packing can fall into hash mode and need the classic tree's
+    exact re-verification) keep the classic tree with its per-operator
+    EXPLAIN ANALYZE breakdown; ctx-dependent gates (sysvars,
+    device-engine routing) defer to the open()-time delegate."""
+    from tidb_tpu.executor.pipeline import FusedScanProbeExec
+
+    if plan.kind != "inner" or plan.other_cond is not None:
+        return None
+    probe_idx = 1 - plan.build_side
+    probe_plan = plan.children[probe_idx]
+    build_plan = plan.children[plan.build_side]
+    probe_keys = plan.eq_left if probe_idx == 0 else plan.eq_right
+    build_keys = plan.eq_right if plan.build_side == 1 else plan.eq_left
+    if len(probe_keys) != 1 or len(build_keys) != 1:
+        return None
+    stages, base = peel_stages(probe_plan)
+    if type(base) is not PScan or base.table is None:
+        return None
+    # build-side cache eligibility: only a plain scan pipeline over a
+    # stored table proves a parked build current via table_ident; the
+    # tag carries the peeled plan's full shape (incl. literal values —
+    # a plan-cache hit patches literals before the builder runs)
+    bstages, bbase = peel_stages(build_plan)
+    build_table = bbase.table if type(bbase) is PScan else None
+    build_tag = None
+    if build_table is not None:
+        build_tag = repr((bstages, getattr(bbase, "pushed_cond", None),
+                          build_keys,
+                          tuple(c.uid for c in build_plan.schema)))
+
+    def fallback(plan=plan):
+        return _build_hash_join(plan)
+
+    return FusedScanProbeExec(
+        plan.schema, base.schema, base.table,
+        scan_stages_for(base, stages), scan_prune_bounds(base),
+        list(probe_plan.schema), probe_keys, build_keys,
+        list(build_plan.schema),
+        build_child_build=lambda: build_executor(build_plan),
+        build_table=build_table, build_tag=build_tag,
+        fallback_build=fallback)
+
+
 def build_executor(plan: PhysicalPlan) -> Executor:
     # pipeline fusion: Selection/Projection chains over a scan
     stages, base = peel_stages(plan)
@@ -208,35 +294,10 @@ def build_executor(plan: PhysicalPlan) -> Executor:
             plan.other_cond,
         )
     if isinstance(plan, PHashJoin):
-        probe_idx = 1 - plan.build_side
-        probe_plan = plan.children[probe_idx]
-        build_plan = plan.children[plan.build_side]
-        probe_keys = plan.eq_left if probe_idx == 0 else plan.eq_right
-        build_keys = plan.eq_right if plan.build_side == 1 else plan.eq_left
-        # semi/anti joins need no build payload — unless an other_cond must
-        # evaluate build columns during the probe, and then only those
-        if plan.kind in ("semi", "anti"):
-            if plan.other_cond is None:
-                build_payload_schema = []
-            else:
-                from tidb_tpu.expression.expr import ColumnRef, walk
-
-                refs = {n.name for n in walk(plan.other_cond) if isinstance(n, ColumnRef)}
-                build_payload_schema = [c for c in build_plan.schema if c.uid in refs]
-        else:
-            build_payload_schema = list(build_plan.schema)
-        return HashJoinExec(
-            plan.schema,
-            build_executor(probe_plan),
-            build_executor(build_plan),
-            plan.kind,
-            probe_keys,
-            build_keys,
-            other_cond=plan.other_cond,
-            probe_schema=list(probe_plan.schema),
-            build_schema=build_payload_schema,
-            exists_sem=plan.exists_sem,
-        )
+        fused = _try_fused_scan_probe(plan)
+        if fused is not None:
+            return fused
+        return _build_hash_join(plan)
     if isinstance(plan, PSort):
         return SortExec(plan.schema, build_executor(plan.child), plan.items)
     if isinstance(plan, PWindow):

@@ -315,6 +315,29 @@ class HashJoinExec(Executor):
             packed = packed + (self._np_as_int64(k, mode) - lo) * stride
         return packed
 
+    def _resolve_probe_table(self) -> int:
+        """Resolve the probe strategy (tidb_tpu_join_probe_mode via
+        hash_probe.resolve_mode — trace-time platform aware) and build
+        the open-addressing table ONCE over the staged sorted keys when
+        the table path is selected. Dense packed domains keep the O(1)
+        direct-address index instead (it beats any hash walk), and
+        over-capacity builds fall back to searchsorted. Returns the
+        table's resident bytes for the memory tracker."""
+        from tidb_tpu.ops import hash_probe as hp
+
+        self._probe_mode = hp.resolve_mode(
+            getattr(self.ctx, "join_probe_mode", "off"))
+        self._probe_table = None
+        if self._probe_mode == "sorted" or self._direct:
+            self._probe_mode = "sorted"
+            return 0
+        t = jk.build_hash_table(self._sorted_keys)
+        if t is None:  # build side exceeds the VMEM capacity envelope
+            self._probe_mode = "sorted"
+            return 0
+        self._probe_table = t
+        return int(sum(a.nbytes for a in t[:3]))
+
     def _set_probe_pack_params(self, nk: int) -> None:
         """Device copies of the pack parameters the probe kernel takes
         as traced args (modes stay static)."""
@@ -383,6 +406,7 @@ class HashJoinExec(Executor):
             self._firsts = jnp.zeros(2, dtype=jnp.int64)
             self._direct_lo = self._direct_rng = 0
         nbytes += self._firsts.nbytes
+        nbytes += self._resolve_probe_table()
         dsp.record(n_staged, site="stage")
         return nbytes
 
@@ -442,6 +466,7 @@ class HashJoinExec(Executor):
         # ARGS, never closure state — see _match_filter)
         self._build_keyvals_dev = out_k if self._hash_mode else ()
         nbytes = sorted_keys.nbytes + self._firsts.nbytes
+        nbytes += self._resolve_probe_table()
         for d, v in zip(out_d, out_v):
             nbytes += d.nbytes + v.nbytes
         for k in self._build_keyvals_dev:
@@ -636,6 +661,9 @@ class HashJoinExec(Executor):
         return packed, valid & np.asarray(chunk.sel), in_r
 
     def _process_probe_chunk_np(self, chunk: Chunk):
+        from tidb_tpu.utils.metrics import JOIN_PROBE_MODE_TOTAL
+
+        JOIN_PROBE_MODE_TOTAL.inc(mode="host")
         packed, ok, in_r = self._np_probe_keys(chunk)
         if self._firsts_np is not None:
             # dense packed domain: O(1) gathers into the radix histogram
@@ -758,7 +786,8 @@ class HashJoinExec(Executor):
             sel, self._los, self._strides, self._rngs,
             self._firsts, self._direct_lo, self._direct_rng,
             modes=self._modes, hash_mode=self._hash_mode,
-            left_pad=left_pad, direct=self._direct)
+            left_pad=left_pad, direct=self._direct,
+            table=self._probe_table, probe=self._probe_mode)
 
         if self.kind in ("semi", "anti") and not has_filter:
             if Rp != cap:

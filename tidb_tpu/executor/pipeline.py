@@ -30,6 +30,14 @@ executor spine into a push-based, device-resident pipeline:
     segment store generation, so DML, DDL, ANALYZE and TRUNCATE all
     invalidate. A warm TPC-H Q1/Q6 re-run stages nothing.
 
+ISSUE 10 extends fusion past aggregation roots: ``FusedScanProbeExec``
+runs an inner hash join's probe side — decode + filter + project + key
+pack + probe + first-tile expansion — as ONE jitted program per staged
+chunk against a device-resident build table, with the build side itself
+parked in the ``DeviceBufferCache`` so a warm repeated join stages and
+sorts nothing. See the class docstring for the overflow/deferral
+contract.
+
 Glue (finalize, result decode) still runs under ``host_eager`` like the
 rest of the executor tier; the staging device is pinned in the MAIN
 thread (the prefetch thread does not inherit jax's thread-local default
@@ -39,19 +47,25 @@ device) so buffers always land where the fused program runs.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from tidb_tpu.chunk.chunk import Chunk
+from tidb_tpu.chunk.column import Column
 from tidb_tpu.executor.aggregate import HashAggExec, make_segment_kernel
-from tidb_tpu.executor.base import ExecContext, raise_if_cancelled
+from tidb_tpu.executor.base import ExecContext, Executor, raise_if_cancelled
+from tidb_tpu.executor.join import HashJoinExec
+from tidb_tpu.ops import join_kernels as jk
 from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.utils.memory import QueryOOMError
 
 __all__ = ["DEVICE_CACHE", "DeviceBufferCache", "ChunkPrefetcher",
-           "FusedScanAggExec", "table_ident"]
+           "FusedScanAggExec", "FusedScanProbeExec", "table_ident"]
 
 
 def table_ident(table) -> tuple:
@@ -364,80 +378,26 @@ def _make_fused_generic_fn(stages, col_types, group_exprs, aggs,
     return run
 
 
-class FusedScanAggExec(HashAggExec):
-    """HashAgg whose child is a fusible scan pipeline, executed as a
-    push-based device-resident fragment: staged inputs stream through
-    ONE jitted program per chunk and the aggregation state never visits
-    the host until finalize. Falls back to the classic pull-based tree
-    (``fallback_build``) when the context disables fusion or the
-    aggregate shape needs the host paths (DISTINCT, non-core funcs,
-    ``tidb_enable_tpu_exec`` off for generic strategy)."""
-
-    def __init__(self, schema, scan_schema, table, stages, prune_bounds,
-                 group_exprs, group_uids, aggs, strategy,
-                 segment_sizes=None, fallback_build=None):
-        super().__init__(schema, None, group_exprs, group_uids, aggs,
-                         strategy, segment_sizes=segment_sizes)
-        self.children = []
-        self.scan_schema = scan_schema
-        self.table = table
-        self.scan_stages = stages
-        self.prune_bounds = prune_bounds
-        self._fallback_build = fallback_build
-        self._delegate = None
-        self._pin = None
-        self._prefetcher = None
-        self._seg_cap = None
-
-    # -- lifecycle ---------------------------------------------------------
-
-    def open(self, ctx: ExecContext) -> None:
-        self.ctx = ctx
-        self._out = []
-        self._emitted = False
-        self._delegate = None
-        if not self._fuse_eligible(ctx):
-            d = self._fallback_build()
-            d.open(ctx)
-            self._delegate = d
-            return
-        try:
-            if self.strategy == "segment":
-                self._run_segment_fused()
-            else:
-                self._run_generic_fused()
-        finally:
-            self._release_staging()
-
-    def next(self):
-        if self._delegate is not None:
-            return self._delegate.next()
-        return super().next()
-
-    def close(self) -> None:
-        if self._delegate is not None:
-            self._delegate.close()
-            self._delegate = None
-        self._release_staging()
-        super().close()
+class _StagedScanMixin:
+    """The scan side of a fused fragment, shared by ``FusedScanAggExec``
+    and ``FusedScanProbeExec``: plan the ordered chunk staging schedule
+    (packed columnar segments with zone-map pruning, raw slices for the
+    uncovered tail), stream the staged device pytrees through the
+    prefetcher, and ride the cross-statement ``DeviceBufferCache``.
+    Requires ``table``, ``scan_schema``, ``prune_bounds``, ``ctx``,
+    ``stats``, and the ``_pin``/``_prefetcher``/``_seg_cap`` slots."""
 
     def _release_staging(self) -> None:
+        it = getattr(self, "_staged_iter", None)
+        if it is not None:
+            it.close()  # runs the generator's finally (fill release)
+            self._staged_iter = None
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
         if self._pin is not None:
             self._pin.close()
             self._pin = None
-
-    def _fuse_eligible(self, ctx: ExecContext) -> bool:
-        if not getattr(ctx, "pipeline_fuse", True) or self.table is None:
-            return False
-        if self.strategy == "segment":
-            return True
-        from tidb_tpu.planner.logical import core_generic_agg
-
-        return ctx.device_agg and core_generic_agg(self.group_exprs,
-                                                   self.aggs)
 
     # -- staging plan ------------------------------------------------------
 
@@ -600,7 +560,7 @@ class FusedScanAggExec(HashAggExec):
             # identity, bounds, capacities), so folding it into the
             # key would turn every DML into a silent key change (stale
             # entry leaks until LRU) instead of a counted invalidation
-            tag = ("scanagg",
+            tag = ("scanstage",
                    tuple((c.uid, c.name) for c in self.scan_schema),
                    ctx.chunk_capacity, self._seg_cap,
                    repr(self.prune_bounds))
@@ -657,6 +617,74 @@ class FusedScanAggExec(HashAggExec):
         finally:
             fill_tracker.release(nbytes)
 
+
+class FusedScanAggExec(_StagedScanMixin, HashAggExec):
+    """HashAgg whose child is a fusible scan pipeline, executed as a
+    push-based device-resident fragment: staged inputs stream through
+    ONE jitted program per chunk and the aggregation state never visits
+    the host until finalize. Falls back to the classic pull-based tree
+    (``fallback_build``) when the context disables fusion or the
+    aggregate shape needs the host paths (DISTINCT, non-core funcs,
+    ``tidb_enable_tpu_exec`` off for generic strategy)."""
+
+    def __init__(self, schema, scan_schema, table, stages, prune_bounds,
+                 group_exprs, group_uids, aggs, strategy,
+                 segment_sizes=None, fallback_build=None):
+        super().__init__(schema, None, group_exprs, group_uids, aggs,
+                         strategy, segment_sizes=segment_sizes)
+        self.children = []
+        self.scan_schema = scan_schema
+        self.table = table
+        self.scan_stages = stages
+        self.prune_bounds = prune_bounds
+        self._fallback_build = fallback_build
+        self._delegate = None
+        self._pin = None
+        self._prefetcher = None
+        self._seg_cap = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self, ctx: ExecContext) -> None:
+        self.ctx = ctx
+        self._out = []
+        self._emitted = False
+        self._delegate = None
+        if not self._fuse_eligible(ctx):
+            d = self._fallback_build()
+            d.open(ctx)
+            self._delegate = d
+            return
+        try:
+            if self.strategy == "segment":
+                self._run_segment_fused()
+            else:
+                self._run_generic_fused()
+        finally:
+            self._release_staging()
+
+    def next(self):
+        if self._delegate is not None:
+            return self._delegate.next()
+        return super().next()
+
+    def close(self) -> None:
+        if self._delegate is not None:
+            self._delegate.close()
+            self._delegate = None
+        self._release_staging()
+        super().close()
+
+    def _fuse_eligible(self, ctx: ExecContext) -> bool:
+        if not getattr(ctx, "pipeline_fuse", True) or self.table is None:
+            return False
+        if self.strategy == "segment":
+            return True
+        from tidb_tpu.planner.logical import core_generic_agg
+
+        return ctx.device_agg and core_generic_agg(self.group_exprs,
+                                                   self.aggs)
+
     # -- fused execution ---------------------------------------------------
 
     def _run_segment_fused(self):
@@ -706,3 +734,362 @@ class FusedScanAggExec(HashAggExec):
             raise_if_cancelled(ctx)  # see _run_segment_fused
             stack.push(fused(*staged))
         self._finalize_group_tables(stack.tables())
+
+
+# ---------------------------------------------------------------------------
+# fused scan→probe programs (ISSUE 10: fusion past aggregation roots)
+# ---------------------------------------------------------------------------
+
+
+def _make_fused_probe_fn(stages, col_types, key_ir, key_mode, probe_uids,
+                         direct: bool, probe: str, seg_cap: Optional[int]):
+    """(staged scan inputs, build arrays) -> (first output tile, totals,
+    probe state): decode + filter + project + key pack + probe range
+    lookup + count + prefix sum + first-tile expansion as ONE program.
+
+    The expansion emits a single FIXED-capacity tile (the chunk's own
+    capacity) inside the same dispatch — for the workhorse PK-FK shape
+    (Q18's lineitem→orders) every probe row matches at most once, so the
+    whole chunk's output fits and the chunk completes in ONE device
+    round trip. The on-device ``total`` doubles as the overflow flag:
+    the caller's batched window fetch reads it, and only chunks whose
+    expansion overflowed the in-program tile pay classic ``expand_tiles``
+    dispatches for the remainder. The probe's range lookup runs through
+    ``probe_ranges_any`` — the SAME traced step as the standalone
+    probe kernel (direct-address index / open-addressing table /
+    searchsorted), so the fused and classic paths cannot drift."""
+    from tidb_tpu.expression.compiler import eval_expr
+    from tidb_tpu.ops.segment_scan import make_segment_scan_fn
+
+    scan_fn = make_segment_scan_fn(stages, col_types, seg_stride=seg_cap)
+
+    def run(data, valid, refs, sel, sorted_keys, n_build, firsts,
+            lo_packed, rng_packed, tkeys, tlos, this, tok,
+            b_datas, b_valids):
+        ch = _barrier_chunk(scan_fn(data, valid, refs, sel))
+        kd, kv = eval_expr(key_ir, ch)
+        packed = jk.as_int64_key(kd, key_mode)
+        ok = kv & ch.sel
+        start, end, in_range = jk.probe_ranges_any(
+            sorted_keys, n_build, packed, firsts, lo_packed, rng_packed,
+            tkeys, tlos, this, tok, direct, probe)
+        count = jnp.where(ok & in_range, end - start, 0)
+        cum = jnp.cumsum(count)
+        total = cum[-1]
+        R = packed.shape[0]
+        B = sorted_keys.shape[0]
+        valid_out, probe_row, build_pos, _k = jk.tile_positions(
+            start, count, cum, 0, R, R, B)
+        p_cols = tuple((ch.columns[u].data, ch.columns[u].valid)
+                       for u in probe_uids)
+        out_p = tuple((jnp.take(d, probe_row, mode="clip"),
+                       jnp.take(v, probe_row, mode="clip") & valid_out)
+                      for d, v in p_cols)
+        out_b = tuple((jnp.take(d, build_pos, mode="clip"),
+                       jnp.take(v, build_pos, mode="clip") & valid_out)
+                      for d, v in zip(b_datas, b_valids))
+        return out_p, out_b, valid_out, total, start, count, cum, p_cols
+
+    return run
+
+
+class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
+    """Inner hash join whose probe side is a plain scan pipeline, run
+    as a push-based device fragment (ISSUE 10): each staged probe chunk
+    streams through ONE jitted scan→probe→expand program against a
+    device-resident build table, cutting the classic tree's per-chunk
+    scan dispatch + probe dispatch + expand dispatch(es) to a single
+    round trip for the PK-FK shape. Per-chunk match totals stay on
+    device and resolve in one batched fetch per deferral window
+    (PROBE_SYNC_CHUNKS), exactly like the classic probe's deferral —
+    the fused path adds no per-chunk host syncs.
+
+    The build side runs the classic ``HashJoinExec`` build (drain +
+    pack + sort + direct/hash index) and — when the build child is
+    itself a plain scan over a stored table — parks the finished device
+    arrays in the cross-statement ``DeviceBufferCache`` keyed by the
+    build plan's shape and proven current by ``table_ident``, so a warm
+    repeated join stages and sorts NOTHING. Ineligible contexts
+    (fusion/device engine off) fall back to the classic tree through
+    the open()-time ``fallback_build`` delegate, like
+    ``FusedScanAggExec``."""
+
+    def __init__(self, schema, scan_schema, table, stages, prune_bounds,
+                 probe_schema, probe_keys, build_keys, build_schema,
+                 build_child_build, build_table=None, build_tag=None,
+                 fallback_build=None):
+        Executor.__init__(self, schema, [])
+        self.kind = "inner"
+        self.probe_keys = probe_keys
+        self.build_keys = build_keys
+        self.other_cond = None
+        self.probe_schema = probe_schema
+        self.build_schema = build_schema
+        self.exists_sem = False
+        self.scan_schema = scan_schema
+        self.table = table
+        self.scan_stages = stages
+        self.prune_bounds = prune_bounds
+        self._build_child_build = build_child_build
+        self._build_cache_table = build_table
+        self._build_cache_tag = build_tag
+        self._fallback_build = fallback_build
+        self._delegate = None
+        self._pin = None
+        self._prefetcher = None
+        self._staged_iter = None
+        self._seg_cap = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self, ctx: ExecContext) -> None:
+        self.ctx = ctx
+        self._delegate = None
+        self._pending: List[Chunk] = []
+        self._drained = False
+        if not self._fuse_eligible(ctx):
+            d = self._fallback_build()
+            d.open(ctx)
+            self._delegate = d
+            return
+        try:
+            self._open_build(ctx)
+            jobs = self._plan_staging(ctx)
+            self._fused_fn = self._make_fused()
+            self._staged_iter = self._staged_chunks(jobs)
+        except BaseException:
+            self._release_staging()
+            raise
+
+    def next(self) -> Optional[Chunk]:
+        if self._delegate is not None:
+            return self._delegate.next()
+        while True:
+            if self._pending:
+                return self._pending.pop(0)
+            if self._drained:
+                return None
+            self._fill_pending_fused()
+
+    def close(self) -> None:
+        if self._delegate is not None:
+            self._delegate.close()
+            self._delegate = None
+        self._release_staging()
+        super().close()  # releases the build side's tracked bytes
+
+    def _fuse_eligible(self, ctx: ExecContext) -> bool:
+        if not getattr(ctx, "pipeline_fuse", True) or self.table is None:
+            return False
+        # the fused program is a device fragment: host-engine routing
+        # (device_agg off) keeps the classic tree and its numpy probe
+        return bool(getattr(ctx, "device_agg", True))
+
+    # -- build side (classic build + cross-statement device cache) ---------
+
+    # everything a warm statement needs to probe without re-draining the
+    # build child: the staged device arrays AND the host-side pack/index
+    # decisions derived from the drained build data
+    _BUILD_STATE_FIELDS = (
+        "_sorted_keys", "_n_build_dev", "_firsts", "_build_payload",
+        "_build_keyvals_dev", "_payload_uids", "_pack_info", "_hash_mode",
+        "_modes", "_los", "_strides", "_rngs", "_direct", "_direct_lo",
+        "_direct_rng", "_n_build", "_build_had_null", "_has_filter",
+        "_probe_mode", "_probe_table", "_build_bytes")
+
+    def _open_build(self, ctx: ExecContext) -> None:
+        from tidb_tpu.utils.metrics import JOIN_BUILD_SECONDS
+
+        from tidb_tpu.ops import hash_probe as hp
+
+        budget = int(getattr(ctx, "device_buffer_cache_bytes", 0) or 0)
+        bt = self._build_cache_table
+        cacheable = (budget > 0 and bt is not None
+                     and ctx.read_ts is None and ctx.txn_marker == 0)
+        tag = ident = None
+        if cacheable:
+            # the RESOLVED probe mode joins the tag: the parked state
+            # bakes in the mode's table/index decision, and a knob
+            # change must mint a fresh build, not serve a stale one
+            tag = ("joinbuild", self._build_cache_tag,
+                   hp.resolve_mode(getattr(ctx, "join_probe_mode", "off")))
+            ident = table_ident(bt)
+            hit = DEVICE_CACHE.get(bt, tag, ident)
+            if hit is not None:
+                t0 = time.perf_counter()
+                self._restore_build(hit[0])
+                self.stats.staged += 1
+                JOIN_BUILD_SECONDS.observe(time.perf_counter() - t0,
+                                           tier="cached")
+                return
+        child = self._build_child_build()
+        child.open(ctx)
+        self.children = [None, child]
+        try:
+            self._build()  # HashJoinExec._build: drains children[1]
+        finally:
+            child.close()
+            self.children = []
+        if cacheable:
+            # ownership of the resident arrays transfers to the process
+            # cache; the statement keeps its charge until close() like
+            # any other build (the _staged_chunks fill pattern)
+            DEVICE_CACHE.put(bt, tag, ident, [self._snapshot_build()],
+                             self._build_bytes, budget)
+
+    def _snapshot_build(self) -> dict:
+        return {f: getattr(self, f) for f in self._BUILD_STATE_FIELDS}
+
+    def _restore_build(self, state: dict) -> None:
+        for f, v in state.items():
+            setattr(self, f, v)
+        self._sorted_keys_np = None
+        self._build_payload_np = {}
+        self._build_schema_by_uid = {c.uid: c
+                                     for c in (self.build_schema or [])}
+        # the resident bytes are owned (and budgeted) by the process
+        # cache on a hit — close() must not release them
+        self._build_bytes = 0
+
+    # -- fused probe loop --------------------------------------------------
+
+    def _make_fused(self):
+        from tidb_tpu.ops.segment_scan import segment_scan_key
+
+        col_types = [(c.uid, c.type_) for c in self.scan_schema]
+        probe_uids = tuple(c.uid for c in self.probe_schema)
+        stages, seg_cap = self.scan_stages, self._seg_cap
+        probe = "sorted" if self._probe_table is None else self._probe_mode
+        self._fused_probe_label = "direct" if self._direct else probe
+        # per-statement invariants, hoisted off the per-chunk hot loop:
+        # the direct-domain device scalars and the payload arg tuples
+        # are fixed once the build completes
+        self._direct_lo_dev = jnp.asarray(self._direct_lo, dtype=jnp.int64)
+        self._direct_rng_dev = jnp.asarray(self._direct_rng,
+                                           dtype=jnp.int64)
+        self._table_args = (self._probe_table
+                            if self._probe_table is not None
+                            else jk.no_table())
+        self._b_datas = tuple(self._build_payload[u][0]
+                              for u in self._payload_uids)
+        self._b_valids = tuple(self._build_payload[u][1]
+                               for u in self._payload_uids)
+        key = ("probe|" + segment_scan_key(stages, col_types, seg_cap)
+               + "|" + repr((self.probe_keys, self._modes, self._direct,
+                             probe, probe_uids,
+                             tuple(self._payload_uids))))
+        return cached_jit(
+            "fusedprobe", key,
+            lambda: _make_fused_probe_fn(
+                stages, col_types, self.probe_keys[0], self._modes[0],
+                probe_uids, self._direct, probe, seg_cap))
+
+    def _fill_pending_fused(self) -> None:
+        """Pull staged probe chunks until output lands in _pending or
+        the scan drains. Every chunk's match total stays a device scalar
+        inside its deferral token; ONE batched device_get per window
+        resolves the whole window — the fused fragment syncs
+        O(chunks / window), the same budget as the classic probe."""
+        deferred: List[dict] = []
+        dbytes = 0
+        while not self._pending and not self._drained:
+            raise_if_cancelled(self.ctx)
+            staged = next(self._staged_iter, None)
+            if staged is None:
+                self._drained = True
+                break
+            tok = self._probe_chunk_fused(staged)
+            deferred.append(tok)
+            dbytes += tok["nbytes"]
+            if (len(deferred) >= self.PROBE_SYNC_CHUNKS
+                    or dbytes >= self.PROBE_DEFER_BYTES):
+                self._finish_fused_batch(deferred)
+                deferred = []
+                dbytes = 0
+        if deferred:
+            self._finish_fused_batch(deferred)
+
+    def _probe_chunk_fused(self, staged) -> dict:
+        """Launch the fused scan→probe→expand program for one staged
+        chunk; returns the deferral token pinning its device results."""
+        from tidb_tpu.utils.metrics import JOIN_PROBE_MODE_TOTAL
+
+        t0 = time.perf_counter()
+        JOIN_PROBE_MODE_TOTAL.inc(mode="fused_" + self._fused_probe_label)
+        data, valid, refs, sel = staged
+        out_p, out_b, sel_tile, total_dev, start, count, cum, p_cols = \
+            self._fused_fn(data, valid, refs, sel, self._sorted_keys,
+                           self._n_build_dev, self._firsts,
+                           self._direct_lo_dev, self._direct_rng_dev,
+                           *self._table_args, self._b_datas,
+                           self._b_valids)
+        tok = {"out_p": out_p, "out_b": out_b, "sel_tile": sel_tile,
+               "total_dev": total_dev, "start": start, "count": count,
+               "cum": cum, "p_cols": p_cols,
+               "cap": int(sel_tile.shape[0]), "t0": t0}
+        # the window pins the chunk's expanded tile AND the probe state
+        # needed for a potential overflow re-expansion
+        tok["nbytes"] = _pytree_nbytes(
+            (out_p, out_b, sel_tile, start, count, cum, p_cols))
+        return tok
+
+    def _finish_fused_batch(self, tokens: List[dict]) -> None:
+        from tidb_tpu.utils import dispatch as dsp
+        from tidb_tpu.utils.metrics import JOIN_PROBE_SECONDS
+
+        # THE intentional probe sync, batched: one fetch of the
+        # accumulated per-chunk match totals per deferred window — the
+        # totals double as overflow flags, and fused chunks whose
+        # expansion fit their in-program tile need nothing further
+        # (sanctioned device_get outside any loop — the chunk-loop
+        # sync-budget pass watches the loop form)
+        totals = jax.device_get([t["total_dev"] for t in tokens])
+        dsp.record(site="fetch")
+        for tok, total in zip(tokens, totals):
+            try:
+                self._emit_fused(tok, int(total))
+            finally:
+                JOIN_PROBE_SECONDS.observe(time.perf_counter() - tok["t0"],
+                                           kind="fused")
+
+    def _emit_fused(self, tok: dict, total: int) -> None:
+        """Complete one fused chunk with its host-known total: emit the
+        in-program tile, then expand any overflow past the tile through
+        the classic fixed-capacity tile dispatches."""
+        if total == 0:
+            return
+        cap = tok["cap"]
+        cols = {}
+        for c, (d, v) in zip(self.probe_schema, tok["out_p"]):
+            cols[c.uid] = Column(d, v, c.type_)
+        for uid, (d, v) in zip(self._payload_uids, tok["out_b"]):
+            cols[uid] = Column(d, v, self._build_schema_by_uid[uid].type_)
+        self._pending.append(Chunk(cols, tok["sel_tile"]))
+        self.stats.chunks += 1
+        if total <= cap:
+            return
+        # dup-heavy overflow: slots [cap, total) expand through
+        # expand_tiles against the SAME device arrays (start/count/cum
+        # and the scan-produced probe columns are already resident)
+        p_datas = tuple(d for d, _v in tok["p_cols"])
+        p_valids = tuple(v for _d, v in tok["p_cols"])
+        b_datas, b_valids = self._b_datas, self._b_valids
+        max_tiles = max(1, getattr(self.ctx, "join_tiles", 8))
+        w0 = cap
+        while w0 < total:
+            rem = -(-(total - w0) // cap)  # ceil-div: tiles still needed
+            T = min(jk.shape_bucket(rem, floor=1), max_tiles)
+            out_p, out_b, sel_t, _pr, _bp = jk.expand_tiles(
+                tok["start"], tok["count"], tok["count"], tok["cum"], w0,
+                p_datas, p_valids, b_datas, b_valids, n_tiles=T,
+                tile_cap=cap, build_cap=self._sorted_keys.shape[0])
+            for i in range(min(T, rem)):
+                cols = {}
+                for c, (d2, v2) in zip(self.probe_schema, out_p):
+                    cols[c.uid] = Column(d2[i], v2[i], c.type_)
+                for uid, (d2, v2) in zip(self._payload_uids, out_b):
+                    cols[uid] = Column(d2[i], v2[i],
+                                       self._build_schema_by_uid[uid].type_)
+                self._pending.append(Chunk(cols, sel_t[i]))
+                self.stats.chunks += 1
+            w0 += T * cap

@@ -1,11 +1,19 @@
-"""Open-addressing hash probe for the fragment join (Pallas).
+"""Open-addressing hash probe for the device joins (Pallas).
 
-The fragment join (parallel/fragment.py) sorts the build side by key
-hash and probes with two `jnp.searchsorted` calls — O(log Rb) dependent
-gather rounds per probe element on TPU. The reference's hash join probes
-an O(1)-expected hash table instead (ref: executor/'s HashJoinExec
-build+probe workers; SURVEY.md:294-296 names this kernel as the planned
-fast path). This module supplies that table:
+Both join tiers sort their build side and, pre-ISSUE 10, probed with
+two `jnp.searchsorted` calls — O(log Rb) dependent gather rounds per
+probe element, hostile to TPU (each round is an HBM gather the next
+round depends on). The reference's hash join probes an O(1)-expected
+hash table instead (ref: executor/'s HashJoinExec build+probe workers;
+SURVEY.md:294-296 names this kernel as the planned fast path). This
+module supplies that table, consumed two ways: the fragment join
+(parallel/fragment.py) builds + probes it inside one shard_map program
+via `probe_for_join`, and the main single-chip join (ISSUE 10) builds
+it ONCE per join build (ops/join_kernels.build_hash_table) and probes
+it per chunk with the table arrays as kernel args. Strategy selection:
+`tidb_tpu_join_probe_mode` (off/auto/xla/pallas) through
+`resolve_mode` — auto picks the table exactly when the computation
+targets TPU.
 
   * BUILD (XLA, inside the same jit): runs of equal values in the sorted
     hash array become (lo, hi) ranges; each run's FIRST row inserts
@@ -37,28 +45,40 @@ import jax.numpy as jnp
 from tidb_tpu.ops.segment_sum import pallas_enabled
 
 __all__ = ["probe_ranges", "xla_probe_ranges", "probe_for_join",
-           "set_mode", "MAX_CAPACITY"]
+           "set_mode", "resolve_mode", "table_capacity", "MAX_CAPACITY"]
 
 import os
 
-# "off" (default): always searchsorted; "auto": hash table when the
+# "off": always searchsorted; "auto" (default): hash table when the
 # computation targets TPU (trace-time force_platform aware, like
 # segment_sum); "xla": hash table everywhere (window-scan probe);
 # "pallas": hash table with the Pallas VMEM kernel.
 #
-# Default is OFF because the table path has never run on real silicon
-# (the tunnel was dead all round): on CPU searchsorted measured faster
-# (ops/PROBE_BENCH.json — 32 fixed window rounds vs ~2*log2(Rb)
-# cache-friendly binary rounds), and the on-chip recapture path must
-# not gamble on unvalidated Mosaic/axon codegen. The expected TPU win
-# (VMEM-resident table vs HBM binary search) is one env var away:
-# TIDB_HASH_PROBE=xla or =pallas.
-_mode = os.environ.get("TIDB_HASH_PROBE", "off")
+# Auto keeps CPU on searchsorted because it measures faster there
+# (bench.py bench_probe — 32 fixed window rounds vs ~2*log2(Rb)
+# cache-friendly binary rounds) while TPU gets the VMEM-resident table
+# instead of O(log Rb) dependent HBM gather rounds per element. The
+# session wires tidb_tpu_join_probe_mode through set_mode; the env var
+# only seeds the pre-session default (offline tools, bare fragments).
+_mode = os.environ.get("TIDB_HASH_PROBE", "auto")
 
 
 def set_mode(m: str) -> None:
     global _mode
     _mode = m
+
+
+def resolve_mode(mode: str = None) -> str:
+    """Concrete probe strategy — 'sorted' | 'xla' | 'pallas' — for the
+    platform the CURRENT computation targets (trace-time, so mesh
+    fragments under force_platform resolve against the mesh's devices).
+    `mode` defaults to the module global the session sysvar wires."""
+    m = _mode if mode is None else mode
+    if m == "off":
+        return "sorted"
+    if m == "auto":
+        return "xla" if pallas_enabled() else "sorted"
+    return m
 
 
 def probe_for_join(sorted_hashes: jax.Array, probes: jax.Array):
@@ -107,6 +127,20 @@ def _next_pow2(n: int) -> int:
     while c < n:
         c *= 2
     return c
+
+
+def table_capacity(n_build: int):
+    """Open-addressing table capacity for an `n_build`-row build side,
+    or None when the table is ineligible (load factor would exceed 1/2
+    within the VMEM cap, or the build is empty). One definition shared
+    by probe_ranges (fragment tier, in-jit) and the main join's
+    build-time table construction (ops/join_kernels.build_hash_table)."""
+    if n_build == 0:
+        return None
+    cap = min(_next_pow2(max(2 * n_build, 16)), MAX_CAPACITY)
+    if cap < 2 * n_build:
+        return None
+    return cap
 
 
 def _build_table(sh: jax.Array, cap: int):
@@ -260,9 +294,8 @@ def probe_ranges(sorted_hashes: jax.Array, probes: jax.Array,
     from tidb_tpu.ops.join_kernels import _note_trace
 
     _note_trace("hash_probe")  # trace-time only: joins the retrace guard
-    Rb = sorted_hashes.shape[0]
-    cap = min(_next_pow2(max(2 * Rb, 16)), MAX_CAPACITY)
-    if cap < 2 * Rb or Rb == 0:
+    cap = table_capacity(sorted_hashes.shape[0])
+    if cap is None:
         # load factor would exceed 1/2 (or VMEM): stay on searchsorted
         return xla_probe_ranges(sorted_hashes, probes)
     keys, los, his, ok = _build_table(sorted_hashes, cap)

@@ -18,11 +18,17 @@ keys executables purely on (shapes, dtypes, static flags):
   * build sides are padded to power-of-two buckets (``shape_bucket``),
     so two queries whose build sides land in the same bucket share one
     compiled program, and a steady-state repeated join re-traces nothing;
-  * the probe is ONE fused kernel — key pack → searchsorted → per-row
+  * the probe is ONE fused kernel — key pack → range lookup → per-row
     match count → prefix sum — and expansion is one fused kernel
     emitting ``[T, C]`` fixed-capacity output tiles (the same layout
     ``parallel/partition.py`` streams), T output tiles per dispatch
-    instead of one dispatch per output window;
+    instead of one dispatch per output window. The range lookup
+    (``probe_ranges_any``) is strategy-parameterized (ISSUE 10):
+    dense packed domains take the O(1) direct-address index, the
+    TPU-shaped path probes the prebuilt open-addressing table
+    (``build_hash_table`` / ops/hash_probe, MAX_PROBES vectorized
+    window rounds instead of O(log B) dependent gathers), and
+    searchsorted remains the CPU default and in-jit fallback;
   * the build sort runs on device: NULL/dead keys are sent to
     ``INT64_MAX`` and sorted to the tail with a stable secondary flag,
     so ``n_build`` (a traced scalar) bounds every probe range exactly
@@ -54,7 +60,8 @@ from tidb_tpu.utils.hashutil import SM_ADD, SM_MUL1, SM_MUL2
 
 __all__ = [
     "shape_bucket", "as_int64_key", "hash_combine_device",
-    "build_sort", "probe_count", "expand_tiles",
+    "build_sort", "build_hash_table", "no_table", "probe_count",
+    "probe_ranges_any", "expand_tiles",
     "sort_build_hashes", "probe_hash_ranges", "tile_positions",
 ]
 
@@ -202,31 +209,111 @@ def build_direct_index(sorted_keys, n_build, lo, rng_bucket: int):
                                rng_bucket=int(rng_bucket))
 
 
+# -- open-addressing hash table over the sorted build keys ------------------
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _build_hash_table(sorted_keys, cap):
+    _note_trace("hash_table")
+    from tidb_tpu.ops.hash_probe import _build_table
+
+    return _build_table(sorted_keys, cap)
+
+
+def build_hash_table(sorted_keys):
+    """(keys32, lo32, hi32, all_placed) open-addressing table over the
+    sorted build keys — built ONCE per join build (like the
+    direct-address index) and passed to every probe_count as args, so
+    the per-chunk probe is MAX_PROBES vectorized window rounds instead
+    of O(log B) dependent searchsorted gathers (ISSUE 10: the TPU-shaped
+    main-join probe). Returns None when the build side exceeds the VMEM
+    capacity envelope — the caller stays on searchsorted. The sentinel
+    tail (NULL/dead keys at INT64_MAX) forms ordinary runs whose ranges
+    the probe's n_build clamp truncates exactly like searchsorted's."""
+    from tidb_tpu.ops.hash_probe import table_capacity
+
+    cap = table_capacity(sorted_keys.shape[0])
+    if cap is None:
+        return None
+    dispatch.record(site="jit:join.build")
+    return _build_hash_table(sorted_keys, cap=cap)
+
+
+_NO_TABLE = None
+
+
+def no_table():
+    """Placeholder table args for the searchsorted path: tiny constant-
+    shape arrays the kernel's static 'sorted' branch never reads (XLA
+    dead-code-eliminates them), keeping one probe_count signature.
+    Memoized — probe_count runs once per probe chunk, and minting four
+    device constants per chunk would tax the very hot path this module
+    exists to thin (first call is lazy so no arrays materialize at
+    import, before backend selection)."""
+    global _NO_TABLE
+    if _NO_TABLE is None:
+        _NO_TABLE = (jnp.full(2, 0x7FFFFFFF, dtype=jnp.int32),
+                     jnp.zeros(2, dtype=jnp.int32),
+                     jnp.zeros(2, dtype=jnp.int32), jnp.asarray(False))
+    return _NO_TABLE
+
+
+def probe_ranges_any(sorted_keys, n_build, packed, firsts, lo_packed,
+                     rng_packed, tkeys, tlos, this, tok,
+                     direct: bool, probe: str):
+    """(start, end, in_range) match ranges per packed probe key — THE
+    range-lookup step, traced inside both the standalone probe kernel
+    and the fused scan→probe program so the two cannot drift. Strategy
+    is static: 'direct' wins when the dense-domain index exists (two
+    O(1) gathers beat any hash walk), else the open-addressing table
+    ('xla' window scan / 'pallas' VMEM kernel) with the in-jit lax.cond
+    searchsorted fallback when the build overflowed its displacement
+    bound, else plain searchsorted. Ranges clamp to n_build so the
+    NULL/dead/padding sentinel tail can never produce a match."""
+    from tidb_tpu.ops import hash_probe as hp
+
+    ones = jnp.ones(packed.shape[0], dtype=jnp.bool_)
+    if direct:
+        # dense domain: two gathers into the radix histogram's prefix sums
+        idx = packed - lo_packed
+        in_range = (idx >= 0) & (idx < rng_packed)
+        idxc = jnp.clip(idx, 0, firsts.shape[0] - 2)
+        return jnp.take(firsts, idxc), jnp.take(firsts, idxc + 1), in_range
+    if probe != "sorted":
+        def fast(_):
+            fn = hp._probe_pallas if probe == "pallas" else hp._probe_xla
+            return fn(tkeys, tlos, this, sorted_keys, packed,
+                      tkeys.shape[0])
+
+        def slow(_):
+            lo = jnp.searchsorted(sorted_keys, packed, side="left")
+            hi = jnp.searchsorted(sorted_keys, packed, side="right")
+            return lo.astype(jnp.int64), hi.astype(jnp.int64)
+
+        start, end = jax.lax.cond(tok, fast, slow, None)
+    else:
+        start = jnp.searchsorted(sorted_keys, packed, side="left")
+        end = jnp.searchsorted(sorted_keys, packed, side="right")
+    # the region past n_build holds NULL/dead/padding sentinels: clamp
+    # so a probe of INT64_MAX counts only the genuine run
+    return (jnp.minimum(start, n_build), jnp.minimum(end, n_build), ones)
+
+
 # -- probe: pack + range lookup + count + prefix sum, one kernel ------------
 
 @functools.partial(jax.jit, static_argnames=("modes", "hash_mode",
-                                             "left_pad", "direct"))
+                                             "left_pad", "direct", "probe"))
 def _probe_count(sorted_keys, n_build, key_datas, key_valids, sel,
                  los, strides, rngs, firsts, lo_packed, rng_packed,
-                 modes, hash_mode, left_pad, direct):
+                 tkeys, tlos, this, tok,
+                 modes, hash_mode, left_pad, direct, probe):
     _note_trace("probe")
     packed, kvalid, in_range = _pack_device(
         key_datas, key_valids, los, strides, rngs, sel, modes, hash_mode)
     ok = kvalid & sel
-    if direct:
-        # dense domain: two gathers into the radix histogram's prefix sums
-        idx = packed - lo_packed
-        in_range = in_range & (idx >= 0) & (idx < rng_packed)
-        idxc = jnp.clip(idx, 0, firsts.shape[0] - 2)
-        start = jnp.take(firsts, idxc)
-        end = jnp.take(firsts, idxc + 1)
-    else:
-        start = jnp.searchsorted(sorted_keys, packed, side="left")
-        end = jnp.searchsorted(sorted_keys, packed, side="right")
-        # the region past n_build holds NULL/dead/padding sentinels: clamp
-        # so a probe of INT64_MAX counts only the genuine run
-        start = jnp.minimum(start, n_build)
-        end = jnp.minimum(end, n_build)
+    start, end, range_ok = probe_ranges_any(
+        sorted_keys, n_build, packed, firsts, lo_packed, rng_packed,
+        tkeys, tlos, this, tok, direct, probe)
+    in_range = in_range & range_ok
     count = jnp.where(ok & in_range, end - start, 0)
     matched = count > 0
     real_count = count
@@ -240,17 +327,28 @@ def _probe_count(sorted_keys, n_build, key_datas, key_valids, sel,
 
 def probe_count(sorted_keys, n_build, key_datas, key_valids, sel,
                 los, strides, rngs, firsts, lo_packed, rng_packed,
-                modes, hash_mode, left_pad, direct):
+                modes, hash_mode, left_pad, direct,
+                table=None, probe="sorted"):
     """Fused probe over one chunk: (start, count, real_count, cum, total,
     ok, matched). ``total`` is the only value a caller syncs to the
-    host (to size the expansion)."""
+    host (to size the expansion). ``table`` is the prebuilt
+    open-addressing table (build_hash_table) consulted when ``probe``
+    is 'xla'/'pallas'; 'sorted' takes placeholder args and the
+    searchsorted branch."""
+    from tidb_tpu.utils.metrics import JOIN_PROBE_MODE_TOTAL
+
+    probe = "sorted" if table is None else str(probe)
+    JOIN_PROBE_MODE_TOTAL.inc(mode="direct" if direct else probe)
+    tkeys, tlos, this, tok = table if table is not None else no_table()
     dispatch.record(site="jit:join.probe")
     return _probe_count(sorted_keys, n_build, key_datas, key_valids, sel,
                         los, strides, rngs, firsts,
                         jnp.asarray(lo_packed, dtype=jnp.int64),
                         jnp.asarray(rng_packed, dtype=jnp.int64),
+                        tkeys, tlos, this, tok,
                         modes=tuple(modes), hash_mode=bool(hash_mode),
-                        left_pad=bool(left_pad), direct=bool(direct))
+                        left_pad=bool(left_pad), direct=bool(direct),
+                        probe=probe)
 
 
 # -- shared expand-position arithmetic --------------------------------------

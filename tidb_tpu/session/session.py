@@ -868,6 +868,7 @@ class Session:
                 self.sysvars.get("tidb_tpu_join_device_build")),
             join_tiles=int(
                 self.sysvars.get("tidb_tpu_join_tiles_per_dispatch")),
+            join_probe_mode=self._wire_probe_mode(),
             broadcast_rows_limit=int(
                 self.sysvars.get("tidb_broadcast_join_threshold_count")),
             columnar_enable=bool(
@@ -885,6 +886,23 @@ class Session:
             stage_encoded=bool(self.sysvars.get("tidb_tpu_stage_encoded")),
             cancel_check=self.cancel_reason,
         )
+
+    def _wire_probe_mode(self) -> str:
+        """Effective tidb_tpu_join_probe_mode, ALSO wired into
+        ops/hash_probe.set_mode so the fragment-tier join (which reads
+        the module-global at trace time, inside its shard_map program)
+        follows the same knob as the single-chip executor. The global is
+        process-wide: concurrent sessions with divergent session-level
+        values race it for the fragment tier only — the single-chip
+        join carries the mode per-statement through ExecContext. Already
+        -compiled fragment programs keep their traced strategy until the
+        jit cache turns over (results are identical either way; only
+        the probe's cost model changes)."""
+        mode = str(self.sysvars.get("tidb_tpu_join_probe_mode"))
+        from tidb_tpu.ops import hash_probe
+
+        hash_probe.set_mode(mode)
+        return mode
 
     def _agg_push_down(self) -> bool:
         """Effective eager-aggregation switch. Device-engine sessions

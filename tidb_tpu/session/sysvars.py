@@ -104,6 +104,17 @@ _reg(
     SysVar("tidb_tpu_join_device_build", True, BOTH, "bool"),
     SysVar("tidb_tpu_join_tiles_per_dispatch", 8, BOTH, "int",
            min_=1, max_=64),
+    # join probe strategy (ISSUE 10): how probe chunks resolve (lo, hi)
+    # match ranges over the sorted build keys. off = searchsorted always;
+    # auto = open-addressing hash table when the computation targets TPU
+    # (trace-time force_platform aware, like segment_sum), searchsorted
+    # on CPU where its cache-friendly binary rounds measure faster;
+    # xla/pallas force the table everywhere (window-scan probe / Pallas
+    # VMEM kernel). Dense packed-key domains keep the O(1) direct-address
+    # index regardless. Also wires ops/hash_probe.set_mode for the
+    # fragment-tier join (process-global, read at trace time).
+    SysVar("tidb_tpu_join_probe_mode", "auto", BOTH, "enum",
+           enum_values=("off", "auto", "xla", "pallas")),
     SysVar("tidb_broadcast_join_threshold_count", 1 << 21, BOTH, "int",
            min_=1 << 10, max_=1 << 28),
     # -- serving tier (ISSUE 7): admission-controlled scheduler +

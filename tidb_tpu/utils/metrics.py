@@ -239,6 +239,13 @@ JOIN_COMPILE_TOTAL = Counter(
     "incremented at TRACE time inside the fused join kernels, so a "
     "steady-state repeated join must not move it (the retrace guard "
     "test and EXPLAIN ANALYZE's recompiles field both read it)")
+JOIN_PROBE_MODE_TOTAL = Counter(
+    "tidb_tpu_join_probe_mode_total",
+    "Probe chunks resolved per strategy, by mode: sorted (searchsorted "
+    "range lookup), xla / pallas (open-addressing hash table, window-"
+    "scan / VMEM kernel), direct (dense-domain direct-address index), "
+    "host (numpy tier), fused_* (same strategies inside a fused "
+    "scan->probe program) — captures show which path actually ran")
 JOIN_PROBE_SECONDS = Histogram(
     "tidb_tpu_join_probe_seconds",
     "Wall time of one fused probe+expand pass over a probe chunk, by "
