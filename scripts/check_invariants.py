@@ -6,6 +6,9 @@ Runs the AST lint passes in tidb_tpu/analysis/ over the repo:
   jit-hygiene          device programs module-level + argument-driven
   host-sync            no silent device→host syncs in hot loop bodies
   lock-discipline      lock-order cycles, mixed locked/unlocked writes
+  resource-lifecycle   acquires (pins/charges/cursors/arms) reach their
+                       release on every path
+  blocking-under-lock  no registered lock held across a blocking call
   metrics-coverage     /metrics collectors rendered + documented
   failpoint-coverage   no dead/armed-but-siteless failpoints
   sysvar-coverage      tidb_* sysvars registered, read, documented
@@ -13,11 +16,19 @@ Runs the AST lint passes in tidb_tpu/analysis/ over the repo:
 
 Exit 0 only with zero unsuppressed violations.  Suppressions need an
 inline reason (`# lint: disable=<pass> -- <reason>`, or
-`# host-sync: <reason>` for intentional syncs) and are counted in the
-report so the allowlist stays visible.
+`# host-sync: <reason>` / `# lifecycle: <reason>` for intentional
+syncs/handoffs) and are counted in the report so the allowlist stays
+visible.
+
+``--json`` emits the machine-readable report (violations, suppressions,
+per-pass timings; schema asserted tier-1). ``--changed <paths...>``
+restricts the AST passes to the given repo-relative files — the
+incremental mode for the builder loop, well under a second on a diff
+(the registry passes need the whole tree and are skipped there unless
+explicitly selected with --pass).
 
 Usage: python scripts/check_invariants.py [--root DIR] [--pass NAME]
-       [--list] [--syncs]
+       [--list] [--syncs] [--json] [--changed PATH...]
 """
 
 from __future__ import annotations
@@ -59,6 +70,12 @@ def main(argv=None) -> int:
     ap.add_argument("--syncs", action="store_true",
                     help="also print the annotated intentional host-sync "
                          "table (the README source of truth)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report (violations, "
+                         "suppressions, per-pass timings) as JSON")
+    ap.add_argument("--changed", nargs="+", default=None, metavar="PATH",
+                    help="incremental mode: lint only these repo-relative "
+                         "files with the AST passes (<1s on a diff)")
     args = ap.parse_args(argv)
 
     analysis = _import_analysis(ROOT)
@@ -75,17 +92,34 @@ def main(argv=None) -> int:
                   f"(have: {', '.join(sorted(known))})")
             return 2
         passes = [p for p in passes if p.id in args.passes]
+    if args.changed is not None and not args.passes:
+        # a changed subset cannot prove registry coverage either way:
+        # run only the file-scoped AST passes over the diff
+        from tidb_tpu.analysis.core import AST_PASS_IDS
 
-    driver = analysis.Driver(args.root, passes)
+        passes = [p for p in passes if p.id in AST_PASS_IDS]
+
+    driver = analysis.Driver(args.root, passes, changed=args.changed)
     reports = driver.run()
+    if args.json:
+        import json
+
+        print(json.dumps(driver.to_json(reports), indent=2,
+                         sort_keys=True))
+        return 0 if not any(r.violations or r.problems
+                            for r in reports) else 1
     text, rc = driver.render(reports)
     print(text)
 
     if args.syncs:
         from tidb_tpu.analysis.host_sync import annotated_sites
+        from tidb_tpu.analysis.resource_lifecycle import lifecycle_sites
 
         print("\nannotated intentional host syncs:")
         for rel, line, reason in annotated_sites(driver.project):
+            print(f"  {rel}:{line}  {reason}")
+        print("\nannotated lifecycle handoffs:")
+        for rel, line, reason in lifecycle_sites(driver.project):
             print(f"  {rel}:{line}  {reason}")
     return rc
 

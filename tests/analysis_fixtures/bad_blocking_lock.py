@@ -1,0 +1,29 @@
+"""Fixture: blocking calls under a registered lock — the leaf-lock
+violations the blocking-under-lock pass must flag (lock across
+device_get, lock across MemTracker.consume), plus the sanctioned
+snapshot-then-block form that must stay clean."""
+
+import threading
+
+import jax
+
+
+class BadProbe:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.totals = []
+
+    def drain(self, totals):
+        with self._lock:
+            out = jax.device_get(totals)   # BAD: device round trip under lock
+        return out
+
+    def charge(self, tracker, nbytes):
+        with self._lock:
+            tracker.consume(nbytes)        # BAD: consume re-enters spill
+
+    def snapshot_then_block(self, tracker, nbytes):
+        with self._lock:
+            snap = list(self.totals)       # ok: pure host work under lock
+        tracker.consume(nbytes)            # ok: lock released first
+        return jax.device_get(snap)        # ok: lock released first

@@ -1,0 +1,44 @@
+"""Fixture: acquires whose release dies with the first exception — the
+leak classes the resource-lifecycle pass must flag (the evict_segment
+ENOSPC bug shape), plus the sanctioned forms that must stay clean."""
+
+from tidb_tpu.columnar.store import ScanPin
+
+
+def save(seg):
+    raise OSError("ENOSPC")
+
+
+class BadStore:
+    def evict(self, seg):
+        seg.pins += 1          # BAD: decrement only on the success path
+        save(seg)              # ENOSPC here pins the segment forever
+        seg.pins -= 1
+
+    def evict_ok(self, seg):
+        seg.pins += 1
+        try:
+            save(seg)
+        finally:
+            seg.pins -= 1      # ok: release reachable on every path
+
+
+def leak_on_exception(store, tracker, work):
+    pin = ScanPin(store, tracker)   # BAD: close() only on the success path
+    work(pin)
+    pin.close()
+
+
+def charge_without_release(tracker, nbytes):
+    tracker.consume(nbytes)    # BAD: no release on any path
+    return nbytes
+
+
+def handoff_to_caller(store, tracker):
+    return ScanPin(store, tracker)  # ok: ownership moves to the caller
+
+
+def annotated_handoff(store, tracker, registry):
+    # lifecycle: parked on the registry; registry.shutdown() closes it
+    pin = ScanPin(store, tracker)
+    registry.append(pin)

@@ -129,9 +129,10 @@ class TestJoinIntegration:
         jitcache.clear()
         try:
             s = Session(chunk_capacity=1 << 14, mesh=make_mesh())
-            # the sysvar is THE knob now: every statement wires it into
-            # hash_probe.set_mode (a direct set_mode here would be
-            # clobbered by the session's next statement)
+            # the sysvar is THE knob: it rides ExecContext into the
+            # fragment builder as a trace-time static (ISSUE 12) — the
+            # process global is no longer written per statement, so
+            # concurrent sessions cannot clobber each other
             s.execute(f"set tidb_tpu_join_probe_mode = '{mode}'")
             s.execute("create table f (k bigint, v bigint)")
             s.execute("create table d (k bigint primary key, g bigint)")
@@ -140,11 +141,9 @@ class TestJoinIntegration:
             s.execute("insert into d values " + ",".join(
                 f"({i}, {i % 7})" for i in range(53)))
             s.execute("set tidb_device_engine_mode = 'force'")
-            # the wiring is per-STATEMENT: the query below re-installs
-            # the session's mode right before its executors build (a
-            # background internal session — auto-analyze — may wire its
-            # own default in between, which is why no assert on the
-            # global here)
+            # per-STATEMENT threading: the query below carries the
+            # session's mode through ExecContext into build_fn (and the
+            # fragment cache key), never through the process global
             assert s.sysvars.get("tidb_tpu_join_probe_mode") == mode
             sql = ("select g, count(*), sum(v) from f join d on f.k = d.k "
                    "group by g order by g")

@@ -1,0 +1,469 @@
+"""Runtime invariant sanitizer (ISSUE 12): every detector has a
+mutation test that deliberately breaks its invariant and asserts the
+typed report, and a sanitized tier-1 subset (serving + columnar +
+pipeline + join workloads) runs CLEAN under the gate.
+
+Detectors: lock-order witness (cycle-checked, diffed against the
+static lock graph), MemTracker double-release/residual typed at
+release()/detach(), ScanPin balance at statement end, the per-statement
+host-sync budget, and the shared-mutable-global witness that confirms
+the PR 10 hash_probe.set_mode race is gone."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tidb_tpu.analysis import sanitizer as san
+from tidb_tpu.errors import SanitizerError
+from tidb_tpu.session import Session
+from tidb_tpu.utils.memory import MemTracker
+
+
+@pytest.fixture(autouse=True)
+def clean_sanitizer():
+    """Every test starts and ends with the sanitizer off and empty —
+    the witness state is process-global by design."""
+    san.disable()
+    yield
+    san.disable()
+
+
+def sanitized_session(**kw):
+    s = Session(**kw)
+    s.execute("set tidb_tpu_sanitize = 1")
+    return s
+
+
+def findings(kind=None):
+    fs = san.report()["findings"]
+    return [f for f in fs if kind is None or f["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness
+# ---------------------------------------------------------------------------
+
+
+class TestLockWitness:
+    def test_engine_locks_are_registered(self):
+        from tidb_tpu.storage.catalog import Catalog
+        from tidb_tpu.utils import memory
+
+        cat = Catalog()
+        assert isinstance(cat.lock, san.TrackedLock)
+        assert isinstance(memory._ACCOUNT_LOCK, san.TrackedLock)
+        assert isinstance(cat.plan_cache.lock, san.TrackedLock)
+
+    def test_nested_acquisition_records_an_edge(self):
+        san.enable()
+        a = san.tracked_lock("TestW.a_lock")
+        b = san.tracked_lock("TestW.b_lock")
+        with a:
+            with b:
+                pass
+        edges = san.lock_edges()
+        assert "TestW.b_lock" in edges.get("TestW.a_lock", {}), edges
+
+    def test_runtime_cycle_is_a_fatal_finding(self):
+        """Mutation: acquire A->B on one thread and B->A on another
+        (sequentially — the witness needs the ORDER, not a live
+        deadlock) and the cycle check must fire, typed."""
+        san.enable()
+        a = san.tracked_lock("TestC.a_lock")
+        b = san.tracked_lock("TestC.b_lock")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        with b:
+            with a:
+                pass
+        f = san.check_lock_cycle()
+        assert f is not None and f.fatal
+        assert "TestC.a_lock" in f.subject and "TestC.b_lock" in f.subject
+        assert findings("lock-cycle")
+
+    def test_cycle_fails_the_sanitized_statement(self):
+        """The cycle check runs at statement end: a witnessed cycle
+        turns the next sanitized statement into a typed error."""
+        s = sanitized_session()
+        s.execute("create table w (a int)")
+        a = san.tracked_lock("TestS.a_lock")
+        b = san.tracked_lock("TestS.b_lock")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        with b:
+            with a:
+                pass
+        with pytest.raises(SanitizerError, match="lock-cycle"):
+            s.execute("select count(*) from w")
+
+    def test_diff_static_surfaces_novel_edges(self):
+        """An order witnessed at runtime that the AST never saw (these
+        test locks exist in no source file) lands in diff_static's
+        novel list — the blind-spot surface the ISSUE asks for."""
+        san.enable()
+        a = san.tracked_lock("TestD.a_lock")
+        b = san.tracked_lock("TestD.b_lock")
+        with a:
+            with b:
+                pass
+        d = san.diff_static()
+        assert any(x == "TestD.a_lock" and y == "TestD.b_lock"
+                   for x, y, _thr in d["novel"]), d["novel"]
+
+    def test_static_graph_nonempty(self):
+        """The diff has a real static side: the AST lock graph over the
+        registered modules carries edges (e.g. through the catalog)."""
+        from tidb_tpu.analysis.lock_discipline import static_lock_edges
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        static = static_lock_edges(root)
+        assert isinstance(static, dict)
+
+
+class TestGateSemantics:
+    def test_env_gate_honors_falsy_strings(self, monkeypatch):
+        """TIDB_TPU_SANITIZE=0 must DISABLE (bool(\"0\") is True — the
+        review-caught trap), and the sysvar default uses the SAME
+        parser."""
+        from tidb_tpu.session.sysvars import _sanitizer_env_gate
+
+        for v in ("", "0", "false", "OFF", "no"):
+            monkeypatch.setenv("TIDB_TPU_SANITIZE", v)
+            assert san.env_gate() is False, v
+            assert _sanitizer_env_gate() is False, v
+        for v in ("1", "true", "ON", "yes"):
+            monkeypatch.setenv("TIDB_TPU_SANITIZE", v)
+            assert san.env_gate() is True, v
+
+    def test_release_pops_held_across_disable(self):
+        """A disable() landing while a thread sits inside a tracked
+        critical section must not strand the lock name on the held
+        stack — a stale entry would mint phantom order edges (and
+        phantom cycles) after the next enable()."""
+        san.enable()
+        a = san.tracked_lock("TestP.a_lock")
+        a.acquire()
+        san.disable(reset_state=False)  # mid-critical-section flip
+        a.release()                     # must still pop the held stack
+        san.enable()
+        b = san.tracked_lock("TestP.b_lock")
+        with b:
+            pass
+        edges = san.lock_edges()
+        assert "TestP.b_lock" not in edges.get("TestP.a_lock", {}), edges
+
+
+# ---------------------------------------------------------------------------
+# tracker balance
+# ---------------------------------------------------------------------------
+
+
+class TestTrackerWitness:
+    def test_double_release_is_typed(self):
+        san.enable()
+        t = MemTracker("mutant")
+        t.consume(100)
+        t.release(150)  # 50 bytes returned twice
+        fs = findings("tracker-double-release")
+        assert fs and fs[0]["fatal"] and fs[0]["subject"] == "mutant"
+
+    def test_balanced_tracker_is_clean(self):
+        san.enable()
+        t = MemTracker("ok")
+        t.consume(100)
+        t.release(100)
+        assert not findings("tracker-double-release")
+
+    def test_detach_residual_is_a_leak_witness(self):
+        san.enable()
+        parent = MemTracker("parent")
+        child = MemTracker("child", parent=parent)
+        child.consume(4096)
+        child.detach()  # reclaims, but the witness records the leak
+        fs = findings("tracker-residual")
+        assert fs and not fs[0]["fatal"] and "4096" in fs[0]["detail"]
+        assert parent.consumed == 0  # detach still reclaimed it
+
+    def test_clean_detach_no_witness(self):
+        san.enable()
+        parent = MemTracker("parent")
+        child = MemTracker("child", parent=parent)
+        child.consume(64)
+        child.release(64)
+        child.detach()
+        assert not findings("tracker-residual")
+
+
+# ---------------------------------------------------------------------------
+# pin balance at statement end
+# ---------------------------------------------------------------------------
+
+
+def _store_and_tracker():
+    from tidb_tpu.columnar.store import store_for
+
+    s = Session()
+    s.execute("create table p (a int, b int)")
+    t = s.catalog.table("test", "p")
+    n = 4096
+    t.insert_columns({"a": np.arange(n, dtype=np.int64),
+                      "b": np.arange(n, dtype=np.int64) % 7})
+    store = store_for(t, segment_rows=1024)
+    store.refresh(force=True)
+    assert store is not None and store.segments
+    return store, MemTracker("stmt", spill_root=True)
+
+
+class TestPinWitness:
+    def test_leaked_pin_is_fatal_at_statement_end(self):
+        from tidb_tpu.columnar.store import ScanPin
+
+        store, tracker = _store_and_tracker()
+        san.enable()
+        scope = san.statement_begin()
+        pin = ScanPin(store, tracker)  # mutation: never closed
+        out = san.statement_end(scope)
+        leaks = [f for f in out if f.kind == "pin-leak"]
+        assert leaks and leaks[0].fatal
+        assert "ScanPin" in leaks[0].subject
+        pin.close()  # leave the store sane for other assertions
+
+    def test_closed_pin_is_clean(self):
+        from tidb_tpu.columnar.store import ScanPin
+
+        store, tracker = _store_and_tracker()
+        san.enable()
+        scope = san.statement_begin()
+        pin = ScanPin(store, tracker)
+        segs, _pruned, _cov = store.plan_scan([], pin=pin)
+        for seg in segs:
+            pin.touch(seg)
+        pin.close()
+        out = san.statement_end(scope)
+        assert not [f for f in out if f.kind == "pin-leak"], out
+        assert all(seg.pins == 0 for seg in store.segments)
+        assert tracker.consumed == 0
+
+
+# ---------------------------------------------------------------------------
+# host-sync budget
+# ---------------------------------------------------------------------------
+
+
+class TestSyncBudget:
+    def test_unit_budget_breach(self):
+        san.enable()
+        scope = san.statement_begin(sync_budget=2)
+        for _ in range(3):
+            san.count_sync()
+        out = san.statement_end(scope)
+        hits = [f for f in out if f.kind == "host-sync-budget"]
+        assert hits and hits[0].fatal and "3" in hits[0].detail
+
+    def test_statement_over_budget_raises_typed(self):
+        """A multi-sync statement (generic group-by: several finalize
+        fetches) under budget=1 fails with the typed error; the same
+        statement under the default budget passes."""
+        s = sanitized_session(chunk_capacity=1 << 12)
+        s.execute("create table g (a int, b int)")
+        t = s.catalog.table("test", "g")
+        n = 10000
+        t.insert_columns({"a": np.arange(n, dtype=np.int64),
+                          "b": np.arange(n, dtype=np.int64) % 13})
+        sql = "select b % 7 as grp, sum(a) from g group by grp order by grp"
+        ok = s.query(sql)  # default budget: clean
+        assert len(ok) == 7
+        s.execute("set tidb_tpu_sanitize_sync_budget = 1")
+        with pytest.raises(SanitizerError, match="host-sync-budget"):
+            s.query(sql)
+
+
+# ---------------------------------------------------------------------------
+# shared-mutable-global witness (the PR 10 set_mode race)
+# ---------------------------------------------------------------------------
+
+
+class TestGlobalWitness:
+    def test_set_mode_during_statement_is_fatal(self):
+        from tidb_tpu.ops import hash_probe
+
+        before = hash_probe._mode
+        san.enable()
+        scope = san.statement_begin()
+        try:
+            hash_probe.set_mode("xla")  # mutation: the PR 10 race shape
+        finally:
+            out = san.statement_end(scope)
+            hash_probe.set_mode(before)
+        hits = [f for f in out if f.kind == "shared-global-write"]
+        assert hits and hits[0].fatal
+        assert "hash_probe" in hits[0].subject
+
+    def test_set_mode_outside_statements_is_allowed(self):
+        from tidb_tpu.ops import hash_probe
+
+        before = hash_probe._mode
+        san.enable()
+        hash_probe.set_mode("off")  # offline seeding: no scope in flight
+        hash_probe.set_mode(before)
+        assert not findings("shared-global-write")
+
+    def test_statements_no_longer_write_the_global(self):
+        """The satellite fix, witness-confirmed: sessions with DIVERGENT
+        probe modes run joins concurrently-shaped and the process global
+        never moves — the mode rides ExecContext/fragment args."""
+        from tidb_tpu.ops import hash_probe
+
+        before = hash_probe._mode
+        s1 = sanitized_session()
+        s1.execute("create table j1 (k int primary key, v int)")
+        s1.execute("insert into j1 values " + ",".join(
+            f"({i},{i * 3})" for i in range(64)))
+        s1.execute("create table j2 (k int, w int)")
+        s1.execute("insert into j2 values " + ",".join(
+            f"({i % 64},{i})" for i in range(256)))
+        s2 = sanitized_session(catalog=s1.catalog)
+        s1.execute("set tidb_tpu_join_probe_mode = 'xla'")
+        s2.execute("set tidb_tpu_join_probe_mode = 'off'")
+        q = ("select sum(j1.v + j2.w) from j1 join j2 on j1.k = j2.k")
+        r1 = s1.query(q)
+        r2 = s2.query(q)
+        assert r1 == r2
+        assert hash_probe._mode == before, \
+            "a statement wrote the process global"
+        assert not findings("shared-global-write")
+
+
+# ---------------------------------------------------------------------------
+# sanitized tier-1 subset: serving + columnar + pipeline + join, clean
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizedSubset:
+    """Representative workloads from the serving, columnar, pipeline,
+    and join suites run under the gate: results exact, zero fatal
+    findings (a SanitizerError would fail the statement loudly)."""
+
+    def _bulk(self, s, name, n, mod=97):
+        s.execute(f"create table {name} (a int, b int, c int)")
+        t = s.catalog.table("test", name)
+        rng = np.random.default_rng(7)
+        t.insert_columns({
+            "a": np.arange(n, dtype=np.int64),
+            "b": np.asarray(rng.integers(0, mod, n), dtype=np.int64),
+            "c": np.asarray(rng.integers(0, 1000, n), dtype=np.int64)})
+        return t
+
+    def test_columnar_scan_prune_and_spill_clean(self):
+        s = sanitized_session(chunk_capacity=1 << 12)
+        s.execute("set tidb_tpu_segment_rows = 2048")
+        t = self._bulk(s, "t", 10000)
+        a = t.data["a"][:10000]
+        b = t.data["b"][:10000]
+        want = int(b[(a >= 8000)].sum())
+        got = s.query("select sum(b) from t where a >= 8000")[0][0]
+        assert int(got) == want
+        # budget-capped rescan: spill path under the gate (device cache
+        # off so the budget actually engages, per the PR 9 gotcha)
+        s.execute("set global tidb_tpu_device_buffer_cache_bytes = 0")
+        s.execute("set tidb_mem_quota_query = 16777216")
+        for _ in range(2):
+            got = s.query("select sum(b) from t where a >= 2000")[0][0]
+            assert int(got) == int(b[(a >= 2000)].sum())
+
+    def test_pipeline_fused_agg_clean(self):
+        s = sanitized_session(chunk_capacity=1 << 12)
+        t = self._bulk(s, "t", 20000, mod=13)
+        b = t.data["b"][:20000]
+        c = t.data["c"][:20000]
+        rows = s.query(
+            "select b, count(*), sum(c) from t group by b order by b")
+        assert len(rows) == 13
+        for grp, cnt, total in rows:
+            m = b == int(grp)
+            assert int(cnt) == int(m.sum())
+            assert int(total) == int(c[m].sum())
+
+    def test_join_clean(self):
+        s = sanitized_session()
+        self._bulk(s, "f", 5000, mod=50)
+        s.execute("create table d (k int primary key, name int)")
+        s.execute("insert into d values " + ",".join(
+            f"({i},{i * 7})" for i in range(50)))
+        t = s.catalog.table("test", "f")
+        b = t.data["b"][:5000]
+        want = int(sum(b * 7 + b))
+        got = s.query(
+            "select sum(d.name + f.b) from f join d on f.b = d.k")[0][0]
+        assert int(got) == want
+
+    def test_serving_concurrent_clean(self):
+        from tidb_tpu.serving import StatementScheduler
+        from tidb_tpu.storage.catalog import Catalog
+
+        cat = Catalog()
+        boot = Session(catalog=cat)
+        boot.execute("set global tidb_tpu_sanitize = 1")
+        boot.execute("set global tidb_slow_log_threshold = 300000")
+        boot.execute("set global tidb_trace_sample_rate = 0")
+        boot.execute("set global tidb_tpu_batch_window_us = 20000")
+        boot.execute(
+            "create table t (id bigint primary key, v bigint)")
+        boot.execute("insert into t values " + ",".join(
+            f"({i},{i * 11})" for i in range(100)))
+        sched = StatementScheduler(cat, workers=3)
+        try:
+            sessions = [Session(catalog=cat) for _ in range(4)]
+            sids = [s.prepare("select v from t where id = ?")[0]
+                    for s in sessions]
+            results = [[] for _ in range(4)]
+            errors = []
+            barrier = threading.Barrier(4)
+
+            def client(ci):
+                barrier.wait()
+                for i in range(12):
+                    key = (ci * 17 + i * 5) % 100
+                    try:
+                        rs = sched.submit_prepared(
+                            sessions[ci], sids[ci], [key])
+                        results[ci].append((key, rs.rows))
+                    except Exception as e:  # noqa: BLE001 — asserted below
+                        errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(ci,))
+                       for ci in range(4)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert not errors, errors
+            for ci in range(4):
+                for key, rows in results[ci]:
+                    assert rows == [(key * 11,)]
+        finally:
+            sched.shutdown()
+        fatal = [f for f in san.report()["findings"] if f["fatal"]]
+        assert not fatal, fatal
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

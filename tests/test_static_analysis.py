@@ -28,6 +28,9 @@ FIXTURES = os.path.join(ROOT, "tests", "analysis_fixtures")
 sys.path.insert(0, ROOT) if ROOT not in sys.path else None
 
 from tidb_tpu.analysis import Driver  # noqa: E402
+from tidb_tpu.analysis.blocking_under_lock import (  # noqa: E402
+    BlockingUnderLockPass,
+)
 from tidb_tpu.analysis.core import Project  # noqa: E402
 from tidb_tpu.analysis.error_shape import ErrorShapePass  # noqa: E402
 from tidb_tpu.analysis.host_sync import (  # noqa: E402
@@ -39,6 +42,9 @@ from tidb_tpu.analysis.lock_discipline import (  # noqa: E402
     LockDisciplinePass,
 )
 from tidb_tpu.analysis.registry import SysvarCoveragePass  # noqa: E402
+from tidb_tpu.analysis.resource_lifecycle import (  # noqa: E402
+    ResourceLifecyclePass,
+)
 
 
 def _mini_root(tmp_path, *files, sysvars=None, readme="# nothing\n"):
@@ -121,6 +127,7 @@ class TestRealTree:
             text=True, cwd=ROOT, timeout=120)
         assert proc.returncode == 0
         for pid in ("jit-hygiene", "host-sync", "lock-discipline",
+                    "resource-lifecycle", "blocking-under-lock",
                     "metrics-coverage", "failpoint-coverage",
                     "sysvar-coverage", "error-shape"):
             assert pid in proc.stdout
@@ -268,12 +275,13 @@ class TestColumnarScope:
         assert unlocked, [v.render() for v in rep.violations]
 
     def test_gather_wait_under_foreign_lock_is_flagged(self, tmp_path):
-        """ISSUE 7 serving discipline: a cv.wait() while holding another
+        """ISSUE 7 serving discipline (generalized into the ISSUE 12
+        blocking-under-lock pass): a cv.wait() while holding another
         lock (the batch gather window parked with the catalog lock held)
         is flagged; waiting with only the cv's own lock is not."""
         root = _mini_root(tmp_path, ("serving", "bad_gather_wait.py"))
-        p = LockDisciplinePass(
-            modules=(), wait_modules=("tidb_tpu/serving/bad_gather_wait.py",))
+        p = BlockingUnderLockPass(
+            modules=("tidb_tpu/serving/bad_gather_wait.py",))
         rep, _ = _run_pass(root, p)
         hits = [v for v in rep.violations if "wait()" in v.message]
         # the plain nested-with site AND the one inside a match arm
@@ -282,12 +290,12 @@ class TestColumnarScope:
         assert all("gather-window" in v.message for v in hits)
 
     def test_real_serving_modules_wait_lock_free(self):
-        """The real serving tier must pass its own wait discipline (the
-        default wait_modules cover scheduler.py + batcher.py)."""
-        from tidb_tpu.analysis.lock_discipline import DEFAULT_WAIT_MODULES
+        """The real serving tier must pass its own blocking discipline
+        (the default modules cover scheduler.py + batcher.py)."""
+        from tidb_tpu.analysis.blocking_under_lock import DEFAULT_MODULES
 
-        assert any("batcher" in m for m in DEFAULT_WAIT_MODULES)
-        assert any("scheduler" in m for m in DEFAULT_WAIT_MODULES)
+        assert any("batcher" in m for m in DEFAULT_MODULES)
+        assert any("scheduler" in m for m in DEFAULT_MODULES)
 
     def test_real_modules_use_the_locked_suffix_convention(self):
         """The convention the pass leans on must hold: *_locked methods
@@ -472,6 +480,199 @@ class TestSuppressions:
         # the reason wraps onto a continuation comment line in the
         # fixture; the recorded reason must carry the whole sentence
         assert "signature key covering" in s.reason, s.reason
+
+
+class TestResourceLifecycleFixture:
+    """ISSUE 12 tentpole (a): acquire/release pairing."""
+
+    def test_leak_shapes_are_flagged(self, tmp_path):
+        root = _mini_root(tmp_path, ("executor", "bad_resource_leak.py"))
+        rep, hygiene = _run_pass(root, ResourceLifecyclePass())
+        msgs = [v.render() for v in rep.violations]
+        # exactly: the ENOSPC counter bump, the success-path-only
+        # ScanPin close, and the consume with no release anywhere —
+        # never the finally form, the return handoff, or the annotated
+        # handoff
+        assert len(rep.violations) == 3, msgs
+        assert any("seg.pins" in m and "success path" in m
+                   for m in msgs), msgs
+        assert any("ScanPin" in m and "success path" in m
+                   for m in msgs), msgs
+        assert any("consume" in m and "no matching release" in m
+                   for m in msgs), msgs
+        assert not hygiene.problems, hygiene.problems
+
+    def test_stale_lifecycle_annotation_is_flagged(self, tmp_path):
+        # an annotation governing no acquire would pre-allowlist a
+        # FUTURE leak on that line — flag it like stale host-sync notes
+        pkg = tmp_path / "tidb_tpu" / "executor"
+        pkg.mkdir(parents=True)
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "x.py").write_text(
+            "def f(xs):\n"
+            "    # lifecycle: covered acquire was refactored away\n"
+            "    return sum(xs)\n")
+        rep, _ = _run_pass(str(tmp_path), ResourceLifecyclePass())
+        assert any("stale lifecycle" in v.message
+                   for v in rep.violations), rep.violations
+
+    def test_reasonless_lifecycle_annotation_is_a_violation(self, tmp_path):
+        pkg = tmp_path / "tidb_tpu" / "executor"
+        pkg.mkdir(parents=True)
+        (tmp_path / "README.md").write_text("x")
+        (pkg / "x.py").write_text(
+            "def f(t, b):\n"
+            "    t.consume(b)  # lifecycle:\n")
+        _rep, hygiene = _run_pass(str(tmp_path), ResourceLifecyclePass())
+        assert any("lifecycle annotation without a reason" in v.message
+                   for v in hygiene.problems), hygiene.problems
+
+    def test_real_tree_is_clean(self, real_tree_reports):
+        rep = [r for r in real_tree_reports
+               if r.pass_id == "resource-lifecycle"][0]
+        assert not rep.violations, [v.render() for v in rep.violations]
+
+
+class TestBlockingUnderLockFixture:
+    """ISSUE 12 tentpole (b): no registered lock across a blocking call
+    — the columnar leaf-lock rule, machine-checked."""
+
+    def test_device_get_and_consume_under_lock_flagged(self, tmp_path):
+        root = _mini_root(tmp_path, ("executor", "bad_blocking_lock.py"))
+        p = BlockingUnderLockPass(
+            modules=("tidb_tpu/executor/bad_blocking_lock.py",))
+        rep, _ = _run_pass(root, p)
+        msgs = [v.render() for v in rep.violations]
+        # exactly the under-lock device fetch and consume — the
+        # snapshot-then-block form stays clean
+        assert len(rep.violations) == 2, msgs
+        assert any("device fetch" in m for m in msgs), msgs
+        assert any("re-enters spill" in m for m in msgs), msgs
+        assert all("self._lock" in m for m in msgs), msgs
+
+    def test_store_leaf_rule_holds_on_real_tree(self, real_tree_reports):
+        """The columnar 'store lock is a LEAF' comment is now a
+        machine-checked fact: store.py carries zero unsuppressed
+        blocking-under-lock violations."""
+        rep = [r for r in real_tree_reports
+               if r.pass_id == "blocking-under-lock"][0]
+        store = [v for v in rep.violations
+                 if v.path.endswith("columnar/store.py")]
+        assert not store, [v.render() for v in store]
+        assert not rep.violations, [v.render() for v in rep.violations]
+
+    def test_memory_account_lock_exception_is_documented(
+            self, real_tree_reports):
+        """utils/memory's spill-under-account-lock is the one sanctioned
+        exception — present as a SUPPRESSION (with its reason), never
+        silently invisible."""
+        rep = [r for r in real_tree_reports
+               if r.pass_id == "blocking-under-lock"][0]
+        mem = [(v, s) for v, s in rep.suppressed
+               if v.path.endswith("utils/memory.py")]
+        assert mem, "expected the documented account-lock suppression"
+        assert all(s.reason for _v, s in mem)
+
+
+class TestSuppressionCountPinned:
+    """ISSUE 12 satellite: the report's suppression count is a tier-1-
+    asserted number so allowlist drift is visible in review. Update the
+    constant DELIBERATELY when adding/removing a suppression."""
+
+    EXPECTED_SUPPRESSIONS = 26
+    # annotated-allowlist entries are the same drift class: a future
+    # `# lifecycle:` on a real leak must move a pinned number
+    EXPECTED_LIFECYCLE_ANNOTATIONS = 2
+
+    def test_suppression_count_is_pinned(self, real_tree_reports):
+        total = sum(len(r.suppressed) for r in real_tree_reports)
+        assert total == self.EXPECTED_SUPPRESSIONS, (
+            f"suppression count moved: {total} != "
+            f"{self.EXPECTED_SUPPRESSIONS}. If the change is deliberate "
+            "(a new documented exception, or one removed), update "
+            "EXPECTED_SUPPRESSIONS in the same commit.")
+
+    def test_lifecycle_annotation_count_is_pinned(self):
+        from tidb_tpu.analysis.resource_lifecycle import lifecycle_sites
+
+        sites = lifecycle_sites(Project(ROOT))
+        assert len(sites) == self.EXPECTED_LIFECYCLE_ANNOTATIONS, sites
+        for _rel, _line, reason in sites:
+            assert reason, sites
+
+    def test_no_stale_line_directives_in_tree(self, real_tree_reports):
+        """The stale-suppression sweep stays done: zero line-level
+        directives that no longer suppress anything."""
+        hygiene = [r for r in real_tree_reports
+                   if r.pass_id == "suppressions"][0]
+        stale = [v for v in hygiene.problems
+                 if "stale suppression" in v.message]
+        assert not stale, [v.render() for v in stale]
+
+
+class TestJsonAndChangedModes:
+    """ISSUE 12 satellite: machine-readable report + incremental lint
+    for the builder loop."""
+
+    def test_json_schema_round_trips(self, tmp_path):
+        import json
+
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--json"], capture_output=True,
+            text=True, cwd=ROOT, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        # round-trip: serialize -> parse -> identical document
+        assert json.loads(json.dumps(doc)) == doc
+        assert doc["schema"] == Driver.JSON_SCHEMA
+        assert doc["ok"] is True and doc["violation_count"] == 0
+        assert doc["suppression_count"] == \
+            TestSuppressionCountPinned.EXPECTED_SUPPRESSIONS
+        assert doc["lifecycle_annotation_count"] == \
+            TestSuppressionCountPinned.EXPECTED_LIFECYCLE_ANNOTATIONS
+        assert doc["host_sync_annotation_count"] > 0
+        ids = {p["id"] for p in doc["passes"]}
+        assert {"jit-hygiene", "host-sync", "lock-discipline",
+                "resource-lifecycle", "blocking-under-lock",
+                "error-shape", "suppressions"} <= ids
+        for p in doc["passes"]:
+            assert p["seconds"] >= 0
+            for v in p["violations"] + p["problems"]:
+                assert set(v) == {"pass", "path", "line", "message"}
+            for s in p["suppressed"]:
+                assert s["reason"]
+
+    def test_changed_mode_is_fast_and_clean(self):
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--changed",
+             "tidb_tpu/columnar/store.py", "tidb_tpu/utils/memory.py",
+             "tidb_tpu/executor/pipeline.py"],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # the ISSUE's builder-loop budget, with interpreter startup
+        assert elapsed < 5, f"--changed took {elapsed:.1f}s"
+
+    def test_changed_mode_catches_violations_in_the_diff(self, tmp_path):
+        """An incremental run over a file WITH a violation still fails:
+        restriction narrows scope, never strength."""
+        root = _mini_root(tmp_path, ("executor", "bad_blocking_lock.py"))
+        p = BlockingUnderLockPass(
+            modules=("tidb_tpu/executor/bad_blocking_lock.py",))
+        driver = Driver(root, [p],
+                        changed=["tidb_tpu/executor/bad_blocking_lock.py"])
+        reports = driver.run()
+        rep = [r for r in reports if r.pass_id == p.id][0]
+        assert len(rep.violations) == 2, \
+            [v.render() for v in rep.violations]
+        # and a restriction EXCLUDING the bad file sees nothing
+        driver2 = Driver(root, [BlockingUnderLockPass(
+            modules=("tidb_tpu/executor/bad_blocking_lock.py",))],
+            changed=["tidb_tpu/other.py"])
+        reports2 = driver2.run()
+        rep2 = [r for r in reports2 if r.pass_id == p.id][0]
+        assert not rep2.violations
 
 
 class TestShimBackCompat:

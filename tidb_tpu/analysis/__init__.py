@@ -8,19 +8,36 @@ handful of code invariants nothing used to enforce:
   * hot paths must not silently sync the host (ROADMAP items 1 and 3)
   * the multi-threaded DCN/coordinator layer must keep a cycle-free
     lock-acquisition order and never mutate shared state unlocked
+  * every acquired resource (pins, tracker charges, cursors, staging
+    generators, failpoint arms) must reach its release on every path
+    (ISSUE 12: resource-lifecycle)
+  * no registered lock may be held across a blocking call — waits,
+    device fetches, socket/file I/O, tracker consume (ISSUE 12:
+    blocking-under-lock, generalizing PR 7's wait discipline)
   * every registry (metrics, failpoints, sysvars) must stay covered
   * errors must stay typed, coded, and never silently swallowed
 
 ``scripts/check_invariants.py`` drives the passes (tier-1 via
-tests/test_static_analysis.py).  Suppressions require an inline reason:
+tests/test_static_analysis.py; ``--json`` for the machine-readable
+report, ``--changed <paths>`` for sub-second diff lints).
+Suppressions require an inline reason:
 
     # lint: disable=<pass>[,<pass>] -- <reason>            (line scope)
     # lint: module-disable=<pass> -- <reason>              (file scope)
     # host-sync: <reason>           (host-sync pass only; the annotated
                                      allowlist of intentional syncs)
+    # lifecycle: <reason>           (resource-lifecycle pass only; a
+                                     documented ownership handoff)
 
 A suppression with no reason is itself a violation, and every
-suppression is counted and reported so the allowlist stays visible.
+suppression is counted and reported so the allowlist stays visible
+(the count is tier-1-asserted, so drift shows up in review).
+
+The runtime half (ISSUE 12) lives in ``analysis/sanitizer.py``: a
+debug-mode witness behind ``tidb_tpu_sanitize`` that records lock
+orders, tracker/pin balances, and per-statement host-sync counts, and
+cross-checks them against the static model (see README "Sanitizer
+mode").
 """
 
 from tidb_tpu.analysis.core import (  # noqa: F401

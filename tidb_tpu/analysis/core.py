@@ -26,10 +26,14 @@ __all__ = ["Violation", "SourceFile", "Project", "Pass", "Driver",
            "all_passes"]
 
 # grammar (see package docstring): lint disables carry the pass list
-# and a `--`-separated reason; host-sync annotations carry a reason
+# and a `--`-separated reason; host-sync annotations carry a reason;
+# lifecycle annotations (ISSUE 12) document an acquire whose release
+# deliberately lives elsewhere (ownership handoff) — same shape as
+# host-sync: `# lifecycle: <why the release is guaranteed elsewhere>`
 _DISABLE_RE = re.compile(
     r"#\s*lint:\s*(module-)?disable=([a-z0-9_,-]+)\s*(?:--\s*(.*))?$")
 _HOST_SYNC_RE = re.compile(r"#\s*host-sync:\s*(.*)$")
+_LIFECYCLE_RE = re.compile(r"#\s*lifecycle:\s*(.*)$")
 
 
 @dataclass
@@ -67,6 +71,7 @@ class SourceFile:
         self.tree = ast.parse(self.text, filename=self.rel)
         self.suppressions: List[Suppression] = []
         self.host_sync_notes: Dict[int, str] = {}   # line -> reason
+        self.lifecycle_notes: Dict[int, str] = {}   # line -> reason
         # line -> innermost statement span (start, end): a directive
         # trailing a multi-line statement must govern the whole
         # statement, not just the physical line the comment sits on
@@ -110,6 +115,11 @@ class SourceFile:
             if m:
                 self.host_sync_notes[self._target_line(line)] = \
                     self._absorb_reason(m.group(1).strip(), line)
+                continue
+            m = _LIFECYCLE_RE.search(text)
+            if m:
+                self.lifecycle_notes[self._target_line(line)] = \
+                    self._absorb_reason(m.group(1).strip(), line)
 
     def _absorb_reason(self, reason: str, line: int) -> str:
         """A standalone directive's reason may wrap onto following
@@ -123,7 +133,8 @@ class SourceFile:
             text = self.lines[ln - 1].strip()
             if not text.startswith("#"):
                 break
-            if _DISABLE_RE.search(text) or _HOST_SYNC_RE.search(text):
+            if _DISABLE_RE.search(text) or _HOST_SYNC_RE.search(text) \
+                    or _LIFECYCLE_RE.search(text):
                 break  # a new directive starts its own reason
             reason = f"{reason} {text.lstrip('#').strip()}".strip()
         return reason
@@ -164,14 +175,31 @@ class SourceFile:
                 return ln, reason
         return None
 
+    def lifecycle_note(self, line: int) -> Optional[Tuple[int, str]]:
+        """`# lifecycle:` handoff annotation governing `line` (same
+        statement-span rules as host_sync_note)."""
+        if line in self.lifecycle_notes:
+            return line, self.lifecycle_notes[line]
+        for ln, reason in self.lifecycle_notes.items():
+            if self._same_stmt(ln, line):
+                return ln, reason
+        return None
+
 
 class Project:
-    """Lazily-parsed view of the repo: every .py under <root>/tidb_tpu."""
+    """Lazily-parsed view of the repo: every .py under <root>/tidb_tpu.
 
-    def __init__(self, root: str):
+    ``restrict`` (repo-relative paths) narrows the listing to a changed
+    subset — the ``--changed`` incremental mode parses (and checks) only
+    those files, which is what makes a diff lint land in well under a
+    second for the builder loop."""
+
+    def __init__(self, root: str, restrict: Optional[List[str]] = None):
         self.root = os.path.abspath(root)
         self._files: Dict[str, SourceFile] = {}
         self._listing: Optional[List[str]] = None
+        self.restrict = (None if restrict is None else
+                         {os.path.normpath(r) for r in restrict})
 
     def paths(self) -> List[str]:
         if self._listing is None:
@@ -181,6 +209,10 @@ class Project:
                 dirnames[:] = [d for d in dirnames if d != "__pycache__"]
                 out.extend(os.path.join(dirpath, f)
                            for f in filenames if f.endswith(".py"))
+            if self.restrict is not None:
+                out = [p for p in out
+                       if os.path.normpath(os.path.relpath(p, self.root))
+                       in self.restrict]
             self._listing = sorted(out)
         return self._listing
 
@@ -218,16 +250,20 @@ class PassReport:
     suppressed: List[Tuple[Violation, Suppression]] = field(
         default_factory=list)
     problems: List[Violation] = field(default_factory=list)     # bad directives
+    seconds: float = 0.0        # wall clock of this pass's run()
 
 
 class Driver:
     """Run passes, apply suppressions, render the report."""
 
-    def __init__(self, root: str, passes: Optional[List[Pass]] = None):
-        self.project = Project(root)
+    def __init__(self, root: str, passes: Optional[List[Pass]] = None,
+                 changed: Optional[List[str]] = None):
+        self.project = Project(root, restrict=changed)
         self.passes = passes if passes is not None else all_passes()
 
     def run(self) -> List[PassReport]:
+        import time as _time
+
         reports = []
         # directives are validated against the FULL pass registry, not
         # just the selected subset — `--pass error-shape` must not
@@ -235,6 +271,7 @@ class Driver:
         known = {p.id for p in all_passes()} | {p.id for p in self.passes}
         for p in self.passes:
             rep = PassReport(p.id)
+            t0 = _time.perf_counter()
             for v in p.run(self.project):
                 sf = self._file_for(v)
                 sup = sf.suppression_for(p.id, v.line) if sf else None
@@ -243,6 +280,7 @@ class Driver:
                     rep.suppressed.append((v, sup))
                 else:
                     rep.violations.append(v)
+            rep.seconds = _time.perf_counter() - t0
             reports.append(rep)
         # directive hygiene rides the first report: a suppression that
         # names no reason, an unknown pass id, or a line-level directive
@@ -276,6 +314,11 @@ class Driver:
                     hygiene.problems.append(Violation(
                         "suppressions", sf.rel, line,
                         "host-sync annotation without a reason"))
+            for line, reason in sf.lifecycle_notes.items():
+                if not reason:
+                    hygiene.problems.append(Violation(
+                        "suppressions", sf.rel, line,
+                        "lifecycle annotation without a reason"))
         reports.append(hygiene)
         return reports
 
@@ -307,8 +350,61 @@ class Driver:
                    f"{n_sup} suppressed (each with a recorded reason)")
         return "\n".join(out), (1 if bad else 0)
 
+    # JSON report schema version: bump on breaking shape changes — the
+    # builder loop and tier-1 round-trip test both pin it
+    JSON_SCHEMA = 1
+
+    def to_json(self, reports: List[PassReport]) -> dict:
+        """Machine-readable report: violations, suppressions, per-pass
+        timings, and the annotated-allowlist counts (host-sync syncs +
+        lifecycle handoffs — annotations are allowlist entries exactly
+        like suppressions, so drift in them must be machine-visible
+        too). The shape round-trips through json (tier-1 asserted) so
+        external builder loops can consume it without scraping."""
+        def _viol(v: Violation) -> dict:
+            return {"pass": v.pass_id, "path": v.path.replace(os.sep, "/"),
+                    "line": v.line, "message": v.message}
+
+        passes = []
+        n_bad = 0
+        n_sup = 0
+        for rep in reports:
+            issues = rep.violations + rep.problems
+            n_bad += len(issues)
+            n_sup += len(rep.suppressed)
+            passes.append({
+                "id": rep.pass_id,
+                "seconds": round(rep.seconds, 4),
+                "violations": [_viol(v) for v in rep.violations],
+                "problems": [_viol(v) for v in rep.problems],
+                "suppressed": [
+                    {"pass": rep.pass_id,
+                     "path": v.path.replace(os.sep, "/"), "line": v.line,
+                     "reason": s.reason} for v, s in rep.suppressed],
+            })
+        from tidb_tpu.analysis.host_sync import annotated_sites
+        from tidb_tpu.analysis.resource_lifecycle import lifecycle_sites
+
+        return {
+            "schema": Driver.JSON_SCHEMA,
+            "ok": n_bad == 0,
+            "violation_count": n_bad,
+            "suppression_count": n_sup,
+            "host_sync_annotation_count": len(annotated_sites(self.project)),
+            "lifecycle_annotation_count": len(lifecycle_sites(self.project)),
+            "passes": passes,
+        }
+
+
+# AST-only passes (no live engine import): the set the --changed
+# incremental mode runs over a diff — the registry passes need the whole
+# tree (a changed subset can't prove sysvar/metric coverage either way)
+AST_PASS_IDS = ("jit-hygiene", "host-sync", "lock-discipline",
+                "resource-lifecycle", "blocking-under-lock", "error-shape")
+
 
 def all_passes() -> List[Pass]:
+    from tidb_tpu.analysis.blocking_under_lock import BlockingUnderLockPass
     from tidb_tpu.analysis.error_shape import ErrorShapePass
     from tidb_tpu.analysis.host_sync import HostSyncPass
     from tidb_tpu.analysis.jit_hygiene import JitHygienePass
@@ -318,11 +414,14 @@ def all_passes() -> List[Pass]:
         MetricsCoveragePass,
         SysvarCoveragePass,
     )
+    from tidb_tpu.analysis.resource_lifecycle import ResourceLifecyclePass
 
     return [
         JitHygienePass(),
         HostSyncPass(),
         LockDisciplinePass(),
+        ResourceLifecyclePass(),
+        BlockingUnderLockPass(),
         MetricsCoveragePass(),
         FailpointCoveragePass(),
         SysvarCoveragePass(),

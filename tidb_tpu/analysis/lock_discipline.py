@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tidb_tpu.analysis.core import Pass, Project, SourceFile, Violation
 
-__all__ = ["LockDisciplinePass"]
+__all__ = ["LockDisciplinePass", "static_lock_edges"]
 
 _MUTATORS = {
     "append", "extend", "add", "remove", "discard", "pop", "popitem",
@@ -51,15 +51,9 @@ DEFAULT_MODULES = (
     "tidb_tpu/columnar/store.py",
 )
 
-# serving-tier gather discipline (ISSUE 7): modules where a blocking
-# wait() must never park the thread while it holds any OTHER lock — the
-# batch gather window with (say) the catalog statement lock held would
-# stall every singleton statement and every other batch's device
-# dispatch for the whole window
-DEFAULT_WAIT_MODULES = (
-    "tidb_tpu/serving/scheduler.py",
-    "tidb_tpu/serving/batcher.py",
-)
+# NOTE: the serving-tier wait-discipline check (ISSUE 7) moved to
+# blocking_under_lock.py (ISSUE 12), which generalizes it — waits are
+# one of several blocking-call kinds no registered lock may span.
 
 
 def _is_threading_ctor(node: ast.AST, names: Sequence[str]) -> bool:
@@ -241,30 +235,62 @@ class _ClassScan:
             self.mutations.append((attr, fn.name, line, locked))
 
 
+def _scan_modules(project: Project, modules: Sequence[str]
+                  ) -> List["_ClassScan"]:
+    scans: List[_ClassScan] = []
+    for sf in project.files():
+        if sf.rel not in modules:
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cs = _ClassScan(sf, node)
+                cs.scan()
+                scans.append(cs)
+    return scans
+
+
+def _edges_of(scans: List["_ClassScan"]) -> Dict[str, Dict[str, str]]:
+    edges: Dict[str, Dict[str, str]] = {}
+    acquires_of: Dict[Tuple[str, str], Set[str]] = {}
+    for cs in scans:
+        for m, acq in cs.method_acquires.items():
+            acquires_of[(cs.cls.name, m)] = acq
+    for cs in scans:
+        for a, b, loc in cs.edges:
+            edges.setdefault(a, {}).setdefault(b, loc)
+        for held, method, loc in cs.deferred_calls:
+            for b in acquires_of.get((cs.cls.name, method), ()):
+                if b != held:
+                    edges.setdefault(held, {}).setdefault(
+                        b, f"{loc} (via {method}())")
+    return edges
+
+
+def static_lock_edges(root: str,
+                      modules: Sequence[str] = DEFAULT_MODULES
+                      ) -> Dict[str, Dict[str, str]]:
+    """The static acquisition-order graph (A -> {B: site}) over the
+    registered lock modules — what the AST can prove. The runtime
+    sanitizer (analysis/sanitizer.py) diffs its witnessed orders
+    against this: a runtime edge absent here came through a path the
+    AST cannot see (a prefetch thread, a scheduler worker, a
+    finalizer) and is exactly what the witness exists to surface."""
+    project = Project(root)
+    mods = tuple(m.replace("/", os.sep) for m in modules)
+    return _edges_of(_scan_modules(project, mods))
+
+
 class LockDisciplinePass(Pass):
     id = "lock-discipline"
     doc = ("no lock-acquisition-order cycles; no attribute mutated both "
-           "under a lock and without one; no blocking wait() while "
-           "holding another lock in the serving tier")
+           "under a lock and without one")
 
-    def __init__(self, modules: Sequence[str] = DEFAULT_MODULES,
-                 wait_modules: Sequence[str] = DEFAULT_WAIT_MODULES):
+    def __init__(self, modules: Sequence[str] = DEFAULT_MODULES):
         self.modules = tuple(m.replace("/", os.sep) for m in modules)
-        self.wait_modules = tuple(m.replace("/", os.sep)
-                                  for m in wait_modules)
 
     def run(self, project: Project) -> List[Violation]:
         out: List[Violation] = []
-        out.extend(self._check_waits(project))
-        scans: List[_ClassScan] = []
-        for sf in project.files():
-            if sf.rel not in self.modules:
-                continue
-            for node in sf.tree.body:
-                if isinstance(node, ast.ClassDef):
-                    cs = _ClassScan(sf, node)
-                    cs.scan()
-                    scans.append(cs)
+        scans = _scan_modules(project, self.modules)
 
         # -- mixed locked/unlocked mutation --------------------------------
         for cs in scans:
@@ -290,19 +316,7 @@ class LockDisciplinePass(Pass):
                         "suppress with the confinement argument."))
 
         # -- acquisition-order cycles --------------------------------------
-        edges: Dict[str, Dict[str, str]] = {}
-        acquires_of: Dict[Tuple[str, str], Set[str]] = {}
-        for cs in scans:
-            for m, acq in cs.method_acquires.items():
-                acquires_of[(cs.cls.name, m)] = acq
-        for cs in scans:
-            for a, b, loc in cs.edges:
-                edges.setdefault(a, {}).setdefault(b, loc)
-            for held, method, loc in cs.deferred_calls:
-                for b in acquires_of.get((cs.cls.name, method), ()):
-                    if b != held:
-                        edges.setdefault(held, {}).setdefault(
-                            b, f"{loc} (via {method}())")
+        edges = _edges_of(scans)
         cycle = self._find_cycle(edges)
         if cycle is not None:
             path, locs = cycle
@@ -313,88 +327,6 @@ class LockDisciplinePass(Pass):
                 + " -> ".join(path)
                 + " ; acquisition sites: " + "; ".join(locs)))
         return out
-
-    # -- gather-window wait discipline (serving tier) -------------------
-
-    def _check_waits(self, project: Project) -> List[Violation]:
-        """Flag `X.wait(...)` reached while a `with`-acquired lock OTHER
-        than X itself is held. Condition.wait releases only its OWN
-        lock; any other lock held across the wait is held for the whole
-        gather window (and, transitively, across other batches' device
-        dispatches — the exact stall ISSUE 7 forbids)."""
-        out: List[Violation] = []
-        for sf in project.files():
-            if sf.rel not in self.wait_modules:
-                continue
-            for node in ast.walk(sf.tree):
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    self._walk_waits(sf, node.body, (), out)
-        return out
-
-    def _walk_waits(self, sf: SourceFile, stmts, held, out) -> None:
-        for stmt in stmts:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-                continue  # closure/method bodies run later, outside
-                # this lock scope (methods get their own walk from run())
-            for node in ast.walk(stmt) if not isinstance(
-                    stmt, (ast.With, ast.AsyncWith, ast.For, ast.AsyncFor,
-                           ast.While, ast.If, ast.Try, ast.Match)) else ():
-                self._flag_wait(sf, node, held, out)
-            if isinstance(stmt, (ast.With, ast.AsyncWith)):
-                new = list(held)
-                for item in stmt.items:
-                    ctx = item.context_expr
-                    # only attribute/name contexts count as locks —
-                    # `with host_eager():` / `with tracing.span(...):`
-                    # are not synchronization
-                    if isinstance(ctx, (ast.Attribute, ast.Name)):
-                        new.append(ast.unparse(ctx))
-                    for sub in ast.walk(ctx):
-                        self._flag_wait(sf, sub, held, out)
-                self._walk_waits(sf, stmt.body, tuple(new), out)
-            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
-                for sub in ast.walk(stmt.iter if isinstance(
-                        stmt, (ast.For, ast.AsyncFor)) else stmt.test):
-                    self._flag_wait(sf, sub, held, out)
-                self._walk_waits(sf, stmt.body, held, out)
-                self._walk_waits(sf, stmt.orelse, held, out)
-            elif isinstance(stmt, ast.If):
-                for sub in ast.walk(stmt.test):
-                    self._flag_wait(sf, sub, held, out)
-                self._walk_waits(sf, stmt.body, held, out)
-                self._walk_waits(sf, stmt.orelse, held, out)
-            elif isinstance(stmt, ast.Try):
-                self._walk_waits(sf, stmt.body, held, out)
-                for h in stmt.handlers:
-                    self._walk_waits(sf, h.body, held, out)
-                self._walk_waits(sf, stmt.orelse, held, out)
-                self._walk_waits(sf, stmt.finalbody, held, out)
-            elif isinstance(stmt, ast.Match):
-                for sub in ast.walk(stmt.subject):
-                    self._flag_wait(sf, sub, held, out)
-                for case in stmt.cases:
-                    if case.guard is not None:
-                        for sub in ast.walk(case.guard):
-                            self._flag_wait(sf, sub, held, out)
-                    self._walk_waits(sf, case.body, held, out)
-
-    def _flag_wait(self, sf: SourceFile, node, held, out) -> None:
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in ("wait", "wait_for")):
-            return
-        target = ast.unparse(node.func.value)
-        others = [h for h in held if h != target]
-        if others:
-            out.append(Violation(
-                self.id, sf.rel, node.lineno,
-                f"blocking {node.func.attr}() on `{target}` while holding "
-                f"{', '.join(sorted(set(others)))} — a gather-window wait "
-                "must not park the worker with another lock held (it "
-                "stalls every statement and batch dispatch behind that "
-                "lock for the whole window). Release the outer lock "
-                "before waiting."))
 
     @staticmethod
     def _find_cycle(edges: Dict[str, Dict[str, str]]

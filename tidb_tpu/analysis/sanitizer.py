@@ -1,0 +1,406 @@
+"""Runtime invariant sanitizer (ISSUE 12): records what actually
+happens and diffs it against the static model.
+
+The static passes prove what the AST can see. This layer witnesses the
+rest at runtime, behind the ``tidb_tpu_sanitize`` sysvar (or the
+``TIDB_TPU_SANITIZE`` env var for whole-process runs):
+
+  * **lock-order witness** — the registered engine locks (created via
+    :func:`tracked_lock`) record every nested acquisition into a
+    process-global order graph, across ALL threads: orders threaded
+    through prefetch threads, scheduler workers, and weakref finalizers
+    that the AST cannot see. The graph is cycle-checked at statement
+    end, and :func:`diff_static` diffs it against the static lock graph
+    (analysis/lock_discipline.static_lock_edges) — a runtime edge the
+    static model lacks is exactly the blind spot this exists to light.
+  * **tracker balance** — MemTracker release()/detach() report typed
+    findings: a double release (consumed below zero) is fatal; a
+    detach-time residual (bytes the statement never release()d) is a
+    recorded leak witness (the engine's detach() reclaims it by design,
+    so it stays non-fatal but visible).
+  * **pin balance** — every ScanPin opened during a statement must be
+    closed by statement end; a leaked pin is a fatal finding (the class
+    of bug that surfaces later as spurious typed OOM).
+  * **host-sync budget** — a per-statement counter of
+    ``jax.device_get`` round trips (the sanctioned sync chokepoint is
+    patched while enabled), asserted against the statement's declared
+    budget (``tidb_tpu_sanitize_sync_budget``).
+  * **shared-global witness** — registered process-global writes (e.g.
+    ``ops.hash_probe.set_mode``) during ANY in-flight statement are
+    fatal findings: the set_mode race documented in PR 10 is the
+    founding member of this class.
+
+Import-time this module is stdlib-only (the analyzer contract: never
+pull jax into the CLI); the device_get patch imports jax lazily at
+enable() — which only ever runs inside a live engine process.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["enabled", "enable", "disable", "tracked_lock", "TrackedLock",
+           "statement_begin", "statement_end", "Finding", "report",
+           "diff_static", "check_lock_cycle", "reset",
+           "note_tracker_release", "note_tracker_detach",
+           "note_pin_open", "note_pin_close", "note_global_write",
+           "count_sync"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+_FINDINGS_CAP = 256
+
+
+def env_gate() -> bool:
+    """The TIDB_TPU_SANITIZE env seed, with conventional falsy strings
+    honored — `TIDB_TPU_SANITIZE=0` must DISABLE, not enable (a bare
+    bool() on the string "0" is True)."""
+    v = os.environ.get("TIDB_TPU_SANITIZE", "")
+    return v.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+@dataclass
+class Finding:
+    kind: str          # lock-cycle | tracker-double-release | ...
+    subject: str       # what it happened to (lock names, tracker label)
+    detail: str
+    fatal: bool = True
+    thread: str = ""
+
+    def render(self) -> str:
+        sev = "FATAL" if self.fatal else "note"
+        return f"[sanitizer:{self.kind}] {sev} {self.subject}: {self.detail}"
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.enabled = env_gate()
+        self.findings: List[Finding] = []
+        # runtime lock graph: a -> {b: "thread/site"} for every b
+        # acquired while a was held on the same thread
+        self.edges: Dict[str, Dict[str, str]] = {}
+        self.active_scopes = 0
+        self.dropped = 0
+        self._jax_patch = None  # (module, original device_get)
+
+
+_ST = _State()
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return _ST.enabled
+
+
+def enable() -> None:
+    """Turn the witness on process-wide and patch the sanctioned sync
+    chokepoint. Idempotent and STICKY: the first sanitized statement
+    enables it for the whole process (the lock graph must span
+    sessions/threads to witness cross-session orders), and flipping
+    the sysvar off stops per-statement scopes but leaves the witness
+    recording until an explicit disable() — debug mode is per-process,
+    not per-session (README "Sanitizer mode")."""
+    with _ST.lock:
+        if _ST.enabled and _ST._jax_patch is not None:
+            return
+        _ST.enabled = True
+        if _ST._jax_patch is None:
+            try:
+                import jax
+            except Exception:  # noqa: BLE001 — CLI/lint contexts have
+                # no jax; the lock/tracker witnesses still work
+                return
+            orig = jax.device_get
+
+            def counted_device_get(x):
+                count_sync()
+                return orig(x)
+
+            jax.device_get = counted_device_get
+            _ST._jax_patch = (jax, orig)
+
+
+def disable(reset_state: bool = True) -> None:
+    with _ST.lock:
+        _ST.enabled = False
+        if _ST._jax_patch is not None:
+            mod, orig = _ST._jax_patch
+            mod.device_get = orig
+            _ST._jax_patch = None
+        if reset_state:
+            reset()
+
+
+def reset() -> None:
+    """Drop all witnessed state (tests isolate through this)."""
+    with _ST.lock:
+        _ST.findings = []
+        _ST.edges = {}
+        _ST.dropped = 0
+
+
+def _add_finding(f: Finding) -> None:
+    f.thread = threading.current_thread().name
+    with _ST.lock:
+        if len(_ST.findings) >= _FINDINGS_CAP:
+            _ST.dropped += 1
+            return
+        _ST.findings.append(f)
+
+
+# -- lock witness -----------------------------------------------------------
+
+
+def _held() -> List[str]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class TrackedLock:
+    """Wrapper around a threading lock that records nested-acquisition
+    order while the sanitizer is enabled. Transparent otherwise (one
+    attribute check per acquire). Condition() interop works by
+    delegation: ``_release_save``/``_acquire_restore``/``_is_owned``
+    resolve to the inner lock, so a cv built over a tracked lock parks
+    and resumes exactly like an untracked one (the held stack keeps the
+    name across the wait — consistent, since the lock is re-acquired
+    before the waiter continues)."""
+
+    __slots__ = ("name", "_lk")
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._lk = inner
+
+    def acquire(self, *args, **kwargs):
+        got = self._lk.acquire(*args, **kwargs)
+        if got and _ST.enabled:
+            held = _held()
+            me = self.name
+            with _ST.lock:
+                for h in set(held):
+                    if h != me:
+                        _ST.edges.setdefault(h, {}).setdefault(
+                            me, threading.current_thread().name)
+            held.append(me)
+        return got
+
+    def release(self):
+        # pop UNCONDITIONALLY: a disable() landing while this thread is
+        # inside its critical section must not strand the name on the
+        # held stack (a stale entry would mint phantom order edges —
+        # and phantom cycles — after the next enable). Only acquire's
+        # edge recording is gated on the flag.
+        held = getattr(_tls, "held", None)
+        if held:
+            # remove the LAST occurrence (reentrant locks stack)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == self.name:
+                    del held[i]
+                    break
+        self._lk.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lk.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._lk, name)
+
+
+def tracked_lock(name: str, factory=threading.Lock) -> TrackedLock:
+    """A registered engine lock: ``self._lock =
+    tracked_lock("SegmentStore._lock")``. Names follow the static
+    graph's ``Class.attr`` convention so diff_static lines up."""
+    return TrackedLock(name, factory())
+
+
+def lock_edges() -> Dict[str, Dict[str, str]]:
+    with _ST.lock:
+        return {a: dict(bs) for a, bs in _ST.edges.items()}
+
+
+def check_lock_cycle() -> Optional[Finding]:
+    """DFS the runtime graph; a cycle is a witnessed deadlock order."""
+    from tidb_tpu.analysis.lock_discipline import LockDisciplinePass
+
+    cyc = LockDisciplinePass._find_cycle(lock_edges())
+    if cyc is None:
+        return None
+    path, locs = cyc
+    f = Finding("lock-cycle", " -> ".join(path),
+                "runtime acquisition-order cycle witnessed across "
+                f"threads: {'; '.join(locs)}")
+    _add_finding(f)
+    return f
+
+
+def diff_static(root: str = _REPO_ROOT) -> dict:
+    """Diff the witnessed lock graph against the static model. Returns
+    {"novel": [(a, b, thread)], "static_only": [(a, b)]} — novel edges
+    came through paths the AST cannot see (callbacks, worker threads,
+    finalizers); they are the witness's yield, not violations."""
+    from tidb_tpu.analysis.lock_discipline import static_lock_edges
+
+    static = static_lock_edges(root)
+    runtime = lock_edges()
+    novel = [(a, b, thr) for a, bs in runtime.items()
+             for b, thr in bs.items() if b not in static.get(a, {})]
+    static_only = [(a, b) for a, bs in static.items()
+                   for b in bs if b not in runtime.get(a, {})]
+    return {"novel": sorted(novel), "static_only": sorted(static_only)}
+
+
+# -- tracker / pin / global hooks ------------------------------------------
+
+
+def note_tracker_release(label: str, consumed: int) -> None:
+    """Called by MemTracker.release when a tracker's balance went
+    negative — more bytes released than were ever consumed."""
+    _add_finding(Finding(
+        "tracker-double-release", label,
+        f"released below zero (consumed={consumed}) — some charge was "
+        "returned twice"))
+
+
+def note_tracker_detach(label: str, residual: int) -> None:
+    """Called by MemTracker.detach for a nonzero residual: bytes the
+    statement consumed and never released. detach() reclaims them (by
+    design), so this is a leak WITNESS, not a failure."""
+    _add_finding(Finding(
+        "tracker-residual", label,
+        f"{residual} bytes never release()d before detach "
+        "(reclaimed by detach; leak witness)", fatal=False))
+
+
+def note_pin_open(pin) -> None:
+    sc = _current_scope()
+    if sc is not None:
+        sc.pins[id(pin)] = pin
+
+
+def note_pin_close(pin) -> None:
+    sc = _current_scope()
+    if sc is not None:
+        sc.pins.pop(id(pin), None)
+
+
+def note_global_write(name: str, value) -> None:
+    """A registered process-global was written. During ANY in-flight
+    statement that is a race with every other session reading it at
+    trace time (the hash_probe.set_mode class) — fatal."""
+    with _ST.lock:
+        active = _ST.active_scopes
+    if active > 0:
+        _add_finding(Finding(
+            "shared-global-write", name,
+            f"process-global written to {value!r} while {active} "
+            "statement(s) were in flight — thread the value through "
+            "ExecContext/fragment args instead"))
+
+
+def count_sync() -> None:
+    sc = _current_scope()
+    if sc is not None:
+        sc.syncs += 1
+
+
+# -- statement scope --------------------------------------------------------
+
+
+@dataclass
+class _StmtScope:
+    sync_budget: Optional[int]
+    start_idx: int
+    pins: Dict[int, object] = field(default_factory=dict)
+    syncs: int = 0
+
+
+def _current_scope() -> Optional[_StmtScope]:
+    scopes = getattr(_tls, "scopes", None)
+    return scopes[-1] if scopes else None
+
+
+def statement_begin(sync_budget: Optional[int] = None) -> _StmtScope:
+    scopes = getattr(_tls, "scopes", None)
+    if scopes is None:
+        scopes = _tls.scopes = []
+    with _ST.lock:
+        sc = _StmtScope(sync_budget, len(_ST.findings))
+        _ST.active_scopes += 1
+    scopes.append(sc)
+    return sc
+
+
+def statement_end(scope: _StmtScope) -> List[Finding]:
+    """Close the scope and return every finding it produced: leaked
+    pins, a blown sync budget, witnessed lock cycles, and any global
+    findings recorded while it ran."""
+    scopes = getattr(_tls, "scopes", None)
+    if scopes and scopes[-1] is scope:
+        scopes.pop()
+    elif scopes and scope in scopes:
+        scopes.remove(scope)
+    with _ST.lock:
+        _ST.active_scopes = max(_ST.active_scopes - 1, 0)
+    out: List[Finding] = []
+    for pin in scope.pins.values():
+        f = Finding(
+            "pin-leak", type(pin).__name__,
+            "opened during the statement and never closed — its charges "
+            "and segment references outlive the statement (surfaces "
+            "later as spurious typed OOM / stuck eviction)")
+        _add_finding(f)
+        out.append(f)
+    if scope.sync_budget is not None and scope.syncs > scope.sync_budget:
+        f = Finding(
+            "host-sync-budget", "statement",
+            f"{scope.syncs} device_get round trips > declared budget "
+            f"{scope.sync_budget} — a per-chunk sync storm the "
+            "pipelined executor exists to remove")
+        _add_finding(f)
+        out.append(f)
+    cyc = check_lock_cycle()
+    if cyc is not None and cyc not in out:
+        out.append(cyc)
+    # collect global findings recorded while this scope ran, but only
+    # those witnessed ON THIS THREAD: statement scopes are per-thread,
+    # and blaming statement B for a pin statement A leaked (they merely
+    # overlapped) would cascade one bug into typed failures on every
+    # innocent concurrent statement. Off-thread findings (prefetch
+    # workers, other sessions) stay visible in report().
+    me = threading.current_thread().name
+    with _ST.lock:
+        for f in _ST.findings[scope.start_idx:]:
+            if f.thread == me and f not in out:
+                out.append(f)
+    return out
+
+
+def report() -> dict:
+    """Snapshot for tests/tools: findings + the witnessed lock graph."""
+    with _ST.lock:
+        findings = list(_ST.findings)
+        dropped = _ST.dropped
+    return {
+        "enabled": _ST.enabled,
+        "findings": [
+            {"kind": f.kind, "subject": f.subject, "detail": f.detail,
+             "fatal": f.fatal, "thread": f.thread} for f in findings],
+        "dropped": dropped,
+        "lock_edges": lock_edges(),
+    }

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tidb_tpu.analysis import sanitizer as _san
 from tidb_tpu.columnar.encoding import Encoding, encode_column
 from tidb_tpu.columnar.spillfile import SegmentSpillFile, make_spill_dir
 from tidb_tpu.columnar.zonemap import ZoneMap, build_zone_map, segment_pruned
@@ -158,7 +159,10 @@ class SegmentStore:
         # invalidated segments still referenced by in-flight scans;
         # freed by the last release_planned
         self._retired: List[Segment] = []
-        self._lock = threading.Lock()
+        # the LEAF lock (module doc); registered with the sanitizer's
+        # runtime order witness so a violation of leaf-ness through any
+        # callback path shows up as a witnessed edge/cycle
+        self._lock = _san.tracked_lock("SegmentStore._lock")
 
     # -- build / refresh ---------------------------------------------------
 
@@ -230,6 +234,9 @@ class SegmentStore:
             covered = self.covered
             if pin is not None:
                 for s in segs:
+                    # lifecycle: each ref is handed to the pin (extended
+                    # into pin.planned below under this same lock);
+                    # ScanPin.close() -> release_planned drops them all
                     s.refs += 1
                 pin.planned.extend(segs)
         if bounds:
@@ -419,6 +426,8 @@ class ScanPin:
         self.planned: List[Segment] = []  # ref-counted via plan_scan
         self._current: Optional[Segment] = None
         self.closed = False
+        if _san.enabled():
+            _san.note_pin_open(self)  # balanced at statement end
 
     def touch(self, seg: Segment) -> None:
         """Pin `seg` for staging (unpins the previously staged one) and
@@ -465,6 +474,8 @@ class ScanPin:
         if self.closed:
             return
         self.closed = True
+        if _san.enabled():
+            _san.note_pin_close(self)
         if self._current is not None:
             self.store.unpin_segment(self._current)
             self._current = None
@@ -479,7 +490,7 @@ class ScanPin:
 
 # -- store lifecycle --------------------------------------------------------
 
-_CREATE_LOCK = threading.Lock()
+_CREATE_LOCK = _san.tracked_lock("columnar._CREATE_LOCK")
 
 
 def _base_of(table):

@@ -105,3 +105,14 @@ class SchedulerQueueTimeoutError(ExecutionError):
     code = 9008  # same busy-class error: the server is saturated
 
 
+class SanitizerError(ExecutionError):
+    """The runtime invariant sanitizer (tidb_tpu_sanitize, ISSUE 12)
+    witnessed a broken engine invariant during this statement: a leaked
+    pin, a tracker double-release, a lock-order cycle, a blown
+    host-sync budget, or a raced process global. Debug mode only — the
+    statement's RESULT was produced normally; the error reports the
+    invariant breach so it fails loudly in sanitized runs."""
+
+    code = 1105  # ER_UNKNOWN_ERROR (engine-internal diagnostic)
+
+

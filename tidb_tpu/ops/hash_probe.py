@@ -57,14 +57,26 @@ import os
 # Auto keeps CPU on searchsorted because it measures faster there
 # (bench.py bench_probe — 32 fixed window rounds vs ~2*log2(Rb)
 # cache-friendly binary rounds) while TPU gets the VMEM-resident table
-# instead of O(log Rb) dependent HBM gather rounds per element. The
-# session wires tidb_tpu_join_probe_mode through set_mode; the env var
-# only seeds the pre-session default (offline tools, bare fragments).
+# instead of O(log Rb) dependent HBM gather rounds per element.
+# Sessions thread tidb_tpu_join_probe_mode PER STATEMENT through
+# ExecContext/fragment args (ISSUE 12 — the old per-statement set_mode
+# write raced concurrent sessions); this global is only the default
+# for offline tools and bare fragments, seeded by the env var.
 _mode = os.environ.get("TIDB_HASH_PROBE", "auto")
 
 
 def set_mode(m: str) -> None:
+    """Seed the PROCESS-WIDE default probe mode. Offline tools and bare
+    fragments only: engine statements thread the session's resolved
+    mode per-statement (ExecContext.join_probe_mode -> fragment args,
+    ISSUE 12), so concurrent sessions never race this global. The
+    sanitizer's shared-mutable-global witness flags any write that
+    lands while a statement is in flight."""
     global _mode
+    from tidb_tpu.analysis import sanitizer as _san
+
+    if _san.enabled():
+        _san.note_global_write("ops.hash_probe._mode", m)
     _mode = m
 
 
@@ -81,14 +93,20 @@ def resolve_mode(mode: str = None) -> str:
     return m
 
 
-def probe_for_join(sorted_hashes: jax.Array, probes: jax.Array):
+def probe_for_join(sorted_hashes: jax.Array, probes: jax.Array,
+                   mode: str = None):
     """The fragment join's probe entry point: (lo, hi) ranges over the
-    sorted build hashes via the configured strategy."""
-    if _mode == "off" or (_mode == "auto" and not pallas_enabled()):
+    sorted build hashes via the configured strategy. ``mode`` is the
+    per-statement value threaded from ExecContext through the fragment
+    builder (ISSUE 12 — the trace-time global read raced concurrent
+    sessions); None falls back to the process default for offline
+    tools and bare fragments."""
+    m = _mode if mode is None else mode
+    if m == "off" or (m == "auto" and not pallas_enabled()):
         lo, hi = xla_probe_ranges(sorted_hashes, probes)
         return lo.astype(jnp.int64), hi.astype(jnp.int64)
     return probe_ranges(sorted_hashes, probes,
-                        use_pallas=(_mode == "pallas"))
+                        use_pallas=(m == "pallas"))
 
 MAX_PROBES = 32
 # three int32 tables of this capacity ~= 6 MiB of VMEM: dimension-sized

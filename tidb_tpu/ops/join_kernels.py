@@ -458,12 +458,14 @@ def sort_build_hashes(b_hash, b_live):
     return sh, cvi, order
 
 
-def probe_hash_ranges(sh, cvi, p_hash, p_ok):
+def probe_hash_ranges(sh, cvi, p_hash, p_ok, mode=None):
     """(lo, cnt) per probe row over a sorted build-hash array, through
     the configured probe strategy (ops/hash_probe: open-addressing table
-    on TPU, searchsorted elsewhere — identical range semantics)."""
+    on TPU, searchsorted elsewhere — identical range semantics).
+    ``mode`` threads the per-statement tidb_tpu_join_probe_mode from
+    the fragment args (ISSUE 12); None = process default."""
     from tidb_tpu.ops.hash_probe import probe_for_join
 
-    lo, hi = probe_for_join(sh, p_hash)
+    lo, hi = probe_for_join(sh, p_hash, mode=mode)
     cnt = jnp.where(p_ok, cvi[hi] - cvi[lo], 0)
     return lo, cnt

@@ -583,13 +583,20 @@ class DistFragmentExec(HashAggExec):
         knobs double; "expand"/"compact" jump to the reported required
         factor in one recompile (skewed joins can demand 100x+ at once).
         Returns (out, growths) or (None, growths) past the ceilings."""
+        # the statement's resolved probe mode becomes a trace-time
+        # static of the fragment program: it joins the cache key (a
+        # knob flip must not serve a program traced for the other
+        # strategy) and rides build_fn instead of the process global
+        # that concurrent sessions used to race (ISSUE 12)
+        probe_mode = getattr(self.ctx, "join_probe_mode", None)
         while True:
             # each retry pays a recompile: bail between attempts if the
             # statement was killed or ran out of its deadline
             raise_if_cancelled(self.ctx)
-            key = ("frag", prog.sig, growths, shapes_sig, types_sig)
+            key = ("frag", prog.sig, growths, shapes_sig, types_sig,
+                   probe_mode)
             fn = self._cache.get_fragment(
-                key, lambda: prog.build_fn(growths))
+                key, lambda: prog.build_fn(growths, probe_mode=probe_mode))
             out, ovf = fn(*args)
             # host-sync: the per-knob overflow vector (a few int64s)
             # gates the capacity-retry loop — one fetch per dispatch

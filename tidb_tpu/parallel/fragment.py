@@ -507,7 +507,10 @@ class _Compiler:
             b_live = bch2.sel & b_kvalid2
             sh, cvi, order = sort_build_hashes(b_hash2, b_live)
             p_ok = pch2.sel & p_kvalid2
-            lo, cnt = probe_hash_ranges(sh, cvi, p_hash2, p_ok)
+            # probe strategy threaded per-statement via build_fn (the
+            # module-global read raced concurrent sessions, ISSUE 12)
+            lo, cnt = probe_hash_ranges(sh, cvi, p_hash2, p_ok,
+                                        mode=env.get("probe_mode"))
 
             cum = jnp.cumsum(cnt)
             total = cum[-1]
@@ -804,9 +807,13 @@ def compile_fragment(agg: PHashAgg, mesh, n_parts: int,
     n_bc = len(c.broadcasts)
     n_knobs = c.n_growth
 
-    def build_fn(growths: Tuple[float, ...]):
+    def build_fn(growths: Tuple[float, ...], probe_mode: str = None):
+        # probe_mode: the statement's resolved tidb_tpu_join_probe_mode
+        # (trace-time STATIC — callers key their fragment cache on it so
+        # a knob flip can never serve a program traced for the other
+        # strategy); None = the hash_probe process default
         def frag(*args):
-            env = {"scan": [], "bcast": []}
+            env = {"scan": [], "bcast": [], "probe_mode": probe_mode}
             i = 0
             for _ in range(n_src):
                 env["scan"].append((args[i], args[i + 1], args[i + 2],

@@ -78,15 +78,20 @@ class BatchGroup:
         self.window_s = window_s
         self.max_size = max_size
         self.created = time.monotonic()
-        self.cv = threading.Condition()
+        from tidb_tpu.analysis import sanitizer as _san
+
+        self.cv = threading.Condition(
+            _san.tracked_lock("BatchGroup.cv", threading.RLock))
         self.members: List[Member] = []
         self.sealed = False
 
 
 class Batcher:
     def __init__(self, scheduler):
+        from tidb_tpu.analysis import sanitizer as _san
+
         self.scheduler = scheduler
-        self._lock = threading.Lock()
+        self._lock = _san.tracked_lock("Batcher._lock")
         self._open: Dict[object, BatchGroup] = {}
         self._seq = itertools.count(1)
         # per-digest coalesce counts for information_schema.scheduler_stats
@@ -352,7 +357,10 @@ class Batcher:
                 if est:
                     # per-member accounting: propagates into the
                     # session/server trackers; a quota breach cancels
-                    # THIS member only (typed OOM), never the batch
+                    # THIS member only (typed OOM), never the batch.
+                    # lifecycle: the statement tracker owns the charge —
+                    # Session._execute_timed detach()es it (residuals
+                    # included) at this member's statement end
                     ctx.mem_tracker.consume(est)
                 cache = sess.catalog.plan_cache
                 cache.note_hit(entry)

@@ -78,7 +78,12 @@ class StatementScheduler:
         # tidb_tpu_sched_mem_quota (0 = unlimited)
         self.server_tracker = MemTracker("server", budget=None)
         self.batcher = Batcher(self)
-        self._cv = threading.Condition()
+        # cv over a sanitizer-tracked lock (ISSUE 12): worker-thread
+        # acquisition orders join the runtime witness graph
+        from tidb_tpu.analysis import sanitizer as _san
+
+        self._cv = threading.Condition(
+            _san.tracked_lock("StatementScheduler._cv", threading.RLock))
         self._work = collections.deque()  # _Task | BatchGroup
         self._queued = 0                  # admitted, not yet claimed
         self._inflight_batches = 0

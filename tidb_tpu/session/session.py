@@ -601,6 +601,18 @@ class Session:
         from tidb_tpu.columnar.store import scan_counts as _seg_counts
 
         seg0 = _seg_counts()
+        # runtime invariant sanitizer (ISSUE 12): debug-mode statement
+        # scope — pin/tracker balances, host-sync budget, lock-order
+        # witness — checked at statement end; fatal findings raise a
+        # typed SanitizerError on the success path
+        _san_scope = None
+        _san_findings: list = []
+        if bool(self.sysvars.get("tidb_tpu_sanitize")):
+            from tidb_tpu.analysis import sanitizer as _san
+
+            _san.enable()
+            _san_scope = _san.statement_begin(sync_budget=int(
+                self.sysvars.get("tidb_tpu_sanitize_sync_budget")))
         t0 = _time.perf_counter()
         try:
             with ctx:
@@ -638,6 +650,13 @@ class Session:
             if self._mem_parent is not None:
                 for t in self._stmt_trackers:
                     t.detach()
+            if _san_scope is not None:
+                from tidb_tpu.analysis import sanitizer as _san
+
+                # after the detach loop so residual witnesses attribute
+                # to this statement; fatal findings raise on the
+                # success path below (never mask an in-flight error)
+                _san_findings = _san.statement_end(_san_scope)
             # BaseException safety net (KeyboardInterrupt & co bypass
             # the except): a trace must never leak onto the thread. The
             # normal paths pop via _finish_trace before this runs.
@@ -658,6 +677,13 @@ class Session:
         # finalization — a never-popped trace would swallow every later
         # statement on this thread into a dead span tree
         self.catalog.plugins.statement_end(self, sql, stype, dur, None)
+        fatal = [f for f in _san_findings if f.fatal]
+        if fatal:
+            from tidb_tpu.errors import SanitizerError
+
+            raise SanitizerError(
+                "sanitizer: engine invariant broken during this "
+                "statement: " + "; ".join(f.render() for f in fatal[:4]))
         return result
 
     def _maybe_log_slow(self, sql: str, dur: float, detail, trace_id: str,
@@ -888,21 +914,17 @@ class Session:
         )
 
     def _wire_probe_mode(self) -> str:
-        """Effective tidb_tpu_join_probe_mode, ALSO wired into
-        ops/hash_probe.set_mode so the fragment-tier join (which reads
-        the module-global at trace time, inside its shard_map program)
-        follows the same knob as the single-chip executor. The global is
-        process-wide: concurrent sessions with divergent session-level
-        values race it for the fragment tier only — the single-chip
-        join carries the mode per-statement through ExecContext. Already
-        -compiled fragment programs keep their traced strategy until the
-        jit cache turns over (results are identical either way; only
-        the probe's cost model changes)."""
-        mode = str(self.sysvars.get("tidb_tpu_join_probe_mode"))
-        from tidb_tpu.ops import hash_probe
-
-        hash_probe.set_mode(mode)
-        return mode
+        """Effective tidb_tpu_join_probe_mode. Carried per-statement
+        through ExecContext for BOTH tiers: the single-chip join reads
+        it at stage time, and the fragment tier threads it into
+        build_fn as a trace-time static (part of the fragment cache
+        key), so concurrent sessions with divergent session values
+        never race a process global. The PR 10 wiring wrote
+        ops/hash_probe.set_mode here every statement — the documented
+        set_mode race; the global now only seeds offline tools and
+        bare fragments, and the sanitizer's shared-mutable-global
+        witness flags any statement-time write."""
+        return str(self.sysvars.get("tidb_tpu_join_probe_mode"))
 
     def _agg_push_down(self) -> bool:
         """Effective eager-aggregation switch. Device-engine sessions

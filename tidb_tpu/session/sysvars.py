@@ -16,6 +16,15 @@ from tidb_tpu.errors import ExecutionError
 
 __all__ = ["SysVar", "SYSVARS", "SysVarStore", "canonical"]
 
+
+def _sanitizer_env_gate() -> bool:
+    """TIDB_TPU_SANITIZE env seed for the sanitize sysvar default —
+    ONE parser (analysis/sanitizer.env_gate) so `=0` disables here and
+    in the sanitizer's own process gate identically."""
+    from tidb_tpu.analysis.sanitizer import env_gate
+
+    return env_gate()
+
 GLOBAL, SESSION, BOTH = "global", "session", "both"
 
 
@@ -111,10 +120,23 @@ _reg(
     # on CPU where its cache-friendly binary rounds measure faster;
     # xla/pallas force the table everywhere (window-scan probe / Pallas
     # VMEM kernel). Dense packed-key domains keep the O(1) direct-address
-    # index regardless. Also wires ops/hash_probe.set_mode for the
-    # fragment-tier join (process-global, read at trace time).
+    # index regardless. Threaded per-statement through ExecContext into
+    # BOTH tiers (fragment programs take it as a trace-time static in
+    # their cache key) — the hash_probe process global is only the
+    # offline default (ISSUE 12 fixed the set_mode race).
     SysVar("tidb_tpu_join_probe_mode", "auto", BOTH, "enum",
            enum_values=("off", "auto", "xla", "pallas")),
+    # -- runtime invariant sanitizer (ISSUE 12) ------------------------
+    # debug mode: wrap the registered locks in the runtime order
+    # witness, audit tracker/pin balances at statement end, count
+    # device_get round trips against the declared budget, and raise a
+    # typed SanitizerError on fatal findings. Seeded by the
+    # TIDB_TPU_SANITIZE env var for whole-process runs.
+    SysVar("tidb_tpu_sanitize", _sanitizer_env_gate(), BOTH, "bool"),
+    # per-statement ceiling on sanctioned device_get round trips while
+    # sanitizing — the runtime form of the host-sync chunk-loop budget
+    SysVar("tidb_tpu_sanitize_sync_budget", 4096, BOTH, "int",
+           min_=1, max_=1 << 20),
     SysVar("tidb_broadcast_join_threshold_count", 1 << 21, BOTH, "int",
            min_=1 << 10, max_=1 << 28),
     # -- serving tier (ISSUE 7): admission-controlled scheduler +

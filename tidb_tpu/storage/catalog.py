@@ -31,8 +31,11 @@ class Catalog:
 
         # statement-granularity lock for multi-threaded front-ends (the wire
         # server): the host storage layer is single-writer by design, like
-        # the reference's per-region leaseholder
-        self.lock = threading.RLock()
+        # the reference's per-region leaseholder. Registered with the
+        # sanitizer's runtime lock-order witness (ISSUE 12).
+        from tidb_tpu.analysis import sanitizer as _san
+
+        self.lock = _san.tracked_lock("Catalog.lock", threading.RLock)
         self.databases: Dict[str, Database] = {"test": Database("test")}
         # extension points (ref: plugin/ — per-process plugin list)
         from tidb_tpu.plugin import PluginRegistry

@@ -20,6 +20,7 @@ import tempfile
 import threading
 from typing import Callable, List, Optional
 
+from tidb_tpu.analysis import sanitizer as _san
 from tidb_tpu.errors import ExecutionError
 
 __all__ = ["MemTracker", "QueryOOMError", "SpillFile", "SpillableRuns",
@@ -32,7 +33,9 @@ __all__ = ["MemTracker", "QueryOOMError", "SpillFile", "SpillableRuns",
 # Reentrant because _on_exceed -> spill() re-enters release()/consume()
 # on the same thread. Spill I/O under the lock is acceptable: it only
 # happens past the budget, where correctness beats concurrency.
-_ACCOUNT_LOCK = threading.RLock()
+# Registered with the sanitizer's lock witness (ISSUE 12) so orders
+# threaded through the staging/scheduler threads are recorded.
+_ACCOUNT_LOCK = _san.tracked_lock("memory._ACCOUNT_LOCK", threading.RLock)
 
 
 def spill_root_of(tracker: "MemTracker") -> "MemTracker":
@@ -83,6 +86,11 @@ class MemTracker:
             if p is None or self.consumed == 0:
                 return
             n = self.consumed
+            if _san.enabled() and n > 0:
+                # leak witness (typed at detach, per ISSUE 12): bytes
+                # the statement consumed and never released — detach
+                # reclaims them, the sanitizer makes them visible
+                _san.note_tracker_detach(self.label, n)
             node = p
             while node is not None:
                 node.consumed -= n
@@ -102,11 +110,21 @@ class MemTracker:
                 node.consumed += nbytes
                 node.max_consumed = max(node.max_consumed, node.consumed)
                 if node.budget is not None and node.consumed > node.budget:
+                    # lint: disable=blocking-under-lock -- deliberate:
+                    # past the budget, spill I/O runs under the account
+                    # lock — correctness beats concurrency there (module
+                    # doc); re-entrancy is why the lock is an RLock
                     node._on_exceed()
                 node = node.parent
 
     def release(self, nbytes: int) -> None:
         with _ACCOUNT_LOCK:
+            if _san.enabled() and nbytes > 0 and \
+                    self.consumed - nbytes < 0 <= self.consumed:
+                # crossing zero on THIS release = some charge returned
+                # twice (fatal finding; reported once per crossing)
+                _san.note_tracker_release(self.label,
+                                          self.consumed - nbytes)
             node = self
             while node is not None:
                 node.consumed -= nbytes
