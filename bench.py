@@ -572,6 +572,132 @@ def bench_plan_cache(extra):
     return out
 
 
+def bench_multichip(extra=None, n_rows=None, reps=None,
+                    write_path="MULTICHIP_r06.json"):
+    """Sharded scale-out capture (ISSUE 13): the SAME scan-agg query at
+    1 -> 2 -> 4 workers over SHARD BY placement, interleaved arms,
+    serial-oracle hash equality on every arm.
+
+    Metric semantics on a single-core harness (this box has 1 CPU):
+    workers are in-process, so raw wall clock CANNOT scale — what a
+    multi-host fleet achieves is the distributed CRITICAL PATH, which
+    IS measurable here: each owner's partial is timed individually
+    (sequentially, so measurements don't contend), and
+
+        scaleout_s = max(partial_i) + (wall - sum(partial_i))
+
+    i.e. the slowest owner's partial plus the measured coordinator
+    overhead (rewrite + drain + final merge) from the real end-to-end
+    run. At W=1 that degenerates to the measured wall clock, so
+    speedups are self-relative. On a >=4-core box the raw wall-clock
+    speedup is reported alongside and should approach the modeled one.
+    Every arm's full result must hash-equal the serial oracle's."""
+    import hashlib
+    import threading as _threading
+
+    import numpy as np
+
+    from tidb_tpu.parallel.dcn import Cluster, Worker, partial_rewrite
+    from tidb_tpu.session import Session
+
+    n_rows = n_rows or int(os.environ.get("BENCH_MULTICHIP_ROWS",
+                                          str(1 << 20)))
+    reps = reps or max(REPS, 3)
+    rng = np.random.default_rng(13)
+    k = rng.permutation(n_rows).astype(np.int64)
+    g = (k % 97).astype(np.int64)
+    v = (k * 7 - 3).astype(np.int64)
+    ddl = ("create table t (k bigint, g bigint, v bigint) "
+           "shard by hash(k) shards 8")
+    sql = ("select g, count(*) as n, sum(v) as sv, min(v) as mv, "
+           "max(v) as xv from t group by g order by g")
+
+    def rows_hash(rows):
+        return hashlib.sha256(
+            repr([tuple(int(x) for x in r) for r in rows]).encode()
+        ).hexdigest()[:16]
+
+    oracle = Session(chunk_capacity=CAP)
+    oracle.execute(ddl)
+    oracle.catalog.table("test", "t").insert_columns(
+        {"k": k, "g": g, "v": v})
+    want_hash = rows_hash(oracle.query(sql))
+
+    fleets = {}
+    for W in (1, 2, 4):
+        ws = [Worker() for _ in range(W)]
+        for w in ws:
+            _threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in ws],
+                     rpc_timeout_s=600.0)
+        cl.ddl(ddl)
+        cl.load_sharded("t", arrays={"k": k, "g": g, "v": v})
+        fleets[W] = (ws, cl)
+
+    partial_sql, _final, _names = partial_rewrite(
+        sql, partitioned=frozenset({"t"}))
+    out = {"n_rows": n_rows, "reps": reps, "host_cpus": os.cpu_count(),
+           "oracle_hash": want_hash, "arms": {}}
+    best = {}  # W -> (scaleout_s, wall_s, max_partial_s)
+    try:
+        # warm every arm (compile + plan caches) and pin hash equality
+        for W, (ws, cl) in fleets.items():
+            h = rows_hash(cl.query(sql))
+            out["arms"][W] = {"workers": W, "hash_equal": h == want_hash,
+                              "hash": h}
+        # interleaved measurement: rep-major, arm-minor, so machine
+        # drift perturbs every arm equally instead of biasing one
+        for _rep in range(reps):
+            for W, (ws, cl) in fleets.items():
+                t0 = time.perf_counter()
+                cl.query(sql)
+                wall = time.perf_counter() - t0
+                pt = []
+                for i in range(W):
+                    t0 = time.perf_counter()
+                    first = cl._call(i, {"cmd": "partial_paged",
+                                         "sql": partial_sql,
+                                         "page_rows": 1 << 16})
+                    cl._drain_pages(i, first)
+                    pt.append(time.perf_counter() - t0)
+                scaleout = max(pt) + max(wall - sum(pt), 0.0)
+                cur = best.get(W)
+                if cur is None or scaleout < cur[0]:
+                    best[W] = (scaleout, wall, max(pt))
+        for W, (scaleout, wall, mp) in best.items():
+            out["arms"][W].update(
+                scaleout_s=round(scaleout, 4), wall_s=round(wall, 4),
+                max_partial_s=round(mp, 4),
+                rows_per_sec_scaleout=round(n_rows / scaleout, 1))
+        base = best[1][0]
+        out["speedup_2w"] = round(base / best[2][0], 3)
+        out["speedup_4w"] = round(base / best[4][0], 3)
+        out["wall_speedup_4w"] = round(best[1][1] / best[4][1], 3)
+        out["hash_equal"] = all(a["hash_equal"]
+                                for a in out["arms"].values())
+        out["arms"] = {str(W): a for W, a in out["arms"].items()}
+    finally:
+        for _W, (_ws, cl) in fleets.items():
+            try:
+                cl.shutdown()
+            except Exception:  # noqa: BLE001 — bench cleanup
+                pass
+    if write_path:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            write_path)
+        json.dump(out, open(path, "w"), indent=1)
+    if extra is not None:
+        extra["multichip"] = {kk: out[kk] for kk in
+                              ("speedup_2w", "speedup_4w",
+                               "wall_speedup_4w", "hash_equal",
+                               "host_cpus")}
+    log(f"# multichip: speedup_2w={out.get('speedup_2w')} "
+        f"speedup_4w={out.get('speedup_4w')} "
+        f"wall_4w={out.get('wall_speedup_4w')} "
+        f"hash_equal={out.get('hash_equal')}")
+    return out
+
+
 def bench_oltp(extra, clients_list=(8, 16), iters=150):
     """Multi-client OLTP benchmark (ISSUE 7): sysbench-style point-get
     workload at N client threads through the serving tier, coalesced
@@ -1460,6 +1586,14 @@ def main(locked_detail=("acquired", "acquired")):
         extra["oltp"] = bench_oltp(extra)
     except Exception as e:  # noqa: BLE001
         extra["oltp_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # sharded scale-out capture (ISSUE 13): same scan-agg at 1/2/4
+    # workers over SHARD BY placement -> MULTICHIP_r06.json
+    try:
+        log("# multichip scale-out bench")
+        bench_multichip(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["multichip_error"] = f"{type(e).__name__}: {e}"[:300]
 
     print(json.dumps({
         "metric": "tpch_q1_rows_per_sec",

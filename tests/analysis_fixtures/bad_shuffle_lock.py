@@ -1,0 +1,27 @@
+"""Fixture: shuffle sends under the shard-map/inbox lock — the
+blocking-under-lock violations ISSUE 13 adds to the governed surface
+(a peer-socket send while holding the placement lock stalls every
+stage/gather behind one slow peer), plus the sanctioned
+snapshot-then-send form that must stay clean."""
+
+import threading
+
+
+class BadExchange:
+    def __init__(self):
+        self._shard_map_lock = threading.Lock()
+        self._placements = {}
+
+    def scatter_under_lock(self, sock, batch):
+        with self._shard_map_lock:
+            sock.sendall(batch)            # BAD: peer send under the map lock
+
+    def stage_under_lock(self, sock, nbytes):
+        with self._shard_map_lock:
+            return sock.recv(nbytes)       # BAD: peer recv under the map lock
+
+    def snapshot_then_send(self, sock, batch):
+        with self._shard_map_lock:
+            smap = dict(self._placements)  # ok: pure host work under lock
+        sock.sendall(batch)                # ok: lock released first
+        return smap

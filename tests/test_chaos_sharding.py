@@ -1,0 +1,275 @@
+"""Chaos grid for the sharded-placement tier (ISSUE 13): failpoints at
+`shuffle.send` / `shuffle.recv` / `2pc.prepare` / `2pc.commit` — every
+run must return results identical to the no-fault run or raise a clean
+TYPED error, never hang, and never leak a cursor, cancel token, staged
+shuffle, or prepared 2PC transaction. A coordinator "crash" between
+prepare and commit must leave every shard consistent: typed error to
+the caller, then recover_txns() lands the recorded decision on every
+participant (committed-everywhere or rolled-back-everywhere).
+
+Workers run IN-PROCESS (threads) so the process-global failpoint
+registry reaches both sides of the wire — same harness as
+test_chaos_dcn."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import TiDBTPUError
+from tidb_tpu.parallel.dcn import Cluster, Worker
+from tidb_tpu.utils.failpoint import FailpointError, failpoint, hits
+
+N_ROWS = 400
+
+JOIN_SQL = ("select d.grp, count(*) as n, sum(f.v) as sv from f "
+            "join d on f.k = d.k group by d.grp order by d.grp")
+
+TYPED = (TiDBTPUError, ConnectionError, OSError, FailpointError)
+
+
+def _mk_cluster(n_workers=3):
+    workers = [Worker() for _ in range(n_workers)]
+    for w in workers:
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+    cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                 rpc_timeout_s=15.0, connect_timeout_s=5.0)
+    cl.ddl("create table f (k bigint, v bigint) shard by hash(k) shards 6")
+    cl.ddl("create table d (k bigint, grp bigint) shard by hash(grp) "
+           "shards 3")
+    ks = np.arange(N_ROWS, dtype=np.int64)
+    cl.load_sharded("f", arrays={"k": ks, "v": ks * 3})
+    dk = ks[::2]
+    cl.load_sharded("d", arrays={"k": dk, "grp": dk % 5})
+    return workers, cl
+
+
+def _assert_clean(workers, cl):
+    """Post-run invariants: no cursor, inflight token, staged shuffle,
+    tracker charge, or pending 2PC transaction retained anywhere."""
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if all(not w._cursors and not w._inflight
+               and w._inbox.open_count() == 0 and w._txn2pc is None
+               for w in workers):
+            break
+        time.sleep(0.02)
+    assert all(not w._cursors for w in workers), \
+        [len(w._cursors) for w in workers]
+    assert all(not w._inflight for w in workers), \
+        [len(w._inflight) for w in workers]
+    assert all(w._inbox.open_count() == 0 for w in workers), \
+        [w._inbox.open_count() for w in workers]
+    assert all(w._shuffle_tracker.consumed == 0 for w in workers), \
+        [w._shuffle_tracker.consumed for w in workers]
+    assert all(w._txn2pc is None for w in workers), \
+        [w._txn2pc for w in workers]
+    assert not cl._txn_pending and not cl._txn_decided, \
+        (cl._txn_pending, cl._txn_decided)
+
+
+def _kill_worker(w):
+    """Hard-kill an in-process worker (shutdown() required: close()
+    alone leaves the blocked accept() serving one zombie connection)."""
+    w._running = False
+    try:
+        w._sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    w._sock.close()
+
+
+class TestShuffleFaults:
+    @pytest.mark.parametrize("fault", ["shuffle.send", "shuffle.recv"])
+    def test_fault_mid_shuffle_is_typed_and_leakless(self, fault):
+        workers, cl = _mk_cluster()
+        try:
+            want = cl.query(JOIN_SQL)  # no-fault baseline
+            with failpoint(fault, times=1):
+                try:
+                    got = cl.query(JOIN_SQL)
+                except TYPED:
+                    got = None  # typed failure is an accepted outcome
+            assert hits(fault) > 0, f"{fault} never sat on the path"
+            if got is not None:
+                assert got == want
+            _assert_clean(workers, cl)
+            # the fleet still answers a fresh statement exactly
+            assert cl.query(JOIN_SQL) == want
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+    def test_worker_death_mid_shuffle_is_typed_and_leakless(self):
+        """A worker killed between scatter and gather: the statement
+        fails TYPED (no failover — the rows live only in the dead
+        worker's inbox) and the survivors retain nothing."""
+        workers, cl = _mk_cluster()
+        try:
+            def kill():
+                _kill_worker(workers[2])
+
+            with failpoint("shuffle.recv", action=kill, nth=1):
+                with pytest.raises(TYPED):
+                    cl.query(JOIN_SQL)
+            _assert_clean(workers[:2], cl)
+        finally:
+            cl.shutdown()
+
+    def test_inbox_quota_backpressure_is_typed(self):
+        """An over-budget receiver refuses the stage with a typed OOM
+        that travels sender -> coordinator; nothing stays staged. The
+        inbox budget is pinned directly (the sysvar clamps at 1 MiB —
+        far above this fixture's batches); what's under test is the
+        refusal travelling the wire and releasing cleanly."""
+        workers, cl = _mk_cluster()
+        for w in workers:
+            w._shuffle_budget = (
+                lambda w=w: setattr(w._shuffle_tracker, "budget", 64))
+        try:
+            with pytest.raises(TiDBTPUError, match="Out Of Memory Quota"):
+                cl.query(JOIN_SQL)
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+
+class TestReshardFaults:
+    def test_apply_fault_keeps_fence_and_staged_rows_then_recovers(self):
+        """A fault in reshard phase B (after the first worker already
+        truncated and swapped): the staged batches are the ONLY copy of
+        the moved rows, so they are retained, the table stays FENCED
+        (statements refused typed — routing by either map over a
+        half-swapped fleet would silently double-count), and
+        recover_reshard() re-drives the idempotent applies to a fully
+        consistent new placement with zero lost rows."""
+        workers, cl = _mk_cluster()
+        try:
+            baseline = cl.query("select count(*) as n, sum(v) as s from f")
+            with failpoint("reshard.apply", nth=2):
+                with pytest.raises(TiDBTPUError, match="recover_reshard"):
+                    cl.reshard("alter table f shard by hash(k) shards 4")
+            # fenced while inconsistent
+            with pytest.raises(TiDBTPUError, match="resharded"):
+                cl.query("select count(*) as n from f")
+            out = cl.recover_reshard()
+            assert out == {"f": "resharded"}, out
+            assert cl.placement("f").shards == 4
+            assert cl.query("select count(*) as n, sum(v) as s from f") \
+                == baseline
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+    def test_scatter_fault_leaves_table_untouched(self):
+        """A fault BEFORE any worker swapped: staged state is dropped,
+        the fence lifts, and the table still serves the old placement
+        exactly."""
+        workers, cl = _mk_cluster()
+        try:
+            baseline = cl.query("select count(*) as n, sum(v) as s from f")
+            with failpoint("shuffle.send", times=1):
+                with pytest.raises(TYPED):
+                    cl.reshard("alter table f shard by hash(k) shards 4")
+            assert cl.placement("f").shards == 6  # unchanged
+            assert cl.query("select count(*) as n, sum(v) as s from f") \
+                == baseline
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+
+class TestTwoPhaseCommitFaults:
+    DML = "insert into f (k, v) values (9001, 1), (9002, 2), (9003, 3)"
+    CHECK = "select count(*) as n, sum(v) as s from f where k >= 9000"
+
+    def test_prepare_fault_aborts_everywhere(self):
+        """A coordinator crash DURING prepare: no decision recorded, so
+        recovery rolls every participant back — the write is nowhere."""
+        workers, cl = _mk_cluster()
+        try:
+            with failpoint("2pc.prepare", times=1):
+                with pytest.raises(TYPED):
+                    cl.execute_dml(self.DML)
+            cl.recover_txns()
+            assert cl.query(self.CHECK)[0][0] == 0
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+    def test_crash_between_prepare_and_commit_recovers_committed(self):
+        """THE acceptance window: every participant prepared, decision
+        recorded, coordinator dies before any commit fan-out. The
+        caller sees a typed error; while unrecovered, the prepared
+        participants refuse foreign statements typed; recover_txns()
+        re-drives the decision and the write is EVERYWHERE."""
+        workers, cl = _mk_cluster()
+        try:
+            with failpoint("2pc.commit", times=1):
+                with pytest.raises(TYPED):
+                    cl.execute_dml(self.DML)
+            # decision recorded but undelivered: prepared participants
+            # hold the transaction open and refuse other statements
+            assert cl._txn_decided, "decision record missing"
+            pend = [w for w in workers if w._txn2pc is not None]
+            assert pend, "no participant left prepared"
+            with pytest.raises(TYPED, match="pending"):
+                cl.query(self.CHECK)
+            out = cl.recover_txns()
+            assert set(out.values()) == {"committed"}, out
+            assert tuple(map(int, cl.query(self.CHECK)[0])) == (3, 6)
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+    def test_worker_lost_at_commit_recovers_idempotently(self):
+        """One participant's commit RPC fails (connection fault): the
+        caller gets a typed error naming recovery; recover_txns()
+        re-sends commits — workers that already committed ack
+        idempotently, the failed one lands it."""
+        workers, cl = _mk_cluster()
+        try:
+            # the first len(parts) sends after arming are the prepares;
+            # fault the FIRST commit send
+            smap = cl.placement("f")
+            parts = {smap.worker_of(smap.shard_of(k))
+                     for k in (9001, 9002, 9003)}
+            with failpoint("dcn.coord.send", exc=ConnectionError,
+                           nth=len(parts) + 1):
+                with pytest.raises(TYPED):
+                    cl.execute_dml(self.DML)
+            assert cl._txn_decided, "decision record missing"
+            cl.recover_txns()
+            cl.recover_txns()  # idempotent: second pass is a no-op
+            assert tuple(map(int, cl.query(self.CHECK)[0])) == (3, 6)
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+    def test_prepared_participant_blocks_until_resolved(self):
+        """A prepared participant never resolves unilaterally — it
+        voted yes, and the coordinator may hold a commit decision it
+        cannot see (exactly this scenario). Statements stay refused
+        TYPED however long it waits; only a coordinator's recovery
+        releases it — and the recorded decision lands, never a
+        unilateral rollback that would contradict it."""
+        workers, cl = _mk_cluster()
+        try:
+            with failpoint("2pc.commit", times=1):
+                with pytest.raises(TYPED):
+                    cl.execute_dml(self.DML)
+            pend = [w for w in workers if w._txn2pc is not None]
+            assert pend
+            for w in pend:  # however old the prepare is...
+                w._txn2pc = (w._txn2pc[0], time.monotonic() - 3600.0)
+            # ...the participant still blocks rather than guess
+            with pytest.raises(TYPED, match="pending"):
+                cl.query(self.CHECK)
+            out = cl.recover_txns()
+            assert set(out.values()) == {"committed"}
+            assert tuple(map(int, cl.query(self.CHECK)[0])) == (3, 6)
+            _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()

@@ -1,0 +1,604 @@
+"""Sharded table placement + cross-process shuffle (ISSUE 13).
+
+Shard-map correctness is sqlite-oracled: the same rows load into a
+local Session (mirrored to sqlite) AND into 1/2/4-worker in-process
+clusters through the placement router; scans, joins, aggs, and 2PC DML
+must agree row for row — over hash and range placement, skewed keys,
+NULL shard keys, and empty shards. Owner pruning is asserted through
+the workers' own `stats` counters: a non-owner does NO work."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import ExecutionError, TiDBTPUError, UnsupportedError
+from tidb_tpu.parallel.dcn import Cluster, Worker
+from tidb_tpu.session import Session
+from tidb_tpu.sharding.placement import (
+    ShardMap,
+    owners_by_worker,
+    shard_of_array,
+    shard_of_value,
+    worker_of_shard,
+)
+from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+
+N_ROWS = 1200
+
+DDL_HASH = ("create table f (k bigint, g bigint, v bigint, s varchar(8)) "
+            "shard by hash(k) shards 8")
+DDL_RANGE = ("create table f (k bigint, g bigint, v bigint, s varchar(8)) "
+             "shard by range(k) shards (300, 700)")
+DDL_DIM = ("create table d (k bigint, w bigint, name varchar(8)) "
+           "shard by hash(w) shards 4")
+
+
+def _fact_rows(skewed=False, null_keys=False):
+    rng = np.random.default_rng(7)
+    if skewed:
+        # 90% of keys collapse onto 3 values: whole shards stay empty
+        # while one owner carries nearly everything
+        k = np.where(rng.random(N_ROWS) < 0.9,
+                     rng.integers(0, 3, N_ROWS), rng.integers(0, 1000, N_ROWS))
+    else:
+        k = rng.permutation(N_ROWS)
+    k = k.astype(np.int64)
+    kv = np.ones(N_ROWS, dtype=bool)
+    if null_keys:
+        kv = rng.random(N_ROWS) > 0.1  # ~10% NULL shard keys
+    g = (np.arange(N_ROWS, dtype=np.int64) % 7)
+    v = np.arange(N_ROWS, dtype=np.int64) * 3 - 100
+    s = [f"s{i % 5}" if i % 11 else None for i in range(N_ROWS)]
+    return k, kv, g, v, s
+
+
+def _mk_cluster(n_workers, ddl=DDL_HASH, **rows_kw):
+    workers = [Worker() for _ in range(n_workers)]
+    for w in workers:
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+    cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                 rpc_timeout_s=30.0, connect_timeout_s=5.0)
+    cl.ddl(ddl)
+    cl.ddl(DDL_DIM)
+    k, kv, g, v, s = _fact_rows(**rows_kw)
+    cl.load_sharded("f", arrays={"k": k, "g": g, "v": v},
+                    valids={"k": kv}, strings={"s": s})
+    dk = np.arange(0, N_ROWS, 4, dtype=np.int64)
+    cl.load_sharded("d", arrays={"k": dk, "w": dk % 13},
+                    strings={"name": [f"n{i % 9}" for i in dk]})
+    return workers, cl
+
+
+def _mk_oracle(ddl=DDL_HASH, **rows_kw):
+    s = Session(chunk_capacity=4096)
+    s.execute(ddl)
+    s.execute(DDL_DIM)
+    k, kv, g, v, sv = _fact_rows(**rows_kw)
+    t = s.catalog.table("test", "f")
+    t.insert_columns({"k": k, "g": g, "v": v}, {"k": kv}, strings={"s": sv})
+    dk = np.arange(0, N_ROWS, 4, dtype=np.int64)
+    s.catalog.table("test", "d").insert_columns(
+        {"k": dk, "w": dk % 13}, strings={"name": [f"n{i % 9}" for i in dk]})
+    return s
+
+
+QUERIES = [
+    # Q1-shape scan-agg over the sharded fact
+    ("select g, count(*) as n, count(v) as cv, sum(v) as sv, "
+     "min(v) as mv, max(v) as xv, avg(v) as av from f group by g "
+     "order by g"),
+    # global agg, selective filter
+    ("select count(*) as n, sum(v) as sv from f where k < 400"),
+    # TopN pushdown over the sharded fact
+    ("select k, v from f where v > 0 order by v desc, k limit 9"),
+    # shuffle join of two sharded tables (f hash(k), d hash(w): d is
+    # NOT placed on the join key, so at least one side must exchange)
+    ("select count(*) as n, sum(f.v) as sv from f join d on f.k = d.k"),
+    # shuffle join + group by + dim filter
+    ("select d.name, count(*) as n, sum(f.v) as sv from f "
+     "join d on f.k = d.k where d.w < 11 group by d.name order by d.name"),
+]
+
+
+class TestPlacementMath:
+    def test_hash_map_deterministic_and_total(self):
+        smap = ShardMap("hash", "k", 8, 4)
+        vals = np.arange(-500, 500, dtype=np.int64)
+        a = shard_of_array(smap, vals)
+        b = shard_of_array(smap, vals)
+        assert (a == b).all()
+        assert ((a >= 0) & (a < 8)).all()
+        # scalar form agrees with the vector form
+        for v in (-500, 0, 3, 499):
+            assert shard_of_value(smap, v) == a[list(vals).index(v)]
+
+    def test_null_keys_land_in_shard_zero(self):
+        smap = ShardMap("hash", "k", 8, 4)
+        vals = np.array([1, 2, 3], dtype=np.int64)
+        valid = np.array([True, False, True])
+        out = shard_of_array(smap, vals, valid)
+        assert out[1] == 0
+        assert shard_of_value(smap, None) == 0
+
+    def test_range_bounds(self):
+        smap = ShardMap("range", "k", 3, 2, bounds=(100, 200))
+        vals = np.array([-5, 0, 99, 100, 150, 199, 200, 10**9],
+                        dtype=np.int64)
+        out = shard_of_array(smap, vals)
+        assert list(out) == [0, 0, 0, 1, 1, 1, 2, 2]
+
+    def test_owner_assignment_round_robin(self):
+        assert worker_of_shard(5, 4) == 1
+        owners = owners_by_worker(6, 4)
+        assert owners == {0: [0, 4], 1: [1, 5], 2: [2], 3: [3]}
+        # workers owning nothing are ABSENT — the non-dispatch set
+        assert 3 not in owners_by_worker(2, 4)
+
+    def test_colocation_rule(self):
+        # hash on the join key with shards % W == 0 -> co-located
+        assert ShardMap("hash", "k", 8, 4).colocated_on("k")
+        assert not ShardMap("hash", "k", 6, 4).colocated_on("k")
+        assert not ShardMap("hash", "k", 8, 4).colocated_on("j")
+        assert not ShardMap("range", "k", 4, 4, (1, 2, 3)).colocated_on("k")
+        # the co-location identity the planner relies on:
+        # (mix(k) % (m*W)) % W == mix(k) % W
+        big = ShardMap("hash", "k", 8, 4)
+        small = ShardMap("hash", "k", 4, 4)
+        vals = np.arange(10000, dtype=np.int64)
+        assert (shard_of_array(big, vals) % 4
+                == shard_of_array(small, vals) % 4).all()
+
+    def test_wire_roundtrip(self):
+        smap = ShardMap("range", "k", 3, 4, bounds=(10, 20), version=5)
+        assert ShardMap.from_wire(smap.to_wire()) == smap
+
+
+class TestShuffleDataPlane:
+    def test_encode_decode_roundtrip_with_nulls(self):
+        from tidb_tpu.sharding import shuffle as shfl
+        from tidb_tpu.types import SQLType, TypeKind
+
+        t_int = SQLType(TypeKind.INT)
+        arrays = {"a": np.array([5, 1000, -3, 7], dtype=np.int64)}
+        valids = {"a": np.array([True, True, False, True])}
+        strings = {"s": ["x", None, "yy", "z"]}
+        batch = shfl.encode_batch({"a": t_int}, arrays, valids, strings)
+        # FoR narrowing engaged: range 1003 fits int16
+        assert batch["cols"]["a"]["enc"] == "for"
+        assert batch["cols"]["a"]["d"].dtype == np.int16
+        a2, v2, s2 = shfl.decode_batch({"a": t_int}, batch)
+        assert (a2["a"][v2["a"]] == arrays["a"][valids["a"]]).all()
+        assert s2["s"] == strings["s"]
+        assert shfl.batch_wire_bytes(batch) > 0
+
+    def test_inbox_backpressure_is_typed_and_released(self):
+        from tidb_tpu.sharding.shuffle import ShuffleInbox
+        from tidb_tpu.utils.memory import MemTracker, QueryOOMError
+
+        tracker = MemTracker("t", budget=64, spill_enabled=False)
+        inbox = ShuffleInbox(tracker)
+        small = {"n": 1, "cols": {"a": {
+            "d": np.zeros(4, dtype=np.int8),
+            "v": np.ones(4, dtype=bool), "ref": 0, "enc": "raw",
+            "dt": "int8"}}}
+        big = {"n": 1, "cols": {"a": {
+            "d": np.zeros(256, dtype=np.int8),
+            "v": np.ones(256, dtype=bool), "ref": 0, "enc": "raw",
+            "dt": "int8"}}}
+        inbox.stage("s1", "f", small)
+        with pytest.raises(QueryOOMError):
+            inbox.stage("s1", "f", big)  # charge rolled back, un-staged
+        assert len(inbox.drain("s1", "f")) == 1
+        inbox.close("s1")
+        assert tracker.consumed == 0
+        assert inbox.open_count() == 0
+        inbox.close("s1")  # idempotent
+
+
+@pytest.mark.parametrize("n_workers", [1, 2, 4])
+class TestShardedOracle:
+    """sqlite-oracle equality over hash and range placement, at every
+    fleet width — including skewed keys, NULL shard keys, and empty
+    shards (8 hash shards over 1 worker; 3 range shards over 4)."""
+
+    @pytest.mark.parametrize("ddl", [DDL_HASH, DDL_RANGE])
+    @pytest.mark.parametrize("sql", QUERIES)
+    def test_query_matches_sqlite(self, n_workers, ddl, sql):
+        workers, cl = _mk_cluster(n_workers, ddl=ddl)
+        oracle = _mk_oracle(ddl=ddl)
+        conn = mirror_to_sqlite(oracle.catalog)
+        try:
+            got = cl.query(sql)
+            want = conn.execute(sql).fetchall()
+            ok, msg = rows_equal(got, want,
+                                 ordered="order by" in sql)
+            assert ok, f"{n_workers}w {ddl[:40]}...\n{sql}\n{msg}"
+            self._assert_clean(workers)
+        finally:
+            cl.shutdown()
+
+    @pytest.mark.parametrize("rows_kw", [
+        {"skewed": True}, {"null_keys": True}])
+    def test_skew_and_null_shard_keys(self, n_workers, rows_kw):
+        workers, cl = _mk_cluster(n_workers, **rows_kw)
+        oracle = _mk_oracle(**rows_kw)
+        conn = mirror_to_sqlite(oracle.catalog)
+        try:
+            for sql in (QUERIES[0], QUERIES[3]):
+                got = cl.query(sql)
+                want = conn.execute(sql).fetchall()
+                ok, msg = rows_equal(got, want,
+                                     ordered="order by" in sql)
+                assert ok, f"{rows_kw}\n{sql}\n{msg}"
+            self._assert_clean(workers)
+        finally:
+            cl.shutdown()
+
+    def test_dml_2pc_matches_sqlite(self, n_workers):
+        workers, cl = _mk_cluster(n_workers)
+        oracle = _mk_oracle()
+        try:
+            dmls = [
+                ("insert into f (k, g, v, s) values "
+                 "(100001, 1, 11, 'new'), (100002, 2, -7, null), "
+                 "(100003, 3, 0, 'x')"),
+                "update f set v = v + 1 where g = 3",
+                "update f set v = 0 where k = 100001",
+                "delete from f where k = 100002",
+                "delete from f where g = 5",
+            ]
+            for dml in dmls:
+                cl.execute_dml(dml)
+                oracle.execute(dml)
+            conn = mirror_to_sqlite(oracle.catalog)
+            for sql in (QUERIES[0], QUERIES[1]):
+                got = cl.query(sql)
+                want = conn.execute(sql).fetchall()
+                ok, msg = rows_equal(got, want,
+                                     ordered="order by" in sql)
+                assert ok, f"{sql}\n{msg}"
+            # no pending 2PC state anywhere after clean commits
+            assert not cl._txn_pending and not cl._txn_decided
+            assert all(w._txn2pc is None for w in workers)
+            self._assert_clean(workers)
+        finally:
+            cl.shutdown()
+
+    @staticmethod
+    def _assert_clean(workers):
+        assert all(not w._cursors for w in workers), \
+            [len(w._cursors) for w in workers]
+        assert all(w._inbox.open_count() == 0 for w in workers), \
+            [w._inbox.open_count() for w in workers]
+        assert all(w._shuffle_tracker.consumed == 0 for w in workers), \
+            [w._shuffle_tracker.consumed for w in workers]
+
+
+class TestOwnerPruning:
+    """The acceptance criterion: a sharded scan provably dispatches
+    only to shard owners — non-owners' stats counters do not move."""
+
+    def test_non_owners_do_no_work(self):
+        # 2 shards over 4 workers: workers 2 and 3 own NOTHING
+        workers, cl = _mk_cluster(
+            4, ddl=("create table f (k bigint, g bigint, v bigint, "
+                    "s varchar(8)) shard by hash(k) shards 2"))
+        try:
+            before = [dict(w.stats) for w in workers]
+            cl.query("select g, sum(v) as s from f group by g order by g")
+            cl.query("select count(*) as n from f where k < 100")
+            after = [dict(w.stats) for w in workers]
+            deltas = [a["executed"] - b["executed"]
+                      for a, b in zip(after, before)]
+            assert deltas[0] > 0 and deltas[1] > 0, deltas
+            assert deltas[2] == 0 and deltas[3] == 0, deltas
+            # f's 2 shards land on workers 0/1; d's 4 cover everyone
+            assert [s["shards_owned"]
+                    for s in cl.worker_stats()] == [2, 2, 1, 1]
+        finally:
+            cl.shutdown()
+
+    def test_shard_key_equality_prunes_to_one_owner(self):
+        workers, cl = _mk_cluster(4)
+        try:
+            before = [w.stats["executed"] for w in workers]
+            got = cl.query("select count(*) as n, sum(v) as s from f "
+                           "where k = 37")
+            assert got[0][0] == 1
+            after = [w.stats["executed"] for w in workers]
+            moved = [i for i, (a, b) in enumerate(zip(after, before))
+                     if a > b]
+            assert len(moved) == 1, (before, after)
+            # the mover is exactly the owner the map names
+            smap = cl.placement("f")
+            assert moved == [smap.worker_of(smap.shard_of(37))]
+        finally:
+            cl.shutdown()
+
+    def test_shard_scan_metric_counts_pruning(self):
+        from tidb_tpu.utils.metrics import SHARD_SCAN_TOTAL
+
+        workers, cl = _mk_cluster(2)
+        try:
+            base = SHARD_SCAN_TOTAL.value(pruned="yes")
+            cl.query("select count(*) as n from f where k = 5")
+            assert SHARD_SCAN_TOTAL.value(pruned="yes") == base + 1
+        finally:
+            cl.shutdown()
+
+
+class TestDmlRouting:
+    def test_insert_routes_rows_to_owners_only(self):
+        workers, cl = _mk_cluster(4)
+        try:
+            smap = cl.placement("f")
+            w = smap.worker_of(smap.shard_of(500000))
+            res = cl.execute_dml(
+                "insert into f (k, g, v) values (500000, 0, 1)")
+            assert res["workers"] == [w]
+            # the row is readable fleet-wide and exactly once
+            got = cl.query("select count(*) as n from f where k = 500000")
+            assert got[0][0] == 1
+        finally:
+            cl.shutdown()
+
+    def test_null_shard_key_routes_to_shard_zero_owner(self):
+        workers, cl = _mk_cluster(4)
+        try:
+            res = cl.execute_dml(
+                "insert into f (k, g, v) values (null, 0, 9)")
+            assert res["workers"] == [0]
+            got = cl.query("select count(*) as n from f where k is null")
+            assert got[0][0] == 1
+        finally:
+            cl.shutdown()
+
+    def test_non_literal_shard_key_refused_typed(self):
+        workers, cl = _mk_cluster(2)
+        try:
+            with pytest.raises(UnsupportedError):
+                cl.execute_dml("insert into f (k, g, v) values (1 + 2, 0, 1)")
+        finally:
+            cl.shutdown()
+
+    def test_unplaced_table_refused_typed(self):
+        workers, cl = _mk_cluster(2)
+        try:
+            cl.broadcast_exec("create table plain (a bigint)")
+            with pytest.raises(ExecutionError):
+                cl.execute_dml("insert into plain values (1)")
+        finally:
+            cl.shutdown()
+
+
+class TestResharding:
+    def test_reshard_moves_data_and_bumps_version(self):
+        workers, cl = _mk_cluster(4)
+        oracle = _mk_oracle()
+        conn = mirror_to_sqlite(oracle.catalog)
+        try:
+            v0 = cl.placement("f").version
+            cl.reshard("alter table f shard by hash(k) shards 6")
+            assert cl.placement("f").version == v0 + 1
+            assert cl.placement("f").shards == 6
+            got = cl.query(QUERIES[0])
+            want = conn.execute(QUERIES[0]).fetchall()
+            ok, msg = rows_equal(got, want, ordered=True)
+            assert ok, msg
+            # ownership observably moved (6 shards round-robin: 2/2/1/1
+            # for f + 1/1/1/1 for d)
+            st = cl.worker_stats()
+            assert [s["shards_owned"] for s in st] == [3, 3, 2, 2]
+            assert all(w._inbox.open_count() == 0 for w in workers)
+        finally:
+            cl.shutdown()
+
+    def test_reshard_to_range_placement(self):
+        workers, cl = _mk_cluster(2)
+        oracle = _mk_oracle()
+        conn = mirror_to_sqlite(oracle.catalog)
+        try:
+            cl.reshard("alter table f shard by range(k) shards (600)")
+            got = cl.query(QUERIES[1])
+            want = conn.execute(QUERIES[1]).fetchall()
+            ok, msg = rows_equal(got, want)
+            assert ok, msg
+            # range 2 shards over 2 workers + equality prune: one owner
+            before = [w.stats["executed"] for w in workers]
+            cl.query("select count(*) as n from f where k = 999")
+            after = [w.stats["executed"] for w in workers]
+            assert [a - b for a, b in zip(after, before)] == [0, 1]
+        finally:
+            cl.shutdown()
+
+    def test_reshard_racing_inflight_statement(self):
+        """A reshard landing in the MIDDLE of an in-flight statement
+        (between two of its drain pages, where no coordinator socket
+        lock is held): the statement's placement snapshot and already-
+        opened worker cursors keep its result exact against the
+        pre-reshard state, and the next statement routes by the new
+        map. The cached-plan half of the race is the local test below."""
+        from tidb_tpu.utils.failpoint import failpoint
+
+        workers, cl = _mk_cluster(4)
+        cl.PAGE_ROWS = 2  # force multi-page drains: a mid-drain window
+        oracle = _mk_oracle()
+        conn = mirror_to_sqlite(oracle.catalog)
+        fired = threading.Event()
+
+        def do_reshard():
+            if not fired.is_set():
+                fired.set()
+                # coordinator thread, between page fetches: sockets free
+                cl.reshard("alter table f shard by hash(k) shards 12")
+
+        try:
+            with failpoint("dcn.coord.fetch", action=do_reshard, nth=2):
+                got = cl.query(QUERIES[0])
+            assert fired.is_set()
+            want = conn.execute(QUERIES[0]).fetchall()
+            ok, msg = rows_equal(got, want, ordered=True)
+            assert ok, msg
+            assert cl.placement("f").shards == 12
+            got = cl.query(QUERIES[1])
+            want = conn.execute(QUERIES[1]).fetchall()
+            ok, msg = rows_equal(got, want)
+            assert ok, msg
+        finally:
+            cl.shutdown()
+
+    def test_reshard_ddl_demotes_cached_plan_locally(self):
+        """The session-level half of the race: ALTER ... SHARD BY bumps
+        schema_version, so a cached plan for the table demotes via the
+        existing catalog-lock revalidation instead of serving a stale
+        placement epoch."""
+        s = Session()
+        s.execute("create table r (k bigint, v bigint) "
+                  "shard by hash(k) shards 4")
+        s.execute("insert into r values (1, 10), (2, 20)")
+        s.execute("set session tidb_enable_non_prepared_plan_cache = 1")
+        sql = "select sum(v) as s from r where k < 10"
+        assert s.query(sql) == [(30,)]
+        assert s.query(sql) == [(30,)]  # now cached
+        assert s.query("select @@last_plan_from_cache") == [(1,)]
+        v0 = s.catalog.schema_version
+        s.execute("alter table r shard by hash(k) shards 8")
+        assert s.catalog.schema_version == v0 + 1
+        assert s.catalog.table("test", "r").schema.shard_by.shards == 8
+        assert s.query(sql) == [(30,)]
+        # the reshard invalidated the cached plan: this was a re-plan
+        assert s.query("select @@last_plan_from_cache") == [(0,)]
+
+    def test_alter_shard_via_ddl_refused(self):
+        """Registering a new map without moving rows would route scans
+        to owners that do not hold them — ddl() refuses and points at
+        reshard()."""
+        workers, cl = _mk_cluster(2)
+        try:
+            with pytest.raises(UnsupportedError, match="reshard"):
+                cl.ddl("alter table f shard by hash(k) shards 2")
+            assert cl.placement("f").shards == 8  # untouched
+            # ...and over a BROADCAST (replicated) table: registering a
+            # map over W full copies would multiply every aggregate
+            cl.broadcast_exec("create table bc (k bigint)")
+            cl.mark_broadcast("bc")
+            with pytest.raises(UnsupportedError, match="reshard"):
+                cl.ddl("alter table bc shard by hash(k) shards 2")
+            assert cl.placement("bc") is None
+        finally:
+            cl.shutdown()
+
+    def test_reshard_with_replicas_refused(self):
+        workers = [Worker() for _ in range(2)]
+        for w in workers:
+            threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                     replicas={0: 1, 1: 0})
+        try:
+            cl.ddl(DDL_HASH)
+            with pytest.raises(UnsupportedError):
+                cl.reshard("alter table f shard by hash(k) shards 2")
+        finally:
+            cl.shutdown()
+
+
+class TestWorkerStatsSurface:
+    def test_info_schema_gains_shard_columns(self):
+        workers, cl = _mk_cluster(2)
+        try:
+            cl.query(QUERIES[3])  # drive some shuffle traffic
+            s = Session()
+            rows = s.query(
+                "select endpoint, shards_owned, shard_bytes, "
+                "shuffle_bytes_in, shuffle_bytes_out, open_cursors "
+                "from information_schema.dcn_worker_stats")
+            mine = [r for r in rows
+                    if any(r[0] == f"127.0.0.1:{w.port}" for w in workers)]
+            assert len(mine) == 2, rows
+            # f: 8 shards over 2 workers = 4 each; d: 4 shards = 2 each
+            assert all(r[1] == 6 for r in mine), mine
+            assert all(r[2] > 0 for r in mine), mine
+            assert sum(r[3] for r in mine) > 0, mine  # shuffle moved bytes
+            assert all(r[5] == 0 for r in mine), mine
+        finally:
+            cl.shutdown()
+
+    def test_shuffle_bytes_metric_moves(self):
+        from tidb_tpu.utils.metrics import SHUFFLE_BYTES_TOTAL
+
+        workers, cl = _mk_cluster(2)
+        try:
+            b_in = SHUFFLE_BYTES_TOTAL.value(dir="in")
+            b_out = SHUFFLE_BYTES_TOTAL.value(dir="out")
+            cl.query(QUERIES[3])
+            assert SHUFFLE_BYTES_TOTAL.value(dir="in") > b_in
+            assert SHUFFLE_BYTES_TOTAL.value(dir="out") > b_out
+        finally:
+            cl.shutdown()
+
+
+class TestShardedFailover:
+    def test_dead_owner_fails_over_to_replica_mirror(self):
+        """load_sharded mirrors each owner's slice into
+        `<table>__part<w>` on its replica, so the existing failover
+        path serves a sharded partition through a dead owner."""
+        import socket as _socket
+
+        workers = [Worker() for _ in range(2)]
+        for w in workers:
+            threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                     replicas={0: 1, 1: 0}, rpc_timeout_s=5.0,
+                     connect_timeout_s=2.0)
+        try:
+            cl.ddl("create table f (k bigint, v bigint) "
+                   "shard by hash(k) shards 4")
+            ks = np.arange(500, dtype=np.int64)
+            cl.load_sharded("f", arrays={"k": ks, "v": ks * 2})
+            sql = "select count(*) as n, sum(v) as s from f"
+            want = cl.query(sql)
+            w0 = workers[0]
+            w0._running = False
+            try:
+                w0._sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            w0._sock.close()
+            assert cl.query(sql) == want
+        finally:
+            cl.shutdown()
+
+
+class TestExchangePlanner:
+    def test_colocated_sides_skip_the_exchange(self):
+        """Both tables hash-placed ON the join key with shards % W == 0:
+        the planner moves NOTHING (no scatter work, no shuffle bytes)."""
+        workers = [Worker() for _ in range(2)]
+        for w in workers:
+            threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in workers])
+        try:
+            cl.ddl("create table a (k bigint, v bigint) "
+                   "shard by hash(k) shards 4")
+            cl.ddl("create table b (k bigint, u bigint) "
+                   "shard by hash(k) shards 2")
+            ks = np.arange(400, dtype=np.int64)
+            cl.load_sharded("a", arrays={"k": ks, "v": ks * 2})
+            cl.load_sharded("b", arrays={"k": ks[::2], "u": ks[::2] + 1})
+            before = [w.stats["shuffle_bytes_out"] for w in workers]
+            got = cl.query("select count(*) as n, sum(a.v) as sv "
+                           "from a join b on a.k = b.k")
+            assert tuple(map(int, got[0])) == (200, int((ks[::2] * 2).sum()))
+            after = [w.stats["shuffle_bytes_out"] for w in workers]
+            assert before == after, (before, after)
+        finally:
+            cl.shutdown()
+
+    def test_shuffle_key_equality_required(self):
+        workers, cl = _mk_cluster(2)
+        try:
+            with pytest.raises(TiDBTPUError):
+                cl.query("select count(*) as n from f join d on f.k < d.k")
+        finally:
+            cl.shutdown()

@@ -574,6 +574,49 @@ class TestBlockingUnderLockFixture:
         assert all(s.reason for _v, s in mem)
 
 
+class TestShardingScope:
+    """ISSUE 13: the analyzer roots extend to tidb_tpu/sharding/ — the
+    shuffle data plane obeys the same leaf-lock, host-sync, and
+    lifecycle discipline as every other governed tier."""
+
+    def test_sharding_in_default_roots(self):
+        from tidb_tpu.analysis.blocking_under_lock import (
+            DEFAULT_MODULES as BLOCK_MODULES,
+        )
+        from tidb_tpu.analysis.lock_discipline import (
+            DEFAULT_MODULES as LOCK_MODULES,
+        )
+        from tidb_tpu.analysis.resource_lifecycle import (
+            ResourceLifecyclePass,
+        )
+
+        assert "tidb_tpu/sharding/shuffle.py" in BLOCK_MODULES
+        assert "tidb_tpu/sharding/shuffle.py" in LOCK_MODULES
+        assert "sharding" in HostSyncPass.SCOPE
+        assert "sharding" in ResourceLifecyclePass.SCOPE
+
+    def test_shuffle_send_under_map_lock_is_flagged(self, tmp_path):
+        """A peer-socket send/recv while holding the shard-map lock is
+        the violation; snapshot-then-send stays clean."""
+        root = _mini_root(tmp_path, ("sharding", "bad_shuffle_lock.py"))
+        p = BlockingUnderLockPass(
+            modules=("tidb_tpu/sharding/bad_shuffle_lock.py",))
+        rep, _ = _run_pass(root, p)
+        msgs = [v.render() for v in rep.violations]
+        assert len(rep.violations) == 2, msgs
+        assert any("socket send" in m for m in msgs), msgs
+        assert any("socket recv" in m for m in msgs), msgs
+        assert all("_shard_map_lock" in m for m in msgs), msgs
+
+    def test_real_sharding_modules_are_clean(self, real_tree_reports):
+        """The real shuffle/placement modules carry zero unsuppressed
+        violations in ANY pass — the inbox lock is provably a leaf."""
+        for rep in real_tree_reports:
+            bad = [v for v in rep.violations
+                   if "tidb_tpu/sharding/" in v.path.replace("\\", "/")]
+            assert not bad, [v.render() for v in bad]
+
+
 class TestSuppressionCountPinned:
     """ISSUE 12 satellite: the report's suppression count is a tier-1-
     asserted number so allowlist drift is visible in review. Update the

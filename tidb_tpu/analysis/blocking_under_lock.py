@@ -52,6 +52,11 @@ DEFAULT_MODULES = (
     "tidb_tpu/columnar/store.py",
     "tidb_tpu/executor/pipeline.py",
     "tidb_tpu/utils/memory.py",
+    # shuffle exchange (ISSUE 13): the placement and inbox locks are
+    # LEAVES — a shuffle send under them would stall every stage/gather
+    # behind one slow peer socket (fixture: bad_shuffle_lock.py)
+    "tidb_tpu/sharding/shuffle.py",
+    "tidb_tpu/sharding/placement.py",
 )
 
 # attribute names whose call blocks the thread

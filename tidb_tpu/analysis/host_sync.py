@@ -304,7 +304,8 @@ class HostSyncPass(Pass):
            "serving/columnar; intentional ones carry "
            "`# host-sync: <reason>`")
 
-    SCOPE = ("executor", "ops", "parallel", "serving", "columnar")
+    SCOPE = ("executor", "ops", "parallel", "serving", "columnar",
+             "sharding")
 
     def run(self, project: Project) -> List[Violation]:
         out: List[Violation] = []

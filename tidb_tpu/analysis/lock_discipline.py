@@ -49,6 +49,9 @@ DEFAULT_MODULES = (
     # columnar segment store (ISSUE 8): the store's leaf lock guards
     # segment residency/spill state shared across concurrent scans
     "tidb_tpu/columnar/store.py",
+    # shuffle exchange (ISSUE 13): the inbox lock guards staged-batch
+    # state shared by peer-stage RPC threads and the gather/apply phase
+    "tidb_tpu/sharding/shuffle.py",
 )
 
 # NOTE: the serving-tier wait-discipline check (ISSUE 7) moved to

@@ -239,7 +239,7 @@ class ResourceLifecyclePass(Pass):
            "path: finally/with/class-managed, or a `# lifecycle:` "
            "annotated handoff")
 
-    SCOPE = ("executor", "columnar", "parallel", "serving")
+    SCOPE = ("executor", "columnar", "parallel", "serving", "sharding")
     EXTRA_FILES = ("tidb_tpu/utils/memory.py",)
 
     def __init__(self, scope: Sequence[str] = SCOPE,

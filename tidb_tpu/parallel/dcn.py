@@ -301,6 +301,53 @@ def _is_loopback(host: str) -> bool:
     return host in ("127.0.0.1", "::1", "localhost")
 
 
+def dial(host: str, port: int, secret: Optional[str] = None,
+         timeout: Optional[float] = None) -> socket.socket:
+    """Client-side connect + mutual auth handshake against a Worker.
+    Shared by the coordinator (``Cluster._connect``) and by workers
+    dialing PEERS for the shuffle exchange (ISSUE 13) — one handshake
+    implementation, so the endpoint-binding and downgrade-refusal
+    rules hold on every link in the fleet."""
+    s = socket.create_connection((host, port), timeout=timeout)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    flag = _recv_exact(s, 1)
+    if flag == b"\x01":
+        if not secret:
+            s.close()
+            raise ExecutionError(
+                "dcn worker demands auth but no secret configured")
+        nonce_w = _recv_exact(s, 16)
+        nonce_c = os.urandom(16)
+        claim_host = "127.0.0.1" if host == "localhost" else host
+        endpoint = f"{claim_host}:{port}".encode()
+        transcript = endpoint + b"|" + nonce_w + nonce_c
+        s.sendall(nonce_c + bytes([len(endpoint)]) + endpoint
+                  + hmac.new(secret.encode(),
+                             b"dcn-coord|" + transcript,
+                             hashlib.sha256).digest())
+        # reverse challenge: the worker must prove the secret too — a
+        # spoofed worker that merely echoed the \x01 flag cannot
+        mac_w = _recv_exact(s, 32)
+        want = hmac.new(secret.encode(), b"dcn-worker|" + transcript,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(mac_w, want):
+            s.close()
+            raise ExecutionError(
+                f"dcn worker {host}:{port} failed the reverse "
+                "handshake (wrong or missing secret)")
+    elif secret:
+        # downgrade refusal: a client configured for auth must not talk
+        # to an endpoint that waives it (spoofed worker)
+        s.close()
+        raise ExecutionError(
+            f"dcn worker {host}:{port} does not require auth but this "
+            "cluster is configured with a secret")
+    # create_connection leaves its connect timeout armed on the socket;
+    # callers apply per-RPC deadlines themselves
+    s.settimeout(None)
+    return s
+
+
 # ---------------------------------------------------------------------------
 # worker
 # ---------------------------------------------------------------------------
@@ -361,12 +408,47 @@ class Worker:
         self.stats: Dict[str, int] = {
             "executed": 0, "cancelled": 0, "deadline_exceeded": 0,
             "cancel_rpcs": 0, "pages": 0,
+            "shuffle_bytes_in": 0, "shuffle_bytes_out": 0,
         }
         self._stats_lock = threading.Lock()
+        # sharded placement (ISSUE 13): table -> (owned shard ids, bytes)
+        # recorded by the coordinator's place_shards RPC; surfaced via
+        # cmd "stats" -> information_schema.dcn_worker_stats
+        self._placed: Dict[str, Tuple[List[int], int]] = {}
+        self._placed_lock = threading.Lock()
+        # shuffle exchange inbox: batches from peer workers staged here
+        # until the coordinator's gather/apply phase drains them; bytes
+        # charged to a MemTracker (budget re-read from the session's
+        # tidb_mem_quota_query before every stage) so a hot shuffle hits
+        # typed backpressure instead of silent growth
+        from tidb_tpu.sharding.shuffle import ShuffleInbox
+        from tidb_tpu.utils.memory import MemTracker
 
-    def _bump(self, key: str) -> None:
+        self._shuffle_tracker = MemTracker("shuffle", budget=None,
+                                           spill_enabled=False)
+        self._inbox = ShuffleInbox(self._shuffle_tracker)
+        # pooled peer connections for scatter sends: one authed socket
+        # per peer endpoint, serialized by a per-peer lock (an
+        # interleaved send/recv pair would desync the framing — same
+        # rule as the coordinator's _sock_locks). Re-dialing per batch
+        # paid TCP connect + the mutual-auth handshake on the hot path.
+        self._peer_socks: Dict[Tuple[str, int], socket.socket] = {}
+        self._peer_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._peer_pool_lock = threading.Lock()
+        # reshard idempotency: shuffle ids this worker already applied —
+        # a re-driven reshard_apply (lost response) must NOT truncate
+        # again against an inbox it already drained and closed
+        self._reshards_done: Dict[str, int] = {}
+        # one pending prepared 2PC transaction at a time (the shared
+        # session holds its provisional writes between the prepare and
+        # commit RPCs); other statements are refused typed while it is
+        # pending so they cannot be absorbed into the open transaction
+        self._txn2pc: Optional[Tuple[str, float]] = None
+        self._txn2pc_lock = threading.Lock()
+
+    def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
-            self.stats[key] += 1
+            self.stats[key] += n
 
     def _drop_cursor_locked(self, h) -> None:
         self._cursors.pop(h, None)
@@ -544,6 +626,7 @@ class Worker:
         self._bump("executed")
         try:
             with self._exec_lock:
+                self._guard_2pc_locked()
                 if my_cancel is not None:
                     sess._ext_cancel = my_cancel
                 if my_deadline is not None:
@@ -568,6 +651,341 @@ class Worker:
                 with self._inflight_lock:
                     if self._inflight.get(token) is ev:
                         del self._inflight[token]
+
+    # -- sharded placement + shuffle exchange + 2PC (ISSUE 13) ----------
+
+    def _guard_2pc_locked(self) -> None:
+        """Called under the exec lock before any statement runs: while
+        a prepared 2PC transaction is pending, foreign statements are
+        refused TYPED (they would otherwise silently join the open
+        transaction on the shared session). A prepared participant
+        NEVER resolves unilaterally — it voted yes, and the coordinator
+        may hold a commit decision it cannot see, so only txn_commit /
+        txn_abort (a coordinator's recover_txns()) releases it. This is
+        the textbook 2PC blocking window, kept observable on purpose."""
+        with self._txn2pc_lock:
+            pend = self._txn2pc
+            if pend is not None:
+                raise ExecutionError(
+                    f"dcn worker: 2pc transaction {pend[0]} is pending "
+                    "(prepare acknowledged, decision not yet received); "
+                    "statement refused until a coordinator resolves it")
+
+    def _txn2pc_cmd(self, cmd: str, msg: Dict):
+        """txn_prepare / txn_commit / txn_abort: one participant's half
+        of the coordinator's two-phase commit (storage/txn2pc.py is the
+        single-process committer the session's COMMIT already runs; this
+        wraps it in the cross-process prepare/decide protocol)."""
+        xid = str(msg["xid"])
+        sess = self.session
+        if cmd == "txn_prepare":
+            with self._exec_lock:
+                with self._txn2pc_lock:
+                    pend = self._txn2pc
+                if pend is not None and pend[0] == xid:
+                    return "prepared"  # retried prepare: already staged
+                if pend is not None:
+                    raise ExecutionError(
+                        f"dcn worker: 2pc transaction {pend[0]} still "
+                        f"pending; cannot prepare {xid}")
+                sess.execute("begin")
+                try:
+                    sess.execute(msg["sql"])
+                except Exception:
+                    try:
+                        sess.execute("rollback")
+                    except Exception:  # noqa: BLE001 — abort cleanup
+                        pass
+                    raise
+                with self._txn2pc_lock:
+                    self._txn2pc = (xid, time.monotonic())
+            return "prepared"
+        with self._exec_lock:
+            with self._txn2pc_lock:
+                mine = self._txn2pc is not None and self._txn2pc[0] == xid
+            if not mine:
+                # already finished here, or never prepared (a commit
+                # retry after a lost response): idempotent ack
+                return "idempotent"
+            sess.execute("commit" if cmd == "txn_commit" else "rollback")
+            # cleared only AFTER the commit/rollback lands: a failed
+            # commit must keep the guard up, or the next statement
+            # would silently join the still-open prepared transaction
+            # and a commit re-drive would get a hollow idempotent ack
+            with self._txn2pc_lock:
+                self._txn2pc = None
+        return "done"
+
+    def _shuffle_budget(self) -> None:
+        """Re-arm the inbox tracker's budget from the session's memory
+        quota before a stage lands — the knob is a live sysvar, and the
+        budget must be whatever it says NOW."""
+        q = int(self.session.sysvars.get("tidb_mem_quota_query"))
+        self._shuffle_tracker.budget = q if q > 0 else None
+
+    def _shuffle_stage(self, msg: Dict) -> int:
+        """A PEER worker's batch arriving: charge, stage, account."""
+        inject("shuffle.recv")
+        self._shuffle_budget()
+        n = self._inbox.stage(str(msg["shuffle_id"]), str(msg["side"]),
+                              msg["batch"])
+        self._bump("shuffle_bytes_in", n)
+        from tidb_tpu.utils.metrics import SHUFFLE_BYTES_TOTAL
+
+        SHUFFLE_BYTES_TOTAL.inc(n, dir="in")
+        return n
+
+    def _shuffle_scatter(self, msg: Dict) -> Dict:
+        """Partition this worker's live rows of `table` by the shipped
+        shard map (mode=hash) — or replicate them to every peer
+        (mode=broadcast) — and ship per-destination batches
+        FoR-encoded. dest == self stages straight into the local inbox
+        (no wire). All socket work happens with NO worker lock held."""
+        from tidb_tpu.sharding import placement as pl
+        from tidb_tpu.sharding import shuffle as shfl
+        from tidb_tpu.utils.metrics import SHUFFLE_BYTES_TOTAL
+
+        table = self.session.catalog.table(
+            msg.get("db") or self.session.db, msg["table"])
+        arrays, valids, strings, n = shfl.extract_live_columns(
+            table, msg.get("columns") or None)
+        n_workers = int(msg["n_workers"])
+        mode = msg.get("mode", "hash")
+        parts = None
+        if mode != "broadcast":
+            key = msg["key"]
+            if key in strings:
+                raise UnsupportedError(
+                    "dcn shuffle: string shuffle keys are unsupported "
+                    "(dictionary codes are process-local)")
+            smap = pl.ShardMap.from_wire(msg["map"])
+            shards = pl.shard_of_array(smap, arrays[key], valids[key])
+            dest = shards % np.int64(max(n_workers, 1))
+            parts = shfl.partition_rows(arrays, valids, strings, dest,
+                                        n_workers)
+        types = {c.name: c.type_ for c in table.schema.columns}
+        sid, side = str(msg["shuffle_id"]), str(msg["side"])
+        self_i = int(msg["self_index"])
+        peers = msg["peers"]
+        timeout = float(msg.get("timeout_s") or 30.0)
+        sent_bytes = 0
+        # broadcast replicates to the GATHER set only (`dests`) and
+        # encodes its one identical batch ONCE; a hash shuffle routes
+        # over every worker — each owns a key range — with a distinct
+        # batch per destination
+        bcast_batch = None
+        if mode == "broadcast":
+            dests = [int(d) for d in (msg.get("dests")
+                                      or range(n_workers))]
+            if n:
+                bcast_batch = shfl.encode_batch(types, arrays, valids,
+                                                strings)
+        else:
+            dests = range(n_workers)
+        for w in dests:
+            if mode == "broadcast":
+                batch = bcast_batch
+            else:
+                batch = (shfl.encode_batch(types, *parts[w])
+                         if parts[w] is not None else None)
+            if batch is None:
+                continue
+            if w == self_i:
+                self._shuffle_budget()
+                self._inbox.stage(sid, side, batch)
+                continue
+            inject("shuffle.send")
+            host, port = peers[w]
+            resp = self._peer_call(
+                str(host), int(port),
+                {"cmd": "shuffle_stage", "shuffle_id": sid,
+                 "side": side, "batch": batch}, timeout)
+            if not resp.get("ok"):
+                # the peer's typed refusal (e.g. inbox OOM backpressure)
+                # travels through this worker back to the coordinator
+                raise ExecutionError(
+                    f"shuffle stage to worker {w} failed: "
+                    f"{resp.get('error')}")
+            nb = int(resp["result"])
+            sent_bytes += nb
+            self._bump("shuffle_bytes_out", nb)
+            SHUFFLE_BYTES_TOTAL.inc(nb, dir="out")
+        return {"rows": int(n), "bytes": sent_bytes}
+
+    def _peer_call(self, host: str, port: int, msg: Dict,
+                   timeout: float) -> Dict:
+        """One RPC to a peer worker over the pooled connection for that
+        endpoint (dialed + authed on first use, dropped on any wire
+        fault so the next call re-dials). The per-peer lock serializes
+        concurrent scatters — two sides of one shuffle ship in
+        parallel threads and must not interleave frames."""
+        key = (host, port)
+        with self._peer_pool_lock:
+            lk = self._peer_locks.setdefault(key, threading.Lock())
+        with lk:
+            s = self._peer_socks.get(key)
+            if s is None:
+                s = dial(host, port, secret=self.secret, timeout=timeout)
+                self._peer_socks[key] = s
+            try:
+                s.settimeout(timeout)
+                _send(s, msg)
+                resp = _recv(s)
+                s.settimeout(None)
+            except (ConnectionError, OSError, DcnCodecError):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                self._peer_socks.pop(key, None)
+                raise
+        return resp
+
+    def _clone_temp_table(self, base, name: str, columns: List[str]):
+        """Fresh table holding the shipped column subset of `base`'s
+        schema — no constraints, defaults, or generated columns (the
+        exchange ships materialized values; re-running column logic
+        would double-apply it)."""
+        import copy
+
+        from tidb_tpu.storage.table import TableSchema
+
+        cat = self.session.catalog
+        db = self.session.db
+        cat.drop_table(db, name, if_exists=True)
+        cols = []
+        for cn in columns:
+            ci = copy.deepcopy(base.schema.col(cn))
+            ci.not_null = False
+            ci.auto_increment = False
+            ci.default = None
+            ci.state = "public"
+            cols.append(ci)
+        cat.create_table(db, TableSchema(name, cols))
+        return cat.table(db, name)
+
+    def _shuffle_gather(self, msg: Dict) -> Dict:
+        """Assemble this worker's staged batches into temp tables (one
+        per exchanged side), run the partial SQL over the co-partitioned
+        slice, and release the shuffle state. The result pages through
+        the SAME cursor machinery as partial_paged, so drains, cancel
+        tokens, and leak accounting are identical."""
+        from tidb_tpu.sharding import shuffle as shfl
+
+        sid = str(msg["shuffle_id"])
+        cat = self.session.catalog
+        created: List[str] = []
+        try:
+            for sd in msg["sides"]:
+                base = cat.table(msg.get("db") or self.session.db,
+                                 sd["table"])
+                t = self._clone_temp_table(base, sd["temp"], sd["columns"])
+                created.append(sd["temp"])
+                types = {c.name: c.type_ for c in t.schema.columns}
+                shfl.assemble_into_table(self.session, sd["temp"], types,
+                                         self._inbox.drain(sid, sd["side"]))
+            return self._partial_paged(msg)
+        finally:
+            # the cursor holds materialized host rows: the staged
+            # batches and temp tables are dead weight from here (and on
+            # error they must not outlive the statement)
+            self._inbox.close(sid)
+            for name in created:
+                try:
+                    cat.drop_table(self.session.db, name, if_exists=True)
+                except Exception:  # noqa: BLE001 — cleanup best effort
+                    pass
+
+    def _reshard_apply(self, msg: Dict) -> int:
+        """Swap this worker's slice of `table` for the rows the
+        resharding scatter staged here: truncate, then land every
+        inbox batch. Runs under the exec lock so no statement observes
+        the half-swapped table. IDEMPOTENT against coordinator
+        re-drives (a lost response must not truncate again over an
+        already-drained inbox), and the inbox entry releases only on
+        SUCCESS — a failed apply keeps the staged rows, which are the
+        only remaining copy once the truncate ran."""
+        from tidb_tpu.sharding import shuffle as shfl
+
+        sid = str(msg["shuffle_id"])
+        db = msg.get("db") or self.session.db
+        t = self.session.catalog.table(db, msg["table"])
+        types = {c.name: c.type_ for c in t.schema.columns}
+        with self._exec_lock:
+            # same guard as every statement path: TRUNCATE is DDL and
+            # would IMPLICITLY COMMIT a pending prepared 2PC txn —
+            # refuse typed instead (the reshard recovers once the
+            # coordinator resolves the transaction)
+            self._guard_2pc_locked()
+            with self._placed_lock:
+                done = self._reshards_done.get(sid)
+            if done is not None:
+                return done
+            batches = self._inbox.drain(sid, str(msg["side"]))
+            self.session.execute(f"truncate table `{msg['table']}`")
+            total = 0
+            for b in batches:
+                arrays, valids, strs = shfl.decode_batch(types, b)
+                if b["n"]:
+                    total += t.insert_columns(arrays, valids,
+                                              strings=strs)
+            with self._placed_lock:
+                self._reshards_done[sid] = total
+                while len(self._reshards_done) > 64:
+                    self._reshards_done.pop(
+                        next(iter(self._reshards_done)))
+            self._inbox.close(sid)
+            return total
+
+    def _partial_paged(self, msg: Dict) -> Dict:
+        """Run the partial once, return the first page + a cursor the
+        coordinator drains with "fetch" — bounds the coordinator's
+        in-flight volume to one page per worker. Shared by the plain
+        partial path and the shuffle gather (same cursor, token, and
+        leak discipline)."""
+        inject("dcn.worker.partial")
+        rs = self._run_sql(msg)
+        rows = rs.rows
+        tracing.annotate(f"partial:rows={len(rows)}")
+        page = int(msg.get("page_rows", 8192))
+        token = msg.get("token")
+        if len(rows) <= page:
+            with self._cursor_lock:
+                self._drop_token_cursor_locked(token)
+            return {"rows": rows, "cursor": None, "total": len(rows)}
+        now = time.time()
+        if token is not None:
+            with self._inflight_lock:
+                poisoned = self._cancelled_tokens.pop(
+                    token, None) is not None
+            if poisoned:
+                # the coordinator abandoned this statement (cancel
+                # arrived after execution finished): don't pin a
+                # cursor nobody will ever drain
+                return {"rows": rows[:page], "cursor": None,
+                        "total": len(rows)}
+        with self._cursor_lock:
+            # a RETRY of this token (first response lost on the
+            # wire) must not leave the first attempt's cursor
+            # pinned: evict it before opening the replacement
+            self._drop_token_cursor_locked(token)
+            # reap abandoned cursors (a crashed coordinator must not
+            # leak result memory); live drains are refreshed on every
+            # fetch so they never look idle
+            stale = [h for h, (ts, _r) in self._cursors.items()
+                     if now - ts > self.CURSOR_TTL_S]
+            for h in stale:
+                self._drop_cursor_locked(h)
+            if len(self._cursors) >= self.CURSOR_CAP:
+                raise ExecutionError(
+                    f"dcn worker: {self.CURSOR_CAP} partial cursors "
+                    "already open")
+            h = self._next_cursor
+            self._next_cursor += 1
+            self._cursors[h] = (now, rows)
+            if token is not None:
+                self._token_cursors[token] = h
+        return {"rows": rows[:page], "cursor": h, "total": len(rows)}
 
     def _handle(self, msg: Dict):
         if msg.get("deadline_s") is not None:
@@ -605,7 +1023,32 @@ class Worker:
                 out = dict(self.stats)
             with self._cursor_lock:
                 out["open_cursors"] = len(self._cursors)
+            with self._placed_lock:
+                out["shards_owned"] = sum(
+                    len(s) for s, _b in self._placed.values())
+                out["shard_bytes"] = sum(
+                    b for _s, b in self._placed.values())
+            out["open_shuffles"] = self._inbox.open_count()
             return out
+        if cmd == "place_shards":
+            with self._placed_lock:
+                self._placed[str(msg["table"])] = (
+                    [int(s) for s in (msg.get("shards") or [])],
+                    int(msg.get("bytes") or 0))
+            return "placed"
+        if cmd == "shuffle_stage":
+            return self._shuffle_stage(msg)
+        if cmd == "shuffle_scatter":
+            return self._shuffle_scatter(msg)
+        if cmd == "shuffle_gather":
+            return self._shuffle_gather(msg)
+        if cmd == "shuffle_close":
+            self._inbox.close(str(msg["shuffle_id"]))
+            return "closed"
+        if cmd == "reshard_apply":
+            return self._reshard_apply(msg)
+        if cmd in ("txn_prepare", "txn_commit", "txn_abort"):
+            return self._txn2pc_cmd(cmd, msg)
         if cmd == "exec":
             rs = self._run_sql(msg)
             return rs.rows if rs is not None else None
@@ -644,52 +1087,7 @@ class Worker:
             rs = self._run_sql(msg)
             return rs.rows
         if cmd == "partial_paged":
-            # run the partial once, return the first page + a cursor the
-            # coordinator drains with "fetch" — bounds the coordinator's
-            # in-flight volume to one page per worker
-            inject("dcn.worker.partial")
-            rs = self._run_sql(msg)
-            rows = rs.rows
-            tracing.annotate(f"partial:rows={len(rows)}")
-            page = int(msg.get("page_rows", 8192))
-            token = msg.get("token")
-            if len(rows) <= page:
-                with self._cursor_lock:
-                    self._drop_token_cursor_locked(token)
-                return {"rows": rows, "cursor": None, "total": len(rows)}
-            now = time.time()
-            if token is not None:
-                with self._inflight_lock:
-                    poisoned = self._cancelled_tokens.pop(
-                        token, None) is not None
-                if poisoned:
-                    # the coordinator abandoned this statement (cancel
-                    # arrived after execution finished): don't pin a
-                    # cursor nobody will ever drain
-                    return {"rows": rows[:page], "cursor": None,
-                            "total": len(rows)}
-            with self._cursor_lock:
-                # a RETRY of this token (first response lost on the
-                # wire) must not leave the first attempt's cursor
-                # pinned: evict it before opening the replacement
-                self._drop_token_cursor_locked(token)
-                # reap abandoned cursors (a crashed coordinator must not
-                # leak result memory); live drains are refreshed on every
-                # fetch so they never look idle
-                stale = [h for h, (ts, _r) in self._cursors.items()
-                         if now - ts > self.CURSOR_TTL_S]
-                for h in stale:
-                    self._drop_cursor_locked(h)
-                if len(self._cursors) >= self.CURSOR_CAP:
-                    raise ExecutionError(
-                        f"dcn worker: {self.CURSOR_CAP} partial cursors "
-                        "already open")
-                h = self._next_cursor
-                self._next_cursor += 1
-                self._cursors[h] = (now, rows)
-                if token is not None:
-                    self._token_cursors[token] = h
-            return {"rows": rows[:page], "cursor": h, "total": len(rows)}
+            return self._partial_paged(msg)
         if cmd == "fetch":
             inject("dcn.worker.page")
             self._bump("pages")
@@ -796,8 +1194,10 @@ def _from_sql(src, rename: Dict[str, str]) -> str:
 
 
 def partial_rewrite(sql: str, table_as: Optional[str] = None,
-                    partitioned=frozenset(), broadcast=frozenset()
-                    ) -> Tuple[str, str, List[str]]:
+                    partitioned=frozenset(), broadcast=frozenset(),
+                    renames: Optional[Dict[str, str]] = None,
+                    co_partitioned=frozenset(),
+                    parsed=None) -> Tuple[str, str, List[str]]:
     """One SELECT -> (partial_sql, final_sql, out_names). partial_sql
     runs on every worker; its result rows are unioned into the staging
     table __dcn_partial__ on the coordinator, where final_sql computes
@@ -811,8 +1211,17 @@ def partial_rewrite(sql: str, table_as: Optional[str] = None,
     coprocessor-join shape, SURVEY.md:131): each worker joins its fact
     partition against its full local dim copies, so the partial/final
     aggregate split stays exact. `table_as` substitutes the partitioned
-    table's name — the replica-partition retry reads `<fact>__part<i>`."""
-    stmts = parse(sql)
+    table's name — the replica-partition retry reads `<fact>__part<i>`.
+
+    Shuffle joins (ISSUE 13) relax the one-partitioned-table rule:
+    tables in `co_partitioned` are co-partitioned ON THE JOIN KEY at
+    execution time (the cross-process exchange routes both sides with
+    the same hash), so the partial/final aggregate split stays exact
+    with any number of them; `renames` substitutes the per-worker
+    staging-table names the exchanged sides materialize into.
+    `parsed` (the pre-parsed statement list) skips the re-parse when
+    the caller already holds one — the coordinator's planner does."""
+    stmts = parsed if parsed is not None else parse(sql)
     if len(stmts) != 1 or not isinstance(stmts[0], A.SelectStmt):
         raise UnsupportedError("dcn tier handles a single SELECT")
     st = stmts[0]
@@ -829,6 +1238,19 @@ def partial_rewrite(sql: str, table_as: Optional[str] = None,
             raise UnsupportedError(
                 f"table {fact!r} is broadcast (replicated), not "
                 "partitioned; query it on one worker directly")
+    elif co_partitioned:
+        # shuffle plan: every side is either co-partitioned on the join
+        # key (exchange output, or hash-placed on it already) or a
+        # broadcast dim — the coordinator's exchange planner already
+        # validated the join keys
+        missing = [t.name for t in tables
+                   if t.name not in co_partitioned
+                   and t.name not in broadcast]
+        if missing:
+            raise UnsupportedError(
+                f"dcn shuffle join sides {missing} are neither "
+                "co-partitioned nor broadcast")
+        fact = next(t.name for t in tables if t.name in co_partitioned)
     else:
         parts = [t.name for t in tables if t.name in partitioned]
         if len(parts) != 1:
@@ -867,7 +1289,9 @@ def partial_rewrite(sql: str, table_as: Optional[str] = None,
                     return True
         return False
 
-    rename = {fact: table_as} if table_as else {}
+    rename = dict(renames or {})
+    if table_as:
+        rename[fact] = table_as
     from_sql = _from_sql(st.from_, rename)
     where = f" where {expr_to_sql(st.where)}" if st.where is not None else ""
 
@@ -981,6 +1405,108 @@ def _topn_rewrite(st: A.SelectStmt, from_sql: str, where: str
 
 
 # ---------------------------------------------------------------------------
+# predicate helpers for shard-key pruning + shuffle planning (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+_NOT_LITERAL = object()
+
+
+def _eq_conjuncts(e):
+    """Flatten an AND tree into its conjuncts."""
+    if isinstance(e, A.EBinary) and e.op == "and":
+        yield from _eq_conjuncts(e.left)
+        yield from _eq_conjuncts(e.right)
+    else:
+        yield e
+
+
+def _literal_int(e):
+    """Integer value of a literal expr (None for NULL); _NOT_LITERAL
+    when it is anything else — float literals included, because the
+    device's f64 compare and python int arithmetic can disagree, so a
+    float-pinned shard key must not prune (same rule as zone maps)."""
+    neg = False
+    while isinstance(e, A.EUnary) and e.op in ("-", "+"):
+        neg ^= (e.op == "-")
+        e = e.arg
+    if isinstance(e, A.ENull):
+        return None
+    if isinstance(e, A.ENum) and "." not in e.text \
+            and "e" not in e.text.lower():
+        try:
+            v = int(e.text)
+        except ValueError:
+            return _NOT_LITERAL
+        return -v if neg else v
+    return _NOT_LITERAL
+
+
+def _shard_eq_value(where, table: str, column: str):
+    """(value, True) when a WHERE conjunct pins `column` to one integer
+    literal (col = N, qualifier absent or naming `table`) — the scan
+    then dispatches to that single shard's owner."""
+    if where is None:
+        return None, False
+    for c in _eq_conjuncts(where):
+        if not (isinstance(c, A.EBinary) and c.op == "="):
+            continue
+        for name_side, lit_side in ((c.left, c.right),
+                                    (c.right, c.left)):
+            if not isinstance(name_side, A.EName):
+                continue
+            if name_side.name != column:
+                continue
+            if name_side.qualifier not in (None, table):
+                continue
+            v = _literal_int(lit_side)
+            if v is not _NOT_LITERAL:
+                return v, True
+    return None, False
+
+
+def _walk_exprs(node):
+    """Every dataclass expr node reachable from `node` (AST subtrees,
+    lists, tuples) — the EName harvest for used-column analysis."""
+    import dataclasses as _dc
+
+    stack = [node]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, (list, tuple)):
+            stack.extend(e)
+            continue
+        if not _dc.is_dataclass(e):
+            continue
+        yield e
+        for fld in _dc.fields(e):
+            stack.append(getattr(e, fld.name))
+
+
+def _equi_name_pairs(st) -> List[Tuple[A.EName, A.EName]]:
+    """(EName, EName) pairs from every equality conjunct in the JOIN ON
+    trees and the WHERE — the candidate shuffle keys."""
+    conds: List = []
+
+    def walk_src(src):
+        if isinstance(src, A.Join):
+            if src.on is not None:
+                conds.extend(_eq_conjuncts(src.on))
+            walk_src(src.left)
+            walk_src(src.right)
+
+    walk_src(st.from_)
+    if st.where is not None:
+        conds.extend(_eq_conjuncts(st.where))
+    out = []
+    for c in conds:
+        if isinstance(c, A.EBinary) and c.op == "=" \
+                and isinstance(c.left, A.EName) \
+                and isinstance(c.right, A.EName):
+            out.append((c.left, c.right))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # coordinator
 # ---------------------------------------------------------------------------
 
@@ -1052,6 +1578,28 @@ class Cluster:
         self._endpoints = list(endpoints)
         self._partitioned: set = set()
         self._broadcast: set = set()
+        # sharded placement (ISSUE 13): table -> ShardMap snapshot +
+        # loaded bytes. The lock is a LEAF: snapshot under it, never a
+        # socket send (blocking-under-lock pass enforces the shape —
+        # see tests/analysis_fixtures/bad_shuffle_lock.py)
+        self._placements: Dict[str, object] = {}
+        self._placement_bytes: Dict[str, int] = {}
+        self._placement_lock = threading.Lock()
+        self._table_cols_cache: Dict[str, List[str]] = {}
+        # reshard fence + recovery: while a table is in `_resharding`
+        # (live reshard) or `_reshard_pending` (phase B interrupted —
+        # some workers swapped, some not), statements against it are
+        # refused TYPED instead of silently mixing placement epochs;
+        # recover_reshard() re-drives the idempotent applies
+        self._resharding: set = set()
+        self._reshard_pending: Dict[str, Dict] = {}
+        # 2PC coordinator state: xid -> participant worker ids. A txn
+        # moves pending -> decided at the commit point; recover_txns()
+        # finishes either side after a coordinator "crash" (failpoint
+        # between prepare and commit — the chaos grid's window)
+        self._txn_pending: Dict[str, List[int]] = {}
+        self._txn_decided: Dict[str, List[int]] = {}
+        self._txn_lock = threading.Lock()
         self._health: List[_LinkHealth] = [_LinkHealth() for _ in endpoints]
         # per-call RPC budget (deadline + timeout) travels thread-local
         # so _call keeps its monkeypatch-friendly (i, msg) signature
@@ -1081,46 +1629,8 @@ class Cluster:
     def _connect(self, host: str, port: int,
                  timeout: Optional[float] = None) -> socket.socket:
         inject("dcn.connect")
-        s = socket.create_connection(
-            (host, port), timeout=timeout or self.connect_timeout_s)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        flag = _recv_exact(s, 1)
-        if flag == b"\x01":
-            if not self.secret:
-                s.close()
-                raise ExecutionError(
-                    "dcn worker demands auth but no secret configured")
-            nonce_w = _recv_exact(s, 16)
-            nonce_c = os.urandom(16)
-            claim_host = "127.0.0.1" if host == "localhost" else host
-            endpoint = f"{claim_host}:{port}".encode()
-            transcript = endpoint + b"|" + nonce_w + nonce_c
-            s.sendall(nonce_c + bytes([len(endpoint)]) + endpoint
-                      + hmac.new(self.secret.encode(),
-                                 b"dcn-coord|" + transcript,
-                                 hashlib.sha256).digest())
-            # reverse challenge: the worker must prove the secret too —
-            # a spoofed worker that merely echoed the \x01 flag cannot
-            mac_w = _recv_exact(s, 32)
-            want = hmac.new(self.secret.encode(),
-                            b"dcn-worker|" + transcript,
-                            hashlib.sha256).digest()
-            if not hmac.compare_digest(mac_w, want):
-                s.close()
-                raise ExecutionError(
-                    f"dcn worker {host}:{port} failed the reverse "
-                    "handshake (wrong or missing secret)")
-        elif self.secret:
-            # downgrade refusal: a coordinator configured for auth must
-            # not talk to an endpoint that waives it (spoofed worker)
-            s.close()
-            raise ExecutionError(
-                f"dcn worker {host}:{port} does not require auth but this "
-                "cluster is configured with a secret")
-        # create_connection leaves its connect timeout armed on the
-        # socket; RPC deadlines are applied per call in _call instead
-        s.settimeout(None)
-        return s
+        return dial(host, port, secret=self.secret,
+                    timeout=timeout or self.connect_timeout_s)
 
     def __len__(self):
         return len(self._socks)
@@ -1444,6 +1954,454 @@ class Cluster:
     def mark_partitioned(self, table: str) -> None:
         self._partitioned.add(table)
 
+    # -- sharded placement (ISSUE 13) -----------------------------------
+
+    def ddl(self, sql: str) -> None:
+        """Broadcast a DDL to the fleet; SHARD BY metadata additionally
+        registers a coordinator-side placement so loads, scans, joins,
+        and DML route by shard ownership from here on. An ALTER ...
+        SHARD BY must go through reshard() — registering a new map
+        without moving the rows would route scans to owners that do
+        not hold them."""
+        shard = None
+        stmt = None
+        try:
+            stmt = parse(sql)[0]
+            shard = getattr(stmt, "shard", None)
+        except Exception:  # noqa: BLE001 — let the workers' parsers
+            pass           # be the authority on malformed DDL
+        if shard is not None and isinstance(stmt, A.AlterTableStmt):
+            n = stmt.table.name
+            # refuse whenever the fleet is known to hold the table's
+            # rows (placed, row-range partitioned, OR broadcast) —
+            # registering a map without moving them would route scans
+            # to owners that do not hold the data (and a broadcast
+            # table fanned as partitioned multiplies every aggregate)
+            if self.placement(n) is not None or n in self._partitioned \
+                    or n in self._broadcast:
+                raise UnsupportedError(
+                    "ALTER ... SHARD BY over loaded data must go "
+                    "through Cluster.reshard() (the rows have to move)")
+        self.broadcast_exec(sql)
+        self._table_cols_cache.clear()
+        if shard is None:
+            return
+        from tidb_tpu.sharding.placement import ShardMap
+
+        name = stmt.table.name
+        kind, col, arg = shard
+        with self._placement_lock:
+            old = self._placements.get(name)
+            version = (old.version + 1) if old is not None else 0
+            if kind == "range":
+                smap = ShardMap("range", col, len(arg) + 1,
+                                len(self._socks), tuple(arg), version)
+            else:
+                smap = ShardMap("hash", col, int(arg), len(self._socks),
+                                (), version)
+            self._placements[name] = smap
+        self._partitioned.add(name)
+
+    def placement(self, table: str):
+        with self._placement_lock:
+            return self._placements.get(table)
+
+    def load_sharded(self, table: str, arrays=None, valids=None,
+                     strings=None, db: Optional[str] = None) -> int:
+        """Route rows to their shard owners per the registered
+        placement (register with Cluster.ddl's SHARD BY first). Every
+        owner also records its owned shard set + bytes (place_shards),
+        so `information_schema.dcn_worker_stats` shows where data
+        lives; a worker with a replica mirrors its slice into
+        `<table>__part<w>` exactly like load_partition."""
+        from tidb_tpu.sharding import placement as pl
+        from tidb_tpu.sharding import shuffle as shfl
+
+        # rows landed mid-reshard would be silently erased by the
+        # apply-phase truncate — same fence as scans and DML
+        self._check_reshard_fence([table])
+        smap = self.placement(table)
+        if smap is None:
+            raise ExecutionError(
+                f"no shard placement registered for {table!r} "
+                "(CREATE ... SHARD BY via Cluster.ddl)")
+        arrays = {k: np.asarray(v) for k, v in (arrays or {}).items()}
+        valids = {k: np.asarray(v, dtype=bool)
+                  for k, v in (valids or {}).items()}
+        strings = {k: list(v) for k, v in (strings or {}).items()}
+        if smap.column not in arrays:
+            raise ExecutionError(
+                f"load_sharded({table!r}): shard column "
+                f"{smap.column!r} missing from arrays")
+        for k, a in arrays.items():
+            if k not in valids:
+                valids[k] = np.ones(len(a), dtype=bool)
+        key = arrays[smap.column]
+        shards = pl.shard_of_array(smap, key, valids[smap.column])
+        dest = shards % np.int64(max(len(self._socks), 1))
+        parts = shfl.partition_rows(arrays, valids, strings, dest,
+                                    len(self._socks))
+        owners = smap.owners()
+        total = 0
+        total_bytes = 0
+        for w, part in enumerate(parts):
+            part_bytes = 0
+            if part is not None:
+                a, v, s = part
+                total += self._call(w, {
+                    "cmd": "load_columns", "table": table, "arrays": a,
+                    "valids": v, "strings": s, "db": db})
+                part_bytes = sum(x.nbytes for x in a.values()) \
+                    + sum(x.nbytes for x in v.values()) \
+                    + sum(len(x or "") + 1 for col in s.values()
+                          for x in col)
+                rep = self.replicas.get(w)
+                if rep is not None:
+                    self._call(rep, {
+                        "cmd": "load_columns",
+                        "table": f"{table}__part{w}", "like": table,
+                        "arrays": a, "valids": v, "strings": s, "db": db})
+            total_bytes += part_bytes
+            self._call(w, {"cmd": "place_shards", "table": table,
+                           "shards": owners.get(w, []),
+                           "bytes": part_bytes})
+        self._partitioned.add(table)
+        with self._placement_lock:
+            self._placement_bytes[table] = \
+                self._placement_bytes.get(table, 0) + total_bytes
+        return total
+
+    def _table_columns(self, table: str) -> List[str]:
+        """Public column names of a fleet table in schema order, read
+        once from the first REACHABLE worker (the coordinator's merge
+        session does not hold worker schemas, and one dead worker must
+        not take shuffle planning / INSERT routing down with it)."""
+        cached = self._table_cols_cache.get(table)
+        if cached is not None:
+            return cached
+        last: Optional[Exception] = None
+        for i in range(len(self._socks)):
+            try:
+                rows = self._call_retry(i, {
+                    "cmd": "exec",
+                    "sql": f"show columns from `{table}`"})
+                break
+            except Exception as e:  # noqa: BLE001 — try the next
+                last = e            # endpoint; raise the last failure
+        else:
+            raise ExecutionError(
+                f"no worker could describe {table!r}: {last}")
+        cols = [r[0] for r in rows]
+        self._table_cols_cache[table] = cols
+        return cols
+
+    def _check_reshard_fence(self, names) -> None:
+        """Refuse statements against tables mid-reshard (live, or
+        interrupted awaiting recover_reshard()): routing by either map
+        over a half-swapped fleet silently double-counts or drops the
+        moved rows."""
+        with self._placement_lock:
+            fenced = [n for n in names
+                      if n in self._resharding
+                      or n in self._reshard_pending]
+        if fenced:
+            raise ExecutionError(
+                f"table(s) {fenced} are being resharded; retry after "
+                "the reshard (or Cluster.recover_reshard()) completes")
+
+    # -- distributed writes: 2PC across shard owners --------------------
+
+    def execute_dml(self, sql: str) -> Dict[str, object]:
+        """A write against a sharded table, two-phase-committed across
+        the shard owners it touches: INSERT ... VALUES rows route by
+        the shard key (literal rows only); UPDATE/DELETE run on every
+        owner (each owns a disjoint slice, so the same statement is
+        exact fleet-wide), pruned to one owner when the WHERE pins the
+        shard column to a literal. Returns {"xid", "workers"}."""
+        stmts = parse(sql)
+        if len(stmts) != 1:
+            raise UnsupportedError("dcn dml handles a single statement")
+        st = stmts[0]
+        if hasattr(st, "table"):
+            self._check_reshard_fence([st.table.name])
+        if isinstance(st, A.InsertStmt):
+            per_worker = self._route_insert(st)
+        elif isinstance(st, (A.UpdateStmt, A.DeleteStmt)):
+            name = st.table.name
+            smap = self.placement(name)
+            if smap is None:
+                raise ExecutionError(
+                    f"no shard placement registered for {name!r}")
+            targets = sorted(smap.owners())
+            val, found = _shard_eq_value(getattr(st, "where", None),
+                                         name, smap.column)
+            if found:
+                w = smap.worker_of(smap.shard_of(val))
+                if w in targets:
+                    targets = [w]
+            per_worker = {w: sql for w in targets}
+        else:
+            raise UnsupportedError(
+                "dcn dml handles INSERT ... VALUES / UPDATE / DELETE")
+        return self._two_phase(per_worker)
+
+    def _route_insert(self, st) -> Dict[int, str]:
+        name = st.table.name
+        smap = self.placement(name)
+        if smap is None:
+            raise ExecutionError(
+                f"no shard placement registered for {name!r}")
+        if st.rows is None:
+            raise UnsupportedError("dcn dml: INSERT ... SELECT")
+        cols = st.columns or self._table_columns(name)
+        try:
+            ki = cols.index(smap.column)
+        except ValueError:
+            raise UnsupportedError(
+                f"dcn dml: INSERT must supply shard column "
+                f"{smap.column!r}")
+        groups: Dict[int, List[str]] = {}
+        for row in st.rows:
+            if ki >= len(row):
+                raise UnsupportedError("dcn dml: row narrower than the "
+                                       "shard column position")
+            v = _literal_int(row[ki])
+            if v is _NOT_LITERAL:
+                raise UnsupportedError(
+                    "dcn dml: shard-key values must be integer "
+                    "literals (or NULL)")
+            w = smap.worker_of(smap.shard_of(v))
+            groups.setdefault(w, []).append(
+                "(" + ", ".join(expr_to_sql(e) for e in row) + ")")
+        collist = ""
+        if st.columns:
+            collist = " (" + ", ".join(f"`{c}`" for c in st.columns) + ")"
+        return {w: f"insert into `{name}`{collist} values "
+                   + ", ".join(vals)
+                for w, vals in groups.items()}
+
+    def _two_phase(self, per_worker: Dict[int, str]) -> Dict[str, object]:
+        """PREPARE on every participant -> record the commit decision
+        (the Percolator primary-write analogue; recover_txns() replays
+        it) -> COMMIT everywhere. Failpoints 2pc.prepare / 2pc.commit
+        sit on either side of the decision: a fault before it must
+        leave every shard aborted, after it committed — never mixed."""
+        xid = f"x{os.getpid()}-{next(_TOKEN_SEQ)}"
+        parts = sorted(per_worker)
+        if not parts:
+            return {"xid": xid, "workers": []}
+        with self._txn_lock:
+            self._txn_pending[xid] = parts
+        prepared: List[int] = []
+        try:
+            inject("2pc.prepare")
+            for w in parts:
+                self._call(w, {"cmd": "txn_prepare", "xid": xid,
+                               "sql": per_worker[w]})
+                prepared.append(w)
+        except Exception:
+            aborted_all = True
+            # abort the ACKED participants AND the one whose prepare
+            # was in flight: a lost response may have prepared it
+            # server-side, and txn_abort is idempotent on the rest
+            for w in parts[:len(prepared) + 1]:
+                try:
+                    self._call(w, {"cmd": "txn_abort", "xid": xid})
+                except Exception:  # noqa: BLE001 — recover_txns owns
+                    aborted_all = False  # the leftovers
+            if aborted_all:
+                with self._txn_lock:
+                    self._txn_pending.pop(xid, None)
+            raise
+        # COMMIT POINT: after this record exists the txn IS committed —
+        # a crash below re-drives commits from recover_txns()
+        with self._txn_lock:
+            self._txn_decided[xid] = parts
+            self._txn_pending.pop(xid, None)
+        inject("2pc.commit")
+        errs = []
+        for w in parts:
+            try:
+                self._call(w, {"cmd": "txn_commit", "xid": xid})
+            except Exception as e:  # noqa: BLE001 — keep decided entry
+                errs.append((w, e))
+        if errs:
+            raise ExecutionError(
+                f"2pc commit {xid} incomplete on workers "
+                f"{[w for w, _ in errs]} ({errs[0][1]}); the decision "
+                "is recorded — recover_txns() finishes it")
+        with self._txn_lock:
+            self._txn_decided.pop(xid, None)
+        return {"xid": xid, "workers": parts}
+
+    def recover_txns(self) -> Dict[str, str]:
+        """Coordinator crash recovery: re-drive COMMIT for every
+        decided transaction (idempotent — workers ack unknown xids) and
+        ABORT every prepared-but-undecided one. Leaves every shard
+        consistent: committed-everywhere or rolled-back-everywhere."""
+        with self._txn_lock:
+            decided = dict(self._txn_decided)
+            pending = dict(self._txn_pending)
+        out: Dict[str, str] = {}
+        for xid, parts in decided.items():
+            ok = True
+            for w in parts:
+                try:
+                    self._call_retry(w, {"cmd": "txn_commit",
+                                         "xid": xid})
+                except Exception:  # noqa: BLE001 — retry next recover
+                    ok = False
+            if ok:
+                with self._txn_lock:
+                    self._txn_decided.pop(xid, None)
+                out[xid] = "committed"
+        for xid, parts in pending.items():
+            ok = True
+            for w in parts:
+                try:
+                    self._call_retry(w, {"cmd": "txn_abort", "xid": xid})
+                except Exception:  # noqa: BLE001 — retry next recover
+                    ok = False
+            if ok:
+                with self._txn_lock:
+                    self._txn_pending.pop(xid, None)
+                out[xid] = "aborted"
+        return out
+
+    # -- resharding -----------------------------------------------------
+
+    def reshard(self, sql: str) -> None:
+        """ALTER TABLE ... SHARD BY across the fleet: broadcast the
+        metadata change (every worker's schema_version bumps, demoting
+        cached plans), then redistribute the rows through the shuffle
+        machinery — each current owner scatters its slice by the NEW
+        map, each worker swaps its slice for what it received.
+        Stop-the-world for the table; replica mirrors are refused (they
+        would silently serve the OLD placement on failover)."""
+        stmt = parse(sql)[0]
+        if not (isinstance(stmt, A.AlterTableStmt)
+                and stmt.action == "reshard"):
+            raise UnsupportedError("reshard() takes ALTER ... SHARD BY")
+        if self.replicas:
+            raise UnsupportedError(
+                "reshard with replica mirrors is unsupported: the "
+                "`__part` copies would keep the old placement")
+        name = stmt.table.name
+        old = self.placement(name)
+        if old is None:
+            raise ExecutionError(
+                f"no shard placement registered for {name!r}")
+        from tidb_tpu.sharding.placement import ShardMap
+
+        kind, col, arg = stmt.shard
+        W = len(self._socks)
+        if kind == "range":
+            new = ShardMap("range", col, len(arg) + 1, W, tuple(arg),
+                           old.version + 1)
+        else:
+            new = ShardMap("hash", col, int(arg), W, (), old.version + 1)
+        with self._placement_lock:
+            if name in self._resharding or name in self._reshard_pending:
+                raise ExecutionError(
+                    f"table {name!r} is already mid-reshard")
+            self._resharding.add(name)
+        sid = f"reshard{os.getpid()}-{next(_TOKEN_SEQ)}"
+        peers = [[h, p] for h, p in self._endpoints]
+        try:
+            self.broadcast_exec(sql)
+            # phase A: every current owner scatters by the NEW map. A
+            # failure HERE is recoverable by dropping the staged state:
+            # no worker has truncated anything yet
+            try:
+                for w in sorted(old.owners()):
+                    self._call(w, {
+                        "cmd": "shuffle_scatter", "shuffle_id": sid,
+                        "table": name, "side": name, "mode": "hash",
+                        "key": new.column, "map": new.to_wire(),
+                        "n_workers": W, "self_index": w, "peers": peers})
+            except Exception:
+                self._shuffle_close_all(sid, range(W))
+                raise
+            # phase B: every worker swaps its slice for the staged
+            # rows. From the first apply on, the staged batches are the
+            # ONLY copy of moved rows — a failure must KEEP them (and
+            # the fence) for recover_reshard(), never drop them
+            state = {"sid": sid, "map": new,
+                     "remaining": list(range(W))}
+            with self._placement_lock:
+                self._reshard_pending[name] = state
+            self._finish_reshard(name, state)
+        finally:
+            with self._placement_lock:
+                self._resharding.discard(name)
+
+    def _finish_reshard(self, name: str, state: Dict) -> None:
+        """Drive (or re-drive) reshard phase B: apply on every
+        remaining worker (idempotent server-side — a lost response
+        re-drives safely), then install the new placement and release
+        the fence. Raises typed on remaining failures, keeping the
+        pending record so recover_reshard() can finish the job."""
+        sid, new = state["sid"], state["map"]
+        W = len(self._socks)
+        errs = []
+        for w in list(state["remaining"]):
+            try:
+                inject("reshard.apply")
+                self._call(w, {"cmd": "reshard_apply", "shuffle_id": sid,
+                               "table": name, "side": name})
+                state["remaining"].remove(w)
+            except Exception as e:  # noqa: BLE001 — collected; the
+                errs.append((w, e))  # pending record drives recovery
+        if errs:
+            raise ExecutionError(
+                f"reshard of {name!r} interrupted on workers "
+                f"{[w for w, _ in errs]} ({errs[0][1]}); staged rows "
+                "are retained — Cluster.recover_reshard() finishes it")
+        new_owners = new.owners()
+        for w in range(W):
+            try:
+                self._call(w, {"cmd": "place_shards", "table": name,
+                               "shards": new_owners.get(w, []),
+                               "bytes": self._placement_bytes.get(
+                                   name, 0) // max(W, 1)})
+            except Exception:  # noqa: BLE001 — stats-only surface;
+                pass           # placement install below is what counts
+        with self._placement_lock:
+            self._placements[name] = new
+            self._reshard_pending.pop(name, None)
+
+    def recover_reshard(self) -> Dict[str, str]:
+        """Finish interrupted reshards (coordinator 'restart' after a
+        phase-B fault): re-drive the idempotent applies on the workers
+        that still owe one, then install the new map. Tables that
+        recover report 'resharded'; still-failing ones stay fenced."""
+        with self._placement_lock:
+            pending = dict(self._reshard_pending)
+        out: Dict[str, str] = {}
+        for name, state in pending.items():
+            try:
+                self._finish_reshard(name, state)
+                out[name] = "resharded"
+            except Exception:  # noqa: BLE001 — stays fenced; the next
+                continue       # recover_reshard() retries
+        return out
+
+    def _shuffle_close_all(self, sid: str, targets) -> None:
+        """Best-effort release of a shuffle's staged state fleet-wide
+        (the statement's spent deadline must not strangle cleanup —
+        same rule as _close_cursor)."""
+        old_dl = getattr(self._tl, "deadline", None)
+        self._tl.deadline = None
+        try:
+            for i in targets:
+                try:
+                    self._call(i, {"cmd": "shuffle_close",
+                                   "shuffle_id": sid})
+                except Exception:  # noqa: BLE001 — the worker may be
+                    pass           # gone; its TTL reaper backstops
+        finally:
+            self._tl.deadline = old_dl
+
     # coordinator-side streaming: one page per round trip; the staging
     # table (columnar, engine-managed) is the only full-volume buffer
     PAGE_ROWS = 8192
@@ -1613,6 +2571,209 @@ class Cluster:
             if tr is not None:
                 tr.end(sp)
 
+    # -- distributed planning: owner pruning + exchange choice ----------
+
+    def _plan_query(self, sql: str) -> Dict:
+        """Owner-pruned targets and (when two sharded tables join) the
+        exchange plan. Placement is snapshotted HERE, at statement
+        start: a reshard racing this statement bumps the map version
+        but never changes routing mid-flight."""
+        st = None
+        tables: List = []
+        try:
+            stmts = parse(sql)
+            if len(stmts) == 1 and isinstance(stmts[0], A.SelectStmt):
+                st = stmts[0]
+                tables = _from_tables(st.from_)
+        except Exception:  # noqa: BLE001 — malformed/unsupported
+            st, tables = None, []  # shapes: let partial_rewrite raise
+        self._check_reshard_fence([t.name for t in tables])
+        placed = {}
+        for t in tables:
+            m = self.placement(t.name)
+            if m is not None and t.name not in placed:
+                placed[t.name] = m
+        if st is not None and len(placed) >= 2:
+            return self._plan_shuffle(sql, st, tables, placed)
+        partial_sql, final_sql, _names = partial_rewrite(
+            sql, partitioned=self._partitioned, broadcast=self._broadcast,
+            parsed=[st] if st is not None else None)
+        targets = None
+        if len(placed) == 1:
+            name, smap = next(iter(placed.items()))
+            targets = [w for w in sorted(smap.owners())
+                       if w < len(self._socks)]
+            val, found = _shard_eq_value(st.where, name, smap.column)
+            if found:
+                w = smap.worker_of(smap.shard_of(val))
+                if w in targets:
+                    targets = [w]
+            from tidb_tpu.utils.metrics import SHARD_SCAN_TOTAL
+
+            pruned = len(targets) < len(self._socks)
+            SHARD_SCAN_TOTAL.inc(pruned="yes" if pruned else "no")
+        return {"partial_sql": partial_sql, "final_sql": final_sql,
+                "targets": targets, "shuffle": None}
+
+    def _resolve_ename(self, e: A.EName, tables, cols_by_table):
+        """Base table an EName belongs to (qualifier match first, else
+        the unique table carrying that column name); None = ambiguous
+        or unknown."""
+        if e.qualifier:
+            for t in tables:
+                if e.qualifier in (t.name, t.alias):
+                    return t.name
+            return None
+        hits = [t.name for t in {t.name: t for t in tables}.values()
+                if e.name in cols_by_table.get(t.name, ())]
+        return hits[0] if len(hits) == 1 else None
+
+    def _used_columns(self, st, tables, cols_by_table) -> Dict[str, List[str]]:
+        """Per-table column set the query references — what an exchange
+        must ship. SELECT * ships everything."""
+        if any(isinstance(e, A.EStar) for e in _walk_exprs(st.items)):
+            return {t.name: list(cols_by_table[t.name]) for t in tables}
+        used: Dict[str, set] = {t.name: set() for t in tables}
+        for e in _walk_exprs((st.items, st.where, st.group_by,
+                              st.order_by, st.from_)):
+            if isinstance(e, A.EName):
+                owner = self._resolve_ename(e, tables, cols_by_table)
+                if owner is not None \
+                        and e.name in cols_by_table.get(owner, ()):
+                    used[owner].add(e.name)
+        return {n: [c for c in cols_by_table[n] if c in s]
+                for n, s in used.items()}
+
+    def _plan_shuffle(self, sql: str, st, tables, placed) -> Dict:
+        """Exchange plan for a join of two sharded tables. Per side:
+        `local` (hash-placed on its join key with shards % W == 0 —
+        already co-located with the shuffle's destinations), `broadcast`
+        (replicating the small side costs less than hashing both:
+        small*(W-1) < big, under the broadcast byte cap), else
+        `shuffle`. The broadcast-vs-shuffle choice is exactly the
+        shard-map-cardinality rule ROADMAP item 2 names."""
+        if len(placed) != 2:
+            raise UnsupportedError(
+                "dcn shuffle join supports exactly two sharded tables "
+                f"(got {sorted(placed)})")
+        W = len(self._socks)
+        cols_by_table = {t.name: self._table_columns(t.name)
+                        for t in {t.name: t for t in tables}.values()}
+        keys: Dict[str, str] = {}
+        for le, re_ in _equi_name_pairs(st):
+            ta = self._resolve_ename(le, tables, cols_by_table)
+            tb = self._resolve_ename(re_, tables, cols_by_table)
+            if ta in placed and tb in placed and ta != tb:
+                keys = {ta: le.name, tb: re_.name}
+                break
+        if not keys:
+            raise UnsupportedError(
+                "dcn shuffle join needs an equality condition between "
+                "the two sharded tables")
+        used = self._used_columns(st, tables, cols_by_table)
+        with self._placement_lock:
+            bytes_ = {n: self._placement_bytes.get(n, 1 << 62)
+                      for n in placed}
+        names = sorted(placed, key=lambda n: bytes_[n])
+        small, big = names[0], names[1]
+        modes: Dict[str, str] = {}
+        for n in placed:
+            if placed[n].colocated_on(keys[n]):
+                modes[n] = "local"
+        if len(modes) < 2:
+            if not modes and bytes_[small] <= self.BROADCAST_LIMIT_BYTES \
+                    and bytes_[small] * max(W - 1, 0) < bytes_[big]:
+                modes[small] = "broadcast"
+                modes[big] = "local"
+            else:
+                for n in placed:
+                    modes.setdefault(n, "shuffle")
+        sid = f"sh{os.getpid()}-{next(_TOKEN_SEQ)}"
+        # gather runs on every worker when a side is hash-shuffled
+        # (each worker owns a hash range of the key space); with only
+        # local+broadcast sides, the placed local side's owners suffice
+        # — and the broadcast replicates to exactly that gather set
+        if any(m == "shuffle" for m in modes.values()):
+            targets = list(range(W))
+        else:
+            loc = next(n for n in placed if modes[n] == "local")
+            targets = [w for w in sorted(placed[loc].owners()) if w < W]
+        renames: Dict[str, str] = {}
+        sides: List[Dict] = []
+        scatter: List[Tuple[int, Dict]] = []
+        peers = [[h, p] for h, p in self._endpoints]
+        for n in placed:
+            if modes[n] == "local":
+                continue
+            cols = sorted(set(used.get(n) or []) | {keys[n]})
+            temp = f"__shuffle_{sid.replace('-', '_')}_{n}"
+            renames[n] = temp
+            sides.append({"table": n, "temp": temp, "side": n,
+                          "columns": cols})
+            wire_map = {"kind": "hash", "column": keys[n], "shards": W,
+                        "n_workers": W, "bounds": [], "version": 0}
+            for w in sorted(placed[n].owners()):
+                if w >= W:
+                    continue
+                msg = {"cmd": "shuffle_scatter", "shuffle_id": sid,
+                       "table": n, "side": n, "columns": cols,
+                       "n_workers": W, "self_index": w, "peers": peers}
+                if modes[n] == "broadcast":
+                    msg.update(mode="broadcast", dests=targets)
+                else:
+                    msg.update(mode="hash", key=keys[n], map=wire_map)
+                scatter.append((w, msg))
+        partial_sql, final_sql, _names = partial_rewrite(
+            sql, partitioned=self._partitioned, broadcast=self._broadcast,
+            renames=renames, co_partitioned=frozenset(placed),
+            parsed=[st])
+        from tidb_tpu.utils.metrics import SHARD_SCAN_TOTAL
+
+        SHARD_SCAN_TOTAL.inc(
+            pruned="yes" if len(targets) < W else "no")
+        return {"partial_sql": partial_sql, "final_sql": final_sql,
+                "targets": targets,
+                "shuffle": {"id": sid, "scatter": scatter,
+                            "sides": sides}}
+
+    def _run_scatter(self, shuffle: Dict, cancel_reason) -> None:
+        """Phase A of a shuffle query: every owner of every exchanged
+        side partitions + ships its rows, concurrently. The phase is a
+        BARRIER — gathers only dispatch after every scatter acked, so
+        a worker's inbox provably holds its complete slice."""
+        work = shuffle["scatter"]
+        if not work:
+            return
+        with tracing.span(f"dcn.scatter[{len(work)}]"):
+            errs: List[Optional[Exception]] = [None] * len(work)
+            deadline = getattr(self._tl, "deadline", None)
+            rpc_timeout = getattr(self._tl, "rpc_timeout", None)
+
+            def run(j, w, msg):
+                self._tl.deadline = deadline
+                self._tl.rpc_timeout = rpc_timeout
+                try:
+                    if deadline is not None:
+                        msg = dict(msg, timeout_s=max(
+                            deadline - time.monotonic(), 1e-3))
+                    self._call(w, msg)
+                except Exception as e:  # noqa: BLE001
+                    errs[j] = e
+
+            threads = [threading.Thread(target=run, args=(j, w, m),
+                                        daemon=True)
+                       for j, (w, m) in enumerate(work)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            failed = [e for e in errs if e is not None]
+            if failed:
+                raise failed[0]
+            r = cancel_reason()
+            if r is not None:
+                raise r
+
     def query(self, sql: str, schema_sql: Optional[str] = None,
               session=None, timeout_s: Optional[float] = None,
               cancel=None) -> List[tuple]:
@@ -1644,9 +2805,17 @@ class Cluster:
         unreachable the query fails fast, unless partial results were
         opted into (constructor flag or tidb_tpu_dcn_partial_results) —
         then reachable partitions are served and a warning is recorded
-        in `last_warnings` (and the session's warning area)."""
-        partial_sql, final_sql, _names = partial_rewrite(
-            sql, partitioned=self._partitioned, broadcast=self._broadcast)
+        in `last_warnings` (and the session's warning area).
+
+        Sharded placement (ISSUE 13): a scan of a SHARD BY table
+        dispatches ONLY to the workers owning its shards (one worker
+        when the WHERE pins the shard key to a literal — non-owners do
+        no work, observable in their `stats` counters); a join of two
+        sharded tables runs as a cross-process SHUFFLE (or broadcast,
+        when the smaller side is cheaper to replicate) with the partial
+        agg computed over each worker's co-partitioned slice."""
+        plan = self._plan_query(sql)
+        partial_sql, final_sql = plan["partial_sql"], plan["final_sql"]
 
         rpc_timeout = self.rpc_timeout_s
         budget_s = timeout_s
@@ -1707,14 +2876,28 @@ class Cluster:
         old_to = getattr(self._tl, "rpc_timeout", None)
         self._tl.deadline = deadline
         self._tl.rpc_timeout = rpc_timeout
+        shuffle = plan.get("shuffle")
         try:
+            if shuffle is not None:
+                self._run_scatter(shuffle, cancel_reason)
             return self._query_inner(
                 sql, partial_sql, final_sql, schema_sql, session,
-                deadline, rpc_timeout, token, cancel_reason, partial_ok)
+                deadline, rpc_timeout, token, cancel_reason, partial_ok,
+                targets=plan.get("targets"),
+                gather=shuffle,
+                failover_ok=shuffle is None)
         except BaseException as e:
             err = e
             raise
         finally:
+            if shuffle is not None:
+                # release staged exchange state fleet-wide (EVERY
+                # worker — a broadcast may have staged onto non-gather
+                # workers) — on success the gathers already closed
+                # their own; this is the error backstop (chaos grid
+                # asserts zero retained)
+                self._shuffle_close_all(shuffle["id"],
+                                        range(len(self._socks)))
             self._tl.deadline = old_dl
             self._tl.rpc_timeout = old_to
             self._finish_query_trace(tr, root_span, owns_trace, err,
@@ -1740,11 +2923,18 @@ class Cluster:
 
     def _query_inner(self, sql, partial_sql, final_sql, schema_sql,
                      session, deadline, rpc_timeout, token,
-                     cancel_reason, partial_ok) -> List[tuple]:
-        # kick every worker's partial concurrently; each returns only
+                     cancel_reason, partial_ok, targets=None,
+                     gather=None, failover_ok=True) -> List[tuple]:
+        # kick every TARGET worker's partial concurrently (`targets` is
+        # the shard-owner set for placed tables — non-owners get NO rpc
+        # and do NO work; None = the whole fleet); each returns only
         # its first page (the rest waits behind the worker's cursor).
         # The message carries the statement's REMAINING budget and the
-        # cancel token so the worker enforces both server-side.
+        # cancel token so the worker enforces both server-side. With
+        # `gather` set the dispatch is a shuffle_gather (same response
+        # shape, cursors, and tokens as partial_paged).
+        ws = list(targets) if targets is not None \
+            else list(range(len(self._socks)))
         firsts: List = [None] * len(self._socks)
         errs: List = [None] * len(self._socks)
         # coordinator dispatch spans: one per worker, recorded directly
@@ -1762,6 +2952,10 @@ class Cluster:
                 tracing.push(tr, sp)
             msg = {"cmd": "partial_paged", "sql": partial_sql,
                    "page_rows": self.PAGE_ROWS, "token": token}
+            if gather is not None:
+                msg["cmd"] = "shuffle_gather"
+                msg["shuffle_id"] = gather["id"]
+                msg["sides"] = gather["sides"]
             if deadline is not None:
                 msg["deadline_s"] = max(deadline - time.monotonic(), 1e-3)
             try:
@@ -1776,7 +2970,7 @@ class Cluster:
                     tr.end(sp)
 
         threads = [threading.Thread(target=start, args=(i,), daemon=True)
-                   for i in range(len(self._socks))]
+                   for i in ws]
         for t in threads:
             t.start()
         # interruptible join: a KILL (or deadline expiry) while workers
@@ -1855,7 +3049,7 @@ class Cluster:
         # after it arrived completely, so mid-drain failover can re-run
         # it on the replica without duplicating staged rows
         try:
-            for i in range(len(self._socks)):
+            for i in ws:
                 r = cancel_reason()
                 if r is not None:
                     self.cancel_tokens(tokens)
@@ -1863,7 +3057,8 @@ class Cluster:
                 with tracing.span(f"dcn.drain[w{i}]") as drain_sp:
                     self._drain_one(i, firsts, errs, open_cursors, sql,
                                     cancel_reason, tokens, partial_ok,
-                                    session, ingest, drain_sp)
+                                    session, ingest, drain_sp,
+                                    failover_ok)
         finally:
             for ent in open_cursors:
                 self._close_cursor(*ent)
@@ -1875,10 +3070,12 @@ class Cluster:
 
     def _drain_one(self, i, firsts, errs, open_cursors, sql,
                    cancel_reason, tokens, partial_ok, session, ingest,
-                   drain_sp) -> None:
+                   drain_sp, failover_ok=True) -> None:
         """Drain worker i's partial into the staging table, failing over
         to its replica on a non-typed error (split out of _query_inner
-        so each drain can carry its own trace span)."""
+        so each drain can carry its own trace span). `failover_ok=False`
+        for shuffle gathers: the rows live only in that worker's inbox,
+        so a replica re-run cannot reproduce them — fail typed."""
         try:
             if errs[i] is not None:
                 raise errs[i]
@@ -1890,6 +3087,9 @@ class Cluster:
                 # the statement's budget is spent / it was killed: a
                 # replica re-run cannot help, and the error must keep
                 # its type
+                self.cancel_tokens(tokens)
+                raise
+            if not failover_ok:
                 self.cancel_tokens(tokens)
                 raise
             # the primary may be alive (coordinator-side error):
@@ -1947,7 +3147,9 @@ class Cluster:
                               idempotent=True)
 
     _STAT_KEYS = ("executed", "cancelled", "deadline_exceeded",
-                  "cancel_rpcs", "pages", "open_cursors")
+                  "cancel_rpcs", "pages", "open_cursors",
+                  "shards_owned", "shard_bytes",
+                  "shuffle_bytes_in", "shuffle_bytes_out")
 
     def worker_stats_rows(self) -> List[tuple]:
         """Row-per-worker form of worker_stats() for

@@ -317,6 +317,10 @@ class CreateTableStmt:
     # PARTITION BY: ("range", col, [(pname, upper_or_None_for_MAXVALUE)])
     # or ("hash", col, n_partitions)
     partition: Optional[tuple] = None
+    # SHARD BY: ("hash", col, n_shards) or ("range", col, [bounds...]) —
+    # cross-worker placement metadata (tidb_tpu/sharding), orthogonal to
+    # the single-process PARTITION BY pruning above
+    shard: Optional[tuple] = None
     temporary: bool = False  # CREATE TEMPORARY TABLE (session-local)
     # table options accepted but not implemented (-> SHOW WARNINGS)
     ignored: List[str] = field(default_factory=list)
@@ -350,8 +354,10 @@ class AlterTableStmt:
     table: TableName
     action: str = ""          # add_column | drop_column | rename | add_index
                               # | add_foreign_key | drop_foreign_key
-                              # | add_check | drop_check
+                              # | add_check | drop_check | reshard
     column: Optional[ColumnDef] = None
+    # reshard: new SHARD BY spec, same shape as CreateTableStmt.shard
+    shard: Optional[tuple] = None
     old_name: Optional[str] = None
     new_name: Optional[str] = None
     index: Optional[Tuple[str, List[str]]] = None

@@ -794,7 +794,39 @@ class Parser:
                 stmt.partition = ("hash", col, n)
             else:
                 raise self.error("expected RANGE or HASH after PARTITION BY")
+        # SHARD BY HASH (col) SHARDS n | SHARD BY RANGE (col) SHARDS
+        # (b1, b2, ...) — cross-worker placement (tidb_tpu/sharding):
+        # k ascending bounds make k+1 shards, shard i = [b_{i-1}, b_i)
+        if self._accept_word("shard"):
+            self.expect_kw("by")
+            stmt.shard = self._parse_shard_spec()
         return stmt
+
+    def _parse_shard_spec(self) -> tuple:
+        if self._accept_word("hash"):
+            self.expect_op("(")
+            col = self.expect_ident()
+            self.expect_op(")")
+            self._expect_word("shards")
+            n = self._int_literal("shard count")
+            if n <= 0:
+                raise self.error("SHARDS must be positive")
+            return ("hash", col, n)
+        if self._accept_word("range"):
+            self.expect_op("(")
+            col = self.expect_ident()
+            self.expect_op(")")
+            self._expect_word("shards")
+            self.expect_op("(")
+            bounds = [self._int_literal("shard bound")]
+            while self.accept_op(","):
+                bounds.append(self._int_literal("shard bound"))
+            self.expect_op(")")
+            if any(a >= b for a, b in zip(bounds, bounds[1:])):
+                raise self.error("SHARD BY RANGE bounds must be strictly "
+                                 "increasing")
+            return ("range", col, bounds)
+        raise self.error("expected RANGE or HASH after SHARD BY")
 
     def _int_literal(self, what: str) -> int:
         """A (possibly negative) integer literal token."""
@@ -1054,6 +1086,13 @@ class Parser:
         if self.accept_kw("modify"):
             self.accept_kw("column")
             return AlterTableStmt(table, "modify_column", column=self.parse_column_def())
+        if self._accept_word("shard"):
+            # ALTER TABLE t SHARD BY ... — resharding DDL: new placement
+            # metadata, schema_version bump (plan caches + placement
+            # snapshots invalidate)
+            self.expect_kw("by")
+            return AlterTableStmt(table, "reshard",
+                                  shard=self._parse_shard_spec())
         raise self.error("unsupported ALTER TABLE action")
 
     # -- misc statements -----------------------------------------------------

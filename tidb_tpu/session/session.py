@@ -1988,12 +1988,41 @@ class Session:
             t.checks = [c for c in t.checks if c.name != stmt.old_name]
             if len(t.checks) == before:
                 raise SchemaError(f"no CHECK constraint {stmt.old_name!r}")
+        elif stmt.action == "reshard":
+            # new placement metadata; version bump invalidates placement
+            # snapshots, schema_version bump (below) invalidates cached
+            # plans — an in-flight statement demotes via the existing
+            # catalog-lock revalidation instead of serving a stale map
+            old = t.schema.shard_by
+            info = self._shard_by_info(stmt.shard, t.schema.columns)
+            info.version = (old.version + 1) if old is not None else 1
+            t.schema.shard_by = info
         else:
             raise UnsupportedError(f"ALTER TABLE {stmt.action}")
         # every completed ALTER advances the schema version (ref: one
         # version per DDL job) — plan-cache invalidation hangs off it
         self.catalog.schema_version += 1
         return None
+
+    @staticmethod
+    def _shard_by_info(spec, cols):
+        """Validate a parsed SHARD BY spec against the column list and
+        build the persisted ShardByInfo (None passes through)."""
+        if spec is None:
+            return None
+        from tidb_tpu.storage.table import ShardByInfo
+
+        kind, scol, arg = spec
+        info = next((c for c in cols if c.name == scol), None)
+        if info is None:
+            raise SchemaError(f"unknown shard column {scol!r}")
+        if info.type_.kind != TypeKind.INT:
+            raise SchemaError(
+                f"shard column {scol!r} must be integer-typed")
+        if kind == "range":
+            return ShardByInfo(kind="range", column=scol,
+                               shards=len(arg) + 1, bounds=list(arg))
+        return ShardByInfo(kind="hash", column=scol, shards=int(arg))
 
     def _run_create_table(self, stmt: A.CreateTableStmt):
         if stmt.like is not None:
@@ -2037,7 +2066,8 @@ class Session:
                 part = PartitionInfo(kind="hash", column=pcol,
                                      n_parts=int(spec))
         schema = TableSchema(stmt.table.name, cols, primary_key=pk,
-                             collation=stmt.collation, partition=part)
+                             collation=stmt.collation, partition=part,
+                             shard_by=self._shard_by_info(stmt.shard, cols))
         if stmt.temporary:
             if stmt.foreign_keys:
                 raise UnsupportedError(

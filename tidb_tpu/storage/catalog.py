@@ -832,6 +832,9 @@ class Catalog:
                  ("executed", INT64), ("cancelled", INT64),
                  ("deadline_exceeded", INT64), ("cancel_rpcs", INT64),
                  ("pages", INT64), ("open_cursors", INT64),
+                 ("shards_owned", INT64), ("shard_bytes", INT64),
+                 ("shuffle_bytes_in", INT64),
+                 ("shuffle_bytes_out", INT64),
                  ("reconnects", INT64), ("replica", INT64),
                  ("error", STRING)],
                 rows,

@@ -31,7 +31,8 @@ from tidb_tpu.types import (
     decimal_to_scaled,
 )
 
-__all__ = ["ColumnInfo", "TableSchema", "Table", "TableTxnLog"]
+__all__ = ["ColumnInfo", "TableSchema", "Table", "TableTxnLog",
+           "ShardByInfo"]
 
 
 @dataclass
@@ -185,6 +186,23 @@ class PartitionInfo:
 
 
 @dataclass
+class ShardByInfo:
+    """Cross-worker placement metadata (SHARD BY ... DDL; consumed by
+    tidb_tpu/sharding). HASH: shard = mix(value) % shards, NULL -> 0.
+    RANGE: `bounds` are k ascending exclusive uppers making k+1 shards
+    (shard i holds bounds[i-1] <= value < bounds[i]; the last shard is
+    unbounded above), NULL -> 0. `version` bumps on every reshard so
+    placement snapshots and plan-cache entries keyed on it invalidate —
+    the catalog's schema_version bumps alongside."""
+
+    kind: str                 # "hash" | "range"
+    column: str
+    shards: int
+    bounds: List[int] = field(default_factory=list)  # range only
+    version: int = 0
+
+
+@dataclass
 class TableSchema:
     name: str
     columns: List[ColumnInfo]
@@ -194,6 +212,8 @@ class TableSchema:
     collation: Optional[str] = None
     # PARTITION BY metadata; None = unpartitioned
     partition: Optional[PartitionInfo] = None
+    # SHARD BY metadata (cross-worker placement); None = unsharded
+    shard_by: Optional[ShardByInfo] = None
 
     def col(self, name: str) -> ColumnInfo:
         for c in self.columns:

@@ -285,6 +285,19 @@ SPILL_TOTAL = Counter(
 SPILL_BYTES = Counter(
     "tidb_tpu_spill_bytes_total", "Bytes shed to tmp storage by spills")
 
+# -- sharded placement + cross-process shuffle (ISSUE 13) -------------------
+
+SHUFFLE_BYTES_TOTAL = Counter(
+    "tidb_tpu_shuffle_bytes_total",
+    "Cross-worker shuffle exchange payload bytes (FoR-encoded batches) "
+    "by direction: out = shipped to a peer worker, in = staged into "
+    "the local inbox from a peer")
+SHARD_SCAN_TOTAL = Counter(
+    "tidb_tpu_shard_scan_total",
+    "Distributed statements planned against SHARD BY placement, by "
+    "whether owner pruning skipped part of the fleet (pruned=yes: at "
+    "least one non-owner worker received no RPC and did no work)")
+
 # -- columnar segment store (ISSUE 8) ---------------------------------------
 
 SCAN_SEGMENTS_SCANNED_TOTAL = Counter(
