@@ -9,6 +9,11 @@ Runs the AST lint passes in tidb_tpu/analysis/ over the repo:
   resource-lifecycle   acquires (pins/charges/cursors/arms) reach their
                        release on every path
   blocking-under-lock  no registered lock held across a blocking call
+  protocol-conformance DCN wire protocol: senders/handler arms agree on
+                       cmds+fields, worker re-sends carry the envelope,
+                       committed model (wire_protocol.json) is fresh
+  cache-key-completeness every value a cached_jit/get_fragment traced
+                       body closes over is named in its cache key
   metrics-coverage     /metrics collectors rendered + documented
   failpoint-coverage   no dead/armed-but-siteless failpoints
   sysvar-coverage      tidb_* sysvars registered, read, documented

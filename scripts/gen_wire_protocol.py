@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Regenerate the committed DCN wire-protocol artifacts from the static
+protocol model (tidb_tpu/analysis/wire_protocol.py):
+
+  tidb_tpu/analysis/wire_protocol.json   machine-readable model — the
+                                         runtime wire witness
+                                         (analysis/sanitizer.py) diffs
+                                         real traffic against it
+  docs/WIRE_PROTOCOL.md                  the generated reference table
+                                         (cmd -> sender sites ->
+                                         handler -> fields)
+
+The protocol-conformance pass (and a tier-1 drift test) assert both
+files match a fresh extraction, so protocol edits that skip this script
+fail the analyzer — the model can never silently rot.
+
+Usage: python scripts/gen_wire_protocol.py [--root DIR] [--check]
+
+``--check`` writes nothing and exits 1 when either artifact is stale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_analysis(root: str):
+    sys.path.insert(0, root)
+    try:
+        import importlib.util as _ilu
+        _spec = _ilu.spec_from_file_location(
+            "_light_import",
+            os.path.join(root, "scripts", "_light_import.py"))
+        _light = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_light)
+        _light.ensure_light_tidb_tpu(root)
+        from tidb_tpu.analysis import wire_protocol
+        from tidb_tpu.analysis.core import Project
+    finally:
+        sys.path.pop(0)
+    return wire_protocol, Project
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=ROOT)
+    ap.add_argument("--check", action="store_true",
+                    help="verify the committed artifacts are fresh "
+                         "(exit 1 on drift), write nothing")
+    args = ap.parse_args(argv)
+
+    wp, Project = _import_analysis(ROOT)
+    project = Project(args.root)
+    wire = wp.to_wire_model(wp.extract_model(project))
+    json_text = json.dumps(wire, indent=2, sort_keys=True) + "\n"
+    md_text = wp.render_markdown(wire)
+
+    json_path = os.path.join(args.root, wp.MODEL_REL_PATH)
+    md_path = os.path.join(args.root, wp.DOC_REL_PATH)
+    targets = [(json_path, json_text), (md_path, md_text)]
+    if args.check:
+        stale = []
+        for path, want in targets:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    have = f.read()
+            except OSError:
+                have = None
+            if have != want:
+                stale.append(os.path.relpath(path, args.root))
+        if stale:
+            print("stale wire-protocol artifacts: " + ", ".join(stale)
+                  + " (run scripts/gen_wire_protocol.py)")
+            return 1
+        print("wire-protocol artifacts are fresh")
+        return 0
+    for path, text in targets:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {os.path.relpath(path, args.root)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

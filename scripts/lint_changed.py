@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Git-aware diff lint: feed the working diff into the analyzer's
+incremental mode (ISSUE 14 satellite).
+
+Collects changed files from ``git diff --name-status`` (plus untracked
+files from ``git status --porcelain``), keeps the ``tidb_tpu/*.py``
+subset that still exists on disk — deletions are dropped (nothing to
+lint), renames lint their NEW path — and hands the list to
+``check_invariants.py --changed``, the sub-second AST-pass subset.
+
+Usage: python scripts/lint_changed.py [--base REF] [--root DIR]
+       [extra check_invariants args...]
+
+``--base`` defaults to HEAD (the uncommitted working diff). A run with
+no changed tidb_tpu files exits 0 and says so — an empty diff is clean
+by definition, not an error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_name_status(out: str) -> list:
+    """``git diff --name-status -z`` records -> candidate repo-relative
+    paths. Deleted files contribute nothing (there is no file to lint);
+    renames/copies (R*/C* carry TWO paths) contribute the NEW path."""
+    fields = [f for f in out.split("\0") if f]
+    paths = []
+    i = 0
+    while i < len(fields):
+        status = fields[i]
+        if status.startswith(("R", "C")):
+            # old path, new path — lint the NEW one
+            if i + 2 >= len(fields):
+                break
+            paths.append(fields[i + 2])
+            i += 3
+        elif status.startswith("D"):
+            i += 2  # deleted: nothing on disk to lint
+        else:
+            if i + 1 >= len(fields):
+                break
+            paths.append(fields[i + 1])
+            i += 2
+    return paths
+
+
+def filter_lintable(paths, root: str) -> list:
+    """The analyzer's jurisdiction: tidb_tpu/*.py files that exist on
+    disk (a path deleted since the diff was taken has nothing to
+    lint)."""
+    out = []
+    seen = set()
+    for p in paths:
+        norm = p.replace("\\", "/")
+        if not norm.endswith(".py") or not norm.startswith("tidb_tpu/"):
+            continue
+        if norm in seen:
+            continue
+        seen.add(norm)
+        if os.path.exists(os.path.join(root, norm)):
+            out.append(norm)
+    return sorted(out)
+
+
+def changed_paths(root: str, base: str) -> list:
+    """Changed files vs ``base`` plus untracked files (a brand-new
+    module must lint before its first commit, not after)."""
+    diff = subprocess.run(
+        ["git", "diff", "--name-status", "-z", base],
+        capture_output=True, text=True, cwd=root, check=True)
+    paths = parse_name_status(diff.stdout)
+    status = subprocess.run(
+        ["git", "status", "--porcelain", "-z", "--untracked-files=all"],
+        capture_output=True, text=True, cwd=root, check=True)
+    for rec in status.stdout.split("\0"):
+        if rec.startswith("??"):
+            paths.append(rec[3:])
+    return paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base", default="HEAD",
+                    help="git ref to diff against (default: HEAD, the "
+                         "uncommitted working diff)")
+    ap.add_argument("--root", default=ROOT)
+    args, passthrough = ap.parse_known_args(argv)
+
+    try:
+        paths = changed_paths(args.root, args.base)
+    except (subprocess.CalledProcessError, OSError) as e:
+        print(f"lint_changed: git diff failed: {e}")
+        return 2
+    lintable = filter_lintable(paths, args.root)
+    if not lintable:
+        print("lint_changed: no changed tidb_tpu/*.py files "
+              f"vs {args.base} — nothing to lint")
+        return 0
+    print("lint_changed: " + " ".join(lintable))
+    sys.path.insert(0, os.path.join(args.root, "scripts"))
+    try:
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "check_invariants",
+            os.path.join(args.root, "scripts", "check_invariants.py"))
+        ci = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(ci)
+    finally:
+        sys.path.pop(0)
+    return ci.main(["--changed", *lintable, *passthrough])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Known-bad cache-key shapes for the cache-key-completeness pass
+(ISSUE 14): a value the traced body closes over that the key does not
+name (the PR 10 hash_probe.set_mode race class), and a sysvar read at
+trace time. The clean forms (value in the key inline, through a local
+``sig`` assignment chain, and a complete get_fragment key) must stay
+silent.
+
+Copied under tidb_tpu/executor/ by the test and scanned with
+``CacheKeyCompletenessPass()``.
+"""
+
+from tidb_tpu.utils.jitcache import cached_jit
+
+
+def make_kernel(mode):
+    def fn(x):
+        return x if mode else x
+    return fn
+
+
+_SESSION = None
+
+
+def _bad_module_level_build():
+    # BAD: trace-time sysvar read in a MODULE-LEVEL cache site —
+    # module-level free names are static code identity, but a live
+    # knob frozen at trace time is the race class regardless of scope
+    mode = _SESSION.sysvars.get("tidb_tpu_join_probe_mode")
+    return make_kernel(mode)
+
+
+_MODULE_FN = cached_jit("fixture", "static-key", _bad_module_level_build)
+
+
+class BadCacheExec:
+    def open_bad_closure(self, stages, mode):
+        # BAD: `mode` shapes the traced program but is not in the key —
+        # a key collision serves a program traced for the other mode
+        self._fn = cached_jit("fixture", repr(stages),
+                              lambda: make_kernel(mode))
+
+    def open_bad_attr(self, stages):
+        # BAD: self._mode missing from the key (exact dotted path
+        # required — repr(stages) naming self would not cover it)
+        self._fn = cached_jit("fixture", repr(stages),
+                              lambda: make_kernel(self._mode))
+
+    def open_bad_sysvar(self, session, stages):
+        # BAD: a live knob read at trace time; must be read outside and
+        # threaded through the key as an argument
+        def build():
+            mode = session.sysvars.get("tidb_tpu_join_probe_mode")
+            return make_kernel(mode)
+
+        self._fn = cached_jit("fixture", repr(stages), build)
+
+    def open_bad_fragment(self, cache, stages, mode):
+        # BAD: the fragment key omits mode
+        return cache.get_fragment(("frag", repr(stages)),
+                                  lambda: make_kernel(mode))
+
+    def open_clean_inline(self, stages, mode):
+        self._fn = cached_jit("fixture", repr((stages, mode)),
+                              lambda: make_kernel(mode))
+
+    def open_clean_chain(self, stages, mode):
+        # the sig assignment chain names stages+mode in the key, and
+        # the local fn assignment resolves back to them
+        sig = repr((stages, mode))
+        fn = make_kernel(mode)
+        self._fn = cached_jit("fixture", sig, lambda: fn)
+
+    def open_clean_fragment(self, cache, stages, mode):
+        key = ("frag", repr(stages), mode)
+        return cache.get_fragment(key, lambda: make_kernel(mode))

@@ -1,0 +1,85 @@
+"""Known-bad wire-protocol shapes for the protocol-conformance pass
+(ISSUE 14). Each bad method below must be flagged by exactly the
+intended detector; the clean forms (send_good, the forked re-dispatch,
+the envelope-carrying worker re-send) must stay silent.
+
+Copied under tidb_tpu/parallel/ by the test and scanned with
+``ProtocolConformancePass(modules=(<this file>,), model_path=None)``.
+"""
+
+
+def _recv(conn):
+    return {}
+
+
+class BadWorker:
+    """The handler class (defines _handle), plus worker-side re-sends."""
+
+    def _serve_conn(self, conn):
+        msg = _recv(conn)
+        if msg.get("trace_id"):
+            pass  # envelope read: trace context peeked at receipt
+
+    def _handle(self, msg):
+        if msg.get("deadline_s") is not None:
+            msg["_deadline_mono"] = 1.0  # server-local annotation
+        cmd = msg["cmd"]
+        if cmd == "good":
+            return msg["payload"]
+        if cmd == "needs_field":
+            # token is a HARD unconditional read; payload is optional
+            return msg["token"] + (msg.get("payload") or 0)
+        if cmd == "orphan_arm":
+            # BAD: no send site anywhere — dead arm
+            return 1
+        raise ValueError(cmd)
+
+    def redispatch_bad(self, msg, peers):
+        for p in peers:
+            # BAD: worker-side re-send without trace_id/deadline_s
+            self._peer(p, {"cmd": "good", "payload": msg["payload"]})
+
+    def redispatch_good(self, msg, peers):
+        for p in peers:
+            peer_msg = {"cmd": "good", "payload": msg["payload"]}
+            dl = msg.get("_deadline_mono")
+            if dl is not None:
+                peer_msg["deadline_s"] = dl
+            peer_msg["trace_id"] = "t"
+            self._peer(p, peer_msg)
+
+    def _peer(self, p, m):
+        return {"ok": True}
+
+
+class Coordinator:
+    def _call(self, i, msg):
+        return None
+
+    def send_good(self):
+        self._call(0, {"cmd": "good", "payload": 1})
+
+    def send_missing_required(self):
+        # BAD: the needs_field handler reads msg["token"] unconditionally
+        self._call(0, {"cmd": "needs_field"})
+
+    def send_unknown_cmd(self):
+        # BAD: no handler arm for this cmd
+        self._call(0, {"cmd": "no_such_cmd"})
+
+    def send_dead_field(self):
+        # BAD: junk is read by no handler — dead wire bytes
+        self._call(0, {"cmd": "good", "payload": 2, "junk": 3})
+
+    def send_nonliteral(self, c):
+        # BAD: the model cannot name a dynamic cmd
+        self._call(0, {"cmd": c})
+
+    def send_forked(self, gather):
+        # clean: the partial_paged -> shuffle_gather fork shape — the
+        # fork inherits payload and adds token in its own branch
+        msg = {"cmd": "good", "payload": 1}
+        if gather:
+            msg["cmd"] = "needs_field"
+            msg["token"] = 2
+        self._call(0, msg)

@@ -128,6 +128,7 @@ class TestRealTree:
         assert proc.returncode == 0
         for pid in ("jit-hygiene", "host-sync", "lock-discipline",
                     "resource-lifecycle", "blocking-under-lock",
+                    "protocol-conformance", "cache-key-completeness",
                     "metrics-coverage", "failpoint-coverage",
                     "sysvar-coverage", "error-shape"):
             assert pid in proc.stdout
@@ -622,7 +623,9 @@ class TestSuppressionCountPinned:
     asserted number so allowlist drift is visible in review. Update the
     constant DELIBERATELY when adding/removing a suppression."""
 
-    EXPECTED_SUPPRESSIONS = 26
+    # ISSUE 14 added two: the ping health arm (protocol-conformance)
+    # and GroupTableStack's caller-supplied key (cache-key-completeness)
+    EXPECTED_SUPPRESSIONS = 28
     # annotated-allowlist entries are the same drift class: a future
     # `# lifecycle:` on a real leak must move a pinned number
     EXPECTED_LIFECYCLE_ANNOTATIONS = 2
@@ -677,6 +680,7 @@ class TestJsonAndChangedModes:
         ids = {p["id"] for p in doc["passes"]}
         assert {"jit-hygiene", "host-sync", "lock-discipline",
                 "resource-lifecycle", "blocking-under-lock",
+                "protocol-conformance", "cache-key-completeness",
                 "error-shape", "suppressions"} <= ids
         for p in doc["passes"]:
             assert p["seconds"] >= 0

@@ -1,0 +1,466 @@
+"""Wire-protocol conformance analyzer + runtime wire witness (ISSUE 14):
+
+  * the static protocol model extracted from parallel/dcn.py +
+    sharding/shuffle.py is structurally sane (known cmds, handler
+    reads, envelope) and the protocol-conformance pass runs CLEAN over
+    the real tree (one reasoned suppression: the ping health arm)
+  * the committed artifacts (analysis/wire_protocol.json,
+    docs/WIRE_PROTOCOL.md) match a fresh extraction — drift check
+  * every detector is mutation-tested via tests/analysis_fixtures/
+    bad_wire_protocol.py / bad_cache_key.py: bad sender, bad handler,
+    dead field, dead arm, missing envelope, non-literal cmd,
+    incomplete cache key, trace-time sysvar read — each caught by
+    exactly the intended detector, clean forms silent
+  * the runtime wire witness (sanitizer.note_wire_msg, hooked into
+    dcn._send) diffs real traffic against the committed model: typed
+    findings for unknown cmds/fields and missing required fields, and
+    a sanitized sharding/2PC chaos subset reports ZERO wire diffs
+  * scripts/lint_changed.py feeds git diffs into the analyzer's
+    incremental mode, dropping deletions and following renames
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "analysis_fixtures")
+
+sys.path.insert(0, ROOT) if ROOT not in sys.path else None
+
+from tidb_tpu.analysis import sanitizer as san  # noqa: E402
+from tidb_tpu.analysis.cache_key import (  # noqa: E402
+    CacheKeyCompletenessPass,
+)
+from tidb_tpu.analysis.core import Driver, Project  # noqa: E402
+from tidb_tpu.analysis.wire_protocol import (  # noqa: E402
+    ProtocolConformancePass,
+    extract_model,
+    render_markdown,
+    to_wire_model,
+    MODEL_REL_PATH,
+    DOC_REL_PATH,
+)
+
+
+@pytest.fixture(scope="module")
+def real_model():
+    return extract_model(Project(ROOT))
+
+
+# ---------------------------------------------------------------------------
+# static model over the real tree
+# ---------------------------------------------------------------------------
+
+
+class TestProtocolModel:
+    def test_known_cmds_extracted(self, real_model):
+        cmds = {s.cmd for s in real_model.senders}
+        assert {"exec", "partial_paged", "shuffle_gather",
+                "shuffle_scatter", "shuffle_stage", "txn_prepare",
+                "txn_commit", "txn_abort", "reshard_apply", "fetch",
+                "cancel", "load_columns", "place_shards",
+                "shuffle_close", "close_cursor", "stats",
+                "shutdown", "ddl_stage"} <= cmds
+        assert set(real_model.handlers) >= cmds
+
+    def test_handler_reads_are_modeled(self, real_model):
+        h = real_model.handlers["shuffle_stage"]
+        assert {"batch", "shuffle_id", "side"} <= h.required
+        h = real_model.handlers["fetch"]
+        assert {"cursor", "offset"} <= h.required
+        assert "page_rows" in h.optional
+        # conditional reads stay distinguishable: txn sql only exists
+        # on the prepare branch
+        assert "sql" in real_model.handlers["txn_commit"].conditional
+
+    def test_envelope_is_modeled(self, real_model):
+        assert {"trace_id", "deadline_s"} <= real_model.envelope_sent
+        assert {"trace_id", "deadline_s"} <= real_model.envelope_read
+
+    def test_worker_resend_carries_envelope(self, real_model):
+        """The ISSUE's headline fix: the shuffle_scatter peer
+        re-dispatch propagates trace context + remaining deadline."""
+        peer_sends = [s for s in real_model.senders
+                      if s.cmd == "shuffle_stage" and s.in_handler_class]
+        assert peer_sends
+        for s in peer_sends:
+            assert {"trace_id", "deadline_s"} <= s.fields(), s
+
+    def test_real_tree_pass_is_clean_with_ping_suppressed(self):
+        driver = Driver(ROOT, [ProtocolConformancePass()])
+        reports = driver.run()
+        rep = [r for r in reports if r.pass_id == "protocol-conformance"][0]
+        assert not rep.violations, [v.render() for v in rep.violations]
+        assert len(rep.suppressed) == 1
+        assert "ping" in rep.suppressed[0][1].reason \
+            or "health" in rep.suppressed[0][1].reason
+
+    def test_committed_model_matches_fresh_extraction(self, real_model):
+        """The drift check the pass enforces, asserted directly: the
+        committed JSON and the generated markdown must both match."""
+        wire = to_wire_model(real_model)
+        with open(os.path.join(ROOT, MODEL_REL_PATH),
+                  encoding="utf-8") as f:
+            assert json.load(f) == wire, \
+                "run scripts/gen_wire_protocol.py"
+        with open(os.path.join(ROOT, DOC_REL_PATH),
+                  encoding="utf-8") as f:
+            assert f.read() == render_markdown(wire), \
+                "run scripts/gen_wire_protocol.py"
+
+    def test_gen_script_check_mode(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "scripts", "gen_wire_protocol.py"),
+             "--check"],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "fresh" in proc.stdout
+
+    def test_model_is_line_number_free(self, real_model):
+        """Committed-model stability: unrelated edits to dcn.py must
+        not churn the artifact, so it carries function names only."""
+        wire = to_wire_model(real_model)
+        text = json.dumps(wire)
+        assert '"line"' not in text and '"path"' not in text
+        assert "Cluster.broadcast_exec" in text
+
+
+# ---------------------------------------------------------------------------
+# mutation fixtures
+# ---------------------------------------------------------------------------
+
+
+def _mini_root(tmp_path, subdir, name):
+    pkg = tmp_path / "tidb_tpu" / subdir
+    pkg.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, name), pkg / name)
+    return str(tmp_path)
+
+
+class TestProtocolFixture:
+    def _violations(self, tmp_path):
+        root = _mini_root(tmp_path, "parallel", "bad_wire_protocol.py")
+        p = ProtocolConformancePass(
+            modules=("tidb_tpu/parallel/bad_wire_protocol.py",),
+            model_path=None, doc_path=None)
+        return p.run(Project(root))
+
+    def test_every_detector_fires_once(self, tmp_path):
+        vs = self._violations(tmp_path)
+        msgs = [v.message for v in vs]
+        assert len(vs) == 6, [v.render() for v in vs]
+        assert sum("no arm for it" in m for m in msgs) == 1
+        assert sum("omits field 'token'" in m for m in msgs) == 1
+        assert sum("dead wire bytes" in m and "'junk'" in m
+                   for m in msgs) == 1
+        assert sum("dead arm" in m for m in msgs) == 1
+        assert sum("does not propagate the statement envelope" in m
+                   for m in msgs) == 1
+        assert sum("non-literal cmd" in m for m in msgs) == 1
+
+    def test_clean_forms_stay_silent(self, tmp_path):
+        """send_good, the forked re-dispatch, and the envelope-carrying
+        worker re-send must not be flagged (the fork inherits payload
+        and adds token on its own branch)."""
+        vs = self._violations(tmp_path)
+        with open(os.path.join(FIXTURES, "bad_wire_protocol.py"),
+                  encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        # method name owning each line: span from its def to the next
+        owner = {}
+        current = None
+        for i, ln in enumerate(lines, 1):
+            stripped = ln.strip()
+            if stripped.startswith("def "):
+                current = stripped.split("(")[0][4:]
+            owner[i] = current
+        clean = {"send_good", "send_forked", "redispatch_good"}
+        bad = [v for v in vs if owner.get(v.line) in clean]
+        assert not bad, [v.render() for v in bad]
+
+
+class TestCacheKeyFixture:
+    def test_bad_shapes_flagged_clean_shapes_silent(self, tmp_path):
+        root = _mini_root(tmp_path, "executor", "bad_cache_key.py")
+        vs = CacheKeyCompletenessPass().run(Project(root))
+        msgs = [v.message for v in vs]
+        assert len(vs) == 6, [v.render() for v in vs]
+        assert sum("mode" in m and "does not cover" in m
+                   for m in msgs) >= 2          # closure + fragment
+        assert sum("self._mode" in m for m in msgs) == 1
+        # method-scope sysvar read + the MODULE-LEVEL site (module
+        # names are static identity, but a live knob read at trace
+        # time is flagged regardless of scope)
+        assert sum("sysvar read inside a traced cache body" in m
+                   for m in msgs) == 2
+        assert sum("session" in m and "does not cover" in m
+                   for m in msgs) == 1
+        # the clean forms at the end of the fixture stay silent
+        with open(os.path.join(FIXTURES, "bad_cache_key.py"),
+                  encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        first_clean = next(i for i, ln in enumerate(lines, 1)
+                           if "def open_clean_inline" in ln)
+        assert all(v.line < first_clean for v in vs), \
+            [v.render() for v in vs]
+
+    def test_real_tree_clean_with_one_suppression(self):
+        driver = Driver(ROOT, [CacheKeyCompletenessPass()])
+        reports = driver.run()
+        rep = [r for r in reports
+               if r.pass_id == "cache-key-completeness"][0]
+        assert not rep.violations, [v.render() for v in rep.violations]
+        assert len(rep.suppressed) == 1
+        assert "aggmerge" in rep.suppressed[0][1].reason \
+            or "nkeys" in rep.suppressed[0][1].reason
+
+    def test_probe_mode_key_site_is_proven(self):
+        """The PR 10 fix stays machine-checked: _dispatch_retry's
+        fragment key names probe_mode, and deleting it from the key
+        would be a violation (simulated on a copy)."""
+        src_path = os.path.join(ROOT, "tidb_tpu", "parallel",
+                                "executor.py")
+        with open(src_path, encoding="utf-8") as f:
+            src = f.read()
+        mutated = src.replace(
+            'key = ("frag", prog.sig, growths, shapes_sig, types_sig,\n'
+            '                   probe_mode)',
+            'key = ("frag", prog.sig, growths, shapes_sig, types_sig)')
+        assert mutated != src, "fragment key site moved — update test"
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            pkg = os.path.join(tmp, "tidb_tpu", "parallel")
+            os.makedirs(pkg)
+            with open(os.path.join(pkg, "executor.py"), "w",
+                      encoding="utf-8") as f:
+                f.write(mutated)
+            vs = CacheKeyCompletenessPass().run(Project(tmp))
+            assert any("probe_mode" in v.message for v in vs), \
+                [v.render() for v in vs]
+
+
+# ---------------------------------------------------------------------------
+# runtime wire witness
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def clean_sanitizer():
+    san.disable()
+    yield
+    san.disable()
+
+
+def _wire_findings():
+    return [f for f in san.report()["findings"]
+            if f["kind"].startswith("wire-")]
+
+
+class TestWireWitnessUnit:
+    def test_unknown_cmd_field_and_missing_required(self, clean_sanitizer):
+        san.enable()
+        san.note_wire_msg({"cmd": "made_up_cmd", "x": 1})
+        san.note_wire_msg({"cmd": "fetch", "cursor": 1, "offset": 0,
+                           "bogus": 2})
+        san.note_wire_msg({"cmd": "fetch", "cursor": 1})
+        kinds = [(f["kind"], f["subject"]) for f in _wire_findings()]
+        assert ("wire-unknown-cmd", "made_up_cmd") in kinds
+        assert ("wire-unknown-field", "fetch.bogus") in kinds
+        assert ("wire-missing-field", "fetch.offset") in kinds
+
+    def test_clean_and_non_request_frames_ignored(self, clean_sanitizer):
+        san.enable()
+        san.note_wire_msg({"cmd": "exec", "sql": "select 1",
+                           "trace_id": "t"})       # envelope allowed
+        san.note_wire_msg({"ok": True, "result": 3})  # response
+        san.note_wire_msg([1, 2, 3])                  # not a dict
+        san.note_wire_msg({"cmd": "exec", "sql": "x",
+                           "_deadline_mono": 1.0})    # server-local key
+        assert not _wire_findings(), _wire_findings()
+
+    def test_unloadable_model_is_witnessed_not_silent(
+            self, clean_sanitizer, monkeypatch):
+        """A missing/corrupt committed model must not fail OPEN
+        silently: one non-fatal finding records that the wire witness
+        is off for the process."""
+        monkeypatch.setattr(san, "_WIRE_MODEL_PATH",
+                            "/nonexistent/wire_protocol.json")
+        monkeypatch.setitem(san._WIRE, "loaded", False)
+        monkeypatch.setitem(san._WIRE, "model", None)
+        san.enable()
+        san.note_wire_msg({"cmd": "exec", "sql": "x"})
+        san.note_wire_msg({"cmd": "exec", "sql": "y"})
+        fs = [f for f in san.report()["findings"]
+              if f["kind"] == "wire-model-unavailable"]
+        assert len(fs) == 1 and not fs[0]["fatal"], fs
+        san.set_wire_model(None)  # reload the committed model next use
+
+    def test_custom_model_hook(self, clean_sanitizer):
+        san.enable()
+        san.set_wire_model({"schema": 1,
+                            "envelope": {"sent": [], "read": []},
+                            "cmds": {"only": {
+                                "handler": {"fn": "X", "required": ["a"],
+                                            "conditional": [],
+                                            "optional": []},
+                                "senders": []}}})
+        try:
+            san.note_wire_msg({"cmd": "only", "a": 1})
+            assert not _wire_findings()
+            san.note_wire_msg({"cmd": "only"})
+            assert [f["kind"] for f in _wire_findings()] == \
+                ["wire-missing-field"]
+        finally:
+            san.set_wire_model(None)
+
+
+def _mk_cluster(n_workers=2):
+    from tidb_tpu.parallel.dcn import Cluster, Worker
+
+    workers = [Worker() for _ in range(n_workers)]
+    for w in workers:
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+    cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                 rpc_timeout_s=15.0, connect_timeout_s=5.0)
+    cl.ddl("create table f (k bigint, v bigint) shard by hash(k) shards 4")
+    cl.ddl("create table d (k bigint, grp bigint) shard by hash(grp) "
+           "shards 2")
+    ks = np.arange(120, dtype=np.int64)
+    cl.load_sharded("f", arrays={"k": ks, "v": ks * 3})
+    dk = ks[::2]
+    cl.load_sharded("d", arrays={"k": dk, "grp": dk % 5})
+    return workers, cl
+
+
+JOIN_SQL = ("select d.grp, count(*) as n, sum(f.v) as sv from f "
+            "join d on f.k = d.k group by d.grp order by d.grp")
+
+
+class TestWireWitnessEndToEnd:
+    def test_sanitized_sharding_2pc_chaos_subset_is_wire_clean(
+            self, clean_sanitizer):
+        """The ISSUE's acceptance: real traffic — shuffle join, 2PC
+        write, a mid-shuffle fault, a commit-side fault plus recovery —
+        diffs clean against the static model through the live _send
+        hook. Every byte that crossed a socket was modeled."""
+        from tidb_tpu.errors import TiDBTPUError
+        from tidb_tpu.utils.failpoint import FailpointError, failpoint
+
+        san.enable()
+        workers, cl = _mk_cluster()
+        try:
+            baseline = cl.query(JOIN_SQL)
+            assert baseline
+            cl.execute_dml(
+                "insert into f (k, v) values (500, 1), (501, 2)")
+            with failpoint("shuffle.send", times=1):
+                try:
+                    cl.query(JOIN_SQL)
+                except (TiDBTPUError, ConnectionError, OSError,
+                        FailpointError):
+                    pass
+            # the faulted write targets a key outside d's join domain,
+            # so the recovered commit cannot move the baseline result
+            with failpoint("2pc.commit", times=1):
+                try:
+                    cl.execute_dml("update f set v = v + 1 "
+                                   "where k = 500")
+                except (TiDBTPUError, ConnectionError, OSError,
+                        FailpointError):
+                    pass
+            cl.recover_txns()
+            assert not cl._txn_pending and not cl._txn_decided
+            assert cl.query(JOIN_SQL) == baseline
+        finally:
+            try:
+                cl.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+        assert not _wire_findings(), _wire_findings()
+
+    def test_unmodeled_cmd_is_witnessed(self, clean_sanitizer):
+        """Mutation direction: a cmd the model does not know crosses
+        the socket -> typed wire finding AND the worker's own unknown-
+        command error (the witness sees it before the wire does)."""
+        from tidb_tpu.errors import ExecutionError
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        san.enable()
+        w = Worker()
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port)], rpc_timeout_s=10.0)
+        try:
+            with pytest.raises(ExecutionError):
+                cl._call(0, {"cmd": "definitely_not_modeled"})
+        finally:
+            try:
+                cl.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+        kinds = [(f["kind"], f["subject"]) for f in _wire_findings()]
+        assert ("wire-unknown-cmd", "definitely_not_modeled") in kinds
+
+
+# ---------------------------------------------------------------------------
+# git-aware diff lint
+# ---------------------------------------------------------------------------
+
+
+class TestLintChanged:
+    def _load(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "lint_changed",
+            os.path.join(ROOT, "scripts", "lint_changed.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_name_status_parsing_handles_delete_and_rename(self):
+        mod = self._load()
+        out = ("M\0tidb_tpu/a.py\0"
+               "R100\0tidb_tpu/old.py\0tidb_tpu/new.py\0"
+               "D\0tidb_tpu/gone.py\0"
+               "A\0tidb_tpu/added.py\0")
+        assert mod.parse_name_status(out) == \
+            ["tidb_tpu/a.py", "tidb_tpu/new.py", "tidb_tpu/added.py"]
+
+    def test_filter_keeps_existing_package_python_only(self, tmp_path):
+        mod = self._load()
+        pkg = tmp_path / "tidb_tpu"
+        pkg.mkdir()
+        (pkg / "real.py").write_text("x = 1\n")
+        paths = ["tidb_tpu/real.py", "tidb_tpu/real.py",  # deduped
+                 "tidb_tpu/vanished.py",                  # not on disk
+                 "tests/test_x.py",                       # out of scope
+                 "tidb_tpu/data.json",                    # not python
+                 "README.md"]
+        assert mod.filter_lintable(paths, str(tmp_path)) == \
+            ["tidb_tpu/real.py"]
+
+    def test_end_to_end_subprocess(self):
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "scripts", "lint_changed.py"),
+             "--base", "HEAD"],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "lint_changed:" in proc.stdout
+        assert elapsed < 30, f"lint_changed took {elapsed:.1f}s"
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

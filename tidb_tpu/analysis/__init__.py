@@ -16,6 +16,14 @@ handful of code invariants nothing used to enforce:
     blocking-under-lock, generalizing PR 7's wait discipline)
   * every registry (metrics, failpoints, sysvars) must stay covered
   * errors must stay typed, coded, and never silently swallowed
+  * the DCN dict wire protocol's senders and handler arms must agree
+    on cmds and fields, worker re-sends must propagate the statement
+    envelope, and the committed protocol model must match a fresh
+    extraction (ISSUE 14: protocol-conformance; the runtime wire
+    witness in sanitizer.py diffs real traffic against the model)
+  * every value a cached device program closes over must be named in
+    its cache key (ISSUE 14: cache-key-completeness, generalizing the
+    PR 10 hash_probe.set_mode fix)
 
 ``scripts/check_invariants.py`` drives the passes (tier-1 via
 tests/test_static_analysis.py; ``--json`` for the machine-readable

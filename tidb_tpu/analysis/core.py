@@ -398,13 +398,17 @@ class Driver:
 
 # AST-only passes (no live engine import): the set the --changed
 # incremental mode runs over a diff — the registry passes need the whole
-# tree (a changed subset can't prove sysvar/metric coverage either way)
+# tree (a changed subset can't prove sysvar/metric coverage either way),
+# and protocol-conformance is registry-shaped too: a diff that excludes
+# dcn.py would see a protocol with no handler and flag everything
 AST_PASS_IDS = ("jit-hygiene", "host-sync", "lock-discipline",
-                "resource-lifecycle", "blocking-under-lock", "error-shape")
+                "resource-lifecycle", "blocking-under-lock",
+                "cache-key-completeness", "error-shape")
 
 
 def all_passes() -> List[Pass]:
     from tidb_tpu.analysis.blocking_under_lock import BlockingUnderLockPass
+    from tidb_tpu.analysis.cache_key import CacheKeyCompletenessPass
     from tidb_tpu.analysis.error_shape import ErrorShapePass
     from tidb_tpu.analysis.host_sync import HostSyncPass
     from tidb_tpu.analysis.jit_hygiene import JitHygienePass
@@ -415,6 +419,7 @@ def all_passes() -> List[Pass]:
         SysvarCoveragePass,
     )
     from tidb_tpu.analysis.resource_lifecycle import ResourceLifecyclePass
+    from tidb_tpu.analysis.wire_protocol import ProtocolConformancePass
 
     return [
         JitHygienePass(),
@@ -422,6 +427,8 @@ def all_passes() -> List[Pass]:
         LockDisciplinePass(),
         ResourceLifecyclePass(),
         BlockingUnderLockPass(),
+        ProtocolConformancePass(),
+        CacheKeyCompletenessPass(),
         MetricsCoveragePass(),
         FailpointCoveragePass(),
         SysvarCoveragePass(),

@@ -29,6 +29,21 @@ rest at runtime, behind the ``tidb_tpu_sanitize`` sysvar (or the
     ``ops.hash_probe.set_mode``) during ANY in-flight statement are
     fatal findings: the set_mode race documented in PR 10 is the
     founding member of this class.
+  * **wire witness** (ISSUE 14) — every request crossing a DCN worker
+    socket (``parallel/dcn._send`` calls :func:`note_wire_msg` while
+    enabled) is diffed against the committed static protocol model
+    (``analysis/wire_protocol.json``): an unknown cmd, an unknown
+    field, or a missing handler-required field is a typed finding.
+    This is the closed loop that keeps the static extractor honest —
+    real traffic the model cannot account for means the extractor
+    missed a send site, and the model would otherwise silently rot.
+    Surfacing: sends on the statement's own thread (2PC, reshard)
+    raise through the statement scope like any fatal finding; sends on
+    dispatch/scatter worker threads stay in :func:`report` (the
+    own-thread rule — blaming a concurrent statement for another's
+    traffic cascades one bug into innocent failures), which is why the
+    chaos gate asserts ``report()`` wire-clean rather than relying on
+    per-statement raises alone.
 
 Import-time this module is stdlib-only (the analyzer contract: never
 pull jax into the CLI); the device_get patch imports jax lazily at
@@ -47,7 +62,8 @@ __all__ = ["enabled", "enable", "disable", "tracked_lock", "TrackedLock",
            "diff_static", "check_lock_cycle", "reset",
            "note_tracker_release", "note_tracker_detach",
            "note_pin_open", "note_pin_close", "note_global_write",
-           "count_sync"]
+           "count_sync", "note_wire_msg", "wire_model",
+           "set_wire_model"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -317,6 +333,104 @@ def count_sync() -> None:
     sc = _current_scope()
     if sc is not None:
         sc.syncs += 1
+
+
+# -- wire witness (ISSUE 14) ------------------------------------------------
+
+
+# committed static protocol model (analysis/wire_protocol.json):
+# loaded lazily once, stdlib-only. {"loaded": bool, "model": dict|None}
+_WIRE = {"loaded": False, "model": None}
+_WIRE_MODEL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "wire_protocol.json")
+
+
+def wire_model() -> Optional[dict]:
+    # the lazy load is guarded: a distributed query's parallel
+    # dispatch threads hit their first _send simultaneously, and the
+    # outage finding below must record ONCE, not once per thread
+    with _ST.lock:
+        if _WIRE["loaded"]:
+            return _WIRE["model"]
+        try:
+            import json
+
+            with open(_WIRE_MODEL_PATH, encoding="utf-8") as f:
+                _WIRE["model"] = json.load(f)
+        except (OSError, ValueError) as e:
+            # failing OPEN silently would leave the operator believing
+            # the wire witness is armed while a whole finding class is
+            # off — record the outage ONCE, non-fatal (the other
+            # witnesses still run; a lint/CLI context has no model by
+            # design and never reaches here: note_wire_msg only fires
+            # from a live dcn._send)
+            _WIRE["model"] = None
+            _add_finding(Finding(
+                "wire-model-unavailable", _WIRE_MODEL_PATH,
+                f"static protocol model failed to load ({e}) — the "
+                "wire witness is OFF for this process; restore the "
+                "committed analysis/wire_protocol.json "
+                "(scripts/gen_wire_protocol.py regenerates it)",
+                fatal=False))
+        _WIRE["loaded"] = True
+    return _WIRE["model"]
+
+
+def set_wire_model(model: Optional[dict]) -> None:
+    """Test hook: install a model (None re-loads the committed one on
+    next use)."""
+    _WIRE["model"] = model
+    _WIRE["loaded"] = model is not None
+
+
+def note_wire_msg(msg) -> None:
+    """Called by ``parallel/dcn._send`` (behind the enabled() flag) for
+    every frame leaving a socket. Requests — dicts carrying a string
+    ``cmd`` — are diffed against the static protocol model; responses
+    and handshake bytes pass through untouched. ``_``-prefixed keys are
+    server-local annotations, never wire fields."""
+    if not isinstance(msg, dict):
+        return
+    cmd = msg.get("cmd")
+    if not isinstance(cmd, str):
+        return
+    model = wire_model()
+    if model is None:
+        return
+    env = model.get("envelope", {})
+    ent = model.get("cmds", {}).get(cmd)
+    if ent is None:
+        _add_finding(Finding(
+            "wire-unknown-cmd", cmd,
+            "request crossed a worker socket with a cmd absent from "
+            "the static protocol model — a send site the extractor "
+            "cannot see (or a dynamically-minted cmd); fix the sender "
+            "or regenerate scripts/gen_wire_protocol.py"))
+        return
+    fields = {k for k in msg
+              if isinstance(k, str) and k != "cmd"
+              and not k.startswith("_")}
+    allowed = set(env.get("sent", ())) | set(env.get("read", ()))
+    h = ent.get("handler")
+    if h is not None:
+        allowed |= set(h.get("required", ())) \
+            | set(h.get("conditional", ())) | set(h.get("optional", ()))
+    for s in ent.get("senders", ()):
+        allowed |= set(s.get("required", ())) | set(s.get("optional", ()))
+    for f in sorted(fields - allowed):
+        _add_finding(Finding(
+            "wire-unknown-field", f"{cmd}.{f}",
+            "field crossed a worker socket that the static protocol "
+            "model does not know for this cmd — dead wire bytes at "
+            "best, a handler the model missed at worst; regenerate "
+            "the model or fix the sender"))
+    if h is not None:
+        for f in sorted(set(h.get("required", ())) - fields):
+            _add_finding(Finding(
+                "wire-missing-field", f"{cmd}.{f}",
+                "request omits a field the handler reads "
+                "unconditionally — this exact message raises a remote "
+                "KeyError on the worker"))
 
 
 # -- statement scope --------------------------------------------------------

@@ -304,6 +304,11 @@ class GroupTableStack:
 
     def __init__(self, nkeys: int, aggs: List[AggSpec], cache_key: str):
         self._levels: List[object] = []
+        # lint: disable=cache-key-completeness -- nkeys/aggs arrive
+        # WITH their key: every caller passes cache_key =
+        # repr((group_exprs, aggs)) — the repr of exactly the values
+        # nkeys and aggs derive from — so the key names them even
+        # though this scope cannot prove it
         self._merge = cached_jit(
             "aggmerge", cache_key, lambda: make_merge_kernel(nkeys, aggs),
             donate_argnums=(0, 1),

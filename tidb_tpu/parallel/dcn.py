@@ -77,6 +77,19 @@ def clusters_alive() -> List["Cluster"]:
     return list(_CLUSTERS)
 
 
+def _retype_wire_error(err: str, detail: str) -> ExecutionError:
+    """One rule for every hop: a remote error travels the wire as
+    `ClassName: message`, and kill/deadline must stay typed end to end
+    whether the hop is coordinator->worker (Cluster._remote_error) or
+    worker->peer (the shuffle_stage re-dispatch). A second copy of this
+    prefix match would silently drift the next typed class."""
+    if err.startswith("QueryTimeoutError:"):
+        return QueryTimeoutError(detail)
+    if err.startswith("QueryKilledError:"):
+        return QueryKilledError(detail)
+    return ExecutionError(detail)
+
+
 class DcnCodecError(ExecutionError):
     """Malformed wire frame: the connection is desynced and must die."""
 
@@ -267,6 +280,16 @@ _IO_TLS = threading.local()
 
 
 def _send(sock: socket.socket, obj) -> None:
+    # runtime wire witness (ISSUE 14): while the sanitizer is enabled,
+    # every request leaving a socket is diffed against the committed
+    # static protocol model (unknown cmd/field or missing required
+    # field = typed finding). Cost when off = one flag check — the
+    # always-wrap contract tracked locks follow (README "Sanitizer
+    # mode"); analysis.sanitizer is stdlib-only, so the import is free.
+    from tidb_tpu.analysis import sanitizer as _san
+
+    if _san.enabled():
+        _san.note_wire_msg(obj)
     payload = _dumps(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
     from tidb_tpu.utils.metrics import DCN_BYTES
@@ -724,7 +747,17 @@ class Worker:
         self._shuffle_tracker.budget = q if q > 0 else None
 
     def _shuffle_stage(self, msg: Dict) -> int:
-        """A PEER worker's batch arriving: charge, stage, account."""
+        """A PEER worker's batch arriving: charge, stage, account. The
+        propagated statement budget (deadline_s -> _deadline_mono,
+        anchored at receipt like every RPC) is honored here: staging
+        bytes for a statement that already expired would pin inbox
+        memory nobody will ever gather."""
+        dl = msg.get("_deadline_mono")
+        if dl is not None and time.monotonic() > dl:
+            raise QueryTimeoutError(
+                "Query execution was interrupted, maximum statement "
+                "execution time exceeded (shuffle stage received after "
+                "the deadline)")
         inject("shuffle.recv")
         self._shuffle_budget()
         n = self._inbox.stage(str(msg["shuffle_id"]), str(msg["side"]),
@@ -745,6 +778,16 @@ class Worker:
         from tidb_tpu.sharding import shuffle as shfl
         from tidb_tpu.utils.metrics import SHUFFLE_BYTES_TOTAL
 
+        # budget checked BEFORE any extract/partition/encode work (and
+        # before the self-destination local stage, which has no peer
+        # hop to catch it): scattering for an expired statement pins
+        # inbox bytes nobody will gather
+        dl0 = msg.get("_deadline_mono")
+        if dl0 is not None and time.monotonic() > dl0:
+            raise QueryTimeoutError(
+                "Query execution was interrupted, maximum statement "
+                "execution time exceeded (shuffle scatter received "
+                "after the deadline)")
         table = self.session.catalog.table(
             msg.get("db") or self.session.db, msg["table"])
         arrays, valids, strings, n = shfl.extract_live_columns(
@@ -796,16 +839,60 @@ class Worker:
                 continue
             inject("shuffle.send")
             host, port = peers[w]
-            resp = self._peer_call(
-                str(host), int(port),
-                {"cmd": "shuffle_stage", "shuffle_id": sid,
-                 "side": side, "batch": batch}, timeout)
+            # mandatory-envelope propagation (ISSUE 14): this hop is a
+            # fan-out re-send, and _peer_call injects nothing — the
+            # statement's remaining budget and trace context must ride
+            # the message explicitly or they die at this worker (the
+            # protocol-conformance pass enforces it; a peer staging for
+            # an expired statement would burn memory nobody drains)
+            peer_msg = {"cmd": "shuffle_stage", "shuffle_id": sid,
+                        "side": side, "batch": batch}
+            dl = msg.get("_deadline_mono")
+            if dl is not None:
+                rem = dl - time.monotonic()
+                if rem <= 0:
+                    raise QueryTimeoutError(
+                        "Query execution was interrupted, maximum "
+                        "statement execution time exceeded (before "
+                        f"shuffle stage to worker {w})")
+                peer_msg["deadline_s"] = rem
+                timeout = min(timeout, rem)
+            tr = tracing.current()
+            sp = (tr.begin(f"peer.shuffle_stage[w{w}]",
+                           tracing.current_span_id())
+                  if tr is not None else None)
+            if tr is not None:
+                peer_msg["trace_id"] = tr.trace_id
+            resp = None
+            try:
+                try:
+                    resp = self._peer_call(str(host), int(port),
+                                           peer_msg, timeout)
+                except (socket.timeout, TimeoutError) as e:
+                    # the clamped socket timeout IS the deadline when
+                    # the budget ran out mid-hop: surface the same 3024
+                    # the pre-send rem<=0 check raises (same mapping as
+                    # Cluster._call's timeout path)
+                    if dl is not None and time.monotonic() >= dl:
+                        raise QueryTimeoutError(
+                            "Query execution was interrupted, maximum "
+                            "statement execution time exceeded "
+                            f"(shuffle stage to worker {w})") from e
+                    raise
+            finally:
+                if tr is not None:
+                    if isinstance(resp, dict) and resp.get("trace"):
+                        tr.graft(resp["trace"], sp, proc=f"{host}:{port}")
+                    tr.end(sp)
             if not resp.get("ok"):
-                # the peer's typed refusal (e.g. inbox OOM backpressure)
-                # travels through this worker back to the coordinator
-                raise ExecutionError(
-                    f"shuffle stage to worker {w} failed: "
-                    f"{resp.get('error')}")
+                # the peer's typed refusal (inbox OOM backpressure, or
+                # the new deadline check) travels through this worker
+                # back to the coordinator — RE-TYPED, so a peer-side
+                # deadline expiry reaches the client as the same 3024
+                # the sender-side rem<=0 check raises
+                err = str(resp.get("error"))
+                raise _retype_wire_error(
+                    err, f"shuffle stage to worker {w} failed: {err}")
             nb = int(resp["result"])
             sent_bytes += nb
             self._bump("shuffle_bytes_out", nb)
@@ -995,6 +1082,9 @@ class Worker:
                 msg["deadline_s"])
         inject("dcn.worker.handle")
         cmd = msg["cmd"]
+        # lint: disable=protocol-conformance -- health-probe arm with no
+        # in-tree sender by design: tests and operators dial it raw to
+        # check liveness without touching any statement machinery
         if cmd == "ping":
             return "pong"
         if cmd == "cancel":
@@ -1082,10 +1172,6 @@ class Worker:
             return table.insert_columns(
                 msg.get("arrays") or {}, msg.get("valids"),
                 strings=msg.get("strings"))
-        if cmd == "partial":
-            inject("dcn.worker.partial")
-            rs = self._run_sql(msg)
-            return rs.rows
         if cmd == "partial_paged":
             return self._partial_paged(msg)
         if cmd == "fetch":
@@ -1709,20 +1795,16 @@ class Cluster:
     def _remote_error(self, i: int, err: str) -> ExecutionError:
         """Re-type a worker-reported error: kill/deadline travel the
         wire as `ClassName: message` and must stay typed end to end."""
-        msg = f"dcn worker {i}: {err}"
-        if err.startswith("QueryTimeoutError:"):
-            return QueryTimeoutError(msg)
-        if err.startswith("QueryKilledError:"):
-            return QueryKilledError(msg)
-        return ExecutionError(msg)
+        return _retype_wire_error(err, f"dcn worker {i}: {err}")
 
     def _call(self, i: int, msg: Dict):
         t0 = time.perf_counter()
         timeout = self._rpc_budget(i)
         # trace-context propagation: under an active trace every RPC
-        # gets a span, the message carries (trace_id, span_id) so the
-        # worker records server-side spans against it, and the response
-        # piggybacks those spans back for grafting under the rpc span
+        # gets a span, the message carries trace_id (only — see below)
+        # so the worker records server-side spans against it, and the
+        # response piggybacks those spans back for grafting under the
+        # rpc span
         tr = tracing.current()
         sp = None
         if tr is not None:
@@ -1730,9 +1812,12 @@ class Cluster:
                           parent_id=tracing.current_span_id())
             # copy before annotating: call sites share one msg dict
             # across workers (`[{...}] * n`), and the trace context is
-            # per-call — in-place writes would cross span ids between
-            # workers and race the codec
-            msg = dict(msg, trace_id=tr.trace_id, span_id=sp.span_id)
+            # per-call — in-place writes would cross trace ids between
+            # workers and race the codec. Only trace_id travels: the
+            # worker's spans graft back under THIS side's rpc span, so
+            # a wire span_id would be dead bytes on every message (the
+            # protocol-conformance pass enforces exactly that).
+            msg = dict(msg, trace_id=tr.trace_id)
         try:
             with self._sock_locks[i]:  # one in-flight RPC per worker
                 if self._closed:
@@ -2555,7 +2640,6 @@ class Cluster:
                 msg = {"cmd": "cancel", "token": token}
                 if tr is not None and sp is not None:
                     msg["trace_id"] = tr.trace_id
-                    msg["span_id"] = sp.span_id
                 _send(s, msg)
                 resp = _recv(s)
                 if tr is not None and sp is not None \
@@ -2748,17 +2832,40 @@ class Cluster:
             errs: List[Optional[Exception]] = [None] * len(work)
             deadline = getattr(self._tl, "deadline", None)
             rpc_timeout = getattr(self._tl, "rpc_timeout", None)
+            # scatter threads carry the statement's trace exactly like
+            # the dispatch threads in _query_inner: without the push,
+            # _call sees no trace, ships no trace_id, and the worker's
+            # peer re-dispatch has no context to propagate (ISSUE 14 —
+            # the envelope must survive EVERY fan-out hop)
+            tr = tracing.current()
+            scatter_parent = tracing.current_span_id()
 
             def run(j, w, msg):
                 self._tl.deadline = deadline
                 self._tl.rpc_timeout = rpc_timeout
+                sp = None
+                if tr is not None:
+                    sp = tr.begin(f"dcn.scatter_send[w{w}]",
+                                  scatter_parent)
+                    tracing.push(tr, sp)
                 try:
                     if deadline is not None:
-                        msg = dict(msg, timeout_s=max(
-                            deadline - time.monotonic(), 1e-3))
+                        # remaining budget rides the scatter twice:
+                        # timeout_s bounds the worker's own peer
+                        # sockets, deadline_s arms the server-side
+                        # budget the worker PROPAGATES into its
+                        # shuffle_stage re-sends (ISSUE 14 envelope)
+                        rem = max(deadline - time.monotonic(), 1e-3)
+                        msg = dict(msg, timeout_s=rem, deadline_s=rem)
                     self._call(w, msg)
                 except Exception as e:  # noqa: BLE001
                     errs[j] = e
+                    if sp is not None:
+                        sp.notes.append(f"error:{type(e).__name__}")
+                finally:
+                    if tr is not None:
+                        tracing.pop()
+                        tr.end(sp)
 
             threads = [threading.Thread(target=run, args=(j, w, m),
                                         daemon=True)
