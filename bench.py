@@ -1020,11 +1020,15 @@ def bench_join_fused(extra=None, sf=None, reps=None):
     probe dispatch + expand dispatch per chunk, build re-drained every
     execution). Arms INTERLEAVED through the SAME session (machine
     drift must not bias one arm); plan cache on so planning noise
-    cancels; eager-agg push-down off to pin the join shape under test.
-    Loud cross-checks: arms byte-identical to each other AND the sqlite
-    oracle, warm fused dispatches from the engine counter (the <= 12
-    acceptance budget), and probe-mode equivalence (searchsorted vs
-    hash table) result-hash equal on the SAME fused query."""
+    cancels. Eager-agg push-down stays at its DEFAULT (on): plan
+    feedback (ISSUE 15) must LEARN that the pushed plan's join cannot
+    device-cache its build and select the no-push fused shape by
+    measurement — the bench asserts the flip instead of pinning
+    tidb_opt_agg_push_down=0 like it used to. Loud cross-checks: arms
+    byte-identical to each other AND the sqlite oracle, warm fused
+    dispatches from the engine counter (the <= 12 acceptance budget),
+    probe-mode equivalence (searchsorted vs hash table) result-hash
+    equal on the SAME fused query, and the feedback-chosen variant."""
     from tidb_tpu.executor.pipeline import DEVICE_CACHE
     from tidb_tpu.session import Session
     from tidb_tpu.storage.catalog import Catalog
@@ -1038,10 +1042,15 @@ def bench_join_fused(extra=None, sf=None, reps=None):
     s.execute("SET tidb_slow_log_threshold = 300000")
     s.execute("SET tidb_device_engine_mode = 'force'")
     s.execute("SET tidb_enable_non_prepared_plan_cache = 1")
-    # pin the Q18 join shape: eager aggregation would re-plan a partial
-    # agg below the join and the fragment under test would disappear
-    s.execute("SET tidb_opt_agg_push_down = 0")
+    # NO tidb_opt_agg_push_down pin (ISSUE 15): with fresh stats the
+    # heuristic planner pushes a partial agg below this join (the
+    # eager-agg shrink gate fires on NDV evidence), which blocks the
+    # fused scan→probe shape; plan feedback explores the no-push
+    # alternative and keeps whichever measures faster warm — asserted
+    # below. ANALYZE is the realistic production state AND what arms
+    # the eager-agg decision this bench must learn through.
     counts = load_tpch(s.catalog, sf=sf, native=False)
+    s.execute("ANALYZE TABLE lineitem, orders")
     rows = counts["lineitem"]
     conn = mirror_to_sqlite(s.catalog, tables=["lineitem", "orders"])
     sql = ("select o_orderpriority, count(*) as n, sum(l_quantity) as q "
@@ -1056,8 +1065,18 @@ def bench_join_fused(extra=None, sf=None, reps=None):
         return got, time.perf_counter() - t0, _dsp.count() - d0
 
     DEVICE_CACHE.clear()
+    from tidb_tpu.planner.feedback import STORE as FB
+
+    FB.clear()  # a prior bench call's learning must not pre-warm this one
+    # warmup doubles as feedback convergence: run 1 executes the default
+    # (eager-push) plan and records it, runs 2-3 explore the no-push
+    # variant cold then warm, runs 4-5 re-measure the default warm —
+    # after this both variants have WARM measurements and the store
+    # picks the fused no-push shape for every measured run below
     one(True)
-    one(True)  # second fill: jits traced, build + scan caches parked
+    one(True)  # jits traced, build + scan caches parked (no-push plan)
+    one(False)
+    one(True)
     one(False)
     fused_best = classic_best = float("inf")
     fused_disp = classic_disp = 0
@@ -1071,6 +1090,20 @@ def bench_join_fused(extra=None, sf=None, reps=None):
     ok_arms, msg = rows_equal(fused_rows, classic_rows, ordered=True)
     want = conn.execute(sql).fetchall()
     ok_oracle, msg2 = rows_equal(fused_rows, want, ordered=True)
+
+    # feedback acceptance: a warm execution must select the no-push
+    # (fused) plan BECAUSE the store chose it (sysvar still default-on),
+    # not because of a pin — _fb_last_apd False = the override engaged
+    # on the statement we just ran
+    from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+    digest = sql_digest(normalize_sql(sql))
+    s.query(sql)
+    last_apd = s._fb_last_apd  # before any further statement clobbers it
+    chosen_by_feedback = bool(
+        last_apd is False
+        and FB.apd_decision(digest) is False
+        and s.query("select @@tidb_opt_agg_push_down")[0][0])
 
     # probe-mode equivalence on the SAME fused fragment: the hash-table
     # path (the TPU-shaped kernel, runnable via XLA window scans on
@@ -1092,6 +1125,7 @@ def bench_join_fused(extra=None, sf=None, reps=None):
         "rows_per_sec_fused": round(rows / fused_best, 1),
         "hash_equal": bool(ok_arms),
         "probe_modes_equal": bool(modes_equal),
+        "chosen_by_feedback": chosen_by_feedback,
         "check": "ok" if ok_oracle else f"MISMATCH: {msg2}"[:300],
     }
     if not ok_arms:
@@ -1101,7 +1135,8 @@ def bench_join_fused(extra=None, sf=None, reps=None):
     log(f"# join fused: fused={fused_best * 1e3:.1f}ms "
         f"({fused_disp} disp) classic={classic_best * 1e3:.1f}ms "
         f"({classic_disp} disp) speedup={out['fused_over_classic']}x "
-        f"modes_equal={modes_equal} check={out['check']}")
+        f"modes_equal={modes_equal} feedback={chosen_by_feedback} "
+        f"check={out['check']}")
     conn.close()
     if extra is not None:
         extra["join_fused"] = out

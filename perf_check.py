@@ -215,6 +215,12 @@ def main():
             if not jfu["probe_modes_equal"]:
                 jfu_bad["join_probe_mode_equivalence"] = (
                     jfu.get("mode_mismatch", "table != searchsorted"))
+            # ISSUE 15: the fused (no-push) plan must be CHOSEN by the
+            # plan-feedback store with tidb_opt_agg_push_down at its
+            # default — the bench no longer pins the sysvar
+            if not jfu["chosen_by_feedback"]:
+                jfu_bad["join_fused_feedback"] = (
+                    "fused plan not selected by plan feedback")
             if not jfu_bad and jfu_speed >= 1.3:
                 break
         print(f"join_fused_speedup       {jfu_speed}  (need >= 1.3)")

@@ -57,6 +57,9 @@ DEFAULT_MODULES = (
     # behind one slow peer socket (fixture: bad_shuffle_lock.py)
     "tidb_tpu/sharding/shuffle.py",
     "tidb_tpu/sharding/placement.py",
+    # plan feedback (ISSUE 15): the store lock is a LEAF — fold/read
+    # only, no planning, device work, or I/O may run under it
+    "tidb_tpu/planner/feedback.py",
 )
 
 # attribute names whose call blocks the thread

@@ -383,6 +383,7 @@ class Driver:
                      "reason": s.reason} for v, s in rep.suppressed],
             })
         from tidb_tpu.analysis.host_sync import annotated_sites
+        from tidb_tpu.analysis.registry import plan_feedback_surfaces
         from tidb_tpu.analysis.resource_lifecycle import lifecycle_sites
 
         return {
@@ -392,6 +393,12 @@ class Driver:
             "suppression_count": n_sup,
             "host_sync_annotation_count": len(annotated_sites(self.project)),
             "lifecycle_annotation_count": len(lifecycle_sites(self.project)),
+            # ISSUE 15: the plan-feedback layer's user-visible surfaces
+            # (I_S table, endpoint, metric, sysvar, EXPLAIN drift
+            # column, slow-log column) counted statically — drift here
+            # means a surface was silently dropped in a refactor
+            "plan_feedback_surface_count":
+                len(plan_feedback_surfaces(self.project)),
             "passes": passes,
         }
 

@@ -52,6 +52,9 @@ DEFAULT_MODULES = (
     # shuffle exchange (ISSUE 13): the inbox lock guards staged-batch
     # state shared by peer-stage RPC threads and the gather/apply phase
     "tidb_tpu/sharding/shuffle.py",
+    # plan feedback (ISSUE 15): the store's leaf lock guards per-digest
+    # observations folded by concurrent statement-end harvests
+    "tidb_tpu/planner/feedback.py",
 )
 
 # NOTE: the serving-tier wait-discipline check (ISSUE 7) moved to

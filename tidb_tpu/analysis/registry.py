@@ -24,7 +24,44 @@ from typing import Dict, List, Set, Tuple
 from tidb_tpu.analysis.core import Pass, Project, Violation
 
 __all__ = ["MetricsCoveragePass", "FailpointCoveragePass",
-           "SysvarCoveragePass", "metrics_problems", "failpoint_scan"]
+           "SysvarCoveragePass", "metrics_problems", "failpoint_scan",
+           "plan_feedback_surfaces"]
+
+
+# ---------------------------------------------------------------------------
+# plan-feedback surfaces (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+# every user-visible surface the plan-feedback layer must keep alive,
+# as (repo-relative file, required marker). check_invariants --json
+# reports the count so a refactor that silently drops one (renames the
+# I_S table, loses the endpoint, un-registers the sysvar/metric) is a
+# STATIC diff, caught before any runtime test notices.
+_PLAN_FEEDBACK_SURFACES: Tuple[Tuple[str, str], ...] = (
+    ("tidb_tpu/storage/catalog.py", 'if name == "plan_feedback"'),
+    ("tidb_tpu/server/status.py", '"/plan_feedback"'),
+    ("tidb_tpu/utils/metrics.py", '"tidb_tpu_plan_est_drift"'),
+    ("tidb_tpu/session/sysvars.py", '"tidb_tpu_plan_feedback"'),
+    ("tidb_tpu/utils/execdetails.py", '"drift"'),
+    ("tidb_tpu/storage/catalog.py", '("worst_drift", FLOAT64)'),
+)
+
+
+def plan_feedback_surfaces(project: Project) -> List[Tuple[str, str]]:
+    """The plan-feedback surfaces present in this tree: each registered
+    (file, marker) pair whose marker still appears in the file's
+    source. A full tree has all of them; the count is pinned tier-1."""
+    out: List[Tuple[str, str]] = []
+    for rel, marker in _PLAN_FEEDBACK_SURFACES:
+        path = os.path.join(project.root, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        if marker in src:
+            out.append((rel, marker))
+    return out
 
 
 # ---------------------------------------------------------------------------

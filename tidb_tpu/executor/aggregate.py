@@ -411,6 +411,10 @@ class HashAggExec(Executor):
         raise ExecutionError(f"unknown aggregate {a.func}")
 
     def _chunks_from_host(self, out_arrays: Dict[str, tuple], n: int, cap: int):
+        # plan feedback: the group count is host-known here for free —
+        # every finalize path (segment, generic host, device tables,
+        # external merge batches) funnels through this emit
+        self.stats.add_out_rows(n)
         for start in range(0, max(n, 1), cap):
             end = min(start + cap, n)
             if n == 0 and self.group_exprs:

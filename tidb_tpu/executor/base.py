@@ -66,6 +66,29 @@ class RuntimeStats:
     # were already in place when the compute loop asked — prefetch hits
     # plus device-buffer-cache hits. EXPLAIN ANALYZE's `staged` column
     staged: int = 0
+    # plan feedback (ISSUE 15): the planner's row estimate for the plan
+    # node this executor answers for (-1 = unannotated), and the actual
+    # output rows the operator learned HOST-SIDE FOR FREE (-1 = never
+    # known without instrumentation): joins fill it from their already-
+    # batched match-total fetches, aggregates from the group count at
+    # finalize — no new per-chunk device syncs. `measured` marks rows as
+    # exact (the instrument() wrapper counted every emitted chunk);
+    # feedback harvest prefers `rows` then, else `out_rows`.
+    est_rows: float = -1.0
+    out_rows: int = -1
+    measured: bool = False
+    # fused scan→probe tile telemetry (feedback consumer: tile-capacity
+    # sizing): chunks probed / chunks whose expansion overflowed the
+    # in-program tile / the worst ceil(overflow/cap) tile need seen
+    tile_chunks: int = 0
+    tile_overflows: int = 0
+    tile_max_need: int = 0
+
+    def add_out_rows(self, n: int) -> None:
+        """Fold a host-known output count into out_rows, owning the
+        -1 = unknown sentinel so call sites don't each re-implement
+        the set-vs-accumulate split."""
+        self.out_rows = n if self.out_rows < 0 else self.out_rows + n
 
 
 @dataclass

@@ -206,6 +206,17 @@ def _try_fused_scan_probe(plan: PHashJoin):
 
 
 def build_executor(plan: PhysicalPlan) -> Executor:
+    """Build the executor for `plan` and annotate it with the plan node
+    it answers for: plan feedback (ISSUE 15) and EXPLAIN ANALYZE's
+    est/drift columns pair each executor's actual row count with its
+    node's est_rows through this link. Fused/peeled executors carry the
+    TOP of the chain they absorbed — their output is that node's."""
+    e = _build_executor(plan)
+    e._feedback_plan = plan
+    return e
+
+
+def _build_executor(plan: PhysicalPlan) -> Executor:
     # pipeline fusion: Selection/Projection chains over a scan
     stages, base = peel_stages(plan)
     if isinstance(base, PPointGet):

@@ -691,6 +691,9 @@ class HashJoinExec(Executor):
             return
 
         total = int(count.sum())
+        if self.kind == "inner":  # host path is unfiltered by
+            # eligibility; the exact output count is already host-side
+            self.stats.add_out_rows(total)
         if total == 0:
             return
         cum = np.cumsum(count)
@@ -752,6 +755,11 @@ class HashJoinExec(Executor):
         from tidb_tpu.utils import dispatch as dsp
 
         dsp.record(site="fetch")
+        if self.kind == "inner" and not self._has_filter:
+            # plan feedback: for the unfiltered inner join the summed
+            # match totals ARE the output cardinality, host-known from
+            # the fetch this loop already pays — record it for free
+            self.stats.add_out_rows(int(sum(int(t) for t in totals)))
         for tok, total in zip(tokens, totals):
             try:
                 self._probe_finish(tok, int(total))

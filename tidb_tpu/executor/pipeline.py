@@ -618,6 +618,45 @@ class _StagedScanMixin:
             fill_tracker.release(nbytes)
 
 
+def _collect_feedback_pairs(root) -> list:
+    """(plan_node, actual_out_rows) pairs of every annotated exec in a
+    transient subtree whose actual is host-known — taken BEFORE the
+    subtree is dropped, so plan feedback still sees e.g. the build-side
+    join a fused probe drained inside its own open()."""
+    out = []
+    stack = [root]
+    while stack:
+        e = stack.pop()
+        if e is None:
+            continue
+        p = getattr(e, "_feedback_plan", None)
+        rows = getattr(getattr(e, "stats", None), "out_rows", -1)
+        if p is not None and rows >= 0:
+            out.append((p, int(rows)))
+        out.extend(getattr(e, "_fb_build_pairs", ()))
+        stack.extend(getattr(e, "children", ()))
+        stack.append(getattr(e, "_delegate", None))
+    return out
+
+
+def _close_delegate(outer) -> None:
+    """Close a fused exec's open()-time fallback delegate and preserve
+    the feedback truth its subtree learned: the delegate's own
+    host-known output count folds onto the OUTER exec's stats (they
+    answer for the same plan node), and its annotated children's pairs
+    park on _fb_build_pairs — plan feedback harvests after the tree is
+    closed, when only the outer exec remains."""
+    d, outer._delegate = outer._delegate, None
+    if d is None:
+        return
+    d.close()  # first: nested fused execs fold their own delegates
+    st = getattr(d, "stats", None)
+    if st is not None and st.out_rows >= 0:
+        outer.stats.add_out_rows(st.out_rows)
+    outer._fb_build_pairs = (tuple(outer._fb_build_pairs)
+                             + tuple(_collect_feedback_pairs(d)))
+
+
 class FusedScanAggExec(_StagedScanMixin, HashAggExec):
     """HashAgg whose child is a fusible scan pipeline, executed as a
     push-based device-resident fragment: staged inputs stream through
@@ -639,6 +678,8 @@ class FusedScanAggExec(_StagedScanMixin, HashAggExec):
         self.prune_bounds = prune_bounds
         self._fallback_build = fallback_build
         self._delegate = None
+        self._ran_fused = False
+        self._fb_build_pairs = ()
         self._pin = None
         self._prefetcher = None
         self._seg_cap = None
@@ -651,10 +692,12 @@ class FusedScanAggExec(_StagedScanMixin, HashAggExec):
         self._emitted = False
         self._delegate = None
         if not self._fuse_eligible(ctx):
+            self._ran_fused = False
             d = self._fallback_build()
             d.open(ctx)
             self._delegate = d
             return
+        self._ran_fused = True
         try:
             if self.strategy == "segment":
                 self._run_segment_fused()
@@ -669,9 +712,7 @@ class FusedScanAggExec(_StagedScanMixin, HashAggExec):
         return super().next()
 
     def close(self) -> None:
-        if self._delegate is not None:
-            self._delegate.close()
-            self._delegate = None
+        _close_delegate(self)
         self._release_staging()
         super().close()
 
@@ -835,6 +876,8 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
         self._build_cache_tag = build_tag
         self._fallback_build = fallback_build
         self._delegate = None
+        self._ran_fused = False
+        self._fb_build_pairs = ()
         self._pin = None
         self._prefetcher = None
         self._staged_iter = None
@@ -848,10 +891,12 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
         self._pending: List[Chunk] = []
         self._drained = False
         if not self._fuse_eligible(ctx):
+            self._ran_fused = False
             d = self._fallback_build()
             d.open(ctx)
             self._delegate = d
             return
+        self._ran_fused = True
         try:
             self._open_build(ctx)
             jobs = self._plan_staging(ctx)
@@ -872,9 +917,7 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
             self._fill_pending_fused()
 
     def close(self) -> None:
-        if self._delegate is not None:
-            self._delegate.close()
-            self._delegate = None
+        _close_delegate(self)
         self._release_staging()
         super().close()  # releases the build side's tracked bytes
 
@@ -930,6 +973,9 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
         finally:
             child.close()
             self.children = []
+            # the transient build subtree is gone after this open();
+            # park its host-known actuals for the feedback harvest
+            self._fb_build_pairs = _collect_feedback_pairs(child)
         if cacheable:
             # ownership of the resident arrays transfers to the process
             # cache; the statement keeps its charge until close() like
@@ -1045,6 +1091,18 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
         # sync-budget pass watches the loop form)
         totals = jax.device_get([t["total_dev"] for t in tokens])
         dsp.record(site="fetch")
+        # plan feedback: the fused inner PK-FK shape's summed totals are
+        # its exact output cardinality, and total vs tile capacity is
+        # the overflow telemetry that sizes join_tiles next time —
+        # all host-known from the fetch this window already pays
+        self.stats.add_out_rows(int(sum(int(t) for t in totals)))
+        for tok, total in zip(tokens, totals):
+            self.stats.tile_chunks += 1
+            if int(total) > tok["cap"]:
+                self.stats.tile_overflows += 1
+                need = -(-(int(total) - tok["cap"]) // tok["cap"])
+                self.stats.tile_max_need = max(self.stats.tile_max_need,
+                                               need)
         for tok, total in zip(tokens, totals):
             try:
                 self._emit_fused(tok, int(total))

@@ -812,6 +812,7 @@ class Worker:
         peers = msg["peers"]
         timeout = float(msg.get("timeout_s") or 30.0)
         sent_bytes = 0
+        local_bytes = 0
         # broadcast replicates to the GATHER set only (`dests`) and
         # encodes its one identical batch ONCE; a hash shuffle routes
         # over every worker — each owns a key range — with a distinct
@@ -835,7 +836,12 @@ class Worker:
                 continue
             if w == self_i:
                 self._shuffle_budget()
-                self._inbox.stage(sid, side, batch)
+                # the self-destination copy never crosses a socket but
+                # IS part of the exchange volume: ack it separately so
+                # the coordinator's plan feedback sizes the side by ALL
+                # copies, not just the remote ones (wire metrics stay
+                # honest — sent_bytes counts shipped bytes only)
+                local_bytes += self._inbox.stage(sid, side, batch)
                 continue
             inject("shuffle.send")
             host, port = peers[w]
@@ -897,7 +903,8 @@ class Worker:
             sent_bytes += nb
             self._bump("shuffle_bytes_out", nb)
             SHUFFLE_BYTES_TOTAL.inc(nb, dir="out")
-        return {"rows": int(n), "bytes": sent_bytes}
+        return {"rows": int(n), "bytes": sent_bytes,
+                "local_bytes": local_bytes}
 
     def _peer_call(self, host: str, port: int, msg: Dict,
                    timeout: float) -> Dict:
@@ -2758,6 +2765,27 @@ class Cluster:
         with self._placement_lock:
             bytes_ = {n: self._placement_bytes.get(n, 1 << 62)
                       for n in placed}
+        # plan feedback (ISSUE 15, consumer a): a previous execution of
+        # this digest RECORDED each exchanged side's actual wire bytes
+        # (scatter acks, summed per side). Observed bytes beat the raw
+        # placement sizes — a query shipping two narrow columns of a
+        # wide table can broadcast where the table's own size says
+        # shuffle. The choice only picks among correct exchange plans.
+        fb_digest = ""
+        pversions = {n: int(getattr(placed[n], "version", 0))
+                     for n in placed}
+        try:
+            from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+            from tidb_tpu.planner.feedback import STORE as _fb_store
+
+            fb_digest = sql_digest(normalize_sql(sql))
+            hint = _fb_store.shuffle_hint(fb_digest, pversions)
+            for n, nb in hint.items():
+                if n in bytes_:
+                    bytes_[n] = int(nb)
+        except Exception:  # noqa: BLE001 — feedback is advisory only
+            fb_digest = ""
         names = sorted(placed, key=lambda n: bytes_[n])
         small, big = names[0], names[1]
         modes: Dict[str, str] = {}
@@ -2818,7 +2846,8 @@ class Cluster:
         return {"partial_sql": partial_sql, "final_sql": final_sql,
                 "targets": targets,
                 "shuffle": {"id": sid, "scatter": scatter,
-                            "sides": sides}}
+                            "sides": sides, "digest": fb_digest,
+                            "pversions": pversions}}
 
     def _run_scatter(self, shuffle: Dict, cancel_reason) -> None:
         """Phase A of a shuffle query: every owner of every exchanged
@@ -2857,7 +2886,7 @@ class Cluster:
                         # shuffle_stage re-sends (ISSUE 14 envelope)
                         rem = max(deadline - time.monotonic(), 1e-3)
                         msg = dict(msg, timeout_s=rem, deadline_s=rem)
-                    self._call(w, msg)
+                    acks[j] = self._call(w, msg)
                 except Exception as e:  # noqa: BLE001
                     errs[j] = e
                     if sp is not None:
@@ -2867,6 +2896,7 @@ class Cluster:
                         tracing.pop()
                         tr.end(sp)
 
+            acks: List[Optional[Dict]] = [None] * len(work)
             threads = [threading.Thread(target=run, args=(j, w, m),
                                         daemon=True)
                        for j, (w, m) in enumerate(work)]
@@ -2880,6 +2910,30 @@ class Cluster:
             r = cancel_reason()
             if r is not None:
                 raise r
+            # plan feedback: the scatter acks carry each owner's
+            # exchange bytes — shipped wire bytes PLUS the locally
+            # staged self-copy (part of the volume even though it never
+            # crossed a socket). Summed per side they are what the NEXT
+            # planning of this digest sizes broadcast-vs-shuffle with;
+            # a broadcast ack covers len(dests) identical copies, so
+            # normalize to the one-copy payload.
+            digest = shuffle.get("digest")
+            if digest:
+                side_bytes: Dict[str, int] = {}
+                for (w, msg), ack in zip(work, acks):
+                    if not isinstance(ack, dict):
+                        continue
+                    nb = (int(ack.get("bytes") or 0)
+                          + int(ack.get("local_bytes") or 0))
+                    if msg.get("mode") == "broadcast":
+                        nb = nb // max(len(msg.get("dests") or [1]), 1)
+                    side = str(msg.get("side"))
+                    side_bytes[side] = side_bytes.get(side, 0) + nb
+                if side_bytes:
+                    from tidb_tpu.planner.feedback import STORE as _fbs
+
+                    _fbs.record_shuffle(digest, side_bytes,
+                                        shuffle.get("pversions"))
 
     def query(self, sql: str, schema_sql: Optional[str] = None,
               session=None, timeout_s: Optional[float] = None,

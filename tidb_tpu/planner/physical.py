@@ -677,8 +677,25 @@ def eq_join_rows(left: LogicalPlan, right: LogicalPlan, eq_conds,
     magnitude), else |L|*|R| / max(ndv_l, ndv_r) from whichever side has
     an NDV (sketch-maintained under churn), else skipped. With no usable
     key the estimate falls back to max(|L|,|R|). A LEFT join emits every
-    left row at least once, so its estimate floors at |L|."""
+    left row at least once, so its estimate floors at |L|.
+
+    Plan feedback (ISSUE 15): when a previous execution RECORDED this
+    join's actual output cardinality (keyed by the base-table columns
+    its equalities resolve to) and planning runs with
+    tidb_tpu_plan_feedback hints installed, the observed count
+    overrides the heuristic — runtime truth beats any selectivity
+    model (correlated filters shift key distributions no per-column
+    statistic can see)."""
     from tidb_tpu.statistics import eq_join_selectivity
+
+    from tidb_tpu.planner import feedback as _fb
+
+    hints = _fb.current_hints()
+    if hints is not None:
+        got = hints.join_rows(left, right, eq_conds)
+        if got is not None:
+            out = max(min(float(got), l * r), 1.0)
+            return max(out, l) if kind == "left" else out
 
     sel = None
     for le, re_ in eq_conds:
@@ -710,6 +727,18 @@ def _estimate(plan: LogicalPlan) -> float:
         s = table_stats(plan.table)
         n = float(s.n_rows) if s is not None else float(plan.table.live_rows)
         if plan.pushed_cond is not None:
+            # plan feedback (ISSUE 15): an observed selectivity for this
+            # (table, filter) shape — recorded where a past execution
+            # knew the actual — beats the histogram guess
+            from tidb_tpu.planner import feedback as _fb
+
+            hints = _fb.current_hints()
+            if hints is not None:
+                uid_to_name = {c.uid: c.name for c in plan.schema}
+                got = hints.scan_rows(plan.table, plan.table_name,
+                                      plan.pushed_cond, uid_to_name, n)
+                if got is not None:
+                    return max(min(got, n), 1.0)
             if s is not None:
                 uid_to_col = {c.uid: c.name for c in plan.schema}
                 n *= scan_selectivity(plan.table, plan.pushed_cond, uid_to_col)

@@ -732,6 +732,22 @@ class PlanCache:
                 self._bypass_reasons.get(reason, 0) + 1
         self._metric("bypass")
 
+    def invalidate_digest(self, digest: str) -> int:
+        """Drop every entry of one statement digest (keys lead with the
+        digest). Plan feedback (ISSUE 15) calls this when a NEW
+        significant cardinality observation lands: a cached plan would
+        otherwise keep serving the pre-feedback shape forever. O(size)
+        over a small LRU; counted as invalidations."""
+        with self.lock:
+            doomed = [k for k in self._od
+                      if isinstance(k, tuple) and k and k[0] == digest]
+            for k in doomed:
+                del self._od[k]
+            if doomed:
+                self.invalidations += len(doomed)
+                self._metric("invalidate", len(doomed))
+            return len(doomed)
+
     def clear(self) -> None:
         with self.lock:
             self._od.clear()

@@ -18,6 +18,10 @@ Endpoints:
     /trace       - JSON: summaries of the kept (tail-sampled) traces
                    (?top=N, default 50); /trace?id=<trace_id> returns
                    one trace's full cross-process span tree
+    /plan_feedback - JSON: the plan-feedback store (?top=N digests,
+                   default 50): per-(digest, plan) est-vs-actual
+                   operator cardinalities, warm latencies, eager-agg
+                   exploration state, tile-overflow telemetry
 """
 
 from __future__ import annotations
@@ -111,6 +115,19 @@ class StatusServer:
                                 "traces": tracing.STORE.list(top),
                                 "capacity": tracing.STORE.capacity,
                             }).encode()
+                        ctype = "application/json"
+                    elif self.path == "/plan_feedback" or \
+                            self.path.startswith("/plan_feedback?"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        from tidb_tpu.planner.feedback import STORE
+
+                        q = parse_qs(urlparse(self.path).query)
+                        try:
+                            top = int(q.get("top", ["50"])[0])
+                        except ValueError:
+                            top = 50
+                        body = json.dumps(STORE.stats_dict(top)).encode()
                         ctype = "application/json"
                     elif self.path == "/cluster":
                         from tidb_tpu.parallel.dcn import clusters_alive

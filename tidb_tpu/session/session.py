@@ -312,6 +312,14 @@ class Session:
         # (source, normalized, digest) computed by the plan-cache probe,
         # reused by _record_stmt so the hot path lexes the text once
         self._stmt_digest_memo = None
+        # plan feedback (ISSUE 15): the executed (phys, root, rows)
+        # parked for harvest, the worst est-vs-actual drift of the last
+        # statement (slow-log column), and the effective eager-agg
+        # setting the plan was acquired with (exploration may differ
+        # from the sysvar)
+        self._fb_capture = None
+        self._fb_worst_drift = (0.0, "")
+        self._fb_last_apd = None
         # prepare-time (sql, norm, digest, StmtInfo) for the current
         # prepared execution: the probe skips lexing + AST analysis
         self._ps_ctx = None
@@ -569,6 +577,14 @@ class Session:
         self._plan_from_cache_stmt = False
         self._stmt_plan_s = 0.0
         self._stmt_digest_memo = None
+        # plan feedback (ISSUE 15): _run_select parks (phys, root, rows)
+        # here; the success path below harvests est-vs-actual truth from
+        # it and MUST drop the reference at statement end — a parked
+        # executor tree pins device arrays
+        self._fb_capture = None
+        self._fb_worst_drift = (0.0, "")
+        self._fb_last_apd = None
+        c0 = _dsp.compile_count()
         # always-on tracing (utils/tracing.py): every statement RECORDS
         # a span tree; tail rules / head sampling decide at the end
         # whether it is kept. A statement arriving with a trace already
@@ -662,12 +678,21 @@ class Session:
             # normal paths pop via _finish_trace before this runs.
             import sys as _sys
 
+            if _sys.exc_info()[0] is not None:
+                # failed statements don't harvest: drop the parked
+                # executor tree NOW (it pins device arrays). The
+                # success path consumes it in _fb_record below.
+                self._fb_capture = None
             if owns_trace and _sys.exc_info()[0] is not None \
                     and tracing.current() is tr:
                 tracing.pop()
         dur = _time.perf_counter() - t0
         M.QUERY_TOTAL.inc(type=stype, status="ok")
         M.QUERY_DURATION.observe(dur, type=stype)
+        # plan feedback (ISSUE 15): fold this execution's est-vs-actual
+        # truth into the per-digest store BEFORE the summary/slow-log/
+        # trace surfaces run, so they all see the drift it computed
+        self._fb_record(dur, result, _dsp.compile_count() - c0)
         detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, result,
                                    seg0=seg0)
         trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur)
@@ -686,6 +711,58 @@ class Session:
                 "statement: " + "; ".join(f.render() for f in fatal[:4]))
         return result
 
+    def _fb_enabled(self) -> bool:
+        return bool(self.sysvars.get("tidb_tpu_plan_feedback"))
+
+    def _fb_record(self, dur: float, result, recompiles: int) -> None:
+        """Plan feedback capture (ISSUE 15): harvest the executed tree
+        parked by _run_select and fold the observation into the process
+        store. Runs on the SUCCESS path only (a partial execution's
+        actuals are not the statement's truth) and, like every other
+        diagnostic here, can never fail the statement. The feedback may
+        reshape future PLANS of this digest; when a new significant
+        cardinality hint landed, the digest's plan-cache entries are
+        evicted so the next planning actually consults it."""
+        cap, self._fb_capture = self._fb_capture, None
+        self._fb_worst_drift = (0.0, "")
+        if cap is None or not self._fb_enabled():
+            return
+        try:
+            from tidb_tpu.planner import feedback as _fb
+            from tidb_tpu.utils import metrics as M
+            from tidb_tpu.utils import tracing
+
+            phys, root, n_rows = cap
+            memo = self._stmt_digest_memo
+            digest = memo[2] if memo is not None else ""
+            if not digest:
+                return
+            warm = self._plan_from_cache_stmt and recompiles == 0
+            obs = _fb.harvest(phys, root, n_rows, dur, warm)
+            apd = self._fb_last_apd if self._fb_last_apd is not None \
+                else self._agg_push_down()
+            new_hint = _fb.STORE.record(
+                digest, self._last_plan_digest or "", apd, obs,
+                capacity=int(
+                    self.sysvars.get("tidb_tpu_plan_feedback_capacity")))
+            if obs.worst_drift > 1.0:
+                self._fb_worst_drift = (obs.worst_drift_ratio,
+                                        obs.worst_drift_op)
+                tracing.annotate(
+                    f"worst_drift:{obs.worst_drift_op} "
+                    f"{obs.worst_drift_ratio:.2f}x")
+            if obs.worst_drift > 0:
+                # only statements with at least one known actual
+                # observe: otherwise the 1.0 bucket would conflate
+                # "every estimate exact" with "no data"
+                M.PLAN_EST_DRIFT.observe(_fb.drift_factor(obs))
+            if new_hint:
+                pc = getattr(self.catalog, "plan_cache", None)
+                if pc is not None:
+                    pc.invalidate_digest(digest)
+        except Exception:  # noqa: BLE001 — diagnostics never fail a stmt
+            pass
+
     def _maybe_log_slow(self, sql: str, dur: float, detail, trace_id: str,
                         disposition: str = "") -> None:
         """One slow-log decision for both the success and the error path
@@ -697,12 +774,14 @@ class Session:
         if dur * 1e3 < threshold:
             return
         M.SLOW_QUERY_TOTAL.inc()
+        drift, drift_op = self._fb_worst_drift
         self.catalog.log_slow_query(
             self.db, sql, dur, digest=detail[0],
             plan_digest=self._last_plan_digest or "",
             max_mem=detail[1], dispatches=detail[2],
             segs_scanned=detail[3], segs_pruned=detail[4],
-            trace_id=trace_id, disposition=disposition)
+            trace_id=trace_id, disposition=disposition,
+            worst_drift=drift, worst_drift_op=drift_op)
 
     def _stmt_digest(self, stmt, sql: str):
         """(normalized_text, digest) for this statement, memoized per
@@ -780,6 +859,7 @@ class Session:
             seg1 = _seg_counts()
             segs_scanned = seg1[0] - seg0[0]
             segs_pruned = seg1[1] - seg0[1]
+            drift, drift_op = self._fb_worst_drift
             self.catalog.stmt_summary.record(
                 digest, norm, stype, self._last_plan_digest or "", dur,
                 max_mem=max_mem,
@@ -787,6 +867,7 @@ class Session:
                 dispatches=dispatches, fragments=fragments, error=error,
                 plan_from_cache=self._plan_from_cache_stmt,
                 plan_latency_s=self._stmt_plan_s,
+                worst_drift=drift, worst_drift_op=drift_op,
                 max_stmt_count=int(
                     self.sysvars.get("tidb_stmt_summary_max_stmt_count")))
             return digest, max_mem, dispatches, segs_scanned, segs_pruned
@@ -879,7 +960,7 @@ class Session:
         for old in self._stmt_trackers[:-64]:
             old.detach()  # evicted trackers must not pin parent bytes
         del self._stmt_trackers[:-64]  # bound pathological statements
-        return ExecContext(
+        ctx = ExecContext(
             chunk_capacity=self._plan_capacity(plan),
             group_concat_max_len=int(
                 self.sysvars.get("group_concat_max_len")),
@@ -912,6 +993,19 @@ class Session:
             stage_encoded=bool(self.sysvars.get("tidb_tpu_stage_encoded")),
             cancel_check=self.cancel_reason,
         )
+        if self._fb_enabled():
+            # plan feedback consumer (c): a digest whose fused probes
+            # overflowed their in-program tiles gets its tile batch
+            # sized to the observed worst need — the overflow remainder
+            # then expands in one batched dispatch instead of several
+            memo = self._stmt_digest_memo
+            if memo is not None and memo[2]:
+                from tidb_tpu.planner import feedback as _fb
+
+                need = _fb.STORE.tile_hint(memo[2])
+                if need > ctx.join_tiles:
+                    ctx.join_tiles = need
+        return ctx
 
     def _wire_probe_mode(self) -> str:
         """Effective tidb_tpu_join_probe_mode. Carried per-statement
@@ -973,23 +1067,33 @@ class Session:
 
         from tidb_tpu.utils import metrics as M
 
+        from tidb_tpu.planner import feedback as _fb
+
         t0 = _time.perf_counter()
-        phys = plan_statement(
-            stmt, self.catalog, db=self.db,
-            execute_subplan=execute_subplan or self._execute_subplan,
-            cascades=bool(self.sysvars.get("tidb_enable_cascades_planner")),
-            n_parts=self._n_parts(),
-            session_info={"user": self.user,
-                          "conn_id": getattr(self, "conn_id", 0),
-                          # columnar knobs for plan-time materialization
-                          # (CTE reuse segments its result iff enabled)
-                          "columnar_enable": bool(
-                              self.sysvars.get("tidb_tpu_columnar_enable")),
-                          "segment_rows": int(
-                              self.sysvars.get("tidb_tpu_segment_rows"))},
-            agg_push_down=(self._agg_push_down() if agg_push_down is None
-                           else agg_push_down),
-        )
+        # plan feedback (ISSUE 15): install the recorded-cardinality
+        # hints for this one planning call — the estimators consult
+        # them thread-locally, so EXPLAIN and TRACE show the same
+        # feedback-shaped plan an execution would get
+        with _fb.planning_hints(self._fb_enabled()):
+            phys = plan_statement(
+                stmt, self.catalog, db=self.db,
+                execute_subplan=execute_subplan or self._execute_subplan,
+                cascades=bool(
+                    self.sysvars.get("tidb_enable_cascades_planner")),
+                n_parts=self._n_parts(),
+                session_info={
+                    "user": self.user,
+                    "conn_id": getattr(self, "conn_id", 0),
+                    # columnar knobs for plan-time materialization
+                    # (CTE reuse segments its result iff enabled)
+                    "columnar_enable": bool(
+                        self.sysvars.get("tidb_tpu_columnar_enable")),
+                    "segment_rows": int(
+                        self.sysvars.get("tidb_tpu_segment_rows"))},
+                agg_push_down=(self._agg_push_down()
+                               if agg_push_down is None
+                               else agg_push_down),
+            )
         M.PLAN_SECONDS.observe(_time.perf_counter() - t0)
         return phys
 
@@ -997,11 +1101,34 @@ class Session:
         """Physical plan for a SELECT/UNION, through the digest-keyed
         plan cache when the statement is eligible (ref: planner/core
         plan_cache*). Sets @@last_plan_from_cache and accumulates the
-        acquisition wall time for the statements summary."""
+        acquisition wall time for the statements summary.
+
+        Plan feedback (ISSUE 15): when the session WOULD push eager
+        aggregation (sysvar on, no explicit override from the dist
+        re-plan), the digest's measured push-vs-no-push decision can
+        select the no-push variant instead — it caches under its own
+        key (eff_apd is part of the plan-cache key), so the flip is a
+        clean second entry, not a cache poison. A user pin of
+        tidb_opt_agg_push_down=0 is authoritative and never consulted."""
         import time as _time
 
         t0 = _time.perf_counter()
         try:
+            if (agg_push_down is None and self._fb_enabled()
+                    and self._agg_push_down()):
+                src = getattr(stmt, "_source", None)
+                if src and len(src) <= 16384:
+                    from tidb_tpu.planner import feedback as _fb
+
+                    try:
+                        digest = self._stmt_digest(stmt, src)[1]
+                        if _fb.STORE.apd_decision(digest) is False:
+                            agg_push_down = False
+                    except Exception:  # noqa: BLE001 — feedback is
+                        pass           # advisory, never load-bearing
+            self._fb_last_apd = (self._agg_push_down()
+                                 if agg_push_down is None
+                                 else bool(agg_push_down))
             return self._acquire_plan_inner(stmt, agg_push_down)
         finally:
             self._stmt_plan_s += _time.perf_counter() - t0
@@ -1391,10 +1518,15 @@ class Session:
             if isinstance(c, PProjection) and c.n_visible is not None and c.n_visible < len(phys.schema):
                 n_vis = c.n_visible
         with tracing.span("session.execute"):
-            return run_plan(root,
-                            self._exec_ctx(hints=getattr(stmt, "hints", ()),
-                                           plan=phys),
-                            n_visible=n_vis)
+            rs = run_plan(root,
+                          self._exec_ctx(hints=getattr(stmt, "hints", ()),
+                                         plan=phys),
+                          n_visible=n_vis)
+        if self._fb_enabled():
+            # park the executed tree for the statement-end feedback
+            # harvest; _execute_timed drops the reference either way
+            self._fb_capture = (phys, root, len(rs.rows))
+        return rs
 
     # ------------------------------------------------------------------
 
@@ -1677,8 +1809,8 @@ class Session:
 
             for tn in stmt.tables:
                 t = self.catalog.table(tn.schema or self.db, tn.name)
-                analyze_table(t)
-                t.modify_count = 0
+                analyze_table(t)  # also invalidates plan feedback —
+                t.modify_count = 0  # see statistics.analyze_table
             return None
         if isinstance(stmt, A.CreateIndexStmt):
             t = self.catalog.table(stmt.table.schema or self.db, stmt.table.name)

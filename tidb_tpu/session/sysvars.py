@@ -139,6 +139,19 @@ _reg(
            min_=1, max_=1 << 20),
     SysVar("tidb_broadcast_join_threshold_count", 1 << 21, BOTH, "int",
            min_=1 << 10, max_=1 << 28),
+    # -- plan feedback (ISSUE 15) --------------------------------------
+    # close the estimate->actual loop: record per-digest est-vs-actual
+    # operator cardinalities at statement end and let the next planning
+    # of the same digest consume them (join ordering, eager-agg push-
+    # down exploration, fused-probe tile sizing, dcn broadcast-vs-
+    # shuffle). Off = plans are byte-identical to the heuristic-only
+    # planner and nothing is recorded. Feedback changes PLANS only,
+    # never results.
+    SysVar("tidb_tpu_plan_feedback", True, BOTH, "bool"),
+    # LRU cap on distinct statement digests the feedback store retains;
+    # GLOBAL: one store per process, like the statements summary
+    SysVar("tidb_tpu_plan_feedback_capacity", 512, GLOBAL, "int",
+           min_=1, max_=1 << 16),
     # -- serving tier (ISSUE 7): admission-controlled scheduler +
     # cross-session micro-batched dispatch -----------------------------
     # wire-connection cap enforced at the accept loop; over-limit

@@ -147,7 +147,16 @@ def _seed_sketch(table, col_name: str, vals: np.ndarray) -> None:
 
 
 def analyze_table(table) -> TableStats:
-    """Collect stats over the live rows of a host table."""
+    """Collect stats over the live rows of a host table.
+
+    Also invalidates the plan-feedback store (ISSUE 15): recorded
+    est-vs-actual truth was measured against the OLD statistics and the
+    plans they produced — ANALYZE (manual or auto) resets the baseline,
+    mirroring the plan cache's stats-identity revalidation. One choke
+    point here covers both the ANALYZE statement and auto-analyze."""
+    from tidb_tpu.planner import feedback as _feedback
+
+    _feedback.STORE.on_schema_change()
     n = table.n
     live = np.asarray(table.live_mask(0, n)) if n else np.zeros(0, dtype=bool)
     n_live = int(live.sum())

@@ -118,9 +118,23 @@ class Catalog:
         # (lazy import: the catalog must stay importable without jax)
         import sys
 
+        # getattr-guarded: sys.modules can surface a module ANOTHER
+        # thread is mid-importing (the dict entry lands before the body
+        # finishes); a missing global just means the cache doesn't
+        # exist yet — nothing to invalidate
         pipe = sys.modules.get("tidb_tpu.executor.pipeline")
-        if pipe is not None:
-            pipe.DEVICE_CACHE.on_schema_change()
+        cache = getattr(pipe, "DEVICE_CACHE", None)
+        if cache is not None:
+            cache.on_schema_change()
+        # plan feedback (ISSUE 15): recorded est-vs-actual truth was
+        # measured against plans over the OLD schema — same eager
+        # invalidation rule (and the same hook) as the plan cache.
+        # Lazy like the device cache: the catalog stays importable
+        # without pulling the planner stack in.
+        fb = sys.modules.get("tidb_tpu.planner.feedback")
+        store = getattr(fb, "STORE", None)
+        if store is not None:
+            store.on_schema_change()
 
     def processlist_rows(self, viewer_user=None, with_state=False):
         """Live-session rows for SHOW PROCESSLIST and
@@ -286,7 +300,9 @@ class Catalog:
                        digest: str = "", plan_digest: str = "",
                        max_mem: int = 0, dispatches: int = 0,
                        segs_scanned: int = 0, segs_pruned: int = 0,
-                       trace_id: str = "", disposition: str = "") -> None:
+                       trace_id: str = "", disposition: str = "",
+                       worst_drift: float = 0.0,
+                       worst_drift_op: str = "") -> None:
         """One slow-log row. `trace_id` joins the row to the kept trace
         in information_schema.cluster_trace / /trace?id= (tail sampling
         retains every over-threshold statement's trace, so the id is
@@ -296,7 +312,11 @@ class Catalog:
         `segs_scanned`/`segs_pruned`: columnar segments staged vs
         zone-map-skipped across the statement's scans — a slow scan
         with zero pruning on a range predicate is the "no clustering /
-        stale zone maps" signature."""
+        stale zone maps" signature. `worst_drift`/`worst_drift_op`: the
+        statement's worst per-operator actual/est row ratio and the
+        operator that earned it (plan feedback, ISSUE 15) — a slow
+        statement with a hundredfold drift is a PLANNING problem, not
+        an execution one, findable without tracing."""
         import logging
         import time
 
@@ -304,7 +324,7 @@ class Catalog:
             time.strftime("%Y-%m-%d %H:%M:%S"), db, round(duration_s, 4),
             sql.strip()[:2048], digest, plan_digest, int(max_mem),
             int(dispatches), int(segs_scanned), int(segs_pruned),
-            trace_id, disposition,
+            trace_id, disposition, worst_drift_op, round(worst_drift, 4),
         ))
         logging.getLogger("tidb_tpu.slowlog").warning(
             "slow query (%.3fs) db=%s digest=%s mem=%d dispatches=%d "
@@ -787,7 +807,8 @@ class Catalog:
                  ("plan_digest", STRING), ("max_mem", INT64),
                  ("dispatches", INT64), ("segs_scanned", INT64),
                  ("segs_pruned", INT64), ("trace_id", STRING),
-                 ("disposition", STRING)],
+                 ("disposition", STRING), ("worst_drift_op", STRING),
+                 ("worst_drift", FLOAT64)],
                 list(self.slow_queries),
             )
         if name == "cluster_trace":
@@ -884,8 +905,27 @@ class Catalog:
                  ("rows_sent", INT64), ("errors", INT64),
                  ("dispatches", INT64), ("fragments", INT64),
                  ("first_seen", STRING), ("last_seen", STRING),
-                 ("plan_cache_hits", INT64), ("sum_plan_latency", FLOAT64)],
+                 ("plan_cache_hits", INT64), ("sum_plan_latency", FLOAT64),
+                 ("max_drift", FLOAT64), ("mean_drift", FLOAT64),
+                 ("worst_drift_op", STRING)],
                 self.stmt_summary.rows(),
+            )
+        if name == "plan_feedback":
+            # per-operator est-vs-actual truth of every recorded
+            # (digest, plan) — the SQL face of the plan-feedback store
+            # (ISSUE 15). No listing guard needed: the store is local
+            # process memory, reading it fans out nothing.
+            from tidb_tpu.planner.feedback import STORE as _fb_store
+
+            return make(
+                [("digest", STRING), ("plan_digest", STRING),
+                 ("variant", STRING), ("execs", INT64),
+                 ("warm_execs", INT64), ("best_warm_ms", FLOAT64),
+                 ("eager_partial", INT64), ("fused_probe", INT64),
+                 ("op", STRING), ("est_rows", FLOAT64),
+                 ("actual_rows", FLOAT64), ("drift", FLOAT64),
+                 ("op_execs", INT64)],
+                _fb_store.rows(),
             )
         if name == "statistics":
             rows = []
@@ -916,7 +956,8 @@ def _time_strftime(ts: float) -> str:
 _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
                 "key_column_usage", "referential_constraints",
                 "partitions", "processlist", "statements_summary",
-                "cluster_trace", "dcn_worker_stats", "scheduler_stats")
+                "cluster_trace", "dcn_worker_stats", "scheduler_stats",
+                "plan_feedback")
 
 
 class SessionCatalog:

@@ -34,6 +34,13 @@ def _wrap(e) -> None:
 
     orig_open, orig_next = e.open, e.next
     st = e.stats
+    # instrumented row counts are exact (every emitted chunk is summed);
+    # the builder's plan annotation pairs them with the node's estimate
+    # for the est/drift columns and the plan-feedback harvest
+    st.measured = True
+    p = getattr(e, "_feedback_plan", None)
+    if p is not None:
+        st.est_rows = float(getattr(p, "est_rows", -1.0))
 
     def open_(ctx):
         t0 = time.perf_counter()
@@ -79,8 +86,15 @@ def analyze_text(root) -> str:
     chunks whose device buffers were already in place when the compute
     loop asked (prefetch overlap + device-buffer-cache hits) out of the
     chunks the operator staged — the observability face of the
-    pipelined staging path (ISSUE 9)."""
-    rows: List[Tuple[str, str, str, str, str, str]] = []
+    pipelined staging path (ISSUE 9).
+
+    `estRows` and `drift` (ISSUE 15) put the planner's estimate next to
+    what actually happened: drift = actRows/estRows, so 1.00 is a
+    perfect estimate, 100.00 a hundredfold underestimate — the same
+    ratio the plan-feedback store records and PLAN_EST_DRIFT exposes.
+    Operators the builder couldn't annotate (peeled-away interior
+    nodes) show "-"."""
+    rows: List[Tuple[str, str, str, str, str, str, str, str]] = []
     anchor = min((e_ts for e_ts in _walk_first_ts(root)), default=None)
     span_total = 0.0
     if anchor is not None:
@@ -106,9 +120,17 @@ def analyze_text(root) -> str:
         else:
             start = "|"
         staged = str(e.stats.staged) if e.stats.staged else "-"
+        est = e.stats.est_rows
+        if est >= 0:
+            est_s = f"{est:.2f}"
+            drift_s = f"{e.stats.rows / est:.2f}" if est > 0 else "-"
+        else:
+            est_s = drift_s = "-"
         rows.append((
             indent + type(e).__name__.replace("Exec", ""),
+            est_s,
             str(e.stats.rows),
+            drift_s,
             f"{total * 1e3:.1f}ms",
             start,
             staged,
@@ -125,16 +147,15 @@ def analyze_text(root) -> str:
             visit(c, depth + 1, i == len(e.children) - 1)
 
     visit(root, 0, True)
-    w0 = max(len(r[0]) for r in rows) + 2
-    w1 = max(len(r[1]) for r in rows) + 2
-    w2 = max(len(r[2]) for r in rows) + 2
-    w3 = max(len(r[3]) for r in rows) + 2
-    w4 = max(max(len(r[4]) for r in rows), len("staged")) + 2
-    lines = [f"{'id':<{w0}}{'actRows':<{w1}}{'time':<{w2}}"
-             f"{'start':<{w3}}{'staged':<{w4}}execution info"]
+    heads = ("id", "estRows", "actRows", "drift", "time", "start",
+             "staged")
+    widths = [max(max(len(r[i]) for r in rows), len(heads[i])) + 2
+              for i in range(len(heads))]
+    lines = ["".join(f"{h:<{w}}" for h, w in zip(heads, widths))
+             + "execution info"]
     for r in rows:
-        lines.append(f"{r[0]:<{w0}}{r[1]:<{w1}}{r[2]:<{w2}}{r[3]:<{w3}}"
-                     f"{r[4]:<{w4}}{r[5]}")
+        lines.append("".join(f"{r[i]:<{w}}" for i, w in enumerate(widths))
+                     + r[len(heads)])
     return "\n".join(lines)
 
 

@@ -35,7 +35,9 @@ class _Entry:
                  "exec_count", "sum_latency", "max_latency", "latencies",
                  "max_mem", "rows_sent", "errors", "dispatches",
                  "fragments", "first_seen", "last_seen",
-                 "plan_cache_hits", "sum_plan_latency")
+                 "plan_cache_hits", "sum_plan_latency",
+                 "max_drift", "sum_drift", "drift_samples",
+                 "worst_drift_op")
 
     def __init__(self, digest: str, digest_text: str, stmt_type: str):
         self.digest = digest
@@ -59,6 +61,14 @@ class _Entry:
         # visible per digest, not just end-to-end)
         self.plan_cache_hits = 0
         self.sum_plan_latency = 0.0
+        # plan feedback (ISSUE 15): per-digest estimation-drift
+        # aggregates — chronic misestimates are findable here without
+        # tracing. Drift is the worst per-operator actual/est row ratio
+        # of one execution; 0.0 samples (no actual known) don't count.
+        self.max_drift = 0.0
+        self.sum_drift = 0.0
+        self.drift_samples = 0
+        self.worst_drift_op = ""
 
     def p95(self) -> float:
         if not self.latencies:
@@ -85,6 +95,7 @@ class StmtSummary:
                rows_sent: int = 0, dispatches: int = 0, fragments: int = 0,
                error: bool = False, plan_from_cache: bool = False,
                plan_latency_s: float = 0.0,
+               worst_drift: float = 0.0, worst_drift_op: str = "",
                max_stmt_count: Optional[int] = None) -> None:
         with self.lock:
             if max_stmt_count is not None:
@@ -108,6 +119,14 @@ class StmtSummary:
             e.fragments += int(fragments)
             e.plan_cache_hits += 1 if plan_from_cache else 0
             e.sum_plan_latency += plan_latency_s
+            if worst_drift > 0:
+                drift = abs(worst_drift)
+                sym = max(drift, 1.0 / drift) if drift > 0 else 0.0
+                if sym > e.max_drift:
+                    e.max_drift = sym
+                    e.worst_drift_op = worst_drift_op
+                e.sum_drift += sym
+                e.drift_samples += 1
             e.last_seen = time.time()
             if plan_digest:
                 e.plan_digest = plan_digest
@@ -140,6 +159,9 @@ class StmtSummary:
                 e.max_mem, e.rows_sent, e.errors, e.dispatches,
                 e.fragments, _fmt_ts(e.first_seen), _fmt_ts(e.last_seen),
                 e.plan_cache_hits, round(e.sum_plan_latency, 6),
+                round(e.max_drift, 4),
+                round(e.sum_drift / max(e.drift_samples, 1), 4),
+                e.worst_drift_op,
             ))
         return out
 
@@ -150,5 +172,6 @@ class StmtSummary:
                 "exec_count", "sum_latency", "avg_latency", "max_latency",
                 "p95_latency", "max_mem", "rows_sent", "errors",
                 "dispatches", "fragments", "first_seen", "last_seen",
-                "plan_cache_hits", "sum_plan_latency")
+                "plan_cache_hits", "sum_plan_latency", "max_drift",
+                "mean_drift", "worst_drift_op")
         return [dict(zip(cols, r)) for r in self.rows()[:max(0, n)]]
