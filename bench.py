@@ -300,6 +300,40 @@ def wait_for_idle(tag=None, extra=None, max_wait=IDLE_WAIT):
     return idle
 
 
+def bench_provenance():
+    """Provenance stamped into every bench JSON artifact (ISSUE 16):
+    the git revision the numbers were measured at plus the engaged
+    feature flags (their default values in this tree — every bench
+    session runs with defaults). perf_check warns when a committed
+    floor's revision differs from the tree being checked, so a stale
+    capture can't silently gate a changed engine."""
+    rev = ""
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5).stdout.strip()
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        pass
+    flags = {}
+    try:
+        from tidb_tpu.session.sysvars import SysVarStore
+
+        sv = SysVarStore({})  # defaults only — bench sessions run stock
+        for name in ("tidb_enable_tpu_exec", "tidb_device_engine_mode",
+                     "tidb_tpu_pipeline_fuse", "tidb_tpu_columnar_enable",
+                     "tidb_tpu_plan_feedback", "tidb_tpu_join_probe_mode",
+                     "tidb_tpu_stage_encoded",
+                     "tidb_tpu_device_buffer_cache_bytes"):
+            try:
+                flags[name] = sv.get(name)
+            except Exception:  # noqa: BLE001 — a renamed flag drops out
+                pass
+    except Exception:  # noqa: BLE001
+        pass
+    return {"git_rev": rev, "flags": flags}
+
+
 def bench_query(s, engine_sql, sqlite_conn, sqlite_sql, rows, reps=REPS,
                 ordered=True, extra=None, tag=None):
     """Run engine_sql reps times; cross-check once vs sqlite. Returns
@@ -682,6 +716,7 @@ def bench_multichip(extra=None, n_rows=None, reps=None,
                 cl.shutdown()
             except Exception:  # noqa: BLE001 — bench cleanup
                 pass
+    out["provenance"] = bench_provenance()
     if write_path:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             write_path)
@@ -1630,6 +1665,7 @@ def main(locked_detail=("acquired", "acquired")):
     except Exception as e:  # noqa: BLE001
         extra["multichip_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    extra["provenance"] = bench_provenance()
     print(json.dumps({
         "metric": "tpch_q1_rows_per_sec",
         "value": round(q1_rps, 1),

@@ -297,6 +297,10 @@ def main():
                 "floors": measured,
                 "set_at": time.strftime("%Y-%m-%d %H:%M:%S"),
                 "load": [load0["loadavg"], load1["loadavg"]],
+                # ISSUE 16: record WHERE the floor came from so a later
+                # check against a different tree warns instead of
+                # silently gating changed code with stale numbers
+                "provenance": bench.bench_provenance(),
             }
             json.dump(floors, open(FLOOR_PATH, "w"), indent=1)
             print(f"floors[{plat_key}] set: {measured}")
@@ -310,6 +314,15 @@ def main():
         if floors is None:
             print(f"INCONCLUSIVE: no committed floor for platform {plat_key}")
             sys.exit(2)
+        # provenance drift is a WARNING, not a failure: old floors are
+        # still a valid lower bound, but the reader should know the
+        # numbers were captured on a different revision (ISSUE 16)
+        floor_rev = floors.get("provenance", {}).get("git_rev", "")
+        cur_rev = bench.bench_provenance()["git_rev"]
+        if floor_rev and cur_rev and floor_rev != cur_rev:
+            print(f"WARNING: floors set at rev {floor_rev}, checking rev "
+                  f"{cur_rev} — rerun with --set after intentional perf "
+                  "changes")
         bad = list(pc_bad)
         for k, floor in floors["floors"].items():
             got = measured.get(k, 0.0)

@@ -1,0 +1,303 @@
+"""Fleet metrics aggregation (ISSUE 16): snapshot wire form, merge
+semantics (counters sum, gauges per-worker only, histograms bucket-wise,
+exemplars worst-wins), and the live cluster scrape grid — 1/2/4 REAL
+worker subprocesses, a worker killed mid-scrape yielding an error row
+(never a failed scrape), and a sanitized 4-thread concurrent scrape."""
+
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tidb_tpu.parallel.dcn import Cluster, fleet_metrics_entries
+from tidb_tpu.utils.metrics import (Counter, Gauge, Histogram, Registry,
+                                    SNAPSHOT_SCHEMA, cluster_rows,
+                                    merge_snapshots, render_cluster,
+                                    snapshot)
+
+# ---------------------------------------------------------------------------
+# merge semantics (pure, no workers)
+# ---------------------------------------------------------------------------
+
+
+def _entry(label, reg):
+    return (label, snapshot(reg), "")
+
+
+class TestMergeSemantics:
+    def test_counters_sum_exactly(self):
+        regs = []
+        for n in (3, 5, 11):
+            reg = Registry()
+            Counter("t_reqs", registry=reg).inc(n, op="scan")
+            regs.append(reg)
+        merged = merge_snapshots(
+            [_entry(f"w{i}", r) for i, r in enumerate(regs)])
+        (m,) = [m for m in merged if m["name"] == "t_reqs"]
+        assert m["kind"] == "counter"
+        [(labels, v)] = m["samples"]
+        assert labels == {"op": "scan"} and v == 19.0
+
+    def test_counter_label_sets_merge_independently(self):
+        r1, r2 = Registry(), Registry()
+        c1 = Counter("t_ops", registry=r1)
+        c1.inc(2, op="a")
+        c1.inc(7, op="b")
+        Counter("t_ops", registry=r2).inc(5, op="a")
+        merged = merge_snapshots([_entry("w1", r1), _entry("w2", r2)])
+        (m,) = [m for m in merged if m["name"] == "t_ops"]
+        by_op = {s[0]["op"]: s[1] for s in m["samples"]}
+        assert by_op == {"a": 7.0, "b": 7.0}
+
+    def test_gauges_omitted_from_fleet_view(self):
+        reg = Registry()
+        Gauge("t_depth", registry=reg).set(4)
+        Counter("t_c", registry=reg).inc(1)
+        merged = merge_snapshots([_entry("w1", reg), _entry("w2", reg)])
+        assert [m["name"] for m in merged] == ["t_c"]
+        # ...but the per-worker render still carries the gauge
+        text = render_cluster([_entry("w1", reg)])
+        assert 't_depth{worker="w1"} 4' in text
+
+    def test_histograms_merge_bucket_wise_exactly(self):
+        regs = []
+        obs = [(0.002, 0.2), (0.004, 7.0)]
+        for lo, hi in obs:
+            reg = Registry()
+            h = Histogram("t_lat", buckets=(0.005, 0.5), registry=reg)
+            h.observe(lo)
+            h.observe(hi)
+            regs.append(reg)
+        merged = merge_snapshots(
+            [_entry(f"w{i}", r) for i, r in enumerate(regs)])
+        (m,) = [m for m in merged if m["name"] == "t_lat"]
+        [(_labels, counts, total, _ex)] = m["samples"]
+        # per worker: [1 under 5ms, 1 mid, 0 over] and [1, 0, 1]
+        assert counts == [2, 1, 1]
+        assert total == pytest.approx(sum(lo + hi for lo, hi in obs))
+
+    def test_mismatched_buckets_skipped_not_corrupted(self):
+        snap_a = {"schema": SNAPSHOT_SCHEMA, "metrics": [
+            {"name": "t_h", "kind": "histogram", "help": "",
+             "buckets": [0.1, 1.0],
+             "samples": [[{}, [1, 0, 0], 0.05, None]]}]}
+        snap_b = {"schema": SNAPSHOT_SCHEMA, "metrics": [
+            {"name": "t_h", "kind": "histogram", "help": "",
+             "buckets": [0.25, 2.0],  # foreign layout: unmergeable
+             "samples": [[{}, [9, 9, 9], 99.0, None]]}]}
+        merged = merge_snapshots([("a", snap_a, ""), ("b", snap_b, "")])
+        (m,) = [m for m in merged if m["name"] == "t_h"]
+        assert m["samples"][0][1] == [1, 0, 0]
+        assert m["samples"][0][2] == 0.05
+
+    def test_exemplar_worst_wins(self):
+        def snap_with(v, tid):
+            return {"schema": SNAPSHOT_SCHEMA, "metrics": [
+                {"name": "t_h", "kind": "histogram", "help": "",
+                 "buckets": [1.0],
+                 "samples": [[{}, [0, 1], v, [v, tid, 1]]]}]}
+        merged = merge_snapshots([("a", snap_with(0.3, "small"), ""),
+                                  ("b", snap_with(4.2, "big"), ""),
+                                  ("c", snap_with(1.1, "mid"), "")])
+        (m,) = merged
+        ex = m["samples"][0][3]
+        assert ex[0] == 4.2 and ex[1] == "big"
+
+    def test_malformed_and_errored_entries_contribute_nothing(self):
+        reg = Registry()
+        Counter("t_c", registry=reg).inc(2)
+        entries = [_entry("ok", reg),
+                   ("down", None, "ConnectionError: refused"),
+                   ("junk", {"metrics": "not-a-list"}, ""),
+                   ("junk2", "not-a-dict", "")]
+        merged = merge_snapshots(entries)
+        (m,) = [m for m in merged if m["name"] == "t_c"]
+        assert m["samples"][0][1] == 2.0
+
+    def test_error_entry_renders_scrape_error_sample(self):
+        reg = Registry()
+        Counter("t_c", registry=reg).inc(1)
+        text = render_cluster([_entry("w1", reg),
+                               ("10.0.0.9:4000", None,
+                                "ConnectionError: refused")])
+        assert "# TYPE tidb_tpu_cluster_scrape_error gauge" in text
+        assert ('tidb_tpu_cluster_scrape_error{worker="10.0.0.9:4000"'
+                in text)
+        assert 't_c{worker="w1"} 1' in text
+        assert 't_c{worker="fleet"} 1' in text
+
+    def test_cluster_rows_error_row_shape(self):
+        reg = Registry()
+        Counter("t_c", registry=reg).inc(3)
+        rows = cluster_rows([_entry("w1", reg),
+                             ("dead:1", None, "TimeoutError: rpc")])
+        err_rows = [r for r in rows if r[4]]
+        assert err_rows == [("dead:1", None, None, None,
+                             "TimeoutError: rpc")]
+        fleet = {(r[1], r[2]): r[3] for r in rows if r[0] == "fleet"}
+        assert fleet[("t_c", "")] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# live scrape grid: 1/2/4 REAL worker subprocesses
+# ---------------------------------------------------------------------------
+
+
+def _spawn_workers(n):
+    env = dict(os.environ)
+    procs, ports = [], []
+    for _ in range(n):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.parallel.dcn",
+             "--device", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(__file__)))
+        line = p.stdout.readline()
+        m = re.search(r"DCN_WORKER_PORT=(\d+)", line)
+        assert m, f"worker failed to start: {line!r}"
+        procs.append(p)
+        ports.append(int(m.group(1)))
+    return procs, ports
+
+
+@pytest.fixture(scope="module")
+def worker_pool():
+    procs, ports = _spawn_workers(4)
+    yield ports
+    for p in procs:
+        p.kill()
+        p.wait(timeout=10)
+
+
+def _counter_sums(entries):
+    """{(metric, label_key): summed value} over per-worker counter
+    samples — the independent oracle the fleet merge must equal."""
+    sums = {}
+    for _label, snap, err in entries:
+        if err or not isinstance(snap, dict):
+            continue
+        for m in snap["metrics"]:
+            if m.get("kind") != "counter":
+                continue
+            for labels, v in m["samples"]:
+                key = (m["name"], tuple(sorted(labels.items())))
+                sums[key] = sums.get(key, 0.0) + v
+    return sums
+
+
+class TestLiveClusterScrape:
+    @pytest.mark.parametrize("n", [1, 2, 4])
+    def test_scrape_grid_counter_sum_exact(self, worker_pool, n):
+        cl = Cluster([("127.0.0.1", p) for p in worker_pool[:n]])
+        try:
+            cl.broadcast_exec(
+                f"create table g{n} (k bigint, v bigint)")
+            cl.broadcast_exec(
+                f"insert into g{n} values (1, 10), (2, 20)")
+            for i in range(n):
+                cl._call(i, {"cmd": "exec",
+                             "sql": f"select sum(v) from g{n}"})
+            entries = cl.metrics_snapshots()
+            assert len(entries) == n
+            assert all(err == "" for _l, _s, err in entries)
+            assert all(s["schema"] == SNAPSHOT_SCHEMA
+                       for _l, s, _e in entries)
+            oracle = _counter_sums(entries)
+            # the workers executed statements: the scrape is non-trivial
+            moved = [k for k in oracle
+                     if k[0] == "tidb_tpu_query_total" and oracle[k] > 0]
+            assert moved, "worker registries show no executed statements"
+            merged = merge_snapshots(entries)
+            for m in merged:
+                if m["kind"] != "counter":
+                    continue
+                for labels, v in m["samples"]:
+                    key = (m["name"], tuple(sorted(labels.items())))
+                    assert v == oracle[key], (key, v, oracle[key])
+            # histograms: fleet bucket counts = elementwise worker sums
+            for m in merged:
+                if m["kind"] != "histogram":
+                    continue
+                for labels, counts, _total, _ex in m["samples"]:
+                    key = tuple(sorted(labels.items()))
+                    per = [s[1] for _l, snap, _e in entries
+                           for mm in snap["metrics"]
+                           if mm["name"] == m["name"]
+                           for s in mm["samples"]
+                           if tuple(sorted(s[0].items())) == key]
+                    want = [sum(col) for col in zip(*per)]
+                    assert counts == want, (m["name"], key)
+        finally:
+            cl.close()
+
+    def test_worker_killed_mid_scrape_yields_error_row(self, worker_pool):
+        procs, ports = _spawn_workers(1)
+        cl = Cluster([("127.0.0.1", worker_pool[0]),
+                      ("127.0.0.1", ports[0])])
+        try:
+            entries = cl.metrics_snapshots()
+            assert all(err == "" for _l, _s, err in entries)
+            procs[0].kill()
+            procs[0].wait(timeout=10)
+            entries = cl.metrics_snapshots()
+            assert len(entries) == 2
+            live = [e for e in entries if not e[2]]
+            dead = [e for e in entries if e[2]]
+            assert len(live) == 1 and len(dead) == 1
+            assert dead[0][0].endswith(str(ports[0]))
+            assert dead[0][1] is None
+            # the scrape surfaces still render — error row, not a raise
+            text = render_cluster(entries)
+            assert "tidb_tpu_cluster_scrape_error" in text
+            rows = cluster_rows(entries)
+            assert any(r[4] and r[0].endswith(str(ports[0]))
+                       for r in rows)
+        finally:
+            cl.close()
+            for p in procs:
+                p.wait(timeout=10)
+
+    def test_concurrent_scrape_four_threads(self, worker_pool):
+        cl = Cluster([("127.0.0.1", p) for p in worker_pool])
+        results, errors = [None] * 4, []
+
+        def scrape(i):
+            try:
+                entries = cl.metrics_snapshots()
+                text = render_cluster(entries)
+                rows = cluster_rows(entries)
+                results[i] = (entries, text, rows)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        try:
+            threads = [threading.Thread(target=scrape, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            for entries, text, rows in results:
+                assert len(entries) == 4
+                assert all(err == "" for _l, _s, err in entries)
+                assert 'worker="fleet"' in text
+                assert any(r[0] == "fleet" for r in rows)
+        finally:
+            cl.close()
+
+    def test_fleet_metrics_entries_includes_coordinator_and_workers(
+            self, worker_pool):
+        cl = Cluster([("127.0.0.1", p) for p in worker_pool[:2]])
+        try:
+            entries = fleet_metrics_entries()
+            labels = [label for label, _s, _e in entries]
+            assert labels[0] == "coordinator"
+            for port in worker_pool[:2]:
+                assert any(lb.endswith(str(port)) for lb in labels)
+        finally:
+            cl.close()

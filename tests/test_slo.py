@@ -1,0 +1,250 @@
+"""Per-digest latency SLOs (ISSUE 16): sliding-window percentiles +
+burn ratio (serving/slo.py), the information_schema.digest_latency and
+/slo surfaces, and the deliberately-minimal shed consumer — OFF by
+default, byte-identical admission when off, typed 9008 when on."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tidb_tpu.errors import SLOShedError
+from tidb_tpu.serving.slo import (DigestLatencyStore, OBJECTIVE, STORE,
+                                  WINDOW)
+from tidb_tpu.session import Session
+from tidb_tpu.utils.metrics import DIGEST_P99
+
+
+class TestDigestLatencyStore:
+    def test_percentiles_and_burn(self):
+        st = DigestLatencyStore(capacity=8)
+        # 90 fast + 10 slow against a 100ms target: 10% of the window
+        # over target -> burn = 0.10 / 0.01 = 10x the error budget
+        for _ in range(90):
+            st.observe("d1", "select fast", 0.010, target_ms=100)
+        for _ in range(10):
+            st.observe("d1", "select fast", 0.500, target_ms=100)
+        (row,) = st.rows()
+        digest, _text, n, execs, p50, p95, p99, target, breaches, burn, _ = row
+        assert digest == "d1" and n == 100 and execs == 100
+        assert p50 == pytest.approx(10.0)
+        assert p99 == pytest.approx(500.0)
+        assert p95 >= p50 and p99 >= p95
+        assert target == 100.0 and breaches == 10
+        assert burn == pytest.approx(0.10 / (1 - OBJECTIVE))
+
+    def test_window_is_bounded(self):
+        st = DigestLatencyStore()
+        for _ in range(WINDOW * 2):
+            st.observe("d", "q", 0.001)
+        (row,) = st.rows()
+        assert row[2] == WINDOW and row[3] == WINDOW * 2
+
+    def test_lru_eviction_drops_gauge_series(self):
+        st = DigestLatencyStore(capacity=2)
+        for d in ("a", "b", "c"):  # capacity 2: "a" evicted
+            st.observe(d, "q", 0.5)
+        assert len(st) == 2 and st.evicted == 1
+        series = {s[0].get("digest") for s in DIGEST_P99.samples()}
+        assert "a" not in series
+        assert {"b", "c"} <= series
+        st.clear()
+        series = {s[0].get("digest") for s in DIGEST_P99.samples()}
+        assert not {"b", "c"} & series
+
+    def test_should_shed_ranks_by_burn(self):
+        st = DigestLatencyStore()
+        for _ in range(10):
+            st.observe("burning", "q", 0.9, target_ms=100)  # burn 100
+            st.observe("inside", "q", 0.010, target_ms=100)  # burn 0
+        # half the window over target: burn 50 — over budget but not
+        # within 10% of the worst burner
+        for i in range(10):
+            st.observe("warm", "q", 0.9 if i % 2 else 0.01, target_ms=100)
+        assert st.should_shed("burning")
+        assert not st.should_shed("inside")
+        assert not st.should_shed("warm")
+        assert not st.should_shed("never-seen")
+        assert not st.should_shed("")
+
+    def test_error_budget_boundary_not_shed(self):
+        st = DigestLatencyStore()
+        st.observe("ok", "q", 0.010, target_ms=100)
+        assert not st.should_shed("ok")  # burn 0 <= 1.0
+
+
+class TestSessionSLOSurface:
+    def test_statements_feed_digest_latency(self):
+        s = Session()
+        s.execute("create table slo_t (a bigint)")
+        s.execute("insert into slo_t values (1), (2)")
+        for _ in range(3):
+            s.query("select count(*) from slo_t where a > 0")
+        rows = s.query(
+            "select digest_text, window_n, execs, p50_ms, p99_ms,"
+            " target_ms, burn_ratio from information_schema.digest_latency"
+            " where digest_text ="
+            " 'select count ( * ) from slo_t where a > ?'")
+        assert len(rows) == 1, rows
+        _t, n, execs, p50, p99, target, burn = rows[0]
+        assert execs >= 3 and n >= 3
+        assert p99 >= p50 > 0
+        assert target == float(s.sysvars.get("tidb_tpu_slo_target_ms"))
+        assert burn >= 0.0
+
+    def test_error_path_observed(self):
+        s = Session()
+        with pytest.raises(Exception):
+            s.query("select * from missing_tbl_for_slo")
+        rows = s.query(
+            "select execs from information_schema.digest_latency"
+            " where digest_text = 'select * from missing_tbl_for_slo'")
+        assert rows and rows[0][0] >= 1
+
+    def test_target_sysvar_in_force_at_observe(self):
+        s = Session()
+        s.execute("set global tidb_tpu_slo_target_ms = 1234")
+        try:
+            s.query("select 41 + 1")
+            rows = s.query(
+                "select target_ms from information_schema.digest_latency"
+                " where digest_text = 'select ? + ?'")
+            assert rows and rows[-1][0] == 1234.0
+        finally:
+            s.execute("set global tidb_tpu_slo_target_ms = 300")
+
+    def test_slo_endpoint(self):
+        from tidb_tpu.server import Server
+        from tidb_tpu.storage.catalog import Catalog
+
+        cat = Catalog()
+        s = Session(catalog=cat)
+        s.query("select 7")
+        srv = Server(catalog=cat, port=0, status_port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.status_port}"
+            body = json.loads(
+                urllib.request.urlopen(base + "/slo?top=5").read())
+            assert body["objective"] == OBJECTIVE
+            assert body["capacity"] >= 1
+            assert len(body["digests"]) <= 5
+            if body["digests"]:
+                for field in ("digest", "p50_ms", "p99_ms", "target_ms",
+                              "burn_ratio", "breaches"):
+                    assert field in body["digests"][0]
+        finally:
+            srv.stop()
+
+    def test_digest_p99_gauge_rendered(self):
+        s = Session()
+        s.query("select 40 + 2")
+        from tidb_tpu.utils.metrics import render_prometheus
+
+        text = render_prometheus()
+        assert "tidb_tpu_digest_p99_seconds{digest=" in text
+
+
+class TestShedConsumer:
+    def _sched(self, **globals_):
+        from tidb_tpu.serving.scheduler import StatementScheduler
+        from tidb_tpu.storage.catalog import Catalog
+
+        cat = Catalog()
+        boot = Session(catalog=cat)
+        for k, v in globals_.items():
+            boot.execute(f"set global {k} = {int(v)}")
+        return StatementScheduler(cat, workers=1), cat
+
+    def test_flag_off_is_default_and_computes_nothing(self):
+        sched, cat = self._sched()
+        try:
+            s = Session(catalog=cat)
+            # default OFF: no digest computed, admission untouched even
+            # for a digest the store would shed under pressure
+            assert sched._shed_digest(s, sql="select 1") == ""
+            for _ in range(5):
+                STORE.observe("deadbeef", "q", 9.9, target_ms=1)
+            assert sched.submit_query(s, "select 1").rows == [(1,)]
+        finally:
+            sched.shutdown()
+            STORE.clear()
+
+    def test_flag_on_sheds_burning_digest_under_pressure(self):
+        from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+        sched, cat = self._sched(tidb_tpu_sched_slo_shed=True,
+                                 tidb_tpu_sched_max_queue=4)
+        try:
+            s = Session(catalog=cat)
+            sql = "select 123456789 from nowhere_shed"
+            digest = sql_digest(normalize_sql(sql))
+            assert sched._shed_digest(s, sql=sql) == digest
+            for _ in range(5):
+                STORE.observe(digest, sql, 9.9, target_ms=1)
+            assert STORE.should_shed(digest)
+            # no pressure (queue empty): burning digest still admitted
+            sched._admit(sched._shed_digest(s, sql=sql))
+            sched._unqueue()
+            # queue >= 3/4 full: the burn ranking engages, typed 9008
+            with sched._cv:
+                sched._queued = 3
+            try:
+                with pytest.raises(SLOShedError) as ei:
+                    sched._admit(sched._shed_digest(s, sql=sql))
+                assert ei.value.code == 9008
+                assert "shed by SLO burn" in str(ei.value)
+            finally:
+                with sched._cv:
+                    sched._queued = 0
+        finally:
+            sched.shutdown()
+            STORE.clear()
+
+    def test_flag_on_spares_digest_inside_budget(self):
+        from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+        sched, cat = self._sched(tidb_tpu_sched_slo_shed=True,
+                                 tidb_tpu_sched_max_queue=4)
+        try:
+            s = Session(catalog=cat)
+            sql = "select 55 from fine_digest"
+            digest = sql_digest(normalize_sql(sql))
+            STORE.observe(digest, sql, 0.0001, target_ms=1000)
+            with sched._cv:
+                sched._queued = 3
+            try:
+                # pressured but inside budget: the full-queue rejection
+                # (not the shed) is what eventually fires
+                sched._admit(sched._shed_digest(s, sql=sql))
+                sched._unqueue()
+            finally:
+                with sched._cv:
+                    sched._queued = 0
+        finally:
+            sched.shutdown()
+            STORE.clear()
+
+    def test_store_lock_is_leaf_under_concurrent_observe(self):
+        st = DigestLatencyStore(capacity=16)
+        errors = []
+
+        def hammer(i):
+            try:
+                for k in range(200):
+                    st.observe(f"d{(i * 7 + k) % 24}", "q",
+                               0.001 * (k % 9), target_ms=2)
+                    st.should_shed(f"d{k % 24}")
+                    st.rows()
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(st) <= 16

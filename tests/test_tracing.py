@@ -371,7 +371,7 @@ class TestDistributedTracing:
             text = M.render_prometheus()
             assert re.search(
                 r'tidb_tpu_dcn_rpc_seconds_bucket\{.*le="\+Inf"\} \d+ '
-                r'# \{trace_id="[^"]+"\}', text)
+                r'# \{trace_id="[^"]+",kept="[01]"\}', text)
         finally:
             cl.shutdown()
 

@@ -60,6 +60,9 @@ DEFAULT_MODULES = (
     # plan feedback (ISSUE 15): the store lock is a LEAF — fold/read
     # only, no planning, device work, or I/O may run under it
     "tidb_tpu/planner/feedback.py",
+    # latency SLOs (ISSUE 16): same leaf contract — the metric gauge
+    # updates and eviction cleanup run after the lock is released
+    "tidb_tpu/serving/slo.py",
 )
 
 # attribute names whose call blocks the thread

@@ -383,7 +383,8 @@ class Driver:
                      "reason": s.reason} for v, s in rep.suppressed],
             })
         from tidb_tpu.analysis.host_sync import annotated_sites
-        from tidb_tpu.analysis.registry import plan_feedback_surfaces
+        from tidb_tpu.analysis.registry import (observability_surfaces,
+                                                plan_feedback_surfaces)
         from tidb_tpu.analysis.resource_lifecycle import lifecycle_sites
 
         return {
@@ -399,6 +400,12 @@ class Driver:
             # means a surface was silently dropped in a refactor
             "plan_feedback_surface_count":
                 len(plan_feedback_surfaces(self.project)),
+            # ISSUE 16: the observability plane's user-visible surfaces
+            # (cluster_metrics/digest_latency I_S tables, scope=cluster
+            # render, /slo endpoint, metrics_snapshot cmd, profile
+            # columns, SLO sysvars/consumer) counted the same way
+            "observability_surface_count":
+                len(observability_surfaces(self.project)),
             "passes": passes,
         }
 

@@ -55,6 +55,9 @@ DEFAULT_MODULES = (
     # plan feedback (ISSUE 15): the store's leaf lock guards per-digest
     # observations folded by concurrent statement-end harvests
     "tidb_tpu/planner/feedback.py",
+    # latency SLOs (ISSUE 16): the digest-latency store's leaf lock
+    # guards windows folded at statement end and read at admission
+    "tidb_tpu/serving/slo.py",
 )
 
 # NOTE: the serving-tier wait-discipline check (ISSUE 7) moved to

@@ -25,7 +25,7 @@ from tidb_tpu.analysis.core import Pass, Project, Violation
 
 __all__ = ["MetricsCoveragePass", "FailpointCoveragePass",
            "SysvarCoveragePass", "metrics_problems", "failpoint_scan",
-           "plan_feedback_surfaces"]
+           "plan_feedback_surfaces", "observability_surfaces"]
 
 
 # ---------------------------------------------------------------------------
@@ -47,12 +47,11 @@ _PLAN_FEEDBACK_SURFACES: Tuple[Tuple[str, str], ...] = (
 )
 
 
-def plan_feedback_surfaces(project: Project) -> List[Tuple[str, str]]:
-    """The plan-feedback surfaces present in this tree: each registered
-    (file, marker) pair whose marker still appears in the file's
-    source. A full tree has all of them; the count is pinned tier-1."""
+def _surfaces_present(project: Project,
+                      pairs: Tuple[Tuple[str, str], ...]
+                      ) -> List[Tuple[str, str]]:
     out: List[Tuple[str, str]] = []
-    for rel, marker in _PLAN_FEEDBACK_SURFACES:
+    for rel, marker in pairs:
         path = os.path.join(project.root, rel)
         try:
             with open(path, encoding="utf-8") as f:
@@ -62,6 +61,39 @@ def plan_feedback_surfaces(project: Project) -> List[Tuple[str, str]]:
         if marker in src:
             out.append((rel, marker))
     return out
+
+
+def plan_feedback_surfaces(project: Project) -> List[Tuple[str, str]]:
+    """The plan-feedback surfaces present in this tree: each registered
+    (file, marker) pair whose marker still appears in the file's
+    source. A full tree has all of them; the count is pinned tier-1."""
+    return _surfaces_present(project, _PLAN_FEEDBACK_SURFACES)
+
+
+# every user-visible surface of the ISSUE 16 observability plane
+# (cluster metrics, resource profiles, latency SLOs), same contract as
+# the plan-feedback list: a refactor that drops a surface is a static
+# diff in check_invariants --json before any runtime test notices.
+_OBSERVABILITY_SURFACES: Tuple[Tuple[str, str], ...] = (
+    ("tidb_tpu/storage/catalog.py", 'if name == "cluster_metrics"'),
+    ("tidb_tpu/storage/catalog.py", 'if name == "digest_latency"'),
+    ("tidb_tpu/server/status.py", 'scope=cluster'),
+    ("tidb_tpu/server/status.py", '"/slo"'),
+    ("tidb_tpu/parallel/dcn.py", '"metrics_snapshot"'),
+    ("tidb_tpu/utils/metrics.py", '"tidb_tpu_digest_p99_seconds"'),
+    ("tidb_tpu/utils/metrics.py", '"tidb_tpu_xfer_bytes_total"'),
+    ("tidb_tpu/utils/metrics.py", '"tidb_tpu_compile_seconds_total"'),
+    ("tidb_tpu/session/sysvars.py", '"tidb_tpu_slo_target_ms"'),
+    ("tidb_tpu/session/sysvars.py", '"tidb_tpu_sched_slo_shed"'),
+    ("tidb_tpu/serving/slo.py", "should_shed"),
+    ("tidb_tpu/storage/catalog.py", '("xfer_bytes", INT64)'),
+)
+
+
+def observability_surfaces(project: Project) -> List[Tuple[str, str]]:
+    """The ISSUE 16 observability surfaces present in this tree (same
+    marker contract as plan_feedback_surfaces)."""
+    return _surfaces_present(project, _OBSERVABILITY_SURFACES)
 
 
 # ---------------------------------------------------------------------------

@@ -467,6 +467,9 @@ class ScanPin:
             _seg, b = self.charged.pop(id(seg), (None, 0))
             if b:
                 self.tracker.release(b)
+            from tidb_tpu.utils import dispatch as _dsp
+
+            _dsp.record_spill(b or freed)  # per-stmt profile (ISSUE 16)
             return b or freed
         return 0
 

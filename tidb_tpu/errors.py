@@ -105,6 +105,15 @@ class SchedulerQueueTimeoutError(ExecutionError):
     code = 9008  # same busy-class error: the server is saturated
 
 
+class SLOShedError(AdmissionRejectedError):
+    """Shed at admission under queue pressure because the statement's
+    digest is burning its latency SLO budget fastest
+    (tidb_tpu_sched_slo_shed, ISSUE 16). The statement never started —
+    safe to retry; results are never affected, only who waits."""
+
+    code = 9008  # the same busy class: back off and retry
+
+
 class SanitizerError(ExecutionError):
     """The runtime invariant sanitizer (tidb_tpu_sanitize, ISSUE 12)
     witnessed a broken engine invariant during this statement: a leaked

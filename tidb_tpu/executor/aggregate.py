@@ -355,7 +355,7 @@ class HashAggExec(Executor):
 
         from tidb_tpu.utils import dispatch as dsp
 
-        host = jax.device_get(state)
+        host = dsp.record_fetch(jax.device_get(state))
         dsp.record(site="fetch")
         if self.group_exprs:
             occupied = np.nonzero(host["occ"] > 0)[0]
@@ -437,6 +437,7 @@ class HashAggExec(Executor):
     # ------------------------------------------------------------------
 
     def _run_generic(self):
+        from tidb_tpu.utils import dispatch as dsp
         from tidb_tpu.utils.memory import SpillableRuns
 
         group_exprs, aggs = self.group_exprs, self.aggs
@@ -469,7 +470,7 @@ class HashAggExec(Executor):
             # per-column np.asarray syncs this loop used to pay. The
             # device tiers (fused pipeline / _run_generic_device) are
             # the no-per-chunk-fetch paths
-            outs, sel = jax.device_get(eval_all(chunk))
+            outs, sel = dsp.record_fetch(jax.device_get(eval_all(chunk)))
             sel = np.asarray(sel)
             live = np.nonzero(sel)[0]
             total += len(live)
@@ -637,12 +638,14 @@ class HashAggExec(Executor):
         import jax
 
         from tidb_tpu.executor.agg_device import table_to_host_partial
+        from tidb_tpu.utils import dispatch as dsp
 
         cap = self.ctx.chunk_capacity
         if not tables:
             self._out = []  # grouped agg over empty input -> no rows
             return
-        host_tables = jax.device_get(tables)  # ONE round trip (finalize)
+        host_tables = dsp.record_fetch(
+            jax.device_get(tables))  # ONE round trip (finalize)
         # account the durable (ngroups-sliced) partial tables with the
         # same incremental discipline as the host spill-merge path; the
         # padded slot arrays are transients

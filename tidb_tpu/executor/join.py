@@ -751,9 +751,10 @@ class HashJoinExec(Executor):
         # round trip this loop used to pay; the totals size the tile
         # expansions (sanctioned device_get outside any loop — the
         # chunk-loop sync-budget pass watches the loop form)
-        totals = jax.device_get([t["total_dev"] for t in tokens])
         from tidb_tpu.utils import dispatch as dsp
 
+        totals = dsp.record_fetch(
+            jax.device_get([t["total_dev"] for t in tokens]))
         dsp.record(site="fetch")
         if self.kind == "inner" and not self._has_filter:
             # plan feedback: for the unfiltered inner join the summed

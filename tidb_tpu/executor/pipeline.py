@@ -267,10 +267,12 @@ class ChunkPrefetcher:
 
     def get(self, i: int):
         """Chunk i's staged device pytree, blocking on in-flight staging."""
+        from tidb_tpu.utils import dispatch as dsp
         from tidb_tpu.utils.metrics import PIPELINE_PREFETCH_TOTAL
 
         if self._thread is None:
             staged, nbytes = self._stage(self.jobs[i]())
+            dsp.record_xfer(nbytes, "h2d")
             PIPELINE_PREFETCH_TOTAL.inc(outcome="inline")
             return staged
         with self._cv:
@@ -285,6 +287,10 @@ class ChunkPrefetcher:
             staged, nbytes = self._staged.pop(i)
             self._cv.notify_all()
         self.tracker.release(nbytes)
+        # h2d accounting lands HERE (the consuming statement thread),
+        # not in _stage on the daemon thread — the thread-local profile
+        # must attribute the staged bytes to the statement that asked
+        dsp.record_xfer(nbytes, "h2d")
         PIPELINE_PREFETCH_TOTAL.inc(outcome="hit" if ready else "wait")
         if ready and self.stats is not None:
             self.stats.staged += 1
@@ -1089,7 +1095,8 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
         # expansion fit their in-program tile need nothing further
         # (sanctioned device_get outside any loop — the chunk-loop
         # sync-budget pass watches the loop form)
-        totals = jax.device_get([t["total_dev"] for t in tokens])
+        totals = dsp.record_fetch(
+            jax.device_get([t["total_dev"] for t in tokens]))
         dsp.record(site="fetch")
         # plan feedback: the fused inner PK-FK shape's summed totals are
         # its exact output cardinality, and total vs tile capacity is

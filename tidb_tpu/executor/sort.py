@@ -29,6 +29,7 @@ class _Materializing(Executor):
     _runs = None
 
     def _drain_to_runs(self, sort_items: List[Tuple[object, bool]]):
+        from tidb_tpu.utils import dispatch as _dsp
         from tidb_tpu.utils.memory import SpillableRuns
 
         child = self.children[0]
@@ -49,7 +50,7 @@ class _Materializing(Executor):
             # ONE device_get per chunk (Chunk/Column are pytrees) — the
             # per-column np.asarray calls below then see numpy and cost
             # nothing (was 2 syncs per column)
-            kcols, ch = jax.device_get(eval_chunk(ch))
+            kcols, ch = _dsp.record_fetch(jax.device_get(eval_chunk(ch)))
             sel = np.asarray(ch.sel)
             live = np.nonzero(sel)[0]
             named = {}

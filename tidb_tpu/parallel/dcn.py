@@ -61,7 +61,8 @@ from tidb_tpu.parser.printer import expr_to_sql
 from tidb_tpu.utils import tracing
 from tidb_tpu.utils.failpoint import inject
 
-__all__ = ["Worker", "Cluster", "partial_rewrite", "clusters_alive"]
+__all__ = ["Worker", "Cluster", "partial_rewrite", "clusters_alive",
+           "fleet_metrics_entries"]
 
 # health-machine states, exported for tests and /cluster
 UP, SUSPECT, DOWN = "up", "suspect", "down"
@@ -75,6 +76,24 @@ _TOKEN_SEQ = itertools.count(1)
 
 def clusters_alive() -> List["Cluster"]:
     return list(_CLUSTERS)
+
+
+def fleet_metrics_entries() -> List[tuple]:
+    """One cluster scrape: the coordinator's own registry (labeled
+    ``coordinator``) plus every live Cluster's per-worker snapshots.
+    The input shape metrics.render_cluster / cluster_rows consume —
+    /metrics?scope=cluster and information_schema.cluster_metrics read
+    the SAME entries, so the two surfaces can never disagree."""
+    from tidb_tpu.utils import metrics as _metrics
+
+    entries: List[tuple] = [("coordinator", _metrics.snapshot(), "")]
+    for cl in clusters_alive():
+        try:
+            entries.extend(cl.metrics_snapshots())
+        except Exception as e:  # noqa: BLE001 — a cluster mid-shutdown
+            entries.append((f"cluster@{id(cl):x}", None,
+                            f"{type(e).__name__}: {e}"))
+    return entries
 
 
 def _retype_wire_error(err: str, detail: str) -> ExecutionError:
@@ -1127,6 +1146,14 @@ class Worker:
                     b for _s, b in self._placed.values())
             out["open_shuffles"] = self._inbox.open_count()
             return out
+        if cmd == "metrics_snapshot":
+            # fleet metrics plane (ISSUE 16): this process's entire
+            # registry in wire form — the coordinator merges per-worker
+            # snapshots for /metrics?scope=cluster and
+            # information_schema.cluster_metrics
+            from tidb_tpu.utils import metrics as _metrics
+
+            return _metrics.snapshot()
         if cmd == "place_shards":
             with self._placed_lock:
                 self._placed[str(msg["table"])] = (
@@ -3343,6 +3370,33 @@ class Cluster:
         for t in threads:
             t.join()
         return rows
+
+    def metrics_snapshots(self) -> List[tuple]:
+        """(endpoint_label, metrics snapshot | None, error) per worker —
+        the fleet half of the ISSUE 16 metrics plane. Gathered
+        CONCURRENTLY with the worker_stats_rows discipline: one dead
+        worker costs one timeout and contributes an error entry, never
+        a failed scrape. Idempotent (a pure read), so it rides the
+        retry path."""
+        out: List = [None] * len(self._endpoints)
+
+        def gather(i: int, host: str, port: int) -> None:
+            label = f"{host}:{port}"
+            try:
+                snap = self._call_retry(i, {"cmd": "metrics_snapshot"})
+                out[i] = (label, snap, "")
+            except Exception as e:  # noqa: BLE001 — down worker: an
+                out[i] = (label, None,  # error entry, not a failure
+                          f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=gather, args=(i, host, port),
+                                    daemon=True)
+                   for i, (host, port) in enumerate(self._endpoints)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
 
     def health_snapshot(self) -> Dict:
         """JSON-friendly view of the per-worker health machine — the

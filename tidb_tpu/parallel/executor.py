@@ -705,12 +705,12 @@ class DistFragmentExec(HashAggExec):
                 else:
                     seg_state = _timed_combine(prog.sig, seg_state, out)
             else:
+                from tidb_tpu.utils import dispatch as dsp
+
                 # host-sync: >HBM generic streaming — per-part group
                 # tables must merge on host across batches (parts stay
                 # disjoint), one batched fetch per streamed batch
-                host = jax.device_get(out)
-                from tidb_tpu.utils import dispatch as dsp
-
+                host = dsp.record_fetch(jax.device_get(out))
                 dsp.record(site="fetch")
                 if gen_parts is None:
                     n_parts_out = len(np.asarray(host["n"]).reshape(-1))
@@ -780,7 +780,7 @@ class DistFragmentExec(HashAggExec):
         from tidb_tpu.executor.agg_device import table_to_host_partial
         from tidb_tpu.utils import dispatch as dsp
 
-        host = jax.device_get(out)
+        host = dsp.record_fetch(jax.device_get(out))
         dsp.record(site="fetch")
         nk = len(self.group_exprs)
         cap = self.ctx.chunk_capacity

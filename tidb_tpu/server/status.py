@@ -2,7 +2,11 @@
 Prometheus, /status for liveness/version, plus schema introspection).
 
 Endpoints:
-    /metrics     - Prometheus text exposition of tidb_tpu_* collectors
+    /metrics     - Prometheus text exposition of tidb_tpu_* collectors;
+                   ?scope=cluster scrapes every live Cluster's workers
+                   over DCN and renders per-worker `worker` labels plus
+                   the merged `worker="fleet"` view (unreachable
+                   workers become error samples, never a failed scrape)
     /status      - JSON: version, connections, schema version, uptime
     /schema      - JSON: databases -> tables -> row counts
     /statements  - JSON: top-N statement digests by cumulative latency
@@ -22,6 +26,9 @@ Endpoints:
                    default 50): per-(digest, plan) est-vs-actual
                    operator cardinalities, warm latencies, eager-agg
                    exploration state, tile-overflow telemetry
+    /slo         - JSON: the per-digest latency SLO store (?top=N,
+                   default 50): sliding-window p50/p95/p99, breach
+                   counts, and burn ratios against tidb_tpu_slo_target_ms
 """
 
 from __future__ import annotations
@@ -49,10 +56,24 @@ class StatusServer:
 
             def do_GET(self):
                 try:
-                    if self.path == "/metrics":
-                        from tidb_tpu.utils.metrics import render_prometheus
+                    if self.path == "/metrics" or \
+                            self.path.startswith("/metrics?"):
+                        from urllib.parse import parse_qs, urlparse
 
-                        body = render_prometheus().encode()
+                        q = parse_qs(urlparse(self.path).query)
+                        if q.get("scope", [""])[0] == "cluster":
+                            from tidb_tpu.parallel.dcn import \
+                                fleet_metrics_entries
+                            from tidb_tpu.utils.metrics import \
+                                render_cluster
+
+                            body = render_cluster(
+                                fleet_metrics_entries()).encode()
+                        else:
+                            from tidb_tpu.utils.metrics import \
+                                render_prometheus
+
+                            body = render_prometheus().encode()
                         ctype = "text/plain; version=0.0.4"
                     elif self.path == "/status":
                         from tidb_tpu.utils.metrics import CONN_GAUGE
@@ -128,6 +149,20 @@ class StatusServer:
                         except ValueError:
                             top = 50
                         body = json.dumps(STORE.stats_dict(top)).encode()
+                        ctype = "application/json"
+                    elif self.path == "/slo" or \
+                            self.path.startswith("/slo?"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        from tidb_tpu.serving.slo import STORE as slo_store
+
+                        q = parse_qs(urlparse(self.path).query)
+                        try:
+                            top = int(q.get("top", ["50"])[0])
+                        except ValueError:
+                            top = 50
+                        body = json.dumps(
+                            slo_store.stats_dict(top)).encode()
                         ctype = "application/json"
                     elif self.path == "/cluster":
                         from tidb_tpu.parallel.dcn import clusters_alive

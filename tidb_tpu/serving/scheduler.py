@@ -34,6 +34,7 @@ from typing import Optional
 from tidb_tpu.errors import (
     AdmissionRejectedError,
     SchedulerQueueTimeoutError,
+    SLOShedError,
 )
 from tidb_tpu.serving.batcher import Batcher, BatchGroup
 from tidb_tpu.session.sysvars import SysVarStore
@@ -125,12 +126,46 @@ class StatementScheduler:
 
     # -- admission -------------------------------------------------------
 
-    def _admit(self) -> None:
+    def _shed_digest(self, sess, sql=None, stmt_id=None) -> str:
+        """Statement digest for the SLO shed consumer, or "" when
+        tidb_tpu_sched_slo_shed is off — the default path computes
+        NOTHING and admission decisions stay byte-identical."""
+        if not bool(self.sysvars.get("tidb_tpu_sched_slo_shed")):
+            return ""
+        try:
+            if sql is not None:
+                from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+                return sql_digest(normalize_sql(sql))
+            ent = sess._prepared.get(stmt_id)
+            return ent[4] if ent is not None else ""
+        except Exception:  # noqa: BLE001 — a digest failure must never
+            return ""      # lose a statement; it just skips the shed
+
+    def _admit(self, shed_digest: str = "") -> None:
         from tidb_tpu.utils import metrics as M
 
         quota = int(self.sysvars.get("tidb_tpu_sched_mem_quota"))
         self.server_tracker.budget = quota or None
         maxq = int(self.sysvars.get("tidb_tpu_sched_max_queue"))
+        # SLO shed (ISSUE 16), deliberately minimal: only when the flag
+        # gave us a digest AND the queue is pressured (>= 3/4 full — a
+        # racy read by design; pressure is a heuristic, not an
+        # invariant) does the burn ranking get consulted. Checked
+        # before _cv: the SLO store lock is a leaf and must not nest
+        # under the scheduler's.
+        if shed_digest and self._queued * 4 >= maxq * 3:
+            from tidb_tpu.serving.slo import STORE as _slo
+
+            if _slo.should_shed(shed_digest):
+                with self._cv:
+                    self.rejected += 1
+                M.SCHED_ADMISSION_TOTAL.inc(outcome="rejected")
+                M.SLO_SHED_TOTAL.inc()
+                raise SLOShedError(
+                    "server is busy: shed by SLO burn ranking "
+                    f"(digest {shed_digest[:16]} over budget under "
+                    "queue pressure; tidb_tpu_sched_slo_shed=1)")
         with self._cv:
             if self._draining:
                 why = "statement scheduler is draining (server shutdown)"
@@ -164,7 +199,7 @@ class StatementScheduler:
         """Text-protocol statement: admission + singleton execution on
         a worker (the catalog statement lock is taken by the worker,
         exactly as the thread-per-connection server did)."""
-        self._admit()
+        self._admit(self._shed_digest(sess, sql=sql))
         self._session_tracker(sess)
         task = _Task(sess, lambda: sess.execute(sql))
         self._enqueue_task(task)
@@ -173,7 +208,7 @@ class StatementScheduler:
     def submit_prepared(self, sess, stmt_id: int, params: list):
         """Binary-protocol execution: coalescible statements join a
         batch group; everything else runs singleton."""
-        self._admit()
+        self._admit(self._shed_digest(sess, stmt_id=stmt_id))
         self._session_tracker(sess)
         met = int(sess.sysvars.get("max_execution_time"))
         deadline = (time.monotonic() + met / 1e3) if met > 0 else None

@@ -320,6 +320,9 @@ class Session:
         self._fb_capture = None
         self._fb_worst_drift = (0.0, "")
         self._fb_last_apd = None
+        # resource profile of the last recorded statement (ISSUE 16):
+        # (mem_max, xfer_bytes, compile_ms, spill_bytes) or None
+        self._stmt_profile = None
         # prepare-time (sql, norm, digest, StmtInfo) for the current
         # prepared execution: the probe skips lexing + AST analysis
         self._ps_ctx = None
@@ -585,6 +588,15 @@ class Session:
         self._fb_worst_drift = (0.0, "")
         self._fb_last_apd = None
         c0 = _dsp.compile_count()
+        # per-statement resource profile (ISSUE 16): thread-local
+        # baselines for transfer bytes / compile seconds / spill bytes —
+        # all host-side accounting at existing choke points, zero new
+        # device syncs (PR 14's contract)
+        try:
+            prof0 = (_dsp.xfer_bytes(), _dsp.compile_seconds(),
+                     _dsp.spill_bytes())
+        except Exception:  # noqa: BLE001 — diagnostics never fail a stmt
+            prof0 = (0, 0.0, 0)
         # always-on tracing (utils/tracing.py): every statement RECORDS
         # a span tree; tail rules / head sampling decide at the end
         # whether it is kept. A statement arriving with a trace already
@@ -643,7 +655,8 @@ class Session:
             if isinstance(exc, QueryTimeoutError):
                 M.DEADLINE_EXCEEDED_TOTAL.inc()
             detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, None,
-                                       seg0=seg0, error=True)
+                                       seg0=seg0, prof0=prof0, error=True)
+            self._slo_observe(dur)
             tracing.annotate(f"error:{type(exc).__name__}: {exc}")
             trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur,
                                           error=exc)
@@ -694,7 +707,8 @@ class Session:
         # trace surfaces run, so they all see the drift it computed
         self._fb_record(dur, result, _dsp.compile_count() - c0)
         detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, result,
-                                   seg0=seg0)
+                                   seg0=seg0, prof0=prof0)
+        self._slo_observe(dur)
         trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur)
         self._maybe_log_slow(sql, dur, detail, trace_id)
         # plugin hooks run LAST (mirroring the error path): an audit
@@ -763,6 +777,24 @@ class Session:
         except Exception:  # noqa: BLE001 — diagnostics never fail a stmt
             pass
 
+    def _slo_observe(self, dur: float) -> None:
+        """SLO plane (ISSUE 16): fold this statement's wall time into the
+        per-digest latency window. Success AND error paths — what the
+        user waited is what the SLO measures. Diagnostics never fail a
+        statement."""
+        try:
+            memo = self._stmt_digest_memo
+            if memo is None or not memo[2]:
+                return
+            from tidb_tpu.serving import slo as _slo
+
+            _slo.STORE.observe(
+                memo[2], memo[1], dur,
+                target_ms=int(self.sysvars.get("tidb_tpu_slo_target_ms")),
+                capacity=int(self.sysvars.get("tidb_tpu_slo_capacity")))
+        except Exception:  # noqa: BLE001 — diagnostics never fail a stmt
+            pass
+
     def _maybe_log_slow(self, sql: str, dur: float, detail, trace_id: str,
                         disposition: str = "") -> None:
         """One slow-log decision for both the success and the error path
@@ -781,7 +813,9 @@ class Session:
             max_mem=detail[1], dispatches=detail[2],
             segs_scanned=detail[3], segs_pruned=detail[4],
             trace_id=trace_id, disposition=disposition,
-            worst_drift=drift, worst_drift_op=drift_op)
+            worst_drift=drift, worst_drift_op=drift_op,
+            xfer_bytes=detail[5], compile_ms=detail[6],
+            spill_bytes=detail[7])
 
     def _stmt_digest(self, stmt, sql: str):
         """(normalized_text, digest) for this statement, memoized per
@@ -834,14 +868,16 @@ class Session:
 
     def _record_stmt(self, stmt, sql: str, stype: str, dur: float,
                      d0: int, f0: int, result, seg0=(0, 0),
-                     error: bool = False):
+                     prof0=(0, 0.0, 0), error: bool = False):
         """Fold one execution into the per-digest statements summary;
-        returns (digest, max_mem, dispatches, segs_scanned, segs_pruned)
-        for the slow-query log. Digests come from the bindinfo
+        returns (digest, max_mem, dispatches, segs_scanned, segs_pruned,
+        xfer_bytes, compile_ms, spill_bytes) for the slow-query log and
+        the EXPLAIN ANALYZE profile line. Digests come from the bindinfo
         normalizer, so parameterized variants of one statement
         aggregate under one entry."""
         from tidb_tpu.utils import dispatch as _dsp
 
+        self._stmt_profile = None
         try:
             # memoized: the statement-start trace_id computation (or the
             # plan-cache probe) already lexed this source
@@ -859,6 +895,20 @@ class Session:
             seg1 = _seg_counts()
             segs_scanned = seg1[0] - seg0[0]
             segs_pruned = seg1[1] - seg0[1]
+            # resource profile deltas (ISSUE 16): host-side counters
+            # moved at the existing staging/fetch/spill choke points
+            xfer = _dsp.xfer_bytes() - prof0[0]
+            compile_ms = (_dsp.compile_seconds() - prof0[1]) * 1e3
+            spill = _dsp.spill_bytes() - prof0[2]
+            self._stmt_profile = (max_mem, xfer, compile_ms, spill)
+            if xfer or spill or compile_ms >= 1.0:
+                from tidb_tpu.utils import tracing as _tracing
+
+                # span annotation on kept traces: the statement's
+                # resource footprint travels with its trace
+                _tracing.annotate(
+                    f"profile: mem_max={max_mem} xfer_bytes={xfer} "
+                    f"compile_ms={compile_ms:.1f} spill_bytes={spill}")
             drift, drift_op = self._fb_worst_drift
             self.catalog.stmt_summary.record(
                 digest, norm, stype, self._last_plan_digest or "", dur,
@@ -868,13 +918,15 @@ class Session:
                 plan_from_cache=self._plan_from_cache_stmt,
                 plan_latency_s=self._stmt_plan_s,
                 worst_drift=drift, worst_drift_op=drift_op,
+                xfer_bytes=xfer, compile_ms=compile_ms, spill_bytes=spill,
                 max_stmt_count=int(
                     self.sysvars.get("tidb_stmt_summary_max_stmt_count")))
-            return digest, max_mem, dispatches, segs_scanned, segs_pruned
+            return (digest, max_mem, dispatches, segs_scanned, segs_pruned,
+                    xfer, compile_ms, spill)
         except Exception:  # noqa: BLE001 — diagnostics must never fail
             # (or mask) the statement; an unrecordable statement is
             # simply absent from the summary
-            return "", 0, 0, 0, 0
+            return "", 0, 0, 0, 0, 0, 0.0, 0
 
     def query(self, sql: str) -> List[tuple]:
         rs = self.execute(sql)
@@ -3049,12 +3101,24 @@ class Session:
         # statement itself; ANALYZE even executes it
         self._check_plan_privs(phys)
         if stmt.analyze:
+            from tidb_tpu.utils import dispatch as _dsp
             from tidb_tpu.utils.execdetails import analyze_text, instrument
 
             root = self._build_root(phys)
             instrument(root)
+            # resource profile (ISSUE 16): deltas of the thread-local
+            # host-side counters around the execution — no new syncs
+            p0 = (_dsp.xfer_bytes(), _dsp.compile_seconds(),
+                  _dsp.spill_bytes())
             run_plan(root, self._exec_ctx(plan=phys))  # execute; rows discarded
             text = analyze_text(root)
+            mem_max = max((t.max_consumed for t in self._stmt_trackers),
+                          default=0)
+            text += ("\nprofile: mem_max=%d xfer_bytes=%d compile_ms=%.1f"
+                     " spill_bytes=%d"
+                     % (mem_max, _dsp.xfer_bytes() - p0[0],
+                        (_dsp.compile_seconds() - p0[1]) * 1e3,
+                        _dsp.spill_bytes() - p0[2]))
             return ResultSet(names=["EXPLAIN ANALYZE"],
                              rows=[(line,) for line in text.split("\n")])
         text = explain_text(phys)

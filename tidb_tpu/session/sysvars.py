@@ -186,6 +186,22 @@ _reg(
     # statement (a child of the server tracker); 0 = unlimited
     SysVar("tidb_tpu_mem_quota_session", 0, BOTH, "int",
            min_=0, max_=1 << 45),
+    # -- per-digest latency SLOs (ISSUE 16) ----------------------------
+    # latency objective per statement execution: the SLO store counts a
+    # window observation over this target as a budget breach and
+    # derives the burn ratio from the breach fraction (99% objective)
+    SysVar("tidb_tpu_slo_target_ms", 300, GLOBAL, "int",
+           min_=1, max_=1 << 31),
+    # LRU cap on distinct digests the SLO store retains; GLOBAL: one
+    # store per process, like the plan-feedback capacity
+    SysVar("tidb_tpu_slo_capacity", 512, GLOBAL, "int",
+           min_=1, max_=1 << 16),
+    # the first SLO consumer (default OFF): under admission queue
+    # pressure (queue >= 3/4 of tidb_tpu_sched_max_queue) shed the
+    # statements whose digest is burning its SLO budget fastest, with
+    # a typed 9008 rejection. Plans and results are never affected —
+    # off leaves admission decisions byte-identical
+    SysVar("tidb_tpu_sched_slo_shed", False, GLOBAL, "bool"),
     # -- columnar segment store (ISSUE 8) ------------------------------
     # scans over stored tables stage encoded, zone-mapped segments with
     # decompression fused into the jitted scan program; off = raw slices

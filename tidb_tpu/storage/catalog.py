@@ -302,7 +302,9 @@ class Catalog:
                        segs_scanned: int = 0, segs_pruned: int = 0,
                        trace_id: str = "", disposition: str = "",
                        worst_drift: float = 0.0,
-                       worst_drift_op: str = "") -> None:
+                       worst_drift_op: str = "",
+                       xfer_bytes: int = 0, compile_ms: float = 0.0,
+                       spill_bytes: int = 0) -> None:
         """One slow-log row. `trace_id` joins the row to the kept trace
         in information_schema.cluster_trace / /trace?id= (tail sampling
         retains every over-threshold statement's trace, so the id is
@@ -325,6 +327,7 @@ class Catalog:
             sql.strip()[:2048], digest, plan_digest, int(max_mem),
             int(dispatches), int(segs_scanned), int(segs_pruned),
             trace_id, disposition, worst_drift_op, round(worst_drift, 4),
+            int(xfer_bytes), round(float(compile_ms), 3), int(spill_bytes),
         ))
         logging.getLogger("tidb_tpu.slowlog").warning(
             "slow query (%.3fs) db=%s digest=%s mem=%d dispatches=%d "
@@ -808,7 +811,8 @@ class Catalog:
                  ("dispatches", INT64), ("segs_scanned", INT64),
                  ("segs_pruned", INT64), ("trace_id", STRING),
                  ("disposition", STRING), ("worst_drift_op", STRING),
-                 ("worst_drift", FLOAT64)],
+                 ("worst_drift", FLOAT64), ("xfer_bytes", INT64),
+                 ("compile_ms", FLOAT64), ("spill_bytes", INT64)],
                 list(self.slow_queries),
             )
         if name == "cluster_trace":
@@ -907,7 +911,8 @@ class Catalog:
                  ("first_seen", STRING), ("last_seen", STRING),
                  ("plan_cache_hits", INT64), ("sum_plan_latency", FLOAT64),
                  ("max_drift", FLOAT64), ("mean_drift", FLOAT64),
-                 ("worst_drift_op", STRING)],
+                 ("worst_drift_op", STRING), ("xfer_bytes", INT64),
+                 ("compile_ms", FLOAT64), ("spill_bytes", INT64)],
                 self.stmt_summary.rows(),
             )
         if name == "plan_feedback":
@@ -926,6 +931,40 @@ class Catalog:
                  ("actual_rows", FLOAT64), ("drift", FLOAT64),
                  ("op_execs", INT64)],
                 _fb_store.rows(),
+            )
+        if name == "cluster_metrics":
+            # the fleet metrics plane (ISSUE 16): the SAME scrape
+            # entries /metrics?scope=cluster renders, as SQL rows —
+            # per-worker samples, the merged worker='fleet' view, and
+            # an error row per unreachable worker. Guarded like
+            # dcn_worker_stats: a SHOW TABLES / schema walk (listing)
+            # must not scrape a live fleet just to report existence.
+            rows = []
+            if not listing:
+                from tidb_tpu.parallel.dcn import fleet_metrics_entries
+                from tidb_tpu.utils.metrics import cluster_rows
+
+                rows = cluster_rows(fleet_metrics_entries())
+            return make(
+                [("worker", STRING), ("metric", STRING),
+                 ("labels", STRING), ("value", FLOAT64),
+                 ("error", STRING)],
+                rows,
+            )
+        if name == "digest_latency":
+            # per-digest latency SLO store (ISSUE 16): sliding-window
+            # percentiles + burn ratio against tidb_tpu_slo_target_ms.
+            # No listing guard needed: local process memory.
+            from tidb_tpu.serving.slo import STORE as _slo_store
+
+            return make(
+                [("digest", STRING), ("digest_text", STRING),
+                 ("window_n", INT64), ("execs", INT64),
+                 ("p50_ms", FLOAT64), ("p95_ms", FLOAT64),
+                 ("p99_ms", FLOAT64), ("target_ms", FLOAT64),
+                 ("breaches", INT64), ("burn_ratio", FLOAT64),
+                 ("last_seen", STRING)],
+                _slo_store.rows(),
             )
         if name == "statistics":
             rows = []
@@ -957,7 +996,7 @@ _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
                 "key_column_usage", "referential_constraints",
                 "partitions", "processlist", "statements_summary",
                 "cluster_trace", "dcn_worker_stats", "scheduler_stats",
-                "plan_feedback")
+                "plan_feedback", "cluster_metrics", "digest_latency")
 
 
 class SessionCatalog:

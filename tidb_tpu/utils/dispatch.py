@@ -18,11 +18,14 @@ knob the reference gets from its coprocessor request counters
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
 
-__all__ = ["record", "count", "counted_jit"]
+__all__ = ["record", "count", "counted_jit", "record_xfer", "xfer_bytes",
+           "record_fetch", "record_spill", "spill_bytes",
+           "compile_seconds"]
 
 import threading
 
@@ -65,6 +68,47 @@ def count() -> int:
     return getattr(_tls, "count", 0)
 
 
+def record_xfer(nbytes: int, direction: str = "h2d") -> None:
+    """Count host↔device transfer BYTES on this thread (ISSUE 16
+    resource profiles). Called at the existing staging/fetch choke
+    points AFTER the transfer completes — never a new device sync. The
+    thread-local feeds the per-statement profile; the process-wide
+    mirror feeds /metrics."""
+    n = int(nbytes)
+    if n <= 0:
+        return
+    _tls.xfer = getattr(_tls, "xfer", 0) + n
+    from tidb_tpu.utils.metrics import XFER_BYTES
+
+    XFER_BYTES.inc(n, dir=direction)
+
+
+def xfer_bytes() -> int:
+    return getattr(_tls, "xfer", 0)
+
+
+def record_fetch(tree):
+    """Record a COMPLETED device→host fetch's bytes (d2h) and return
+    the tree unchanged — wraps the sanctioned ``jax.device_get`` sites
+    (the arrays are host-resident by the time this sums nbytes, so the
+    accounting itself never blocks)."""
+    n = sum(getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(tree))
+    record_xfer(n, "d2h")
+    return tree
+
+
+def record_spill(nbytes: int) -> None:
+    """Count bytes this thread's statement spilled to disk (the
+    process-wide SPILL_BYTES/SPILL_SEGMENT_BYTES metrics move at the
+    spill sites themselves)."""
+    _tls.spill = getattr(_tls, "spill", 0) + int(nbytes)
+
+
+def spill_bytes() -> int:
+    return getattr(_tls, "spill", 0)
+
+
 def record_compile(kernel: str = "join") -> None:
     """Count one kernel (re)trace on this thread. Called from inside
     traced jit bodies (they only execute at trace time), so the counter
@@ -81,6 +125,20 @@ def compile_count() -> int:
     return getattr(_tls, "compiles", 0)
 
 
+def _record_compile_seconds(s: float) -> None:
+    _tls.compile_s = getattr(_tls, "compile_s", 0.0) + float(s)
+    from tidb_tpu.utils.metrics import COMPILE_SECONDS
+
+    COMPILE_SECONDS.inc(float(s))
+
+
+def compile_seconds() -> float:
+    """Wall seconds this thread spent tracing+compiling fragments
+    (first invocation per jit entry per shape — where XLA compiles
+    synchronously), attributed to the statement that triggered them."""
+    return getattr(_tls, "compile_s", 0.0)
+
+
 def by_site() -> dict:
     """Cumulative per-site breakdown (for profiling, not EXPLAIN)."""
     return dict(getattr(_tls, "by_site", {}))
@@ -91,9 +149,21 @@ def counted_jit(fn: Callable, site: str = "jit", **jit_kwargs) -> Callable:
     # lint: disable=jit-hygiene -- this IS the counting wrapper the
     # pass audits call sites of; identity discipline is the caller's
     jitted = jax.jit(fn, **jit_kwargs)
+    sizer = getattr(jitted, "_cache_size", None)
 
     def counted(*args, **kwargs):
         record(site=site)
-        return jitted(*args, **kwargs)
+        if sizer is None:
+            return jitted(*args, **kwargs)
+        # compile-seconds attribution (ISSUE 16): a growing executable
+        # cache means THIS invocation paid a trace+compile — charge its
+        # wall time to the triggering statement's thread. Warm calls
+        # pay two perf_counter reads and one C++ cache-size probe.
+        n0 = sizer()
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        if sizer() > n0:
+            _record_compile_seconds(time.perf_counter() - t0)
+        return out
 
     return counted

@@ -233,10 +233,12 @@ class SpillableRuns:
         self._frozen = None
         self.buf_bytes = 0
         self.tracker.release(freed)
+        from tidb_tpu.utils import dispatch as _dsp
         from tidb_tpu.utils.metrics import SPILL_BYTES, SPILL_TOTAL
 
         SPILL_TOTAL.inc()
         SPILL_BYTES.inc(freed)
+        _dsp.record_spill(freed)  # per-statement profile (ISSUE 16)
         return freed
 
     @property

@@ -4,7 +4,17 @@ served on the HTTP status port).
 Counters and histograms with optional labels, exposed in the Prometheus
 text format by server/status.py. A process-global REGISTRY mirrors the
 reference's package-level collectors; everything is thread-safe under
-one lock (metric updates are far off the hot device path)."""
+one lock (metric updates are far off the hot device path).
+
+Fleet aggregation (ISSUE 16): ``snapshot()`` produces a DCN-codec-safe
+wire form of every registered metric; the coordinator merges per-worker
+snapshots (counters sum, gauges ship per-worker only, histograms merge
+bucket-wise, exemplars keep the worst observation) and renders
+``/metrics?scope=cluster`` with per-worker ``worker`` labels plus a
+merged ``worker="fleet"`` view. An unreachable worker contributes a
+``tidb_tpu_cluster_scrape_error`` sample (and an error row on
+``information_schema.cluster_metrics``) instead of failing the scrape —
+the ``dcn_worker_stats`` rule."""
 
 from __future__ import annotations
 
@@ -12,7 +22,8 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Histogram", "Gauge", "REGISTRY", "Registry",
-           "render_prometheus"]
+           "render_prometheus", "snapshot", "merge_snapshots",
+           "render_cluster", "cluster_rows", "SNAPSHOT_SCHEMA"]
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0)
 
@@ -56,6 +67,12 @@ class Counter(_Metric):
     def value(self, **labels) -> float:
         with self.lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def remove(self, **labels) -> None:
+        """Drop one label set (an LRU-evicted digest's gauge must not
+        render a stale value forever)."""
+        with self.lock:
+            self._values.pop(tuple(sorted(labels.items())), None)
 
     def samples(self):
         with self.lock:  # snapshot: writers may insert new label keys
@@ -145,6 +162,26 @@ def _fmt_labels(labels: Dict, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _exemplar_kept(trace_id: str) -> int:
+    """1 when the exemplar's trace is currently readable on /trace?id=.
+    Exemplars record trace_id at OBSERVATION time; the trace may later
+    be discarded (head sampling) or ring-evicted — annotating the
+    rendered exemplar stops operators chasing 404s for those."""
+    from tidb_tpu.utils import tracing
+
+    return 1 if tracing.STORE.get(trace_id) is not None else 0
+
+
+def _exemplar_tail(ex) -> str:
+    """OpenMetrics exemplar rendering: the worst recent observation's
+    trace_id (+ whether that trace is still fetchable), on +Inf."""
+    if ex is None:
+        return ""
+    kept = ex[2] if len(ex) > 2 else _exemplar_kept(ex[1])
+    return (f' # {{trace_id="{ex[1]}",kept="{int(kept)}"}}'
+            f' {round(float(ex[0]), 6)}')
+
+
 def render_prometheus(registry: Optional[Registry] = None) -> str:
     """Prometheus text exposition of every registered metric."""
     reg = registry or REGISTRY
@@ -163,17 +200,232 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
                     out.append(f"{m.name}_bucket{le} {acc}")
                 acc += counts[-1]
                 le = _fmt_labels(labels, 'le="+Inf"')
-                # OpenMetrics exemplar: the worst recent observation's
-                # trace_id, linking the histogram to /trace?id=...
-                tail = (f' # {{trace_id="{ex[1]}"}} {round(ex[0], 6)}'
-                        if ex is not None else "")
-                out.append(f"{m.name}_bucket{le} {acc}{tail}")
+                ex2 = (ex[0], ex[1]) if ex is not None else None
+                out.append(f"{m.name}_bucket{le} {acc}"
+                           f"{_exemplar_tail(ex2)}")
                 out.append(f"{m.name}_sum{_fmt_labels(labels)} {total}")
                 out.append(f"{m.name}_count{_fmt_labels(labels)} {acc}")
         else:
             for labels, v in m.samples():
                 out.append(f"{m.name}{_fmt_labels(labels)} {v}")
     return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation (ISSUE 16): snapshot wire form + merge + renderers
+# ---------------------------------------------------------------------------
+
+SNAPSHOT_SCHEMA = 1
+
+
+def snapshot(registry: Optional[Registry] = None) -> Dict:
+    """DCN-codec-safe wire form of every registered metric (the
+    ``metrics_snapshot`` RPC payload): name/kind/help per metric, label
+    dicts + scalar values per sample; histograms carry their bucket
+    bounds, per-bucket counts, sum, and the exemplar as
+    ``[value, trace_id, kept]`` — ``kept`` is stamped HERE because only
+    the observing process's trace store can answer it."""
+    reg = registry or REGISTRY
+    with reg.lock:
+        metrics = list(reg.metrics)
+    out = []
+    for m in metrics:
+        d: Dict = {"name": m.name, "kind": m.kind, "help": m.help}
+        if isinstance(m, Histogram):
+            d["buckets"] = [float(b) for b in m.buckets]
+            d["samples"] = [
+                [labels, list(counts), float(total),
+                 None if ex is None
+                 else [float(ex[0]), str(ex[1]), _exemplar_kept(ex[1])]]
+                for labels, counts, total, ex in m.samples()]
+        else:
+            d["samples"] = [[labels, float(v)]
+                            for labels, v in m.samples()]
+        out.append(d)
+    return {"schema": SNAPSHOT_SCHEMA, "metrics": out}
+
+
+def _iter_snap_metrics(entries):
+    """(worker_label, metric_dict) over every well-formed snapshot in
+    scrape entries [(label, snapshot|None, error)] — malformed or
+    errored entries contribute nothing here (their error surfaces
+    separately)."""
+    for label, snap, _err in entries:
+        if not isinstance(snap, dict):
+            continue
+        for m in snap.get("metrics") or ():
+            if isinstance(m, dict) and m.get("name"):
+                yield label, m
+
+
+def merge_snapshots(entries) -> List[Dict]:
+    """Fleet-merged metric list from scrape entries
+    ``[(worker_label, snapshot|None, error)]``:
+
+      * counters — label-set values SUM across workers
+      * gauges — per-worker readings only (a summed queue depth or
+        health state is a lie); merged output omits them
+      * histograms — per-bucket counts and sums merge bucket-wise
+        (requires identical bucket bounds — all processes run the same
+        collectors; a mismatched snapshot's sample is skipped)
+      * exemplars — the worst (max-value) observation wins
+
+    Returns metric dicts in the snapshot shape, first-seen order."""
+    merged: "Dict[str, Dict]" = {}
+    order: List[str] = []
+    for _label, m in _iter_snap_metrics(entries):
+        name, kind = m["name"], m.get("kind", "untyped")
+        if kind == "gauge":
+            continue
+        cur = merged.get(name)
+        if cur is None:
+            cur = merged[name] = {"name": name, "kind": kind,
+                                  "help": m.get("help", ""),
+                                  "samples": {}}
+            if kind == "histogram":
+                cur["buckets"] = list(m.get("buckets") or ())
+            order.append(name)
+        for s in m.get("samples") or ():
+            try:
+                labels = dict(s[0])
+                key = tuple(sorted(labels.items()))
+            except (TypeError, IndexError):
+                continue
+            if kind == "histogram":
+                if list(m.get("buckets") or ()) != cur["buckets"]:
+                    continue  # foreign bucket layout: unmergeable
+                counts, total = list(s[1]), float(s[2])
+                ex = s[3] if len(s) > 3 else None
+                hit = cur["samples"].get(key)
+                if hit is None:
+                    cur["samples"][key] = [labels, counts, total, ex]
+                else:
+                    hit[1] = [a + b for a, b in zip(hit[1], counts)]
+                    hit[2] += total
+                    if ex is not None and (hit[3] is None
+                                           or ex[0] >= hit[3][0]):
+                        hit[3] = ex
+            else:
+                v = float(s[1])
+                hit = cur["samples"].get(key)
+                if hit is None:
+                    cur["samples"][key] = [labels, v]
+                else:
+                    hit[1] += v
+    out = []
+    for name in order:
+        m = merged[name]
+        m["samples"] = list(m["samples"].values())
+        out.append(m)
+    return out
+
+
+def _snap_sample_lines(m: Dict, labels: Dict, s, out: List[str]) -> None:
+    """Exposition lines of one snapshot-form sample (histogram or
+    scalar), shared by the per-worker and fleet sections."""
+    name = m["name"]
+    if m.get("kind") == "histogram":
+        counts, total = s[1], s[2]
+        ex = s[3] if len(s) > 3 else None
+        acc = 0
+        for b, c in zip(m.get("buckets") or (), counts):
+            acc += c
+            le = _fmt_labels(labels, f'le="{b}"')
+            out.append(f"{name}_bucket{le} {acc}")
+        acc += counts[-1] if counts else 0
+        le = _fmt_labels(labels, 'le="+Inf"')
+        out.append(f"{name}_bucket{le} {acc}{_exemplar_tail(ex)}")
+        out.append(f"{name}_sum{_fmt_labels(labels)} {total}")
+        out.append(f"{name}_count{_fmt_labels(labels)} {acc}")
+    else:
+        out.append(f"{name}{_fmt_labels(labels)} {s[1]}")
+
+
+def render_cluster(entries) -> str:
+    """Prometheus text exposition of a cluster scrape: every worker's
+    samples labeled ``worker=<label>``, the merged fleet view labeled
+    ``worker="fleet"`` (counters/histograms only — see
+    merge_snapshots), and one ``tidb_tpu_cluster_scrape_error`` gauge
+    sample per unreachable worker (the scrape itself never fails)."""
+    out: List[str] = []
+    seen_meta = set()
+    by_name: "Dict[str, List]" = {}
+    order: List[str] = []
+    for label, m in _iter_snap_metrics(entries):
+        if m["name"] not in by_name:
+            by_name[m["name"]] = []
+            order.append(m["name"])
+        by_name[m["name"]].append((label, m))
+    fleet = {m["name"]: m for m in merge_snapshots(entries)}
+    for name in order:
+        first = by_name[name][0][1]
+        if name not in seen_meta:
+            seen_meta.add(name)
+            out.append(f"# HELP {name} {first.get('help', '')}")
+            out.append(f"# TYPE {name} {first.get('kind', 'untyped')}")
+        for label, m in by_name[name]:
+            for s in m.get("samples") or ():
+                try:
+                    labels = dict(s[0])
+                except (TypeError, IndexError):
+                    continue
+                labels["worker"] = label
+                _snap_sample_lines(m, labels, s, out)
+        fm = fleet.get(name)
+        if fm is not None:
+            for s in fm["samples"]:
+                labels = dict(s[0])
+                labels["worker"] = "fleet"
+                _snap_sample_lines(fm, labels, s, out)
+    errs = [(label, err) for label, snap, err in entries if err]
+    if errs:
+        out.append("# HELP tidb_tpu_cluster_scrape_error Workers whose "
+                   "metrics_snapshot RPC failed during this cluster "
+                   "scrape (error row, not a failed scrape)")
+        out.append("# TYPE tidb_tpu_cluster_scrape_error gauge")
+        for label, err in errs:
+            lbl = _fmt_labels({"worker": label,
+                               "error": err.replace('"', "'")})
+            out.append(f"tidb_tpu_cluster_scrape_error{lbl} 1")
+    return "\n".join(out) + "\n"
+
+
+def cluster_rows(entries) -> List[tuple]:
+    """information_schema.cluster_metrics rows from scrape entries:
+    ``(worker, metric, labels, value, error)``. Histograms contribute
+    their ``_count`` and ``_sum`` series (the SQL surface is for
+    totals; bucket shapes live on /metrics). Fleet-merged rows carry
+    ``worker='fleet'``; an unreachable worker yields one row whose
+    ``error`` is set and whose metric columns are NULL."""
+    rows: List[tuple] = []
+
+    def sample_rows(worker: str, m: Dict) -> None:
+        name = m["name"]
+        for s in m.get("samples") or ():
+            try:
+                labels = dict(s[0])
+            except (TypeError, IndexError):
+                continue
+            lbl = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            if m.get("kind") == "histogram":
+                rows.append((worker, f"{name}_count", lbl,
+                             float(sum(s[1])), ""))
+                rows.append((worker, f"{name}_sum", lbl, float(s[2]), ""))
+            else:
+                rows.append((worker, name, lbl, float(s[1]), ""))
+
+    for label, snap, err in entries:
+        if err:
+            rows.append((label, None, None, None, err))
+            continue
+        if not isinstance(snap, dict):
+            continue
+        for m in snap.get("metrics") or ():
+            if isinstance(m, dict) and m.get("name"):
+                sample_rows(label, m)
+    for m in merge_snapshots(entries):
+        sample_rows("fleet", m)
+    return rows
 
 
 # -- engine collectors (ref: metrics/*.go one file per layer) ---------------
@@ -386,3 +638,28 @@ BATCH_COALESCE_TOTAL = Counter(
     "tidb_tpu_batch_coalesce_total",
     "Statements that rode a multi-statement coalesced dispatch (members "
     "of batches with n >= 2; singleton executions never count)")
+
+# -- cluster observability plane (ISSUE 16) ---------------------------------
+
+XFER_BYTES = Counter(
+    "tidb_tpu_xfer_bytes_total",
+    "Host<->device transfer bytes observed at the EXISTING staging/"
+    "fetch choke points (prefetcher stagings, probe-window and agg "
+    "drains), by dir: h2d, d2h — the process-wide mirror of the "
+    "per-statement profile accounting; no new device syncs are paid "
+    "to collect it")
+COMPILE_SECONDS = Counter(
+    "tidb_tpu_compile_seconds_total",
+    "Wall seconds spent in first-invocation kernel/fragment "
+    "trace+compile, attributed to the triggering statement's profile "
+    "(warm statements add zero)")
+DIGEST_P99 = Gauge(
+    "tidb_tpu_digest_p99_seconds",
+    "Sliding-window p99 statement latency per digest (the SLO store's "
+    "view; label sets follow the store's LRU — an evicted digest's "
+    "series is removed)")
+SLO_SHED_TOTAL = Counter(
+    "tidb_tpu_slo_shed_total",
+    "Statements shed at admission under queue pressure because their "
+    "digest was burning its latency SLO budget fastest "
+    "(tidb_tpu_sched_slo_shed; plans and results are never affected)")

@@ -37,7 +37,8 @@ class _Entry:
                  "fragments", "first_seen", "last_seen",
                  "plan_cache_hits", "sum_plan_latency",
                  "max_drift", "sum_drift", "drift_samples",
-                 "worst_drift_op")
+                 "worst_drift_op", "xfer_bytes", "compile_ms",
+                 "spill_bytes")
 
     def __init__(self, digest: str, digest_text: str, stmt_type: str):
         self.digest = digest
@@ -69,6 +70,12 @@ class _Entry:
         self.sum_drift = 0.0
         self.drift_samples = 0
         self.worst_drift_op = ""
+        # resource profile (ISSUE 16): cumulative host↔device transfer
+        # bytes, fragment compile wall time, and spill bytes across this
+        # digest's executions — all host-side accounting, no new syncs
+        self.xfer_bytes = 0
+        self.compile_ms = 0.0
+        self.spill_bytes = 0
 
     def p95(self) -> float:
         if not self.latencies:
@@ -96,6 +103,8 @@ class StmtSummary:
                error: bool = False, plan_from_cache: bool = False,
                plan_latency_s: float = 0.0,
                worst_drift: float = 0.0, worst_drift_op: str = "",
+               xfer_bytes: int = 0, compile_ms: float = 0.0,
+               spill_bytes: int = 0,
                max_stmt_count: Optional[int] = None) -> None:
         with self.lock:
             if max_stmt_count is not None:
@@ -127,6 +136,9 @@ class StmtSummary:
                     e.worst_drift_op = worst_drift_op
                 e.sum_drift += sym
                 e.drift_samples += 1
+            e.xfer_bytes += int(xfer_bytes)
+            e.compile_ms += float(compile_ms)
+            e.spill_bytes += int(spill_bytes)
             e.last_seen = time.time()
             if plan_digest:
                 e.plan_digest = plan_digest
@@ -162,6 +174,7 @@ class StmtSummary:
                 round(e.max_drift, 4),
                 round(e.sum_drift / max(e.drift_samples, 1), 4),
                 e.worst_drift_op,
+                e.xfer_bytes, round(e.compile_ms, 3), e.spill_bytes,
             ))
         return out
 
@@ -173,5 +186,6 @@ class StmtSummary:
                 "p95_latency", "max_mem", "rows_sent", "errors",
                 "dispatches", "fragments", "first_seen", "last_seen",
                 "plan_cache_hits", "sum_plan_latency", "max_drift",
-                "mean_drift", "worst_drift_op")
+                "mean_drift", "worst_drift_op", "xfer_bytes",
+                "compile_ms", "spill_bytes")
         return [dict(zip(cols, r)) for r in self.rows()[:max(0, n)]]
