@@ -844,42 +844,315 @@ def bench_oltp(extra, clients_list=(8, 16), iters=150):
             f"hit_rate={cfg['hit_rate']} oracle={cfg['oracle']}")
         if mismatches:
             log(f"# OLTP ORACLE MISMATCH at {n_clients} clients")
-    # update mix (reported only): 90/10 point-get/update at the largest
-    # client count, everything through the scheduler
-    n_clients = clients_list[-1]
+    # the 90/10 point-get/update mix moved to bench_mixed (ISSUE 17):
+    # it is floored now (group-commit DML), so it runs two-armed on a
+    # fresh catalog per arm with a serial-oracle state-hash cross-check
+    return out
+
+
+def _mixed_sbtest(n_rows=5000):
+    """Fresh sbtest catalog for one mixed-workload arm (identical
+    initial state across arms and the serial oracle)."""
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.catalog import Catalog
+
+    cat = Catalog()
+    boot = Session(catalog=cat)
+    boot.execute("SET GLOBAL tidb_slow_log_threshold = 300000")
+    boot.execute("SET GLOBAL tidb_trace_sample_rate = 0")
+    boot.execute("CREATE TABLE sbtest (id bigint primary key, k bigint,"
+                 " c varchar(64), pad varchar(32))")
+    boot.execute("INSERT INTO sbtest VALUES " + ",".join(
+        f"({i},{i % 499},'c-{i:010d}-{i * 7 % 997:04d}','pad-{i % 83}')"
+        for i in range(n_rows)))
+    boot.execute("ANALYZE TABLE sbtest")
+    return cat, boot
+
+
+def _sbtest_state_hash(cat):
+    """Content hash of sbtest's committed state (order-independent of
+    execution interleaving: rows sorted by primary key)."""
+    import hashlib
+
+    from tidb_tpu.session import Session
+
+    rows = Session(catalog=cat).query(
+        "select id, k, c, pad from sbtest order by id")
+    return hashlib.sha256(repr(rows).encode()).hexdigest()[:16]
+
+
+def bench_mixed(extra=None, n_clients=16, iters=150):
+    """Mixed 90/10 point-get/point-update OLTP (ISSUE 17): the write
+    path catching the read path. Two arms, each on a FRESH catalog with
+    identical initial state: window=0 (every statement singleton
+    through the scheduler — the pre-group-commit shape) vs the gather
+    window ON (reads coalesce as before; the 10% autocommit updates now
+    group-commit through the SAME window into one merged engine pass).
+    Every run cross-checks the final table content hash against a
+    serial one-session execution of the same statement multiset — the
+    per-key updates commute (k = k + 1), so the final state is
+    interleaving-invariant and the hash must match exactly."""
+    import threading
+
+    from tidb_tpu.serving import StatementScheduler
+    from tidb_tpu.session import Session
+    from tidb_tpu.utils import metrics as _M
+
+    n_rows = 5000
+    point_tmpl = "select c, pad, k from sbtest where id = ?"
+
+    def key_of(client, i):
+        return (client * 7919 + i * 97) % n_rows
+
+    def run_arm(window_us):
+        cat, boot = _mixed_sbtest(n_rows)
+        boot.execute(f"SET GLOBAL tidb_tpu_batch_window_us = {window_us}")
+        sched = StatementScheduler(cat, workers=4)
+        sessions = [Session(catalog=cat) for _ in range(n_clients)]
+        sids = [s.prepare(point_tmpl)[0] for s in sessions]
+        sched.submit_prepared(sessions[0], sids[0], [0])
+        barrier = threading.Barrier(n_clients + 1)
+
+        def mixed(ci):
+            sess, sid = sessions[ci], sids[ci]
+            barrier.wait()
+            for i in range(iters):
+                k = key_of(ci, i)
+                if i % 10 == 9:
+                    sched.submit_query(
+                        sess, f"update sbtest set k = k + 1 where id = {k}")
+                else:
+                    sched.submit_prepared(sess, sid, [k])
+
+        threads = [threading.Thread(target=mixed, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        adm = sched.stats_dict()
+        sched.shutdown()
+        return (n_clients * iters / wall, _sbtest_state_hash(cat),
+                {k: adm[k] for k in ("admitted", "rejected", "timed_out")})
+
+    hist0 = list(next(
+        (c for _l, c, _s, _e in _M.DML_BATCH_SIZE.samples()), [])) or None
+    c0 = _M.BATCH_COALESCE_TOTAL.value()
+    cold_rps, cold_hash, _ = run_arm(0)
+    warm_rps, warm_hash, adm = run_arm(1500)
+    hist1 = list(next(
+        (c for _l, c, _s, _e in _M.DML_BATCH_SIZE.samples()), []))
+    hist = (hist1 if hist0 is None
+            else [a - b for a, b in zip(hist1, hist0)])
+    # serial oracle: the same statement multiset through ONE session,
+    # no scheduler — the state every interleaving must reach
+    cat, _boot = _mixed_sbtest(n_rows)
+    oracle = Session(catalog=cat)
+    for ci in range(n_clients):
+        for i in range(iters):
+            if i % 10 == 9:
+                oracle.execute("update sbtest set k = k + 1 "
+                               f"where id = {key_of(ci, i)}")
+    want_hash = _sbtest_state_hash(cat)
+    ok = cold_hash == want_hash and warm_hash == want_hash
+    out = {
+        "clients": n_clients,
+        "iters": iters,
+        "unbatched_stmts_per_sec": round(cold_rps, 1),
+        "mixed_90_10_stmts_per_sec": round(warm_rps, 1),
+        "group_commit_speedup": round(warm_rps / max(cold_rps, 1e-9), 3),
+        "coalesced_stmts": _M.BATCH_COALESCE_TOTAL.value() - c0,
+        "dml_batch_hist": {
+            str(b): int(c) for b, c in
+            zip(list(_M.DML_BATCH_SIZE.buckets) + ["+Inf"], hist) if c},
+        "admission": adm,
+        "oracle": "ok" if ok else (
+            f"STATE HASH MISMATCH want={want_hash} "
+            f"unbatched={cold_hash} batched={warm_hash}"),
+    }
+    log(f"# mixed 90/10 at {n_clients} clients: "
+        f"unbatched={out['unbatched_stmts_per_sec']}/s "
+        f"group-commit={out['mixed_90_10_stmts_per_sec']}/s "
+        f"({out['group_commit_speedup']}x) oracle={out['oracle']}")
+    if extra is not None:
+        extra["mixed"] = out
+    return out
+
+
+def bench_htap(extra=None, n_clients=8, ingest_iters=160,
+               analytics_iters=10, sf=0.05):
+    """HTAP bench (ISSUE 17, tentpole c): analytics (TPC-H Q6 + a
+    Q18-shape big-join aggregate) running DURING sustained multi-client
+    ingest into the same lineitem — group-commit coalesces the insert
+    stream, background compaction keeps the scan path from inheriting
+    an ever-growing delta inline. Reports OLTP insert throughput,
+    analytics p50/p99 under ingest, observed staleness (committed rows
+    an analytics snapshot had not yet seen), and the compaction outcome
+    counters. Ends with a flag-off equality check: the final Q6 with
+    tidb_tpu_compaction=0 must be byte-identical to compaction ON."""
+    import threading
+
+    from tidb_tpu.serving import StatementScheduler
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.catalog import Catalog
+    from tidb_tpu.storage.tpch import load_tpch
+    from tidb_tpu.storage.tpch_queries import Q
+    from tidb_tpu.utils import metrics as _M
+
+    cat = Catalog()
+    boot = Session(catalog=cat)
+    boot.execute("SET GLOBAL tidb_slow_log_threshold = 300000")
+    boot.execute("SET GLOBAL tidb_trace_sample_rate = 0")
+    boot.execute("SET GLOBAL tidb_tpu_batch_window_us = 1500")
+    # delta threshold at its floor so the ingest stream actually crosses
+    # it mid-run: the fold then happens on the background worker while
+    # analytics keeps scanning (the initial segmentation stays inline)
+    boot.execute("SET GLOBAL tidb_tpu_segment_delta_rows = 1024")
+    counts = load_tpch(cat, sf=sf, native=False)
+    base_rows = counts["lineitem"]
+    li = cat.table("test", "lineitem")
+    ins_cols = list(li.insertable_names())
+    q18_shape = (
+        "select o_orderkey, sum(l_quantity) as q from lineitem "
+        "join orders on l_orderkey = o_orderkey "
+        "group by o_orderkey order by q desc, o_orderkey limit 10")
+
     sched = StatementScheduler(cat, workers=4)
     sessions = [Session(catalog=cat) for _ in range(n_clients)]
-    sids = [s.prepare(point_tmpl)[0] for s in sessions]
-    sched.submit_prepared(sessions[0], sids[0], [0])
-    barrier = threading.Barrier(n_clients + 1)
+    committed = [0]          # rows committed (monotone, under lock)
+    commit_lock = threading.Lock()
+    barrier = threading.Barrier(n_clients + 2)
+    stop = threading.Event()
+    key_base = 10_000_000    # ingested l_orderkey = key_base + seq
+    seq_src = iter(range(1, 1 << 30))
+    seq_lock = threading.Lock()
 
-    def mixed(ci):
-        sess, sid = sessions[ci], sids[ci]
-        barrier.wait()
-        for i in range(iters):
-            k = key_of(ci, i)
-            if i % 10 == 9:
-                sched.submit_query(
-                    sess, f"update sbtest set k = k + 1 where id = {k}")
+    def ingest_row(seq):
+        vals = []
+        for cname in ins_cols:
+            if cname == "l_orderkey":
+                vals.append(str(key_base + seq))
+            elif cname == "l_quantity":
+                vals.append(str(1 + seq % 50))
+            elif cname == "l_extendedprice":
+                vals.append(str(900 + seq % 1000))
+            elif cname == "l_discount":
+                vals.append(f"0.0{seq % 10}")
+            elif cname == "l_shipdate":
+                vals.append(f"'1994-0{1 + seq % 6}-15'")
             else:
-                sched.submit_prepared(sess, sid, [k])
+                from tidb_tpu.types import TypeKind as _TK
 
-    threads = [threading.Thread(target=mixed, args=(ci,))
+                c = li.schema.col(cname)
+                if c.type_.is_dict_encoded:
+                    vals.append("'x'")
+                elif c.type_.kind in (_TK.DATE, _TK.DATETIME):
+                    vals.append("'1995-01-01'")
+                else:
+                    vals.append("0")
+        return ("insert into lineitem (" + ", ".join(ins_cols)
+                + ") values (" + ", ".join(vals) + ")")
+
+    errs = []
+
+    def oltp(ci):
+        sess = sessions[ci]
+        barrier.wait()
+        for _ in range(ingest_iters):
+            with seq_lock:
+                seq = next(seq_src)
+            try:
+                sched.submit_query(sess, ingest_row(seq))
+                with commit_lock:
+                    committed[0] += 1
+            except Exception as e:  # noqa: BLE001 — reported below
+                errs.append(f"{type(e).__name__}: {e}"[:200])
+        stop.set()  # first finisher ends the analytics loop's tail
+
+    lat, staleness_rows = [], []
+    ana_sess = Session(catalog=cat)
+
+    def analytics():
+        barrier.wait()
+        i = 0
+        while True:
+            with commit_lock:
+                c_before = committed[0]
+            sql = Q["q6"][0] if i % 2 == 0 else q18_shape
+            t0 = time.perf_counter()
+            sched.submit_query(ana_sess, sql)
+            lat.append(time.perf_counter() - t0)
+            seen = ana_sess.query(
+                "select count(*) as n from lineitem "
+                f"where l_orderkey >= {key_base}")[0][0]
+            staleness_rows.append(max(0, c_before - seen))
+            i += 1
+            if i >= analytics_iters and stop.is_set():
+                break
+
+    cmp0 = {o: _M.COMPACTION_TOTAL.value(outcome=o)
+            for o in ("background", "inline", "inline_fallback",
+                      "discarded", "failed")}
+    dml_hist0 = list(next(
+        (c for _l, c, _s, _e in _M.DML_BATCH_SIZE.samples()), [])) or None
+    threads = [threading.Thread(target=oltp, args=(ci,))
                for ci in range(n_clients)]
+    ana = threading.Thread(target=analytics)
     for t in threads:
         t.start()
+    ana.start()
     barrier.wait()
     t0 = time.perf_counter()
     for t in threads:
         t.join()
-    wall = time.perf_counter() - t0
-    adm = sched.stats_dict()
+    oltp_wall = time.perf_counter() - t0
+    ana.join()
+    ana_wall = time.perf_counter() - t0
     sched.shutdown()
-    out["mixed_90_10_stmts_per_sec"] = round(n_clients * iters / wall, 1)
-    out["admission"] = {k: adm[k] for k in
-                        ("admitted", "rejected", "timed_out")}
-    log(f"# oltp mixed 90/10 at {n_clients} clients: "
-        f"{out['mixed_90_10_stmts_per_sec']}/s admission={out['admission']}")
+    compaction = {o: _M.COMPACTION_TOTAL.value(outcome=o) - v
+                  for o, v in cmp0.items()}
+    dml_hist1 = list(next(
+        (c for _l, c, _s, _e in _M.DML_BATCH_SIZE.samples()), []))
+    dml_hist = (dml_hist1 if dml_hist0 is None
+                else [a - b for a, b in zip(dml_hist1, dml_hist0)])
+    # flag-off byte-identical: the compaction worker must never have
+    # changed WHAT a scan returns, only where the rebuild ran
+    chk = Session(catalog=cat)
+    chk.execute("SET tidb_tpu_compaction = 0")
+    off_rows = chk.query(Q["q6"][0])
+    chk.execute("SET tidb_tpu_compaction = 1")
+    on_rows = chk.query(Q["q6"][0])
+    lats = sorted(lat)
+    out = {
+        "sf": sf,
+        "base_rows": base_rows,
+        "ingest_clients": n_clients,
+        "ingested_rows": committed[0],
+        "ingest_errors": errs[:3],
+        "htap_oltp_stmts_per_sec": round(committed[0] / oltp_wall, 1),
+        "analytics_queries": len(lat),
+        "htap_analytics_qps": round(len(lat) / max(ana_wall, 1e-9), 2),
+        "analytics_p50_ms": round(lats[len(lats) // 2] * 1e3, 1),
+        "analytics_p99_ms": round(
+            lats[max(0, int(len(lats) * 0.99) - 1)] * 1e3, 1),
+        "staleness_rows_max": max(staleness_rows) if staleness_rows else 0,
+        "compaction": compaction,
+        "dml_batch_hist": {
+            str(b): int(c) for b, c in
+            zip(list(_M.DML_BATCH_SIZE.buckets) + ["+Inf"], dml_hist)
+            if c},
+        "flag_off_equal": repr(off_rows) == repr(on_rows),
+    }
+    log(f"# htap: ingest={out['htap_oltp_stmts_per_sec']}/s "
+        f"analytics={out['htap_analytics_qps']}/s "
+        f"p99={out['analytics_p99_ms']}ms "
+        f"staleness<={out['staleness_rows_max']} rows "
+        f"compaction={compaction} flag_off_equal={out['flag_off_equal']}")
+    if extra is not None:
+        extra["htap"] = out
     return out
 
 
@@ -1656,6 +1929,22 @@ def main(locked_detail=("acquired", "acquired")):
         extra["oltp"] = bench_oltp(extra)
     except Exception as e:  # noqa: BLE001
         extra["oltp_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # mixed 90/10 with group-commit DML (ISSUE 17): window on vs off on
+    # fresh catalogs, serial-oracle state-hash checked every run
+    try:
+        log("# mixed 90/10 group-commit bench")
+        bench_mixed(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["mixed_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # HTAP: analytics during sustained ingest with background
+    # compaction ON (ISSUE 17), staleness + p99 + flag-off equality
+    try:
+        log("# htap bench")
+        bench_htap(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["htap_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # sharded scale-out capture (ISSUE 13): same scan-agg at 1/2/4
     # workers over SHARD BY placement -> MULTICHIP_r06.json

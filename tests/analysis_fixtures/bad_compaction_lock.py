@@ -1,0 +1,33 @@
+"""Fixture: compaction-worker-shaped blocking bugs — the encoded
+segment rebuild (spill-file save + np.save) run while HOLDING the
+store lock, exactly the stall the background worker exists to avoid;
+the blocking-under-lock pass must flag both I/O sites. The sanctioned
+protocol — snapshot under the lock, build outside every lock, cut
+over with a pointer swap — must stay clean."""
+
+import threading
+
+import numpy as np
+
+
+class BadCompactor:
+    def __init__(self):
+        self.store_lock = threading.Lock()
+        self.delta = []
+        self.segments = []
+
+    def rebuild_under_lock(self, spill):
+        with self.store_lock:
+            rows = list(self.delta)
+            spill.save(rows)            # BAD: spill I/O under the store lock
+            np.save("/tmp/seg", rows)   # BAD: encode I/O under the store lock
+            self.segments = [rows]
+
+    def snapshot_then_rebuild(self, spill):
+        with self.store_lock:
+            rows = list(self.delta)     # ok: snapshot is pure host work
+        spill.save(rows)                # ok: build runs outside every lock
+        built = np.asarray(rows)
+        with self.store_lock:
+            self.segments = [built]     # ok: cutover is a pointer swap
+        return built

@@ -298,6 +298,37 @@ class TestColumnarScope:
         assert any("batcher" in m for m in DEFAULT_MODULES)
         assert any("scheduler" in m for m in DEFAULT_MODULES)
 
+    def test_compaction_in_both_lock_rosters(self):
+        """ISSUE 17: the background compaction worker is governed by
+        the same lock discipline as the store it rebuilds for."""
+        from tidb_tpu.analysis.blocking_under_lock import (
+            DEFAULT_MODULES as BLOCK_MODULES,
+        )
+        from tidb_tpu.analysis.lock_discipline import (
+            DEFAULT_MODULES as LOCK_MODULES,
+        )
+
+        assert "tidb_tpu/columnar/compaction.py" in BLOCK_MODULES
+        assert "tidb_tpu/columnar/compaction.py" in LOCK_MODULES
+
+    def test_compaction_rebuild_under_lock_flagged(self, tmp_path):
+        """The fixture's rebuild-I/O-under-the-store-lock sites are
+        flagged; the snapshot/build-outside/cutover protocol the real
+        worker follows stays clean."""
+        root = _mini_root(tmp_path, ("columnar", "bad_compaction_lock.py"))
+        p = BlockingUnderLockPass(
+            modules=("tidb_tpu/columnar/bad_compaction_lock.py",))
+        rep, _ = _run_pass(root, p)
+        hits = [v for v in rep.violations
+                if "store_lock" in v.message]
+        assert len(hits) == 2, [v.render() for v in rep.violations]
+        assert any("spill.save" in v.message for v in hits)
+        assert any("np.save" in v.message for v in hits)
+        # both BAD sites live in rebuild_under_lock; the sanctioned
+        # snapshot/build-outside/cutover function below stays clean
+        assert len(rep.violations) == 2, \
+            [v.render() for v in rep.violations]
+
     def test_real_modules_use_the_locked_suffix_convention(self):
         """The convention the pass leans on must hold: *_locked methods
         exist in dcn.py (documentation that the heuristic is live)."""

@@ -1,0 +1,461 @@
+"""Write path (ISSUE 17): group-commit DML coalescing + background
+delta->segment compaction.
+
+Covers the ISSUE's test checklist: N-client group-commit exactness
+against a serial oracle (interleaved inserts/updates/deletes), dup-key
+conflicts isolated to their member, KILL / deadline landing mid-window,
+explicit-txn / autocommit=0 sessions bypassing the window, sharded
+writes riding ONE 2PC prepare round per window (armed-failpoint round
+count), and compaction chaos: a failing background rebuild, a scan
+racing the cutover, worker death degrading typed to the inline path,
+zero leaked pins/segments, and a sanitized run staying clean.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.analysis import sanitizer as san
+from tidb_tpu.errors import (
+    ExecutionError,
+    QueryKilledError,
+    QueryTimeoutError,
+)
+from tidb_tpu.serving import StatementScheduler
+from tidb_tpu.session import Session
+from tidb_tpu.storage.catalog import Catalog
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import failpoint, hits
+from tidb_tpu.utils.memory import MemTracker
+
+N_ROWS = 100
+
+
+def make_cat(**globals_):
+    cat = Catalog()
+    boot = Session(catalog=cat)
+    boot.execute("set global tidb_slow_log_threshold = 300000")
+    boot.execute("set global tidb_trace_sample_rate = 0")
+    for k, v in globals_.items():
+        boot.execute(f"set global {k} = {v}")
+    boot.execute(
+        "create table t (id bigint primary key, k bigint, c varchar(32))")
+    boot.execute("insert into t values " + ",".join(
+        f"({i},{i % 7},'c-{i:05d}')" for i in range(N_ROWS)))
+    boot.execute("analyze table t")
+    return cat, boot
+
+
+def run_write_clients(sched, cat, n_clients, stmts_of):
+    """N client threads each submitting its statement list through the
+    scheduler's text path; returns (sessions, per-client errors)."""
+    sessions = [Session(catalog=cat) for _ in range(n_clients)]
+    errors = [[] for _ in range(n_clients)]
+    barrier = threading.Barrier(n_clients)
+
+    def client(ci):
+        sess = sessions[ci]
+        barrier.wait()
+        for sql in stmts_of(ci):
+            try:
+                sched.submit_query(sess, sql)
+            except Exception as e:  # noqa: BLE001 — asserted by callers
+                errors[ci].append(e)
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sessions, errors
+
+
+def table_state(cat):
+    s = Session(catalog=cat)
+    return sorted(s.query("select id, k, c from t"))
+
+
+class TestGroupCommitExactness:
+    def test_n_clients_interleaved_match_serial_oracle(self):
+        """8 clients interleave point updates, inserts and deletes
+        through gathered group-commit windows; the final table state is
+        byte-identical to the same statement multiset applied serially,
+        and at least some statements actually coalesced."""
+        n_clients = 8
+
+        def stmts_of(ci):
+            out = []
+            for i in range(6):
+                out.append(f"update t set k = k + 1 "
+                           f"where id = {(ci * 11 + i * 5) % N_ROWS}")
+            rid = 1000 + ci
+            out.append(f"insert into t values ({rid}, {ci}, 'n-{ci}')")
+            out.append(f"delete from t where id = {900 + ci}")  # no row
+            return out
+
+        cat, _boot = make_cat(tidb_tpu_batch_window_us=20000,
+                              tidb_tpu_max_batch_size=8)
+        sched = StatementScheduler(cat, workers=4)
+        c0 = M.DML_BATCH_SIZE.count()
+        _sessions, errors = run_write_clients(sched, cat, n_clients,
+                                              stmts_of)
+        snap = sched.batcher.snapshot()
+        sched.shutdown()
+        assert not any(errors), errors
+
+        oracle_cat, _ob = make_cat()
+        os_ = Session(catalog=oracle_cat)
+        for ci in range(n_clients):
+            for sql in stmts_of(ci):
+                os_.execute(sql)
+        assert table_state(cat) == table_state(oracle_cat)
+        # the histogram observed every window; the run gathered SOME
+        # multi-member windows (timing-dependent how many)
+        assert M.DML_BATCH_SIZE.count() > c0
+        assert snap["coalesced_stmts"] > 0, snap
+
+    def test_coalesced_digest_reaches_scheduler_stats(self):
+        """A write window's digest surfaces in the per-digest coalesce
+        rows of information_schema.scheduler_stats, exactly like a read
+        batch's."""
+        cat, boot = make_cat(tidb_tpu_batch_window_us=200000,
+                             tidb_tpu_max_batch_size=4)
+        sched = StatementScheduler(cat, workers=2)
+        sessions = [Session(catalog=cat) for _ in range(4)]
+        members = [
+            sched.batcher.try_join_dml(
+                s, f"update t set k = k + 1 where id = {i}", None)
+            for i, s in enumerate(sessions)]
+        assert all(m is not None for m in members)
+        for m in members:
+            assert m.done.wait(10)
+            assert m.exc is None, m.exc
+        srows = boot.query(
+            "select * from information_schema.scheduler_stats")
+        assert any(r[1] != "" and r[9] >= 4 for r in srows), srows
+        snap = sched.batcher.snapshot()
+        assert snap["coalesced_stmts"] >= 4
+        assert any(v >= 4 for v in snap["coalesce_by_digest"].values())
+        sched.shutdown()
+
+
+class TestConflictsAndFallback:
+    def test_duplicate_key_insert_first_wins_rest_typed(self):
+        """Four members of one window insert the same primary key: the
+        merged pass fails, every member re-executes singleton-style,
+        exactly one succeeds and the rest get the typed duplicate-entry
+        error — serial semantics, member-exact."""
+        cat, _boot = make_cat(tidb_tpu_batch_window_us=200000,
+                              tidb_tpu_max_batch_size=4)
+        sched = StatementScheduler(cat, workers=2)
+        sessions = [Session(catalog=cat) for _ in range(4)]
+        members = [
+            sched.batcher.try_join_dml(
+                s, "insert into t values (5000, 1, 'dup')", None)
+            for s in sessions]
+        assert all(m is not None for m in members)
+        for m in members:
+            assert m.done.wait(10)
+        ok = [m for m in members if m.exc is None]
+        bad = [m for m in members if m.exc is not None]
+        assert len(ok) == 1 and len(bad) == 3, [m.exc for m in members]
+        for m in bad:
+            assert isinstance(m.exc, ExecutionError)
+            assert "duplicate entry" in str(m.exc).lower()
+        s = Session(catalog=cat)
+        assert s.query("select count(*) from t where id = 5000") == [(1,)]
+        sched.shutdown()
+
+    def test_same_row_updates_fall_back_serial_exact(self):
+        """Members of one window bump the SAME row: k = k + 1 six times
+        must add 6, not 1 — the merged pass detects the duplicate
+        target and the group re-executes singleton-style."""
+        cat, _boot = make_cat(tidb_tpu_batch_window_us=20000,
+                              tidb_tpu_max_batch_size=8)
+        sched = StatementScheduler(cat, workers=4)
+        k0 = Session(catalog=cat).query(
+            "select k from t where id = 5")[0][0]
+        _sessions, errors = run_write_clients(
+            sched, cat, 6, lambda ci: ["update t set k = k + 1 "
+                                       "where id = 5"])
+        sched.shutdown()
+        assert not any(errors), errors
+        s = Session(catalog=cat)
+        assert s.query("select k from t where id = 5") == [(k0 + 6,)]
+
+    def test_open_txn_and_autocommit0_bypass_window(self):
+        """A session inside BEGIN (or with autocommit=0) owns its
+        commit point: the probe refuses, the statement runs singleton,
+        and ROLLBACK undoes it."""
+        cat, _boot = make_cat(tidb_tpu_batch_window_us=200000)
+        sched = StatementScheduler(cat, workers=2)
+        s = Session(catalog=cat)
+        s.execute("begin")
+        assert s.dml_batch_probe(
+            "update t set k = k + 1 where id = 7") is None
+        sched.submit_query(s, "update t set k = k + 1 where id = 7")
+        s.execute("rollback")
+        assert Session(catalog=cat).query(
+            "select k from t where id = 7") == [(7 % 7,)]
+        s2 = Session(catalog=cat)
+        s2.execute("set autocommit = 0")
+        assert s2.dml_batch_probe(
+            "update t set k = k + 1 where id = 7") is None
+        sched.shutdown()
+
+
+class TestKillDeadlineMidWindow:
+    def test_killed_member_excluded_write_not_applied(self):
+        """KILL QUERY lands while the write window gathers: the killed
+        member raises typed, its row is untouched, and its batchmates'
+        writes apply."""
+        cat, boot = make_cat(tidb_tpu_batch_window_us=300000,
+                             tidb_tpu_max_batch_size=3)
+        sched = StatementScheduler(cat, workers=2)
+        sa, sb, sc = (Session(catalog=cat) for _ in range(3))
+        ma = sched.batcher.try_join_dml(
+            sa, "update t set k = k + 1 where id = 10", None)
+        mb = sched.batcher.try_join_dml(
+            sb, "update t set k = k + 1 where id = 11", None)
+        assert ma is not None and mb is not None
+        boot.execute(f"kill query {sa.conn_id}")
+        mc = sched.batcher.try_join_dml(
+            sc, "update t set k = k + 1 where id = 12", None)  # seals
+        assert mc is not None
+        for m in (ma, mb, mc):
+            assert m.done.wait(10)
+        assert isinstance(ma.exc, QueryKilledError)
+        assert mb.exc is None and mc.exc is None
+        s = Session(catalog=cat)
+        assert s.query("select k from t where id = 10") == [(10 % 7,)]
+        assert s.query("select k from t where id = 11") == [(11 % 7 + 1,)]
+        assert s.query("select k from t where id = 12") == [(12 % 7 + 1,)]
+        # one-shot: the killed session keeps writing
+        sched.submit_query(sa, "update t set k = k + 1 where id = 10")
+        assert s.query("select k from t where id = 10") == [(10 % 7 + 1,)]
+        sched.shutdown()
+
+    def test_deadline_expired_member_typed_timeout(self):
+        cat, _boot = make_cat(tidb_tpu_batch_window_us=300000,
+                              tidb_tpu_max_batch_size=2)
+        sched = StatementScheduler(cat, workers=2)
+        sa, sb = Session(catalog=cat), Session(catalog=cat)
+        expired = time.monotonic() - 0.01
+        ma = sched.batcher.try_join_dml(
+            sa, "update t set k = k + 1 where id = 20", expired)
+        mb = sched.batcher.try_join_dml(
+            sb, "update t set k = k + 1 where id = 21", None)  # seals
+        assert ma is not None and mb is not None
+        for m in (ma, mb):
+            assert m.done.wait(10)
+        assert isinstance(ma.exc, QueryTimeoutError)
+        assert mb.exc is None
+        s = Session(catalog=cat)
+        assert s.query("select k from t where id = 20") == [(20 % 7,)]
+        assert s.query("select k from t where id = 21") == [(21 % 7 + 1,)]
+        sched.shutdown()
+
+
+class TestSharded2PCWindow:
+    def test_window_is_one_prepare_round_per_shard(self):
+        """8 concurrent execute_dml writes inside one Cluster window
+        ride exactly ONE 2PC prepare round (armed-failpoint hit count),
+        and every row lands."""
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        workers = [Worker() for _ in range(2)]
+        for w in workers:
+            threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                     rpc_timeout_s=15.0, connect_timeout_s=5.0)
+        try:
+            cl.ddl("create table f (k bigint, v bigint) "
+                   "shard by hash(k) shards 4")
+            cl.load_sharded("f", arrays={
+                "k": np.arange(8, dtype=np.int64),
+                "v": np.zeros(8, dtype=np.int64)})
+            cl.dml_window_us = 200000
+            n = 8
+            barrier = threading.Barrier(n)
+            errors = []
+
+            def client(i):
+                barrier.wait()
+                try:
+                    res = cl.execute_dml(
+                        f"insert into f values ({100 + i}, {i * 10})")
+                    assert res["workers"], res
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    errors.append(e)
+
+            with failpoint("2pc.prepare", action=lambda: None):
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(n)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                rounds = hits("2pc.prepare")
+            assert not errors, errors
+            assert rounds == 1, f"expected ONE merged round, got {rounds}"
+            assert cl._dml_window.windows == 1
+            assert cl._dml_window.coalesced_stmts == n
+            got = cl.query("select count(*) as n, sum(v) as s from f "
+                           "where k >= 100")
+            assert tuple(map(int, got[0])) == (n, sum(i * 10
+                                                      for i in range(n)))
+        finally:
+            cl.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# compaction chaos
+# ---------------------------------------------------------------------------
+
+
+def _mk_store(n=4096, seg_rows=1024, delta_rows=1024):
+    from tidb_tpu.columnar.store import store_for
+
+    s = Session()
+    # pin the session's columnar config to the store's: a query's scan
+    # re-applies the session values through store_for (delta_rows
+    # follows the latest caller), which would otherwise undo ours
+    s.execute(f"set tidb_tpu_segment_rows = {seg_rows}")
+    s.execute(f"set tidb_tpu_segment_delta_rows = {delta_rows}")
+    s.execute("create table p (a int, b int)")
+    t = s.catalog.table("test", "p")
+    t.insert_columns({"a": np.arange(n, dtype=np.int64),
+                      "b": np.arange(n, dtype=np.int64) % 7})
+    store = store_for(t, segment_rows=seg_rows, delta_rows=delta_rows,
+                      compaction=True)
+    store.refresh(force=True)
+    assert store.segments
+    return s, t, store
+
+
+def _append_delta(t, n0, count):
+    t.insert_columns({"a": np.arange(n0, n0 + count, dtype=np.int64),
+                      "b": np.zeros(count, dtype=np.int64)})
+
+
+@pytest.fixture()
+def fresh_worker():
+    from tidb_tpu.columnar import compaction
+
+    compaction.reset_for_tests()
+    yield
+    compaction.reset_for_tests()
+
+
+class TestCompactionChaos:
+    def test_background_rebuild_installs_and_counts(self, fresh_worker):
+        from tidb_tpu.columnar.compaction import default_worker
+
+        s, t, store = _mk_store()
+        b0 = M.COMPACTION_TOTAL.value(outcome="background")
+        _append_delta(t, 4096, 1024)
+        store.refresh()
+        assert store._compact_pending
+        assert default_worker().drain(10)
+        assert not store._compact_pending
+        assert M.COMPACTION_TOTAL.value(outcome="background") == b0 + 1
+        assert store.covered == 4096 + 1024
+        assert s.query("select count(*), sum(b) from p") == \
+            [(5120, sum(i % 7 for i in range(4096)))]
+
+    def test_rebuild_failpoint_fails_closed_data_exact(self, fresh_worker):
+        """compact.rebuild fires inside the background build: the job
+        counts as failed, the pending mark clears (no wedged store),
+        and scans stay exact off the raw-merge delta."""
+        from tidb_tpu.columnar.compaction import default_worker
+
+        s, t, store = _mk_store()
+        f0 = M.COMPACTION_TOTAL.value(outcome="failed")
+        _append_delta(t, 4096, 1024)
+        with failpoint("compact.rebuild", times=1):
+            store.refresh()
+            assert default_worker().drain(10)
+        assert M.COMPACTION_TOTAL.value(outcome="failed") == f0 + 1
+        assert not store._compact_pending
+        assert store.covered == 4096  # nothing installed
+        assert s.query("select count(*) from p") == [(5120,)]
+        # the NEXT refresh re-requests and succeeds
+        store.refresh()
+        assert default_worker().drain(10)
+        assert store.covered == 5120
+
+    def test_scan_racing_cutover_keeps_retired_segment(self, fresh_worker):
+        """A scan plans (and references) the trailing partial segment,
+        then the background cutover retires it: the segment must stay
+        alive until the pin closes, then free with zero leaks."""
+        from tidb_tpu.columnar.compaction import default_worker
+        from tidb_tpu.columnar.store import ScanPin
+
+        _s, t, store = _mk_store(n=4096 + 512)  # trailing partial: 512
+        assert store.segments[-1].rows < store.segment_rows
+        tracker = MemTracker("stmt", spill_root=True)
+        pin = ScanPin(store, tracker)
+        segs, _pruned, _cov = store.plan_scan([], pin=pin)
+        partial = store.segments[-1]
+        assert partial in segs and partial.refs >= 1
+        _append_delta(t, 4096 + 512, 1024)
+        store.refresh()
+        assert default_worker().drain(10)
+        # cutover installed full segments; the planned partial retired
+        # but survives the race because the pin still references it
+        assert partial not in store.segments
+        assert partial.retired and partial in store._retired
+        assert partial.data is not None
+        pin.close()
+        assert partial not in store._retired
+        assert all(seg.refs == 0 and seg.pins == 0
+                   for seg in store.segments)
+        assert store.covered == 4096 + 512 + 1024
+
+    def test_worker_death_degrades_inline_typed(self, fresh_worker):
+        """A dead worker refuses the job; the store rebuilds inline on
+        the statement path, counted as inline_fallback — same bytes,
+        same data, no silent loss."""
+        from tidb_tpu.columnar import compaction
+
+        s, t, store = _mk_store()
+        compaction.default_worker().stop()  # the worker "dies"
+        i0 = M.COMPACTION_TOTAL.value(outcome="inline_fallback")
+        _append_delta(t, 4096, 1024)
+        store.refresh()
+        assert not store._compact_pending
+        assert M.COMPACTION_TOTAL.value(outcome="inline_fallback") == i0 + 1
+        assert store.covered == 5120  # rebuilt inline, immediately
+        assert s.query("select count(*) from p") == [(5120,)]
+
+    def test_sanitized_compaction_run_is_clean(self, fresh_worker):
+        """A scan pinned across a background cutover, closed properly,
+        leaves no sanitizer findings: no leaked pins, no tracker
+        residue, every retired segment freed."""
+        from tidb_tpu.columnar.compaction import default_worker
+        from tidb_tpu.columnar.store import ScanPin
+
+        _s, t, store = _mk_store()
+        san.enable()
+        try:
+            scope = san.statement_begin()
+            tracker = MemTracker("stmt", spill_root=True)
+            pin = ScanPin(store, tracker)
+            segs, _p, _c = store.plan_scan([], pin=pin)
+            _append_delta(t, 4096, 1024)
+            store.refresh()
+            assert default_worker().drain(10)
+            for seg in segs:
+                pin.touch(seg)
+            pin.close()
+            tracker.detach()
+            out = san.statement_end(scope)
+        finally:
+            san.disable()
+        fatal = [f for f in out if f.fatal]
+        assert not fatal, fatal
+        assert all(seg.pins == 0 for seg in store.segments)
+        assert not store._retired

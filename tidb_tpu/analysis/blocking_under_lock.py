@@ -63,6 +63,11 @@ DEFAULT_MODULES = (
     # latency SLOs (ISSUE 16): same leaf contract — the metric gauge
     # updates and eviction cleanup run after the lock is released
     "tidb_tpu/serving/slo.py",
+    # background compaction (ISSUE 17): the whole point of the worker
+    # is rebuild-outside-locks — encode/spill I/O under the store or
+    # queue lock would stall every scan behind the rebuild it exists
+    # to hide (fixture: bad_compaction_lock.py)
+    "tidb_tpu/columnar/compaction.py",
 )
 
 # attribute names whose call blocks the thread

@@ -58,6 +58,10 @@ DEFAULT_MODULES = (
     # latency SLOs (ISSUE 16): the digest-latency store's leaf lock
     # guards windows folded at statement end and read at admission
     "tidb_tpu/serving/slo.py",
+    # background compaction (ISSUE 17): the worker queue lock is a
+    # LEAF under the store lock; snapshot/cutover take the store lock
+    # only for pointer swaps — the segment build itself runs unlocked
+    "tidb_tpu/columnar/compaction.py",
 )
 
 # NOTE: the serving-tier wait-discipline check (ISSUE 7) moved to

@@ -43,7 +43,7 @@ from tidb_tpu.columnar.spillfile import SegmentSpillFile, make_spill_dir
 from tidb_tpu.columnar.zonemap import ZoneMap, build_zone_map, segment_pruned
 
 __all__ = ["Segment", "SegmentStore", "ScanPin", "store_for",
-           "build_for_result", "scan_counts"]
+           "build_for_result", "scan_counts", "compact_counts"]
 
 # smallest table (rows) that earns a store at all; matches the sysvar
 # floor so tiny unit-test tables stay on the raw path with zero overhead
@@ -72,6 +72,21 @@ def scan_counts() -> Tuple[int, int]:
     """Cumulative (scanned, pruned) on this thread; the session diffs
     around each statement for the slow log."""
     return (getattr(_tls, "scanned", 0), getattr(_tls, "pruned", 0))
+
+
+def _count_compact_wait(seconds: float, nbytes: int) -> None:
+    _tls.compact_wait = getattr(_tls, "compact_wait", 0.0) + seconds
+    _tls.compact_bytes = getattr(_tls, "compact_bytes", 0) + nbytes
+
+
+def compact_counts() -> Tuple[float, int]:
+    """Cumulative (inline rebuild wait seconds, rebuilt bytes) paid by
+    THIS thread's statements; the session diffs around each statement
+    so write-induced scan stalls surface as ``compaction_wait_ms`` in
+    EXPLAIN ANALYZE and the slow log instead of vanishing into scan
+    time (ISSUE 17)."""
+    return (getattr(_tls, "compact_wait", 0.0),
+            getattr(_tls, "compact_bytes", 0))
 
 
 class Segment:
@@ -152,6 +167,11 @@ class SegmentStore:
         self.covered = 0
         self.built_epoch = getattr(table, "data_epoch", 0)
         self.generation = 0          # bumps on every full rebuild
+        # background compaction (ISSUE 17): follows the latest caller's
+        # tidb_tpu_compaction through store_for; while a job is pending
+        # the non-force refresh keeps serving the current generation
+        self.compaction_on = False
+        self._compact_pending = False
         self._touch_seq = 0
         self._seg_seq = 0            # unique per segment: spill file tags
         self._tmp: Optional[str] = None
@@ -188,7 +208,11 @@ class SegmentStore:
         self.generation += 1
         self._stats_view = None
 
-    def _refresh_locked(self, force: bool = False) -> None:
+    def _refresh_locked(self, force: bool = False) -> Tuple[bool, int]:
+        """Returns ``(want_background, inline_bytes_built)``. The
+        background request is only DECIDED here; the caller submits it
+        to the worker AFTER releasing the lock, so the store lock stays
+        a leaf and never blocks on the worker's queue."""
         t = self.table
         epoch = getattr(t, "data_epoch", 0)
         if epoch != self.built_epoch:
@@ -196,11 +220,34 @@ class SegmentStore:
             self.built_epoch = epoch
         tail = t.n - self.covered
         if tail <= 0:
-            return
+            return False, 0
         if not force and self.covered > 0 and tail < max(self.delta_rows, 1):
-            return  # small delta: stays on the raw merge path
+            return False, 0  # small delta: stays on the raw merge path
         if not force and self.covered == 0 and t.n < self.segment_rows:
-            return
+            return False, 0
+        if not force and self.compaction_on and self.covered > 0:
+            # background path (ISSUE 17): scans keep serving the
+            # current generation + raw-merge delta while the worker
+            # folds the delta in; a pending job suppresses re-requests.
+            # Only DELTA folding defers — the initial segmentation
+            # (covered == 0) still builds inline so the first scan of a
+            # table sees encoded, zone-mapped segments, exactly as with
+            # compaction off
+            if self._compact_pending:
+                return False, 0
+            self._compact_pending = True
+            return True, 0
+        return False, self._inline_rebuild_locked()
+
+    def _inline_rebuild_locked(self) -> int:
+        """Today's statement-path rebuild; returns encoded bytes built
+        (charged to the scanning statement by plan_scan) and records
+        the wall time on the thread's compaction-wait counter."""
+        import time as _time
+
+        t = self.table
+        t0 = _time.perf_counter()
+        built = 0
         # the trailing partial segment (if any) re-builds at full size
         if self.segments and self.segments[-1].rows < self.segment_rows:
             last = self.segments.pop()
@@ -213,11 +260,43 @@ class SegmentStore:
             self._seg_seq += 1
             self.segments.append(seg)
             self.covered = e
+            built += seg.nbytes
         self._stats_view = None
+        _count_compact_wait(_time.perf_counter() - t0, built)
+        return built
+
+    @staticmethod
+    def _note_inline(built: int, outcome: str = "inline") -> None:
+        """Metric side of an inline rebuild — called with the store
+        lock RELEASED (the counter has its own lock; keep the store
+        lock a leaf)."""
+        if built <= 0:
+            return
+        from tidb_tpu.utils.metrics import (
+            COMPACTION_BYTES,
+            COMPACTION_TOTAL,
+        )
+
+        COMPACTION_TOTAL.inc(outcome=outcome)
+        COMPACTION_BYTES.inc(built)
+
+    def compact_inline_fallback(self) -> None:
+        """Backpressure degradation (worker queue full / worker dead):
+        clear the pending mark and rebuild inline on the statement
+        path, exactly as with tidb_tpu_compaction=0 — typed, counted."""
+        with self._lock:
+            self._compact_pending = False
+            built = self._inline_rebuild_locked()
+        self._note_inline(built, outcome="inline_fallback")
 
     def refresh(self, force: bool = False) -> None:
         with self._lock:
-            self._refresh_locked(force=force)
+            want, built = self._refresh_locked(force=force)
+        self._note_inline(built)
+        if want:
+            from tidb_tpu.columnar.compaction import submit
+
+            submit(self)
 
     # -- scan planning -----------------------------------------------------
 
@@ -229,7 +308,7 @@ class SegmentStore:
         until the pin closes. Counts flow to the engine metrics and the
         per-thread statement counters."""
         with self._lock:
-            self._refresh_locked()
+            want, built = self._refresh_locked()
             segs = list(self.segments)
             covered = self.covered
             if pin is not None:
@@ -239,6 +318,31 @@ class SegmentStore:
                     # ScanPin.close() -> release_planned drops them all
                     s.refs += 1
                 pin.planned.extend(segs)
+        self._note_inline(built)
+        if want:
+            from tidb_tpu.columnar.compaction import submit
+
+            submit(self)
+        if built and pin is not None:
+            # the inline rebuild ran under THIS statement's budget:
+            # charge the encoded bytes transiently so the stall is
+            # attributable (max_mem, OOM actions); the resident bytes
+            # themselves are charged per segment on touch
+            from tidb_tpu.utils.memory import QueryOOMError
+
+            try:
+                pin.tracker.consume(built)
+            except QueryOOMError:
+                # attribution, not admission control: the built bytes
+                # are store-resident and shared across statements, so
+                # the statement cannot shed them — consume() already
+                # spilled what it could and recorded the peak, which is
+                # all this transient charge is for
+                pass
+            finally:
+                # consume() records the charge BEFORE the budget check
+                # can raise OOM, so the release must run even then
+                pin.tracker.release(built)
         if bounds:
             kept = [s for s in segs if not segment_pruned(s.zmaps, bounds)]
         else:
@@ -504,13 +608,14 @@ def _base_of(table):
 
 def store_for(table, segment_rows: int, delta_rows: Optional[int] = None,
               spill_dir: Optional[str] = None,
-              min_rows: Optional[int] = None) -> Optional[SegmentStore]:
+              min_rows: Optional[int] = None,
+              compaction: Optional[bool] = None) -> Optional[SegmentStore]:
     """The table's segment store, creating it on first use once the
     table has at least `min_rows` (default: one segment) of rows.
     Returns None for engines without `data_epoch` (foreign table
     objects) and for small tables. The first creator's `segment_rows`
-    wins for the store's lifetime; `delta_rows`/`spill_dir` follow the
-    latest caller."""
+    wins for the store's lifetime; `delta_rows`/`spill_dir`/
+    `compaction` follow the latest caller."""
     base = _base_of(table)
     if getattr(base, "data_epoch", None) is None:
         return None
@@ -529,6 +634,8 @@ def store_for(table, segment_rows: int, delta_rows: Optional[int] = None,
         store.delta_rows = max(int(delta_rows), 1)
     if spill_dir:
         store.spill_dir = spill_dir
+    if compaction is not None:
+        store.compaction_on = bool(compaction)
     return store
 
 

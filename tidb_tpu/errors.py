@@ -114,6 +114,17 @@ class SLOShedError(AdmissionRejectedError):
     code = 9008  # the same busy class: back off and retry
 
 
+class TwoPhaseCommitIncomplete(ExecutionError):
+    """A distributed transaction passed its commit point (the decision
+    is durably recorded) but one or more participants missed the COMMIT
+    message. The writes ARE committed — recover_txns() re-drives the
+    commit idempotently. Callers must NOT retry the statement: it would
+    double-apply. Distinguished from pre-decision failures (plain
+    ExecutionError), where every shard aborted and a retry is safe."""
+
+    code = 1105  # ER_UNKNOWN_ERROR (operational; resolved by recovery)
+
+
 class SanitizerError(ExecutionError):
     """The runtime invariant sanitizer (tidb_tpu_sanitize, ISSUE 12)
     witnessed a broken engine invariant during this statement: a leaked

@@ -139,6 +139,10 @@ class ExecContext:
     # directory for spilled segment files (tidb_tpu_columnar_spill_dir;
     # empty = system tmp)
     columnar_spill_dir: str = ""
+    # background delta->segment compaction (ISSUE 17): delta-depth
+    # rebuilds run on a worker thread off the statement path instead of
+    # inline at the next scan (tidb_tpu_compaction)
+    compaction_enable: bool = True
     # pipelined device-resident execution (ISSUE 9): fuse eligible
     # scan->filter->project->partial-agg fragments into one jitted
     # program per chunk (tidb_tpu_pipeline_fuse)

@@ -425,7 +425,8 @@ class _StagedScanMixin:
             store = store_for(
                 table, segment_rows=ctx.segment_rows,
                 delta_rows=ctx.segment_delta_rows,
-                spill_dir=ctx.columnar_spill_dir or None)
+                spill_dir=ctx.columnar_spill_dir or None,
+                compaction=ctx.compaction_enable)
             if store is not None:
                 self._pin = ScanPin(store, ctx.mem_tracker)
                 segs, pruned, covered = store.plan_scan(

@@ -107,7 +107,8 @@ class TableScanExec(Executor):
         store = store_for(
             self.table, segment_rows=ctx.segment_rows,
             delta_rows=ctx.segment_delta_rows,
-            spill_dir=ctx.columnar_spill_dir or None)
+            spill_dir=ctx.columnar_spill_dir or None,
+            compaction=ctx.compaction_enable)
         if store is None:
             return 0
         # the pin exists BEFORE planning so every snapshot segment is

@@ -53,6 +53,7 @@ from tidb_tpu.errors import (
     ExecutionError,
     QueryKilledError,
     QueryTimeoutError,
+    TwoPhaseCommitIncomplete,
     UnsupportedError,
 )
 from tidb_tpu.parser import ast as A
@@ -732,7 +733,12 @@ class Worker:
                         f"pending; cannot prepare {xid}")
                 sess.execute("begin")
                 try:
-                    sess.execute(msg["sql"])
+                    # batched group-commit prepare (ISSUE 17): a window
+                    # of coalesced writes arrives as one `sqls` list and
+                    # stages inside ONE participant transaction; the
+                    # singleton `sql` form stays wire-compatible
+                    for one in (msg.get("sqls") or [msg["sql"]]):
+                        sess.execute(one)
                 except Exception:
                     try:
                         sess.execute("rollback")
@@ -1648,6 +1654,103 @@ class _LinkHealth:
         self.since = time.monotonic()
 
 
+class _DmlMember:
+    """One execute_dml call waiting inside a 2PC write window."""
+
+    __slots__ = ("per_worker", "done", "result", "exc")
+
+    def __init__(self, per_worker: Dict[int, str]):
+        self.per_worker = per_worker
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+
+
+class _DmlWindow:
+    """Cross-session group commit over the mesh (ISSUE 17): concurrent
+    execute_dml calls gather for ``Cluster.dml_window_us`` and ride ONE
+    prepare/decide/commit round per shard owner — each worker's prepare
+    carries the window's statements as a `sqls` list staged inside one
+    participant transaction.
+
+    Exactness mirrors the local batcher's fallback rule: a failure
+    BEFORE the commit decision aborted every shard, so the leader
+    re-drives each member's own write as a singleton round (exact typed
+    errors, no lost statements). A TwoPhaseCommitIncomplete happened
+    AFTER the decision — the writes are committed — so it propagates to
+    every member unretried (a retry would double-apply)."""
+
+    def __init__(self, cluster: "Cluster"):
+        self.cluster = cluster
+        self._lock = threading.Lock()
+        self._open: Optional[List[_DmlMember]] = None
+        self.windows = 0           # merged rounds executed (n >= 2)
+        self.coalesced_stmts = 0   # members of merged rounds
+
+    def submit(self, per_worker: Dict[int, str]) -> Dict[str, object]:
+        member = _DmlMember(per_worker)
+        with self._lock:
+            if self._open is not None:
+                self._open.append(member)
+                leader = False
+            else:
+                self._open = [member]
+                leader = True
+        if not leader:
+            member.done.wait()
+        else:
+            # the leader IS the gather clock: it sleeps out the window
+            # on its caller's thread (no worker pool on the coordinator)
+            time.sleep(self.cluster.dml_window_us / 1e6)
+            with self._lock:
+                members = self._open or [member]
+                self._open = None
+            self._run(members)
+        if member.exc is not None:
+            raise member.exc
+        return member.result
+
+    def _run(self, members: List[_DmlMember]) -> None:
+        cl = self.cluster
+        if len(members) == 1:
+            m = members[0]
+            try:
+                m.result = cl._two_phase(m.per_worker)
+            except BaseException as e:  # noqa: BLE001 — relayed
+                m.exc = e
+            m.done.set()
+            return
+        merged: Dict[int, List[str]] = {}
+        for m in members:
+            for w, sql in m.per_worker.items():
+                merged.setdefault(w, []).append(sql)
+        with self._lock:
+            self.windows += 1
+            self.coalesced_stmts += len(members)
+        try:
+            res = cl._two_phase(merged)
+        except TwoPhaseCommitIncomplete as e:
+            for m in members:
+                m.exc = e
+                m.done.set()
+            return
+        except Exception:  # noqa: BLE001 — every shard aborted; the
+            # members re-run alone for their exact typed errors
+            for m in members:
+                try:
+                    m.result = cl._two_phase(m.per_worker)
+                except BaseException as e:  # noqa: BLE001 — relayed
+                    m.exc = e
+                m.done.set()
+            return
+        for m in members:
+            # shared xid, member-specific participant list (the workers
+            # THIS write touched — what a singleton round would report)
+            m.result = {"xid": res["xid"],
+                        "workers": sorted(m.per_worker)}
+            m.done.set()
+
+
 class Cluster:
     """Coordinator-side handle on the worker fleet.
 
@@ -1720,6 +1823,11 @@ class Cluster:
         self._txn_pending: Dict[str, List[int]] = {}
         self._txn_decided: Dict[str, List[int]] = {}
         self._txn_lock = threading.Lock()
+        # group-commit write window (ISSUE 17): >0 gathers concurrent
+        # execute_dml calls for this many microseconds and two-phase-
+        # commits the whole window in ONE round per shard owner
+        self.dml_window_us = 0
+        self._dml_window = _DmlWindow(self)
         self._health: List[_LinkHealth] = [_LinkHealth() for _ in endpoints]
         # per-call RPC budget (deadline + timeout) travels thread-local
         # so _call keeps its monkeypatch-friendly (i, msg) signature
@@ -2262,6 +2370,8 @@ class Cluster:
         else:
             raise UnsupportedError(
                 "dcn dml handles INSERT ... VALUES / UPDATE / DELETE")
+        if self.dml_window_us > 0:
+            return self._dml_window.submit(per_worker)
         return self._two_phase(per_worker)
 
     def _route_insert(self, st) -> Dict[int, str]:
@@ -2299,12 +2409,15 @@ class Cluster:
                    + ", ".join(vals)
                 for w, vals in groups.items()}
 
-    def _two_phase(self, per_worker: Dict[int, str]) -> Dict[str, object]:
+    def _two_phase(self, per_worker: Dict[int, object]) -> Dict[str, object]:
         """PREPARE on every participant -> record the commit decision
         (the Percolator primary-write analogue; recover_txns() replays
         it) -> COMMIT everywhere. Failpoints 2pc.prepare / 2pc.commit
         sit on either side of the decision: a fault before it must
-        leave every shard aborted, after it committed — never mixed."""
+        leave every shard aborted, after it committed — never mixed.
+        A per-worker value may be a LIST of statements (a coalesced
+        write window, ISSUE 17): they stage inside one participant
+        transaction and the whole window costs one round per shard."""
         xid = f"x{os.getpid()}-{next(_TOKEN_SEQ)}"
         parts = sorted(per_worker)
         if not parts:
@@ -2315,8 +2428,13 @@ class Cluster:
         try:
             inject("2pc.prepare")
             for w in parts:
-                self._call(w, {"cmd": "txn_prepare", "xid": xid,
-                               "sql": per_worker[w]})
+                stmts = per_worker[w]
+                if isinstance(stmts, list):
+                    self._call(w, {"cmd": "txn_prepare", "xid": xid,
+                                   "sqls": stmts})
+                else:
+                    self._call(w, {"cmd": "txn_prepare", "xid": xid,
+                                   "sql": stmts})
                 prepared.append(w)
         except Exception:
             aborted_all = True
@@ -2345,7 +2463,9 @@ class Cluster:
             except Exception as e:  # noqa: BLE001 — keep decided entry
                 errs.append((w, e))
         if errs:
-            raise ExecutionError(
+            # typed: the decision IS recorded, so callers (the DML
+            # window especially) must never retry — that double-applies
+            raise TwoPhaseCommitIncomplete(
                 f"2pc commit {xid} incomplete on workers "
                 f"{[w for w, _ in errs]} ({errs[0][1]}); the decision "
                 "is recorded — recover_txns() finishes it")

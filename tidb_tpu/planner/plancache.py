@@ -59,7 +59,8 @@ from tidb_tpu.parser import ast as A
 __all__ = ["PlanCache", "PlanCacheEntry", "StmtInfo", "TemplateInfo",
            "analyze_statement", "analyze_template", "bind_template_params",
            "transform_literals", "make_sentinels", "build_entry",
-           "instantiate", "batchable_plan", "DEFAULT_CAPACITY"]
+           "instantiate", "batchable_plan", "batchable_dml",
+           "classify_dml", "DEFAULT_CAPACITY"]
 
 DEFAULT_CAPACITY = 256
 
@@ -629,6 +630,113 @@ def _batchable_reason(entry: PlanCacheEntry) -> str:
         if anchor not in ("key_values", "pushed_cond"):
             return f"param outside the access path ({anchor or '?'})"
     return ""
+
+
+def _literal_expr(e) -> bool:
+    """True when `e` evaluates to a constant from its text alone: a
+    literal, or a sign applied to a numeric literal. Deliberately
+    stricter than the binder's constant folding — functions (NOW()),
+    casts and variables bind fine on the singleton path but are refused
+    here so a group-committed member can never observe a different
+    evaluation context than its singleton execution would have."""
+    if isinstance(e, (A.ENum, A.EStr, A.ENull, A.EBool)):
+        return True
+    if isinstance(e, A.EUnary) and e.op in ("-", "+"):
+        return isinstance(e.arg, A.ENum)
+    return False
+
+
+def _point_where(stmt) -> Optional[Tuple[str, object]]:
+    """(column, literal value AST) for a WHERE of exactly `col = lit`
+    (either operand order); None for any other shape."""
+    w = getattr(stmt, "where", None)
+    if not isinstance(w, A.EBinary) or w.op != "=":
+        return None
+    name, lit = w.left, w.right
+    if _literal_expr(name) and isinstance(lit, A.EName):
+        name, lit = lit, name
+    if not isinstance(name, A.EName) or not _literal_expr(lit):
+        return None
+    tname = stmt.table.name.lower()
+    alias = (stmt.table.alias or stmt.table.name).lower()
+    if name.qualifier and name.qualifier.lower() not in (tname, alias):
+        return None
+    return name.name, lit
+
+
+def classify_dml(stmt) -> Tuple[str, Optional[dict]]:
+    """Structural half of the group-commit DML classifier (ISSUE 17):
+    ('', parts) when `stmt` has a shape the write batcher can coalesce,
+    else (reason, None). Schema-dependent gates (unique index on the
+    WHERE column, SET columns outside every index, value binding) run
+    in Session.dml_batch_probe, which owns the catalog.
+
+    Coalescible shapes — chosen so N members applied as ONE engine pass
+    inside one transaction are provably equal to N serial singletons:
+
+      * INSERT ... VALUES with purely literal rows (no SELECT source,
+        no REPLACE/ON DUPLICATE KEY — their conflict flows are
+        per-row-stateful);
+      * point UPDATE: single table, WHERE col = literal, every SET
+        value a literal or one ``col ± literal`` step over this table's
+        own columns (host-evaluable at the probed rows);
+      * point DELETE: single table, WHERE col = literal.
+    """
+    if isinstance(stmt, A.InsertStmt):
+        if stmt.select is not None:
+            return "INSERT ... SELECT", None
+        if stmt.replace or stmt.on_dup:
+            return "REPLACE / ON DUPLICATE KEY UPDATE", None
+        if not stmt.rows:
+            return "no VALUES rows", None
+        for row in stmt.rows:
+            for cell in row:
+                if not _literal_expr(cell):
+                    return "non-literal INSERT value", None
+        return "", {"kind": "insert"}
+    if isinstance(stmt, A.UpdateStmt):
+        if stmt.from_ is not None:
+            return "multi-table UPDATE", None
+        point = _point_where(stmt)
+        if point is None:
+            return "WHERE is not `col = literal`", None
+        sets = []
+        for name_ast, val_ast in stmt.sets:
+            if name_ast.qualifier:
+                return "qualified SET column", None
+            if _literal_expr(val_ast):
+                sets.append((name_ast.name, ("const", val_ast)))
+                continue
+            # one additive step over a column of this table:
+            # col ± literal (or literal + col)
+            if (isinstance(val_ast, A.EBinary) and val_ast.op in ("+", "-")):
+                lhs, rhs = val_ast.left, val_ast.right
+                if (isinstance(lhs, A.EName) and not lhs.qualifier
+                        and _literal_expr(rhs)):
+                    sets.append((name_ast.name,
+                                 ("delta", lhs.name, val_ast.op, rhs, False)))
+                    continue
+                if (val_ast.op == "+" and isinstance(rhs, A.EName)
+                        and not rhs.qualifier and _literal_expr(lhs)):
+                    sets.append((name_ast.name,
+                                 ("delta", rhs.name, "+", lhs, False)))
+                    continue
+            return "SET value beyond literal / col±literal", None
+        return "", {"kind": "update", "where": point, "sets": sets}
+    if isinstance(stmt, A.DeleteStmt):
+        if stmt.from_ is not None:
+            return "multi-table DELETE", None
+        point = _point_where(stmt)
+        if point is None:
+            return "WHERE is not `col = literal`", None
+        return "", {"kind": "delete", "where": point}
+    return "not a DML statement", None
+
+
+def batchable_dml(stmt) -> str:
+    """'' when `stmt` passes the structural group-commit gate (the
+    write-path sibling of batchable_plan), else the blocking reason."""
+    return classify_dml(stmt)[0]
 
 
 # ---------------------------------------------------------------------------

@@ -36,18 +36,27 @@ from typing import Dict, List, Optional
 __all__ = ["Batcher", "BatchGroup", "Member"]
 
 
+class _DmlFallback(Exception):
+    """Raised inside a group-commit pass to abort the (not yet
+    committed) group transaction and send every member to singleton
+    execution — the same correctness gate the read batcher's
+    shared-pass fallback provides."""
+
+
 class Member:
     """One admitted, coalescible statement waiting for its result."""
 
     __slots__ = ("session", "stmt_id", "params", "info", "t0", "deadline",
-                 "group", "done", "result", "exc", "timed_out", "drop")
+                 "group", "done", "result", "exc", "timed_out", "drop",
+                 "sql")
 
     def __init__(self, session, stmt_id: int, params: list, info,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], sql: Optional[str] = None):
         self.session = session
         self.stmt_id = stmt_id
         self.params = params
-        self.info = info                  # StmtInfo from the probe
+        self.info = info                  # StmtInfo / DML spec from the probe
+        self.sql = sql                    # text-protocol member (DML window)
         self.t0 = time.perf_counter()     # for the sched.queue span
         self.deadline = deadline          # monotonic; None = unbounded
         self.group: Optional["BatchGroup"] = None
@@ -84,6 +93,10 @@ class BatchGroup:
             _san.tracked_lock("BatchGroup.cv", threading.RLock))
         self.members: List[Member] = []
         self.sealed = False
+        # group-commit DML window (ISSUE 17): the opening member's spec
+        # (shape fields — kind/table/SET columns — are digest-identical
+        # across members); None = a read batch
+        self.dml = None
 
 
 class Batcher:
@@ -98,6 +111,9 @@ class Batcher:
         self._coalesced_by_digest: Dict[str, int] = {}
         self.batches = 0            # groups executed (any size)
         self.coalesced_stmts = 0    # members of n>=2 groups
+        # internal session owning group-commit DML transactions (lazy:
+        # read-only deployments never create it)
+        self._writer = None
 
     # -- submit side ----------------------------------------------------
 
@@ -114,8 +130,32 @@ class Batcher:
         if probe is None:
             return None
         key, entry, info = probe
-        max_size = int(sched.sysvars.get("tidb_tpu_max_batch_size"))
         member = Member(session, stmt_id, params, info, deadline)
+        return self._join(key, member, window_us, entry=entry)
+
+    def try_join_dml(self, session, sql: str,
+                     deadline: Optional[float]) -> Optional[Member]:
+        """Coalesce an autocommit text-protocol point write into an
+        open group-commit window (ISSUE 17). Same gather/seal machinery
+        as reads — the keys carry a "dml" marker so a write window can
+        never mix with a read batch. None = not coalescible."""
+        sched = self.scheduler
+        window_us = int(sched.sysvars.get("tidb_tpu_batch_window_us"))
+        if window_us <= 0:
+            return None
+        probe = session.dml_batch_probe(sql)
+        if probe is None:
+            return None
+        key, spec = probe
+        member = Member(session, -1, [], spec, deadline, sql=sql)
+        return self._join(key, member, window_us, dml=spec)
+
+    def _join(self, key, member: Member, window_us: int, entry=None,
+              dml=None) -> Member:
+        """Append `member` to the open group for `key`, or open a fresh
+        group and enqueue its gather task."""
+        sched = self.scheduler
+        max_size = int(sched.sysvars.get("tidb_tpu_max_batch_size"))
         with self._lock:
             g = self._open.get(key)
             if g is not None and not g.sealed and len(g.members) < max_size:
@@ -125,6 +165,7 @@ class Batcher:
                 enqueue = False
             else:
                 g = BatchGroup(key, entry, window_us / 1e6, max_size)
+                g.dml = dml
                 g.members.append(member)
                 member.group = g
                 self._open[key] = g
@@ -202,7 +243,10 @@ class Batcher:
         from tidb_tpu.utils import metrics as M
 
         n = len(members)
-        M.BATCH_SIZE.observe(n)
+        if group.dml is not None:
+            M.DML_BATCH_SIZE.observe(n)
+        else:
+            M.BATCH_SIZE.observe(n)
         with self._lock:
             self.batches += 1
             if n >= 2:
@@ -213,7 +257,10 @@ class Batcher:
                     d.pop(next(iter(d)))
         if n >= 2:
             M.BATCH_COALESCE_TOTAL.inc(n)
-        self._execute(group, members)
+        if group.dml is not None:
+            self._execute_dml(group, members)
+        else:
+            self._execute(group, members)
 
     # -- the one gathered dispatch --------------------------------------
 
@@ -408,7 +455,10 @@ class Batcher:
         sess._stmt_runner = runner
         sess._sched_queue_s = _time.perf_counter() - member.t0
         try:
-            res = sess.execute_prepared(member.stmt_id, member.params)
+            if member.sql is not None:
+                res = sess.execute(member.sql)
+            else:
+                res = sess.execute_prepared(member.stmt_id, member.params)
         except BaseException as e:  # noqa: BLE001 — relayed verbatim to
             member.finish(exc=e)    # the submitting connection thread
         else:
@@ -416,3 +466,156 @@ class Batcher:
         finally:
             sess._stmt_runner = None
             sess._sched_queue_s = 0.0
+
+    # -- group-commit DML (ISSUE 17) ------------------------------------
+
+    def _dml_writer(self):
+        """The internal session owning group-commit transactions. Not a
+        client connection: removed from the process list so KILL can
+        never target the shared writer."""
+        if self._writer is None:
+            from tidb_tpu.session.session import Session
+
+            w = Session(self.scheduler.catalog)
+            w.catalog.processes.pop(w.conn_id, None)
+            self._writer = w
+        return self._writer
+
+    def _execute_dml(self, group: BatchGroup, members: List[Member]) -> None:
+        """One engine pass for every live member's point write — one
+        merged insert/update/delete inside ONE writer transaction —
+        then per-member finalization through Session._execute_timed.
+        Any failure of the merged pass rolls the group transaction back
+        (``_run_dml`` aborts implicit txns on any exception) and every
+        member re-executes singleton-style with its exact typed error."""
+        catalog = self.scheduler.catalog
+        batch_id = next(self._seq)
+        with catalog.lock:
+            try:
+                included = self._dml_pass(group, members)
+            except Exception:  # noqa: BLE001 — ANY group-commit failure
+                # (conflict shapes, schema race, engine error) aborted
+                # the group txn; singleton re-execution is exact
+                included = None
+            n = len(members)
+            for i, m in enumerate(members):
+                runner = (self._dml_runner(i, n, batch_id, m)
+                          if included is not None and included[i] else None)
+                self._finalize(m, runner)
+
+    def _dml_pass(self, group: BatchGroup,
+                  members: List[Member]) -> List[bool]:
+        """The merged write. Runs under catalog.lock in the writer
+        session's own (implicit, autocommit) transaction: one index
+        probe stack, one delta append / MVCC marker write, one commit.
+        Returns the per-member inclusion mask — members killed or
+        expired before the pass are excluded and get their typed error
+        from _finalize without having written anything."""
+        import time as _time
+
+        import numpy as np
+
+        catalog = self.scheduler.catalog
+        # drop snapshot at T1: the kill flag is only consumed at
+        # statement entry and deadlines are monotone, so _finalize's
+        # re-check re-derives the same typed error for excluded members
+        included = []
+        now = _time.monotonic()
+        for m in members:
+            sess = m.session
+            dead = m.deadline is not None and now > m.deadline
+            included.append(not (sess._kill_query or sess._killed or dead))
+        live = [m for m, ok in zip(members, included) if ok]
+        if not live:
+            return included
+        if catalog.schema_version != group.key[4]:
+            raise _DmlFallback("schema changed during gather")
+        spec0 = group.dml
+        table = catalog.table(spec0["db"], spec0["table"])
+        kind = spec0["kind"]
+        writer = self._dml_writer()
+
+        if kind == "insert":
+            rows = []
+            for m in live:
+                rows.extend(m.info["rows"])
+
+            def do(txn):
+                table.insert_rows(rows, columns=spec0["columns"],
+                                  begin_ts=txn.marker,
+                                  log=txn.log_for(table))
+        else:
+            def probe(txn):
+                sets_ids = []
+                for m in live:
+                    ids = np.asarray(table.index_lookup(
+                        m.info["index"], m.info["key"],
+                        read_ts=txn.read_ts, marker=txn.marker),
+                        dtype=np.int64)
+                    sets_ids.append(ids)
+                return sets_ids
+
+            if kind == "update":
+                def do(txn):
+                    sets_ids = probe(txn)
+                    all_ids = (np.concatenate(sets_ids) if sets_ids
+                               else np.zeros(0, dtype=np.int64))
+                    if len(all_ids) == 0:
+                        return
+                    if len(np.unique(all_ids)) != len(all_ids):
+                        # two members hit the same row: serial order
+                        # matters (k+2 vs k+1) — group cannot be exact
+                        raise _DmlFallback("duplicate target rows")
+                    updates = {name: [] for name, _, _ in live[0].info["sets"]}
+                    for m, ids in zip(live, sets_ids):
+                        k = len(ids)
+                        for name, mode, val in m.info["sets"]:
+                            if mode == "const":
+                                updates[name].extend([val] * k)
+                            else:  # delta: col ± literal on OLD values
+                                src, op, delta = val
+                                d = table.data[src][ids].tolist()
+                                v = table.valid[src][ids].tolist()
+                                for dv, ok in zip(d, v):
+                                    if not ok:
+                                        updates[name].append(None)
+                                    elif op == "+":
+                                        updates[name].append(dv + delta)
+                                    else:
+                                        updates[name].append(dv - delta)
+                    table.update_rows(all_ids.tolist(), updates,
+                                      begin_ts=txn.marker,
+                                      end_ts=txn.marker, marker=txn.marker,
+                                      log=txn.log_for(table),
+                                      log_for=txn.log_for)
+            else:  # delete — dup ids dedup to ONE marker, serial-exact
+                def do(txn):
+                    sets_ids = probe(txn)
+                    all_ids = (np.concatenate(sets_ids) if sets_ids
+                               else np.zeros(0, dtype=np.int64))
+                    if len(all_ids) == 0:
+                        return
+                    all_ids = np.unique(all_ids)
+                    table.delete_rows(all_ids.tolist(), end_ts=txn.marker,
+                                      marker=txn.marker,
+                                      log=txn.log_for(table),
+                                      log_for=txn.log_for)
+
+        writer._run_dml(do)
+        return included
+
+    def _dml_runner(self, i: int, n: int, batch_id: int, member: Member):
+        """The injected _stmt_runner for an applied group-commit member:
+        its write already committed in the merged pass, so the runner
+        only books the batch span (DML returns no rows in this engine)."""
+
+        def run(_stmt):
+            if member.drop is not None:
+                raise member.drop
+            from tidb_tpu.utils import tracing
+
+            with tracing.span(f"sched.batch[n={n}]"):
+                tracing.annotate(f"batch:{batch_id} member:{i} dml:applied")
+                return None
+
+        return run

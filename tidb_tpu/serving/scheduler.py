@@ -198,9 +198,19 @@ class StatementScheduler:
     def submit_query(self, sess, sql: str):
         """Text-protocol statement: admission + singleton execution on
         a worker (the catalog statement lock is taken by the worker,
-        exactly as the thread-per-connection server did)."""
+        exactly as the thread-per-connection server did). Autocommit
+        point writes may instead join a group-commit window (ISSUE 17)
+        and ride one merged engine pass."""
         self._admit(self._shed_digest(sess, sql=sql))
         self._session_tracker(sess)
+        met = int(sess.sysvars.get("max_execution_time"))
+        deadline = (time.monotonic() + met / 1e3) if met > 0 else None
+        try:
+            member = self.batcher.try_join_dml(sess, sql, deadline)
+        except Exception:  # noqa: BLE001 — the probe must never lose a
+            member = None  # statement; singleton fallback handles it
+        if member is not None:
+            return self._await_member(member)
         task = _Task(sess, lambda: sess.execute(sql))
         self._enqueue_task(task)
         return self._await_task(task)

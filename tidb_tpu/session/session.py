@@ -626,9 +626,11 @@ class Session:
             qs.notes.append(f"queued {int(q_s * 1e6)}us before execution")
         d0 = _dsp.count()
         f0 = _dsp.by_site().get("fragment", 0)
+        from tidb_tpu.columnar.store import compact_counts as _cmp_counts
         from tidb_tpu.columnar.store import scan_counts as _seg_counts
 
         seg0 = _seg_counts()
+        cw0 = _cmp_counts()
         # runtime invariant sanitizer (ISSUE 12): debug-mode statement
         # scope — pin/tracker balances, host-sync budget, lock-order
         # witness — checked at statement end; fatal findings raise a
@@ -655,7 +657,8 @@ class Session:
             if isinstance(exc, QueryTimeoutError):
                 M.DEADLINE_EXCEEDED_TOTAL.inc()
             detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, None,
-                                       seg0=seg0, prof0=prof0, error=True)
+                                       seg0=seg0, prof0=prof0, cw0=cw0,
+                                       error=True)
             self._slo_observe(dur)
             tracing.annotate(f"error:{type(exc).__name__}: {exc}")
             trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur,
@@ -707,7 +710,7 @@ class Session:
         # trace surfaces run, so they all see the drift it computed
         self._fb_record(dur, result, _dsp.compile_count() - c0)
         detail = self._record_stmt(stmt, sql, stype, dur, d0, f0, result,
-                                   seg0=seg0, prof0=prof0)
+                                   seg0=seg0, prof0=prof0, cw0=cw0)
         self._slo_observe(dur)
         trace_id = self._finish_trace(tr, stmt_span, owns_trace, dur)
         self._maybe_log_slow(sql, dur, detail, trace_id)
@@ -815,7 +818,7 @@ class Session:
             trace_id=trace_id, disposition=disposition,
             worst_drift=drift, worst_drift_op=drift_op,
             xfer_bytes=detail[5], compile_ms=detail[6],
-            spill_bytes=detail[7])
+            spill_bytes=detail[7], compaction_wait_ms=detail[8])
 
     def _stmt_digest(self, stmt, sql: str):
         """(normalized_text, digest) for this statement, memoized per
@@ -868,13 +871,13 @@ class Session:
 
     def _record_stmt(self, stmt, sql: str, stype: str, dur: float,
                      d0: int, f0: int, result, seg0=(0, 0),
-                     prof0=(0, 0.0, 0), error: bool = False):
+                     prof0=(0, 0.0, 0), cw0=(0.0, 0), error: bool = False):
         """Fold one execution into the per-digest statements summary;
         returns (digest, max_mem, dispatches, segs_scanned, segs_pruned,
-        xfer_bytes, compile_ms, spill_bytes) for the slow-query log and
-        the EXPLAIN ANALYZE profile line. Digests come from the bindinfo
-        normalizer, so parameterized variants of one statement
-        aggregate under one entry."""
+        xfer_bytes, compile_ms, spill_bytes, compaction_wait_ms) for the
+        slow-query log and the EXPLAIN ANALYZE profile line. Digests
+        come from the bindinfo normalizer, so parameterized variants of
+        one statement aggregate under one entry."""
         from tidb_tpu.utils import dispatch as _dsp
 
         self._stmt_profile = None
@@ -900,6 +903,14 @@ class Session:
             xfer = _dsp.xfer_bytes() - prof0[0]
             compile_ms = (_dsp.compile_seconds() - prof0[1]) * 1e3
             spill = _dsp.spill_bytes() - prof0[2]
+            # inline delta->segment rebuild time this statement paid on
+            # its own scan path (ISSUE 17) — attributable write-induced
+            # stall instead of anonymous scan time
+            from tidb_tpu.columnar.store import (
+                compact_counts as _cmp_counts,
+            )
+
+            compact_ms = (_cmp_counts()[0] - cw0[0]) * 1e3
             self._stmt_profile = (max_mem, xfer, compile_ms, spill)
             if xfer or spill or compile_ms >= 1.0:
                 from tidb_tpu.utils import tracing as _tracing
@@ -922,11 +933,11 @@ class Session:
                 max_stmt_count=int(
                     self.sysvars.get("tidb_stmt_summary_max_stmt_count")))
             return (digest, max_mem, dispatches, segs_scanned, segs_pruned,
-                    xfer, compile_ms, spill)
+                    xfer, compile_ms, spill, compact_ms)
         except Exception:  # noqa: BLE001 — diagnostics must never fail
             # (or mask) the statement; an unrecordable statement is
             # simply absent from the summary
-            return "", 0, 0, 0, 0, 0, 0.0, 0
+            return "", 0, 0, 0, 0, 0, 0.0, 0, 0.0
 
     def query(self, sql: str) -> List[tuple]:
         rs = self.execute(sql)
@@ -1037,6 +1048,7 @@ class Session:
                 self.sysvars.get("tidb_tpu_segment_delta_rows")),
             columnar_spill_dir=str(
                 self.sysvars.get("tidb_tpu_columnar_spill_dir")),
+            compaction_enable=bool(self.sysvars.get("tidb_tpu_compaction")),
             pipeline_fuse=bool(self.sysvars.get("tidb_tpu_pipeline_fuse")),
             prefetch_depth=int(
                 self.sysvars.get("tidb_tpu_pipeline_prefetch_depth")),
@@ -1372,6 +1384,138 @@ class Session:
         if _pc.batchable_plan(entry):
             return None  # non-empty string = the blocking reason
         return key, entry, info
+
+    _DML_HEADS = ("insert", "update", "delete")
+
+    def dml_batch_probe(self, sql: str):
+        """Group-commit coalescing probe (ISSUE 17, the write-path
+        sibling of batch_probe): decide WITHOUT executing whether this
+        autocommit text-protocol write can join a gathered DML window.
+        Returns (key, spec) when every gate passes, else None — the
+        statement then runs the full singleton path, which also owns
+        raising the real error for anything the probe refused (bad
+        values, missing privileges, unknown tables)."""
+        head = sql.lstrip()[:6].lower()
+        if head not in self._DML_HEADS:
+            return None
+        # session-state gates, mirroring batch_probe: open txns keep
+        # their own commit point, sharded sessions route writes through
+        # the mesh, executor plugins may intercept DML
+        if (self.txn is not None or self._killed or self._kill_query
+                or not self.sysvars.get("autocommit")
+                or self._shard_cache is not None
+                or str(self.sysvars.get("tidb_executor_plugin"))):
+            return None
+        if getattr(self.catalog, "_temp", None):
+            # a TEMPORARY namespace is session-local; the batcher's
+            # writer session could resolve the wrong table
+            return None
+        try:
+            stmts = parse(sql)
+        except Exception:  # noqa: BLE001 — singleton raises the parse error
+            return None
+        if len(stmts) != 1:
+            return None
+        stmt = stmts[0]
+        from tidb_tpu.planner import plancache as _pc
+
+        reason, parts = _pc.classify_dml(stmt)
+        if reason:
+            return None
+        kind = parts["kind"]
+        # the singleton dispatch's privilege gate, probed up front: a
+        # denial falls back to singleton execution, which raises it
+        self._priv_table(kind, stmt.table)
+        db = stmt.table.schema or self.db
+        try:
+            table = self.catalog.table(db, stmt.table.name)
+            spec = self._dml_spec(kind, stmt, db, table, parts)
+        except Exception:  # noqa: BLE001 — any refusal -> singleton
+            return None
+        if spec is None:
+            return None
+        from tidb_tpu.bindinfo import normalize_sql, sql_digest
+
+        digest = sql_digest(normalize_sql(sql))
+        # schema_version pins the spec's bindings: a DDL between probe
+        # and execution splits groups, and the batcher re-checks the
+        # version at apply time under the catalog lock
+        key = (digest, "dml", db, kind, self.catalog.schema_version)
+        return key, spec
+
+    def _dml_spec(self, kind, stmt, db, table, parts):
+        """Schema-dependent half of the group-commit classifier: bind
+        the statement's literals and resolve its point-access index.
+        None = not coalescible. Built on the submitting connection
+        thread; the batcher applies it under the catalog lock."""
+        from tidb_tpu.planner.binder import Binder
+
+        binder = Binder()
+        gen_cols = {g.col for g in table.generated}
+        spec = {"kind": kind, "db": db, "table": stmt.table.name}
+        if kind == "insert":
+            if stmt.columns and gen_cols & set(stmt.columns):
+                return None  # singleton raises the generated-column error
+            names = stmt.columns or table.insertable_names()
+            rows = []
+            for r_ast in stmt.rows:
+                if len(r_ast) != len(names):
+                    return None  # singleton raises the count mismatch
+                rows.append([self._bind_const(binder, cell,
+                                              table.schema.col(cname))
+                             for cell, cname in zip(r_ast, names)])
+            spec["columns"] = stmt.columns
+            spec["rows"] = rows
+            return spec
+        where_col, lit_ast = parts["where"]
+        col = table.schema.col(where_col)
+        if col.type_.is_dict_encoded:
+            # a string key's encoding can shift when the dictionary
+            # grows between probe and apply; ints/dates are stable
+            return None
+        idx = next((ix for ix in table.indexes.values()
+                    if ix.unique and ix.columns == [where_col]), None)
+        if idx is None:
+            return None  # no O(log n) point access; singleton scans
+        v = self._bind_const(binder, lit_ast, col)
+        if v is None:
+            return None  # WHERE col = NULL matches nothing (MySQL)
+        key_vals = table.encode_index_key(idx, {where_col: v})
+        if key_vals is None:
+            return None
+        spec["index"] = idx.name
+        spec["key"] = key_vals
+        if kind == "delete":
+            return spec
+        indexed = {c for ix in table.indexes.values() for c in ix.columns}
+        sets = []
+        for set_col, how in parts["sets"]:
+            tc = table.schema.col(set_col)
+            if tc.name in gen_cols:
+                return None  # singleton raises the generated-column error
+            if tc.name in indexed:
+                # a SET over an indexed column could redirect ANOTHER
+                # member's point probe mid-window (serial executions
+                # would observe it); uniqueness races live here too
+                return None
+            if how[0] == "const":
+                sets.append((tc.name, "const",
+                             self._bind_const(binder, how[1], tc)))
+                continue
+            _tag, src, op, delta_ast, _swap = how
+            sc = table.schema.col(src)
+            if sc.type_.is_dict_encoded or sc.type_.kind not in (
+                    TypeKind.INT, TypeKind.FLOAT):
+                return None  # host-side ± only over plain numerics
+            if tc.type_.is_dict_encoded or tc.type_.kind not in (
+                    TypeKind.INT, TypeKind.FLOAT):
+                return None
+            delta = self._bind_const(binder, delta_ast, sc)
+            if delta is None:
+                return None  # col ± NULL is NULL; keep the host eval dumb
+            sets.append((tc.name, "delta", (src, op, delta)))
+        spec["sets"] = sets
+        return spec
 
     def _apply_binding(self, stmt):
         """Plan-binding lookup (ref: bindinfo BindHandle): on a match of
@@ -3108,17 +3252,21 @@ class Session:
             instrument(root)
             # resource profile (ISSUE 16): deltas of the thread-local
             # host-side counters around the execution — no new syncs
+            from tidb_tpu.columnar.store import compact_counts as _cmp
+
             p0 = (_dsp.xfer_bytes(), _dsp.compile_seconds(),
                   _dsp.spill_bytes())
+            cw0 = _cmp()
             run_plan(root, self._exec_ctx(plan=phys))  # execute; rows discarded
             text = analyze_text(root)
             mem_max = max((t.max_consumed for t in self._stmt_trackers),
                           default=0)
             text += ("\nprofile: mem_max=%d xfer_bytes=%d compile_ms=%.1f"
-                     " spill_bytes=%d"
+                     " spill_bytes=%d compaction_wait_ms=%.1f"
                      % (mem_max, _dsp.xfer_bytes() - p0[0],
                         (_dsp.compile_seconds() - p0[1]) * 1e3,
-                        _dsp.spill_bytes() - p0[2]))
+                        _dsp.spill_bytes() - p0[2],
+                        (_cmp()[0] - cw0[0]) * 1e3))
             return ResultSet(names=["EXPLAIN ANALYZE"],
                              rows=[(line,) for line in text.split("\n")])
         text = explain_text(phys)

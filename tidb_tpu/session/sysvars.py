@@ -218,6 +218,10 @@ _reg(
     # directory for spilled segment files (empty = system tmp); cold
     # segments evicted under the statement memory budget land here
     SysVar("tidb_tpu_columnar_spill_dir", "", BOTH, "str"),
+    # background delta->segment compaction (ISSUE 17): a worker thread
+    # rebuilds trailing segments off the statement path and cuts over
+    # at the store lock; 0 = today's inline rebuild-at-scan behavior
+    SysVar("tidb_tpu_compaction", True, BOTH, "bool"),
     # -- pipelined device-resident execution (ISSUE 9) -----------------
     # fuse scan->filter->project->partial-agg into ONE jitted program
     # per fragment, accumulating agg state on device across chunks with

@@ -304,7 +304,8 @@ class Catalog:
                        worst_drift: float = 0.0,
                        worst_drift_op: str = "",
                        xfer_bytes: int = 0, compile_ms: float = 0.0,
-                       spill_bytes: int = 0) -> None:
+                       spill_bytes: int = 0,
+                       compaction_wait_ms: float = 0.0) -> None:
         """One slow-log row. `trace_id` joins the row to the kept trace
         in information_schema.cluster_trace / /trace?id= (tail sampling
         retains every over-threshold statement's trace, so the id is
@@ -328,6 +329,7 @@ class Catalog:
             int(dispatches), int(segs_scanned), int(segs_pruned),
             trace_id, disposition, worst_drift_op, round(worst_drift, 4),
             int(xfer_bytes), round(float(compile_ms), 3), int(spill_bytes),
+            round(float(compaction_wait_ms), 3),
         ))
         logging.getLogger("tidb_tpu.slowlog").warning(
             "slow query (%.3fs) db=%s digest=%s mem=%d dispatches=%d "
@@ -812,7 +814,8 @@ class Catalog:
                  ("segs_pruned", INT64), ("trace_id", STRING),
                  ("disposition", STRING), ("worst_drift_op", STRING),
                  ("worst_drift", FLOAT64), ("xfer_bytes", INT64),
-                 ("compile_ms", FLOAT64), ("spill_bytes", INT64)],
+                 ("compile_ms", FLOAT64), ("spill_bytes", INT64),
+                 ("compaction_wait_ms", FLOAT64)],
                 list(self.slow_queries),
             )
         if name == "cluster_trace":

@@ -639,6 +639,29 @@ BATCH_COALESCE_TOTAL = Counter(
     "Statements that rode a multi-statement coalesced dispatch (members "
     "of batches with n >= 2; singleton executions never count)")
 
+# -- write path: group-commit DML + background compaction (ISSUE 17) --------
+
+DML_BATCH_SIZE = Histogram(
+    "tidb_tpu_dml_batch_size",
+    "Members per group-committed DML window (1 = a batchable write "
+    "whose gather window closed alone); mirrors tidb_tpu_batch_size "
+    "for the read path",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+COMPACTION_TOTAL = Counter(
+    "tidb_tpu_compaction_total",
+    "Delta->segment rebuild passes, by outcome: background (worker "
+    "build installed at cutover), inline (statement-path rebuild — the "
+    "pre-compaction behavior, still used when tidb_tpu_compaction=0 or "
+    "force-refresh), inline_fallback (worker queue full or dead: typed "
+    "degradation back to the statement path), discarded (the store "
+    "changed under the worker's snapshot; built segments dropped), "
+    "failed (background build raised)")
+COMPACTION_BYTES = Counter(
+    "tidb_tpu_compaction_bytes_total",
+    "Encoded segment bytes produced by delta->segment rebuilds "
+    "(background and inline alike); with tidb_tpu_compaction_total "
+    "this gives bytes-per-pass and the write amplification trend")
+
 # -- cluster observability plane (ISSUE 16) ---------------------------------
 
 XFER_BYTES = Counter(
