@@ -1,0 +1,39 @@
+"""Known-bad fixture for the fused scan→top-k sync budget (ISSUE 18):
+the fused TopN loop carries bounded winner state ON DEVICE across
+staged chunks and resolves ONE fetch at finalize — a per-chunk
+``jax.device_get`` inside the merge-drain loop re-creates the
+materializing sort's host round-trips the fused path exists to
+remove, and an un-annotated one must fail the host-sync pass.
+
+Expected violations: the two un-annotated merge-loop fetches below
+(the per-chunk winner-state fetch and the per-chunk overflow-flag
+poll). The single finalize fetch is the sanctioned shape.
+"""
+
+import jax
+
+
+def drain_topk_chunks(chunks, state):
+    snapshots = []
+    for ch in chunks:
+        state = ch.merge(state)
+        # BAD: one winner-state fetch per staged chunk — the bounded
+        # state exists so NOTHING moves until finalize
+        snapshots.append(jax.device_get(state.ranks))
+    return state, snapshots
+
+
+def poll_topk_overflow(chunks, state):
+    spilled = []
+    for ch in chunks:
+        state = ch.merge(state)
+        spilled.append(jax.device_get(state.overflow))  # BAD: per chunk
+    return spilled
+
+
+def finalize_topk(state):
+    # OK: the fused contract — the winner buffer, payload slots, and
+    # overflow flag move in ONE transfer after the last chunk merges
+    ranks, payload, overflow = jax.device_get(
+        (state.ranks, state.payload, state.overflow))
+    return ranks, payload, bool(overflow)

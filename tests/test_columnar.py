@@ -186,6 +186,31 @@ class TestZoneMaps:
             Literal(type_=INT64, value=-(1 << 63) - 1)))
         assert collect_prune_bounds(cond, {"u1": ("c", INT64)}) == ()
 
+    def test_decimal_literal_on_float_col_descales(self):
+        """DECIMAL literal vs FLOAT column: the compiler compares
+        f * 10**scale against the scaled int in float64, so the bound
+        must carry the scale factor on the zone side — feeding the raw
+        scaled int against unscaled float min/max pruned every segment
+        (``where f = 10.75`` silently returned zero rows)."""
+        from tidb_tpu.expression.expr import Call, ColumnRef, Literal
+        from tidb_tpu.types import TypeKind
+
+        BOOL = SQLType(TypeKind.BOOL)
+        dec2 = SQLType(TypeKind.DECIMAL, precision=10, scale=2)
+        cond = Call(type_=BOOL, op="eq", args=(
+            ColumnRef(type_=F64, name="u1"),
+            Literal(type_=dec2, value=1075)))  # 10.75
+        (b,) = collect_prune_bounds(cond, {"u1": ("f", F64)})
+        assert b.value == 1075.0 and b.col_scale_mul == 100
+        # zone [0.0 .. 499.75]: 10.75 is inside -> must NOT prune
+        z = {"f": build_zone_map(np.arange(2000) * 0.25,
+                                 np.ones(2000, dtype=np.bool_))}
+        assert not segment_pruned(z, [b])
+        # zone [0.0 .. 9.75]: 10.75 is above -> prunes
+        z = {"f": build_zone_map(np.arange(40) * 0.25,
+                                 np.ones(40, dtype=np.bool_))}
+        assert segment_pruned(z, [b])
+
     def test_null_literal_is_never(self):
         from tidb_tpu.expression.expr import Call, ColumnRef, Literal
         from tidb_tpu.types import TypeKind
@@ -296,6 +321,37 @@ class TestPruningOracle:
         # rows in the delta (beyond segment coverage) are found
         self.assert_equal(
             s, conn, "select count(*) from t where a >= 20000")
+
+    def test_float_eq_prune_and_dml_rowids_under_segments(self):
+        """Two regressions that only reproduce with folded segments:
+        (1) float-literal eq/ge/le predicates pruned every segment
+        (missing descale of the DECIMAL literal), and (2) UPDATE/DELETE
+        reconstructed physical row ids positionally from chunk order,
+        which is wrong once chunks size to segments / skip pruned
+        ranges — deletes hit the wrong rows or missed delta rows."""
+        s = Session(chunk_capacity=1 << 12)
+        s.execute("set tidb_tpu_segment_rows = 1024")
+        s.execute("create table ft (f double, i int)")
+        s.execute("insert into ft values "
+                  + ",".join(f"({i * 0.25}, {i})" for i in range(2000)))
+        # float equality / closed range on folded segments finds the row
+        assert s.query("select i from ft where f = 10.75") == [(43,)]
+        assert s.query(
+            "select i from ft where f >= 10.75 and f <= 10.75") == [(43,)]
+        assert s.query("select i from ft where f = 10.76") == []
+        # DELETE of a row that lives in the DELTA (past segment coverage)
+        s.execute("insert into ft values (99999.5, -1)")
+        s.execute("delete from ft where i = -1")
+        assert s.query("select i from ft where i = -1") == []
+        # DELETE/UPDATE of rows inside the second folded segment hit
+        # exactly the matching rows, not their positional aliases
+        s.execute("update ft set i = 7777 where f = 499.75")
+        assert s.query("select i from ft where f = 499.75") == [(7777,)]
+        s.execute("delete from ft where i = 1500")
+        assert s.query("select i from ft where i = 1500") == []
+        assert sorted(s.query("select i from ft where i in (1499, 1501)")) \
+            == [(1499,), (1501,)]
+        assert s.query("select count(*) from ft") == [(1999,)]
 
     def test_epoch_invalidation_on_dict_growth(self, seg_session):
         """A dictionary-growth re-encode rewrites stored codes in

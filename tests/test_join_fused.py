@@ -273,18 +273,21 @@ class TestFallbackGates:
         _fill(s2, n_dim=500, n_fact=5000)
         assert s2.query(Q18_SHAPE) == got
 
-    def test_outer_and_filtered_joins_keep_classic(self):
-        """Plan-static gates: left joins and other_cond joins never
-        route to the fused exec (their NULL-pad / re-verification
-        semantics live in the classic tree)."""
+    def test_outer_joins_fuse_filtered_joins_keep_classic(self):
+        """Plan-static gates after the ISSUE 18 widening: pure equi-key
+        LEFT joins now ride the fused probe (NULL-pad via the unmatched
+        mask), while other_cond joins still never route there (their
+        residual re-verification lives in the classic tree)."""
         s = _session(cap=1 << 14)
         _fill(s, n_dim=300, n_fact=3000)
         c0 = _fused_probes()
         s.query("select count(*), count(o.g) from l left join o"
                 " on l.k = o.k")
+        assert _fused_probes() > c0, "equi-key left join no longer fuses"
+        c1 = _fused_probes()
         s.query("select count(*) from l join o on l.k = o.k"
                 " and o.p < l.q * 100")
-        assert _fused_probes() == c0
+        assert _fused_probes() == c1, "other_cond join ran the fused probe"
 
     def test_deadline_interrupts_fused_probe(self):
         """A typed statement deadline surfaces from inside the fused

@@ -220,6 +220,29 @@ class TestHostSyncFixture:
                     if v.path.endswith("executor/pipeline.py")]
         assert not pipeline, [v.render() for v in pipeline]
 
+    def test_topk_drain_loop_fetch_is_flagged(self, tmp_path):
+        """ISSUE 18 satellite: the fused scan→top-k module class — an
+        un-annotated per-chunk device_get inside the winner-state merge
+        loop fails the pass; the single finalize fetch (the bounded
+        device-state contract) stays clean."""
+        root = _mini_root(tmp_path, ("ops", "bad_topk_sync.py"))
+        rep, _ = _run_pass(root, HostSyncPass())
+        msgs = [v.render() for v in rep.violations]
+        assert len(rep.violations) == 2, msgs
+        assert all("device_get" in v.message for v in rep.violations), msgs
+        # exactly the per-chunk winner-state (line 22) and overflow-poll
+        # (line 30) loop fetches — never the batched finalize fetch
+        assert sorted(v.line for v in rep.violations) == [22, 30], msgs
+
+    def test_fused_topk_module_is_clean(self, real_tree_reports):
+        """The real device top-k kernels (ops/topk.py) carry zero
+        unsuppressed host-sync violations — every chunk merge stays on
+        device; the one sanctioned fetch lives at the pipeline's
+        finalize, outside this module."""
+        hs = [r for r in real_tree_reports if r.pass_id == "host-sync"][0]
+        topk = [v for v in hs.violations if v.path.endswith("ops/topk.py")]
+        assert not topk, [v.render() for v in topk]
+
 
 class TestLockDisciplineFixture:
     def test_cycle_is_flagged(self, tmp_path):

@@ -1,0 +1,442 @@
+"""Fused device TopN + CLUSTER BY ordered compaction (ISSUE 18).
+
+Edge grids prove fused == classic == sqlite on NULL-heavy, dup-key,
+empty, and all-filtered ORDER BY [+ LIMIT] shapes through BOTH device
+paths (the single-key candidate cut and the multi-key variadic merge),
+the warm dispatch budget holds, DML/txn/DDL invalidate the fused
+state, cancellation mid-fused-TopN raises the typed errors with
+staging released, k-overflow feeds the plan-feedback store, and the
+CLUSTER BY DDL keeps tables sorted at delta->segment fold with
+``tidb_tpu_compaction=0`` byte-identical to ON.
+"""
+
+import random
+import sqlite3
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import QueryKilledError, QueryTimeoutError
+from tidb_tpu.executor.base import ExecContext
+from tidb_tpu.executor.pipeline import FusedScanTopNExec
+from tidb_tpu.session import Session
+from tidb_tpu.utils import dispatch as dsp
+
+
+def _walk(e):
+    yield e
+    for c in getattr(e, "children", []) or []:
+        yield from _walk(c)
+
+
+def _lit(x):
+    if x is None:
+        return "NULL"
+    if isinstance(x, str):
+        return f"'{x}'"
+    return str(x)
+
+
+@pytest.fixture(scope="module")
+def topn_session():
+    """Multi-chunk NULL-heavy/dup-key table + sqlite oracle. The 4k
+    chunk capacity over 10k rows forces several staged chunks, so the
+    single-key candidate cut (chunk rows > state cap) and the carried
+    merge state both engage."""
+    s = Session(chunk_capacity=1 << 12)
+    s.query("create database tn")
+    s.query("use tn")
+    s.query("set tidb_tpu_segment_rows = 1024")
+    s.query("create table t (k varchar(10), g int, v int, f double)")
+    random.seed(18)
+    rows = []
+    for i in range(10000):
+        rows.append((
+            random.choice(["a", "b", "c", None]),      # NULL-heavy dict key
+            i % 5,                                     # dup-heavy int key
+            None if i % 7 == 0 else i % 211,           # NULL + dup values
+            round(i * 0.25, 2),                        # unique tiebreak
+        ))
+    for off in range(0, len(rows), 1000):
+        vals = ",".join("(%s)" % ",".join(_lit(v) for v in r)
+                        for r in rows[off:off + 1000])
+        s.query(f"insert into t values {vals}")
+    conn = sqlite3.connect(":memory:")
+    conn.execute("create table t (k text, g int, v int, f real)")
+    conn.executemany("insert into t values (?,?,?,?)", rows)
+    return s, conn
+
+
+def _arms(s, sql):
+    """(fused rows, classic rows) — ordered, NOT sorted: TopN output
+    order is part of the contract."""
+    s.query("set tidb_tpu_pipeline_fuse = 0")
+    try:
+        classic = s.query(sql)
+    finally:
+        s.query("set tidb_tpu_pipeline_fuse = 1")
+    return s.query(sql), classic
+
+
+# every query is ORDER-deterministic: either the key set is unique (f)
+# or f breaks ties, so the full ordered row list is comparable across
+# engines (sqlite sorts NULLs first ASC / last DESC, like the engine)
+TOPN_QUERIES = [
+    # single-key cut path: unique float key, desc
+    "select f, k, g from t order by f desc limit 50",
+    # single-key cut path over a NULL-heavy key + unique tiebreak
+    "select v, f, k from t order by v, f limit 60",
+    "select v, f, k from t order by v desc, f desc limit 60",
+    # dup-heavy first key: boundary-tie class spans chunks
+    "select g, f, v from t order by g desc, f limit 45",
+    # multi-key variadic merge with an offset slice
+    "select g, v, f from t order by g, v desc, f limit 40 offset 15",
+    # fused filter ahead of the top-k state
+    "select f, v from t where g <> 2 order by f desc limit 33",
+    "select f, k from t where v < 100 and v > 50 order by f limit 25",
+    # all-filtered: zero live rows through every chunk
+    "select f, v from t where v < -5 order by f limit 10",
+    # LIMIT larger than the result
+    "select f, v from t where v = 1 order by f limit 5000 offset 2",
+]
+
+
+class TestFusedClassicOracle:
+    @pytest.mark.parametrize("sql", TOPN_QUERIES)
+    def test_fused_matches_classic_and_sqlite(self, topn_session, sql):
+        s, conn = topn_session
+        fused, classic = _arms(s, sql)
+        assert fused == classic, (sql, fused[:5], classic[:5])
+        want = conn.execute(sql).fetchall()
+        norm = [tuple(round(x, 6) if isinstance(x, float) else x
+                      for x in r) for r in fused]
+        wnorm = [tuple(round(x, 6) if isinstance(x, float) else x
+                       for x in r) for r in want]
+        assert norm == wnorm, (sql, norm[:5], wnorm[:5])
+
+    def test_fused_executor_is_routed(self, topn_session):
+        s, _ = topn_session
+        txt = "\n".join(str(r) for r in s.query(
+            "explain analyze select f, v from t order by f desc limit 9"))
+        assert "FusedScanTopN" in txt, txt
+
+    def test_overflow_k_falls_back_classic(self, topn_session):
+        """offset + count past the chunk-capacity gate records the
+        overflow on the exec and runs the classic delegate."""
+        s, conn = topn_session
+        sql = "select f, v from t order by f limit 5000"
+        from tidb_tpu.executor.builder import build_executor
+        from tidb_tpu.parser import parse
+
+        root = build_executor(s._plan_select(parse(sql)[0]))
+        tops = [e for e in _walk(root) if isinstance(e, FusedScanTopNExec)]
+        assert tops
+        ctx = ExecContext(chunk_capacity=1 << 12, segment_rows=1 << 10)
+        try:
+            root.open(ctx)
+            while root.next() is not None:
+                pass
+        finally:
+            root.close()
+        assert not tops[0]._ran_fused
+        assert tops[0]._topn_overflow == 5000
+        fused, classic = _arms(s, sql)
+        assert fused == classic
+
+    def test_full_sort_under_capacity_gate(self, topn_session):
+        """A plain ORDER BY (no LIMIT) whose table fits one chunk rides
+        the same device state — the top-n IS the complete sort."""
+        s, conn = topn_session
+        s.query("create table small (v int, f double)")
+        random.seed(7)
+        vals = [(None if i % 5 == 0 else (i * 37) % 97, i / 8.0)
+                for i in range(600)]
+        s.query("insert into small values " + ",".join(
+            "(%s)" % ",".join(_lit(x) for x in r) for r in vals))
+        conn.execute("create table small (v int, f real)")
+        conn.executemany("insert into small values (?,?)", vals)
+        sql = "select v, f from small order by v desc, f"
+        fused, classic = _arms(s, sql)
+        assert fused == classic
+        assert fused == conn.execute(sql).fetchall()
+        txt = "\n".join(str(r) for r in s.query("explain analyze " + sql))
+        assert "FusedScanTopN" in txt, txt
+
+
+class TestWarmDispatchBudget:
+    @pytest.mark.parametrize("sql", [
+        "select f, k from t order by f desc limit 50",
+        "select g, v, f from t order by g, v desc, f limit 40",
+    ])
+    def test_warm_topn_single_digit(self, topn_session, sql):
+        """Warm fused TopN: the staged chunks ride the device buffer
+        cache, so a run is the per-chunk fused programs + ONE finalize
+        fetch — single-digit dispatches, never per-row host traffic."""
+        s, _ = topn_session
+        s.query(sql)
+        s.query(sql)  # second fill: jit traced, buffer cache filled
+        c0 = dsp.count()
+        s.query(sql)
+        warm = dsp.count() - c0
+        assert warm <= 9, (sql, warm, dsp.by_site())
+
+
+class TestInvalidation:
+    def test_dml_visible_to_fused_topn(self, topn_session):
+        s, _ = topn_session
+        sql = "select f, v from t order by f desc limit 3"
+        before = s.query(sql)
+        s.query("insert into t values ('z', 9, 9, 99999.5)")
+        try:
+            got = s.query(sql)
+            assert got[0] == (99999.5, 9), got
+            fused, classic = _arms(s, sql)
+            assert fused == classic
+        finally:
+            s.query("delete from t where f = 99999.5")
+        assert s.query(sql) == before
+
+    def test_txn_pending_rows_visible_and_rolled_back(self, topn_session):
+        s, _ = topn_session
+        sql = "select f, v from t order by f desc limit 2"
+        before = s.query(sql)
+        s.query("begin")
+        try:
+            s.query("insert into t values ('z', 1, 1, 88888.25)")
+            fused, classic = _arms(s, sql)
+            assert fused == classic
+            assert fused[0] == (88888.25, 1), fused
+        finally:
+            s.query("rollback")
+        assert s.query(sql) == before
+
+    def test_ddl_truncate_empties_fused_topn(self):
+        s = Session(chunk_capacity=1 << 10)
+        s.query("create table tt (v int, f double)")
+        s.query("insert into tt values " + ",".join(
+            f"({i % 13}, {i}.5)" for i in range(3000)))
+        sql = "select v, f from tt order by v desc, f limit 7"
+        assert len(s.query(sql)) == 7
+        s.query("truncate table tt")
+        fused, classic = _arms(s, sql)
+        assert fused == classic == []
+
+
+class TestCancellation:
+    @pytest.mark.parametrize("err", [QueryTimeoutError, QueryKilledError])
+    def test_typed_abort_mid_fused_topn(self, topn_session, err):
+        """raise_if_cancelled polls BETWEEN chunk merges: a deadline or
+        kill firing after the first chunk aborts with the typed error
+        and releases staging (pins + prefetcher)."""
+        s, _ = topn_session
+        from tidb_tpu.executor.builder import build_executor
+        from tidb_tpu.parser import parse
+
+        root = build_executor(s._plan_select(parse(
+            "select f, v from t order by f desc limit 20")[0]))
+        tops = [e for e in _walk(root) if isinstance(e, FusedScanTopNExec)]
+        assert tops
+        polls = []
+
+        def cancel():
+            polls.append(1)
+            return err("aborted mid-topn") if len(polls) > 2 else False
+
+        ctx = ExecContext(chunk_capacity=1 << 11, cancel_check=cancel,
+                          segment_rows=1 << 10)
+        try:
+            with pytest.raises(err):
+                root.open(ctx)
+                while root.next() is not None:
+                    pass
+        finally:
+            root.close()
+        ex = tops[0]
+        assert ex._pin is None and ex._prefetcher is None
+
+
+class TestTopNOverflowFeedback:
+    def test_overflow_recorded_then_routed_classic(self):
+        """First execution pays the gate fallback and records the
+        k-overflow; the harvest makes the digest's SECOND execution
+        start classic (ctx.fused_topn off) instead of re-probing."""
+        from tidb_tpu.bindinfo import normalize_sql, sql_digest
+        from tidb_tpu.planner import feedback as fb
+
+        s = Session(chunk_capacity=1 << 10)
+        s.query("create table big (v int, f double)")
+        s.query("insert into big values " + ",".join(
+            f"({(i * 17) % 251}, {i}.25)" for i in range(3000)))
+        sql = "select v, f from big order by v, f limit 2000"
+        dg = sql_digest(normalize_sql(sql))
+        fb.STORE.clear()
+        try:
+            first = s.query(sql)
+            assert fb.STORE.topn_overflow(dg) >= 2000, \
+                fb.STORE.stats_dict(50)
+            # the consumer runs in _exec_ctx keyed on the statement's
+            # digest memo: the same digest now starts classic
+            s._stmt_digest_memo = (sql, normalize_sql(sql), dg)
+            assert s._exec_ctx().fused_topn is False
+            assert s.query(sql) == first
+        finally:
+            fb.STORE.clear()
+
+
+class TestClusterBy:
+    def test_ddl_persists_and_alters(self):
+        s = Session()
+        s.query("create table c1 (a int, b int) cluster by (a)")
+        t = s.catalog.table("test", "c1")
+        assert t.schema.cluster_by == "a"
+        s.query("alter table c1 cluster by (b)")
+        assert t.schema.cluster_by == "b"
+        s.query("alter table c1 cluster by none")
+        assert t.schema.cluster_by is None
+
+    def test_ordered_compaction_sorts_at_fold(self):
+        """Shuffled ingest into a clustered table: the delta->segment
+        fold physically re-sorts (watermark covers every row, column
+        ascending NULLs-first) — no hand-ordered load involved."""
+        s = Session(chunk_capacity=1 << 10)
+        s.query("set tidb_tpu_segment_rows = 512")
+        s.query("create table cl (d int, v int) cluster by (d)")
+        random.seed(3)
+        order = list(range(4000))
+        random.shuffle(order)
+        for off in range(0, 4000, 1000):
+            s.query("insert into cl values " + ",".join(
+                f"({d}, {d % 7})" for d in order[off:off + 1000]))
+        # scans drive refresh/fold on the statement path
+        assert s.query("select count(*) from cl") == [(4000,)]
+        t = s.catalog.table("test", "cl")
+        assert t.clustered_rows == t.n == 4000
+        col = t.data["d"][:t.n]
+        assert (np.diff(col) >= 0).all(), "cluster column not sorted"
+
+    def test_flag_off_fold_equality(self):
+        """tidb_tpu_compaction moves WHERE the rebuild runs, never what
+        a scan returns: identical ingest with the worker off folds to
+        the same rows AND the same physical clustered order."""
+        res = {}
+        for flag in (0, 1):
+            s = Session(chunk_capacity=1 << 10)
+            s.query(f"set tidb_tpu_compaction = {flag}")
+            s.query("set tidb_tpu_segment_rows = 512")
+            s.query("create table cf (d int, v int) cluster by (d)")
+            random.seed(5)
+            order = list(range(3000))
+            random.shuffle(order)
+            for off in range(0, 3000, 1000):
+                s.query("insert into cf values " + ",".join(
+                    f"({d}, {(d * 3) % 11})" for d in order[off:off + 1000]))
+            rows = s.query("select d, v from cf where d >= 100 and d < 900 "
+                           "order by d, v")
+            t = s.catalog.table("test", "cf")
+            res[flag] = (rows, t.clustered_rows, t.n)
+        assert res[0][0] == res[1][0]
+        assert res[0][1:] == res[1][1:]
+
+    def test_recluster_refused_under_other_sessions_txn(self):
+        """The single-writer invariant is CATALOG-wide: another
+        session's open transaction (even one touching a DIFFERENT
+        table, whose write log holds positional rowids mid
+        collect-to-apply) must block the permute — this table's own
+        provisional state is empty, so only the catalog-level open-txn
+        gate can refuse here."""
+        a = Session()
+        a.query("create table cg (d int, v int) cluster by (d)")
+        a.query("insert into cg values (9, 1), (2, 2), (4, 3)")
+        a.query("create table other (x int)")
+        a.query("insert into other values (1)")
+        t = a.catalog.table("test", "cg")
+        b = Session(catalog=a.catalog)
+        b.query("begin")
+        try:
+            b.query("update other set x = 2")
+            assert t.recluster() is False  # cg itself looks idle
+        finally:
+            b.query("commit")
+        assert t.recluster() is True
+        assert (np.diff(t.data["d"][:t.n].astype(np.int64)) >= 0).all()
+
+    def test_recluster_partial_failure_leaves_table_intact(self):
+        """The permute is all-or-nothing: if allocating any permuted
+        column fails (a MemoryError mid-loop at SF1 scale), NO column
+        may have moved — a half-permuted table is silent row corruption
+        with no data_epoch bump to invalidate the segment store. The
+        fancy-index on the SECOND column ('v') is made to raise; the
+        first column ('d') must come through untouched."""
+        class Boom(MemoryError):
+            pass
+
+        class ExplodingOnFancyIndex(np.ndarray):
+            def __getitem__(self, item):
+                if isinstance(item, np.ndarray) and item.ndim == 1 \
+                        and item.dtype.kind in "iu":
+                    raise Boom()
+                return super().__getitem__(item)
+
+        s = Session()
+        s.query("create table cx (d int, v int) cluster by (d)")
+        s.query("insert into cx values (7, 1), (3, 2), (5, 3)")
+        t = s.catalog.table("test", "cx")
+        before = {n: t.data[n][:t.n].copy() for n in t.data}
+        epoch = t.data_epoch
+        plain = t.data["v"]
+        t.data["v"] = plain.view(ExplodingOnFancyIndex)
+        try:
+            with pytest.raises(Boom):
+                t.recluster()
+        finally:
+            t.data["v"] = plain
+        assert t.data_epoch == epoch, "failed permute must not publish"
+        for name in before:
+            assert (t.data[name][:t.n] == before[name]).all(), \
+                f"column {name!r} moved during a failed permute"
+        # and the watermark still says unclustered: a later fold retries
+        assert t.clustered_rows < t.n
+        assert t.recluster() is True  # clean retry succeeds
+        assert (np.diff(t.data["d"][:t.n].astype(np.int64)) >= 0).all()
+
+    def test_cluster_by_composes_with_shard_by(self):
+        """The trailing CREATE TABLE options parse in either order (and
+        duplicates are rejected) — CLUSTER BY before SHARD BY used to
+        fail because the clauses were accepted in one fixed sequence."""
+        for ddl in (
+            "create table co1 (k int, c int) cluster by (c) "
+            "shard by hash(k) shards 2",
+            "create table co2 (k int, c int) shard by hash(k) shards 2 "
+            "cluster by (c)",
+        ):
+            s = Session()
+            s.query(ddl)
+            t = s.catalog.table("test", ddl.split()[2])
+            assert t.schema.cluster_by == "c"
+            assert t.schema.shard_by is not None
+        s = Session()
+        with pytest.raises(Exception, match="duplicate CLUSTER BY"):
+            s.query("create table cdup (a int) cluster by (a) "
+                    "cluster by (a)")
+
+    def test_recluster_refused_under_open_txn(self):
+        """Open transactions hold physical row positions (write logs
+        address rows by index): recluster refuses, then succeeds after
+        commit — same caller contract as gc()."""
+        s = Session()
+        s.query("create table cr (d int, v int) cluster by (d)")
+        s.query("insert into cr values (5, 1), (1, 2), (3, 3)")
+        t = s.catalog.table("test", "cr")
+        s.query("begin")
+        try:
+            s.query("update cr set v = 9 where d = 3")
+            assert t.recluster() is False
+        finally:
+            s.query("commit")
+        assert t.recluster() is True
+        assert t.clustered_rows == t.n
+        # t.n counts dead MVCC versions (the committed UPDATE left one);
+        # the contract is physical order by cluster key, not row count
+        assert (np.diff(t.data["d"][:t.n].astype(np.int64)) >= 0).all()
+        assert sorted(s.query("select d, v from cr")) == \
+            [(1, 2), (3, 9), (5, 1)]

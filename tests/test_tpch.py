@@ -1,20 +1,26 @@
 """All 22 TPC-H queries vs the sqlite oracle on identical generated data
 (ref test strategy: SURVEY.md §4 — executor tests run real SQL end-to-end
-against an in-process oracle; this is the explaintest/correctness tier)."""
+against an in-process oracle; this is the explaintest/correctness tier).
+
+SF 0.1 (ISSUE 18): lineitem ~600k rows — large enough that the fused
+pipeline's staged scan batching, device top-k roots, multi-key/outer
+probes, and CLUSTER BY ordered compaction all engage on real shapes
+instead of toy single-chunk tables. The oracle side is indexed
+(testutil.index_tpch_oracle) so sqlite stays O(probes)."""
 
 import pytest
 
 from tidb_tpu.session import Session
 from tidb_tpu.storage.tpch import load_tpch
 from tidb_tpu.storage.tpch_queries import Q
-from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+from tidb_tpu.testutil import index_tpch_oracle, mirror_to_sqlite, rows_equal
 
 
 @pytest.fixture(scope="module")
 def tpch_session():
     s = Session(chunk_capacity=8192)
-    load_tpch(s.catalog, sf=0.005)
-    oracle = mirror_to_sqlite(s.catalog)
+    load_tpch(s.catalog, sf=0.1)
+    oracle = index_tpch_oracle(mirror_to_sqlite(s.catalog))
     return s, oracle
 
 

@@ -68,6 +68,11 @@ DEFAULT_MODULES = (
     # queue lock would stall every scan behind the rebuild it exists
     # to hide (fixture: bad_compaction_lock.py)
     "tidb_tpu/columnar/compaction.py",
+    # fused device top-k (ISSUE 18): the kernels are pure and lock-free
+    # by contract — any lock (or device fetch under one) appearing here
+    # means per-chunk merge state leaked host-side coordination
+    # (fixture: bad_topk_sync.py covers the host-sync half)
+    "tidb_tpu/ops/topk.py",
 )
 
 # attribute names whose call blocks the thread

@@ -62,6 +62,10 @@ DEFAULT_MODULES = (
     # LEAF under the store lock; snapshot/cutover take the store lock
     # only for pointer swaps — the segment build itself runs unlocked
     "tidb_tpu/columnar/compaction.py",
+    # fused device top-k (ISSUE 18): lock-free by contract — the merge
+    # state lives on device and the pipeline owns all coordination, so
+    # any lock acquired here is a discipline violation by definition
+    "tidb_tpu/ops/topk.py",
 )
 
 # NOTE: the serving-tier wait-discipline check (ISSUE 7) moved to

@@ -240,7 +240,10 @@ class ResourceLifecyclePass(Pass):
            "annotated handoff")
 
     SCOPE = ("executor", "columnar", "parallel", "serving", "sharding")
-    EXTRA_FILES = ("tidb_tpu/utils/memory.py",)
+    # ops/topk.py (ISSUE 18): the device top-k kernels allocate carried
+    # merge state the pipeline must release at finalize — the module
+    # itself must stay acquisition-free for that contract to hold
+    EXTRA_FILES = ("tidb_tpu/utils/memory.py", "tidb_tpu/ops/topk.py")
 
     def __init__(self, scope: Sequence[str] = SCOPE,
                  extra_files: Sequence[str] = EXTRA_FILES):

@@ -172,6 +172,10 @@ class SegmentStore:
         # the non-force refresh keeps serving the current generation
         self.compaction_on = False
         self._compact_pending = False
+        # CLUSTER BY ordered compaction (ISSUE 18): True while one
+        # statement thread runs the physical re-sort — concurrent
+        # planners skip instead of double-permuting
+        self._recluster_busy = False
         self._touch_seq = 0
         self._seg_seq = 0            # unique per segment: spill file tags
         self._tmp: Optional[str] = None
@@ -289,7 +293,57 @@ class SegmentStore:
             built = self._inline_rebuild_locked()
         self._note_inline(built, outcome="inline_fallback")
 
+    def _want_recluster_locked(self, force: bool) -> bool:
+        """Is an ordered (CLUSTER BY) rewrite due before the next fold?
+        Piggybacks on the fold cadence: the delta threshold that would
+        trigger a rebuild is also what makes re-sorting worthwhile."""
+        t = self.table
+        if not getattr(getattr(t, "schema", None), "cluster_by", None):
+            return False
+        if getattr(t, "clustered_rows", 0) >= t.n:
+            return False
+        if force:
+            return True
+        if self.covered > 0:
+            return t.n - self.covered >= max(self.delta_rows, 1)
+        return t.n >= self.segment_rows
+
+    def _maybe_recluster(self, force: bool = False) -> None:
+        """CLUSTER BY ordered compaction (ISSUE 18), on the STATEMENT
+        thread like gc(): physically re-sort the table by its cluster
+        column right before a delta->segment fold, so the rebuild's
+        zone maps cover sorted row ranges and prune range filters. The
+        permute runs with the STORE lock released (leaf rule; the busy
+        flag keeps a second planner from double-permuting) — but it is
+        Table.recluster that takes the CATALOG writer lock and refuses
+        while any transaction is open, exactly like gc: row positions
+        may only move under that lock with no write log holding
+        positional row ids (a DML's collect-to-apply window runs under
+        it). The resulting data_epoch bump makes the next
+        _refresh_locked rebuild every segment in the new order."""
+        with self._lock:
+            want = self._want_recluster_locked(force) \
+                and not self._recluster_busy
+            if want:
+                self._recluster_busy = True
+        if not want:
+            return
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            moved = self.table.recluster()
+        finally:
+            with self._lock:
+                self._recluster_busy = False
+        if moved:
+            _count_compact_wait(_time.perf_counter() - t0, 0)
+            from tidb_tpu.utils.metrics import COMPACTION_TOTAL
+
+            COMPACTION_TOTAL.inc(outcome="recluster")
+
     def refresh(self, force: bool = False) -> None:
+        self._maybe_recluster(force)
         with self._lock:
             want, built = self._refresh_locked(force=force)
         self._note_inline(built)
@@ -307,6 +361,7 @@ class SegmentStore:
         snapshot segment is reference-counted against invalidation
         until the pin closes. Counts flow to the engine metrics and the
         per-thread statement counters."""
+        self._maybe_recluster()
         with self._lock:
             want, built = self._refresh_locked()
             segs = list(self.segments)

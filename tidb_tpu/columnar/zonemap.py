@@ -94,6 +94,14 @@ def _literal_in_cmp_space(col_type, lit_type, value):
     same float64s the device compares."""
     ck, lk = col_type.kind, lit_type.kind
     if ck == TypeKind.FLOAT:
+        if lk == TypeKind.DECIMAL:
+            # DECIMAL literal vs FLOAT column: the compiler aligns on
+            # the decimal scale (float side multiplied by 10**scale,
+            # literal stays the scaled int, compared in float64) — the
+            # bound must live in that same space, so the zone min/max
+            # get the 10**scale factor and the scaled literal is cast
+            # to the float64 the device promotes it to
+            return float(value), 10 ** lit_type.scale
         return float(value), 1
     if isinstance(value, (float, np.floating)):
         return None

@@ -147,6 +147,11 @@ class ExecContext:
     # scan->filter->project->partial-agg fragments into one jitted
     # program per chunk (tidb_tpu_pipeline_fuse)
     pipeline_fuse: bool = True
+    # fused ORDER BY [+ LIMIT] roots (ISSUE 18): False routes the
+    # statement to the classic materializing sort up front — plan
+    # feedback flips it for digests whose observed LIMIT + offset
+    # overflowed the device top-k capacity gate
+    fused_topn: bool = True
     # staging chunks kept in flight ahead of compute by the prefetch
     # thread; 0 = stage inline (tidb_tpu_pipeline_prefetch_depth)
     prefetch_depth: int = 2

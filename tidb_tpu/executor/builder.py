@@ -156,26 +156,34 @@ def _build_hash_join(plan: PHashJoin) -> HashJoinExec:
 
 
 def _try_fused_scan_probe(plan: PHashJoin):
-    """Inner hash join whose probe side peels to a PLAIN table scan
-    pipeline runs as a fused scan→probe fragment (ISSUE 10): one jitted
-    decode+filter+project+probe+expand program per staged probe chunk,
-    the build side device-resident (and device-buffer-cached when it is
-    itself a plain scan over a stored table). Plan-STATIC gates decide
-    here — outer/semi/anti kinds, other_cond, and multi-key joins
-    (whose packing can fall into hash mode and need the classic tree's
-    exact re-verification) keep the classic tree with its per-operator
-    EXPLAIN ANALYZE breakdown; ctx-dependent gates (sysvars,
-    device-engine routing) defer to the open()-time delegate."""
+    """Inner or LEFT OUTER hash join whose probe side peels to a PLAIN
+    table scan pipeline runs as a fused scan→probe fragment (ISSUE 10,
+    widened by ISSUE 18 to composite keys and the left-outer pad): one
+    jitted decode+filter+project+probe+expand program per staged probe
+    chunk, the build side device-resident (and device-buffer-cached
+    when it is itself a plain scan over a stored table). Plan-STATIC
+    gates decide here — semi/anti kinds and other_cond keep the classic
+    tree with its per-operator EXPLAIN ANALYZE breakdown; ctx-dependent
+    gates (sysvars, device-engine routing) defer to the open()-time
+    delegate, as does the data-dependent hash-mode packing escape
+    (composite key ranges overflowing int64 need the classic probe's
+    exact re-verification, known only after the build drain)."""
     from tidb_tpu.executor.pipeline import FusedScanProbeExec
 
-    if plan.kind != "inner" or plan.other_cond is not None:
+    if plan.kind not in ("inner", "left") or plan.other_cond is not None:
+        return None
+    if plan.exists_sem:
+        return None
+    if plan.kind == "left" and plan.build_side != 1:
+        # the fused probe streams the PRESERVED side; a left join built
+        # on the left would pad the wrong side
         return None
     probe_idx = 1 - plan.build_side
     probe_plan = plan.children[probe_idx]
     build_plan = plan.children[plan.build_side]
     probe_keys = plan.eq_left if probe_idx == 0 else plan.eq_right
     build_keys = plan.eq_right if plan.build_side == 1 else plan.eq_left
-    if len(probe_keys) != 1 or len(build_keys) != 1:
+    if len(probe_keys) != len(build_keys) or not probe_keys:
         return None
     stages, base = peel_stages(probe_plan)
     if type(base) is not PScan or base.table is None:
@@ -202,6 +210,40 @@ def _try_fused_scan_probe(plan: PHashJoin):
         list(build_plan.schema),
         build_child_build=lambda: build_executor(build_plan),
         build_table=build_table, build_tag=build_tag,
+        kind=plan.kind, fallback_build=fallback)
+
+
+def _try_fused_scan_topn(plan):
+    """ORDER BY [+ LIMIT] root whose child peels to a PLAIN table scan
+    pipeline runs as a fused scan→top-k fragment (ISSUE 18): one jitted
+    decode+filter+project+top-k-merge program per staged chunk, a
+    bounded device state of the current winners, one fetch at finalize.
+    Plan-static gates only reject shapes with no scan pipeline to fuse;
+    the capacity gates (LIMIT + offset vs the chunk capacity, table
+    size for a full ORDER BY) are ctx/data-dependent and defer to the
+    open()-time delegate — which is where the k-overflow feedback
+    record comes from."""
+    from tidb_tpu.executor.pipeline import FusedScanTopNExec
+
+    if not plan.items:
+        return None
+    stages, base = peel_stages(plan.child)
+    if type(base) is not PScan or base.table is None:
+        return None
+    topn = isinstance(plan, PTopN)
+
+    def fallback(plan=plan):
+        if isinstance(plan, PTopN):
+            return TopNExec(plan.schema, build_executor(plan.child),
+                            plan.items, plan.count, plan.offset)
+        return SortExec(plan.schema, build_executor(plan.child),
+                        plan.items)
+
+    return FusedScanTopNExec(
+        plan.schema, base.schema, base.table,
+        scan_stages_for(base, stages), scan_prune_bounds(base),
+        plan.items, plan.count if topn else None,
+        plan.offset if topn else 0, full_sort=not topn,
         fallback_build=fallback)
 
 
@@ -310,6 +352,9 @@ def _build_executor(plan: PhysicalPlan) -> Executor:
             return fused
         return _build_hash_join(plan)
     if isinstance(plan, PSort):
+        fused = _try_fused_scan_topn(plan)
+        if fused is not None:
+            return fused
         return SortExec(plan.schema, build_executor(plan.child), plan.items)
     if isinstance(plan, PWindow):
         from tidb_tpu.executor.window import WindowExec
@@ -319,6 +364,9 @@ def _build_executor(plan: PhysicalPlan) -> Executor:
                           plan.out_uid, plan.out_type, plan.params,
                           frame=plan.frame)
     if isinstance(plan, PTopN):
+        fused = _try_fused_scan_topn(plan)
+        if fused is not None:
+            return fused
         return TopNExec(plan.schema, build_executor(plan.child), plan.items, plan.count, plan.offset)
     if isinstance(plan, PLimit):
         return LimitExec(plan.schema, build_executor(plan.child), plan.count, plan.offset)

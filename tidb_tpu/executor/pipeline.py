@@ -65,7 +65,8 @@ from tidb_tpu.utils.jitcache import cached_jit
 from tidb_tpu.utils.memory import QueryOOMError
 
 __all__ = ["DEVICE_CACHE", "DeviceBufferCache", "ChunkPrefetcher",
-           "FusedScanAggExec", "FusedScanProbeExec", "table_ident"]
+           "FusedScanAggExec", "FusedScanProbeExec", "FusedScanTopNExec",
+           "table_ident"]
 
 
 def table_ident(table) -> tuple:
@@ -789,8 +790,9 @@ class FusedScanAggExec(_StagedScanMixin, HashAggExec):
 # ---------------------------------------------------------------------------
 
 
-def _make_fused_probe_fn(stages, col_types, key_ir, key_mode, probe_uids,
-                         direct: bool, probe: str, seg_cap: Optional[int]):
+def _make_fused_probe_fn(stages, col_types, key_irs, modes, probe_uids,
+                         direct: bool, probe: str, left: bool,
+                         seg_cap: Optional[int]):
     """(staged scan inputs, build arrays) -> (first output tile, totals,
     probe state): decode + filter + project + key pack + probe range
     lookup + count + prefix sum + first-tile expansion as ONE program.
@@ -805,7 +807,14 @@ def _make_fused_probe_fn(stages, col_types, key_ir, key_mode, probe_uids,
     dispatches for the remainder. The probe's range lookup runs through
     ``probe_ranges_any`` — the SAME traced step as the standalone
     probe kernel (direct-address index / open-addressing table /
-    searchsorted), so the fused and classic paths cannot drift."""
+    searchsorted), so the fused and classic paths cannot drift.
+
+    ISSUE 18 widens the shape: composite keys pack through the SAME
+    ``jk.pack_keys`` range packer as the standalone probe (the traced
+    pack ranges arrive as args), and LEFT OUTER pads every live
+    unmatched probe row with one NULL-build-payload slot in-program —
+    ``real_count`` rides the deferral token so the overflow
+    re-expansion masks the pad slots identically."""
     from tidb_tpu.expression.compiler import eval_expr
     from tidb_tpu.ops.segment_scan import make_segment_scan_fn
 
@@ -813,36 +822,54 @@ def _make_fused_probe_fn(stages, col_types, key_ir, key_mode, probe_uids,
 
     def run(data, valid, refs, sel, sorted_keys, n_build, firsts,
             lo_packed, rng_packed, tkeys, tlos, this, tok,
-            b_datas, b_valids):
+            los, strides, rngs, b_datas, b_valids):
         ch = _barrier_chunk(scan_fn(data, valid, refs, sel))
-        kd, kv = eval_expr(key_ir, ch)
-        packed = jk.as_int64_key(kd, key_mode)
-        ok = kv & ch.sel
-        start, end, in_range = jk.probe_ranges_any(
+        kds, kvs = [], []
+        for ir in key_irs:
+            kd, kv = eval_expr(ir, ch)
+            kds.append(kd)
+            kvs.append(kv)
+        packed, kvalid, pack_ok = jk.pack_keys(
+            kds, kvs, los, strides, rngs, ch.sel, modes, False)
+        ok = kvalid & ch.sel
+        start, end, range_ok = jk.probe_ranges_any(
             sorted_keys, n_build, packed, firsts, lo_packed, rng_packed,
             tkeys, tlos, this, tok, direct, probe)
+        in_range = pack_ok & range_ok
         count = jnp.where(ok & in_range, end - start, 0)
+        real_count = count
+        if left:
+            # unfiltered LEFT JOIN: every live probe row emits >= 1
+            # slot; the pad slot carries NULL build payload (the
+            # classic probe's left_pad arithmetic, traced here)
+            count = jnp.where(ch.sel, jnp.maximum(count, 1), 0)
         cum = jnp.cumsum(count)
         total = cum[-1]
         R = packed.shape[0]
         B = sorted_keys.shape[0]
-        valid_out, probe_row, build_pos, _k = jk.tile_positions(
+        valid_out, probe_row, build_pos, k = jk.tile_positions(
             start, count, cum, 0, R, R, B)
         p_cols = tuple((ch.columns[u].data, ch.columns[u].valid)
                        for u in probe_uids)
         out_p = tuple((jnp.take(d, probe_row, mode="clip"),
                        jnp.take(v, probe_row, mode="clip") & valid_out)
                       for d, v in p_cols)
+        bmask = valid_out
+        if left:
+            bmask = bmask & (k < jnp.take(real_count, probe_row,
+                                          mode="clip"))
         out_b = tuple((jnp.take(d, build_pos, mode="clip"),
-                       jnp.take(v, build_pos, mode="clip") & valid_out)
+                       jnp.take(v, build_pos, mode="clip") & bmask)
                       for d, v in zip(b_datas, b_valids))
-        return out_p, out_b, valid_out, total, start, count, cum, p_cols
+        return (out_p, out_b, valid_out, total, start, count, real_count,
+                cum, p_cols)
 
     return run
 
 
 class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
-    """Inner hash join whose probe side is a plain scan pipeline, run
+    """Inner or LEFT OUTER hash join (single- or composite-key, ISSUE
+    18) whose probe side is a plain scan pipeline, run
     as a push-based device fragment (ISSUE 10): each staged probe chunk
     streams through ONE jitted scan→probe→expand program against a
     device-resident build table, cutting the classic tree's per-chunk
@@ -865,9 +892,9 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
     def __init__(self, schema, scan_schema, table, stages, prune_bounds,
                  probe_schema, probe_keys, build_keys, build_schema,
                  build_child_build, build_table=None, build_tag=None,
-                 fallback_build=None):
+                 kind="inner", fallback_build=None):
         Executor.__init__(self, schema, [])
-        self.kind = "inner"
+        self.kind = kind
         self.probe_keys = probe_keys
         self.build_keys = build_keys
         self.other_cond = None
@@ -906,6 +933,17 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
         self._ran_fused = True
         try:
             self._open_build(ctx)
+            if self._hash_mode:
+                # composite-key ranges overflowed int64 range packing
+                # (data-dependent, known only after the build drain):
+                # hash candidates need the classic probe's exact per-key
+                # re-verification after expansion, so keep the classic
+                # tree — its feedback pairs were parked by _open_build
+                self._ran_fused = False
+                d = self._fallback_build()
+                d.open(ctx)
+                self._delegate = d
+                return
             jobs = self._plan_staging(ctx)
             self._fused_fn = self._make_fused()
             self._staged_iter = self._staged_chunks(jobs)
@@ -1029,13 +1067,14 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
                                for u in self._payload_uids)
         key = ("probe|" + segment_scan_key(stages, col_types, seg_cap)
                + "|" + repr((self.probe_keys, self._modes, self._direct,
-                             probe, probe_uids,
+                             probe, probe_uids, self.kind,
                              tuple(self._payload_uids))))
         return cached_jit(
             "fusedprobe", key,
             lambda: _make_fused_probe_fn(
-                stages, col_types, self.probe_keys[0], self._modes[0],
-                probe_uids, self._direct, probe, seg_cap))
+                stages, col_types, tuple(self.probe_keys),
+                tuple(self._modes), probe_uids, self._direct, probe,
+                self.kind == "left", seg_cap))
 
     def _fill_pending_fused(self) -> None:
         """Pull staged probe chunks until output lands in _pending or
@@ -1070,20 +1109,22 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
         t0 = time.perf_counter()
         JOIN_PROBE_MODE_TOTAL.inc(mode="fused_" + self._fused_probe_label)
         data, valid, refs, sel = staged
-        out_p, out_b, sel_tile, total_dev, start, count, cum, p_cols = \
+        (out_p, out_b, sel_tile, total_dev, start, count, real_count,
+         cum, p_cols) = \
             self._fused_fn(data, valid, refs, sel, self._sorted_keys,
                            self._n_build_dev, self._firsts,
                            self._direct_lo_dev, self._direct_rng_dev,
-                           *self._table_args, self._b_datas,
-                           self._b_valids)
+                           *self._table_args, self._los, self._strides,
+                           self._rngs, self._b_datas, self._b_valids)
         tok = {"out_p": out_p, "out_b": out_b, "sel_tile": sel_tile,
                "total_dev": total_dev, "start": start, "count": count,
-               "cum": cum, "p_cols": p_cols,
+               "real_count": real_count, "cum": cum, "p_cols": p_cols,
                "cap": int(sel_tile.shape[0]), "t0": t0}
         # the window pins the chunk's expanded tile AND the probe state
         # needed for a potential overflow re-expansion
         tok["nbytes"] = _pytree_nbytes(
-            (out_p, out_b, sel_tile, start, count, cum, p_cols))
+            (out_p, out_b, sel_tile, start, count, real_count, cum,
+             p_cols))
         return tok
 
     def _finish_fused_batch(self, tokens: List[dict]) -> None:
@@ -1146,9 +1187,11 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
             rem = -(-(total - w0) // cap)  # ceil-div: tiles still needed
             T = min(jk.shape_bucket(rem, floor=1), max_tiles)
             out_p, out_b, sel_t, _pr, _bp = jk.expand_tiles(
-                tok["start"], tok["count"], tok["count"], tok["cum"], w0,
-                p_datas, p_valids, b_datas, b_valids, n_tiles=T,
-                tile_cap=cap, build_cap=self._sorted_keys.shape[0])
+                tok["start"], tok["count"], tok["real_count"],
+                tok["cum"], w0, p_datas, p_valids, b_datas, b_valids,
+                n_tiles=T, tile_cap=cap,
+                build_cap=self._sorted_keys.shape[0],
+                left=self.kind == "left")
             for i in range(min(T, rem)):
                 cols = {}
                 for c, (d2, v2) in zip(self.probe_schema, out_p):
@@ -1159,3 +1202,203 @@ class FusedScanProbeExec(_StagedScanMixin, HashJoinExec):
                 self._pending.append(Chunk(cols, sel_t[i]))
                 self.stats.chunks += 1
             w0 += T * cap
+
+
+# ---------------------------------------------------------------------------
+# fused scan→top-k programs (ISSUE 18: fusing the operator long tail)
+# ---------------------------------------------------------------------------
+
+
+def _make_fused_topn_fn(stages, col_types, sort_irs, descs, out_uids,
+                        seg_cap: Optional[int]):
+    """(state, staged scan inputs) -> state: decode + filter + project +
+    per-chunk top-k merge as ONE program. The bounded top-k state (the
+    C = shape_bucket(offset + count) current winners, ops/topk.py
+    layout) is the only thing carried between chunks — exactly the
+    fused aggregate's state contract, so the scan never materializes to
+    host and the winners are fetched once at finalize."""
+    from tidb_tpu.expression.compiler import eval_expr
+    from tidb_tpu.ops import topk as tk
+    from tidb_tpu.ops.segment_scan import make_segment_scan_fn
+
+    scan_fn = make_segment_scan_fn(stages, col_types, seg_stride=seg_cap)
+
+    def run(state, data, valid, refs, sel):
+        ch = _barrier_chunk(scan_fn(data, valid, refs, sel))
+        pairs = tuple(tk.rank_operands(*eval_expr(ir, ch), desc)
+                      for ir, desc in zip(sort_irs, descs))
+        payload = tuple((ch.columns[u].data, ch.columns[u].valid)
+                        for u in out_uids)
+        return tk.topk_merge(state, pairs, payload, ch.sel, descs)
+
+    return run
+
+
+class FusedScanTopNExec(_StagedScanMixin, Executor):
+    """ORDER BY [+ LIMIT] root whose child is a plain scan pipeline,
+    run as a push-based device fragment (ISSUE 18): each staged chunk
+    streams through ONE jitted scan→top-k program that folds the
+    chunk's rows into a bounded device state of the current
+    ``offset + count`` winners; the host fetches the winners exactly
+    once at finalize. The classic ``TopNExec`` pays one device_get per
+    chunk (it materializes EVERY child row to host runs before
+    ``np.lexsort`` keeps k of them) — here the full-table host round
+    trip disappears and the sort work per chunk is one cheap
+    single-array cut to C candidates (single sort key; ops/topk.py
+    ``_cut_single_key``) or one ``lax.sort`` over C + chunk_capacity
+    rows (multi-key).
+
+    A full ORDER BY (no LIMIT) takes the same path under a capacity
+    gate — when every live row fits the state (``table.n <= capacity``)
+    the "top n" IS the complete sort; larger inputs keep the classic
+    materializing sort via the open()-time ``fallback_build`` delegate.
+    A LIMIT whose ``offset + count`` exceeds the gate falls back the
+    same way and records the k-overflow on the exec (plan feedback
+    harvests it, so the digest's SECOND execution routes to the classic
+    plan up front instead of re-paying the fallback probe).
+
+    Ordering is bit-exact with the classic path: ops/topk.py replicates
+    ``_sort_order``'s null-rank/negation semantics and ties resolve by
+    global drain position, the device analogue of np.lexsort stability.
+    """
+
+    def __init__(self, schema, scan_schema, table, stages, prune_bounds,
+                 items, count, offset, full_sort=False,
+                 fallback_build=None):
+        Executor.__init__(self, schema, [])
+        self.scan_schema = scan_schema
+        self.table = table
+        self.scan_stages = stages
+        self.prune_bounds = prune_bounds
+        self.items = items
+        self.count = count
+        self.offset = offset
+        self.full_sort = full_sort
+        self._fallback_build = fallback_build
+        self._delegate = None
+        self._ran_fused = False
+        self._topn_overflow = 0
+        self._fb_build_pairs = ()
+        self._pin = None
+        self._prefetcher = None
+        self._seg_cap = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self, ctx: ExecContext) -> None:
+        self.ctx = ctx
+        self._chunks: List[Chunk] = []
+        self._delegate = None
+        self._topn_overflow = 0
+        k, eligible = self._state_rows(ctx)
+        if not eligible:
+            self._ran_fused = False
+            d = self._fallback_build()
+            d.open(ctx)
+            self._delegate = d
+            return
+        self._ran_fused = True
+        try:
+            self._run_fused(ctx, k)
+        finally:
+            self._release_staging()
+
+    def next(self) -> Optional[Chunk]:
+        if self._delegate is not None:
+            return self._delegate.next()
+        if self._chunks:
+            return self._chunks.pop(0)
+        return None
+
+    def close(self) -> None:
+        _close_delegate(self)
+        self._release_staging()
+        super().close()
+
+    def _state_rows(self, ctx: ExecContext):
+        """(k, fuse?) — k the live-row bound the device state must hold
+        (offset + count, or the whole table for a full sort). The gate
+        is the chunk capacity: the per-chunk merge sorts C + capacity
+        rows, so a state larger than one chunk loses the asymptotic
+        win over the classic path anyway. Overflow is recorded on the
+        exec for the feedback harvest (satellite: a digest whose
+        LIMIT + offset proved too big plans classic next time)."""
+        # no device_agg gate: like the segment-strategy fused agg, the
+        # top-k state program wins on every backend (it removes the
+        # classic path's per-chunk host materialization), so host-engine
+        # routing does not demote it
+        if not getattr(ctx, "pipeline_fuse", True) or self.table is None:
+            return 0, False
+        if not getattr(ctx, "fused_topn", True):
+            return 0, False  # plan feedback routed this digest classic
+        if not self.items:
+            return 0, False
+        gate = int(ctx.chunk_capacity)
+        if self.full_sort:
+            k = int(self.table.n)
+        else:
+            k = int(self.count) + int(self.offset)
+        if k > gate:
+            self._topn_overflow = k
+            return k, False
+        return k, True
+
+    # -- fused execution ---------------------------------------------------
+
+    def _run_fused(self, ctx: ExecContext, k: int) -> None:
+        from tidb_tpu.ops import topk as tk
+        from tidb_tpu.ops.segment_scan import segment_scan_key
+        from tidb_tpu.utils import dispatch as dsp
+
+        jobs = self._plan_staging(ctx)
+        col_types = [(c.uid, c.type_) for c in self.scan_schema]
+        stages, seg_cap = self.scan_stages, self._seg_cap
+        cap_state = jk.shape_bucket(k, floor=64)
+        sort_irs = tuple(e for e, _ in self.items)
+        descs = tuple(bool(d) for _, d in self.items)
+        out_uids = tuple(c.uid for c in self.schema)
+        key = ("topn|" + segment_scan_key(stages, col_types, seg_cap)
+               + "|" + repr((self.items, out_uids, cap_state)))
+        fused = cached_jit(
+            "fusedtopk", key,
+            lambda: _make_fused_topn_fn(stages, col_types, sort_irs,
+                                        descs, out_uids, seg_cap),
+            donate_argnums=0)
+        key_floats = tuple(tk.key_spec(e.type_) for e in sort_irs)
+        dtypes = tuple(c.type_.np_dtype for c in self.schema)
+        state = tk.topk_init(cap_state, key_floats, dtypes)
+        for staged in self._staged_chunks(jobs):
+            # KILL/deadline polls BETWEEN device steps: the fusion must
+            # not turn a chunked fragment into an uninterruptible run
+            raise_if_cancelled(ctx)
+            state = fused(state, *staged)
+        # THE intentional top-k sync: ONE fetch of the C winners at
+        # finalize, however many chunks streamed through (sanctioned
+        # device_get outside any loop — the chunk-loop sync-budget pass
+        # watches the loop form)
+        dead, _ranks, _pos, _next, payload = state
+        host = dsp.record_fetch(jax.device_get((dead, payload)))
+        dsp.record(site="fetch")
+        self._emit_winners(*host)
+
+    def _emit_winners(self, dead, payload) -> None:
+        """Slice [offset, offset + count) of the live winners (the
+        state is already in final sort order — dead slots sort last)
+        into capacity-sized output chunks."""
+        n_live = int((np.asarray(dead) == 0).sum())
+        lo = 0 if self.full_sort else min(int(self.offset), n_live)
+        hi = n_live if self.full_sort else min(
+            int(self.offset) + int(self.count), n_live)
+        self.stats.add_out_rows(hi - lo)
+        cap = self.ctx.chunk_capacity
+        for s in range(lo, hi, cap):
+            e = min(s + cap, hi)
+            cols = {}
+            for c, (d, v) in zip(self.schema, payload):
+                cols[c.uid] = Column.from_numpy(
+                    np.asarray(d)[s:e], c.type_,
+                    valid=np.asarray(v)[s:e], capacity=cap)
+            sel = np.zeros(cap, dtype=np.bool_)
+            sel[:e - s] = True
+            self._chunks.append(Chunk(cols, sel))
+            self.stats.chunks += 1

@@ -59,7 +59,7 @@ from tidb_tpu.utils import dispatch
 from tidb_tpu.utils.hashutil import SM_ADD, SM_MUL1, SM_MUL2
 
 __all__ = [
-    "shape_bucket", "as_int64_key", "hash_combine_device",
+    "shape_bucket", "as_int64_key", "hash_combine_device", "pack_keys",
     "build_sort", "build_hash_table", "no_table", "probe_count",
     "probe_ranges_any", "expand_tiles",
     "sort_build_hashes", "probe_hash_ranges", "tile_positions",
@@ -138,6 +138,12 @@ def _pack_device(key_datas, key_valids, los, strides, rngs, sel,
         in_range = in_range & (d >= lo) & (d < lo + rng)
         packed = packed + jnp.clip(d - lo, 0, jnp.maximum(rng - 1, 0)) * stride
     return packed, valid, in_range
+
+
+# the fused scan→probe program (executor/pipeline.py) traces the SAME
+# packing step as the standalone probe kernel, so the two cannot drift
+# on multi-key range packing or the out-of-range mask
+pack_keys = _pack_device
 
 
 # -- build: pack + sort + payload gather, all on device ---------------------

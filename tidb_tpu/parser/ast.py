@@ -321,6 +321,9 @@ class CreateTableStmt:
     # cross-worker placement metadata (tidb_tpu/sharding), orthogonal to
     # the single-process PARTITION BY pruning above
     shard: Optional[tuple] = None
+    # CLUSTER BY (col): keep the table physically ordered by this column
+    # at delta->segment compaction so zone maps prune (ISSUE 18)
+    cluster: Optional[str] = None
     temporary: bool = False  # CREATE TEMPORARY TABLE (session-local)
     # table options accepted but not implemented (-> SHOW WARNINGS)
     ignored: List[str] = field(default_factory=list)
@@ -355,9 +358,12 @@ class AlterTableStmt:
     action: str = ""          # add_column | drop_column | rename | add_index
                               # | add_foreign_key | drop_foreign_key
                               # | add_check | drop_check | reshard
+                              # | cluster
     column: Optional[ColumnDef] = None
     # reshard: new SHARD BY spec, same shape as CreateTableStmt.shard
     shard: Optional[tuple] = None
+    # cluster: new CLUSTER BY column (None = CLUSTER BY NONE, clears it)
+    cluster: Optional[str] = None
     old_name: Optional[str] = None
     new_name: Optional[str] = None
     index: Optional[Tuple[str, List[str]]] = None

@@ -794,13 +794,39 @@ class Parser:
                 stmt.partition = ("hash", col, n)
             else:
                 raise self.error("expected RANGE or HASH after PARTITION BY")
-        # SHARD BY HASH (col) SHARDS n | SHARD BY RANGE (col) SHARDS
-        # (b1, b2, ...) — cross-worker placement (tidb_tpu/sharding):
-        # k ascending bounds make k+1 shards, shard i = [b_{i-1}, b_i)
-        if self._accept_word("shard"):
+        # Trailing table options, composable in ANY order (each at most
+        # once):
+        #   SHARD BY HASH (col) SHARDS n | SHARD BY RANGE (col) SHARDS
+        #   (b1, b2, ...) — cross-worker placement (tidb_tpu/sharding):
+        #   k ascending bounds make k+1 shards, shard i = [b_{i-1}, b_i)
+        #   CLUSTER BY (col) — keep the table physically ordered by
+        #   this column at delta->segment compaction so zone maps prune
+        #   without hand-ordered ingest (ISSUE 18)
+        seen = set()
+        while True:
+            if self._accept_word("shard"):
+                opt = "shard"
+            elif self._accept_word("cluster"):
+                opt = "cluster"
+            else:
+                break
+            if opt in seen:
+                raise self.error(f"duplicate {opt.upper()} BY clause")
+            seen.add(opt)
             self.expect_kw("by")
-            stmt.shard = self._parse_shard_spec()
+            if opt == "shard":
+                stmt.shard = self._parse_shard_spec()
+            else:
+                stmt.cluster = self._parse_cluster_spec()
         return stmt
+
+    def _parse_cluster_spec(self) -> Optional[str]:
+        if self._accept_word("none"):
+            return None
+        self.expect_op("(")
+        col = self.expect_ident()
+        self.expect_op(")")
+        return col
 
     def _parse_shard_spec(self) -> tuple:
         if self._accept_word("hash"):
@@ -1093,6 +1119,13 @@ class Parser:
             self.expect_kw("by")
             return AlterTableStmt(table, "reshard",
                                   shard=self._parse_shard_spec())
+        if self._accept_word("cluster"):
+            # ALTER TABLE t CLUSTER BY (col) | CLUSTER BY NONE — ordered
+            # compaction hint: the next delta->segment fold physically
+            # re-sorts the table by this column (ISSUE 18)
+            self.expect_kw("by")
+            return AlterTableStmt(table, "cluster",
+                                  cluster=self._parse_cluster_spec())
         raise self.error("unsupported ALTER TABLE action")
 
     # -- misc statements -----------------------------------------------------

@@ -115,6 +115,9 @@ class Observation:
         self.tile_chunks = 0
         self.tile_overflows = 0
         self.tile_max_need = 0
+        # fused top-k (ISSUE 18): largest LIMIT+offset k a FusedScanTopNExec
+        # observed past its device capacity gate (0 = never overflowed)
+        self.topn_overflow = 0
         self.worst_drift = 0.0       # max(ratio, 1/ratio) over known ops
         self.worst_drift_op = ""
         self.worst_drift_ratio = 1.0  # signed actual/est of the worst op
@@ -126,7 +129,8 @@ class _Variant:
     __slots__ = ("digest", "plan_digest", "apd", "execs", "warm_execs",
                  "best_warm_s", "best_any_s", "eager_partial",
                  "fused_probe", "ops", "tile_chunks", "tile_overflows",
-                 "tile_max_need", "worst_drift", "worst_drift_op")
+                 "tile_max_need", "topn_overflow", "worst_drift",
+                 "worst_drift_op")
 
     def __init__(self, digest: str, plan_digest: str, apd: bool):
         self.digest = digest
@@ -142,6 +146,7 @@ class _Variant:
         self.tile_chunks = 0
         self.tile_overflows = 0
         self.tile_max_need = 0
+        self.topn_overflow = 0
         self.worst_drift = 0.0
         self.worst_drift_op = ""
 
@@ -386,6 +391,7 @@ class PlanFeedbackStore:
             v.tile_chunks += obs.tile_chunks
             v.tile_overflows += obs.tile_overflows
             v.tile_max_need = max(v.tile_max_need, obs.tile_max_need)
+            v.topn_overflow = max(v.topn_overflow, obs.topn_overflow)
             if obs.worst_drift > v.worst_drift:
                 v.worst_drift = obs.worst_drift
                 v.worst_drift_op = obs.worst_drift_op
@@ -508,6 +514,19 @@ class PlanFeedbackStore:
                     need = max(need, v.tile_max_need)
             return min(need, 64)
 
+    def topn_overflow(self, digest: str) -> int:
+        """Largest ORDER BY+LIMIT k this digest was observed to need
+        PAST the fused top-k capacity gate (0 = never overflowed). The
+        session consumes it per statement: an overflowing digest's
+        SECOND execution starts on the classic materializing sort
+        instead of re-failing the fused gate at every open()."""
+        with self.lock:
+            variants = self._by_digest.get(digest)
+            if not variants:
+                return 0
+            return max((v.topn_overflow for v in variants.values()),
+                       default=0)
+
     def shuffle_hint(self, digest: str,
                      versions: Optional[Dict[str, int]] = None
                      ) -> Dict[str, int]:
@@ -574,6 +593,7 @@ class PlanFeedbackStore:
                         "worst_drift": round(v.worst_drift, 3),
                         "worst_drift_op": v.worst_drift_op,
                         "tile_overflow": [v.tile_overflows, v.tile_chunks],
+                        "topn_overflow": v.topn_overflow,
                         "ops": {op: [round(o.est_rows, 2),
                                      round(o.actual_rows, 2)]
                                 for op, o in v.ops.items()},
@@ -708,6 +728,12 @@ def harvest(phys, root, result_rows: int, latency_s: float,
                 obs.tile_overflows += st.tile_overflows
                 obs.tile_max_need = max(obs.tile_max_need,
                                         st.tile_max_need)
+        if type(e).__name__ == "FusedScanTopNExec" \
+                and getattr(e, "_topn_overflow", 0):
+            # the k this root WANTED but couldn't fuse — the store's
+            # topn_overflow() consumer routes the digest classic
+            obs.topn_overflow = max(obs.topn_overflow,
+                                    int(e._topn_overflow))
         # actuals a transient subtree learned before it was dropped —
         # a fused probe's drained build child, or EITHER fused exec's
         # open()-time fallback delegate tree (_close_delegate parks

@@ -1069,6 +1069,12 @@ class Session:
                 need = _fb.STORE.tile_hint(memo[2])
                 if need > ctx.join_tiles:
                     ctx.join_tiles = need
+                # fused top-k consumer (ISSUE 18): a digest whose
+                # ORDER BY+LIMIT k overflowed the device capacity gate
+                # starts classic on its SECOND execution instead of
+                # re-failing the gate at every open()
+                if _fb.STORE.topn_overflow(memo[2]):
+                    ctx.fused_topn = False
         return ctx
 
     def _wire_probe_mode(self) -> str:
@@ -2316,6 +2322,15 @@ class Session:
             t.checks = [c for c in t.checks if c.name != stmt.old_name]
             if len(t.checks) == before:
                 raise SchemaError(f"no CHECK constraint {stmt.old_name!r}")
+        elif stmt.action == "cluster":
+            # ordered-compaction hint (ISSUE 18): persisted on the
+            # schema; the NEXT delta->segment fold physically re-sorts
+            # the table (Table.recluster), so the statement itself stays
+            # metadata-only like reshard
+            t.schema.cluster_by = self._cluster_by_col(
+                stmt.cluster, t.schema.columns)
+            base = getattr(t, "_base", t)
+            base.clustered_rows = 0  # force the re-sort at the next fold
         elif stmt.action == "reshard":
             # new placement metadata; version bump invalidates placement
             # snapshots, schema_version bump (below) invalidates cached
@@ -2331,6 +2346,22 @@ class Session:
         # version per DDL job) — plan-cache invalidation hangs off it
         self.catalog.schema_version += 1
         return None
+
+    @staticmethod
+    def _cluster_by_col(name, cols):
+        """Validate a CLUSTER BY column name (None = clear the hint).
+        Any orderable type works — dictionary codes order
+        lexicographically by construction — except JSON, whose code
+        order carries no meaning worth sorting a table by."""
+        if name is None:
+            return None
+        info = next((c for c in cols if c.name == name), None)
+        if info is None:
+            raise SchemaError(f"unknown cluster column {name!r}")
+        if info.type_.kind == TypeKind.JSON:
+            raise SchemaError(
+                f"cluster column {name!r} must not be JSON-typed")
+        return name
 
     @staticmethod
     def _shard_by_info(spec, cols):
@@ -2395,7 +2426,9 @@ class Session:
                                      n_parts=int(spec))
         schema = TableSchema(stmt.table.name, cols, primary_key=pk,
                              collation=stmt.collation, partition=part,
-                             shard_by=self._shard_by_info(stmt.shard, cols))
+                             shard_by=self._shard_by_info(stmt.shard, cols),
+                             cluster_by=self._cluster_by_col(
+                                 stmt.cluster, cols))
         if stmt.temporary:
             if stmt.foreign_keys:
                 raise UnsupportedError(
@@ -2884,22 +2917,29 @@ class Session:
             from tidb_tpu.planner.rules import fold_constants
 
             stages.append(("filter", fold_constants(cond)))
-        scan = TableScanExec(schema=cols, table=table, stages=stages)
+        # the scan's __rowid__ pseudo-column carries each row's TRUE
+        # physical id. Reconstructing ids from chunk position (live +
+        # running chunk_capacity) is wrong under the columnar store:
+        # segment chunks size to the segment (not chunk_capacity) and
+        # zone pruning skips ranges, so positional math deletes/updates
+        # the wrong rows or misses delta rows entirely.
+        rid = PlanCol(uid=binder.new_uid(f"{table_name}.__rowid__"),
+                      name="__rowid__", type_=INT64, qualifier=table_name)
+        scan = TableScanExec(schema=cols + [rid], table=table, stages=stages)
         ctx = self._exec_ctx()
         scan.open(ctx)
         ids = []
-        base = 0
         try:
             while True:
                 ch = scan.next()
                 if ch is None:
                     break
                 live = np.nonzero(np.asarray(ch.sel))[0]
-                ids.append(live + base)
-                base += ctx.chunk_capacity
+                ids.append(np.asarray(ch.col(rid.uid).data)[live])
         finally:
             scan.close()
-        return np.concatenate(ids) if ids else np.zeros(0, dtype=np.int64)
+        return (np.concatenate(ids).astype(np.int64)
+                if ids else np.zeros(0, dtype=np.int64))
 
     def _multi_table_targets(self, stmt) -> List[A.TableName]:
         """All base tables in a multi-table DML's table-refs tree."""
@@ -3475,6 +3515,8 @@ class Session:
                         for n, u in zip(pi.names, pi.uppers))
                     ddl += (f"\nPARTITION BY RANGE (`{pi.column}`) "
                             f"({parts})")
+            if t.schema.cluster_by:
+                ddl += f"\nCLUSTER BY (`{t.schema.cluster_by}`)"
             return ResultSet(names=["Table", "Create Table"],
                              rows=[(stmt.target, ddl)])
         if stmt.kind == "create_view":

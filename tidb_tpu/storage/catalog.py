@@ -474,6 +474,7 @@ class Catalog:
 
         t = make_table(schema, engine)
         t.ts_source = self.next_ts
+        t.txn_guard = self  # recluster's writer-lock + open-txn gate
         # two-pass: every FK spec must RESOLVE before any back-edge is
         # written — a failure after partial wiring would leave phantom
         # references blocking DROP of the parents forever
@@ -1067,6 +1068,7 @@ class SessionCatalog:
 
         t = make_table(schema, engine)
         t.ts_source = self._base.next_ts
+        t.txn_guard = self._base
         self._temp[(db, schema.name)] = t
         object.__setattr__(self, "_temp_epoch", self._temp_epoch + 1)
         return t

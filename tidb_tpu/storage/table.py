@@ -214,6 +214,11 @@ class TableSchema:
     partition: Optional[PartitionInfo] = None
     # SHARD BY metadata (cross-worker placement); None = unsharded
     shard_by: Optional[ShardByInfo] = None
+    # CLUSTER BY column (ISSUE 18): delta->segment compaction keeps the
+    # table physically sorted by this column (ASC, NULLs first) so the
+    # columnar store's zone maps prune range filters without the loader
+    # having to hand-order ingest; None = no ordered compaction
+    cluster_by: Optional[str] = None
 
     def col(self, name: str) -> ColumnInfo:
         for c in self.columns:
@@ -262,9 +267,20 @@ class Table:
         # (every DML bumps it) and under-describes (it can't tell an
         # append from a rewrite).
         self.data_epoch = 0
+        # CLUSTER BY watermark: leading physical rows known to be in
+        # cluster order. Appends grow `n` past it (the delta is
+        # unordered); recluster() advances it to `n`. Order-preserving
+        # rewrites (gc's mask compaction) keep a full watermark valid.
+        self.clustered_rows = 0
         self._auto_inc = 1
         self._local_ts = 0  # fallback TSO for catalog-less tables
         self.ts_source = None  # catalog-provided TSO (set by create_table)
+        # owning catalog (set by create_table): recluster() takes its
+        # writer lock and consults its open-txn registry, because the
+        # single-writer invariant it must respect is CATALOG-wide (a
+        # DML's collect-to-apply window under catalog.lock), not
+        # visible from this table's provisional state alone
+        self.txn_guard = None
         cap = _MIN_CAP
         self._cap = cap
         self.data: Dict[str, np.ndarray] = {}
@@ -1298,6 +1314,8 @@ class Table:
         del self.data[name]
         del self.valid[name]
         self.dicts.pop(name, None)
+        if self.schema.cluster_by == name:
+            self.schema.cluster_by = None  # ordering key is gone
         self.version += 1
         self.data_epoch += 1  # column set changed under existing rows
 
@@ -1773,6 +1791,10 @@ class Table:
             self.valid[name][m:n] = False
         self.begin_ts[:m] = self.begin_ts[:n][keep]
         self.end_ts[:m] = self.end_ts[:n][keep]
+        # mask compaction preserves relative order: a FULLY clustered
+        # table stays clustered; a partial watermark would need per-row
+        # accounting, so it conservatively resets
+        self.clustered_rows = m if self.clustered_rows >= n else 0
         self.n = m
         self.data_epoch += 1  # physical row positions moved
         # release buffer memory when the table shrank far below capacity
@@ -1787,6 +1809,83 @@ class Table:
         self.version += 1
         return k
 
+    def recluster(self) -> bool:
+        """Physically re-sort ALL rows by the CLUSTER BY column (ASC,
+        NULLs first, stable — so same-key rows keep arrival order) so
+        segment zone maps over the rebuild prune range filters (ISSUE
+        18). Returns True when rows actually moved (data_epoch bumps,
+        invalidating the segment store for an ordered rebuild).
+
+        Row positions may only move under the catalog's writer lock
+        with NO transaction open — the same contract as gc(): txn write
+        logs address rows by position, and _run_dml's collect-to-apply
+        window assumes positions are stable while it holds the catalog
+        lock. Scans trigger recluster from the statement path WITHOUT
+        that lock, so the permute takes it here (re-entrant for a DML's
+        own internal scan) and refuses — returning False, trying again
+        at a later fold — while the catalog's open-txn registry is
+        non-empty. Catalog-less tables (unit fixtures) fall back to the
+        table-local evidence of an open txn: provisional begin/end
+        timestamps, pessimistic row locks, provisionally-ended rows."""
+        col = self.schema.cluster_by
+        if not col or col not in self.data or self.n <= 1:
+            return False
+        if self.clustered_rows >= self.n:
+            return False  # already in order
+        guard = self.txn_guard
+        if guard is None:
+            return self._recluster_locked()
+        with guard.lock:
+            if guard._open_txns:
+                return False
+            return self._recluster_locked()
+
+    def _recluster_locked(self) -> bool:
+        """The permute body; caller holds the catalog lock (or owns the
+        table outright). The table-local open-txn checks stay as
+        defense in depth for catalog-less tables."""
+        col = self.schema.cluster_by
+        n = self.n
+        if self.clustered_rows >= n:
+            return False  # raced: another caller sorted first
+        if self.row_locks or self._txn_dead:
+            return False
+        b, e = self.begin_ts[:n], self.end_ts[:n]
+        if (b >= TXN_TS_BASE).any() or \
+                ((e >= TXN_TS_BASE) & (e < MAX_TS)).any():
+            return False
+        d, v = self.data[col][:n], self.valid[col][:n]
+        if np.issubdtype(d.dtype, np.floating):
+            key = d.astype(np.float64)
+        else:
+            # dict codes order lexicographically by construction, so
+            # sorting string/date columns by code is sorting by value
+            key = d.astype(np.int64)
+        key = np.where(v, key, 0)
+        nullrank = v.astype(np.int64)  # NULLs first, like ASC sort
+        order = np.lexsort((key, nullrank))
+        if (order == np.arange(n)).all():
+            self.clustered_rows = n  # already sorted: watermark only
+            return False
+        # permute into FRESH buffers first — each fancy-index allocates
+        # (tens of MB per column at SF1), and a MemoryError halfway
+        # through an in-place loop would leave some columns permuted
+        # and others not, permanently. The install loop below is plain
+        # buffer copies into existing storage: nothing left to fail.
+        perm = [(name, self.data[name][:n][order],
+                 self.valid[name][:n][order]) for name in self.data]
+        b_new = self.begin_ts[:n][order]
+        e_new = self.end_ts[:n][order]
+        for name, d_new, v_new in perm:
+            self.data[name][:n] = d_new
+            self.valid[name][:n] = v_new
+        self.begin_ts[:n] = b_new
+        self.end_ts[:n] = e_new
+        self.clustered_rows = n
+        self.data_epoch += 1  # physical row positions moved
+        self.version += 1
+        return True
+
     def truncate(self):
         if any(child is not self for child, _fk in self.referencing):
             raise ExecutionError(
@@ -1795,6 +1894,7 @@ class Table:
         self.n = 0
         self.version += 1
         self.data_epoch += 1  # every stored payload discarded
+        self.clustered_rows = 0
         self.begin_ts[:] = 0
         self.end_ts[:] = MAX_TS
         for c in self.schema.columns:

@@ -209,7 +209,8 @@ def _load_orders_lineitem_native(make_table, counts, sf, seed,
 
 def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 7,
               native: Optional[bool] = None,
-              cluster_lineitem: bool = False) -> Dict[str, int]:
+              cluster_lineitem: bool = False,
+              cluster: bool = True) -> Dict[str, int]:
     """Generate and ingest all eight TPC-H tables at scale factor `sf`.
     Returns table -> row count.
 
@@ -218,12 +219,25 @@ def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 
     codes with no per-row Python objects. None = auto (native when the
     library builds/loads); False forces the numpy oracle generator.
 
-    `cluster_lineitem` ingests lineitem in l_shipdate order — the
-    time-ordered-arrival layout production fact tables have (rows land
-    as they ship), which is what makes the columnar store's date zone
-    maps prune (ISSUE 8's Q6 floor measures exactly this). Implies the
-    numpy generator for orders/lineitem; query results are unaffected
-    (row order is not observable through SQL)."""
+    `cluster` (default) declares ``CLUSTER BY (l_shipdate)`` on
+    lineitem: ordered compaction (ISSUE 18) physically sorts the fact
+    table at the first delta->segment fold, so the columnar store's
+    date zone maps prune (ISSUE 8's Q6 floor) regardless of ingest
+    order — no hand-ordered load needed. Row order is not observable
+    through SQL, so query results are unaffected.
+
+    `cluster_lineitem` (DEPRECATED — `cluster` supersedes it) ingests
+    lineitem pre-sorted in l_shipdate order. Implies the numpy
+    generator for orders/lineitem."""
+    if cluster_lineitem:
+        import warnings
+
+        warnings.warn(
+            "load_tpch(cluster_lineitem=True) is deprecated: lineitem "
+            "now carries CLUSTER BY (l_shipdate) by default "
+            "(cluster=True) and ordered compaction sorts it at the "
+            "first delta->segment fold", DeprecationWarning,
+            stacklevel=2)
     rng = np.random.default_rng(seed)
     counts = {}
 
@@ -235,7 +249,9 @@ def load_tpch(catalog: Catalog, sf: float = 0.01, db: str = "test", seed: int = 
             "part": ["p_partkey"], "partsupp": ["ps_partkey", "ps_suppkey"],
             "orders": ["o_orderkey"], "lineitem": ["l_orderkey", "l_linenumber"],
         }[name]
-        return catalog.create_table(db, TableSchema(name, cols, primary_key=pk))
+        cb = "l_shipdate" if cluster and name == "lineitem" else None
+        return catalog.create_table(
+            db, TableSchema(name, cols, primary_key=pk, cluster_by=cb))
 
     # region / nation -------------------------------------------------------
     t = make_table("region")
