@@ -323,7 +323,8 @@ def main():
               "(need >= 12)")
         if not gr["all_exact"]:
             bad_q = [k for k, v in gr["queries"].items()
-                     if v.get("check") != "ok"]
+                     if v.get("check") != "ok"
+                     or not v.get("device_arm_equal", True)]
             pc_bad.append(f"tpch_grid_exact={bad_q}")
         if gr["fused_queries"] < 12:
             pc_bad.append(f"tpch_grid_fused={gr['fused_queries']} < 12")

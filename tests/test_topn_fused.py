@@ -440,3 +440,120 @@ class TestClusterBy:
         assert (np.diff(t.data["d"][:t.n].astype(np.int64)) >= 0).all()
         assert sorted(s.query("select d, v from cr")) == \
             [(1, 2), (3, 9), (5, 1)]
+
+
+class TestNaNKeyKernel:
+    """NaN sort keys (no SQL literal produces one, but expression
+    evaluation can) must rank exactly like BOTH reference sorts —
+    host ``np.lexsort`` and the XLA variadic merge rank any NaN after
+    every real value in either direction. The single-key candidate cut
+    classes NaN explicitly: ``< thresh`` and ``== thresh`` are both
+    false for NaN, so without its own class the cut silently DROPPED
+    NaN rows (and let them poison the threshold estimate) while the
+    small-chunk merge path kept them."""
+
+    @staticmethod
+    def _operands(vals, valid, desc):
+        v = np.where(valid, -vals if desc else vals, 0.0)
+        nr = (~valid if desc else valid).astype(np.int32)
+        return nr, v
+
+    def _run(self, n, cap, desc, nan_frac, null_frac=0.1, seed=18):
+        import jax.numpy as jnp
+
+        from tidb_tpu.ops.topk import merge_topk, rank_operands, topk_init
+
+        rng = np.random.default_rng(seed)
+        vals = rng.normal(size=n)
+        nan_at = rng.random(n) < nan_frac
+        vals[nan_at] = np.nan
+        valid = ~(rng.random(n) < null_frac) | nan_at  # NULL ∩ NaN = ∅
+        state = topk_init(cap, [True], [np.dtype(np.float64)])
+        data, jvalid = jnp.asarray(vals), jnp.asarray(valid)
+        state = merge_topk(
+            state, (rank_operands(data, jvalid, desc),),
+            ((data, jvalid),), jnp.ones(n, dtype=jnp.bool_), (desc,))
+        dead, ranks, pos, _next, _payload = state
+        got = np.asarray(pos)[np.asarray(dead) == 0]
+        nr, v = self._operands(vals, valid, desc)
+        want = np.lexsort((np.arange(n), v, nr))[:len(got)]
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("desc", [False, True])
+    def test_cut_path_keeps_nans(self, desc):
+        # n >> cap engages _cut_single_key (the path that dropped NaNs)
+        self._run(n=6000, cap=64, desc=desc, nan_frac=0.05)
+
+    @pytest.mark.parametrize("desc", [False, True])
+    def test_merge_path_parity(self, desc):
+        # n <= cap: the full variadic merge, the cut's reference arm
+        self._run(n=48, cap=64, desc=desc, nan_frac=0.25)
+
+    @pytest.mark.parametrize("desc", [False, True])
+    def test_nan_heavy_boundary(self, desc):
+        # NaN class straddles the capacity boundary in both directions
+        self._run(n=5000, cap=64, desc=desc, nan_frac=0.99, null_frac=0.0)
+
+
+class TestReclusterReaderGate:
+    """CLUSTER BY permutes rows IN PLACE; autocommit readers are
+    lock-free and never appear in the catalog's open-txn set, so the
+    permute must also refuse while any statement or scan is counted in
+    the reader registry, and scan-path triggers defer to the statement
+    boundary instead of permuting mid-read."""
+
+    def _clustered(self, s, name="rg", rows=2000):
+        s.query(f"create table {name} (d int, v int) cluster by (d)")
+        random.seed(11)
+        order = list(range(rows))
+        random.shuffle(order)
+        s.query(f"insert into {name} values " + ",".join(
+            f"({d}, {d % 7})" for d in order))
+        return s.catalog.table("test", name)
+
+    def test_refused_while_statement_reader_counted(self):
+        s = Session()
+        t = self._clustered(s)
+        cat = s.catalog
+        cat.reader_enter()
+        try:
+            assert t.recluster() is False
+        finally:
+            cat.reader_exit()
+        assert t.recluster() is True
+        assert t.clustered_rows == t.n
+
+    def test_refused_while_scan_open_across_statements(self):
+        """A paged cursor keeps its executor tree open past the
+        statement that created it: the scan count (not the statement
+        depth) must hold the permute off until close()."""
+        s = Session(chunk_capacity=1 << 9)
+        t = self._clustered(s)
+        from tidb_tpu.executor.builder import build_executor
+        from tidb_tpu.parser import parse
+
+        root = build_executor(s._plan_select(
+            parse("select d, v from rg")[0]))
+        ctx = ExecContext(chunk_capacity=1 << 9)
+        root.open(ctx)
+        try:
+            assert root.next() is not None  # mid-drain
+            assert s.catalog._open_scans >= 1
+            assert t.recluster() is False
+        finally:
+            root.close()
+        assert s.catalog._open_scans == 0
+        assert t.recluster() is True
+
+    def test_scan_trigger_defers_to_statement_boundary(self):
+        """The scan-path trigger (plan_scan/refresh) only NOTES the
+        permute; it runs at the end of the noticing statement, when the
+        reader registry is quiescent — the cadence the fold tests rely
+        on (clustered_rows == n right after the SELECT returns)."""
+        s = Session(chunk_capacity=1 << 10)
+        s.query("set tidb_tpu_segment_rows = 512")
+        t = self._clustered(s)
+        assert s.query("select count(*) from rg") == [(2000,)]
+        assert t.clustered_rows == t.n == 2000
+        assert not s.catalog._recluster_pending
+        assert (np.diff(t.data["d"][:t.n].astype(np.int64)) >= 0).all()

@@ -309,30 +309,49 @@ class SegmentStore:
         return t.n >= self.segment_rows
 
     def _maybe_recluster(self, force: bool = False) -> None:
-        """CLUSTER BY ordered compaction (ISSUE 18), on the STATEMENT
-        thread like gc(): physically re-sort the table by its cluster
-        column right before a delta->segment fold, so the rebuild's
-        zone maps cover sorted row ranges and prune range filters. The
-        permute runs with the STORE lock released (leaf rule; the busy
-        flag keeps a second planner from double-permuting) — but it is
-        Table.recluster that takes the CATALOG writer lock and refuses
-        while any transaction is open, exactly like gc: row positions
-        may only move under that lock with no write log holding
-        positional row ids (a DML's collect-to-apply window runs under
-        it). The resulting data_epoch bump makes the next
-        _refresh_locked rebuild every segment in the new order."""
+        """CLUSTER BY ordered compaction (ISSUE 18). A scan that
+        notices the fold cadence made a re-sort worthwhile must NOT
+        permute here: the caller is a lock-free reader (plan_scan), and
+        other statements may be mid-scan of the very arrays the permute
+        moves — torn rows with no lock to stop them. Instead the due
+        permute is QUEUED on the owning catalog and performed by
+        Session at a statement boundary, under the catalog writer lock
+        with the reader registry quiescent (run_pending_reclusters).
+        Catalog-less tables (unit fixtures, single-owner by
+        construction) keep the immediate permute."""
         with self._lock:
             want = self._want_recluster_locked(force) \
                 and not self._recluster_busy
-            if want:
-                self._recluster_busy = True
         if not want:
             return
+        guard = getattr(self.table, "txn_guard", None)
+        if guard is None:
+            self.recluster_now()
+        else:
+            guard.note_recluster_due(self)
+
+    def recluster_now(self, quiesced: bool = False) -> bool:
+        """The permute body, with the STORE lock released (leaf rule;
+        the busy flag keeps a second caller from double-permuting). It
+        is Table.recluster that takes the CATALOG writer lock, refuses
+        while any transaction is open (row positions may only move with
+        no write log holding positional row ids) and — unless the
+        caller already quiesced the reader registry — refuses while any
+        statement or scan is in flight. The resulting data_epoch bump
+        makes the next _refresh_locked rebuild every segment in the new
+        order. Returns True when the queued work is DONE (rows moved,
+        or the table no longer wants sorting); False = retry later."""
+        with self._lock:
+            if self._recluster_busy:
+                return False
+            if not self._want_recluster_locked(True):
+                return True  # raced: sorted (or hint dropped) meanwhile
+            self._recluster_busy = True
         import time as _time
 
         t0 = _time.perf_counter()
         try:
-            moved = self.table.recluster()
+            moved = self.table.recluster(quiesced=quiesced)
         finally:
             with self._lock:
                 self._recluster_busy = False
@@ -341,6 +360,8 @@ class SegmentStore:
             from tidb_tpu.utils.metrics import COMPACTION_TOTAL
 
             COMPACTION_TOTAL.inc(outcome="recluster")
+        t = self.table
+        return bool(moved) or getattr(t, "clustered_rows", 0) >= t.n
 
     def refresh(self, force: bool = False) -> None:
         self._maybe_recluster(force)

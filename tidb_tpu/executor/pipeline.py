@@ -405,6 +405,9 @@ class _StagedScanMixin:
         if self._pin is not None:
             self._pin.close()
             self._pin = None
+        if getattr(self, "_staged_scan_counted", False):
+            self._staged_scan_counted = False
+            self.table.txn_guard.scan_exit()
 
     # -- staging plan ------------------------------------------------------
 
@@ -417,6 +420,14 @@ class _StagedScanMixin:
         exactly like the unfused scan."""
         cap = ctx.chunk_capacity
         table = self.table
+        # count as an open scan for the staging window: raw-tail slices
+        # and live_mask reads hit the table's live arrays lock-free, so
+        # a CLUSTER BY permute must refuse until _release_staging runs
+        guard = getattr(table, "txn_guard", None)
+        if guard is not None and not getattr(
+                self, "_staged_scan_counted", False):
+            guard.scan_enter()
+            self._staged_scan_counted = True
         jobs = []
         tail_start = 0
         self._seg_cap = None
@@ -658,6 +669,10 @@ def _close_delegate(outer) -> None:
     if d is None:
         return
     d.close()  # first: nested fused execs fold their own delegates
+    # EXPLAIN ANALYZE renders AFTER the tree is closed, so keep the
+    # closed delegate reachable — analyze_text walks _fallback_taken to
+    # show the classic subtree that actually ran under a [classic] node
+    outer._fallback_taken = d
     st = getattr(d, "stats", None)
     if st is not None and st.out_rows >= 0:
         outer.stats.add_out_rows(st.out_rows)

@@ -65,10 +65,22 @@ class TableScanExec(Executor):
         self._seg_i = 0
         self._seg_fn = None
         self._pin = None
+        self._scan_counted = False
+
+    def _count_scan(self) -> None:
+        """Register as a lock-free reader of the table's live arrays for
+        the scan's whole lifetime (paged cursors keep scans open past
+        their statement; point/index paths hold physical row ids): a
+        CLUSTER BY permute refuses while any scan is counted."""
+        guard = getattr(self.table, "txn_guard", None)
+        if guard is not None and not self._scan_counted:
+            guard.scan_enter()
+            self._scan_counted = True
 
     def open(self, ctx: ExecContext) -> None:
         self.ctx = ctx
         cap = ctx.chunk_capacity
+        self._count_scan()
         self._fn = (
             cached_jit("pipeline", repr(self.stages), lambda: make_pipeline_fn(self.stages))
             if self.stages
@@ -179,6 +191,9 @@ class TableScanExec(Executor):
         if self._pin is not None:
             self._pin.close()
             self._pin = None
+        if self._scan_counted:
+            self._scan_counted = False
+            self.table.txn_guard.scan_exit()
         super().close()
 
     def next(self) -> Optional[Chunk]:
@@ -263,6 +278,7 @@ class PointGetExec(TableScanExec):
         # handful of fetched rows evaluate eagerly instead.
         Executor.open(self, ctx)
         self.ctx = ctx
+        self._count_scan()
         self._fn = make_pipeline_fn(self.stages) if self.stages else None
         rows = self.table.index_lookup(
             self.index_name, self.key_values,
@@ -294,6 +310,7 @@ class RowIdScanExec(TableScanExec):
     def open(self, ctx: ExecContext) -> None:
         Executor.open(self, ctx)
         self.ctx = ctx
+        self._count_scan()
         self._fn = make_pipeline_fn(self.stages) if self.stages else None
         rows = self._row_ids(ctx)
         self._rows = rows

@@ -152,7 +152,7 @@ def _cut_single_key(nullrank, value, sel, cap: int, desc: bool):
     difference between the fused path winning and losing against the
     classic host ``np.lexsort``.
 
-    Exactness: the key's two null-rank classes select in rank order
+    Exactness: the key's null-rank classes select in rank order
     (ASC: NULLs then values; DESC: values then NULLs — the
     ``rank_operands`` convention). The all-NULL class ties completely,
     so its winners are the first ``k`` in drain (array) order — one
@@ -164,6 +164,16 @@ def _cut_single_key(nullrank, value, sel, cap: int, desc: bool):
     mask keeps the selection exact. Ties therefore resolve identically
     to the full merge's drain-position operand.
 
+    NaN (float keys only) is its own third class: ``< thresh`` and
+    ``== thresh`` are both false for NaN, so leaving NaN rows in the
+    value class would silently DROP them (and poison the threshold
+    sort). Both orderings the engine must match — host ``np.lexsort``
+    and the XLA total-order merge sort — place NaN after every real
+    value in either direction (DESC negates, and NumPy/XLA rank any
+    NaN as maximal), i.e. ASC: NULLs, values, NaN; DESC: values, NaN,
+    NULLs. NaNs tie completely, so like the NULL class their winners
+    are the first ``k`` in drain order.
+
     Returns ``(idx [cap] i32, live [cap] bool)`` — source-row gathers
     for the candidate buffer (winner order is irrelevant: the variadic
     merge re-sorts)."""
@@ -171,18 +181,28 @@ def _cut_single_key(nullrank, value, sel, cap: int, desc: bool):
     null_nr = jnp.int32(1 if desc else 0)
     is_null = (nullrank == null_nr) & sel
     is_val = sel & ~is_null
+    floating = jnp.issubdtype(value.dtype, jnp.floating)
+    if floating:
+        is_nan = is_val & jnp.isnan(value)
+        is_val = is_val & ~is_nan
+        n_nan = jnp.sum(is_nan.astype(jnp.int64))
+    else:  # trace-time skip: int keys have no NaN class
+        is_nan = None
+        n_nan = jnp.int64(0)
     n_null = jnp.sum(is_null.astype(jnp.int64))
     n_val = jnp.sum(is_val.astype(jnp.int64))
     c = jnp.int64(cap)
-    if desc:
+    if desc:  # values, NaN, NULLs
         k_val = jnp.minimum(c, n_val)
-        k_null = jnp.minimum(c - k_val, n_null)
-    else:
+        k_nan = jnp.minimum(c - k_val, n_nan)
+        k_null = jnp.minimum(c - k_val - k_nan, n_null)
+    else:  # NULLs, values, NaN
         k_null = jnp.minimum(c, n_null)
         k_val = jnp.minimum(c - k_null, n_val)
+        k_nan = jnp.minimum(c - k_null - k_val, n_nan)
     ncum = jnp.cumsum(is_null.astype(jnp.int64))
     win_null = is_null & (ncum <= k_null)
-    if jnp.issubdtype(value.dtype, jnp.floating):
+    if floating:
         sentinel = jnp.asarray(jnp.inf, value.dtype)
     else:
         sentinel = jnp.asarray(jnp.iinfo(value.dtype).max, value.dtype)
@@ -195,6 +215,9 @@ def _cut_single_key(nullrank, value, sel, cap: int, desc: bool):
     win_val = (strict | (boundary & (bcum <= k_val - n_strict))) \
         & (k_val > 0)
     win = win_null | win_val
+    if is_nan is not None:
+        nancum = jnp.cumsum(is_nan.astype(jnp.int64))
+        win = win | (is_nan & (nancum <= k_nan))
     # compact the <= cap winners by gather, not scatter: the j-th winner
     # sits at the first index whose running win-count reaches j+1, and
     # cap binary searches beat an n-update serial XLA CPU scatter

@@ -643,6 +643,13 @@ class Session:
             _san.enable()
             _san_scope = _san.statement_begin(sync_budget=int(
                 self.sysvars.get("tidb_tpu_sanitize_sync_budget")))
+        # CLUSTER BY ordered compaction (ISSUE 18): due permutes run at
+        # statement boundaries ONLY — never from a reader's plan_scan —
+        # and only while the catalog's reader registry is quiescent.
+        # This statement then registers as a lock-free reader so no
+        # other thread's boundary can move rows out from under it.
+        self.catalog.run_pending_reclusters()
+        self.catalog.reader_enter()
         t0 = _time.perf_counter()
         try:
             with ctx:
@@ -672,6 +679,10 @@ class Session:
             self.catalog.plugins.statement_end(self, sql, stype, dur, exc)
             raise
         finally:
+            self.catalog.reader_exit()
+            # a permute the statement's own plan_scan queued runs now,
+            # at ITS end — scans closed, cursors (if any) still counted
+            self.catalog.run_pending_reclusters()
             self._current_sql = None
             # disarm: a later Cluster.query(session=...) poll must not
             # see this statement's (possibly long-expired) deadline

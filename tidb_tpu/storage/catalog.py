@@ -8,6 +8,7 @@ detect schema changes)."""
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -27,8 +28,6 @@ class Database:
 
 class Catalog:
     def __init__(self):
-        import threading
-
         # statement-granularity lock for multi-threaded front-ends (the wire
         # server): the host storage layer is single-writer by design, like
         # the reference's per-region leaseholder. Registered with the
@@ -99,6 +98,23 @@ class Catalog:
         self.processes = weakref.WeakValueDictionary()
         self._conn_id = 0
         self._conn_id_lock = threading.Lock()
+        # lock-free reader registry (ISSUE 18 recluster): autocommit
+        # SELECTs never enter _open_txns, yet a CLUSTER BY permute moves
+        # the physical rows they read without any lock. Statements
+        # register their execution window here (reader_enter/exit), scan
+        # executors and paged cursors additionally count open scans
+        # (scan_enter/exit — a DCN cursor outlives its statement), and
+        # recluster runs ONLY while this registry is quiescent, holding
+        # _readers_lock so no new reader can start mid-permute. Order:
+        # Catalog.lock -> Catalog.readers, both leaf-short except the
+        # permute itself (the intended compaction pause).
+        self._readers_lock = _san.tracked_lock(
+            "Catalog.readers", threading.Lock)
+        self._stmt_readers: Dict[int, int] = {}  # thread ident -> depth
+        self._open_scans = 0
+        # SegmentStores whose CLUSTER BY permute is due; performed at
+        # the next quiescent statement boundary (run_pending_reclusters)
+        self._recluster_pending: list = []
 
     @property
     def schema_version(self) -> int:
@@ -241,6 +257,69 @@ class Catalog:
 
     def end_txn(self, marker: int) -> None:
         self._open_txns.pop(marker, None)
+
+    # -- lock-free reader registry (CLUSTER BY permute safety) --------------
+    # Readers of the live column arrays take no lock (the MVCC design:
+    # committed rows are stable under concurrent APPENDS). A physical
+    # permute breaks that invariant, so it may only run while nothing is
+    # reading: statements bracket themselves with reader_enter/exit, scan
+    # executors (and the paged cursors that outlive a statement) with
+    # scan_enter/exit, and run_pending_reclusters refuses unless both
+    # counts are zero — holding _readers_lock across the permute so no
+    # new reader can begin mid-move.
+
+    def reader_enter(self) -> None:
+        ident = threading.get_ident()
+        with self._readers_lock:
+            self._stmt_readers[ident] = self._stmt_readers.get(ident, 0) + 1
+
+    def reader_exit(self) -> None:
+        ident = threading.get_ident()
+        with self._readers_lock:
+            d = self._stmt_readers.get(ident, 0) - 1
+            if d <= 0:
+                self._stmt_readers.pop(ident, None)
+            else:
+                self._stmt_readers[ident] = d
+
+    def scan_enter(self) -> None:
+        with self._readers_lock:
+            self._open_scans += 1
+
+    def scan_exit(self) -> None:
+        with self._readers_lock:
+            self._open_scans = max(self._open_scans - 1, 0)
+
+    def note_recluster_due(self, store) -> None:
+        """A scan noticed a CLUSTER BY permute is due (fold cadence).
+        Queue it; the permute runs at a statement boundary, never on the
+        reader path that noticed it."""
+        with self._readers_lock:
+            if store not in self._recluster_pending:
+                self._recluster_pending.append(store)
+
+    def run_pending_reclusters(self) -> None:
+        """Perform queued CLUSTER BY permutes if the world is quiescent
+        (no open txns, no registered statement windows, no open scans).
+        Called at statement boundaries with the calling thread NOT
+        registered. Stores whose permute still refuses (e.g. another
+        session's open txn) stay queued for a later boundary."""
+        if not self._recluster_pending:
+            return
+        with self.lock:
+            if self._open_txns:
+                return
+            done = []
+            with self._readers_lock:
+                if self._stmt_readers or self._open_scans:
+                    return
+                # _readers_lock HELD across the permute: a new reader
+                # blocks in reader_enter until rows stop moving
+                for store in self._recluster_pending:
+                    if store.recluster_now(quiesced=True):
+                        done.append(store)
+            for store in done:
+                self._recluster_pending.remove(store)
 
     # -- 2PC status records (the Percolator primary; ref: txn status in
     # TiKV consulted by lock resolution) ------------------------------------

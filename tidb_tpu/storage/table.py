@@ -1809,7 +1809,7 @@ class Table:
         self.version += 1
         return k
 
-    def recluster(self) -> bool:
+    def recluster(self, quiesced: bool = False) -> bool:
         """Physically re-sort ALL rows by the CLUSTER BY column (ASC,
         NULLs first, stable — so same-key rows keep arrival order) so
         segment zone maps over the rebuild prune range filters (ISSUE
@@ -1820,13 +1820,19 @@ class Table:
         with NO transaction open — the same contract as gc(): txn write
         logs address rows by position, and _run_dml's collect-to-apply
         window assumes positions are stable while it holds the catalog
-        lock. Scans trigger recluster from the statement path WITHOUT
-        that lock, so the permute takes it here (re-entrant for a DML's
-        own internal scan) and refuses — returning False, trying again
-        at a later fold — while the catalog's open-txn registry is
-        non-empty. Catalog-less tables (unit fixtures) fall back to the
-        table-local evidence of an open txn: provisional begin/end
-        timestamps, pessimistic row locks, provisionally-ended rows."""
+        lock. Open txns are NOT the only readers of physical positions:
+        an autocommit SELECT reads the live arrays lock-free (it never
+        enters _open_txns), so the permute additionally requires the
+        catalog's reader registry to be quiescent — no registered
+        statement window, no open scan executor or paged cursor — and
+        holds the registry lock across the move so no new reader can
+        start mid-permute. ``quiesced=True`` is the catalog's own
+        run_pending_reclusters path, which already holds that lock.
+        Refusals return False; the queued fold retries at a later
+        statement boundary. Catalog-less tables (unit fixtures) fall
+        back to the table-local evidence of an open txn: provisional
+        begin/end timestamps, pessimistic row locks, provisionally-ended
+        rows."""
         col = self.schema.cluster_by
         if not col or col not in self.data or self.n <= 1:
             return False
@@ -1838,7 +1844,12 @@ class Table:
         with guard.lock:
             if guard._open_txns:
                 return False
-            return self._recluster_locked()
+            if quiesced:
+                return self._recluster_locked()
+            with guard._readers_lock:
+                if guard._stmt_readers or guard._open_scans:
+                    return False
+                return self._recluster_locked()
 
     def _recluster_locked(self) -> bool:
         """The permute body; caller holds the catalog lock (or owns the

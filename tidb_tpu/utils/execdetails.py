@@ -105,13 +105,14 @@ def analyze_text(root) -> str:
         indent = ""
         if depth:
             indent = "  " * (depth - 1) + ("└─" if last else "├─")
+        kids = _actual_children(e)
         total = e.stats.open_wall + e.stats.next_wall
-        child_total = sum(c.stats.open_wall + c.stats.next_wall for c in e.children)
+        child_total = sum(c.stats.open_wall + c.stats.next_wall for c in kids)
         own = max(total - child_total, 0.0)
         own_disp = max(
-            e.stats.dispatches - sum(c.stats.dispatches for c in e.children), 0)
+            e.stats.dispatches - sum(c.stats.dispatches for c in kids), 0)
         own_rc = max(
-            e.stats.recompiles - sum(c.stats.recompiles for c in e.children), 0)
+            e.stats.recompiles - sum(c.stats.recompiles for c in kids), 0)
         if anchor is not None and e.stats.first_ts is not None:
             off = e.stats.first_ts - anchor
             pos = (round(off / span_total * (_GANTT_W - 1))
@@ -126,8 +127,15 @@ def analyze_text(root) -> str:
             drift_s = f"{e.stats.rows / est:.2f}" if est > 0 else "-"
         else:
             est_s = drift_s = "-"
+        name = type(e).__name__.replace("Exec", "")
+        # a fused exec that delegated to its classic fallback must not
+        # render as if the fused path ran: mark it and show the classic
+        # subtree that actually executed (kept via _fallback_taken —
+        # run_plan closes the tree before EXPLAIN ANALYZE renders)
+        if hasattr(e, "_ran_fused") and not e._ran_fused:
+            name += "[classic]"
         rows.append((
-            indent + type(e).__name__.replace("Exec", ""),
+            indent + name,
             est_s,
             str(e.stats.rows),
             drift_s,
@@ -143,8 +151,8 @@ def analyze_text(root) -> str:
                f" segs_pruned:{e.stats.segs_pruned}"
                if e.stats.segs_scanned or e.stats.segs_pruned else ""),
         ))
-        for i, c in enumerate(e.children):
-            visit(c, depth + 1, i == len(e.children) - 1)
+        for i, c in enumerate(kids):
+            visit(c, depth + 1, i == len(kids) - 1)
 
     visit(root, 0, True)
     heads = ("id", "estRows", "actRows", "drift", "time", "start",
@@ -159,10 +167,23 @@ def analyze_text(root) -> str:
     return "\n".join(lines)
 
 
+def _actual_children(e):
+    """Render children plus any classic fallback subtree a fused exec
+    actually ran (live ``_delegate`` pre-close, ``_fallback_taken``
+    after — the normal EXPLAIN ANALYZE path renders post-close)."""
+    kids = list(getattr(e, "children", ()))
+    d = getattr(e, "_delegate", None)
+    if d is None:
+        d = getattr(e, "_fallback_taken", None)
+    if d is not None:
+        kids.append(d)
+    return kids
+
+
 def _walk_first_ts(root):
     stack = [root]
     while stack:
         e = stack.pop()
         if e.stats.first_ts is not None:
             yield e.stats.first_ts
-        stack.extend(e.children)
+        stack.extend(_actual_children(e))
