@@ -735,6 +735,199 @@ def bench_multichip(extra=None, n_rows=None, reps=None,
     return out
 
 
+def bench_elastic(extra=None, n_rows=None, before_s=1.5, after_s=1.5,
+                  n_readers=2, n_writers=2):
+    """Elastic-topology SLO bench (ISSUE 19): p99 latency + throughput
+    dip DURING a live online reshard under sustained mixed traffic.
+    Readers (group-agg over the stable keyspace, sqlite-oracle-checked
+    on EVERY result) and 2PC point-insert writers run continuously
+    against a 3-worker fleet; mid-run the table reshards 12 -> 24
+    shards (shard-function change: every shard moves — the worst
+    case). Captured: per-phase read p50/p99 (before/during/after the
+    reshard), statements served per 1-second window, and the
+    throughput dip (served rate during / before). The serving SLO —
+    every 1s window serves at least one successful statement, and
+    every acked writer row survives the cutover — is what perf_check
+    floors; the latency numbers are the operator-facing artifact."""
+    import threading as _threading
+
+    import numpy as np
+
+    from tidb_tpu.errors import TiDBTPUError
+    from tidb_tpu.parallel.dcn import Cluster, Worker
+    from tidb_tpu.session import Session
+    from tidb_tpu.testutil import mirror_to_sqlite, rows_equal
+
+    n_rows = n_rows or int(os.environ.get("BENCH_ELASTIC_ROWS",
+                                          str(1 << 16)))
+    rng = np.random.default_rng(19)
+    k = rng.permutation(n_rows).astype(np.int64)
+    g = (k % 23).astype(np.int64)
+    v = (k * 5 - 7).astype(np.int64)
+    ddl = ("create table e (k bigint, g bigint, v bigint) "
+           "shard by hash(k) shards 12")
+    read_sql = (f"select g, count(*) as n, sum(v) as sv from e "
+                f"where k < {n_rows} group by g order by g")
+
+    oracle = Session(chunk_capacity=CAP)
+    oracle.execute(ddl)
+    oracle.catalog.table("test", "e").insert_columns(
+        {"k": k, "g": g, "v": v})
+    conn = mirror_to_sqlite(oracle.catalog)
+    want = conn.execute(read_sql).fetchall()
+
+    workers = [Worker() for _ in range(3)]
+    for w in workers:
+        _threading.Thread(target=w.serve_forever, daemon=True).start()
+    cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                 rpc_timeout_s=600.0)
+    cl.ddl(ddl)
+    cl.load_sharded("e", arrays={"k": k, "g": g, "v": v})
+
+    stop = _threading.Event()
+    lock = _threading.Lock()
+    reads = []       # (t_done, dur_s) of oracle-exact reads
+    writes = []      # (t_done, dur_s) of acked inserts
+    mismatches = []  # correctness violations — must stay empty
+    errors = []      # non-transient typed errors — must stay empty
+    applied = []     # acked writer sql, replayed into the oracle
+
+    def transient(e):
+        # a statement landing inside a 2PC prepare->commit window is
+        # refused typed and retried by the client — the documented
+        # guard, topology change or not
+        return "pending" in str(e)
+
+    def reader():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                got = cl.query(read_sql)
+            except TiDBTPUError as e:
+                if not transient(e):
+                    with lock:
+                        errors.append(repr(e))
+                continue
+            t1 = time.perf_counter()
+            ok, msg = rows_equal(got, want, ordered=True)
+            with lock:
+                (reads.append((t1, t1 - t0)) if ok
+                 else mismatches.append(msg))
+
+    def writer(wid):
+        nn = 0
+        while not stop.is_set():
+            kk = n_rows + wid * 10_000_000 + nn
+            nn += 1
+            sql = (f"insert into e (k, g, v) values "
+                   f"({kk}, {kk % 23}, {kk * 5})")
+            t0 = time.perf_counter()
+            try:
+                cl.execute_dml(sql)
+            except TiDBTPUError as e:
+                if not transient(e):
+                    with lock:
+                        errors.append(repr(e))
+                continue
+            t1 = time.perf_counter()
+            with lock:
+                writes.append((t1, t1 - t0))
+                applied.append(sql)
+            time.sleep(0.002)
+
+    threads = ([_threading.Thread(target=reader)
+                for _ in range(n_readers)]
+               + [_threading.Thread(target=writer, args=(w,))
+                  for w in range(n_writers)])
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(before_s)
+        t_r0 = time.perf_counter()
+        cl.reshard("alter table e shard by hash(k) shards 24")
+        t_r1 = time.perf_counter()
+        time.sleep(after_s)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(120)
+    t_end = time.perf_counter()
+    try:
+        # every acked writer row must have survived the cutover: replay
+        # the acked multiset into the oracle, compare the WHOLE table
+        for sql in applied:
+            conn.execute(sql)
+        full = "select count(*) as n, sum(v) as sv from e"
+        okf, msgf = rows_equal(cl.query(full),
+                               conn.execute(full).fetchall())
+        new_shards = cl.placement("e").shards
+    finally:
+        try:
+            cl.shutdown()
+        except Exception:  # noqa: BLE001 — bench cleanup
+            pass
+        conn.close()
+    check = "ok"
+    if errors:
+        check = f"TYPED ERRORS ({len(errors)}): {errors[0]}"[:300]
+    if mismatches:
+        check = f"READ MISMATCH: {mismatches[0]}"[:300]
+    if not okf:
+        check = f"WRITER ROWS LOST: {msgf}"[:300]
+    if new_shards != 24:
+        check = f"RESHARD DID NOT LAND: shards={new_shards}"
+
+    stamps = sorted(t for t, _d in reads + writes)
+    windows = []
+    w0 = t_start
+    while w0 < t_end:
+        windows.append(sum(1 for t in stamps if w0 <= t < w0 + 1.0))
+        w0 += 1.0
+
+    def pctl(durs, q):
+        if not durs:
+            return None
+        ds = sorted(durs)
+        return round(ds[min(len(ds) - 1, int(q * len(ds)))] * 1e3, 2)
+
+    phases = {"before": [d for t, d in reads if t < t_r0],
+              "during": [d for t, d in reads if t_r0 <= t < t_r1],
+              "after": [d for t, d in reads if t >= t_r1]}
+    n_before = sum(1 for t in stamps if t < t_r0)
+    n_during = sum(1 for t in stamps if t_r0 <= t < t_r1)
+    rate_before = n_before / max(t_r0 - t_start, 1e-9)
+    rate_during = n_during / max(t_r1 - t_r0, 1e-9)
+    out = {
+        "n_rows": n_rows, "workers": 3, "shards": "12 -> 24",
+        "reshard_s": round(t_r1 - t_r0, 3),
+        "wall_s": round(t_end - t_start, 3),
+        "stmts_served": len(stamps),
+        "reads_ok": len(reads), "writes_acked": len(writes),
+        "windows_1s": windows,
+        "served_every_window": all(c > 0 for c in windows),
+        "read_p50_ms": {p: pctl(d, 0.50) for p, d in phases.items()},
+        "read_p99_ms": {p: pctl(d, 0.99) for p, d in phases.items()},
+        "rate_before_sps": round(rate_before, 1),
+        "rate_during_sps": round(rate_during, 1),
+        "throughput_dip": round(rate_during / max(rate_before, 1e-9), 3),
+        "check": check,
+        "provenance": bench_provenance(),
+    }
+    log(f"# elastic: reshard={out['reshard_s']}s of {out['wall_s']}s, "
+        f"{out['stmts_served']} stmts, dip={out['throughput_dip']} "
+        f"p99 before/during/after="
+        f"{out['read_p99_ms']['before']}/{out['read_p99_ms']['during']}/"
+        f"{out['read_p99_ms']['after']}ms "
+        f"served_every_window={out['served_every_window']} "
+        f"check={check}")
+    if extra is not None:
+        extra["elastic"] = {kk: out[kk] for kk in (
+            "reshard_s", "served_every_window", "throughput_dip",
+            "read_p99_ms", "stmts_served", "check")}
+    return out
+
+
 def bench_oltp(extra, clients_list=(8, 16), iters=150):
     """Multi-client OLTP benchmark (ISSUE 7): sysbench-style point-get
     workload at N client threads through the serving tier, coalesced
@@ -1828,6 +2021,11 @@ def bench_zone_pruning(extra=None, sf=None, reps=None):
         "segs_pruned": pruned,
         "pruned_fraction": round(frac, 4),
         "check": check,
+        # ISSUE 19 satellite: stamp the capture so perf_check (and a
+        # reader of BENCH_r*) can tell machine drift from regression —
+        # the SF1 ratio sits near its floor, provenance names the rev
+        # and flag set that produced each number
+        "provenance": bench_provenance(),
     }
     log(f"# zone pruning q6 sf={sf}: pruned={best_on * 1e3:.1f}ms "
         f"unpruned={best_off * 1e3:.1f}ms "
@@ -2255,6 +2453,15 @@ def main(locked_detail=("acquired", "acquired")):
         bench_multichip(extra)
     except Exception as e:  # noqa: BLE001
         extra["multichip_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # elastic-topology SLO (ISSUE 19): p99 + throughput dip during a
+    # live 12->24 online reshard under sustained mixed traffic; the
+    # serving floor (every 1s window serves) is gated in perf_check
+    try:
+        log("# elastic reshard bench")
+        bench_elastic(extra)
+    except Exception as e:  # noqa: BLE001
+        extra["elastic_error"] = f"{type(e).__name__}: {e}"[:300]
 
     extra["provenance"] = bench_provenance()
     print(json.dumps({

@@ -1,0 +1,34 @@
+"""Fixture: topology-gate discipline violations ISSUE 19 adds to the
+governed surface — a peer RPC while holding the gate registry lock
+stalls every statement's gate acquire behind one cutover, and a bare
+reader-count mutation races the writer's drain check. The
+snapshot-then-send form at the bottom must stay clean."""
+
+import threading
+
+
+class BadGates:
+    def __init__(self):
+        self._gates_lock = threading.Lock()
+        self._readers = {}
+
+    def backfill_under_lock(self, sock, batch):
+        with self._gates_lock:
+            sock.sendall(batch)       # BAD: peer RPC under the registry lock
+
+    def fingerprint_under_lock(self, sock, nbytes):
+        with self._gates_lock:
+            return sock.recv(nbytes)  # BAD: peer recv under the registry lock
+
+    def acquire_read(self, table):
+        with self._gates_lock:
+            self._readers[table] = self._readers.get(table, 0) + 1
+
+    def release_read(self, table):
+        self._readers[table] -= 1     # BAD: bare mutation races the drain
+
+    def snapshot_then_send(self, sock, batch):
+        with self._gates_lock:
+            tables = dict(self._readers)  # ok: pure host work under lock
+        sock.sendall(batch)               # ok: lock released first
+        return tables

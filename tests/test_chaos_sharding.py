@@ -1,5 +1,7 @@
 """Chaos grid for the sharded-placement tier (ISSUE 13): failpoints at
-`shuffle.send` / `shuffle.recv` / `2pc.prepare` / `2pc.commit` — every
+`shuffle.send` / `shuffle.recv` / `2pc.prepare` / `2pc.commit`, plus
+the elastic-topology grid (ISSUE 19): `reshard.backfill` /
+`reshard.cutover` / `member.join` / `member.drain` — every
 run must return results identical to the no-fault run or raise a clean
 TYPED error, never hang, and never leak a cursor, cancel token, staged
 shuffle, or prepared 2PC transaction. A coordinator "crash" between
@@ -136,47 +138,212 @@ class TestShuffleFaults:
             cl.shutdown()
 
 
+def _kill_and_sever(workers, cl, i):
+    """In-process 'machine death' of worker i: listener down AND the
+    coordinator's established link severed (shutdown() wakes a
+    coordinator blocked in recv on it with a clean EOF)."""
+    _kill_worker(workers[i])
+    try:
+        cl._socks[i].shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+
+
 class TestReshardFaults:
-    def test_apply_fault_keeps_fence_and_staged_rows_then_recovers(self):
-        """A fault in reshard phase B (after the first worker already
-        truncated and swapped): the staged batches are the ONLY copy of
-        the moved rows, so they are retained, the table stays FENCED
-        (statements refused typed — routing by either map over a
-        half-swapped fleet would silently double-count), and
-        recover_reshard() re-drives the idempotent applies to a fully
-        consistent new placement with zero lost rows."""
+    RESHARD = "alter table f shard by hash(k) shards 4"
+    COUNT = "select count(*) as n, sum(v) as s from f"
+
+    def test_backfill_fault_abandons_cleanly(self):
+        """A fault while a shard backfills — nothing destructive
+        happened yet, so the run ABANDONS: staging dropped, no fence,
+        the table keeps serving the OLD placement exactly, and a fresh
+        reshard() completes."""
         workers, cl = _mk_cluster()
         try:
-            baseline = cl.query("select count(*) as n, sum(v) as s from f")
-            with failpoint("reshard.apply", nth=2):
-                with pytest.raises(TiDBTPUError, match="recover_reshard"):
-                    cl.reshard("alter table f shard by hash(k) shards 4")
-            # fenced while inconsistent
-            with pytest.raises(TiDBTPUError, match="resharded"):
-                cl.query("select count(*) as n from f")
-            out = cl.recover_reshard()
-            assert out == {"f": "resharded"}, out
+            baseline = cl.query(self.COUNT)
+            with failpoint("reshard.backfill", times=1):
+                with pytest.raises(TYPED):
+                    cl.reshard(self.RESHARD)
+            assert hits("reshard.backfill") > 0, "failpoint never hit"
+            assert not cl._reshard_state  # abandoned, not fenced
+            assert cl.placement("f").shards == 6  # old map still serves
+            assert cl.recover_reshard() == {}  # nothing to recover
+            assert cl.query(self.COUNT) == baseline
+            cl.reshard(self.RESHARD)  # a clean retry completes
             assert cl.placement("f").shards == 4
-            assert cl.query("select count(*) as n, sum(v) as s from f") \
-                == baseline
+            assert cl.query(self.COUNT) == baseline
             _assert_clean(workers, cl)
         finally:
             cl.shutdown()
 
-    def test_scatter_fault_leaves_table_untouched(self):
-        """A fault BEFORE any worker swapped: staged state is dropped,
-        the fence lifts, and the table still serves the old placement
-        exactly."""
+    def test_cutover_fault_fences_shard_then_recovers(self):
+        """A fault AFTER a shard's cutover watermark (its sources may
+        be part-purged): exactly that shard fences — statements
+        refused typed, naming the shard — and recover_reshard()
+        re-drives the idempotent purge/install from the watermark.
+        A second recovery pass is a no-op."""
         workers, cl = _mk_cluster()
         try:
-            baseline = cl.query("select count(*) as n, sum(v) as s from f")
-            with failpoint("shuffle.send", times=1):
+            baseline = cl.query(self.COUNT)
+            with failpoint("reshard.cutover", times=1):
                 with pytest.raises(TYPED):
-                    cl.reshard("alter table f shard by hash(k) shards 4")
-            assert cl.placement("f").shards == 6  # unchanged
-            assert cl.query("select count(*) as n, sum(v) as s from f") \
-                == baseline
+                    cl.reshard(self.RESHARD)
+            assert hits("reshard.cutover") > 0, "failpoint never hit"
+            with pytest.raises(TiDBTPUError, match="recover_reshard"):
+                cl.query(self.COUNT)
+            out = cl.recover_reshard()
+            assert out == {"f": "resharded"}, out
+            assert cl.recover_reshard() == {}  # idempotent
+            assert cl.placement("f").shards == 4
+            assert cl.query(self.COUNT) == baseline
             _assert_clean(workers, cl)
+        finally:
+            cl.shutdown()
+
+    def test_worker_death_mid_backfill_abandons_typed(self):
+        """A worker dying during backfill: typed failure, the run
+        abandons (nothing destructive), survivors retain no staged
+        state, and statements over the old placement that still owns
+        the dead worker degrade typed — never silently wrong."""
+        workers, cl = _mk_cluster()
+        try:
+            def kill():
+                _kill_and_sever(workers, cl, 2)
+                raise ConnectionError("worker 2 died mid-backfill")
+
+            with failpoint("reshard.backfill", action=kill, nth=1):
+                with pytest.raises(TYPED):
+                    cl.reshard(self.RESHARD)
+            assert not cl._reshard_state  # abandoned, not fenced
+            assert cl.placement("f").shards == 6
+            with pytest.raises(TYPED):
+                cl.query(self.COUNT)  # old placement owns the dead worker
+            _assert_clean(workers[:2], cl)
+        finally:
+            cl.shutdown()
+
+    def test_worker_death_mid_cutover_stays_fenced_typed(self):
+        """A worker dying INSIDE a cutover window (post-watermark): the
+        shard stays fenced — statements refused typed, and recovery
+        with the worker still dead fails typed and KEEPS the fence.
+        Exact-or-typed: never a half-swapped answer."""
+        workers, cl = _mk_cluster()
+        try:
+            def kill():
+                _kill_and_sever(workers, cl, 2)
+                raise ConnectionError("worker 2 died mid-cutover")
+
+            with failpoint("reshard.cutover", action=kill, nth=1):
+                with pytest.raises(TYPED):
+                    cl.reshard(self.RESHARD)
+            with pytest.raises(TiDBTPUError, match="recover_reshard"):
+                cl.query(self.COUNT)
+            assert cl.recover_reshard() == {}  # dead worker blocks it...
+            with pytest.raises(TiDBTPUError, match="recover_reshard"):
+                cl.query(self.COUNT)  # ...and the fence HOLDS
+            _assert_clean(workers[:2], cl)
+        finally:
+            cl.shutdown()
+
+
+class TestMembershipFaults:
+    COUNT = "select count(*) as n, sum(v) as s from f"
+
+    def test_join_fault_never_half_admits(self):
+        """A fault at admission: typed error, the fleet stays at W
+        workers — never a half-admitted socket — and a clean
+        add_worker() afterwards admits, rebalances online, and the
+        widened fleet still answers exactly."""
+        workers, cl = _mk_cluster()
+        joiner = Worker()
+        threading.Thread(target=joiner.serve_forever, daemon=True).start()
+        try:
+            base_c = cl.query(self.COUNT)
+            base_j = cl.query(JOIN_SQL)
+            with failpoint("member.join", times=1):
+                with pytest.raises(TYPED):
+                    cl.add_worker("127.0.0.1", joiner.port)
+            assert hits("member.join") > 0, "failpoint never hit"
+            assert len(cl._socks) == 3  # unchanged
+            assert cl.query(self.COUNT) == base_c
+            i = cl.add_worker("127.0.0.1", joiner.port)
+            assert i == 3 and len(cl._socks) == 4
+            assert cl.query(self.COUNT) == base_c
+            assert cl.query(JOIN_SQL) == base_j
+            _assert_clean(workers + [joiner], cl)
+        finally:
+            cl.shutdown()
+
+    def test_drain_fault_refuses_typed_then_drains_through(self):
+        """A fault at the drain entry: typed, nothing moved, the fleet
+        still has W workers serving the old placement exactly; a clean
+        remove_worker() afterwards drains through and the compacted
+        fleet answers exactly."""
+        workers, cl = _mk_cluster()
+        try:
+            base_c = cl.query(self.COUNT)
+            base_j = cl.query(JOIN_SQL)
+            with failpoint("member.drain", times=1):
+                with pytest.raises(TYPED):
+                    cl.remove_worker(2)
+            assert hits("member.drain") > 0, "failpoint never hit"
+            assert len(cl._socks) == 3 and cl._draining is None
+            assert cl.query(self.COUNT) == base_c
+            cl.remove_worker(2)
+            assert len(cl._socks) == 2 and cl._draining is None
+            assert cl.query(self.COUNT) == base_c
+            assert cl.query(JOIN_SQL) == base_j
+            _assert_clean(workers[:2], cl)
+        finally:
+            cl.shutdown()
+
+    def test_drain_fault_mid_cutover_resumes(self):
+        """THE resumable drain: a fault after a cutover watermark
+        during remove_worker leaves `_draining` held and the table
+        fenced; recover_reshard() finishes the interrupted table, a
+        second remove_worker(j) picks the drain up where it left off,
+        and the compacted fleet serves the new placement exactly."""
+        workers, cl = _mk_cluster()
+        try:
+            base_c = cl.query(self.COUNT)
+            base_j = cl.query(JOIN_SQL)
+            with failpoint("reshard.cutover", times=1):
+                with pytest.raises(TYPED):
+                    cl.remove_worker(2)
+            assert cl._draining == 2  # the drain survives the fault
+            with pytest.raises(TiDBTPUError, match="already draining"):
+                cl.remove_worker(1)
+            out = cl.recover_reshard()
+            assert set(out.values()) == {"resharded"}, out
+            cl.remove_worker(2)  # resumes: remaining tables + compact
+            assert len(cl._socks) == 2 and cl._draining is None
+            assert cl.query(self.COUNT) == base_c
+            assert cl.query(JOIN_SQL) == base_j
+            _assert_clean(workers[:2], cl)
+        finally:
+            cl.shutdown()
+
+    def test_draining_worker_death_degrades_typed(self):
+        """The draining worker dies mid-drain (its rows are the ones
+        being moved): the drain degrades TYPED with `_draining` kept —
+        statements over the old placement that still owns the dead
+        worker fail typed, a competing drain is refused typed — never
+        a silent wrong answer."""
+        workers, cl = _mk_cluster()
+        try:
+            def kill():
+                _kill_and_sever(workers, cl, 2)
+                raise ConnectionError("worker 2 died mid-drain")
+
+            with failpoint("reshard.backfill", action=kill, nth=1):
+                with pytest.raises(TYPED):
+                    cl.remove_worker(2)
+            assert cl._draining == 2  # held open, typed — resumable
+            with pytest.raises(TiDBTPUError, match="already draining"):
+                cl.remove_worker(1)
+            with pytest.raises(TYPED):
+                cl.query(JOIN_SQL)  # old placement owns the dead worker
+            _assert_clean(workers[:2], cl)
         finally:
             cl.shutdown()
 

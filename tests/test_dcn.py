@@ -268,6 +268,115 @@ class TestReplicaFailover:
                 pass
 
 
+class TestCircuitBreakerBackoff:
+    """Pin the reconnect circuit breaker's math (ISSUE 19 satellite):
+    UP -> SUSPECT half-opens immediately, repeated failures double the
+    backoff from RECONNECT_BASE_S up to RECONNECT_CAP_S with at most
+    RECONNECT_MAX_DOUBLINGS doublings, jitter stays inside
+    [1, 1 + JITTER_FRAC), and inside the window _reconnect_locked
+    fails fast without touching the network."""
+
+    def _mk(self):
+        import threading as th
+
+        from tidb_tpu.parallel.dcn import Cluster, Worker
+
+        w = Worker()
+        th.Thread(target=w.serve_forever, daemon=True).start()
+        return w, Cluster([("127.0.0.1", w.port)])
+
+    def test_backoff_doubles_to_cap_with_bounded_jitter(self):
+        import time as _time
+
+        from tidb_tpu.parallel.dcn import DOWN, SUSPECT, Cluster
+
+        w, cl = self._mk()
+        try:
+            h = cl._health[0]
+            with cl._sock_locks[0]:
+                # first failure from UP: SUSPECT, half-open immediately
+                cl._note_failure_locked(0, RuntimeError("blip"))
+                assert h.state == SUSPECT
+                assert h.next_retry == 0.0
+                assert h.attempts == 0
+                for n in range(1, 12):
+                    t0 = _time.monotonic()
+                    cl._note_failure_locked(0, RuntimeError(f"fail {n}"))
+                    t1 = _time.monotonic()
+                    assert h.state == DOWN
+                    assert h.attempts == n
+                    nominal = Cluster.RECONNECT_BASE_S * (
+                        2 ** min(n, Cluster.RECONNECT_MAX_DOUBLINGS))
+                    nominal = min(nominal, Cluster.RECONNECT_CAP_S)
+                    # window = now + nominal * (1 + jitter), jitter in
+                    # [0, JITTER_FRAC): bound it from both sides using
+                    # monotonic stamps taken around the call
+                    assert h.next_retry - t0 >= nominal
+                    assert (h.next_retry - t1
+                            < nominal * (1.0 + Cluster.JITTER_FRAC))
+                    # the cap is a hard ceiling: attempts beyond
+                    # MAX_DOUBLINGS (and the 2.0s cap itself) never
+                    # push the window past CAP * (1 + JITTER_FRAC)
+                    assert (h.next_retry - t1 < Cluster.RECONNECT_CAP_S
+                            * (1.0 + Cluster.JITTER_FRAC))
+                    if n >= Cluster.RECONNECT_MAX_DOUBLINGS:
+                        assert nominal == Cluster.RECONNECT_CAP_S
+        finally:
+            cl.shutdown()
+
+    def test_circuit_open_fails_fast_with_typed_window(self):
+        import time as _time
+
+        from tidb_tpu.parallel.dcn import DOWN
+
+        w, cl = self._mk()
+        try:
+            h = cl._health[0]
+            with cl._sock_locks[0]:
+                cl._set_state(0, DOWN)
+                h.last_error = "boom: peer reset"
+                h.next_retry = _time.monotonic() + 5.0
+                t0 = _time.monotonic()
+                with pytest.raises(ConnectionError,
+                                   match=r"circuit open for another "
+                                         r"\d+\.\d\ds") as ei:
+                    cl._reconnect_locked(0)
+                # fail-fast contract: no dial happened inside the
+                # window — the refusal is immediate and names the
+                # last error so the operator sees WHY it is down
+                assert _time.monotonic() - t0 < 0.5
+                assert "boom: peer reset" in str(ei.value)
+        finally:
+            h.next_retry = 0.0
+            cl.shutdown()
+
+    def test_half_open_probe_then_ok_resets_breaker(self):
+        from tidb_tpu.parallel.dcn import DOWN, UP
+
+        w, cl = self._mk()
+        try:
+            h = cl._health[0]
+            with cl._sock_locks[0]:
+                cl._set_state(0, DOWN)
+                h.attempts = 3
+                h.next_retry = 0.0  # window elapsed: probe allowed
+                before = h.reconnects
+                sock = cl._reconnect_locked(0)
+                assert sock is cl._socks[0]
+                assert h.reconnects == before + 1
+                cl._note_ok_locked(0)
+                assert h.state == UP
+                assert h.attempts == 0
+                assert h.next_retry == 0.0
+            # the re-dialed link serves statements again
+            cl.broadcast_exec("create table cb (k bigint)")
+            cl._call(0, {"cmd": "exec",
+                         "sql": "insert into cb values (1), (2)"})
+            assert cl.query("select count(*) as n from cb") == [(2,)]
+        finally:
+            cl.shutdown()
+
+
 class TestStreamingMerge:
     def _mk_cluster(self, n_rows=2000):
         import threading as th

@@ -413,12 +413,13 @@ class TestResharding:
             cl.shutdown()
 
     def test_reshard_racing_inflight_statement(self):
-        """A reshard landing in the MIDDLE of an in-flight statement
-        (between two of its drain pages, where no coordinator socket
-        lock is held): the statement's placement snapshot and already-
-        opened worker cursors keep its result exact against the
-        pre-reshard state, and the next statement routes by the new
-        map. The cached-plan half of the race is the local test below."""
+        """An ONLINE reshard kicked off in the MIDDLE of an in-flight
+        statement: the statement holds its table read-gate, so the
+        reshard's first per-shard write window queues behind it — the
+        statement's placement snapshot and already-opened worker
+        cursors keep its result exact, the reshard then proceeds to
+        completion, and the next statement routes by the new map. The
+        cached-plan half of the race is the local test below."""
         from tidb_tpu.utils.failpoint import failpoint
 
         workers, cl = _mk_cluster(4)
@@ -426,12 +427,18 @@ class TestResharding:
         oracle = _mk_oracle()
         conn = mirror_to_sqlite(oracle.catalog)
         fired = threading.Event()
+        thread: List[threading.Thread] = []
 
         def do_reshard():
             if not fired.is_set():
                 fired.set()
-                # coordinator thread, between page fetches: sockets free
-                cl.reshard("alter table f shard by hash(k) shards 12")
+                # the reshard must run on its OWN thread: the statement
+                # triggering this failpoint holds the table's read
+                # gate, and the backfill write-gates the same table
+                t = threading.Thread(target=cl.reshard, args=(
+                    "alter table f shard by hash(k) shards 12",))
+                t.start()
+                thread.append(t)
 
         try:
             with failpoint("dcn.coord.fetch", action=do_reshard, nth=2):
@@ -440,11 +447,14 @@ class TestResharding:
             want = conn.execute(QUERIES[0]).fetchall()
             ok, msg = rows_equal(got, want, ordered=True)
             assert ok, msg
+            thread[0].join(timeout=120)
+            assert not thread[0].is_alive()
             assert cl.placement("f").shards == 12
             got = cl.query(QUERIES[1])
             want = conn.execute(QUERIES[1]).fetchall()
             ok, msg = rows_equal(got, want)
             assert ok, msg
+            assert all(w._inbox.open_count() == 0 for w in workers)
         finally:
             cl.shutdown()
 
@@ -489,16 +499,38 @@ class TestResharding:
         finally:
             cl.shutdown()
 
-    def test_reshard_with_replicas_refused(self):
+    def test_reshard_rebuilds_replica_mirrors(self):
+        """An online reshard over a replica-mirrored placement (was
+        refused when reshard was stop-the-world) rebuilds the `__part`
+        mirrors per cut-over shard: a subsequent owner death fails
+        over to a replica serving the NEW placement, never the old."""
         workers = [Worker() for _ in range(2)]
         for w in workers:
             threading.Thread(target=w.serve_forever, daemon=True).start()
         cl = Cluster([("127.0.0.1", w.port) for w in workers],
-                     replicas={0: 1, 1: 0})
+                     replicas={0: 1, 1: 0},
+                     rpc_timeout_s=30.0, connect_timeout_s=5.0)
+        oracle = _mk_oracle()
+        conn = mirror_to_sqlite(oracle.catalog)
         try:
             cl.ddl(DDL_HASH)
-            with pytest.raises(UnsupportedError):
-                cl.reshard("alter table f shard by hash(k) shards 2")
+            cl.ddl(DDL_DIM)
+            k, kv, g, v, s = _fact_rows()
+            cl.load_sharded("f", arrays={"k": k, "g": g, "v": v},
+                            valids={"k": kv}, strings={"s": s})
+            cl.reshard("alter table f shard by hash(k) shards 6")
+            got = cl.query(QUERIES[0])
+            want = conn.execute(QUERIES[0]).fetchall()
+            ok, msg = rows_equal(got, want, ordered=True)
+            assert ok, msg
+            # owner death: worker 0's slice must come from worker 1's
+            # rebuilt f__part0 mirror — i.e. the POST-reshard placement
+            workers[0]._running = False
+            workers[0]._sock.close()
+            cl._socks[0].close()
+            got = cl.query(QUERIES[0])
+            ok, msg = rows_equal(got, want, ordered=True)
+            assert ok, msg
         finally:
             cl.shutdown()
 
@@ -566,6 +598,235 @@ class TestShardedFailover:
                 pass
             w0._sock.close()
             assert cl.query(sql) == want
+        finally:
+            cl.shutdown()
+
+
+class TestElasticMembership:
+    def test_add_worker_replays_schema_seeds_broadcast_rebalances(self):
+        """add_worker() admits a node into a SERVING fleet: the DDL
+        history replays (schema parity), broadcast tables seed in
+        full, and every placed table rebalances onto the widened
+        fleet through the online reshard path — after which the whole
+        query suite still matches the sqlite oracle."""
+        workers, cl = _mk_cluster(2)
+        conn = mirror_to_sqlite(_mk_oracle().catalog)
+        joiner = Worker()
+        threading.Thread(target=joiner.serve_forever, daemon=True).start()
+        try:
+            bk = np.arange(7, dtype=np.int64)
+            cl.broadcast_exec("create table bc (k bigint, v bigint)")
+            cl.broadcast_table("bc", arrays={"k": bk, "v": bk * 2})
+            i = cl.add_worker("127.0.0.1", joiner.port)
+            assert i == 2 and len(cl._socks) == 3
+            assert cl.placement("f").n_workers == 3
+            assert cl.placement("d").n_workers == 3
+            # schema parity + broadcast seed, checked AT the joiner
+            got = joiner.session.query(
+                "select count(*) as n, sum(v) as s from bc")
+            assert tuple(map(int, got[0])) == (7, 42), got
+            for sql in QUERIES:
+                got = cl.query(sql)
+                want = conn.execute(sql).fetchall()
+                ok, msg = rows_equal(got, want,
+                                     ordered="order by" in sql)
+                assert ok, f"{sql}\n{msg}"
+            # the joiner owns real shards, not just schema
+            s = Session()
+            rows = s.query(
+                "select endpoint, shards_owned from "
+                "information_schema.dcn_worker_stats")
+            mine = {r[0]: r[1] for r in rows}
+            assert mine.get(f"127.0.0.1:{joiner.port}", 0) > 0, rows
+        finally:
+            cl.shutdown()
+
+    def test_remove_worker_drains_and_compacts(self):
+        """Graceful drain: worker 2's shards move off through the
+        online path, the fleet compacts to W-1, and the suite still
+        matches the oracle over the compacted placement."""
+        workers, cl = _mk_cluster(3)
+        conn = mirror_to_sqlite(_mk_oracle().catalog)
+        try:
+            cl.remove_worker(2)
+            assert len(cl._socks) == 2
+            assert cl.placement("f").n_workers == 2
+            assert cl.placement("d").n_workers == 2
+            for sql in QUERIES:
+                got = cl.query(sql)
+                want = conn.execute(sql).fetchall()
+                ok, msg = rows_equal(got, want,
+                                     ordered="order by" in sql)
+                assert ok, f"{sql}\n{msg}"
+            # the removed worker's tables no longer hold f rows
+            got = workers[2].session.query("select count(*) as n from f")
+            assert int(got[0][0]) == 0, got
+        finally:
+            cl.shutdown()
+
+    def test_remove_worker_typed_refusals(self):
+        workers, cl = _mk_cluster(2)
+        try:
+            with pytest.raises(ExecutionError, match="no worker 9"):
+                cl.remove_worker(9)
+            with pytest.raises(UnsupportedError, match="strand rows"):
+                cl.remove_worker(1, graceful=False)
+            hand = np.arange(5, dtype=np.int64)
+            cl.broadcast_exec("create table hp (k bigint)")
+            cl.load_partition(0, "hp", arrays={"k": hand}, db="test")
+            with pytest.raises(UnsupportedError, match="load_partition"):
+                cl.remove_worker(1)
+        finally:
+            cl.shutdown()
+
+    def test_remove_last_worker_refused(self):
+        w = Worker()
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port)])
+        try:
+            with pytest.raises(ExecutionError, match="last worker"):
+                cl.remove_worker(0)
+        finally:
+            cl.shutdown()
+
+    def test_remove_worker_rebuilds_mirrors_for_failover(self):
+        """ISSUE 19 acceptance: after remove_worker() on a
+        replica-mirrored placement, a subsequent owner death fails
+        over to a replica serving the NEW (compacted) placement —
+        the `__part` mirrors were rebuilt, never left stale."""
+        workers = [Worker() for _ in range(3)]
+        for w in workers:
+            threading.Thread(target=w.serve_forever, daemon=True).start()
+        cl = Cluster([("127.0.0.1", w.port) for w in workers],
+                     replicas={0: 1, 1: 2, 2: 0},
+                     rpc_timeout_s=30.0, connect_timeout_s=5.0)
+        oracle = _mk_oracle()
+        conn = mirror_to_sqlite(oracle.catalog)
+        try:
+            cl.ddl(DDL_HASH)
+            cl.ddl(DDL_DIM)
+            k, kv, g, v, s = _fact_rows()
+            cl.load_sharded("f", arrays={"k": k, "g": g, "v": v},
+                            valids={"k": kv}, strings={"s": s})
+            cl.remove_worker(2)
+            # pairs touching the removed index drop; 0 -> 1 survives
+            assert cl.replicas == {0: 1}, cl.replicas
+            want = conn.execute(QUERIES[0]).fetchall()
+            ok, msg = rows_equal(cl.query(QUERIES[0]), want, ordered=True)
+            assert ok, msg
+            # owner death: worker 0's slice must come from worker 1's
+            # REBUILT f__part0 mirror — the compacted placement's rows
+            workers[0]._running = False
+            workers[0]._sock.close()
+            cl._socks[0].close()
+            ok, msg = rows_equal(cl.query(QUERIES[0]), want, ordered=True)
+            assert ok, msg
+        finally:
+            cl.shutdown()
+
+
+class TestServeThroughReshard:
+    def test_sustained_mixed_traffic_through_online_reshard(self):
+        """THE tentpole acceptance: sustained mixed traffic (readers +
+        2PC writers) across a live reshard. Readers over the stable
+        keyspace must match the sqlite oracle in EVERY window —
+        before, during, and after the topology change — writers'
+        rows must all survive the cutover exactly, and every 1-second
+        window of the run must serve at least one successful
+        statement."""
+        import time as _time
+
+        workers, cl = _mk_cluster(3)
+        oracle = _mk_oracle()
+        conn = mirror_to_sqlite(oracle.catalog)
+        read_sql = ("select g, count(*) as n, sum(v) as sv from f "
+                    "where k < 10000 group by g order by g")
+        want = conn.execute(read_sql).fetchall()
+        stop = threading.Event()
+        lock = threading.Lock()
+        successes: list = []   # monotonic stamps of served statements
+        errors: list = []      # (kind, repr) — a healthy run has none
+        applied: list = []     # writer sql that was acked
+
+        # the one accepted transient: a statement landing on a worker
+        # inside a 2PC prepare->commit window is refused typed and the
+        # client retries — that's the documented guard, topology change
+        # or not. Anything else recorded here fails the test.
+        def transient(e):
+            return "pending" in str(e)
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    got = cl.query(read_sql)
+                except TiDBTPUError as e:
+                    if not transient(e):
+                        with lock:
+                            errors.append(("read", repr(e)))
+                    continue
+                ok, msg = rows_equal(got, want, ordered=True)
+                with lock:
+                    if not ok:
+                        errors.append(("mismatch", msg))
+                    else:
+                        successes.append(_time.monotonic())
+
+        def writer(wid):
+            n = 0
+            while not stop.is_set():
+                kk = 10000 + wid * 100000 + n
+                n += 1
+                sql = (f"insert into f (k, g, v) values "
+                       f"({kk}, {kk % 7}, {kk * 3})")
+                try:
+                    cl.execute_dml(sql)
+                except TiDBTPUError as e:
+                    if not transient(e):
+                        with lock:
+                            errors.append(("write", repr(e)))
+                    continue
+                with lock:
+                    applied.append(sql)
+                    successes.append(_time.monotonic())
+                _time.sleep(0.005)
+
+        threads = ([threading.Thread(target=reader) for _ in range(2)]
+                   + [threading.Thread(target=writer, args=(w,))
+                      for w in range(2)])
+        t0 = _time.monotonic()
+        for t in threads:
+            t.start()
+        try:
+            _time.sleep(0.8)  # "before" traffic
+            cl.reshard("alter table f shard by hash(k) shards 12")
+            _time.sleep(0.8)  # "after" traffic
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(60)
+        try:
+            assert not any(t.is_alive() for t in threads)
+            t1 = _time.monotonic()
+            assert errors == [], errors[:5]
+            assert cl.placement("f").shards == 12
+            # every 1s window of the run served at least one statement
+            w0 = t0
+            while w0 < t1:
+                assert any(w0 <= ts < w0 + 1.0 for ts in successes), \
+                    f"no successful statement in [{w0 - t0:.1f}s, " \
+                    f"{w0 - t0 + 1.0:.1f}s) of the run"
+                w0 += 1.0
+            # writers' rows all survived the cutover: replay the acked
+            # DML into the oracle and compare the WHOLE table
+            for sql in applied:
+                conn.execute(sql)
+            full = ("select count(*) as n, count(v) as cv, sum(v) as sv "
+                    "from f")
+            got = cl.query(full)
+            ok, msg = rows_equal(got, conn.execute(full).fetchall())
+            assert ok, msg
+            ok, msg = rows_equal(cl.query(read_sql), want, ordered=True)
+            assert ok, msg
         finally:
             cl.shutdown()
 

@@ -672,6 +672,70 @@ class TestShardingScope:
             assert not bad, [v.render() for v in bad]
 
 
+class TestElasticScope:
+    """ISSUE 19: the analyzer roster extends to the topology-gate
+    module — parallel/membership.py obeys the same leaf-lock and
+    no-blocking-under-lock discipline as the rest of the coordination
+    plane, and the elastic-topology surfaces are a pinned static
+    count in check_invariants --json."""
+
+    def test_membership_in_default_rosters(self):
+        from tidb_tpu.analysis.blocking_under_lock import (
+            DEFAULT_MODULES as BLOCK_MODULES,
+        )
+        from tidb_tpu.analysis.lock_discipline import (
+            DEFAULT_MODULES as LOCK_MODULES,
+        )
+        from tidb_tpu.analysis.resource_lifecycle import (
+            ResourceLifecyclePass,
+        )
+
+        assert "tidb_tpu/parallel/membership.py" in BLOCK_MODULES
+        assert "tidb_tpu/parallel/membership.py" in LOCK_MODULES
+        assert "parallel" in ResourceLifecyclePass.SCOPE
+
+    def test_gate_rpc_under_registry_lock_is_flagged(self, tmp_path):
+        """A peer send/recv while holding the gate registry lock is
+        the violation (it stalls every statement's gate acquire behind
+        one cutover's network); snapshot-then-send stays clean."""
+        root = _mini_root(tmp_path, ("parallel", "bad_membership_lock.py"))
+        p = BlockingUnderLockPass(
+            modules=("tidb_tpu/parallel/bad_membership_lock.py",))
+        rep, _ = _run_pass(root, p)
+        msgs = [v.render() for v in rep.violations]
+        assert len(rep.violations) == 2, msgs
+        assert any("socket send" in m for m in msgs), msgs
+        assert any("socket recv" in m for m in msgs), msgs
+        assert all("_gates_lock" in m for m in msgs), msgs
+
+    def test_bare_reader_count_mutation_is_flagged(self, tmp_path):
+        """The reader-count map is mutated under the registry lock in
+        one method and bare in another — the race the writer's
+        drain-to-zero check cannot survive."""
+        root = _mini_root(tmp_path, ("parallel", "bad_membership_lock.py"))
+        p = LockDisciplinePass(
+            modules=("tidb_tpu/parallel/bad_membership_lock.py",))
+        rep, _ = _run_pass(root, p)
+        hits = [v for v in rep.violations if "self._readers" in v.message]
+        assert hits, [v.render() for v in rep.violations]
+        assert all("without a lock" in v.message for v in hits)
+
+    def test_real_membership_module_is_clean(self, real_tree_reports):
+        for rep in real_tree_reports:
+            bad = [v for v in rep.violations
+                   if v.path.replace("\\", "/").endswith(
+                       "parallel/membership.py")]
+            assert not bad, [v.render() for v in bad]
+
+    def test_elastic_surface_count_pinned(self):
+        from tidb_tpu.analysis.core import Project
+        from tidb_tpu.analysis.registry import (_ELASTIC_SURFACES,
+                                                elastic_surfaces)
+
+        got = elastic_surfaces(Project(ROOT))
+        assert len(got) == len(_ELASTIC_SURFACES) == 11, got
+
+
 class TestSuppressionCountPinned:
     """ISSUE 12 satellite: the report's suppression count is a tier-1-
     asserted number so allowlist drift is visible in review. Update the

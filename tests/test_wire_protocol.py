@@ -65,8 +65,10 @@ class TestProtocolModel:
         cmds = {s.cmd for s in real_model.senders}
         assert {"exec", "partial_paged", "shuffle_gather",
                 "shuffle_scatter", "shuffle_stage", "txn_prepare",
-                "txn_commit", "txn_abort", "reshard_apply", "fetch",
-                "cancel", "load_columns", "place_shards",
+                "txn_commit", "txn_abort", "reshard_backfill",
+                "reshard_stage", "reshard_fingerprint",
+                "reshard_install", "reshard_purge", "table_dump",
+                "fetch", "cancel", "load_columns", "place_shards",
                 "shuffle_close", "close_cursor", "stats",
                 "shutdown", "ddl_stage"} <= cmds
         assert set(real_model.handlers) >= cmds

@@ -73,6 +73,11 @@ DEFAULT_MODULES = (
     # means per-chunk merge state leaked host-side coordination
     # (fixture: bad_topk_sync.py covers the host-sync half)
     "tidb_tpu/ops/topk.py",
+    # topology gates (ISSUE 19): Condition.wait released-while-waiting
+    # is the one sanctioned blocking call; anything else under the
+    # registry lock (an RPC, a fingerprint build) would stall EVERY
+    # statement's gate acquire behind one cutover
+    "tidb_tpu/parallel/membership.py",
 )
 
 # attribute names whose call blocks the thread

@@ -383,7 +383,8 @@ class Driver:
                      "reason": s.reason} for v, s in rep.suppressed],
             })
         from tidb_tpu.analysis.host_sync import annotated_sites
-        from tidb_tpu.analysis.registry import (observability_surfaces,
+        from tidb_tpu.analysis.registry import (elastic_surfaces,
+                                                observability_surfaces,
                                                 plan_feedback_surfaces)
         from tidb_tpu.analysis.resource_lifecycle import lifecycle_sites
 
@@ -406,6 +407,12 @@ class Driver:
             # columns, SLO sysvars/consumer) counted the same way
             "observability_surface_count":
                 len(observability_surfaces(self.project)),
+            # ISSUE 19: the elastic-topology plane's surfaces (online
+            # reshard + recovery, membership lifecycle, cluster_info
+            # I_S table, reshard/membership metrics, gate sysvar)
+            # counted the same way
+            "elastic_surface_count":
+                len(elastic_surfaces(self.project)),
             "passes": passes,
         }
 

@@ -66,6 +66,10 @@ DEFAULT_MODULES = (
     # state lives on device and the pipeline owns all coordination, so
     # any lock acquired here is a discipline violation by definition
     "tidb_tpu/ops/topk.py",
+    # topology gates (ISSUE 19): the gate registry's one lock guards
+    # per-table reader/writer counts mutated by every statement and
+    # every reshard/membership cutover (fixture: bad_membership_lock.py)
+    "tidb_tpu/parallel/membership.py",
 )
 
 # NOTE: the serving-tier wait-discipline check (ISSUE 7) moved to

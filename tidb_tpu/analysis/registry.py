@@ -25,7 +25,8 @@ from tidb_tpu.analysis.core import Pass, Project, Violation
 
 __all__ = ["MetricsCoveragePass", "FailpointCoveragePass",
            "SysvarCoveragePass", "metrics_problems", "failpoint_scan",
-           "plan_feedback_surfaces", "observability_surfaces"]
+           "plan_feedback_surfaces", "observability_surfaces",
+           "elastic_surfaces"]
 
 
 # ---------------------------------------------------------------------------
@@ -94,6 +95,32 @@ def observability_surfaces(project: Project) -> List[Tuple[str, str]]:
     """The ISSUE 16 observability surfaces present in this tree (same
     marker contract as plan_feedback_surfaces)."""
     return _surfaces_present(project, _OBSERVABILITY_SURFACES)
+
+
+# every user-visible surface of the ISSUE 19 elastic-topology plane
+# (online reshard, membership lifecycle, recovery entry points, the
+# cluster_info I_S table, metrics, gate sysvar), same contract as the
+# two lists above: a refactor that drops one is a static diff in
+# check_invariants --json before any runtime test notices.
+_ELASTIC_SURFACES: Tuple[Tuple[str, str], ...] = (
+    ("tidb_tpu/parallel/dcn.py", "def reshard"),
+    ("tidb_tpu/parallel/dcn.py", "def recover_reshard"),
+    ("tidb_tpu/parallel/dcn.py", "def add_worker"),
+    ("tidb_tpu/parallel/dcn.py", "def remove_worker"),
+    ("tidb_tpu/parallel/dcn.py", "def reshard_progress_rows"),
+    ("tidb_tpu/parallel/membership.py", "CLUSTER_GATE"),
+    ("tidb_tpu/storage/catalog.py", 'if name == "cluster_info"'),
+    ("tidb_tpu/utils/metrics.py", '"tidb_tpu_reshard_shards_total"'),
+    ("tidb_tpu/utils/metrics.py", '"tidb_tpu_reshard_active"'),
+    ("tidb_tpu/utils/metrics.py", '"tidb_tpu_membership_total"'),
+    ("tidb_tpu/session/sysvars.py", '"tidb_tpu_reshard_gate_wait_ms"'),
+)
+
+
+def elastic_surfaces(project: Project) -> List[Tuple[str, str]]:
+    """The ISSUE 19 elastic-topology surfaces present in this tree
+    (same marker contract as plan_feedback_surfaces)."""
+    return _surfaces_present(project, _ELASTIC_SURFACES)
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,7 @@ import hmac
 import itertools
 import os
 import random
+import re
 import socket
 import struct
 import threading
@@ -56,6 +57,7 @@ from tidb_tpu.errors import (
     TwoPhaseCommitIncomplete,
     UnsupportedError,
 )
+from tidb_tpu.parallel.membership import CLUSTER_GATE, TableGates
 from tidb_tpu.parser import ast as A
 from tidb_tpu.parser import parse
 from tidb_tpu.parser.printer import expr_to_sql
@@ -478,9 +480,9 @@ class Worker:
         self._peer_socks: Dict[Tuple[str, int], socket.socket] = {}
         self._peer_locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._peer_pool_lock = threading.Lock()
-        # reshard idempotency: shuffle ids this worker already applied —
-        # a re-driven reshard_apply (lost response) must NOT truncate
-        # again against an inbox it already drained and closed
+        # reshard idempotency ledger: per-(run, shard) install keys this
+        # worker already applied — a re-driven reshard_install (lost
+        # response, recover_reshard) must NOT land the staging rows twice
         self._reshards_done: Dict[str, int] = {}
         # one pending prepared 2PC transaction at a time (the shared
         # session holds its provisional writes between the prepare and
@@ -1015,46 +1017,210 @@ class Worker:
                 except Exception:  # noqa: BLE001 — cleanup best effort
                     pass
 
-    def _reshard_apply(self, msg: Dict) -> int:
-        """Swap this worker's slice of `table` for the rows the
-        resharding scatter staged here: truncate, then land every
-        inbox batch. Runs under the exec lock so no statement observes
-        the half-swapped table. IDEMPOTENT against coordinator
-        re-drives (a lost response must not truncate again over an
-        already-drained inbox), and the inbox entry releases only on
-        SUCCESS — a failed apply keeps the staged rows, which are the
-        only remaining copy once the truncate ran."""
+    def _table_like(self, db: str, name: str, like: str):
+        """Resolve `name`, cloning `like`'s FULL schema (defaults,
+        constraints, generated columns intact) when absent. Replica
+        `__part` mirrors and reshard backfill staging tables both go
+        through here: a staging table must apply double-written DML
+        exactly like the real table, or the cutover fingerprints can
+        never match."""
+        import copy
+
+        cat = self.session.catalog
+        try:
+            return cat.table(db, name)
+        except Exception:  # noqa: BLE001 — absent: clone it
+            base = cat.table(db, like)
+            schema = copy.deepcopy(base.schema)
+            schema.name = name
+            cat.create_table(db, schema)
+            return cat.table(db, name)
+
+    def _reshard_backfill(self, msg: Dict) -> Dict:
+        """Online-reshard backfill SOURCE (ISSUE 19): extract this
+        worker's live rows of `table` that the NEW map assigns to
+        `shard` and stage them into the destination owner's staging
+        table — a peer-to-peer hop like _shuffle_scatter (no
+        coordinator copy), carrying the same mandatory deadline/trace
+        envelope. The extract+encode runs under the exec lock (a point
+        snapshot no concurrent statement can tear); the peer send runs
+        with NO lock held."""
+        from tidb_tpu.sharding import placement as pl
         from tidb_tpu.sharding import shuffle as shfl
 
-        sid = str(msg["shuffle_id"])
+        inject("reshard.backfill")
         db = msg.get("db") or self.session.db
-        t = self.session.catalog.table(db, msg["table"])
-        types = {c.name: c.type_ for c in t.schema.columns}
+        smap = pl.ShardMap.from_wire(msg["map"])
+        shard = int(msg["shard"])
         with self._exec_lock:
-            # same guard as every statement path: TRUNCATE is DDL and
-            # would IMPLICITLY COMMIT a pending prepared 2PC txn —
-            # refuse typed instead (the reshard recovers once the
-            # coordinator resolves the transaction)
+            self._guard_2pc_locked()
+            table = self.session.catalog.table(db, msg["table"])
+            arrays, valids, strings, _n = shfl.extract_live_columns(table)
+            if smap.column in strings:
+                raise UnsupportedError(
+                    "reshard: string shard keys are unsupported "
+                    "(dictionary codes are process-local)")
+            shards = pl.shard_of_array(smap, arrays[smap.column],
+                                       valids[smap.column])
+            idx = np.nonzero(shards == np.int64(shard))[0]
+            if not len(idx):
+                return {"rows": 0, "bytes": 0}
+            types = {c.name: c.type_ for c in table.schema.columns}
+            batch = shfl.encode_batch(
+                types, {k: v[idx] for k, v in arrays.items()},
+                {k: v[idx] for k, v in valids.items()},
+                {k: [col[i] for i in idx] for k, col in strings.items()})
+        stage_msg = {"cmd": "reshard_stage", "table": msg["staging"],
+                     "like": msg["table"], "db": msg.get("db"),
+                     "batch": batch}
+        if int(msg["dest_index"]) == int(msg["self_index"]):
+            rows = self._reshard_stage(stage_msg)
+            return {"rows": int(rows), "bytes": 0}
+        host, port = msg["dest"]
+        timeout = float(msg.get("timeout_s") or 30.0)
+        dl = msg.get("_deadline_mono")
+        if dl is not None:
+            rem = dl - time.monotonic()
+            if rem <= 0:
+                raise QueryTimeoutError(
+                    "Query execution was interrupted, maximum statement "
+                    "execution time exceeded (before reshard stage to "
+                    f"{host}:{port})")
+            stage_msg["deadline_s"] = rem
+            timeout = min(timeout, rem)
+        tr = tracing.current()
+        if tr is not None:
+            stage_msg["trace_id"] = tr.trace_id
+        resp = self._peer_call(str(host), int(port), stage_msg, timeout)
+        if not resp.get("ok"):
+            err = str(resp.get("error"))
+            raise _retype_wire_error(
+                err, f"reshard stage to {host}:{port} failed: {err}")
+        return {"rows": int(resp["result"]), "bytes": 0}
+
+    def _reshard_stage(self, msg: Dict) -> int:
+        """Backfill DESTINATION: land one shipped batch into the
+        staging table (cloned from the real table's full schema on
+        first touch)."""
+        from tidb_tpu.sharding import shuffle as shfl
+
+        db = msg.get("db") or self.session.db
+        with self._exec_lock:
+            self._guard_2pc_locked()
+            t = self._table_like(db, msg["table"], msg["like"])
+            types = {c.name: c.type_ for c in t.schema.columns}
+            b = msg["batch"]
+            if not b["n"]:
+                return 0
+            arrays, valids, strs = shfl.decode_batch(types, b)
+            return t.insert_columns(arrays, valids, strings=strs)
+
+    def _reshard_fingerprint(self, msg: Dict) -> Dict:
+        """Row-count + order-independent hash of a table's live rows —
+        restricted to the rows the shipped map assigns to `shard` when
+        a map is given (source side), the whole table otherwise
+        (staging side). An absent table is an EMPTY row set, not an
+        error: a shard nobody backfilled anything for has no staging
+        table and must still validate."""
+        from tidb_tpu.parallel.membership import rows_fingerprint
+        from tidb_tpu.sharding import placement as pl
+        from tidb_tpu.sharding import shuffle as shfl
+
+        db = msg.get("db") or self.session.db
+        with self._exec_lock:
+            try:
+                table = self.session.catalog.table(db, msg["table"])
+            except Exception:  # noqa: BLE001 — absent: empty set
+                return {"n": 0, "fp": 0}
+            arrays, valids, strings, _n = shfl.extract_live_columns(table)
+            sel = None
+            if msg.get("map") is not None:
+                smap = pl.ShardMap.from_wire(msg["map"])
+                shards = pl.shard_of_array(smap, arrays[smap.column],
+                                           valids[smap.column])
+                sel = shards == np.int64(int(msg["shard"]))
+            n, fp = rows_fingerprint(arrays, valids, strings,
+                                     table.schema.public_names(), sel)
+        return {"n": n, "fp": fp}
+
+    def _reshard_install(self, msg: Dict) -> int:
+        """Cutover at the new owner: move the validated staging rows
+        into the real table and drop the staging. IDEMPOTENT against
+        coordinator re-drives (recover_reshard) via the per-(run,shard)
+        ledger — a lost response must not install twice."""
+        from tidb_tpu.sharding import shuffle as shfl
+
+        key = f"{msg['sid']}#i{int(msg['shard'])}"
+        db = msg.get("db") or self.session.db
+        cat = self.session.catalog
+        with self._exec_lock:
             self._guard_2pc_locked()
             with self._placed_lock:
-                done = self._reshards_done.get(sid)
+                done = self._reshards_done.get(key)
             if done is not None:
                 return done
-            batches = self._inbox.drain(sid, str(msg["side"]))
-            self.session.execute(f"truncate table `{msg['table']}`")
             total = 0
-            for b in batches:
-                arrays, valids, strs = shfl.decode_batch(types, b)
-                if b["n"]:
-                    total += t.insert_columns(arrays, valids,
-                                              strings=strs)
+            try:
+                st = cat.table(db, msg["staging"])
+            except Exception:  # noqa: BLE001 — nothing backfilled
+                st = None
+            if st is not None:
+                t = cat.table(db, msg["table"])
+                arrays, valids, strings, n = shfl.extract_live_columns(st)
+                if n:
+                    total = t.insert_columns(arrays, valids,
+                                             strings=strings)
+                cat.drop_table(db, msg["staging"], if_exists=True)
             with self._placed_lock:
-                self._reshards_done[sid] = total
+                self._reshards_done[key] = total
                 while len(self._reshards_done) > 64:
                     self._reshards_done.pop(
                         next(iter(self._reshards_done)))
-            self._inbox.close(sid)
             return total
+
+    def _reshard_purge(self, msg: Dict) -> int:
+        """Cutover at an old owner: delete the live rows the NEW map
+        assigns to `shard` (their installed copy at the new owner is
+        the surviving one). Naturally idempotent — a re-drive finds no
+        matching live rows."""
+        from tidb_tpu.sharding import placement as pl
+
+        db = msg.get("db") or self.session.db
+        smap = pl.ShardMap.from_wire(msg["map"])
+        shard = int(msg["shard"])
+        with self._exec_lock:
+            self._guard_2pc_locked()
+            t = self.session.catalog.table(db, msg["table"])
+            n = t.n
+            if not n:
+                return 0
+            idx = np.nonzero(t.live_mask(0, n))[0]
+            if not len(idx):
+                return 0
+            shards = pl.shard_of_array(
+                smap, t.data[smap.column][:n][idx],
+                t.valid[smap.column][:n][idx])
+            victims = idx[shards == np.int64(shard)]
+            if not len(victims):
+                return 0
+            return t.delete_rows(victims)
+
+    def _table_dump(self, msg: Dict) -> Dict:
+        """Full live-row snapshot of a table in load_columns shape —
+        the coordinator's source for replica-mirror rebuilds and for
+        seeding a joining worker's broadcast tables."""
+        from tidb_tpu.sharding import shuffle as shfl
+
+        db = msg.get("db") or self.session.db
+        with self._exec_lock:
+            try:
+                table = self.session.catalog.table(db, msg["table"])
+            except Exception:  # noqa: BLE001 — absent: empty dump
+                return {"arrays": {}, "valids": {}, "strings": {},
+                        "n": 0}
+            arrays, valids, strings, n = shfl.extract_live_columns(table)
+        return {"arrays": arrays, "valids": valids, "strings": strings,
+                "n": n}
 
     def _partial_paged(self, msg: Dict) -> Dict:
         """Run the partial once, return the first page + a cursor the
@@ -1175,8 +1341,18 @@ class Worker:
         if cmd == "shuffle_close":
             self._inbox.close(str(msg["shuffle_id"]))
             return "closed"
-        if cmd == "reshard_apply":
-            return self._reshard_apply(msg)
+        if cmd == "reshard_backfill":
+            return self._reshard_backfill(msg)
+        if cmd == "reshard_stage":
+            return self._reshard_stage(msg)
+        if cmd == "reshard_fingerprint":
+            return self._reshard_fingerprint(msg)
+        if cmd == "reshard_install":
+            return self._reshard_install(msg)
+        if cmd == "reshard_purge":
+            return self._reshard_purge(msg)
+        if cmd == "table_dump":
+            return self._table_dump(msg)
         if cmd in ("txn_prepare", "txn_commit", "txn_abort"):
             return self._txn2pc_cmd(cmd, msg)
         if cmd == "exec":
@@ -1194,21 +1370,16 @@ class Worker:
         if cmd == "load_columns":
             db = msg.get("db") or self.session.db
             name = msg["table"]
-            cat = self.session.catalog
             like = msg.get("like")
             if like is not None:
                 # replica partitions clone the base table's schema into
                 # their own namespaced table on first load
-                try:
-                    cat.table(db, name)
-                except Exception:  # noqa: BLE001 — absent: clone it
-                    import copy
-
-                    base = cat.table(db, like)
-                    schema = copy.deepcopy(base.schema)
-                    schema.name = name
-                    cat.create_table(db, schema)
-            table = cat.table(db, name)
+                table = self._table_like(db, name, like)
+            else:
+                table = self.session.catalog.table(db, name)
+            if msg.get("replace"):
+                # mirror rebuild / joiner seed: this load IS the table
+                table.truncate()
             return table.insert_columns(
                 msg.get("arrays") or {}, msg.get("valids"),
                 strings=msg.get("strings"))
@@ -1590,6 +1761,23 @@ def _shard_eq_value(where, table: str, column: str):
     return None, False
 
 
+def _rewrite_dml_table(sql: str, name: str, repl: str) -> str:
+    """Retarget an UPDATE/DELETE statement at a different physical
+    table (the reshard double-write against a staging copy). Textual
+    but anchored: only the leading ``update <name>`` / ``delete from
+    <name>`` token rewrites, so a same-named column or string literal
+    deeper in the statement stays untouched."""
+    pat = re.compile(
+        r"^(\s*(?:update|delete\s+from)\s+)(`%s`|%s)\b"
+        % (re.escape(name), re.escape(name)), re.IGNORECASE)
+    out, n = pat.subn(lambda m: m.group(1) + f"`{repl}`", sql, count=1)
+    if not n:
+        raise UnsupportedError(
+            "dcn dml: cannot retarget statement at the reshard staging "
+            f"copy ({sql[:60]!r})")
+    return out
+
+
 def _walk_exprs(node):
     """Every dataclass expr node reachable from `node` (AST subtrees,
     lists, tuples) — the EName harvest for used-column analysis."""
@@ -1723,7 +1911,11 @@ class _DmlWindow:
         merged: Dict[int, List[str]] = {}
         for m in members:
             for w, sql in m.per_worker.items():
-                merged.setdefault(w, []).append(sql)
+                # a member's per-worker value may itself be a LIST
+                # (reshard double-writes): flatten, don't nest
+                bucket = merged.setdefault(w, [])
+                (bucket.extend if isinstance(sql, list)
+                 else bucket.append)(sql)
         with self._lock:
             self.windows += 1
             self.coalesced_stmts += len(members)
@@ -1781,6 +1973,9 @@ class Cluster:
     RECONNECT_MAX_DOUBLINGS = 6   # attempts beyond this probe at the cap
     JITTER_FRAC = 0.25
     CANCEL_DIAL_TIMEOUT_S = 2.0   # side-channel cancel must never hang
+    # default bound on a statement's wait for a topology-change gate
+    # (overridden per-session by tidb_tpu_reshard_gate_wait_ms)
+    GATE_WAIT_S = 10.0
 
     def __init__(self, endpoints: List[Tuple[str, int]],
                  secret: Optional[str] = None,
@@ -1809,13 +2004,24 @@ class Cluster:
         self._placement_bytes: Dict[str, int] = {}
         self._placement_lock = threading.Lock()
         self._table_cols_cache: Dict[str, List[str]] = {}
-        # reshard fence + recovery: while a table is in `_resharding`
-        # (live reshard) or `_reshard_pending` (phase B interrupted —
-        # some workers swapped, some not), statements against it are
-        # refused TYPED instead of silently mixing placement epochs;
-        # recover_reshard() re-drives the idempotent applies
-        self._resharding: set = set()
-        self._reshard_pending: Dict[str, Dict] = {}
+        # online reshard (ISSUE 19): table -> per-shard state machine
+        # ({"sid","old","new","moves","shards","dw","xl"}). Statements
+        # keep routing by the OLD map while shards backfill in the
+        # background; DML double-writes to the destination staging for
+        # shards in `dw`; the fence narrows to shards left in "cutover"
+        # by a fault (recover_reshard re-drives the idempotent half).
+        self._reshard_state: Dict[str, Dict] = {}
+        # per-table readers/writer gates: every statement read-acquires
+        # its tables + CLUSTER_GATE; backfill/cutover/membership
+        # finalize write-acquire briefly (bounded — see membership.py)
+        self._gates = TableGates()
+        # elastic membership: DDL replay log for joiners, and the drain
+        # translation (old worker index -> surviving socket index) that
+        # keeps already-compacted placements routable mid-drain
+        self._ddl_log: List[str] = []
+        self._membership_lock = threading.Lock()
+        self._draining: Optional[int] = None
+        self._drain_xl: Optional[Dict[int, int]] = None
         # 2PC coordinator state: xid -> participant worker ids. A txn
         # moves pending -> decided at the commit point; recover_txns()
         # finishes either side after a coordinator "crash" (failpoint
@@ -1844,11 +2050,22 @@ class Cluster:
         from tidb_tpu.session import Session
 
         self._merge_session = Session()
+        # concurrent statements share the merge session and its one
+        # __dcn_partial__ staging table: the merge phase serializes
+        # behind this lock (sustained mixed traffic runs DURING
+        # topology changes — ISSUE 19; worker-side partials still
+        # compute concurrently, only the coordinator merge queues)
+        self._merge_lock = threading.Lock()
         _CLUSTERS.add(self)
 
     def _set_state(self, i: int, state: str) -> None:
-        self._health[i].state = state
-        self._health[i].since = time.monotonic()
+        # entry FIELDS are confined by _sock_locks[i] (every caller is
+        # a *_locked method) or by construction (ctor/add_worker touch
+        # an index no statement can reach yet); the list SHAPE is what
+        # _membership_lock + the cluster gate guard
+        h = self._health[i]
+        h.state = state
+        h.since = time.monotonic()
         from tidb_tpu.utils.metrics import WORKER_STATE
 
         host, port = self._endpoints[i]
@@ -2089,6 +2306,11 @@ class Cluster:
 
     def broadcast_exec(self, sql: str) -> None:
         self._call_all([{"cmd": "exec", "sql": sql}] * len(self._socks))
+        # membership replay log: add_worker() replays the broadcast
+        # history so a joiner's schema (and broadcast-table DDL) match
+        # the fleet before it takes placement traffic
+        with self._membership_lock:
+            self._ddl_log.append(sql)
 
     def online_ddl(self, sql: str, between_stages=None) -> None:
         """ONLINE multi-version schema change across worker processes
@@ -2121,6 +2343,11 @@ class Cluster:
                 done.append(stage)
                 if between_stages is not None:
                     between_stages(stage)
+            # fully public everywhere: one replayable statement for
+            # future joiners (a joiner applies it atomically — it has
+            # no concurrent DML to stage around)
+            with self._membership_lock:
+                self._ddl_log.append(sql)
         except Exception:
             if "public" not in done:
                 try:
@@ -2244,9 +2471,18 @@ class Cluster:
         from tidb_tpu.sharding import placement as pl
         from tidb_tpu.sharding import shuffle as shfl
 
-        # rows landed mid-reshard would be silently erased by the
-        # apply-phase truncate — same fence as scans and DML
         self._check_reshard_fence([table])
+        # bulk loads don't ride the double-write machinery: rows landed
+        # mid-reshard/mid-drain would miss the staging copy and vanish
+        # at cutover — refuse typed until the topology settles
+        if self._mid_reshard(table):
+            raise ExecutionError(
+                f"load_sharded({table!r}): table is mid-reshard; "
+                "retry after the reshard completes")
+        if self._draining is not None:
+            raise ExecutionError(
+                f"load_sharded({table!r}): worker {self._draining} is "
+                "draining; retry after remove_worker completes")
         smap = self.placement(table)
         if smap is None:
             raise ExecutionError(
@@ -2323,18 +2559,76 @@ class Cluster:
         return cols
 
     def _check_reshard_fence(self, names) -> None:
-        """Refuse statements against tables mid-reshard (live, or
-        interrupted awaiting recover_reshard()): routing by either map
-        over a half-swapped fleet silently double-counts or drops the
-        moved rows."""
+        """Refuse statements against a SHARD left in "cutover" by a
+        fault (half-swapped: sources may be part-purged, the
+        destination not yet installed — either map double-counts or
+        drops its rows). Per-shard, not per-table: a healthy online
+        reshard never trips this — its cutover windows hide behind the
+        table gate instead — and the refusal names the stuck shard so
+        the operator knows exactly what recover_reshard() will fix."""
         with self._placement_lock:
-            fenced = [n for n in names
-                      if n in self._resharding
-                      or n in self._reshard_pending]
+            fenced = []
+            for n in names:
+                rst = self._reshard_state.get(n)
+                if rst is None:
+                    continue
+                stuck = sorted(s for s, v in rst["shards"].items()
+                               if v == "cutover")
+                if stuck:
+                    fenced.append((n, stuck))
         if fenced:
+            detail = "; ".join(f"{n!r} shard(s) {sh}" for n, sh in fenced)
             raise ExecutionError(
-                f"table(s) {fenced} are being resharded; retry after "
-                "the reshard (or Cluster.recover_reshard()) completes")
+                f"shard cutover interrupted: {detail} — "
+                "Cluster.recover_reshard() finishes the swap")
+
+    def _acquire_read_gate(self, names, session=None) -> List[str]:
+        """Statement-side topology gate: shared-acquire the touched
+        tables plus CLUSTER_GATE. Bounded — a stuck cutover degrades
+        this statement TYPED after the configured wait, never hangs
+        it."""
+        wait = self.GATE_WAIT_S
+        if session is not None:
+            try:
+                wait = float(session.sysvars.get(
+                    "tidb_tpu_reshard_gate_wait_ms")) / 1e3
+            except Exception:  # noqa: BLE001 — default stands
+                pass
+        try:
+            return self._gates.acquire_read([*names, CLUSTER_GATE],
+                                            timeout_s=wait)
+        except TimeoutError as e:
+            raise ExecutionError(
+                f"topology change in progress: {e}") from None
+
+    def _owner_socket(self, smap, w: int) -> int:
+        """Socket index serving a placement's worker index `w`.
+        Identity except mid-drain: a table already compacted onto W-1
+        workers (its map's n_workers differs from the live socket
+        count) routes through the drain translation until
+        remove_worker() finalizes the socket list."""
+        if (self._drain_xl is not None
+                and smap.n_workers != len(self._socks)):
+            return self._drain_xl.get(int(w), int(w))
+        return int(w)
+
+    def _mid_reshard(self, name: str) -> bool:
+        with self._placement_lock:
+            return name in self._reshard_state
+
+    def _effective_owner_workers(self, name: str, smap) -> List[int]:
+        """Socket indices that may hold live rows of `name` RIGHT NOW:
+        the (drain-translated) old-map owners, plus destinations of
+        shards already cut over mid-reshard. This is the scan/scatter
+        dispatch set while a topology change is in flight."""
+        with self._placement_lock:
+            rst = self._reshard_state.get(name)
+            extra = ({rst["moves"][s][1]
+                      for s, v in rst["shards"].items() if v == "done"}
+                     if rst is not None else set())
+        out = {self._owner_socket(smap, w) for w in smap.owners()}
+        out |= extra
+        return sorted(w for w in out if 0 <= w < len(self._socks))
 
     # -- distributed writes: 2PC across shard owners --------------------
 
@@ -2344,37 +2638,45 @@ class Cluster:
         the shard key (literal rows only); UPDATE/DELETE run on every
         owner (each owns a disjoint slice, so the same statement is
         exact fleet-wide), pruned to one owner when the WHERE pins the
-        shard column to a literal. Returns {"xid", "workers"}."""
+        shard column to a literal. During an online reshard the same
+        statement ALSO lands on the staging copy of every moved shard
+        still in its double-write window — riding the same 2PC, so
+        both placements commit or neither. Returns {"xid", "workers"}."""
         stmts = parse(sql)
         if len(stmts) != 1:
             raise UnsupportedError("dcn dml handles a single statement")
         st = stmts[0]
-        if hasattr(st, "table"):
-            self._check_reshard_fence([st.table.name])
-        if isinstance(st, A.InsertStmt):
-            per_worker = self._route_insert(st)
-        elif isinstance(st, (A.UpdateStmt, A.DeleteStmt)):
-            name = st.table.name
-            smap = self.placement(name)
-            if smap is None:
-                raise ExecutionError(
-                    f"no shard placement registered for {name!r}")
-            targets = sorted(smap.owners())
-            val, found = _shard_eq_value(getattr(st, "where", None),
-                                         name, smap.column)
-            if found:
-                w = smap.worker_of(smap.shard_of(val))
-                if w in targets:
-                    targets = [w]
-            per_worker = {w: sql for w in targets}
-        else:
-            raise UnsupportedError(
-                "dcn dml handles INSERT ... VALUES / UPDATE / DELETE")
-        if self.dml_window_us > 0:
-            return self._dml_window.submit(per_worker)
-        return self._two_phase(per_worker)
+        names = [st.table.name] if hasattr(st, "table") else []
+        self._check_reshard_fence(names)
+        gate = self._acquire_read_gate(names)
+        try:
+            if isinstance(st, A.InsertStmt):
+                per_worker = self._route_insert(st)
+            elif isinstance(st, (A.UpdateStmt, A.DeleteStmt)):
+                per_worker = self._route_update_delete(st, sql)
+            else:
+                raise UnsupportedError(
+                    "dcn dml handles INSERT ... VALUES / UPDATE / "
+                    "DELETE")
+            if self.dml_window_us > 0:
+                return self._dml_window.submit(per_worker)
+            return self._two_phase(per_worker)
+        finally:
+            self._gates.release_read(gate)
 
-    def _route_insert(self, st) -> Dict[int, str]:
+    def _reshard_snapshot(self, name: str):
+        """Point-in-time copy of a table's reshard routing state (or
+        None): (per-shard states, double-write set, moves, new map) —
+        taken under the placement lock so a statement routes by ONE
+        consistent view even as shards flip around it."""
+        with self._placement_lock:
+            rst = self._reshard_state.get(name)
+            if rst is None:
+                return None
+            return (dict(rst["shards"]), set(rst["dw"]), rst["moves"],
+                    rst["new"])
+
+    def _route_insert(self, st) -> Dict[int, object]:
         name = st.table.name
         smap = self.placement(name)
         if smap is None:
@@ -2389,7 +2691,12 @@ class Cluster:
             raise UnsupportedError(
                 f"dcn dml: INSERT must supply shard column "
                 f"{smap.column!r}")
-        groups: Dict[int, List[str]] = {}
+        snap = self._reshard_snapshot(name)
+        # (socket, physical table) -> VALUES tuples. Mid-reshard, a row
+        # whose NEW shard already cut over routes to its new owner
+        # alone; one still backfilling double-writes old owner + the
+        # destination's staging copy
+        groups: Dict[Tuple[int, str], List[str]] = {}
         for row in st.rows:
             if ki >= len(row):
                 raise UnsupportedError("dcn dml: row narrower than the "
@@ -2399,15 +2706,78 @@ class Cluster:
                 raise UnsupportedError(
                     "dcn dml: shard-key values must be integer "
                     "literals (or NULL)")
-            w = smap.worker_of(smap.shard_of(v))
-            groups.setdefault(w, []).append(
-                "(" + ", ".join(expr_to_sql(e) for e in row) + ")")
+            vals = "(" + ", ".join(expr_to_sql(e) for e in row) + ")"
+            w_old = self._owner_socket(
+                smap, smap.worker_of(smap.shard_of(v)))
+            if snap is None:
+                groups.setdefault((w_old, name), []).append(vals)
+                continue
+            shards, dw, moves, new = snap
+            s_new = new.shard_of(v)
+            if shards.get(s_new) == "done":
+                groups.setdefault((moves[s_new][1], name),
+                                  []).append(vals)
+                continue
+            groups.setdefault((w_old, name), []).append(vals)
+            if s_new in dw:
+                groups.setdefault(
+                    (moves[s_new][1], f"{name}__bf{s_new}"),
+                    []).append(vals)
         collist = ""
         if st.columns:
             collist = " (" + ", ".join(f"`{c}`" for c in st.columns) + ")"
-        return {w: f"insert into `{name}`{collist} values "
-                   + ", ".join(vals)
-                for w, vals in groups.items()}
+        per: Dict[int, List[str]] = {}
+        for (w, tbl), vals in groups.items():
+            per.setdefault(w, []).append(
+                f"insert into `{tbl}`{collist} values "
+                + ", ".join(vals))
+        return {w: (sqls[0] if len(sqls) == 1 else sqls)
+                for w, sqls in per.items()}
+
+    def _route_update_delete(self, st, sql: str) -> Dict[int, object]:
+        name = st.table.name
+        smap = self.placement(name)
+        if smap is None:
+            raise ExecutionError(
+                f"no shard placement registered for {name!r}")
+        snap = self._reshard_snapshot(name)
+        val, found = _shard_eq_value(getattr(st, "where", None),
+                                     name, smap.column)
+        per: Dict[int, List[str]] = {}
+
+        def add(w: int, s: str) -> None:
+            lst = per.setdefault(w, [])
+            if s not in lst:
+                lst.append(s)
+
+        if found:
+            w_old = self._owner_socket(
+                smap, smap.worker_of(smap.shard_of(val)))
+            if snap is None:
+                add(w_old, sql)
+            else:
+                shards, dw, moves, new = snap
+                s_new = new.shard_of(val)
+                if shards.get(s_new) == "done":
+                    add(moves[s_new][1], sql)
+                else:
+                    add(w_old, sql)
+                    if s_new in dw:
+                        add(moves[s_new][1], _rewrite_dml_table(
+                            sql, name, f"{name}__bf{s_new}"))
+        else:
+            for w in smap.owners():
+                add(self._owner_socket(smap, w), sql)
+            if snap is not None:
+                shards, dw, moves, new = snap
+                for s, v in shards.items():
+                    if v == "done":
+                        add(moves[s][1], sql)
+                for s in sorted(dw):
+                    add(moves[s][1], _rewrite_dml_table(
+                        sql, name, f"{name}__bf{s}"))
+        return {w: (sqls[0] if len(sqls) == 1 else sqls)
+                for w, sqls in per.items()}
 
     def _two_phase(self, per_worker: Dict[int, object]) -> Dict[str, object]:
         """PREPARE on every participant -> record the commit decision
@@ -2507,24 +2877,23 @@ class Cluster:
                 out[xid] = "aborted"
         return out
 
-    # -- resharding -----------------------------------------------------
+    # -- online resharding (ISSUE 19) -----------------------------------
 
     def reshard(self, sql: str) -> None:
-        """ALTER TABLE ... SHARD BY across the fleet: broadcast the
-        metadata change (every worker's schema_version bumps, demoting
-        cached plans), then redistribute the rows through the shuffle
-        machinery — each current owner scatters its slice by the NEW
-        map, each worker swaps its slice for what it received.
-        Stop-the-world for the table; replica mirrors are refused (they
-        would silently serve the OLD placement on failover)."""
+        """ALTER TABLE ... SHARD BY across the fleet, ONLINE:
+        statements keep routing by the OLD map while every moved shard
+        backfills into a staging table at its new owner; DML
+        double-writes both placements per moved shard; each shard cuts
+        over independently behind a brief per-table write gate, only
+        after a row-count + order-independent-hash validation against
+        its sources. A fault mid-cutover narrows the fence to THAT
+        shard; recover_reshard() finishes the run from its per-shard
+        watermark. Replica `__part` mirrors rebuild per shard, so
+        failover never serves the old placement."""
         stmt = parse(sql)[0]
         if not (isinstance(stmt, A.AlterTableStmt)
                 and stmt.action == "reshard"):
             raise UnsupportedError("reshard() takes ALTER ... SHARD BY")
-        if self.replicas:
-            raise UnsupportedError(
-                "reshard with replica mirrors is unsupported: the "
-                "`__part` copies would keep the old placement")
         name = stmt.table.name
         old = self.placement(name)
         if old is None:
@@ -2539,91 +2908,425 @@ class Cluster:
                            old.version + 1)
         else:
             new = ShardMap("hash", col, int(arg), W, (), old.version + 1)
+        # metadata first, OUTSIDE the reshard state: every worker's
+        # schema_version bumps (demoting cached plans), and a failure
+        # here leaves nothing to clean up
+        self.broadcast_exec(sql)
+        self._online_reshard(name, new)
+
+    def _online_reshard(self, name: str, new) -> None:
+        """Shared served-through driver for reshard() and membership
+        changes: register the per-shard state machine, then drive it.
+        A fault BEFORE anything destructive abandons cleanly (old
+        placement keeps serving, unfenced); after the first cutover
+        began, state is kept for recover_reshard()."""
+        from tidb_tpu.sharding import placement as pl
+        from tidb_tpu.utils.metrics import RESHARD_ACTIVE
+
+        old = self.placement(name)
+        # drain translation: under remove_worker the NEW map's worker
+        # indices live in the compacted space — resolve destinations to
+        # live socket indices HERE (a placement-level skip test would
+        # compare across the two index spaces and mis-skip)
+        translate = self._drain_xl if (
+            self._draining is not None
+            and new.n_workers < old.n_workers) else None
+        same_fn = (old.kind == new.kind and old.column == new.column
+                   and old.shards == new.shards
+                   and old.bounds == new.bounds)
+        moves: Dict[int, Tuple[List[int], int]] = {}
+        for s in range(new.shards):
+            dst = pl.worker_of_shard(s, new.n_workers)
+            if translate is not None:
+                dst = translate.get(dst, dst)
+            if same_fn:
+                src = pl.worker_of_shard(s, old.n_workers)
+                if src == dst:
+                    continue  # same socket keeps the shard: no move
+                moves[s] = ([src], dst)
+            else:
+                # shard function changed: any old shard can feed any
+                # new one, so every old owner is a source
+                moves[s] = (sorted(old.owners()), dst)
+        sid = f"reshard{os.getpid()}-{next(_TOKEN_SEQ)}"
+        state = {"sid": sid, "old": old, "new": new, "moves": moves,
+                 "shards": {s: "pending" for s in moves},
+                 "dw": set(), "xl": translate}
         with self._placement_lock:
-            if name in self._resharding or name in self._reshard_pending:
+            if name in self._reshard_state:
                 raise ExecutionError(
                     f"table {name!r} is already mid-reshard")
-            self._resharding.add(name)
-        sid = f"reshard{os.getpid()}-{next(_TOKEN_SEQ)}"
-        peers = [[h, p] for h, p in self._endpoints]
+            self._reshard_state[name] = state
+        RESHARD_ACTIVE.set(1, table=name)
         try:
-            self.broadcast_exec(sql)
-            # phase A: every current owner scatters by the NEW map. A
-            # failure HERE is recoverable by dropping the staged state:
-            # no worker has truncated anything yet
-            try:
-                for w in sorted(old.owners()):
-                    self._call(w, {
-                        "cmd": "shuffle_scatter", "shuffle_id": sid,
-                        "table": name, "side": name, "mode": "hash",
-                        "key": new.column, "map": new.to_wire(),
-                        "n_workers": W, "self_index": w, "peers": peers})
-            except Exception:
-                self._shuffle_close_all(sid, range(W))
-                raise
-            # phase B: every worker swaps its slice for the staged
-            # rows. From the first apply on, the staged batches are the
-            # ONLY copy of moved rows — a failure must KEEP them (and
-            # the fence) for recover_reshard(), never drop them
-            state = {"sid": sid, "map": new,
-                     "remaining": list(range(W))}
-            with self._placement_lock:
-                self._reshard_pending[name] = state
-            self._finish_reshard(name, state)
+            self._drive_reshard(name, state)
+        except Exception:
+            if not self._reshard_destructive(state):
+                self._abandon_reshard(name, state)
+            raise
         finally:
             with self._placement_lock:
-                self._resharding.discard(name)
+                active = name in self._reshard_state
+            RESHARD_ACTIVE.set(1 if active else 0, table=name)
 
-    def _finish_reshard(self, name: str, state: Dict) -> None:
-        """Drive (or re-drive) reshard phase B: apply on every
-        remaining worker (idempotent server-side — a lost response
-        re-drives safely), then install the new placement and release
-        the fence. Raises typed on remaining failures, keeping the
-        pending record so recover_reshard() can finish the job."""
-        sid, new = state["sid"], state["map"]
-        W = len(self._socks)
-        errs = []
-        for w in list(state["remaining"]):
+    @staticmethod
+    def _reshard_destructive(state: Dict) -> bool:
+        """True once any shard reached "cutover": sources may be
+        part-purged, so the run can no longer abandon — only recover
+        forward."""
+        return any(v in ("cutover", "done")
+                   for v in state["shards"].values())
+
+    def _drive_reshard(self, name: str, state: Dict) -> None:
+        """Advance the state machine from wherever it stands (first run
+        and recover_reshard both land here): backfill every pending
+        shard — the double-write window opens per shard as it stages —
+        then cut each staged/stuck shard over. Validation is skipped
+        for shards re-entered in "cutover": their sources may already
+        be half-purged, and purge/install are idempotent."""
+        for s in sorted(state["shards"]):
+            if state["shards"][s] == "pending":
+                self._backfill_shard(name, state, s)
+        for s in sorted(state["shards"]):
+            st = state["shards"][s]
+            if st in ("staged", "cutover"):
+                self._cutover_shard(name, state, s,
+                                    validate=(st == "staged"))
+        self._finalize_reshard(name, state)
+
+    def _backfill_shard(self, name: str, state: Dict, s: int) -> None:
+        """Copy shard `s`'s live rows from every source owner into the
+        staging table at its destination (peer-to-peer, off the
+        coordinator's wire). The table's write gate is held across
+        extract + double-write enable, so the snapshot and the
+        double-write stream tile EXACTLY — no statement can slip a
+        write between them (the MVCC extract would miss it or the
+        staging would double it)."""
+        srcs, dst = state["moves"][s]
+        staging = f"{name}__bf{s}"
+        peers = [[h, p] for h, p in self._endpoints]
+        self._gates.acquire_write(name)
+        try:
+            for w in srcs:
+                self._call(w, {
+                    "cmd": "reshard_backfill", "table": name,
+                    "staging": staging, "shard": int(s),
+                    "map": state["new"].to_wire(),
+                    "dest": peers[dst], "dest_index": int(dst),
+                    "self_index": int(w)})
+            with self._placement_lock:
+                state["dw"].add(s)
+                state["shards"][s] = "staged"
+        finally:
+            self._gates.release_write(name)
+        from tidb_tpu.utils.metrics import RESHARD_SHARDS_TOTAL
+
+        RESHARD_SHARDS_TOTAL.inc(phase="backfill")
+
+    def _cutover_shard(self, name: str, state: Dict, s: int,
+                       validate: bool) -> None:
+        """Flip one shard to the new placement behind the table's write
+        gate: validate the staging against the sources (row count +
+        order-independent hash), record the "cutover" watermark, purge
+        the moved rows at the sources, install the staging rows at the
+        destination, rebuild the touched replica mirrors — all in ONE
+        gate hold, so no statement observes the half-swapped shard.
+        Purge runs BEFORE install: when the destination is also a
+        source (shard-function change), the installed rows must not be
+        re-purged as "moved away"."""
+        srcs, dst = state["moves"][s]
+        staging = f"{name}__bf{s}"
+        new_wire = state["new"].to_wire()
+        self._gates.acquire_write(name)
+        try:
+            if validate:
+                got = self._call(dst, {"cmd": "reshard_fingerprint",
+                                       "table": staging})
+                want_n, want_fp = 0, 0
+                for w in srcs:
+                    r = self._call(w, {
+                        "cmd": "reshard_fingerprint", "table": name,
+                        "map": new_wire, "shard": int(s)})
+                    want_n += int(r["n"])
+                    want_fp = (want_fp + int(r["fp"])) % (1 << 64)
+                if want_n != int(got["n"]) or want_fp != int(got["fp"]):
+                    raise ExecutionError(
+                        f"reshard of {name!r}: shard {s} backfill "
+                        f"validation failed (sources n={want_n} "
+                        f"fp={want_fp:#x}, staging n={int(got['n'])} "
+                        f"fp={int(got['fp']):#x}) — not cutting over")
+            # WATERMARK: from here the swap is destructive. Recorded
+            # BEFORE the first purge so a fault below fences exactly
+            # this shard and recover_reshard() re-drives instead of
+            # abandoning
+            with self._placement_lock:
+                state["shards"][s] = "cutover"
+            inject("reshard.cutover")
+            for w in srcs:
+                self._call(w, {"cmd": "reshard_purge", "table": name,
+                               "map": new_wire, "shard": int(s)})
+            self._call(dst, {"cmd": "reshard_install", "table": name,
+                             "staging": staging, "sid": state["sid"],
+                             "shard": int(s)})
+            with self._placement_lock:
+                state["shards"][s] = "done"
+                state["dw"].discard(s)
+            for w in sorted({dst, *srcs}):
+                self._rebuild_mirror(name, w)
+        finally:
+            self._gates.release_write(name)
+        from tidb_tpu.utils.metrics import RESHARD_SHARDS_TOTAL
+
+        RESHARD_SHARDS_TOTAL.inc(phase="cutover")
+
+    def _finalize_reshard(self, name: str, state: Dict) -> None:
+        """Every shard flipped: install the new map as THE placement,
+        drop the run state (double-writes stop), and refresh each
+        socket's owned-shard listing (the stats surface scans read)."""
+        new, xl = state["new"], state["xl"]
+        listing: Dict[int, List[int]] = {}
+        for w_new, shs in new.owners().items():
+            sock = xl.get(w_new, w_new) if xl is not None else w_new
+            listing[sock] = shs
+        per_bytes = self._placement_bytes.get(name, 0) // max(
+            len(self._socks), 1)
+        for sock in range(len(self._socks)):
             try:
-                inject("reshard.apply")
-                self._call(w, {"cmd": "reshard_apply", "shuffle_id": sid,
-                               "table": name, "side": name})
-                state["remaining"].remove(w)
-            except Exception as e:  # noqa: BLE001 — collected; the
-                errs.append((w, e))  # pending record drives recovery
-        if errs:
-            raise ExecutionError(
-                f"reshard of {name!r} interrupted on workers "
-                f"{[w for w, _ in errs]} ({errs[0][1]}); staged rows "
-                "are retained — Cluster.recover_reshard() finishes it")
-        new_owners = new.owners()
-        for w in range(W):
-            try:
-                self._call(w, {"cmd": "place_shards", "table": name,
-                               "shards": new_owners.get(w, []),
-                               "bytes": self._placement_bytes.get(
-                                   name, 0) // max(W, 1)})
+                self._call(sock, {
+                    "cmd": "place_shards", "table": name,
+                    "shards": listing.get(sock, []),
+                    "bytes": per_bytes if listing.get(sock) else 0})
             except Exception:  # noqa: BLE001 — stats-only surface;
-                pass           # placement install below is what counts
+                pass           # the placement install is what counts
         with self._placement_lock:
             self._placements[name] = new
-            self._reshard_pending.pop(name, None)
+            self._reshard_state.pop(name, None)
+
+    def _abandon_reshard(self, name: str, state: Dict) -> None:
+        """A fault before anything destructive: pop the state FIRST
+        (DML stops double-writing immediately), then best-effort drop
+        the staging tables. The table keeps serving the OLD placement,
+        unfenced — the failed run simply never happened."""
+        with self._placement_lock:
+            self._reshard_state.pop(name, None)
+        old_dl = getattr(self._tl, "deadline", None)
+        self._tl.deadline = None
+        try:
+            for s, (_srcs, dst) in state["moves"].items():
+                try:
+                    self._call(dst, {
+                        "cmd": "exec",
+                        "sql": f"drop table if exists `{name}__bf{s}`"})
+                except Exception:  # noqa: BLE001 — worker may be gone;
+                    pass           # a later load re-clones over it
+        finally:
+            self._tl.deadline = old_dl
 
     def recover_reshard(self) -> Dict[str, str]:
-        """Finish interrupted reshards (coordinator 'restart' after a
-        phase-B fault): re-drive the idempotent applies on the workers
-        that still owe one, then install the new map. Tables that
-        recover report 'resharded'; still-failing ones stay fenced."""
+        """Finish interrupted ONLINE reshards from their per-shard
+        watermark: pending shards re-backfill, staged shards validate
+        and cut over, shards stuck in "cutover" re-drive their
+        idempotent purge/install. Tables that finish report
+        'resharded'; still-failing ones stay fenced on their stuck
+        shard."""
         with self._placement_lock:
-            pending = dict(self._reshard_pending)
+            pending = dict(self._reshard_state)
         out: Dict[str, str] = {}
         for name, state in pending.items():
             try:
-                self._finish_reshard(name, state)
+                self._drive_reshard(name, state)
                 out[name] = "resharded"
             except Exception:  # noqa: BLE001 — stays fenced; the next
                 continue       # recover_reshard() retries
         return out
+
+    def reshard_progress_rows(self) -> List[tuple]:
+        """information_schema.cluster_info rows: one per moved shard of
+        every in-flight reshard (operators watch cutover progress and
+        spot fenced shards), plus a fleet summary row."""
+        out: List[tuple] = []
+        with self._placement_lock:
+            snap = {n: (st["old"].version, st["new"].version,
+                        dict(st["shards"]),
+                        {s: m[1] for s, m in st["moves"].items()})
+                    for n, st in self._reshard_state.items()}
+        drain = self._draining
+        out.append(("__fleet__", -1, "serving", -1,
+                    -1, -1, len(self._socks),
+                    drain if drain is not None else -1))
+        for name in sorted(snap):
+            old_v, new_v, shards, dsts = snap[name]
+            for s in sorted(shards):
+                out.append((name, int(s), shards[s], int(dsts[s]),
+                            int(old_v), int(new_v), len(self._socks),
+                            drain if drain is not None else -1))
+        return out
+
+    def _rebuild_mirror(self, name: str, w: int) -> None:
+        """Re-mirror socket `w`'s slice of `name` into its replica's
+        `__part{w}` table from a fresh dump: after a cutover or a fleet
+        compaction, failover must serve the NEW placement — a stale
+        mirror would silently resurrect the old one."""
+        rep = self.replicas.get(int(w))
+        if rep is None or not (0 <= rep < len(self._socks)):
+            return
+        dump = self._call(int(w), {"cmd": "table_dump", "table": name})
+        self._call(rep, {
+            "cmd": "load_columns", "table": f"{name}__part{int(w)}",
+            "like": name, "replace": True, "arrays": dump["arrays"],
+            "valids": dump["valids"], "strings": dump["strings"]})
+
+    # -- elastic membership (ISSUE 19) ----------------------------------
+
+    def _placement_names(self) -> List[str]:
+        with self._placement_lock:
+            return sorted(self._placements)
+
+    def add_worker(self, host: str, port: int) -> int:
+        """Admit a new worker into the serving fleet: dial it, replay
+        the DDL history so its schema matches, seed the broadcast
+        tables, then rebalance every placed table onto the widened
+        fleet via the online reshard path (round-robin remap — the
+        co-location identity holds for the new W). Statements only
+        pause for the brief CLUSTER_GATE write window that appends the
+        socket; a failure during admission rolls the fleet back to W
+        workers, typed — never half-admitted. Returns the new index."""
+        from tidb_tpu.sharding.placement import with_n_workers
+        from tidb_tpu.utils.metrics import MEMBERSHIP_TOTAL
+
+        with self._membership_lock:
+            if self._draining is not None:
+                raise ExecutionError(
+                    "membership change already in progress (worker "
+                    f"{self._draining} is draining)")
+            inject("member.join")
+            sock = self._connect(host, port)
+            self._gates.acquire_write(CLUSTER_GATE)
+            try:
+                i = len(self._socks)
+                self._socks.append(sock)
+                self._endpoints.append((host, port))
+                self._sock_locks.append(threading.Lock())
+                self._health.append(_LinkHealth())
+                try:
+                    self._set_state(i, UP)
+                    for ddl_sql in list(self._ddl_log):
+                        self._call(i, {"cmd": "exec", "sql": ddl_sql})
+                    for t in sorted(self._broadcast):
+                        dump = self._call(0, {"cmd": "table_dump",
+                                              "table": t})
+                        self._call(i, {
+                            "cmd": "load_columns", "table": t,
+                            "replace": True, "arrays": dump["arrays"],
+                            "valids": dump["valids"],
+                            "strings": dump["strings"]})
+                except Exception as e:
+                    self._socks.pop()
+                    self._endpoints.pop()
+                    self._sock_locks.pop()
+                    self._health.pop()
+                    try:
+                        sock.close()
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                    raise ExecutionError(
+                        f"add_worker({host}:{port}) failed during "
+                        f"admission: {e}") from e
+            finally:
+                self._gates.release_write(CLUSTER_GATE)
+            MEMBERSHIP_TOTAL.inc(kind="join")
+            # rebalance each placed table onto the widened fleet,
+            # served through — the joiner starts taking real traffic
+            # shard by shard as cutovers land
+            for name in self._placement_names():
+                smap = self.placement(name)
+                if smap is not None \
+                        and smap.n_workers != len(self._socks):
+                    self._online_reshard(
+                        name, with_n_workers(smap, len(self._socks)))
+            return i
+
+    def remove_worker(self, j: int, graceful: bool = True) -> None:
+        """Drain worker `j` out of the fleet: every placed table
+        reshards online onto the surviving W-1 workers (the drain
+        translation keeps already-compacted maps routable mid-drain),
+        then the socket list compacts under the CLUSTER_GATE write
+        window and every replica mirror rebuilds against the new
+        placement. RESUMABLE: a fault mid-drain (the draining worker
+        dying included) degrades typed with `_draining` kept — tables
+        already moved keep serving the new placement, the rest the old
+        one — and a second remove_worker(j) picks up where it left
+        off. graceful=False skips the data move and is refused while
+        any sharded/partitioned table still places rows."""
+        from tidb_tpu.sharding.placement import with_n_workers
+        from tidb_tpu.utils.metrics import MEMBERSHIP_TOTAL
+
+        with self._membership_lock:
+            W = len(self._socks)
+            if not (0 <= j < W):
+                raise ExecutionError(f"remove_worker: no worker {j}")
+            if W <= 1:
+                raise ExecutionError(
+                    "remove_worker: cannot remove the last worker")
+            if self._draining is not None and self._draining != j:
+                raise ExecutionError(
+                    f"worker {self._draining} is already draining")
+            placed_names = self._placement_names()
+            loose = sorted(t for t in self._partitioned
+                           if t not in placed_names
+                           and t not in self._broadcast)
+            if loose:
+                # row-range tables placed by hand (load_partition) have
+                # no ShardMap to drive a drain — moving them silently
+                # would break the caller's explicit placement
+                raise UnsupportedError(
+                    f"remove_worker: table(s) {loose} are partitioned "
+                    "by hand (load_partition) — move them explicitly "
+                    "first")
+            if not graceful and placed_names:
+                raise UnsupportedError(
+                    "remove_worker(graceful=False) would strand rows "
+                    f"of {placed_names} — drain gracefully instead")
+            inject("member.drain")
+            self._draining = j
+            self._drain_xl = {c: (c if c < j else c + 1)
+                              for c in range(W - 1)}
+            if graceful:
+                for name in placed_names:
+                    smap = self.placement(name)
+                    if smap is not None and smap.n_workers == W:
+                        self._online_reshard(
+                            name, with_n_workers(smap, W - 1))
+            # finalize: compact the fleet under the cluster gate (no
+            # statement is mid-flight over the dying index)
+            self._gates.acquire_write(CLUSTER_GATE)
+            try:
+                sock = self._socks.pop(j)
+                self._endpoints.pop(j)
+                self._sock_locks.pop(j)
+                self._health.pop(j)
+                self.replicas = {
+                    (w if w < j else w - 1): (r if r < j else r - 1)
+                    for w, r in self.replicas.items()
+                    if w != j and r != j}
+                self._draining = None
+                self._drain_xl = None
+                try:
+                    if sock is not None:
+                        sock.close()
+                except Exception:  # noqa: BLE001 — already dead is fine
+                    pass
+            finally:
+                self._gates.release_write(CLUSTER_GATE)
+            MEMBERSHIP_TOTAL.inc(kind="remove")
+            # re-mirror every owner's slice in the COMPACTED index
+            # space: `__part{w}` names shifted for workers past j, and
+            # a failover must serve the new placement
+            for name in placed_names:
+                smap = self.placement(name)
+                if smap is not None:
+                    for w in sorted(smap.owners()):
+                        self._rebuild_mirror(name, w)
 
     def _shuffle_close_all(self, sid: str, targets) -> None:
         """Best-effort release of a shuffle's staged state fleet-wide
@@ -2811,11 +3514,14 @@ class Cluster:
 
     # -- distributed planning: owner pruning + exchange choice ----------
 
-    def _plan_query(self, sql: str) -> Dict:
+    def _plan_query(self, sql: str, session=None) -> Dict:
         """Owner-pruned targets and (when two sharded tables join) the
         exchange plan. Placement is snapshotted HERE, at statement
         start: a reshard racing this statement bumps the map version
-        but never changes routing mid-flight."""
+        but never changes routing mid-flight. The returned plan carries
+        the statement's topology read-gate in "gate" — query() releases
+        it when the statement finishes (a planning failure releases it
+        here)."""
         st = None
         tables: List = []
         try:
@@ -2825,33 +3531,56 @@ class Cluster:
                 tables = _from_tables(st.from_)
         except Exception:  # noqa: BLE001 — malformed/unsupported
             st, tables = None, []  # shapes: let partial_rewrite raise
-        self._check_reshard_fence([t.name for t in tables])
-        placed = {}
-        for t in tables:
-            m = self.placement(t.name)
-            if m is not None and t.name not in placed:
-                placed[t.name] = m
-        if st is not None and len(placed) >= 2:
-            return self._plan_shuffle(sql, st, tables, placed)
-        partial_sql, final_sql, _names = partial_rewrite(
-            sql, partitioned=self._partitioned, broadcast=self._broadcast,
-            parsed=[st] if st is not None else None)
-        targets = None
-        if len(placed) == 1:
-            name, smap = next(iter(placed.items()))
-            targets = [w for w in sorted(smap.owners())
-                       if w < len(self._socks)]
-            val, found = _shard_eq_value(st.where, name, smap.column)
-            if found:
-                w = smap.worker_of(smap.shard_of(val))
-                if w in targets:
-                    targets = [w]
-            from tidb_tpu.utils.metrics import SHARD_SCAN_TOTAL
+        names = [t.name for t in tables]
+        self._check_reshard_fence(names)
+        gate = self._acquire_read_gate(names, session)
+        try:
+            placed = {}
+            for t in tables:
+                m = self.placement(t.name)
+                if m is not None and t.name not in placed:
+                    placed[t.name] = m
+            if st is not None and len(placed) >= 2:
+                plan = self._plan_shuffle(sql, st, tables, placed)
+                plan["gate"] = gate
+                return plan
+            partial_sql, final_sql, _names = partial_rewrite(
+                sql, partitioned=self._partitioned,
+                broadcast=self._broadcast,
+                parsed=[st] if st is not None else None)
+            targets = None
+            if len(placed) == 1:
+                name, smap = next(iter(placed.items()))
+                targets = self._effective_owner_workers(name, smap)
+                val, found = _shard_eq_value(st.where, name, smap.column)
+                if found:
+                    snap = self._reshard_snapshot(name)
+                    if snap is not None:
+                        # pinned scan mid-reshard: a cut-over shard's
+                        # rows live at the new owner, everything else
+                        # still serves from the old one
+                        shards, _dw, moves, new = snap
+                        s_new = new.shard_of(val)
+                        if shards.get(s_new) == "done":
+                            targets = [moves[s_new][1]]
+                        else:
+                            targets = [self._owner_socket(
+                                smap,
+                                smap.worker_of(smap.shard_of(val)))]
+                    else:
+                        w = self._owner_socket(
+                            smap, smap.worker_of(smap.shard_of(val)))
+                        if w in targets:
+                            targets = [w]
+                from tidb_tpu.utils.metrics import SHARD_SCAN_TOTAL
 
-            pruned = len(targets) < len(self._socks)
-            SHARD_SCAN_TOTAL.inc(pruned="yes" if pruned else "no")
-        return {"partial_sql": partial_sql, "final_sql": final_sql,
-                "targets": targets, "shuffle": None}
+                pruned = len(targets) < len(self._socks)
+                SHARD_SCAN_TOTAL.inc(pruned="yes" if pruned else "no")
+            return {"partial_sql": partial_sql, "final_sql": final_sql,
+                    "targets": targets, "shuffle": None, "gate": gate}
+        except BaseException:
+            self._gates.release_read(gate)
+            raise
 
     def _resolve_ename(self, e: A.EName, tables, cols_by_table):
         """Base table an EName belongs to (qualifier match first, else
@@ -2937,7 +3666,13 @@ class Cluster:
         small, big = names[0], names[1]
         modes: Dict[str, str] = {}
         for n in placed:
-            if placed[n].colocated_on(keys[n]):
+            # co-location only holds when the map was resolved against
+            # the CURRENT fleet width and no shard is mid-flight to a
+            # different owner (reshard/drain): otherwise re-shuffle —
+            # the scatter sources below cover both placements
+            if placed[n].colocated_on(keys[n]) \
+                    and placed[n].n_workers == W \
+                    and not self._mid_reshard(n):
                 modes[n] = "local"
         if len(modes) < 2:
             if not modes and bytes_[small] <= self.BROADCAST_LIMIT_BYTES \
@@ -2956,7 +3691,7 @@ class Cluster:
             targets = list(range(W))
         else:
             loc = next(n for n in placed if modes[n] == "local")
-            targets = [w for w in sorted(placed[loc].owners()) if w < W]
+            targets = self._effective_owner_workers(loc, placed[loc])
         renames: Dict[str, str] = {}
         sides: List[Dict] = []
         scatter: List[Tuple[int, Dict]] = []
@@ -2971,9 +3706,7 @@ class Cluster:
                           "columns": cols})
             wire_map = {"kind": "hash", "column": keys[n], "shards": W,
                         "n_workers": W, "bounds": [], "version": 0}
-            for w in sorted(placed[n].owners()):
-                if w >= W:
-                    continue
+            for w in self._effective_owner_workers(n, placed[n]):
                 msg = {"cmd": "shuffle_scatter", "shuffle_id": sid,
                        "table": n, "side": n, "columns": cols,
                        "n_workers": W, "self_index": w, "peers": peers}
@@ -3122,7 +3855,7 @@ class Cluster:
         sharded tables runs as a cross-process SHUFFLE (or broadcast,
         when the smaller side is cheaper to replicate) with the partial
         agg computed over each worker's co-partitioned slice."""
-        plan = self._plan_query(sql)
+        plan = self._plan_query(sql, session)
         partial_sql, final_sql = plan["partial_sql"], plan["final_sql"]
 
         rpc_timeout = self.rpc_timeout_s
@@ -3206,6 +3939,8 @@ class Cluster:
                 # asserts zero retained)
                 self._shuffle_close_all(shuffle["id"],
                                         range(len(self._socks)))
+            if plan.get("gate") is not None:
+                self._gates.release_read(plan["gate"])
             self._tl.deadline = old_dl
             self._tl.rpc_timeout = old_to
             self._finish_query_trace(tr, root_span, owns_trace, err,
@@ -3318,63 +4053,64 @@ class Cluster:
             raise interrupted
 
         s = self._merge_session
-        s.execute("drop table if exists __dcn_partial__")
-        ddl_done = schema_sql is not None
-        if ddl_done:
-            s.execute(schema_sql)
-        else:
-            # infer column types from the union of every partition's
-            # FIRST page — one partition may be all-NULL in a column
-            # another types (the old all-rows inference saw everything;
-            # sampling only partition 0 would mistype such columns)
-            sample = [r for f in firsts if f is not None for r in f["rows"]]
-            if sample:
-                s.execute(self._infer_staging_ddl(partial_sql, sample))
-                ddl_done = True
-        staging = None
+        with self._merge_lock:
+            s.execute("drop table if exists __dcn_partial__")
+            ddl_done = schema_sql is not None
+            if ddl_done:
+                s.execute(schema_sql)
+            else:
+                # infer column types from the union of every partition's
+                # FIRST page — one partition may be all-NULL in a column
+                # another types (the old all-rows inference saw everything;
+                # sampling only partition 0 would mistype such columns)
+                sample = [r for f in firsts if f is not None for r in f["rows"]]
+                if sample:
+                    s.execute(self._infer_staging_ddl(partial_sql, sample))
+                    ddl_done = True
+            staging = None
 
-        def ingest(rows: List[tuple]) -> None:
-            nonlocal ddl_done, staging
-            if not rows:
-                return
+            def ingest(rows: List[tuple]) -> None:
+                nonlocal ddl_done, staging
+                if not rows:
+                    return
+                if not ddl_done:
+                    s.execute(self._infer_staging_ddl(partial_sql, rows))
+                    ddl_done = True
+                if staging is None:
+                    staging = s.catalog.table(s.db, "__dcn_partial__")
+                for st in range(0, len(rows), 4096):
+                    staging.insert_rows(rows[st: st + 4096])
+
+            # every cursor this query opens — on primaries AND replicas — is
+            # tracked here until fully drained; the finally block releases
+            # whatever a failure left behind, so no worker pins a partial
+            # until the TTL (one worker can hold two entries: its own
+            # partition's cursor and a replica partition's)
+            open_cursors: List = [[i, f["cursor"]] for i, f in enumerate(firsts)
+                                  if f is not None and f.get("cursor") is not None]
+
+            # drain one partition at a time; a partition is ingested only
+            # after it arrived completely, so mid-drain failover can re-run
+            # it on the replica without duplicating staged rows
+            try:
+                for i in ws:
+                    r = cancel_reason()
+                    if r is not None:
+                        self.cancel_tokens(tokens)
+                        raise r
+                    with tracing.span(f"dcn.drain[w{i}]") as drain_sp:
+                        self._drain_one(i, firsts, errs, open_cursors, sql,
+                                        cancel_reason, tokens, partial_ok,
+                                        session, ingest, drain_sp,
+                                        failover_ok)
+            finally:
+                for ent in open_cursors:
+                    self._close_cursor(*ent)
+
             if not ddl_done:
-                s.execute(self._infer_staging_ddl(partial_sql, rows))
-                ddl_done = True
-            if staging is None:
-                staging = s.catalog.table(s.db, "__dcn_partial__")
-            for st in range(0, len(rows), 4096):
-                staging.insert_rows(rows[st: st + 4096])
-
-        # every cursor this query opens — on primaries AND replicas — is
-        # tracked here until fully drained; the finally block releases
-        # whatever a failure left behind, so no worker pins a partial
-        # until the TTL (one worker can hold two entries: its own
-        # partition's cursor and a replica partition's)
-        open_cursors: List = [[i, f["cursor"]] for i, f in enumerate(firsts)
-                              if f is not None and f.get("cursor") is not None]
-
-        # drain one partition at a time; a partition is ingested only
-        # after it arrived completely, so mid-drain failover can re-run
-        # it on the replica without duplicating staged rows
-        try:
-            for i in ws:
-                r = cancel_reason()
-                if r is not None:
-                    self.cancel_tokens(tokens)
-                    raise r
-                with tracing.span(f"dcn.drain[w{i}]") as drain_sp:
-                    self._drain_one(i, firsts, errs, open_cursors, sql,
-                                    cancel_reason, tokens, partial_ok,
-                                    session, ingest, drain_sp,
-                                    failover_ok)
-        finally:
-            for ent in open_cursors:
-                self._close_cursor(*ent)
-
-        if not ddl_done:
-            s.execute(self._infer_staging_ddl(partial_sql, []))
-        with tracing.span("dcn.final_merge"):
-            return s.query(final_sql)
+                s.execute(self._infer_staging_ddl(partial_sql, []))
+            with tracing.span("dcn.final_merge"):
+                return s.query(final_sql)
 
     def _drain_one(self, i, firsts, errs, open_cursors, sql,
                    cancel_reason, tokens, partial_ok, session, ingest,

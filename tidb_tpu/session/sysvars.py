@@ -265,6 +265,12 @@ _reg(
     # query (default, exact results) or serve the reachable partitions
     # with a warning (availability over completeness)
     SysVar("tidb_tpu_dcn_partial_results", False, BOTH, "bool"),
+    # bound on a statement's wait for a topology-change gate (online
+    # reshard backfill/cutover window, membership finalize), ms: past
+    # it the statement degrades TYPED ("topology change in progress")
+    # instead of hanging behind a stuck cutover
+    SysVar("tidb_tpu_reshard_gate_wait_ms", 10000, BOTH, "int",
+           min_=0, max_=1 << 31),
     SysVar("tx_isolation", "REPEATABLE-READ", BOTH, "str"),
     SysVar("transaction_isolation", "REPEATABLE-READ", BOTH, "str"),
     SysVar("character_set_client", "utf8mb4", BOTH, "str"),

@@ -27,7 +27,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = ["ShardMap", "shard_of_array", "shard_of_value",
-           "worker_of_shard", "owners_by_worker"]
+           "worker_of_shard", "owners_by_worker", "with_n_workers",
+           "shards_to_move"]
 
 # keep in sync with parallel/distsql._HASH_MULT — co-location between a
 # hash placement and a hash shuffle depends on the identical mix
@@ -126,4 +127,40 @@ def owners_by_worker(shards: int, n_workers: int) -> Dict[int, List[int]]:
     out: Dict[int, List[int]] = {}
     for s in range(shards):
         out.setdefault(worker_of_shard(s, n_workers), []).append(s)
+    return out
+
+
+def with_n_workers(smap: ShardMap, n_workers: int) -> ShardMap:
+    """Same placement math, re-resolved against a different fleet width
+    (membership change): shard ids are untouched — only the round-robin
+    shard->worker assignment moves, which keeps the co-location identity
+    `(mix(k) % (m*W')) % W' == mix(k) % W'` intact for the NEW W'.
+    Bumps `version` so cached plans demote like any other map change."""
+    return ShardMap(kind=smap.kind, column=smap.column, shards=smap.shards,
+                    n_workers=int(n_workers), bounds=smap.bounds,
+                    version=smap.version + 1)
+
+
+def shards_to_move(old: ShardMap, new: ShardMap) -> Dict[int, List[int]]:
+    """Online-reshard work list: NEW-map shard id -> the old-map workers
+    whose live rows can contain that shard's keys (the backfill sources).
+
+    When only the fleet width changed (same kind/column/shards/bounds),
+    each new shard IS an old shard, so its single source is its old
+    owner — and shards whose owner doesn't move are skipped entirely.
+    When the shard function itself changed (count, kind, or bounds),
+    any old shard can contribute rows to any new shard, so every new
+    shard backfills from every old owner."""
+    same_fn = (old.kind == new.kind and old.column == new.column
+               and old.shards == new.shards and old.bounds == new.bounds)
+    out: Dict[int, List[int]] = {}
+    old_workers = sorted(old.owners())
+    for s in range(new.shards):
+        if same_fn:
+            src = worker_of_shard(s, old.n_workers)
+            if src == worker_of_shard(s, new.n_workers):
+                continue  # owner unchanged: nothing moves
+            out[s] = [src]
+        else:
+            out[s] = list(old_workers)
     return out

@@ -1034,6 +1034,29 @@ class Catalog:
                  ("error", STRING)],
                 rows,
             )
+        if name == "cluster_info":
+            # topology / online-reshard progress (ISSUE 19): a fleet
+            # summary row per live coordinator plus one row per moved
+            # shard of every in-flight reshard — operators watch
+            # cutover progress and spot a fault-fenced shard (state =
+            # "cutover") here. No listing guard needed: local
+            # coordinator memory, reading it fans out nothing.
+            rows = []
+            if not listing:
+                from tidb_tpu.parallel.dcn import clusters_alive
+
+                for cl in clusters_alive():
+                    try:
+                        rows.extend(cl.reshard_progress_rows())
+                    except Exception:  # noqa: BLE001 — a dying
+                        continue       # coordinator shows no rows
+            return make(
+                [("table_name", STRING), ("shard", INT64),
+                 ("state", STRING), ("dst_worker", INT64),
+                 ("old_version", INT64), ("new_version", INT64),
+                 ("workers", INT64), ("draining", INT64)],
+                rows,
+            )
         if name == "digest_latency":
             # per-digest latency SLO store (ISSUE 16): sliding-window
             # percentiles + burn ratio against tidb_tpu_slo_target_ms.
@@ -1079,7 +1102,8 @@ _INFO_TABLES = ("schemata", "tables", "columns", "statistics", "slow_query",
                 "key_column_usage", "referential_constraints",
                 "partitions", "processlist", "statements_summary",
                 "cluster_trace", "dcn_worker_stats", "scheduler_stats",
-                "plan_feedback", "cluster_metrics", "digest_latency")
+                "plan_feedback", "cluster_metrics", "digest_latency",
+                "cluster_info")
 
 
 class SessionCatalog:

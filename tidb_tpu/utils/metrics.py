@@ -549,6 +549,23 @@ SHARD_SCAN_TOTAL = Counter(
     "Distributed statements planned against SHARD BY placement, by "
     "whether owner pruning skipped part of the fleet (pruned=yes: at "
     "least one non-owner worker received no RPC and did no work)")
+RESHARD_SHARDS_TOTAL = Counter(
+    "tidb_tpu_reshard_shards_total",
+    "Per-shard online-reshard steps completed, by phase: backfill = "
+    "shard snapshot staged at its new owner (double-write window "
+    "opened), cutover = shard validated and flipped to the new "
+    "placement")
+RESHARD_ACTIVE = Gauge(
+    "tidb_tpu_reshard_active",
+    "1 while the labeled table has an online reshard in flight "
+    "(statements keep serving by the old map; DML double-writes moved "
+    "shards), 0 once the new placement is installed or the run "
+    "abandoned")
+MEMBERSHIP_TOTAL = Counter(
+    "tidb_tpu_membership_total",
+    "Cluster membership changes completed, by kind: join = "
+    "add_worker admitted a new worker into the serving fleet, remove "
+    "= remove_worker drained one out")
 
 # -- columnar segment store (ISSUE 8) ---------------------------------------
 
